@@ -1,0 +1,145 @@
+"""Core ChangeItem model tests (cf. reference changeitem/change_item_test.go)."""
+
+import pytest
+
+from transferia_tpu.abstract import (
+    ChangeItem,
+    Kind,
+    OldKeys,
+    TableID,
+    collapse,
+    split_by_table_id,
+)
+from transferia_tpu.abstract.change_item import (
+    done_table_load,
+    init_table_load,
+    split_by_id,
+)
+from transferia_tpu.abstract.schema import new_table_schema
+
+
+SCHEMA = new_table_schema([
+    ("id", "int64", True),
+    ("name", "utf8"),
+    ("score", "double"),
+])
+
+
+def row(kind, id_, name=None, score=None, lsn=0, old_id=None):
+    return ChangeItem(
+        kind=kind,
+        schema="public",
+        table="users",
+        column_names=("id", "name", "score"),
+        column_values=(id_, name, score),
+        table_schema=SCHEMA,
+        lsn=lsn,
+        old_keys=OldKeys(("id",), (old_id,)) if old_id is not None else OldKeys(),
+    )
+
+
+def test_table_id_and_values():
+    it = row(Kind.INSERT, 1, "alice", 9.5)
+    assert it.table_id == TableID("public", "users")
+    assert it.value("name") == "alice"
+    assert it.value("missing") is None
+    assert it.as_dict() == {"id": 1, "name": "alice", "score": 9.5}
+    assert it.key_values() == (1,)
+    assert it.is_row_event()
+    assert not it.is_system()
+
+
+def test_control_events():
+    tid = TableID("public", "users")
+    init = init_table_load(tid, SCHEMA, part_id="p0")
+    done = done_table_load(tid, SCHEMA, part_id="p0")
+    assert init.kind == Kind.INIT_TABLE_LOAD and init.is_system()
+    assert init.part_id == "p0"
+    assert not done.is_row_event()
+    assert init.table_id == tid
+
+
+def test_effective_key_uses_old_keys():
+    upd = row(Kind.UPDATE, 2, "bob", 1.0, old_id=1)
+    assert upd.effective_key() == (1,)
+    assert upd.key_values() == (2,)
+    assert upd.keys_changed()
+
+
+def test_remove_columns():
+    it = row(Kind.INSERT, 1, "alice", 9.5)
+    slim = it.remove_columns(["score"])
+    assert slim.column_names == ("id", "name")
+    assert slim.table_schema.names() == ["id", "name"]
+
+
+def test_json_roundtrip():
+    it = row(Kind.UPDATE, 2, "bob", 1.5, lsn=42, old_id=2)
+    d = it.to_json()
+    back = ChangeItem.from_json(d)
+    assert back.kind == Kind.UPDATE
+    assert back.as_dict() == it.as_dict()
+    assert back.lsn == 42
+    assert back.old_keys.as_dict() == {"id": 2}
+    assert back.table_schema == SCHEMA
+
+
+def test_split_by_table_id():
+    a = row(Kind.INSERT, 1)
+    b = ChangeItem(kind=Kind.INSERT, schema="public", table="other",
+                   table_schema=SCHEMA)
+    groups = split_by_table_id([a, b, a])
+    assert len(groups) == 2
+    assert len(groups[TableID("public", "users")]) == 2
+
+
+def test_split_by_id_groups_consecutive_txns():
+    items = [
+        ChangeItem(kind=Kind.INSERT, txn_id="t1", lsn=1),
+        ChangeItem(kind=Kind.INSERT, txn_id="t1", lsn=1),
+        ChangeItem(kind=Kind.INSERT, txn_id="t2", lsn=2),
+    ]
+    groups = split_by_id(items)
+    assert [len(g) for g in groups] == [2, 1]
+
+
+class TestCollapse:
+    def test_insert_then_update_folds_to_insert(self):
+        items = [row(Kind.INSERT, 1, "a", 1.0), row(Kind.UPDATE, 1, "a2", 2.0)]
+        out = collapse(items)
+        assert len(out) == 1
+        assert out[0].kind == Kind.INSERT
+        assert out[0].as_dict() == {"id": 1, "name": "a2", "score": 2.0}
+
+    def test_insert_then_delete_vanishes(self):
+        out = collapse([row(Kind.INSERT, 1, "a", 1.0), row(Kind.DELETE, 1)])
+        assert out == []
+
+    def test_delete_without_insert_stays(self):
+        out = collapse([row(Kind.UPDATE, 1, "x", 0.0), row(Kind.DELETE, 1)])
+        assert len(out) == 1
+        assert out[0].kind == Kind.DELETE
+
+    def test_distinct_keys_preserved_in_order(self):
+        items = [row(Kind.INSERT, 2, "b", 0.0), row(Kind.INSERT, 1, "a", 0.0)]
+        out = collapse(items)
+        assert [o.value("id") for o in out] == [2, 1]
+
+    def test_key_change_passthrough(self):
+        items = [row(Kind.INSERT, 1, "a", 0.0),
+                 row(Kind.UPDATE, 2, "a", 0.0, old_id=1)]
+        out = collapse(items)
+        assert len(out) == 2  # not collapsed across key change
+
+    def test_no_pk_passthrough(self):
+        schema = new_table_schema([("v", "int64")])
+        items = [
+            ChangeItem(kind=Kind.INSERT, table="t", column_names=("v",),
+                       column_values=(i,), table_schema=schema)
+            for i in range(3)
+        ]
+        assert collapse(items) == items
+
+    def test_control_passthrough(self):
+        items = [init_table_load(TableID("", "t"))]
+        assert collapse(items) == items

@@ -1,0 +1,152 @@
+"""ColumnBatch: pivot/unpivot, arrow interop, functional ops."""
+
+import numpy as np
+import pytest
+
+from transferia_tpu.abstract import ChangeItem, Kind, TableID
+from transferia_tpu.abstract.schema import CanonicalType, new_table_schema
+from transferia_tpu.columnar import Column, ColumnBatch, bucket_rows
+
+
+SCHEMA = new_table_schema([
+    ("id", "int64", True),
+    ("name", "utf8"),
+    ("score", "double"),
+    ("payload", "any"),
+])
+TID = TableID("public", "users")
+
+
+def make_batch(n=4):
+    return ColumnBatch.from_pydict(TID, SCHEMA, {
+        "id": list(range(n)),
+        "name": [f"user{i}" for i in range(n)],
+        "score": [i * 1.5 for i in range(n)],
+        "payload": [{"k": i} for i in range(n)],
+    })
+
+
+def test_from_pydict_and_back():
+    b = make_batch()
+    assert b.n_rows == 4
+    d = b.to_pydict()
+    assert d["id"] == [0, 1, 2, 3]
+    assert d["name"] == ["user0", "user1", "user2", "user3"]
+    assert d["payload"][2] == {"k": 2}
+
+
+def test_nulls_roundtrip():
+    b = ColumnBatch.from_pydict(TID, SCHEMA, {
+        "id": [1, None, 3],
+        "name": ["a", None, "c"],
+        "score": [None, 2.0, None],
+        "payload": [None, None, {"x": 1}],
+    })
+    d = b.to_pydict()
+    assert d["id"] == [1, None, 3]
+    assert d["name"] == ["a", None, "c"]
+    assert d["score"] == [None, 2.0, None]
+    assert d["payload"] == [None, None, {"x": 1}]
+
+
+def test_pivot_unpivot_roundtrip():
+    items = [
+        ChangeItem(
+            kind=Kind.INSERT, schema="public", table="users",
+            column_names=("id", "name", "score", "payload"),
+            column_values=(i, f"u{i}", i * 0.5, {"i": i}),
+            table_schema=SCHEMA, lsn=100 + i,
+        )
+        for i in range(3)
+    ]
+    b = ColumnBatch.from_rows(items)
+    assert b.n_rows == 3
+    assert b.kinds is None  # pure inserts
+    back = b.to_rows()
+    assert [r.as_dict() for r in back] == [i.as_dict() for i in items]
+    assert [r.lsn for r in back] == [100, 101, 102]
+
+
+def test_mixed_kinds_pivot():
+    items = [
+        ChangeItem(kind=k, schema="public", table="users",
+                   column_names=("id", "name", "score", "payload"),
+                   column_values=(i, "x", 0.0, None), table_schema=SCHEMA)
+        for i, k in enumerate([Kind.INSERT, Kind.UPDATE, Kind.DELETE])
+    ]
+    b = ColumnBatch.from_rows(items)
+    assert b.kinds is not None
+    assert [b.kind_at(i) for i in range(3)] == [
+        Kind.INSERT, Kind.UPDATE, Kind.DELETE
+    ]
+
+
+def test_filter_and_take():
+    b = make_batch(6)
+    f = b.filter(np.array([True, False, True, False, True, False]))
+    assert f.n_rows == 3
+    assert f.to_pydict()["id"] == [0, 2, 4]
+    assert f.to_pydict()["name"] == ["user0", "user2", "user4"]
+    t = b.take(np.array([3, 1]))
+    assert t.to_pydict()["name"] == ["user3", "user1"]
+
+
+def test_project_and_concat():
+    b = make_batch(2)
+    p = b.project(["id", "name"])
+    assert list(p.columns) == ["id", "name"]
+    assert p.schema.names() == ["id", "name"]
+    c = ColumnBatch.concat([make_batch(2), make_batch(3)])
+    assert c.n_rows == 5
+    assert c.to_pydict()["id"] == [0, 1, 0, 1, 2]
+
+
+def test_slice():
+    b = make_batch(5)
+    s = b.slice(1, 3)
+    assert s.to_pydict()["id"] == [1, 2]
+
+
+def test_arrow_roundtrip():
+    b = make_batch(4)
+    rb = b.to_arrow()
+    assert rb.num_rows == 4
+    back = ColumnBatch.from_arrow(rb, TID, SCHEMA)
+    assert back.to_pydict()["name"] == b.to_pydict()["name"]
+    assert back.to_pydict()["score"] == b.to_pydict()["score"]
+
+
+def test_arrow_import_infers_schema():
+    import pyarrow as pa
+
+    rb = pa.record_batch({
+        "a": pa.array([1, 2, 3], type=pa.int32()),
+        "s": pa.array(["x", "yy", None]),
+    })
+    b = ColumnBatch.from_arrow(rb, TableID("", "t"))
+    assert b.schema.find("a").data_type == CanonicalType.INT32
+    assert b.schema.find("s").data_type == CanonicalType.UTF8
+    assert b.to_pydict()["s"] == ["x", "yy", None]
+
+
+def test_var_width_layout_is_flat_bytes():
+    b = make_batch(3)
+    col = b.column("name")
+    assert col.data.dtype == np.uint8
+    assert col.offsets is not None and col.offsets.dtype == np.int32
+    assert bytes(col.data[col.offsets[1]:col.offsets[2]]) == b"user1"
+
+
+def test_bucket_rows():
+    assert bucket_rows(1) == 256
+    assert bucket_rows(256) == 256
+    assert bucket_rows(257) == 1024
+    assert bucket_rows(2_000_000) % 1048576 == 0
+
+
+def test_ragged_batch_rejected():
+    with pytest.raises(ValueError, match="ragged"):
+        ColumnBatch(TID, SCHEMA, {
+            "id": Column.from_pylist("id", CanonicalType.INT64, [1, 2]),
+            "name": Column.from_pylist("name", CanonicalType.UTF8, ["a"]),
+        })

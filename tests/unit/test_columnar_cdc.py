@@ -1,0 +1,79 @@
+"""CDC-specific columnar behavior: old_keys/txn_id preservation, collapse
+delete semantics, overflow guard (regression tests for review findings)."""
+
+import numpy as np
+import pytest
+
+from transferia_tpu.abstract import ChangeItem, Kind, OldKeys, TableID, collapse
+from transferia_tpu.abstract.schema import new_table_schema
+from transferia_tpu.columnar import ColumnBatch
+from transferia_tpu.columnar.batch import _offsets_from_lengths
+
+
+SCHEMA = new_table_schema([("id", "int64", True), ("v", "utf8")])
+
+
+def _row(kind, id_, v=None, old_id=None, txn=""):
+    return ChangeItem(
+        kind=kind, schema="s", table="t",
+        column_names=("id", "v"), column_values=(id_, v),
+        table_schema=SCHEMA, txn_id=txn,
+        old_keys=OldKeys(("id",), (old_id,)) if old_id is not None else OldKeys(),
+    )
+
+
+def test_pivot_preserves_old_keys_and_txn_id():
+    items = [
+        _row(Kind.INSERT, 1, "a", txn="t1"),
+        _row(Kind.DELETE, None, old_id=7, txn="t2"),
+        _row(Kind.UPDATE, 3, "c", old_id=2, txn="t3"),
+    ]
+    b = ColumnBatch.from_rows(items)
+    back = b.to_rows()
+    assert back[1].kind == Kind.DELETE
+    assert back[1].old_keys.as_dict() == {"id": 7}
+    assert back[1].effective_key() == (7,)
+    assert back[2].old_keys.as_dict() == {"id": 2}
+    assert [r.txn_id for r in back] == ["t1", "t2", "t3"]
+    # survives take/concat
+    t = ColumnBatch.concat([b, b]).take(np.array([1, 4]))
+    rows = t.to_rows()
+    assert all(r.old_keys.as_dict() == {"id": 7} for r in rows)
+    assert all(r.txn_id == "t2" for r in rows)
+
+
+def test_mixed_schema_rejected():
+    other = new_table_schema([("id", "int64", True)])
+    a = _row(Kind.INSERT, 1, "a")
+    b = ChangeItem(kind=Kind.INSERT, schema="s", table="t",
+                   column_names=("id",), column_values=(2,),
+                   table_schema=other)
+    with pytest.raises(ValueError, match="mixed table schemas"):
+        ColumnBatch.from_rows([a, b])
+
+
+def test_collapse_delete_insert_delete_keeps_delete():
+    out = collapse([
+        _row(Kind.DELETE, 1),
+        _row(Kind.INSERT, 1, "x"),
+        _row(Kind.DELETE, 1),
+    ])
+    assert len(out) == 1 and out[0].kind == Kind.DELETE
+
+
+def test_collapse_delete_then_insert_keeps_insert():
+    out = collapse([_row(Kind.DELETE, 1), _row(Kind.INSERT, 1, "new")])
+    assert [o.kind for o in out] == [Kind.INSERT]
+
+
+def test_offsets_overflow_guarded():
+    with pytest.raises(ValueError, match="2GiB"):
+        _offsets_from_lengths(np.array([2**30, 2**30, 2**30], dtype=np.int64))
+
+
+def test_fingerprint_includes_properties():
+    from transferia_tpu.abstract.schema import ColSchema, CanonicalType, TableSchema
+
+    a = TableSchema([ColSchema("x", CanonicalType.INT64)])
+    b = TableSchema([ColSchema("x", CanonicalType.INT64, properties=(("k", "v"),))])
+    assert a.fingerprint() != b.fingerprint()

@@ -1,0 +1,57 @@
+"""Typesystem rules + versioned fallbacks."""
+
+from transferia_tpu.abstract.schema import CanonicalType, new_table_schema
+from transferia_tpu.abstract import TableID
+from transferia_tpu.columnar import ColumnBatch
+from transferia_tpu.typesystem import (
+    Fallback,
+    fallbacks_for,
+    map_source_type,
+    map_target_type,
+    register_fallback,
+    register_source_rules,
+    register_target_rules,
+)
+
+
+def test_source_rules_exact_and_parametric():
+    register_source_rules("testdb", {
+        "bigint": CanonicalType.INT64,
+        "varchar": CanonicalType.UTF8,
+        "*": CanonicalType.ANY,
+    })
+    assert map_source_type("testdb", "bigint") == CanonicalType.INT64
+    assert map_source_type("testdb", "varchar(255)") == CanonicalType.UTF8
+    assert map_source_type("testdb", "weirdtype") == CanonicalType.ANY
+    assert map_source_type("nonexistent", "x") == CanonicalType.ANY
+
+
+def test_target_rules():
+    register_target_rules("testsink", {
+        CanonicalType.INT64: "Int64",
+        CanonicalType.UTF8: "String",
+    })
+    assert map_target_type("testsink", CanonicalType.INT64) == "Int64"
+    assert map_target_type("testsink", CanonicalType.DOUBLE) == "double"
+
+
+def test_versioned_fallbacks():
+    calls = []
+
+    def downgrade(batch):
+        calls.append(1)
+        return batch
+
+    register_fallback(Fallback(
+        name="testdb_date_as_string", since=2, provider="testdb",
+        side="source", apply=downgrade,
+    ))
+    # transfer pinned before the change gets the fallback
+    assert [f.name for f in fallbacks_for("testdb", "source", 1)] == [
+        "testdb_date_as_string"
+    ]
+    # up-to-date transfer does not
+    assert fallbacks_for("testdb", "source", 2) == []
+    # other provider/side does not
+    assert fallbacks_for("otherdb", "source", 1) == []
+    assert fallbacks_for("testdb", "target", 1) == []

@@ -1,0 +1,29 @@
+"""transferia-tpu: a TPU-native data-ingestion (EL(T)) framework.
+
+Brand-new framework with the capabilities of the reference Go engine
+(transferia/transferia): snapshot + CDC replication between DBMSes, object
+stores and message brokers, with pluggable providers, transformers, parsers
+and a coordinator-based sharded dataplane.  Unlike the reference — whose data
+plane is hand-optimized row-oriented Go — this framework's currency is the
+columnar `ColumnBatch` and its hot path (parsing, the transformer chain,
+encode/decode) compiles to XLA/Pallas kernels via JAX.
+
+Layer map (cf. SURVEY.md §1 for the reference equivalents):
+  abstract/     core data model: ChangeItem, TableSchema, Source/Sink/Storage
+  columnar/     ColumnBatch (Arrow-style columnar block) + row pivot
+  typesystem/   canonical type lattice, per-provider rules, versioned fallbacks
+  models/       Transfer/Endpoint model, runtimes
+  coordinator/  control-plane KV/queue (memory, filestore)
+  middlewares/  sink pipeline combinators
+  transform/    transformer framework + registry (JAX compute path)
+  parsers/      queue payload -> ChangeItems (vectorized)
+  serializers/  ChangeItems -> bytes
+  providers/    connector plugins
+  tasks/        operations: activate, snapshot loader, upload, checksum
+  runtime/      local replication worker, strategies
+  parallel/     device mesh sharding of the transform step
+  ops/          jax/pallas kernels (hashing, predicates, string ops)
+  cli/          trtpu command-line interface
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,65 @@
+"""Core data model (reference: pkg/abstract/, pkg/abstract/changeitem/)."""
+
+from transferia_tpu.abstract.kinds import Kind
+from transferia_tpu.abstract.schema import (
+    CanonicalType,
+    ColSchema,
+    TableID,
+    TableSchema,
+)
+from transferia_tpu.abstract.change_item import (
+    ChangeItem,
+    OldKeys,
+    collapse,
+    split_by_id,
+    split_by_table_id,
+)
+from transferia_tpu.abstract.table import (
+    TableDescription,
+    OperationTablePart,
+)
+from transferia_tpu.abstract.errors import (
+    FatalError,
+    AbortTransferError,
+    TableUploadError,
+    CodedError,
+    is_fatal,
+)
+from transferia_tpu.abstract.interfaces import (
+    AsyncSink,
+    IncrementalStorage,
+    SampleableStorage,
+    ShardingStorage,
+    Sinker,
+    Source,
+    Storage,
+    Pusher,
+)
+
+__all__ = [
+    "Kind",
+    "CanonicalType",
+    "ColSchema",
+    "TableID",
+    "TableSchema",
+    "ChangeItem",
+    "OldKeys",
+    "collapse",
+    "split_by_id",
+    "split_by_table_id",
+    "TableDescription",
+    "OperationTablePart",
+    "FatalError",
+    "AbortTransferError",
+    "TableUploadError",
+    "CodedError",
+    "is_fatal",
+    "AsyncSink",
+    "Sinker",
+    "Source",
+    "Storage",
+    "Pusher",
+    "ShardingStorage",
+    "IncrementalStorage",
+    "SampleableStorage",
+]

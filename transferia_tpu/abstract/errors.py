@@ -1,0 +1,79 @@
+"""Error taxonomy.
+
+Reference parity: pkg/abstract/errors.go (fatal markers), pkg/errors/
+(categorized + coded errors).  Fatal errors terminate replication instead of
+being retried (runtime/local/replication.go:120-131); coded errors carry a
+stable machine-readable code for operators.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class TransferError(Exception):
+    """Base class for framework errors."""
+
+
+class FatalError(TransferError):
+    """Non-retriable: replication must stop and the transfer be failed."""
+
+
+class AbortTransferError(FatalError):
+    """Operator-visible abort (bad config, incompatible schema)."""
+
+
+class CodedError(TransferError):
+    """Error with a stable code (pkg/errors/coded)."""
+
+    def __init__(self, code: str, message: str, fatal: bool = False):
+        super().__init__(f"[{code}] {message}")
+        self.code = code
+        self.fatal = fatal
+
+
+# Stable codes (pkg/errors/codes) — extend as providers land.
+class Codes:
+    GENERIC_NO_PKEY = "generic.no_primary_key"
+    MAIN_WORKER_RESTART = "runtime.main_worker_restart"
+    UNPARSEABLE = "parser.unparseable"
+    MISSING_DATA_TRANSFORMATION = "transformer.missing_data"
+    DIAL_TIMEOUT = "network.dial_timeout"
+    DROP_NOT_ALLOWED = "target.drop_not_allowed"
+    TABLE_SPLIT_FAILED = "storage.table_split_failed"
+
+
+class TableUploadError(TransferError):
+    """Per-part upload failure; retried with backoff by the snapshot loader."""
+
+    def __init__(self, message: str, cause: Optional[BaseException] = None):
+        super().__init__(message)
+        self.cause = cause
+
+
+class CategorizedError(TransferError):
+    """Error attributed to source / target / internal (pkg/errors/categories)."""
+
+    SOURCE = "source"
+    TARGET = "target"
+    INTERNAL = "internal"
+
+    def __init__(self, category: str, message: str,
+                 cause: Optional[BaseException] = None):
+        super().__init__(f"({category}) {message}")
+        self.category = category
+        self.cause = cause
+
+
+def is_fatal(err: BaseException) -> bool:
+    """abstract.IsFatal — walks the cause chain."""
+    seen = set()
+    cur: Optional[BaseException] = err
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        if isinstance(cur, FatalError):
+            return True
+        if isinstance(cur, CodedError) and cur.fatal:
+            return True
+        cur = cur.__cause__ or getattr(cur, "cause", None)
+    return False

@@ -1,0 +1,217 @@
+"""Core dataplane contracts.
+
+Reference parity: pkg/abstract/source.go:3 (Source), sink.go:14 (Sinker),
+async_sink.go:12 (AsyncSink), storage.go:286-420 (Storage + optional
+capability interfaces), slot_monitor.go.
+
+The unit flowing through pushers/sinks is a **batch**: either a list of
+row-view ChangeItems or a columnar `ColumnBatch` (the TPU currency).  Sinks
+that only understand rows call `batch_to_items`; columnar-native sinks (CH,
+parquet) consume blocks zero-copy.  Control events always travel as
+ChangeItem lists so ordering relative to data blocks is preserved by the
+single serialized push path.
+"""
+
+from __future__ import annotations
+
+import abc
+import concurrent.futures
+from typing import Any, Callable, Iterable, Optional, Sequence, Union, TYPE_CHECKING
+
+from transferia_tpu.abstract.change_item import ChangeItem
+from transferia_tpu.abstract.schema import TableID, TableSchema
+from transferia_tpu.abstract.table import TableDescription
+
+if TYPE_CHECKING:  # avoid import cycle; columnar imports schema only
+    from transferia_tpu.columnar.batch import ColumnBatch
+
+# A push unit: row items or one columnar block.
+Batch = Union[Sequence[ChangeItem], "ColumnBatch"]
+
+# Synchronous pusher: raises on error (reference: func(items) error).
+Pusher = Callable[[Batch], None]
+
+
+def is_columnar(batch: Batch) -> bool:
+    return hasattr(batch, "columns") and hasattr(batch, "n_rows")
+
+
+class Source(abc.ABC):
+    """Replication source (source.go:3): runs until stop() or fatal error."""
+
+    @abc.abstractmethod
+    def run(self, sink: "AsyncSink") -> None:
+        """Block, pushing batches into sink until stop() is called."""
+
+    @abc.abstractmethod
+    def stop(self) -> None:
+        ...
+
+
+class Sinker(abc.ABC):
+    """Synchronous, non-concurrent sink (sink.go:10-14)."""
+
+    @abc.abstractmethod
+    def push(self, batch: Batch) -> None:
+        ...
+
+    def close(self) -> None:
+        ...
+
+
+class AsyncSink(abc.ABC):
+    """Asynchronous sink (async_sink.go:12).
+
+    async_push returns a Future resolved when the batch is durably delivered;
+    callers ack upstream (e.g. commit Kafka offsets) only after resolution —
+    the at-least-once discipline (kafka/source.go:251).
+    """
+
+    @abc.abstractmethod
+    def async_push(self, batch: Batch) -> "concurrent.futures.Future[None]":
+        ...
+
+    def close(self) -> None:
+        ...
+
+
+class SyncAsAsyncSink(AsyncSink):
+    """Adapter: wrap a synchronous Sinker as an AsyncSink (resolved inline)."""
+
+    def __init__(self, sinker: Sinker):
+        self._sinker = sinker
+
+    def async_push(self, batch: Batch) -> "concurrent.futures.Future[None]":
+        fut: concurrent.futures.Future[None] = concurrent.futures.Future()
+        try:
+            self._sinker.push(batch)
+            fut.set_result(None)
+        except BaseException as e:  # propagate through the future
+            fut.set_exception(e)
+        return fut
+
+    def close(self) -> None:
+        self._sinker.close()
+
+
+def resolve_all(futures: Iterable["concurrent.futures.Future[None]"]) -> None:
+    """Wait for pushes; re-raise the first error."""
+    for f in futures:
+        f.result()
+
+
+class TableInfo:
+    """Table listing entry (storage.go TableInfo)."""
+
+    __slots__ = ("eta_rows", "is_view", "schema")
+
+    def __init__(self, eta_rows: int = 0, is_view: bool = False,
+                 schema: Optional[TableSchema] = None):
+        self.eta_rows = eta_rows
+        self.is_view = is_view
+        self.schema = schema
+
+
+class Storage(abc.ABC):
+    """Snapshot source (storage.go:286)."""
+
+    @abc.abstractmethod
+    def table_list(self, include: Optional[list[TableID]] = None
+                   ) -> dict[TableID, TableInfo]:
+        ...
+
+    @abc.abstractmethod
+    def table_schema(self, table: TableID) -> TableSchema:
+        ...
+
+    @abc.abstractmethod
+    def load_table(self, table: TableDescription, pusher: Pusher) -> None:
+        """Stream the table (or slice) into the pusher as batches."""
+
+    def exact_table_rows_count(self, table: TableID) -> int:
+        return self.estimate_table_rows_count(table)
+
+    def estimate_table_rows_count(self, table: TableID) -> int:
+        return 0
+
+    def table_exists(self, table: TableID) -> bool:
+        return table in self.table_list(include=[table])
+
+    def ping(self) -> None:
+        ...
+
+    def close(self) -> None:
+        ...
+
+
+# -- optional storage capabilities (storage.go:300-420) ----------------------
+
+class PositionalStorage(abc.ABC):
+    """Exposes the log position at snapshot start (storage.go:300)."""
+
+    @abc.abstractmethod
+    def position(self) -> dict[str, Any]:
+        ...
+
+
+class ShardingStorage(abc.ABC):
+    """Splits one table into parallel-loadable parts (storage.go:339)."""
+
+    @abc.abstractmethod
+    def shard_table(self, table: TableDescription) -> list[TableDescription]:
+        ...
+
+
+class SnapshotableStorage(abc.ABC):
+    """Transactionally consistent snapshot bracket (storage.go:359)."""
+
+    def begin_snapshot(self) -> None:
+        ...
+
+    def end_snapshot(self) -> None:
+        ...
+
+
+class IncrementalStorage(abc.ABC):
+    """Cursor-based incremental snapshots (storage.go:354)."""
+
+    @abc.abstractmethod
+    def get_increment_state(self, tables: list["IncrementalTable"]
+                            ) -> list[TableDescription]:
+        """Return table descriptions filtered to rows past the stored cursor."""
+
+    @abc.abstractmethod
+    def next_increment_state(self, tables: list["IncrementalTable"]
+                             ) -> dict[str, Any]:
+        """Compute the post-snapshot cursor values to persist."""
+
+
+class IncrementalTable:
+    """RegularSnapshot incremental table spec (model/endpoint IncrementalTable)."""
+
+    __slots__ = ("table", "cursor_field", "initial_state")
+
+    def __init__(self, table: TableID, cursor_field: str, initial_state: str = ""):
+        self.table = table
+        self.cursor_field = cursor_field
+        self.initial_state = initial_state
+
+
+class SampleableStorage(abc.ABC):
+    """Checksum sampling (storage.go:322-337)."""
+
+    @abc.abstractmethod
+    def load_random_sample(self, table: TableDescription, pusher: Pusher) -> None:
+        ...
+
+    @abc.abstractmethod
+    def load_top_bottom_sample(self, table: TableDescription, pusher: Pusher) -> None:
+        ...
+
+    @abc.abstractmethod
+    def load_sample_by_set(self, table: TableDescription,
+                           keys: Sequence[ChangeItem], pusher: Pusher) -> None:
+        ...
+
+    def table_accessible(self, table: TableDescription) -> bool:
+        return True

@@ -1,0 +1,110 @@
+"""Table work units: descriptions, parts, filters.
+
+Reference parity: pkg/abstract (TableDescription), operation_table_part.go:8-21
+(OperationTablePart — the unit of sharded-snapshot work assignment).
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from transferia_tpu.abstract.schema import TableID
+
+
+@dataclass
+class TableDescription:
+    """A table (or a slice of one) to snapshot."""
+
+    id: TableID
+    filter: str = ""       # WHERE-like predicate (pkg/predicate syntax)
+    offset: int = 0
+    eta_rows: int = 0      # estimated rows (for big-first scheduling)
+
+    def fqtn(self) -> str:
+        return self.id.fqtn()
+
+    def part_key(self) -> str:
+        return f"{self.id}|{self.filter}|{self.offset}"
+
+
+@dataclass
+class OperationTablePart:
+    """Sharded-snapshot work unit (operation_table_part.go:8-21).
+
+    Created by the main worker's table splitter, published through the
+    coordinator, pulled by secondary workers via AssignOperationTablePart.
+    """
+
+    operation_id: str = ""
+    table_id: TableID = field(default_factory=lambda: TableID("", ""))
+    filter: str = ""
+    offset: int = 0
+    part_index: int = 0
+    parts_count: int = 1
+    eta_rows: int = 0
+    completed_rows: int = 0
+    read_bytes: int = 0
+    completed: bool = False
+    worker_index: Optional[int] = None  # assignee
+
+    def key(self) -> str:
+        return f"{self.operation_id}/{self.table_id}/{self.part_index}"
+
+    def part_id(self) -> str:
+        """PartID stamped on control events and rows of this part."""
+        return f"{self.table_id}_{self.part_index}_{self.parts_count}"
+
+    def to_description(self) -> TableDescription:
+        return TableDescription(
+            id=self.table_id,
+            filter=self.filter,
+            offset=self.offset,
+            eta_rows=self.eta_rows,
+        )
+
+    def to_json(self) -> dict[str, Any]:
+        return {
+            "operation_id": self.operation_id,
+            "schema": self.table_id.namespace,
+            "table": self.table_id.name,
+            "filter": self.filter,
+            "offset": self.offset,
+            "part_index": self.part_index,
+            "parts_count": self.parts_count,
+            "eta_rows": self.eta_rows,
+            "completed_rows": self.completed_rows,
+            "read_bytes": self.read_bytes,
+            "completed": self.completed,
+            "worker_index": self.worker_index,
+        }
+
+    @staticmethod
+    def from_json(d: dict[str, Any]) -> "OperationTablePart":
+        return OperationTablePart(
+            operation_id=d.get("operation_id", ""),
+            table_id=TableID(d.get("schema", ""), d.get("table", "")),
+            filter=d.get("filter", ""),
+            offset=d.get("offset", 0),
+            part_index=d.get("part_index", 0),
+            parts_count=d.get("parts_count", 1),
+            eta_rows=d.get("eta_rows", 0),
+            completed_rows=d.get("completed_rows", 0),
+            read_bytes=d.get("read_bytes", 0),
+            completed=d.get("completed", False),
+            worker_index=d.get("worker_index"),
+        )
+
+    @staticmethod
+    def from_description(op_id: str, td: TableDescription,
+                         part_index: int = 0, parts_count: int = 1) -> "OperationTablePart":
+        return OperationTablePart(
+            operation_id=op_id,
+            table_id=td.id,
+            filter=td.filter,
+            offset=td.offset,
+            part_index=part_index,
+            parts_count=parts_count,
+            eta_rows=td.eta_rows,
+        )

@@ -1,0 +1,14 @@
+"""Columnar data plane: the TPU currency.
+
+The reference engine moves row-oriented ChangeItems everywhere; this
+framework's equivalent of its hand-optimized Go hot loops (generic parser,
+serializer batch loops, CH marshaller — SURVEY.md §3.5 "hot loops") is the
+`ColumnBatch`: an Arrow-style columnar block whose fixed-width columns are
+device-ready numpy/jax arrays and whose variable-width columns are
+(uint8 bytes, int32 offsets) pairs.  Pivot/unpivot at the row-oriented edges
+only.
+"""
+
+from transferia_tpu.columnar.batch import Column, ColumnBatch, bucket_rows
+
+__all__ = ["Column", "ColumnBatch", "bucket_rows"]

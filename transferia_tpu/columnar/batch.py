@@ -1,0 +1,651 @@
+"""ColumnBatch: Arrow-style columnar block shared by host and device.
+
+Design notes (TPU-first):
+- Fixed-width canonical types map 1:1 to numpy dtypes (`CanonicalType.np_dtype`)
+  so a column ships to the device with zero copies beyond the HBM transfer.
+- Variable-width types (string/utf8/any/decimal) are a flat uint8 byte buffer
+  plus (n_rows+1) int32 offsets — the layout Pallas string kernels consume.
+- NULLs are a boolean validity array (True = valid), matching Arrow semantics.
+- Row-count bucketing (`bucket_rows`) pads batches to power-of-two-ish sizes
+  so XLA compiles once per (schema fingerprint, bucket) instead of once per
+  batch — the shape-static analogue of the reference's schema-hash keyed
+  transformer plan cache (pkg/transformer/transformation.go:47-60).
+
+Reference parity: this replaces the []ChangeItem batch of
+pkg/abstract/changeitem as the bulk currency; ChangeItems remain the row view.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Any, Iterable, Optional, Sequence
+
+import numpy as np
+
+from transferia_tpu.abstract.change_item import ChangeItem, OldKeys
+from transferia_tpu.abstract.kinds import CODE_KINDS, KIND_CODES, Kind
+from transferia_tpu.abstract.schema import (
+    CanonicalType,
+    ColSchema,
+    TableID,
+    TableSchema,
+)
+
+_BUCKETS = (256, 1024, 4096, 16384, 65536, 262144, 1048576)
+_INT32_MAX = 2**31 - 1
+
+
+def _offsets_from_lengths(lengths) -> np.ndarray:
+    """Build int32 offsets from per-row byte lengths, guarding overflow.
+
+    Device kernels index with int32; a single batch's var-width column must
+    stay under 2 GiB (the bufferer flushes far earlier) — fail loudly rather
+    than let numpy wrap the cumsum.
+    """
+    off64 = np.zeros(len(lengths) + 1, dtype=np.int64)
+    if len(lengths):
+        np.cumsum(lengths, dtype=np.int64, out=off64[1:])
+    if off64[-1] > _INT32_MAX:
+        raise ValueError(
+            f"variable-width column exceeds 2GiB in one batch "
+            f"({int(off64[-1])} bytes); split the batch"
+        )
+    return off64.astype(np.int32)
+
+
+def bucket_rows(n: int) -> int:
+    """Smallest standard bucket >= n (caps XLA recompiles)."""
+    for b in _BUCKETS:
+        if n <= b:
+            return b
+    # beyond the largest bucket: round up to a multiple of it
+    top = _BUCKETS[-1]
+    return ((n + top - 1) // top) * top
+
+
+@dataclass
+class Column:
+    """One column of a batch.
+
+    data: fixed-width -> (n,) array of ctype.np_dtype
+          variable-width -> (total_bytes,) uint8 buffer
+    offsets: (n+1,) int32 — only for variable-width columns
+    validity: (n,) bool (True = present) or None meaning all-valid
+    """
+
+    name: str
+    ctype: CanonicalType
+    data: np.ndarray
+    offsets: Optional[np.ndarray] = None
+    validity: Optional[np.ndarray] = None
+
+    def __post_init__(self):
+        if self.ctype.is_variable_width and self.offsets is None:
+            raise ValueError(f"column {self.name}: var-width requires offsets")
+
+    @property
+    def n_rows(self) -> int:
+        if self.offsets is not None:
+            return len(self.offsets) - 1
+        return len(self.data)
+
+    def nbytes(self) -> int:
+        n = self.data.nbytes
+        if self.offsets is not None:
+            n += self.offsets.nbytes
+        if self.validity is not None:
+            n += self.validity.nbytes
+        return n
+
+    def is_valid(self, i: int) -> bool:
+        return self.validity is None or bool(self.validity[i])
+
+    # -- row access ---------------------------------------------------------
+    def value(self, i: int) -> Any:
+        """Python value at row i (None when invalid)."""
+        if not self.is_valid(i):
+            return None
+        if self.offsets is not None:
+            raw = bytes(self.data[self.offsets[i]:self.offsets[i + 1]])
+            return _decode_varwidth(self.ctype, raw)
+        v = self.data[i]
+        if self.ctype == CanonicalType.BOOLEAN:
+            return bool(v)
+        if self.ctype.is_integer or self.ctype in (
+            CanonicalType.DATE, CanonicalType.DATETIME,
+            CanonicalType.TIMESTAMP, CanonicalType.INTERVAL,
+        ):
+            return int(v)
+        return float(v)
+
+    def to_pylist(self) -> list[Any]:
+        return [self.value(i) for i in range(self.n_rows)]
+
+    # -- functional ops -----------------------------------------------------
+    def take(self, indices: np.ndarray) -> "Column":
+        """Gather rows (host-side; device path uses ops.strings.take_bytes)."""
+        validity = self.validity[indices] if self.validity is not None else None
+        if self.offsets is None:
+            return Column(self.name, self.ctype, self.data[indices], None, validity)
+        lens = (self.offsets[1:] - self.offsets[:-1])[indices]
+        new_offsets = _offsets_from_lengths(lens)
+        out = np.empty(int(new_offsets[-1]), dtype=np.uint8)
+        starts = self.offsets[:-1][indices]
+        for j in range(len(indices)):
+            out[new_offsets[j]:new_offsets[j + 1]] = (
+                self.data[starts[j]:starts[j] + lens[j]]
+            )
+        return Column(self.name, self.ctype, out, new_offsets, validity)
+
+    def filter(self, mask: np.ndarray) -> "Column":
+        return self.take(np.nonzero(mask)[0])
+
+    @staticmethod
+    def from_pylist(name: str, ctype: CanonicalType,
+                    values: Sequence[Any]) -> "Column":
+        n = len(values)
+        validity = np.fromiter(
+            (v is not None for v in values), dtype=np.bool_, count=n
+        )
+        all_valid = bool(validity.all()) if n else True
+        if ctype.is_variable_width:
+            bufs = [
+                _encode_varwidth(ctype, v) if v is not None else b""
+                for v in values
+            ]
+            offsets = _offsets_from_lengths([len(b) for b in bufs])
+            data = np.frombuffer(b"".join(bufs), dtype=np.uint8).copy() \
+                if bufs else np.zeros(0, dtype=np.uint8)
+            return Column(name, ctype, data, offsets,
+                          None if all_valid else validity)
+        dt = ctype.np_dtype
+        data = np.zeros(n, dtype=dt)
+        for i, v in enumerate(values):
+            if v is not None:
+                data[i] = v
+        return Column(name, ctype, data, None, None if all_valid else validity)
+
+
+def _encode_varwidth(ctype: CanonicalType, v: Any) -> bytes:
+    if ctype == CanonicalType.STRING:
+        if isinstance(v, bytes):
+            return v
+        return str(v).encode()
+    if ctype in (CanonicalType.UTF8, CanonicalType.DECIMAL):
+        return v.encode() if isinstance(v, str) else str(v).encode()
+    # ANY: canonical JSON bytes
+    if isinstance(v, bytes):
+        return v
+    return json.dumps(v, separators=(",", ":"), default=str).encode()
+
+
+def _decode_varwidth(ctype: CanonicalType, raw: bytes) -> Any:
+    if ctype == CanonicalType.STRING:
+        return raw
+    if ctype in (CanonicalType.UTF8, CanonicalType.DECIMAL):
+        return raw.decode("utf-8", errors="replace")
+    try:
+        return json.loads(raw) if raw else None
+    except (ValueError, UnicodeDecodeError):
+        return raw
+
+
+class ColumnBatch:
+    """A columnar block of rows for one table.
+
+    kinds is None for pure-insert (snapshot) blocks; otherwise an int8 array
+    of KIND_CODES for mixed CDC blocks.  lsns/commit_times are optional
+    per-row metadata carried through the pipeline for checkpointing.
+    old_keys/txn_ids are host-side per-row sidecars (never shipped to the
+    device) preserving CDC row identity for updates/deletes across the pivot.
+    """
+
+    __slots__ = ("table_id", "schema", "columns", "kinds", "lsns",
+                 "commit_times", "part_id", "read_bytes", "old_keys",
+                 "txn_ids")
+
+    def __init__(self, table_id: TableID, schema: TableSchema,
+                 columns: dict[str, Column],
+                 kinds: Optional[np.ndarray] = None,
+                 lsns: Optional[np.ndarray] = None,
+                 commit_times: Optional[np.ndarray] = None,
+                 part_id: str = "", read_bytes: int = 0,
+                 old_keys: Optional[list[OldKeys]] = None,
+                 txn_ids: Optional[list[str]] = None):
+        self.table_id = table_id
+        self.schema = schema
+        self.columns = columns
+        self.kinds = kinds
+        self.lsns = lsns
+        self.commit_times = commit_times
+        self.part_id = part_id
+        self.read_bytes = read_bytes
+        self.old_keys = old_keys
+        self.txn_ids = txn_ids
+        self._check()
+
+    def _check(self):
+        n = self.n_rows
+        for c in self.columns.values():
+            if c.n_rows != n:
+                raise ValueError(
+                    f"ragged batch: column {c.name} has {c.n_rows} rows, "
+                    f"expected {n}"
+                )
+
+    @property
+    def n_rows(self) -> int:
+        if not self.columns:
+            return 0 if self.kinds is None else len(self.kinds)
+        return next(iter(self.columns.values())).n_rows
+
+    def __len__(self) -> int:
+        return self.n_rows
+
+    def nbytes(self) -> int:
+        return sum(c.nbytes() for c in self.columns.values())
+
+    def kind_at(self, i: int) -> Kind:
+        if self.kinds is None:
+            return Kind.INSERT
+        return CODE_KINDS[int(self.kinds[i])]
+
+    def column(self, name: str) -> Column:
+        return self.columns[name]
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def from_pydict(table_id: TableID, schema: TableSchema,
+                    data: dict[str, Sequence[Any]], **kw) -> "ColumnBatch":
+        cols = {}
+        for cs in schema:
+            if cs.name in data:
+                cols[cs.name] = Column.from_pylist(
+                    cs.name, cs.data_type, data[cs.name]
+                )
+        return ColumnBatch(table_id, schema, cols, **kw)
+
+    @staticmethod
+    def from_rows(items: Sequence[ChangeItem]) -> "ColumnBatch":
+        """Pivot a uniform-table row batch into a columnar block.
+
+        All items must share table_id and table_schema; mixed kinds are
+        captured in the kinds array.  This is the host-side pivot the
+        BASELINE.json north star describes (ChangeItem rows -> column
+        buffers).
+        """
+        if not items:
+            raise ValueError("from_rows: empty batch")
+        first = items[0]
+        if first.table_schema is None:
+            raise ValueError("from_rows: items must carry table_schema")
+        schema = first.table_schema
+        tid = first.table_id
+        n = len(items)
+        per_col: dict[str, list[Any]] = {c.name: [None] * n for c in schema}
+        kinds = np.zeros(n, dtype=np.int8)
+        lsns = np.zeros(n, dtype=np.int64)
+        commit_times = np.zeros(n, dtype=np.int64)
+        mixed = False
+        old_keys: Optional[list[OldKeys]] = None
+        txn_ids: Optional[list[str]] = None
+        for i, it in enumerate(items):
+            if it.table_id != tid:
+                raise ValueError("from_rows: mixed tables in batch")
+            if it.table_schema is not schema and it.table_schema != schema:
+                raise ValueError(
+                    "from_rows: mixed table schemas in batch (schema changed "
+                    "mid-stream?) — split the batch on schema boundaries"
+                )
+            code = KIND_CODES.get(it.kind)
+            if code is None:
+                raise ValueError(f"from_rows: non-row kind {it.kind}")
+            kinds[i] = code
+            mixed = mixed or code != 0
+            lsns[i] = it.lsn
+            commit_times[i] = it.commit_time_ns
+            if it.old_keys.key_names:
+                if old_keys is None:
+                    old_keys = [OldKeys()] * n
+                old_keys[i] = it.old_keys
+            if it.txn_id:
+                if txn_ids is None:
+                    txn_ids = [""] * n
+                txn_ids[i] = it.txn_id
+            for name, value in zip(it.column_names, it.column_values):
+                if name in per_col:
+                    per_col[name][i] = value
+        cols = {
+            c.name: Column.from_pylist(c.name, c.data_type, per_col[c.name])
+            for c in schema
+        }
+        return ColumnBatch(
+            tid, schema, cols,
+            kinds=kinds if mixed else None,
+            lsns=lsns if lsns.any() else None,
+            commit_times=commit_times if commit_times.any() else None,
+            part_id=first.part_id,
+            read_bytes=sum(it.size_bytes for it in items),
+            old_keys=old_keys,
+            txn_ids=txn_ids,
+        )
+
+    # -- row view -----------------------------------------------------------
+    def to_rows(self) -> list[ChangeItem]:
+        """Unpivot to ChangeItems (row-oriented edges only)."""
+        names = tuple(self.columns.keys())
+        cols = list(self.columns.values())
+        out = []
+        for i in range(self.n_rows):
+            out.append(ChangeItem(
+                kind=self.kind_at(i),
+                schema=self.table_id.namespace,
+                table=self.table_id.name,
+                column_names=names,
+                column_values=tuple(c.value(i) for c in cols),
+                table_schema=self.schema,
+                lsn=int(self.lsns[i]) if self.lsns is not None else 0,
+                commit_time_ns=int(self.commit_times[i])
+                if self.commit_times is not None else 0,
+                part_id=self.part_id,
+                old_keys=self.old_keys[i] if self.old_keys is not None
+                else OldKeys(),
+                txn_id=self.txn_ids[i] if self.txn_ids is not None else "",
+            ))
+        return out
+
+    def to_pydict(self) -> dict[str, list[Any]]:
+        return {name: c.to_pylist() for name, c in self.columns.items()}
+
+    # -- functional ops -----------------------------------------------------
+    def with_columns(self, columns: dict[str, Column],
+                     schema: Optional[TableSchema] = None) -> "ColumnBatch":
+        return ColumnBatch(
+            self.table_id, schema or self.schema, columns,
+            kinds=self.kinds, lsns=self.lsns, commit_times=self.commit_times,
+            part_id=self.part_id, read_bytes=self.read_bytes,
+            old_keys=self.old_keys, txn_ids=self.txn_ids,
+        )
+
+    def rename_table(self, table_id: TableID) -> "ColumnBatch":
+        return ColumnBatch(
+            table_id, self.schema, self.columns,
+            kinds=self.kinds, lsns=self.lsns, commit_times=self.commit_times,
+            part_id=self.part_id, read_bytes=self.read_bytes,
+            old_keys=self.old_keys, txn_ids=self.txn_ids,
+        )
+
+    def project(self, names: Sequence[str]) -> "ColumnBatch":
+        cols = {n: self.columns[n] for n in names if n in self.columns}
+        return self.with_columns(cols, self.schema.project(list(cols)))
+
+    def filter(self, mask: np.ndarray) -> "ColumnBatch":
+        idx = np.nonzero(np.asarray(mask))[0]
+        return self.take(idx)
+
+    def take(self, indices: np.ndarray) -> "ColumnBatch":
+        cols = {n: c.take(indices) for n, c in self.columns.items()}
+        return ColumnBatch(
+            self.table_id, self.schema, cols,
+            kinds=self.kinds[indices] if self.kinds is not None else None,
+            lsns=self.lsns[indices] if self.lsns is not None else None,
+            commit_times=self.commit_times[indices]
+            if self.commit_times is not None else None,
+            part_id=self.part_id, read_bytes=self.read_bytes,
+            old_keys=[self.old_keys[int(i)] for i in indices]
+            if self.old_keys is not None else None,
+            txn_ids=[self.txn_ids[int(i)] for i in indices]
+            if self.txn_ids is not None else None,
+        )
+
+    def slice(self, start: int, stop: int) -> "ColumnBatch":
+        return self.take(np.arange(start, min(stop, self.n_rows)))
+
+    @staticmethod
+    def concat(batches: Sequence["ColumnBatch"]) -> "ColumnBatch":
+        if not batches:
+            raise ValueError("concat: empty")
+        if len(batches) == 1:
+            return batches[0]
+        first = batches[0]
+        cols = {}
+        for name, c0 in first.columns.items():
+            parts = [b.columns[name] for b in batches]
+            validity = None
+            if any(p.validity is not None for p in parts):
+                validity = np.concatenate([
+                    p.validity if p.validity is not None
+                    else np.ones(p.n_rows, dtype=np.bool_)
+                    for p in parts
+                ])
+            if c0.offsets is not None:
+                data = np.concatenate([p.data for p in parts])
+                lens = np.concatenate([
+                    p.offsets[1:] - p.offsets[:-1] for p in parts
+                ])
+                offsets = _offsets_from_lengths(lens)
+                cols[name] = Column(name, c0.ctype, data, offsets, validity)
+            else:
+                cols[name] = Column(
+                    name, c0.ctype,
+                    np.concatenate([p.data for p in parts]), None, validity,
+                )
+        def cat(attr, fill_dtype):
+            arrs = [getattr(b, attr) for b in batches]
+            if all(a is None for a in arrs):
+                return None
+            return np.concatenate([
+                a if a is not None else np.zeros(b.n_rows, dtype=fill_dtype)
+                for a, b in zip(arrs, batches)
+            ])
+        def cat_list(attr, fill):
+            vals = [getattr(b, attr) for b in batches]
+            if all(v is None for v in vals):
+                return None
+            out = []
+            for v, b in zip(vals, batches):
+                out.extend(v if v is not None else [fill] * b.n_rows)
+            return out
+
+        return ColumnBatch(
+            first.table_id, first.schema, cols,
+            kinds=cat("kinds", np.int8),
+            lsns=cat("lsns", np.int64),
+            commit_times=cat("commit_times", np.int64),
+            part_id=first.part_id,
+            read_bytes=sum(b.read_bytes for b in batches),
+            old_keys=cat_list("old_keys", OldKeys()),
+            txn_ids=cat_list("txn_ids", ""),
+        )
+
+    # -- arrow interop ------------------------------------------------------
+    def to_arrow(self):
+        """Convert to a pyarrow.RecordBatch (for parquet sinks etc.)."""
+        import pyarrow as pa
+
+        arrays, fields = [], []
+        for cs in self.schema:
+            c = self.columns.get(cs.name)
+            if c is None:
+                continue
+            pa_type = _ARROW_TYPES[cs.data_type]
+            if c.offsets is not None:
+                buf_data = pa.py_buffer(c.data.tobytes())
+                buf_off = pa.py_buffer(c.offsets.astype(np.int32).tobytes())
+                mask_buf = _arrow_validity(c.validity, c.n_rows)
+                arr = pa.Array.from_buffers(
+                    pa_type, c.n_rows, [mask_buf, buf_off, buf_data]
+                )
+            else:
+                arr = pa.array(c.data, type=pa_type,
+                               mask=(~c.validity) if c.validity is not None else None)
+            arrays.append(arr)
+            fields.append(pa.field(cs.name, pa_type, nullable=not cs.required))
+        return pa.RecordBatch.from_arrays(arrays, schema=pa.schema(fields))
+
+    @staticmethod
+    def from_arrow(rb, table_id: TableID,
+                   schema: Optional[TableSchema] = None) -> "ColumnBatch":
+        """Zero-ish-copy import from a pyarrow RecordBatch.
+
+        Parquet/Arrow sources land here directly — no row pivot, per the
+        north star ("never re-row the data between source and sink").
+        """
+        import pyarrow as pa
+        import pyarrow.compute as pc
+
+        if schema is None:
+            schema = arrow_to_table_schema(rb.schema)
+        cols: dict[str, Column] = {}
+        for cs in schema:
+            idx = rb.schema.get_field_index(cs.name)
+            if idx < 0:
+                continue
+            arr = rb.column(idx)
+            if isinstance(arr, pa.ChunkedArray):
+                arr = arr.combine_chunks()
+            cols[cs.name] = _arrow_to_column(cs, arr)
+        return ColumnBatch(table_id, schema, cols)
+
+
+_ARROW_TYPES: dict[CanonicalType, Any] = {}
+
+
+def _init_arrow_types():
+    import pyarrow as pa
+
+    _ARROW_TYPES.update({
+        CanonicalType.INT8: pa.int8(),
+        CanonicalType.INT16: pa.int16(),
+        CanonicalType.INT32: pa.int32(),
+        CanonicalType.INT64: pa.int64(),
+        CanonicalType.UINT8: pa.uint8(),
+        CanonicalType.UINT16: pa.uint16(),
+        CanonicalType.UINT32: pa.uint32(),
+        CanonicalType.UINT64: pa.uint64(),
+        CanonicalType.FLOAT: pa.float32(),
+        CanonicalType.DOUBLE: pa.float64(),
+        CanonicalType.BOOLEAN: pa.bool_(),
+        CanonicalType.DATE: pa.date32(),
+        CanonicalType.DATETIME: pa.timestamp("s"),
+        CanonicalType.TIMESTAMP: pa.timestamp("us"),
+        CanonicalType.INTERVAL: pa.duration("us"),
+        CanonicalType.STRING: pa.binary(),
+        CanonicalType.UTF8: pa.string(),
+        CanonicalType.ANY: pa.string(),
+        CanonicalType.DECIMAL: pa.string(),
+    })
+
+
+try:  # pyarrow is present in the baked image; soft-fail for minimal envs
+    _init_arrow_types()
+except ImportError:  # pragma: no cover
+    pass
+
+
+def _arrow_validity(validity: Optional[np.ndarray], n: int):
+    import pyarrow as pa
+
+    if validity is None:
+        return None
+    bits = np.packbits(validity, bitorder="little")
+    return pa.py_buffer(bits.tobytes())
+
+
+def _arrow_to_column(cs: ColSchema, arr) -> Column:
+    import pyarrow as pa
+    import pyarrow.types as pt
+
+    validity = None
+    if arr.null_count:
+        validity = np.asarray(arr.is_valid())
+    t = arr.type
+    if pt.is_string(t) or pt.is_large_string(t) or pt.is_binary(t) \
+            or pt.is_large_binary(t):
+        if pt.is_large_string(t) or pt.is_large_binary(t):
+            arr = arr.cast(pa.string() if pt.is_large_string(t) else pa.binary())
+        bufs = arr.buffers()
+        off = np.frombuffer(bufs[1], dtype=np.int32,
+                            count=len(arr) + 1 + arr.offset)
+        data = np.frombuffer(bufs[2], dtype=np.uint8) if bufs[2] is not None \
+            else np.zeros(0, dtype=np.uint8)
+        if arr.offset:
+            off = off[arr.offset:]
+        if off[0] != 0:
+            data = data[off[0]:off[-1]]
+            off = off - off[0]
+        return Column(cs.name, cs.data_type, np.ascontiguousarray(data),
+                      np.ascontiguousarray(off), validity)
+    if cs.data_type.is_variable_width:
+        # canonical var-width but arrow gave a non-string type: stringify
+        vals = arr.to_pylist()
+        col = Column.from_pylist(cs.name, cs.data_type, vals)
+        return col
+    if pt.is_timestamp(t):
+        unit_scale = {"s": 1_000_000, "ms": 1_000, "us": 1, "ns": 1}[t.unit]
+        vals = np.asarray(arr.cast(pa.int64()).fill_null(0))
+        if cs.data_type == CanonicalType.DATETIME:
+            div = {"s": 1, "ms": 1_000, "us": 1_000_000, "ns": 1_000_000_000}[t.unit]
+            data = (vals // div).astype(np.int64)
+        else:
+            data = (vals * unit_scale if t.unit in ("s", "ms")
+                    else vals // (1000 if t.unit == "ns" else 1)).astype(np.int64)
+        return Column(cs.name, cs.data_type, data, None, validity)
+    if pt.is_date32(t):
+        data = np.asarray(arr.cast(pa.int32()).fill_null(0))
+        return Column(cs.name, cs.data_type, data.astype(np.int32), None, validity)
+    if pt.is_boolean(t):
+        data = np.asarray(arr.fill_null(False))
+        return Column(cs.name, cs.data_type, data.astype(np.bool_), None, validity)
+    data = np.asarray(arr.fill_null(0)).astype(cs.data_type.np_dtype, copy=False)
+    return Column(cs.name, cs.data_type, np.ascontiguousarray(data), None, validity)
+
+
+def arrow_to_table_schema(pa_schema) -> TableSchema:
+    """Infer a canonical TableSchema from an arrow schema."""
+    import pyarrow.types as pt
+
+    cols = []
+    for f in pa_schema:
+        t = f.type
+        if pt.is_int8(t):
+            ct = CanonicalType.INT8
+        elif pt.is_int16(t):
+            ct = CanonicalType.INT16
+        elif pt.is_int32(t):
+            ct = CanonicalType.INT32
+        elif pt.is_int64(t):
+            ct = CanonicalType.INT64
+        elif pt.is_uint8(t):
+            ct = CanonicalType.UINT8
+        elif pt.is_uint16(t):
+            ct = CanonicalType.UINT16
+        elif pt.is_uint32(t):
+            ct = CanonicalType.UINT32
+        elif pt.is_uint64(t):
+            ct = CanonicalType.UINT64
+        elif pt.is_float32(t):
+            ct = CanonicalType.FLOAT
+        elif pt.is_float64(t):
+            ct = CanonicalType.DOUBLE
+        elif pt.is_boolean(t):
+            ct = CanonicalType.BOOLEAN
+        elif pt.is_date32(t) or pt.is_date64(t):
+            ct = CanonicalType.DATE
+        elif pt.is_timestamp(t):
+            ct = CanonicalType.TIMESTAMP if t.unit in ("us", "ns") \
+                else CanonicalType.DATETIME
+        elif pt.is_string(t) or pt.is_large_string(t):
+            ct = CanonicalType.UTF8
+        elif pt.is_binary(t) or pt.is_large_binary(t):
+            ct = CanonicalType.STRING
+        elif pt.is_decimal(t):
+            ct = CanonicalType.DECIMAL
+        else:
+            ct = CanonicalType.ANY
+        cols.append(ColSchema(
+            name=f.name, data_type=ct, required=not f.nullable,
+            original_type=f"arrow:{t}",
+        ))
+    return TableSchema(cols)

@@ -1,0 +1,29 @@
+"""Typesystem: canonical lattice + per-provider rules + versioned fallbacks.
+
+Reference parity: pkg/abstract/typesystem/ — source rules map provider-native
+type names to CanonicalType; target rules map CanonicalType to the target's
+DDL type string; versioned `Fallback` transforms keep old transfers on old
+type mappings (fallback.go:21-29, LatestVersion in model/transfer.go:45-54).
+"""
+
+from transferia_tpu.typesystem.rules import (
+    register_source_rules,
+    register_target_rules,
+    source_rules,
+    target_rules,
+    map_source_type,
+    map_target_type,
+)
+from transferia_tpu.typesystem.fallbacks import (
+    Fallback,
+    register_fallback,
+    fallbacks_for,
+    LATEST_VERSION,
+)
+
+__all__ = [
+    "register_source_rules", "register_target_rules",
+    "source_rules", "target_rules",
+    "map_source_type", "map_target_type",
+    "Fallback", "register_fallback", "fallbacks_for", "LATEST_VERSION",
+]

@@ -1,0 +1,60 @@
+"""Versioned type fallbacks (typesystem/fallback.go:21-29).
+
+A transfer records the typesystem version current at its creation
+(`Transfer.type_system_version`); when the framework's LATEST_VERSION moves
+ahead, every registered fallback with `since > transfer_version` is applied
+as a sink middleware so old transfers keep seeing old type behavior
+(pkg/middlewares/fallback.go).  Fallbacks transform ColumnBatches (or
+row items) just before the sink.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+from transferia_tpu.columnar.batch import ColumnBatch
+
+# Bump when a provider changes its canonical mapping; register a fallback
+# restoring the old behavior for transfers pinned to older versions.
+LATEST_VERSION = 1
+
+
+@dataclass(frozen=True)
+class Fallback:
+    """One versioned transform.
+
+    since: the version that *introduced the new behavior*; transfers with
+    type_system_version < since get this fallback applied (which undoes the
+    new behavior).
+    picker: provider name this fallback belongs to ("" = all).
+    side: "source" or "target" — which end's rules changed.
+    apply: ColumnBatch -> ColumnBatch.
+    """
+
+    name: str
+    since: int
+    provider: str
+    side: str
+    apply: Callable[[ColumnBatch], ColumnBatch]
+
+
+_FALLBACKS: list[Fallback] = []
+
+
+def register_fallback(fb: Fallback) -> None:
+    _FALLBACKS.append(fb)
+
+
+def fallbacks_for(provider: str, side: str,
+                  transfer_version: int) -> list[Fallback]:
+    """All fallbacks to apply for a transfer pinned at transfer_version,
+    ordered newest-change-first (applied innermost-last like the reference's
+    middleware chain)."""
+    out = [
+        fb for fb in _FALLBACKS
+        if fb.side == side
+        and fb.provider in ("", provider)
+        and fb.since > transfer_version
+    ]
+    return sorted(out, key=lambda fb: -fb.since)
