@@ -1,0 +1,102 @@
+"""Predicate parser + vectorized mask evaluation."""
+
+import numpy as np
+import pytest
+
+from transferia_tpu.abstract import TableID
+from transferia_tpu.abstract.schema import new_table_schema
+from transferia_tpu.columnar import ColumnBatch
+from transferia_tpu.predicate import ParseError, compile_mask, parse
+
+
+SCHEMA = new_table_schema([
+    ("id", "int64", True),
+    ("price", "double"),
+    ("name", "utf8"),
+    ("active", "boolean"),
+])
+
+
+def make_batch():
+    return ColumnBatch.from_pydict(TableID("", "t"), SCHEMA, {
+        "id": [1, 2, 3, 4, 5],
+        "price": [10.0, 25.5, None, 99.9, 5.0],
+        "name": ["alpha", "beta", "alphabet", None, "gamma"],
+        "active": [True, False, True, True, None],
+    })
+
+
+def mask_of(text):
+    return compile_mask(parse(text))(make_batch()).tolist()
+
+
+def test_numeric_comparisons():
+    assert mask_of("id > 3") == [False, False, False, True, True]
+    assert mask_of("id <= 2") == [True, True, False, False, False]
+    assert mask_of("id != 3") == [True, True, False, True, True]
+    assert mask_of("price >= 25.5") == [False, True, False, True, False]
+
+
+def test_null_semantics():
+    # NULL never matches comparisons
+    assert mask_of("price > 0") == [True, True, False, True, True]
+    assert mask_of("price IS NULL") == [False, False, True, False, False]
+    assert mask_of("price IS NOT NULL") == [True, True, False, True, True]
+    assert mask_of("name IS NULL") == [False, False, False, True, False]
+
+
+def test_boolean_and_or_not():
+    assert mask_of("id > 1 AND id < 4") == [False, True, True, False, False]
+    assert mask_of("id = 1 OR id = 5") == [True, False, False, False, True]
+    assert mask_of("NOT id = 1") == [False, True, True, True, True]
+    assert mask_of("id = 1 OR id = 2 AND price > 20") == \
+        [True, True, False, False, False]  # AND binds tighter
+    assert mask_of("(id = 1 OR id = 2) AND price > 20") == \
+        [False, True, False, False, False]
+
+
+def test_string_equality_vectorized():
+    assert mask_of("name = 'alpha'") == [True, False, False, False, False]
+    assert mask_of("name != 'alpha'") == [False, True, True, False, True]
+
+
+def test_like():
+    assert mask_of("name LIKE 'alpha%'") == [True, False, True, False, False]
+    assert mask_of("name LIKE '%bet'") == [False, False, True, False, False]
+    assert mask_of("name LIKE '%eta%'") == [False, True, False, False, False]
+    # row 3 has NULL name: excluded under SQL 3VL even with NOT
+    assert mask_of("name NOT LIKE 'alpha%'") == [False, True, False, False, True]
+    assert mask_of("name LIKE 'a%t'") == [False, False, True, False, False]
+
+
+def test_in_and_between():
+    assert mask_of("id IN (1, 3, 5)") == [True, False, True, False, True]
+    assert mask_of("id NOT IN (1, 3, 5)") == [False, True, False, True, False]
+    assert mask_of("name IN ('beta', 'gamma')") == \
+        [False, True, False, False, True]
+    assert mask_of("id BETWEEN 2 AND 4") == [False, True, True, True, False]
+
+
+def test_bool_column():
+    assert mask_of("active = TRUE") == [True, False, True, True, False]
+    assert mask_of("active = FALSE") == [False, True, False, False, False]
+
+
+def test_empty_predicate_is_true():
+    assert mask_of("") == [True] * 5
+
+
+def test_parse_errors():
+    with pytest.raises(ParseError):
+        parse("id >")
+    with pytest.raises(ParseError):
+        parse("id = 1 extra")
+    with pytest.raises(ParseError):
+        parse("AND id = 1")
+    with pytest.raises(ParseError):
+        parse("id BETWEEN 1 OR 2")
+
+
+def test_columns_introspection():
+    node = parse("id > 1 AND (name = 'x' OR price IS NULL)")
+    assert node.columns() == {"id", "name", "price"}

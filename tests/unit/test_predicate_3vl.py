@@ -1,0 +1,73 @@
+"""Three-valued-logic regression tests (review finding: NOT over NULL)."""
+
+from transferia_tpu.abstract import TableID
+from transferia_tpu.abstract.schema import new_table_schema
+from transferia_tpu.columnar import ColumnBatch
+from transferia_tpu.predicate import compile_mask, parse
+
+
+SCHEMA = new_table_schema([("id", "int64", True), ("name", "utf8"),
+                           ("x", "double")])
+
+
+def batch():
+    return ColumnBatch.from_pydict(TableID("", "t"), SCHEMA, {
+        "id": [1, 2, 3],
+        "name": [None, "alpha", "beta"],
+        "x": [None, 1.0, 2.0],
+    })
+
+
+def mask(text):
+    return compile_mask(parse(text))(batch()).tolist()
+
+
+def test_not_like_excludes_null():
+    # row 1 has NULL name: NOT LIKE must not match it (SQL 3VL)
+    assert mask("name LIKE 'a%'") == [False, True, False]
+    assert mask("name NOT LIKE 'a%'") == [False, False, True]
+
+
+def test_not_equals_matches_equals_negation():
+    assert mask("NOT name = 'alpha'") == mask("name != 'alpha'") == \
+        [False, False, True]
+    assert mask("NOT x = 1") == mask("x != 1") == [False, False, True]
+
+
+def test_not_in_excludes_null():
+    assert mask("name NOT IN ('alpha')") == [False, False, True]
+    assert mask("NOT name IN ('alpha')") == [False, False, True]
+
+
+def test_null_propagates_through_and_or():
+    # OR: NULL OR TRUE = TRUE; NULL OR FALSE = NULL (no match)
+    assert mask("x > 0 OR id = 1") == [True, True, True]
+    assert mask("x > 99 OR name = 'alpha'") == [False, True, False]
+    # AND: NULL AND TRUE = NULL (no match)
+    assert mask("x > 0 AND id >= 1") == [False, True, True]
+    # NOT over a NULL-involved conjunction still excludes the NULL row
+    assert mask("NOT (x > 0 AND id >= 1)") == [False, False, False]
+
+
+def test_is_null_unaffected():
+    assert mask("x IS NULL") == [True, False, False]
+    assert mask("NOT x IS NULL") == [False, True, True]
+
+
+def test_mixed_table_row_batch_through_chain():
+    from transferia_tpu.transform import build_chain
+    from transferia_tpu.abstract import ChangeItem, Kind
+
+    other = new_table_schema([("id", "int64", True)])
+    chain = build_chain({"transformers": [
+        {"rename_tables": {"tables": [{"from": ".t", "to": ".t2"}]}},
+    ]})
+    items = [
+        ChangeItem(kind=Kind.INSERT, table="t", column_names=("id",),
+                   column_values=(1,), table_schema=other),
+        ChangeItem(kind=Kind.INSERT, table="u", column_names=("id",),
+                   column_values=(2,), table_schema=other),
+    ]
+    out = chain.apply(items)  # must not raise on mixed tables
+    tables = sorted(it.table_id.name for it in out)
+    assert tables == ["t2", "u"]

@@ -1,0 +1,211 @@
+"""Transformer framework + built-in plugins."""
+
+import hashlib
+import hmac
+
+import numpy as np
+import pytest
+
+from transferia_tpu.abstract import TableID
+from transferia_tpu.abstract.schema import CanonicalType, new_table_schema
+from transferia_tpu.columnar import ColumnBatch
+from transferia_tpu.transform import (
+    Transformation,
+    build_chain,
+    make_transformer,
+    registered_transformers,
+)
+
+
+SCHEMA = new_table_schema([
+    ("id", "int64", True),
+    ("email", "utf8"),
+    ("amount", "double"),
+    ("country", "utf8"),
+])
+TID = TableID("shop", "orders")
+
+
+def make_batch(n=4):
+    return ColumnBatch.from_pydict(TID, SCHEMA, {
+        "id": list(range(1, n + 1)),
+        "email": [f"u{i}@example.com" for i in range(1, n + 1)],
+        "amount": [i * 10.0 for i in range(1, n + 1)],
+        "country": ["de", "us", "de", "fr"][:n],
+    })
+
+
+def test_registry_lists_builtins():
+    names = registered_transformers()
+    for expected in ("rename_tables", "rename_columns", "filter_columns",
+                     "filter_rows", "mask_field", "to_string",
+                     "number_to_float", "replace_primary_key", "lambda",
+                     "sharder", "table_splitter", "logger", "to_datetime",
+                     "filter_rows_by_ids"):
+        assert expected in names
+
+
+def test_rename_tables():
+    chain = build_chain({"transformers": [
+        {"rename_tables": {"tables": [{"from": "shop.orders",
+                                       "to": "dw.orders_v2"}]}},
+    ]})
+    out = chain.apply(make_batch())
+    assert out.table_id == TableID("dw", "orders_v2")
+    out_t, _ = chain.output_schema(TID, SCHEMA)
+    assert out_t == TableID("dw", "orders_v2")
+
+
+def test_rename_columns():
+    chain = build_chain({"transformers": [
+        {"rename_columns": {"columns": {"email": "email_hash"}}},
+    ]})
+    out = chain.apply(make_batch())
+    assert "email_hash" in out.columns and "email" not in out.columns
+    assert out.schema.find("email_hash") is not None
+
+
+def test_filter_columns_keeps_pk():
+    chain = build_chain({"transformers": [
+        {"filter_columns": {"exclude": ["id", "email"]}},
+    ]})
+    out = chain.apply(make_batch())
+    # id is primary key: kept despite exclude
+    assert list(out.columns) == ["id", "amount", "country"]
+
+
+def test_filter_rows_predicate():
+    chain = build_chain({"transformers": [
+        {"filter_rows": {"filter": "amount > 15 AND country = 'de'"}},
+    ]})
+    out = chain.apply(make_batch())
+    assert out.to_pydict()["id"] == [3]
+
+
+def test_mask_field_hmac():
+    chain = build_chain({"transformers": [
+        {"mask_field": {"columns": ["email"], "salt": "s3cr3t"}},
+    ]})
+    out = chain.apply(make_batch(2))
+    got = out.to_pydict()["email"]
+    want = [
+        hmac.new(b"s3cr3t", f"u{i}@example.com".encode(),
+                 hashlib.sha256).hexdigest()
+        for i in (1, 2)
+    ]
+    assert got == want
+    assert out.schema.find("email").data_type == CanonicalType.UTF8
+
+
+def test_mask_field_fixed_width_column():
+    chain = build_chain({"transformers": [
+        {"mask_field": {"columns": ["id"], "salt": "k"}},
+    ]})
+    out = chain.apply(make_batch(2))
+    want = hmac.new(b"k", b"1", hashlib.sha256).hexdigest()
+    assert out.to_pydict()["id"][0] == want
+
+
+def test_number_to_float():
+    chain = build_chain({"transformers": [{"number_to_float": {}}]})
+    out = chain.apply(make_batch())
+    assert out.schema.find("id").data_type == CanonicalType.DOUBLE
+    assert out.to_pydict()["id"] == [1.0, 2.0, 3.0, 4.0]
+
+
+def test_to_string():
+    chain = build_chain({"transformers": [
+        {"to_string": {"columns": ["amount"]}},
+    ]})
+    out = chain.apply(make_batch(2))
+    assert out.to_pydict()["amount"] == ["10.0", "20.0"]
+
+
+def test_replace_primary_key():
+    chain = build_chain({"transformers": [
+        {"replace_primary_key": {"keys": ["country", "id"]}},
+    ]})
+    out = chain.apply(make_batch())
+    keys = [c.name for c in out.schema.key_columns()]
+    assert keys == ["country", "id"]
+    assert out.schema.names()[0] == "country"
+
+
+def test_lambda_columns_mode():
+    from transferia_tpu.transform.plugins.lambda_tf import register_lambda
+
+    register_lambda("double_amount", lambda cols: {
+        "amount": cols["amount"] * 2
+    })
+    chain = build_chain({"transformers": [
+        {"lambda": {"function": "double_amount"}},
+    ]})
+    out = chain.apply(make_batch(2))
+    assert out.to_pydict()["amount"] == [20.0, 40.0]
+
+
+def test_lambda_mask_mode():
+    from transferia_tpu.transform.plugins.lambda_tf import register_lambda
+
+    register_lambda("big_only", lambda cols: cols["amount"] > 25)
+    chain = build_chain({"transformers": [
+        {"lambda": {"function": "big_only", "mode": "mask"}},
+    ]})
+    out = chain.apply(make_batch())
+    assert out.to_pydict()["id"] == [3, 4]
+
+
+def test_sharder_adds_shard_column():
+    chain = build_chain({"transformers": [
+        {"sharder": {"shard_by": ["id"], "shard_count": 4}},
+    ]})
+    out = chain.apply(make_batch())
+    shards = out.to_pydict()["__shard"]
+    assert all(0 <= s < 4 for s in shards)
+    # deterministic
+    again = chain.apply(make_batch())
+    assert again.to_pydict()["__shard"] == shards
+
+
+def test_table_splitter_multiway():
+    chain = build_chain({"transformers": [
+        {"table_splitter": {"column": "country"}},
+    ]})
+    out = chain.apply(make_batch())
+    # heterogeneous output comes back as row items
+    assert isinstance(out, list)
+    tables = {it.table_id.name for it in out}
+    assert tables == {"orders_de", "orders_us", "orders_fr"}
+    assert len(out) == 4
+
+
+def test_chain_plan_cache_and_stats():
+    chain = build_chain({"transformers": [
+        {"filter_rows": {"filter": "amount > 0"}},
+    ]})
+    chain.apply(make_batch())
+    chain.apply(make_batch())
+    assert chain.stats.m.value("transform_plan_compiles") == 1.0
+    assert chain.stats.m.value("transform_rows_in") == 8.0
+
+
+def test_chain_passthrough_for_unsuitable():
+    chain = build_chain({"transformers": [
+        {"filter_rows": {"filter": "nonexistent_col > 5"}},
+    ]})
+    out = chain.apply(make_batch())
+    assert out.n_rows == 4  # transformer not suitable -> passthrough
+
+
+def test_unknown_transformer_raises():
+    with pytest.raises(KeyError, match="unknown transformer"):
+        build_chain({"transformers": [{"bogus": {}}]})
+
+
+def test_row_items_pivoted():
+    chain = build_chain({"transformers": [
+        {"filter_rows": {"filter": "amount > 15"}},
+    ]})
+    items = make_batch().to_rows()
+    out = chain.apply(items)
+    assert out.n_rows == 3

@@ -1,0 +1,1 @@
+"""trtpu command-line interface (reference: cmd/trcli/)."""

@@ -1,0 +1,10 @@
+"""Factories resolving providers into pipeline components.
+
+Reference parity: pkg/storage_factory, pkg/source_factory, pkg/sink_factory.
+"""
+
+from transferia_tpu.factories.sink import make_async_sink, make_sinker
+from transferia_tpu.factories.source import new_source
+from transferia_tpu.factories.storage import new_storage
+
+__all__ = ["make_async_sink", "make_sinker", "new_source", "new_storage"]

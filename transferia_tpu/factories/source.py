@@ -1,0 +1,20 @@
+"""Source factory (pkg/source_factory/source_factory.go:13)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from transferia_tpu.abstract.interfaces import Source
+from transferia_tpu.providers.registry import get_provider
+from transferia_tpu.stats.registry import Metrics
+
+
+def new_source(transfer, metrics: Optional[Metrics] = None) -> Source:
+    provider = get_provider(transfer.src_provider(), transfer, metrics)
+    source = provider.source()
+    if source is None:
+        raise ValueError(
+            f"provider {transfer.src_provider()!r} has no replication "
+            f"capability"
+        )
+    return source

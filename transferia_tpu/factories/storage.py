@@ -1,0 +1,19 @@
+"""Storage factory (pkg/storage_factory/storage_factory.go:15)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from transferia_tpu.abstract.interfaces import Storage
+from transferia_tpu.providers.registry import get_provider
+from transferia_tpu.stats.registry import Metrics
+
+
+def new_storage(transfer, metrics: Optional[Metrics] = None) -> Storage:
+    provider = get_provider(transfer.src_provider(), transfer, metrics)
+    storage = provider.storage()
+    if storage is None:
+        raise ValueError(
+            f"provider {transfer.src_provider()!r} has no snapshot capability"
+        )
+    return storage

@@ -1,0 +1,44 @@
+"""Sink pipeline middlewares (reference: pkg/middlewares/).
+
+Two combinator shapes, mirroring abstract.Middleware / AsyncMiddleware
+(pkg/abstract/middleware.go:3-5):
+
+    Middleware      = Callable[[Sinker], Sinker]
+    AsyncMiddleware = Callable[[AsyncSink], AsyncSink]
+
+The full stack is assembled by sink_factory (see transferia_tpu.sink.factory)
+in the reference's order (pkg/sink_factory/sink_factory.go:97-197).
+"""
+
+from transferia_tpu.middlewares.helpers import (
+    batch_bytes,
+    batch_len,
+    batch_table,
+    is_control_batch,
+)
+from transferia_tpu.middlewares.sync import (
+    Filter,
+    IntervalThrottler,
+    Measurer,
+    NonRowSeparator,
+    Retrier,
+    Statistician,
+    TypeFallbacks,
+    Transformation,
+)
+from transferia_tpu.middlewares.asynchronizer import (
+    Asynchronizer,
+    Bufferer,
+    BuffererConfig,
+    ErrorTracker,
+    MemThrottler,
+    Synchronizer,
+)
+
+__all__ = [
+    "batch_bytes", "batch_len", "batch_table", "is_control_batch",
+    "Filter", "IntervalThrottler", "Measurer", "NonRowSeparator",
+    "Retrier", "Statistician", "TypeFallbacks", "Transformation",
+    "Asynchronizer", "Bufferer", "BuffererConfig", "ErrorTracker",
+    "MemThrottler", "Synchronizer",
+]

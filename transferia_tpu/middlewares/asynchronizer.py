@@ -1,0 +1,300 @@
+"""Asynchronous middlewares (wrap/produce AsyncSink).
+
+Reference parity: pkg/middlewares/asynchronizer.go, synchronizer/ (+bufferer
+synchronizer/bufferer/bufferer.go:15-33), memthrottle, error_tracker.go.
+
+The Bufferer is where TPU batch sizes are born: it accumulates small pushes
+until a row/byte/interval trigger fires, merging adjacent compatible units
+into large ColumnBatches so the jitted transform/encode kernels see big
+static shapes.  Control events flush the buffer and pass through standalone,
+preserving the Init/DoneTableLoad ordering contract.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import queue
+import threading
+from typing import Optional
+
+from transferia_tpu.abstract.interfaces import (
+    AsyncSink,
+    Batch,
+    Sinker,
+    SyncAsAsyncSink,
+    is_columnar,
+)
+from transferia_tpu.abstract.kinds import Kind
+from transferia_tpu.columnar.batch import ColumnBatch
+from transferia_tpu.middlewares.helpers import (
+    batch_bytes,
+    batch_len,
+    is_control_batch,
+)
+from transferia_tpu.stats.registry import BuffererStats
+
+logger = logging.getLogger(__name__)
+
+Future = concurrent.futures.Future
+
+
+class Synchronizer(SyncAsAsyncSink):
+    """Sync sinker as AsyncSink with inline resolution
+    (middlewares/synchronizer)."""
+
+
+class Asynchronizer(AsyncSink):
+    """Order-preserving async adapter: single worker thread drains a queue
+    (middlewares/asynchronizer.go).  Lets the source continue reading while
+    the sink writes."""
+
+    def __init__(self, inner: Sinker, max_queue: int = 16):
+        self.inner = inner
+        self._q: "queue.Queue" = queue.Queue(maxsize=max_queue)
+        self._closed = threading.Event()
+        self._worker = threading.Thread(
+            target=self._run, name="asynchronizer", daemon=True
+        )
+        self._worker.start()
+
+    def _run(self):
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            batch, fut = item
+            try:
+                self.inner.push(batch)
+                fut.set_result(None)
+            except BaseException as e:
+                fut.set_exception(e)
+
+    def async_push(self, batch: Batch) -> "Future[None]":
+        fut: Future = Future()
+        if self._closed.is_set():
+            fut.set_exception(RuntimeError("asynchronizer closed"))
+            return fut
+        self._q.put((batch, fut))
+        return fut
+
+    def close(self) -> None:
+        if not self._closed.is_set():
+            self._closed.set()
+            self._q.put(None)
+            self._worker.join(timeout=60)
+            # fail anything that raced in after the sentinel
+            while True:
+                try:
+                    item = self._q.get_nowait()
+                except queue.Empty:
+                    break
+                if item is not None:
+                    item[1].set_exception(
+                        RuntimeError("asynchronizer closed")
+                    )
+            self.inner.close()
+
+
+class ErrorTracker(AsyncSink):
+    """Latches the first push error; subsequent pushes fail fast
+    (middlewares/error_tracker.go).  The replication loop reads
+    `failure` to decide restart vs fatal."""
+
+    def __init__(self, inner: AsyncSink):
+        self.inner = inner
+        self._lock = threading.Lock()
+        self.failure: Optional[BaseException] = None
+
+    def _latch(self, fut: "Future[None]") -> None:
+        err = fut.exception()
+        if err is not None:
+            with self._lock:
+                if self.failure is None:
+                    self.failure = err
+
+    def async_push(self, batch: Batch) -> "Future[None]":
+        with self._lock:
+            if self.failure is not None:
+                fut: Future = Future()
+                fut.set_exception(self.failure)
+                return fut
+        fut = self.inner.async_push(batch)
+        fut.add_done_callback(self._latch)
+        return fut
+
+    def close(self) -> None:
+        self.inner.close()
+
+
+class MemThrottler(AsyncSink):
+    """Bounds in-flight buffered bytes (middlewares/memthrottle).
+
+    async_push blocks while outstanding (pushed-but-unresolved) bytes exceed
+    the limit — backpressure for fast sources / slow sinks.
+    """
+
+    def __init__(self, inner: AsyncSink, limit_bytes: int = 512 << 20):
+        self.inner = inner
+        self.limit = limit_bytes
+        self._outstanding = 0
+        self._cv = threading.Condition()
+
+    def async_push(self, batch: Batch) -> "Future[None]":
+        nbytes = batch_bytes(batch)
+        with self._cv:
+            while self._outstanding > 0 and \
+                    self._outstanding + nbytes > self.limit:
+                self._cv.wait(timeout=1.0)
+            self._outstanding += nbytes
+        fut = self.inner.async_push(batch)
+
+        def release(_f):
+            with self._cv:
+                self._outstanding -= nbytes
+                self._cv.notify_all()
+
+        fut.add_done_callback(release)
+        return fut
+
+    def close(self) -> None:
+        self.inner.close()
+
+
+class BuffererConfig:
+    """Flush triggers (synchronizer/bufferer/bufferer.go:15-33)."""
+
+    def __init__(self, trigger_rows: int = 100_000,
+                 trigger_bytes: int = 64 << 20,
+                 trigger_interval: float = 1.0):
+        self.trigger_rows = trigger_rows
+        self.trigger_bytes = trigger_bytes
+        self.trigger_interval = trigger_interval
+
+
+class Bufferer(AsyncSink):
+    """Accumulate pushes, flush on count/size/interval/non-row/close.
+
+    Futures resolve when the flush containing their batch completes (or
+    fails).  Control/system batches flush pending data first, then push
+    standalone — never reordered relative to surrounding data.
+    """
+
+    def __init__(self, inner: Sinker, cfg: Optional[BuffererConfig] = None,
+                 stats: Optional[BuffererStats] = None):
+        self.inner = inner
+        self.cfg = cfg or BuffererConfig()
+        self.stats = stats or BuffererStats()
+        self._lock = threading.RLock()
+        self._buf: list[tuple[Batch, Future]] = []
+        self._rows = 0
+        self._bytes = 0
+        self._closed = False
+        self._ticker: Optional[threading.Thread] = None
+        self._wake = threading.Event()
+        if self.cfg.trigger_interval > 0:
+            self._ticker = threading.Thread(
+                target=self._tick, name="bufferer-ticker", daemon=True
+            )
+            self._ticker.start()
+
+    # -- internals ----------------------------------------------------------
+    def _tick(self):
+        while not self._closed:
+            self._wake.wait(timeout=self.cfg.trigger_interval)
+            self._wake.clear()
+            if self._closed:
+                return
+            with self._lock:
+                if self._buf:
+                    self._flush_locked()
+
+    @staticmethod
+    def _mergeable(a: Batch, b: Batch) -> bool:
+        if is_columnar(a) and is_columnar(b):
+            return (
+                a.table_id == b.table_id
+                and a.schema.fingerprint() == b.schema.fingerprint()
+                and a.part_id == b.part_id
+            )
+        return not is_columnar(a) and not is_columnar(b)
+
+    def _flush_locked(self) -> None:
+        buf, self._buf = self._buf, []
+        rows, self._rows = self._rows, 0
+        self._bytes = 0
+        self.stats.buffered_rows.set(0)
+        self.stats.buffered_bytes.set(0)
+        if not buf:
+            return
+        # merge adjacent compatible units into big pushes
+        groups: list[tuple[list[Batch], list[Future]]] = []
+        for batch, fut in buf:
+            if groups and self._mergeable(groups[-1][0][-1], batch):
+                groups[-1][0].append(batch)
+                groups[-1][1].append(fut)
+            else:
+                groups.append(([batch], [fut]))
+        failed: Optional[BaseException] = None
+        for batches, futs in groups:
+            if failed is not None:
+                for f in futs:
+                    f.set_exception(failed)
+                continue
+            try:
+                if len(batches) == 1:
+                    merged = batches[0]
+                elif is_columnar(batches[0]):
+                    merged = ColumnBatch.concat(batches)
+                else:
+                    merged = [it for b in batches for it in b]
+                self.inner.push(merged)
+                for f in futs:
+                    f.set_result(None)
+                self.stats.flush_count.inc()
+                self.stats.flush_rows.inc(batch_len(merged))
+            except BaseException as e:
+                failed = e
+                for f in futs:
+                    f.set_exception(e)
+
+    # -- AsyncSink ----------------------------------------------------------
+    def async_push(self, batch: Batch) -> "Future[None]":
+        fut: Future = Future()
+        with self._lock:
+            if self._closed:
+                fut.set_exception(RuntimeError("bufferer closed"))
+                return fut
+            if is_control_batch(batch):
+                # flush pending data, then push the control batch standalone
+                self._flush_locked()
+                try:
+                    self.inner.push(batch)
+                    fut.set_result(None)
+                except BaseException as e:
+                    fut.set_exception(e)
+                return fut
+            self._buf.append((batch, fut))
+            self._rows += batch_len(batch)
+            self._bytes += batch_bytes(batch)
+            self.stats.buffered_rows.set(self._rows)
+            self.stats.buffered_bytes.set(self._bytes)
+            if (self._rows >= self.cfg.trigger_rows
+                    or self._bytes >= self.cfg.trigger_bytes):
+                self._flush_locked()
+        return fut
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._flush_locked()
+            self._closed = True
+        self._wake.set()
+        if self._ticker:
+            self._ticker.join(timeout=5)
+        self.inner.close()

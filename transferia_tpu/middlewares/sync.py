@@ -1,0 +1,181 @@
+"""Synchronous middlewares (wrap Sinker).
+
+Reference parity: pkg/middlewares/{statistician,filter,nonrow_separator,
+fallback,retrier,interval_throttler}.go and the Measurer
+(middlewares/synchronizer/measurer.go).
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Iterable, Optional, Sequence
+
+from transferia_tpu.abstract.errors import is_fatal
+from transferia_tpu.abstract.interfaces import Batch, Sinker, is_columnar
+from transferia_tpu.abstract.schema import TableID
+from transferia_tpu.middlewares.helpers import (
+    batch_bytes,
+    batch_len,
+    split_rows_controls,
+)
+from transferia_tpu.stats.registry import SinkerStats
+from transferia_tpu.utils.backoff import retry_with_backoff
+
+logger = logging.getLogger(__name__)
+
+
+class _Wrap(Sinker):
+    def __init__(self, inner: Sinker):
+        self.inner = inner
+
+    def push(self, batch: Batch) -> None:
+        self.inner.push(batch)
+
+    def close(self) -> None:
+        self.inner.close()
+
+
+class Statistician(_Wrap):
+    """Counts pushed rows/bytes per table (middlewares/statistician.go)."""
+
+    def __init__(self, inner: Sinker, stats: SinkerStats):
+        super().__init__(inner)
+        self.stats = stats
+
+    def push(self, batch: Batch) -> None:
+        n = batch_len(batch)
+        nbytes = batch_bytes(batch)
+        self.stats.inflight_rows.inc(n)
+        t0 = time.monotonic()
+        try:
+            self.inner.push(batch)
+        except BaseException:
+            self.stats.errors.inc()
+            raise
+        finally:
+            self.stats.inflight_rows.dec(n)
+        self.stats.push_time.observe(time.monotonic() - t0)
+        self.stats.rows.inc(n)
+        self.stats.bytes.inc(nbytes)
+        if is_columnar(batch):
+            self.stats.record_table(str(batch.table_id), n)
+        else:
+            for it in batch:
+                if it.is_row_event():
+                    self.stats.record_table(str(it.table_id), 1)
+
+
+class Filter(_Wrap):
+    """Excludes configured tables (middlewares/filter.go — system tables)."""
+
+    def __init__(self, inner: Sinker,
+                 exclude: Callable[[TableID], bool]):
+        super().__init__(inner)
+        self.exclude = exclude
+
+    def push(self, batch: Batch) -> None:
+        if is_columnar(batch):
+            if self.exclude(batch.table_id):
+                return
+            self.inner.push(batch)
+            return
+        kept = [it for it in batch if not self.exclude(it.table_id)]
+        if kept:
+            self.inner.push(kept)
+
+
+class NonRowSeparator(_Wrap):
+    """Ensures inner pushes are homogeneous: row runs or single control items
+    (middlewares/nonrow_separator.go)."""
+
+    def push(self, batch: Batch) -> None:
+        for part in split_rows_controls(batch):
+            self.inner.push(part)
+
+
+class TypeFallbacks(_Wrap):
+    """Applies versioned typesystem fallbacks to columnar batches
+    (middlewares/fallback.go)."""
+
+    def __init__(self, inner: Sinker, fallbacks: Sequence):
+        super().__init__(inner)
+        self.fallbacks = list(fallbacks)
+
+    def push(self, batch: Batch) -> None:
+        if self.fallbacks and is_columnar(batch):
+            for fb in self.fallbacks:
+                batch = fb.apply(batch)
+        self.inner.push(batch)
+
+
+class Retrier(_Wrap):
+    """Retries non-fatal push errors with exponential backoff
+    (middlewares/retrier.go; snapshot-stage only, sink_factory.go:181)."""
+
+    def __init__(self, inner: Sinker, attempts: int = 3,
+                 base_delay: float = 0.5):
+        super().__init__(inner)
+        self.attempts = attempts
+        self.base_delay = base_delay
+
+    def push(self, batch: Batch) -> None:
+        retry_with_backoff(
+            lambda: self.inner.push(batch),
+            attempts=self.attempts,
+            base_delay=self.base_delay,
+            retriable=lambda e: not is_fatal(e),
+            on_retry=lambda i, e: logger.warning(
+                "sink push retry %d/%d after error: %s", i, self.attempts, e
+            ),
+        )
+
+
+class Measurer(_Wrap):
+    """Logs slow pushes (middlewares/synchronizer/measurer.go)."""
+
+    def __init__(self, inner: Sinker, warn_seconds: float = 30.0):
+        super().__init__(inner)
+        self.warn_seconds = warn_seconds
+
+    def push(self, batch: Batch) -> None:
+        t0 = time.monotonic()
+        self.inner.push(batch)
+        dt = time.monotonic() - t0
+        if dt > self.warn_seconds:
+            logger.warning("slow sink push: %d rows took %.1fs",
+                           batch_len(batch), dt)
+
+
+class IntervalThrottler(_Wrap):
+    """Minimum interval between pushes (middlewares/interval_throttler.go)."""
+
+    def __init__(self, inner: Sinker, interval: float):
+        super().__init__(inner)
+        self.interval = interval
+        self._last = 0.0
+
+    def push(self, batch: Batch) -> None:
+        now = time.monotonic()
+        wait = self._last + self.interval - now
+        if wait > 0:
+            time.sleep(wait)
+        self._last = time.monotonic()
+        self.inner.push(batch)
+
+
+class Transformation(_Wrap):
+    """Applies the transformer chain (middlewares/transformation.go).
+
+    Chain is a transform.Transformation instance; imported lazily to keep
+    layering acyclic.
+    """
+
+    def __init__(self, inner: Sinker, chain):
+        super().__init__(inner)
+        self.chain = chain
+
+    def push(self, batch: Batch) -> None:
+        out = self.chain.apply(batch)
+        if batch_len(out) or not batch_len(batch):
+            self.inner.push(out)

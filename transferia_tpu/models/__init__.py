@@ -1,0 +1,29 @@
+"""Transfer/Endpoint model (reference: pkg/abstract/model/)."""
+
+from transferia_tpu.models.endpoint import (
+    CleanupPolicy,
+    EndpointParams,
+    endpoint_from_dict,
+    register_endpoint,
+)
+from transferia_tpu.models.transfer import (
+    DataObjects,
+    RegularSnapshot,
+    Runtime,
+    ShardingUploadParams,
+    Transfer,
+    TransferType,
+)
+
+__all__ = [
+    "CleanupPolicy",
+    "EndpointParams",
+    "endpoint_from_dict",
+    "register_endpoint",
+    "DataObjects",
+    "RegularSnapshot",
+    "Runtime",
+    "ShardingUploadParams",
+    "Transfer",
+    "TransferType",
+]

@@ -1,0 +1,107 @@
+"""Endpoint parameter model + serialization registry.
+
+Reference parity: pkg/abstract/model/endpoint.go (EndpointParams + ~40 opt-in
+capability interfaces) and endpoint_registry.go / serialization.go (the
+provider-keyed codec used to round-trip endpoint params through YAML/JSON).
+
+Capabilities are opt-in methods/attributes on params classes rather than Go
+interface assertions; the helpers below (`capability`) read them with safe
+defaults, so providers only declare what they support.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Type
+
+
+class CleanupPolicy(str, enum.Enum):
+    """Destination cleanup on (re)activation (model CleanupType)."""
+
+    DROP = "drop"
+    TRUNCATE = "truncate"
+    DISABLED = "disabled"
+
+
+@dataclass
+class EndpointParams:
+    """Base endpoint parameters; providers subclass with their own fields.
+
+    Class attributes:
+      PROVIDER: registry key (e.g. "pg", "ch", "kafka", "s3", "sample").
+      IS_SOURCE/IS_TARGET: which roles the subclass may play.
+    """
+
+    PROVIDER = ""
+    IS_SOURCE = False
+    IS_TARGET = False
+
+    # common opt-ins with defaults (endpoint.go capabilities)
+    cleanup_policy: CleanupPolicy = CleanupPolicy.DROP
+
+    def provider(self) -> str:
+        return type(self).PROVIDER
+
+    # -- serialization ------------------------------------------------------
+    def to_dict(self) -> dict[str, Any]:
+        d = dataclasses.asdict(self)
+        for k, v in list(d.items()):
+            if isinstance(v, enum.Enum):
+                d[k] = v.value
+        d["__provider__"] = self.provider()
+        d["__role__"] = "source" if type(self).IS_SOURCE else "target"
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict[str, Any]) -> "EndpointParams":
+        kwargs = {}
+        fields = {f.name: f for f in dataclasses.fields(cls)}
+        for k, v in d.items():
+            if k.startswith("__") or k not in fields:
+                continue
+            ftype = fields[k].type
+            if fields[k].name == "cleanup_policy":
+                v = CleanupPolicy(v)
+            kwargs[k] = v
+        return cls(**kwargs)
+
+
+# provider -> role -> params class
+_ENDPOINT_REGISTRY: dict[tuple[str, str], Type[EndpointParams]] = {}
+
+
+def register_endpoint(cls: Type[EndpointParams]) -> Type[EndpointParams]:
+    """Class decorator: register a params class for YAML/JSON round-trip."""
+    role = "source" if cls.IS_SOURCE else "target"
+    _ENDPOINT_REGISTRY[(cls.PROVIDER, role)] = cls
+    return cls
+
+
+def endpoint_from_dict(d: dict[str, Any],
+                       provider: Optional[str] = None,
+                       role: Optional[str] = None) -> EndpointParams:
+    provider = provider or d.get("__provider__", "")
+    role = role or d.get("__role__", "source")
+    cls = _ENDPOINT_REGISTRY.get((provider, role))
+    if cls is None:
+        raise KeyError(
+            f"unknown endpoint: provider={provider!r} role={role!r}; "
+            f"known: {sorted(_ENDPOINT_REGISTRY)}"
+        )
+    return cls.from_dict(d)
+
+
+def known_endpoints() -> list[tuple[str, str]]:
+    return sorted(_ENDPOINT_REGISTRY)
+
+
+def capability(params: Any, name: str, default: Any = None) -> Any:
+    """Read an opt-in capability attribute/method with a default.
+
+    e.g. capability(dst, "is_shardeable", False),
+         capability(src, "parser_config", None).
+    """
+    v = getattr(params, name, default)
+    return v() if callable(v) else v

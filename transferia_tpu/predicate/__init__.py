@@ -1,0 +1,19 @@
+"""Predicate engine: WHERE-like filters over columnar batches.
+
+Reference parity: pkg/predicate/ (ast.go, parser.go) — used by include
+filters, incremental cursors, and the filter_rows transformer.  Here the AST
+compiles to a vectorized boolean-mask function over ColumnBatch columns
+(numpy on host, jax.numpy under jit) instead of the reference's per-row
+interpreter — one mask evaluation per batch, not per row.
+"""
+
+from transferia_tpu.predicate.parser import parse, ParseError
+from transferia_tpu.predicate.ast import (
+    And, Or, Not, Cmp, InList, IsNull, Between, Node,
+)
+from transferia_tpu.predicate.compile import compile_mask
+
+__all__ = [
+    "parse", "ParseError", "compile_mask",
+    "And", "Or", "Not", "Cmp", "InList", "IsNull", "Between", "Node",
+]

@@ -1,0 +1,31 @@
+"""Provider plugin registry (reference: pkg/providers/provider.go).
+
+Providers register under a name and expose optional capability
+constructors (Snapshot/Replication/Sinker/...); factories resolve them at
+transfer build time.  Built-in providers self-register on import, mirroring
+the reference's blank-import dataplane registration
+(pkg/dataplane/providers.go:1-23).
+"""
+
+from transferia_tpu.providers.registry import (
+    Provider,
+    get_provider,
+    register_provider,
+    registered_providers,
+)
+
+__all__ = [
+    "Provider",
+    "get_provider",
+    "register_provider",
+    "registered_providers",
+]
+
+
+def load_builtin_providers() -> None:
+    """Import all built-in providers (idempotent)."""
+    from transferia_tpu.providers import sample, stdout, memory, file as file_p  # noqa: F401
+    try:
+        from transferia_tpu.providers import s3, clickhouse, kafka, postgres  # noqa: F401
+    except ImportError:  # pragma: no cover - optional deps during bring-up
+        pass

@@ -1,0 +1,292 @@
+"""Local-filesystem object provider: parquet/jsonl/csv files as tables.
+
+Reference parity: the S3 provider's format-reader stack
+(pkg/providers/s3/reader/registry/: csv/json/line/parquet + schema
+inference reader/abstract.go:40-52) operating on a local directory; the S3
+provider proper layers remote listing on top of this (providers/s3.py).
+
+Parquet is the columnar fast path: row groups map straight to ColumnBatch
+via arrow with no row pivot (the ClickBench north-star read path), and each
+row group is a shardable part.
+"""
+
+from __future__ import annotations
+
+import glob as globmod
+import json
+import os
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from transferia_tpu.abstract.interfaces import (
+    Batch,
+    Pusher,
+    ShardingStorage,
+    Sinker,
+    Storage,
+    TableInfo,
+    is_columnar,
+)
+from transferia_tpu.abstract.kinds import Kind
+from transferia_tpu.abstract.schema import TableID, TableSchema
+from transferia_tpu.abstract.table import TableDescription
+from transferia_tpu.columnar.batch import ColumnBatch, arrow_to_table_schema
+from transferia_tpu.models.endpoint import EndpointParams, register_endpoint
+from transferia_tpu.providers.registry import Provider, register_provider
+
+
+@register_endpoint
+@dataclass
+class FileSourceParams(EndpointParams):
+    PROVIDER = "fs"
+    IS_SOURCE = True
+
+    path: str = ""            # file, dir, or glob
+    format: str = "parquet"   # parquet | jsonl | csv
+    table: str = "data"       # logical table name
+    namespace: str = "fs"
+    batch_rows: int = 65_536
+
+
+@register_endpoint
+@dataclass
+class FileTargetParams(EndpointParams):
+    PROVIDER = "fs"
+    IS_TARGET = True
+
+    path: str = ""            # output directory
+    format: str = "parquet"   # parquet | jsonl
+
+
+def _expand(path: str) -> list[str]:
+    if os.path.isdir(path):
+        return sorted(
+            p for p in globmod.glob(os.path.join(path, "**", "*"),
+                                    recursive=True)
+            if os.path.isfile(p)
+        )
+    return sorted(globmod.glob(path))
+
+
+class FileStorage(Storage, ShardingStorage):
+    def __init__(self, params: FileSourceParams):
+        self.params = params
+        self.table = TableID(params.namespace, params.table)
+        self._schema: Optional[TableSchema] = None
+
+    def _files(self) -> list[str]:
+        files = _expand(self.params.path)
+        if not files:
+            raise FileNotFoundError(
+                f"fs source: no files match {self.params.path!r}"
+            )
+        return files
+
+    # -- schema inference ---------------------------------------------------
+    def table_schema(self, table: TableID) -> TableSchema:
+        if self._schema is None:
+            f = self._files()[0]
+            if self.params.format == "parquet":
+                import pyarrow.parquet as pq
+
+                self._schema = arrow_to_table_schema(
+                    pq.read_schema(f)
+                )
+            elif self.params.format == "csv":
+                import pyarrow.csv as pacsv
+
+                # stream only the first block — never parse the whole file
+                # just to learn the schema
+                with pacsv.open_csv(f) as reader:
+                    self._schema = arrow_to_table_schema(reader.schema)
+            else:  # jsonl: sample first lines
+                import pyarrow as pa
+
+                rows = []
+                with open(f) as fh:
+                    for i, line in enumerate(fh):
+                        if i >= 100 or not line.strip():
+                            break
+                        rows.append(json.loads(line))
+                tbl = pa.Table.from_pylist(rows)
+                self._schema = arrow_to_table_schema(tbl.schema)
+        return self._schema
+
+    def table_list(self, include=None):
+        if include and not any(
+                self.table.include_matches(p) for p in include):
+            return {}
+        eta = 0
+        if self.params.format == "parquet":
+            import pyarrow.parquet as pq
+
+            for f in self._files():
+                eta += pq.ParquetFile(f).metadata.num_rows
+        return {self.table: TableInfo(
+            eta_rows=eta, schema=self.table_schema(self.table)
+        )}
+
+    def estimate_table_rows_count(self, table: TableID) -> int:
+        info = self.table_list().get(self.table)
+        return info.eta_rows if info else 0
+
+    # -- sharding: one part per file (parquet: per row-group run) -----------
+    def shard_table(self, table: TableDescription) -> list[TableDescription]:
+        files = self._files()
+        out = []
+        for f in files:
+            if self.params.format == "parquet":
+                import pyarrow.parquet as pq
+
+                meta = pq.ParquetFile(f).metadata
+                out.append(TableDescription(
+                    id=table.id, filter=f"file:{f}",
+                    eta_rows=meta.num_rows,
+                ))
+            else:
+                out.append(TableDescription(id=table.id, filter=f"file:{f}"))
+        return out
+
+    # -- load ---------------------------------------------------------------
+    def load_table(self, table: TableDescription, pusher: Pusher) -> None:
+        if table.filter.startswith("file:"):
+            files = [table.filter[5:]]
+        else:
+            files = self._files()
+        schema = self.table_schema(table.id)
+        for f in files:
+            self._load_file(f, table.id, schema, pusher)
+
+    def _load_file(self, path: str, tid: TableID, schema: TableSchema,
+                   pusher: Pusher) -> None:
+        fmt = self.params.format
+        if fmt == "parquet":
+            import pyarrow.parquet as pq
+
+            pf = pq.ParquetFile(path)
+            for rb in pf.iter_batches(batch_size=self.params.batch_rows):
+                batch = ColumnBatch.from_arrow(rb, tid, schema)
+                batch.read_bytes = rb.nbytes
+                pusher(batch)
+        elif fmt == "csv":
+            import pyarrow.csv as pacsv
+
+            with pacsv.open_csv(
+                path,
+                read_options=pacsv.ReadOptions(
+                    block_size=max(1 << 20, self.params.batch_rows * 64)
+                ),
+            ) as reader:
+                for rb in reader:
+                    if rb.num_rows:
+                        batch = ColumnBatch.from_arrow(rb, tid, schema)
+                        batch.read_bytes = rb.nbytes
+                        pusher(batch)
+        elif fmt == "jsonl":
+            rows: list[dict] = []
+            nbytes = 0
+            with open(path, "rb") as fh:
+                for line in fh:
+                    if not line.strip():
+                        continue
+                    rows.append(json.loads(line))
+                    nbytes += len(line)
+                    if len(rows) >= self.params.batch_rows:
+                        self._push_json_rows(rows, nbytes, tid, schema, pusher)
+                        rows, nbytes = [], 0
+            if rows:
+                self._push_json_rows(rows, nbytes, tid, schema, pusher)
+        else:
+            raise ValueError(f"fs source: unknown format {fmt!r}")
+
+    @staticmethod
+    def _push_json_rows(rows: list[dict], nbytes: int, tid: TableID,
+                        schema: TableSchema, pusher: Pusher) -> None:
+        data = {c.name: [r.get(c.name) for r in rows] for c in schema}
+        batch = ColumnBatch.from_pydict(tid, schema, data)
+        batch.read_bytes = nbytes
+        pusher(batch)
+
+
+class FileSinker(Sinker):
+    """Writes per-table files; parquet goes through arrow zero-pivot."""
+
+    def __init__(self, params: FileTargetParams):
+        self.params = params
+        os.makedirs(params.path, exist_ok=True)
+        self._writers: dict[TableID, object] = {}
+        self._counters: dict[TableID, int] = {}
+
+    def _out_path(self, tid: TableID, ext: str) -> str:
+        self._counters[tid] = self._counters.get(tid, 0)
+        return os.path.join(
+            self.params.path,
+            f"{tid.namespace}.{tid.name}.{self._counters[tid]:06d}.{ext}",
+        )
+
+    def push(self, batch: Batch) -> None:
+        if is_columnar(batch):
+            self._write_columnar(batch)
+            return
+        # process in order: rows before a done-marker must land in the file
+        # that marker finalizes
+        run: list = []
+        for it in batch:
+            if it.is_row_event():
+                run.append(it)
+                continue
+            if run:
+                self._write_columnar(ColumnBatch.from_rows(run))
+                run = []
+            if it.kind in (Kind.DONE_TABLE_LOAD,
+                           Kind.DONE_SHARDED_TABLE_LOAD):
+                self._finish_table(it.table_id)
+        if run:
+            self._write_columnar(ColumnBatch.from_rows(run))
+
+    def _write_columnar(self, batch: ColumnBatch) -> None:
+        tid = batch.table_id
+        if self.params.format == "parquet":
+            import pyarrow.parquet as pq
+
+            rb = batch.to_arrow()
+            w = self._writers.get(tid)
+            if w is None:
+                w = pq.ParquetWriter(
+                    self._out_path(tid, "parquet"), rb.schema
+                )
+                self._writers[tid] = w
+            w.write_batch(rb)
+        elif self.params.format == "jsonl":
+            path = os.path.join(
+                self.params.path, f"{tid.namespace}.{tid.name}.jsonl"
+            )
+            with open(path, "a") as fh:
+                for row in batch.to_rows():
+                    fh.write(json.dumps(row.as_dict(), default=str) + "\n")
+        else:
+            raise ValueError(f"fs sink: unknown format {self.params.format!r}")
+
+    def _finish_table(self, tid: TableID) -> None:
+        w = self._writers.pop(tid, None)
+        if w is not None:
+            w.close()
+            self._counters[tid] = self._counters.get(tid, 0) + 1
+
+    def close(self) -> None:
+        for w in self._writers.values():
+            w.close()
+        self._writers.clear()
+
+
+@register_provider
+class FileProvider(Provider):
+    NAME = "fs"
+
+    def storage(self):
+        return FileStorage(self.transfer.src)
+
+    def sinker(self):
+        return FileSinker(self.transfer.dst)

@@ -1,0 +1,185 @@
+"""In-memory mock provider for tests.
+
+Reference parity: pkg/abstract/model/model_mock_destination.go /
+model_mock_source.go and the *2mock e2e suites — a sink that captures
+everything for assertions, and a storage made of pre-loaded batches.
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from transferia_tpu.abstract.change_item import ChangeItem
+from transferia_tpu.abstract.interfaces import (
+    Batch,
+    Pusher,
+    Sinker,
+    Storage,
+    TableInfo,
+    is_columnar,
+)
+from transferia_tpu.abstract.schema import TableID, TableSchema
+from transferia_tpu.abstract.table import TableDescription
+from transferia_tpu.columnar.batch import ColumnBatch
+from transferia_tpu.models.endpoint import EndpointParams, register_endpoint
+from transferia_tpu.providers.registry import Provider, register_provider
+
+# sink_id -> captured store
+_STORES: dict[str, "MemoryStore"] = {}
+_SOURCES: dict[str, list[ColumnBatch]] = {}
+
+
+class MemoryStore:
+    """Captured pushes, with row-level views for assertions."""
+
+    def __init__(self):
+        self.lock = threading.Lock()
+        self.batches: list[Batch] = []
+
+    def push(self, batch: Batch) -> None:
+        with self.lock:
+            self.batches.append(batch)
+
+    # -- assertion helpers --------------------------------------------------
+    def rows(self, table: Optional[TableID] = None) -> list[ChangeItem]:
+        out = []
+        with self.lock:
+            for b in self.batches:
+                items = b.to_rows() if is_columnar(b) else list(b)
+                for it in items:
+                    if it.is_row_event() and \
+                            (table is None or it.table_id == table):
+                        out.append(it)
+        return out
+
+    def control_events(self) -> list[ChangeItem]:
+        out = []
+        with self.lock:
+            for b in self.batches:
+                if not is_columnar(b):
+                    out.extend(it for it in b if not it.is_row_event())
+        return out
+
+    def row_count(self, table: Optional[TableID] = None) -> int:
+        n = 0
+        with self.lock:
+            for b in self.batches:
+                if is_columnar(b):
+                    if table is None or b.table_id == table:
+                        n += b.n_rows
+                else:
+                    n += sum(
+                        1 for it in b
+                        if it.is_row_event()
+                        and (table is None or it.table_id == table)
+                    )
+        return n
+
+    def tables(self) -> set[TableID]:
+        out = set()
+        with self.lock:
+            for b in self.batches:
+                if is_columnar(b):
+                    out.add(b.table_id)
+                else:
+                    out.update(it.table_id for it in b)
+        return out
+
+    def clear(self) -> None:
+        with self.lock:
+            self.batches.clear()
+
+
+def get_store(sink_id: str) -> MemoryStore:
+    if sink_id not in _STORES:
+        _STORES[sink_id] = MemoryStore()
+    return _STORES[sink_id]
+
+
+def seed_source(source_id: str, batches: list[ColumnBatch]) -> None:
+    """Pre-load batches for a MemorySourceParams storage."""
+    _SOURCES[source_id] = batches
+
+
+@register_endpoint
+@dataclass
+class MemoryTargetParams(EndpointParams):
+    PROVIDER = "memory"
+    IS_TARGET = True
+
+    sink_id: str = "default"
+    fail_pushes: int = 0       # fail the first N pushes (retry testing)
+    bufferer: Optional[dict] = None
+
+    def bufferer_config(self):
+        return self.bufferer
+
+
+@register_endpoint
+@dataclass
+class MemorySourceParams(EndpointParams):
+    PROVIDER = "memory"
+    IS_SOURCE = True
+
+    source_id: str = "default"
+
+
+class MemorySinker(Sinker):
+    def __init__(self, params: MemoryTargetParams):
+        self.params = params
+        self.store = get_store(params.sink_id)
+        self._fails_left = params.fail_pushes
+
+    def push(self, batch: Batch) -> None:
+        if self._fails_left > 0:
+            self._fails_left -= 1
+            raise ConnectionError(
+                f"injected failure ({self._fails_left} left)"
+            )
+        self.store.push(batch)
+
+
+class MemoryStorage(Storage):
+    def __init__(self, params: MemorySourceParams):
+        self.batches = _SOURCES.get(params.source_id, [])
+
+    def _by_table(self) -> dict[TableID, list[ColumnBatch]]:
+        out: dict[TableID, list[ColumnBatch]] = {}
+        for b in self.batches:
+            out.setdefault(b.table_id, []).append(b)
+        return out
+
+    def table_list(self, include=None):
+        out = {}
+        for tid, batches in self._by_table().items():
+            if include and not any(tid.include_matches(p) for p in include):
+                continue
+            out[tid] = TableInfo(
+                eta_rows=sum(b.n_rows for b in batches),
+                schema=batches[0].schema,
+            )
+        return out
+
+    def table_schema(self, table: TableID) -> TableSchema:
+        return self._by_table()[table][0].schema
+
+    def load_table(self, table: TableDescription, pusher: Pusher) -> None:
+        for b in self._by_table().get(table.id, []):
+            pusher(b)
+
+
+@register_provider
+class MemoryProvider(Provider):
+    NAME = "memory"
+
+    def storage(self):
+        if isinstance(self.transfer.src, MemorySourceParams):
+            return MemoryStorage(self.transfer.src)
+        return None
+
+    def sinker(self):
+        if isinstance(self.transfer.dst, MemoryTargetParams):
+            return MemorySinker(self.transfer.dst)
+        return None

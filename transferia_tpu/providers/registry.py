@@ -1,0 +1,120 @@
+"""Provider base + registry (provider.go:15-94)."""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional, Type
+
+from transferia_tpu.abstract.interfaces import (
+    AsyncSink,
+    Sinker,
+    Source,
+    Storage,
+)
+from transferia_tpu.stats.registry import Metrics
+
+
+@dataclass
+class TestResult:
+    """Endpoint connectivity check result (provider Tester)."""
+
+    ok: bool
+    checks: dict[str, str] = field(default_factory=dict)  # name -> "ok"/err
+
+    def add(self, name: str, err: Optional[BaseException] = None) -> None:
+        self.checks[name] = "ok" if err is None else str(err)
+        if err is not None:
+            self.ok = False
+
+
+class ActivateCallbacks:
+    """Hooks handed to Provider.activate (provider_tasks.go Activator)."""
+
+    def __init__(self, cleanup: Callable[[list], None],
+                 upload: Callable[[list], None]):
+        self.cleanup = cleanup
+        self.upload = upload
+
+
+class Provider(abc.ABC):
+    """One connector.  Subclasses override the capabilities they support;
+    the default None/NotImplemented signals 'capability absent' the way the
+    reference's interface assertions do (provider.go:15-88)."""
+
+    NAME = ""
+
+    def __init__(self, transfer, metrics: Optional[Metrics] = None):
+        self.transfer = transfer
+        self.metrics = metrics or Metrics()
+
+    # -- capabilities (return None when unsupported) ------------------------
+    def storage(self) -> Optional[Storage]:
+        """Snapshot capability."""
+        return None
+
+    def source(self) -> Optional[Source]:
+        """Replication capability."""
+        return None
+
+    def sinker(self) -> Optional[Sinker]:
+        """Sync sink capability."""
+        return None
+
+    def snapshot_sinker(self) -> Optional[Sinker]:
+        """Dedicated snapshot-stage sink (SnapshotSinker), else sinker()."""
+        return None
+
+    def async_sink(self) -> Optional[AsyncSink]:
+        """Native AsyncSink (e.g. CH async insert path)."""
+        return None
+
+    def activate(self, callbacks: ActivateCallbacks) -> None:
+        """Custom activation flow (Activator); default = cleanup + upload of
+        all tables, implemented by the activate task itself."""
+        raise NotImplementedError
+
+    def supports_activate(self) -> bool:
+        return type(self).activate is not Provider.activate
+
+    def cleanup(self, tables: list) -> None:
+        """Cleanuper: drop/truncate target tables per cleanup_policy."""
+
+    def test(self) -> TestResult:
+        """Tester: connectivity / permissions checks."""
+        return TestResult(ok=True)
+
+    def deactivate(self) -> None:
+        """Deactivator: release source resources (slots etc.)."""
+
+
+_PROVIDERS: dict[str, Type[Provider]] = {}
+
+
+def register_provider(cls: Type[Provider]) -> Type[Provider]:
+    if not cls.NAME:
+        raise ValueError("provider class must set NAME")
+    _PROVIDERS[cls.NAME] = cls
+    return cls
+
+
+def get_provider(name: str, transfer, metrics: Optional[Metrics] = None
+                 ) -> Provider:
+    cls = _PROVIDERS.get(name)
+    if cls is None:
+        from transferia_tpu.providers import load_builtin_providers
+
+        load_builtin_providers()
+        cls = _PROVIDERS.get(name)
+    if cls is None:
+        raise KeyError(
+            f"unknown provider {name!r}; registered: {sorted(_PROVIDERS)}"
+        )
+    return cls(transfer, metrics)
+
+
+def registered_providers() -> list[str]:
+    from transferia_tpu.providers import load_builtin_providers
+
+    load_builtin_providers()
+    return sorted(_PROVIDERS)

@@ -1,0 +1,256 @@
+"""Synthetic sample provider — the built-in load generator.
+
+Reference parity: pkg/providers/sample/ (model_source.go:20-27 iot/user
+presets, sharded_storage.go).  Generates deterministic columnar batches
+directly (no row pivot): the data is born device-ready, which is what makes
+it the benchmark source for the TPU path.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from transferia_tpu.abstract.change_item import (
+    done_table_load,
+    init_table_load,
+)
+from transferia_tpu.abstract.interfaces import (
+    AsyncSink,
+    Pusher,
+    ShardingStorage,
+    Source,
+    Storage,
+    TableInfo,
+)
+from transferia_tpu.abstract.schema import TableID, TableSchema, new_table_schema
+from transferia_tpu.abstract.table import TableDescription
+from transferia_tpu.columnar.batch import Column, ColumnBatch
+from transferia_tpu.models.endpoint import EndpointParams, register_endpoint
+from transferia_tpu.providers.registry import Provider, register_provider
+from transferia_tpu.typesystem.rules import register_source_rules
+from transferia_tpu.abstract.schema import CanonicalType
+
+
+@register_endpoint
+@dataclass
+class SampleSourceParams(EndpointParams):
+    PROVIDER = "sample"
+    IS_SOURCE = True
+
+    preset: str = "iot"          # iot | users
+    table: str = "events"
+    rows: int = 100_000          # snapshot rows
+    batch_rows: int = 16_384
+    rate: float = 0.0            # replication rows/sec, 0 = unthrottled
+    replication_batch: int = 1024
+    seed: int = 7
+    shard_parts: int = 0         # >0: advertise ShardingStorage parts
+
+
+_IOT_SCHEMA = new_table_schema([
+    ("event_id", "int64", True),
+    ("device_id", "utf8"),
+    ("ts", "timestamp"),
+    ("temperature", "double"),
+    ("humidity", "double"),
+    ("status", "utf8"),
+])
+
+_USERS_SCHEMA = new_table_schema([
+    ("user_id", "int64", True),
+    ("name", "utf8"),
+    ("email", "utf8"),
+    ("age", "int32"),
+    ("score", "double"),
+    ("country", "utf8"),
+])
+
+_STATUSES = np.array(["ok", "warn", "error", "offline"])
+_COUNTRIES = np.array(["de", "us", "fr", "jp", "br", "in"])
+
+register_source_rules("sample", {
+    "int64": CanonicalType.INT64, "utf8": CanonicalType.UTF8,
+    "timestamp": CanonicalType.TIMESTAMP, "double": CanonicalType.DOUBLE,
+    "int32": CanonicalType.INT32,
+})
+
+
+def _utf8_column(name: str, values: np.ndarray) -> Column:
+    """Build a var-width column from a numpy unicode array (vectorized)."""
+    joined = "\x00".join(values.tolist())
+    data = np.frombuffer(joined.encode(), dtype=np.uint8)
+    # recompute offsets from encoded lengths (ascii-safe presets)
+    lens = np.array([len(v.encode()) for v in values.tolist()], dtype=np.int64)
+    from transferia_tpu.columnar.batch import _offsets_from_lengths
+
+    offsets = _offsets_from_lengths(lens)
+    # strip separators
+    out = np.empty(int(offsets[-1]), dtype=np.uint8)
+    pos = 0
+    src = 0
+    for L in lens:
+        out[pos:pos + L] = data[src:src + L]
+        pos += L
+        src += L + 1
+    return Column(name, CanonicalType.UTF8, out, offsets)
+
+
+def make_batch(preset: str, table: TableID, start: int, n: int,
+               seed: int) -> ColumnBatch:
+    """Deterministic batch of n rows with ids [start, start+n)."""
+    rng = np.random.default_rng(seed + start)
+    ids = np.arange(start, start + n, dtype=np.int64)
+    if preset == "iot":
+        dev = rng.integers(0, 1000, n)
+        cols = {
+            "event_id": Column("event_id", CanonicalType.INT64, ids),
+            "device_id": _utf8_column(
+                "device_id",
+                np.char.add("dev-", dev.astype("U6")),
+            ),
+            "ts": Column("ts", CanonicalType.TIMESTAMP,
+                         np.int64(1_700_000_000_000_000) + ids * 1000),
+            "temperature": Column(
+                "temperature", CanonicalType.DOUBLE,
+                np.round(rng.normal(21.0, 5.0, n), 3),
+            ),
+            "humidity": Column(
+                "humidity", CanonicalType.DOUBLE,
+                np.round(rng.uniform(0, 100, n), 3),
+            ),
+            "status": _utf8_column(
+                "status", _STATUSES[rng.integers(0, 4, n)].astype("U8")
+            ),
+        }
+        return ColumnBatch(table, _IOT_SCHEMA, cols)
+    if preset == "users":
+        cols = {
+            "user_id": Column("user_id", CanonicalType.INT64, ids),
+            "name": _utf8_column(
+                "name", np.char.add("user_", ids.astype("U12"))
+            ),
+            "email": _utf8_column(
+                "email",
+                np.char.add(np.char.add("u", ids.astype("U12")),
+                            "@example.com"),
+            ),
+            "age": Column("age", CanonicalType.INT32,
+                          rng.integers(18, 90, n).astype(np.int32)),
+            "score": Column("score", CanonicalType.DOUBLE,
+                            np.round(rng.uniform(0, 1000, n), 2)),
+            "country": _utf8_column(
+                "country", _COUNTRIES[rng.integers(0, 6, n)].astype("U4")
+            ),
+        }
+        return ColumnBatch(table, _USERS_SCHEMA, cols)
+    raise ValueError(f"sample: unknown preset {preset!r}")
+
+
+def preset_schema(preset: str) -> TableSchema:
+    return _IOT_SCHEMA if preset == "iot" else _USERS_SCHEMA
+
+
+class SampleStorage(Storage, ShardingStorage):
+    """Snapshot storage over the generator (sample/sharded_storage.go)."""
+
+    def __init__(self, params: SampleSourceParams):
+        self.params = params
+        self.table = TableID("sample", params.table)
+
+    def table_list(self, include=None):
+        info = TableInfo(eta_rows=self.params.rows,
+                         schema=preset_schema(self.params.preset))
+        tables = {self.table: info}
+        if include:
+            tables = {
+                t: i for t, i in tables.items()
+                if any(t.include_matches(p) for p in include)
+            }
+        return tables
+
+    def table_schema(self, table: TableID) -> TableSchema:
+        return preset_schema(self.params.preset)
+
+    def estimate_table_rows_count(self, table: TableID) -> int:
+        return self.params.rows
+
+    def exact_table_rows_count(self, table: TableID) -> int:
+        return self.params.rows
+
+    def shard_table(self, table: TableDescription) -> list[TableDescription]:
+        parts = self.params.shard_parts
+        if parts <= 1:
+            return [table]
+        total = self.params.rows
+        per = (total + parts - 1) // parts
+        out = []
+        for i in range(parts):
+            lo = i * per
+            hi = min(total, lo + per)
+            if lo >= hi:
+                break
+            out.append(TableDescription(
+                id=table.id, filter=f"rows:{lo}:{hi}", offset=lo,
+                eta_rows=hi - lo,
+            ))
+        return out
+
+    def load_table(self, table: TableDescription, pusher: Pusher) -> None:
+        if table.filter.startswith("rows:"):
+            _, lo_s, hi_s = table.filter.split(":")
+            lo, hi = int(lo_s), int(hi_s)
+        else:
+            lo, hi = 0, self.params.rows
+        bs = self.params.batch_rows
+        for start in range(lo, hi, bs):
+            n = min(bs, hi - start)
+            pusher(make_batch(self.params.preset, table.id, start, n,
+                              self.params.seed))
+
+
+class SampleReplicationSource(Source):
+    """Endless insert stream (replication mode load generator)."""
+
+    def __init__(self, params: SampleSourceParams):
+        self.params = params
+        self.table = TableID("sample", params.table)
+        self._stop = threading.Event()
+
+    def run(self, sink: AsyncSink) -> None:
+        lsn = 0
+        start = self.params.rows  # continue after snapshot range
+        bs = self.params.replication_batch
+        schema = preset_schema(self.params.preset)
+        futures = []
+        while not self._stop.is_set():
+            batch = make_batch(self.params.preset, self.table, start, bs,
+                               self.params.seed)
+            lsn += 1
+            batch.lsns = np.full(bs, lsn, dtype=np.int64)
+            futures.append(sink.async_push(batch))
+            if len(futures) > 16:
+                futures.pop(0).result()
+            start += bs
+            if self.params.rate > 0:
+                self._stop.wait(bs / self.params.rate)
+        for f in futures:
+            f.result()
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+@register_provider
+class SampleProvider(Provider):
+    NAME = "sample"
+
+    def storage(self):
+        return SampleStorage(self.transfer.src)
+
+    def source(self):
+        return SampleReplicationSource(self.transfer.src)

@@ -1,0 +1,15 @@
+"""Typed metric bundles (reference: pkg/stats/, internal/metrics/).
+
+Thin facade over prometheus_client with a per-pipeline registry so tests can
+read values without global state.  Bundles mirror the reference's
+SourceStats / SinkerStats / MiddlewareBuffererStats etc. (pkg/stats/source.go:11,
+sinker.go:12, middleware.go) and are exposed on the CLI's /metrics port.
+"""
+
+from transferia_tpu.stats.registry import Metrics, SinkerStats, SourceStats, \
+    BuffererStats, ReplicationStats, TableStats, TransformStats
+
+__all__ = [
+    "Metrics", "SourceStats", "SinkerStats", "BuffererStats",
+    "ReplicationStats", "TableStats", "TransformStats",
+]

@@ -1,0 +1,190 @@
+"""Metrics facade + typed stat bundles."""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+try:
+    from prometheus_client import (
+        CollectorRegistry,
+        Counter,
+        Gauge,
+        Histogram,
+    )
+    _HAVE_PROM = True
+except ImportError:  # pragma: no cover - degrade to local counters
+    _HAVE_PROM = False
+
+    class _Local:
+        def __init__(self, name, doc, registry=None, **kw):
+            self._v = 0.0
+
+        def inc(self, amount=1.0):
+            self._v += amount
+
+        def dec(self, amount=1.0):
+            self._v -= amount
+
+        def set(self, value):
+            self._v = value
+
+        def observe(self, value):
+            self._v += value
+
+        class _ValueView:
+            def __init__(self, outer):
+                self._outer = outer
+
+            def get(self):
+                return self._outer._v
+
+        @property
+        def _value(self):
+            return self._ValueView(self)
+
+    CollectorRegistry = None  # type: ignore
+    Counter = Gauge = Histogram = _Local  # type: ignore
+
+
+class Metrics:
+    """Per-pipeline metric registry.
+
+    Wraps a prometheus CollectorRegistry; `value()` reads back a sample for
+    tests and progress reporting (the reference reads typed stat structs the
+    same way, pkg/stats/*).
+    """
+
+    def __init__(self, registry: Optional["CollectorRegistry"] = None,
+                 labels: Optional[dict[str, str]] = None):
+        self.registry = registry if registry is not None else (
+            CollectorRegistry() if _HAVE_PROM else None
+        )
+        if not _HAVE_PROM:
+            self.registry = None
+        self.labels = labels or {}
+        self._metrics: dict[str, object] = {}
+
+    def _get(self, cls, name: str, doc: str, **kw):
+        if name not in self._metrics:
+            self._metrics[name] = cls(
+                name, doc, registry=self.registry, **kw
+            )
+        return self._metrics[name]
+
+    def counter(self, name: str, doc: str = "") -> "Counter":
+        return self._get(Counter, name, doc or name)
+
+    def gauge(self, name: str, doc: str = "") -> "Gauge":
+        return self._get(Gauge, name, doc or name)
+
+    def histogram(self, name: str, doc: str = "") -> "Histogram":
+        return self._get(Histogram, name, doc or name,
+                         buckets=(.001, .005, .01, .05, .1, .5, 1, 5, 30, 120))
+
+    def value(self, name: str) -> float:
+        """Read back a counter/gauge current value (tests, progress)."""
+        m = self._metrics.get(name)
+        if m is None:
+            return 0.0
+        try:
+            return m._value.get()  # Counter/Gauge internal, stable in practice
+        except AttributeError:
+            total = 0.0
+            for mf in self.registry.collect():
+                if mf.name == name:
+                    for s in mf.samples:
+                        if s.name in (name, name + "_total"):
+                            total += s.value
+            return total
+
+
+class _Bundle:
+    def __init__(self, metrics: Optional[Metrics] = None):
+        self.m = metrics or Metrics()
+
+
+class SourceStats(_Bundle):
+    """publisher.data.* (pkg/stats/source.go:11-31)."""
+
+    def __init__(self, metrics: Optional[Metrics] = None):
+        super().__init__(metrics)
+        self.changeitems = self.m.counter("publisher_data_changeitems")
+        self.parsed_rows = self.m.counter("publisher_data_parsed_rows")
+        self.unparsed_rows = self.m.counter("publisher_data_unparsed_rows")
+        self.read_bytes = self.m.counter("publisher_data_read_bytes")
+        self.decode_time = self.m.histogram("publisher_time_decode")
+        self.push_time = self.m.histogram("publisher_time_push")
+        self.usage_lag = self.m.gauge("publisher_lag_seconds")
+
+
+class SinkerStats(_Bundle):
+    """sinker.* (pkg/stats/sinker.go:12)."""
+
+    def __init__(self, metrics: Optional[Metrics] = None):
+        super().__init__(metrics)
+        self.inflight_rows = self.m.gauge("sinker_inflight_rows")
+        self.rows = self.m.counter("sinker_pushed_rows")
+        self.bytes = self.m.counter("sinker_pushed_bytes")
+        self.errors = self.m.counter("sinker_push_errors")
+        self.push_time = self.m.histogram("sinker_time_push")
+        self.table_rows: dict[str, int] = {}
+
+    def record_table(self, table: str, rows: int) -> None:
+        self.table_rows[table] = self.table_rows.get(table, 0) + rows
+
+
+class BuffererStats(_Bundle):
+    """middleware bufferer flush metrics."""
+
+    def __init__(self, metrics: Optional[Metrics] = None):
+        super().__init__(metrics)
+        self.flush_count = self.m.counter("bufferer_flushes")
+        self.flush_rows = self.m.counter("bufferer_flush_rows")
+        self.buffered_rows = self.m.gauge("bufferer_buffered_rows")
+        self.buffered_bytes = self.m.gauge("bufferer_buffered_bytes")
+        self.flush_time = self.m.histogram("bufferer_time_flush")
+
+
+class ReplicationStats(_Bundle):
+    def __init__(self, metrics: Optional[Metrics] = None):
+        super().__init__(metrics)
+        self.running = self.m.gauge("replication_running")
+        self.restarts = self.m.counter("replication_restarts")
+        self.fatal_errors = self.m.counter("replication_fatal_errors")
+
+
+class TransformStats(_Bundle):
+    def __init__(self, metrics: Optional[Metrics] = None):
+        super().__init__(metrics)
+        self.rows_in = self.m.counter("transform_rows_in")
+        self.rows_out = self.m.counter("transform_rows_out")
+        self.errors = self.m.counter("transform_error_rows")
+        self.time = self.m.histogram("transform_time")
+        self.compiles = self.m.counter("transform_plan_compiles")
+
+
+class TableStats(_Bundle):
+    """Per-table progress gauges (pkg/stats/table.go)."""
+
+    def __init__(self, metrics: Optional[Metrics] = None):
+        super().__init__(metrics)
+        self.completed_parts = self.m.counter("snapshot_completed_parts")
+        self.completed_rows = self.m.counter("snapshot_completed_rows")
+        self.total_parts = self.m.gauge("snapshot_total_parts")
+        self.eta_rows = self.m.gauge("snapshot_eta_rows")
+
+
+class Timer:
+    """Context manager feeding a histogram."""
+
+    def __init__(self, hist):
+        self.hist = hist
+
+    def __enter__(self):
+        self.t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.monotonic() - self.t0)
+        return False

@@ -1,0 +1,34 @@
+"""Transformer framework (reference: pkg/transformer/ + pkg/abstract/transformer.go).
+
+Transformers operate on ColumnBatch blocks (the TPU currency).  The chain
+(`Transformation`) plans per (table, schema fingerprint) — mirroring the
+reference's plan cache (transformation.go:22-70) — and routes per-row
+failures to the `__transform_error`-tagged output (transformation.go:19).
+"""
+
+from transferia_tpu.transform.base import (
+    TRANSFORM_ERROR_COL,
+    TransformResult,
+    Transformer,
+)
+from transferia_tpu.transform.registry import (
+    make_transformer,
+    register_transformer,
+    registered_transformers,
+)
+from transferia_tpu.transform.chain import Transformation, build_chain
+
+# Load built-in plugins (self-registering, like the reference's init() blank
+# imports in pkg/transformer/registry/).
+import transferia_tpu.transform.plugins  # noqa: E402,F401
+
+__all__ = [
+    "TRANSFORM_ERROR_COL",
+    "TransformResult",
+    "Transformer",
+    "make_transformer",
+    "register_transformer",
+    "registered_transformers",
+    "Transformation",
+    "build_chain",
+]

@@ -1,0 +1,82 @@
+"""Transformer contract (pkg/abstract/transformer.go:32-38)."""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from transferia_tpu.abstract.schema import (
+    CanonicalType,
+    ColSchema,
+    TableID,
+    TableSchema,
+)
+from transferia_tpu.columnar.batch import Column, ColumnBatch
+
+# Error column tagged onto rows that failed a transformer
+# (reference transformation.go:19 __transform_error).
+TRANSFORM_ERROR_COL = "__transform_error"
+
+
+@dataclass
+class TransformResult:
+    """Output of one transformer application.
+
+    transformed: the successfully transformed block (possibly empty).
+    errors: rows that failed, in their *pre-transform* shape with an added
+            __transform_error utf8 column; pushed alongside so no data is
+            silently dropped.
+    """
+
+    transformed: Optional[ColumnBatch]
+    errors: Optional[ColumnBatch] = None
+
+
+class Transformer(abc.ABC):
+    """One transformation step.
+
+    suitable()/result_schema() are called at plan time (cached per schema
+    fingerprint); apply() runs per batch on the hot path.
+    """
+
+    TYPE = ""  # registry key, e.g. "rename_tables"
+
+    @abc.abstractmethod
+    def suitable(self, table: TableID, schema: TableSchema) -> bool:
+        ...
+
+    def result_schema(self, schema: TableSchema) -> TableSchema:
+        """Output schema for an input schema (identity by default)."""
+        return schema
+
+    def result_table(self, table: TableID) -> TableID:
+        """Output table id (identity by default; rename overrides)."""
+        return table
+
+    @abc.abstractmethod
+    def apply(self, batch: ColumnBatch) -> TransformResult:
+        ...
+
+    def describe(self) -> str:
+        return self.TYPE
+
+
+def error_batch(source: ColumnBatch, mask: np.ndarray,
+                message: str) -> Optional[ColumnBatch]:
+    """Build the __transform_error block for rows selected by mask."""
+    if not mask.any():
+        return None
+    failed = source.filter(mask)
+    n = failed.n_rows
+    err_col = Column.from_pylist(
+        TRANSFORM_ERROR_COL, CanonicalType.UTF8, [message] * n
+    )
+    cols = dict(failed.columns)
+    cols[TRANSFORM_ERROR_COL] = err_col
+    schema = failed.schema.append(
+        ColSchema(TRANSFORM_ERROR_COL, CanonicalType.UTF8)
+    )
+    return failed.with_columns(cols, schema)

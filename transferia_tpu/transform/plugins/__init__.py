@@ -1,0 +1,16 @@
+"""Built-in transformers (reference: pkg/transformer/registry/ — 23 plugins).
+
+Each module self-registers via @register_transformer, mirroring the
+reference's init() side-effect registration.
+"""
+
+from transferia_tpu.transform.plugins import (  # noqa: F401
+    convert,
+    filter as filter_plugin,
+    lambda_tf,
+    logger_tf,
+    mask,
+    pk,
+    rename,
+    sharder,
+)

@@ -1,0 +1,113 @@
+"""User-function transformer (reference: registry/lambda cloud-function rows
+transform + registry/custom).
+
+TPU-first twist: the user function operates on the *columnar* view — a dict
+of numpy/jax arrays — and may be a jax.jit-compiled function (the
+BASELINE.json "lambda-transformer as user jax.jit" config).  Three forms:
+
+  fn(columns: dict[str, array]) -> dict[str, array]     # replace columns
+  fn(columns) -> bool mask                              # row filter
+  fn(batch: ColumnBatch) -> ColumnBatch                 # full control
+
+Registered callables are referenced by dotted path or passed directly via
+`register_lambda`.
+"""
+
+from __future__ import annotations
+
+import importlib
+from typing import Any, Callable, Optional
+
+import numpy as np
+
+from transferia_tpu.abstract.schema import TableID, TableSchema
+from transferia_tpu.columnar.batch import Column, ColumnBatch
+from transferia_tpu.transform.base import TransformResult, Transformer
+from transferia_tpu.transform.registry import register_transformer
+
+_LAMBDAS: dict[str, Callable] = {}
+
+
+def register_lambda(name: str, fn: Callable) -> None:
+    """Register a named user function for lambda_transformer configs."""
+    _LAMBDAS[name] = fn
+
+
+def _resolve(ref: str) -> Callable:
+    if ref in _LAMBDAS:
+        return _LAMBDAS[ref]
+    if ":" in ref:
+        mod, attr = ref.split(":", 1)
+        return getattr(importlib.import_module(mod), attr)
+    raise KeyError(
+        f"unknown lambda {ref!r}; register via register_lambda or use "
+        f"'module:function' form"
+    )
+
+
+@register_transformer("lambda")
+class LambdaTransformer(Transformer):
+    """config: function: "name" | "module:attr"; mode: columns|mask|batch;
+    tables: optional include list."""
+
+    def __init__(self, function: str | Callable, mode: str = "columns",
+                 tables: Optional[list[str]] = None):
+        self.fn = function if callable(function) else _resolve(function)
+        if mode not in ("columns", "mask", "batch"):
+            raise ValueError(f"lambda: bad mode {mode!r}")
+        self.mode = mode
+        self.fn_name = function if isinstance(function, str) else \
+            getattr(function, "__name__", "callable")
+        self.tables = [TableID.parse(t) for t in tables] if tables else None
+
+    def suitable(self, table: TableID, schema: TableSchema) -> bool:
+        if self.tables is None:
+            return True
+        return any(table.include_matches(p) for p in self.tables)
+
+    def apply(self, batch: ColumnBatch) -> TransformResult:
+        if self.mode == "batch":
+            return TransformResult(self.fn(batch))
+        arrays = {
+            name: col.data for name, col in batch.columns.items()
+            if col.offsets is None
+        }
+        if self.mode == "mask":
+            mask = np.asarray(self.fn(arrays)).astype(np.bool_)
+            return TransformResult(batch.filter(mask))
+        out = self.fn(arrays)
+        cols = dict(batch.columns)
+        for name, arr in out.items():
+            arr = np.asarray(arr)
+            old = cols.get(name)
+            ctype = old.ctype if old is not None and \
+                arr.dtype == old.data.dtype else _infer_ctype(arr)
+            cols[name] = Column(
+                name, ctype, arr, None,
+                old.validity if old is not None and old.offsets is None
+                else None,
+            )
+        schema = batch.schema.with_types({
+            name: cols[name].ctype for name in out if name in cols
+        })
+        return TransformResult(batch.with_columns(cols, schema))
+
+    def describe(self) -> str:
+        return f"lambda({self.fn_name})"
+
+
+def _infer_ctype(arr: np.ndarray):
+    from transferia_tpu.abstract.schema import CanonicalType
+
+    mapping = {
+        "int8": CanonicalType.INT8, "int16": CanonicalType.INT16,
+        "int32": CanonicalType.INT32, "int64": CanonicalType.INT64,
+        "uint8": CanonicalType.UINT8, "uint16": CanonicalType.UINT16,
+        "uint32": CanonicalType.UINT32, "uint64": CanonicalType.UINT64,
+        "float32": CanonicalType.FLOAT, "float64": CanonicalType.DOUBLE,
+        "bool": CanonicalType.BOOLEAN,
+    }
+    key = str(arr.dtype)
+    if key not in mapping:
+        raise ValueError(f"lambda produced unsupported dtype {arr.dtype}")
+    return mapping[key]

@@ -1,0 +1,117 @@
+"""PII masking transformer: HMAC-SHA256 field hashing
+(reference: pkg/transformer/registry/mask/hmac_hasher.go).
+
+The hash implementation is pluggable: the host path uses hashlib per value;
+when the TPU engine is active, ops.hashing provides a batched kernel over the
+flat byte buffer (same output bytes — canon tests pin equality).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac as hmac_mod
+from dataclasses import replace
+from typing import Callable, Optional
+
+import numpy as np
+
+from transferia_tpu.abstract.schema import (
+    CanonicalType,
+    TableID,
+    TableSchema,
+)
+from transferia_tpu.columnar.batch import Column, ColumnBatch, _offsets_from_lengths
+from transferia_tpu.transform.base import TransformResult, Transformer
+from transferia_tpu.transform.registry import register_transformer
+
+# Batched hasher signature: (data: uint8[], offsets: int32[], validity) ->
+# (hex_data: uint8[], hex_offsets: int32[]).  Default host implementation
+# below; ops.hashing registers a device implementation via set_hash_backend.
+HashBackend = Callable[[bytes, np.ndarray, Optional[np.ndarray], np.ndarray], tuple]
+
+_hash_backend: Optional[HashBackend] = None
+
+
+def set_hash_backend(fn: Optional[HashBackend]) -> None:
+    global _hash_backend
+    _hash_backend = fn
+
+
+def _host_hmac_hex(key: bytes, data: np.ndarray, offsets: np.ndarray,
+                   validity: Optional[np.ndarray]) -> tuple[np.ndarray, np.ndarray]:
+    n = len(offsets) - 1
+    raw = data.tobytes()
+    outs = []
+    for i in range(n):
+        if validity is not None and not validity[i]:
+            outs.append(b"")
+            continue
+        msg = raw[offsets[i]:offsets[i + 1]]
+        outs.append(
+            hmac_mod.new(key, msg, hashlib.sha256).hexdigest().encode()
+        )
+    out_offsets = _offsets_from_lengths([len(o) for o in outs])
+    out_data = np.frombuffer(b"".join(outs), dtype=np.uint8).copy() \
+        if outs else np.zeros(0, dtype=np.uint8)
+    return out_data, out_offsets
+
+
+@register_transformer("mask_field")
+class MaskField(Transformer):
+    """Replace column values with HMAC-SHA256(salt, value) hex digests.
+
+    config: columns: [...], salt: "secret", tables: optional include list.
+    Masked columns become utf8 (64-char hex).  Fixed-width columns are
+    stringified first (so the digest matches the reference's string-repr
+    hashing).
+    """
+
+    def __init__(self, columns: list[str], salt: str = "",
+                 tables: Optional[list[str]] = None):
+        self.columns = columns
+        self.key = salt.encode()
+        self.tables = [TableID.parse(t) for t in tables] if tables else None
+
+    def _match(self, table: TableID) -> bool:
+        if self.tables is None:
+            return True
+        return any(table.include_matches(p) for p in self.tables)
+
+    def suitable(self, table: TableID, schema: TableSchema) -> bool:
+        return self._match(table) and any(
+            schema.find(c) is not None for c in self.columns
+        )
+
+    def result_schema(self, schema: TableSchema) -> TableSchema:
+        return schema.with_types({
+            c: CanonicalType.UTF8
+            for c in self.columns if schema.find(c) is not None
+        })
+
+    def _mask_column(self, col: Column) -> Column:
+        if col.offsets is None:
+            # stringify fixed-width values, then hash
+            strs = [
+                "" if (col.validity is not None and not col.validity[i])
+                else str(col.value(i))
+                for i in range(col.n_rows)
+            ]
+            bufs = [s.encode() for s in strs]
+            offsets = _offsets_from_lengths([len(b) for b in bufs])
+            data = np.frombuffer(b"".join(bufs), dtype=np.uint8).copy() \
+                if bufs else np.zeros(0, dtype=np.uint8)
+        else:
+            data, offsets = col.data, col.offsets
+        backend = _hash_backend or _host_hmac_hex
+        out_data, out_offsets = backend(self.key, data, offsets, col.validity)
+        return Column(col.name, CanonicalType.UTF8, out_data, out_offsets,
+                      col.validity)
+
+    def apply(self, batch: ColumnBatch) -> TransformResult:
+        cols = dict(batch.columns)
+        for name in self.columns:
+            if name in cols:
+                cols[name] = self._mask_column(cols[name])
+        return TransformResult(
+            batch.with_columns(cols, self.result_schema(batch.schema))
+        )

@@ -1,0 +1,37 @@
+"""Exponential backoff retry (pkg/util backoff helpers)."""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, TypeVar
+
+T = TypeVar("T")
+
+
+def retry_with_backoff(
+    fn: Callable[[], T],
+    attempts: int = 3,
+    base_delay: float = 0.5,
+    max_delay: float = 30.0,
+    retriable: Callable[[BaseException], bool] = lambda e: True,
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+) -> T:
+    """Run fn with up to `attempts` tries; exponential backoff between tries.
+
+    Re-raises the last error when attempts are exhausted or when `retriable`
+    returns False (e.g. fatal errors, abstract.IsFatal semantics).
+    """
+    delay = base_delay
+    last: Optional[BaseException] = None
+    for i in range(1, attempts + 1):
+        try:
+            return fn()
+        except BaseException as e:
+            last = e
+            if i >= attempts or not retriable(e):
+                raise
+            if on_retry:
+                on_retry(i, e)
+            time.sleep(min(delay, max_delay))
+            delay *= 2
+    raise last  # pragma: no cover - unreachable
