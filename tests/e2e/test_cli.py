@@ -1,0 +1,135 @@
+"""CLI: transfer.yaml parsing, validate/activate/upload/describe commands."""
+
+import json
+import os
+
+import pytest
+
+from transferia_tpu.cli.config import ConfigError, parse_transfer_yaml
+from transferia_tpu.cli.main import main
+from transferia_tpu.models import TransferType
+from transferia_tpu.providers.memory import get_store
+
+
+YAML = """
+id: yaml-test
+type: SNAPSHOT_ONLY
+src:
+  type: sample
+  params:
+    preset: users
+    table: people
+    rows: 50
+    batch_rows: 25
+dst:
+  type: memory
+  params:
+    sink_id: cli_store
+transformation:
+  transformers:
+    - mask_field: {columns: [email], salt: "${TEST_MASK_SALT:fallback}"}
+runtime:
+  process_count: 2
+"""
+
+
+def test_parse_transfer_yaml_env_substitution(monkeypatch):
+    monkeypatch.setenv("TEST_MASK_SALT", "from-env")
+    t = parse_transfer_yaml(YAML)
+    assert t.id == "yaml-test"
+    assert t.type == TransferType.SNAPSHOT_ONLY
+    assert t.src.provider() == "sample" and t.src.rows == 50
+    assert t.dst.provider() == "memory"
+    salt = t.transformation["transformers"][0]["mask_field"]["salt"]
+    assert salt == "from-env"
+
+
+def test_env_default_used_when_unset(monkeypatch):
+    monkeypatch.delenv("TEST_MASK_SALT", raising=False)
+    t = parse_transfer_yaml(YAML)
+    assert t.transformation["transformers"][0]["mask_field"]["salt"] == \
+        "fallback"
+
+
+def test_missing_env_raises():
+    with pytest.raises(ConfigError, match="NOPE_VAR"):
+        parse_transfer_yaml("""
+id: x
+src: {type: sample, params: {table: "${NOPE_VAR}"}}
+dst: {type: stdout}
+""")
+
+
+def test_unknown_keys_rejected():
+    with pytest.raises(ConfigError, match="unknown config keys"):
+        parse_transfer_yaml("""
+id: x
+bogus_key: 1
+src: {type: sample}
+dst: {type: stdout}
+""")
+
+
+def test_unknown_provider_rejected():
+    with pytest.raises(ConfigError, match="unknown endpoint"):
+        parse_transfer_yaml("""
+id: x
+src: {type: oracle9i}
+dst: {type: stdout}
+""")
+
+
+@pytest.fixture
+def yaml_file(tmp_path, monkeypatch):
+    monkeypatch.delenv("TEST_MASK_SALT", raising=False)
+    p = tmp_path / "transfer.yaml"
+    p.write_text(YAML)
+    return str(p)
+
+
+def test_cli_validate(yaml_file, capsys):
+    rc = main(["validate", "--transfer", yaml_file])
+    assert rc == 0
+    assert "OK: yaml-test" in capsys.readouterr().out
+
+
+def test_cli_validate_bad(tmp_path, capsys):
+    p = tmp_path / "bad.yaml"
+    p.write_text("id: x\nsrc: {type: nope}\ndst: {type: stdout}\n")
+    rc = main(["validate", "--transfer", str(p)])
+    assert rc == 1
+    assert "INVALID" in capsys.readouterr().err
+
+
+def test_cli_activate_runs_snapshot(yaml_file, capsys):
+    store = get_store("cli_store")
+    store.clear()
+    rc = main(["activate", "--transfer", yaml_file])
+    assert rc == 0
+    assert store.row_count() == 50
+    # masked emails are hex digests
+    rows = store.rows()
+    assert all(len(r.value("email")) == 64 for r in rows)
+    assert "activated" in capsys.readouterr().out
+
+
+def test_cli_upload_explicit_table(yaml_file):
+    store = get_store("cli_store")
+    store.clear()
+    rc = main(["upload", "--transfer", yaml_file,
+               "--table", "sample.people"])
+    assert rc == 0
+    assert store.row_count() == 50
+
+
+def test_cli_memory_coordinator_refuses_sharding(yaml_file):
+    with pytest.raises(SystemExit, match="job-count"):
+        main(["--job-count", "2", "activate", "--transfer", yaml_file])
+
+
+def test_cli_describe(capsys):
+    rc = main(["describe", "--provider", "sample"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    assert "sample/source" in out
+    assert "rows" in out["sample/source"]

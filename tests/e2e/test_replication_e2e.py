@@ -1,0 +1,108 @@
+"""E2E replication: sample source pump, retry loop, fatal classification."""
+
+import threading
+import time
+
+import pytest
+
+from transferia_tpu.abstract import TableID
+from transferia_tpu.abstract.errors import FatalError
+from transferia_tpu.abstract.interfaces import AsyncSink, Source
+from transferia_tpu.coordinator import MemoryCoordinator
+from transferia_tpu.coordinator.interface import TransferStatus
+from transferia_tpu.models import Transfer, TransferType
+from transferia_tpu.providers.memory import MemoryTargetParams, get_store
+from transferia_tpu.providers.sample import SampleSourceParams
+from transferia_tpu.runtime import run_replication
+from transferia_tpu.runtime.local import LocalWorker
+
+
+def test_replication_pumps_rows_until_stopped():
+    t = Transfer(
+        id="rep1", type=TransferType.INCREMENT_ONLY,
+        src=SampleSourceParams(preset="iot", table="events", rows=0,
+                               replication_batch=128, rate=0),
+        dst=MemoryTargetParams(sink_id="rep1"),
+    )
+    store = get_store("rep1")
+    store.clear()
+    cp = MemoryCoordinator()
+    stop = threading.Event()
+    th = threading.Thread(
+        target=run_replication,
+        args=(t, cp),
+        kwargs={"stop_event": stop, "backoff": 0.1},
+        daemon=True,
+    )
+    th.start()
+    deadline = time.monotonic() + 10
+    while store.row_count() < 500 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    stop.set()
+    th.join(timeout=10)
+    assert not th.is_alive()
+    assert store.row_count() >= 500
+    assert cp.get_status("rep1") == TransferStatus.RUNNING
+
+
+class FlakySource(Source):
+    """Fails twice, then runs until stopped."""
+
+    attempts = 0
+
+    def __init__(self, fatal=False):
+        self._stop = threading.Event()
+        self.fatal = fatal
+
+    def run(self, sink: AsyncSink) -> None:
+        type(self).attempts += 1
+        if self.fatal:
+            raise FatalError("bad credentials")
+        if type(self).attempts <= 2:
+            raise ConnectionError("transient network error")
+        self._stop.wait()
+
+    def stop(self):
+        self._stop.set()
+
+
+def test_retry_loop_restarts_on_transient_errors(monkeypatch):
+    FlakySource.attempts = 0
+    t = Transfer(id="rep2", type=TransferType.INCREMENT_ONLY,
+                 src=SampleSourceParams(), dst=MemoryTargetParams(
+                     sink_id="rep2"))
+    cp = MemoryCoordinator()
+    src = {}
+
+    def fake_new_source(transfer, metrics=None):
+        s = FlakySource()
+        src["cur"] = s
+        return s
+
+    monkeypatch.setattr("transferia_tpu.runtime.local.new_source",
+                        fake_new_source)
+    stop = threading.Event()
+    th = threading.Thread(
+        target=run_replication, args=(t, cp),
+        kwargs={"stop_event": stop, "backoff": 0.05}, daemon=True,
+    )
+    th.start()
+    deadline = time.monotonic() + 10
+    while FlakySource.attempts < 3 and time.monotonic() < deadline:
+        time.sleep(0.02)
+    stop.set()
+    th.join(timeout=5)
+    assert FlakySource.attempts >= 3  # restarted after 2 transient failures
+
+
+def test_fatal_error_fails_transfer(monkeypatch):
+    t = Transfer(id="rep3", type=TransferType.INCREMENT_ONLY,
+                 src=SampleSourceParams(), dst=MemoryTargetParams(
+                     sink_id="rep3"))
+    cp = MemoryCoordinator()
+    monkeypatch.setattr("transferia_tpu.runtime.local.new_source",
+                        lambda tr, metrics=None: FlakySource(fatal=True))
+    with pytest.raises(FatalError):
+        run_replication(t, cp, backoff=0.05)
+    assert cp.get_status("rep3") == TransferStatus.FAILED
+    assert cp.status_messages("rep3")

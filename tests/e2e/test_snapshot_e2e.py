@@ -1,0 +1,148 @@
+"""E2E snapshot flows (cf. reference tests/e2e/*2mock suites and
+tests/helpers/sharded_snapshot_workers.go)."""
+
+import threading
+
+import pytest
+
+from transferia_tpu.abstract import Kind, TableID
+from transferia_tpu.coordinator import MemoryCoordinator
+from transferia_tpu.models import Transfer, TransferType
+from transferia_tpu.models.transfer import Runtime, ShardingUploadParams
+from transferia_tpu.providers.memory import MemoryTargetParams, get_store
+from transferia_tpu.providers.sample import SampleSourceParams
+from transferia_tpu.tasks import SnapshotLoader, activate_delivery, checksum
+
+
+def make_transfer(tid, rows=200, shard_parts=0, process_count=2,
+                  job_count=1, current_job=0, **kw):
+    return Transfer(
+        id=tid,
+        type=TransferType.SNAPSHOT_ONLY,
+        src=SampleSourceParams(preset="users", table="users", rows=rows,
+                               batch_rows=64, shard_parts=shard_parts),
+        dst=MemoryTargetParams(sink_id=f"e2e_{tid}"),
+        runtime=Runtime(
+            current_job=current_job,
+            sharding=ShardingUploadParams(job_count=job_count,
+                                          process_count=process_count),
+        ),
+        **kw,
+    )
+
+
+def test_activate_snapshot_single_worker():
+    t = make_transfer("snap1", rows=200)
+    store = get_store("e2e_snap1")
+    store.clear()
+    cp = MemoryCoordinator()
+    activate_delivery(t, cp)
+
+    tid = TableID("sample", "users")
+    assert store.row_count(tid) == 200
+    # control events bracket the data
+    controls = [c.kind for c in store.control_events()]
+    assert controls[0] == Kind.INIT_TABLE_LOAD
+    assert controls[-1] == Kind.DONE_TABLE_LOAD
+    assert cp.get_status("snap1").value == "activated"
+    # all ids exactly once
+    ids = sorted(r.value("user_id") for r in store.rows(tid))
+    assert ids == list(range(200))
+
+
+def test_snapshot_sharded_parts_single_process():
+    t = make_transfer("snap2", rows=300, shard_parts=5, process_count=3)
+    store = get_store("e2e_snap2")
+    store.clear()
+    cp = MemoryCoordinator()
+    loader = SnapshotLoader(t, cp, operation_id="op-snap2")
+    loader.upload_tables()
+    tid = TableID("sample", "users")
+    assert store.row_count(tid) == 300
+    ids = sorted(r.value("user_id") for r in store.rows(tid))
+    assert ids == list(range(300))
+    # sharded brackets present
+    kinds = [c.kind for c in store.control_events()]
+    assert Kind.INIT_SHARDED_TABLE_LOAD in kinds
+    assert Kind.DONE_SHARDED_TABLE_LOAD in kinds
+    # per-part init/done with part ids
+    inits = [c for c in store.control_events()
+             if c.kind == Kind.INIT_TABLE_LOAD]
+    assert len(inits) == 5
+    assert all(c.part_id for c in inits)
+    prog = cp.operation_progress("op-snap2")
+    assert prog.done and prog.completed_rows == 300
+
+
+def test_snapshot_sharded_multi_worker_threads():
+    """Main + 2 secondaries sharing one in-proc coordinator
+    (tests/helpers/sharded_snapshot_workers.go pattern)."""
+    store = get_store("e2e_snap3")
+    store.clear()
+    cp = MemoryCoordinator()
+    op_id = "op-snap3"
+
+    def run_worker(idx):
+        t = make_transfer("snap3", rows=400, shard_parts=8,
+                          process_count=2, job_count=3, current_job=idx)
+        t.dst.sink_id = "e2e_snap3"
+        loader = SnapshotLoader(t, cp, operation_id=op_id)
+        loader.upload_tables()
+
+    threads = [threading.Thread(target=run_worker, args=(i,))
+               for i in range(3)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+
+    tid = TableID("sample", "users")
+    ids = sorted(r.value("user_id") for r in store.rows(tid))
+    assert ids == list(range(400))  # exactly once, no dup/loss
+    prog = cp.operation_progress(op_id)
+    assert prog.done
+    # work actually spread across workers
+    workers = {p.worker_index for p in cp.operation_parts(op_id)}
+    assert len(workers) >= 2
+
+
+def test_snapshot_with_flaky_sink_retries():
+    t = make_transfer("snap4", rows=100)
+    t.dst = MemoryTargetParams(sink_id="e2e_snap4", fail_pushes=2)
+    store = get_store("e2e_snap4")
+    store.clear()
+    cp = MemoryCoordinator()
+    SnapshotLoader(t, cp).upload_tables()
+    assert store.row_count(TableID("sample", "users")) == 100
+
+
+def test_checksum_after_snapshot():
+    from transferia_tpu.factories import new_storage
+    from transferia_tpu.providers.memory import (
+        MemorySourceParams,
+        seed_source,
+    )
+    from transferia_tpu.providers.sample import make_batch
+
+    tid = TableID("sample", "users")
+    b = make_batch("users", tid, 0, 50, seed=7)
+    seed_source("chk_src", [b])
+    seed_source("chk_dst_ok", [b])
+    src = new_storage(Transfer(id="c1", src=MemorySourceParams(
+        source_id="chk_src")))
+    dst = new_storage(Transfer(id="c2", src=MemorySourceParams(
+        source_id="chk_dst_ok")))
+    report = checksum(src, dst)
+    assert report.ok, report.summary()
+
+    # corrupt one value in the target
+    bad = make_batch("users", tid, 0, 50, seed=7)
+    import numpy as np
+
+    bad.columns["score"].data[10] += 1.0
+    seed_source("chk_dst_bad", [bad])
+    dst_bad = new_storage(Transfer(id="c3", src=MemorySourceParams(
+        source_id="chk_dst_bad")))
+    report2 = checksum(src, dst_bad)
+    assert not report2.ok
+    assert any("score" in m for t in report2.tables for m in t.mismatches)

@@ -1,0 +1,96 @@
+"""Coordinator implementations: memory + filestore parity."""
+
+import threading
+
+import pytest
+
+from transferia_tpu.abstract import TableID
+from transferia_tpu.abstract.table import OperationTablePart
+from transferia_tpu.coordinator import (
+    FileStoreCoordinator,
+    MemoryCoordinator,
+)
+from transferia_tpu.coordinator.interface import TransferStatus
+
+
+def make_parts(op="op1", n=4):
+    return [
+        OperationTablePart(operation_id=op,
+                           table_id=TableID("s", "t"),
+                           part_index=i, parts_count=n, eta_rows=10 * i)
+        for i in range(n)
+    ]
+
+
+@pytest.fixture(params=["memory", "filestore"])
+def cp(request, tmp_path):
+    if request.param == "memory":
+        return MemoryCoordinator()
+    return FileStoreCoordinator(root=str(tmp_path / "cp"))
+
+
+class TestCoordinator:
+    def test_status_roundtrip(self, cp):
+        assert cp.get_status("t1") == TransferStatus.NEW
+        cp.set_status("t1", TransferStatus.RUNNING)
+        assert cp.get_status("t1") == TransferStatus.RUNNING
+
+    def test_state_kv(self, cp):
+        cp.set_transfer_state("t1", {"lsn": 42, "slot": "s"})
+        cp.set_transfer_state("t1", {"lsn": 43})
+        assert cp.get_transfer_state("t1") == {"lsn": 43, "slot": "s"}
+        cp.remove_transfer_state("t1", ["slot"])
+        assert cp.get_transfer_state("t1") == {"lsn": 43}
+
+    def test_part_assignment_exclusive(self, cp):
+        cp.create_operation_parts("op1", make_parts())
+        a = cp.assign_operation_part("op1", 0)
+        b = cp.assign_operation_part("op1", 1)
+        assert a is not None and b is not None
+        assert a.part_index != b.part_index
+        c = cp.assign_operation_part("op1", 0)
+        d = cp.assign_operation_part("op1", 1)
+        assert {a.part_index, b.part_index, c.part_index, d.part_index} == \
+            {0, 1, 2, 3}
+        assert cp.assign_operation_part("op1", 2) is None  # drained
+
+    def test_clear_assigned_releases_incomplete(self, cp):
+        cp.create_operation_parts("op1", make_parts(n=2))
+        p = cp.assign_operation_part("op1", 1)
+        released = cp.clear_assigned_parts("op1", 1)
+        assert released == 1
+        again = cp.assign_operation_part("op1", 2)
+        assert again.part_index == p.part_index or again is not None
+
+    def test_update_and_progress(self, cp):
+        cp.create_operation_parts("op1", make_parts(n=2))
+        p = cp.assign_operation_part("op1", 0)
+        p.completed = True
+        p.completed_rows = 99
+        cp.update_operation_parts("op1", [p])
+        prog = cp.operation_progress("op1")
+        assert prog.total_parts == 2
+        assert prog.completed_parts == 1
+        assert prog.completed_rows == 99
+        assert not prog.done
+
+    def test_concurrent_assignment_no_duplicates(self, cp):
+        cp.create_operation_parts("op2", make_parts("op2", 16))
+        got = []
+        lock = threading.Lock()
+
+        def claim(widx):
+            while True:
+                p = cp.assign_operation_part("op2", widx)
+                if p is None:
+                    return
+                with lock:
+                    got.append(p.part_index)
+
+        threads = [threading.Thread(target=claim, args=(i,))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(got) == list(range(16))  # each part exactly once

@@ -1,0 +1,266 @@
+"""trtpu: the command-line interface.
+
+Reference parity: cmd/trcli/main.go:37-160 — subcommands activate /
+replicate / upload / check / validate / describe, global flags for the
+coordinator (memory | filestore), worker sharding indices, log level, and a
+Prometheus metrics port.  The memory coordinator refuses job_count > 1
+(main.go:118-121) since parts can't be shared across processes in memory.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import signal
+import sys
+import threading
+
+from transferia_tpu.coordinator import new_coordinator
+from transferia_tpu.coordinator.interface import TransferStatus
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="trtpu",
+        description="TPU-native data transfer: snapshot + CDC replication",
+    )
+    p.add_argument("--log-level", default="info",
+                   choices=["debug", "info", "warning", "error"])
+    p.add_argument("--coordinator", default="memory",
+                   choices=["memory", "filestore"],
+                   help="control-plane backend")
+    p.add_argument("--coordinator-dir", default="",
+                   help="shared directory for --coordinator filestore")
+    p.add_argument("--job-index", type=int, default=0,
+                   help="this worker's index (0 = main)")
+    p.add_argument("--job-count", type=int, default=0,
+                   help="override runtime.job_count")
+    p.add_argument("--process-count", type=int, default=0,
+                   help="override runtime.process_count")
+    p.add_argument("--metrics-port", type=int, default=0,
+                   help="serve Prometheus metrics on this port (0 = off)")
+    p.add_argument("--health-port", type=int, default=0,
+                   help="serve /health on this port (0 = off)")
+    p.add_argument("--operation-id", default="",
+                   help="shared operation id for sharded snapshot workers "
+                        "(default: op-<transfer id>)")
+
+    sub = p.add_subparsers(dest="command", required=True)
+
+    def add_transfer_cmd(name, help_):
+        c = sub.add_parser(name, help=help_)
+        c.add_argument("--transfer", required=True,
+                       help="path to transfer.yaml")
+        return c
+
+    add_transfer_cmd("activate", "snapshot + prepare replication")
+    rep = add_transfer_cmd("replicate",
+                           "activate if needed, then run replication")
+    rep.add_argument("--max-attempts", type=int, default=0,
+                     help="stop after N failed attempts (0 = retry forever)")
+    up = add_transfer_cmd("upload", "ad-hoc copy of explicit tables")
+    up.add_argument("--table", action="append", default=[],
+                    help="table to upload (repeatable), e.g. ns.name")
+    add_transfer_cmd("check", "run checksum comparison source vs target")
+    add_transfer_cmd("validate", "parse and validate the transfer config")
+    desc = sub.add_parser("describe",
+                          help="dump provider endpoint param schemas")
+    desc.add_argument("--provider", default="",
+                      help="limit to one provider")
+    return p
+
+
+def _setup(args) -> None:
+    logging.basicConfig(
+        level=getattr(logging, args.log_level.upper()),
+        format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
+    )
+    if args.metrics_port:
+        try:
+            from prometheus_client import start_http_server
+
+            start_http_server(args.metrics_port)
+            logging.info("metrics on :%d", args.metrics_port)
+        except ImportError:
+            logging.warning("prometheus_client missing; metrics disabled")
+    if args.health_port:
+        _start_health_server(args.health_port)
+
+
+def _start_health_server(port: int) -> None:
+    """Minimal /health endpoint (pkg/serverutil healthcheck)."""
+    import http.server
+
+    class Handler(http.server.BaseHTTPRequestHandler):
+        def do_GET(self):
+            body = b'{"status":"ok"}'
+            self.send_response(200 if self.path in ("/", "/health") else 404)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+
+
+def _coordinator(args):
+    if args.coordinator == "filestore":
+        if not args.coordinator_dir:
+            raise SystemExit(
+                "--coordinator filestore requires --coordinator-dir"
+            )
+        return new_coordinator("filestore", root=args.coordinator_dir)
+    # memory coordinator cannot share parts across processes
+    if args.job_count > 1:
+        raise SystemExit(
+            "--coordinator memory does not support --job-count > 1; "
+            "use --coordinator filestore (main.go:118-121 parity)"
+        )
+    return new_coordinator("memory")
+
+
+def _load_transfer(args):
+    from transferia_tpu.cli.config import load_transfer
+
+    transfer = load_transfer(args.transfer)
+    transfer.runtime.current_job = args.job_index
+    if args.job_count:
+        transfer.runtime.sharding.job_count = args.job_count
+    if args.process_count:
+        transfer.runtime.sharding.process_count = args.process_count
+    return transfer
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    _setup(args)
+
+    if args.command == "describe":
+        return cmd_describe(args)
+    if args.command == "validate":
+        return cmd_validate(args)
+
+    transfer = _load_transfer(args)
+    cp = _coordinator(args)
+
+    if args.command == "activate":
+        from transferia_tpu.tasks import activate_delivery
+
+        activate_delivery(transfer, cp,
+                          operation_id=args.operation_id or None)
+        print(f"transfer {transfer.id}: activated")
+        return 0
+
+    if args.command == "upload":
+        from transferia_tpu.tasks import upload
+
+        upload(transfer, cp, args.table,
+               operation_id=args.operation_id or None)
+        print(f"transfer {transfer.id}: uploaded {len(args.table)} table(s)")
+        return 0
+
+    if args.command == "replicate":
+        return cmd_replicate(args, transfer, cp)
+
+    if args.command == "check":
+        return cmd_check(transfer)
+
+    raise SystemExit(f"unknown command {args.command}")
+
+
+def cmd_replicate(args, transfer, cp) -> int:
+    """replicate (cmd/trcli/replicate/replicate.go:50-101): activate when
+    no prior state, then loop the replication worker."""
+    from transferia_tpu.runtime import run_replication
+    from transferia_tpu.tasks import activate_delivery
+
+    state = cp.get_transfer_state(transfer.id)
+    if state.get("status") != "activated":
+        activate_delivery(transfer, cp)
+    if not transfer.type.has_replication:
+        print("transfer is snapshot-only; nothing to replicate")
+        return 0
+    stop = threading.Event()
+
+    def handle_sig(signum, frame):
+        logging.info("signal %d: stopping replication", signum)
+        stop.set()
+
+    signal.signal(signal.SIGINT, handle_sig)
+    signal.signal(signal.SIGTERM, handle_sig)
+    run_replication(transfer, cp, stop_event=stop,
+                    max_attempts=args.max_attempts)
+    return 0
+
+
+def cmd_check(transfer) -> int:
+    from transferia_tpu.factories.storage import new_storage
+    from transferia_tpu.providers.registry import get_provider
+    from transferia_tpu.tasks import checksum
+
+    src_storage = new_storage(transfer)
+    dst_provider = get_provider(transfer.dst_provider(), transfer)
+    dst_storage = dst_provider.storage()
+    if dst_storage is None:
+        print("destination provider has no storage view; cannot checksum",
+              file=sys.stderr)
+        return 2
+    report = checksum(src_storage, dst_storage)
+    print(report.summary())
+    return 0 if report.ok else 1
+
+
+def cmd_validate(args) -> int:
+    from transferia_tpu.cli.config import load_transfer
+
+    try:
+        transfer = load_transfer(args.transfer)
+    except Exception as e:
+        print(f"INVALID: {e}", file=sys.stderr)
+        return 1
+    # also validate the transformer chain compiles
+    from transferia_tpu.transform import build_chain
+
+    try:
+        build_chain(transfer.transformation)
+    except Exception as e:
+        print(f"INVALID transformation: {e}", file=sys.stderr)
+        return 1
+    print(f"OK: {transfer.id} ({transfer.type.value}) "
+          f"{transfer.src_provider()} -> {transfer.dst_provider()}")
+    return 0
+
+
+def cmd_describe(args) -> int:
+    """Dump endpoint params JSON schemas (trcli describe)."""
+    import dataclasses
+
+    from transferia_tpu.models.endpoint import _ENDPOINT_REGISTRY
+    from transferia_tpu.providers import load_builtin_providers
+
+    load_builtin_providers()
+    out = {}
+    for (provider, role), cls in sorted(_ENDPOINT_REGISTRY.items()):
+        if args.provider and provider != args.provider:
+            continue
+        fields = {}
+        for f in dataclasses.fields(cls):
+            default = f.default if f.default is not dataclasses.MISSING \
+                else None
+            fields[f.name] = {
+                "type": str(f.type),
+                "default": default.value
+                if hasattr(default, "value") else default,
+            }
+        out[f"{provider}/{role}"] = fields
+    print(json.dumps(out, indent=2, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

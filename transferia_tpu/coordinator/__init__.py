@@ -1,0 +1,33 @@
+"""Coordinator: the control-plane contract.
+
+Reference parity: pkg/abstract/coordinator/ (coordinator.go:5-14 composite
+interface, operation.go:40-68 sharded-snapshot part RPCs, transfer_state.go
+checkpoint KV) and pkg/coordinator/s3coordinator/ (serverless shared-bucket
+impl).  Workers never exchange data directly — only through this interface;
+the data plane is DB wire protocols + the TPU transform engine.
+"""
+
+from transferia_tpu.coordinator.interface import (
+    Coordinator,
+    OperationProgress,
+    TransferStatus,
+)
+from transferia_tpu.coordinator.memory import MemoryCoordinator
+from transferia_tpu.coordinator.filestore import FileStoreCoordinator
+
+__all__ = [
+    "Coordinator",
+    "OperationProgress",
+    "TransferStatus",
+    "MemoryCoordinator",
+    "FileStoreCoordinator",
+]
+
+
+def new_coordinator(kind: str, **kw) -> Coordinator:
+    """Factory used by the CLI (--coordinator memory|filestore)."""
+    if kind == "memory":
+        return MemoryCoordinator()
+    if kind in ("filestore", "s3"):
+        return FileStoreCoordinator(**kw)
+    raise ValueError(f"unknown coordinator kind {kind!r}")

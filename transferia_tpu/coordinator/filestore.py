@@ -1,0 +1,196 @@
+"""Shared-directory coordinator (serverless, multi-process).
+
+Reference parity: pkg/coordinator/s3coordinator/coordinator_s3.go — the
+reference coordinates sharded multi-pod runs through JSON objects in a
+shared S3 bucket.  Here the backing store is a shared directory (NFS/
+hostPath/local) with flock-guarded read-modify-write; an object-store
+backend (GCS/S3 via conditional writes) can implement the same layout.
+
+Layout:
+    <root>/transfers/<id>/status.json     {"status": ...}
+    <root>/transfers/<id>/state.json      {...checkpoints...}
+    <root>/transfers/<id>/messages.jsonl
+    <root>/operations/<op>/parts.json     [OperationTablePart...]
+    <root>/health/<scope>.jsonl
+"""
+
+from __future__ import annotations
+
+import contextlib
+import fcntl
+import json
+import os
+import time
+from typing import Any, Optional
+
+from transferia_tpu.abstract.table import OperationTablePart
+from transferia_tpu.coordinator.interface import Coordinator, TransferStatus
+
+
+class FileStoreCoordinator(Coordinator):
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(os.path.join(root, "transfers"), exist_ok=True)
+        os.makedirs(os.path.join(root, "operations"), exist_ok=True)
+        os.makedirs(os.path.join(root, "health"), exist_ok=True)
+
+    # -- file helpers -------------------------------------------------------
+    def _tdir(self, transfer_id: str) -> str:
+        d = os.path.join(self.root, "transfers", transfer_id)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _odir(self, operation_id: str) -> str:
+        d = os.path.join(self.root, "operations", operation_id)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    @contextlib.contextmanager
+    def _locked(self, path: str):
+        """flock-guarded critical section for read-modify-write."""
+        lock_path = path + ".lock"
+        fd = os.open(lock_path, os.O_CREAT | os.O_RDWR, 0o644)
+        try:
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            yield
+        finally:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+            os.close(fd)
+
+    @staticmethod
+    def _read_json(path: str, default):
+        try:
+            with open(path) as fh:
+                return json.load(fh)
+        except (FileNotFoundError, json.JSONDecodeError):
+            return default
+
+    @staticmethod
+    def _write_json(path: str, value) -> None:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(value, fh)
+        os.replace(tmp, path)  # atomic publish
+
+    # -- status -------------------------------------------------------------
+    def set_status(self, transfer_id: str, status: TransferStatus) -> None:
+        p = os.path.join(self._tdir(transfer_id), "status.json")
+        with self._locked(p):
+            self._write_json(p, {"status": status.value, "ts": time.time()})
+
+    def get_status(self, transfer_id: str) -> TransferStatus:
+        p = os.path.join(self._tdir(transfer_id), "status.json")
+        d = self._read_json(p, {"status": "new"})
+        return TransferStatus(d["status"])
+
+    def open_status_message(self, transfer_id: str, category: str,
+                            message: str) -> None:
+        p = os.path.join(self._tdir(transfer_id), "messages.jsonl")
+        with self._locked(p), open(p, "a") as fh:
+            fh.write(json.dumps({
+                "category": category, "message": message, "ts": time.time(),
+            }) + "\n")
+
+    # -- state KV -----------------------------------------------------------
+    def set_transfer_state(self, transfer_id: str,
+                           state: dict[str, Any]) -> None:
+        p = os.path.join(self._tdir(transfer_id), "state.json")
+        with self._locked(p):
+            cur = self._read_json(p, {})
+            cur.update(state)
+            self._write_json(p, cur)
+
+    def get_transfer_state(self, transfer_id: str) -> dict[str, Any]:
+        p = os.path.join(self._tdir(transfer_id), "state.json")
+        return self._read_json(p, {})
+
+    def remove_transfer_state(self, transfer_id: str,
+                              keys: list[str]) -> None:
+        p = os.path.join(self._tdir(transfer_id), "state.json")
+        with self._locked(p):
+            cur = self._read_json(p, {})
+            for k in keys:
+                cur.pop(k, None)
+            self._write_json(p, cur)
+
+    # -- operation parts ----------------------------------------------------
+    def _parts_path(self, operation_id: str) -> str:
+        return os.path.join(self._odir(operation_id), "parts.json")
+
+    def create_operation_parts(self, operation_id: str,
+                               parts: list[OperationTablePart]) -> None:
+        p = self._parts_path(operation_id)
+        with self._locked(p):
+            self._write_json(p, [x.to_json() for x in parts])
+
+    def assign_operation_part(self, operation_id: str, worker_index: int
+                              ) -> Optional[OperationTablePart]:
+        p = self._parts_path(operation_id)
+        with self._locked(p):
+            parts = self._read_json(p, [])
+            for d in parts:
+                if d.get("worker_index") is None and not d.get("completed"):
+                    d["worker_index"] = worker_index
+                    self._write_json(p, parts)
+                    return OperationTablePart.from_json(d)
+            return None
+
+    def clear_assigned_parts(self, operation_id: str,
+                             worker_index: int) -> int:
+        p = self._parts_path(operation_id)
+        released = 0
+        with self._locked(p):
+            parts = self._read_json(p, [])
+            for d in parts:
+                if d.get("worker_index") == worker_index \
+                        and not d.get("completed"):
+                    d["worker_index"] = None
+                    released += 1
+            if released:
+                self._write_json(p, parts)
+        return released
+
+    def update_operation_parts(self, operation_id: str,
+                               parts: list[OperationTablePart]) -> None:
+        p = self._parts_path(operation_id)
+        with self._locked(p):
+            cur = self._read_json(p, [])
+            by_key = {
+                (d["operation_id"], d["schema"], d["table"],
+                 d["part_index"]): d
+                for d in cur
+            }
+            for upd in parts:
+                k = (upd.operation_id, upd.table_id.namespace,
+                     upd.table_id.name, upd.part_index)
+                if k in by_key:
+                    d = by_key[k]
+                    d["completed_rows"] = upd.completed_rows
+                    d["read_bytes"] = upd.read_bytes
+                    d["completed"] = upd.completed
+                    d["worker_index"] = upd.worker_index
+            self._write_json(p, cur)
+
+    def operation_parts(self, operation_id: str) -> list[OperationTablePart]:
+        return [
+            OperationTablePart.from_json(d)
+            for d in self._read_json(self._parts_path(operation_id), [])
+        ]
+
+    def operation_health(self, operation_id: str, worker_index: int,
+                         payload: Optional[dict] = None) -> None:
+        p = os.path.join(self.root, "health", f"op_{operation_id}.jsonl")
+        with self._locked(p), open(p, "a") as fh:
+            fh.write(json.dumps({
+                "worker": worker_index, "ts": time.time(),
+                "payload": payload,
+            }) + "\n")
+
+    def transfer_health(self, transfer_id: str, worker_index: int = 0,
+                        healthy: bool = True) -> None:
+        p = os.path.join(self.root, "health", f"tr_{transfer_id}.jsonl")
+        with self._locked(p), open(p, "a") as fh:
+            fh.write(json.dumps({
+                "worker": worker_index, "ts": time.time(),
+                "healthy": healthy,
+            }) + "\n")

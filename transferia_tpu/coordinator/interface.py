@@ -1,0 +1,126 @@
+"""Coordinator interface (coordinator.go:5-14 + operation.go:40-68)."""
+
+from __future__ import annotations
+
+import abc
+import enum
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from transferia_tpu.abstract.table import OperationTablePart
+
+
+class TransferStatus(str, enum.Enum):
+    NEW = "new"
+    ACTIVATING = "activating"
+    ACTIVATED = "activated"
+    RUNNING = "running"
+    FAILING = "failing"
+    FAILED = "failed"
+    COMPLETED = "completed"
+    DEACTIVATED = "deactivated"
+
+
+@dataclass
+class OperationProgress:
+    """Aggregated snapshot progress (transfer_operation_progress.go)."""
+
+    total_parts: int = 0
+    completed_parts: int = 0
+    total_eta_rows: int = 0
+    completed_rows: int = 0
+
+    @property
+    def done(self) -> bool:
+        return self.total_parts > 0 and \
+            self.completed_parts >= self.total_parts
+
+
+class Coordinator(abc.ABC):
+    """Composite control-plane contract.
+
+    Groups (mirroring the reference's embedded interfaces): transfer status,
+    status messages, transfer state KV (replication checkpoints), operation
+    state, sharded-snapshot part assignment, worker health.
+    """
+
+    # -- transfer status ----------------------------------------------------
+    @abc.abstractmethod
+    def set_status(self, transfer_id: str, status: TransferStatus) -> None:
+        ...
+
+    @abc.abstractmethod
+    def get_status(self, transfer_id: str) -> TransferStatus:
+        ...
+
+    def fail_replication(self, transfer_id: str, error: str) -> None:
+        self.set_status(transfer_id, TransferStatus.FAILED)
+        self.open_status_message(transfer_id, "replication", error)
+
+    # -- user-visible status messages (coordinator/transfer.go:15-25) -------
+    def open_status_message(self, transfer_id: str, category: str,
+                            message: str) -> None:
+        ...
+
+    def close_status_messages(self, transfer_id: str, category: str) -> None:
+        ...
+
+    # -- transfer state KV (transfer_state.go:38-50) ------------------------
+    @abc.abstractmethod
+    def set_transfer_state(self, transfer_id: str,
+                           state: dict[str, Any]) -> None:
+        """Merge keys into the transfer's state (checkpoints, cursors)."""
+
+    @abc.abstractmethod
+    def get_transfer_state(self, transfer_id: str) -> dict[str, Any]:
+        ...
+
+    @abc.abstractmethod
+    def remove_transfer_state(self, transfer_id: str,
+                              keys: list[str]) -> None:
+        ...
+
+    # -- sharded snapshot operations (operation.go:40-68) --------------------
+    @abc.abstractmethod
+    def create_operation_parts(self, operation_id: str,
+                               parts: list[OperationTablePart]) -> None:
+        """Main worker publishes the part work-queue."""
+
+    @abc.abstractmethod
+    def assign_operation_part(self, operation_id: str,
+                              worker_index: int
+                              ) -> Optional[OperationTablePart]:
+        """Atomically claim the next unassigned part (None = queue drained)."""
+
+    @abc.abstractmethod
+    def clear_assigned_parts(self, operation_id: str,
+                             worker_index: int) -> int:
+        """Unassign this worker's incomplete parts (restart recovery,
+        load_snapshot.go:625-632).  Returns number of parts released."""
+
+    @abc.abstractmethod
+    def update_operation_parts(self, operation_id: str,
+                               parts: list[OperationTablePart]) -> None:
+        """Progress/completion flush (UpdateOperationTablesParts)."""
+
+    @abc.abstractmethod
+    def operation_parts(self, operation_id: str) -> list[OperationTablePart]:
+        ...
+
+    def operation_progress(self, operation_id: str) -> OperationProgress:
+        parts = self.operation_parts(operation_id)
+        return OperationProgress(
+            total_parts=len(parts),
+            completed_parts=sum(1 for p in parts if p.completed),
+            total_eta_rows=sum(p.eta_rows for p in parts),
+            completed_rows=sum(p.completed_rows for p in parts),
+        )
+
+    # -- worker health (operation.go:30-36, replication.go:72-74) -----------
+    def operation_health(self, operation_id: str, worker_index: int,
+                         payload: Optional[dict] = None) -> None:
+        ...
+
+    def transfer_health(self, transfer_id: str, worker_index: int = 0,
+                        healthy: bool = True) -> None:
+        ...

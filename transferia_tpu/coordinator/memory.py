@@ -1,0 +1,115 @@
+"""In-process coordinator (coordinator_inmemory.go / coordinator_fake_client.go).
+
+Thread-safe; used for single-process runs and tests (including sharded-mode
+tests that spawn N worker threads in one process, cf.
+tests/helpers/sharded_snapshot_workers.go).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Optional
+
+from transferia_tpu.abstract.table import OperationTablePart
+from transferia_tpu.coordinator.interface import Coordinator, TransferStatus
+
+
+class MemoryCoordinator(Coordinator):
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._status: dict[str, TransferStatus] = {}
+        self._state: dict[str, dict[str, Any]] = {}
+        self._parts: dict[str, list[OperationTablePart]] = {}
+        self._messages: dict[str, list[tuple[str, str]]] = {}
+        self.health_reports: list[tuple] = []
+
+    # -- status -------------------------------------------------------------
+    def set_status(self, transfer_id: str, status: TransferStatus) -> None:
+        with self._lock:
+            self._status[transfer_id] = status
+
+    def get_status(self, transfer_id: str) -> TransferStatus:
+        with self._lock:
+            return self._status.get(transfer_id, TransferStatus.NEW)
+
+    def open_status_message(self, transfer_id: str, category: str,
+                            message: str) -> None:
+        with self._lock:
+            self._messages.setdefault(transfer_id, []).append(
+                (category, message)
+            )
+
+    def status_messages(self, transfer_id: str) -> list[tuple[str, str]]:
+        with self._lock:
+            return list(self._messages.get(transfer_id, []))
+
+    # -- state KV -----------------------------------------------------------
+    def set_transfer_state(self, transfer_id: str,
+                           state: dict[str, Any]) -> None:
+        with self._lock:
+            self._state.setdefault(transfer_id, {}).update(state)
+
+    def get_transfer_state(self, transfer_id: str) -> dict[str, Any]:
+        with self._lock:
+            return dict(self._state.get(transfer_id, {}))
+
+    def remove_transfer_state(self, transfer_id: str,
+                              keys: list[str]) -> None:
+        with self._lock:
+            st = self._state.get(transfer_id, {})
+            for k in keys:
+                st.pop(k, None)
+
+    # -- operation parts ----------------------------------------------------
+    def create_operation_parts(self, operation_id: str,
+                               parts: list[OperationTablePart]) -> None:
+        with self._lock:
+            self._parts[operation_id] = [
+                OperationTablePart.from_json(p.to_json()) for p in parts
+            ]
+
+    def assign_operation_part(self, operation_id: str, worker_index: int
+                              ) -> Optional[OperationTablePart]:
+        with self._lock:
+            for p in self._parts.get(operation_id, []):
+                if p.worker_index is None and not p.completed:
+                    p.worker_index = worker_index
+                    return OperationTablePart.from_json(p.to_json())
+            return None
+
+    def clear_assigned_parts(self, operation_id: str,
+                             worker_index: int) -> int:
+        released = 0
+        with self._lock:
+            for p in self._parts.get(operation_id, []):
+                if p.worker_index == worker_index and not p.completed:
+                    p.worker_index = None
+                    released += 1
+        return released
+
+    def update_operation_parts(self, operation_id: str,
+                               parts: list[OperationTablePart]) -> None:
+        with self._lock:
+            by_key = {p.key(): p for p in self._parts.get(operation_id, [])}
+            for upd in parts:
+                cur = by_key.get(upd.key())
+                if cur is not None:
+                    cur.completed_rows = upd.completed_rows
+                    cur.read_bytes = upd.read_bytes
+                    cur.completed = upd.completed
+                    cur.worker_index = upd.worker_index
+
+    def operation_parts(self, operation_id: str) -> list[OperationTablePart]:
+        with self._lock:
+            return [
+                OperationTablePart.from_json(p.to_json())
+                for p in self._parts.get(operation_id, [])
+            ]
+
+    def operation_health(self, operation_id: str, worker_index: int,
+                         payload: Optional[dict] = None) -> None:
+        self.health_reports.append((operation_id, worker_index, payload))
+
+    def transfer_health(self, transfer_id: str, worker_index: int = 0,
+                        healthy: bool = True) -> None:
+        self.health_reports.append((transfer_id, worker_index, healthy))

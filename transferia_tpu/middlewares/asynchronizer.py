@@ -53,6 +53,7 @@ class Asynchronizer(AsyncSink):
         self.inner = inner
         self._q: "queue.Queue" = queue.Queue(maxsize=max_queue)
         self._closed = threading.Event()
+        self._close_lock = threading.Lock()
         self._worker = threading.Thread(
             target=self._run, name="asynchronizer", daemon=True
         )
@@ -72,28 +73,23 @@ class Asynchronizer(AsyncSink):
 
     def async_push(self, batch: Batch) -> "Future[None]":
         fut: Future = Future()
-        if self._closed.is_set():
-            fut.set_exception(RuntimeError("asynchronizer closed"))
-            return fut
-        self._q.put((batch, fut))
+        # closed-check + enqueue must be atomic with close()'s shutdown, or
+        # a racing push can land behind the sentinel with no worker left
+        with self._close_lock:
+            if self._closed.is_set():
+                fut.set_exception(RuntimeError("asynchronizer closed"))
+                return fut
+            self._q.put((batch, fut))
         return fut
 
     def close(self) -> None:
-        if not self._closed.is_set():
+        with self._close_lock:
+            if self._closed.is_set():
+                return
             self._closed.set()
             self._q.put(None)
-            self._worker.join(timeout=60)
-            # fail anything that raced in after the sentinel
-            while True:
-                try:
-                    item = self._q.get_nowait()
-                except queue.Empty:
-                    break
-                if item is not None:
-                    item[1].set_exception(
-                        RuntimeError("asynchronizer closed")
-                    )
-            self.inner.close()
+        self._worker.join(timeout=60)
+        self.inner.close()
 
 
 class ErrorTracker(AsyncSink):

@@ -78,12 +78,21 @@ def _eval3(node: Node, batch: ColumnBatch) -> tuple[np.ndarray, np.ndarray]:
             Cmp(node.column, "<=", node.high),
         )), batch)
     if isinstance(node, InList):
-        unknown = ~_valid(batch, node.column)
+        col_null = ~_valid(batch, node.column)
         mask = np.zeros(n, dtype=np.bool_)
+        has_null_literal = any(v is None for v in node.values)
         for v in node.values:
-            mask |= _eval_cmp(Cmp(node.column, "=", v), batch)
-        t = (~mask if node.negate else mask) & ~unknown
-        return t, unknown
+            if v is not None:
+                mask |= _eval_cmp(Cmp(node.column, "=", v), batch)
+        # SQL IN semantics: TRUE when matched; UNKNOWN when the column is
+        # NULL or (no match and a NULL literal is in the list); else FALSE.
+        t = mask & ~col_null
+        f = ~mask & ~col_null
+        if has_null_literal:
+            f = np.zeros(n, dtype=np.bool_)
+        if node.negate:
+            t, f = f, t
+        return t, ~t & ~f
     if isinstance(node, Cmp):
         t = _eval_cmp(node, batch)
         unknown = ~_valid(batch, node.column)

@@ -15,6 +15,7 @@ from __future__ import annotations
 import glob as globmod
 import json
 import os
+import uuid
 from dataclasses import dataclass
 from typing import Optional
 
@@ -106,10 +107,12 @@ class FileStorage(Storage, ShardingStorage):
 
                 rows = []
                 with open(f) as fh:
-                    for i, line in enumerate(fh):
-                        if i >= 100 or not line.strip():
-                            break
+                    for line in fh:
+                        if not line.strip():
+                            continue  # skip blanks like the loader does
                         rows.append(json.loads(line))
+                        if len(rows) >= 100:
+                            break
                 tbl = pa.Table.from_pylist(rows)
                 self._schema = arrow_to_table_schema(tbl.schema)
         return self._schema
@@ -211,11 +214,19 @@ class FileStorage(Storage, ShardingStorage):
 
 
 class FileSinker(Sinker):
-    """Writes per-table files; parquet goes through arrow zero-pivot."""
+    """Writes per-table files; parquet goes through arrow zero-pivot.
+
+    File names embed a per-sinker instance token: the snapshot loader builds
+    one sink pipeline per table part in parallel (load_snapshot.go per-part
+    sinks), so concurrent instances must never share an output path —
+    the same contract as the reference S3 sink's part-scoped file splitting
+    (s3/sink/file_splitter.go).
+    """
 
     def __init__(self, params: FileTargetParams):
         self.params = params
         os.makedirs(params.path, exist_ok=True)
+        self._token = uuid.uuid4().hex[:8]
         self._writers: dict[TableID, object] = {}
         self._counters: dict[TableID, int] = {}
 
@@ -223,28 +234,27 @@ class FileSinker(Sinker):
         self._counters[tid] = self._counters.get(tid, 0)
         return os.path.join(
             self.params.path,
-            f"{tid.namespace}.{tid.name}.{self._counters[tid]:06d}.{ext}",
+            f"{tid.namespace}.{tid.name}.{self._token}."
+            f"{self._counters[tid]:06d}.{ext}",
         )
 
     def push(self, batch: Batch) -> None:
         if is_columnar(batch):
             self._write_columnar(batch)
             return
-        # process in order: rows before a done-marker must land in the file
-        # that marker finalizes
-        run: list = []
-        for it in batch:
-            if it.is_row_event():
-                run.append(it)
+        # rows before a done-marker must land in the file that marker
+        # finalizes; reuse the shared ordering-preserving splitter
+        from transferia_tpu.middlewares.helpers import split_rows_controls
+
+        for part in split_rows_controls(batch):
+            items = list(part)
+            if items and items[0].is_row_event():
+                self._write_columnar(ColumnBatch.from_rows(items))
                 continue
-            if run:
-                self._write_columnar(ColumnBatch.from_rows(run))
-                run = []
-            if it.kind in (Kind.DONE_TABLE_LOAD,
-                           Kind.DONE_SHARDED_TABLE_LOAD):
-                self._finish_table(it.table_id)
-        if run:
-            self._write_columnar(ColumnBatch.from_rows(run))
+            for it in items:
+                if it.kind in (Kind.DONE_TABLE_LOAD,
+                               Kind.DONE_SHARDED_TABLE_LOAD):
+                    self._finish_table(it.table_id)
 
     def _write_columnar(self, batch: ColumnBatch) -> None:
         tid = batch.table_id
@@ -261,7 +271,8 @@ class FileSinker(Sinker):
             w.write_batch(rb)
         elif self.params.format == "jsonl":
             path = os.path.join(
-                self.params.path, f"{tid.namespace}.{tid.name}.jsonl"
+                self.params.path,
+                f"{tid.namespace}.{tid.name}.{self._token}.jsonl",
             )
             with open(path, "a") as fh:
                 for row in batch.to_rows():

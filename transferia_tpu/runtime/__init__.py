@@ -1,0 +1,5 @@
+"""Execution runtimes (reference: pkg/runtime/local/)."""
+
+from transferia_tpu.runtime.local import LocalWorker, run_replication
+
+__all__ = ["LocalWorker", "run_replication"]

@@ -1,0 +1,123 @@
+"""Local replication runtime.
+
+Reference parity: pkg/runtime/local/replication_sync_runtime.go:21-155
+(LocalWorker), replicationstrategy/basic_strategy.go:23-139 (source ->
+async-sink pump), replication.go:91-191 (infinite retry loop, 10s backoff,
+fatal-error classification, 1m heartbeats).
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Optional
+
+from transferia_tpu.abstract.errors import is_fatal
+from transferia_tpu.coordinator.interface import Coordinator, TransferStatus
+from transferia_tpu.factories import make_async_sink, new_source
+from transferia_tpu.middlewares.asynchronizer import ErrorTracker
+from transferia_tpu.stats.registry import Metrics, ReplicationStats
+
+logger = logging.getLogger(__name__)
+
+RETRY_BACKOFF_SECONDS = 10.0   # replication.go sleep between attempts
+HEARTBEAT_SECONDS = 60.0       # replication.go:72-74
+
+
+class LocalWorker:
+    """One replication attempt: build source + sink, pump until stop/error."""
+
+    def __init__(self, transfer, coordinator: Coordinator,
+                 metrics: Optional[Metrics] = None):
+        self.transfer = transfer
+        self.cp = coordinator
+        self.metrics = metrics or Metrics()
+        self.source = None
+        self.sink: Optional[ErrorTracker] = None
+        self._error: Optional[BaseException] = None
+
+    def run(self) -> None:
+        """Blocks until the source stops or fails (BasicStrategy.Run)."""
+        self.sink = make_async_sink(self.transfer, self.metrics,
+                                    snapshot_stage=False)
+        self.source = new_source(self.transfer, self.metrics)
+        try:
+            self.source.run(self.sink)
+            # surface sink-side failures latched by the error tracker
+            if isinstance(self.sink, ErrorTracker) and self.sink.failure:
+                raise self.sink.failure
+        finally:
+            self.sink.close()
+
+    def stop(self) -> None:
+        if self.source is not None:
+            self.source.stop()
+
+
+def run_replication(transfer, coordinator: Coordinator,
+                    metrics: Optional[Metrics] = None,
+                    stop_event: Optional[threading.Event] = None,
+                    max_attempts: int = 0,
+                    backoff: float = RETRY_BACKOFF_SECONDS) -> None:
+    """The infinite retry loop (replication.go:91-191).
+
+    Restarts the worker on retriable errors with a fixed backoff; a fatal
+    error fails the transfer and raises.  stop_event ends the loop cleanly.
+    max_attempts=0 means retry forever.
+    """
+    metrics = metrics or Metrics()
+    stats = ReplicationStats(metrics)
+    stop_event = stop_event or threading.Event()
+    attempt = 0
+    while not stop_event.is_set():
+        attempt += 1
+        worker = LocalWorker(transfer, coordinator, metrics)
+        coordinator.set_status(transfer.id, TransferStatus.RUNNING)
+        stats.running.set(1)
+
+        stopper = threading.Thread(
+            target=_stop_on_event, args=(stop_event, worker), daemon=True
+        )
+        stopper.start()
+        heartbeat = threading.Thread(
+            target=_heartbeat_loop,
+            args=(stop_event, coordinator, transfer.id),
+            daemon=True,
+        )
+        heartbeat.start()
+        try:
+            worker.run()
+            if stop_event.is_set():
+                logger.info("replication stopped by request")
+                return
+            # source returned without stop: treat as retriable interruption
+            raise ConnectionError("source terminated unexpectedly")
+        except BaseException as e:
+            stats.running.set(0)
+            if stop_event.is_set():
+                logger.info("replication stopped during error: %s", e)
+                return
+            if is_fatal(e):
+                stats.fatal_errors.inc()
+                logger.error("fatal replication error: %s", e)
+                coordinator.fail_replication(transfer.id, str(e))
+                raise
+            stats.restarts.inc()
+            logger.warning("replication attempt %d failed, retrying in "
+                           "%.0fs: %s", attempt, backoff, e)
+            if max_attempts and attempt >= max_attempts:
+                coordinator.fail_replication(transfer.id, str(e))
+                raise
+            stop_event.wait(backoff)
+
+
+def _stop_on_event(stop_event: threading.Event, worker: LocalWorker) -> None:
+    stop_event.wait()
+    worker.stop()
+
+
+def _heartbeat_loop(stop_event: threading.Event, cp: Coordinator,
+                    transfer_id: str) -> None:
+    while not stop_event.wait(HEARTBEAT_SECONDS):
+        cp.transfer_health(transfer_id, healthy=True)

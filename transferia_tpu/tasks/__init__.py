@@ -1,0 +1,16 @@
+"""Worker tasks / operations (reference: pkg/worker/tasks/)."""
+
+from transferia_tpu.tasks.activate import activate_delivery
+from transferia_tpu.tasks.checksum import ChecksumReport, checksum
+from transferia_tpu.tasks.snapshot import SnapshotLoader
+from transferia_tpu.tasks.table_splitter import split_tables
+from transferia_tpu.tasks.upload import upload
+
+__all__ = [
+    "activate_delivery",
+    "checksum",
+    "ChecksumReport",
+    "SnapshotLoader",
+    "split_tables",
+    "upload",
+]

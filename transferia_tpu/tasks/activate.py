@@ -1,0 +1,96 @@
+"""ActivateDelivery (pkg/worker/tasks/activate_delivery.go:27-180).
+
+Flow: list tables -> primary-key checks -> destination cleanup per policy ->
+provider Activate hook (or default cleanup+upload) -> mark activated (the
+replicate command then starts the replication loop, start_job.go:15).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from transferia_tpu.abstract.errors import AbortTransferError
+from transferia_tpu.coordinator.interface import Coordinator, TransferStatus
+from transferia_tpu.factories import new_storage
+from transferia_tpu.models import CleanupPolicy, TransferType
+from transferia_tpu.models.endpoint import capability
+from transferia_tpu.providers.registry import ActivateCallbacks, get_provider
+from transferia_tpu.stats.registry import Metrics
+from transferia_tpu.tasks.snapshot import SnapshotLoader
+
+logger = logging.getLogger(__name__)
+
+
+def activate_delivery(transfer, coordinator: Coordinator,
+                      metrics: Optional[Metrics] = None,
+                      operation_id: Optional[str] = None) -> None:
+    metrics = metrics or Metrics()
+    coordinator.set_status(transfer.id, TransferStatus.ACTIVATING)
+    try:
+        loader = SnapshotLoader(transfer, coordinator,
+                                operation_id=operation_id, metrics=metrics)
+        tables = None
+        if transfer.type.has_snapshot:
+            storage = new_storage(transfer, metrics)
+            try:
+                tables = loader.filtered_table_list(storage)
+                if not tables:
+                    raise AbortTransferError(
+                        "no tables match the transfer's include list"
+                    )
+                _check_primary_keys(transfer, storage, tables)
+            finally:
+                storage.close()
+
+        dst_provider = get_provider(transfer.dst_provider(), transfer,
+                                    metrics)
+
+        def cleanup_cb(tbls):
+            if transfer.dst.cleanup_policy != CleanupPolicy.DISABLED:
+                logger.info("cleanup (%s): %d tables",
+                            transfer.dst.cleanup_policy.value,
+                            len(tbls or []))
+                dst_provider.cleanup(tbls or [])
+
+        def upload_cb(tbls):
+            loader.upload_tables(tbls)
+
+        src_provider = get_provider(transfer.src_provider(), transfer,
+                                    metrics)
+        if transfer.type.has_snapshot:
+            if src_provider.supports_activate():
+                src_provider.activate(
+                    ActivateCallbacks(cleanup_cb, upload_cb)
+                )
+            else:
+                cleanup_cb(tables)
+                upload_cb(tables)
+        elif transfer.type == TransferType.INCREMENT_ONLY:
+            # replication-only: provider hook for slot/changefeed creation
+            if src_provider.supports_activate():
+                src_provider.activate(
+                    ActivateCallbacks(cleanup_cb, lambda _t: None)
+                )
+        coordinator.set_status(transfer.id, TransferStatus.ACTIVATED)
+        coordinator.set_transfer_state(transfer.id, {"status": "activated"})
+    except BaseException as e:
+        coordinator.set_status(transfer.id, TransferStatus.FAILED)
+        coordinator.open_status_message(transfer.id, "activate", str(e))
+        raise
+
+
+def _check_primary_keys(transfer, storage, tables) -> None:
+    """PK checks (activate_delivery.go:118-131): warn on key-less tables;
+    abort when the destination requires keys (e.g. CDC into keyed stores)."""
+    requires_pk = capability(transfer.dst, "requires_primary_key", False) \
+        or transfer.type.has_replication
+    for td in tables:
+        schema = storage.table_schema(td.id)
+        if schema is not None and not schema.has_primary_key():
+            msg = f"table {td.id} has no primary key"
+            if requires_pk and transfer.type.has_replication:
+                raise AbortTransferError(
+                    msg + " — replication requires primary keys"
+                )
+            logger.warning("%s — updates/deletes cannot be matched", msg)

@@ -1,0 +1,155 @@
+"""Checksum: source/target data validation (pkg/worker/tasks/checksum.go).
+
+Compares row counts and sampled rows between the transfer's source storage
+and a storage view of the destination, with type-aware comparators
+(checksum.go:35-50: floats rounded to 12 significant digits, bytes/str
+unified, NULL == NULL).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from transferia_tpu.abstract.interfaces import (
+    SampleableStorage,
+    Storage,
+)
+from transferia_tpu.abstract.schema import TableID
+from transferia_tpu.abstract.table import TableDescription
+from transferia_tpu.stats.registry import Metrics
+
+logger = logging.getLogger(__name__)
+
+
+@dataclass
+class TableChecksum:
+    table: TableID
+    source_rows: int = 0
+    target_rows: int = 0
+    compared_rows: int = 0
+    mismatches: list[str] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return self.source_rows == self.target_rows and not self.mismatches
+
+
+@dataclass
+class ChecksumReport:
+    tables: list[TableChecksum] = field(default_factory=list)
+
+    @property
+    def ok(self) -> bool:
+        return all(t.ok for t in self.tables)
+
+    def summary(self) -> str:
+        lines = []
+        for t in self.tables:
+            status = "OK" if t.ok else "MISMATCH"
+            lines.append(
+                f"{t.table}: {status} (src={t.source_rows} "
+                f"dst={t.target_rows} compared={t.compared_rows} "
+                f"diffs={len(t.mismatches)})"
+            )
+        return "\n".join(lines)
+
+
+def values_equal(a: Any, b: Any) -> bool:
+    """Type-aware comparator (checksum.go:35-50)."""
+    if a is None or b is None:
+        return a is None and b is None
+    if isinstance(a, bytes) and isinstance(b, str):
+        return a.decode("utf-8", errors="replace") == b
+    if isinstance(a, str) and isinstance(b, bytes):
+        return a == b.decode("utf-8", errors="replace")
+    if isinstance(a, bool) or isinstance(b, bool):
+        return bool(a) == bool(b)
+    if isinstance(a, float) or isinstance(b, float):
+        try:
+            fa, fb = float(a), float(b)
+        except (TypeError, ValueError):
+            return a == b
+        if math.isnan(fa) and math.isnan(fb):
+            return True
+        if fa == fb:
+            return True
+        # round to 12 significant digits (reference float policy)
+        return f"{fa:.12g}" == f"{fb:.12g}"
+    return a == b
+
+
+def _collect_rows(storage: Storage, td: TableDescription, limit: int
+                  ) -> list[dict]:
+    rows: list[dict] = []
+
+    def pusher(batch):
+        if len(rows) >= limit:
+            return
+        items = batch.to_rows() if hasattr(batch, "to_rows") else batch
+        for it in items:
+            if getattr(it, "is_row_event", lambda: False)():
+                rows.append(it.as_dict())
+                if len(rows) >= limit:
+                    return
+
+    if isinstance(storage, SampleableStorage):
+        storage.load_top_bottom_sample(td, pusher)
+    else:
+        storage.load_table(td, pusher)
+    return rows[:limit]
+
+
+def checksum(source_storage: Storage, target_storage: Storage,
+             tables: Optional[list[TableID]] = None,
+             sample_rows: int = 1000,
+             metrics: Optional[Metrics] = None) -> ChecksumReport:
+    report = ChecksumReport()
+    src_tables = source_storage.table_list(
+        [TableID(t.namespace, t.name) for t in tables] if tables else None
+    )
+    for tid in src_tables:
+        tc = TableChecksum(table=tid)
+        report.tables.append(tc)
+        tc.source_rows = source_storage.exact_table_rows_count(tid)
+        try:
+            tc.target_rows = target_storage.exact_table_rows_count(tid)
+        except Exception as e:
+            tc.mismatches.append(f"target count failed: {e}")
+            continue
+        td = TableDescription(id=tid)
+        src_rows = _collect_rows(source_storage, td, sample_rows)
+        dst_rows = _collect_rows(target_storage, td, sample_rows)
+        # key rows by primary key when available, else by position
+        schema = source_storage.table_schema(tid)
+        keys = [c.name for c in schema.key_columns()] if schema else []
+        if keys:
+            dst_by_key = {
+                tuple(r.get(k) for k in keys): r for r in dst_rows
+            }
+            for r in src_rows:
+                key = tuple(r.get(k) for k in keys)
+                other = dst_by_key.get(key)
+                if other is None:
+                    tc.mismatches.append(f"row {key} missing in target")
+                    continue
+                tc.compared_rows += 1
+                for col, val in r.items():
+                    if col in other and not values_equal(val, other[col]):
+                        tc.mismatches.append(
+                            f"row {key} col {col}: "
+                            f"{val!r} != {other[col]!r}"
+                        )
+        else:
+            for i, (a, b) in enumerate(zip(src_rows, dst_rows)):
+                tc.compared_rows += 1
+                for col, val in a.items():
+                    if col in b and not values_equal(val, b[col]):
+                        tc.mismatches.append(
+                            f"row #{i} col {col}: {val!r} != {b[col]!r}"
+                        )
+        if len(tc.mismatches) > 20:
+            tc.mismatches = tc.mismatches[:20] + ["...truncated"]
+    return report

@@ -1,0 +1,47 @@
+"""Table splitter (pkg/worker/tasks/table_splitter/table_splitter.go:14-75).
+
+Splits tables into parallel parts when the source storage implements
+ShardingStorage and the destination accepts sharded writes; sorts parts
+big-first so stragglers start early.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from transferia_tpu.abstract.interfaces import ShardingStorage, Storage
+from transferia_tpu.abstract.table import OperationTablePart, TableDescription
+from transferia_tpu.models.endpoint import capability
+
+logger = logging.getLogger(__name__)
+
+
+def split_tables(storage: Storage, tables: list[TableDescription],
+                 transfer, operation_id: str) -> list[OperationTablePart]:
+    """Build the operation part queue for a snapshot."""
+    shardeable_dst = capability(transfer.dst, "is_shardeable", True)
+    parts: list[OperationTablePart] = []
+    for td in tables:
+        descriptions = [td]
+        if shardeable_dst and isinstance(storage, ShardingStorage):
+            try:
+                descriptions = storage.shard_table(td) or [td]
+            except Exception as e:  # non-fatal: fall back to whole table
+                logger.warning("shard_table(%s) failed, loading whole: %s",
+                               td.id, e)
+                descriptions = [td]
+        n = len(descriptions)
+        for i, d in enumerate(descriptions):
+            parts.append(OperationTablePart(
+                operation_id=operation_id,
+                table_id=d.id,
+                filter=d.filter,
+                offset=d.offset,
+                part_index=i,
+                parts_count=n,
+                eta_rows=d.eta_rows,
+            ))
+    # big-first ordering (table_splitter.go sorts by size desc)
+    parts.sort(key=lambda p: -p.eta_rows)
+    return parts

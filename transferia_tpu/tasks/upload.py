@@ -1,0 +1,27 @@
+"""Ad-hoc table upload (pkg/worker/tasks/upload_tables.go:58)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from transferia_tpu.abstract.schema import TableID
+from transferia_tpu.abstract.table import TableDescription
+from transferia_tpu.coordinator.interface import Coordinator
+from transferia_tpu.stats.registry import Metrics
+from transferia_tpu.tasks.snapshot import SnapshotLoader
+
+
+def upload(transfer, coordinator: Coordinator,
+           tables: list[str],
+           metrics: Optional[Metrics] = None,
+           operation_id: Optional[str] = None) -> None:
+    """Upload an explicit table list (no incremental-state update,
+    upload_tables.go:58)."""
+    if not tables:
+        raise ValueError("upload: explicit table list required")
+    descriptions = [
+        TableDescription(id=TableID.parse(t)) for t in tables
+    ]
+    loader = SnapshotLoader(transfer, coordinator, metrics=metrics,
+                            operation_id=operation_id)
+    loader.upload_tables(descriptions)

@@ -33,6 +33,8 @@ def hash_column_to_shards(col: Column, n_shards: int) -> np.ndarray:
     FNV_OFFSET = np.uint64(14695981039346656037)
     FNV_PRIME = np.uint64(1099511628211)
     n = col.n_rows
+    if n == 0:
+        return np.zeros(0, dtype=np.int32)
     if col.offsets is None:
         raw = np.ascontiguousarray(col.data).view(np.uint8).reshape(n, -1)
         h = np.full(n, FNV_OFFSET, dtype=np.uint64)

@@ -26,7 +26,9 @@ def retry_with_backoff(
     for i in range(1, attempts + 1):
         try:
             return fn()
-        except BaseException as e:
+        # Exception only: KeyboardInterrupt/SystemExit must abort
+        # immediately, not burn the backoff schedule re-pushing batches
+        except Exception as e:
             last = e
             if i >= attempts or not retriable(e):
                 raise
