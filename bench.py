@@ -1,0 +1,179 @@
+"""Benchmark: ClickBench-style parquet snapshot through the TPU data plane.
+
+Measures the north-star path (BASELINE.json): S3/fs parquet -> columnar
+batches (arrow, no row pivot) -> transformer chain (HMAC-SHA256 PII mask on
+the device + vectorized predicate filter) -> sink.  Prints ONE JSON line:
+
+    {"metric": "clickbench_snapshot_rows_per_sec", "value": N,
+     "unit": "rows/sec", "vs_baseline": N / 10_000_000}
+
+vs_baseline is relative to the BASELINE.md target (>=10M rows/sec/chip on
+v5e-1); the reference publishes no absolute numbers (BASELINE.md), so the
+target ratio is the honest comparator.
+
+Runs on the real TPU (no conftest import).  Dataset: a synthetic subset of
+ClickBench `hits` (docs/benchmarks.md:9-17 in the reference — ~100M rows,
+70 cols; here fewer rows/cols, same shape of workload: wide numerics +
+URL/title strings), generated once into /tmp/trtpu_bench.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+ROWS = int(os.environ.get("BENCH_ROWS", 2_000_000))
+BATCH_ROWS = int(os.environ.get("BENCH_BATCH_ROWS", 131_072))
+DATA_DIR = os.environ.get("BENCH_DIR", "/tmp/trtpu_bench")
+PARQUET = os.path.join(DATA_DIR, f"hits_{ROWS}.parquet")
+
+
+def generate_dataset() -> None:
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    os.makedirs(DATA_DIR, exist_ok=True)
+    if os.path.exists(PARQUET):
+        return
+    rng = np.random.default_rng(42)
+    n = ROWS
+    watch_id = rng.integers(0, 2**62, n, dtype=np.int64)
+    user_id = rng.integers(0, 10_000_000, n, dtype=np.int64)
+    counter_id = rng.integers(0, 5000, n).astype(np.int32)
+    region_id = rng.integers(0, 500, n).astype(np.int32)
+    event_time = (1_700_000_000 + rng.integers(0, 86_400 * 30, n)).astype(
+        "datetime64[s]"
+    )
+    res_w = rng.choice(
+        np.array([1280, 1366, 1536, 1920, 2560, 360, 390], dtype=np.int32), n
+    )
+    is_mobile = (rng.random(n) < 0.4).astype(np.int8)
+    # URLs ~30-90 bytes (vectorized string build)
+    host_ids = rng.integers(0, 997, n)
+    path_ids = rng.integers(0, 10_000_019, n)
+    urls = np.char.add(
+        np.char.add("https://example-", host_ids.astype("U4")),
+        np.char.add(".com/page/", path_ids.astype("U9")),
+    )
+    titles = np.char.add("Title ", rng.integers(0, 99_991, n).astype("U6"))
+    phrase_pool = np.array(["", "", "", "buy tpu", "fast etl",
+                            "weather tomorrow", "наушники"], dtype=object)
+    phrases = phrase_pool[rng.integers(0, len(phrase_pool), n)]
+    table = pa.table({
+        "WatchID": watch_id,
+        "UserID": user_id,
+        "CounterID": counter_id,
+        "RegionID": region_id,
+        "EventTime": pa.array(event_time),
+        "ResolutionWidth": res_w,
+        "IsMobile": is_mobile,
+        "URL": pa.array(urls.tolist(), type=pa.string()),
+        "Title": pa.array(titles.tolist(), type=pa.string()),
+        "SearchPhrase": pa.array(phrases.tolist(), type=pa.string()),
+    })
+    pq.write_table(table, PARQUET, row_group_size=BATCH_ROWS,
+                   compression="snappy")
+
+
+def make_transfer(process_count: int):
+    from transferia_tpu.models import Transfer
+    from transferia_tpu.models.transfer import (
+        Runtime,
+        ShardingUploadParams,
+    )
+    from transferia_tpu.providers.file import FileSourceParams
+    from transferia_tpu.providers.stdout import NullTargetParams
+
+    return Transfer(
+        id="bench",
+        src=FileSourceParams(path=PARQUET, format="parquet", table="hits",
+                             batch_rows=BATCH_ROWS),
+        dst=NullTargetParams(),
+        transformation={"transformers": [
+            {"mask_field": {"columns": ["URL"], "salt": "bench-salt"}},
+            {"filter_rows": {
+                "filter": "RegionID < 400 AND ResolutionWidth >= 390"}},
+        ]},
+        runtime=Runtime(sharding=ShardingUploadParams(
+            process_count=process_count)),
+    )
+
+
+def run_pipeline(limit_rows: int | None = None,
+                 process_count: int = 4) -> tuple[int, float]:
+    """Timed: parquet -> transform chain -> devnull sink, through the real
+    snapshot loader (row-group parts in parallel so host decode, H2D,
+    device hash, and D2H overlap across parts).  Returns (rows, seconds)."""
+    from transferia_tpu.abstract.table import TableDescription
+    from transferia_tpu.abstract.schema import TableID
+    from transferia_tpu.coordinator import MemoryCoordinator
+    from transferia_tpu.factories import make_sinker, new_storage
+    from transferia_tpu.ops.sha256 import enable_device_mask_backend
+    from transferia_tpu.tasks import SnapshotLoader
+
+    enable_device_mask_backend()
+    transfer = make_transfer(process_count)
+    t0 = time.perf_counter()
+    if limit_rows is not None:
+        # warmup path: single-thread partial run to compile all programs
+        storage = new_storage(transfer)
+        sink = make_sinker(transfer, snapshot_stage=False)
+        rows = 0
+
+        class _Enough(Exception):
+            pass
+
+        def pusher(batch):
+            nonlocal rows
+            sink.push(batch)
+            rows += batch.n_rows
+            if rows >= limit_rows:
+                raise _Enough()
+
+        try:
+            storage.load_table(
+                TableDescription(id=TableID("fs", "hits")), pusher
+            )
+        except _Enough:
+            pass
+        return rows, time.perf_counter() - t0
+
+    cp = MemoryCoordinator()
+    loader = SnapshotLoader(transfer, cp, operation_id="bench-op")
+    loader.upload_tables()
+    dt = time.perf_counter() - t0
+    prog = cp.operation_progress("bench-op")
+    return prog.completed_rows, dt
+
+
+def main() -> None:
+    t_gen = time.perf_counter()
+    generate_dataset()
+    gen_s = time.perf_counter() - t_gen
+
+    # warmup: compile the hash/filter programs on the first batches
+    warm_rows, warm_s = run_pipeline(limit_rows=BATCH_ROWS * 2)
+
+    rows, dt = run_pipeline()
+    rps = rows / dt
+    result = {
+        "metric": "clickbench_snapshot_rows_per_sec",
+        "value": round(rps),
+        "unit": "rows/sec",
+        "vs_baseline": round(rps / 10_000_000, 4),
+    }
+    print(json.dumps(result))
+    print(
+        f"# rows={rows} time={dt:.2f}s warmup={warm_s:.1f}s "
+        f"gen={gen_s:.1f}s batch={BATCH_ROWS} "
+        f"dataset={PARQUET}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

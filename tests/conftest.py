@@ -1,16 +1,25 @@
 """Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
 
-Must run before jax is imported anywhere — conftest import order guarantees
-this for pytest runs.  Benchmarks (bench.py) do NOT import this and run on
-the real TPU.
+The environment may pre-import jax with a TPU backend registered (e.g. an
+axon sitecustomize) — so setting JAX_PLATFORMS here is not enough.  Backends
+initialize lazily, so flipping jax.config before any computation still
+works; XLA_FLAGS must carry the virtual device count before the CPU client
+spins up.  Benchmarks (bench.py) do NOT import this and run on the real TPU.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("TRANSFERIA_TPU_TESTING", "1")
+
+try:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+except ImportError:  # pragma: no cover
+    pass

@@ -1,0 +1,108 @@
+"""Device SHA-256/HMAC parity with hashlib (canon contract: CPU and TPU
+mask paths must be byte-identical)."""
+
+import hashlib
+import hmac as hmac_mod
+
+import numpy as np
+import pytest
+
+from transferia_tpu.columnar.batch import Column, _offsets_from_lengths
+from transferia_tpu.abstract.schema import CanonicalType
+from transferia_tpu.ops.sha256 import (
+    hmac_sha256_hex_batch,
+    sha256_batch,
+)
+
+
+def make_flat(messages):
+    bufs = [m if isinstance(m, bytes) else m.encode() for m in messages]
+    offsets = _offsets_from_lengths([len(b) for b in bufs])
+    data = np.frombuffer(b"".join(bufs), dtype=np.uint8).copy() if bufs \
+        else np.zeros(0, dtype=np.uint8)
+    return data, offsets
+
+
+MESSAGES = [
+    b"",
+    b"abc",
+    b"hello world",
+    b"a" * 55,     # exactly fits one block with padding
+    b"b" * 56,     # forces a second block
+    b"c" * 64,
+    b"d" * 119,
+    b"e" * 120,
+    b"f" * 200,
+    "unicode-é→".encode(),
+]
+
+
+def test_sha256_matches_hashlib():
+    data, offsets = make_flat(MESSAGES)
+    got = sha256_batch(data, offsets)
+    for i, m in enumerate(MESSAGES):
+        want = hashlib.sha256(m).digest()
+        assert bytes(got[i]) == want, f"row {i} ({m[:12]!r})"
+
+
+@pytest.mark.parametrize("key", [b"", b"k", b"secret-key",
+                                 b"x" * 64, b"y" * 100])
+def test_hmac_matches_hashlib(key):
+    data, offsets = make_flat(MESSAGES)
+    hex_data, hex_offsets = hmac_sha256_hex_batch(key, data, offsets)
+    for i, m in enumerate(MESSAGES):
+        want = hmac_mod.new(key, m, hashlib.sha256).hexdigest()
+        got = bytes(hex_data[hex_offsets[i]:hex_offsets[i + 1]]).decode()
+        assert got == want, f"row {i}"
+
+
+def test_hmac_validity_mask():
+    data, offsets = make_flat([b"aa", b"bb", b"cc"])
+    validity = np.array([True, False, True])
+    hex_data, hex_offsets = hmac_sha256_hex_batch(b"k", data, offsets,
+                                                  validity)
+    lens = hex_offsets[1:] - hex_offsets[:-1]
+    assert lens.tolist() == [64, 0, 64]
+    want = hmac_mod.new(b"k", b"cc", hashlib.sha256).hexdigest()
+    assert bytes(hex_data[hex_offsets[2]:hex_offsets[3]]).decode() == want
+
+
+def test_mask_transformer_device_backend_parity():
+    """MaskField via device backend == host backend, byte for byte."""
+    from transferia_tpu.abstract import TableID
+    from transferia_tpu.abstract.schema import new_table_schema
+    from transferia_tpu.columnar import ColumnBatch
+    from transferia_tpu.ops.sha256 import enable_device_mask_backend
+    from transferia_tpu.transform import build_chain
+    from transferia_tpu.transform.plugins.mask import set_hash_backend
+
+    schema = new_table_schema([("id", "int64", True), ("email", "utf8")])
+    batch = ColumnBatch.from_pydict(TableID("", "u"), schema, {
+        "id": list(range(20)),
+        "email": [f"user{i}@example.com" for i in range(20)],
+    })
+    cfg = {"transformers": [
+        {"mask_field": {"columns": ["email"], "salt": "s"}}]}
+    try:
+        set_hash_backend(None)
+        host = build_chain(cfg).apply(batch).to_pydict()["email"]
+        enable_device_mask_backend()
+        dev = build_chain(cfg).apply(batch).to_pydict()["email"]
+    finally:
+        set_hash_backend(None)
+    assert host == dev
+
+
+def test_pack_unpack_varwidth():
+    from transferia_tpu.ops.device_batch import (
+        pack_varwidth_matrix,
+        unpack_varwidth_matrix,
+    )
+
+    data, offsets = make_flat([b"abc", b"", b"defgh"])
+    col = Column("c", CanonicalType.STRING, data, offsets)
+    m, lens = pack_varwidth_matrix(col)
+    assert m.shape[0] == 3 and lens.tolist() == [3, 0, 5]
+    back = unpack_varwidth_matrix(m, lens)
+    assert bytes(back.data) == b"abcdefgh"
+    assert back.offsets.tolist() == [0, 3, 3, 8]

@@ -1,0 +1,52 @@
+"""Mesh-sharded transform step on the virtual 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from transferia_tpu.parallel import make_mesh, sharded_transform_step
+from transferia_tpu.parallel.mesh import example_step_args
+
+
+def test_virtual_mesh_has_8_devices():
+    assert len(jax.devices()) == 8  # conftest sets the XLA flag
+
+
+def test_make_mesh_shapes():
+    mesh = make_mesh()
+    assert mesh.shape["data"] * mesh.shape["model"] == 8
+    assert mesh.shape["model"] == 2
+    mesh1 = make_mesh(n_devices=1)
+    assert mesh1.shape["data"] == 1 and mesh1.shape["model"] == 1
+
+
+def test_sharded_step_runs_and_reduces():
+    mesh = make_mesh()
+    step = sharded_transform_step(mesh, max_blocks=2, n_shards=8)
+    args = example_step_args(mesh, rows_per_device=64)
+    digests, keep, scores_f32, hist, total = step(*args)
+    n_rows = args[2].shape[0]
+    assert digests.shape[0] == args[0].shape[0]
+    assert digests.shape[1] == n_rows and digests.shape[2] == 8
+    assert keep.shape == (n_rows,)
+    # histogram sums all kept rows across every column shard
+    n_cols = args[0].shape[0]
+    assert int(hist.sum()) == int(np.asarray(keep).sum()) * n_cols
+    assert int(total) == int(np.asarray(keep).sum())
+
+
+def test_sharded_step_matches_single_device():
+    """Sharded result == unsharded result (collective correctness)."""
+    mesh8 = make_mesh()
+    mesh1 = make_mesh(n_devices=1)
+    args8 = example_step_args(mesh8, rows_per_device=32)
+    host_args = tuple(np.asarray(a) for a in args8)
+    step8 = sharded_transform_step(mesh8, max_blocks=2, n_shards=8)
+    step1 = sharded_transform_step(mesh1, max_blocks=2, n_shards=8)
+    out8 = step8(*args8)
+    # single-device mesh: model axis=1 sees ALL columns
+    out1 = step1(*host_args)
+    np.testing.assert_array_equal(np.asarray(out8[0]), np.asarray(out1[0]))
+    np.testing.assert_array_equal(np.asarray(out8[3]), np.asarray(out1[3]))
+    assert int(out8[4]) == int(out1[4])

@@ -128,14 +128,16 @@ class Column:
         validity = self.validity[indices] if self.validity is not None else None
         if self.offsets is None:
             return Column(self.name, self.ctype, self.data[indices], None, validity)
-        lens = (self.offsets[1:] - self.offsets[:-1])[indices]
+        lens = (self.offsets[1:] - self.offsets[:-1])[indices].astype(np.int64)
         new_offsets = _offsets_from_lengths(lens)
-        out = np.empty(int(new_offsets[-1]), dtype=np.uint8)
-        starts = self.offsets[:-1][indices]
-        for j in range(len(indices)):
-            out[new_offsets[j]:new_offsets[j + 1]] = (
-                self.data[starts[j]:starts[j] + lens[j]]
-            )
+        total = int(new_offsets[-1])
+        # vectorized gather: src position of every output byte
+        starts = self.offsets[:-1][indices].astype(np.int64)
+        intra = np.arange(total, dtype=np.int64) - np.repeat(
+            new_offsets[:-1].astype(np.int64), lens
+        )
+        src = np.repeat(starts, lens) + intra
+        out = self.data[src] if total else np.zeros(0, dtype=np.uint8)
         return Column(self.name, self.ctype, out, new_offsets, validity)
 
     def filter(self, mask: np.ndarray) -> "Column":

@@ -1,0 +1,24 @@
+"""Device (JAX/XLA/Pallas) kernels for the hot data-plane ops.
+
+These replace the reference's hand-optimized Go hot loops (SURVEY.md §3
+"hot loops": generic parser, serializer batch loops, CH marshaller, mask
+hasher) with batched device kernels.  Everything here is shape-static:
+callers bucket row counts (columnar.bucket_rows) and byte widths so XLA
+compiles once per (schema fingerprint, bucket).
+"""
+
+from transferia_tpu.ops.sha256 import (
+    hmac_sha256_hex_batch,
+    sha256_batch,
+)
+from transferia_tpu.ops.device_batch import (
+    pack_varwidth_matrix,
+    pad_to_bucket,
+)
+
+__all__ = [
+    "hmac_sha256_hex_batch",
+    "sha256_batch",
+    "pack_varwidth_matrix",
+    "pad_to_bucket",
+]

@@ -1,0 +1,73 @@
+"""Device-side batch utilities: bucketing, padding, var-width packing.
+
+Shape discipline: XLA compiles one program per distinct shape.  The batch
+row count is padded up to a standard bucket (columnar.bucket_rows) with a
+validity tail-mask, and var-width columns pack into fixed-width byte
+matrices bucketed by max row length — so the number of compiled programs is
+bounded by (schema fingerprint x row bucket x width bucket).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from transferia_tpu.columnar.batch import Column, ColumnBatch, bucket_rows
+
+_WIDTH_BUCKETS = (8, 16, 32, 64, 128, 256, 1024, 4096)
+
+
+def bucket_width(w: int) -> int:
+    for b in _WIDTH_BUCKETS:
+        if w <= b:
+            return b
+    top = _WIDTH_BUCKETS[-1]
+    return ((w + top - 1) // top) * top
+
+
+def pad_to_bucket(arr: np.ndarray, n_rows: int
+                  ) -> tuple[np.ndarray, int]:
+    """Pad axis 0 to the row bucket; returns (padded, bucket)."""
+    bucket = bucket_rows(n_rows)
+    if arr.shape[0] == bucket:
+        return arr, bucket
+    pad = [(0, bucket - arr.shape[0])] + [(0, 0)] * (arr.ndim - 1)
+    return np.pad(arr, pad), bucket
+
+
+def pack_varwidth_matrix(col: Column,
+                         width: Optional[int] = None
+                         ) -> tuple[np.ndarray, np.ndarray]:
+    """Flat bytes+offsets -> (N, W) fixed-width byte matrix + (N,) lengths.
+
+    Rows longer than W are truncated (callers pick W >= max length via
+    bucket_width).  Vectorized gather, no per-row Python.
+    """
+    n = col.n_rows
+    offsets = col.offsets
+    lens = (offsets[1:] - offsets[:-1]).astype(np.int64)
+    max_len = int(lens.max()) if n else 0
+    w = width if width is not None else bucket_width(max(max_len, 1))
+    out = np.zeros((n, w), dtype=np.uint8)
+    if len(col.data) and n:
+        cols = np.arange(w)
+        mask = cols[None, :] < np.minimum(lens, w)[:, None]
+        src = (offsets[:-1, None].astype(np.int64) + cols[None, :]) * mask
+        out = np.where(mask, col.data[src], 0).astype(np.uint8)
+    return out, np.minimum(lens, w).astype(np.int32)
+
+
+def unpack_varwidth_matrix(matrix: np.ndarray, lens: np.ndarray) -> Column:
+    """Inverse of pack_varwidth_matrix (for round-trips in tests/sinks)."""
+    from transferia_tpu.abstract.schema import CanonicalType
+    from transferia_tpu.columnar.batch import _offsets_from_lengths
+
+    n = matrix.shape[0]
+    offsets = _offsets_from_lengths(lens.astype(np.int64))
+    out = np.zeros(int(offsets[-1]), dtype=np.uint8)
+    cols = np.arange(matrix.shape[1])
+    mask = cols[None, :] < lens[:, None]
+    flat_dst = (offsets[:-1, None] + cols[None, :])[mask]
+    out[flat_dst] = matrix[mask]
+    return Column("packed", CanonicalType.STRING, out, offsets)

@@ -1,0 +1,18 @@
+"""Multi-chip parallelism over a jax device Mesh.
+
+Reference mapping (SURVEY.md §2.4): the reference's distribution axes —
+multi-worker data parallelism, in-worker threads, intra-table sharding,
+queue partition fan-out — map here to (a) host-level sharded snapshot via
+the coordinator (tasks/snapshot.py) and (b) device-level sharding of the
+transform step over a Mesh: rows shard across the 'data' axis (partition
+fan-in: many queue partitions feed one sharded device batch), masked
+columns shard across the 'model' axis (column-parallel transforms), with
+XLA collectives (psum) producing global stats/histograms over ICI.
+"""
+
+from transferia_tpu.parallel.mesh import (
+    make_mesh,
+    sharded_transform_step,
+)
+
+__all__ = ["make_mesh", "sharded_transform_step"]

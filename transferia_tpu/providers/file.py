@@ -135,7 +135,7 @@ class FileStorage(Storage, ShardingStorage):
         info = self.table_list().get(self.table)
         return info.eta_rows if info else 0
 
-    # -- sharding: one part per file (parquet: per row-group run) -----------
+    # -- sharding: parquet shards per row group, other formats per file -----
     def shard_table(self, table: TableDescription) -> list[TableDescription]:
         files = self._files()
         out = []
@@ -144,23 +144,42 @@ class FileStorage(Storage, ShardingStorage):
                 import pyarrow.parquet as pq
 
                 meta = pq.ParquetFile(f).metadata
-                out.append(TableDescription(
-                    id=table.id, filter=f"file:{f}",
-                    eta_rows=meta.num_rows,
-                ))
+                n_groups = meta.num_row_groups
+                for g in range(n_groups):
+                    out.append(TableDescription(
+                        id=table.id, filter=f"rg:{g}:{g + 1}:{f}",
+                        eta_rows=meta.row_group(g).num_rows,
+                    ))
             else:
                 out.append(TableDescription(id=table.id, filter=f"file:{f}"))
         return out
 
     # -- load ---------------------------------------------------------------
     def load_table(self, table: TableDescription, pusher: Pusher) -> None:
+        schema = self.table_schema(table.id)
+        if table.filter.startswith("rg:"):
+            _, lo, hi, path = table.filter.split(":", 3)
+            self._load_row_groups(path, int(lo), int(hi), table.id, schema,
+                                  pusher)
+            return
         if table.filter.startswith("file:"):
             files = [table.filter[5:]]
         else:
             files = self._files()
-        schema = self.table_schema(table.id)
         for f in files:
             self._load_file(f, table.id, schema, pusher)
+
+    def _load_row_groups(self, path: str, lo: int, hi: int, tid: TableID,
+                         schema: TableSchema, pusher: Pusher) -> None:
+        import pyarrow.parquet as pq
+
+        pf = pq.ParquetFile(path)
+        for rb in pf.iter_batches(batch_size=self.params.batch_rows,
+                                  row_groups=list(range(lo, hi))):
+            if rb.num_rows:
+                batch = ColumnBatch.from_arrow(rb, tid, schema)
+                batch.read_bytes = rb.nbytes
+                pusher(batch)
 
     def _load_file(self, path: str, tid: TableID, schema: TableSchema,
                    pusher: Pusher) -> None:
@@ -168,11 +187,8 @@ class FileStorage(Storage, ShardingStorage):
         if fmt == "parquet":
             import pyarrow.parquet as pq
 
-            pf = pq.ParquetFile(path)
-            for rb in pf.iter_batches(batch_size=self.params.batch_rows):
-                batch = ColumnBatch.from_arrow(rb, tid, schema)
-                batch.read_bytes = rb.nbytes
-                pusher(batch)
+            n_groups = pq.ParquetFile(path).metadata.num_row_groups
+            self._load_row_groups(path, 0, n_groups, tid, schema, pusher)
         elif fmt == "csv":
             import pyarrow.csv as pacsv
 

@@ -51,3 +51,31 @@ class StdoutProvider(Provider):
 
     def sinker(self):
         return StdoutSinker(self.transfer.dst)
+
+
+@register_endpoint
+@dataclass
+class NullTargetParams(EndpointParams):
+    """Counting /dev/null sink (benchmarks; reference ErrorsOutput devnull)."""
+
+    PROVIDER = "devnull"
+    IS_TARGET = True
+
+
+class NullSinker(Sinker):
+    def __init__(self):
+        self.total_rows = 0
+        self.total_bytes = 0
+
+    def push(self, batch: Batch) -> None:
+        self.total_rows += batch_len(batch)
+        if is_columnar(batch):
+            self.total_bytes += batch.nbytes()
+
+
+@register_provider
+class NullProvider(Provider):
+    NAME = "devnull"
+
+    def sinker(self):
+        return NullSinker()
