@@ -1,0 +1,141 @@
+"""Debezium codec: emitter/receiver round-trip (cf. pkg/debezium tests)."""
+
+import json
+
+import pytest
+
+from transferia_tpu.abstract import ChangeItem, Kind, OldKeys
+from transferia_tpu.abstract.schema import new_table_schema
+from transferia_tpu.debezium import DebeziumEmitter, DebeziumReceiver
+
+
+SCHEMA = new_table_schema([
+    ("id", "int64", True),
+    ("name", "utf8"),
+    ("score", "double"),
+    ("active", "boolean"),
+    ("created", "timestamp"),
+])
+
+
+def item(kind=Kind.INSERT, id_=1, old_id=None, **vals):
+    defaults = {"id": id_, "name": "alice", "score": 1.5,
+                "active": True, "created": 1_700_000_000_000_000}
+    defaults.update(vals)
+    return ChangeItem(
+        kind=kind, schema="public", table="users",
+        column_names=tuple(defaults),
+        column_values=tuple(defaults.values()),
+        table_schema=SCHEMA,
+        lsn=77, txn_id="tx9",
+        commit_time_ns=1_700_000_000_000_000_000,
+        old_keys=OldKeys(("id",), (old_id,)) if old_id is not None
+        else OldKeys(),
+    )
+
+
+def test_insert_envelope_shape():
+    em = DebeziumEmitter(topic_prefix="pfx")
+    (key, value), = em.emit_item(item())
+    k = json.loads(key)
+    v = json.loads(value)
+    assert k["payload"] == {"id": 1}
+    assert v["payload"]["op"] == "c"
+    assert v["payload"]["after"]["name"] == "alice"
+    assert v["payload"]["before"] is None
+    assert v["payload"]["source"]["table"] == "users"
+    assert v["payload"]["source"]["lsn"] == 77
+    # schema block declares semantic timestamp type
+    after_schema = next(f for f in v["schema"]["fields"]
+                        if f["field"] == "after")
+    created = next(f for f in after_schema["fields"]
+                   if f["field"] == "created")
+    assert created["name"] == "io.debezium.time.MicroTimestamp"
+
+
+def test_snapshot_op_is_r():
+    em = DebeziumEmitter()
+    (_, value), = em.emit_item(item(), snapshot=True)
+    assert json.loads(value)["payload"]["op"] == "r"
+
+
+def test_delete_tombstone():
+    em = DebeziumEmitter(emit_tombstones=True)
+    out = em.emit_item(item(kind=Kind.DELETE, old_id=5))
+    assert len(out) == 2
+    key, value = out[0]
+    assert json.loads(value)["payload"]["op"] == "d"
+    assert json.loads(key)["payload"] == {"id": 5}
+    assert out[1][1] is None  # tombstone
+
+
+class TestRoundTrip:
+    def roundtrip(self, it, **emitter_kw):
+        em = DebeziumEmitter(**emitter_kw)
+        rc = DebeziumReceiver()
+        (key, value), *_ = em.emit_item(it)
+        return rc.receive(value, key)
+
+    def test_insert(self):
+        back = self.roundtrip(item())
+        assert back.kind == Kind.INSERT
+        assert back.table == "users" and back.schema == "public"
+        assert back.as_dict()["id"] == 1
+        assert back.as_dict()["name"] == "alice"
+        assert back.as_dict()["score"] == 1.5
+        assert back.as_dict()["active"] is True
+        assert back.as_dict()["created"] == 1_700_000_000_000_000
+        assert back.lsn == 77 and back.txn_id == "tx9"
+        # canonical types restored from the schema block
+        assert back.table_schema.find("created").data_type.value == \
+            "timestamp"
+        assert back.table_schema.find("id").primary_key
+
+    def test_update_with_old_keys(self):
+        back = self.roundtrip(item(kind=Kind.UPDATE, id_=2, old_id=1))
+        assert back.kind == Kind.UPDATE
+        assert back.old_keys.as_dict() == {"id": 1}
+        assert back.effective_key() == (1,)
+
+    def test_delete(self):
+        back = self.roundtrip(item(kind=Kind.DELETE, old_id=9))
+        assert back.kind == Kind.DELETE
+        assert back.effective_key() == (9,)
+
+    def test_schemaless_payload(self):
+        back = self.roundtrip(item(), include_schema=False)
+        assert back.kind == Kind.INSERT
+        assert back.as_dict()["name"] == "alice"
+
+    def test_tombstone_returns_none(self):
+        rc = DebeziumReceiver()
+        assert rc.receive(b"", b'{"id": 1}') is None
+
+
+def test_bytes_column_base64():
+    schema = new_table_schema([("id", "int64", True), ("blob", "string")])
+    it = ChangeItem(kind=Kind.INSERT, table="b",
+                    column_names=("id", "blob"),
+                    column_values=(1, b"\x00\xff\x10"),
+                    table_schema=schema)
+    em = DebeziumEmitter()
+    rc = DebeziumReceiver()
+    (key, value), = em.emit_item(it)
+    back = rc.receive(value, key)
+    assert back.as_dict()["blob"] == b"\x00\xff\x10"
+
+
+def test_debezium_parser_plugin():
+    from transferia_tpu.parsers import Message, make_parser
+
+    em = DebeziumEmitter()
+    items = [item(id_=i) for i in range(5)]
+    p = make_parser({"debezium": {}})
+    msgs = []
+    for it in items:
+        (k, v), = em.emit_item(it)
+        msgs.append(Message(value=v, key=k, topic="db.public.users"))
+    res = p.do_batch(msgs)
+    assert res.unparsed is None
+    assert sum(b.n_rows for b in res.batches) == 5
+    assert res.batches[0].to_pydict()["id"] == list(range(5))

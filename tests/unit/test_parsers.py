@@ -1,0 +1,150 @@
+"""Parser framework + plugins (cf. reference tests/canon/parser)."""
+
+import json
+
+import pytest
+
+from transferia_tpu.abstract.schema import CanonicalType, TableID
+from transferia_tpu.parsers import (
+    Message,
+    UNPARSED_TABLE,
+    make_parser,
+    registered_parsers,
+)
+
+
+def msg(value, topic="t1", partition=0, offset=0, key=b""):
+    if isinstance(value, str):
+        value = value.encode()
+    return Message(value=value, key=key, topic=topic, partition=partition,
+                   offset=offset, write_time_ns=1_700_000_000_000_000_000)
+
+
+def test_registry_lists_builtins():
+    names = registered_parsers()
+    for expected in ("json", "generic", "tskv", "blank", "raw_to_table",
+                     "debezium", "cloudevents", "native", "audittrailsv1",
+                     "cloudlogging", "protobuf", "confluent_schema_registry"):
+        assert expected in names, expected
+
+
+class TestGenericJson:
+    def make(self, **kw):
+        return make_parser({"json": {
+            "schema": [
+                {"name": "id", "type": "int64", "key": True},
+                {"name": "name", "type": "utf8"},
+                {"name": "value", "type": "double"},
+            ],
+            "table": "events",
+            **kw,
+        }})
+
+    def test_parses_batch_columnar(self):
+        p = self.make()
+        msgs = [msg(json.dumps({"id": i, "name": f"n{i}", "value": i * 0.5}))
+                for i in range(10)]
+        res = p.do_batch(msgs)
+        assert res.unparsed is None
+        assert len(res.batches) == 1
+        b = res.batches[0]
+        assert b.n_rows == 10
+        assert b.to_pydict()["id"] == list(range(10))
+        # system cols present and keyed (user key declared -> system not key)
+        assert "_offset" in b.columns
+        assert b.schema.find("id").primary_key
+
+    def test_multiline_messages(self):
+        p = self.make()
+        payload = "\n".join(
+            json.dumps({"id": i, "name": "x", "value": 1.0})
+            for i in range(3)
+        )
+        res = p.do_batch([msg(payload)])
+        assert res.batches[0].n_rows == 3
+        assert res.batches[0].to_pydict()["_idx"] == [0, 1, 2]
+
+    def test_bad_rows_to_unparsed(self):
+        p = self.make()
+        msgs = [
+            msg('{"id": 1, "name": "a", "value": 1.0}'),
+            msg('{broken json'),
+            msg('{"id": 2, "name": "b", "value": 2.0}'),
+            msg('[1,2,3]'),  # not an object
+        ]
+        res = p.do_batch(msgs)
+        assert res.batches[0].n_rows == 2
+        assert res.unparsed is not None
+        assert res.unparsed.n_rows == 2
+        assert res.unparsed.table_id == UNPARSED_TABLE
+        reasons = res.unparsed.to_pydict()["reason"]
+        assert all("invalid JSON" in r for r in reasons)
+
+    def test_null_key_rejected(self):
+        p = self.make()
+        res = p.do_batch([msg('{"id": null, "name": "a", "value": 1.0}')])
+        assert not res.batches
+        assert res.unparsed.n_rows == 1
+        assert "null value in key" in res.unparsed.to_pydict()["reason"][0]
+
+    def test_coercion_from_strings(self):
+        p = self.make()
+        res = p.do_batch([msg('{"id": "5", "name": "a", "value": "2.5"}')])
+        assert res.batches[0].to_pydict()["id"] == [5]
+        assert res.batches[0].to_pydict()["value"] == [2.5]
+
+    def test_schema_inference(self):
+        p = make_parser({"json": {"table": "inferred"}})
+        res = p.do_batch([msg('{"a": 1, "b": "x", "c": true}')])
+        b = res.batches[0]
+        assert b.schema.find("a").data_type == CanonicalType.INT64
+        assert b.schema.find("b").data_type == CanonicalType.UTF8
+        assert b.schema.find("c").data_type == CanonicalType.BOOLEAN
+
+    def test_nested_path(self):
+        p = make_parser({"json": {
+            "schema": [{"name": "uid", "type": "int64", "path": "user.id"}],
+            "table": "t",
+        }})
+        res = p.do_batch([msg('{"user": {"id": 42}}')])
+        assert res.batches[0].to_pydict()["uid"] == [42]
+
+
+def test_tskv_parser():
+    p = make_parser({"tskv": {
+        "schema": [{"name": "a", "type": "int64"},
+                   {"name": "b", "type": "utf8"}],
+        "table": "logs",
+    }})
+    res = p.do_batch([msg("tskv\ta=1\tb=hello"), msg("a=2\tb=wor\\tld")])
+    d = res.batches[0].to_pydict()
+    assert d["a"] == [1, 2]
+    assert d["b"] == ["hello", "wor\tld"]
+
+
+def test_blank_parser_mirror_schema():
+    p = make_parser({"blank": {}})
+    res = p.do_batch([msg(b"\x00\x01raw", topic="tp", partition=3,
+                          offset=42, key=b"k")])
+    b = res.batches[0]
+    assert b.table_id == TableID("", "tp")
+    d = b.to_pydict()
+    assert d["data"] == [b"\x00\x01raw"]
+    assert d["partition"] == [3] and d["offset"] == [42]
+
+
+def test_cloudevents_parser():
+    p = make_parser({"cloudevents": {}})
+    ok = {"specversion": "1.0", "id": "e1", "source": "/svc",
+          "type": "demo", "data": {"x": 1}}
+    res = p.do_batch([msg(json.dumps(ok)), msg('{"no": "id"}')])
+    assert res.batches[0].to_pydict()["id"] == ["e1"]
+    assert res.unparsed.n_rows == 1
+
+
+def test_confluent_sr_parser():
+    p = make_parser({"confluent_schema_registry": {"table": "t"}})
+    payload = b"\x00\x00\x00\x00\x07" + b'{"a": 1}'
+    res = p.do_batch([msg(payload), msg(b"\x01nope")])
+    assert res.batches[0].to_pydict()["a"] == [1]
+    assert res.unparsed.n_rows == 1

@@ -1,0 +1,180 @@
+"""Serializers + ParseQueue ordering/ack guarantees
+(cf. pkg/parsequeue/parsequeue_test.go)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from transferia_tpu.abstract import TableID
+from transferia_tpu.abstract.interfaces import AsyncSink
+from transferia_tpu.abstract.schema import new_table_schema
+from transferia_tpu.columnar import ColumnBatch
+from transferia_tpu.parsequeue import ParseQueue
+from transferia_tpu.serializers import (
+    make_queue_serializer,
+    make_serializer,
+)
+
+SCHEMA = new_table_schema([("id", "int64", True), ("name", "utf8")])
+TID = TableID("s", "t")
+
+
+def batch(n=3, start=0):
+    return ColumnBatch.from_pydict(TID, SCHEMA, {
+        "id": list(range(start, start + n)),
+        "name": [f"n{i}" for i in range(start, start + n)],
+    })
+
+
+class TestSerializers:
+    def test_json(self):
+        out = make_serializer("json").serialize(batch(2)).decode()
+        rows = [json.loads(l) for l in out.strip().split("\n")]
+        assert rows == [{"id": 0, "name": "n0"}, {"id": 1, "name": "n1"}]
+
+    def test_csv(self):
+        out = make_serializer("csv", header=True).serialize(batch(2))
+        assert out.decode().splitlines() == ["id,name", "0,n0", "1,n1"]
+
+    def test_parquet_roundtrip(self):
+        import io
+
+        import pyarrow.parquet as pq
+
+        out = make_serializer("parquet").serialize(batch(4))
+        t = pq.read_table(io.BytesIO(out))
+        assert t.column("id").to_pylist() == [0, 1, 2, 3]
+
+    def test_raw(self):
+        from transferia_tpu.parsers import Message, make_parser
+
+        res = make_parser({"blank": {}}).do_batch([
+            Message(value=b"line-a", topic="x"),
+            Message(value=b"line-b", topic="x"),
+        ])
+        out = make_serializer("raw").serialize(res.batches[0])
+        assert out == b"line-a\nline-b\n"
+
+    def test_queue_json_keys(self):
+        pairs = make_queue_serializer("json").serialize_messages(batch(2))
+        assert json.loads(pairs[0][0]) == {"id": 0}
+        assert json.loads(pairs[1][1])["name"] == "n1"
+
+    def test_queue_native_roundtrip(self):
+        from transferia_tpu.parsers import Message, make_parser
+
+        pairs = make_queue_serializer("native").serialize_messages(batch(3))
+        p = make_parser({"native": {}})
+        res = p.do_batch([Message(value=v) for _, v in pairs])
+        assert res.batches[0].to_pydict()["id"] == [0, 1, 2]
+
+    def test_queue_debezium(self):
+        pairs = make_queue_serializer("debezium").serialize_messages(batch(1))
+        v = json.loads(pairs[0][1])
+        assert v["payload"]["op"] == "c"
+
+    def test_queue_mirror(self):
+        from transferia_tpu.parsers import Message, make_parser
+
+        res = make_parser({"blank": {}}).do_batch([
+            Message(value=b"payload", key=b"k1", topic="x"),
+        ])
+        pairs = make_queue_serializer("mirror").serialize_messages(
+            res.batches[0]
+        )
+        assert pairs == [(b"k1", b"payload")]
+
+
+class OrderedSink(AsyncSink):
+    def __init__(self, delay_first=0.0):
+        self.pushed = []
+        self.delay_first = delay_first
+        self.lock = threading.Lock()
+
+    def async_push(self, b):
+        import concurrent.futures
+
+        fut = concurrent.futures.Future()
+        if self.delay_first and not self.pushed:
+            time.sleep(self.delay_first)
+        with self.lock:
+            self.pushed.append(b)
+        fut.set_result(None)
+        return fut
+
+
+class TestParseQueue:
+    def test_order_preserved_under_parallel_parse(self):
+        sink = OrderedSink()
+        acks = []
+
+        def slow_parse(i):
+            # earlier items parse slower: order must still hold
+            time.sleep(0.02 * (8 - i) / 8)
+            return batch(1, start=i)
+
+        pq = ParseQueue(4, sink, slow_parse,
+                        lambda raw, err: acks.append((raw, err)))
+        for i in range(8):
+            pq.add(i)
+        pq.wait()
+        pq.close()
+        pushed_ids = [b.to_pydict()["id"][0] for b in sink.pushed]
+        assert pushed_ids == list(range(8))      # push order == add order
+        assert [a[0] for a in acks] == list(range(8))  # ack order too
+        assert all(a[1] is None for a in acks)
+
+    def test_ack_after_push(self):
+        events = []
+
+        class RecordingSink(AsyncSink):
+            def async_push(self, b):
+                import concurrent.futures
+
+                events.append(("push", b.to_pydict()["id"][0]))
+                fut = concurrent.futures.Future()
+                fut.set_result(None)
+                return fut
+
+        pq = ParseQueue(2, RecordingSink(), lambda i: batch(1, start=i),
+                        lambda raw, err: events.append(("ack", raw)))
+        for i in range(4):
+            pq.add(i)
+        pq.wait()
+        pq.close()
+        # for each i, push precedes ack
+        for i in range(4):
+            assert events.index(("push", i)) < events.index(("ack", i))
+
+    def test_parse_error_acked_with_error_and_latched(self):
+        sink = OrderedSink()
+        acks = []
+
+        def parse(i):
+            if i == 2:
+                raise ValueError("bad payload")
+            return batch(1, start=i)
+
+        pq = ParseQueue(2, sink, parse,
+                        lambda raw, err: acks.append((raw, err)))
+        for i in range(4):
+            pq.add(i)
+        pq.wait_quiet()
+        assert pq.failure is not None
+        with pytest.raises(ValueError):
+            pq.add(99)
+        pq.close()
+        errs = {raw: err for raw, err in acks}
+        assert errs[2] is not None and isinstance(errs[2], ValueError)
+
+
+# helper used above: wait() raises on failure; tests need a non-raising wait
+def _wait_quiet(self):
+    with self._cv:
+        while self._outstanding > 0:
+            self._cv.wait(timeout=0.5)
+
+
+ParseQueue.wait_quiet = _wait_quiet

@@ -1,0 +1,14 @@
+"""Debezium protocol codec (reference: pkg/debezium/ — emitter_*.go,
+receiver.go, per-DB type mappers).
+
+Bidirectional: the emitter turns ChangeItems/ColumnBatches into Debezium
+envelope (key, value) JSON pairs for queue sinks (mysql2kafka config in
+BASELINE.json); the receiver turns Debezium envelopes back into ChangeItems
+for queue sources.  Type fidelity follows Kafka Connect schema names
+(io.debezium.time.*, org.apache.kafka.connect.data.Decimal).
+"""
+
+from transferia_tpu.debezium.emitter import DebeziumEmitter
+from transferia_tpu.debezium.receiver import DebeziumReceiver
+
+__all__ = ["DebeziumEmitter", "DebeziumReceiver"]

@@ -1,0 +1,185 @@
+"""Debezium envelope emitter (pkg/debezium/emitter_*.go, packer/).
+
+Produces (key_bytes, value_bytes) JSON pairs per row.  Deletes also emit the
+tombstone (key, None) message when configured, matching Debezium's default
+topic compaction contract.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Iterable, Optional
+
+from transferia_tpu.abstract.change_item import ChangeItem
+from transferia_tpu.abstract.kinds import Kind
+from transferia_tpu.abstract.schema import TableSchema
+from transferia_tpu.columnar.batch import ColumnBatch
+from transferia_tpu.debezium.types import TO_CONNECT, encode_value
+
+
+def _field_schema(cs) -> dict:
+    ctype, semantic = TO_CONNECT[cs.data_type]
+    out = {"type": ctype, "optional": not cs.required, "field": cs.name}
+    if semantic:
+        out["name"] = semantic
+        out["version"] = 1
+    return out
+
+
+class DebeziumEmitter:
+    """config mirrors the reference's parameters/ subset: topic_prefix,
+    connector name, include_schema (schema block on/off), emit_tombstones."""
+
+    VERSION = "2.5.0.transferia-tpu"
+
+    def __init__(self, topic_prefix: str = "transfer",
+                 connector: str = "transferia-tpu",
+                 include_schema: bool = True,
+                 emit_tombstones: bool = False,
+                 source_db_type: str = "postgresql"):
+        self.topic_prefix = topic_prefix
+        self.connector = connector
+        self.include_schema = include_schema
+        self.emit_tombstones = emit_tombstones
+        self.source_db_type = source_db_type
+
+    # -- schema blocks (cached per table schema fingerprint) ---------------
+    def _value_schema(self, item: ChangeItem, schema: TableSchema) -> dict:
+        fqtn = f"{self.topic_prefix}.{item.schema}.{item.table}"
+        row_fields = [_field_schema(c) for c in schema]
+        row_struct = lambda name: {  # noqa: E731
+            "type": "struct", "optional": True, "field": name,
+            "fields": row_fields,
+            "name": f"{fqtn}.Value",
+        }
+        return {
+            "type": "struct",
+            "name": f"{fqtn}.Envelope",
+            "optional": False,
+            "fields": [
+                row_struct("before"),
+                row_struct("after"),
+                {
+                    "type": "struct", "optional": False, "field": "source",
+                    "name": "io.debezium.connector.common.Source",
+                    "fields": [
+                        {"type": "string", "optional": False,
+                         "field": "version"},
+                        {"type": "string", "optional": False,
+                         "field": "connector"},
+                        {"type": "string", "optional": False, "field": "name"},
+                        {"type": "int64", "optional": False, "field": "ts_ms"},
+                        {"type": "string", "optional": True,
+                         "field": "snapshot"},
+                        {"type": "string", "optional": False, "field": "db"},
+                        {"type": "string", "optional": True, "field": "schema"},
+                        {"type": "string", "optional": False, "field": "table"},
+                        {"type": "int64", "optional": True, "field": "lsn"},
+                        {"type": "string", "optional": True, "field": "txId"},
+                    ],
+                },
+                {"type": "string", "optional": False, "field": "op"},
+                {"type": "int64", "optional": True, "field": "ts_ms"},
+            ],
+        }
+
+    def _key_schema(self, item: ChangeItem, schema: TableSchema) -> dict:
+        fqtn = f"{self.topic_prefix}.{item.schema}.{item.table}"
+        return {
+            "type": "struct", "optional": False, "name": f"{fqtn}.Key",
+            "fields": [_field_schema(c) for c in schema.key_columns()],
+        }
+
+    # -- payload ------------------------------------------------------------
+    def _row_payload(self, names, values, schema: TableSchema) -> dict:
+        out = {}
+        for n, v in zip(names, values):
+            cs = schema.find(n)
+            out[n] = encode_value(cs.data_type, v) if cs else v
+        return out
+
+    def _source(self, item: ChangeItem, snapshot: bool) -> dict:
+        return {
+            "version": self.VERSION,
+            "connector": self.connector,
+            "name": self.topic_prefix,
+            "ts_ms": item.commit_time_ns // 1_000_000 or
+            int(time.time() * 1000),
+            "snapshot": "true" if snapshot else "false",
+            "db": self.source_db_type,
+            "schema": item.schema,
+            "table": item.table,
+            "lsn": item.lsn or None,
+            "txId": item.txn_id or None,
+        }
+
+    def emit_item(self, item: ChangeItem,
+                  snapshot: bool = False) -> list[tuple[bytes, Optional[bytes]]]:
+        """One row -> [(key, value)] (+ tombstone for deletes)."""
+        schema = item.table_schema
+        if schema is None:
+            raise ValueError("debezium emitter requires table_schema")
+        op = {Kind.INSERT: "r" if snapshot else "c",
+              Kind.UPDATE: "u", Kind.DELETE: "d"}.get(item.kind)
+        if op is None:
+            return []  # control events don't serialize to debezium
+
+        key_vals = {}
+        for c in schema.key_columns():
+            if item.kind == Kind.DELETE and item.old_keys.key_names:
+                key_vals[c.name] = encode_value(
+                    c.data_type, item.old_keys.as_dict().get(c.name)
+                )
+            else:
+                key_vals[c.name] = encode_value(
+                    c.data_type, item.value(c.name)
+                )
+
+        after = None
+        before = None
+        if item.kind != Kind.DELETE:
+            after = self._row_payload(item.column_names, item.column_values,
+                                      schema)
+        if item.kind in (Kind.UPDATE, Kind.DELETE) and \
+                item.old_keys.key_names:
+            before = self._row_payload(
+                item.old_keys.key_names, item.old_keys.key_values, schema
+            )
+
+        value_payload = {
+            "before": before,
+            "after": after,
+            "source": self._source(item, snapshot),
+            "op": op,
+            "ts_ms": int(time.time() * 1000),
+        }
+        if self.include_schema:
+            key_obj = {"schema": self._key_schema(item, schema),
+                       "payload": key_vals}
+            value_obj = {"schema": self._value_schema(item, schema),
+                         "payload": value_payload}
+        else:
+            key_obj, value_obj = key_vals, value_payload
+        key_b = json.dumps(key_obj, separators=(",", ":"),
+                           default=str).encode()
+        value_b = json.dumps(value_obj, separators=(",", ":"),
+                             default=str).encode()
+        out: list[tuple[bytes, Optional[bytes]]] = [(key_b, value_b)]
+        if item.kind == Kind.DELETE and self.emit_tombstones:
+            out.append((key_b, None))
+        return out
+
+    def emit_batch(self, batch, snapshot: bool = False
+                   ) -> list[tuple[bytes, Optional[bytes]]]:
+        """ColumnBatch or row list -> envelope pairs, order-preserving."""
+        items: Iterable[ChangeItem]
+        if isinstance(batch, ColumnBatch):
+            items = batch.to_rows()
+        else:
+            items = batch
+        out = []
+        for it in items:
+            if it.is_row_event():
+                out.extend(self.emit_item(it, snapshot))
+        return out

@@ -1,0 +1,165 @@
+"""Debezium envelope receiver (pkg/debezium/receiver.go, receiver_engine.go).
+
+Parses Debezium value JSON (with or without the schema block) back into
+ChangeItems; schema blocks restore canonical types via Connect semantic
+names, schemaless payloads fall back to JSON-shape inference.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+from transferia_tpu.abstract.change_item import ChangeItem, OldKeys
+from transferia_tpu.abstract.kinds import Kind
+from transferia_tpu.abstract.schema import (
+    CanonicalType,
+    ColSchema,
+    TableSchema,
+)
+from transferia_tpu.debezium.types import (
+    FROM_CONNECT,
+    FROM_SEMANTIC,
+    decode_value,
+)
+
+_OPS = {"c": Kind.INSERT, "r": Kind.INSERT, "u": Kind.UPDATE,
+        "d": Kind.DELETE}
+
+
+class DebeziumReceiver:
+    def __init__(self):
+        self._schema_cache: dict[str, TableSchema] = {}
+
+    # -- schema -------------------------------------------------------------
+    def _connect_to_colschema(self, f: dict, keys: set[str]) -> ColSchema:
+        semantic = f.get("name", "")
+        if semantic in FROM_SEMANTIC:
+            ctype = FROM_SEMANTIC[semantic]
+        else:
+            ctype = FROM_CONNECT.get(f.get("type", "string"),
+                                     CanonicalType.ANY)
+        return ColSchema(
+            name=f["field"],
+            data_type=ctype,
+            primary_key=f["field"] in keys,
+            required=not f.get("optional", True),
+        )
+
+    def _schema_from_block(self, value_schema: dict,
+                           key_schema: Optional[dict]) -> Optional[TableSchema]:
+        after = next(
+            (f for f in value_schema.get("fields", [])
+             if f.get("field") == "after"),
+            None,
+        )
+        if after is None:
+            return None
+        keys = set()
+        if key_schema:
+            keys = {f["field"] for f in key_schema.get("fields", [])}
+        name = after.get("name", "")
+        cached = self._schema_cache.get(name) if name else None
+        if cached is not None:
+            return cached
+        schema = TableSchema([
+            self._connect_to_colschema(f, keys)
+            for f in after.get("fields", [])
+        ])
+        if name:
+            self._schema_cache[name] = schema
+        return schema
+
+    @staticmethod
+    def _infer_schema(payload_row: dict, keys: set[str]) -> TableSchema:
+        cols = []
+        for k, v in payload_row.items():
+            if isinstance(v, bool):
+                t = CanonicalType.BOOLEAN
+            elif isinstance(v, int):
+                t = CanonicalType.INT64
+            elif isinstance(v, float):
+                t = CanonicalType.DOUBLE
+            elif isinstance(v, str):
+                t = CanonicalType.UTF8
+            else:
+                t = CanonicalType.ANY
+            cols.append(ColSchema(k, t, primary_key=k in keys))
+        return TableSchema(cols)
+
+    # -- decode -------------------------------------------------------------
+    def receive(self, value: bytes,
+                key: Optional[bytes] = None) -> Optional[ChangeItem]:
+        """One Debezium value (+key) -> ChangeItem (None for tombstones)."""
+        if not value:
+            return None
+        obj = json.loads(value)
+        key_obj = json.loads(key) if key else None
+
+        if isinstance(obj, dict) and "payload" in obj and "schema" in obj:
+            payload = obj["payload"]
+            schema = self._schema_from_block(
+                obj.get("schema") or {},
+                (key_obj or {}).get("schema") if isinstance(key_obj, dict)
+                else None,
+            )
+        else:
+            payload = obj
+            schema = None
+
+        if not isinstance(payload, dict) or "op" not in payload:
+            raise ValueError("not a debezium envelope: missing op")
+        kind = _OPS.get(payload["op"])
+        if kind is None:
+            return None  # txn markers etc.
+
+        source = payload.get("source") or {}
+        after = payload.get("after")
+        before = payload.get("before")
+
+        key_payload = {}
+        if isinstance(key_obj, dict):
+            key_payload = key_obj.get("payload", key_obj)
+            if not isinstance(key_payload, dict):
+                key_payload = {}
+
+        if schema is None:
+            row = after or before or key_payload or {}
+            schema = self._infer_schema(row, set(key_payload))
+
+        def decode_row(row: Optional[dict]) -> dict:
+            if not row:
+                return {}
+            out = {}
+            for k, v in row.items():
+                cs = schema.find(k)
+                out[k] = decode_value(cs.data_type, v) if cs else v
+            return out
+
+        values = decode_row(after if kind != Kind.DELETE else None)
+        before_vals = decode_row(before)
+        if kind == Kind.DELETE and not before_vals:
+            before_vals = decode_row(key_payload)
+
+        names = tuple(schema.names())
+        old_keys = OldKeys()
+        if before_vals:
+            key_cols = [c.name for c in schema.key_columns()] or \
+                list(before_vals)
+            old_keys = OldKeys(
+                tuple(key_cols),
+                tuple(before_vals.get(k) for k in key_cols),
+            )
+        return ChangeItem(
+            kind=kind,
+            schema=source.get("schema") or source.get("db", ""),
+            table=source.get("table", ""),
+            column_names=names if kind != Kind.DELETE else (),
+            column_values=tuple(values.get(n) for n in names)
+            if kind != Kind.DELETE else (),
+            table_schema=schema,
+            old_keys=old_keys,
+            lsn=source.get("lsn") or 0,
+            txn_id=str(source.get("txId") or ""),
+            commit_time_ns=(source.get("ts_ms") or 0) * 1_000_000,
+        )

@@ -1,0 +1,98 @@
+"""Canonical <-> Debezium/Kafka-Connect type mapping.
+
+Reference: pkg/debezium per-DB mappers (pg/, mysql/) generalized over the
+canonical lattice instead of per-DB native types.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from transferia_tpu.abstract.schema import CanonicalType
+
+# canonical -> (connect type, semantic name or None)
+TO_CONNECT: dict[CanonicalType, tuple[str, Optional[str]]] = {
+    CanonicalType.INT8: ("int16", None),
+    CanonicalType.INT16: ("int16", None),
+    CanonicalType.INT32: ("int32", None),
+    CanonicalType.INT64: ("int64", None),
+    CanonicalType.UINT8: ("int16", None),
+    CanonicalType.UINT16: ("int32", None),
+    CanonicalType.UINT32: ("int64", None),
+    CanonicalType.UINT64: ("int64", None),
+    CanonicalType.FLOAT: ("float", None),
+    CanonicalType.DOUBLE: ("double", None),
+    CanonicalType.BOOLEAN: ("boolean", None),
+    CanonicalType.STRING: ("bytes", None),
+    CanonicalType.UTF8: ("string", None),
+    CanonicalType.DATE: ("int32", "io.debezium.time.Date"),
+    CanonicalType.DATETIME: ("int64", "io.debezium.time.Timestamp"),
+    CanonicalType.TIMESTAMP: ("int64", "io.debezium.time.MicroTimestamp"),
+    CanonicalType.INTERVAL: ("int64", "io.debezium.time.MicroDuration"),
+    CanonicalType.DECIMAL: ("string", None),
+    CanonicalType.ANY: ("string", "io.debezium.data.Json"),
+}
+
+# semantic name -> canonical (receiver side)
+FROM_SEMANTIC: dict[str, CanonicalType] = {
+    "io.debezium.time.Date": CanonicalType.DATE,
+    "io.debezium.time.Timestamp": CanonicalType.DATETIME,
+    "io.debezium.time.MicroTimestamp": CanonicalType.TIMESTAMP,
+    "io.debezium.time.NanoTimestamp": CanonicalType.TIMESTAMP,
+    "io.debezium.time.MicroDuration": CanonicalType.INTERVAL,
+    "io.debezium.data.Json": CanonicalType.ANY,
+    "org.apache.kafka.connect.data.Decimal": CanonicalType.DECIMAL,
+}
+
+FROM_CONNECT: dict[str, CanonicalType] = {
+    "int8": CanonicalType.INT8,
+    "int16": CanonicalType.INT16,
+    "int32": CanonicalType.INT32,
+    "int64": CanonicalType.INT64,
+    "float": CanonicalType.FLOAT,
+    "double": CanonicalType.DOUBLE,
+    "boolean": CanonicalType.BOOLEAN,
+    "string": CanonicalType.UTF8,
+    "bytes": CanonicalType.STRING,
+}
+
+
+def encode_value(ctype: CanonicalType, v: Any) -> Any:
+    """Canonical python value -> Debezium payload value."""
+    if v is None:
+        return None
+    if ctype == CanonicalType.DATETIME:
+        return int(v) * 1000  # seconds -> ms (io.debezium.time.Timestamp)
+    if ctype == CanonicalType.STRING:
+        import base64
+
+        raw = v if isinstance(v, bytes) else str(v).encode()
+        return base64.b64encode(raw).decode()
+    if ctype == CanonicalType.ANY and not isinstance(v, str):
+        import json
+
+        return json.dumps(v, separators=(",", ":"), default=str)
+    return v
+
+
+def decode_value(ctype: CanonicalType, v: Any) -> Any:
+    """Debezium payload value -> canonical python value."""
+    if v is None:
+        return None
+    if ctype == CanonicalType.DATETIME:
+        return int(v) // 1000
+    if ctype == CanonicalType.STRING:
+        import base64
+
+        try:
+            return base64.b64decode(v)
+        except Exception:
+            return str(v).encode()
+    if ctype == CanonicalType.ANY and isinstance(v, str):
+        import json
+
+        try:
+            return json.loads(v)
+        except ValueError:
+            return v
+    return v

@@ -1,0 +1,12 @@
+"""ParseQueue: parallel parse, ordered push, serialized ack.
+
+Reference parity: pkg/parsequeue/parsequeue.go:17-90 + README guarantees:
+N parse workers run concurrently, pushes happen strictly in Add() order,
+acks run serialized after their push resolves, and the first error latches
+(fail-fast; subsequent Adds fail immediately).  WaitableParseQueue adds
+Wait() for partition rebalances.
+"""
+
+from transferia_tpu.parsequeue.queue import ParseQueue, WaitableParseQueue
+
+__all__ = ["ParseQueue", "WaitableParseQueue"]

@@ -1,0 +1,38 @@
+"""Parsers: queue payloads -> columnar batches / ChangeItems.
+
+Reference parity: pkg/parsers/ (abstract.go Message/MessageBatch,
+Parser.Do/DoBatch, registry.go, the _unparsed policy in utils.go:145) and
+pkg/parsers/registry/ plugins.
+
+TPU-first difference: DoBatch is the primary API and returns ColumnBatches
+(whole message batches decode into columnar buffers at once — pyarrow's
+vectorized JSON/CSV readers on host today, device byte-tensor kernels where
+it pays); per-message Do exists for CDC edges.  Rows that fail to parse are
+routed to the `_unparsed` system table, never dropped.
+"""
+
+from transferia_tpu.parsers.base import (
+    Message,
+    ParseResult,
+    Parser,
+    UNPARSED_TABLE,
+    unparsed_batch,
+)
+from transferia_tpu.parsers.registry import (
+    make_parser,
+    register_parser,
+    registered_parsers,
+)
+
+import transferia_tpu.parsers.plugins  # noqa: F401  (self-registration)
+
+__all__ = [
+    "Message",
+    "ParseResult",
+    "Parser",
+    "UNPARSED_TABLE",
+    "unparsed_batch",
+    "make_parser",
+    "register_parser",
+    "registered_parsers",
+]

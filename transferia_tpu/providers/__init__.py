@@ -24,7 +24,13 @@ __all__ = [
 
 def load_builtin_providers() -> None:
     """Import all built-in providers (idempotent)."""
-    from transferia_tpu.providers import sample, stdout, memory, file as file_p  # noqa: F401
+    from transferia_tpu.providers import (  # noqa: F401
+        file as file_p,
+        memory,
+        mq,
+        sample,
+        stdout,
+    )
     try:
         from transferia_tpu.providers import s3, clickhouse, kafka, postgres  # noqa: F401
     except ImportError:  # pragma: no cover - optional deps during bring-up

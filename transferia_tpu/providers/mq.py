@@ -1,0 +1,196 @@
+"""In-memory message broker provider.
+
+The local stand-in for Kafka-shaped sources/sinks (reference test recipes
+spin up real brokers via testcontainers, tests/tcrecipes/ — this image has
+no docker, so the broker lives in-process).  It exercises the exact queue
+replication machinery (QueueSource: sequencer + parsequeue + post-push
+offset commits; queue sink: serializer + key-hash partitioning) that the
+kafka provider shares.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from transferia_tpu.abstract.interfaces import Batch, Sinker
+from transferia_tpu.models.endpoint import EndpointParams, register_endpoint
+from transferia_tpu.parsers import Message
+from transferia_tpu.providers.queue_common import FetchedBatch, QueueSource
+from transferia_tpu.providers.registry import Provider, register_provider
+from transferia_tpu.serializers import make_queue_serializer
+from transferia_tpu.transform.plugins.sharder import hash_column_to_shards
+
+
+class MemoryBroker:
+    """topic -> partition -> list of (key, value, timestamp_ns)."""
+
+    def __init__(self, n_partitions: int = 1):
+        self.lock = threading.RLock()
+        self.n_partitions = n_partitions
+        self.topics: dict[str, list[list[tuple]]] = {}
+        self.committed: dict[tuple[str, str, int], int] = {}  # (group,t,p)
+
+    def _topic(self, name: str) -> list[list[tuple]]:
+        with self.lock:
+            if name not in self.topics:
+                self.topics[name] = [[] for _ in range(self.n_partitions)]
+            return self.topics[name]
+
+    def produce(self, topic: str, key: bytes, value: Optional[bytes],
+                partition: Optional[int] = None) -> None:
+        parts = self._topic(topic)
+        if partition is None:
+            partition = (hash(bytes(key or b"")) & 0x7FFFFFFF) % len(parts)
+        with self.lock:
+            parts[partition % len(parts)].append(
+                (key, value, time.time_ns())
+            )
+
+    def fetch_from(self, topic: str, partition: int, offset: int,
+                   max_messages: int) -> list[Message]:
+        parts = self._topic(topic)
+        with self.lock:
+            rows = parts[partition][offset:offset + max_messages]
+        return [
+            Message(value=v if v is not None else b"", key=k or b"",
+                    topic=topic, partition=partition, offset=offset + i,
+                    write_time_ns=ts)
+            for i, (k, v, ts) in enumerate(rows)
+        ]
+
+    def commit(self, group: str, topic: str, partition: int,
+               offset: int) -> None:
+        with self.lock:
+            self.committed[(group, topic, partition)] = offset
+
+    def committed_offset(self, group: str, topic: str,
+                         partition: int) -> int:
+        with self.lock:
+            return self.committed.get((group, topic, partition), -1)
+
+    def size(self, topic: str) -> int:
+        parts = self._topic(topic)
+        with self.lock:
+            return sum(len(p) for p in parts)
+
+
+_BROKERS: dict[str, MemoryBroker] = {}
+
+
+def get_broker(broker_id: str, n_partitions: int = 1) -> MemoryBroker:
+    if broker_id not in _BROKERS:
+        _BROKERS[broker_id] = MemoryBroker(n_partitions)
+    return _BROKERS[broker_id]
+
+
+@register_endpoint
+@dataclass
+class MQSourceParams(EndpointParams):
+    PROVIDER = "mq"
+    IS_SOURCE = True
+
+    broker_id: str = "default"
+    topic: str = "topic"
+    group: str = "transfer"
+    parser: Optional[dict] = None          # one-of parser config
+    parallelism: int = 4
+    n_partitions: int = 1
+
+    def parser_config(self):
+        return self.parser
+
+
+@register_endpoint
+@dataclass
+class MQTargetParams(EndpointParams):
+    PROVIDER = "mq"
+    IS_TARGET = True
+
+    broker_id: str = "default"
+    topic: str = ""                # empty -> per-table "<ns>.<name>"
+    serializer: str = "json"       # json | native | debezium | mirror
+    serializer_config: dict = field(default_factory=dict)
+    n_partitions: int = 1
+    partition_by: str = ""         # column for shard hashing; "" = key hash
+
+
+class _MQClient:
+    """QueueSource client over a MemoryBroker consumer group."""
+
+    def __init__(self, params: MQSourceParams):
+        self.broker = get_broker(params.broker_id, params.n_partitions)
+        self.topic = params.topic
+        self.group = params.group
+        self.positions = {
+            p: self.broker.committed_offset(self.group, self.topic, p) + 1
+            for p in range(params.n_partitions)
+        }
+
+    def fetch(self, max_messages: int = 1024) -> list[FetchedBatch]:
+        out = []
+        for p, pos in self.positions.items():
+            msgs = self.broker.fetch_from(self.topic, p, pos, max_messages)
+            if msgs:
+                self.positions[p] = msgs[-1].offset + 1
+                out.append(FetchedBatch(self.topic, p, msgs))
+        return out
+
+    def commit(self, topic: str, partition: int, offset: int) -> None:
+        self.broker.commit(self.group, topic, partition, offset)
+
+    def close(self) -> None:
+        pass
+
+
+class MQSinker(Sinker):
+    """Queue sink: serialize rows, partition by key/column hash
+    (reference kafka/sink.go + writer/)."""
+
+    def __init__(self, params: MQTargetParams):
+        self.params = params
+        self.broker = get_broker(params.broker_id, params.n_partitions)
+        self.serializer = make_queue_serializer(
+            params.serializer, **(params.serializer_config or {})
+        )
+
+    def push(self, batch: Batch) -> None:
+        from transferia_tpu.abstract.interfaces import is_columnar
+
+        pairs = self.serializer.serialize_messages(batch)
+        partitions: Optional[list[int]] = None
+        if is_columnar(batch):
+            topic = self.params.topic or str(batch.table_id)
+            if self.params.partition_by and \
+                    self.params.partition_by in batch.columns:
+                partitions = hash_column_to_shards(
+                    batch.column(self.params.partition_by),
+                    self.params.n_partitions,
+                ).tolist()
+        else:
+            rows = [it for it in batch if it.is_row_event()]
+            topic = self.params.topic or (
+                str(rows[0].table_id) if rows else "controls"
+            )
+        for i, (key, value) in enumerate(pairs):
+            self.broker.produce(
+                topic, key, value,
+                partition=partitions[i] if partitions
+                and i < len(partitions) else None,
+            )
+
+
+@register_provider
+class MQProvider(Provider):
+    NAME = "mq"
+
+    def source(self):
+        p = self.transfer.src
+        client = _MQClient(p)
+        return QueueSource(client, p.parser, parallelism=p.parallelism,
+                           metrics=self.metrics)
+
+    def sinker(self):
+        return MQSinker(self.transfer.dst)

@@ -1,0 +1,137 @@
+"""Shared queue-source machinery (reference: pkg/providers/kafka/source.go
+fetch/parse/ack loop + sequencer dedup, pkg/parsers wiring via the
+endpoint's Parseable capability).
+
+Any broker provider (in-memory mq, kafka, kinesis, eventhub) composes:
+  reader (broker client) -> Sequencer -> ParseQueue(parser) -> AsyncSink
+                               ^ commit offsets only after confirmed push
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Sequence
+
+from transferia_tpu.abstract.interfaces import AsyncSink, Source
+from transferia_tpu.parsequeue import ParseQueue
+from transferia_tpu.parsers import Message, Parser, make_parser
+from transferia_tpu.stats.registry import Metrics, SourceStats
+
+logger = logging.getLogger(__name__)
+
+
+class Sequencer:
+    """Tracks in-flight (partition, offset) ranges; yields the highest
+    offset safe to commit once pushes confirm (kafka/source.go sequencer:
+    out-of-order acks must not commit past an unacked message)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # partition -> sorted list of [offset, acked]
+        self._inflight: dict[tuple[str, int], list[list]] = {}
+
+    def start_processing(self, topic: str, partition: int,
+                         offsets: Sequence[int]) -> None:
+        with self._lock:
+            lst = self._inflight.setdefault((topic, partition), [])
+            for o in offsets:
+                lst.append([o, False])
+
+    def ack(self, topic: str, partition: int,
+            offsets: Sequence[int]) -> Optional[int]:
+        """Mark offsets done; return new committable high-water mark (the
+        largest offset with no unacked predecessors), or None."""
+        with self._lock:
+            lst = self._inflight.get((topic, partition), [])
+            offset_set = set(offsets)
+            for entry in lst:
+                if entry[0] in offset_set:
+                    entry[1] = True
+            commit = None
+            while lst and lst[0][1]:
+                commit = lst.pop(0)[0]
+            return commit
+
+
+@dataclass
+class FetchedBatch:
+    topic: str
+    partition: int
+    messages: list[Message]
+
+    def offsets(self) -> list[int]:
+        return [m.offset for m in self.messages]
+
+
+class QueueSource(Source):
+    """Generic replication source over a fetch/commit client.
+
+    client contract:
+      fetch(max_messages) -> list[FetchedBatch] (blocking up to poll timeout)
+      commit(topic, partition, offset) -> None
+      close() -> None
+    """
+
+    def __init__(self, client, parser_config, parallelism: int = 4,
+                 metrics: Optional[Metrics] = None,
+                 stop_poll: float = 0.2):
+        self.client = client
+        self.parser: Parser = make_parser(parser_config) \
+            if parser_config else make_parser({"blank": {}})
+        self.parallelism = parallelism
+        self.stats = SourceStats(metrics or Metrics())
+        self.sequencer = Sequencer()
+        self._stop = threading.Event()
+        self.stop_poll = stop_poll
+
+    def run(self, sink: AsyncSink) -> None:
+        def parse(fb: FetchedBatch):
+            t0 = time.monotonic()
+            result = self.parser.do_batch(fb.messages)
+            self.stats.decode_time.observe(time.monotonic() - t0)
+            self.stats.parsed_rows.inc(result.row_count())
+            if result.unparsed is not None:
+                self.stats.unparsed_rows.inc(result.unparsed.n_rows)
+            batches = list(result.batches)
+            if result.unparsed is not None:
+                batches.append(result.unparsed)
+            return batches
+
+        def ack(fb: FetchedBatch, err: Optional[BaseException]):
+            if err is not None:
+                return  # failure latches in the parsequeue; no commit
+            commit = self.sequencer.ack(fb.topic, fb.partition,
+                                        fb.offsets())
+            if commit is not None:
+                self.client.commit(fb.topic, fb.partition, commit)
+
+        pq = ParseQueue(self.parallelism, sink, parse, ack)
+        try:
+            while not self._stop.is_set():
+                if pq.failure is not None:
+                    raise pq.failure
+                fetched = self.client.fetch(max_messages=1024)
+                if not fetched:
+                    self._stop.wait(self.stop_poll)
+                    continue
+                for fb in fetched:
+                    self.stats.changeitems.inc(len(fb.messages))
+                    self.stats.read_bytes.inc(
+                        sum(len(m.value) for m in fb.messages)
+                    )
+                    self.sequencer.start_processing(
+                        fb.topic, fb.partition, fb.offsets()
+                    )
+                    pq.add(fb)
+            pq.wait()
+            if pq.failure is not None:
+                raise pq.failure
+        finally:
+            pq.close()
+            self.client.close()
+
+    def stop(self) -> None:
+        self._stop.set()

@@ -1,0 +1,29 @@
+"""Serializers: batches -> bytes (reference: pkg/serializer/ + queue/).
+
+Two shapes: `BatchSerializer.serialize(batch) -> bytes` for object/file
+sinks (json/csv/parquet/raw), and `QueueSerializer.serialize_messages(batch)
+-> [(key, value)]` for message-broker sinks (debezium/json/native/mirror),
+mirroring serializer/interface.go and serializer/queue/*.
+"""
+
+from transferia_tpu.serializers.formats import (
+    BatchSerializer,
+    CsvSerializer,
+    JsonSerializer,
+    ParquetSerializer,
+    QueueSerializer,
+    RawSerializer,
+    make_serializer,
+    make_queue_serializer,
+)
+
+__all__ = [
+    "BatchSerializer",
+    "CsvSerializer",
+    "JsonSerializer",
+    "ParquetSerializer",
+    "QueueSerializer",
+    "RawSerializer",
+    "make_serializer",
+    "make_queue_serializer",
+]

@@ -1,0 +1,213 @@
+"""Serializer implementations (pkg/serializer/{json,csv,parquet,raw}.go and
+serializer/queue/{debezium,json,native,mirror}*.go)."""
+
+from __future__ import annotations
+
+import abc
+import csv
+import io
+import json
+from typing import Any, Optional, Sequence
+
+from transferia_tpu.abstract.change_item import ChangeItem
+from transferia_tpu.abstract.interfaces import Batch, is_columnar
+from transferia_tpu.columnar.batch import ColumnBatch
+
+
+def _rows_of(batch: Batch) -> list[ChangeItem]:
+    if is_columnar(batch):
+        return batch.to_rows()
+    return [it for it in batch if it.is_row_event()]
+
+
+class BatchSerializer(abc.ABC):
+    """Whole-batch byte encoder (serializer/interface.go:17)."""
+
+    @abc.abstractmethod
+    def serialize(self, batch: Batch) -> bytes:
+        ...
+
+
+class JsonSerializer(BatchSerializer):
+    """JSON lines of row value maps."""
+
+    def __init__(self, add_meta: bool = False):
+        self.add_meta = add_meta
+
+    def serialize(self, batch: Batch) -> bytes:
+        buf = io.BytesIO()
+        for it in _rows_of(batch):
+            row: dict[str, Any] = it.as_dict()
+            if self.add_meta:
+                row = {"__kind": it.kind.value,
+                       "__table": str(it.table_id), **row}
+            buf.write(json.dumps(row, separators=(",", ":"),
+                                 default=_json_default).encode())
+            buf.write(b"\n")
+        return buf.getvalue()
+
+
+def _json_default(v):
+    if isinstance(v, bytes):
+        return v.decode("utf-8", errors="replace")
+    return str(v)
+
+
+class CsvSerializer(BatchSerializer):
+    """RFC-4180 CSV (pkg/csv splitter counterpart on the write side)."""
+
+    def __init__(self, header: bool = False, delimiter: str = ","):
+        self.header = header
+        self.delimiter = delimiter
+
+    def serialize(self, batch: Batch) -> bytes:
+        out = io.StringIO()
+        w = csv.writer(out, delimiter=self.delimiter, lineterminator="\n")
+        rows = _rows_of(batch)
+        if not rows:
+            return b""
+        if self.header:
+            w.writerow(rows[0].column_names)
+        for it in rows:
+            w.writerow([
+                v.decode("utf-8", "replace") if isinstance(v, bytes)
+                else ("" if v is None else v)
+                for v in it.column_values
+            ])
+        return out.getvalue().encode()
+
+
+class ParquetSerializer(BatchSerializer):
+    """Arrow-native parquet encoding — columnar batches never re-row."""
+
+    def __init__(self, compression: str = "snappy"):
+        self.compression = compression
+
+    def serialize(self, batch: Batch) -> bytes:
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        if not is_columnar(batch):
+            rows = _rows_of(batch)
+            if not rows:
+                return b""
+            batch = ColumnBatch.from_rows(rows)
+        rb = batch.to_arrow()
+        sink = io.BytesIO()
+        pq.write_table(pa.Table.from_batches([rb]), sink,
+                       compression=self.compression)
+        return sink.getvalue()
+
+
+class RawSerializer(BatchSerializer):
+    """First column's raw bytes, newline-joined (serializer/raw.go)."""
+
+    def __init__(self, column: str = "data"):
+        self.column = column
+
+    def serialize(self, batch: Batch) -> bytes:
+        out = io.BytesIO()
+        for it in _rows_of(batch):
+            v = it.value(self.column)
+            if v is None and it.column_values:
+                v = it.column_values[0]
+            if isinstance(v, str):
+                v = v.encode()
+            out.write(v or b"")
+            out.write(b"\n")
+        return out.getvalue()
+
+
+class QueueSerializer(abc.ABC):
+    """Per-row (key, value) pairs for message brokers."""
+
+    @abc.abstractmethod
+    def serialize_messages(self, batch: Batch
+                           ) -> list[tuple[bytes, Optional[bytes]]]:
+        ...
+
+
+class JsonQueueSerializer(QueueSerializer):
+    def serialize_messages(self, batch):
+        out = []
+        for it in _rows_of(batch):
+            key = json.dumps(
+                {c.name: it.value(c.name)
+                 for c in (it.table_schema.key_columns()
+                           if it.table_schema else [])},
+                separators=(",", ":"), default=_json_default,
+            ).encode()
+            value = json.dumps(it.as_dict(), separators=(",", ":"),
+                               default=_json_default).encode()
+            out.append((key, value))
+        return out
+
+
+class NativeQueueSerializer(QueueSerializer):
+    def serialize_messages(self, batch):
+        return [
+            (str(it.table_id).encode(),
+             json.dumps(it.to_json(), separators=(",", ":"),
+                        default=_json_default).encode())
+            for it in _rows_of(batch)
+        ]
+
+
+class DebeziumQueueSerializer(QueueSerializer):
+    def __init__(self, **cfg):
+        from transferia_tpu.debezium import DebeziumEmitter
+
+        self.emitter = DebeziumEmitter(**cfg)
+        self.snapshot = False
+
+    def serialize_messages(self, batch):
+        return self.emitter.emit_batch(batch, snapshot=self.snapshot)
+
+
+class MirrorQueueSerializer(QueueSerializer):
+    """Raw pass-through for queue mirroring (queue/mirror: key/data cols
+    from the blank parser's RAW_SCHEMA)."""
+
+    def serialize_messages(self, batch):
+        out = []
+        for it in _rows_of(batch):
+            key = it.value("key") or b""
+            data = it.value("data") or b""
+            if isinstance(key, str):
+                key = key.encode()
+            if isinstance(data, str):
+                data = data.encode()
+            out.append((key, data))
+        return out
+
+
+_SERIALIZERS = {
+    "json": JsonSerializer,
+    "csv": CsvSerializer,
+    "parquet": ParquetSerializer,
+    "raw": RawSerializer,
+}
+
+_QUEUE_SERIALIZERS = {
+    "json": JsonQueueSerializer,
+    "native": NativeQueueSerializer,
+    "debezium": DebeziumQueueSerializer,
+    "mirror": MirrorQueueSerializer,
+}
+
+
+def make_serializer(fmt: str, **cfg) -> BatchSerializer:
+    if fmt not in _SERIALIZERS:
+        raise KeyError(
+            f"unknown serializer {fmt!r}; known: {sorted(_SERIALIZERS)}"
+        )
+    return _SERIALIZERS[fmt](**cfg)
+
+
+def make_queue_serializer(fmt: str, **cfg) -> QueueSerializer:
+    if fmt not in _QUEUE_SERIALIZERS:
+        raise KeyError(
+            f"unknown queue serializer {fmt!r}; known: "
+            f"{sorted(_QUEUE_SERIALIZERS)}"
+        )
+    return _QUEUE_SERIALIZERS[fmt](**cfg)
