@@ -1,0 +1,6 @@
+"""Test recipes: in-process fakes of external systems.
+
+Reference parity: tests/tcrecipes/ spins real services via testcontainers;
+this image has no docker, so recipes are faithful in-process protocol fakes
+(CH HTTP server, etc.) exercising the real wire clients.
+"""

@@ -1,0 +1,212 @@
+"""In-process fake ClickHouse HTTP endpoint.
+
+Implements the subset of the HTTP interface the provider uses: query param
+parsing, CREATE/DROP/TRUNCATE TABLE, INSERT ... FORMAT RowBinary (payload
+decoded with an independent minimal decoder), SELECT count()/system
+queries with FORMAT JSON/JSONCompact.  Runs the real CHClient against real
+sockets — only the server side is fake.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import urllib.parse
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class FakeCH:
+    def __init__(self):
+        self.tables: dict[str, dict] = {}   # name -> {ddl, columns, rows}
+        self.queries: list[str] = []
+        self.lock = threading.Lock()
+        self._srv: ThreadingHTTPServer | None = None
+        self.port = 0
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "FakeCH":
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length)
+                qs = urllib.parse.parse_qs(
+                    urllib.parse.urlparse(self.path).query
+                )
+                query = (qs.get("query") or [""])[0]
+                try:
+                    out = fake.handle(query, body)
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(out)))
+                    self.end_headers()
+                    self.wfile.write(out)
+                except Exception as e:
+                    msg = str(e).encode()
+                    self.send_response(500)
+                    self.send_header("Content-Length", str(len(msg)))
+                    self.end_headers()
+                    self.wfile.write(msg)
+
+            def log_message(self, *a):
+                pass
+
+        self._srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._srv.server_port
+        threading.Thread(target=self._srv.serve_forever,
+                         daemon=True).start()
+        return self
+
+    def stop(self):
+        if self._srv:
+            self._srv.shutdown()
+
+    # -- protocol -----------------------------------------------------------
+    def handle(self, query: str, body: bytes) -> bytes:
+        with self.lock:
+            self.queries.append(query)
+        q = query.strip()
+        low = q.lower()
+        if low == "select 1":
+            return b"1\n"
+        m = re.match(r"create table if not exists `?(\w+)`?\s*\((.*)\)\s*"
+                     r"engine\s*=\s*(.*?)\s+order by", low, re.S)
+        if m:
+            name = re.match(
+                r"CREATE TABLE IF NOT EXISTS `?(\w+)`?", q, re.I
+            ).group(1)
+            cols = self._parse_ddl_cols(q)
+            with self.lock:
+                if name not in self.tables:
+                    self.tables[name] = {
+                        "ddl": q, "columns": cols, "rows": [],
+                    }
+            return b""
+        m = re.match(r"(drop|truncate) table if exists `?(\w+)`?", low)
+        if m:
+            with self.lock:
+                if m.group(1) == "drop":
+                    self.tables.pop(m.group(2), None)
+                elif m.group(2) in self.tables:
+                    self.tables[m.group(2)]["rows"] = []
+            return b""
+        m = re.match(r"insert into `?(\w+)`?\s*\((.*?)\)\s*format rowbinary",
+                     low, re.S)
+        if m:
+            name = re.match(r"INSERT INTO `?(\w+)`?", q, re.I).group(1)
+            col_names = [
+                c.strip().strip("`")
+                for c in re.search(r"\((.*?)\)", q, re.S).group(1).split(",")
+            ]
+            with self.lock:
+                table = self.tables.get(name)
+                if table is None:
+                    raise ValueError(f"Table {name} does not exist")
+                rows = _decode_rowbinary_rows(
+                    body, [table["columns"][c] for c in col_names]
+                )
+                table["rows"].extend(
+                    dict(zip(col_names, r)) for r in rows
+                )
+            return b""
+        m = re.match(r"select count\(\) from `?(\w+)`?", low)
+        if m:
+            with self.lock:
+                n = len(self.tables.get(m.group(1), {}).get("rows", []))
+            return json.dumps({"data": [[n]]}).encode()
+        if "from system.tables" in low:
+            with self.lock:
+                data = [
+                    {"name": n, "total_rows": len(t["rows"])}
+                    for n, t in self.tables.items()
+                ]
+            return json.dumps({"data": data}).encode()
+        if "from system.columns" in low:
+            m = re.search(r"table = '(\w+)'", q)
+            with self.lock:
+                t = self.tables.get(m.group(1)) if m else None
+                data = [
+                    {"name": c, "type": typ, "is_in_primary_key": 0}
+                    for c, typ in (t["columns"].items() if t else [])
+                ]
+            return json.dumps({"data": data}).encode()
+        raise ValueError(f"fake CH: unhandled query: {q[:120]}")
+
+    @staticmethod
+    def _parse_ddl_cols(ddl: str) -> dict[str, str]:
+        inner = re.search(r"\((.*)\)\s*ENGINE", ddl, re.S | re.I).group(1)
+        cols = {}
+        depth = 0
+        current = ""
+        parts = []
+        for ch in inner:
+            if ch == "(":
+                depth += 1
+            elif ch == ")":
+                depth -= 1
+            if ch == "," and depth == 0:
+                parts.append(current)
+                current = ""
+            else:
+                current += ch
+        if current.strip():
+            parts.append(current)
+        for p in parts:
+            toks = p.strip().split(None, 1)
+            cols[toks[0].strip("`")] = toks[1].strip()
+        return cols
+
+    def rows(self, table: str) -> list[dict]:
+        with self.lock:
+            return list(self.tables.get(table, {}).get("rows", []))
+
+
+# -- independent minimal RowBinary decoder (not the framework's) ------------
+
+import struct
+
+_FIXED = {
+    "Int8": ("<b", 1), "Int16": ("<h", 2), "Int32": ("<i", 4),
+    "Int64": ("<q", 8), "UInt8": ("<B", 1), "UInt16": ("<H", 2),
+    "UInt32": ("<I", 4), "UInt64": ("<Q", 8), "Float32": ("<f", 4),
+    "Float64": ("<d", 8), "Bool": ("<B", 1), "Date32": ("<i", 4),
+    "DateTime": ("<I", 4), "DateTime64(6)": ("<q", 8),
+}
+
+
+def _decode_rowbinary_rows(data: bytes, types: list[str]) -> list[list]:
+    pos = 0
+    rows = []
+    while pos < len(data):
+        row = []
+        for t in types:
+            nullable = t.startswith("Nullable(")
+            base = t[9:-1] if nullable else t
+            if nullable:
+                flag = data[pos]
+                pos += 1
+                if flag == 1:
+                    row.append(None)
+                    continue
+            if base in _FIXED:
+                fmt, w = _FIXED[base]
+                v = struct.unpack_from(fmt, data, pos)[0]
+                pos += w
+                row.append(bool(v) if base == "Bool" else v)
+            elif base == "String":
+                ln = 0
+                shift = 0
+                while True:
+                    b = data[pos]
+                    pos += 1
+                    ln |= (b & 0x7F) << shift
+                    if not b & 0x80:
+                        break
+                    shift += 7
+                row.append(data[pos:pos + ln])
+                pos += ln
+            else:
+                raise ValueError(f"fake CH decoder: type {t}")
+        rows.append(row)
+    return rows

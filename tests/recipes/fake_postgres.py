@@ -1,0 +1,383 @@
+"""In-process fake PostgreSQL server (wire protocol v3 subset).
+
+Speaks real sockets against the provider's PGConnection: startup, optional
+SCRAM-SHA-256 auth, simple queries (matched against the exact catalog/DML
+statements the provider issues — a protocol fake, not a SQL engine), and
+COPY OUT/IN streaming.
+"""
+
+from __future__ import annotations
+
+import csv
+import hashlib
+import hmac
+import io
+import re
+import socket
+import socketserver
+import struct
+import threading
+from base64 import b64decode, b64encode
+
+
+class FakeTable:
+    def __init__(self, namespace: str, name: str, columns: list[tuple],
+                 rows: list[dict] | None = None):
+        # columns: (name, pg_type, is_pk, notnull)
+        self.namespace = namespace
+        self.name = name
+        self.columns = columns
+        self.rows = rows or []
+
+
+class FakePG:
+    def __init__(self, password: str = "", scram: bool = False):
+        self.tables: dict[tuple[str, str], FakeTable] = {}
+        self.queries: list[str] = []
+        self.password = password
+        self.scram = scram
+        self.lock = threading.RLock()
+        self.port = 0
+        self._srv = None
+
+    def add_table(self, table: FakeTable) -> None:
+        with self.lock:
+            self.tables[(table.namespace, table.name)] = table
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "FakePG":
+        fake = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    _Session(self.request, fake).run()
+                except (ConnectionError, OSError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = Server(("127.0.0.1", 0), Handler)
+        self.port = self._srv.server_address[1]
+        threading.Thread(target=self._srv.serve_forever,
+                         daemon=True).start()
+        return self
+
+    def stop(self):
+        if self._srv:
+            self._srv.shutdown()
+
+
+class _Session:
+    def __init__(self, sock: socket.socket, fake: FakePG):
+        self.sock = sock
+        self.fake = fake
+
+    # -- framing ------------------------------------------------------------
+    def send(self, t: bytes, payload: bytes = b"") -> None:
+        self.sock.sendall(t + struct.pack("!I", len(payload) + 4) + payload)
+
+    def recv_exact(self, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = self.sock.recv(n - len(out))
+            if not chunk:
+                raise ConnectionError("client gone")
+            out += chunk
+        return out
+
+    def recv_msg(self) -> tuple[bytes, bytes]:
+        header = self.recv_exact(5)
+        ln = struct.unpack("!I", header[1:5])[0]
+        return header[:1], self.recv_exact(ln - 4) if ln > 4 else b""
+
+    def ready(self):
+        self.send(b"Z", b"I")
+
+    def error(self, message: str, code: str = "XX000"):
+        fields = b"SERROR\x00" + f"C{code}".encode() + b"\x00" \
+            + f"M{message}".encode() + b"\x00\x00"
+        self.send(b"E", fields)
+
+    # -- auth ---------------------------------------------------------------
+    def run(self):
+        # startup message (untyped)
+        ln = struct.unpack("!I", self.recv_exact(4))[0]
+        payload = self.recv_exact(ln - 4)
+        proto = struct.unpack("!I", payload[:4])[0]
+        if proto == 80877103:  # SSLRequest -> deny, expect retry
+            self.sock.sendall(b"N")
+            return self.run()
+        if self.fake.scram:
+            self._scram_server()
+        elif self.fake.password:
+            self.send(b"R", struct.pack("!I", 3))  # cleartext
+            t, pw = self.recv_msg()
+            if pw.rstrip(b"\x00").decode() != self.fake.password:
+                self.error("password authentication failed", "28P01")
+                return
+            self.send(b"R", struct.pack("!I", 0))
+        else:
+            self.send(b"R", struct.pack("!I", 0))
+        self.send(b"S", b"server_version\x0016.1 (fake)\x00")
+        self.send(b"K", struct.pack("!II", 4242, 0))
+        self.ready()
+        while True:
+            t, payload = self.recv_msg()
+            if t == b"X":
+                return
+            if t == b"Q":
+                self.handle_query(payload.rstrip(b"\x00").decode())
+
+    def _scram_server(self):
+        self.send(b"R", struct.pack("!I", 10) + b"SCRAM-SHA-256\x00\x00")
+        t, payload = self.recv_msg()
+        # SASLInitialResponse: mech\0 int32 len, body
+        mech_end = payload.index(b"\x00")
+        body = payload[mech_end + 5:].decode()
+        client_first_bare = body.split(",", 2)[2]
+        client_nonce = dict(
+            p.split("=", 1) for p in client_first_bare.split(",")
+        )["r"]
+        salt = b"saltsalt"
+        iterations = 4096
+        server_nonce = client_nonce + "srv"
+        server_first = (
+            f"r={server_nonce},s={b64encode(salt).decode()},i={iterations}"
+        )
+        self.send(b"R", struct.pack("!I", 11) + server_first.encode())
+        t, payload = self.recv_msg()
+        client_final = payload.decode()
+        parts = dict(p.split("=", 1) for p in client_final.split(",", 2)
+                     if "=" in p)
+        salted = hashlib.pbkdf2_hmac(
+            "sha256", self.fake.password.encode(), salt, iterations
+        )
+        client_key = hmac.new(salted, b"Client Key", hashlib.sha256).digest()
+        stored_key = hashlib.sha256(client_key).digest()
+        without_proof = client_final.rsplit(",p=", 1)[0]
+        auth_message = ",".join([
+            client_first_bare, server_first, without_proof,
+        ])
+        client_sig = hmac.new(stored_key, auth_message.encode(),
+                              hashlib.sha256).digest()
+        expect_proof = b64encode(bytes(
+            a ^ b for a, b in zip(client_key, client_sig)
+        )).decode()
+        if parts.get("p") != expect_proof:
+            self.error("SCRAM authentication failed", "28P01")
+            raise ConnectionError("bad scram")
+        server_key = hmac.new(salted, b"Server Key",
+                              hashlib.sha256).digest()
+        server_sig = hmac.new(server_key, auth_message.encode(),
+                              hashlib.sha256).digest()
+        final = f"v={b64encode(server_sig).decode()}"
+        self.send(b"R", struct.pack("!I", 12) + final.encode())
+        self.send(b"R", struct.pack("!I", 0))
+
+    # -- query dispatch -----------------------------------------------------
+    def send_rows(self, columns: list[str], rows: list[list]):
+        desc = struct.pack("!H", len(columns))
+        for c in columns:
+            desc += c.encode() + b"\x00" + struct.pack(
+                "!IhIhih", 0, 0, 25, -1, -1, 0
+            )
+        self.send(b"T", desc)
+        for row in rows:
+            payload = struct.pack("!H", len(row))
+            for v in row:
+                if v is None:
+                    payload += struct.pack("!i", -1)
+                else:
+                    b = str(v).encode()
+                    payload += struct.pack("!i", len(b)) + b
+            self.send(b"D", payload)
+        self.send(b"C", b"SELECT\x00")
+
+    def handle_query(self, sql: str):
+        with self.fake.lock:
+            self.fake.queries.append(sql)
+        try:
+            self.dispatch(sql)
+        except Exception as e:
+            self.error(str(e))
+        self.ready()
+
+    def dispatch(self, sql: str):
+        low = " ".join(sql.lower().split())
+        fake = self.fake
+        if low == "select 1":
+            return self.send_rows(["?column?"], [[1]])
+        if "from pg_class c join pg_namespace" in low:
+            rows = [
+                [t.namespace, t.name, len(t.rows)]
+                for t in fake.tables.values()
+            ]
+            return self.send_rows(["ns", "name", "eta"], rows)
+        if "from pg_attribute" in low:
+            m = re.search(r"'\"?([\w]+)\"?\.\"?([\w]+)\"?'::regclass", sql)
+            t = fake.tables.get((m.group(1), m.group(2))) if m else None
+            if t is None:
+                raise ValueError("relation does not exist")
+            rows = [
+                [name, typ, "t" if notnull else "f", "t" if pk else "f"]
+                for (name, typ, pk, notnull) in t.columns
+            ]
+            return self.send_rows(["name", "typ", "notnull", "is_pk"], rows)
+        m = re.match(r"select count\(\*\) from \"?(\w+)\"?\.\"?(\w+)\"?",
+                     low)
+        if m:
+            t = fake.tables.get((m.group(1), m.group(2)))
+            return self.send_rows(["count"], [[len(t.rows) if t else 0]])
+        if "pg_current_wal_lsn" in low:
+            return self.send_rows(["lsn"], [["0/ABCDEF0"]])
+        if "pg_relation_size" in low:
+            m = re.search(r"'\"?(\w+)\"?\.\"?(\w+)\"?'", sql)
+            t = fake.tables.get((m.group(1), m.group(2))) if m else None
+            size = len(t.rows) * 100 if t else 0
+            return self.send_rows(["size"], [[size]])
+        if "relpages" in low:
+            return self.send_rows(["relpages"], [[1]])
+        if low.startswith("copy (select") and "to stdout" in low:
+            return self.copy_out(sql)
+        if low.startswith("copy ") and "from stdin" in low:
+            return self.copy_in(sql)
+        if low.startswith(("create ", "drop ", "truncate ")):
+            self.apply_ddl(sql)
+            return self.send(b"C", b"OK\x00")
+        if low.startswith(("insert ", "update ", "delete ")):
+            self.apply_dml(sql)
+            return self.send(b"C", b"OK\x00")
+        raise ValueError(f"fake PG: unhandled query: {sql[:120]}")
+
+    # -- COPY ---------------------------------------------------------------
+    def copy_out(self, sql: str):
+        m = re.search(r"FROM \"?(\w+)\"?\.\"?(\w+)\"?", sql)
+        t = self.fake.tables.get((m.group(1), m.group(2))) if m else None
+        if t is None:
+            raise ValueError("relation does not exist")
+        cols = [c[0] for c in t.columns]
+        m2 = re.search(r"SELECT (.*?) FROM", sql, re.S)
+        if m2 and m2.group(1).strip() != "*":
+            cols = [c.strip().strip('"') for c in m2.group(1).split(",")]
+        self.send(b"H", struct.pack("!bh", 0, 0))
+        for row in t.rows:
+            out = io.StringIO()
+            csv.writer(out, lineterminator="\n").writerow(
+                ["" if row.get(c) is None else row.get(c) for c in cols]
+            )
+            self.send(b"d", out.getvalue().encode())
+        self.send(b"c")
+        self.send(b"C", b"COPY\x00")
+
+    def copy_in(self, sql: str):
+        m = re.search(r"COPY \"?(\w+)\"?\.\"?(\w+)\"? \((.*?)\)", sql)
+        t = self.fake.tables.get((m.group(1), m.group(2))) if m else None
+        if t is None:
+            raise ValueError("relation does not exist")
+        cols = [c.strip().strip('"') for c in m.group(3).split(",")]
+        self.send(b"G", struct.pack("!bh", 0, 0))
+        data = b""
+        while True:
+            mt, payload = self.recv_msg()
+            if mt == b"d":
+                data += payload
+            elif mt in (b"c", b"f"):
+                break
+        reader = csv.reader(io.StringIO(data.decode()))
+        with self.fake.lock:
+            for row in reader:
+                t.rows.append({
+                    c: (None if v == "" else v) for c, v in zip(cols, row)
+                })
+        self.send(b"C", b"COPY\x00")
+
+    # -- naive DDL/DML ------------------------------------------------------
+    def apply_ddl(self, sql: str):
+        low = sql.lower()
+        fake = self.fake
+        m = re.match(r'create table if not exists "?(\w+)"?\."?(\w+)"?\s*'
+                     r"\((.*)\)", sql, re.I | re.S)
+        if m:
+            ns, name, body = m.group(1), m.group(2), m.group(3)
+            if (ns, name) not in fake.tables:
+                cols = []
+                pk_cols = set()
+                pkm = re.search(r"PRIMARY KEY \((.*?)\)", body)
+                if pkm:
+                    pk_cols = {c.strip().strip('"')
+                               for c in pkm.group(1).split(",")}
+                    body = body[:pkm.start()].rstrip(", \n")
+                for part in body.split(","):
+                    toks = part.strip().split(None, 1)
+                    if not toks or toks[0].upper() == "PRIMARY":
+                        continue
+                    cname = toks[0].strip('"')
+                    ctype = toks[1].replace(" NOT NULL", "") \
+                        if len(toks) > 1 else "text"
+                    cols.append((cname, ctype.strip(), cname in pk_cols,
+                                 "NOT NULL" in (toks[1] if len(toks) > 1
+                                                else "")))
+                fake.add_table(FakeTable(ns, name, cols))
+            return
+        m = re.match(r'drop table if exists "?(\w+)"?\."?(\w+)"?', sql, re.I)
+        if m:
+            fake.tables.pop((m.group(1), m.group(2)), None)
+            return
+        m = re.match(r'truncate table "?(\w+)"?\."?(\w+)"?', sql, re.I)
+        if m:
+            t = fake.tables.get((m.group(1), m.group(2)))
+            if t is None:
+                raise ValueError(
+                    f'relation "{m.group(1)}.{m.group(2)}" does not exist'
+                )
+            t.rows = []
+            return
+        # create schema etc: no-op
+
+    def apply_dml(self, sql: str):
+        fake = self.fake
+        m = re.match(r'insert into "?(\w+)"?\."?(\w+)"? \((.*?)\) '
+                     r"values \((.*)\)", sql, re.I | re.S)
+        if m:
+            t = fake.tables.get((m.group(1), m.group(2)))
+            if t is None:
+                raise ValueError("relation does not exist")
+            cols = [c.strip().strip('"') for c in m.group(3).split(",")]
+            vals = [v.strip().strip("'")
+                    for v in re.split(r",(?=(?:[^']*'[^']*')*[^']*$)",
+                                      m.group(4).split(" ON CONFLICT")[0])]
+            t.rows.append(dict(zip(cols, vals)))
+            return
+        m = re.match(r'delete from "?(\w+)"?\."?(\w+)"? where (.*)', sql,
+                     re.I | re.S)
+        if m:
+            t = fake.tables.get((m.group(1), m.group(2)))
+            cond = self._parse_where(m.group(3))
+            t.rows = [
+                r for r in t.rows
+                if not all(str(r.get(k)) == v for k, v in cond.items())
+            ]
+            return
+        m = re.match(r'update "?(\w+)"?\."?(\w+)"? set (.*) where (.*)',
+                     sql, re.I | re.S)
+        if m:
+            t = fake.tables.get((m.group(1), m.group(2)))
+            sets = self._parse_where(m.group(3), sep=",")
+            cond = self._parse_where(m.group(4))
+            for r in t.rows:
+                if all(str(r.get(k)) == v for k, v in cond.items()):
+                    r.update(sets)
+            return
+
+    @staticmethod
+    def _parse_where(text: str, sep: str = "AND") -> dict:
+        out = {}
+        parts = text.split(sep if sep == "," else " AND ")
+        for p in parts:
+            if "=" in p:
+                k, v = p.split("=", 1)
+                out[k.strip().strip('"')] = v.strip().strip("'")
+        return out

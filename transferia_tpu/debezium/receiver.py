@@ -58,16 +58,21 @@ class DebeziumReceiver:
         keys = set()
         if key_schema:
             keys = {f["field"] for f in key_schema.get("fields", [])}
-        name = after.get("name", "")
-        cached = self._schema_cache.get(name) if name else None
+        # cache key covers the full field list + key set, not just the table
+        # name — upstream ALTERs change the schema block under the same
+        # <prefix>.<table>.Value name and must invalidate the cache
+        cache_key = json.dumps(
+            [after.get("name", ""), after.get("fields", []), sorted(keys)],
+            sort_keys=True, default=str,
+        )
+        cached = self._schema_cache.get(cache_key)
         if cached is not None:
             return cached
         schema = TableSchema([
             self._connect_to_colschema(f, keys)
             for f in after.get("fields", [])
         ])
-        if name:
-            self._schema_cache[name] = schema
+        self._schema_cache[cache_key] = schema
         return schema
 
     @staticmethod

@@ -68,9 +68,11 @@ def encode_value(ctype: CanonicalType, v: Any) -> Any:
 
         raw = v if isinstance(v, bytes) else str(v).encode()
         return base64.b64encode(raw).decode()
-    if ctype == CanonicalType.ANY and not isinstance(v, str):
+    if ctype == CanonicalType.ANY:
         import json
 
+        # strings are json-encoded too ('123' -> '"123"'): decode_value
+        # json.loads every ANY payload, so the pair must be symmetric
         return json.dumps(v, separators=(",", ":"), default=str)
     return v
 
@@ -94,5 +96,6 @@ def decode_value(ctype: CanonicalType, v: Any) -> Any:
         try:
             return json.loads(v)
         except ValueError:
+            # legacy/foreign producers may emit bare strings
             return v
     return v

@@ -36,11 +36,6 @@ class ParseQueue(Generic[T]):
         self._pool = concurrent.futures.ThreadPoolExecutor(
             max_workers=max(1, parallelism), thread_name_prefix="parse"
         )
-        self._order: "concurrent.futures.Future[None]" = \
-            concurrent.futures.Future()
-        self._order.set_result(None)
-        self._lock = threading.Lock()
-        self._pending: list[tuple] = []  # (raw, parse_future)
         self._pusher = threading.Thread(
             target=self._push_loop, name="parsequeue-push", daemon=True
         )
@@ -93,18 +88,22 @@ class ParseQueue(Generic[T]):
                         return
                     continue
                 raw, parse_fut = self._queue.pop(0)
-            err: Optional[BaseException] = None
-            try:
-                parsed = parse_fut.result()
-                batches = parsed if isinstance(parsed, list) else [parsed]
-                futs = []
-                for b in batches:
-                    if b is not None and _batch_len(b):
-                        futs.append(self.sink.async_push(b))
-                for f in futs:
-                    f.result()
-            except BaseException as e:
-                err = e
+            err: Optional[BaseException] = self._failure
+            if err is None:
+                # once failed, drain without pushing — pushing N+1 after N
+                # failed would break the in-order delivery contract
+                try:
+                    parsed = parse_fut.result()
+                    batches = parsed if isinstance(parsed, list) \
+                        else [parsed]
+                    futs = []
+                    for b in batches:
+                        if b is not None and _batch_len(b):
+                            futs.append(self.sink.async_push(b))
+                    for f in futs:
+                        f.result()
+                except BaseException as e:
+                    err = e
             try:
                 self.ack_fn(raw, err)
             except BaseException as ack_err:

@@ -276,15 +276,22 @@ class TskvParser(GenericJsonParser):
                 if text.startswith("tskv\t"):
                     text = text[5:]
                 row: dict[str, Any] = {}
+                import re as _re
+
+                unescape = {"t": "\t", "n": "\n", "r": "\r", "0": "\0",
+                            "\\": "\\", "=": "="}
                 for pair in text.split("\t"):
                     if not pair:
                         continue
                     if "=" not in pair:
                         raise ValueError(f"no '=' in {pair!r}")
                     k, v = pair.split("=", 1)
-                    row[k] = (
-                        v.replace("\\t", "\t").replace("\\n", "\n")
-                        .replace("\\\\", "\\")
+                    # single-pass unescape: sequential .replace corrupts
+                    # escaped backslashes followed by t/n
+                    row[k] = _re.sub(
+                        r"\\(.)",
+                        lambda m: unescape.get(m.group(1), m.group(1)),
+                        v,
                     )
                 out.append(row if row else None)
             except (ValueError, UnicodeDecodeError):

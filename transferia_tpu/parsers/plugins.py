@@ -287,17 +287,37 @@ class ConfluentSRParser(Parser):
 
     def __init__(self, table: str = "data", namespace: str = "",
                  resolver: Optional[object] = None):
-        self.inner = GenericJsonParser(table=table, namespace=namespace)
+        self.table = table
+        self.namespace = namespace
+        # resolver: callable(schema_id) -> field-spec list (the generic
+        # parser's `schema` config) or None; absent/None falls back to
+        # schema inference
         self.resolver = resolver
+        self._parsers: dict[int, GenericJsonParser] = {}
+
+    def _parser_for(self, schema_id: int) -> GenericJsonParser:
+        p = self._parsers.get(schema_id)
+        if p is None:
+            fields = None
+            if self.resolver is not None:
+                fields = self.resolver(schema_id)
+            p = GenericJsonParser(schema=fields, table=self.table,
+                                  namespace=self.namespace)
+            self._parsers[schema_id] = p
+        return p
 
     def do_batch(self, messages: Sequence[Message]) -> ParseResult:
-        stripped, bad, reasons = [], [], []
+        import struct
+
+        by_schema: dict[int, list[Message]] = {}
+        bad, reasons = [], []
         for m in messages:
             v = m.value
             if len(v) >= 5 and v[0] == 0:
+                schema_id = struct.unpack(">I", v[1:5])[0]
                 payload = v[5:]
                 if payload[:1] in (b"{", b"["):
-                    stripped.append(Message(
+                    by_schema.setdefault(schema_id, []).append(Message(
                         value=payload, key=m.key, topic=m.topic,
                         partition=m.partition, offset=m.offset,
                         write_time_ns=m.write_time_ns,
@@ -310,7 +330,14 @@ class ConfluentSRParser(Parser):
             else:
                 bad.append(m)
                 reasons.append("confluent-sr: missing magic byte")
-        result = self.inner.do_batch(stripped) if stripped else ParseResult()
+        result = ParseResult()
+        for schema_id, msgs in by_schema.items():
+            sub = self._parser_for(schema_id).do_batch(msgs)
+            result.batches.extend(sub.batches)
+            if sub.unparsed is not None:
+                result.unparsed = sub.unparsed \
+                    if result.unparsed is None else \
+                    ColumnBatch.concat([result.unparsed, sub.unparsed])
         if bad:
             ub = unparsed_batch(bad, reasons)
             result.unparsed = ub if result.unparsed is None else \

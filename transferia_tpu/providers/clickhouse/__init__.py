@@ -1,0 +1,17 @@
+"""ClickHouse provider — the primary analytics target.
+
+Reference parity: pkg/providers/clickhouse/ (sink.go sharded fan-out,
+async/marshaller.go RowBinary encoding, schema/ DDL builder, conn/
+HTTP interface).  Re-designed columnar: batches encode to RowBinary with
+vectorized per-column scatters (no per-row loop — the reference's
+marshaller is its CPU hot loop #3), and shard fan-out reuses the
+hash_column_to_shards kernel.
+"""
+
+from transferia_tpu.providers.clickhouse.provider import (
+    CHSourceParams,
+    CHTargetParams,
+    ClickHouseProvider,
+)
+
+__all__ = ["CHSourceParams", "CHTargetParams", "ClickHouseProvider"]

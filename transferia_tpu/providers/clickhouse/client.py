@@ -1,0 +1,112 @@
+"""ClickHouse HTTP interface client (reference: providers/clickhouse/conn/).
+
+Pure stdlib http.client: POST queries, stream INSERT bodies, basic auth,
+per-query settings.  The HTTP interface (port 8123) is the most portable CH
+surface and keeps the client dependency-free.
+"""
+
+from __future__ import annotations
+
+import http.client
+import logging
+import urllib.parse
+from typing import Iterator, Optional
+
+from transferia_tpu.abstract.errors import CategorizedError
+
+logger = logging.getLogger(__name__)
+
+
+class CHError(CategorizedError):
+    def __init__(self, message: str, code: Optional[int] = None):
+        super().__init__(CategorizedError.TARGET, message)
+        self.code = code
+
+
+class CHClient:
+    def __init__(self, host: str = "localhost", port: int = 8123,
+                 database: str = "default", user: str = "default",
+                 password: str = "", secure: bool = False,
+                 timeout: float = 300.0,
+                 settings: Optional[dict] = None):
+        self.host = host
+        self.port = port
+        self.database = database
+        self.user = user
+        self.password = password
+        self.secure = secure
+        self.timeout = timeout
+        self.settings = settings or {}
+
+    def _connect(self) -> http.client.HTTPConnection:
+        cls = http.client.HTTPSConnection if self.secure \
+            else http.client.HTTPConnection
+        return cls(self.host, self.port, timeout=self.timeout)
+
+    def _params(self, query: str, extra: Optional[dict] = None) -> str:
+        params = {
+            "database": self.database,
+            "query": query,
+            **{f"{k}": str(v) for k, v in self.settings.items()},
+            **(extra or {}),
+        }
+        return urllib.parse.urlencode(params)
+
+    def execute(self, query: str, body: bytes = b"",
+                extra_params: Optional[dict] = None) -> bytes:
+        """Run a query; body carries INSERT payload bytes."""
+        conn = self._connect()
+        try:
+            headers = {"Content-Type": "application/octet-stream"}
+            if self.user:
+                import base64
+
+                cred = base64.b64encode(
+                    f"{self.user}:{self.password}".encode()
+                ).decode()
+                headers["Authorization"] = f"Basic {cred}"
+            conn.request(
+                "POST", "/?" + self._params(query, extra_params),
+                body=body, headers=headers,
+            )
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status != 200:
+                raise CHError(
+                    f"clickhouse HTTP {resp.status}: "
+                    f"{data[:500].decode('utf-8', 'replace')}",
+                    code=resp.status,
+                )
+            return data
+        except (ConnectionError, OSError, http.client.HTTPException) as e:
+            raise CHError(f"clickhouse connection failed: {e}") from e
+        finally:
+            conn.close()
+
+    def ping(self) -> None:
+        out = self.execute("SELECT 1")
+        if out.strip() != b"1":
+            raise CHError(f"unexpected ping response {out[:50]!r}")
+
+    def insert_rowbinary(self, table: str, columns: list[str],
+                         payload: bytes) -> None:
+        cols = ", ".join(f"`{c}`" for c in columns)
+        self.execute(
+            f"INSERT INTO {table} ({cols}) FORMAT RowBinary", payload
+        )
+
+    def query_json(self, query: str) -> list[dict]:
+        import json
+
+        raw = self.execute(query + " FORMAT JSON")
+        return json.loads(raw).get("data", [])
+
+    def query_rows(self, query: str) -> list[list]:
+        import json
+
+        raw = self.execute(query + " FORMAT JSONCompact")
+        return json.loads(raw).get("data", [])
+
+    def scalar(self, query: str):
+        rows = self.query_rows(query)
+        return rows[0][0] if rows and rows[0] else None

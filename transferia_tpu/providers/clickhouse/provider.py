@@ -1,0 +1,348 @@
+"""ClickHouse provider: sharded sink, snapshot storage, DDL builder.
+
+Reference parity: providers/clickhouse/sink.go:24-100 (sharder -> per-shard
+lazy sinks), schema/ (DDL from canonical types), storage (SELECT-based
+snapshot).  Typesystem target rules registered for "ch".
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+import numpy as np
+
+from transferia_tpu.abstract.interfaces import (
+    Batch,
+    Pusher,
+    Sinker,
+    Storage,
+    TableInfo,
+    is_columnar,
+)
+from transferia_tpu.abstract.kinds import Kind
+from transferia_tpu.abstract.schema import (
+    CanonicalType,
+    ColSchema,
+    TableID,
+    TableSchema,
+)
+from transferia_tpu.abstract.table import TableDescription
+from transferia_tpu.columnar.batch import ColumnBatch
+from transferia_tpu.models.endpoint import (
+    CleanupPolicy,
+    EndpointParams,
+    register_endpoint,
+)
+from transferia_tpu.providers.clickhouse.client import CHClient
+from transferia_tpu.providers.clickhouse.rowbinary import (
+    decode_rowbinary,
+    encode_rowbinary,
+)
+from transferia_tpu.providers.registry import (
+    Provider,
+    TestResult,
+    register_provider,
+)
+from transferia_tpu.transform.plugins.sharder import hash_column_to_shards
+from transferia_tpu.typesystem.rules import (
+    register_source_rules,
+    register_target_rules,
+)
+
+logger = logging.getLogger(__name__)
+
+register_target_rules("ch", {
+    CanonicalType.INT8: "Int8", CanonicalType.INT16: "Int16",
+    CanonicalType.INT32: "Int32", CanonicalType.INT64: "Int64",
+    CanonicalType.UINT8: "UInt8", CanonicalType.UINT16: "UInt16",
+    CanonicalType.UINT32: "UInt32", CanonicalType.UINT64: "UInt64",
+    CanonicalType.FLOAT: "Float32", CanonicalType.DOUBLE: "Float64",
+    CanonicalType.BOOLEAN: "Bool", CanonicalType.STRING: "String",
+    CanonicalType.UTF8: "String", CanonicalType.DATE: "Date32",
+    CanonicalType.DATETIME: "DateTime",
+    CanonicalType.TIMESTAMP: "DateTime64(6)",
+    CanonicalType.INTERVAL: "Int64", CanonicalType.DECIMAL: "String",
+    CanonicalType.ANY: "String",
+})
+
+register_source_rules("ch", {
+    "int8": CanonicalType.INT8, "int16": CanonicalType.INT16,
+    "int32": CanonicalType.INT32, "int64": CanonicalType.INT64,
+    "uint8": CanonicalType.UINT8, "uint16": CanonicalType.UINT16,
+    "uint32": CanonicalType.UINT32, "uint64": CanonicalType.UINT64,
+    "float32": CanonicalType.FLOAT, "float64": CanonicalType.DOUBLE,
+    "bool": CanonicalType.BOOLEAN, "string": CanonicalType.STRING,
+    "date": CanonicalType.DATE, "date32": CanonicalType.DATE,
+    "datetime": CanonicalType.DATETIME,
+    "datetime64": CanonicalType.TIMESTAMP,
+    "*": CanonicalType.ANY,
+})
+
+
+@dataclass
+class CHShard:
+    name: str
+    hosts: list[str] = field(default_factory=list)
+
+
+@register_endpoint
+@dataclass
+class CHTargetParams(EndpointParams):
+    PROVIDER = "ch"
+    IS_TARGET = True
+
+    host: str = "localhost"
+    port: int = 8123
+    database: str = "default"
+    user: str = "default"
+    password: str = ""
+    secure: bool = False
+    shards: dict = field(default_factory=dict)   # name -> [host:port,...]
+    shard_by: str = ""                           # column; "" = first PK
+    engine: str = ""                             # override table engine
+    insert_settings: dict = field(default_factory=dict)
+    is_shardeable: bool = True
+    bufferer: Optional[dict] = field(
+        default_factory=lambda: {"trigger_rows": 100_000,
+                                 "trigger_interval": 1.0}
+    )
+
+    def bufferer_config(self):
+        return self.bufferer
+
+    def shard_list(self) -> list[CHShard]:
+        if not self.shards:
+            return [CHShard("default", [f"{self.host}:{self.port}"])]
+        return [CHShard(n, list(h)) for n, h in self.shards.items()]
+
+
+@register_endpoint
+@dataclass
+class CHSourceParams(EndpointParams):
+    PROVIDER = "ch"
+    IS_SOURCE = True
+
+    host: str = "localhost"
+    port: int = 8123
+    database: str = "default"
+    user: str = "default"
+    password: str = ""
+    secure: bool = False
+    batch_rows: int = 131_072
+
+
+def ddl_for_schema(table: TableID, schema: TableSchema,
+                   engine: str = "") -> str:
+    """CREATE TABLE DDL from canonical schema (clickhouse/schema/)."""
+    from transferia_tpu.typesystem.rules import map_target_type
+
+    cols = []
+    for c in schema:
+        ch_type = map_target_type("ch", c.data_type)
+        if not c.required and not c.primary_key:
+            ch_type = f"Nullable({ch_type})"
+        cols.append(f"`{c.name}` {ch_type}")
+    keys = [f"`{c.name}`" for c in schema.key_columns()]
+    order = ", ".join(keys) if keys else "tuple()"
+    eng = engine or "MergeTree()"
+    name = f"`{table.name}`" if not table.namespace \
+        else f"`{table.namespace}__{table.name}`"
+    return (
+        f"CREATE TABLE IF NOT EXISTS {name} ({', '.join(cols)}) "
+        f"ENGINE = {eng} ORDER BY ({order})"
+    )
+
+
+def ch_table_name(table: TableID) -> str:
+    return table.name if not table.namespace \
+        else f"{table.namespace}__{table.name}"
+
+
+class CHSinker(Sinker):
+    """Sharded insert sink (sink.go:24-100): rows fan out to shards by key
+    hash; per-shard clients are lazy.  Deletes/updates collapse into
+    ReplacingMergeTree semantics upstream (collapse middleware) — the sink
+    itself inserts."""
+
+    def __init__(self, params: CHTargetParams):
+        self.params = params
+        self.shards = params.shard_list()
+        self._clients: dict[int, CHClient] = {}
+        self._created: set[str] = set()
+
+    def _client(self, shard_idx: int) -> CHClient:
+        if shard_idx not in self._clients:
+            host = self.shards[shard_idx].hosts[0]
+            h, _, p = host.partition(":")
+            self._clients[shard_idx] = CHClient(
+                host=h, port=int(p or 8123),
+                database=self.params.database, user=self.params.user,
+                password=self.params.password, secure=self.params.secure,
+                settings=self.params.insert_settings,
+            )
+        return self._clients[shard_idx]
+
+    def _ensure_table(self, shard_idx: int, batch: ColumnBatch) -> None:
+        name = ch_table_name(batch.table_id)
+        key = f"{shard_idx}/{name}"
+        if key in self._created:
+            return
+        ddl = ddl_for_schema(batch.table_id, batch.schema,
+                             self.params.engine)
+        self._client(shard_idx).execute(ddl)
+        self._created.add(key)
+
+    def _shard_of(self, batch: ColumnBatch) -> np.ndarray:
+        n_shards = len(self.shards)
+        if n_shards == 1:
+            return np.zeros(batch.n_rows, dtype=np.int32)
+        col_name = self.params.shard_by
+        if not col_name:
+            keys = batch.schema.key_columns()
+            col_name = keys[0].name if keys else next(iter(batch.columns))
+        return hash_column_to_shards(batch.column(col_name), n_shards)
+
+    def push(self, batch: Batch) -> None:
+        if not is_columnar(batch):
+            rows = [it for it in batch if it.is_row_event()]
+            for it in batch:
+                if it.kind in (Kind.TRUNCATE, Kind.DROP):
+                    self._apply_cleanup(it.table_id, it.kind)
+            if not rows:
+                return
+            batch = ColumnBatch.from_rows(rows)
+        if batch.kinds is not None:
+            raise ValueError(
+                "CH sink is insert-only; collapse updates/deletes upstream "
+                "or use a ReplacingMergeTree flow with version columns"
+            )
+        shards = self._shard_of(batch)
+        nullable = {
+            c.name: (not c.required and not c.primary_key)
+            for c in batch.schema
+        }
+        for shard_idx in np.unique(shards):
+            part = batch.filter(shards == shard_idx) \
+                if len(self.shards) > 1 else batch
+            self._ensure_table(int(shard_idx), part)
+            payload = encode_rowbinary(part, nullable)
+            self._client(int(shard_idx)).insert_rowbinary(
+                ch_table_name(part.table_id), list(part.columns), payload
+            )
+
+    def _apply_cleanup(self, table: TableID, kind: Kind) -> None:
+        stmt = "TRUNCATE TABLE IF EXISTS" if kind == Kind.TRUNCATE \
+            else "DROP TABLE IF EXISTS"
+        for i in range(len(self.shards)):
+            self._client(i).execute(f"{stmt} `{ch_table_name(table)}`")
+
+
+class CHStorage(Storage):
+    """Snapshot source over SELECT (storage + storage_sharding.go)."""
+
+    def __init__(self, params: CHSourceParams):
+        self.params = params
+        self.client = CHClient(
+            host=params.host, port=params.port, database=params.database,
+            user=params.user, password=params.password,
+            secure=params.secure,
+        )
+
+    def table_list(self, include=None):
+        rows = self.client.query_json(
+            f"SELECT name, total_rows FROM system.tables "
+            f"WHERE database = '{self.params.database}'"
+        )
+        out = {}
+        for r in rows:
+            tid = TableID(self.params.database, r["name"])
+            if include and not any(tid.include_matches(p) for p in include):
+                continue
+            out[tid] = TableInfo(eta_rows=int(r.get("total_rows") or 0))
+        return out
+
+    def table_schema(self, table: TableID) -> TableSchema:
+        from transferia_tpu.typesystem.rules import map_source_type
+
+        rows = self.client.query_json(
+            f"SELECT name, type, is_in_primary_key FROM system.columns "
+            f"WHERE database = '{self.params.database}' "
+            f"AND table = '{table.name}'"
+        )
+        cols = []
+        for r in rows:
+            ch_type = r["type"]
+            nullable = ch_type.startswith("Nullable(")
+            base = ch_type[9:-1] if nullable else ch_type
+            cols.append(ColSchema(
+                name=r["name"],
+                data_type=map_source_type("ch", base.lower()),
+                primary_key=bool(int(r.get("is_in_primary_key") or 0)),
+                required=not nullable,
+                original_type=f"ch:{ch_type}",
+            ))
+        return TableSchema(cols)
+
+    def exact_table_rows_count(self, table: TableID) -> int:
+        return int(self.client.scalar(
+            f"SELECT count() FROM `{table.name}`"
+        ) or 0)
+
+    def estimate_table_rows_count(self, table: TableID) -> int:
+        return self.exact_table_rows_count(table)
+
+    def load_table(self, table: TableDescription, pusher: Pusher) -> None:
+        schema = self.table_schema(table.id)
+        nullable = {c.name: not c.required for c in schema}
+        cols = ", ".join(f"`{c.name}`" for c in schema)
+        where = f" WHERE {table.filter}" if table.filter else ""
+        raw = self.client.execute(
+            f"SELECT {cols} FROM `{table.id.name}`{where} FORMAT RowBinary"
+        )
+        if raw:
+            batch = decode_rowbinary(raw, schema, nullable)
+            out = ColumnBatch(table.id, schema, batch.columns)
+            out.read_bytes = len(raw)
+            pusher(out)
+
+    def ping(self) -> None:
+        self.client.ping()
+
+
+@register_provider
+class ClickHouseProvider(Provider):
+    NAME = "ch"
+
+    def storage(self):
+        if isinstance(self.transfer.src, CHSourceParams):
+            return CHStorage(self.transfer.src)
+        return None
+
+    def sinker(self):
+        if isinstance(self.transfer.dst, CHTargetParams):
+            return CHSinker(self.transfer.dst)
+        return None
+
+    def cleanup(self, tables: list) -> None:
+        params = self.transfer.dst
+        sinker = CHSinker(params)
+        kind = Kind.DROP if params.cleanup_policy == CleanupPolicy.DROP \
+            else Kind.TRUNCATE
+        for td in tables or []:
+            tid = td.id if hasattr(td, "id") else td
+            sinker._apply_cleanup(tid, kind)
+
+    def test(self) -> TestResult:
+        result = TestResult(ok=True)
+        params = self.transfer.dst or self.transfer.src
+        client = CHClient(host=params.host, port=params.port,
+                          database=params.database, user=params.user,
+                          password=params.password, secure=params.secure)
+        try:
+            client.ping()
+            result.add("ping")
+        except Exception as e:
+            result.add("ping", e)
+        return result

@@ -163,8 +163,13 @@ class MQSinker(Sinker):
         partitions: Optional[list[int]] = None
         if is_columnar(batch):
             topic = self.params.topic or str(batch.table_id)
+            # column-hash partitioning is only sound when pairs align 1:1
+            # with rows; serializers may expand rows (e.g. debezium
+            # tombstones), in which case fall back to key-hash so a
+            # tombstone always lands in its delete's partition
             if self.params.partition_by and \
-                    self.params.partition_by in batch.columns:
+                    self.params.partition_by in batch.columns and \
+                    len(pairs) == batch.n_rows:
                 partitions = hash_column_to_shards(
                     batch.column(self.params.partition_by),
                     self.params.n_partitions,
@@ -177,8 +182,7 @@ class MQSinker(Sinker):
         for i, (key, value) in enumerate(pairs):
             self.broker.produce(
                 topic, key, value,
-                partition=partitions[i] if partitions
-                and i < len(partitions) else None,
+                partition=partitions[i] if partitions is not None else None,
             )
 
 

@@ -1,0 +1,18 @@
+"""PostgreSQL provider.
+
+Reference parity: pkg/providers/postgres/ (storage.go snapshot reads,
+splitter/ ctid sharding, typesystem.go).  The client is a dependency-free
+implementation of the v3 wire protocol (this image ships no libpq binding);
+snapshot loads stream through COPY ... TO STDOUT (FORMAT csv) into
+pyarrow's vectorized CSV reader — rows land columnar without a per-row
+decode loop.  Logical-replication CDC (slot management, wal2json decode,
+slot monitor) builds on the same protocol layer (replication.py).
+"""
+
+from transferia_tpu.providers.postgres.provider import (
+    PGSourceParams,
+    PGTargetParams,
+    PostgresProvider,
+)
+
+__all__ = ["PGSourceParams", "PGTargetParams", "PostgresProvider"]

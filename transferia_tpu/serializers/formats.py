@@ -154,11 +154,14 @@ class NativeQueueSerializer(QueueSerializer):
 
 
 class DebeziumQueueSerializer(QueueSerializer):
-    def __init__(self, **cfg):
+    """config: emitter params + snapshot: bool (emits op 'r' instead of 'c'
+    for initial-load rows, Debezium's snapshot-read marker)."""
+
+    def __init__(self, snapshot: bool = False, **cfg):
         from transferia_tpu.debezium import DebeziumEmitter
 
         self.emitter = DebeziumEmitter(**cfg)
-        self.snapshot = False
+        self.snapshot = snapshot
 
     def serialize_messages(self, batch):
         return self.emitter.emit_batch(batch, snapshot=self.snapshot)
