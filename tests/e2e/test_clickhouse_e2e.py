@@ -82,11 +82,23 @@ def test_ch_storage_reads_back(fake_ch):
     assert len(fake_ch.rows("sample__ev")) == 100
     from transferia_tpu.providers.clickhouse.provider import CHStorage
 
-    storage = CHStorage(CHSourceParams(host="127.0.0.1", port=fake_ch.port))
+    storage = CHStorage(CHSourceParams(host="127.0.0.1", port=fake_ch.port,
+                                       batch_rows=40))
     tables = storage.table_list()
     tid = TableID("default", "sample__ev")
     assert tid in tables and tables[tid].eta_rows == 100
     assert storage.exact_table_rows_count(tid) == 100
+    # streamed RowBinary read back through load_table (batched at 40)
+    from transferia_tpu.abstract.table import TableDescription
+
+    got = []
+    storage.load_table(TableDescription(id=tid), got.append)
+    assert sum(b.n_rows for b in got) == 100
+    assert len(got) == 3  # 40+40+20 respects batch_rows
+    ids = sorted(
+        v for b in got for v in b.to_pydict()["event_id"]
+    )
+    assert ids == list(range(100))
 
 
 def test_ch_cleanup_drop(fake_ch):

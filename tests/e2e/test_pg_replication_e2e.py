@@ -1,0 +1,157 @@
+"""PG logical replication (CDC) e2e against the fake wire server."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from transferia_tpu.abstract import Kind, TableID
+from transferia_tpu.coordinator import MemoryCoordinator
+from transferia_tpu.models import Transfer, TransferType
+from transferia_tpu.providers.memory import MemoryTargetParams, get_store
+from transferia_tpu.providers.postgres import PGSourceParams
+from transferia_tpu.providers.postgres.replication import (
+    int_to_lsn,
+    lsn_to_int,
+)
+from transferia_tpu.runtime import run_replication
+from tests.recipes.fake_postgres import FakePG
+
+
+def w2j_insert(i, name="n"):
+    return json.dumps({
+        "action": "I", "schema": "public", "table": "t",
+        "columns": [
+            {"name": "id", "type": "bigint", "value": i},
+            {"name": "name", "type": "text", "value": f"{name}{i}"},
+        ],
+        "pk": [{"name": "id", "type": "bigint"}],
+    }).encode()
+
+
+def w2j_update(i, name):
+    return json.dumps({
+        "action": "U", "schema": "public", "table": "t",
+        "columns": [
+            {"name": "id", "type": "bigint", "value": i},
+            {"name": "name", "type": "text", "value": name},
+        ],
+        "identity": [{"name": "id", "type": "bigint", "value": i}],
+        "pk": [{"name": "id", "type": "bigint"}],
+    }).encode()
+
+
+def w2j_delete(i):
+    return json.dumps({
+        "action": "D", "schema": "public", "table": "t",
+        "identity": [{"name": "id", "type": "bigint", "value": i}],
+        "pk": [{"name": "id", "type": "bigint"}],
+    }).encode()
+
+
+def test_lsn_conversion():
+    assert lsn_to_int("0/1000") == 0x1000
+    assert lsn_to_int("A/BC") == (10 << 32) | 0xBC
+    assert int_to_lsn((10 << 32) | 0xBC) == "A/BC"
+
+
+def test_pg_cdc_stream_to_memory():
+    srv = FakePG().start()
+    try:
+        # pre-feed txn begin + rows + commit
+        srv.feed_wal(json.dumps({"action": "B"}).encode())
+        for i in range(5):
+            srv.feed_wal(w2j_insert(i))
+        srv.feed_wal(w2j_update(2, "updated"))
+        srv.feed_wal(w2j_delete(0))
+        srv.feed_wal(json.dumps({"action": "C"}).encode())
+
+        store = get_store("pgcdc")
+        store.clear()
+        cp = MemoryCoordinator()
+        t = Transfer(
+            id="pgcdc", type=TransferType.INCREMENT_ONLY,
+            src=PGSourceParams(host="127.0.0.1", port=srv.port,
+                               database="db", user="u"),
+            dst=MemoryTargetParams(sink_id="pgcdc"),
+        )
+        stop = threading.Event()
+        th = threading.Thread(
+            target=run_replication, args=(t, cp),
+            kwargs={"stop_event": stop, "backoff": 0.1}, daemon=True,
+        )
+        th.start()
+        deadline = time.monotonic() + 15
+        while store.row_count() < 7 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        # slot was created
+        assert "transferia_pgcdc" in srv.slots
+        rows = store.rows(TableID("public", "t"))
+        assert len(rows) == 7
+        kinds = [r.kind for r in rows]
+        assert kinds.count(Kind.INSERT) == 5
+        assert kinds.count(Kind.UPDATE) == 1
+        assert kinds.count(Kind.DELETE) == 1
+        upd = next(r for r in rows if r.kind == Kind.UPDATE)
+        assert upd.value("name") == "updated"
+        assert upd.old_keys.as_dict() == {"id": 2}
+        dele = next(r for r in rows if r.kind == Kind.DELETE)
+        assert dele.effective_key() == (0,)
+        # LSN checkpoint persisted and standby status flushed
+        deadline = time.monotonic() + 5
+        while srv.flushed_lsn == 0 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        state = cp.get_transfer_state("pgcdc")
+        assert "pg_wal_lsn" in state
+        assert srv.flushed_lsn > 0
+
+        # live feed while running
+        srv.feed_wal(w2j_insert(100))
+        deadline = time.monotonic() + 5
+        while store.row_count() < 8 and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert store.row_count() == 8
+        stop.set()
+        th.join(timeout=10)
+    finally:
+        srv.stop()
+
+
+def test_slot_monitor_fatal_on_lag():
+    srv = FakePG().start()
+    try:
+        from transferia_tpu.abstract.errors import FatalError
+        from transferia_tpu.providers.postgres.replication import (
+            SlotMonitor,
+        )
+
+        params = PGSourceParams(host="127.0.0.1", port=srv.port,
+                                database="db", user="u")
+        mon = SlotMonitor(params, "s1", max_lag_bytes=10_000)
+        assert mon.check_once() == 1024  # fake reports 1024
+        mon_small = SlotMonitor(params, "s1", max_lag_bytes=10)
+        with pytest.raises(FatalError, match="lag"):
+            mon_small.check_once()
+    finally:
+        srv.stop()
+
+
+def test_deactivate_drops_slot():
+    srv = FakePG().start()
+    try:
+        from transferia_tpu.providers.registry import get_provider
+
+        t = Transfer(
+            id="pgdrop", type=TransferType.INCREMENT_ONLY,
+            src=PGSourceParams(host="127.0.0.1", port=srv.port,
+                               database="db", user="u",
+                               slot_name="myslot"),
+            dst=MemoryTargetParams(sink_id="x"),
+        )
+        srv.slots["myslot"] = "wal2json"
+        provider = get_provider("pg", t)
+        provider.deactivate()
+        assert "myslot" not in srv.slots
+    finally:
+        srv.stop()

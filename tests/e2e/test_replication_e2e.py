@@ -74,7 +74,7 @@ def test_retry_loop_restarts_on_transient_errors(monkeypatch):
     cp = MemoryCoordinator()
     src = {}
 
-    def fake_new_source(transfer, metrics=None):
+    def fake_new_source(transfer, metrics=None, coordinator=None):
         s = FlakySource()
         src["cur"] = s
         return s
@@ -100,8 +100,10 @@ def test_fatal_error_fails_transfer(monkeypatch):
                  src=SampleSourceParams(), dst=MemoryTargetParams(
                      sink_id="rep3"))
     cp = MemoryCoordinator()
-    monkeypatch.setattr("transferia_tpu.runtime.local.new_source",
-                        lambda tr, metrics=None: FlakySource(fatal=True))
+    monkeypatch.setattr(
+        "transferia_tpu.runtime.local.new_source",
+        lambda tr, metrics=None, coordinator=None: FlakySource(fatal=True),
+    )
     with pytest.raises(FatalError):
         run_replication(t, cp, backoff=0.05)
     assert cp.get_status("rep3") == TransferStatus.FAILED

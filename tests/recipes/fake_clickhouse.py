@@ -110,6 +110,25 @@ class FakeCH:
                     dict(zip(col_names, r)) for r in rows
                 )
             return b""
+        m = re.match(r"select (.*) from `?(\w+)`?\s*(?:where .*)?"
+                     r"format rowbinary", low, re.S)
+        if m:
+            name = re.search(r"FROM `?(\w+)`?", q, re.I).group(1)
+            with self.lock:
+                t = self.tables.get(name)
+                if t is None:
+                    raise ValueError(f"Table {name} does not exist")
+                sel = re.match(r"SELECT (.*?) FROM", q, re.S | re.I).group(1)
+                cols = []
+                for expr in sel.split(","):
+                    expr = expr.strip()
+                    mm = re.match(r"toString\(`(\w+)`\) AS", expr)
+                    cols.append(mm.group(1) if mm
+                                else expr.strip("`"))
+                return _encode_rowbinary_rows(
+                    t["rows"], cols,
+                    [t["columns"][c] for c in cols],
+                )
         m = re.match(r"select count\(\) from `?(\w+)`?", low)
         if m:
             with self.lock:
@@ -173,6 +192,45 @@ _FIXED = {
     "Float64": ("<d", 8), "Bool": ("<B", 1), "Date32": ("<i", 4),
     "DateTime": ("<I", 4), "DateTime64(6)": ("<q", 8),
 }
+
+
+def _encode_varint(n: int) -> bytes:
+    out = b""
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+def _encode_rowbinary_rows(rows: list[dict], cols: list[str],
+                           types: list[str]) -> bytes:
+    out = b""
+    for row in rows:
+        for c, t in zip(cols, types):
+            v = row.get(c)
+            nullable = t.startswith("Nullable(")
+            base = t[9:-1] if nullable else t
+            if nullable:
+                if v is None:
+                    out += b"\x01"
+                    continue
+                out += b"\x00"
+            if base in _FIXED:
+                fmt, w = _FIXED[base]
+                if base in ("Float32", "Float64"):
+                    v = float(v or 0)
+                elif base == "Bool":
+                    v = 1 if v in (True, "True", "true", 1) else 0
+                else:
+                    v = int(v or 0)
+                out += struct.pack(fmt, v)
+            else:
+                raw = v if isinstance(v, bytes) else str(v or "").encode()
+                out += _encode_varint(len(raw)) + raw
+    return out
 
 
 def _decode_rowbinary_rows(data: bytes, types: list[str]) -> list[list]:

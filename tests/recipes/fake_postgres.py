@@ -20,6 +20,12 @@ import threading
 from base64 import b64decode, b64encode
 
 
+class _PGStateError(Exception):
+    def __init__(self, message: str, code: str = "XX000"):
+        super().__init__(message)
+        self.code = code
+
+
 class FakeTable:
     def __init__(self, namespace: str, name: str, columns: list[tuple],
                  rows: list[dict] | None = None):
@@ -39,6 +45,20 @@ class FakePG:
         self.lock = threading.RLock()
         self.port = 0
         self._srv = None
+        # replication state
+        self.slots: dict[str, str] = {}          # slot -> plugin
+        self.wal: list[tuple[int, bytes]] = []   # (lsn, wal2json payload)
+        self.flushed_lsn = 0                     # last standby-status flush
+        self.wal_event = threading.Event()
+
+    def feed_wal(self, payload: bytes, lsn: int | None = None) -> None:
+        """Append one wal2json message for streaming to subscribers."""
+        with self.lock:
+            lsn = lsn if lsn is not None else (
+                (self.wal[-1][0] + 8) if self.wal else 0x2000
+            )
+            self.wal.append((lsn, payload))
+        self.wal_event.set()
 
     def add_table(self, table: FakeTable) -> None:
         with self.lock:
@@ -201,6 +221,10 @@ class _Session:
             self.fake.queries.append(sql)
         try:
             self.dispatch(sql)
+        except _PGStateError as e:
+            self.error(str(e), e.code)
+        except ConnectionError:
+            raise
         except Exception as e:
             self.error(str(e))
         self.ready()
@@ -210,6 +234,34 @@ class _Session:
         fake = self.fake
         if low == "select 1":
             return self.send_rows(["?column?"], [[1]])
+        if low == "identify_system":
+            return self.send_rows(
+                ["systemid", "timeline", "xlogpos", "dbname"],
+                [["7000", "1", "0/1000", "db"]],
+            )
+        m = re.match(r"create_replication_slot (\w+) logical (\w+)", low)
+        if m:
+            with fake.lock:
+                if m.group(1) in fake.slots:
+                    raise _PGStateError(
+                        f'replication slot "{m.group(1)}" already exists',
+                        "42710",
+                    )
+                fake.slots[m.group(1)] = m.group(2)
+            return self.send_rows(
+                ["slot_name", "consistent_point", "snapshot_name",
+                 "output_plugin"],
+                [[m.group(1), "0/1000", None, m.group(2)]],
+            )
+        m = re.match(r"drop_replication_slot (\w+)", low)
+        if m:
+            with fake.lock:
+                fake.slots.pop(m.group(1), None)
+            return self.send(b"C", b"DROP_REPLICATION_SLOT\x00")
+        if low.startswith("start_replication"):
+            return self.stream_replication()
+        if "pg_wal_lsn_diff" in low:
+            return self.send_rows(["diff"], [[1024]])
         if "from pg_class c join pg_namespace" in low:
             rows = [
                 [t.namespace, t.name, len(t.rows)]
@@ -251,6 +303,38 @@ class _Session:
             self.apply_dml(sql)
             return self.send(b"C", b"OK\x00")
         raise ValueError(f"fake PG: unhandled query: {sql[:120]}")
+
+    # -- replication streaming ---------------------------------------------
+    def stream_replication(self):
+        import select
+        import time as _time
+
+        self.send(b"W", struct.pack("!bh", 0, 0))
+        sent = 0
+        fake = self.fake
+        while True:
+            with fake.lock:
+                wal = list(fake.wal)
+            progressed = sent < len(wal)
+            while sent < len(wal):
+                lsn, payload = wal[sent]
+                msg = b"w" + struct.pack("!QQQ", lsn, lsn, 0) + payload
+                self.send(b"d", msg)
+                sent += 1
+            # keepalive so the client flushes its status
+            last = wal[-1][0] if wal else 0
+            self.send(b"d", b"k" + struct.pack("!QQB", last, 0, 0))
+            readable, _, _ = select.select([self.sock], [], [], 0.05)
+            if readable:
+                t, payload = self.recv_msg()
+                if t == b"d" and payload[:1] == b"r":
+                    flushed = struct.unpack("!Q", payload[9:17])[0]
+                    with fake.lock:
+                        fake.flushed_lsn = flushed - 1
+                elif t in (b"X", b"c"):
+                    raise ConnectionError("replication client done")
+            if not progressed:
+                _time.sleep(0.02)
 
     # -- COPY ---------------------------------------------------------------
     def copy_out(self, sql: str):

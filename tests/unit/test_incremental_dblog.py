@@ -1,0 +1,218 @@
+"""Incremental snapshot cursors, DBLog watermarks, cron matcher."""
+
+import threading
+import time
+
+import pytest
+
+from transferia_tpu.abstract import ChangeItem, Kind, TableID
+from transferia_tpu.abstract.interfaces import SyncAsAsyncSink
+from transferia_tpu.abstract.schema import new_table_schema
+from transferia_tpu.columnar import ColumnBatch
+from transferia_tpu.coordinator import MemoryCoordinator
+from transferia_tpu.models import Transfer
+from transferia_tpu.models.transfer import (
+    IncrementalTableCfg,
+    RegularSnapshot,
+)
+from transferia_tpu.providers.memory import (
+    MemorySourceParams,
+    MemoryTargetParams,
+    get_store,
+    seed_source,
+)
+from transferia_tpu.tasks import SnapshotLoader
+
+
+SCHEMA = new_table_schema([("id", "int64", True), ("v", "utf8")])
+TID = TableID("m", "inc")
+
+
+def seed(source_id, ids):
+    seed_source(source_id, [ColumnBatch.from_pydict(TID, SCHEMA, {
+        "id": list(ids), "v": [f"v{i}" for i in ids],
+    })])
+
+
+def make_transfer(source_id, sink_id):
+    return Transfer(
+        id=f"inc-{source_id}",
+        src=MemorySourceParams(source_id=source_id),
+        dst=MemoryTargetParams(sink_id=sink_id),
+        regular_snapshot=RegularSnapshot(
+            enabled=True, cron="* * * * *",
+            incremental=[IncrementalTableCfg(
+                namespace="m", name="inc", cursor_field="id",
+            )],
+        ),
+    )
+
+
+class TestIncrementalSnapshot:
+    def test_first_run_full_then_only_new_rows(self):
+        seed("inc1", range(10))
+        t = make_transfer("inc1", "inc1_store")
+        store = get_store("inc1_store")
+        store.clear()
+        cp = MemoryCoordinator()
+        SnapshotLoader(t, cp, operation_id="op-a").upload_tables()
+        assert store.row_count(TID) == 10
+        state = cp.get_transfer_state(t.id)
+        assert state["incremental_state"][str(TID)] == 9
+
+        # new rows arrive; second snapshot only moves the delta
+        seed("inc1", range(15))
+        store.clear()
+        SnapshotLoader(t, cp, operation_id="op-b").upload_tables()
+        ids = sorted(r.value("id") for r in store.rows(TID))
+        assert ids == [10, 11, 12, 13, 14]
+        assert cp.get_transfer_state(t.id)["incremental_state"][str(TID)] \
+            == 14
+
+    def test_no_new_rows_pushes_nothing(self):
+        seed("inc2", range(5))
+        t = make_transfer("inc2", "inc2_store")
+        store = get_store("inc2_store")
+        store.clear()
+        cp = MemoryCoordinator()
+        SnapshotLoader(t, cp, operation_id="op-a").upload_tables()
+        store.clear()
+        SnapshotLoader(t, cp, operation_id="op-b").upload_tables()
+        assert store.row_count(TID) == 0
+
+
+class TestDBLog:
+    def test_chunked_snapshot_dedups_live_events(self):
+        from transferia_tpu.dblog import (
+            DBLogSnapshot,
+            WatermarkKind,
+        )
+        from transferia_tpu.dblog.core import (
+            PagedChunkIterator,
+            StorageSignalTable,
+        )
+        from transferia_tpu.providers.memory import MemorySinker
+
+        # source table: ids 0..19
+        all_ids = list(range(20))
+
+        def load_fn(cursor, limit):
+            start = 0 if cursor is None else all_ids.index(cursor) + 1
+            ids = all_ids[start:start + limit]
+            if not ids:
+                return None
+            return ColumnBatch.from_pydict(TID, SCHEMA, {
+                "id": ids, "v": [f"old{i}" for i in ids],
+            })
+
+        store = get_store("dblog_store")
+        store.clear()
+        sink = SyncAsAsyncSink(MemorySinker(MemoryTargetParams(
+            sink_id="dblog_store")))
+
+        written: list[tuple] = []
+        signal_schema = new_table_schema([
+            ("mark_id", "utf8", True), ("kind", "utf8"),
+        ])
+
+        snapshot_holder = {}
+
+        def write_fn(mark_id, kind):
+            # simulate the watermark arriving back through the CDC stream
+            item = ChangeItem(
+                kind=Kind.INSERT, schema="", table="__transferia_signal",
+                column_names=("mark_id", "kind"),
+                column_values=(mark_id, kind),
+                table_schema=signal_schema,
+            )
+            written.append((mark_id, kind))
+            # feed the CDC stream on another "thread" (inline is fine)
+            snapshot_holder["snap"].filter_cdc([item])
+
+        signal = StorageSignalTable(write_fn)
+        chunks = PagedChunkIterator(load_fn, "id", chunk_rows=8)
+        snap = DBLogSnapshot(signal, chunks, sink, ["id"])
+        snapshot_holder["snap"] = snap
+
+        # live CDC updates id 5 while snapshotting (between watermarks)
+        orig_write = signal.write_fn
+
+        def write_with_live(mark_id, kind):
+            orig_write(mark_id, kind)
+            if kind == "low" and not snapshot_holder.get("updated"):
+                snapshot_holder["updated"] = True
+                live = ChangeItem(
+                    kind=Kind.UPDATE, schema="m", table="inc",
+                    column_names=("id", "v"), column_values=(5, "live5"),
+                    table_schema=SCHEMA,
+                )
+                out = snap.filter_cdc([live])
+                # live event still flows to the sink via replication path
+                sink.async_push(out).result()
+
+        signal.write_fn = write_with_live
+
+        total = snap.run(chunk_timeout=5)
+        # id 5 was superseded by the live event: 19 snapshot rows + 1 live
+        assert total == 19
+        rows = store.rows(TID)
+        assert len(rows) == 20
+        by_id = {}
+        for r in rows:
+            by_id[r.value("id")] = r.value("v")
+        assert by_id[5] == "live5"       # live wins
+        assert by_id[6] == "old6"
+        kinds = [k for _, k in written]
+        assert kinds.count("low") == kinds.count("high")
+        assert kinds[-1] == "success"
+
+    def test_watermark_timeout_marks_bad(self):
+        from transferia_tpu.dblog import DBLogSnapshot
+        from transferia_tpu.dblog.core import (
+            PagedChunkIterator,
+            StorageSignalTable,
+        )
+
+        written = []
+        signal = StorageSignalTable(lambda i, k: written.append(k))
+        chunks = PagedChunkIterator(lambda c, l: None, "id")
+        snap = DBLogSnapshot(signal, chunks,
+                             SyncAsAsyncSink(None), ["id"])
+        with pytest.raises(TimeoutError, match="not observed"):
+            snap.run(chunk_timeout=0.1)
+        assert written[-1] == "bad"
+
+
+class TestCron:
+    def test_parse_and_match(self):
+        from transferia_tpu.utils.cron import parse_cron
+
+        spec = parse_cron("*/15 3 * * *")
+        assert spec.minutes == frozenset({0, 15, 30, 45})
+        assert spec.hours == frozenset({3})
+        t = time.struct_time((2026, 7, 28, 3, 30, 0, 1, 209, 0))
+        assert spec.matches(t)
+        t2 = time.struct_time((2026, 7, 28, 4, 30, 0, 1, 209, 0))
+        assert not spec.matches(t2)
+
+    def test_ranges_and_lists(self):
+        from transferia_tpu.utils.cron import parse_cron
+
+        spec = parse_cron("0 0 1,15 * 1-5")
+        assert spec.days == frozenset({1, 15})
+        assert spec.weekdays == frozenset({1, 2, 3, 4, 5})
+
+    def test_bad_exprs(self):
+        from transferia_tpu.utils.cron import parse_cron
+
+        with pytest.raises(ValueError):
+            parse_cron("* * *")
+        with pytest.raises(ValueError):
+            parse_cron("99 * * * *")
+
+    def test_next_after(self):
+        from transferia_tpu.utils.cron import parse_cron
+
+        spec = parse_cron("* * * * *")
+        nxt = spec.next_after(1_700_000_000)
+        assert nxt % 60 == 0 and nxt > 1_700_000_000

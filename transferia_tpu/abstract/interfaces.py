@@ -173,17 +173,25 @@ class SnapshotableStorage(abc.ABC):
 
 
 class IncrementalStorage(abc.ABC):
-    """Cursor-based incremental snapshots (storage.go:354)."""
+    """Cursor-based incremental snapshots (storage.go:354).
+
+    Flow (load_snapshot_incremental.go): before a snapshot the loader reads
+    the persisted cursor state, asks for filtered TableDescriptions
+    (rows past each cursor), snapshots the slices, and on success persists
+    `next_increment_state` — captured BEFORE loading so rows written during
+    the snapshot are re-read next time rather than skipped.
+    """
 
     @abc.abstractmethod
-    def get_increment_state(self, tables: list["IncrementalTable"]
+    def get_increment_state(self, tables: list["IncrementalTable"],
+                            state: dict[str, Any]
                             ) -> list[TableDescription]:
-        """Return table descriptions filtered to rows past the stored cursor."""
+        """Table descriptions filtered to rows past each stored cursor."""
 
     @abc.abstractmethod
     def next_increment_state(self, tables: list["IncrementalTable"]
                              ) -> dict[str, Any]:
-        """Compute the post-snapshot cursor values to persist."""
+        """Cursor values (str(table_id) -> value) to persist on success."""
 
 
 class IncrementalTable:

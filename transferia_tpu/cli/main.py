@@ -64,6 +64,12 @@ def build_parser() -> argparse.ArgumentParser:
                     help="table to upload (repeatable), e.g. ns.name")
     add_transfer_cmd("check", "run checksum comparison source vs target")
     add_transfer_cmd("validate", "parse and validate the transfer config")
+    add_transfer_cmd("deactivate",
+                     "release source resources (replication slots etc.)")
+    reg = add_transfer_cmd("regular-snapshot",
+                           "run the cron-driven re-snapshot loop")
+    reg.add_argument("--max-runs", type=int, default=0,
+                     help="stop after N runs (0 = forever)")
     desc = sub.add_parser("describe",
                           help="dump provider endpoint param schemas")
     desc.add_argument("--provider", default="",
@@ -169,6 +175,20 @@ def main(argv=None) -> int:
 
     if args.command == "check":
         return cmd_check(transfer)
+
+    if args.command == "deactivate":
+        from transferia_tpu.providers.registry import get_provider
+
+        get_provider(transfer.src_provider(), transfer).deactivate()
+        cp.set_status(transfer.id, TransferStatus.DEACTIVATED)
+        print(f"transfer {transfer.id}: deactivated")
+        return 0
+
+    if args.command == "regular-snapshot":
+        from transferia_tpu.runtime.local import run_regular_snapshot
+
+        run_regular_snapshot(transfer, cp, max_runs=args.max_runs)
+        return 0
 
     raise SystemExit(f"unknown command {args.command}")
 

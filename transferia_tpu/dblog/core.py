@@ -1,0 +1,224 @@
+"""DBLog watermark snapshot engine."""
+
+from __future__ import annotations
+
+import abc
+import enum
+import logging
+import threading
+import uuid
+from dataclasses import dataclass
+from typing import Any, Callable, Optional, Sequence
+
+from transferia_tpu.abstract.change_item import ChangeItem
+from transferia_tpu.abstract.interfaces import AsyncSink, Batch, is_columnar
+from transferia_tpu.abstract.schema import TableID
+from transferia_tpu.columnar.batch import ColumnBatch
+
+logger = logging.getLogger(__name__)
+
+
+class WatermarkKind(str, enum.Enum):
+    LOW = "low"
+    HIGH = "high"
+    SUCCESS = "success"   # snapshot finished
+    BAD = "bad"           # snapshot aborted
+
+
+@dataclass(frozen=True)
+class Watermark:
+    id: str
+    kind: WatermarkKind
+
+
+class SignalTable(abc.ABC):
+    """Writes watermarks into the source so they appear in its CDC stream
+    (dblog/signal_table.go:32).  The caller generates the Watermark (and
+    registers it as expected) BEFORE the write — a fast CDC echo must not
+    race the registration."""
+
+    @abc.abstractmethod
+    def write_watermark(self, wm: Watermark) -> None:
+        ...
+
+    @abc.abstractmethod
+    def is_watermark(self, item: ChangeItem) -> Optional[Watermark]:
+        """Recognize a CDC event as one of our watermarks."""
+
+
+class ChunkIterator(abc.ABC):
+    """PK-ordered chunk reader (dblog/incremental_iterator.go:209-320)."""
+
+    @abc.abstractmethod
+    def next_chunk(self) -> Optional[ColumnBatch]:
+        """Next chunk past the internal cursor; None when exhausted."""
+
+
+class DBLogSnapshot:
+    """Drives chunked snapshot concurrent with a replication stream.
+
+    The replication pipeline pushes through `filter_cdc`; the snapshot
+    loop calls `run`.  Between a LOW and HIGH watermark pair, primary keys
+    seen in CDC events mark chunk rows stale (the live event supersedes the
+    chunk copy) — dblog/incremental_async_sink.go:14-207.
+    """
+
+    def __init__(self, signal: SignalTable, chunks: ChunkIterator,
+                 sink: AsyncSink, key_columns: Sequence[str]):
+        self.signal = signal
+        self.chunks = chunks
+        self.sink = sink
+        self.key_columns = list(key_columns)
+        self._lock = threading.Lock()
+        self._window_open = False
+        self._touched: set[tuple] = set()
+        self._expected: dict[str, WatermarkKind] = {}
+        self._events = {
+            WatermarkKind.LOW: threading.Event(),
+            WatermarkKind.HIGH: threading.Event(),
+        }
+
+    # -- CDC side -----------------------------------------------------------
+    def filter_cdc(self, batch: Batch) -> Batch:
+        """Intercept the replication stream: consume watermarks, record
+        touched PKs while a chunk window is open.  Returns the batch minus
+        watermark rows."""
+        items = batch.to_rows() if is_columnar(batch) else list(batch)
+        out = []
+        for it in items:
+            wm = self.signal.is_watermark(it) if it.is_row_event() else None
+            if wm is not None:
+                self._on_watermark(wm)
+                continue
+            with self._lock:
+                if self._window_open and it.is_row_event():
+                    self._touched.add(
+                        (it.table_id, it.effective_key())
+                    )
+            out.append(it)
+        if is_columnar(batch) and len(out) == len(items):
+            return batch  # nothing filtered: keep columnar
+        return out
+
+    def _on_watermark(self, wm: Watermark) -> None:
+        expected = self._expected.pop(wm.id, None)
+        if expected is None or expected != wm.kind:
+            logger.warning("unexpected watermark %s", wm)
+            return
+        with self._lock:
+            if wm.kind == WatermarkKind.LOW:
+                self._window_open = True
+                self._touched.clear()
+            elif wm.kind == WatermarkKind.HIGH:
+                self._window_open = False
+        self._events[wm.kind].set()
+
+    # -- snapshot side ------------------------------------------------------
+    def _write_and_wait(self, kind: WatermarkKind,
+                        timeout: float = 30.0) -> None:
+        self._events[kind].clear()
+        wm = Watermark(id=uuid.uuid4().hex, kind=kind)
+        self._expected[wm.id] = kind   # register BEFORE writing
+        self.signal.write_watermark(wm)
+        if not self._events[kind].wait(timeout):
+            raise TimeoutError(
+                f"{kind.value} watermark {wm.id} not observed in the CDC "
+                f"stream within {timeout}s — is replication running?"
+            )
+
+    def run(self, chunk_timeout: float = 30.0) -> int:
+        """Snapshot all chunks; returns rows pushed."""
+        total = 0
+        try:
+            while True:
+                self._write_and_wait(WatermarkKind.LOW, chunk_timeout)
+                chunk = self.chunks.next_chunk()
+                self._write_and_wait(WatermarkKind.HIGH, chunk_timeout)
+                if chunk is None or chunk.n_rows == 0:
+                    break
+                with self._lock:
+                    touched = set(self._touched)
+                if touched:
+                    rows = chunk.to_rows()
+                    keep = [
+                        it for it in rows
+                        if (it.table_id, it.effective_key()) not in touched
+                    ]
+                    if len(keep) < len(rows):
+                        logger.info(
+                            "dblog chunk: %d rows deduped against live "
+                            "events", len(rows) - len(keep),
+                        )
+                    if not keep:
+                        continue
+                    self.sink.async_push(keep).result()
+                    total += len(keep)
+                else:
+                    self.sink.async_push(chunk).result()
+                    total += chunk.n_rows
+            self.signal.write_watermark(
+                Watermark(uuid.uuid4().hex, WatermarkKind.SUCCESS)
+            )
+            return total
+        except BaseException:
+            self.signal.write_watermark(
+                Watermark(uuid.uuid4().hex, WatermarkKind.BAD)
+            )
+            raise
+
+
+# ---------------------------------------------------------------------------
+# Generic implementations
+# ---------------------------------------------------------------------------
+
+SIGNAL_TABLE = TableID("", "__transferia_signal")
+
+
+class StorageSignalTable(SignalTable):
+    """Signal table over a writer callback (DB providers supply an INSERT
+    into their __transferia_signal table; the CDC stream echoes it)."""
+
+    def __init__(self, write_fn: Callable[[str, str], None],
+                 table: TableID = SIGNAL_TABLE):
+        self.write_fn = write_fn
+        self.table = table
+
+    def write_watermark(self, wm: Watermark) -> None:
+        self.write_fn(wm.id, wm.kind.value)
+
+    def is_watermark(self, item: ChangeItem) -> Optional[Watermark]:
+        if item.table_id != self.table:
+            return None
+        vals = item.as_dict()
+        try:
+            return Watermark(id=vals["mark_id"],
+                             kind=WatermarkKind(vals["kind"]))
+        except (KeyError, ValueError):
+            return None
+
+
+class PagedChunkIterator(ChunkIterator):
+    """Cursor-paged chunks over a load callback.
+
+    load_fn(last_key or None, limit) -> ColumnBatch (PK-ordered).
+    """
+
+    def __init__(self, load_fn, key_column: str, chunk_rows: int = 10_000):
+        self.load_fn = load_fn
+        self.key_column = key_column
+        self.chunk_rows = chunk_rows
+        self._cursor: Optional[Any] = None
+        self._done = False
+
+    def next_chunk(self) -> Optional[ColumnBatch]:
+        if self._done:
+            return None
+        chunk = self.load_fn(self._cursor, self.chunk_rows)
+        if chunk is None or chunk.n_rows == 0:
+            self._done = True
+            return None
+        col = chunk.column(self.key_column)
+        self._cursor = col.value(chunk.n_rows - 1)
+        if chunk.n_rows < self.chunk_rows:
+            self._done = True
+        return chunk

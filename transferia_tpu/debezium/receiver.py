@@ -60,10 +60,16 @@ class DebeziumReceiver:
             keys = {f["field"] for f in key_schema.get("fields", [])}
         # cache key covers the full field list + key set, not just the table
         # name — upstream ALTERs change the schema block under the same
-        # <prefix>.<table>.Value name and must invalidate the cache
-        cache_key = json.dumps(
-            [after.get("name", ""), after.get("fields", []), sorted(keys)],
-            sort_keys=True, default=str,
+        # <prefix>.<table>.Value name and must invalidate the cache.  Tuple
+        # key, not json.dumps: this runs per received message.
+        cache_key = (
+            after.get("name", ""),
+            tuple(
+                (f.get("field"), f.get("type"), f.get("name"),
+                 f.get("optional", True))
+                for f in after.get("fields", [])
+            ),
+            frozenset(keys),
         )
         cached = self._schema_cache.get(cache_key)
         if cached is not None:

@@ -74,12 +74,21 @@ def make_sinker(transfer, metrics: Optional[Metrics] = None,
     src_fb = fallbacks_for(transfer.src_provider(), "source", version)
     if src_fb:
         s = TypeFallbacks(s, src_fb)
+    from transferia_tpu.metering.agent import (
+        InputMetering,
+        OutputMetering,
+        metering_agent,
+    )
+
+    agent = metering_agent(transfer.id)
+    s = OutputMetering(s, agent)
     s = Statistician(s, stats or SinkerStats(metrics))
     s = Filter(s, _system_table_filter)
     s = NonRowSeparator(s)
     chain = build_chain(transfer.transformation)
     if chain is not None:
         s = TransformationMW(s, chain)
+    s = InputMetering(s, agent)
     s = Measurer(s)
     if snapshot_stage:
         s = Retrier(s)

@@ -9,8 +9,10 @@ from transferia_tpu.providers.registry import get_provider
 from transferia_tpu.stats.registry import Metrics
 
 
-def new_source(transfer, metrics: Optional[Metrics] = None) -> Source:
-    provider = get_provider(transfer.src_provider(), transfer, metrics)
+def new_source(transfer, metrics: Optional[Metrics] = None,
+               coordinator=None) -> Source:
+    provider = get_provider(transfer.src_provider(), transfer, metrics,
+                            coordinator)
     source = provider.source()
     if source is None:
         raise ValueError(

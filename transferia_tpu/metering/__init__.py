@@ -1,0 +1,20 @@
+"""Usage metering (reference: pkg/metering/agent.go).
+
+Counts input/output rows and bytes per transfer with a pluggable writer;
+the default writer is a no-op (stub by default in the reference too), a
+JSONL file writer ships for audit trails.
+"""
+
+from transferia_tpu.metering.agent import (
+    MeteringAgent,
+    JsonlMeteringWriter,
+    initialize_metering,
+    metering_agent,
+)
+
+__all__ = [
+    "MeteringAgent",
+    "JsonlMeteringWriter",
+    "initialize_metering",
+    "metering_agent",
+]

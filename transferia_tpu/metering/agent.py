@@ -1,0 +1,116 @@
+"""Metering agent + middleware."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Optional, Protocol
+
+from transferia_tpu.abstract.interfaces import Batch, Sinker
+from transferia_tpu.middlewares.helpers import batch_bytes, batch_len
+
+
+class MeteringWriter(Protocol):
+    def write(self, record: dict) -> None: ...
+
+
+class NullWriter:
+    def write(self, record: dict) -> None:
+        pass
+
+
+class JsonlMeteringWriter:
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+
+    def write(self, record: dict) -> None:
+        with self._lock, open(self.path, "a") as fh:
+            fh.write(json.dumps(record) + "\n")
+
+
+class MeteringAgent:
+    """Aggregates rows/bytes and flushes periodic usage records
+    (metering.Agent Initialize :117)."""
+
+    def __init__(self, transfer_id: str,
+                 writer: Optional[MeteringWriter] = None,
+                 flush_interval: float = 60.0):
+        self.transfer_id = transfer_id
+        self.writer = writer or NullWriter()
+        self.flush_interval = flush_interval
+        self._lock = threading.Lock()
+        self._counters = {"input_rows": 0, "input_bytes": 0,
+                          "output_rows": 0, "output_bytes": 0}
+        self._last_flush = time.time()
+
+    def record(self, direction: str, rows: int, nbytes: int) -> None:
+        with self._lock:
+            self._counters[f"{direction}_rows"] += rows
+            self._counters[f"{direction}_bytes"] += nbytes
+            if time.time() - self._last_flush >= self.flush_interval:
+                self._flush_locked()
+
+    def _flush_locked(self) -> None:
+        record = {
+            "transfer_id": self.transfer_id,
+            "ts": time.time(),
+            **self._counters,
+        }
+        self.writer.write(record)
+        self._last_flush = time.time()
+
+    def flush(self) -> None:
+        with self._lock:
+            self._flush_locked()
+
+    def totals(self) -> dict:
+        with self._lock:
+            return dict(self._counters)
+
+
+_AGENTS: dict[str, MeteringAgent] = {}
+_DEFAULT_WRITER: Optional[MeteringWriter] = None
+
+
+def initialize_metering(writer: Optional[MeteringWriter] = None) -> None:
+    global _DEFAULT_WRITER
+    _DEFAULT_WRITER = writer
+
+
+def metering_agent(transfer_id: str) -> MeteringAgent:
+    if transfer_id not in _AGENTS:
+        _AGENTS[transfer_id] = MeteringAgent(transfer_id, _DEFAULT_WRITER)
+    return _AGENTS[transfer_id]
+
+
+class OutputMetering(Sinker):
+    """Sink middleware counting delivered rows/bytes
+    (sink_factory.go OutputDataMetering)."""
+
+    def __init__(self, inner: Sinker, agent: MeteringAgent):
+        self.inner = inner
+        self.agent = agent
+
+    def push(self, batch: Batch) -> None:
+        self.inner.push(batch)
+        self.agent.record("output", batch_len(batch), batch_bytes(batch))
+
+    def close(self) -> None:
+        self.inner.close()
+
+
+class InputMetering(Sinker):
+    """Counts rows entering the pipeline (InputDataMetering)."""
+
+    def __init__(self, inner: Sinker, agent: MeteringAgent):
+        self.inner = inner
+        self.agent = agent
+
+    def push(self, batch: Batch) -> None:
+        self.agent.record("input", batch_len(batch), batch_bytes(batch))
+        self.inner.push(batch)
+
+    def close(self) -> None:
+        self.inner.close()

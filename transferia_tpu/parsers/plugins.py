@@ -309,7 +309,9 @@ class ConfluentSRParser(Parser):
     def do_batch(self, messages: Sequence[Message]) -> ParseResult:
         import struct
 
-        by_schema: dict[int, list[Message]] = {}
+        # contiguous runs per schema id: offset order within the batch must
+        # survive schema evolution (CDC consumers replay in emit order)
+        runs: list[tuple[int, list[Message]]] = []
         bad, reasons = [], []
         for m in messages:
             v = m.value
@@ -317,11 +319,15 @@ class ConfluentSRParser(Parser):
                 schema_id = struct.unpack(">I", v[1:5])[0]
                 payload = v[5:]
                 if payload[:1] in (b"{", b"["):
-                    by_schema.setdefault(schema_id, []).append(Message(
+                    stripped = Message(
                         value=payload, key=m.key, topic=m.topic,
                         partition=m.partition, offset=m.offset,
                         write_time_ns=m.write_time_ns,
-                    ))
+                    )
+                    if runs and runs[-1][0] == schema_id:
+                        runs[-1][1].append(stripped)
+                    else:
+                        runs.append((schema_id, [stripped]))
                 else:
                     bad.append(m)
                     reasons.append(
@@ -331,7 +337,7 @@ class ConfluentSRParser(Parser):
                 bad.append(m)
                 reasons.append("confluent-sr: missing magic byte")
         result = ParseResult()
-        for schema_id, msgs in by_schema.items():
+        for schema_id, msgs in runs:
             sub = self._parser_for(schema_id).do_batch(msgs)
             result.batches.extend(sub.batches)
             if sub.unparsed is not None:

@@ -83,6 +83,35 @@ class CHClient:
         finally:
             conn.close()
 
+    def execute_stream(self, query: str):
+        """Run a query and return (read_fn, close_fn) streaming the response
+        body in chunks — snapshot reads must not buffer whole tables."""
+        conn = self._connect()
+        headers = {"Content-Type": "application/octet-stream"}
+        if self.user:
+            import base64
+
+            cred = base64.b64encode(
+                f"{self.user}:{self.password}".encode()
+            ).decode()
+            headers["Authorization"] = f"Basic {cred}"
+        try:
+            conn.request("POST", "/?" + self._params(query),
+                         body=b"", headers=headers)
+            resp = conn.getresponse()
+            if resp.status != 200:
+                data = resp.read()
+                conn.close()
+                raise CHError(
+                    f"clickhouse HTTP {resp.status}: "
+                    f"{data[:500].decode('utf-8', 'replace')}",
+                    code=resp.status,
+                )
+        except (ConnectionError, OSError, http.client.HTTPException) as e:
+            conn.close()
+            raise CHError(f"clickhouse connection failed: {e}") from e
+        return resp.read, conn.close
+
     def ping(self) -> None:
         out = self.execute("SELECT 1")
         if out.strip() != b"1":

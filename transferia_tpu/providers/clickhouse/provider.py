@@ -36,10 +36,7 @@ from transferia_tpu.models.endpoint import (
     register_endpoint,
 )
 from transferia_tpu.providers.clickhouse.client import CHClient
-from transferia_tpu.providers.clickhouse.rowbinary import (
-    decode_rowbinary,
-    encode_rowbinary,
-)
+from transferia_tpu.providers.clickhouse.rowbinary import encode_rowbinary
 from transferia_tpu.providers.registry import (
     Provider,
     TestResult,
@@ -293,19 +290,35 @@ class CHStorage(Storage):
     def estimate_table_rows_count(self, table: TableID) -> int:
         return self.exact_table_rows_count(table)
 
+    @staticmethod
+    def _select_expr(c: ColSchema) -> str:
+        """Types this decoder can't take off the wire (Decimal, UUID, Array,
+        anything mapped to ANY/DECIMAL) are cast server-side to String."""
+        if c.data_type in (CanonicalType.ANY, CanonicalType.DECIMAL):
+            return f"toString(`{c.name}`) AS `{c.name}`"
+        return f"`{c.name}`"
+
     def load_table(self, table: TableDescription, pusher: Pusher) -> None:
+        from transferia_tpu.providers.clickhouse.rowbinary import (
+            decode_rowbinary_stream,
+        )
+
         schema = self.table_schema(table.id)
         nullable = {c.name: not c.required for c in schema}
-        cols = ", ".join(f"`{c.name}`" for c in schema)
+        cols = ", ".join(self._select_expr(c) for c in schema)
         where = f" WHERE {table.filter}" if table.filter else ""
-        raw = self.client.execute(
+        read_fn, close_fn = self.client.execute_stream(
             f"SELECT {cols} FROM `{table.id.name}`{where} FORMAT RowBinary"
         )
-        if raw:
-            batch = decode_rowbinary(raw, schema, nullable)
-            out = ColumnBatch(table.id, schema, batch.columns)
-            out.read_bytes = len(raw)
-            pusher(out)
+        try:
+            for batch in decode_rowbinary_stream(
+                    read_fn, schema, nullable,
+                    batch_rows=self.params.batch_rows):
+                out = ColumnBatch(table.id, schema, batch.columns)
+                out.read_bytes = out.nbytes()
+                pusher(out)
+        finally:
+            close_fn()
 
     def ping(self) -> None:
         self.client.ping()

@@ -227,56 +227,123 @@ def encode_rowbinary(batch: ColumnBatch,
 # Decoder (CH snapshot source + tests)
 # ---------------------------------------------------------------------------
 
+class _NeedMore(Exception):
+    """Row parse ran off the end of the buffer (partial network chunk)."""
+
+
+def _wire_fixed(cs) -> Optional[tuple[np.dtype, int]]:
+    """Per-column wire format, honoring the CH-native original type: a
+    ClickHouse `Date` column is uint16 days on the wire while our canonical
+    DATE encodes as Date32 (int32)."""
+    if cs.original_type == "ch:Date":
+        return np.dtype("<u2"), 2
+    return _fixed_width(cs.data_type)
+
+
+def _parse_row(buf: memoryview, pos: int, schema, nullable: dict,
+               fixed: dict, out: dict) -> int:
+    n = len(buf)
+    for c in schema:
+        if nullable.get(c.name, False):
+            if pos >= n:
+                raise _NeedMore()
+            flag = buf[pos]
+            pos += 1
+            if flag == 1:
+                out[c.name].append(None)
+                continue
+        fx = fixed[c.name]
+        if fx is not None:
+            dt, width = fx
+            if pos + width > n:
+                raise _NeedMore()
+            v = np.frombuffer(buf[pos:pos + width], dtype=dt)[0]
+            if c.data_type == CanonicalType.BOOLEAN:
+                out[c.name].append(bool(v))
+            elif c.data_type.is_float:
+                out[c.name].append(float(v))
+            else:
+                out[c.name].append(int(v))
+            pos += width
+        else:
+            ln = 0
+            shift = 0
+            while True:
+                if pos >= n:
+                    raise _NeedMore()
+                b = buf[pos]
+                pos += 1
+                ln |= (b & 0x7F) << shift
+                if not b & 0x80:
+                    break
+                shift += 7
+            if pos + ln > n:
+                raise _NeedMore()
+            raw = bytes(buf[pos:pos + ln])
+            pos += ln
+            if c.data_type == CanonicalType.STRING:
+                out[c.name].append(raw)
+            else:
+                out[c.name].append(raw.decode("utf-8", "replace"))
+    return pos
+
+
 def decode_rowbinary(data: bytes, schema,
                      nullable: Optional[dict[str, bool]] = None
                      ) -> ColumnBatch:
-    """RowBinary bytes -> ColumnBatch for the given TableSchema.
-
-    Sequential parse (the wire format is inherently row-major); used by the
-    snapshot source where network IO dominates, and by tests to pin the
-    encoder.
-    """
+    """RowBinary bytes -> ColumnBatch (whole buffer; tests + small reads)."""
     from transferia_tpu.abstract.schema import TableID
 
     nullable = nullable or {}
     buf = memoryview(data)
     pos = 0
     cols: dict[str, list] = {c.name: [] for c in schema}
-    fixed = {c.name: _fixed_width(c.data_type) for c in schema}
+    fixed = {c.name: _wire_fixed(c) for c in schema}
     while pos < len(buf):
-        for c in schema:
-            is_nullable = nullable.get(c.name, False)
-            if is_nullable:
-                if buf[pos] == 1:
-                    cols[c.name].append(None)
-                    pos += 1
-                    continue
-                pos += 1
-            fx = fixed[c.name]
-            if fx is not None:
-                dt, width = fx
-                v = np.frombuffer(buf[pos:pos + width], dtype=dt)[0]
-                if c.data_type == CanonicalType.BOOLEAN:
-                    cols[c.name].append(bool(v))
-                elif c.data_type.is_float:
-                    cols[c.name].append(float(v))
-                else:
-                    cols[c.name].append(int(v))
-                pos += width
-            else:
-                ln = 0
-                shift = 0
-                while True:
-                    b = buf[pos]
-                    pos += 1
-                    ln |= (b & 0x7F) << shift
-                    if not b & 0x80:
-                        break
-                    shift += 7
-                raw = bytes(buf[pos:pos + ln])
-                pos += ln
-                if c.data_type == CanonicalType.STRING:
-                    cols[c.name].append(raw)
-                else:
-                    cols[c.name].append(raw.decode("utf-8", "replace"))
+        pos = _parse_row(buf, pos, schema, nullable, fixed, cols)
     return ColumnBatch.from_pydict(TableID("", "decoded"), schema, cols)
+
+
+def decode_rowbinary_stream(read_fn, schema,
+                            nullable: Optional[dict[str, bool]] = None,
+                            batch_rows: int = 131_072,
+                            chunk_bytes: int = 8 << 20):
+    """Incremental decode: read_fn(n) -> bytes ('' = EOF).  Yields
+    ColumnBatches of up to batch_rows rows in constant memory — partial
+    rows at chunk boundaries carry over to the next chunk."""
+    from transferia_tpu.abstract.schema import TableID
+
+    nullable = nullable or {}
+    fixed = {c.name: _wire_fixed(c) for c in schema}
+    leftover = b""
+    cols: dict[str, list] = {c.name: [] for c in schema}
+    rows = 0
+    eof = False
+    while not eof:
+        chunk = read_fn(chunk_bytes)
+        if not chunk:
+            eof = True
+        data = leftover + chunk if leftover else chunk
+        buf = memoryview(data)
+        pos = 0
+        while pos < len(buf):
+            row_start = pos
+            try:
+                pos = _parse_row(buf, pos, schema, nullable, fixed, cols)
+            except _NeedMore:
+                if eof:
+                    raise ValueError(
+                        "rowbinary stream truncated mid-row"
+                    ) from None
+                pos = row_start
+                break
+            rows += 1
+            if rows >= batch_rows:
+                yield ColumnBatch.from_pydict(
+                    TableID("", "decoded"), schema, cols
+                )
+                cols = {c.name: [] for c in schema}
+                rows = 0
+        leftover = bytes(buf[pos:])
+    if rows:
+        yield ColumnBatch.from_pydict(TableID("", "decoded"), schema, cols)

@@ -14,6 +14,7 @@ from typing import Optional
 from transferia_tpu.abstract.change_item import ChangeItem
 from transferia_tpu.abstract.interfaces import (
     Batch,
+    IncrementalStorage,
     Pusher,
     Sinker,
     Storage,
@@ -141,7 +142,10 @@ class MemorySinker(Sinker):
         self.store.push(batch)
 
 
-class MemoryStorage(Storage):
+class MemoryStorage(Storage, IncrementalStorage):
+    """Also implements IncrementalStorage and predicate filters so e2e
+    tests can exercise cursor-based snapshots without a real DB."""
+
     def __init__(self, params: MemorySourceParams):
         self.batches = _SOURCES.get(params.source_id, [])
 
@@ -166,8 +170,45 @@ class MemoryStorage(Storage):
         return self._by_table()[table][0].schema
 
     def load_table(self, table: TableDescription, pusher: Pusher) -> None:
+        mask_fn = None
+        if table.filter:
+            from transferia_tpu.predicate import compile_mask, parse
+
+            mask_fn = compile_mask(parse(table.filter))
         for b in self._by_table().get(table.id, []):
+            if mask_fn is not None:
+                b = b.filter(mask_fn(b))
+                if b.n_rows == 0:
+                    continue
             pusher(b)
+
+    # -- IncrementalStorage -------------------------------------------------
+    def get_increment_state(self, tables, state):
+        out = []
+        for t in tables:
+            cursor = state.get(str(t.table), t.initial_state or None)
+            if cursor is None or cursor == "":
+                out.append(TableDescription(id=t.table))
+            else:
+                lit = cursor if isinstance(cursor, (int, float)) \
+                    else f"'{cursor}'"
+                out.append(TableDescription(
+                    id=t.table, filter=f"{t.cursor_field} > {lit}"
+                ))
+        return out
+
+    def next_increment_state(self, tables):
+        out = {}
+        for t in tables:
+            best = None
+            for b in self._by_table().get(t.table, []):
+                if t.cursor_field in b.columns:
+                    for v in b.columns[t.cursor_field].to_pylist():
+                        if v is not None and (best is None or v > best):
+                            best = v
+            if best is not None:
+                out[str(t.table)] = best
+        return out
 
 
 @register_provider

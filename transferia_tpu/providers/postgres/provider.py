@@ -15,6 +15,7 @@ from typing import Optional
 
 from transferia_tpu.abstract.interfaces import (
     Batch,
+    IncrementalStorage,
     PositionalStorage,
     Pusher,
     ShardingStorage,
@@ -125,7 +126,8 @@ def _conn(params) -> PGConnection:
     ).connect()
 
 
-class PGStorage(Storage, ShardingStorage, PositionalStorage):
+class PGStorage(Storage, ShardingStorage, PositionalStorage,
+                IncrementalStorage):
     def __init__(self, params: PGSourceParams):
         self.params = params
         self._c: Optional[PGConnection] = None
@@ -201,6 +203,38 @@ class PGStorage(Storage, ShardingStorage, PositionalStorage):
             return {"wal_lsn": lsn}
         except PGError:
             return {}
+
+    # -- IncrementalStorage (storage_incremental.go) ------------------------
+    @staticmethod
+    def _cursor_literal(v) -> str:
+        if isinstance(v, (int, float)):
+            return str(v)
+        s = str(v).replace("'", "''")
+        return f"'{s}'"
+
+    def get_increment_state(self, tables, state):
+        out = []
+        for t in tables:
+            cursor = state.get(str(t.table), t.initial_state or None)
+            if cursor in (None, ""):
+                out.append(TableDescription(id=t.table))
+            else:
+                out.append(TableDescription(
+                    id=t.table,
+                    filter=f'"{t.cursor_field}" > '
+                           f"{self._cursor_literal(cursor)}",
+                ))
+        return out
+
+    def next_increment_state(self, tables):
+        out = {}
+        for t in tables:
+            v = self.conn.scalar(
+                f'SELECT max("{t.cursor_field}") FROM {t.table.fqtn()}'
+            )
+            if v is not None:
+                out[str(t.table)] = v
+        return out
 
     # -- intra-table sharding (postgres/splitter: ctid block ranges) --------
     def shard_table(self, table: TableDescription) -> list[TableDescription]:
@@ -454,6 +488,42 @@ class PostgresProvider(Provider):
         if isinstance(self.transfer.dst, PGTargetParams):
             return PGSinker(self.transfer.dst)
         return None
+
+    def source(self):
+        """Logical-replication CDC (publisher.go)."""
+        if isinstance(self.transfer.src, PGSourceParams):
+            from transferia_tpu.providers.postgres.replication import (
+                PGReplicationSource,
+            )
+
+            return PGReplicationSource(
+                self.transfer.src, self.transfer.id,
+                coordinator=self.coordinator,
+            )
+        return None
+
+    def deactivate(self) -> None:
+        """Drop the replication slot (postgres Deactivator)."""
+        from transferia_tpu.providers.postgres.replication import (
+            PGReplicationSource,
+            ReplicationConnection,
+        )
+
+        src = self.transfer.src
+        if not isinstance(src, PGSourceParams):
+            return
+        slot = src.slot_name or \
+            f"transferia_{self.transfer.id}".replace("-", "_")
+        conn = ReplicationConnection(
+            host=src.host, port=src.port, database=src.database,
+            user=src.user, password=src.password, replication=True,
+        ).connect()
+        try:
+            conn.drop_slot(slot)
+        except PGError as e:
+            logger.warning("drop slot %s: %s", slot, e)
+        finally:
+            conn.close()
 
     def cleanup(self, tables: list) -> None:
         params = self.transfer.dst

@@ -267,12 +267,29 @@ class PGConnection:
                 return
             # H (CopyOutResponse), c (CopyDone), C ignored
 
+    def _drain_until_ready(self, first_error: "PGError") -> None:
+        """Consume messages through ReadyForQuery so the connection stays
+        usable, then raise — an early raise leaves replies buffered and
+        every later query would read the previous query's responses."""
+        while True:
+            try:
+                t, _ = self._recv_message()
+            except PGError:
+                continue
+            if t == b"Z":
+                raise first_error
+
     def copy_in(self, sql: str, chunks) -> None:
         """COPY ... FROM STDIN: send chunks, finish, wait for commit."""
         self._send(b"Q", sql.encode() + b"\x00")
-        t, payload = self._recv_message()
+        try:
+            t, payload = self._recv_message()
+        except PGError as e:
+            self._drain_until_ready(e)
         if t != b"G":
-            raise PGError(f"expected CopyInResponse, got {t!r}")
+            self._drain_until_ready(
+                PGError(f"expected CopyInResponse, got {t!r}")
+            )
         for chunk in chunks:
             if chunk:
                 self._send(b"d", chunk)

@@ -44,9 +44,14 @@ class Provider(abc.ABC):
 
     NAME = ""
 
-    def __init__(self, transfer, metrics: Optional[Metrics] = None):
+    def __init__(self, transfer, metrics: Optional[Metrics] = None,
+                 coordinator=None):
         self.transfer = transfer
         self.metrics = metrics or Metrics()
+        # control-plane handle for sources that checkpoint positions
+        # (wal LSN, binlog pos, incremental cursors) — may be None for
+        # pure snapshot flows
+        self.coordinator = coordinator
 
     # -- capabilities (return None when unsupported) ------------------------
     def storage(self) -> Optional[Storage]:
@@ -98,8 +103,8 @@ def register_provider(cls: Type[Provider]) -> Type[Provider]:
     return cls
 
 
-def get_provider(name: str, transfer, metrics: Optional[Metrics] = None
-                 ) -> Provider:
+def get_provider(name: str, transfer, metrics: Optional[Metrics] = None,
+                 coordinator=None) -> Provider:
     cls = _PROVIDERS.get(name)
     if cls is None:
         from transferia_tpu.providers import load_builtin_providers
@@ -110,7 +115,7 @@ def get_provider(name: str, transfer, metrics: Optional[Metrics] = None
         raise KeyError(
             f"unknown provider {name!r}; registered: {sorted(_PROVIDERS)}"
         )
-    return cls(transfer, metrics)
+    return cls(transfer, metrics, coordinator)
 
 
 def registered_providers() -> list[str]:

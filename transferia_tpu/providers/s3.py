@@ -1,0 +1,358 @@
+"""S3 / object-storage provider.
+
+Reference parity: pkg/providers/s3/ — snapshot source with format readers
+(csv/json/line/parquet via reader/registry/), schema inference
+(reader/abstract.go:40-52), and the snapshot/replication sinks with file
+splitting (sink/file_splitter.go).  Storage access goes through fsspec, so
+the same provider serves s3://, gs://, and file:// URLs depending on which
+backends the environment ships (gcsfs is baked into this image; s3fs plugs
+in the same way).  Parquet objects stream row-group-parallel straight into
+columnar batches — the ClickBench snapshot path.
+
+The reference's SQS-event replication source (s3/source/) needs a queue
+feed; wire one by pointing an mq/kafka source at the bucket notification
+stream and a `blank` parser at the object keys.
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+from transferia_tpu.abstract.errors import CategorizedError
+from transferia_tpu.abstract.interfaces import (
+    Batch,
+    Pusher,
+    ShardingStorage,
+    Sinker,
+    Storage,
+    TableInfo,
+    is_columnar,
+)
+from transferia_tpu.abstract.kinds import Kind
+from transferia_tpu.abstract.schema import TableID, TableSchema
+from transferia_tpu.abstract.table import TableDescription
+from transferia_tpu.columnar.batch import ColumnBatch, arrow_to_table_schema
+from transferia_tpu.models.endpoint import EndpointParams, register_endpoint
+from transferia_tpu.providers.registry import (
+    Provider,
+    TestResult,
+    register_provider,
+)
+
+logger = logging.getLogger(__name__)
+
+
+@register_endpoint
+@dataclass
+class S3SourceParams(EndpointParams):
+    PROVIDER = "s3"
+    IS_SOURCE = True
+
+    url: str = ""              # e.g. s3://bucket/prefix/*.parquet
+    format: str = "parquet"    # parquet | jsonl | csv
+    table: str = "data"
+    namespace: str = "s3"
+    batch_rows: int = 65_536
+    endpoint_url: str = ""     # custom S3 endpoint (minio etc.)
+    anon: bool = True
+    storage_options: dict = field(default_factory=dict)
+
+
+@register_endpoint
+@dataclass
+class S3TargetParams(EndpointParams):
+    PROVIDER = "s3"
+    IS_TARGET = True
+
+    url: str = ""              # output directory URL
+    format: str = "parquet"    # parquet | jsonl
+    endpoint_url: str = ""
+    anon: bool = False
+    storage_options: dict = field(default_factory=dict)
+    max_rows_per_file: int = 1_000_000   # file splitting (file_splitter.go)
+
+
+def _fs_for(url: str, params) -> tuple[object, str]:
+    """fsspec filesystem + path for a URL."""
+    try:
+        import fsspec
+    except ImportError as e:  # pragma: no cover
+        raise CategorizedError(
+            CategorizedError.INTERNAL,
+            "fsspec is required for the s3 provider",
+        ) from e
+    opts = dict(params.storage_options or {})
+    if url.startswith("s3://"):
+        opts.setdefault("anon", params.anon)
+        if params.endpoint_url:
+            opts.setdefault("client_kwargs",
+                            {"endpoint_url": params.endpoint_url})
+    try:
+        fs, path = fsspec.core.url_to_fs(url, **opts)
+    except ImportError as e:
+        raise CategorizedError(
+            CategorizedError.SOURCE,
+            f"no fsspec backend for {url.split('://')[0]}:// "
+            f"(install s3fs/gcsfs): {e}",
+        ) from e
+    return fs, path
+
+
+class S3Storage(Storage, ShardingStorage):
+    def __init__(self, params: S3SourceParams):
+        self.params = params
+        self.table = TableID(params.namespace, params.table)
+        self._schema: Optional[TableSchema] = None
+        self._fs = None
+        self._files: Optional[list[str]] = None
+
+    @property
+    def fs(self):
+        if self._fs is None:
+            self._fs, self._root = _fs_for(self.params.url, self.params)
+        return self._fs
+
+    def files(self) -> list[str]:
+        if self._files is None:
+            fs = self.fs
+            if "*" in self._root or "?" in self._root:
+                found = sorted(fs.glob(self._root))
+            elif fs.isdir(self._root):
+                found = sorted(
+                    p for p in fs.find(self._root) if not p.endswith("/")
+                )
+            else:
+                found = [self._root] if fs.exists(self._root) else []
+            if not found:
+                raise FileNotFoundError(
+                    f"s3 source: no objects match {self.params.url!r}"
+                )
+            self._files = found
+        return self._files
+
+    # -- schema inference (reader/abstract.go:40-52) ------------------------
+    def table_schema(self, table: TableID) -> TableSchema:
+        if self._schema is None:
+            f = self.files()[0]
+            if self.params.format == "parquet":
+                import pyarrow.parquet as pq
+
+                with self.fs.open(f, "rb") as fh:
+                    self._schema = arrow_to_table_schema(
+                        pq.read_schema(fh)
+                    )
+            elif self.params.format == "csv":
+                import pyarrow.csv as pacsv
+
+                with self.fs.open(f, "rb") as fh:
+                    head = fh.read(1 << 20)
+                with pacsv.open_csv(io.BytesIO(head)) as reader:
+                    self._schema = arrow_to_table_schema(reader.schema)
+            else:
+                import pyarrow as pa
+
+                rows = []
+                with self.fs.open(f, "rb") as fh:
+                    for i, line in enumerate(fh):
+                        if i >= 100:
+                            break
+                        if line.strip():
+                            rows.append(json.loads(line))
+                self._schema = arrow_to_table_schema(
+                    pa.Table.from_pylist(rows).schema
+                )
+        return self._schema
+
+    def table_list(self, include=None):
+        if include and not any(
+                self.table.include_matches(p) for p in include):
+            return {}
+        eta = 0
+        if self.params.format == "parquet":
+            import pyarrow.parquet as pq
+
+            for f in self.files():
+                with self.fs.open(f, "rb") as fh:
+                    eta += pq.ParquetFile(fh).metadata.num_rows
+        return {self.table: TableInfo(
+            eta_rows=eta, schema=self.table_schema(self.table)
+        )}
+
+    def estimate_table_rows_count(self, table: TableID) -> int:
+        info = self.table_list().get(self.table)
+        return info.eta_rows if info else 0
+
+    def shard_table(self, table: TableDescription) -> list[TableDescription]:
+        out = []
+        for f in self.files():
+            eta = 0
+            if self.params.format == "parquet":
+                import pyarrow.parquet as pq
+
+                with self.fs.open(f, "rb") as fh:
+                    eta = pq.ParquetFile(fh).metadata.num_rows
+            out.append(TableDescription(id=table.id, filter=f"obj:{f}",
+                                        eta_rows=eta))
+        return out
+
+    def load_table(self, table: TableDescription, pusher: Pusher) -> None:
+        files = [table.filter[4:]] if table.filter.startswith("obj:") \
+            else self.files()
+        schema = self.table_schema(table.id)
+        for f in files:
+            self._load_object(f, table.id, schema, pusher)
+
+    def _load_object(self, path: str, tid: TableID, schema: TableSchema,
+                     pusher: Pusher) -> None:
+        fmt = self.params.format
+        if fmt == "parquet":
+            import pyarrow.parquet as pq
+
+            with self.fs.open(path, "rb") as fh:
+                pf = pq.ParquetFile(fh)
+                for rb in pf.iter_batches(
+                        batch_size=self.params.batch_rows):
+                    if rb.num_rows:
+                        batch = ColumnBatch.from_arrow(rb, tid, schema)
+                        batch.read_bytes = rb.nbytes
+                        pusher(batch)
+        elif fmt == "csv":
+            import pyarrow.csv as pacsv
+
+            with self.fs.open(path, "rb") as fh:
+                data = fh.read()
+            with pacsv.open_csv(io.BytesIO(data)) as reader:
+                for rb in reader:
+                    if rb.num_rows:
+                        batch = ColumnBatch.from_arrow(rb, tid, schema)
+                        batch.read_bytes = rb.nbytes
+                        pusher(batch)
+        else:  # jsonl
+            rows = []
+            nbytes = 0
+            with self.fs.open(path, "rb") as fh:
+                for line in fh:
+                    if not line.strip():
+                        continue
+                    rows.append(json.loads(line))
+                    nbytes += len(line)
+                    if len(rows) >= self.params.batch_rows:
+                        self._push_rows(rows, nbytes, tid, schema, pusher)
+                        rows, nbytes = [], 0
+            if rows:
+                self._push_rows(rows, nbytes, tid, schema, pusher)
+
+    @staticmethod
+    def _push_rows(rows, nbytes, tid, schema, pusher):
+        data = {c.name: [r.get(c.name) for r in rows] for c in schema}
+        batch = ColumnBatch.from_pydict(tid, schema, data)
+        batch.read_bytes = nbytes
+        pusher(batch)
+
+    def ping(self) -> None:
+        self.files()
+
+
+class S3Sinker(Sinker):
+    """Object sink with size-based file splitting (sink/file_splitter.go)."""
+
+    def __init__(self, params: S3TargetParams):
+        import uuid as _uuid
+
+        self.params = params
+        self.fs, self.root = _fs_for(params.url, params)
+        self.token = _uuid.uuid4().hex[:8]
+        self._counters: dict[TableID, int] = {}
+        self._rows_in_file: dict[TableID, int] = {}
+        self._writers: dict[TableID, object] = {}
+        self._handles: dict[TableID, object] = {}
+
+    def _next_path(self, tid: TableID, ext: str) -> str:
+        n = self._counters.get(tid, 0)
+        return f"{self.root.rstrip('/')}/" \
+               f"{tid.namespace}.{tid.name}.{self.token}.{n:06d}.{ext}"
+
+    def push(self, batch: Batch) -> None:
+        if not is_columnar(batch):
+            for it in batch:
+                if it.kind in (Kind.DONE_TABLE_LOAD,
+                               Kind.DONE_SHARDED_TABLE_LOAD):
+                    self._finish(it.table_id)
+            rows = [it for it in batch if it.is_row_event()]
+            if not rows:
+                return
+            batch = ColumnBatch.from_rows(rows)
+        tid = batch.table_id
+        if self.params.format == "parquet":
+            import pyarrow.parquet as pq
+
+            rb = batch.to_arrow()
+            w = self._writers.get(tid)
+            if w is None:
+                fh = self.fs.open(self._next_path(tid, "parquet"), "wb")
+                w = pq.ParquetWriter(fh, rb.schema)
+                self._writers[tid] = w
+                self._handles[tid] = fh
+                self._rows_in_file[tid] = 0
+            w.write_batch(rb)
+            self._rows_in_file[tid] += batch.n_rows
+            if self._rows_in_file[tid] >= self.params.max_rows_per_file:
+                self._finish(tid)
+        else:
+            # object stores have no append: keep one open handle per table
+            # and rotate whole objects at the row threshold
+            fh = self._handles.get(tid)
+            if fh is None:
+                fh = self.fs.open(self._next_path(tid, "jsonl"), "wb")
+                self._handles[tid] = fh
+                self._rows_in_file[tid] = 0
+            for row in batch.to_rows():
+                fh.write(json.dumps(
+                    row.as_dict(), default=str
+                ).encode() + b"\n")
+            self._rows_in_file[tid] += batch.n_rows
+            if self._rows_in_file[tid] >= self.params.max_rows_per_file:
+                self._finish(tid)
+
+    def _finish(self, tid: TableID) -> None:
+        w = self._writers.pop(tid, None)
+        if w is not None:
+            w.close()
+        fh = self._handles.pop(tid, None)
+        if fh is not None:
+            fh.close()
+        if w is not None or fh is not None:
+            self._counters[tid] = self._counters.get(tid, 0) + 1
+
+    def close(self) -> None:
+        for tid in set(list(self._writers) + list(self._handles)):
+            self._finish(tid)
+
+
+@register_provider
+class S3Provider(Provider):
+    NAME = "s3"
+
+    def storage(self):
+        if isinstance(self.transfer.src, S3SourceParams):
+            return S3Storage(self.transfer.src)
+        return None
+
+    def sinker(self):
+        if isinstance(self.transfer.dst, S3TargetParams):
+            return S3Sinker(self.transfer.dst)
+        return None
+
+    def test(self) -> TestResult:
+        result = TestResult(ok=True)
+        try:
+            if isinstance(self.transfer.src, S3SourceParams):
+                S3Storage(self.transfer.src).ping()
+            result.add("list")
+        except Exception as e:
+            result.add("list", e)
+        return result

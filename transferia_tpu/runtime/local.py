@@ -41,7 +41,8 @@ class LocalWorker:
         """Blocks until the source stops or fails (BasicStrategy.Run)."""
         self.sink = make_async_sink(self.transfer, self.metrics,
                                     snapshot_stage=False)
-        self.source = new_source(self.transfer, self.metrics)
+        self.source = new_source(self.transfer, self.metrics,
+                                 coordinator=self.cp)
         try:
             self.source.run(self.sink)
             # surface sink-side failures latched by the error tracker
@@ -110,6 +111,39 @@ def run_replication(transfer, coordinator: Coordinator,
                 coordinator.fail_replication(transfer.id, str(e))
                 raise
             stop_event.wait(backoff)
+
+
+def run_regular_snapshot(transfer, coordinator: Coordinator,
+                         metrics: Optional[Metrics] = None,
+                         stop_event: Optional[threading.Event] = None,
+                         max_runs: int = 0) -> None:
+    """Cron-driven re-snapshot loop (pkg/abstract/regular_snapshot.go +
+    helm CronJob).  Each tick runs an incremental-aware upload of all
+    tables; cursors persist through the coordinator."""
+    from transferia_tpu.tasks.snapshot import SnapshotLoader
+    from transferia_tpu.utils.cron import parse_cron
+
+    rs = transfer.regular_snapshot
+    if not rs.enabled or not rs.cron:
+        raise ValueError("transfer has no regular_snapshot cron configured")
+    spec = parse_cron(rs.cron)
+    stop_event = stop_event or threading.Event()
+    runs = 0
+    while not stop_event.is_set():
+        next_t = spec.next_after()
+        wait = max(0.0, next_t - time.time())
+        logger.info("regular snapshot: next run in %.0fs", wait)
+        if stop_event.wait(wait):
+            return
+        loader = SnapshotLoader(
+            transfer, coordinator,
+            operation_id=f"op-{transfer.id}-{int(next_t)}",
+            metrics=metrics,
+        )
+        loader.upload_tables()
+        runs += 1
+        if max_runs and runs >= max_runs:
+            return
 
 
 def _stop_on_event(stop_event: threading.Event, worker: LocalWorker) -> None:

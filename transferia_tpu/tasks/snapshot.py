@@ -28,6 +28,8 @@ from transferia_tpu.abstract.change_item import (
 )
 from transferia_tpu.abstract.errors import TableUploadError, is_fatal
 from transferia_tpu.abstract.interfaces import (
+    IncrementalStorage,
+    IncrementalTable,
     PositionalStorage,
     SnapshotableStorage,
     Storage,
@@ -92,6 +94,31 @@ class SnapshotLoader:
         out.sort(key=lambda t: -t.eta_rows)
         return out
 
+    # -- incremental cursors (load_snapshot_incremental.go) -----------------
+    def _incremental_tables(self) -> list[IncrementalTable]:
+        return [
+            IncrementalTable(TableID(c.namespace, c.name), c.cursor_field,
+                             c.initial_state)
+            for c in self.transfer.regular_snapshot.incremental
+        ]
+
+    def _apply_incremental(self, storage: Storage,
+                           tables: list[TableDescription]
+                           ) -> tuple[list[TableDescription], Optional[dict]]:
+        inc = self._incremental_tables()
+        if not inc or not isinstance(storage, IncrementalStorage):
+            return tables, None
+        # capture the next cursor BEFORE loading: rows arriving during the
+        # snapshot re-read next time instead of being skipped
+        next_state = storage.next_increment_state(inc)
+        state = self.cp.get_transfer_state(self.transfer.id).get(
+            "incremental_state", {}
+        )
+        filtered = {td.id: td for td in
+                    storage.get_increment_state(inc, state)}
+        merged = [filtered.get(td.id, td) for td in tables]
+        return merged, next_state
+
     # -- main worker ----------------------------------------------------------
     def _main_flow(self, storage: Storage,
                    tables: list[TableDescription]) -> None:
@@ -104,6 +131,7 @@ class SnapshotLoader:
                     self.cp.set_transfer_state(
                         self.transfer.id, {"snapshot_position": pos}
                     )
+            tables, next_inc_state = self._apply_incremental(storage, tables)
             parts = split_tables(storage, tables, self.transfer,
                                  self.operation_id)
             self.cp.create_operation_parts(self.operation_id, parts)
@@ -135,6 +163,13 @@ class SnapshotLoader:
                 resolve_all(futs)
             finally:
                 sink.close()
+            if next_inc_state is not None:
+                # persist cursors only after the whole snapshot succeeded
+                # (load_snapshot.go:228-240)
+                self.cp.set_transfer_state(
+                    self.transfer.id,
+                    {"incremental_state": next_inc_state},
+                )
         finally:
             if isinstance(storage, SnapshotableStorage):
                 storage.end_snapshot()
