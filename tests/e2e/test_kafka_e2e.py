@@ -1,0 +1,155 @@
+"""Kafka provider e2e over real sockets against the fake broker
+(cf. reference kafka2ch suites)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from transferia_tpu.abstract import TableID
+from transferia_tpu.coordinator import MemoryCoordinator
+from transferia_tpu.models import Transfer, TransferType
+from transferia_tpu.providers.kafka import (
+    KafkaSourceParams,
+    KafkaTargetParams,
+)
+from transferia_tpu.providers.kafka.client import KafkaClient
+from transferia_tpu.providers.kafka.protocol import (
+    Record,
+    decode_record_batches,
+    encode_record_batch,
+)
+from transferia_tpu.providers.memory import MemoryTargetParams, get_store
+from transferia_tpu.runtime import run_replication
+from tests.recipes.fake_kafka import FakeKafka
+
+
+def test_record_batch_roundtrip():
+    records = [
+        Record(key=b"k1", value=b"v1", timestamp_ms=1000),
+        Record(key=None, value=b"v2", timestamp_ms=1005,
+               headers=[(b"h", b"x")]),
+        Record(key=b"k3", value=None, timestamp_ms=1010),
+    ]
+    blob = encode_record_batch(records, base_offset=40)
+    back = decode_record_batches(blob)
+    assert [r.offset for r in back] == [40, 41, 42]
+    assert back[0].key == b"k1" and back[0].value == b"v1"
+    assert back[1].key is None and back[1].headers == [(b"h", b"x")]
+    assert back[2].value is None
+    assert [r.timestamp_ms for r in back] == [1000, 1005, 1010]
+
+
+def test_crc_validation():
+    blob = bytearray(encode_record_batch([Record(key=b"k", value=b"v")]))
+    blob[-1] ^= 0xFF  # corrupt payload
+    with pytest.raises(ValueError, match="CRC"):
+        decode_record_batches(bytes(blob))
+
+
+@pytest.fixture
+def broker():
+    srv = FakeKafka(n_partitions=2).start()
+    yield srv
+    srv.stop()
+
+
+def test_client_produce_fetch(broker):
+    client = KafkaClient([f"127.0.0.1:{broker.port}"])
+    meta = client.metadata(["t1"])
+    assert meta == {"t1": [0, 1]}
+    base = client.produce("t1", 0, [Record(key=b"a", value=b"1"),
+                                    Record(key=b"b", value=b"2")])
+    assert base == 0
+    base2 = client.produce("t1", 0, [Record(key=b"c", value=b"3")])
+    assert base2 == 2
+    records, high = client.fetch("t1", 0, 0)
+    assert [r.value for r in records] == [b"1", b"2", b"3"]
+    assert high == 3
+    # fetch from mid-offset
+    records, _ = client.fetch("t1", 0, 2)
+    assert [r.value for r in records] == [b"3"]
+    assert client.list_offsets("t1", 0, -1) == 3
+    assert client.list_offsets("t1", 0, -2) == 0
+    client.close()
+
+
+def test_kafka_replication_to_memory(broker):
+    client = KafkaClient([f"127.0.0.1:{broker.port}"])
+    for i in range(100):
+        client.produce("events", i % 2, [Record(
+            key=str(i).encode(),
+            value=json.dumps({"id": i, "v": f"x{i}"}).encode(),
+        )])
+    client.close()
+    store = get_store("ke2e")
+    store.clear()
+    cp = MemoryCoordinator()
+    t = Transfer(
+        id="ke2e", type=TransferType.INCREMENT_ONLY,
+        src=KafkaSourceParams(
+            brokers=[f"127.0.0.1:{broker.port}"], topic="events",
+            parser={"json": {"schema": [
+                {"name": "id", "type": "int64", "key": True},
+                {"name": "v", "type": "utf8"},
+            ], "table": "events"}},
+        ),
+        dst=MemoryTargetParams(sink_id="ke2e"),
+    )
+    stop = threading.Event()
+    th = threading.Thread(
+        target=run_replication, args=(t, cp),
+        kwargs={"stop_event": stop, "backoff": 0.1}, daemon=True,
+    )
+    th.start()
+    deadline = time.monotonic() + 20
+    while store.row_count() < 100 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert store.row_count() == 100
+    ids = sorted(r.value("id") for r in store.rows(TableID("", "events")))
+    assert ids == list(range(100))
+    # offsets checkpointed in the coordinator
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        state = cp.get_transfer_state("ke2e").get("kafka_offsets", {})
+        if state.get("events:0") == 49 and state.get("events:1") == 49:
+            break
+        time.sleep(0.05)
+    assert cp.get_transfer_state("ke2e")["kafka_offsets"] == {
+        "events:0": 49, "events:1": 49,
+    }
+    stop.set()
+    th.join(timeout=10)
+
+
+def test_kafka_sink_produces(broker):
+    from transferia_tpu.abstract.schema import new_table_schema
+    from transferia_tpu.columnar import ColumnBatch
+    from transferia_tpu.providers.kafka.provider import KafkaSinker
+
+    schema = new_table_schema([("id", "int64", True), ("name", "utf8")])
+    batch = ColumnBatch.from_pydict(TableID("s", "t"), schema, {
+        "id": list(range(10)), "name": [f"n{i}" for i in range(10)],
+    })
+    sinker = KafkaSinker(KafkaTargetParams(
+        brokers=[f"127.0.0.1:{broker.port}"], topic="out",
+        serializer="json", partition_by="id",
+    ))
+    sinker.push(batch)
+    sinker.close()
+    assert broker.size("out") == 10
+    vals = [json.loads(r.value) for p in (0, 1)
+            for r in broker.records("out", p)]
+    assert sorted(v["id"] for v in vals) == list(range(10))
+    # partitioning by id is deterministic: same batch -> same spread
+    p0 = {json.loads(r.value)["id"] for r in broker.records("out", 0)}
+    sinker2 = KafkaSinker(KafkaTargetParams(
+        brokers=[f"127.0.0.1:{broker.port}"], topic="out",
+        serializer="json", partition_by="id",
+    ))
+    sinker2.push(batch)
+    sinker2.close()
+    p0_after = {json.loads(r.value)["id"]
+                for r in broker.records("out", 0)}
+    assert p0 == p0_after

@@ -1,0 +1,210 @@
+"""In-process fake Kafka broker (wire-protocol subset).
+
+Server side of what the provider's client speaks: ApiVersions ignored,
+Metadata v1, Produce v3 (stores the raw record batch, re-serving it on
+fetch — a real broker does the same), Fetch v4, ListOffsets v1.
+"""
+
+from __future__ import annotations
+
+import socketserver
+import struct
+import threading
+from typing import Optional
+
+from transferia_tpu.providers.kafka.protocol import (
+    Reader,
+    decode_record_batches,
+    enc_str as _enc_str,
+    encode_record_batch,
+)
+
+
+class FakeKafka:
+    def __init__(self, n_partitions: int = 2,
+                 auto_create_topics: bool = True):
+        self.n_partitions = n_partitions
+        self.auto_create = auto_create_topics
+        # topic -> partition -> list[Record] (absolute offsets = index)
+        self.topics: dict[str, list[list]] = {}
+        self.lock = threading.RLock()
+        self.port = 0
+        self._srv = None
+
+    def create_topic(self, name: str,
+                     n_partitions: Optional[int] = None) -> None:
+        with self.lock:
+            if name not in self.topics:
+                self.topics[name] = [
+                    [] for _ in range(n_partitions or self.n_partitions)
+                ]
+
+    def records(self, topic: str, partition: int = 0) -> list:
+        with self.lock:
+            return list(self.topics.get(topic, [[]])[partition])
+
+    def size(self, topic: str) -> int:
+        with self.lock:
+            return sum(len(p) for p in self.topics.get(topic, []))
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "FakeKafka":
+        fake = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        raw = self._recv_exact(4)
+                        size = struct.unpack("!i", raw)[0]
+                        payload = self._recv_exact(size)
+                        resp = fake.handle_request(payload)
+                        self.request.sendall(
+                            struct.pack("!i", len(resp)) + resp
+                        )
+                except (ConnectionError, OSError):
+                    return
+
+            def _recv_exact(self, n):
+                out = b""
+                while len(out) < n:
+                    chunk = self.request.recv(n - len(out))
+                    if not chunk:
+                        raise ConnectionError()
+                    out += chunk
+                return out
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = Server(("127.0.0.1", 0), Handler)
+        self.port = self._srv.server_address[1]
+        threading.Thread(target=self._srv.serve_forever,
+                         daemon=True).start()
+        return self
+
+    def stop(self):
+        if self._srv:
+            self._srv.shutdown()
+
+    # -- dispatch -----------------------------------------------------------
+    def handle_request(self, payload: bytes) -> bytes:
+        r = Reader(payload)
+        api_key = r.i16()
+        api_version = r.i16()
+        corr = r.i32()
+        r.string()  # client id
+        body = {
+            3: self._metadata,
+            0: self._produce,
+            1: self._fetch,
+            2: self._list_offsets,
+        }.get(api_key, lambda _r: b"")(r)
+        return struct.pack("!i", corr) + body
+
+    def _metadata(self, r: Reader) -> bytes:
+        n = r.i32()
+        wanted = None
+        if n >= 0:
+            wanted = [r.string() for _ in range(n)]
+        with self.lock:
+            if wanted:
+                for t in wanted:
+                    if self.auto_create:
+                        self.create_topic(t)
+            names = wanted if wanted is not None else list(self.topics)
+            out = struct.pack("!i", 1)  # one broker
+            out += struct.pack("!i", 0) + _enc_str("127.0.0.1") \
+                + struct.pack("!i", self.port) + _enc_str(None)
+            out += struct.pack("!i", 0)  # controller
+            out += struct.pack("!i", len(names))
+            for name in names:
+                parts = self.topics.get(name)
+                err = 0 if parts is not None else 3
+                out += struct.pack("!h", err) + _enc_str(name) + b"\x00"
+                out += struct.pack("!i", len(parts or []))
+                for pid in range(len(parts or [])):
+                    out += struct.pack("!hiii", 0, pid, 0, 1)
+                    out += struct.pack("!i", 0)       # replicas
+                    out += struct.pack("!i", 0)       # isr
+        return out
+
+    def _produce(self, r: Reader) -> bytes:
+        r.string()           # transactional id
+        r.i16()              # acks
+        r.i32()              # timeout
+        out_topics = []
+        for _ in range(r.i32()):
+            topic = r.string()
+            for _ in range(r.i32()):
+                partition = r.i32()
+                blob = r.bytes_() or b""
+                records = decode_record_batches(blob)
+                with self.lock:
+                    self.create_topic(topic)
+                    plist = self.topics[topic][partition]
+                    base = len(plist)
+                    for i, rec in enumerate(records):
+                        rec.offset = base + i
+                        plist.append(rec)
+                out_topics.append((topic, partition, base))
+        out = struct.pack("!i", len(out_topics))
+        for topic, partition, base in out_topics:
+            out += _enc_str(topic) + struct.pack("!i", 1)
+            out += struct.pack("!ihqq", partition, 0, base, -1)
+        out += struct.pack("!i", 0)  # throttle
+        return out
+
+    def _list_offsets(self, r: Reader) -> bytes:
+        r.i32()  # replica id
+        out = b""
+        n_topics = r.i32()
+        out += struct.pack("!i", n_topics)
+        for _ in range(n_topics):
+            topic = r.string()
+            n_parts = r.i32()
+            out += _enc_str(topic) + struct.pack("!i", n_parts)
+            for _ in range(n_parts):
+                partition = r.i32()
+                ts = r.i64()
+                with self.lock:
+                    plist = self.topics.get(topic, [[]] * (partition + 1))
+                    n = len(plist[partition]) if partition < len(plist) \
+                        else 0
+                offset = 0 if ts == -2 else n
+                out += struct.pack("!ihqq", partition, 0, -1, offset)
+        return out
+
+    def _fetch(self, r: Reader) -> bytes:
+        r.i32()  # replica
+        r.i32()  # max wait
+        r.i32()  # min bytes
+        r.i32()  # max bytes
+        r.i8()   # isolation
+        n_topics = r.i32()
+        out = struct.pack("!i", 0)  # throttle
+        out += struct.pack("!i", n_topics)
+        for _ in range(n_topics):
+            topic = r.string()
+            n_parts = r.i32()
+            out += _enc_str(topic) + struct.pack("!i", n_parts)
+            for _ in range(n_parts):
+                partition = r.i32()
+                offset = r.i64()
+                r.i32()  # partition max bytes
+                with self.lock:
+                    plist = self.topics.get(topic)
+                    records = plist[partition][offset:offset + 1000] \
+                        if plist else []
+                    high = len(plist[partition]) if plist else 0
+                if records:
+                    blob = encode_record_batch(
+                        records, base_offset=records[0].offset
+                    )
+                else:
+                    blob = b""
+                out += struct.pack("!ihqq", partition, 0, high, high)
+                out += struct.pack("!i", 0)   # aborted txns
+                out += struct.pack("!i", len(blob)) + blob
+        return out

@@ -31,7 +31,10 @@ def load_builtin_providers() -> None:
         sample,
         stdout,
     )
-    try:
-        from transferia_tpu.providers import s3, clickhouse, kafka, postgres  # noqa: F401
-    except ImportError:  # pragma: no cover - optional deps during bring-up
-        pass
+    from transferia_tpu.providers import (  # noqa: F401
+        clickhouse,
+        kafka,
+        mysql,
+        postgres,
+        s3,
+    )

@@ -1,0 +1,21 @@
+"""Kafka provider.
+
+Reference parity: pkg/providers/kafka/ — source.go (fetch loop + inflight
+throttling + sequencer dedup), sink.go + writer/ (serializer-driven
+producer), partition_source.go (queue->S3 per-partition pipelines), mirror
+mode.  The client is a dependency-free implementation of the Kafka wire
+protocol (this image ships no Kafka client library): ApiVersions, Metadata,
+Produce/Fetch with record-batch v2 framing (zigzag varints, CRC32C), and
+ListOffsets.  Group membership is intentionally NOT used — offsets commit
+through the transfer coordinator like every other source checkpoint
+(transfer_state KV), which is exactly how the reference treats queue
+positions (at-least-once, commit after confirmed push).
+"""
+
+from transferia_tpu.providers.kafka.provider import (
+    KafkaProvider,
+    KafkaSourceParams,
+    KafkaTargetParams,
+)
+
+__all__ = ["KafkaProvider", "KafkaSourceParams", "KafkaTargetParams"]

@@ -1,0 +1,236 @@
+"""Minimal Kafka broker client: Metadata, Produce, Fetch, ListOffsets.
+
+Request framing: int32 size + apiKey(2) apiVersion(2) correlationId(4)
+clientId(STRING) + body.  API versions used are old-but-universally-
+supported non-flexible ones (Metadata v1, Produce v3, Fetch v4,
+ListOffsets v1) so the codec stays simple and works against any broker
+>= 0.11 as well as compatibility layers (Redpanda, the test fake).
+"""
+
+from __future__ import annotations
+
+import logging
+import socket
+import struct
+import threading
+from typing import Optional
+
+from transferia_tpu.abstract.errors import CategorizedError
+from transferia_tpu.providers.kafka.protocol import (
+    Reader,
+    Record,
+    decode_record_batches,
+    enc_bytes,
+    enc_str,
+    encode_record_batch,
+)
+
+logger = logging.getLogger(__name__)
+
+API_METADATA = 3
+API_PRODUCE = 0
+API_FETCH = 1
+API_LIST_OFFSETS = 2
+
+# Kafka error codes we interpret
+ERR_NONE = 0
+ERR_UNKNOWN_TOPIC = 3
+ERR_OFFSET_OUT_OF_RANGE = 1
+
+
+class KafkaError(CategorizedError):
+    def __init__(self, message: str, code: int = -1):
+        super().__init__(CategorizedError.SOURCE, message)
+        self.code = code
+
+
+class KafkaClient:
+    def __init__(self, brokers: list[str], client_id: str = "transferia-tpu",
+                 timeout: float = 30.0):
+        self.brokers = brokers
+        self.client_id = client_id
+        self.timeout = timeout
+        self._sock: Optional[socket.socket] = None
+        self._corr = 0
+        self._lock = threading.Lock()
+
+    # -- connection ---------------------------------------------------------
+    def _connect(self) -> socket.socket:
+        if self._sock is not None:
+            return self._sock
+        last: Optional[Exception] = None
+        for b in self.brokers:
+            host, _, port = b.partition(":")
+            try:
+                s = socket.create_connection(
+                    (host, int(port or 9092)), timeout=self.timeout
+                )
+                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+                self._sock = s
+                return s
+            except OSError as e:
+                last = e
+        raise KafkaError(f"no kafka broker reachable: {last}")
+
+    def close(self) -> None:
+        if self._sock is not None:
+            self._sock.close()
+            self._sock = None
+
+    def _roundtrip(self, api_key: int, api_version: int,
+                   body: bytes) -> Reader:
+        with self._lock:
+            sock = self._connect()
+            self._corr += 1
+            corr = self._corr
+            header = struct.pack("!hhi", api_key, api_version, corr) \
+                + enc_str(self.client_id)
+            msg = header + body
+            try:
+                sock.sendall(struct.pack("!i", len(msg)) + msg)
+                size = struct.unpack("!i", self._recv_exact(sock, 4))[0]
+                payload = self._recv_exact(sock, size)
+            except OSError as e:
+                self.close()
+                raise KafkaError(f"kafka io error: {e}") from e
+        r = Reader(payload)
+        got_corr = r.i32()
+        if got_corr != corr:
+            self.close()
+            raise KafkaError(
+                f"correlation mismatch: {got_corr} != {corr}"
+            )
+        return r
+
+    @staticmethod
+    def _recv_exact(sock: socket.socket, n: int) -> bytes:
+        out = b""
+        while len(out) < n:
+            chunk = sock.recv(n - len(out))
+            if not chunk:
+                raise OSError("kafka connection closed")
+            out += chunk
+        return out
+
+    # -- metadata -----------------------------------------------------------
+    def metadata(self, topics: Optional[list[str]] = None) -> dict:
+        """topic -> [partition ids] (Metadata v1)."""
+        if topics is None:
+            body = struct.pack("!i", -1)
+        else:
+            body = struct.pack("!i", len(topics))
+            for t in topics:
+                body += enc_str(t)
+        r = self._roundtrip(API_METADATA, 1, body)
+        n_brokers = r.i32()
+        for _ in range(n_brokers):
+            r.i32()          # node id
+            r.string()       # host
+            r.i32()          # port
+            r.string()       # rack
+        r.i32()              # controller id
+        n_topics = r.i32()
+        out: dict[str, list[int]] = {}
+        for _ in range(n_topics):
+            err = r.i16()
+            name = r.string()
+            r.i8()           # is_internal
+            n_parts = r.i32()
+            parts = []
+            for _ in range(n_parts):
+                r.i16()      # partition error
+                pid = r.i32()
+                r.i32()      # leader
+                for _ in range(r.i32()):
+                    r.i32()  # replicas
+                for _ in range(r.i32()):
+                    r.i32()  # isr
+                parts.append(pid)
+            if err == ERR_NONE and name is not None:
+                out[name] = sorted(parts)
+        return out
+
+    # -- produce ------------------------------------------------------------
+    def produce(self, topic: str, partition: int,
+                records: list[Record], acks: int = -1,
+                timeout_ms: int = 30_000) -> int:
+        """Append records; returns the base offset assigned (Produce v3)."""
+        batch = encode_record_batch(records)
+        body = enc_str(None)                      # transactional id
+        body += struct.pack("!hi", acks, timeout_ms)
+        body += struct.pack("!i", 1) + enc_str(topic)
+        body += struct.pack("!i", 1) + struct.pack("!i", partition)
+        body += enc_bytes(batch)
+        r = self._roundtrip(API_PRODUCE, 3, body)
+        n_topics = r.i32()
+        base_offset = -1
+        for _ in range(n_topics):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()              # partition
+                err = r.i16()
+                base_offset = r.i64()
+                r.i64()              # log append time
+                if err != ERR_NONE:
+                    raise KafkaError(f"produce failed: error {err}",
+                                     code=err)
+        r.i32()  # throttle
+        return base_offset
+
+    # -- offsets ------------------------------------------------------------
+    def list_offsets(self, topic: str, partition: int,
+                     timestamp: int = -2) -> int:
+        """-2 = earliest, -1 = latest (ListOffsets v1)."""
+        body = struct.pack("!i", -1)              # replica id
+        body += struct.pack("!i", 1) + enc_str(topic)
+        body += struct.pack("!i", 1)
+        body += struct.pack("!iq", partition, timestamp)
+        r = self._roundtrip(API_LIST_OFFSETS, 1, body)
+        offset = 0
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()
+                err = r.i16()
+                r.i64()              # timestamp
+                offset = r.i64()
+                if err != ERR_NONE:
+                    raise KafkaError(f"list_offsets failed: {err}",
+                                     code=err)
+        return offset
+
+    # -- fetch --------------------------------------------------------------
+    def fetch(self, topic: str, partition: int, offset: int,
+              max_bytes: int = 8 << 20,
+              max_wait_ms: int = 250) -> tuple[list[Record], int]:
+        """Returns (records, high_watermark) from the given offset
+        (Fetch v4)."""
+        body = struct.pack("!iiii", -1, max_wait_ms, 1, max_bytes)
+        body += b"\x00"                           # isolation level
+        body += struct.pack("!i", 1) + enc_str(topic)
+        body += struct.pack("!i", 1)
+        body += struct.pack("!iqi", partition, offset, max_bytes)
+        r = self._roundtrip(API_FETCH, 4, body)
+        r.i32()  # throttle
+        records: list[Record] = []
+        high = 0
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()              # partition
+                err = r.i16()
+                high = r.i64()
+                r.i64()              # last stable offset
+                for _ in range(r.i32()):
+                    r.i64()          # aborted txn producer id
+                    r.i64()          # first offset
+                blob = r.bytes_() or b""
+                if err == ERR_OFFSET_OUT_OF_RANGE:
+                    raise KafkaError("offset out of range", code=err)
+                if err != ERR_NONE:
+                    raise KafkaError(f"fetch failed: error {err}",
+                                     code=err)
+                records.extend(decode_record_batches(blob))
+        # the broker may return records below the requested offset (batch
+        # alignment); trim client-side
+        return [rec for rec in records if rec.offset >= offset], high

@@ -1,0 +1,252 @@
+"""Kafka wire protocol primitives: framing, record batches v2, codecs.
+
+Binary conventions: big-endian fixed ints; STRING = int16 len + utf8
+(-1 = null); BYTES = int32 len + data (-1 = null); record-batch internals
+use zigzag varints.  CRC32C (Castagnoli) covers the batch from the
+attributes field onward.
+"""
+
+from __future__ import annotations
+
+import struct
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+try:
+    import google_crc32c
+
+    def crc32c(data: bytes) -> int:
+        return google_crc32c.value(data)
+except ImportError:  # pragma: no cover - slow pure-python fallback
+    def _make_table():
+        poly = 0x82F63B78
+        table = []
+        for n in range(256):
+            c = n
+            for _ in range(8):
+                c = (c >> 1) ^ poly if c & 1 else c >> 1
+            table.append(c)
+        return table
+
+    _TABLE = _make_table()
+
+    def crc32c(data: bytes) -> int:
+        crc = 0xFFFFFFFF
+        for b in data:
+            crc = _TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
+        return crc ^ 0xFFFFFFFF
+
+
+# -- primitive codecs --------------------------------------------------------
+
+def enc_str(s: Optional[str]) -> bytes:
+    if s is None:
+        return struct.pack("!h", -1)
+    b = s.encode()
+    return struct.pack("!h", len(b)) + b
+
+
+def enc_bytes(b: Optional[bytes]) -> bytes:
+    if b is None:
+        return struct.pack("!i", -1)
+    return struct.pack("!i", len(b)) + b
+
+
+def enc_varint(n: int) -> bytes:
+    """Zigzag varint."""
+    z = (n << 1) ^ (n >> 63)
+    out = b""
+    while True:
+        b = z & 0x7F
+        z >>= 7
+        if z:
+            out += bytes([b | 0x80])
+        else:
+            return out + bytes([b])
+
+
+class Reader:
+    __slots__ = ("buf", "pos")
+
+    def __init__(self, buf: bytes, pos: int = 0):
+        self.buf = buf
+        self.pos = pos
+
+    def i8(self) -> int:
+        v = struct.unpack_from("!b", self.buf, self.pos)[0]
+        self.pos += 1
+        return v
+
+    def i16(self) -> int:
+        v = struct.unpack_from("!h", self.buf, self.pos)[0]
+        self.pos += 2
+        return v
+
+    def i32(self) -> int:
+        v = struct.unpack_from("!i", self.buf, self.pos)[0]
+        self.pos += 4
+        return v
+
+    def i64(self) -> int:
+        v = struct.unpack_from("!q", self.buf, self.pos)[0]
+        self.pos += 8
+        return v
+
+    def string(self) -> Optional[str]:
+        n = self.i16()
+        if n < 0:
+            return None
+        s = self.buf[self.pos:self.pos + n].decode()
+        self.pos += n
+        return s
+
+    def bytes_(self) -> Optional[bytes]:
+        n = self.i32()
+        if n < 0:
+            return None
+        b = self.buf[self.pos:self.pos + n]
+        self.pos += n
+        return bytes(b)
+
+    def varint(self) -> int:
+        z = 0
+        shift = 0
+        while True:
+            b = self.buf[self.pos]
+            self.pos += 1
+            z |= (b & 0x7F) << shift
+            if not b & 0x80:
+                break
+            shift += 7
+        return (z >> 1) ^ -(z & 1)
+
+    def remaining(self) -> int:
+        return len(self.buf) - self.pos
+
+
+# -- record batches v2 -------------------------------------------------------
+
+@dataclass
+class Record:
+    key: Optional[bytes]
+    value: Optional[bytes]
+    offset: int = 0
+    timestamp_ms: int = 0
+    headers: list = field(default_factory=list)
+
+
+def encode_record_batch(records: list[Record],
+                        base_offset: int = 0) -> bytes:
+    """Records -> one RecordBatch v2 blob."""
+    now = int(time.time() * 1000)
+    base_ts = records[0].timestamp_ms or now if records else now
+    recs = b""
+    for i, r in enumerate(records):
+        body = b"\x00"  # attributes
+        body += enc_varint((r.timestamp_ms or now) - base_ts)
+        body += enc_varint(i)  # offset delta
+        if r.key is None:
+            body += enc_varint(-1)
+        else:
+            body += enc_varint(len(r.key)) + r.key
+        if r.value is None:
+            body += enc_varint(-1)
+        else:
+            body += enc_varint(len(r.value)) + r.value
+        body += enc_varint(len(r.headers))
+        for hk, hv in r.headers:
+            body += enc_varint(len(hk)) + hk
+            body += enc_varint(len(hv)) + hv
+        recs += enc_varint(len(body)) + body
+    # batch body after the crc field
+    after_crc = (
+        struct.pack("!h", 0)                       # attributes
+        + struct.pack("!i", max(0, len(records) - 1))  # lastOffsetDelta
+        + struct.pack("!q", base_ts)
+        + struct.pack("!q", (records[-1].timestamp_ms or now)
+                      if records else now)
+        + struct.pack("!q", -1)                    # producerId
+        + struct.pack("!h", -1)                    # producerEpoch
+        + struct.pack("!i", -1)                    # baseSequence
+        + struct.pack("!i", len(records))
+        + recs
+    )
+    header = (
+        struct.pack("!i", 0)       # partitionLeaderEpoch
+        + b"\x02"                  # magic
+        + struct.pack("!I", crc32c(after_crc))
+    )
+    batch_len = len(header) + len(after_crc)
+    return struct.pack("!q", base_offset) + struct.pack("!i", batch_len) \
+        + header + after_crc
+
+
+def decode_record_batches(data: bytes) -> list[Record]:
+    """RecordBatch v2 blob(s) -> Records with absolute offsets."""
+    out: list[Record] = []
+    pos = 0
+    n = len(data)
+    while pos + 12 <= n:
+        base_offset, batch_len = struct.unpack_from("!qi", data, pos)
+        end = pos + 12 + batch_len
+        if end > n:
+            break  # partial batch at the end of a fetch response
+        r = Reader(data, pos + 12)
+        r.i32()            # partitionLeaderEpoch
+        magic = r.i8()
+        if magic != 2:
+            raise ValueError(f"unsupported record batch magic {magic}")
+        expect_crc = struct.unpack_from("!I", data, r.pos)[0]
+        r.pos += 4
+        if crc32c(data[r.pos:end]) != expect_crc:
+            raise ValueError("record batch CRC mismatch")
+        attributes = r.i16()
+        if attributes & 0x07:
+            raise ValueError(
+                f"compressed record batch (codec {attributes & 0x07}) not "
+                f"supported — configure the topic/producers for "
+                f"uncompressed delivery to this consumer"
+            )
+        r.i32()            # lastOffsetDelta
+        base_ts = r.i64()
+        r.i64()            # maxTimestamp
+        r.i64()            # producerId
+        r.i16()            # producerEpoch
+        r.i32()            # baseSequence
+        count = r.i32()
+        for _ in range(count):
+            r.varint()                 # record length
+            r.i8()                     # attributes
+            ts_delta = r.varint()
+            off_delta = r.varint()
+            klen = r.varint()
+            key = None
+            if klen >= 0:
+                key = bytes(r.buf[r.pos:r.pos + klen])
+                r.pos += klen
+            vlen = r.varint()
+            value = None
+            if vlen >= 0:
+                value = bytes(r.buf[r.pos:r.pos + vlen])
+                r.pos += vlen
+            hcount = r.varint()
+            headers = []
+            for _ in range(hcount):
+                hklen = r.varint()
+                hk = bytes(r.buf[r.pos:r.pos + hklen])
+                r.pos += hklen
+                hvlen = r.varint()
+                hv = b""
+                if hvlen >= 0:
+                    hv = bytes(r.buf[r.pos:r.pos + hvlen])
+                    r.pos += hvlen
+                headers.append((hk, hv))
+            out.append(Record(
+                key=key, value=value,
+                offset=base_offset + off_delta,
+                timestamp_ms=base_ts + ts_delta,
+                headers=headers,
+            ))
+        pos = end
+    return out

@@ -1,0 +1,226 @@
+"""Kafka source/sink over the wire client.
+
+The source composes the shared QueueSource machinery (sequencer +
+parsequeue + post-push commits); offsets checkpoint through the transfer
+coordinator (kafka/source.go commits after push :251 — at-least-once).
+The sink serializes batches and produces per partition, reusing the
+column-hash partitioner when configured.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from dataclasses import dataclass, field
+from typing import Optional
+
+from transferia_tpu.abstract.interfaces import Batch, Sinker, is_columnar
+from transferia_tpu.coordinator.interface import Coordinator
+from transferia_tpu.models.endpoint import EndpointParams, register_endpoint
+from transferia_tpu.parsers import Message
+from transferia_tpu.providers.kafka.client import KafkaClient, KafkaError
+from transferia_tpu.providers.kafka.protocol import Record
+from transferia_tpu.providers.queue_common import FetchedBatch, QueueSource
+from transferia_tpu.providers.registry import (
+    Provider,
+    TestResult,
+    register_provider,
+)
+from transferia_tpu.serializers import make_queue_serializer
+from transferia_tpu.transform.plugins.sharder import hash_column_to_shards
+
+logger = logging.getLogger(__name__)
+
+
+@register_endpoint
+@dataclass
+class KafkaSourceParams(EndpointParams):
+    PROVIDER = "kafka"
+    IS_SOURCE = True
+
+    brokers: list[str] = field(default_factory=lambda: ["localhost:9092"])
+    topic: str = ""
+    parser: Optional[dict] = None
+    parallelism: int = 4
+    max_bytes_per_fetch: int = 8 << 20
+    start_from: str = "earliest"   # earliest | latest
+
+    def parser_config(self):
+        return self.parser
+
+
+@register_endpoint
+@dataclass
+class KafkaTargetParams(EndpointParams):
+    PROVIDER = "kafka"
+    IS_TARGET = True
+
+    brokers: list[str] = field(default_factory=lambda: ["localhost:9092"])
+    topic: str = ""               # "" -> per-table "<ns>.<name>"
+    serializer: str = "json"
+    serializer_config: dict = field(default_factory=dict)
+    partition_by: str = ""
+
+
+class _KafkaQueueClient:
+    """QueueSource client contract over KafkaClient with coordinator-backed
+    offset checkpoints (state key kafka_offsets)."""
+
+    STATE_KEY = "kafka_offsets"
+
+    def __init__(self, params: KafkaSourceParams, transfer_id: str,
+                 coordinator: Optional[Coordinator]):
+        self.params = params
+        self.transfer_id = transfer_id
+        self.cp = coordinator
+        self.client = KafkaClient(params.brokers)
+        meta = self.client.metadata([params.topic])
+        partitions = meta.get(params.topic)
+        if not partitions:
+            raise KafkaError(f"topic {params.topic!r} not found")
+        saved = {}
+        if self.cp is not None:
+            saved = self.cp.get_transfer_state(transfer_id).get(
+                self.STATE_KEY, {}
+            )
+        self.positions: dict[int, int] = {}
+        for p in partitions:
+            key = f"{params.topic}:{p}"
+            if key in saved:
+                self.positions[p] = int(saved[key]) + 1
+            else:
+                ts = -2 if params.start_from == "earliest" else -1
+                self.positions[p] = self.client.list_offsets(
+                    params.topic, p, ts
+                )
+        self._lock = threading.Lock()
+
+    def fetch(self, max_messages: int = 1024) -> list[FetchedBatch]:
+        out = []
+        for p in sorted(self.positions):
+            records, high = self.client.fetch(
+                self.params.topic, p, self.positions[p],
+                max_bytes=self.params.max_bytes_per_fetch,
+            )
+            if not records:
+                continue
+            records = records[:max_messages]
+            self.positions[p] = records[-1].offset + 1
+            out.append(FetchedBatch(
+                self.params.topic, p,
+                [
+                    Message(
+                        value=r.value or b"", key=r.key or b"",
+                        topic=self.params.topic, partition=p,
+                        offset=r.offset,
+                        write_time_ns=r.timestamp_ms * 1_000_000,
+                        headers=tuple(r.headers),
+                    )
+                    for r in records
+                ],
+            ))
+        return out
+
+    def commit(self, topic: str, partition: int, offset: int) -> None:
+        if self.cp is None:
+            return
+        with self._lock:
+            state = self.cp.get_transfer_state(self.transfer_id).get(
+                self.STATE_KEY, {}
+            )
+            state[f"{topic}:{partition}"] = offset
+            self.cp.set_transfer_state(
+                self.transfer_id, {self.STATE_KEY: state}
+            )
+
+    def close(self) -> None:
+        self.client.close()
+
+
+class KafkaSinker(Sinker):
+    def __init__(self, params: KafkaTargetParams):
+        self.params = params
+        self.client = KafkaClient(params.brokers)
+        self.serializer = make_queue_serializer(
+            params.serializer, **(params.serializer_config or {})
+        )
+        self._partitions: dict[str, list[int]] = {}
+
+    def _topic_partitions(self, topic: str) -> list[int]:
+        if topic not in self._partitions:
+            meta = self.client.metadata([topic])
+            self._partitions[topic] = meta.get(topic) or [0]
+        return self._partitions[topic]
+
+    def push(self, batch: Batch) -> None:
+        pairs = self.serializer.serialize_messages(batch)
+        if not pairs:
+            return
+        if is_columnar(batch):
+            topic = self.params.topic or str(batch.table_id)
+        else:
+            rows = [it for it in batch if it.is_row_event()]
+            topic = self.params.topic or (
+                str(rows[0].table_id) if rows else "controls"
+            )
+        partitions = self._topic_partitions(topic)
+        n_parts = len(partitions)
+        per_partition: dict[int, list[Record]] = {}
+        col_parts = None
+        if is_columnar(batch) and self.params.partition_by and \
+                self.params.partition_by in batch.columns and \
+                len(pairs) == batch.n_rows:
+            col_parts = hash_column_to_shards(
+                batch.column(self.params.partition_by), n_parts
+            )
+        from transferia_tpu.providers.kafka.protocol import crc32c
+
+        for i, (key, value) in enumerate(pairs):
+            if col_parts is not None:
+                p = partitions[int(col_parts[i])]
+            else:
+                # deterministic key hash: built-in hash() is randomized per
+                # process and would break per-key partition affinity across
+                # restarts
+                p = partitions[crc32c(bytes(key or b"")) % n_parts]
+            per_partition.setdefault(p, []).append(
+                Record(key=key, value=value)
+            )
+        for p, records in per_partition.items():
+            self.client.produce(topic, p, records)
+
+    def close(self) -> None:
+        self.client.close()
+
+
+@register_provider
+class KafkaProvider(Provider):
+    NAME = "kafka"
+
+    def source(self):
+        if isinstance(self.transfer.src, KafkaSourceParams):
+            p = self.transfer.src
+            client = _KafkaQueueClient(p, self.transfer.id,
+                                       self.coordinator)
+            return QueueSource(client, p.parser,
+                               parallelism=p.parallelism,
+                               metrics=self.metrics)
+        return None
+
+    def sinker(self):
+        if isinstance(self.transfer.dst, KafkaTargetParams):
+            return KafkaSinker(self.transfer.dst)
+        return None
+
+    def test(self) -> TestResult:
+        result = TestResult(ok=True)
+        params = self.transfer.src if isinstance(
+            self.transfer.src, KafkaSourceParams) else self.transfer.dst
+        try:
+            client = KafkaClient(params.brokers)
+            client.metadata()
+            client.close()
+            result.add("metadata")
+        except Exception as e:
+            result.add("metadata", e)
+        return result

@@ -1,0 +1,19 @@
+"""MySQL provider.
+
+Reference parity: pkg/providers/mysql/ — snapshot storage (storage.go,
+sharded reads), schema discovery, typesystem rules; binlog replication
+(canal.go) tracks gtid/binlog positions in the coordinator
+(coordinator/transfer_state.go:17-25 MysqlGtidState).  The client speaks
+the MySQL client/server protocol directly (handshake v10,
+mysql_native_password + caching_sha2_password fast path, COM_QUERY text
+resultsets).  Binlog ROW-event decoding is the remaining CDC gap — the
+position plumbing (gtid state keys) is already in place for it.
+"""
+
+from transferia_tpu.providers.mysql.provider import (
+    MySQLProvider,
+    MySQLSourceParams,
+    MySQLTargetParams,
+)
+
+__all__ = ["MySQLProvider", "MySQLSourceParams", "MySQLTargetParams"]
