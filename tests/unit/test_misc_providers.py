@@ -1,0 +1,157 @@
+"""Breadth providers: registration, delta log resolution, elastic client
+against a tiny fake, gating errors."""
+
+import json
+
+import pytest
+
+from transferia_tpu.abstract import TableID
+from transferia_tpu.abstract.table import TableDescription
+from transferia_tpu.providers.registry import registered_providers
+
+
+def test_provider_inventory():
+    names = set(registered_providers())
+    expected = {
+        "sample", "stdout", "devnull", "memory", "fs", "mq", "s3",
+        "ch", "pg", "mysql", "kafka", "greenplum", "elastic",
+        "opensearch", "bigquery", "delta", "coralogix", "datadog",
+        "airbyte",
+    }
+    missing = expected - names
+    assert not missing, f"missing providers: {missing}"
+
+
+def test_delta_source_reads_live_files(tmp_path):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    from transferia_tpu.providers.misc_providers import (
+        DeltaSourceParams,
+        DeltaStorage,
+    )
+
+    root = tmp_path / "dtable"
+    (root / "_delta_log").mkdir(parents=True)
+    # two data files; one later removed by the log
+    for name, ids in (("part-0.parquet", [1, 2]),
+                      ("part-1.parquet", [3, 4]),
+                      ("part-2.parquet", [9, 9])):
+        pq.write_table(pa.table({"id": ids}), root / name)
+    (root / "_delta_log" / "00000000000000000000.json").write_text(
+        "\n".join([
+            json.dumps({"metaData": {"id": "t"}}),
+            json.dumps({"add": {"path": "part-0.parquet"}}),
+            json.dumps({"add": {"path": "part-2.parquet"}}),
+        ])
+    )
+    (root / "_delta_log" / "00000000000000000001.json").write_text(
+        "\n".join([
+            json.dumps({"add": {"path": "part-1.parquet"}}),
+            json.dumps({"remove": {"path": "part-2.parquet"}}),
+        ])
+    )
+    storage = DeltaStorage(DeltaSourceParams(path=str(root), table="d"))
+    tid = TableID("", "d")
+    assert storage.table_list()[tid].eta_rows == 4
+    got = []
+    storage.load_table(TableDescription(id=tid), got.append)
+    ids = sorted(v for b in got for v in b.to_pydict()["id"])
+    assert ids == [1, 2, 3, 4]  # removed file's 9s are gone
+
+
+def test_airbyte_gated():
+    from transferia_tpu.providers.misc_providers import (
+        AirbyteSourceParams,
+        AirbyteStorage,
+    )
+
+    st = AirbyteStorage(AirbyteSourceParams(image="airbyte/source-x"))
+    with pytest.raises(NotImplementedError, match="container runtime"):
+        st.table_list()
+
+
+def test_elastic_roundtrip_with_fake():
+    import threading
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    docs: dict[str, dict] = {}
+
+    class Handler(BaseHTTPRequestHandler):
+        def _send(self, obj, status=200):
+            body = json.dumps(obj).encode()
+            self.send_response(status)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):
+            if self.path == "/":
+                return self._send({"version": {"number": "8.0-fake"}})
+            if self.path == "/_cat/indices?format=json":
+                return self._send([{"index": "logs"}])
+            if self.path.endswith("/_count"):
+                return self._send({"count": len(docs)})
+            return self._send({}, 404)
+
+        def do_POST(self):
+            length = int(self.headers.get("Content-Length") or 0)
+            body = self.rfile.read(length)
+            if self.path == "/_bulk":
+                lines = [l for l in body.split(b"\n") if l.strip()]
+                items = []
+                for a, d in zip(lines[::2], lines[1::2]):
+                    action = json.loads(a)["index"]
+                    docs[action.get("_id", str(len(docs)))] = json.loads(d)
+                    items.append({"index": {"status": 201}})
+                return self._send({"errors": False, "items": items})
+            if self.path.endswith("/_search"):
+                req = json.loads(body)
+                hits = [
+                    {"_id": k, "_index": "logs", "_source": v,
+                     "sort": [k]}
+                    for k, v in sorted(docs.items())
+                ]
+                after = req.get("search_after")
+                if after:
+                    hits = [h for h in hits if h["sort"] > after]
+                hits = hits[:req.get("size", 10)]
+                return self._send({"hits": {"hits": hits}})
+            return self._send({}, 404)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        from transferia_tpu.abstract.schema import new_table_schema
+        from transferia_tpu.columnar import ColumnBatch
+        from transferia_tpu.providers.elastic import (
+            ESStorage,
+            ESSinker,
+            ElasticSourceParams,
+            ElasticTargetParams,
+        )
+
+        port = srv.server_address[1]
+        sinker = ESSinker(ElasticTargetParams(host="127.0.0.1", port=port,
+                                              secure=False))
+        schema = new_table_schema([("id", "int64", True), ("msg", "utf8")])
+        sinker.push(ColumnBatch.from_pydict(TableID("", "logs"), schema, {
+            "id": [1, 2], "msg": ["hello", "world"],
+        }))
+        assert len(docs) == 2
+        assert docs["1"]["msg"] == "hello"
+
+        storage = ESStorage(ElasticSourceParams(host="127.0.0.1",
+                                                port=port, secure=False,
+                                                batch_rows=1))
+        tid = TableID("", "logs")
+        assert storage.table_list()[tid].eta_rows == 2
+        got = []
+        storage.load_table(TableDescription(id=tid), got.append)
+        all_docs = [d for b in got for d in b.to_pydict()["doc"]]
+        assert {d["msg"] for d in all_docs} == {"hello", "world"}
+    finally:
+        srv.shutdown()

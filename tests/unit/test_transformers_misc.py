@@ -1,0 +1,136 @@
+"""Remaining transformer plugins (batch_splitter, jsonparser, groupers...)."""
+
+import json
+
+import pytest
+
+from transferia_tpu.abstract import TableID
+from transferia_tpu.abstract.schema import new_table_schema
+from transferia_tpu.columnar import ColumnBatch
+from transferia_tpu.transform import build_chain, registered_transformers
+
+
+TID = TableID("m", "t")
+
+
+def test_all_reference_transformers_registered():
+    names = set(registered_transformers())
+    expected = {
+        "batch_splitter", "clickhouse_sql", "custom", "dbt", "filter_columns",
+        "filter_rows", "filter_rows_by_ids", "jsonparser", "lambda",
+        "logger", "mask_field", "mongo_pk_extender", "number_to_float",
+        "problem_item_detector", "raw_doc_grouper", "raw_cdc_doc_grouper",
+        "regex_replace", "rename_tables", "rename_columns",
+        "replace_primary_key", "sharder", "table_splitter", "to_datetime",
+        "to_string", "yt_dict",
+    }
+    missing = expected - names - {"clickhouse_sql"}
+    assert not missing, f"missing transformers: {missing}"
+
+
+def test_batch_splitter():
+    schema = new_table_schema([("id", "int64", True)])
+    b = ColumnBatch.from_pydict(TID, schema, {"id": list(range(25))})
+    chain = build_chain({"transformers": [
+        {"batch_splitter": {"max_rows": 10}},
+    ]})
+    out = chain.apply(b)
+    # heterogeneous multi-output comes back as rows, all 25 present
+    ids = [it.value("id") for it in out]
+    assert sorted(ids) == list(range(25))
+
+
+def test_regex_replace():
+    schema = new_table_schema([("id", "int64", True), ("email", "utf8")])
+    b = ColumnBatch.from_pydict(TID, schema, {
+        "id": [1, 2], "email": ["a@x.com", "b@y.org"],
+    })
+    chain = build_chain({"transformers": [
+        {"regex_replace": {"columns": ["email"], "pattern": "@.*$",
+                           "replacement": "@***"}},
+    ]})
+    assert chain.apply(b).to_pydict()["email"] == ["a@***", "b@***"]
+
+
+def test_jsonparser_expands_and_errors():
+    schema = new_table_schema([("id", "int64", True), ("payload", "utf8")])
+    b = ColumnBatch.from_pydict(TID, schema, {
+        "id": [1, 2, 3],
+        "payload": [json.dumps({"a": 5, "n": {"x": "deep"}}),
+                    "NOT JSON", json.dumps({"a": 7})],
+    })
+    chain = build_chain({"transformers": [
+        {"jsonparser": {"column": "payload", "fields": [
+            {"name": "a", "type": "int64"},
+            {"name": "x", "type": "utf8", "path": "n.x"},
+        ]}},
+    ]})
+    out = chain.apply(b)  # rows: 2 good + 1 tagged error
+    good = [it for it in out if it.value("__transform_error") is None]
+    bad = [it for it in out if it.value("__transform_error") is not None]
+    assert len(good) == 2 and len(bad) == 1
+    assert {it.value("a") for it in good} == {5, 7}
+    assert good[0].value("payload") is None  # dropped source column
+    assert next(it.value("x") for it in good
+                if it.value("a") == 5) == "deep"
+
+
+def test_problem_item_detector():
+    schema = new_table_schema([("id", "int64", True), ("v", "utf8")])
+    b = ColumnBatch.from_pydict(TID, schema, {
+        "id": [1, None, 3], "v": ["a", "b", "c"],
+    })
+    chain = build_chain({"transformers": [
+        {"problem_item_detector": {}},
+    ]})
+    out = chain.apply(b)
+    good = [it for it in out if it.value("__transform_error") is None]
+    bad = [it for it in out if it.value("__transform_error") is not None]
+    assert [it.value("v") for it in good] == ["a", "c"]
+    assert len(bad) == 1 and "required" in bad[0].value("__transform_error")
+
+
+def test_raw_doc_grouper():
+    schema = new_table_schema([("id", "int64", True), ("a", "utf8"),
+                               ("b", "double")])
+    b = ColumnBatch.from_pydict(TID, schema, {
+        "id": [1], "a": ["x"], "b": [2.5],
+    })
+    chain = build_chain({"transformers": [
+        {"raw_doc_grouper": {"keys": ["id"]}},
+    ]})
+    out = chain.apply(b)
+    assert out.to_pydict()["doc"] == [{"a": "x", "b": 2.5}]
+    assert out.schema.find("id").primary_key
+
+
+def test_mongo_pk_extender():
+    schema = new_table_schema([("_id", "any", True), ("v", "utf8")])
+    b = ColumnBatch.from_pydict(TID, schema, {
+        "_id": [{"oid": "abc", "shard": "s1"}], "v": ["x"],
+    })
+    chain = build_chain({"transformers": [
+        {"mongo_pk_extender": {"fields": ["oid", "shard"]}},
+    ]})
+    d = chain.apply(b).to_pydict()
+    assert d["oid"] == ["abc"] and d["shard"] == ["s1"]
+
+
+def test_yt_dict():
+    schema = new_table_schema([("id", "int64", True), ("j", "any")])
+    b = ColumnBatch.from_pydict(TID, schema, {
+        "id": [1], "j": [{"z": 1, "a": 2}],
+    })
+    chain = build_chain({"transformers": [{"yt_dict": {}}]})
+    out = chain.apply(b)
+    assert out.to_pydict()["j"] == ['{"a": 2, "z": 1}']
+
+
+def test_dbt_gated():
+    from transferia_tpu.transform import make_transformer
+
+    t = make_transformer("dbt", {"profile": "x"})
+    schema = new_table_schema([("id", "int64", True)])
+    b = ColumnBatch.from_pydict(TID, schema, {"id": [1]})
+    with pytest.raises(NotImplementedError, match="container"):
+        t.apply(b)

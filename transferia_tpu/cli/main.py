@@ -74,6 +74,10 @@ def build_parser() -> argparse.ArgumentParser:
                           help="dump provider endpoint param schemas")
     desc.add_argument("--provider", default="",
                       help="limit to one provider")
+    tsd = sub.add_parser("typesystem-docs",
+                         help="generate per-provider typesystem.md files")
+    tsd.add_argument("--out", default="docs/typesystem",
+                     help="output directory")
     return p
 
 
@@ -143,6 +147,11 @@ def _load_transfer(args):
 
 
 def main(argv=None) -> int:
+    # die quietly when piped into head & co.
+    try:
+        signal.signal(signal.SIGPIPE, signal.SIG_DFL)
+    except (AttributeError, ValueError):
+        pass  # non-POSIX or non-main thread (tests)
     args = build_parser().parse_args(argv)
     _setup(args)
 
@@ -150,6 +159,8 @@ def main(argv=None) -> int:
         return cmd_describe(args)
     if args.command == "validate":
         return cmd_validate(args)
+    if args.command == "typesystem-docs":
+        return cmd_typesystem_docs(args)
 
     transfer = _load_transfer(args)
     cp = _coordinator(args)
@@ -253,6 +264,26 @@ def cmd_validate(args) -> int:
         return 1
     print(f"OK: {transfer.id} ({transfer.type.value}) "
           f"{transfer.src_provider()} -> {transfer.dst_provider()}")
+    return 0
+
+
+def cmd_typesystem_docs(args) -> int:
+    """Generate per-provider typesystem.md (typesystem/schema_doc.go)."""
+    import os
+
+    from transferia_tpu.providers import load_builtin_providers
+    from transferia_tpu.typesystem.rules import (
+        doc_markdown,
+        supported_providers,
+    )
+
+    load_builtin_providers()
+    os.makedirs(args.out, exist_ok=True)
+    for provider in supported_providers():
+        path = os.path.join(args.out, f"{provider}.md")
+        with open(path, "w") as fh:
+            fh.write(doc_markdown(provider))
+        print(path)
     return 0
 
 

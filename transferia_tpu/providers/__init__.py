@@ -33,7 +33,10 @@ def load_builtin_providers() -> None:
     )
     from transferia_tpu.providers import (  # noqa: F401
         clickhouse,
+        elastic,
+        greenplum,
         kafka,
+        misc_providers,
         mysql,
         postgres,
         s3,

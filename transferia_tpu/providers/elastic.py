@@ -1,0 +1,288 @@
+"""Elasticsearch / OpenSearch provider.
+
+Reference parity: pkg/providers/elastic/ + opensearch/ — index dump
+(snapshot via scroll/search_after) and restore (bulk indexing).  Pure
+stdlib HTTP against the REST API; the same implementation registers under
+both provider names (the reference's opensearch provider delegates to
+elastic the same way).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+import http.client
+import urllib.parse
+
+from transferia_tpu.abstract.errors import CategorizedError
+from transferia_tpu.abstract.interfaces import (
+    Batch,
+    Pusher,
+    Sinker,
+    Storage,
+    TableInfo,
+    is_columnar,
+)
+from transferia_tpu.abstract.schema import (
+    CanonicalType,
+    ColSchema,
+    TableID,
+    TableSchema,
+)
+from transferia_tpu.abstract.table import TableDescription
+from transferia_tpu.columnar.batch import ColumnBatch
+from transferia_tpu.models.endpoint import EndpointParams, register_endpoint
+from transferia_tpu.providers.registry import (
+    Provider,
+    TestResult,
+    register_provider,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class ESError(CategorizedError):
+    pass
+
+
+class ESClient:
+    def __init__(self, host: str, port: int, user: str = "",
+                 password: str = "", secure: bool = False,
+                 timeout: float = 120.0):
+        self.host = host
+        self.port = port
+        self.user = user
+        self.password = password
+        self.secure = secure
+        self.timeout = timeout
+
+    def request(self, method: str, path: str,
+                body: Optional[object] = None,
+                raw_body: Optional[bytes] = None,
+                content_type: str = "application/json") -> dict:
+        cls = http.client.HTTPSConnection if self.secure \
+            else http.client.HTTPConnection
+        conn = cls(self.host, self.port, timeout=self.timeout)
+        try:
+            headers = {"Content-Type": content_type}
+            if self.user:
+                import base64
+
+                cred = base64.b64encode(
+                    f"{self.user}:{self.password}".encode()
+                ).decode()
+                headers["Authorization"] = f"Basic {cred}"
+            payload = raw_body if raw_body is not None else (
+                json.dumps(body).encode() if body is not None else None
+            )
+            conn.request(method, path, body=payload, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status >= 300:
+                raise ESError(
+                    CategorizedError.TARGET,
+                    f"elastic HTTP {resp.status}: {data[:300].decode('utf-8', 'replace')}",
+                )
+            return json.loads(data) if data else {}
+        except (ConnectionError, OSError, http.client.HTTPException) as e:
+            raise ESError(CategorizedError.TARGET,
+                          f"elastic connection failed: {e}") from e
+        finally:
+            conn.close()
+
+
+def _es_params(provider_name: str):
+    @dataclass
+    class SourceParams(EndpointParams):
+        PROVIDER = provider_name
+        IS_SOURCE = True
+
+        host: str = "localhost"
+        port: int = 9200
+        user: str = ""
+        password: str = ""
+        secure: bool = False
+        index: str = ""            # empty = all non-system indices
+        batch_rows: int = 5_000
+
+    @dataclass
+    class TargetParams(EndpointParams):
+        PROVIDER = provider_name
+        IS_TARGET = True
+
+        host: str = "localhost"
+        port: int = 9200
+        user: str = ""
+        password: str = ""
+        secure: bool = False
+
+    SourceParams.__name__ = f"{provider_name.title()}SourceParams"
+    TargetParams.__name__ = f"{provider_name.title()}TargetParams"
+    return register_endpoint(SourceParams), register_endpoint(TargetParams)
+
+
+ElasticSourceParams, ElasticTargetParams = _es_params("elastic")
+OpenSearchSourceParams, OpenSearchTargetParams = _es_params("opensearch")
+
+DOC_SCHEMA = TableSchema([
+    ColSchema("_id", CanonicalType.UTF8, primary_key=True),
+    ColSchema("_index", CanonicalType.UTF8),
+    ColSchema("doc", CanonicalType.ANY),
+])
+
+
+class ESStorage(Storage):
+    """Index dump via search_after pagination (PIT-less, sorted on _id)."""
+
+    def __init__(self, params):
+        self.params = params
+        self.client = ESClient(params.host, params.port, params.user,
+                               params.password, params.secure)
+
+    def _indices(self) -> list[str]:
+        if self.params.index:
+            return [self.params.index]
+        out = self.client.request("GET", "/_cat/indices?format=json")
+        return sorted(
+            r["index"] for r in out if not r["index"].startswith(".")
+        )
+
+    def table_list(self, include=None):
+        tables = {}
+        for idx in self._indices():
+            tid = TableID("", idx)
+            if include and not any(tid.include_matches(p) for p in include):
+                continue
+            count = self.client.request("GET", f"/{idx}/_count").get(
+                "count", 0
+            )
+            tables[tid] = TableInfo(eta_rows=int(count), schema=DOC_SCHEMA)
+        return tables
+
+    def table_schema(self, table: TableID) -> TableSchema:
+        return DOC_SCHEMA
+
+    def exact_table_rows_count(self, table: TableID) -> int:
+        return int(self.client.request(
+            "GET", f"/{table.name}/_count"
+        ).get("count", 0))
+
+    def load_table(self, table: TableDescription, pusher: Pusher) -> None:
+        search_after = None
+        while True:
+            body = {
+                "size": self.params.batch_rows,
+                "sort": [{"_id": "asc"}],
+                "query": {"match_all": {}},
+            }
+            if search_after is not None:
+                body["search_after"] = search_after
+            out = self.client.request(
+                "POST", f"/{table.id.name}/_search", body
+            )
+            hits = out.get("hits", {}).get("hits", [])
+            if not hits:
+                return
+            batch = ColumnBatch.from_pydict(table.id, DOC_SCHEMA, {
+                "_id": [h["_id"] for h in hits],
+                "_index": [h["_index"] for h in hits],
+                "doc": [h["_source"] for h in hits],
+            })
+            pusher(batch)
+            search_after = hits[-1]["sort"]
+            if len(hits) < self.params.batch_rows:
+                return
+
+    def ping(self) -> None:
+        self.client.request("GET", "/")
+
+
+class ESSinker(Sinker):
+    """Bulk indexing sink: doc-shaped batches index their `doc` column;
+    arbitrary tables index the whole row as the document."""
+
+    def __init__(self, params):
+        self.params = params
+        self.client = ESClient(params.host, params.port, params.user,
+                               params.password, params.secure)
+
+    def push(self, batch: Batch) -> None:
+        if not is_columnar(batch):
+            rows = [it for it in batch if it.is_row_event()]
+            if not rows:
+                return
+            batch = ColumnBatch.from_rows(rows)
+        index = batch.table_id.name.lower()
+        data = batch.to_pydict()
+        keys = [c.name for c in batch.schema.key_columns()]
+        lines = []
+        for i in range(batch.n_rows):
+            if "doc" in data and "_id" in data:
+                doc_id = data["_id"][i]
+                doc = data["doc"][i]
+            else:
+                doc = {
+                    k: (v[i].decode("utf-8", "replace")
+                        if isinstance(v[i], bytes) else v[i])
+                    for k, v in data.items()
+                }
+                doc_id = "_".join(str(data[k][i]) for k in keys) \
+                    if keys else None
+            action = {"index": {"_index": index}}
+            if doc_id is not None:
+                action["index"]["_id"] = str(doc_id)
+            lines.append(json.dumps(action, default=str))
+            lines.append(json.dumps(doc, default=str))
+        payload = ("\n".join(lines) + "\n").encode()
+        out = self.client.request(
+            "POST", "/_bulk", raw_body=payload,
+            content_type="application/x-ndjson",
+        )
+        if out.get("errors"):
+            first = next(
+                (item["index"].get("error")
+                 for item in out.get("items", [])
+                 if item.get("index", {}).get("error")),
+                "unknown",
+            )
+            raise ESError(CategorizedError.TARGET,
+                          f"bulk indexing failed: {first}")
+
+
+def _make_provider(name: str, src_cls, dst_cls):
+    class _Provider(Provider):
+        NAME = name
+
+        def storage(self):
+            if isinstance(self.transfer.src, src_cls):
+                return ESStorage(self.transfer.src)
+            return None
+
+        def sinker(self):
+            if isinstance(self.transfer.dst, dst_cls):
+                return ESSinker(self.transfer.dst)
+            return None
+
+        def test(self) -> TestResult:
+            result = TestResult(ok=True)
+            params = self.transfer.src if isinstance(
+                self.transfer.src, src_cls) else self.transfer.dst
+            try:
+                ESClient(params.host, params.port, params.user,
+                         params.password, params.secure).request("GET", "/")
+                result.add("ping")
+            except Exception as e:
+                result.add("ping", e)
+            return result
+
+    _Provider.__name__ = f"{name.title()}Provider"
+    return register_provider(_Provider)
+
+
+ElasticProvider = _make_provider("elastic", ElasticSourceParams,
+                                 ElasticTargetParams)
+OpenSearchProvider = _make_provider("opensearch", OpenSearchSourceParams,
+                                    OpenSearchTargetParams)

@@ -1,0 +1,79 @@
+"""Greenplum provider (reference: pkg/providers/greenplum/).
+
+Greenplum speaks the PostgreSQL protocol; the provider specializes the PG
+storage with segment-parallel reads: `gp_segment_id` partitions a table
+across segments, so shard_table emits one part per segment (the
+reference's segment-parallel snapshot, referenced directly by the
+snapshot loader, load_snapshot.go:23).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+from transferia_tpu.abstract.table import TableDescription
+from transferia_tpu.models.endpoint import register_endpoint
+from transferia_tpu.providers.postgres.provider import (
+    PGSinker,
+    PGSourceParams,
+    PGStorage,
+    PGTargetParams,
+)
+from transferia_tpu.providers.postgres.wire import PGError
+from transferia_tpu.providers.registry import Provider, register_provider
+
+logger = logging.getLogger(__name__)
+
+
+@register_endpoint
+@dataclass
+class GPSourceParams(PGSourceParams):
+    PROVIDER = "greenplum"
+
+    segment_parallel: bool = True
+
+
+@register_endpoint
+@dataclass
+class GPTargetParams(PGTargetParams):
+    PROVIDER = "greenplum"
+
+
+class GPStorage(PGStorage):
+    def shard_table(self, table: TableDescription) -> list[TableDescription]:
+        if not getattr(self.params, "segment_parallel", True):
+            return super().shard_table(table)
+        try:
+            n_segments = int(self.conn.scalar(
+                "SELECT count(*) FROM gp_segment_configuration "
+                "WHERE role = 'p' AND content >= 0"
+            ) or 0)
+        except PGError:
+            # not actually a Greenplum cluster: plain-PG ctid split
+            return super().shard_table(table)
+        if n_segments <= 1:
+            return [table]
+        return [
+            TableDescription(
+                id=table.id,
+                filter=f"gp_segment_id = {seg}",
+                eta_rows=table.eta_rows // n_segments,
+            )
+            for seg in range(n_segments)
+        ]
+
+
+@register_provider
+class GreenplumProvider(Provider):
+    NAME = "greenplum"
+
+    def storage(self):
+        if isinstance(self.transfer.src, GPSourceParams):
+            return GPStorage(self.transfer.src)
+        return None
+
+    def sinker(self):
+        if isinstance(self.transfer.dst, GPTargetParams):
+            return PGSinker(self.transfer.dst)
+        return None

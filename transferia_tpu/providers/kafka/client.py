@@ -5,6 +5,12 @@ clientId(STRING) + body.  API versions used are old-but-universally-
 supported non-flexible ones (Metadata v1, Produce v3, Fetch v4,
 ListOffsets v1) so the codec stays simple and works against any broker
 >= 0.11 as well as compatibility layers (Redpanda, the test fake).
+
+Partition leadership: Metadata responses populate a node table and a
+(topic, partition) -> leader map; produce/fetch/list_offsets route to the
+partition leader and refresh metadata + retry once on NOT_LEADER or
+connection failures, so multi-broker clusters work, not just the
+single-broker case.
 """
 
 from __future__ import annotations
@@ -24,18 +30,22 @@ from transferia_tpu.providers.kafka.protocol import (
     enc_str,
     encode_record_batch,
 )
+from transferia_tpu.utils.net import recv_exact
 
 logger = logging.getLogger(__name__)
 
-API_METADATA = 3
 API_PRODUCE = 0
 API_FETCH = 1
 API_LIST_OFFSETS = 2
+API_METADATA = 3
 
-# Kafka error codes we interpret
 ERR_NONE = 0
-ERR_UNKNOWN_TOPIC = 3
 ERR_OFFSET_OUT_OF_RANGE = 1
+ERR_UNKNOWN_TOPIC = 3
+ERR_LEADER_NOT_AVAILABLE = 5
+ERR_NOT_LEADER = 6
+
+_RETRIABLE = {ERR_LEADER_NOT_AVAILABLE, ERR_NOT_LEADER}
 
 
 class KafkaError(CategorizedError):
@@ -47,40 +57,64 @@ class KafkaError(CategorizedError):
 class KafkaClient:
     def __init__(self, brokers: list[str], client_id: str = "transferia-tpu",
                  timeout: float = 30.0):
-        self.brokers = brokers
+        self.bootstrap = brokers
         self.client_id = client_id
         self.timeout = timeout
-        self._sock: Optional[socket.socket] = None
+        self._conns: dict[object, socket.socket] = {}  # node_id | "boot"
+        self._nodes: dict[int, tuple[str, int]] = {}
+        self._leaders: dict[tuple[str, int], int] = {}
         self._corr = 0
         self._lock = threading.Lock()
 
-    # -- connection ---------------------------------------------------------
-    def _connect(self) -> socket.socket:
-        if self._sock is not None:
-            return self._sock
-        last: Optional[Exception] = None
-        for b in self.brokers:
-            host, _, port = b.partition(":")
+    # -- connections --------------------------------------------------------
+    def _dial(self, host: str, port: int) -> socket.socket:
+        s = socket.create_connection((host, port), timeout=self.timeout)
+        s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        return s
+
+    def _conn_for(self, node) -> socket.socket:
+        sock = self._conns.get(node)
+        if sock is not None:
+            return sock
+        if node == "boot":
+            last: Optional[Exception] = None
+            for b in self.bootstrap:
+                host, _, port = b.partition(":")
+                try:
+                    sock = self._dial(host, int(port or 9092))
+                    break
+                except OSError as e:
+                    last = e
+                    sock = None
+            if sock is None:
+                raise KafkaError(f"no kafka broker reachable: {last}")
+        else:
+            addr = self._nodes.get(node)
+            if addr is None:
+                raise KafkaError(f"unknown broker node {node}")
             try:
-                s = socket.create_connection(
-                    (host, int(port or 9092)), timeout=self.timeout
-                )
-                s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-                self._sock = s
-                return s
+                sock = self._dial(*addr)
             except OSError as e:
-                last = e
-        raise KafkaError(f"no kafka broker reachable: {last}")
+                raise KafkaError(
+                    f"broker node {node} {addr} unreachable: {e}"
+                ) from e
+        self._conns[node] = sock
+        return sock
+
+    def _drop_conn(self, node) -> None:
+        sock = self._conns.pop(node, None)
+        if sock is not None:
+            sock.close()
 
     def close(self) -> None:
-        if self._sock is not None:
-            self._sock.close()
-            self._sock = None
-
-    def _roundtrip(self, api_key: int, api_version: int,
-                   body: bytes) -> Reader:
         with self._lock:
-            sock = self._connect()
+            for node in list(self._conns):
+                self._drop_conn(node)
+
+    def _roundtrip(self, api_key: int, api_version: int, body: bytes,
+                   node="boot") -> Reader:
+        with self._lock:
+            sock = self._conn_for(node)
             self._corr += 1
             corr = self._corr
             header = struct.pack("!hhi", api_key, api_version, corr) \
@@ -88,33 +122,24 @@ class KafkaClient:
             msg = header + body
             try:
                 sock.sendall(struct.pack("!i", len(msg)) + msg)
-                size = struct.unpack("!i", self._recv_exact(sock, 4))[0]
-                payload = self._recv_exact(sock, size)
-            except OSError as e:
-                self.close()
-                raise KafkaError(f"kafka io error: {e}") from e
+                size = struct.unpack("!i", recv_exact(sock, 4))[0]
+                payload = recv_exact(sock, size)
+            except (OSError, ConnectionError) as e:
+                self._drop_conn(node)
+                raise KafkaError(f"kafka io error (node {node}): {e}") from e
         r = Reader(payload)
         got_corr = r.i32()
         if got_corr != corr:
-            self.close()
+            with self._lock:
+                self._drop_conn(node)
             raise KafkaError(
                 f"correlation mismatch: {got_corr} != {corr}"
             )
         return r
 
-    @staticmethod
-    def _recv_exact(sock: socket.socket, n: int) -> bytes:
-        out = b""
-        while len(out) < n:
-            chunk = sock.recv(n - len(out))
-            if not chunk:
-                raise OSError("kafka connection closed")
-            out += chunk
-        return out
-
     # -- metadata -----------------------------------------------------------
     def metadata(self, topics: Optional[list[str]] = None) -> dict:
-        """topic -> [partition ids] (Metadata v1)."""
+        """topic -> [partition ids]; refreshes node + leader maps."""
         if topics is None:
             body = struct.pack("!i", -1)
         else:
@@ -122,33 +147,57 @@ class KafkaClient:
             for t in topics:
                 body += enc_str(t)
         r = self._roundtrip(API_METADATA, 1, body)
-        n_brokers = r.i32()
-        for _ in range(n_brokers):
-            r.i32()          # node id
-            r.string()       # host
-            r.i32()          # port
-            r.string()       # rack
-        r.i32()              # controller id
-        n_topics = r.i32()
-        out: dict[str, list[int]] = {}
-        for _ in range(n_topics):
-            err = r.i16()
-            name = r.string()
-            r.i8()           # is_internal
-            n_parts = r.i32()
-            parts = []
-            for _ in range(n_parts):
-                r.i16()      # partition error
-                pid = r.i32()
-                r.i32()      # leader
+        with self._lock:
+            for _ in range(r.i32()):
+                node_id = r.i32()
+                host = r.string()
+                port = r.i32()
+                r.string()       # rack
+                self._nodes[node_id] = (host or "", port)
+            r.i32()              # controller id
+            n_topics = r.i32()
+            out: dict[str, list[int]] = {}
+            for _ in range(n_topics):
+                err = r.i16()
+                name = r.string()
+                r.i8()           # is_internal
+                parts = []
                 for _ in range(r.i32()):
-                    r.i32()  # replicas
-                for _ in range(r.i32()):
-                    r.i32()  # isr
-                parts.append(pid)
-            if err == ERR_NONE and name is not None:
-                out[name] = sorted(parts)
+                    r.i16()      # partition error
+                    pid = r.i32()
+                    leader = r.i32()
+                    for _ in range(r.i32()):
+                        r.i32()  # replicas
+                    for _ in range(r.i32()):
+                        r.i32()  # isr
+                    parts.append(pid)
+                    if name is not None:
+                        self._leaders[(name, pid)] = leader
+                if err == ERR_NONE and name is not None:
+                    out[name] = sorted(parts)
         return out
+
+    def _leader_node(self, topic: str, partition: int):
+        leader = self._leaders.get((topic, partition))
+        if leader is None or leader not in self._nodes:
+            self.metadata([topic])
+            leader = self._leaders.get((topic, partition))
+        # fall back to bootstrap when metadata gave nothing (test fakes
+        # reporting no broker list still answer everything themselves)
+        return leader if leader is not None and leader in self._nodes \
+            else "boot"
+
+    def _routed(self, topic: str, partition: int, api: int, version: int,
+                body: bytes) -> Reader:
+        """Round-trip to the partition leader; one metadata-refresh retry
+        on routing errors."""
+        node = self._leader_node(topic, partition)
+        try:
+            return self._roundtrip(api, version, body, node)
+        except KafkaError:
+            self.metadata([topic])
+            node = self._leader_node(topic, partition)
+            return self._roundtrip(api, version, body, node)
 
     # -- produce ------------------------------------------------------------
     def produce(self, topic: str, partition: int,
@@ -161,21 +210,30 @@ class KafkaClient:
         body += struct.pack("!i", 1) + enc_str(topic)
         body += struct.pack("!i", 1) + struct.pack("!i", partition)
         body += enc_bytes(batch)
-        r = self._roundtrip(API_PRODUCE, 3, body)
-        n_topics = r.i32()
-        base_offset = -1
-        for _ in range(n_topics):
-            r.string()
+
+        def attempt() -> int:
+            r = self._routed(topic, partition, API_PRODUCE, 3, body)
+            base_offset = -1
             for _ in range(r.i32()):
-                r.i32()              # partition
-                err = r.i16()
-                base_offset = r.i64()
-                r.i64()              # log append time
-                if err != ERR_NONE:
-                    raise KafkaError(f"produce failed: error {err}",
-                                     code=err)
-        r.i32()  # throttle
-        return base_offset
+                r.string()
+                for _ in range(r.i32()):
+                    r.i32()              # partition
+                    err = r.i16()
+                    base_offset = r.i64()
+                    r.i64()              # log append time
+                    if err != ERR_NONE:
+                        raise KafkaError(f"produce failed: error {err}",
+                                         code=err)
+            r.i32()  # throttle
+            return base_offset
+
+        try:
+            return attempt()
+        except KafkaError as e:
+            if e.code not in _RETRIABLE:
+                raise
+            self.metadata([topic])
+            return attempt()
 
     # -- offsets ------------------------------------------------------------
     def list_offsets(self, topic: str, partition: int,
@@ -185,7 +243,7 @@ class KafkaClient:
         body += struct.pack("!i", 1) + enc_str(topic)
         body += struct.pack("!i", 1)
         body += struct.pack("!iq", partition, timestamp)
-        r = self._roundtrip(API_LIST_OFFSETS, 1, body)
+        r = self._routed(topic, partition, API_LIST_OFFSETS, 1, body)
         offset = 0
         for _ in range(r.i32()):
             r.string()
@@ -210,27 +268,38 @@ class KafkaClient:
         body += struct.pack("!i", 1) + enc_str(topic)
         body += struct.pack("!i", 1)
         body += struct.pack("!iqi", partition, offset, max_bytes)
-        r = self._roundtrip(API_FETCH, 4, body)
-        r.i32()  # throttle
-        records: list[Record] = []
-        high = 0
-        for _ in range(r.i32()):
-            r.string()
+
+        def attempt():
+            r = self._routed(topic, partition, API_FETCH, 4, body)
+            r.i32()  # throttle
+            records: list[Record] = []
+            high = 0
             for _ in range(r.i32()):
-                r.i32()              # partition
-                err = r.i16()
-                high = r.i64()
-                r.i64()              # last stable offset
+                r.string()
                 for _ in range(r.i32()):
-                    r.i64()          # aborted txn producer id
-                    r.i64()          # first offset
-                blob = r.bytes_() or b""
-                if err == ERR_OFFSET_OUT_OF_RANGE:
-                    raise KafkaError("offset out of range", code=err)
-                if err != ERR_NONE:
-                    raise KafkaError(f"fetch failed: error {err}",
-                                     code=err)
-                records.extend(decode_record_batches(blob))
+                    r.i32()              # partition
+                    err = r.i16()
+                    high = r.i64()
+                    r.i64()              # last stable offset
+                    for _ in range(r.i32()):
+                        r.i64()          # aborted txn producer id
+                        r.i64()          # first offset
+                    blob = r.bytes_() or b""
+                    if err == ERR_OFFSET_OUT_OF_RANGE:
+                        raise KafkaError("offset out of range", code=err)
+                    if err != ERR_NONE:
+                        raise KafkaError(f"fetch failed: error {err}",
+                                         code=err)
+                    records.extend(decode_record_batches(blob))
+            return records, high
+
+        try:
+            records, high = attempt()
+        except KafkaError as e:
+            if e.code not in _RETRIABLE:
+                raise
+            self.metadata([topic])
+            records, high = attempt()
         # the broker may return records below the requested offset (batch
         # alignment); trim client-side
         return [rec for rec in records if rec.offset >= offset], high

@@ -208,6 +208,11 @@ def decode_record_batches(data: bytes) -> list[Record]:
                 f"supported — configure the topic/producers for "
                 f"uncompressed delivery to this consumer"
             )
+        if attributes & 0x20:
+            # control batch: txn commit/abort markers are broker metadata,
+            # never data — skip, but keep offset accounting moving
+            pos = end
+            continue
         r.i32()            # lastOffsetDelta
         base_ts = r.i64()
         r.i64()            # maxTimestamp

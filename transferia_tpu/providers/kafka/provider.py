@@ -45,6 +45,14 @@ class KafkaSourceParams(EndpointParams):
     max_bytes_per_fetch: int = 8 << 20
     start_from: str = "earliest"   # earliest | latest
 
+    def __post_init__(self):
+        if self.start_from not in ("earliest", "latest"):
+            # a typo silently meaning "latest" would skip all existing data
+            raise ValueError(
+                f"kafka start_from must be 'earliest' or 'latest', "
+                f"got {self.start_from!r}"
+            )
+
     def parser_config(self):
         return self.parser
 

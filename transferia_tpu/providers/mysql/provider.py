@@ -218,9 +218,18 @@ class MySQLStorage(Storage, PositionalStorage, IncrementalStorage):
         ) or 0)
 
     def position(self) -> dict:
-        """Binlog/gtid position (MysqlGtidState parity)."""
-        try:
-            rows = self.conn.query("SHOW MASTER STATUS")
+        """Binlog/gtid position (MysqlGtidState parity).
+
+        MySQL 8.4 removed SHOW MASTER STATUS in favor of SHOW BINARY LOG
+        STATUS; try both, and never silently checkpoint an empty position.
+        """
+        last_err = None
+        for stmt in ("SHOW MASTER STATUS", "SHOW BINARY LOG STATUS"):
+            try:
+                rows = self.conn.query(stmt)
+            except MySQLError as e:
+                last_err = e
+                continue
             if rows:
                 r = rows[0]
                 return {
@@ -228,8 +237,10 @@ class MySQLStorage(Storage, PositionalStorage, IncrementalStorage):
                     "binlog_pos": r.get("Position"),
                     "gtid_set": r.get("Executed_Gtid_Set", ""),
                 }
-        except MySQLError:
-            pass
+        logger.warning(
+            "could not read binlog position (binary logging off, "
+            "insufficient privileges, or unsupported server): %s", last_err,
+        )
         return {}
 
     def load_table(self, table: TableDescription, pusher: Pusher) -> None:

@@ -14,6 +14,7 @@ import struct
 from typing import Optional
 
 from transferia_tpu.abstract.errors import CategorizedError
+from transferia_tpu.utils.net import recv_exact
 
 CLIENT_LONG_PASSWORD = 0x1
 CLIENT_PROTOCOL_41 = 0x200
@@ -64,13 +65,10 @@ class MySQLConnection:
 
     # -- framing ------------------------------------------------------------
     def _recv_exact(self, n: int) -> bytes:
-        out = b""
-        while len(out) < n:
-            chunk = self.sock.recv(n - len(out))
-            if not chunk:
-                raise MySQLError("connection closed by server")
-            out += chunk
-        return out
+        try:
+            return recv_exact(self.sock, n)
+        except ConnectionError as e:
+            raise MySQLError(str(e)) from e
 
     _MAX_PACKET = 0xFFFFFF
 

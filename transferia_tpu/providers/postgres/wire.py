@@ -17,6 +17,7 @@ from base64 import b64decode, b64encode
 from typing import Iterator, Optional
 
 from transferia_tpu.abstract.errors import CategorizedError
+from transferia_tpu.utils.net import recv_exact
 
 
 class PGError(CategorizedError):
@@ -51,13 +52,10 @@ class PGConnection:
         self.sock.sendall(msg)
 
     def _recv_exact(self, n: int) -> bytes:
-        out = b""
-        while len(out) < n:
-            chunk = self.sock.recv(n - len(out))
-            if not chunk:
-                raise PGError("connection closed by server")
-            out += chunk
-        return out
+        try:
+            return recv_exact(self.sock, n)
+        except ConnectionError as e:
+            raise PGError(str(e)) from e
 
     def _recv_message(self) -> tuple[bytes, bytes]:
         header = self._recv_exact(5)

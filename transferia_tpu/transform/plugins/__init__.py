@@ -5,11 +5,13 @@ reference's init() side-effect registration.
 """
 
 from transferia_tpu.transform.plugins import (  # noqa: F401
+    ch_sql,
     convert,
     filter as filter_plugin,
     lambda_tf,
     logger_tf,
     mask,
+    misc,
     pk,
     rename,
     sharder,

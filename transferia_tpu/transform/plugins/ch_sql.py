@@ -1,0 +1,112 @@
+"""ClickHouse-local SQL transformer (registry/clickhouse).
+
+Ships the batch to a ClickHouse server as a temp table, runs the user's
+SQL over it, and reads the result back — the reference's approach for
+arbitrary SQL transforms.  The query references the batch as `{table}`.
+"""
+
+from __future__ import annotations
+
+import logging
+import uuid
+from typing import Optional
+
+from transferia_tpu.abstract.schema import TableID, TableSchema
+from transferia_tpu.columnar.batch import ColumnBatch
+from transferia_tpu.transform.base import TransformResult, Transformer
+from transferia_tpu.transform.registry import register_transformer
+
+logger = logging.getLogger(__name__)
+
+
+@register_transformer("clickhouse_sql")
+class ClickHouseSqlTransformer(Transformer):
+    """config: query: "SELECT id, upper(name) AS name FROM {table}",
+    host/port/database/user/password of the scratch CH server."""
+
+    def __init__(self, query: str, host: str = "localhost",
+                 port: int = 8123, database: str = "default",
+                 user: str = "default", password: str = "",
+                 tables: Optional[list[str]] = None):
+        self.query = query
+        self.host = host
+        self.port = port
+        self.database = database
+        self.user = user
+        self.password = password
+        self.tables = [TableID.parse(t) for t in tables] if tables else None
+
+    def suitable(self, table: TableID, schema: TableSchema) -> bool:
+        if self.tables is None:
+            return True
+        return any(table.include_matches(p) for p in self.tables)
+
+    def apply(self, batch: ColumnBatch) -> TransformResult:
+        from transferia_tpu.providers.clickhouse.client import CHClient
+        from transferia_tpu.providers.clickhouse.provider import (
+            ddl_for_schema,
+        )
+        from transferia_tpu.providers.clickhouse.rowbinary import (
+            decode_rowbinary_stream,
+            encode_rowbinary,
+        )
+
+        client = CHClient(host=self.host, port=self.port,
+                          database=self.database, user=self.user,
+                          password=self.password)
+        tmp = f"__tf_{uuid.uuid4().hex[:10]}"
+        tmp_tid = TableID("", tmp)
+        nullable = {
+            c.name: (not c.required and not c.primary_key)
+            for c in batch.schema
+        }
+        try:
+            client.execute(
+                ddl_for_schema(tmp_tid, batch.schema, engine="Memory()")
+            )
+            client.insert_rowbinary(
+                tmp, list(batch.columns), encode_rowbinary(batch, nullable)
+            )
+            sql = self.query.replace("{table}", f"`{tmp}`")
+            # result schema from DESCRIBE, then stream the rows
+            desc = client.query_json(f"DESCRIBE ({sql})")
+            from transferia_tpu.abstract.schema import ColSchema
+            from transferia_tpu.typesystem.rules import map_source_type
+
+            cols = []
+            res_nullable = {}
+            for r in desc:
+                ch_type = r["type"]
+                is_n = ch_type.startswith("Nullable(")
+                base = ch_type[9:-1] if is_n else ch_type
+                cols.append(ColSchema(
+                    name=r["name"],
+                    data_type=map_source_type(
+                        "ch", base.split("(")[0].lower()
+                    ),
+                    required=not is_n,
+                    original_type=f"ch:{ch_type}",
+                ))
+                res_nullable[r["name"]] = is_n
+            out_schema = TableSchema(cols)
+            read_fn, close_fn = client.execute_stream(
+                f"SELECT * FROM ({sql}) FORMAT RowBinary"
+            )
+            try:
+                parts = list(decode_rowbinary_stream(
+                    read_fn, out_schema, res_nullable
+                ))
+            finally:
+                close_fn()
+            if not parts:
+                return TransformResult(batch.slice(0, 0))
+            merged = parts[0] if len(parts) == 1 else \
+                ColumnBatch.concat(parts)
+            return TransformResult(ColumnBatch(
+                batch.table_id, out_schema, merged.columns
+            ))
+        finally:
+            try:
+                client.execute(f"DROP TABLE IF EXISTS `{tmp}`")
+            except Exception as e:  # cleanup is best-effort
+                logger.warning("temp table cleanup failed: %s", e)

@@ -52,13 +52,29 @@ class LambdaTransformer(Transformer):
 
     def __init__(self, function: str | Callable, mode: str = "columns",
                  tables: Optional[list[str]] = None):
-        self.fn = function if callable(function) else _resolve(function)
+        # resolution is lazy for dotted paths: transfer configs must
+        # validate on machines where the user module isn't importable
+        # (e.g. `trtpu validate` on a control host) — but the value's TYPE
+        # is still checked eagerly so validate catches nulls/maps
+        if not callable(function) and not isinstance(function, str):
+            raise ValueError(
+                f"lambda: function must be a callable or a "
+                f"'module:attr' string, got {type(function).__name__}"
+            )
+        self._fn = function if callable(function) else None
+        self._ref = function if isinstance(function, str) else None
         if mode not in ("columns", "mask", "batch"):
             raise ValueError(f"lambda: bad mode {mode!r}")
         self.mode = mode
         self.fn_name = function if isinstance(function, str) else \
             getattr(function, "__name__", "callable")
         self.tables = [TableID.parse(t) for t in tables] if tables else None
+
+    @property
+    def fn(self) -> Callable:
+        if self._fn is None:
+            self._fn = _resolve(self._ref)
+        return self._fn
 
     def suitable(self, table: TableID, schema: TableSchema) -> bool:
         if self.tables is None:

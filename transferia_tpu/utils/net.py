@@ -1,0 +1,23 @@
+"""Shared socket helpers for the wire-protocol clients."""
+
+from __future__ import annotations
+
+import socket
+
+
+def recv_exact(sock: socket.socket, n: int,
+               closed_msg: str = "connection closed by peer") -> bytes:
+    """Read exactly n bytes (raises ConnectionError on EOF).
+
+    Accumulates into a list to avoid O(n^2) bytes concatenation on large
+    frames (COPY chunks, fetch responses).
+    """
+    parts: list[bytes] = []
+    got = 0
+    while got < n:
+        chunk = sock.recv(n - got)
+        if not chunk:
+            raise ConnectionError(closed_msg)
+        parts.append(chunk)
+        got += len(chunk)
+    return b"".join(parts) if len(parts) != 1 else parts[0]
