@@ -1,0 +1,153 @@
+"""Mongo provider e2e: BSON round-trip, snapshot, change streams, sink."""
+
+import threading
+import time
+
+import pytest
+
+from transferia_tpu.abstract import Kind, TableID
+from transferia_tpu.coordinator import MemoryCoordinator
+from transferia_tpu.models import Transfer, TransferType
+from transferia_tpu.providers.memory import MemoryTargetParams, get_store
+from transferia_tpu.providers.mongo import (
+    MongoSourceParams,
+    MongoTargetParams,
+    bson,
+)
+from transferia_tpu.runtime import run_replication
+from transferia_tpu.tasks import activate_delivery
+from tests.recipes.fake_mongo import FakeMongo
+
+
+def test_bson_roundtrip():
+    doc = {
+        "s": "text", "i": 5, "big": 2**40, "f": 1.5, "b": True,
+        "none": None, "arr": [1, "two", {"three": 3}],
+        "nested": {"x": {"y": "z"}},
+        "oid": bson.ObjectId(b"\x01" * 12),
+        "dt": bson.UTCDateTime(1_700_000_000_000),
+        "ts": bson.Timestamp(100, 2),
+        "bin": b"\x00\xff",
+    }
+    data = bson.encode(doc)
+    back, end = bson.decode(data)
+    assert end == len(data)
+    assert back["s"] == "text" and back["i"] == 5 and back["big"] == 2**40
+    assert back["b"] is True and back["none"] is None
+    assert back["arr"][2]["three"] == 3
+    assert back["nested"]["x"]["y"] == "z"
+    assert back["oid"] == doc["oid"]
+    assert back["dt"].ms == 1_700_000_000_000
+    assert back["ts"].t == 100 and back["ts"].i == 2
+    assert back["bin"] == b"\x00\xff"
+
+
+def test_bson_golden_bytes():
+    # {"a": 1} per the BSON spec: 0c000000 10 'a' 00 01000000 00
+    assert bson.encode({"a": 1}) == \
+        b"\x0c\x00\x00\x00\x10a\x00\x01\x00\x00\x00\x00"
+
+
+@pytest.fixture
+def fake_mongo():
+    srv = FakeMongo().start()
+    srv.seed("shop", "items", [
+        {"_id": f"i{n}", "name": f"item {n}", "price": n * 2.0,
+         "tags": ["a", "b"]}
+        for n in range(25)
+    ])
+    yield srv
+    srv.stop()
+
+
+def test_mongo_snapshot(fake_mongo):
+    store = get_store("mg1")
+    store.clear()
+    t = Transfer(
+        id="mg1",
+        src=MongoSourceParams(host="127.0.0.1", port=fake_mongo.port,
+                              database="shop", batch_rows=10),
+        dst=MemoryTargetParams(sink_id="mg1"),
+    )
+    activate_delivery(t, MemoryCoordinator())
+    tid = TableID("shop", "items")
+    assert store.row_count(tid) == 25
+    rows = store.rows(tid)
+    by_id = {r.value("_id"): r for r in rows}
+    assert by_id["i3"].value("document")["name"] == "item 3"
+    assert by_id["i3"].value("document")["tags"] == ["a", "b"]
+
+
+def test_mongo_change_stream(fake_mongo):
+    fake_mongo.feed_event({
+        "_id": {"_data": "tok1"},
+        "operationType": "insert",
+        "ns": {"db": "shop", "coll": "items"},
+        "documentKey": {"_id": "new1"},
+        "fullDocument": {"_id": "new1", "name": "fresh"},
+    })
+    store = get_store("mg2")
+    store.clear()
+    cp = MemoryCoordinator()
+    t = Transfer(
+        id="mg2", type=TransferType.INCREMENT_ONLY,
+        src=MongoSourceParams(host="127.0.0.1", port=fake_mongo.port,
+                              database="shop"),
+        dst=MemoryTargetParams(sink_id="mg2"),
+    )
+    stop = threading.Event()
+    th = threading.Thread(
+        target=run_replication, args=(t, cp),
+        kwargs={"stop_event": stop, "backoff": 0.1}, daemon=True,
+    )
+    th.start()
+    deadline = time.monotonic() + 10
+    while store.row_count() < 1 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    # live event mid-stream + delete
+    fake_mongo.feed_event({
+        "_id": {"_data": "tok2"},
+        "operationType": "delete",
+        "ns": {"db": "shop", "coll": "items"},
+        "documentKey": {"_id": "i9"},
+    })
+    while store.row_count() < 2 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    stop.set()
+    th.join(timeout=10)
+    rows = store.rows()
+    assert rows[0].kind == Kind.INSERT
+    assert rows[0].value("document")["name"] == "fresh"
+    assert rows[1].kind == Kind.DELETE
+    assert rows[1].effective_key() == ("i9",)
+    assert cp.get_transfer_state("mg2")["mongo_resume_token"] == "tok2"
+
+
+def test_mongo_sink_upsert_delete(fake_mongo):
+    from transferia_tpu.abstract import ChangeItem, OldKeys
+    from transferia_tpu.providers.mongo.provider import (
+        DOC_SCHEMA,
+        MongoSinker,
+    )
+
+    sinker = MongoSinker(MongoTargetParams(host="127.0.0.1",
+                                           port=fake_mongo.port,
+                                           database="dw"))
+    sinker.push([
+        ChangeItem(kind=Kind.INSERT, schema="dw", table="out",
+                   column_names=("_id", "document"),
+                   column_values=("k1", {"v": 1}),
+                   table_schema=DOC_SCHEMA),
+        ChangeItem(kind=Kind.INSERT, schema="dw", table="out",
+                   column_names=("_id", "document"),
+                   column_values=("k2", {"v": 2}),
+                   table_schema=DOC_SCHEMA),
+    ])
+    assert len(fake_mongo.dbs["dw"]["out"]) == 2
+    sinker.push([
+        ChangeItem(kind=Kind.DELETE, schema="dw", table="out",
+                   table_schema=DOC_SCHEMA,
+                   old_keys=OldKeys(("_id",), ("k1",))),
+    ])
+    assert list(fake_mongo.dbs["dw"]["out"]) == ["k2"]
+    sinker.close()

@@ -1,0 +1,176 @@
+"""In-process fake MongoDB server (OP_MSG subset) using the provider's own
+BSON codec for framing (the codec itself is pinned by round-trip unit
+tests against golden bytes)."""
+
+from __future__ import annotations
+
+import socketserver
+import struct
+import threading
+from typing import Optional
+
+from transferia_tpu.providers.mongo import bson
+
+OP_MSG = 2013
+
+
+class FakeMongo:
+    def __init__(self):
+        # db -> collection -> {_id_jsonish: doc}
+        self.dbs: dict[str, dict[str, dict]] = {}
+        self.change_events: list[dict] = []
+        self.commands: list[dict] = []
+        self.lock = threading.RLock()
+        self.port = 0
+        self._srv = None
+        self._cursors: dict[int, list] = {}
+        self._next_cursor = 100
+
+    def seed(self, db: str, coll: str, docs: list[dict]) -> None:
+        with self.lock:
+            store = self.dbs.setdefault(db, {}).setdefault(coll, {})
+            for d in docs:
+                store[str(d.get("_id"))] = d
+
+    def feed_event(self, ev: dict) -> None:
+        with self.lock:
+            self.change_events.append(ev)
+
+    def start(self) -> "FakeMongo":
+        fake = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                try:
+                    while True:
+                        raw = self._recv(4)
+                        ln = struct.unpack("<i", raw)[0]
+                        payload = self._recv(ln - 4)
+                        req_id = struct.unpack_from("<i", payload, 0)[0]
+                        # reqID(4) respTo(4) opCode(4) flags(4) kind(1)
+                        doc, _ = bson.decode(payload, 17)
+                        resp_doc = fake.dispatch(doc)
+                        body = struct.pack("<I", 0) + b"\x00" \
+                            + bson.encode(resp_doc)
+                        header = struct.pack(
+                            "<iiii", 16 + len(body), 1, req_id, OP_MSG
+                        )
+                        self.request.sendall(header + body)
+                except (ConnectionError, OSError, struct.error):
+                    return
+
+            def _recv(self, n):
+                out = b""
+                while len(out) < n:
+                    chunk = self.request.recv(n - len(out))
+                    if not chunk:
+                        raise ConnectionError()
+                    out += chunk
+                return out
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._srv = Server(("127.0.0.1", 0), Handler)
+        self.port = self._srv.server_address[1]
+        threading.Thread(target=self._srv.serve_forever,
+                         daemon=True).start()
+        return self
+
+    def stop(self):
+        if self._srv:
+            self._srv.shutdown()
+
+    # -- command dispatch ----------------------------------------------------
+    def dispatch(self, cmd: dict) -> dict:
+        with self.lock:
+            self.commands.append(cmd)
+        db = cmd.get("$db", "admin")
+        if "hello" in cmd or "isMaster" in cmd:
+            return {"ok": 1, "maxWireVersion": 17,
+                    "saslSupportedMechs": ["SCRAM-SHA-256"]}
+        if "ping" in cmd:
+            return {"ok": 1}
+        if "listCollections" in cmd:
+            colls = sorted(self.dbs.get(db, {}))
+            return {"ok": 1, "cursor": {"id": 0, "ns": f"{db}.$cmd",
+                    "firstBatch": [
+                        {"name": c, "type": "collection"} for c in colls
+                    ]}}
+        if "count" in cmd:
+            coll = self.dbs.get(db, {}).get(cmd["count"], {})
+            return {"ok": 1, "n": len(coll)}
+        if "find" in cmd:
+            docs = sorted(
+                self.dbs.get(db, {}).get(cmd["find"], {}).values(),
+                key=lambda d: str(d.get("_id")),
+            )
+            return self._cursor_reply(db, cmd["find"], docs,
+                                      cmd.get("batchSize", 101))
+        if "getMore" in cmd:
+            cid = cmd["getMore"]
+            if cid == getattr(self, "_live_stream_cursor", None):
+                # change stream: drain newly fed events, cursor stays open
+                with self.lock:
+                    batch = list(self.change_events)
+                    self.change_events.clear()
+                return {"ok": 1, "cursor": {"id": cid, "ns": "x",
+                                            "nextBatch": batch}}
+            with self.lock:
+                rest = self._cursors.get(cid, [])
+                batch = rest[:cmd.get("batchSize", 101)]
+                self._cursors[cid] = rest[len(batch):]
+                done = not self._cursors[cid]
+                if done:
+                    self._cursors.pop(cid, None)
+            return {"ok": 1, "cursor": {
+                "id": 0 if done else cid,
+                "ns": "x", "nextBatch": batch,
+            }}
+        if "aggregate" in cmd:
+            # change stream: serve fed events, then an open empty cursor
+            with self.lock:
+                events = list(self.change_events)
+                self.change_events.clear()
+                cid = self._next_cursor
+                self._next_cursor += 1
+                self._cursors[cid] = []  # live cursor, refilled by getMore
+            self._live_stream_cursor = cid
+            return {"ok": 1, "cursor": {"id": cid, "ns": "x",
+                                        "firstBatch": events}}
+        if "update" in cmd:
+            store = self.dbs.setdefault(db, {}).setdefault(
+                cmd["update"], {}
+            )
+            n = 0
+            for u in cmd.get("updates", []):
+                doc = u["u"]
+                store[str(doc.get("_id"))] = doc
+                n += 1
+            return {"ok": 1, "n": n}
+        if "delete" in cmd:
+            store = self.dbs.setdefault(db, {}).setdefault(
+                cmd["delete"], {}
+            )
+            n = 0
+            for d in cmd.get("deletes", []):
+                key = str(d["q"].get("_id"))
+                if key in store:
+                    del store[key]
+                    n += 1
+            return {"ok": 1, "n": n}
+        return {"ok": 0, "errmsg": f"unhandled command {list(cmd)[:1]}",
+                "code": 59, "codeName": "CommandNotFound"}
+
+    def _cursor_reply(self, db, coll, docs, batch_size) -> dict:
+        first = docs[:batch_size]
+        rest = docs[batch_size:]
+        cid = 0
+        if rest:
+            with self.lock:
+                cid = self._next_cursor
+                self._next_cursor += 1
+                self._cursors[cid] = rest
+        return {"ok": 1, "cursor": {"id": cid, "ns": f"{db}.{coll}",
+                                    "firstBatch": first}}

@@ -10,6 +10,7 @@ from __future__ import annotations
 import hashlib
 import os
 import re
+import socket
 import socketserver
 import struct
 import threading
@@ -35,6 +36,44 @@ class FakeMySQL:
         self.lock = threading.RLock()
         self.port = 0
         self._srv = None
+        self.binlog_events: list[bytes] = []  # pre-framed event bodies
+        self._next_log_pos = 10_000  # past SHOW MASTER STATUS's 4242
+
+    # -- binlog event builders (independent encoder mirroring the client
+    # decoder; TABLE_MAP + ROWS v2 for [bigint, varchar(N)] shapes) --------
+    def _event(self, etype: int, payload: bytes) -> bytes:
+        self._next_log_pos += 19 + len(payload)
+        header = struct.pack("<IBIII", 1_700_000_000, etype, 1,
+                             19 + len(payload), self._next_log_pos)
+        return header[:17] + struct.pack("<H", 0) + payload
+
+    def feed_table_map(self, table_id: int, schema: str, table: str,
+                       col_specs: list[tuple]) -> None:
+        """col_specs: (type_byte, meta_bytes) tuples."""
+        body = table_id.to_bytes(6, "little") + struct.pack("<H", 1)
+        body += bytes([len(schema)]) + schema.encode() + b"\x00"
+        body += bytes([len(table)]) + table.encode() + b"\x00"
+        body += bytes([len(col_specs)])
+        body += bytes(t for t, _ in col_specs)
+        meta = b"".join(m for _, m in col_specs)
+        body += bytes([len(meta)]) + meta
+        body += bytes((len(col_specs) + 7) // 8)  # null-allowed bitmap
+        with self.lock:
+            self.binlog_events.append(self._event(19, body))
+
+    def feed_rows(self, etype: int, table_id: int, n_cols: int,
+                  images: list[bytes]) -> None:
+        """images: pre-encoded row images (null bitmap + values)."""
+        body = table_id.to_bytes(6, "little") + struct.pack("<H", 1)
+        body += struct.pack("<H", 2)  # v2 extra-info length (just itself)
+        body += bytes([n_cols])
+        bitmap = bytes([0xFF] * ((n_cols + 7) // 8))
+        body += bitmap
+        if etype == 31:  # update: before+after bitmaps
+            body += bitmap
+        body += b"".join(images)
+        with self.lock:
+            self.binlog_events.append(self._event(etype, body))
 
     def add_table(self, t: FakeMyTable) -> None:
         with self.lock:
@@ -154,14 +193,44 @@ class _MySession:
             if cmd == 0x0E:  # PING
                 self.send_ok()
                 continue
+            if cmd == 0x12:  # COM_BINLOG_DUMP
+                self.stream_binlog()
+                return
             if cmd == 0x03:  # QUERY
                 sql = pkt[1:].decode("utf-8", "replace")
                 with self.fake.lock:
                     self.fake.queries.append(sql)
+                if sql.startswith("SET @master_binlog_checksum"):
+                    self.send_ok()
+                    continue
                 try:
                     self.dispatch(sql)
                 except Exception as e:
                     self.send_err(str(e))
+
+    def stream_binlog(self):
+        """Serve fed binlog events as OK-prefixed packets, then poll for
+        newly fed events until the client disconnects."""
+        import time as _time
+
+        sent = 0
+        while True:
+            with self.fake.lock:
+                events = list(self.fake.binlog_events)
+            while sent < len(events):
+                self.seq = 1
+                self.send_packet(b"\x00" + events[sent])
+                sent += 1
+            _time.sleep(0.02)
+            # detect client disconnect cheaply
+            import select
+
+            r, _, _ = select.select([self.sock], [], [], 0)
+            if r:
+                probe = self.sock.recv(1, socket.MSG_PEEK) \
+                    if hasattr(socket, "MSG_PEEK") else b"x"
+                if not probe:
+                    raise ConnectionError()
 
     @staticmethod
     def _native_token(password: str, nonce: bytes) -> bytes:

@@ -37,6 +37,7 @@ def load_builtin_providers() -> None:
         greenplum,
         kafka,
         misc_providers,
+        mongo,
         mysql,
         postgres,
         s3,

@@ -1,0 +1,17 @@
+"""MongoDB provider.
+
+Reference parity: pkg/providers/mongo/ — snapshot with per-collection
+parallelization units (parallelization_unit*.go), change-stream
+replication (change_stream.go), bulk-op sink (sink_bulk_operations.go).
+The client is a dependency-free BSON codec + OP_MSG wire implementation
+(this image ships no pymongo): hello, SCRAM-SHA-256 auth, find/getMore
+cursors, insert/update/delete, aggregate (change streams).
+"""
+
+from transferia_tpu.providers.mongo.provider import (
+    MongoProvider,
+    MongoSourceParams,
+    MongoTargetParams,
+)
+
+__all__ = ["MongoProvider", "MongoSourceParams", "MongoTargetParams"]

@@ -1,0 +1,188 @@
+"""Minimal BSON codec (the subset MongoDB's commands and documents use).
+
+Types: double, string, document, array, binary, ObjectId, bool, UTC
+datetime, null, int32, timestamp, int64, decimal128 (passed through as
+bytes).  Unknown element types raise — silent truncation would corrupt
+document streams.
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any
+
+
+class ObjectId:
+    __slots__ = ("raw",)
+
+    def __init__(self, raw: bytes):
+        if len(raw) != 12:
+            raise ValueError("ObjectId must be 12 bytes")
+        self.raw = raw
+
+    def __repr__(self) -> str:
+        return f"ObjectId({self.raw.hex()})"
+
+    def __str__(self) -> str:
+        return self.raw.hex()
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ObjectId) and other.raw == self.raw
+
+    def __hash__(self) -> int:
+        return hash(self.raw)
+
+
+class Binary:
+    __slots__ = ("subtype", "raw")
+
+    def __init__(self, raw: bytes, subtype: int = 0):
+        self.raw = raw
+        self.subtype = subtype
+
+
+class Timestamp:
+    """BSON timestamp (oplog ordinal), distinct from UTC datetime."""
+
+    __slots__ = ("t", "i")
+
+    def __init__(self, t: int, i: int):
+        self.t = t
+        self.i = i
+
+    def __repr__(self) -> str:
+        return f"Timestamp({self.t}, {self.i})"
+
+
+class UTCDateTime:
+    """Milliseconds since epoch (kept numeric; no tz library games)."""
+
+    __slots__ = ("ms",)
+
+    def __init__(self, ms: int):
+        self.ms = ms
+
+
+def encode(doc: dict) -> bytes:
+    out = bytearray()
+    for key, value in doc.items():
+        out += _encode_element(key, value)
+    return struct.pack("<i", len(out) + 5) + bytes(out) + b"\x00"
+
+
+def _encode_element(key: str, v: Any) -> bytes:
+    name = key.encode() + b"\x00"
+    if isinstance(v, bool):  # before int!
+        return b"\x08" + name + (b"\x01" if v else b"\x00")
+    if isinstance(v, float):
+        return b"\x01" + name + struct.pack("<d", v)
+    if isinstance(v, str):
+        b = v.encode()
+        return b"\x02" + name + struct.pack("<i", len(b) + 1) + b + b"\x00"
+    if isinstance(v, dict):
+        return b"\x03" + name + encode(v)
+    if isinstance(v, (list, tuple)):
+        return b"\x04" + name + encode(
+            {str(i): item for i, item in enumerate(v)}
+        )
+    if isinstance(v, Binary):
+        return b"\x05" + name + struct.pack("<iB", len(v.raw), v.subtype) \
+            + v.raw
+    if isinstance(v, bytes):
+        return b"\x05" + name + struct.pack("<iB", len(v), 0) + v
+    if isinstance(v, ObjectId):
+        return b"\x07" + name + v.raw
+    if isinstance(v, UTCDateTime):
+        return b"\x09" + name + struct.pack("<q", v.ms)
+    if v is None:
+        return b"\x0a" + name
+    if isinstance(v, Timestamp):
+        return b"\x11" + name + struct.pack("<II", v.i, v.t)
+    if isinstance(v, int):
+        if -(2**31) <= v < 2**31:
+            return b"\x10" + name + struct.pack("<i", v)
+        return b"\x12" + name + struct.pack("<q", v)
+    raise TypeError(f"bson: cannot encode {type(v).__name__}")
+
+
+def decode(data: bytes, offset: int = 0) -> tuple[dict, int]:
+    """Decode one document at offset; returns (doc, end_offset)."""
+    length = struct.unpack_from("<i", data, offset)[0]
+    end = offset + length
+    pos = offset + 4
+    doc: dict = {}
+    while pos < end - 1:
+        etype = data[pos]
+        pos += 1
+        nul = data.index(b"\x00", pos)
+        key = data[pos:nul].decode()
+        pos = nul + 1
+        doc[key], pos = _decode_value(etype, data, pos)
+    return doc, end
+
+
+def _decode_value(etype: int, data: bytes, pos: int) -> tuple[Any, int]:
+    if etype == 0x01:
+        return struct.unpack_from("<d", data, pos)[0], pos + 8
+    if etype == 0x02:
+        ln = struct.unpack_from("<i", data, pos)[0]
+        s = data[pos + 4:pos + 4 + ln - 1].decode("utf-8", "replace")
+        return s, pos + 4 + ln
+    if etype == 0x03:
+        return decode(data, pos)
+    if etype == 0x04:
+        arr_doc, end = decode(data, pos)
+        return [arr_doc[k] for k in sorted(arr_doc, key=int)], end
+    if etype == 0x05:
+        ln, subtype = struct.unpack_from("<iB", data, pos)
+        raw = bytes(data[pos + 5:pos + 5 + ln])
+        return (raw if subtype == 0 else Binary(raw, subtype)), pos + 5 + ln
+    if etype == 0x06:  # undefined (deprecated)
+        return None, pos
+    if etype == 0x07:
+        return ObjectId(bytes(data[pos:pos + 12])), pos + 12
+    if etype == 0x08:
+        return data[pos] == 1, pos + 1
+    if etype == 0x09:
+        return UTCDateTime(struct.unpack_from("<q", data, pos)[0]), pos + 8
+    if etype == 0x0A:
+        return None, pos
+    if etype == 0x0B:  # regex: two cstrings
+        n1 = data.index(b"\x00", pos)
+        n2 = data.index(b"\x00", n1 + 1)
+        return {"$regex": data[pos:n1].decode(),
+                "$options": data[n1 + 1:n2].decode()}, n2 + 1
+    if etype == 0x10:
+        return struct.unpack_from("<i", data, pos)[0], pos + 4
+    if etype == 0x11:
+        i, t = struct.unpack_from("<II", data, pos)
+        return Timestamp(t, i), pos + 8
+    if etype == 0x12:
+        return struct.unpack_from("<q", data, pos)[0], pos + 8
+    if etype == 0x13:  # decimal128: surface raw bytes
+        return Binary(bytes(data[pos:pos + 16]), 0x13), pos + 16
+    raise ValueError(f"bson: unsupported element type 0x{etype:02x}")
+
+
+def to_jsonish(v: Any) -> Any:
+    """BSON value -> JSON-serializable canonical form (for ANY columns)."""
+    if isinstance(v, dict):
+        return {k: to_jsonish(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [to_jsonish(x) for x in v]
+    if isinstance(v, ObjectId):
+        return {"$oid": str(v)}
+    if isinstance(v, UTCDateTime):
+        return {"$date": v.ms}
+    if isinstance(v, Timestamp):
+        return {"$timestamp": {"t": v.t, "i": v.i}}
+    if isinstance(v, Binary):
+        import base64
+
+        return {"$binary": base64.b64encode(v.raw).decode(),
+                "$type": v.subtype}
+    if isinstance(v, bytes):
+        import base64
+
+        return {"$binary": base64.b64encode(v).decode(), "$type": 0}
+    return v

@@ -452,6 +452,18 @@ class MySQLProvider(Provider):
             return MySQLStorage(self.transfer.src)
         return None
 
+    def source(self):
+        """Binlog ROW replication (canal.go)."""
+        if isinstance(self.transfer.src, MySQLSourceParams):
+            from transferia_tpu.providers.mysql.binlog import (
+                MySQLBinlogSource,
+            )
+
+            return MySQLBinlogSource(
+                self.transfer.src, self.transfer.id, self.coordinator
+            )
+        return None
+
     def sinker(self):
         if isinstance(self.transfer.dst, MySQLTargetParams):
             return MySQLSinker(self.transfer.dst)
