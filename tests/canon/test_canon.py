@@ -1,0 +1,169 @@
+"""Canonical ("golden") tests (reference: tests/canon/ — embedded canondata
+compared against component output).
+
+Golden files live next to this test; regenerate intentionally with
+REGEN_CANON=1 after reviewing diffs — byte changes here are wire-format
+changes users will see.
+"""
+
+import json
+import os
+import pathlib
+
+import pytest
+
+from transferia_tpu.abstract import ChangeItem, Kind, OldKeys, TableID
+from transferia_tpu.abstract.schema import new_table_schema
+from transferia_tpu.columnar import ColumnBatch
+
+CANON_DIR = pathlib.Path(__file__).parent / "data"
+REGEN = os.environ.get("REGEN_CANON") == "1"
+
+
+def check(name: str, payload: bytes):
+    path = CANON_DIR / name
+    if REGEN:
+        CANON_DIR.mkdir(exist_ok=True)
+        path.write_bytes(payload)
+        pytest.skip(f"regenerated {name}")
+    assert path.exists(), f"canon file {name} missing; run REGEN_CANON=1"
+    expected = path.read_bytes()
+    assert payload == expected, (
+        f"canon mismatch for {name}; if intentional, re-run with "
+        f"REGEN_CANON=1 and review the diff"
+    )
+
+
+SCHEMA = new_table_schema([
+    ("id", "int64", True),
+    ("name", "utf8"),
+    ("score", "double"),
+    ("active", "boolean"),
+    ("created", "timestamp"),
+    ("payload", "any"),
+])
+TID = TableID("shop", "orders")
+
+
+def batch():
+    return ColumnBatch.from_pydict(TID, SCHEMA, {
+        "id": [1, 2, 3],
+        "name": ["alpha", None, "görüş"],
+        "score": [1.5, -2.25, None],
+        "active": [True, False, None],
+        "created": [1_700_000_000_000_000, 0, None],
+        "payload": [{"a": [1, 2]}, None, {"b": {"c": True}}],
+    })
+
+
+def test_canon_json_serializer():
+    from transferia_tpu.serializers import make_serializer
+
+    check("serializer_json.jsonl", make_serializer("json").serialize(batch()))
+
+
+def test_canon_csv_serializer():
+    from transferia_tpu.serializers import make_serializer
+
+    check("serializer_csv.csv",
+          make_serializer("csv", header=True).serialize(batch()))
+
+
+def test_canon_rowbinary():
+    from transferia_tpu.providers.clickhouse.rowbinary import (
+        encode_rowbinary,
+    )
+
+    nullable = {c.name: not c.primary_key for c in SCHEMA}
+    check("clickhouse.rowbinary", encode_rowbinary(batch(), nullable))
+
+
+def test_canon_debezium_envelope():
+    from transferia_tpu.debezium import DebeziumEmitter
+
+    em = DebeziumEmitter(topic_prefix="canon")
+    item = ChangeItem(
+        kind=Kind.UPDATE, schema="shop", table="orders",
+        column_names=("id", "name", "score", "active", "created",
+                      "payload"),
+        column_values=(7, "row", 3.5, True, 1_700_000_000_000_000,
+                       {"k": "v"}),
+        table_schema=SCHEMA,
+        old_keys=OldKeys(("id",), (6,)),
+        lsn=42, txn_id="tx1", commit_time_ns=1_700_000_000_000_000_000,
+    )
+    (key, value), = em.emit_item(item)
+    obj = json.loads(value)
+    obj["payload"]["ts_ms"] = 0  # emission wall-clock: not canon
+    canon = json.dumps(
+        {"key": json.loads(key), "value": obj}, indent=1, sort_keys=True,
+    ).encode()
+    check("debezium_update.json", canon)
+
+
+def test_canon_ch_ddl():
+    from transferia_tpu.providers.clickhouse.provider import ddl_for_schema
+
+    check("clickhouse_ddl.sql",
+          ddl_for_schema(TID, SCHEMA).encode())
+
+
+def test_canon_pg_wal2json_decode():
+    from transferia_tpu.providers.postgres.replication import (
+        Wal2JsonDecoder,
+    )
+
+    dec = Wal2JsonDecoder()
+    item = dec.decode(json.dumps({
+        "action": "U", "schema": "public", "table": "t",
+        "columns": [
+            {"name": "id", "type": "bigint", "value": 9},
+            {"name": "v", "type": "text", "value": "x"},
+        ],
+        "identity": [{"name": "id", "type": "bigint", "value": 8}],
+        "pk": [{"name": "id", "type": "bigint"}],
+    }).encode(), lsn=77)
+    d = item.to_json()
+    d.pop("commit_time")
+    check("wal2json_update.json",
+          json.dumps(d, indent=1, sort_keys=True).encode())
+
+
+def test_canon_hmac_mask():
+    from transferia_tpu.transform import build_chain
+
+    chain = build_chain({"transformers": [
+        {"mask_field": {"columns": ["name"], "salt": "canon-salt"}},
+    ]})
+    out = chain.apply(batch())
+    check("mask_hmac.json",
+          json.dumps(out.to_pydict()["name"], indent=1).encode())
+
+
+def test_canon_parser_output():
+    from transferia_tpu.parsers import Message, make_parser
+
+    p = make_parser({"json": {
+        "schema": [
+            {"name": "id", "type": "int64", "key": True},
+            {"name": "msg", "type": "utf8"},
+        ],
+        "table": "logs",
+    }})
+    msgs = [
+        Message(value=b'{"id": 1, "msg": "ok"}\n{"id": 2, "msg": "two"}',
+                topic="t", partition=3, offset=40,
+                write_time_ns=1_700_000_000_000_000_000),
+        Message(value=b"BROKEN", topic="t", partition=3, offset=41,
+                write_time_ns=1_700_000_000_000_000_000),
+    ]
+    res = p.do_batch(msgs)
+    out = {
+        "rows": res.batches[0].to_pydict(),
+        "unparsed": {
+            k: v for k, v in res.unparsed.to_pydict().items()
+            if k != "_timestamp"
+        },
+    }
+    check("generic_parser.json",
+          json.dumps(out, indent=1, sort_keys=True, default=str).encode())

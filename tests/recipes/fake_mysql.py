@@ -288,6 +288,8 @@ class _MySession:
         if m:
             t = fake.tables.get((m.group(1), m.group(2)))
             return self.send_rows(["c"], [[len(t.rows) if t else 0]])
+        if "@@global.binlog_checksum" in low and low.startswith("select"):
+            return self.send_rows(["@@global.binlog_checksum"], [["NONE"]])
         if low.startswith("show master status"):
             return self.send_rows(
                 ["File", "Position", "Executed_Gtid_Set"],

@@ -1,0 +1,124 @@
+"""Event-model veneer + schema-registry client."""
+
+import json
+import threading
+
+import pytest
+
+from transferia_tpu.abstract import ChangeItem, Kind, TableID
+from transferia_tpu.abstract.change_item import (
+    done_table_load,
+    init_table_load,
+)
+from transferia_tpu.abstract.schema import new_table_schema
+from transferia_tpu.columnar import ColumnBatch
+from transferia_tpu.events import (
+    InsertBatchEvent,
+    RowEvents,
+    TableLoadEvent,
+    batch_to_events,
+    events_to_batches,
+)
+
+
+SCHEMA = new_table_schema([("id", "int64", True)])
+TID = TableID("s", "t")
+
+
+def test_event_roundtrip():
+    cb = ColumnBatch.from_pydict(TID, SCHEMA, {"id": [1, 2]})
+    evs = batch_to_events(cb)
+    assert len(evs) == 1 and isinstance(evs[0], InsertBatchEvent)
+    assert evs[0].row_count() == 2
+
+    items = [
+        init_table_load(TID, SCHEMA, part_id="p1"),
+        ChangeItem(kind=Kind.INSERT, schema="s", table="t",
+                   column_names=("id",), column_values=(1,),
+                   table_schema=SCHEMA),
+        done_table_load(TID, SCHEMA, part_id="p1"),
+    ]
+    evs = batch_to_events(items)
+    assert [type(e).__name__ for e in evs] == [
+        "TableLoadEvent", "RowEvents", "TableLoadEvent",
+    ]
+    assert evs[0].part_id == "p1" and not evs[0].is_done
+    assert evs[2].is_done
+    back = list(events_to_batches(evs))
+    assert len(back) == 3
+    assert back[0][0].kind == Kind.INIT_TABLE_LOAD
+    assert back[1][0].value("id") == 1
+
+
+def test_schema_registry_client():
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    from transferia_tpu.schemaregistry import SchemaRegistryClient
+
+    class Handler(BaseHTTPRequestHandler):
+        def do_GET(self):
+            if self.path == "/schemas/ids/7":
+                body = json.dumps({
+                    "schemaType": "JSON",
+                    "schema": json.dumps({
+                        "type": "object",
+                        "properties": {
+                            "id": {"type": "integer"},
+                            "name": {"type": "string"},
+                            "score": {"type": "number"},
+                        },
+                        "required": ["id"],
+                    }),
+                }).encode()
+                self.send_response(200)
+            elif self.path == "/schemas/ids/8":
+                body = json.dumps({"schemaType": "AVRO",
+                                   "schema": "{}"}).encode()
+                self.send_response(200)
+            else:
+                body = b'{"error_code": 40403}'
+                self.send_response(404)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, *a):
+            pass
+
+    srv = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+    threading.Thread(target=srv.serve_forever, daemon=True).start()
+    try:
+        client = SchemaRegistryClient(
+            f"http://127.0.0.1:{srv.server_address[1]}"
+        )
+        fields = client.fields_for(7)
+        by_name = {f["name"]: f for f in fields}
+        assert by_name["id"]["type"] == "int64"
+        assert by_name["id"]["required"] is True
+        assert by_name["name"]["type"] == "utf8"
+        assert by_name["score"]["type"] == "double"
+        assert client.fields_for(8) is None  # avro -> inference fallback
+        with pytest.raises(Exception, match="404"):
+            client.schema_by_id(99)
+        # cache: second read hits no HTTP (server could be stopped)
+        assert client.fields_for(7) is not None
+    finally:
+        srv.shutdown()
+
+
+def test_confluent_parser_with_registry(tmp_path):
+    """SR-resolved schema drives parsing + coercion."""
+    from transferia_tpu.parsers import Message, make_parser
+    from transferia_tpu.parsers.plugins import ConfluentSRParser
+
+    p = ConfluentSRParser(
+        table="m",
+        resolver=lambda sid: [
+            {"name": "id", "type": "int64", "key": True},
+            {"name": "v", "type": "double"},
+        ] if sid == 3 else None,
+    )
+    framed = b"\x00\x00\x00\x00\x03" + b'{"id": "5", "v": "1.5"}'
+    res = p.do_batch([Message(value=framed)])
+    d = res.batches[0].to_pydict()
+    assert d["id"] == [5] and d["v"] == [1.5]  # coerced per SR schema

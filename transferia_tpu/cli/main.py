@@ -104,9 +104,27 @@ def _start_health_server(port: int) -> None:
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):
-            body = b'{"status":"ok"}'
-            self.send_response(200 if self.path in ("/", "/health") else 404)
-            self.send_header("Content-Type", "application/json")
+            if self.path == "/debug/threads":
+                # pprof-style stack dump (reference serves pprof on :8080)
+                import traceback
+
+                frames = sys._current_frames()
+                names = {t.ident: t.name for t in threading.enumerate()}
+                parts = []
+                for ident, frame in frames.items():
+                    parts.append(
+                        f"Thread {names.get(ident, '?')} ({ident}):\n"
+                        + "".join(traceback.format_stack(frame))
+                    )
+                body = "\n".join(parts).encode()
+                ctype = "text/plain"
+                status = 200
+            else:
+                body = b'{"status":"ok"}'
+                ctype = "application/json"
+                status = 200 if self.path in ("/", "/health") else 404
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)

@@ -1,0 +1,33 @@
+"""Event model v2 (reference: pkg/abstract2/ "Base" — transfer.go:14-263).
+
+The reference grew a second, event-typed dataplane (Event/EventBatch,
+EventSource/EventTarget, Snapshot/ReplicationProvider) used by its delta
+and CH a2 providers.  Here that surface is a thin, typed veneer over the
+primary currency — columnar batches ARE the event batches — so a2-style
+providers plug in without a parallel pipeline:
+
+  InsertBatchEvent        one ColumnBatch of inserts
+  RowEvents               heterogeneous ChangeItem runs
+  TableLoadEvent          Init/Done control markers
+  EventSource/EventTarget adapters to Source/AsyncSink
+"""
+
+from transferia_tpu.events.model import (
+    Event,
+    EventBatch,
+    InsertBatchEvent,
+    RowEvents,
+    TableLoadEvent,
+    batch_to_events,
+    events_to_batches,
+)
+
+__all__ = [
+    "Event",
+    "EventBatch",
+    "InsertBatchEvent",
+    "RowEvents",
+    "TableLoadEvent",
+    "batch_to_events",
+    "events_to_batches",
+]

@@ -1,0 +1,100 @@
+"""Event-typed veneer over the columnar dataplane."""
+
+from __future__ import annotations
+
+import abc
+from dataclasses import dataclass
+from typing import Iterable, Iterator, Sequence, Union
+
+from transferia_tpu.abstract.change_item import ChangeItem
+from transferia_tpu.abstract.interfaces import Batch, is_columnar
+from transferia_tpu.abstract.kinds import Kind
+from transferia_tpu.abstract.schema import TableID
+from transferia_tpu.columnar.batch import ColumnBatch
+
+
+class Event(abc.ABC):
+    """One typed dataplane event (abstract2/transfer.go:14)."""
+
+    @abc.abstractmethod
+    def table(self) -> TableID:
+        ...
+
+
+@dataclass
+class InsertBatchEvent(Event):
+    """A columnar block of inserts — the snapshot hot-path event."""
+
+    batch: ColumnBatch
+
+    def table(self) -> TableID:
+        return self.batch.table_id
+
+    def row_count(self) -> int:
+        return self.batch.n_rows
+
+
+@dataclass
+class RowEvents(Event):
+    """Heterogeneous CDC rows sharing a table."""
+
+    items: list[ChangeItem]
+
+    def table(self) -> TableID:
+        return self.items[0].table_id if self.items else TableID("", "")
+
+
+@dataclass
+class TableLoadEvent(Event):
+    """Init/Done table-load control marker."""
+
+    table_id: TableID
+    kind: Kind
+    part_id: str = ""
+
+    def table(self) -> TableID:
+        return self.table_id
+
+    @property
+    def is_done(self) -> bool:
+        return self.kind in (Kind.DONE_TABLE_LOAD,
+                             Kind.DONE_SHARDED_TABLE_LOAD)
+
+
+# EventBatch = ordered sequence of events (abstract2 EventBatch iterator)
+EventBatch = Sequence[Event]
+
+
+def batch_to_events(batch: Batch) -> list[Event]:
+    """Primary-currency batch -> typed events."""
+    if is_columnar(batch):
+        return [InsertBatchEvent(batch)]
+    out: list[Event] = []
+    run: list[ChangeItem] = []
+    for it in batch:
+        if it.is_row_event():
+            run.append(it)
+            continue
+        if run:
+            out.append(RowEvents(run))
+            run = []
+        if it.kind.is_control:
+            out.append(TableLoadEvent(it.table_id, it.kind, it.part_id))
+    if run:
+        out.append(RowEvents(run))
+    return out
+
+
+def events_to_batches(events: Iterable[Event]) -> Iterator[Batch]:
+    """Typed events -> pushable batches (order preserved)."""
+    from transferia_tpu.abstract.change_item import _control
+
+    for ev in events:
+        if isinstance(ev, InsertBatchEvent):
+            yield ev.batch
+        elif isinstance(ev, RowEvents):
+            yield ev.items
+        elif isinstance(ev, TableLoadEvent):
+            yield [_control(ev.kind, ev.table_id, None, ev.part_id)]
+        else:
+            raise TypeError(f"unknown event {type(ev).__name__}")

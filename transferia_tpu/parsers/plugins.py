@@ -9,7 +9,10 @@ Yandex-internal and intentionally out of scope.
 from __future__ import annotations
 
 import json
+import logging
 from typing import Optional, Sequence
+
+logger = logging.getLogger(__name__)
 
 from transferia_tpu.abstract.change_item import ChangeItem
 from transferia_tpu.abstract.schema import (
@@ -286,12 +289,20 @@ class ConfluentSRParser(Parser):
     """
 
     def __init__(self, table: str = "data", namespace: str = "",
-                 resolver: Optional[object] = None):
+                 resolver: Optional[object] = None,
+                 registry_url: str = "", registry_user: str = "",
+                 registry_password: str = ""):
         self.table = table
         self.namespace = namespace
         # resolver: callable(schema_id) -> field-spec list (the generic
-        # parser's `schema` config) or None; absent/None falls back to
-        # schema inference
+        # parser's `schema` config) or None; a registry_url builds one over
+        # the Confluent REST API (pkg/schemaregistry equivalent); absent
+        # falls back to schema inference
+        if resolver is None and registry_url:
+            from transferia_tpu.schemaregistry import sr_resolver
+
+            resolver = sr_resolver(registry_url, user=registry_user,
+                                   password=registry_password)
         self.resolver = resolver
         self._parsers: dict[int, GenericJsonParser] = {}
 
@@ -299,11 +310,22 @@ class ConfluentSRParser(Parser):
         p = self._parsers.get(schema_id)
         if p is None:
             fields = None
+            resolver_ok = True
             if self.resolver is not None:
-                fields = self.resolver(schema_id)
+                try:
+                    fields = self.resolver(schema_id)
+                except Exception as e:
+                    # transient registry outage: fall back to inference for
+                    # this batch but do NOT cache, so the id retries later
+                    logger.warning(
+                        "schema registry lookup for id %d failed (%s); "
+                        "falling back to inference", schema_id, e,
+                    )
+                    resolver_ok = False
             p = GenericJsonParser(schema=fields, table=self.table,
                                   namespace=self.namespace)
-            self._parsers[schema_id] = p
+            if resolver_ok:
+                self._parsers[schema_id] = p
         return p
 
     def do_batch(self, messages: Sequence[Message]) -> ParseResult:

@@ -180,6 +180,10 @@ class MongoChangeStreamSource(Source):
         conn = _conn(self.params)
         try:
             stage: dict = {"$changeStream": {"fullDocument": "updateLookup"}}
+            if not self.params.database:
+                # no database scoping: watch the whole cluster (a db-level
+                # stream on admin would silently see nothing)
+                stage["$changeStream"]["allChangesForCluster"] = True
             if self.cp is not None:
                 token = self.cp.get_transfer_state(self.transfer_id).get(
                     self.STATE_KEY
@@ -304,25 +308,39 @@ class MongoSinker(Sinker):
             db = self.params.database or it.table_id.namespace or "db"
             by_coll.setdefault((db, it.table_id.name), []).append(it)
         for (db, coll), rows in by_coll.items():
-            updates = []
-            deletes = []
+            # apply in item order: a delete followed by a re-insert of the
+            # same _id must not be reordered into upsert-then-delete
+            run_kind: Optional[bool] = None  # True = delete run
+            run_ops: list[dict] = []
+
+            def flush_run():
+                nonlocal run_ops, run_kind
+                if not run_ops:
+                    return
+                if run_kind:
+                    self.conn.command(db, {"delete": coll,
+                                           "deletes": run_ops})
+                else:
+                    self.conn.command(db, {"update": coll,
+                                           "updates": run_ops})
+                run_ops = []
+
             for it in rows:
-                if it.kind == Kind.DELETE:
+                is_delete = it.kind == Kind.DELETE
+                if run_kind is not None and is_delete != run_kind:
+                    flush_run()
+                run_kind = is_delete
+                if is_delete:
                     key = it.effective_key()
-                    deletes.append({
+                    run_ops.append({
                         "q": {"_id": key[0] if key else None}, "limit": 1,
                     })
                 else:
                     doc = self._doc_of(it)
-                    updates.append({
-                        "q": {"_id": doc["_id"]},
-                        "u": doc,
-                        "upsert": True,
+                    run_ops.append({
+                        "q": {"_id": doc["_id"]}, "u": doc, "upsert": True,
                     })
-            if updates:
-                self.conn.command(db, {"update": coll, "updates": updates})
-            if deletes:
-                self.conn.command(db, {"delete": coll, "deletes": deletes})
+            flush_run()
 
 
 @register_provider

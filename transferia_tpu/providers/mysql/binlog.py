@@ -3,9 +3,11 @@
 Reference parity: pkg/providers/mysql/canal.go — binlog tailing with
 position/gtid checkpointing (coordinator MysqlGtidState parity keys).
 
-Protocol: COM_BINLOG_DUMP after registering as a replica; the server
-streams OK-prefixed binlog events (v4 framing: timestamp(4) type(1)
-server_id(4) event_size(4) log_pos(4) flags(2) + body).  Decoded events:
+Protocol: COM_BINLOG_DUMP (no replica registration — the server streams
+to any authenticated connection); events arrive as OK-prefixed packets
+(v4 framing: timestamp(4) type(1) server_id(4) event_size(4) log_pos(4)
+flags(2) + body, plus a CRC32 trailer when binlog_checksum is on, which
+is negotiated and stripped).  Decoded events:
 FORMAT_DESCRIPTION, ROTATE, TABLE_MAP, WRITE/UPDATE/DELETE_ROWS v1/v2,
 QUERY (DDL passthrough), XID.  Row images decode per the TABLE_MAP column
 types; schemas come from the catalog (information_schema) since binlog
@@ -44,7 +46,6 @@ EV_UPDATE_ROWS_V2 = 31
 EV_DELETE_ROWS_V2 = 32
 
 COM_BINLOG_DUMP = 0x12
-COM_REGISTER_SLAVE = 0x15
 
 # column types (subset)
 T_DECIMAL = 0
@@ -335,10 +336,11 @@ class BinlogReader:
         payload = body[19:]
         out = []
         if etype == EV_ROTATE:
-            # rotate resets positions: pair the NEW file with ITS position
+            # rotate resets positions: pair the NEW file with ITS position.
+            # binlog_file is NOT updated here — the consumer flushes pending
+            # rows against the OLD file first, then applies the rotate
             new_pos = struct.unpack_from("<Q", payload, 0)[0]
             new_file = payload[8:].rstrip(b"\x00").decode()
-            self.binlog_file = new_file
             out.append(("rotate", new_file, new_pos))
             return out
         out.append(("pos", log_pos, ts))
@@ -449,7 +451,15 @@ class MySQLBinlogSource(Source):
             password=self.params.password,
         ).connect()
         try:
-            conn.query("SET @master_binlog_checksum = 'NONE'")
+            # honor the server's checksum setting: MySQL >= 5.6 defaults to
+            # CRC32 and appends 4 bytes per event that must be stripped
+            conn.query(
+                "SET @master_binlog_checksum = @@global.binlog_checksum"
+            )
+            checksum = str(conn.scalar(
+                "SELECT @@global.binlog_checksum"
+            ) or "NONE").upper()
+            checksum_bytes = 4 if checksum == "CRC32" else 0
             file, pos = self._start_position(catalog)
             self._dump(conn, file, pos)
 
@@ -502,11 +512,17 @@ class MySQLBinlogSource(Source):
                     raise conn._err(pkt)
                 if pkt[:1] == b"\xfe" and len(pkt) < 9:
                     break  # EOF
-                for ev in reader.parse_event(pkt[1:]):
+                event = pkt[1:len(pkt) - checksum_bytes] \
+                    if checksum_bytes else pkt[1:]
+                for ev in reader.parse_event(event):
                     if ev[0] == "pos":
                         pending_pos = max(pending_pos, ev[1])
                     elif ev[0] == "rotate":
+                        # flush pending rows against the OLD file, THEN
+                        # switch files — a crash between the two writes
+                        # must never leave (new file, old position)
                         flush()
+                        reader.binlog_file = ev[1]
                         pending_pos = ev[2]
                         last_pos = ev[2]
                         if self.cp is not None:

@@ -100,6 +100,9 @@ class PGSourceParams(EndpointParams):
     database: str = "postgres"
     user: str = "postgres"
     password: str = ""
+    # failover host list (pkg/pgha): tried in order before `host`; the
+    # first host that accepts a connection wins
+    hosts: list[str] = field(default_factory=list)
     schemas: list[str] = field(default_factory=lambda: ["public"])
     batch_rows: int = 131_072
     desired_part_size_bytes: int = 256 << 20  # ctid split target
@@ -120,10 +123,28 @@ class PGTargetParams(EndpointParams):
 
 
 def _conn(params) -> PGConnection:
-    return PGConnection(
-        host=params.host, port=params.port, database=params.database,
-        user=params.user, password=params.password,
-    ).connect()
+    """Connect with pgha-style failover across the configured host list."""
+    candidates = []
+    for h in getattr(params, "hosts", None) or []:
+        host, sep, port = h.rpartition(":")
+        if sep and port.isdigit():
+            candidates.append((host, int(port)))
+        else:
+            # bare hostname, IPv6 literal, or junk port: default port, and
+            # never let a malformed entry abort failover over good hosts
+            candidates.append((h, params.port))
+    candidates.append((params.host, params.port))
+    last: Optional[Exception] = None
+    for host, port in candidates:
+        try:
+            return PGConnection(
+                host=host, port=port, database=params.database,
+                user=params.user, password=params.password,
+            ).connect()
+        except (OSError, PGError) as e:
+            last = e
+            logger.warning("pg host %s:%s unavailable: %s", host, port, e)
+    raise PGError(f"no postgres host reachable: {last}")
 
 
 class PGStorage(Storage, ShardingStorage, PositionalStorage,

@@ -1,0 +1,13 @@
+"""Confluent Schema Registry client (reference: pkg/schemaregistry/).
+
+Resolves schema ids from the registry's REST API and adapts JSON-schema
+definitions into the generic parser's field specs; plugs into the
+confluent_schema_registry parser as its resolver.
+"""
+
+from transferia_tpu.schemaregistry.client import (
+    SchemaRegistryClient,
+    sr_resolver,
+)
+
+__all__ = ["SchemaRegistryClient", "sr_resolver"]
