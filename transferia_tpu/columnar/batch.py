@@ -129,9 +129,23 @@ class Column:
         if self.offsets is None:
             return Column(self.name, self.ctype, self.data[indices], None, validity)
         lens = (self.offsets[1:] - self.offsets[:-1])[indices].astype(np.int64)
-        new_offsets = _offsets_from_lengths(lens)
+        new_offsets = _offsets_from_lengths(lens)  # guards the 2GiB limit
         total = int(new_offsets[-1])
-        # vectorized gather: src position of every output byte
+        from transferia_tpu.native import lib as _native_lib
+
+        cdll = _native_lib()
+        if cdll is not None and total:
+            out = np.empty(total, dtype=np.uint8)
+            out_offsets = np.empty(len(indices) + 1, dtype=np.int32)
+            cdll.gather_varwidth(
+                np.ascontiguousarray(self.data),
+                np.ascontiguousarray(self.offsets, dtype=np.int32),
+                np.ascontiguousarray(indices, dtype=np.int64),
+                len(indices), out, out_offsets,
+            )
+            return Column(self.name, self.ctype, out, out_offsets,
+                          validity)
+        # numpy fallback: flat gather via repeat/arange
         starts = self.offsets[:-1][indices].astype(np.int64)
         intra = np.arange(total, dtype=np.int64) - np.repeat(
             new_offsets[:-1].astype(np.int64), lens

@@ -16,6 +16,7 @@ from transferia_tpu.events.model import (
     Event,
     EventBatch,
     InsertBatchEvent,
+    RawItems,
     RowEvents,
     TableLoadEvent,
     batch_to_events,
@@ -26,6 +27,7 @@ __all__ = [
     "Event",
     "EventBatch",
     "InsertBatchEvent",
+    "RawItems",
     "RowEvents",
     "TableLoadEvent",
     "batch_to_events",

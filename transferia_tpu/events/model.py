@@ -46,11 +46,13 @@ class RowEvents(Event):
 
 @dataclass
 class TableLoadEvent(Event):
-    """Init/Done table-load control marker."""
+    """Init/Done table-load control marker (carries the table schema so
+    sinks can create tables from Init events)."""
 
     table_id: TableID
     kind: Kind
     part_id: str = ""
+    schema: object = None  # Optional[TableSchema]
 
     def table(self) -> TableID:
         return self.table_id
@@ -59,6 +61,17 @@ class TableLoadEvent(Event):
     def is_done(self) -> bool:
         return self.kind in (Kind.DONE_TABLE_LOAD,
                              Kind.DONE_SHARDED_TABLE_LOAD)
+
+
+@dataclass
+class RawItems(Event):
+    """Non-row, non-control items (DDL/truncate/provider-specific kinds) —
+    passed through verbatim so the veneer round trip is lossless."""
+
+    items: list[ChangeItem]
+
+    def table(self) -> TableID:
+        return self.items[0].table_id if self.items else TableID("", "")
 
 
 # EventBatch = ordered sequence of events (abstract2 EventBatch iterator)
@@ -79,7 +92,11 @@ def batch_to_events(batch: Batch) -> list[Event]:
             out.append(RowEvents(run))
             run = []
         if it.kind.is_control:
-            out.append(TableLoadEvent(it.table_id, it.kind, it.part_id))
+            out.append(TableLoadEvent(it.table_id, it.kind, it.part_id,
+                                      it.table_schema))
+        else:
+            # DDL/truncate/provider kinds: lossless passthrough
+            out.append(RawItems([it]))
     if run:
         out.append(RowEvents(run))
     return out
@@ -94,7 +111,9 @@ def events_to_batches(events: Iterable[Event]) -> Iterator[Batch]:
             yield ev.batch
         elif isinstance(ev, RowEvents):
             yield ev.items
+        elif isinstance(ev, RawItems):
+            yield ev.items
         elif isinstance(ev, TableLoadEvent):
-            yield [_control(ev.kind, ev.table_id, None, ev.part_id)]
+            yield [_control(ev.kind, ev.table_id, ev.schema, ev.part_id)]
         else:
             raise TypeError(f"unknown event {type(ev).__name__}")

@@ -1,0 +1,84 @@
+"""Native host-ops loader (C++ via ctypes).
+
+`lib()` returns the loaded library or None; callers keep numpy fallbacks.
+The shared object builds once per environment into this package directory
+(`python -m transferia_tpu.native.build`, or lazily on first use when a
+compiler is present).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import logging
+import os
+import pathlib
+import threading
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_DIR = pathlib.Path(__file__).parent
+_SO = _DIR / "libhostops.so"
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _bind(cdll: ctypes.CDLL) -> ctypes.CDLL:
+    import numpy.ctypeslib as npc
+    import numpy as np
+
+    u8 = npc.ndpointer(np.uint8, flags="C_CONTIGUOUS")
+    i32 = npc.ndpointer(np.int32, flags="C_CONTIGUOUS")
+    i64 = npc.ndpointer(np.int64, flags="C_CONTIGUOUS")
+    u64 = npc.ndpointer(np.uint64, flags="C_CONTIGUOUS")
+    cdll.leb128_encode.argtypes = [u64, ctypes.c_int64, u8, i32]
+    cdll.leb128_encode.restype = ctypes.c_int64
+    cdll.scatter_bytes.argtypes = [u8, i64, i64, i64, ctypes.c_int64, u8]
+    cdll.scatter_bytes.restype = None
+    cdll.gather_varwidth.argtypes = [u8, i32, i64, ctypes.c_int64, u8, i32]
+    cdll.gather_varwidth.restype = ctypes.c_int64
+    return cdll
+
+
+def build(force: bool = False) -> bool:
+    """Compile the shared library; returns True on success."""
+    import shutil
+    import subprocess
+
+    if _SO.exists() and not force:
+        return True
+    cxx = shutil.which("g++") or shutil.which("clang++")
+    if cxx is None:
+        return False
+    src = _DIR / "hostops.cpp"
+    try:
+        subprocess.run(
+            [cxx, "-O3", "-shared", "-fPIC", "-o", str(_SO), str(src)],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired) as e:
+        logger.warning("hostops build failed: %s", e)
+        return False
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """Load (building if needed); None when unavailable."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if os.environ.get("TRANSFERIA_TPU_NO_NATIVE") == "1":
+            return None
+        if not _SO.exists() and not build():
+            return None
+        try:
+            _lib = _bind(ctypes.CDLL(str(_SO)))
+        except OSError as e:
+            logger.warning("hostops load failed: %s", e)
+            _lib = None
+    return _lib

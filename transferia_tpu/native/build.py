@@ -1,0 +1,8 @@
+"""Build the native host-ops library: python -m transferia_tpu.native.build"""
+
+from transferia_tpu.native import build
+
+if __name__ == "__main__":
+    ok = build(force=True)
+    print("built" if ok else "build failed (no compiler?)")
+    raise SystemExit(0 if ok else 1)

@@ -1,0 +1,69 @@
+// Host-side hot-loop kernels (C++), ctypes-bound.
+//
+// The reference gets its performance from hand-optimized Go loops; here the
+// device (XLA) and arrow (C++) carry most of the weight, and this small
+// library covers the residual host loops that numpy can't fully vectorize
+// without large temporaries:
+//   - LEB128 varint encoding (RowBinary string length prefixes)
+//   - interleaved byte scatter (columnar -> row-major RowBinary assembly)
+//   - var-width gather (Column.take without index temporaries)
+//
+// Build: transferia_tpu/native/build.py (g++ -O3 -shared -fPIC).  All
+// callers fall back to the numpy implementations when the library is
+// absent — the extension is an accelerator, never a dependency.
+
+#include <cstdint>
+#include <cstring>
+
+extern "C" {
+
+// values[n] -> out varint bytes; out_lens[n] = bytes written per value.
+// Returns total bytes written.  out must be preallocated (<= 10*n).
+int64_t leb128_encode(const uint64_t* values, int64_t n,
+                      uint8_t* out, int32_t* out_lens) {
+    int64_t pos = 0;
+    for (int64_t i = 0; i < n; i++) {
+        uint64_t v = values[i];
+        int32_t len = 0;
+        do {
+            uint8_t b = v & 0x7F;
+            v >>= 7;
+            out[pos++] = v ? (b | 0x80) : b;
+            len++;
+        } while (v);
+        out_lens[i] = len;
+    }
+    return pos;
+}
+
+// Scatter per-row fields into row-major output:
+//   out[dst_offsets[i] .. +lens[i]] = src[src_offsets[i] .. +lens[i]]
+void scatter_bytes(const uint8_t* src, const int64_t* src_offsets,
+                   const int64_t* dst_offsets, const int64_t* lens,
+                   int64_t n, uint8_t* out) {
+    for (int64_t i = 0; i < n; i++) {
+        memcpy(out + dst_offsets[i], src + src_offsets[i],
+               (size_t)lens[i]);
+    }
+}
+
+// Gather var-width rows: for each index idx[i], copy
+// src[src_offsets[idx[i]] .. src_offsets[idx[i]+1]) into out sequentially;
+// writes out_offsets[n+1].  Returns total bytes.
+int64_t gather_varwidth(const uint8_t* src, const int32_t* src_offsets,
+                        const int64_t* idx, int64_t n,
+                        uint8_t* out, int32_t* out_offsets) {
+    int64_t pos = 0;
+    out_offsets[0] = 0;
+    for (int64_t i = 0; i < n; i++) {
+        int64_t j = idx[i];
+        int32_t start = src_offsets[j];
+        int32_t len = src_offsets[j + 1] - start;
+        memcpy(out + pos, src + start, (size_t)len);
+        pos += len;
+        out_offsets[i + 1] = (int32_t)pos;
+    }
+    return pos;
+}
+
+}  // extern "C"

@@ -106,27 +106,64 @@ class GenericJsonParser(Parser):
 
     # -- decoding -----------------------------------------------------------
     def _decode_rows(self, values: list[bytes]) -> list[Optional[dict]]:
-        """Vectorized-ish decode with bisecting error isolation.
+        """Vectorized decode with bisecting error isolation.
 
         Returns one dict per line (None = unparseable).  The fast path
-        json-decodes the whole block; only blocks containing a bad row pay
-        the split.
+        decodes the whole block in one C++ pass (pyarrow's JSON reader for
+        large batches, a single stdlib json.loads for small ones); only
+        blocks containing a bad row pay the recursive split.
         """
         out: list[Optional[dict]] = [None] * len(values)
 
-        def attempt(lo: int, hi: int) -> None:
+        def block_decode(lo: int, hi: int) -> Optional[list[dict]]:
             blob = b"[" + b",".join(values[lo:hi]) + b"]"
             try:
                 rows = json.loads(blob)
-                ok = (
-                    len(rows) == hi - lo
-                    and all(isinstance(r, dict) for r in rows)
-                )
-                if ok:
-                    out[lo:hi] = rows
-                    return
             except ValueError:
-                pass
+                return None
+            if len(rows) != hi - lo or \
+                    not all(isinstance(r, dict) for r in rows):
+                return None
+            return rows
+
+        def block_decode_arrow(lo: int, hi: int) -> Optional[list[dict]]:
+            """One vectorized pass over newline-joined rows (arrow's C++
+            block reader) — ~5-10x json.loads on wide batches.  Used only
+            with an explicit scalar schema so arrow can't reinterpret
+            values (e.g. date-like strings) differently from json.loads;
+            any mismatch falls back to the bisecting stdlib path."""
+            import io
+
+            try:
+                import pyarrow as pa
+                import pyarrow.json as pajson
+            except ImportError:
+                return block_decode(lo, hi)
+            schema = self._arrow_schema()
+            if schema is None:
+                return block_decode(lo, hi)
+            blob = b"\n".join(values[lo:hi])
+            try:
+                tbl = pajson.read_json(
+                    io.BytesIO(blob),
+                    parse_options=pajson.ParseOptions(
+                        newlines_in_values=False,
+                        explicit_schema=schema,
+                        unexpected_field_behavior="ignore",
+                    ),
+                )
+            except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+                return None
+            if tbl.num_rows != hi - lo:
+                return None
+            return tbl.to_pylist()
+
+        def attempt(lo: int, hi: int) -> None:
+            rows = (block_decode_arrow(lo, hi) if hi - lo >= 256
+                    else block_decode(lo, hi))
+            if rows is not None:
+                out[lo:hi] = rows
+                return
             if hi - lo == 1:
                 return  # isolated bad row stays None
             mid = (lo + hi) // 2
@@ -136,6 +173,34 @@ class GenericJsonParser(Parser):
         if values:
             attempt(0, len(values))
         return out
+
+    def _arrow_schema(self):
+        """Explicit arrow schema for the C++ fast path, or None when the
+        declared fields need features arrow can't mirror (nested paths,
+        ANY variants, inference) or pyarrow is absent."""
+        if not self.fields:
+            return None
+        try:
+            import pyarrow as pa
+        except ImportError:
+            return None
+
+        scalar = {
+            CanonicalType.INT8: pa.int64(), CanonicalType.INT16: pa.int64(),
+            CanonicalType.INT32: pa.int64(),
+            CanonicalType.INT64: pa.int64(),
+            CanonicalType.FLOAT: pa.float64(),
+            CanonicalType.DOUBLE: pa.float64(),
+            CanonicalType.BOOLEAN: pa.bool_(),
+            CanonicalType.UTF8: pa.string(),
+            CanonicalType.STRING: pa.string(),
+        }
+        out = []
+        for cs in self.fields:
+            if cs.path or cs.data_type not in scalar:
+                return None
+            out.append(pa.field(cs.name, scalar[cs.data_type]))
+        return pa.schema(out)
 
     def _extract(self, rows: list[dict], cs: ColSchema) -> list[Any]:
         if cs.path:
@@ -152,8 +217,116 @@ class GenericJsonParser(Parser):
             return [get(r) for r in rows]
         return [r.get(cs.name) for r in rows]
 
+    def _fast_columnar(self, messages: Sequence[Message],
+                       lines: "_Lines") -> Optional[ParseResult]:
+        """Whole-batch columnar shortcut: arrow-decode straight into the
+        ColumnBatch with vectorized system columns — no per-row dicts.
+        Returns None when anything (bad rows, null keys, exotic schema)
+        needs the general path."""
+        if type(self) is not GenericJsonParser or not self.fields:
+            return None
+        if len(lines.values) < 256:
+            return None
+        import io
+
+        import numpy as np
+
+        try:
+            import pyarrow as pa
+            import pyarrow.json as pajson
+        except ImportError:  # minimal install: general path only
+            return None
+        schema = self._arrow_schema()
+        if schema is None:
+            return None
+        try:
+            tbl = pajson.read_json(
+                io.BytesIO(b"\n".join(lines.values)),
+                parse_options=pajson.ParseOptions(
+                    newlines_in_values=False,
+                    explicit_schema=schema,
+                    unexpected_field_behavior="ignore",
+                ),
+            )
+        except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+            return None
+        if tbl.num_rows != len(lines.values):
+            return None
+        keep = np.ones(tbl.num_rows, dtype=bool)
+        if not self.null_keys_allowed:
+            # null-key offenders route to _unparsed without abandoning the
+            # already-done C++ parse
+            for cs in self.fields:
+                if cs.primary_key and tbl.column(cs.name).null_count:
+                    keep &= np.asarray(
+                        tbl.column(cs.name).combine_chunks().is_valid()
+                    )
+        kept_pos = np.nonzero(keep)[0]
+        if len(kept_pos) != tbl.num_rows:
+            tbl = tbl.take(pa.array(kept_pos))
+        out_schema = self._schema or self._build_schema(self.fields)
+        batch = ColumnBatch.from_arrow(
+            tbl.combine_chunks().to_batches()[0] if tbl.num_rows else
+            tbl.to_batches() or pa.RecordBatch.from_pylist([], schema),
+            self.table,
+            out_schema.project([c.name for c in self.fields]),
+        ) if tbl.num_rows else None
+        cols = dict(batch.columns) if batch is not None else {}
+        if self.add_system_cols and batch is not None:
+            midx = np.asarray(lines.msg_index)[kept_pos]
+            write_ns = np.array(
+                [m.write_time_ns for m in messages], dtype=np.int64
+            )
+            offsets_arr = np.array(
+                [m.offset for m in messages], dtype=np.uint64
+            )
+            parts = [f"{m.topic}:{m.partition}" for m in messages]
+            cols["_timestamp"] = Column(
+                "_timestamp", CanonicalType.TIMESTAMP,
+                (write_ns // 1000)[midx],
+            )
+            cols["_partition"] = Column.from_pylist(
+                "_partition", CanonicalType.UTF8,
+                [parts[i] for i in midx],
+            )
+            cols["_offset"] = Column("_offset", CanonicalType.UINT64,
+                                     offsets_arr[midx])
+            cols["_idx"] = Column(
+                "_idx", CanonicalType.UINT32,
+                np.asarray(lines.line_index,
+                           dtype=np.uint32)[kept_pos],
+            )
+        result = ParseResult()
+        if batch is not None:
+            ordered = {
+                c.name: cols[c.name] for c in out_schema if c.name in cols
+            }
+            result.batches.append(
+                ColumnBatch(self.table, out_schema, ordered)
+            )
+        bad_pos = np.nonzero(~keep)[0]
+        if len(bad_pos):
+            bad_msgs = [
+                Message(
+                    value=lines.values[i],
+                    topic=messages[lines.msg_index[i]].topic,
+                    partition=messages[lines.msg_index[i]].partition,
+                    offset=messages[lines.msg_index[i]].offset,
+                    write_time_ns=messages[lines.msg_index[i]]
+                    .write_time_ns,
+                )
+                for i in bad_pos
+            ]
+            result.unparsed = unparsed_batch(
+                bad_msgs, ["null value in key column"] * len(bad_pos)
+            )
+        return result
+
     def do_batch(self, messages: Sequence[Message]) -> ParseResult:
         lines = _Lines(messages)
+        fast = self._fast_columnar(messages, lines)
+        if fast is not None:
+            return fast
         decoded = self._decode_rows(lines.values)
 
         # line index -> failure reason; grows as validation rejects rows

@@ -39,8 +39,22 @@ def _leb128_lengths(values: np.ndarray) -> np.ndarray:
 
 
 def _encode_varints(values: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
-    """values -> (flat varint bytes, per-value byte length), vectorized."""
+    """values -> (flat varint bytes, per-value byte length).
+
+    Uses the native hostops kernel when available (single pass, no
+    temporaries); numpy multi-pass otherwise.
+    """
+    from transferia_tpu.native import lib
+
     n = len(values)
+    cdll = lib()
+    if cdll is not None and n:
+        out = np.empty(n * 10, dtype=np.uint8)
+        lens = np.empty(n, dtype=np.int32)
+        total = cdll.leb128_encode(
+            np.ascontiguousarray(values, dtype=np.uint64), n, out, lens
+        )
+        return out[:total].copy(), lens.astype(np.int64)
     vlens = _leb128_lengths(values)
     offsets = np.zeros(n + 1, dtype=np.int64)
     np.cumsum(vlens, out=offsets[1:])
@@ -210,15 +224,25 @@ def encode_rowbinary(batch: ColumnBatch,
     np.cumsum(row_lens, out=row_offsets[1:])
     out = np.zeros(int(row_offsets[-1]), dtype=np.uint8)
     field_start = row_offsets[:-1].copy()
+    from transferia_tpu.native import lib
+
+    cdll = lib()
     for e in encoded:
         lens = e.lens
         total = int(lens.sum())
         if total:
-            inner = np.arange(total) - np.repeat(
-                np.concatenate([[0], np.cumsum(lens)[:-1]]), lens
-            )
-            dst = np.repeat(field_start, lens) + inner
-            out[dst] = e.data
+            src_off = np.zeros(n, dtype=np.int64)
+            np.cumsum(lens[:-1], out=src_off[1:])
+            if cdll is not None:
+                cdll.scatter_bytes(
+                    np.ascontiguousarray(e.data),
+                    src_off, np.ascontiguousarray(field_start),
+                    np.ascontiguousarray(lens), n, out,
+                )
+            else:
+                inner = np.arange(total) - np.repeat(src_off, lens)
+                dst = np.repeat(field_start, lens) + inner
+                out[dst] = e.data
         field_start += lens
     return out.tobytes()
 

@@ -126,12 +126,17 @@ def _conn(params) -> PGConnection:
     """Connect with pgha-style failover across the configured host list."""
     candidates = []
     for h in getattr(params, "hosts", None) or []:
-        host, sep, port = h.rpartition(":")
-        if sep and port.isdigit():
+        if h.startswith("["):  # [v6]:port or [v6]
+            v6, _, rest = h[1:].partition("]")
+            port = rest.lstrip(":")
+            candidates.append((v6, int(port) if port.isdigit()
+                               else params.port))
+        elif h.count(":") == 1 and h.rpartition(":")[2].isdigit():
+            host, _, port = h.rpartition(":")
             candidates.append((host, int(port)))
         else:
-            # bare hostname, IPv6 literal, or junk port: default port, and
-            # never let a malformed entry abort failover over good hosts
+            # bare hostname, unbracketed IPv6 literal, or junk port:
+            # default port — a malformed entry must never abort failover
             candidates.append((h, params.port))
     candidates.append((params.host, params.port))
     last: Optional[Exception] = None
