@@ -66,6 +66,10 @@ def build_parser() -> argparse.ArgumentParser:
     add_transfer_cmd("validate", "parse and validate the transfer config")
     add_transfer_cmd("deactivate",
                      "release source resources (replication slots etc.)")
+    sniff = add_transfer_cmd("sniff",
+                             "preview sample rows from the source")
+    sniff.add_argument("--rows", type=int, default=5,
+                       help="rows per table")
     reg = add_transfer_cmd("regular-snapshot",
                            "run the cron-driven re-snapshot loop")
     reg.add_argument("--max-runs", type=int, default=0,
@@ -211,6 +215,15 @@ def main(argv=None) -> int:
         get_provider(transfer.src_provider(), transfer).deactivate()
         cp.set_status(transfer.id, TransferStatus.DEACTIVATED)
         print(f"transfer {transfer.id}: deactivated")
+        return 0
+
+    if args.command == "sniff":
+        from transferia_tpu.providers.registry import get_provider
+
+        sample = get_provider(transfer.src_provider(), transfer).sniff(
+            max_rows=args.rows
+        )
+        print(json.dumps(sample, indent=2, default=str))
         return 0
 
     if args.command == "regular-snapshot":

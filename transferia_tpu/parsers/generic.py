@@ -56,12 +56,13 @@ def _field_to_colschema(f: dict) -> ColSchema:
 class _Lines:
     """Flattened (message, line) view of a batch."""
 
-    __slots__ = ("values", "msg_index", "line_index")
+    __slots__ = ("values", "msg_index", "line_index", "arrow_failed_full")
 
     def __init__(self, messages: Sequence[Message]):
         self.values: list[bytes] = []
         self.msg_index: list[int] = []
         self.line_index: list[int] = []
+        self.arrow_failed_full = False
         for mi, m in enumerate(messages):
             for li, line in enumerate(m.value.split(b"\n")):
                 if line.strip():
@@ -105,7 +106,8 @@ class GenericJsonParser(Parser):
         return self._schema
 
     # -- decoding -----------------------------------------------------------
-    def _decode_rows(self, values: list[bytes]) -> list[Optional[dict]]:
+    def _decode_rows(self, values: list[bytes],
+                     skip_full_arrow: bool = False) -> list[Optional[dict]]:
         """Vectorized decode with bisecting error isolation.
 
         Returns one dict per line (None = unparseable).  The fast path
@@ -158,8 +160,9 @@ class GenericJsonParser(Parser):
                 return None
             return tbl.to_pylist()
 
-        def attempt(lo: int, hi: int) -> None:
-            rows = (block_decode_arrow(lo, hi) if hi - lo >= 256
+        def attempt(lo: int, hi: int, skip_arrow: bool = False) -> None:
+            use_arrow = hi - lo >= 256 and not skip_arrow
+            rows = (block_decode_arrow(lo, hi) if use_arrow
                     else block_decode(lo, hi))
             if rows is not None:
                 out[lo:hi] = rows
@@ -171,7 +174,9 @@ class GenericJsonParser(Parser):
             attempt(mid, hi)
 
         if values:
-            attempt(0, len(values))
+            # skip_full_arrow: the caller already ran (and failed) the
+            # full-range arrow parse — don't pay it twice
+            attempt(0, len(values), skip_arrow=skip_full_arrow)
         return out
 
     def _arrow_schema(self):
@@ -249,8 +254,12 @@ class GenericJsonParser(Parser):
                 ),
             )
         except (pa.ArrowInvalid, pa.ArrowNotImplementedError):
+            # tell the general path the full-range arrow parse is a known
+            # failure so it goes straight to bisection
+            lines.arrow_failed_full = True
             return None
         if tbl.num_rows != len(lines.values):
+            lines.arrow_failed_full = True
             return None
         keep = np.ones(tbl.num_rows, dtype=bool)
         if not self.null_keys_allowed:
@@ -266,9 +275,7 @@ class GenericJsonParser(Parser):
             tbl = tbl.take(pa.array(kept_pos))
         out_schema = self._schema or self._build_schema(self.fields)
         batch = ColumnBatch.from_arrow(
-            tbl.combine_chunks().to_batches()[0] if tbl.num_rows else
-            tbl.to_batches() or pa.RecordBatch.from_pylist([], schema),
-            self.table,
+            tbl.combine_chunks().to_batches()[0], self.table,
             out_schema.project([c.name for c in self.fields]),
         ) if tbl.num_rows else None
         cols = dict(batch.columns) if batch is not None else {}
@@ -327,7 +334,9 @@ class GenericJsonParser(Parser):
         fast = self._fast_columnar(messages, lines)
         if fast is not None:
             return fast
-        decoded = self._decode_rows(lines.values)
+        decoded = self._decode_rows(
+            lines.values, skip_full_arrow=lines.arrow_failed_full
+        )
 
         # line index -> failure reason; grows as validation rejects rows
         bad: dict[int, str] = {
@@ -441,7 +450,8 @@ def _coerce(data: dict[str, list], schema: TableSchema) -> dict[str, list]:
 class TskvParser(GenericJsonParser):
     """TSKV (tab-separated key=value) lines -> same output contract."""
 
-    def _decode_rows(self, values: list[bytes]) -> list[Optional[dict]]:
+    def _decode_rows(self, values: list[bytes],
+                     skip_full_arrow: bool = False) -> list[Optional[dict]]:
         out: list[Optional[dict]] = []
         for line in values:
             try:

@@ -92,6 +92,54 @@ class Provider(abc.ABC):
     def deactivate(self) -> None:
         """Deactivator: release source resources (slots etc.)."""
 
+    SNIFF_TABLE_CAP = 20
+
+    def sniff(self, max_rows: int = 10) -> dict:
+        """Sniffer (provider.go Sniffer): preview a sample of rows from up
+        to SNIFF_TABLE_CAP tables (a "_truncated" entry reports how many
+        tables were skipped).  Default implementation samples through the
+        storage capability.
+        """
+        storage = self.storage()
+        if storage is None:
+            raise NotImplementedError(
+                f"provider {self.NAME!r} has no snapshot capability to "
+                f"sniff"
+            )
+        from transferia_tpu.abstract.table import TableDescription
+
+        out: dict[str, list] = {}
+        try:
+            all_tables = list(storage.table_list())
+            if len(all_tables) > self.SNIFF_TABLE_CAP:
+                out["_truncated"] = [
+                    f"{len(all_tables) - self.SNIFF_TABLE_CAP} more "
+                    f"tables not sampled"
+                ]
+            for tid in all_tables[:self.SNIFF_TABLE_CAP]:
+                rows: list = []
+
+                class _Enough(Exception):
+                    pass
+
+                def pusher(batch):
+                    items = batch.to_rows() \
+                        if hasattr(batch, "to_rows") else batch
+                    for it in items:
+                        if it.is_row_event():
+                            rows.append(it.as_dict())
+                            if len(rows) >= max_rows:
+                                raise _Enough()
+
+                try:
+                    storage.load_table(TableDescription(id=tid), pusher)
+                except _Enough:
+                    pass
+                out[str(tid)] = rows
+        finally:
+            storage.close()
+        return out
+
 
 _PROVIDERS: dict[str, Type[Provider]] = {}
 

@@ -25,8 +25,14 @@ logger = logging.getLogger(__name__)
 def activate_delivery(transfer, coordinator: Coordinator,
                       metrics: Optional[Metrics] = None,
                       operation_id: Optional[str] = None) -> None:
+    """Activation with rollback discipline (activate_delivery.go:27 uses
+    util.Rollbacks the same way): source-side resources acquired during a
+    failed activation — e.g. replication slots — are released."""
+    from transferia_tpu.utils.rollbacks import Rollbacks
+
     metrics = metrics or Metrics()
     coordinator.set_status(transfer.id, TransferStatus.ACTIVATING)
+    rollbacks = Rollbacks()
     try:
         loader = SnapshotLoader(transfer, coordinator,
                                 operation_id=operation_id, metrics=metrics)
@@ -58,6 +64,11 @@ def activate_delivery(transfer, coordinator: Coordinator,
 
         src_provider = get_provider(transfer.src_provider(), transfer,
                                     metrics)
+        # Providers that acquire source resources during THEIR activate
+        # hook register undos on `rollbacks` themselves (never eagerly
+        # here: tearing down a pre-existing slot on a destination-side
+        # failure would lose the WAL position of a previous activation).
+        src_provider.rollbacks = rollbacks
         if transfer.type.has_snapshot:
             if src_provider.supports_activate():
                 src_provider.activate(
@@ -72,11 +83,16 @@ def activate_delivery(transfer, coordinator: Coordinator,
                 src_provider.activate(
                     ActivateCallbacks(cleanup_cb, lambda _t: None)
                 )
+        rollbacks.cancel()
         coordinator.set_status(transfer.id, TransferStatus.ACTIVATED)
         coordinator.set_transfer_state(transfer.id, {"status": "activated"})
     except BaseException as e:
         coordinator.set_status(transfer.id, TransferStatus.FAILED)
         coordinator.open_status_message(transfer.id, "activate", str(e))
+        try:
+            rollbacks.run()
+        except Exception:
+            logger.exception("activation rollback errors")
         raise
 
 
