@@ -36,6 +36,7 @@ def load_builtin_providers() -> None:
         elastic,
         greenplum,
         kafka,
+        kinesis,
         misc_providers,
         mongo,
         mysql,

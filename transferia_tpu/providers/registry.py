@@ -29,12 +29,23 @@ class TestResult:
 
 
 class ActivateCallbacks:
-    """Hooks handed to Provider.activate (provider_tasks.go Activator)."""
+    """Hooks handed to Provider.activate (provider_tasks.go Activator).
+
+    `rollbacks` (a utils.rollbacks.Rollbacks, when the activate task
+    provides one) lets a custom activate hook register undos for source
+    resources it acquires — they run only if the activation fails.
+    """
 
     def __init__(self, cleanup: Callable[[list], None],
-                 upload: Callable[[list], None]):
+                 upload: Callable[[list], None],
+                 rollbacks=None):
         self.cleanup = cleanup
         self.upload = upload
+        self.rollbacks = rollbacks
+
+
+class _SniffDone(Exception):
+    """Internal: stop a sniff load after enough rows."""
 
 
 class Provider(abc.ABC):
@@ -119,21 +130,18 @@ class Provider(abc.ABC):
             for tid in all_tables[:self.SNIFF_TABLE_CAP]:
                 rows: list = []
 
-                class _Enough(Exception):
-                    pass
-
-                def pusher(batch):
+                def pusher(batch, _rows=rows):
                     items = batch.to_rows() \
                         if hasattr(batch, "to_rows") else batch
                     for it in items:
                         if it.is_row_event():
-                            rows.append(it.as_dict())
-                            if len(rows) >= max_rows:
-                                raise _Enough()
+                            _rows.append(it.as_dict())
+                            if len(_rows) >= max_rows:
+                                raise _SniffDone()
 
                 try:
                     storage.load_table(TableDescription(id=tid), pusher)
-                except _Enough:
+                except _SniffDone:
                     pass
                 out[str(tid)] = rows
         finally:

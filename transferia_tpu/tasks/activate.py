@@ -64,15 +64,14 @@ def activate_delivery(transfer, coordinator: Coordinator,
 
         src_provider = get_provider(transfer.src_provider(), transfer,
                                     metrics)
-        # Providers that acquire source resources during THEIR activate
-        # hook register undos on `rollbacks` themselves (never eagerly
-        # here: tearing down a pre-existing slot on a destination-side
-        # failure would lose the WAL position of a previous activation).
-        src_provider.rollbacks = rollbacks
+        # Provider activate hooks that acquire source resources register
+        # undos on callbacks.rollbacks (never registered eagerly here:
+        # tearing down a pre-existing slot on a destination-side failure
+        # would lose the WAL position of a previous activation).
         if transfer.type.has_snapshot:
             if src_provider.supports_activate():
                 src_provider.activate(
-                    ActivateCallbacks(cleanup_cb, upload_cb)
+                    ActivateCallbacks(cleanup_cb, upload_cb, rollbacks)
                 )
             else:
                 cleanup_cb(tables)
@@ -81,7 +80,8 @@ def activate_delivery(transfer, coordinator: Coordinator,
             # replication-only: provider hook for slot/changefeed creation
             if src_provider.supports_activate():
                 src_provider.activate(
-                    ActivateCallbacks(cleanup_cb, lambda _t: None)
+                    ActivateCallbacks(cleanup_cb, lambda _t: None,
+                                      rollbacks)
                 )
         rollbacks.cancel()
         coordinator.set_status(transfer.id, TransferStatus.ACTIVATED)
