@@ -8,22 +8,33 @@ plane is hand-optimized row-oriented Go — this framework's currency is the
 columnar `ColumnBatch` and its hot path (parsing, the transformer chain,
 encode/decode) compiles to XLA/Pallas kernels via JAX.
 
-Layer map (cf. SURVEY.md §1 for the reference equivalents):
-  abstract/     core data model: ChangeItem, TableSchema, Source/Sink/Storage
-  columnar/     ColumnBatch (Arrow-style columnar block) + row pivot
-  typesystem/   canonical type lattice, per-provider rules, versioned fallbacks
-  models/       Transfer/Endpoint model, runtimes
-  coordinator/  control-plane KV/queue (memory, filestore)
-  middlewares/  sink pipeline combinators
-  transform/    transformer framework + registry (JAX compute path)
-  parsers/      queue payload -> ChangeItems (vectorized)
-  serializers/  ChangeItems -> bytes
-  providers/    connector plugins
-  tasks/        operations: activate, snapshot loader, upload, checksum
-  runtime/      local replication worker, strategies
-  parallel/     device mesh sharding of the transform step
-  ops/          jax/pallas kernels (hashing, predicates, string ops)
-  cli/          trtpu command-line interface
+Layer map (cf. SURVEY.md §1 for the reference equivalents; docs/PARITY.md
+maps the full §2 inventory line by line):
+  abstract/       core data model: ChangeItem, TableSchema, Source/Sink/Storage
+  columnar/       ColumnBatch (Arrow-style columnar block) + row pivot
+  typesystem/     canonical type lattice, provider rules, versioned fallbacks
+  models/         Transfer/Endpoint model, runtimes
+  events/         event-typed veneer (abstract2 parity)
+  coordinator/    control-plane KV/queue (memory, filestore)
+  middlewares/    sink pipeline combinators (bufferer, retrier, ...)
+  transform/      transformer framework + 26-plugin registry (JAX path)
+  predicate/      WHERE-filter AST -> vectorized masks (SQL 3VL)
+  parsers/        queue payloads -> columnar batches (arrow fast path)
+  parsequeue/     parallel parse, ordered push, post-push ack
+  serializers/    batches -> bytes (json/csv/parquet/raw + queue formats)
+  debezium/       Debezium envelope emitter/receiver
+  schemaregistry/ Confluent SR client
+  dblog/          watermark-chunked snapshot concurrent with CDC
+  providers/      connector plugins (wire-protocol clients included)
+  tasks/          operations: activate, snapshot loader, upload, checksum
+  runtime/        local replication worker, regular-snapshot loop
+  parallel/       device mesh sharding of the transform step
+  ops/            device kernels (HMAC-SHA256 masking, packing, bucketing)
+  native/         C++ host-ops (LEB128, scatter/gather) via ctypes
+  metering/       usage metering agent + middlewares
+  stats/          typed metric bundles (prometheus)
+  cli/            trtpu command-line interface
+  utils/          backoff, cron, rollbacks, net helpers
 """
 
 __version__ = "0.1.0"
