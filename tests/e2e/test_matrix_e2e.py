@@ -1,0 +1,132 @@
+"""Pairwise provider matrix (cf. reference tests/e2e/ 50 <src>2<dst> dirs):
+every wire source x every sink activates a snapshot end to end, proving
+the canonical typesystem and pipeline glue compose across providers."""
+
+import itertools
+
+import pytest
+
+from transferia_tpu.coordinator import MemoryCoordinator
+from transferia_tpu.models import Transfer
+from transferia_tpu.providers.clickhouse import CHTargetParams
+from transferia_tpu.providers.file import FileTargetParams
+from transferia_tpu.providers.memory import MemoryTargetParams, get_store
+from transferia_tpu.providers.mongo import MongoSourceParams
+from transferia_tpu.providers.mysql import (
+    MySQLSourceParams,
+    MySQLTargetParams,
+)
+from transferia_tpu.providers.postgres import (
+    PGSourceParams,
+    PGTargetParams,
+)
+from transferia_tpu.providers.sample import SampleSourceParams
+from transferia_tpu.tasks import activate_delivery
+from tests.recipes.fake_clickhouse import FakeCH
+from tests.recipes.fake_mongo import FakeMongo
+from tests.recipes.fake_mysql import FakeMySQL, FakeMyTable
+from tests.recipes.fake_postgres import FakePG, FakeTable
+
+ROWS = 20
+
+
+@pytest.fixture(scope="module")
+def farm():
+    pg = FakePG().start()
+    pg.add_table(FakeTable(
+        "public", "src_t",
+        [("id", "bigint", True, True), ("v", "text", False, False)],
+        [{"id": str(i), "v": f"v{i}"} for i in range(ROWS)],
+    ))
+    my = FakeMySQL(user="root", password="p").start()
+    my.add_table(FakeMyTable(
+        "db", "src_t",
+        [("id", "bigint", "bigint", True, True),
+         ("v", "varchar", "varchar(40)", False, False)],
+        [{"id": str(i), "v": f"v{i}"} for i in range(ROWS)],
+    ))
+    mg = FakeMongo().start()
+    mg.seed("db", "src_t", [{"_id": f"k{i:02d}", "v": i}
+                            for i in range(ROWS)])
+    yield {"pg": pg, "mysql": my, "mongo": mg}
+    for srv in (pg, my, mg):
+        srv.stop()
+
+
+SOURCES = ["sample", "pg", "mysql", "mongo"]
+SINKS = ["ch", "pg", "mysql", "fs", "memory"]
+
+
+def _source(name, farm):
+    if name == "sample":
+        return SampleSourceParams(preset="users", table="src_t",
+                                  rows=ROWS, batch_rows=10)
+    if name == "pg":
+        return PGSourceParams(host="127.0.0.1", port=farm["pg"].port,
+                              database="db", user="u")
+    if name == "mysql":
+        return MySQLSourceParams(host="127.0.0.1",
+                                 port=farm["mysql"].port,
+                                 database="db", user="root", password="p")
+    return MongoSourceParams(host="127.0.0.1", port=farm["mongo"].port,
+                             database="db")
+
+
+def _sink(name):
+    """Returns (params, row_count_fn, stopper)."""
+    if name == "ch":
+        srv = FakeCH().start()
+        return (
+            CHTargetParams(host="127.0.0.1", port=srv.port,
+                           bufferer=None),
+            lambda: sum(len(t["rows"]) for t in srv.tables.values()),
+            srv.stop,
+        )
+    if name == "pg":
+        srv = FakePG().start()
+        return (
+            PGTargetParams(host="127.0.0.1", port=srv.port,
+                           database="dw", user="u"),
+            lambda: sum(len(t.rows) for t in srv.tables.values()),
+            srv.stop,
+        )
+    if name == "mysql":
+        srv = FakeMySQL(user="root", password="p").start()
+        return (
+            MySQLTargetParams(host="127.0.0.1", port=srv.port,
+                              database="dw", user="root", password="p"),
+            lambda: sum(len(t.rows) for t in srv.tables.values()),
+            srv.stop,
+        )
+    if name == "fs":
+        d = str(_sink.tmp_path_factory.mktemp("matrix_fs"))
+
+        def count():
+            import glob
+
+            import pyarrow.parquet as pq
+
+            return sum(
+                pq.read_table(f).num_rows
+                for f in glob.glob(f"{d}/*.parquet")
+            )
+
+        return FileTargetParams(path=d, format="parquet"), count, None
+    store = get_store("matrix_e2e")
+    store.clear()
+    return (MemoryTargetParams(sink_id="matrix_e2e"),
+            store.row_count, None)
+
+
+@pytest.mark.parametrize("src,dst", list(itertools.product(SOURCES, SINKS)))
+def test_pair(src, dst, farm, tmp_path_factory):
+    _sink.tmp_path_factory = tmp_path_factory  # auto-cleaned temp dirs
+    params, count_fn, stopper = _sink(dst)
+    try:
+        t = Transfer(id=f"mx-{src}2{dst}", src=_source(src, farm),
+                     dst=params)
+        activate_delivery(t, MemoryCoordinator())
+        assert count_fn() == ROWS, f"{src}->{dst} lost rows"
+    finally:
+        if stopper:
+            stopper()
