@@ -1,25 +1,23 @@
 """Test bootstrap: force JAX onto a virtual 8-device CPU mesh.
 
 The environment may pre-import jax with a TPU backend registered (e.g. an
-axon sitecustomize) — so setting JAX_PLATFORMS here is not enough.  Backends
-initialize lazily, so flipping jax.config before any computation still
-works; XLA_FLAGS must carry the virtual device count before the CPU client
-spins up.  Benchmarks (bench.py) do NOT import this and run on the real TPU.
+axon sitecustomize) — so setting JAX_PLATFORMS here is not enough.  The
+shared recipe lives in transferia_tpu.testing (also used by the driver's
+__graft_entry__ dry run — keep one copy).  Benchmarks (bench.py) do NOT
+import this and run on the real TPU.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
 os.environ.setdefault("TRANSFERIA_TPU_TESTING", "1")
 
 try:
-    import jax
+    from transferia_tpu.testing import force_virtual_cpu_mesh
 
-    jax.config.update("jax_platforms", "cpu")
-except ImportError:  # pragma: no cover
-    pass
+    if not force_virtual_cpu_mesh(8):  # pragma: no cover
+        raise RuntimeError(
+            "jax backend initialized before conftest ran — tests cannot "
+            "force the virtual CPU mesh; run pytest from a fresh interpreter"
+        )
+except ImportError:  # pragma: no cover - jax is an optional extra;
+    pass  # non-jax test files still run without it
