@@ -112,10 +112,10 @@ def run_pipeline(limit_rows: int | None = None,
     from transferia_tpu.abstract.schema import TableID
     from transferia_tpu.coordinator import MemoryCoordinator
     from transferia_tpu.factories import make_sinker, new_storage
-    from transferia_tpu.ops.sha256 import enable_device_mask_backend
     from transferia_tpu.tasks import SnapshotLoader
 
-    enable_device_mask_backend()
+    # the transformer chain fuses mask+filter into one device program by
+    # default (transform/fused.py); no explicit backend switch needed
     transfer = make_transfer(process_count)
     t0 = time.perf_counter()
     if limit_rows is not None:
@@ -150,33 +150,79 @@ def run_pipeline(limit_rows: int | None = None,
     return prog.completed_rows, dt
 
 
-def _device_available(timeout_s: float = 120.0) -> bool:
+def _device_available(timeout_s: float = 90.0, attempts: int = 2) -> bool:
     """Probe jax device init in a subprocess — a wedged TPU runtime hangs
-    indefinitely in-process, and the bench must always print its JSON."""
+    indefinitely in-process, and the bench must always print its JSON.
+    Bounded retries: transient runtime-init failures (e.g. a TPU chip
+    still claimed by a dying process) often clear within a minute."""
     import subprocess
 
-    try:
-        proc = subprocess.run(
-            [sys.executable, "-c",
-             "import jax; jax.devices(); print('ok')"],
-            capture_output=True, timeout=timeout_s,
-        )
-        return b"ok" in proc.stdout
-    except subprocess.TimeoutExpired:
-        return False
+    for attempt in range(1, attempts + 1):
+        try:
+            proc = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; d=jax.devices(); print('ok', d[0].platform)"],
+                capture_output=True, timeout=timeout_s,
+            )
+            out = proc.stdout.decode(errors="replace").strip()
+            if out.startswith("ok "):
+                platform = out.split()[-1].lower()
+                # an accelerator platform only: a jax that silently fell
+                # back to CPU must NOT be recorded as a device number
+                if platform in ("tpu", "axon", "neuron"):
+                    print(f"# device probe ok (attempt {attempt}): "
+                          f"{platform}", file=sys.stderr)
+                    return True
+                print(f"# device probe found non-accelerator platform "
+                      f"{platform!r}; treating as unavailable",
+                      file=sys.stderr)
+                return False
+            print(f"# device probe attempt {attempt} failed: "
+                  f"rc={proc.returncode} "
+                  f"stderr={proc.stderr[-300:].decode(errors='replace')}",
+                  file=sys.stderr)
+        except subprocess.TimeoutExpired:
+            print(f"# device probe attempt {attempt} timed out "
+                  f"({timeout_s:.0f}s) — TPU runtime init hung",
+                  file=sys.stderr)
+        if attempt < attempts:
+            time.sleep(5)
+    return False
+
+
+def _force_cpu_backend() -> bool:
+    """Persistent TPU-init failure: fall back to the host pipeline so the
+    bench still measures end-to-end (labeled as a fallback in the JSON —
+    NOT a TPU number).  Device fusion is disabled: with no accelerator, the
+    C++ batched HMAC + numpy predicate host path outruns XLA-on-CPU.
+    Returns False when a jax backend is already live (too late to flip)."""
+    from transferia_tpu.testing import force_virtual_cpu_mesh
+    from transferia_tpu.transform.fused import set_device_fusion
+
+    set_device_fusion(False)
+    return force_virtual_cpu_mesh(1)
 
 
 def main() -> None:
+    fallback = None
     if not _device_available():
-        print(json.dumps({
-            "metric": "clickbench_snapshot_rows_per_sec",
-            "value": 0,
-            "unit": "rows/sec",
-            "vs_baseline": 0.0,
-        }))
-        print("# jax device init hung/unavailable; bench skipped",
+        fallback = "cpu-backend"
+        if not _force_cpu_backend():
+            # a live (wedged) backend can't be flipped: report honestly
+            # rather than hanging without ever printing the JSON
+            print(json.dumps({
+                "metric": "clickbench_snapshot_rows_per_sec",
+                "value": 0,
+                "unit": "rows/sec",
+                "vs_baseline": 0.0,
+                "fallback": "none-backend-wedged",
+            }))
+            print("# jax backend already initialized and TPU wedged; "
+                  "cannot fall back in-process", file=sys.stderr)
+            return
+        print("# TPU runtime unavailable after retries; measuring on the "
+              "host pipeline (CPU) as a labeled diagnostic fallback",
               file=sys.stderr)
-        return
     t_gen = time.perf_counter()
     generate_dataset()
     gen_s = time.perf_counter() - t_gen
@@ -192,10 +238,13 @@ def main() -> None:
         "unit": "rows/sec",
         "vs_baseline": round(rps / 10_000_000, 4),
     }
+    if fallback:
+        result["fallback"] = fallback
     print(json.dumps(result))
     print(
         f"# rows={rows} time={dt:.2f}s warmup={warm_s:.1f}s "
         f"gen={gen_s:.1f}s batch={BATCH_ROWS} "
+        f"backend={'cpu-fallback' if fallback else 'device'} "
         f"dataset={PARQUET}",
         file=sys.stderr,
     )

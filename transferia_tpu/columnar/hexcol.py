@@ -1,0 +1,34 @@
+"""Numpy-only helpers shared by the host and device mask paths (no jax)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+
+def hex_to_varwidth(hexes: np.ndarray, validity: Optional[np.ndarray]
+                    ) -> tuple[np.ndarray, np.ndarray]:
+    """(N, 64) hex digest matrix -> flat var-width column bytes+offsets.
+
+    Invalid rows become empty strings (validity is preserved separately by
+    the caller's output Column).
+    """
+    n = hexes.shape[0]
+    if validity is None:
+        out_offsets = np.arange(n + 1, dtype=np.int64) * 64
+        if out_offsets[-1] > 2**31 - 1:
+            raise ValueError("hashed column exceeds 2GiB")
+        return hexes.reshape(-1).copy(), out_offsets.astype(np.int32)
+    lens = np.where(validity, 64, 0).astype(np.int64)
+    out_offsets = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(lens, out=out_offsets[1:])
+    if out_offsets[-1] > 2**31 - 1:
+        raise ValueError("hashed column exceeds 2GiB")
+    out = np.zeros(int(out_offsets[-1]), dtype=np.uint8)
+    valid_rows = np.nonzero(validity)[0]
+    if len(valid_rows):
+        starts = out_offsets[:-1][valid_rows]
+        idx = starts[:, None] + np.arange(64)
+        out[idx.reshape(-1)] = hexes[valid_rows].reshape(-1)
+    return out, out_offsets.astype(np.int32)

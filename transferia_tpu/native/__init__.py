@@ -38,6 +38,17 @@ def _bind(cdll: ctypes.CDLL) -> ctypes.CDLL:
     cdll.scatter_bytes.restype = None
     cdll.gather_varwidth.argtypes = [u8, i32, i64, ctypes.c_int64, u8, i32]
     cdll.gather_varwidth.restype = ctypes.c_int64
+    cdll.pack_sha_blocks.argtypes = [
+        u8, i32, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, u8, i32,
+    ]
+    cdll.pack_sha_blocks.restype = None
+    u32 = npc.ndpointer(np.uint32, flags="C_CONTIGUOUS")
+    cdll.hmac_sha256_hex.argtypes = [
+        u8, i32, ctypes.c_int64, u32, u32, ctypes.c_void_p, u8,
+    ]
+    cdll.hmac_sha256_hex.restype = None
+    cdll.sha256_block_state.argtypes = [u8, u32]
+    cdll.sha256_block_state.restype = None
     return cdll
 
 
@@ -46,12 +57,17 @@ def build(force: bool = False) -> bool:
     import shutil
     import subprocess
 
-    if _SO.exists() and not force:
+    src = _DIR / "hostops.cpp"
+    if not src.exists():
+        # source pruned from the deployment: use a prebuilt .so as-is
+        return _SO.exists()
+    if (_SO.exists() and not force
+            and _SO.stat().st_mtime >= src.stat().st_mtime):
         return True
     cxx = shutil.which("g++") or shutil.which("clang++")
     if cxx is None:
-        return False
-    src = _DIR / "hostops.cpp"
+        # no compiler: a stale-but-working prebuilt .so beats no library
+        return _SO.exists()
     try:
         subprocess.run(
             [cxx, "-O3", "-shared", "-fPIC", "-o", str(_SO), str(src)],
@@ -74,11 +90,13 @@ def lib() -> Optional[ctypes.CDLL]:
         _tried = True
         if os.environ.get("TRANSFERIA_TPU_NO_NATIVE") == "1":
             return None
-        if not _SO.exists() and not build():
+        if not build():  # no-op when the .so is newer than the source
             return None
         try:
             _lib = _bind(ctypes.CDLL(str(_SO)))
-        except OSError as e:
+        except (OSError, AttributeError) as e:
+            # AttributeError: a prebuilt .so from an older source without
+            # the newer symbols — honor the "None when unavailable" contract
             logger.warning("hostops load failed: %s", e)
             _lib = None
     return _lib

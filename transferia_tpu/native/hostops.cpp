@@ -66,4 +66,170 @@ int64_t gather_varwidth(const uint8_t* src, const int32_t* src_offsets,
     return pos;
 }
 
+// Pack var-width rows into padded SHA-256 block matrices (the host side of
+// the device HMAC path): row i of out gets src bytes, the 0x80 terminator,
+// zero fill, and the 8-byte big-endian bit length (including prefix_len
+// virtual bytes, e.g. the HMAC ipad block) at the end of its last block.
+// width must be a multiple of 64 and >= row_len + 9 for every row (callers
+// bucket width; rows that don't fit are a caller bug).  n_blocks[i] gets
+// the per-row block count.
+void pack_sha_blocks(const uint8_t* src, const int32_t* offsets,
+                     int64_t n, int32_t width, int32_t prefix_len,
+                     uint8_t* out, int32_t* n_blocks) {
+    for (int64_t i = 0; i < n; i++) {
+        int32_t start = offsets[i];
+        int32_t len = offsets[i + 1] - start;
+        uint8_t* row = out + (int64_t)i * width;
+        memcpy(row, src + start, (size_t)len);
+        memset(row + len, 0, (size_t)(width - len));
+        row[len] = 0x80;
+        int32_t nb = (len + 9 + 63) / 64;
+        n_blocks[i] = nb;
+        uint64_t bits = ((uint64_t)len + (uint64_t)prefix_len) * 8;
+        uint8_t* p = row + (int64_t)nb * 64 - 8;
+        for (int k = 0; k < 8; k++) {
+            p[k] = (uint8_t)(bits >> (8 * (7 - k)));
+        }
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Scalar SHA-256 (FIPS 180-4) — the host twin of the device kernel in
+// ops/sha256.py, used by the mask transformer's host path so CPU-only runs
+// hash at memcpy-adjacent speed instead of per-row Python hashlib calls.
+
+static const uint32_t K256[64] = {
+    0x428a2f98, 0x71374491, 0xb5c0fbcf, 0xe9b5dba5,
+    0x3956c25b, 0x59f111f1, 0x923f82a4, 0xab1c5ed5,
+    0xd807aa98, 0x12835b01, 0x243185be, 0x550c7dc3,
+    0x72be5d74, 0x80deb1fe, 0x9bdc06a7, 0xc19bf174,
+    0xe49b69c1, 0xefbe4786, 0x0fc19dc6, 0x240ca1cc,
+    0x2de92c6f, 0x4a7484aa, 0x5cb0a9dc, 0x76f988da,
+    0x983e5152, 0xa831c66d, 0xb00327c8, 0xbf597fc7,
+    0xc6e00bf3, 0xd5a79147, 0x06ca6351, 0x14292967,
+    0x27b70a85, 0x2e1b2138, 0x4d2c6dfc, 0x53380d13,
+    0x650a7354, 0x766a0abb, 0x81c2c92e, 0x92722c85,
+    0xa2bfe8a1, 0xa81a664b, 0xc24b8b70, 0xc76c51a3,
+    0xd192e819, 0xd6990624, 0xf40e3585, 0x106aa070,
+    0x19a4c116, 0x1e376c08, 0x2748774c, 0x34b0bcb5,
+    0x391c0cb3, 0x4ed8aa4a, 0x5b9cca4f, 0x682e6ff3,
+    0x748f82ee, 0x78a5636f, 0x84c87814, 0x8cc70208,
+    0x90befffa, 0xa4506ceb, 0xbef9a3f7, 0xc67178f2,
+};
+
+static inline uint32_t rotr32(uint32_t x, int n) {
+    return (x >> n) | (x << (32 - n));
+}
+
+static inline uint32_t load_be32(const uint8_t* p) {
+    return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
+           ((uint32_t)p[2] << 8) | (uint32_t)p[3];
+}
+
+static void sha256_compress(uint32_t h[8], const uint8_t* p) {
+    uint32_t w[64];
+    for (int i = 0; i < 16; i++) w[i] = load_be32(p + 4 * i);
+    for (int i = 16; i < 64; i++) {
+        uint32_t x15 = w[i - 15], x2 = w[i - 2];
+        uint32_t s0 = rotr32(x15, 7) ^ rotr32(x15, 18) ^ (x15 >> 3);
+        uint32_t s1 = rotr32(x2, 17) ^ rotr32(x2, 19) ^ (x2 >> 10);
+        w[i] = w[i - 16] + s0 + w[i - 7] + s1;
+    }
+    uint32_t a = h[0], b = h[1], c = h[2], d = h[3];
+    uint32_t e = h[4], f = h[5], g = h[6], hh = h[7];
+    for (int i = 0; i < 64; i++) {
+        uint32_t s1 = rotr32(e, 6) ^ rotr32(e, 11) ^ rotr32(e, 25);
+        uint32_t ch = (e & f) ^ (~e & g);
+        uint32_t t1 = hh + s1 + ch + K256[i] + w[i];
+        uint32_t s0 = rotr32(a, 2) ^ rotr32(a, 13) ^ rotr32(a, 22);
+        uint32_t maj = (a & b) ^ (a & c) ^ (b & c);
+        uint32_t t2 = s0 + maj;
+        hh = g; g = f; f = e; e = d + t1;
+        d = c; c = b; b = a; a = t1 + t2;
+    }
+    h[0] += a; h[1] += b; h[2] += c; h[3] += d;
+    h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
+}
+
+static const char HEXD[] = "0123456789abcdef";
+
+// One SHA-256 compression of a 64-byte block from the initial state —
+// exposed for HMAC key-state setup (hashlib exposes no mid-state, and this
+// keeps the compression in exactly two places: here and ops/sha256.py).
+void sha256_block_state(const uint8_t* block, uint32_t* out_state) {
+    static const uint32_t H0[8] = {
+        0x6a09e667, 0xbb67ae85, 0x3c6ef372, 0xa54ff53a,
+        0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
+    };
+    memcpy(out_state, H0, 32);
+    sha256_compress(out_state, block);
+}
+
+// Batched HMAC-SHA256 -> ascii hex.  inner/outer are the precomputed key
+// states (ipad/opad blocks already compressed — same contract as the
+// device kernel's _hmac_key_states).  Rows with validity[i]==0 get 64
+// zero bytes (the caller maps them to empty strings).  validity may be
+// NULL (all valid).  out_hex must hold n*64 bytes.
+void hmac_sha256_hex(const uint8_t* data, const int32_t* offsets,
+                     int64_t n, const uint32_t* inner_state,
+                     const uint32_t* outer_state, const uint8_t* validity,
+                     uint8_t* out_hex) {
+    for (int64_t i = 0; i < n; i++) {
+        uint8_t* dst = out_hex + i * 64;
+        if (validity && !validity[i]) {
+            memset(dst, 0, 64);
+            continue;
+        }
+        const uint8_t* msg = data + offsets[i];
+        uint64_t len = (uint64_t)(offsets[i + 1] - offsets[i]);
+        uint32_t h[8];
+        memcpy(h, inner_state, 32);
+        uint64_t off = 0;
+        while (len - off >= 64) {
+            sha256_compress(h, msg + off);
+            off += 64;
+        }
+        uint8_t tail[128];
+        uint64_t rem = len - off;
+        memcpy(tail, msg + off, (size_t)rem);
+        tail[rem] = 0x80;
+        uint64_t tail_len = (rem + 9 <= 64) ? 64 : 128;
+        memset(tail + rem + 1, 0, (size_t)(tail_len - rem - 1));
+        uint64_t bits = (64 + len) * 8;  // +64: virtual ipad prefix block
+        for (int k = 0; k < 8; k++) {
+            tail[tail_len - 8 + k] = (uint8_t)(bits >> (8 * (7 - k)));
+        }
+        sha256_compress(h, tail);
+        if (tail_len == 128) sha256_compress(h, tail + 64);
+        // outer: H(K^opad || inner_digest) — digest is 32 bytes, 1 block
+        uint8_t oblk[64];
+        for (int wi = 0; wi < 8; wi++) {
+            oblk[4 * wi + 0] = (uint8_t)(h[wi] >> 24);
+            oblk[4 * wi + 1] = (uint8_t)(h[wi] >> 16);
+            oblk[4 * wi + 2] = (uint8_t)(h[wi] >> 8);
+            oblk[4 * wi + 3] = (uint8_t)h[wi];
+        }
+        oblk[32] = 0x80;
+        memset(oblk + 33, 0, 23);  // bytes 33..55; 56..63 hold the length
+        uint64_t obits = (64 + 32) * 8;
+        for (int k = 0; k < 8; k++) {
+            oblk[56 + k] = (uint8_t)(obits >> (8 * (7 - k)));
+        }
+        uint32_t ho[8];
+        memcpy(ho, outer_state, 32);
+        sha256_compress(ho, oblk);
+        for (int wi = 0; wi < 8; wi++) {
+            uint32_t v = ho[wi];
+            dst[8 * wi + 0] = HEXD[(v >> 28) & 0xF];
+            dst[8 * wi + 1] = HEXD[(v >> 24) & 0xF];
+            dst[8 * wi + 2] = HEXD[(v >> 20) & 0xF];
+            dst[8 * wi + 3] = HEXD[(v >> 16) & 0xF];
+            dst[8 * wi + 4] = HEXD[(v >> 12) & 0xF];
+            dst[8 * wi + 5] = HEXD[(v >> 8) & 0xF];
+            dst[8 * wi + 6] = HEXD[(v >> 4) & 0xF];
+            dst[8 * wi + 7] = HEXD[v & 0xF];
+        }
+    }
+}
+
 }  // extern "C"

@@ -1,0 +1,201 @@
+"""Fused device transform program: HMAC mask + row predicate in one launch.
+
+Round-1 shape of the device path was one kernel per transformer (mask only)
+with a host hop between steps.  This module compiles the whole device-able
+run of a transformer plan — every HMAC-SHA256 masked column, hex encoding,
+and the row-filter predicate — into ONE jitted XLA program per
+(schema fingerprint, row bucket, width buckets), so a mask+filter transfer
+does a single H2D/compute/D2H round-trip per batch.
+
+Reference hot loops being displaced: pkg/transformer/transformation.go:22-70
+(chain apply) and pkg/transformer/registry/mask/hmac_hasher.go +
+registry/filter_rows (per-row Go).
+
+Host side: rows pack into padded SHA block matrices via the C++ hostops
+kernel (pack_sha_blocks — memcpy-bound, GIL-free) with a vectorized numpy
+fallback; the device side is pure jnp (ops/sha256.py core), so the same
+program runs on TPU and on the CPU backend (tests pin byte-parity against
+hashlib on the virtual mesh).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from transferia_tpu.columnar.batch import bucket_rows
+from transferia_tpu.ops.sha256 import (
+    _hmac_key_states,
+    hmac_device_core,
+    prepare_padded_blocks,
+)
+
+
+def _pallas_pack_enabled() -> bool:
+    """Opt-in TPU-side ragged pack (ops/ragged_pallas.py).
+
+    Off by default until the per-row DMA pattern is profiled on real
+    hardware; the portable C++/numpy host pack is the default feed path.
+    """
+    import os
+
+    if os.environ.get("TRANSFERIA_TPU_PALLAS_PACK") != "1":
+        return False
+    try:
+        import jax
+
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
+def hex_device(h):
+    """(N, 8) uint32 digest words -> (N, 64) ascii-hex uint8, on device."""
+    shifts = jnp.arange(28, -1, -4, dtype=jnp.uint32)  # 28,24,...,0
+    nib = (h[:, :, None] >> shifts[None, None, :]) & jnp.uint32(0xF)
+    out = jnp.where(nib < 10, nib + 48, nib + 87).astype(jnp.uint8)
+    return out.reshape(h.shape[0], 64)
+
+
+def pow2_blocks(max_len: int) -> int:
+    """Block count bucket for a max row length (bytes, before padding)."""
+    nb = (max_len + 9 + 63) // 64
+    return 1 << (nb - 1).bit_length() if nb > 1 else 1
+
+
+def pack_hmac_blocks(data: np.ndarray, offsets: np.ndarray,
+                     max_blocks: int) -> tuple[np.ndarray, np.ndarray]:
+    """Flat bytes+offsets -> (N, max_blocks*64) padded HMAC message blocks.
+
+    The 64-byte ipad prefix block is virtual (compressed separately from the
+    cached key state); lengths in the SHA padding include it (prefix_len=64).
+    C++ fast path releases the GIL so part threads overlap pack with device
+    compute; numpy fallback is prepare_padded_blocks.
+    """
+    from transferia_tpu.native import lib as native_lib
+
+    n = len(offsets) - 1
+    width = max_blocks * 64
+    cdll = native_lib()
+    if cdll is not None and n:
+        out = np.empty((n, width), dtype=np.uint8)
+        n_blocks = np.empty(n, dtype=np.int32)
+        cdll.pack_sha_blocks(
+            np.ascontiguousarray(data),
+            np.ascontiguousarray(offsets, dtype=np.int32),
+            n, width, 64, out, n_blocks,
+        )
+        return out, n_blocks
+    blocks, n_blocks, mb = prepare_padded_blocks(
+        data, offsets, prefix_len=64, max_blocks=max_blocks
+    )
+    return blocks, n_blocks
+
+
+from transferia_tpu.columnar.hexcol import hex_to_varwidth  # noqa: F401
+# (re-exported: the fused step builds its output columns with it)
+
+
+class FusedMaskFilterProgram:
+    """One jitted program: HMAC+hex every masked column, evaluate the keep
+    predicate — recompiled only when a bucket (rows / block width) changes.
+
+    mask_keys: HMAC key per masked column (parallel to the blocks the caller
+    passes); pred_node: predicate AST or None; the caller supplies the
+    predicate columns as (data, validity) arrays.
+    """
+
+    def __init__(self, mask_keys: Sequence[bytes], pred_node=None):
+        self._states = []
+        for key in mask_keys:
+            inner, outer = _hmac_key_states(bytes(key))
+            self._states.append((jnp.asarray(inner[0]),
+                                 jnp.asarray(outer[0])))
+        self._pred_fn = None
+        if pred_node is not None:
+            from transferia_tpu.predicate.device import compile_mask_jnp
+
+            self._pred_fn = compile_mask_jnp(pred_node)
+
+        def program(blocks_t, nblocks_t, states_t, pred_cols,
+                    max_blocks_t):
+            hexes = tuple(
+                hex_device(hmac_device_core(b, nb, st[0], st[1], mb))
+                for b, nb, st, mb in zip(
+                    blocks_t, nblocks_t, states_t, max_blocks_t
+                )
+            )
+            if self._pred_fn is not None:
+                # bucketed batch length is static under this trace; a
+                # fused run always has >= 1 masked column
+                keep = self._pred_fn(pred_cols, blocks_t[0].shape[0])
+            else:
+                keep = jnp.zeros((0,), dtype=jnp.bool_)  # unused sentinel
+            return hexes, keep
+
+        self._jit = jax.jit(program, static_argnums=(4,))
+
+    def run(self, mask_cols: Sequence[tuple[np.ndarray, np.ndarray]],
+            pred_cols: dict[str, tuple[np.ndarray, Optional[np.ndarray]]],
+            n_rows: int) -> tuple[list[np.ndarray], Optional[np.ndarray]]:
+        """mask_cols: per masked column (flat uint8 data, int32 offsets).
+        pred_cols: name -> (fixed-width data, validity or None).
+        Returns ([hex (n_rows, 64) per masked column], keep mask or None).
+        """
+        use_pallas_pack = _pallas_pack_enabled()
+        bucket = bucket_rows(n_rows)
+        blocks_t, nblocks_t, mb_t = [], [], []
+        for data, offsets in mask_cols:
+            lens = offsets[1:] - offsets[:-1]
+            max_len = int(lens.max()) if n_rows else 0
+            mb = pow2_blocks(max_len)
+            if use_pallas_pack:
+                from transferia_tpu.ops.ragged_pallas import (
+                    pack_blocks_device,
+                )
+
+                width = mb * 64
+                flat = np.ascontiguousarray(data)
+                total = int(offsets[-1])
+                if len(flat) < total + width:
+                    flat = np.pad(flat, (0, total + width - len(flat)))
+                blocks_dev, nblocks_dev = pack_blocks_device(
+                    flat, np.ascontiguousarray(offsets, dtype=np.int32),
+                    bucket, mb,
+                )
+                # zero pad rows' block count so they never update state
+                if bucket != n_rows:
+                    row = jnp.arange(bucket, dtype=jnp.int32)
+                    nblocks_dev = jnp.where(row < n_rows, nblocks_dev, 0)
+                blocks_t.append(blocks_dev)
+                nblocks_t.append(nblocks_dev)
+                mb_t.append(mb)
+                continue
+            blocks, n_blocks = pack_hmac_blocks(data, offsets, mb)
+            if bucket != n_rows:
+                blocks = np.pad(blocks, ((0, bucket - n_rows), (0, 0)))
+                n_blocks = np.pad(n_blocks, (0, bucket - n_rows))
+            blocks_t.append(jnp.asarray(blocks))
+            nblocks_t.append(jnp.asarray(n_blocks))
+            mb_t.append(mb)
+        dev_pred = {}
+        for name, (data, validity) in pred_cols.items():
+            if validity is None:
+                validity = np.ones(n_rows, dtype=np.bool_)
+            if bucket != n_rows:
+                data = np.pad(data, (0, bucket - n_rows))
+                validity = np.pad(validity, (0, bucket - n_rows))
+            dev_pred[name] = (jnp.asarray(data), jnp.asarray(validity))
+        hexes_dev, keep_dev = self._jit(
+            tuple(blocks_t), tuple(nblocks_t), tuple(self._states),
+            dev_pred, tuple(mb_t),
+        )
+        hexes = [np.asarray(h)[:n_rows] for h in hexes_dev]
+        keep = (np.asarray(keep_dev)[:n_rows]
+                if self._pred_fn is not None else None)
+        return hexes, keep

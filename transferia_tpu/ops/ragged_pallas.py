@@ -1,0 +1,136 @@
+"""Pallas TPU kernel: ragged var-width rows -> padded SHA block matrices.
+
+The fused mask program (ops/fused.py) consumes (N, max_blocks*64) padded
+message matrices.  The portable feed path packs them on the host (C++
+pack_sha_blocks) and ships the padded matrix over PCIe — ~2.5x the bytes of
+the raw ragged column for short strings.  This kernel moves the pack onto
+the TPU: the host ships the *flat* byte buffer + offsets, and each grid
+step DMAs its rows' byte ranges from HBM into the output block in VMEM,
+then applies the SHA-256 padding (0x80 terminator + big-endian bit length,
+HMAC ipad prefix accounted) as vectorized VPU ops.
+
+This is the var-width byte-gather XLA is weak at: a gather of ragged byte
+ranges lowers to per-element dynamic-slices, while the DMA engine copies
+ranges natively.  Per-row DMAs are small (tens of bytes); the win is
+halving H2D traffic and freeing the host core, not DMA efficiency — so the
+kernel is opt-in (TRANSFERIA_TPU_PALLAS_PACK=1) until profiled on real
+hardware, and correctness is pinned by interpret-mode parity tests against
+the C++/numpy host pack.
+
+Layout contract (caller: ops/fused.py):
+- flat buffer padded with >= width slack bytes (row DMAs may overread);
+- offsets padded to the row bucket by repeating the final offset (pad rows
+  read garbage, produce n_blocks for a zero-length row, and are sliced off
+  on the host);
+- row bucket is a multiple of TILE (=32, the int8 sublane tile).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TILE = 32  # rows per grid step; int8 min tile is (32, 128)
+
+
+def _pack_kernel(width: int, starts_ref, lens_ref, flat_ref,
+                 out_ref, nb_ref, sems):
+    # 1) DMA each row's byte range HBM -> VMEM output block
+    for r in range(TILE):
+        pltpu.make_async_copy(
+            flat_ref.at[pl.ds(starts_ref[r], width)],
+            out_ref.at[r],
+            sems.at[r],
+        ).start()
+    for r in range(TILE):
+        pltpu.make_async_copy(
+            flat_ref.at[pl.ds(starts_ref[r], width)],
+            out_ref.at[r],
+            sems.at[r],
+        ).wait()
+
+    # 2) vectorized SHA padding on the (TILE, width) block
+    col = jax.lax.broadcasted_iota(jnp.int32, (TILE, width), 1)
+    lens = lens_ref[:]  # (TILE, 1) int32 in VMEM (vector operand)
+    data = out_ref[:].astype(jnp.int32)
+    msg = jnp.where(col < lens, data, 0)
+    msg = jnp.where(col == lens, 0x80, msg)
+    nb = (lens + 9 + 63) // 64
+    pos = nb * 64 - 8  # first byte of the 8-byte big-endian length
+    k = col - pos
+    bits = (lens + 64) * 8  # +64: virtual HMAC ipad prefix block
+    shift = 8 * (7 - k)
+    lenbyte = jnp.where(
+        (k >= 0) & (k < 8) & (shift < 32),
+        jax.lax.shift_right_logical(
+            jnp.broadcast_to(bits, col.shape),
+            jnp.clip(shift, 0, 31),
+        ) & 0xFF,
+        0,
+    )
+    msg = jnp.where((k >= 0) & (k < 8), lenbyte, msg)
+    out_ref[:] = msg.astype(jnp.uint8)
+    nb_ref[:] = nb
+
+
+@functools.partial(jax.jit, static_argnums=(3, 4))
+def _pack_blocks_call(flat, starts, lens, width: int, interpret: bool):
+    n = starts.shape[0]
+    grid = n // TILE
+    kernel = functools.partial(_pack_kernel, width)
+    return pl.pallas_call(
+        kernel,
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((TILE,), lambda i: (i,),
+                         memory_space=pltpu.SMEM),  # starts: DMA scalars
+            pl.BlockSpec((TILE, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),  # lens: vector operand
+            pl.BlockSpec(memory_space=pl.ANY),  # flat stays in HBM
+        ],
+        out_specs=[
+            pl.BlockSpec((TILE, width), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((TILE, 1), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((n, width), jnp.uint8),
+            jax.ShapeDtypeStruct((n, 1), jnp.int32),
+        ],
+        scratch_shapes=[pltpu.SemaphoreType.DMA((TILE,))],
+        interpret=interpret,
+    )(starts, lens, flat)
+
+
+def pack_blocks_device(flat_padded: np.ndarray, offsets: np.ndarray,
+                       n_rows_bucket: int, max_blocks: int,
+                       interpret: bool = False):
+    """Host wrapper: pad/shape inputs per the layout contract and invoke.
+
+    flat_padded: (B + >=width slack,) uint8; offsets: (n+1,) int32 for the
+    true rows.  Returns device arrays (blocks (bucket, width) uint8,
+    n_blocks (bucket,) int32) — pad rows' content is garbage-but-valid and
+    must be masked/sliced by the caller.
+    """
+    width = max_blocks * 64
+    n = len(offsets) - 1
+    assert n_rows_bucket % TILE == 0, "bucket must be a TILE multiple"
+    starts = np.empty(n_rows_bucket, dtype=np.int32)
+    lens = np.zeros((n_rows_bucket, 1), dtype=np.int32)
+    starts[:n] = offsets[:-1]
+    starts[n:] = offsets[-1]
+    lens[:n, 0] = offsets[1:] - offsets[:-1]
+    assert len(flat_padded) >= int(offsets[-1]) + width, \
+        "flat buffer needs >= width slack bytes for row overreads"
+    blocks, nb = _pack_blocks_call(
+        jnp.asarray(flat_padded), jnp.asarray(starts), jnp.asarray(lens),
+        width, interpret,
+    )
+    return blocks, nb.reshape(-1)

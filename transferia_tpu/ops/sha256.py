@@ -146,13 +146,15 @@ def _sha256_padded(blocks_u8, n_blocks_per_row, max_blocks: int):
 
 
 def prepare_padded_blocks(data: np.ndarray, offsets: np.ndarray,
-                          prefix_len: int = 0
+                          prefix_len: int = 0,
+                          max_blocks: Optional[int] = None,
                           ) -> tuple[np.ndarray, np.ndarray, int]:
     """Host-side: flat bytes+offsets -> padded SHA-256 block matrix.
 
     prefix_len: bytes of a (virtual) prefix already fed to the state — used
     by HMAC where the 64-byte ipad block is compressed separately; lengths
-    in the padding must include it.
+    in the padding must include it.  max_blocks: force the block bucket
+    (callers sharing a compiled program across batches); None = derive.
 
     Returns (blocks (N, max_blocks*64) uint8, n_blocks (N,) int32,
     max_blocks).  Vectorized with numpy gathers — no per-row Python.
@@ -162,10 +164,15 @@ def prepare_padded_blocks(data: np.ndarray, offsets: np.ndarray,
     total_lens = lens + prefix_len
     # message + 0x80 + 8-byte length, rounded up to 64
     n_blocks = ((lens + 9 + 63) // 64).astype(np.int32)
-    max_blocks = int(n_blocks.max()) if n else 1
-    # bucket to powers of two so XLA compiles once per (rows, block bucket),
-    # not once per batch-specific max length
-    max_blocks = 1 << (max_blocks - 1).bit_length() if max_blocks > 1 else 1
+    needed = int(n_blocks.max()) if n else 1
+    if max_blocks is None:
+        # bucket to powers of two so XLA compiles once per (rows, block
+        # bucket), not once per batch-specific max length
+        max_blocks = 1 << (needed - 1).bit_length() if needed > 1 else 1
+    elif needed > max_blocks:
+        raise ValueError(
+            f"rows need {needed} SHA blocks > forced bucket {max_blocks}"
+        )
     width = max_blocks * 64
     out = np.zeros((n, width), dtype=np.uint8)
     total = int(lens.sum())
@@ -319,22 +326,9 @@ def hmac_sha256_hex_batch(key: bytes, data: np.ndarray,
         (jnp.asarray(inner), jnp.asarray(outer)), max_blocks,
     )
     hexes = _hex_encode(_words_to_bytes(np.asarray(h)[:n]))  # (N, 64)
-    if validity is None:
-        out_offsets = (np.arange(n + 1, dtype=np.int64) * 64)
-        if out_offsets[-1] > 2**31 - 1:
-            raise ValueError("hashed column exceeds 2GiB")
-        return hexes.reshape(-1), out_offsets.astype(np.int32)
-    lens = np.where(validity, 64, 0).astype(np.int64)
-    out_offsets = np.zeros(n + 1, dtype=np.int64)
-    np.cumsum(lens, out=out_offsets[1:])
-    if out_offsets[-1] > 2**31 - 1:
-        raise ValueError("hashed column exceeds 2GiB")
-    out = np.zeros(int(out_offsets[-1]), dtype=np.uint8)
-    valid_rows = np.nonzero(validity)[0]
-    starts = out_offsets[:-1][valid_rows]
-    idx = starts[:, None] + np.arange(64)
-    out[idx.reshape(-1)] = hexes[valid_rows].reshape(-1)
-    return out, out_offsets.astype(np.int32)
+    from transferia_tpu.columnar.hexcol import hex_to_varwidth
+
+    return hex_to_varwidth(hexes, validity)
 
 
 def enable_device_mask_backend() -> None:

@@ -28,7 +28,10 @@ class _Plan:
 
     def __init__(self, steps: list[Transformer], in_table: TableID,
                  in_schema: TableSchema):
-        self.steps = steps
+        from transferia_tpu.transform.fused import maybe_fuse_steps
+
+        self.steps = maybe_fuse_steps(steps, in_table, in_schema)
+        steps = self.steps
         table, schema = in_table, in_schema
         for t in steps:
             table = t.result_table(table)
@@ -72,7 +75,8 @@ class Transformation:
                     logger.info(
                         "transform plan for %s/%s: %s",
                         table, schema.fingerprint(),
-                        [t.describe() for t in steps] or "(passthrough)",
+                        [t.describe() for t in plan.steps]
+                        or "(passthrough)",
                     )
         return plan
 

@@ -1,0 +1,204 @@
+"""Plan-time fusion of device-able transformer runs into one device step.
+
+The transformer chain plans per (table, schema fingerprint)
+(transform/chain.py).  At plan time this pass scans the chosen steps for
+maximal runs of device-able transformers — HMAC mask (mask_field) and
+row-filter predicates (filter_rows) — and replaces each run with a single
+DeviceFusedStep whose apply() does ONE device round-trip per batch
+(ops/fused.py), instead of one host pass (or one device launch) per step.
+
+Fusion preconditions (checked against the schema at that chain position):
+- mask_field targets only variable-width columns (fixed-width masking
+  stringifies per value on the host; that step stays unfused);
+- a column is masked at most once per run (a second hash would need the
+  first's output — runs split instead);
+- filter_rows predicates are device-compatible (predicate/device.py) and
+  never reference a column masked EARLIER in the run (the fused predicate
+  evaluates on the run's input batch; filter-before-mask is fine because
+  the mask+filter outputs commute when the predicate sees pre-mask bytes).
+
+Default: ON when jax imports; kill switch TRANSFERIA_TPU_DEVICE=0 or
+set_device_fusion(False).  CPU/TPU parity is pinned by canon tests — the
+fused output is byte-identical to the host step-by-step path.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional, Sequence
+
+from transferia_tpu.abstract.schema import (
+    CanonicalType,
+    TableID,
+    TableSchema,
+)
+from transferia_tpu.predicate.ast import TrueNode
+from transferia_tpu.columnar.batch import Column, ColumnBatch
+from transferia_tpu.transform.base import TransformResult, Transformer
+from transferia_tpu.transform.plugins.filter import FilterRows
+from transferia_tpu.transform.plugins.mask import MaskField
+
+logger = logging.getLogger(__name__)
+
+_enabled: Optional[bool] = None
+
+
+def device_fusion_enabled() -> bool:
+    global _enabled
+    if _enabled is None:
+        if os.environ.get("TRANSFERIA_TPU_DEVICE", "").lower() in (
+                "0", "off", "false"):
+            _enabled = False
+        else:
+            try:
+                import jax  # noqa: F401 - presence probe only
+
+                _enabled = True
+            except ImportError:
+                _enabled = False
+    return _enabled
+
+
+def set_device_fusion(on: Optional[bool]) -> None:
+    """Force fusion on/off (None = re-detect from env/jax presence)."""
+    global _enabled
+    _enabled = on
+
+
+class DeviceFusedStep(Transformer):
+    """A fused run of mask_field/filter_rows steps, one device launch."""
+
+    TYPE = "device_fused"
+
+    def __init__(self, members: Sequence[Transformer],
+                 mask_entries: Sequence[tuple[str, bytes]],
+                 pred_node):
+        from transferia_tpu.ops.fused import FusedMaskFilterProgram
+
+        self.members = list(members)
+        self.mask_entries = list(mask_entries)
+        self.pred_node = pred_node
+        self.pred_cols = sorted(pred_node.columns()) if pred_node else []
+        self.program = FusedMaskFilterProgram(
+            [key for _, key in mask_entries], pred_node
+        )
+
+    def suitable(self, table: TableID, schema: TableSchema) -> bool:
+        # constructed at plan time from already-suitable members
+        return True
+
+    def result_schema(self, schema: TableSchema) -> TableSchema:
+        for m in self.members:
+            schema = m.result_schema(schema)
+        return schema
+
+    def result_table(self, table: TableID) -> TableID:
+        for m in self.members:
+            table = m.result_table(table)
+        return table
+
+    def describe(self) -> str:
+        inner = "+".join(m.describe() for m in self.members)
+        return f"device[{inner}]"
+
+    def apply(self, batch: ColumnBatch) -> TransformResult:
+        if batch.n_rows == 0:
+            # keep schema transformation without a device launch
+            out = batch
+            for m in self.members:
+                out = m.apply(out).transformed
+            return TransformResult(out)
+        from transferia_tpu.ops.fused import hex_to_varwidth
+
+        mask_inputs = []
+        for name, _key in self.mask_entries:
+            col = batch.column(name)
+            mask_inputs.append((col.data, col.offsets))
+        pred_inputs = {}
+        for name in self.pred_cols:
+            col = batch.column(name)
+            pred_inputs[name] = (col.data, col.validity)
+        hexes, keep = self.program.run(
+            mask_inputs, pred_inputs, batch.n_rows
+        )
+        cols = dict(batch.columns)
+        for (name, _key), hx in zip(self.mask_entries, hexes):
+            validity = batch.column(name).validity
+            data, offsets = hex_to_varwidth(hx, validity)
+            cols[name] = Column(name, CanonicalType.UTF8, data, offsets,
+                                validity)
+        out = batch.with_columns(cols, self.result_schema(batch.schema))
+        if keep is not None and not keep.all():
+            out = out.filter(keep)
+        return TransformResult(out)
+
+
+def _mask_target_cols(step: MaskField, schema: TableSchema) -> list[str]:
+    return [c for c in step.columns if schema.find(c) is not None]
+
+
+def maybe_fuse_steps(steps: Sequence[Transformer], in_table: TableID,
+                     in_schema: TableSchema) -> list[Transformer]:
+    """Replace device-able runs with DeviceFusedSteps (plan-time)."""
+    if not device_fusion_enabled() or not steps:
+        return list(steps)
+    from transferia_tpu.predicate.device import device_compatible
+
+    out: list[Transformer] = []
+    schema = in_schema
+    i = 0
+    n = len(steps)
+    while i < n:
+        # try to grow a fusable run starting at i
+        group: list[Transformer] = []
+        mask_entries: list[tuple[str, bytes]] = []
+        pred_parts = []
+        masked: set[str] = set()
+        run_schema = schema
+        j = i
+        while j < n:
+            st = steps[j]
+            if isinstance(st, MaskField):
+                targets = _mask_target_cols(st, run_schema)
+                if (not targets
+                        or any(c in masked for c in targets)
+                        or any(not run_schema.find(c)
+                               .data_type.is_variable_width
+                               for c in targets)):
+                    break
+                for c in targets:
+                    mask_entries.append((c, st.key))
+                masked.update(targets)
+            elif isinstance(st, FilterRows):
+                if (not device_compatible(st.node, run_schema)
+                        or (st.node.columns() & masked)):
+                    break
+                if not isinstance(st.node, TrueNode):
+                    # an always-true filter joins the run as a no-op
+                    pred_parts.append(st.node)
+            else:
+                break
+            group.append(st)
+            run_schema = st.result_schema(run_schema)
+            j += 1
+        if mask_entries and group:
+            # a run with at least one device mask pays for the launch;
+            # pure-filter runs stay on the (already vectorized) host path
+            pred_node = None
+            if pred_parts:
+                from transferia_tpu.predicate.ast import And
+
+                pred_node = (pred_parts[0] if len(pred_parts) == 1
+                             else And(tuple(pred_parts)))
+            fused = DeviceFusedStep(group, mask_entries, pred_node)
+            logger.info("fused %d transformer steps onto device: %s",
+                        len(group), fused.describe())
+            out.append(fused)
+            schema = run_schema
+            i = j
+        else:
+            out.append(steps[i])
+            schema = steps[i].result_schema(schema)
+            i += 1
+    return out

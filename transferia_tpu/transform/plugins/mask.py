@@ -40,6 +40,9 @@ def set_hash_backend(fn: Optional[HashBackend]) -> None:
 def _host_hmac_hex(key: bytes, data: np.ndarray, offsets: np.ndarray,
                    validity: Optional[np.ndarray]) -> tuple[np.ndarray, np.ndarray]:
     n = len(offsets) - 1
+    native = _native_hmac_hex(key, data, offsets, validity, n)
+    if native is not None:
+        return native
     raw = data.tobytes()
     outs = []
     for i in range(n):
@@ -54,6 +57,52 @@ def _host_hmac_hex(key: bytes, data: np.ndarray, offsets: np.ndarray,
     out_data = np.frombuffer(b"".join(outs), dtype=np.uint8).copy() \
         if outs else np.zeros(0, dtype=np.uint8)
     return out_data, out_offsets
+
+
+def _hmac_key_states_np(key: bytes,
+                        cdll) -> tuple[np.ndarray, np.ndarray]:
+    """ipad/opad key states via the C++ one-block compression (hashlib
+    exposes no mid-state; this path only runs when the lib is loaded)."""
+    if len(key) > 64:
+        key = hashlib.sha256(key).digest()
+    k = np.zeros(64, dtype=np.uint8)
+    k[:len(key)] = np.frombuffer(key, dtype=np.uint8)
+    inner = np.empty(8, dtype=np.uint32)
+    outer = np.empty(8, dtype=np.uint32)
+    cdll.sha256_block_state(np.ascontiguousarray(k ^ 0x36), inner)
+    cdll.sha256_block_state(np.ascontiguousarray(k ^ 0x5C), outer)
+    return inner, outer
+
+
+_key_state_cache: dict[bytes, tuple[np.ndarray, np.ndarray]] = {}
+
+
+def _native_hmac_hex(key: bytes, data: np.ndarray, offsets: np.ndarray,
+                     validity: Optional[np.ndarray], n: int):
+    """C++ batched HMAC path (GIL-free); None when the lib is absent."""
+    from transferia_tpu.native import lib as native_lib
+
+    cdll = native_lib()
+    if cdll is None or n == 0:
+        return None
+    states = _key_state_cache.get(key)
+    if states is None:
+        states = _hmac_key_states_np(key, cdll)
+        _key_state_cache[key] = states
+    inner, outer = states
+    out_hex = np.empty((n, 64), dtype=np.uint8)
+    valid_arg = None
+    if validity is not None:
+        valid_u8 = np.ascontiguousarray(validity, dtype=np.uint8)
+        valid_arg = valid_u8.ctypes.data
+    cdll.hmac_sha256_hex(
+        np.ascontiguousarray(data),
+        np.ascontiguousarray(offsets, dtype=np.int32),
+        n, inner, outer, valid_arg, out_hex,
+    )
+    from transferia_tpu.columnar.hexcol import hex_to_varwidth
+
+    return hex_to_varwidth(out_hex, validity)
 
 
 @register_transformer("mask_field")
