@@ -1,0 +1,134 @@
+"""Sharded snapshot across OS processes coordinating through S3.
+
+The round-1 gap (VERDICT #6): the flock filestore can't coordinate k8s
+pods.  This suite runs REAL separate python processes — the k8s Indexed
+Job topology — against the S3-API coordinator backed by the in-repo fake
+S3 server (real sockets, conditional writes), asserting exactly-once part
+claims and completed progress.  Reference behavior:
+pkg/coordinator/s3coordinator/coordinator_s3.go + load_snapshot.go:495-671.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from transferia_tpu.abstract import TableID
+from transferia_tpu.abstract.table import OperationTablePart
+from transferia_tpu.coordinator import S3Coordinator
+
+from tests.recipes.fake_s3 import FakeS3
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+CLAIM_WORKER = """
+import json, os, sys
+from transferia_tpu.coordinator import S3Coordinator
+
+cp = S3Coordinator(bucket="b", endpoint=os.environ["FAKE_S3"],
+                   access_key="test-ak", secret_key="test-sk")
+widx = int(sys.argv[1])
+claimed = []
+while True:
+    part = cp.assign_operation_part("op-x", widx)
+    if part is None:
+        break
+    part.completed = True
+    part.completed_rows = 10
+    part.worker_index = widx
+    cp.update_operation_parts("op-x", [part])
+    claimed.append(part.part_index)
+print(json.dumps(claimed))
+"""
+
+SNAPSHOT_WORKER = """
+import os, sys
+from transferia_tpu.coordinator import S3Coordinator
+from transferia_tpu.models import Transfer, TransferType
+from transferia_tpu.models.transfer import Runtime, ShardingUploadParams
+from transferia_tpu.providers.sample import SampleSourceParams
+from transferia_tpu.providers.stdout import NullTargetParams
+from transferia_tpu.tasks import SnapshotLoader
+
+widx = int(sys.argv[1])
+cp = S3Coordinator(bucket="b", endpoint=os.environ["FAKE_S3"],
+                   access_key="test-ak", secret_key="test-sk")
+t = Transfer(
+    id="s3e2e",
+    type=TransferType.SNAPSHOT_ONLY,
+    src=SampleSourceParams(preset="users", table="users", rows=300,
+                           batch_rows=64, shard_parts=6),
+    dst=NullTargetParams(),
+    runtime=Runtime(current_job=widx,
+                    sharding=ShardingUploadParams(job_count=2,
+                                                  process_count=2)),
+)
+SnapshotLoader(t, cp, operation_id="op-s3e2e").upload_tables()
+"""
+
+
+def run_workers(script: str, endpoint: str, n: int,
+                timeout: float = 180.0) -> list[str]:
+    env = dict(os.environ)
+    env["FAKE_S3"] = endpoint
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, "-c", script, str(i)],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True,
+        )
+        for i in range(n)
+    ]
+    outs = []
+    for p in procs:
+        out, err = p.communicate(timeout=timeout)
+        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+        outs.append(out)
+    return outs
+
+
+@pytest.fixture
+def fake_s3():
+    fake = FakeS3(page_size=4).start()
+    try:
+        yield fake
+    finally:
+        fake.stop()
+
+
+def test_cross_process_claims_exactly_once(fake_s3):
+    cp = S3Coordinator(bucket="b", endpoint=fake_s3.endpoint,
+                       access_key="test-ak", secret_key="test-sk")
+    parts = [
+        OperationTablePart(operation_id="op-x",
+                           table_id=TableID("s", "t"),
+                           part_index=i, parts_count=12, eta_rows=10)
+        for i in range(12)
+    ]
+    cp.create_operation_parts("op-x", parts)
+
+    outs = run_workers(CLAIM_WORKER, fake_s3.endpoint, 3)
+    claimed = [json.loads(o) for o in outs]
+    flat = sorted(i for sub in claimed for i in sub)
+    assert flat == list(range(12))  # exactly once across processes
+    prog = cp.operation_progress("op-x")
+    assert prog.done and prog.completed_rows == 120
+
+
+def test_cross_process_sharded_snapshot(fake_s3):
+    outs = run_workers(SNAPSHOT_WORKER, fake_s3.endpoint, 2,
+                       timeout=300.0)
+    assert len(outs) == 2
+    cp = S3Coordinator(bucket="b", endpoint=fake_s3.endpoint,
+                       access_key="test-ak", secret_key="test-sk")
+    prog = cp.operation_progress("op-s3e2e")
+    assert prog.done, prog
+    assert prog.completed_rows == 300
+    parts = cp.operation_parts("op-s3e2e")
+    assert len(parts) == 6
+    assert all(p.completed for p in parts)
+    assert cp.get_status("s3e2e").value in ("activated", "new")

@@ -1,0 +1,165 @@
+"""In-process S3-compatible HTTP server (coordinator + provider tests).
+
+Real-socket fake in the style of the other recipes (fake_kafka etc.):
+implements the S3 REST subset the repo's clients use — GET/PUT/DELETE
+object, ListObjectsV2 with continuation, ETags, and conditional writes
+(If-Match / If-None-Match: *) — so the optimistic-CAS coordinator paths
+are exercised for real.  Set `conditional_writes=False` to emulate an
+endpoint without them (clients must degrade to last-writer-wins).
+
+Requests must carry a SigV4 Authorization header (presence + access-key
+match only; signatures are not re-derived — localstack behaves the same).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import http.server
+import threading
+import urllib.parse
+from typing import Optional
+
+
+class FakeS3:
+    def __init__(self, access_key: str = "test-ak",
+                 conditional_writes: bool = True,
+                 page_size: int = 10):
+        self.access_key = access_key
+        self.conditional_writes = conditional_writes
+        self.page_size = page_size
+        self.objects: dict[str, tuple[bytes, str]] = {}  # key -> (body, etag)
+        self.lock = threading.Lock()
+        self.requests: list[str] = []
+        fake = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _reject(self, status: int, code: str):
+                body = (f"<Error><Code>{code}</Code></Error>").encode()
+                self.send_response(status)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _auth_ok(self) -> bool:
+                auth = self.headers.get("Authorization", "")
+                return ("AWS4-HMAC-SHA256" in auth
+                        and fake.access_key in auth)
+
+            def _parse(self) -> tuple[str, str, dict]:
+                parsed = urllib.parse.urlparse(self.path)
+                segs = parsed.path.lstrip("/").split("/", 1)
+                bucket = segs[0]
+                key = urllib.parse.unquote(segs[1]) if len(segs) > 1 else ""
+                query = dict(urllib.parse.parse_qsl(parsed.query))
+                return bucket, key, query
+
+            def do_PUT(self):
+                if not self._auth_ok():
+                    return self._reject(403, "AccessDenied")
+                _, key, _ = self._parse()
+                fake.requests.append(f"PUT {key}")
+                length = int(self.headers.get("Content-Length", 0))
+                body = self.rfile.read(length)
+                if_match = self.headers.get("If-Match")
+                if_none = self.headers.get("If-None-Match")
+                with fake.lock:
+                    if (if_match or if_none) and not fake.conditional_writes:
+                        return self._reject(501, "NotImplemented")
+                    cur = fake.objects.get(key)
+                    if if_none == "*" and cur is not None:
+                        return self._reject(412, "PreconditionFailed")
+                    if if_match is not None and (
+                            cur is None
+                            or cur[1] != if_match.strip('"')):
+                        return self._reject(412, "PreconditionFailed")
+                    etag = hashlib.md5(body).hexdigest()
+                    fake.objects[key] = (body, etag)
+                self.send_response(200)
+                self.send_header("ETag", f'"{etag}"')
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def do_GET(self):
+                if not self._auth_ok():
+                    return self._reject(403, "AccessDenied")
+                _, key, query = self._parse()
+                if not key and query.get("list-type") == "2":
+                    return self._list(query)
+                fake.requests.append(f"GET {key}")
+                with fake.lock:
+                    cur = fake.objects.get(key)
+                if cur is None:
+                    return self._reject(404, "NoSuchKey")
+                body, etag = cur
+                self.send_response(200)
+                self.send_header("ETag", f'"{etag}"')
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_DELETE(self):
+                if not self._auth_ok():
+                    return self._reject(403, "AccessDenied")
+                _, key, _ = self._parse()
+                with fake.lock:
+                    fake.objects.pop(key, None)
+                self.send_response(204)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def _list(self, query: dict):
+                prefix = query.get("prefix", "")
+                token = query.get("continuation-token", "")
+                with fake.lock:
+                    keys = sorted(k for k in fake.objects
+                                  if k.startswith(prefix))
+                start = 0
+                if token:
+                    start = next((i + 1 for i, k in enumerate(keys)
+                                  if k == token), len(keys))
+                page = keys[start:start + fake.page_size]
+                truncated = start + fake.page_size < len(keys)
+                parts = ["<?xml version='1.0'?><ListBucketResult>"]
+                parts.append(
+                    f"<IsTruncated>{'true' if truncated else 'false'}"
+                    f"</IsTruncated>")
+                if truncated and page:
+                    parts.append(f"<NextContinuationToken>{page[-1]}"
+                                 f"</NextContinuationToken>")
+                for k in page:
+                    with fake.lock:
+                        body, etag = fake.objects[k]
+                    parts.append(
+                        f"<Contents><Key>{k}</Key>"
+                        f"<Size>{len(body)}</Size>"
+                        f'<ETag>"{etag}"</ETag></Contents>')
+                parts.append("</ListBucketResult>")
+                out = "".join(parts).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/xml")
+                self.send_header("Content-Length", str(len(out)))
+                self.end_headers()
+                self.wfile.write(out)
+
+        self.server = http.server.ThreadingHTTPServer(
+            ("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True)
+
+    @property
+    def endpoint(self) -> str:
+        return f"http://127.0.0.1:{self.port}"
+
+    def start(self) -> "FakeS3":
+        self.thread.start()
+        return self
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()

@@ -28,10 +28,18 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--log-level", default="info",
                    choices=["debug", "info", "warning", "error"])
     p.add_argument("--coordinator", default="memory",
-                   choices=["memory", "filestore"],
+                   choices=["memory", "filestore", "s3"],
                    help="control-plane backend")
     p.add_argument("--coordinator-dir", default="",
                    help="shared directory for --coordinator filestore")
+    p.add_argument("--coordinator-bucket", default="",
+                   help="bucket for --coordinator s3")
+    p.add_argument("--coordinator-endpoint", default="",
+                   help="S3-compatible endpoint URL (default: AWS)")
+    p.add_argument("--coordinator-region", default="us-east-1",
+                   help="region for --coordinator s3 signing")
+    p.add_argument("--coordinator-prefix", default="",
+                   help="key prefix inside the coordinator bucket")
     p.add_argument("--job-index", type=int, default=0,
                    help="this worker's index (0 = main)")
     p.add_argument("--job-count", type=int, default=0,
@@ -147,11 +155,24 @@ def _coordinator(args):
                 "--coordinator filestore requires --coordinator-dir"
             )
         return new_coordinator("filestore", root=args.coordinator_dir)
+    if args.coordinator == "s3":
+        if not args.coordinator_bucket:
+            raise SystemExit(
+                "--coordinator s3 requires --coordinator-bucket "
+                "(credentials via AWS_ACCESS_KEY_ID/AWS_SECRET_ACCESS_KEY)"
+            )
+        return new_coordinator(
+            "s3",
+            bucket=args.coordinator_bucket,
+            endpoint=args.coordinator_endpoint,
+            region=args.coordinator_region,
+            prefix=args.coordinator_prefix,
+        )
     # memory coordinator cannot share parts across processes
     if args.job_count > 1:
         raise SystemExit(
             "--coordinator memory does not support --job-count > 1; "
-            "use --coordinator filestore (main.go:118-121 parity)"
+            "use --coordinator filestore or s3 (main.go:118-121 parity)"
         )
     return new_coordinator("memory")
 

@@ -14,6 +14,7 @@ from transferia_tpu.coordinator.interface import (
 )
 from transferia_tpu.coordinator.memory import MemoryCoordinator
 from transferia_tpu.coordinator.filestore import FileStoreCoordinator
+from transferia_tpu.coordinator.s3store import S3Coordinator
 
 __all__ = [
     "Coordinator",
@@ -21,13 +22,16 @@ __all__ = [
     "TransferStatus",
     "MemoryCoordinator",
     "FileStoreCoordinator",
+    "S3Coordinator",
 ]
 
 
 def new_coordinator(kind: str, **kw) -> Coordinator:
-    """Factory used by the CLI (--coordinator memory|filestore)."""
+    """Factory used by the CLI (--coordinator memory|filestore|s3)."""
     if kind == "memory":
         return MemoryCoordinator()
-    if kind in ("filestore", "s3"):
+    if kind == "filestore":
         return FileStoreCoordinator(**kw)
+    if kind == "s3":
+        return S3Coordinator(**kw)
     raise ValueError(f"unknown coordinator kind {kind!r}")
