@@ -24,7 +24,7 @@ from transferia_tpu.runtime import run_replication
 
 class FakeKinesis:
     def __init__(self, access_key="AK", secret_key="SK",
-                 region="us-east-1"):
+                 region="us-east-1", list_page_size=100):
         self.access_key = access_key
         self.secret_key = secret_key
         self.region = region
@@ -34,6 +34,22 @@ class FakeKinesis:
         self.port = 0
         self._srv = None
         self.bad_signatures = 0
+        self.list_page_size = list_page_size
+        self.expired_iterators: set[str] = set()
+        self.issued_iterators: set[str] = set()
+        self._iter_counter = 0
+
+    def _issue(self, shard: str, start: int) -> str:
+        # fresh opaque token each time (real Kinesis never reissues one)
+        self._iter_counter += 1
+        it = f"{shard}:{start}#{self._iter_counter}"
+        self.issued_iterators.add(it)
+        return it
+
+    def expire_issued_iterators(self) -> None:
+        """Mark every iterator handed out so far as expired (5-min TTL)."""
+        with self.lock:
+            self.expired_iterators |= self.issued_iterators
 
     def put(self, shard: str, data: bytes, key: str = "k") -> None:
         with self.lock:
@@ -68,7 +84,9 @@ class FakeKinesis:
                     return self._send(403, {"message": "bad signature"})
                 req = json.loads(body)
                 action = target.split(".")[-1]
-                self._send(200, fake.dispatch(action, req))
+                result = fake.dispatch(action, req)
+                status = 400 if "__type" in result else 200
+                self._send(status, result)
 
             def _send(self, status, obj):
                 out = json.dumps(obj).encode()
@@ -96,7 +114,18 @@ class FakeKinesis:
     def dispatch(self, action, req):
         with self.lock:
             if action == "ListShards":
-                return {"Shards": [{"ShardId": s} for s in self.shards]}
+                names = sorted(self.shards)
+                start = 0
+                if "NextToken" in req:
+                    if "StreamName" in req:
+                        return {"__type": "InvalidArgumentException",
+                                "message": "NextToken excludes StreamName"}
+                    start = int(req["NextToken"])
+                page = names[start:start + self.list_page_size]
+                out = {"Shards": [{"ShardId": s} for s in page]}
+                if start + self.list_page_size < len(names):
+                    out["NextToken"] = str(start + self.list_page_size)
+                return out
             if action == "GetShardIterator":
                 shard = req["ShardId"]
                 if req["ShardIteratorType"] == "AFTER_SEQUENCE_NUMBER":
@@ -112,15 +141,19 @@ class FakeKinesis:
                     start = len(self.shards[shard])
                 else:
                     start = 0
-                return {"ShardIterator": f"{shard}:{start}"}
+                return {"ShardIterator": self._issue(shard, start)}
             if action == "GetRecords":
-                shard, start = req["ShardIterator"].rsplit(":", 1)
-                start = int(start)
+                it = req["ShardIterator"]
+                if it in self.expired_iterators:
+                    return {"__type": "ExpiredIteratorException",
+                            "message": "Iterator expired"}
+                shard, rest = it.rsplit(":", 1)
+                start = int(rest.split("#")[0])
                 records = self.shards[shard][start:start + req.get(
                     "Limit", 1000)]
-                nxt = start + len(records)
                 return {"Records": records,
-                        "NextShardIterator": f"{shard}:{nxt}"}
+                        "NextShardIterator": self._issue(
+                            shard, start + len(records))}
             return {"message": f"unknown action {action}"}
 
 
@@ -186,3 +219,61 @@ def test_kinesis_bad_credentials(kinesis):
     with pytest.raises(KinesisError, match="signature"):
         client.list_shards("s")
     assert kinesis.bad_signatures >= 1
+
+
+def test_list_shards_paginates():
+    """ADVICE round-1: ListShards NextToken was ignored — shards past the
+    first page were never replicated."""
+    from transferia_tpu.providers.kinesis import KinesisClient
+
+    srv = FakeKinesis(list_page_size=1).start()
+    try:
+        srv.shards["shardId-002"] = []
+        client = KinesisClient(
+            access_key="AK", secret_key="SK",
+            endpoint=f"http://127.0.0.1:{srv.port}",
+        )
+        assert client.list_shards("s") == [
+            "shardId-000", "shardId-001", "shardId-002",
+        ]
+    finally:
+        srv.stop()
+
+
+def test_expired_iterator_rebuilds_without_loss():
+    """ADVICE round-1: an expired shard iterator (5-min TTL) wedged the
+    shard until worker restart; fetch must re-acquire from the last seen
+    sequence."""
+    from transferia_tpu.providers.kinesis import (
+        KinesisSourceParams,
+        _KinesisQueueClient,
+    )
+
+    srv = FakeKinesis().start()
+    try:
+        for i in range(6):
+            srv.put("shardId-000", json.dumps({"i": i}).encode())
+        params = KinesisSourceParams(
+            stream="s", access_key="AK", secret_key="SK",
+            endpoint=f"http://127.0.0.1:{srv.port}",
+        )
+        qc = _KinesisQueueClient(params, "t1", MemoryCoordinator())
+        qc.MIN_POLL_INTERVAL = 0.0
+        got = []
+
+        def drain():
+            for b in qc.fetch():
+                got.extend(json.loads(m.value)["i"] for m in b.messages)
+
+        drain()
+        assert got == list(range(6))
+        # TTL elapses; everything issued so far is now dead
+        srv.expire_issued_iterators()
+        srv.put("shardId-000", json.dumps({"i": 6}).encode())
+        deadline = time.monotonic() + 10
+        while 6 not in got and time.monotonic() < deadline:
+            drain()
+        # first drain after expiry rebuilds, next one reads the record
+        assert 6 in got and got == list(range(7))
+    finally:
+        srv.stop()

@@ -126,12 +126,16 @@ class TestDBLog:
                 table_schema=signal_schema,
             )
             written.append((mark_id, kind))
-            # feed the CDC stream on another "thread" (inline is fine)
-            snapshot_holder["snap"].filter_cdc([item])
+            # feed the CDC stream on another "thread" (inline is fine);
+            # the replication pipeline pushes filter_cdc's output — which
+            # now carries the chunk rows inline at the HIGH position
+            out = snapshot_holder["snap"].filter_cdc([item])
+            if out:
+                sink.async_push(out).result()
 
         signal = StorageSignalTable(write_fn)
         chunks = PagedChunkIterator(load_fn, "id", chunk_rows=8)
-        snap = DBLogSnapshot(signal, chunks, sink, ["id"])
+        snap = DBLogSnapshot(signal, chunks, ["id"])
         snapshot_holder["snap"] = snap
 
         # live CDC updates id 5 while snapshotting (between watermarks)
@@ -166,6 +170,80 @@ class TestDBLog:
         assert kinds.count("low") == kinds.count("high")
         assert kinds[-1] == "success"
 
+    def test_chunk_never_trails_post_high_cdc_event(self):
+        """ADVICE round-1 (dblog/core.py:154): a CDC update arriving just
+        after HIGH reflects a commit newer than the chunk read; the chunk
+        must reach the sink BEFORE it, or last-write-wins sinks keep the
+        stale snapshot value.  Inline emission at the HIGH position
+        guarantees the order."""
+        from transferia_tpu.dblog import DBLogSnapshot
+        from transferia_tpu.dblog.core import (
+            PagedChunkIterator,
+            StorageSignalTable,
+        )
+        from transferia_tpu.providers.memory import (
+            MemorySinker as _MS,  # noqa: F401 - same store helpers
+        )
+
+        arrivals: list[tuple] = []
+
+        class RecordingSink:
+            def async_push(self, batch):
+                import concurrent.futures
+
+                for it in (batch.to_rows() if hasattr(batch, "to_rows")
+                           else batch):
+                    arrivals.append((it.value("id"), it.value("v")))
+                f = concurrent.futures.Future()
+                f.set_result(None)
+                return f
+
+        def load_fn(cursor, limit):
+            if cursor is not None:
+                return None
+            return ColumnBatch.from_pydict(TID, SCHEMA, {
+                "id": [1, 2, 3], "v": ["old1", "old2", "old3"],
+            })
+
+        sink = RecordingSink()
+        signal_schema = new_table_schema([
+            ("mark_id", "utf8", True), ("kind", "utf8"),
+        ])
+        holder = {}
+
+        def write_fn(mark_id, kind):
+            item = ChangeItem(
+                kind=Kind.INSERT, schema="", table="__transferia_signal",
+                column_names=("mark_id", "kind"),
+                column_values=(mark_id, kind),
+                table_schema=signal_schema,
+            )
+            # the CDC stream delivers: [watermark, then a fresh commit
+            # for id 2 that happened right after the HIGH write]
+            stream = [item]
+            if kind == "high" and not holder.get("emitted"):
+                holder["emitted"] = True
+                stream.append(ChangeItem(
+                    kind=Kind.UPDATE, schema="m", table="inc",
+                    column_names=("id", "v"), column_values=(2, "new2"),
+                    table_schema=SCHEMA,
+                ))
+            out = holder["snap"].filter_cdc(stream)
+            if out:
+                sink.async_push(out)
+
+        signal = StorageSignalTable(write_fn)
+        chunks = PagedChunkIterator(load_fn, "id", chunk_rows=8)
+        snap = DBLogSnapshot(signal, chunks, ["id"])
+        holder["snap"] = snap
+        snap.run(chunk_timeout=5)
+
+        # chunk row for id 2 (old2, read before the update committed) must
+        # arrive before the newer CDC value — arrival order IS correctness
+        # for last-write-wins sinks
+        ids2 = [(i, v) for i, v in arrivals if i == 2]
+        assert ids2 == [(2, "old2"), (2, "new2")]
+
     def test_watermark_timeout_marks_bad(self):
         from transferia_tpu.dblog import DBLogSnapshot
         from transferia_tpu.dblog.core import (
@@ -176,8 +254,7 @@ class TestDBLog:
         written = []
         signal = StorageSignalTable(lambda i, k: written.append(k))
         chunks = PagedChunkIterator(lambda c, l: None, "id")
-        snap = DBLogSnapshot(signal, chunks,
-                             SyncAsAsyncSink(None), ["id"])
+        snap = DBLogSnapshot(signal, chunks, ["id"])
         with pytest.raises(TimeoutError, match="not observed"):
             snap.run(chunk_timeout=0.1)
         assert written[-1] == "bad"

@@ -11,7 +11,7 @@ from dataclasses import dataclass
 from typing import Any, Callable, Optional, Sequence
 
 from transferia_tpu.abstract.change_item import ChangeItem
-from transferia_tpu.abstract.interfaces import AsyncSink, Batch, is_columnar
+from transferia_tpu.abstract.interfaces import Batch, is_columnar
 from transferia_tpu.abstract.schema import TableID
 from transferia_tpu.columnar.batch import ColumnBatch
 
@@ -61,18 +61,25 @@ class DBLogSnapshot:
     loop calls `run`.  Between a LOW and HIGH watermark pair, primary keys
     seen in CDC events mark chunk rows stale (the live event supersedes the
     chunk copy) — dblog/incremental_async_sink.go:14-207.
+
+    ALL data (chunks included) reaches the target through the CDC
+    pipeline's pushes of `filter_cdc` output: each chunk is emitted inline
+    at its HIGH watermark's stream position, so a chunk row can never
+    trail a newer CDC event for the same key into an arrival-ordered sink.
+    The snapshot thread itself pushes nothing.
     """
 
     def __init__(self, signal: SignalTable, chunks: ChunkIterator,
-                 sink: AsyncSink, key_columns: Sequence[str]):
+                 key_columns: Sequence[str]):
         self.signal = signal
         self.chunks = chunks
-        self.sink = sink
         self.key_columns = list(key_columns)
         self._lock = threading.Lock()
         self._window_open = False
         self._touched: set[tuple] = set()
         self._expected: dict[str, WatermarkKind] = {}
+        self._pending_chunk: Optional[ColumnBatch] = None
+        self._emitted_rows = 0
         self._events = {
             WatermarkKind.LOW: threading.Event(),
             WatermarkKind.HIGH: threading.Event(),
@@ -82,13 +89,28 @@ class DBLogSnapshot:
     def filter_cdc(self, batch: Batch) -> Batch:
         """Intercept the replication stream: consume watermarks, record
         touched PKs while a chunk window is open.  Returns the batch minus
-        watermark rows."""
+        watermark rows, PLUS the deduped pending chunk emitted inline at
+        the HIGH watermark's stream position — chunk rows must never trail
+        a newer CDC event for the same key into an arrival-ordered sink
+        (reference: incremental_async_sink.go:167 serializes exactly so)."""
         items = batch.to_rows() if is_columnar(batch) else list(batch)
+        # fast path: a lone HIGH watermark with an untouched chunk (the
+        # common quiet-table case) passes the chunk through columnar
+        if len(items) == 1 and items[0].is_row_event():
+            wm = self.signal.is_watermark(items[0])
+            if wm is not None:
+                emit = self._on_watermark(wm)
+                return emit if emit is not None else []
+        modified = False
         out = []
         for it in items:
             wm = self.signal.is_watermark(it) if it.is_row_event() else None
             if wm is not None:
-                self._on_watermark(wm)
+                modified = True  # watermark removed (and maybe chunk added)
+                emit = self._on_watermark(wm)
+                if emit is None:
+                    continue
+                out.extend(emit.to_rows() if is_columnar(emit) else emit)
                 continue
             with self._lock:
                 if self._window_open and it.is_row_event():
@@ -96,22 +118,50 @@ class DBLogSnapshot:
                         (it.table_id, it.effective_key())
                     )
             out.append(it)
-        if is_columnar(batch) and len(out) == len(items):
-            return batch  # nothing filtered: keep columnar
+        if is_columnar(batch) and not modified:
+            return batch  # untouched: keep columnar
         return out
 
-    def _on_watermark(self, wm: Watermark) -> None:
+    def _on_watermark(self, wm: Watermark):
+        """Consume a watermark; on HIGH, return the pending chunk (deduped
+        against keys touched inside the window) for the caller to emit
+        inline at this stream position.  Returns None (nothing to emit),
+        a ColumnBatch (untouched chunk — columnar fast path), or a list of
+        ChangeItems (deduped rows)."""
         expected = self._expected.pop(wm.id, None)
         if expected is None or expected != wm.kind:
             logger.warning("unexpected watermark %s", wm)
-            return
+            return None
+        emit = None
         with self._lock:
             if wm.kind == WatermarkKind.LOW:
                 self._window_open = True
                 self._touched.clear()
             elif wm.kind == WatermarkKind.HIGH:
                 self._window_open = False
+                chunk = self._pending_chunk
+                self._pending_chunk = None
+                if chunk is not None and chunk.n_rows:
+                    if not self._touched:
+                        emit = chunk  # columnar, no per-row work
+                        self._emitted_rows = chunk.n_rows
+                    else:
+                        rows = chunk.to_rows()
+                        emit = [
+                            it for it in rows
+                            if (it.table_id, it.effective_key())
+                            not in self._touched
+                        ]
+                        if len(emit) < len(rows):
+                            logger.info(
+                                "dblog chunk: %d rows deduped against "
+                                "live events", len(rows) - len(emit),
+                            )
+                        self._emitted_rows = len(emit)
+                else:
+                    self._emitted_rows = 0
         self._events[wm.kind].set()
+        return emit
 
     # -- snapshot side ------------------------------------------------------
     def _write_and_wait(self, kind: WatermarkKind,
@@ -127,35 +177,24 @@ class DBLogSnapshot:
             )
 
     def run(self, chunk_timeout: float = 30.0) -> int:
-        """Snapshot all chunks; returns rows pushed."""
+        """Snapshot all chunks; returns rows pushed.
+
+        Chunks are not pushed from this thread: each chunk is parked as
+        pending BEFORE its HIGH watermark is written, and the CDC pipeline
+        emits it inline when it consumes that watermark (filter_cdc) —
+        serializing chunk rows against newer live events."""
         total = 0
         try:
             while True:
                 self._write_and_wait(WatermarkKind.LOW, chunk_timeout)
                 chunk = self.chunks.next_chunk()
+                with self._lock:
+                    self._pending_chunk = chunk
                 self._write_and_wait(WatermarkKind.HIGH, chunk_timeout)
                 if chunk is None or chunk.n_rows == 0:
                     break
                 with self._lock:
-                    touched = set(self._touched)
-                if touched:
-                    rows = chunk.to_rows()
-                    keep = [
-                        it for it in rows
-                        if (it.table_id, it.effective_key()) not in touched
-                    ]
-                    if len(keep) < len(rows):
-                        logger.info(
-                            "dblog chunk: %d rows deduped against live "
-                            "events", len(rows) - len(keep),
-                        )
-                    if not keep:
-                        continue
-                    self.sink.async_push(keep).result()
-                    total += len(keep)
-                else:
-                    self.sink.async_push(chunk).result()
-                    total += chunk.n_rows
+                    total += self._emitted_rows
             self.signal.write_watermark(
                 Watermark(uuid.uuid4().hex, WatermarkKind.SUCCESS)
             )

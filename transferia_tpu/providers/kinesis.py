@@ -106,8 +106,13 @@ class KinesisClient:
 
         body = json.dumps(payload).encode()
         target = f"Kinesis_20131202.{action}"
+        # sign the Host header exactly as http.client transmits it: with
+        # ":port" when non-default (custom endpoints, e.g. localstack)
+        default_port = 443 if self.secure else 80
+        signed_host = self.host if self.port == default_port \
+            else f"{self.host}:{self.port}"
         headers = sigv4_headers(
-            "POST", self.host, "/", body, self.region, "kinesis",
+            "POST", signed_host, "/", body, self.region, "kinesis",
             self.access_key, self.secret_key, target,
         )
         cls = http.client.HTTPSConnection if self.secure \
@@ -134,8 +139,18 @@ class KinesisClient:
             conn.close()
 
     def list_shards(self, stream: str) -> list[str]:
-        out = self.call("ListShards", {"StreamName": stream})
-        return sorted(s["ShardId"] for s in out.get("Shards", []))
+        # page on NextToken: streams wider than one page (100 shards)
+        # would otherwise silently lose shards (never replicated)
+        shards: list[str] = []
+        req: dict = {"StreamName": stream}
+        while True:
+            out = self.call("ListShards", req)
+            shards.extend(s["ShardId"] for s in out.get("Shards", []))
+            token = out.get("NextToken")
+            if not token:
+                return sorted(shards)
+            # per API: NextToken must be the only parameter besides limit
+            req = {"NextToken": token}
 
     def shard_iterator(self, stream: str, shard: str,
                       after_sequence: Optional[str] = None,
@@ -205,6 +220,18 @@ class _KinesisQueueClient:
             )
         self.iterators: dict[str, str] = {}
         self._last_poll: dict[str, float] = {}
+        # last sequence seen/committed per shard — iterator rebuild point
+        # when a shard iterator expires (~5 min TTL)
+        self._last_seq: dict[str, Optional[str]] = {
+            s: saved.get(s) for s in self.shards
+        }
+        # shards whose INITIAL iterator was LATEST — only those may
+        # rebuild as LATEST; reshard children start TRIM_HORIZON and must
+        # never skip to the tip on an expired-iterator rebuild
+        self._latest_start: set[str] = set(
+            s for s in self.shards
+            if saved.get(s) is None and params.start_from == "latest"
+        )
         # virtual offset per shard: a dense int the sequencer can order;
         # the real checkpoint token is the sequence number
         self.offsets: dict[str, int] = {s: 0 for s in self.shards}
@@ -250,7 +277,23 @@ class _KinesisQueueClient:
                     < self.MIN_POLL_INTERVAL:
                 continue
             self._last_poll[shard] = now
-            resp = self.client.get_records(it, limit=max_messages)
+            try:
+                resp = self.client.get_records(it, limit=max_messages)
+            except KinesisError as e:
+                if "ExpiredIterator" in e.code:
+                    # shard iterators expire after ~5 min; re-acquire from
+                    # the last seen sequence instead of wedging the shard
+                    logger.info(
+                        "kinesis shard %s iterator expired; rebuilding",
+                        shard)
+                    self.iterators[shard] = self.client.shard_iterator(
+                        self.params.stream, shard,
+                        after_sequence=self._last_seq.get(shard),
+                        latest=(self._last_seq.get(shard) is None
+                                and shard in self._latest_start),
+                    )
+                    continue
+                raise
             self.iterators[shard] = resp.get("NextShardIterator") or ""
             records = resp.get("Records", [])
             if not records:
@@ -260,6 +303,7 @@ class _KinesisQueueClient:
                 off = self.offsets[shard]
                 self.offsets[shard] = off + 1
                 self.sequences[shard][off] = r["SequenceNumber"]
+                self._last_seq[shard] = r["SequenceNumber"]
                 msgs.append(Message(
                     value=base64.b64decode(r["Data"]),
                     key=r.get("PartitionKey", "").encode(),
