@@ -48,12 +48,18 @@ def farm():
     mg = FakeMongo().start()
     mg.seed("db", "src_t", [{"_id": f"k{i:02d}", "v": i}
                             for i in range(ROWS)])
-    yield {"pg": pg, "mysql": my, "mongo": mg}
+    import tempfile
+
+    s3dir = tempfile.mkdtemp(prefix="matrix_s3_")
+    with open(f"{s3dir}/src.log", "w") as fh:
+        for i in range(ROWS):
+            fh.write(f"line-{i}\n")
+    yield {"pg": pg, "mysql": my, "mongo": mg, "s3dir": s3dir}
     for srv in (pg, my, mg):
         srv.stop()
 
 
-SOURCES = ["sample", "pg", "mysql", "mongo"]
+SOURCES = ["sample", "pg", "mysql", "mongo", "s3line"]
 SINKS = ["ch", "pg", "mysql", "fs", "memory"]
 
 
@@ -68,6 +74,11 @@ def _source(name, farm):
         return MySQLSourceParams(host="127.0.0.1",
                                  port=farm["mysql"].port,
                                  database="db", user="root", password="p")
+    if name == "s3line":
+        from transferia_tpu.providers.s3 import S3SourceParams
+
+        return S3SourceParams(url=f"file://{farm['s3dir']}/*.log",
+                              format="line", table="src_t")
     return MongoSourceParams(host="127.0.0.1", port=farm["mongo"].port,
                              database="db")
 

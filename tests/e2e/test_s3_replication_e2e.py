@@ -1,0 +1,275 @@
+"""S3 replication source e2e: new objects -> sink, via poll and SQS
+fetchers (reference pkg/providers/s3/source/ + object_fetcher/)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from transferia_tpu.abstract import TableID
+from transferia_tpu.coordinator import MemoryCoordinator
+from transferia_tpu.models import Transfer, TransferType
+from transferia_tpu.providers.memory import MemoryTargetParams, get_store
+from transferia_tpu.providers.s3 import S3SourceParams
+from transferia_tpu.runtime import run_replication
+
+from tests.recipes.fake_sqs import FakeSQS
+
+TID = TableID("s3", "events")
+
+
+def write_jsonl(path, rows):
+    with open(path, "w") as fh:
+        for r in rows:
+            fh.write(json.dumps(r) + "\n")
+
+
+def start_repl(transfer, cp):
+    stop = threading.Event()
+    th = threading.Thread(
+        target=run_replication, args=(transfer, cp),
+        kwargs={"stop_event": stop, "backoff": 0.1}, daemon=True,
+    )
+    th.start()
+    return stop, th
+
+
+def wait_rows(store, n, timeout=20.0):
+    deadline = time.monotonic() + timeout
+    while store.row_count() < n and time.monotonic() < deadline:
+        time.sleep(0.05)
+    return store.row_count()
+
+
+def test_poll_replication_with_resume(tmp_path):
+    d = tmp_path / "bucket"
+    d.mkdir()
+    write_jsonl(d / "b1.jsonl", [{"id": i, "v": f"a{i}"} for i in range(3)])
+
+    store = get_store("s3repl1")
+    store.clear()
+    cp = MemoryCoordinator()
+    t = Transfer(
+        id="s3repl1", type=TransferType.INCREMENT_ONLY,
+        src=S3SourceParams(url=f"file://{d}", format="jsonl",
+                           table="events", event_source="poll",
+                           poll_interval=0.1),
+        dst=MemoryTargetParams(sink_id="s3repl1"),
+    )
+    stop, th = start_repl(t, cp)
+    assert wait_rows(store, 3) == 3
+    # a new object appears mid-run
+    time.sleep(0.05)
+    write_jsonl(d / "b2.jsonl", [{"id": 3, "v": "a3"}])
+    assert wait_rows(store, 4) == 4
+    stop.set()
+    th.join(timeout=10)
+    # watermark persisted: a restarted worker skips both objects
+    wm = cp.get_transfer_state("s3repl1")["s3_poll_watermark"]
+    assert any(n.endswith("b2.jsonl") for n in wm["names"])
+    write_jsonl(d / "b3.jsonl", [{"id": 4, "v": "a4"}])
+    stop2, th2 = start_repl(t, cp)
+    assert wait_rows(store, 5) == 5
+    stop2.set()
+    th2.join(timeout=10)
+    ids = sorted(r.value("id") for r in store.rows(TID))
+    assert ids == [0, 1, 2, 3, 4]  # no duplicates after resume
+
+
+def test_sqs_replication(tmp_path):
+    d = tmp_path / "bucket"
+    d.mkdir()
+    sqs = FakeSQS().start()
+    try:
+        store = get_store("s3repl2")
+        store.clear()
+        cp = MemoryCoordinator()
+        t = Transfer(
+            id="s3repl2", type=TransferType.INCREMENT_ONLY,
+            src=S3SourceParams(
+                url=f"file://{d}", format="jsonl", table="events",
+                event_source="sqs", sqs_queue_url=sqs.queue_url,
+                sqs_access_key="test-ak", sqs_secret_key="test-sk",
+                sqs_wait_seconds=0, path_pattern="*.jsonl",
+            ),
+            dst=MemoryTargetParams(sink_id="s3repl2"),
+        )
+        stop, th = start_repl(t, cp)
+        # objects land in the bucket, then their creation events arrive
+        write_jsonl(d / "x1.jsonl", [{"id": 1, "v": "one"}])
+        sqs.send_s3_event(str(d / "x1.jsonl"))
+        assert wait_rows(store, 1) == 1
+        # SNS-wrapped event + non-matching key + test event are handled
+        write_jsonl(d / "x2.jsonl", [{"id": 2, "v": "two"}])
+        sqs.send_raw(json.dumps({"Event": "s3:TestEvent"}))
+        sqs.send_s3_event(str(d / "ignore.tmp"))
+        sqs.send_s3_event(str(d / "x2.jsonl"), sns_wrapped=True)
+        assert wait_rows(store, 2) == 2
+        stop.set()
+        th.join(timeout=10)
+        # every message consumed: processed ones deleted after push,
+        # junk ones deleted immediately
+        deadline = time.monotonic() + 5
+        while sqs.queue and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not sqs.queue
+        ids = sorted(r.value("id") for r in store.rows(TID))
+        assert ids == [1, 2]
+    finally:
+        sqs.stop()
+
+
+def test_sqs_redelivery_after_failed_push(tmp_path):
+    """Commit happens only after a durable push: if the push fails, the
+    SQS message is NOT deleted and the object replicates again once its
+    visibility timeout re-delivers it (at-least-once)."""
+    import concurrent.futures
+
+    from transferia_tpu.providers.s3source import S3ReplicationSource
+
+    d = tmp_path / "bucket"
+    d.mkdir()
+    sqs = FakeSQS(visibility=0.2).start()
+    try:
+        params = S3SourceParams(
+            url=f"file://{d}", format="jsonl", table="events",
+            event_source="sqs", sqs_queue_url=sqs.queue_url,
+            sqs_access_key="test-ak", sqs_secret_key="test-sk",
+            sqs_wait_seconds=0,
+        )
+        write_jsonl(d / "y.jsonl", [{"id": 7, "v": "seven"}])
+        sqs.send_s3_event(str(d / "y.jsonl"))
+
+        pushed = []
+        fails = {"left": 1}
+
+        class FlakySink:
+            def async_push(self, batch):
+                f = concurrent.futures.Future()
+                if fails["left"] > 0:
+                    fails["left"] -= 1
+                    f.set_exception(RuntimeError("injected"))
+                else:
+                    pushed.extend(batch.to_rows())
+                    f.set_result(None)
+                return f
+
+        sink = FlakySink()
+        stop = threading.Event()
+
+        def worker():
+            # model the runtime's restart loop around the source
+            while not stop.is_set():
+                src = S3ReplicationSource(params, "s3repl3",
+                                          MemoryCoordinator())
+                threading.Thread(target=lambda e=stop: (
+                    e.wait(), src.stop()), daemon=True).start()
+                try:
+                    src.run(sink)
+                    return
+                except RuntimeError:
+                    time.sleep(0.05)
+
+        th = threading.Thread(target=worker, daemon=True)
+        th.start()
+        deadline = time.monotonic() + 20
+        while not pushed and time.monotonic() < deadline:
+            time.sleep(0.05)
+        stop.set()
+        th.join(timeout=10)
+        assert pushed, "object was never re-delivered after failed push"
+        assert pushed[0].value("id") == 7
+        # the queue drains only after the successful push committed
+        deadline = time.monotonic() + 5
+        while sqs.queue and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert not sqs.queue
+    finally:
+        sqs.stop()
+
+
+def test_sqs_multi_record_message_deleted_only_when_all_committed(tmp_path):
+    """One SQS message can carry several Records: it must survive until
+    EVERY record's object is pushed (deleting on the first commit would
+    lose the rest on crash)."""
+    from transferia_tpu.providers.s3source import SQSObjectFetcher
+
+    d = tmp_path / "bucket"
+    d.mkdir()
+    sqs = FakeSQS().start()
+    try:
+        body = json.dumps({"Records": [
+            {"eventName": "ObjectCreated:Put",
+             "s3": {"bucket": {"name": "b"},
+                    "object": {"key": str(d / "m1.jsonl"), "size": 1}}},
+            {"eventName": "ObjectCreated:Put",
+             "s3": {"bucket": {"name": "b"},
+                    "object": {"key": str(d / "m2.jsonl"), "size": 1}}},
+        ]})
+        sqs.send_raw(body)
+        params = S3SourceParams(
+            url=f"file://{d}", format="jsonl", table="events",
+            event_source="sqs", sqs_queue_url=sqs.queue_url,
+            sqs_access_key="test-ak", sqs_secret_key="test-sk",
+            sqs_wait_seconds=0,
+        )
+        fetcher = SQSObjectFetcher(params)
+        keys = fetcher.fetch_objects()
+        assert len(keys) == 2
+        fetcher.commit(keys[0])
+        assert sqs.queue, "message deleted before all records committed"
+        fetcher.commit(keys[1])
+        assert not sqs.queue
+    finally:
+        sqs.stop()
+
+
+def test_poll_same_mtime_name_before_watermark_not_skipped(tmp_path):
+    """S3 mtimes have 1s granularity: an object written in the same second
+    as an already-committed one whose name sorts LATER must still
+    replicate."""
+    import os
+
+    from transferia_tpu.providers.s3source import PollingObjectFetcher
+
+    import fsspec
+
+    d = tmp_path / "bucket"
+    d.mkdir()
+    fs = fsspec.filesystem("file")
+    cp = MemoryCoordinator()
+
+    (d / "b.jsonl").write_text('{"id": 1}\n')
+    os.utime(d / "b.jsonl", (1000, 1000))
+    fetcher = PollingObjectFetcher(fs, str(d), "t", cp)
+    got = fetcher.fetch_objects()
+    assert [g.split("/")[-1] for g in got] == ["b.jsonl"]
+    fetcher.commit(got[0])
+
+    # a.jsonl appears with the SAME mtime but an earlier-sorting name
+    (d / "a.jsonl").write_text('{"id": 2}\n')
+    os.utime(d / "a.jsonl", (1000, 1000))
+    got2 = fetcher.fetch_objects()
+    assert [g.split("/")[-1] for g in got2] == ["a.jsonl"]
+    fetcher.commit(got2[0])
+    # and a resumed fetcher (fresh state from coordinator) skips both
+    fetcher2 = PollingObjectFetcher(fs, str(d), "t", cp)
+    assert fetcher2.fetch_objects() == []
+
+
+def test_poll_glob_url(tmp_path):
+    """A wildcard source URL must poll its parent and filter by the glob."""
+    import fsspec
+
+    from transferia_tpu.providers.s3source import PollingObjectFetcher
+
+    d = tmp_path / "bucket"
+    d.mkdir()
+    (d / "x.jsonl").write_text('{"id": 1}\n')
+    (d / "x.tmp").write_text("junk")
+    fs = fsspec.filesystem("file")
+    fetcher = PollingObjectFetcher(fs, f"{d}/*.jsonl", "t",
+                                   MemoryCoordinator())
+    got = fetcher.fetch_objects()
+    assert [g.split("/")[-1] for g in got] == ["x.jsonl"]

@@ -232,6 +232,7 @@ class _KinesisQueueClient:
             s for s in self.shards
             if saved.get(s) is None and params.start_from == "latest"
         )
+        self._closed: set[str] = set()  # drained parents post-reshard
         # virtual offset per shard: a dense int the sequencer can order;
         # the real checkpoint token is the sequence number
         self.offsets: dict[str, int] = {s: 0 for s in self.shards}
@@ -266,8 +267,14 @@ class _KinesisQueueClient:
         import time as _time
 
         out = []
-        if any(not it for it in self.iterators.values()):
+        drained = [s for s, it in self.iterators.items()
+                   if not it and s not in self._closed]
+        if drained:
+            # a closed shard's iterator goes empty exactly once: look for
+            # reshard children then mark it closed so the steady-state
+            # fetch loop doesn't re-issue ListShards forever
             self._refresh_shards()
+            self._closed.update(drained)
         now = _time.monotonic()
         for idx, shard in enumerate(self.shards):
             it = self.iterators.get(shard)

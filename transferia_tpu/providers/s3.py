@@ -1,17 +1,16 @@
 """S3 / object-storage provider.
 
 Reference parity: pkg/providers/s3/ — snapshot source with format readers
-(csv/json/line/parquet via reader/registry/), schema inference
-(reader/abstract.go:40-52), and the snapshot/replication sinks with file
-splitting (sink/file_splitter.go).  Storage access goes through fsspec, so
-the same provider serves s3://, gs://, and file:// URLs depending on which
-backends the environment ships (gcsfs is baked into this image; s3fs plugs
-in the same way).  Parquet objects stream row-group-parallel straight into
-columnar batches — the ClickBench snapshot path.
-
-The reference's SQS-event replication source (s3/source/) needs a queue
-feed; wire one by pointing an mq/kafka source at the bucket notification
-stream and a `blank` parser at the object keys.
+(parquet/csv/jsonl/line/nginx/proto via providers/s3readers.py, schema
+inference per reader/abstract.go:40-52), the snapshot/replication sinks
+with file splitting (sink/file_splitter.go), and a replication source
+(providers/s3source.py): set `event_source: sqs` (bucket notifications
+through an SQS queue, s3/source/ + object_fetcher_sqs.go) or
+`event_source: poll` (listing watermark in the coordinator state,
+object_fetcher_poller.go).  Storage access goes through fsspec, so the
+same provider serves s3://, gs://, and file:// URLs depending on which
+backends the environment ships.  Parquet objects stream row-group-parallel
+straight into columnar batches — the ClickBench snapshot path.
 """
 
 from __future__ import annotations
@@ -53,13 +52,36 @@ class S3SourceParams(EndpointParams):
     IS_SOURCE = True
 
     url: str = ""              # e.g. s3://bucket/prefix/*.parquet
-    format: str = "parquet"    # parquet | jsonl | csv
+    format: str = "parquet"    # parquet | jsonl | csv | line | nginx | proto
     table: str = "data"
     namespace: str = "s3"
     batch_rows: int = 65_536
     endpoint_url: str = ""     # custom S3 endpoint (minio etc.)
     anon: bool = True
     storage_options: dict = field(default_factory=dict)
+    nginx_format: str = ""     # log_format template (default: combined)
+    unparsed_policy: str = "route"   # route | skip | fail
+    parser: Optional[dict] = None    # protobuf descriptor config (proto)
+
+    # -- replication (reference pkg/providers/s3/source/) -------------------
+    event_source: str = ""     # "" (snapshot-only) | poll | sqs
+    poll_interval: float = 5.0
+    sqs_queue_url: str = ""
+    sqs_region: str = "us-east-1"
+    sqs_access_key: str = ""
+    sqs_secret_key: str = ""
+    sqs_endpoint: str = ""     # custom endpoint (localstack / fakes)
+    sqs_wait_seconds: int = 10
+    path_pattern: str = ""     # restrict replicated keys (glob)
+
+    def make_reader(self):
+        from transferia_tpu.providers.s3readers import make_reader
+
+        return make_reader(
+            self.format, nginx_format=self.nginx_format,
+            unparsed_policy=self.unparsed_policy,
+            parser_config=self.parser,
+        )
 
 
 @register_endpoint
@@ -109,6 +131,7 @@ class S3Storage(Storage, ShardingStorage):
         self._schema: Optional[TableSchema] = None
         self._fs = None
         self._files: Optional[list[str]] = None
+        self._reader = None
 
     @property
     def fs(self):
@@ -134,37 +157,17 @@ class S3Storage(Storage, ShardingStorage):
             self._files = found
         return self._files
 
+    @property
+    def reader(self):
+        if self._reader is None:
+            self._reader = self.params.make_reader()
+        return self._reader
+
     # -- schema inference (reader/abstract.go:40-52) ------------------------
     def table_schema(self, table: TableID) -> TableSchema:
         if self._schema is None:
-            f = self.files()[0]
-            if self.params.format == "parquet":
-                import pyarrow.parquet as pq
-
-                with self.fs.open(f, "rb") as fh:
-                    self._schema = arrow_to_table_schema(
-                        pq.read_schema(fh)
-                    )
-            elif self.params.format == "csv":
-                import pyarrow.csv as pacsv
-
-                with self.fs.open(f, "rb") as fh:
-                    head = fh.read(1 << 20)
-                with pacsv.open_csv(io.BytesIO(head)) as reader:
-                    self._schema = arrow_to_table_schema(reader.schema)
-            else:
-                import pyarrow as pa
-
-                rows = []
-                with self.fs.open(f, "rb") as fh:
-                    for i, line in enumerate(fh):
-                        if i >= 100:
-                            break
-                        if line.strip():
-                            rows.append(json.loads(line))
-                self._schema = arrow_to_table_schema(
-                    pa.Table.from_pylist(rows).schema
-                )
+            self._schema = self.reader.infer_schema(
+                self.fs, self.files()[0])
         return self._schema
 
     def table_list(self, include=None):
@@ -173,11 +176,8 @@ class S3Storage(Storage, ShardingStorage):
             return {}
         eta = 0
         if self.params.format == "parquet":
-            import pyarrow.parquet as pq
-
             for f in self.files():
-                with self.fs.open(f, "rb") as fh:
-                    eta += pq.ParquetFile(fh).metadata.num_rows
+                eta += self.reader.estimate_rows(self.fs, f)
         return {self.table: TableInfo(
             eta_rows=eta, schema=self.table_schema(self.table)
         )}
@@ -191,10 +191,7 @@ class S3Storage(Storage, ShardingStorage):
         for f in self.files():
             eta = 0
             if self.params.format == "parquet":
-                import pyarrow.parquet as pq
-
-                with self.fs.open(f, "rb") as fh:
-                    eta = pq.ParquetFile(fh).metadata.num_rows
+                eta = self.reader.estimate_rows(self.fs, f)
             out.append(TableDescription(id=table.id, filter=f"obj:{f}",
                                         eta_rows=eta))
         return out
@@ -204,54 +201,8 @@ class S3Storage(Storage, ShardingStorage):
             else self.files()
         schema = self.table_schema(table.id)
         for f in files:
-            self._load_object(f, table.id, schema, pusher)
-
-    def _load_object(self, path: str, tid: TableID, schema: TableSchema,
-                     pusher: Pusher) -> None:
-        fmt = self.params.format
-        if fmt == "parquet":
-            import pyarrow.parquet as pq
-
-            with self.fs.open(path, "rb") as fh:
-                pf = pq.ParquetFile(fh)
-                for rb in pf.iter_batches(
-                        batch_size=self.params.batch_rows):
-                    if rb.num_rows:
-                        batch = ColumnBatch.from_arrow(rb, tid, schema)
-                        batch.read_bytes = rb.nbytes
-                        pusher(batch)
-        elif fmt == "csv":
-            import pyarrow.csv as pacsv
-
-            with self.fs.open(path, "rb") as fh:
-                data = fh.read()
-            with pacsv.open_csv(io.BytesIO(data)) as reader:
-                for rb in reader:
-                    if rb.num_rows:
-                        batch = ColumnBatch.from_arrow(rb, tid, schema)
-                        batch.read_bytes = rb.nbytes
-                        pusher(batch)
-        else:  # jsonl
-            rows = []
-            nbytes = 0
-            with self.fs.open(path, "rb") as fh:
-                for line in fh:
-                    if not line.strip():
-                        continue
-                    rows.append(json.loads(line))
-                    nbytes += len(line)
-                    if len(rows) >= self.params.batch_rows:
-                        self._push_rows(rows, nbytes, tid, schema, pusher)
-                        rows, nbytes = [], 0
-            if rows:
-                self._push_rows(rows, nbytes, tid, schema, pusher)
-
-    @staticmethod
-    def _push_rows(rows, nbytes, tid, schema, pusher):
-        data = {c.name: [r.get(c.name) for r in rows] for c in schema}
-        batch = ColumnBatch.from_pydict(tid, schema, data)
-        batch.read_bytes = nbytes
-        pusher(batch)
+            self.reader.read(self.fs, f, table.id, schema,
+                             self.params.batch_rows, pusher)
 
     def ping(self) -> None:
         self.files()
@@ -345,6 +296,17 @@ class S3Provider(Provider):
     def sinker(self):
         if isinstance(self.transfer.dst, S3TargetParams):
             return S3Sinker(self.transfer.dst)
+        return None
+
+    def source(self):
+        if isinstance(self.transfer.src, S3SourceParams) \
+                and self.transfer.src.event_source:
+            from transferia_tpu.providers.s3source import (
+                S3ReplicationSource,
+            )
+
+            return S3ReplicationSource(
+                self.transfer.src, self.transfer.id, self.coordinator)
         return None
 
     def test(self) -> TestResult:

@@ -1,0 +1,429 @@
+"""S3 object format readers (reference: pkg/providers/s3/reader/registry/
+— csv/json/line/nginx/parquet/proto with schema inference,
+reader/abstract.go:40-52).
+
+A Reader turns one object into ColumnBatches.  Formats:
+  parquet — arrow row groups straight to columnar (zero pivot)
+  csv     — arrow CSV with inferred schema
+  jsonl   — newline-delimited JSON, schema inferred from a sample
+  line    — each line one row (utf8) + system columns
+  nginx   — nginx log_format template parsing ($var tokens), typed fields
+  proto   — varint length-prefixed protobuf frames through the protobuf
+            parser plugin (descriptor config)
+
+line/nginx add the reference's system columns __file_name/__row_index
+(reader/abstract.go:16-17, AppendSystemColsTableSchema) as primary keys so
+replicated rows stay addressable.  Parse failures follow unparsed_policy:
+"route" sends them to the parsers' `_unparsed` system table
+(pkg/parsers/utils.go:145), "skip" drops them counted, "fail" raises.
+"""
+
+from __future__ import annotations
+
+import abc
+import io
+import json
+import logging
+import re
+from typing import Callable, Iterable, Optional
+
+from transferia_tpu.abstract.errors import CategorizedError
+from transferia_tpu.abstract.schema import (
+    CanonicalType,
+    ColSchema,
+    TableID,
+    TableSchema,
+)
+from transferia_tpu.columnar.batch import ColumnBatch, arrow_to_table_schema
+from transferia_tpu.parsers.base import Message, unparsed_batch
+
+logger = logging.getLogger(__name__)
+
+FILE_NAME_COL = "__file_name"
+ROW_INDEX_COL = "__row_index"
+
+Pusher = Callable[[ColumnBatch], None]
+
+
+class ReaderError(CategorizedError):
+    def __init__(self, message: str):
+        super().__init__(CategorizedError.SOURCE, message)
+
+
+class Reader(abc.ABC):
+    """One-object reader; fs is an fsspec filesystem."""
+
+    @abc.abstractmethod
+    def infer_schema(self, fs, path: str) -> TableSchema:
+        ...
+
+    @abc.abstractmethod
+    def read(self, fs, path: str, tid: TableID, schema: TableSchema,
+             batch_rows: int, pusher: Pusher) -> None:
+        ...
+
+    def estimate_rows(self, fs, path: str) -> int:
+        return 0
+
+
+def _system_cols() -> list[ColSchema]:
+    return [
+        ColSchema(FILE_NAME_COL, CanonicalType.UTF8, primary_key=True),
+        ColSchema(ROW_INDEX_COL, CanonicalType.INT64, primary_key=True),
+    ]
+
+
+class ParquetReader(Reader):
+    def infer_schema(self, fs, path: str) -> TableSchema:
+        import pyarrow.parquet as pq
+
+        with fs.open(path, "rb") as fh:
+            return arrow_to_table_schema(pq.read_schema(fh))
+
+    def estimate_rows(self, fs, path: str) -> int:
+        import pyarrow.parquet as pq
+
+        with fs.open(path, "rb") as fh:
+            return pq.ParquetFile(fh).metadata.num_rows
+
+    def read(self, fs, path, tid, schema, batch_rows, pusher) -> None:
+        import pyarrow.parquet as pq
+
+        with fs.open(path, "rb") as fh:
+            pf = pq.ParquetFile(fh)
+            for rb in pf.iter_batches(batch_size=batch_rows):
+                if rb.num_rows:
+                    batch = ColumnBatch.from_arrow(rb, tid, schema)
+                    batch.read_bytes = rb.nbytes
+                    pusher(batch)
+
+
+class CsvReader(Reader):
+    def infer_schema(self, fs, path: str) -> TableSchema:
+        import pyarrow.csv as pacsv
+
+        with fs.open(path, "rb") as fh:
+            head = fh.read(1 << 20)
+        with pacsv.open_csv(io.BytesIO(head)) as reader:
+            return arrow_to_table_schema(reader.schema)
+
+    def read(self, fs, path, tid, schema, batch_rows, pusher) -> None:
+        import pyarrow.csv as pacsv
+
+        with fs.open(path, "rb") as fh:
+            data = fh.read()
+        with pacsv.open_csv(io.BytesIO(data)) as reader:
+            for rb in reader:
+                if rb.num_rows:
+                    batch = ColumnBatch.from_arrow(rb, tid, schema)
+                    batch.read_bytes = rb.nbytes
+                    pusher(batch)
+
+
+class JsonlReader(Reader):
+    def infer_schema(self, fs, path: str) -> TableSchema:
+        import pyarrow as pa
+
+        rows = []
+        with fs.open(path, "rb") as fh:
+            for i, line in enumerate(fh):
+                if i >= 100:
+                    break
+                if line.strip():
+                    rows.append(json.loads(line))
+        return arrow_to_table_schema(pa.Table.from_pylist(rows).schema)
+
+    def read(self, fs, path, tid, schema, batch_rows, pusher) -> None:
+        rows: list[dict] = []
+        nbytes = 0
+        with fs.open(path, "rb") as fh:
+            for line in fh:
+                if not line.strip():
+                    continue
+                rows.append(json.loads(line))
+                nbytes += len(line)
+                if len(rows) >= batch_rows:
+                    self._push(rows, nbytes, tid, schema, pusher)
+                    rows, nbytes = [], 0
+        if rows:
+            self._push(rows, nbytes, tid, schema, pusher)
+
+    @staticmethod
+    def _push(rows, nbytes, tid, schema, pusher):
+        data = {c.name: [r.get(c.name) for r in rows] for c in schema}
+        batch = ColumnBatch.from_pydict(tid, schema, data)
+        batch.read_bytes = nbytes
+        pusher(batch)
+
+
+class LineReader(Reader):
+    """Each line one row (registry/line): `line` utf8 + system columns."""
+
+    SCHEMA = TableSchema([ColSchema("line", CanonicalType.UTF8)]
+                         + _system_cols())
+
+    def infer_schema(self, fs, path: str) -> TableSchema:
+        return self.SCHEMA
+
+    def read(self, fs, path, tid, schema, batch_rows, pusher) -> None:
+        lines: list[str] = []
+        idx0 = 0
+        nbytes = 0
+        row = 0
+        with fs.open(path, "rb") as fh:
+            for raw in fh:
+                text = raw.decode("utf-8", errors="replace").rstrip("\r\n")
+                if not text.strip():
+                    row += 1
+                    continue
+                if not lines:
+                    idx0 = row
+                lines.append(text)
+                nbytes += len(raw)
+                row += 1
+                if len(lines) >= batch_rows:
+                    self._push(lines, idx0, nbytes, path, tid, pusher)
+                    lines, nbytes = [], 0
+        if lines:
+            self._push(lines, idx0, nbytes, path, tid, pusher)
+
+    def _push(self, lines, idx0, nbytes, path, tid, pusher):
+        # row indices are per-pushed-row dense from the first line of the
+        # buffer; blank lines advance the file row counter but aren't rows
+        batch = ColumnBatch.from_pydict(tid, self.SCHEMA, {
+            "line": lines,
+            FILE_NAME_COL: [path] * len(lines),
+            ROW_INDEX_COL: list(range(idx0, idx0 + len(lines))),
+        })
+        batch.read_bytes = nbytes
+        pusher(batch)
+
+
+# default combined log format (nginx docs)
+NGINX_COMBINED = (
+    '$remote_addr - $remote_user [$time_local] "$request" '
+    '$status $body_bytes_sent "$http_referer" "$http_user_agent"'
+)
+
+_NGINX_INT = {"status", "body_bytes_sent", "bytes_sent", "request_length",
+              "connection", "connection_requests", "content_length"}
+_NGINX_FLOAT = {"request_time", "upstream_response_time", "msec",
+                "upstream_connect_time", "upstream_header_time"}
+_VAR_RE = re.compile(r"\$([A-Za-z0-9_]+)")
+
+
+class NginxReader(Reader):
+    """nginx log_format template parser (registry/nginx): literals match
+    exactly, variables capture up to the next literal."""
+
+    def __init__(self, log_format: str = "",
+                 unparsed_policy: str = "route"):
+        fmt = (log_format or NGINX_COMBINED).strip()
+        fmt = re.sub(r"[ \t]*\n[ \t]*", " ", fmt)
+        self.tokens: list[tuple[bool, str]] = []
+        last = 0
+        for m in _VAR_RE.finditer(fmt):
+            if m.start() > last:
+                self.tokens.append((False, fmt[last:m.start()]))
+            self.tokens.append((True, m.group(1)))
+            last = m.end()
+        if last < len(fmt):
+            self.tokens.append((False, fmt[last:]))
+        self.fields = [v for is_var, v in self.tokens if is_var]
+        if not self.fields:
+            raise ReaderError(f"nginx format has no variables: {fmt!r}")
+        self.unparsed_policy = unparsed_policy
+        cols = []
+        for f in self.fields:
+            if f in _NGINX_INT:
+                t = CanonicalType.INT64
+            elif f in _NGINX_FLOAT:
+                t = CanonicalType.DOUBLE
+            else:
+                t = CanonicalType.UTF8
+            cols.append(ColSchema(f, t))
+        self.schema = TableSchema(cols + _system_cols())
+
+    def infer_schema(self, fs, path: str) -> TableSchema:
+        return self.schema
+
+    def parse_line(self, line: str) -> Optional[list]:
+        values: list = []
+        pos = 0
+        n = len(self.tokens)
+        for i, (is_var, val) in enumerate(self.tokens):
+            if not is_var:
+                lit = val
+                if line.startswith(lit, pos):
+                    pos += len(lit)
+                elif i == 0 and line.startswith(lit.lstrip(), pos):
+                    pos += len(lit.lstrip())
+                else:
+                    return None
+                continue
+            # variable: capture up to the next literal (or line end)
+            if i + 1 < n and not self.tokens[i + 1][0]:
+                nxt = self.tokens[i + 1][1]
+                end = line.find(nxt, pos)
+                if end < 0:
+                    return None
+            else:
+                end = len(line)
+            values.append(line[pos:end])
+            pos = end
+        out: list = []
+        for f, raw in zip(self.fields, values):
+            if f in _NGINX_INT:
+                try:
+                    out.append(int(raw))
+                except ValueError:
+                    out.append(None)
+            elif f in _NGINX_FLOAT:
+                try:
+                    out.append(float(raw))
+                except ValueError:
+                    out.append(None)  # e.g. '-' for upstream times
+            else:
+                out.append(raw)
+        return out
+
+    def read(self, fs, path, tid, schema, batch_rows, pusher) -> None:
+        good: list[list] = []
+        good_idx: list[int] = []
+        bad: list[Message] = []
+        reasons: list[str] = []
+        nbytes = 0
+        with fs.open(path, "rb") as fh:
+            for row, raw in enumerate(fh):
+                text = raw.decode("utf-8",
+                                  errors="replace").rstrip("\r\n")
+                if not text.strip():
+                    continue
+                nbytes += len(raw)
+                vals = self.parse_line(text)
+                if vals is None:
+                    if self.unparsed_policy == "fail":
+                        raise ReaderError(
+                            f"nginx parse failed at {path}:{row}: "
+                            f"{text[:200]!r}")
+                    if self.unparsed_policy == "route":
+                        bad.append(Message(value=raw, topic=path,
+                                           offset=row))
+                        reasons.append("nginx format mismatch")
+                        if len(bad) >= batch_rows:
+                            # flush: a fully-mismatched multi-GB log must
+                            # not accumulate in memory
+                            pusher(unparsed_batch(bad, reasons))
+                            bad, reasons = [], []
+                    continue
+                good.append(vals)
+                good_idx.append(row)
+                if len(good) >= batch_rows:
+                    self._push(good, good_idx, nbytes, path, tid, pusher)
+                    good, good_idx, nbytes = [], [], 0
+        if good:
+            self._push(good, good_idx, nbytes, path, tid, pusher)
+        if bad:
+            pusher(unparsed_batch(bad, reasons))
+
+    def _push(self, rows, idx, nbytes, path, tid, pusher):
+        data = {f: [r[i] for r in rows]
+                for i, f in enumerate(self.fields)}
+        data[FILE_NAME_COL] = [path] * len(rows)
+        data[ROW_INDEX_COL] = idx
+        batch = ColumnBatch.from_pydict(tid, self.schema, data)
+        batch.read_bytes = nbytes
+        pusher(batch)
+
+
+class ProtoReader(Reader):
+    """Varint length-prefixed protobuf frames (registry/proto) decoded by
+    the protobuf parser plugin (descriptor config in `parser`)."""
+
+    def __init__(self, parser_config: dict):
+        from transferia_tpu.parsers import make_parser
+
+        if not parser_config or "protobuf" not in parser_config:
+            raise ReaderError(
+                "proto format needs a {'protobuf': {...}} parser config")
+        self.parser = make_parser(parser_config)
+
+    def infer_schema(self, fs, path: str) -> TableSchema:
+        schema = self.parser.result_schema()
+        if schema is not None:
+            return schema
+        # sample the first frames of the object (reader/abstract.go:40-52)
+        with fs.open(path, "rb") as fh:
+            data = fh.read(1 << 20)
+        msgs: list[Message] = []
+        try:
+            for idx, frame in self._frames(data):
+                msgs.append(Message(value=frame, topic=path, offset=idx))
+                if len(msgs) >= 100:
+                    break
+        except ReaderError:
+            pass  # truncated tail of the sample window
+        result = self.parser.do_batch(msgs)
+        if result.batches:
+            return result.batches[0].schema
+        raise ReaderError(f"could not infer proto schema from {path}")
+
+    @staticmethod
+    def _frames(data: bytes) -> Iterable[tuple[int, bytes]]:
+        pos, n, idx = 0, len(data), 0
+        while pos < n:
+            shift, length = 0, 0
+            while True:
+                if pos >= n:
+                    raise ReaderError("truncated varint length prefix")
+                b = data[pos]
+                pos += 1
+                length |= (b & 0x7F) << shift
+                if not (b & 0x80):
+                    break
+                shift += 7
+                if shift > 63:
+                    raise ReaderError("varint length prefix overflow")
+            if pos + length > n:
+                raise ReaderError("truncated protobuf frame")
+            yield idx, data[pos:pos + length]
+            pos += length
+            idx += 1
+
+    def read(self, fs, path, tid, schema, batch_rows, pusher) -> None:
+        with fs.open(path, "rb") as fh:
+            data = fh.read()
+        msgs: list[Message] = []
+        for idx, frame in self._frames(data):
+            msgs.append(Message(value=frame, topic=path, offset=idx))
+            if len(msgs) >= batch_rows:
+                self._flush(msgs, tid, pusher)
+                msgs = []
+        if msgs:
+            self._flush(msgs, tid, pusher)
+
+    def _flush(self, msgs, tid, pusher):
+        result = self.parser.do_batch(msgs)
+        for b in result.batches:
+            pusher(b.rename_table(tid))
+        if result.unparsed is not None and result.unparsed.n_rows:
+            pusher(result.unparsed)
+
+
+def make_reader(fmt: str, *, nginx_format: str = "",
+                unparsed_policy: str = "route",
+                parser_config: Optional[dict] = None) -> Reader:
+    if fmt == "parquet":
+        return ParquetReader()
+    if fmt == "csv":
+        return CsvReader()
+    if fmt == "jsonl":
+        return JsonlReader()
+    if fmt == "line":
+        return LineReader()
+    if fmt == "nginx":
+        return NginxReader(nginx_format, unparsed_policy)
+    if fmt == "proto":
+        return ProtoReader(parser_config or {})
+    raise ReaderError(f"unknown s3 format {fmt!r} (parquet/csv/jsonl/"
+                      f"line/nginx/proto)")

@@ -1,0 +1,336 @@
+"""S3 replication source: new-object events -> reader -> async sink.
+
+Reference parity: pkg/providers/s3/source/ (S3Source over an
+ObjectFetcher) + s3util/object_fetcher/ — two fetch strategies:
+
+  sqs  — S3 bucket notifications through an SQS queue (JSON protocol,
+         SigV4 via utils/awssign); creation events are unwrapped (plain or
+         SNS-enveloped), filtered by path pattern, and their messages are
+         deleted only AFTER the object's rows are durably pushed
+         (at-least-once, object_fetcher_sqs.go).
+  poll — periodic listing with a (mtime, name) watermark persisted in the
+         coordinator transfer-state KV (object_fetcher_poller.go).
+
+Objects decode through the same reader registry as snapshots
+(providers/s3readers.py) so every format — including line/nginx/proto —
+replicates.
+"""
+
+from __future__ import annotations
+
+import fnmatch
+import json
+import logging
+import re
+import threading
+import time
+import urllib.parse
+from typing import Optional
+
+from transferia_tpu.abstract.errors import CategorizedError
+from transferia_tpu.abstract.interfaces import AsyncSink, Source
+from transferia_tpu.abstract.schema import TableID, TableSchema
+from transferia_tpu.coordinator.interface import Coordinator
+
+logger = logging.getLogger(__name__)
+
+
+class S3SourceError(CategorizedError):
+    def __init__(self, message: str):
+        super().__init__(CategorizedError.SOURCE, message)
+
+
+class SQSClient:
+    """Minimal SQS client (JSON protocol, AmazonSQS.* targets)."""
+
+    def __init__(self, queue_url: str, region: str = "us-east-1",
+                 access_key: str = "", secret_key: str = "",
+                 endpoint: str = "", timeout: float = 70.0):
+        import http.client  # noqa: F401 - used in call()
+
+        self.queue_url = queue_url
+        self.region = region
+        self.access_key = access_key
+        self.secret_key = secret_key
+        self.timeout = timeout
+        base = endpoint or queue_url
+        parsed = urllib.parse.urlparse(base)
+        self.host = parsed.hostname or ""
+        self.port = parsed.port or (443 if parsed.scheme == "https" else 80)
+        self.secure = parsed.scheme == "https"
+
+    def call(self, action: str, payload: dict) -> dict:
+        import http.client
+
+        from transferia_tpu.utils.awssign import sign_request
+
+        body = json.dumps(payload).encode()
+        default = 443 if self.secure else 80
+        host = self.host if self.port == default \
+            else f"{self.host}:{self.port}"
+        headers = sign_request(
+            "POST", host, "/", {}, {
+                "content-type": "application/x-amz-json-1.0",
+                "x-amz-target": f"AmazonSQS.{action}",
+            }, body, self.region, "sqs", self.access_key, self.secret_key,
+        )
+        cls = (http.client.HTTPSConnection if self.secure
+               else http.client.HTTPConnection)
+        conn = cls(self.host, self.port, timeout=self.timeout)
+        try:
+            conn.request("POST", "/", body=body, headers=headers)
+            resp = conn.getresponse()
+            data = resp.read()
+            try:
+                obj = json.loads(data) if data else {}
+            except ValueError:
+                obj = {"message": data[:200].decode("utf-8", "replace")}
+            if resp.status != 200:
+                raise S3SourceError(
+                    f"sqs {action}: {obj.get('message', resp.status)}")
+            return obj
+        finally:
+            conn.close()
+
+    def receive(self, max_messages: int = 10,
+                wait_seconds: int = 10) -> list[dict]:
+        out = self.call("ReceiveMessage", {
+            "QueueUrl": self.queue_url,
+            "MaxNumberOfMessages": max_messages,
+            "WaitTimeSeconds": wait_seconds,
+            "VisibilityTimeout": 600,
+        })
+        return out.get("Messages", []) or []
+
+    def delete(self, receipt_handle: str) -> None:
+        self.call("DeleteMessage", {
+            "QueueUrl": self.queue_url,
+            "ReceiptHandle": receipt_handle,
+        })
+
+
+class SQSObjectFetcher:
+    """S3 event notifications via SQS (object_fetcher_sqs.go)."""
+
+    def __init__(self, params, prefix: str = ""):
+        self.client = SQSClient(
+            params.sqs_queue_url, region=params.sqs_region,
+            access_key=params.sqs_access_key,
+            secret_key=params.sqs_secret_key,
+            endpoint=params.sqs_endpoint,
+        )
+        self.wait_seconds = params.sqs_wait_seconds
+        self.pattern = params.path_pattern
+        self.inflight: dict[str, str] = {}       # key -> receipt handle
+        self._pending: dict[str, set] = {}       # receipt -> pending keys
+
+    def fetch_objects(self) -> list[str]:
+        msgs = self.client.receive(wait_seconds=self.wait_seconds)
+        keys: list[str] = []
+        for m in msgs:
+            receipt = m.get("ReceiptHandle", "")
+            body = m.get("Body", "")
+            if "s3:TestEvent" in body:
+                self.client.delete(receipt)
+                continue
+            try:
+                doc = json.loads(body)
+            except ValueError:
+                self.client.delete(receipt)
+                continue
+            if not doc.get("Records") and doc.get("Message"):
+                # SNS-enveloped notification: records are one level down
+                try:
+                    doc = json.loads(doc["Message"])
+                except ValueError:
+                    self.client.delete(receipt)
+                    continue
+            matched: set[str] = set()
+            for rec in doc.get("Records", []):
+                if "ObjectCreated" not in rec.get("eventName", ""):
+                    continue
+                key = urllib.parse.unquote_plus(
+                    rec.get("s3", {}).get("object", {}).get("key", ""))
+                if not key or (self.pattern and not fnmatch.fnmatch(
+                        key, self.pattern)):
+                    continue
+                self.inflight[key] = receipt
+                matched.add(key)
+                keys.append(key)
+            if not matched:
+                # folder creation / non-matching events: drop the message
+                self.client.delete(receipt)
+            else:
+                # one message may carry several records: delete it only
+                # when EVERY key's object has been durably pushed
+                self._pending[receipt] = matched
+        return keys
+
+    def commit(self, key: str) -> None:
+        receipt = self.inflight.pop(key, None)
+        if receipt is None:
+            return
+        pending = self._pending.get(receipt)
+        if pending is not None:
+            pending.discard(key)
+            if pending:
+                return  # other records on this message still replicating
+            self._pending.pop(receipt, None)
+        self.client.delete(receipt)
+
+    def close(self) -> None:
+        pass
+
+
+class PollingObjectFetcher:
+    """Bucket listing with an (mtime, name) watermark persisted in the
+    coordinator state (object_fetcher_poller.go)."""
+
+    STATE_KEY = "s3_poll_watermark"
+
+    def __init__(self, fs, root: str, transfer_id: str,
+                 coordinator: Optional[Coordinator],
+                 pattern: str = ""):
+        self.fs = fs
+        # a glob URL (s3://bucket/prefix/*.jsonl) lists from the first
+        # wildcard-free parent and filters with the glob itself
+        if ("*" in root or "?" in root) and not pattern:
+            pattern = root
+        if "*" in root or "?" in root:
+            head = re.split(r"[*?\[]", root, 1)[0]
+            root = head.rsplit("/", 1)[0] if "/" in head else head
+        self.root = root
+        self.transfer_id = transfer_id
+        self.cp = coordinator
+        self.pattern = pattern
+        saved = {}
+        if self.cp is not None:
+            saved = self.cp.get_transfer_state(transfer_id).get(
+                self.STATE_KEY, {})
+        # watermark: highest committed mtime + every name committed AT
+        # that mtime.  (mtime, name) lexicographic alone would skip an
+        # object whose name sorts before an already-committed same-second
+        # name (S3 LastModified has 1s granularity).
+        self.wm_mtime: float = saved.get("mtime", -1.0)
+        self.wm_names: set[str] = set(saved.get("names", []))
+        self._pending: dict[str, float] = {}
+
+    def _mtime(self, info: dict) -> float:
+        m = info.get("mtime") or info.get("LastModified") or 0
+        if hasattr(m, "timestamp"):
+            return m.timestamp()
+        return float(m or 0)
+
+    def fetch_objects(self) -> list[str]:
+        if hasattr(self.fs, "invalidate_cache"):
+            # s3fs/gcsfs cache directory listings; without this, objects
+            # uploaded after the first poll are never seen
+            self.fs.invalidate_cache(self.root)
+        found = []
+        for path, info in sorted(self.fs.find(
+                self.root, detail=True).items()):
+            if info.get("type") == "directory":
+                continue
+            if self.pattern and not fnmatch.fnmatch(path, self.pattern):
+                continue
+            mtime = self._mtime(info)
+            if mtime < self.wm_mtime:
+                continue
+            if mtime == self.wm_mtime and path in self.wm_names:
+                continue
+            if path in self._pending:
+                continue
+            found.append((mtime, path))
+        found.sort()
+        out = []
+        for mtime, path in found:
+            self._pending[path] = mtime
+            out.append(path)
+        return out
+
+    def commit(self, key: str) -> None:
+        mtime = self._pending.pop(key, None)
+        if mtime is None:
+            return
+        if mtime > self.wm_mtime:
+            self.wm_mtime = mtime
+            self.wm_names = {key}
+        elif mtime == self.wm_mtime:
+            self.wm_names.add(key)
+        else:
+            return  # older than the watermark; nothing to persist
+        if self.cp is not None:
+            self.cp.set_transfer_state(self.transfer_id, {
+                self.STATE_KEY: {"mtime": self.wm_mtime,
+                                 "names": sorted(self.wm_names)},
+            })
+
+    def close(self) -> None:
+        pass
+
+
+class S3ReplicationSource(Source):
+    """Replicates newly created objects through the format reader."""
+
+    def __init__(self, params, transfer_id: str,
+                 coordinator: Optional[Coordinator] = None):
+        from transferia_tpu.providers.s3 import _fs_for
+
+        self.params = params
+        self.table = TableID(params.namespace, params.table)
+        self.fs, self.root = _fs_for(params.url, params)
+        self.reader = params.make_reader()
+        self._schema: Optional[TableSchema] = None
+        self._stop = threading.Event()
+        if params.event_source == "sqs":
+            if not params.sqs_queue_url:
+                raise S3SourceError("event_source=sqs needs sqs_queue_url")
+            self.fetcher = SQSObjectFetcher(params)
+        elif params.event_source == "poll":
+            self.fetcher = PollingObjectFetcher(
+                self.fs, self.root, transfer_id, coordinator,
+                params.path_pattern)
+        else:
+            raise S3SourceError(
+                f"unknown event_source {params.event_source!r} (sqs|poll)")
+
+    def _full_path(self, key: str) -> str:
+        # SQS keys are bucket-relative; the poller returns full paths
+        if self.fs.exists(key):
+            return key
+        bucket = self.root.split("/", 1)[0]
+        return f"{bucket}/{key}"
+
+    def run(self, sink: AsyncSink) -> None:
+        while not self._stop.is_set():
+            keys = self.fetcher.fetch_objects()
+            if not keys:
+                if isinstance(self.fetcher, PollingObjectFetcher):
+                    self._stop.wait(self.params.poll_interval)
+                continue
+            for key in keys:
+                if self._stop.is_set():
+                    break
+                self._replicate_object(key, sink)
+        self.fetcher.close()
+
+    def _replicate_object(self, key: str, sink: AsyncSink) -> None:
+        path = self._full_path(key)
+        if self._schema is None:
+            self._schema = self.reader.infer_schema(self.fs, path)
+        futures = []
+
+        def pusher(batch):
+            futures.append(sink.async_push(batch))
+
+        t0 = time.monotonic()
+        self.reader.read(self.fs, path, self.table, self._schema,
+                         self.params.batch_rows, pusher)
+        for f in futures:
+            f.result()  # at-least-once: commit only after durable push
+        self.fetcher.commit(key)
+        logger.info("s3 replicated %s in %.2fs (%d pushes)",
+                    path, time.monotonic() - t0, len(futures))
+
+    def stop(self) -> None:
+        self._stop.set()
