@@ -48,19 +48,37 @@ def farm():
     mg = FakeMongo().start()
     mg.seed("db", "src_t", [{"_id": f"k{i:02d}", "v": i}
                             for i in range(ROWS)])
+    from tests.recipes.ydb_pb import load_pb
+
+    ydb = None
+    if load_pb() is not None:
+        try:
+            from tests.recipes.fake_ydb import FakeYDB
+
+            ydb = FakeYDB(database="/local").start()
+            ydb.add_table(
+                "db/src_t", [("id", "Int64"), ("v", "Utf8")], ["id"],
+                [{"id": i, "v": f"v{i}"} for i in range(ROWS)],
+            )
+        except ImportError:  # grpcio/protobuf absent: skip ydb pairs only
+            ydb = None
+
     import tempfile
 
     s3dir = tempfile.mkdtemp(prefix="matrix_s3_")
     with open(f"{s3dir}/src.log", "w") as fh:
         for i in range(ROWS):
             fh.write(f"line-{i}\n")
-    yield {"pg": pg, "mysql": my, "mongo": mg, "s3dir": s3dir}
+    yield {"pg": pg, "mysql": my, "mongo": mg, "s3dir": s3dir,
+           "ydb": ydb}
     for srv in (pg, my, mg):
         srv.stop()
+    if ydb is not None:
+        ydb.stop()
 
 
-SOURCES = ["sample", "pg", "mysql", "mongo", "s3line"]
-SINKS = ["ch", "pg", "mysql", "fs", "memory"]
+SOURCES = ["sample", "pg", "mysql", "mongo", "s3line", "ydb"]
+SINKS = ["ch", "pg", "mysql", "fs", "memory", "ydb"]
 
 
 def _source(name, farm):
@@ -79,6 +97,15 @@ def _source(name, farm):
 
         return S3SourceParams(url=f"file://{farm['s3dir']}/*.log",
                               format="line", table="src_t")
+    if name == "ydb":
+        import pytest as _pytest
+
+        from transferia_tpu.providers.ydb import YdbSourceParams
+
+        if farm["ydb"] is None:
+            _pytest.skip("protoc unavailable for the ydb fake")
+        return YdbSourceParams(endpoint=farm["ydb"].endpoint,
+                               database="/local", tables=["db/src_t"])
     return MongoSourceParams(host="127.0.0.1", port=farm["mongo"].port,
                              database="db")
 
@@ -123,6 +150,26 @@ def _sink(name):
             )
 
         return FileTargetParams(path=d, format="parquet"), count, None
+    if name == "ydb":
+        import pytest as _pytest
+
+        from tests.recipes.ydb_pb import load_pb
+
+        if load_pb() is None:
+            _pytest.skip("protoc unavailable for the ydb fake")
+        from transferia_tpu.providers.ydb import YdbTargetParams
+
+        try:
+            from tests.recipes.fake_ydb import FakeYDB
+
+            srv = FakeYDB(database="/dw").start()
+        except ImportError:
+            _pytest.skip("grpcio unavailable for the ydb fake")
+        return (
+            YdbTargetParams(endpoint=srv.endpoint, database="/dw"),
+            lambda: sum(len(t.rows) for t in srv.tables.values()),
+            srv.stop,
+        )
     store = get_store("matrix_e2e")
     store.clear()
     return (MemoryTargetParams(sink_id="matrix_e2e"),

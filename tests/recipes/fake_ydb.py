@@ -1,0 +1,569 @@
+"""In-process YDB fake: grpcio server speaking the API subset.
+
+Implements table-service sessions, a small YQL evaluator covering the
+query shapes the provider emits (paged SELECT with keyset cursors,
+MIN/MAX, DELETE, CREATE/DROP TABLE), BulkUpsert, DescribeTable,
+ListDirectory, and changefeed topics over StreamRead with per-consumer
+committed offsets (redelivery on uncommitted reads).
+
+Requests are decoded with protoc-generated code from
+tests/recipes/ydb_protos/ydb_subset.proto — an independent parser from
+the client's hand codec, so wire-format misunderstandings fail loudly in
+e2e instead of passing both self-consistent sides.
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import re
+import threading
+import time
+from typing import Any, Optional
+
+from tests.recipes.ydb_pb import load_pb
+
+
+class FakeTable:
+    def __init__(self, path: str, columns: list[tuple[str, str]],
+                 primary_key: list[str]):
+        self.path = path
+        self.columns = columns        # [(name, ydb type name)]
+        self.primary_key = primary_key
+        self.rows: dict[tuple, dict] = {}
+        self.changefeed_events: "queue.Queue[bytes]" = queue.Queue()
+        self.feed_log: list[bytes] = []   # retained for redelivery
+
+    def key_of(self, row: dict) -> tuple:
+        return tuple(row.get(k) for k in self.primary_key)
+
+    def upsert(self, row: dict, emit_cdc: bool = True) -> None:
+        key = self.key_of(row)
+        self.rows[key] = dict(row)
+        if emit_cdc:
+            ev = {"key": _cdc_json(list(key)),
+                  "ts": [int(time.time()), len(self.feed_log)],
+                  "update": _cdc_json({
+                      k: v for k, v in row.items()
+                      if k not in self.primary_key
+                  })}
+            self.feed_log.append(json.dumps(ev).encode())
+
+    def erase(self, key: tuple) -> None:
+        self.rows.pop(key, None)
+        ev = {"key": list(key), "erase": {},
+              "ts": [int(time.time()), len(self.feed_log)]}
+        self.feed_log.append(json.dumps(ev).encode())
+
+
+def _cdc_json(v):
+    """YDB changefeed JSON encodes String (bytes) values as base64."""
+    import base64
+
+    if isinstance(v, bytes):
+        return base64.b64encode(v).decode()
+    if isinstance(v, list):
+        return [_cdc_json(x) for x in v]
+    if isinstance(v, dict):
+        return {k: _cdc_json(x) for k, x in v.items()}
+    return v
+
+
+_TYPE_IDS = {
+    "Bool": "BOOL", "Int8": "INT8", "Int16": "INT16", "Int32": "INT32",
+    "Int64": "INT64", "Uint8": "UINT8", "Uint16": "UINT16",
+    "Uint32": "UINT32", "Uint64": "UINT64", "Float": "FLOAT",
+    "Double": "DOUBLE", "String": "STRING", "Utf8": "UTF8",
+    "Json": "JSON", "JsonDocument": "JSON_DOCUMENT", "Date": "DATE",
+    "Datetime": "DATETIME", "Timestamp": "TIMESTAMP",
+    "Interval": "INTERVAL",
+}
+
+
+class FakeYDB:
+    def __init__(self, database: str = "/local"):
+        self.pb = load_pb()
+        if self.pb is None:
+            raise RuntimeError("protoc unavailable for the YDB fake")
+        self.database = database.rstrip("/")
+        self.tables: dict[str, FakeTable] = {}
+        self.consumer_offsets: dict[tuple[str, str], int] = {}
+        self.lock = threading.RLock()
+        self.port = 0
+        self._server = None
+        self.queries: list[str] = []
+
+    # -- data helpers -------------------------------------------------------
+    def add_table(self, name: str, columns: list[tuple[str, str]],
+                  primary_key: list[str],
+                  rows: Optional[list[dict]] = None) -> FakeTable:
+        t = FakeTable(name, columns, primary_key)
+        for r in rows or []:
+            t.upsert(r, emit_cdc=False)
+        with self.lock:
+            self.tables[name] = t
+        return t
+
+    def _resolve(self, path: str) -> Optional[FakeTable]:
+        rel = path
+        if rel.startswith(self.database + "/"):
+            rel = rel[len(self.database) + 1:]
+        rel = rel.strip("/")
+        return self.tables.get(rel)
+
+    # -- type helpers -------------------------------------------------------
+    def _pb_type(self, ydb_name: str):
+        t = self.pb.Type()
+        t.optional_type.item.type_id = getattr(
+            self.pb, _TYPE_IDS.get(ydb_name, "UTF8"))
+        return t
+
+    def _pb_value(self, ydb_name: str, v):
+        val = self.pb.Value()
+        if v is None:
+            val.null_flag_value = 0
+            return val
+        if ydb_name == "Bool":
+            val.bool_value = bool(v)
+        elif ydb_name in ("Int8", "Int16", "Int32"):
+            val.int32_value = int(v)
+        elif ydb_name in ("Uint8", "Uint16", "Uint32", "Date",
+                          "Datetime"):
+            val.uint32_value = int(v)
+        elif ydb_name in ("Int64", "Interval"):
+            val.int64_value = int(v)
+        elif ydb_name in ("Uint64", "Timestamp"):
+            val.uint64_value = int(v)
+        elif ydb_name == "Float":
+            val.float_value = float(v)
+        elif ydb_name == "Double":
+            val.double_value = float(v)
+        elif ydb_name == "String":
+            val.bytes_value = v if isinstance(v, bytes) else \
+                str(v).encode()
+        else:
+            val.text_value = v if isinstance(v, str) else str(v)
+        return val
+
+    # -- YQL evaluator (the provider's query shapes only) -------------------
+    def run_yql(self, yql: str):
+        self.queries.append(yql)
+        yql = yql.strip()
+        m = re.match(r"SELECT MIN\(`(.+?)`\) AS lo, MAX\(`(.+?)`\) AS hi "
+                     r"FROM `(.+?)`", yql)
+        if m:
+            k, _, path = m.groups()
+            t = self._resolve(path)
+            vals = [r.get(k) for r in t.rows.values()] if t else []
+            vals = [v for v in vals if v is not None]
+            lo = min(vals) if vals else None
+            hi = max(vals) if vals else None
+            ktype = dict(t.columns).get(k, "Int64") if t else "Int64"
+            return [("lo", ktype, [lo]), ("hi", ktype, [hi])], 1
+        m = re.match(r"SELECT (.+?) FROM `(.+?)`(.*)$", yql, re.DOTALL)
+        if m:
+            cols_s, path, rest = m.groups()
+            t = self._resolve(path)
+            if t is None:
+                raise ValueError(f"no such table {path}")
+            names = [c.strip().strip("`") for c in cols_s.split(",")]
+            rows = list(t.rows.values())
+            rest = rest.strip()
+            wm = re.match(r"WHERE (.*?)(ORDER BY .*)?$", rest, re.DOTALL)
+            if wm and wm.group(1).strip():
+                cond = wm.group(1).strip()
+                rows = [r for r in rows if _eval_where(cond, r)]
+            om = re.search(r"ORDER BY (.+?)( LIMIT (\d+))?$", rest,
+                           re.DOTALL)
+            if om:
+                order = [c.strip().strip("`")
+                         for c in om.group(1).split(",")]
+                rows.sort(key=lambda r: tuple(r.get(k) for k in order))
+                if om.group(3):
+                    rows = rows[:int(om.group(3))]
+            types = dict(t.columns)
+            return ([(n, types.get(n, "Utf8"),
+                      [r.get(n) for r in rows]) for n in names],
+                    len(rows))
+        m = re.match(r"DELETE FROM `(.+?)`(?: WHERE (.*))?$", yql,
+                     re.DOTALL)
+        if m:
+            path, cond = m.groups()
+            t = self._resolve(path)
+            if t is not None:
+                if cond:
+                    doomed = [k for k, r in t.rows.items()
+                              if _eval_where(cond.strip(), r)]
+                    for k in doomed:
+                        t.rows.pop(k)
+                else:
+                    t.rows.clear()
+            return [], 0
+        raise ValueError(f"fake ydb cannot evaluate: {yql[:200]}")
+
+    def run_scheme(self, yql: str) -> None:
+        self.queries.append(yql)
+        yql = yql.strip()
+        m = re.match(
+            r"CREATE TABLE (?:IF NOT EXISTS )?`(.+?)` \((.+)\)$",
+            yql, re.DOTALL)
+        if m:
+            path, body = m.groups()
+            rel = path
+            if rel.startswith(self.database + "/"):
+                rel = rel[len(self.database) + 1:]
+            pk = re.search(r"PRIMARY KEY \((.+?)\)", body)
+            keys = [k.strip().strip("`")
+                    for k in pk.group(1).split(",")] if pk else []
+            cols = []
+            for part in body[:pk.start()].rstrip(", ").split(","):
+                part = part.strip()
+                if not part:
+                    continue
+                cm = re.match(r"`(.+?)` (\w+)", part)
+                if cm:
+                    cols.append((cm.group(1), cm.group(2)))
+            with self.lock:
+                if rel not in self.tables:
+                    self.add_table(rel, cols, keys)
+            return
+        m = re.match(r"DROP TABLE `(.+?)`$", yql)
+        if m:
+            rel = m.group(1)
+            if rel.startswith(self.database + "/"):
+                rel = rel[len(self.database) + 1:]
+            with self.lock:
+                if rel not in self.tables:
+                    raise ValueError(f"no such table {rel}")
+                self.tables.pop(rel)
+            return
+        raise ValueError(f"fake ydb cannot run scheme: {yql[:200]}")
+
+    # -- grpc plumbing ------------------------------------------------------
+    def start(self) -> "FakeYDB":
+        import grpc
+        from concurrent import futures
+
+        pb = self.pb
+        fake = self
+
+        def _op_response(resp_cls, result_msg=None, status=400000,
+                         issues=()):
+            resp = resp_cls()
+            resp.operation.ready = True
+            resp.operation.status = status
+            for text in issues:
+                im = resp.operation.issues.add()
+                im.message = text
+            if result_msg is not None:
+                resp.operation.result.type_url = "type.googleapis.com/x"
+                resp.operation.result.value = \
+                    result_msg.SerializeToString()
+            return resp.SerializeToString()
+
+        def create_session(request: bytes, context):
+            return _op_response(
+                pb.CreateSessionResponse,
+                pb.CreateSessionResult(session_id="fake-session"))
+
+        def execute_data_query(request: bytes, context):
+            req = pb.ExecuteDataQueryRequest.FromString(request)
+            try:
+                with fake.lock:
+                    cols, _n = fake.run_yql(req.query.yql_text)
+            except ValueError as e:
+                return _op_response(pb.ExecuteDataQueryResponse,
+                                    status=400010, issues=[str(e)])
+            result = pb.ExecuteQueryResult()
+            rs = result.result_sets.add()
+            n_rows = len(cols[0][2]) if cols else 0
+            for name, ydb_t, _vals in cols:
+                col = rs.columns.add()
+                col.name = name
+                col.type.CopyFrom(fake._pb_type(ydb_t))
+            for i in range(n_rows):
+                row = rs.rows.add()
+                for _name, ydb_t, vals in cols:
+                    item = row.items.add()
+                    item.CopyFrom(fake._pb_value(ydb_t, vals[i]))
+            return _op_response(pb.ExecuteDataQueryResponse, result)
+
+        def execute_scheme_query(request: bytes, context):
+            req = pb.ExecuteSchemeQueryRequest.FromString(request)
+            try:
+                with fake.lock:
+                    fake.run_scheme(req.yql_text)
+            except ValueError as e:
+                return _op_response(pb.ExecuteSchemeQueryResponse,
+                                    status=400010, issues=[str(e)])
+            return _op_response(pb.ExecuteSchemeQueryResponse)
+
+        def bulk_upsert(request: bytes, context):
+            req = pb.BulkUpsertRequest.FromString(request)
+            t = fake._resolve(req.table)
+            if t is None:
+                return _op_response(pb.BulkUpsertResponse, status=400010,
+                                    issues=[f"no table {req.table}"])
+            members = [
+                (m.name, m.type)
+                for m in req.rows.type.list_type.item.struct_type.members
+            ]
+            with fake.lock:
+                for row_v in req.rows.value.items:
+                    row = {}
+                    for (name, _t), item in zip(members, row_v.items):
+                        which = item.WhichOneof("value")
+                        if which == "null_flag_value" or which is None:
+                            row[name] = None
+                        elif which == "nested_value":
+                            row[name] = None
+                        else:
+                            row[name] = getattr(item, which)
+                    t.upsert(row)
+            return _op_response(pb.BulkUpsertResponse,
+                                pb.BulkUpsertResult())
+
+        def describe_table(request: bytes, context):
+            req = pb.DescribeTableRequest.FromString(request)
+            t = fake._resolve(req.path)
+            if t is None:
+                return _op_response(pb.DescribeTableResponse,
+                                    status=400140,  # SCHEME_ERROR
+                                    issues=[f"no table {req.path}"])
+            result = pb.DescribeTableResult()
+            result.self.name = t.path.rsplit("/", 1)[-1]
+            result.self.type = 2
+            for name, ydb_t in t.columns:
+                cm = result.columns.add()
+                cm.name = name
+                cm.type.CopyFrom(fake._pb_type(ydb_t))
+            result.primary_key.extend(t.primary_key)
+            return _op_response(pb.DescribeTableResponse, result)
+
+        def list_directory(request: bytes, context):
+            req = pb.ListDirectoryRequest.FromString(request)
+            rel = req.path
+            if rel.startswith(fake.database):
+                rel = rel[len(fake.database):]
+            rel = rel.strip("/")
+            result = pb.ListDirectoryResult()
+            result.self.name = rel or "/"
+            result.self.type = 1
+            seen = set()
+            with fake.lock:
+                for path in sorted(fake.tables):
+                    if rel and not path.startswith(rel + "/"):
+                        continue
+                    tail = path[len(rel) + 1:] if rel else path
+                    head = tail.split("/", 1)[0]
+                    if head in seen:
+                        continue
+                    seen.add(head)
+                    entry = result.children.add()
+                    entry.name = head
+                    entry.type = 2 if "/" not in tail else 1
+            return _op_response(pb.ListDirectoryResponse, result)
+
+        def stream_read(request_iterator, context):
+            session = {"topic": "", "consumer": "", "sent": 0}
+            psid = 1
+            for raw in request_iterator:
+                msg = pb.StreamReadFromClient.FromString(raw)
+                which = msg.WhichOneof("client_message")
+                if which == "init_request":
+                    session["topic"] = \
+                        msg.init_request.topics_read_settings[0].path
+                    session["consumer"] = msg.init_request.consumer
+                    out = pb.StreamReadFromServer()
+                    out.init_response.session_id = "read-1"
+                    yield out.SerializeToString()
+                    start = pb.StreamReadFromServer()
+                    ps = start.start_partition_session_request \
+                        .partition_session
+                    ps.partition_session_id = psid
+                    ps.path = session["topic"]
+                    ps.partition_id = 0
+                    yield start.SerializeToString()
+                elif which == "start_partition_session_response":
+                    pass
+                elif which == "commit_offset_request":
+                    for off in (msg.commit_offset_request
+                                .commit_offsets):
+                        key = (session["topic"], session["consumer"])
+                        with fake.lock:
+                            cur = fake.consumer_offsets.get(key, 0)
+                            fake.consumer_offsets[key] = max(
+                                cur, off.offsets.end)
+                    out = pb.StreamReadFromServer()
+                    out.commit_offset_response.SetInParent()
+                    yield out.SerializeToString()
+                elif which == "read_request":
+                    # serve any uncommitted+unsent events of the feed
+                    topic = session["topic"]
+                    rel = topic
+                    if rel.startswith(fake.database + "/"):
+                        rel = rel[len(fake.database) + 1:]
+                    table_path, _feed = rel.rsplit("/", 1)
+                    t = fake.tables.get(table_path)
+                    if t is None:
+                        continue
+                    key = (topic, session["consumer"])
+                    with fake.lock:
+                        committed = fake.consumer_offsets.get(key, 0)
+                        start_off = max(committed, session["sent"])
+                        events = list(enumerate(t.feed_log))[start_off:]
+                    deadline = time.monotonic() + 0.3
+                    while not events and time.monotonic() < deadline:
+                        time.sleep(0.02)
+                        with fake.lock:
+                            committed = fake.consumer_offsets.get(key, 0)
+                            start_off = max(committed, session["sent"])
+                            events = list(enumerate(
+                                t.feed_log))[start_off:]
+                    if not events:
+                        out = pb.StreamReadFromServer()
+                        out.read_response.SetInParent()
+                        yield out.SerializeToString()
+                        continue
+                    out = pb.StreamReadFromServer()
+                    pd = out.read_response.partition_data.add()
+                    pd.partition_session_id = psid
+                    batch = pd.batches.add()
+                    for off, data in events:
+                        m = batch.messages.add()
+                        m.offset = off
+                        m.data = data
+                    session["sent"] = events[-1][0] + 1
+                    yield out.SerializeToString()
+
+        class Handler(grpc.GenericRpcHandler):
+            def service(self, handler_call_details):
+                method = handler_call_details.method
+                unary = {
+                    "/Ydb.Table.V1.TableService/CreateSession":
+                        create_session,
+                    "/Ydb.Table.V1.TableService/ExecuteDataQuery":
+                        execute_data_query,
+                    "/Ydb.Table.V1.TableService/ExecuteSchemeQuery":
+                        execute_scheme_query,
+                    "/Ydb.Table.V1.TableService/BulkUpsert": bulk_upsert,
+                    "/Ydb.Table.V1.TableService/DescribeTable":
+                        describe_table,
+                    "/Ydb.Scheme.V1.SchemeService/ListDirectory":
+                        list_directory,
+                }
+                if method in unary:
+                    fn = unary[method]
+                    return grpc.unary_unary_rpc_method_handler(
+                        fn, request_deserializer=lambda b: b,
+                        response_serializer=lambda b: b)
+                if method == "/Ydb.Topic.V1.TopicService/StreamRead":
+                    return grpc.stream_stream_rpc_method_handler(
+                        stream_read, request_deserializer=lambda b: b,
+                        response_serializer=lambda b: b)
+                return None
+
+        self._server = grpc.server(
+            futures.ThreadPoolExecutor(max_workers=8))
+        self._server.add_generic_rpc_handlers((Handler(),))
+        self.port = self._server.add_insecure_port("127.0.0.1:0")
+        self._server.start()
+        return self
+
+    @property
+    def endpoint(self) -> str:
+        return f"127.0.0.1:{self.port}"
+
+    def stop(self) -> None:
+        if self._server is not None:
+            self._server.stop(grace=0.2)
+
+
+def _eval_where(cond: str, row: dict) -> bool:
+    """Evaluate the provider's WHERE grammar: backticked idents compared
+    to literals with AND/OR and parentheses."""
+    pos = 0
+
+    def skip_ws():
+        nonlocal pos
+        while pos < len(cond) and cond[pos].isspace():
+            pos += 1
+
+    def parse_or():
+        left = parse_and()
+        while True:
+            skip_ws()
+            if cond[pos:pos + 2].upper() == "OR" and (
+                    pos + 2 >= len(cond) or not cond[pos + 2].isalnum()):
+                nonlocal_pos(2)
+                right = parse_and()
+                left = left or right
+            else:
+                return left
+
+    def nonlocal_pos(n):
+        nonlocal pos
+        pos += n
+
+    def parse_and():
+        left = parse_atom()
+        while True:
+            skip_ws()
+            if cond[pos:pos + 3].upper() == "AND" and (
+                    pos + 3 >= len(cond) or not cond[pos + 3].isalnum()):
+                nonlocal_pos(3)
+                right = parse_atom()
+                left = left and right
+            else:
+                return left
+
+    def parse_atom():
+        nonlocal pos
+        skip_ws()
+        if pos < len(cond) and cond[pos] == "(":
+            pos += 1
+            v = parse_or()
+            skip_ws()
+            assert cond[pos] == ")", cond[pos:]
+            pos += 1
+            return v
+        m = re.match(r"`(.+?)`\s*(>=|<=|!=|=|>|<)\s*", cond[pos:])
+        assert m, cond[pos:pos + 60]
+        name, op = m.group(1), m.group(2)
+        pos += m.end()
+        lit, ln = _parse_literal(cond[pos:])
+        pos += ln
+        val = row.get(name)
+        if val is None:
+            return False
+        try:
+            return {
+                "=": val == lit, "!=": val != lit, ">": val > lit,
+                "<": val < lit, ">=": val >= lit, "<=": val <= lit,
+            }[op]
+        except TypeError:
+            return False
+
+    result = parse_or()
+    return bool(result)
+
+
+def _parse_literal(s: str) -> tuple[Any, int]:
+    s0 = s.lstrip()
+    off = len(s) - len(s0)
+    if s0.startswith('"'):
+        # json string literal
+        dec = json.JSONDecoder()
+        val, end = dec.raw_decode(s0)
+        return val, off + end
+    m = re.match(r"-?\d+\.\d+(e[-+]?\d+)?", s0, re.IGNORECASE)
+    if m:
+        return float(m.group(0)), off + m.end()
+    m = re.match(r"-?\d+", s0)
+    if m:
+        return int(m.group(0)), off + m.end()
+    m = re.match(r"(true|false|NULL)", s0)
+    if m:
+        v = {"true": True, "false": False, "NULL": None}[m.group(1)]
+        return v, off + m.end()
+    raise ValueError(f"bad literal: {s0[:40]}")

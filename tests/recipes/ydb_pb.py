@@ -1,0 +1,35 @@
+"""Compile+import the YDB proto subset (cross-validation side).
+
+protoc is part of the environment's native toolchain; the generated module
+is cached per test session in a temp dir.  Tests that need it call
+load_pb() and skip when protoc is unavailable.
+"""
+
+from __future__ import annotations
+
+import importlib
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+_cached = None
+
+
+def load_pb():
+    global _cached
+    if _cached is not None:
+        return _cached
+    if shutil.which("protoc") is None:
+        return None
+    proto_dir = os.path.join(os.path.dirname(__file__), "ydb_protos")
+    out_dir = tempfile.mkdtemp(prefix="ydb_pb_")
+    subprocess.run(
+        ["protoc", f"--python_out={out_dir}", "-I", proto_dir,
+         "ydb_subset.proto"],
+        check=True, capture_output=True,
+    )
+    sys.path.insert(0, out_dir)
+    _cached = importlib.import_module("ydb_subset_pb2")
+    return _cached

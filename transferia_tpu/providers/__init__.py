@@ -42,4 +42,5 @@ def load_builtin_providers() -> None:
         mysql,
         postgres,
         s3,
+        ydb,
     )

@@ -1,0 +1,19 @@
+"""YDB provider (reference: pkg/providers/ydb/)."""
+
+from transferia_tpu.providers.ydb.provider import (
+    YdbChangefeedSource,
+    YdbProvider,
+    YdbSinker,
+    YdbSourceParams,
+    YdbStorage,
+    YdbTargetParams,
+)
+
+__all__ = [
+    "YdbChangefeedSource",
+    "YdbProvider",
+    "YdbSinker",
+    "YdbSourceParams",
+    "YdbStorage",
+    "YdbTargetParams",
+]
