@@ -126,3 +126,155 @@ def test_binlog_replication_e2e():
         assert state["file"] == "binlog.000001"
     finally:
         srv.stop()
+
+
+def test_gtid_set_model():
+    from transferia_tpu.providers.mysql.gtid import GtidSet
+
+    s = GtidSet.parse("3E11FA47-71CA-11E1-9E33-C80AA9429562:1-5:8,"
+                      "aaaaaaaa-bbbb-cccc-dddd-eeeeeeeeeeee:1-3")
+    assert s.contains("3e11fa47-71ca-11e1-9e33-c80aa9429562", 4)
+    assert not s.contains("3e11fa47-71ca-11e1-9e33-c80aa9429562", 6)
+    assert s.contains("3e11fa47-71ca-11e1-9e33-c80aa9429562", 8)
+    # adjacent interval merge
+    s.add("3e11fa47-71ca-11e1-9e33-c80aa9429562", 6)
+    s.add("3e11fa47-71ca-11e1-9e33-c80aa9429562", 7)
+    assert str(s).startswith(
+        "3e11fa47-71ca-11e1-9e33-c80aa9429562:1-8")
+    # binary round-trip (COM_BINLOG_DUMP_GTID SID block)
+    assert GtidSet.decode(s.encode()) == s
+
+
+def test_gtid_restart_resume():
+    """Restart resumes from the executed-GTID set: transactions already
+    committed to the sink are NOT re-delivered even though the binlog file
+    still contains them (sync_binlog_position.go / MysqlGtidState)."""
+    SID = "11111111-2222-3333-4444-555555555555"
+    srv = FakeMySQL(user="root", password="pw").start()
+    try:
+        srv.add_table(FakeMyTable("shop", "users", [
+            ("id", "bigint", "bigint", True, True),
+            ("name", "varchar", "varchar(50)", False, False),
+        ]))
+        col_specs = [(T_LONGLONG, b""), (T_VARCHAR, struct.pack("<H", 50))]
+        srv.feed_gtid(SID, 1)
+        srv.feed_table_map(7, "shop", "users", col_specs)
+        srv.feed_rows(30, 7, 2, [_row_image(1, "alice")])
+        srv.feed_xid(1)
+        srv.feed_gtid(SID, 2)
+        srv.feed_rows(30, 7, 2, [_row_image(2, "bob")])
+        srv.feed_xid(2)
+
+        store = get_store("blg1")
+        store.clear()
+        cp = MemoryCoordinator()
+        t = Transfer(
+            id="blg1", type=TransferType.INCREMENT_ONLY,
+            src=MySQLSourceParams(host="127.0.0.1", port=srv.port,
+                                  database="shop", user="root",
+                                  password="pw"),
+            dst=MemoryTargetParams(sink_id="blg1"),
+        )
+        stop = threading.Event()
+        th = threading.Thread(
+            target=run_replication, args=(t, cp),
+            kwargs={"stop_event": stop, "backoff": 0.2}, daemon=True,
+        )
+        th.start()
+        deadline = time.monotonic() + 15
+        while store.row_count() < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        # wait for the checkpoint to carry both gtids
+        while time.monotonic() < deadline:
+            state = cp.get_transfer_state("blg1").get("mysql_binlog", {})
+            if f"{SID}:1-2" in state.get("gtid_set", ""):
+                break
+            time.sleep(0.05)
+        stop.set()
+        th.join(timeout=10)
+        assert store.row_count() == 2
+        state = cp.get_transfer_state("blg1")["mysql_binlog"]
+        assert state["gtid_set"] == f"{SID}:1-2"
+
+        # restart: the fake still holds ALL events; a new transaction
+        # appears while we were down
+        srv.feed_gtid(SID, 3)
+        srv.feed_table_map(7, "shop", "users", col_specs)
+        srv.feed_rows(30, 7, 2, [_row_image(3, "carol")])
+        srv.feed_xid(3)
+        stop2 = threading.Event()
+        th2 = threading.Thread(
+            target=run_replication, args=(t, cp),
+            kwargs={"stop_event": stop2, "backoff": 0.2}, daemon=True,
+        )
+        th2.start()
+        deadline = time.monotonic() + 15
+        while store.row_count() < 3 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        time.sleep(0.3)  # would-be duplicates arrive within this window
+        stop2.set()
+        th2.join(timeout=10)
+        rows = store.rows(TableID("shop", "users"))
+        ids = sorted(r.value("id") for r in rows)
+        assert ids == [1, 2, 3], "resumed run re-delivered executed gtids"
+        assert cp.get_transfer_state("blg1")["mysql_binlog"]["gtid_set"] \
+            == f"{SID}:1-3"
+    finally:
+        srv.stop()
+
+
+def test_gtid_not_checkpointed_before_commit():
+    """A GTID joins the executed set only at its transaction boundary —
+    checkpointing it mid-transaction would make a crash-restart skip the
+    transaction's unpushed tail (reviewed data-loss scenario)."""
+    SID = "99999999-8888-7777-6666-555555555555"
+    srv = FakeMySQL(user="root", password="pw").start()
+    try:
+        srv.add_table(FakeMyTable("shop", "users", [
+            ("id", "bigint", "bigint", True, True),
+            ("name", "varchar", "varchar(50)", False, False),
+        ]))
+        col_specs = [(T_LONGLONG, b""), (T_VARCHAR, struct.pack("<H", 50))]
+        srv.feed_gtid(SID, 1)
+        srv.feed_table_map(7, "shop", "users", col_specs)
+        srv.feed_rows(30, 7, 2, [_row_image(1, "a")])
+        srv.feed_xid(1)
+        # open transaction: gtid 2 seen, rows flowing, NO commit yet
+        srv.feed_gtid(SID, 2)
+        srv.feed_rows(30, 7, 2, [_row_image(2, "b")])
+
+        store = get_store("blg2")
+        store.clear()
+        cp = MemoryCoordinator()
+        t = Transfer(
+            id="blg2", type=TransferType.INCREMENT_ONLY,
+            src=MySQLSourceParams(host="127.0.0.1", port=srv.port,
+                                  database="shop", user="root",
+                                  password="pw"),
+            dst=MemoryTargetParams(sink_id="blg2"),
+        )
+        stop = threading.Event()
+        th = threading.Thread(
+            target=run_replication, args=(t, cp),
+            kwargs={"stop_event": stop, "backoff": 0.2}, daemon=True,
+        )
+        th.start()
+        deadline = time.monotonic() + 15
+        while store.row_count() < 2 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        time.sleep(0.8)  # let idle flushes checkpoint
+        state = cp.get_transfer_state("blg2").get("mysql_binlog", {})
+        assert f"{SID}:1" == state.get("gtid_set"), state  # NOT :1-2
+        # commit closes the transaction; now gtid 2 may checkpoint
+        srv.feed_xid(2)
+        while time.monotonic() < deadline:
+            state = cp.get_transfer_state("blg2").get("mysql_binlog", {})
+            if state.get("gtid_set") == f"{SID}:1-2":
+                break
+            time.sleep(0.05)
+        stop.set()
+        th.join(timeout=10)
+        assert cp.get_transfer_state("blg2")["mysql_binlog"]["gtid_set"] \
+            == f"{SID}:1-2"
+    finally:
+        srv.stop()

@@ -15,6 +15,8 @@ from typing import Optional
 from transferia_tpu.providers.kafka.protocol import (
     Reader,
     decode_record_batches,
+    enc_bytes,
+    enc_str,
     enc_str as _enc_str,
     encode_record_batch,
 )
@@ -22,7 +24,11 @@ from transferia_tpu.providers.kafka.protocol import (
 
 class FakeKafka:
     def __init__(self, n_partitions: int = 2,
-                 auto_create_topics: bool = True):
+                 auto_create_topics: bool = True,
+                 sasl: Optional[tuple] = None,
+                 tls_cert: Optional[tuple] = None):
+        """sasl: (mechanism, username, password) to REQUIRE auth;
+        tls_cert: (certfile, keyfile) to serve TLS."""
         self.n_partitions = n_partitions
         self.auto_create = auto_create_topics
         # topic -> partition -> list[Record] (absolute offsets = index)
@@ -30,6 +36,14 @@ class FakeKafka:
         self.lock = threading.RLock()
         self.port = 0
         self._srv = None
+        self.sasl = sasl
+        self.auth_attempts = 0
+        self._ssl_ctx = None
+        if tls_cert is not None:
+            import ssl
+
+            self._ssl_ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+            self._ssl_ctx.load_cert_chain(tls_cert[0], tls_cert[1])
 
     def create_topic(self, name: str,
                      n_partitions: Optional[int] = None) -> None:
@@ -54,16 +68,23 @@ class FakeKafka:
         class Handler(socketserver.BaseRequestHandler):
             def handle(self):
                 try:
+                    if fake._ssl_ctx is not None:
+                        self.request = fake._ssl_ctx.wrap_socket(
+                            self.request, server_side=True)
+                    session = {"authed": fake.sasl is None,
+                               "verifier": None}
                     while True:
                         raw = self._recv_exact(4)
                         size = struct.unpack("!i", raw)[0]
                         payload = self._recv_exact(size)
-                        resp = fake.handle_request(payload)
+                        resp = fake.handle_request(payload, session)
                         self.request.sendall(
                             struct.pack("!i", len(resp)) + resp
                         )
                 except (ConnectionError, OSError):
                     return
+                except Exception:
+                    return  # TLS handshake failures etc.
 
             def _recv_exact(self, n):
                 out = b""
@@ -89,12 +110,23 @@ class FakeKafka:
             self._srv.shutdown()
 
     # -- dispatch -----------------------------------------------------------
-    def handle_request(self, payload: bytes) -> bytes:
+    def handle_request(self, payload: bytes,
+                       session: Optional[dict] = None) -> bytes:
+        session = session if session is not None else {"authed": True}
         r = Reader(payload)
         api_key = r.i16()
         api_version = r.i16()
         corr = r.i32()
         r.string()  # client id
+        if api_key == 17:
+            return struct.pack("!i", corr) + self._sasl_handshake(r)
+        if api_key == 36:
+            return struct.pack("!i", corr) + \
+                self._sasl_authenticate(r, session)
+        if not session.get("authed"):
+            # real brokers drop unauthenticated connections on SASL
+            # listeners
+            raise ConnectionError("unauthenticated request")
         body = {
             3: self._metadata,
             0: self._produce,
@@ -102,6 +134,43 @@ class FakeKafka:
             2: self._list_offsets,
         }.get(api_key, lambda _r: b"")(r)
         return struct.pack("!i", corr) + body
+
+    def _sasl_handshake(self, r: Reader) -> bytes:
+        mech = r.string() or ""
+        want = self.sasl[0] if self.sasl else ""
+        if not self.sasl or mech != want:
+            return (struct.pack("!h", 33)  # UNSUPPORTED_SASL_MECHANISM
+                    + struct.pack("!i", 1) + enc_str(want or "NONE"))
+        return struct.pack("!h", 0) + struct.pack("!i", 1) + enc_str(want)
+
+    def _sasl_authenticate(self, r: Reader, session: dict) -> bytes:
+        from transferia_tpu.utils.scram import ScramError, ServerVerifier
+
+        def resp(err: int, msg: Optional[str], auth: bytes) -> bytes:
+            return (struct.pack("!h", err) + enc_str(msg)
+                    + enc_bytes(auth) + struct.pack("!q", 0))
+
+        data = r.bytes_() or b""
+        mech, user, password = self.sasl
+        self.auth_attempts += 1
+        if mech == "PLAIN":
+            parts = data.split(b"\x00")
+            if len(parts) == 3 and parts[1].decode() == user \
+                    and parts[2].decode() == password:
+                session["authed"] = True
+                return resp(0, None, b"")
+            return resp(58, "bad credentials", b"")  # SASL_AUTH_FAILED
+        try:
+            if session.get("verifier") is None:
+                session["verifier"] = ServerVerifier(mech, user, password)
+                return resp(0, None, session["verifier"].first(data))
+            out = session["verifier"].final(data)
+            session["authed"] = True
+            session["verifier"] = None
+            return resp(0, None, out)
+        except ScramError as e:
+            session["verifier"] = None
+            return resp(58, str(e), b"")
 
     def _metadata(self, r: Reader) -> bytes:
         n = r.i32()

@@ -47,6 +47,20 @@ class FakeMySQL:
                              19 + len(payload), self._next_log_pos)
         return header[:17] + struct.pack("<H", 0) + payload
 
+    def feed_gtid(self, sid: str, gno: int) -> None:
+        """GTID_LOG_EVENT (type 33) opening a transaction group."""
+        import uuid as _uuid
+
+        body = b"\x00" + _uuid.UUID(sid).bytes + struct.pack("<Q", gno)
+        with self.lock:
+            self.binlog_events.append(self._event(33, body))
+
+    def feed_xid(self, xid: int = 1) -> None:
+        """XID_EVENT (type 16): transaction commit marker."""
+        with self.lock:
+            self.binlog_events.append(
+                self._event(16, struct.pack("<Q", xid)))
+
     def feed_table_map(self, table_id: int, schema: str, table: str,
                        col_specs: list[tuple]) -> None:
         """col_specs: (type_byte, meta_bytes) tuples."""
@@ -196,6 +210,16 @@ class _MySession:
             if cmd == 0x12:  # COM_BINLOG_DUMP
                 self.stream_binlog()
                 return
+            if cmd == 0x1E:  # COM_BINLOG_DUMP_GTID
+                # flags(2) server_id(4) name_len(4) name pos(8) dlen(4) set
+                name_len = struct.unpack_from("<I", pkt, 7)[0]
+                off = 11 + name_len + 8
+                dlen = struct.unpack_from("<I", pkt, off)[0]
+                gtid_data = pkt[off + 4:off + 4 + dlen]
+                from transferia_tpu.providers.mysql.gtid import GtidSet
+
+                self.stream_binlog(skip_set=GtidSet.decode(gtid_data))
+                return
             if cmd == 0x03:  # QUERY
                 sql = pkt[1:].decode("utf-8", "replace")
                 with self.fake.lock:
@@ -208,19 +232,33 @@ class _MySession:
                 except Exception as e:
                     self.send_err(str(e))
 
-    def stream_binlog(self):
+    def stream_binlog(self, skip_set=None):
         """Serve fed binlog events as OK-prefixed packets, then poll for
-        newly fed events until the client disconnects."""
+        newly fed events until the client disconnects.  With skip_set
+        (COM_BINLOG_DUMP_GTID), transaction groups whose GTID is already
+        in the executed set are not re-sent — like a real server."""
         import time as _time
+        import uuid as _uuid
 
         sent = 0
+        skipping = False
         while True:
             with self.fake.lock:
                 events = list(self.fake.binlog_events)
             while sent < len(events):
-                self.seq = 1
-                self.send_packet(b"\x00" + events[sent])
+                ev = events[sent]
                 sent += 1
+                etype = ev[4]
+                if skip_set is not None and etype == 33:
+                    sid = str(_uuid.UUID(bytes=ev[19 + 1:19 + 17]))
+                    gno = struct.unpack_from("<Q", ev, 19 + 17)[0]
+                    skipping = skip_set.contains(sid, gno)
+                    if skipping:
+                        continue
+                elif skipping and etype != 33:
+                    continue
+                self.seq = 1
+                self.send_packet(b"\x00" + ev)
             _time.sleep(0.02)
             # detect client disconnect cheaply
             import select
@@ -293,7 +331,7 @@ class _MySession:
         if low.startswith("show master status"):
             return self.send_rows(
                 ["File", "Position", "Executed_Gtid_Set"],
-                [["binlog.000001", 4242, "uuid:1-100"]],
+                [["binlog.000001", 4242, ""]],
             )
         m = re.match(r"select max\(`(\w+)`\) from `(\w+)`\.`(\w+)`", low)
         if m:

@@ -56,10 +56,23 @@ class KafkaError(CategorizedError):
 
 class KafkaClient:
     def __init__(self, brokers: list[str], client_id: str = "transferia-tpu",
-                 timeout: float = 30.0):
+                 timeout: float = 30.0, tls: bool = False,
+                 tls_ca: str = "", tls_verify: bool = True,
+                 sasl_mechanism: str = "", sasl_username: str = "",
+                 sasl_password: str = ""):
         self.bootstrap = brokers
         self.client_id = client_id
         self.timeout = timeout
+        self.tls = tls
+        self.tls_ca = tls_ca
+        self.tls_verify = tls_verify
+        self.sasl_mechanism = sasl_mechanism.upper()
+        self.sasl_username = sasl_username
+        self.sasl_password = sasl_password
+        if self.sasl_mechanism not in (
+                "", "PLAIN", "SCRAM-SHA-256", "SCRAM-SHA-512"):
+            raise KafkaError(
+                f"unsupported sasl mechanism {sasl_mechanism!r}")
         self._conns: dict[object, socket.socket] = {}  # node_id | "boot"
         self._nodes: dict[int, tuple[str, int]] = {}
         self._leaders: dict[tuple[str, int], int] = {}
@@ -70,7 +83,70 @@ class KafkaClient:
     def _dial(self, host: str, port: int) -> socket.socket:
         s = socket.create_connection((host, port), timeout=self.timeout)
         s.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        if self.tls:
+            import ssl
+
+            ctx = ssl.create_default_context()
+            if self.tls_ca:
+                ctx.load_verify_locations(self.tls_ca)
+            if not self.tls_verify:
+                ctx.check_hostname = False
+                ctx.verify_mode = ssl.CERT_NONE
+            s = ctx.wrap_socket(s, server_hostname=host)
+        if self.sasl_mechanism:
+            self._sasl_authenticate(s)
         return s
+
+    # -- SASL (SaslHandshake v1 + SaslAuthenticate v1 frames) ---------------
+    def _raw_roundtrip(self, sock: socket.socket, api_key: int,
+                       api_version: int, body: bytes) -> Reader:
+        self._corr += 1
+        corr = self._corr
+        header = struct.pack("!hhi", api_key, api_version, corr) \
+            + enc_str(self.client_id)
+        msg = header + body
+        sock.sendall(struct.pack("!i", len(msg)) + msg)
+        size = struct.unpack("!i", recv_exact(sock, 4))[0]
+        r = Reader(recv_exact(sock, size))
+        if r.i32() != corr:
+            raise KafkaError("sasl correlation mismatch")
+        return r
+
+    def _sasl_round(self, sock: socket.socket, data: bytes) -> bytes:
+        r = self._raw_roundtrip(sock, 36, 1, enc_bytes(data))
+        err = r.i16()
+        err_msg = r.string()
+        auth = r.bytes_()
+        if err:
+            raise KafkaError(
+                f"sasl authentication failed: {err_msg or err}", err)
+        return auth or b""
+
+    def _sasl_authenticate(self, sock: socket.socket) -> None:
+        r = self._raw_roundtrip(
+            sock, 17, 1, enc_str(self.sasl_mechanism))
+        err = r.i16()
+        if err:
+            n = r.i32()
+            offered = [r.string() for _ in range(max(0, n))]
+            raise KafkaError(
+                f"broker rejected mechanism {self.sasl_mechanism} "
+                f"(offers {offered})", err)
+        if self.sasl_mechanism == "PLAIN":
+            token = (b"\x00" + self.sasl_username.encode()
+                     + b"\x00" + self.sasl_password.encode())
+            self._sasl_round(sock, token)
+            return
+        from transferia_tpu.utils.scram import ScramError, client_exchange
+
+        try:
+            client_exchange(
+                self.sasl_mechanism, self.sasl_username,
+                self.sasl_password,
+                lambda msg: self._sasl_round(sock, msg),
+            )
+        except ScramError as e:
+            raise KafkaError(f"sasl scram failed: {e}") from e
 
     def _conn_for(self, node) -> socket.socket:
         sock = self._conns.get(node)
@@ -202,9 +278,9 @@ class KafkaClient:
     # -- produce ------------------------------------------------------------
     def produce(self, topic: str, partition: int,
                 records: list[Record], acks: int = -1,
-                timeout_ms: int = 30_000) -> int:
+                timeout_ms: int = 30_000, compression: str = "") -> int:
         """Append records; returns the base offset assigned (Produce v3)."""
-        batch = encode_record_batch(records)
+        batch = encode_record_batch(records, compression=compression)
         body = enc_str(None)                      # transactional id
         body += struct.pack("!hi", acks, timeout_ms)
         body += struct.pack("!i", 1) + enc_str(topic)

@@ -136,9 +136,13 @@ class Record:
     headers: list = field(default_factory=list)
 
 
+_CODEC_GZIP = 1
+
+
 def encode_record_batch(records: list[Record],
-                        base_offset: int = 0) -> bytes:
-    """Records -> one RecordBatch v2 blob."""
+                        base_offset: int = 0,
+                        compression: str = "") -> bytes:
+    """Records -> one RecordBatch v2 blob (optionally gzip-compressed)."""
     now = int(time.time() * 1000)
     base_ts = records[0].timestamp_ms or now if records else now
     recs = b""
@@ -159,9 +163,18 @@ def encode_record_batch(records: list[Record],
             body += enc_varint(len(hk)) + hk
             body += enc_varint(len(hv)) + hv
         recs += enc_varint(len(body)) + body
+    attrs = 0
+    if compression == "gzip":
+        import gzip as _gzip
+
+        recs = _gzip.compress(recs)
+        attrs = _CODEC_GZIP
+    elif compression:
+        raise ValueError(f"unsupported compression {compression!r} "
+                         f"(only gzip ships dependency-free)")
     # batch body after the crc field
     after_crc = (
-        struct.pack("!h", 0)                       # attributes
+        struct.pack("!h", attrs)                   # attributes
         + struct.pack("!i", max(0, len(records) - 1))  # lastOffsetDelta
         + struct.pack("!q", base_ts)
         + struct.pack("!q", (records[-1].timestamp_ms or now)
@@ -202,11 +215,13 @@ def decode_record_batches(data: bytes) -> list[Record]:
         if crc32c(data[r.pos:end]) != expect_crc:
             raise ValueError("record batch CRC mismatch")
         attributes = r.i16()
-        if attributes & 0x07:
+        codec = attributes & 0x07
+        if codec not in (0, _CODEC_GZIP):
             raise ValueError(
-                f"compressed record batch (codec {attributes & 0x07}) not "
-                f"supported — configure the topic/producers for "
-                f"uncompressed delivery to this consumer"
+                f"compressed record batch codec {codec} not supported "
+                f"(gzip=1 is; snappy/lz4/zstd need codecs this "
+                f"environment does not ship) — configure the producers "
+                f"accordingly"
             )
         if attributes & 0x20:
             # control batch: txn commit/abort markers are broker metadata,
@@ -220,6 +235,10 @@ def decode_record_batches(data: bytes) -> list[Record]:
         r.i16()            # producerEpoch
         r.i32()            # baseSequence
         count = r.i32()
+        if codec == _CODEC_GZIP:
+            import gzip as _gzip
+
+            r = Reader(_gzip.decompress(bytes(r.buf[r.pos:end])))
         for _ in range(count):
             r.varint()                 # record length
             r.i8()                     # attributes

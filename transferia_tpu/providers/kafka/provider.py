@@ -44,6 +44,13 @@ class KafkaSourceParams(EndpointParams):
     parallelism: int = 4
     max_bytes_per_fetch: int = 8 << 20
     start_from: str = "earliest"   # earliest | latest
+    # -- security (reference: franz-go auth in pkg/providers/kafka/writer/)
+    tls: bool = False
+    tls_ca: str = ""              # CA bundle path (custom/self-signed)
+    tls_verify: bool = True
+    sasl_mechanism: str = ""      # PLAIN | SCRAM-SHA-256 | SCRAM-SHA-512
+    sasl_username: str = ""
+    sasl_password: str = ""
 
     def __post_init__(self):
         if self.start_from not in ("earliest", "latest"):
@@ -68,6 +75,26 @@ class KafkaTargetParams(EndpointParams):
     serializer: str = "json"
     serializer_config: dict = field(default_factory=dict)
     partition_by: str = ""
+    compression: str = ""         # "" | gzip
+    # -- security (reference: franz-go auth in pkg/providers/kafka/writer/)
+    tls: bool = False
+    tls_ca: str = ""              # CA bundle path (custom/self-signed)
+    tls_verify: bool = True
+    sasl_mechanism: str = ""      # PLAIN | SCRAM-SHA-256 | SCRAM-SHA-512
+    sasl_username: str = ""
+    sasl_password: str = ""
+
+
+def _make_client(params) -> KafkaClient:
+    return KafkaClient(
+        params.brokers,
+        tls=getattr(params, "tls", False),
+        tls_ca=getattr(params, "tls_ca", ""),
+        tls_verify=getattr(params, "tls_verify", True),
+        sasl_mechanism=getattr(params, "sasl_mechanism", ""),
+        sasl_username=getattr(params, "sasl_username", ""),
+        sasl_password=getattr(params, "sasl_password", ""),
+    )
 
 
 class _KafkaQueueClient:
@@ -81,7 +108,7 @@ class _KafkaQueueClient:
         self.params = params
         self.transfer_id = transfer_id
         self.cp = coordinator
-        self.client = KafkaClient(params.brokers)
+        self.client = _make_client(params)
         meta = self.client.metadata([params.topic])
         partitions = meta.get(params.topic)
         if not partitions:
@@ -148,7 +175,7 @@ class _KafkaQueueClient:
 class KafkaSinker(Sinker):
     def __init__(self, params: KafkaTargetParams):
         self.params = params
-        self.client = KafkaClient(params.brokers)
+        self.client = _make_client(params)
         self.serializer = make_queue_serializer(
             params.serializer, **(params.serializer_config or {})
         )
@@ -195,7 +222,9 @@ class KafkaSinker(Sinker):
                 Record(key=key, value=value)
             )
         for p, records in per_partition.items():
-            self.client.produce(topic, p, records)
+            self.client.produce(
+                topic, p, records,
+                compression=getattr(self.params, "compression", ""))
 
     def close(self) -> None:
         self.client.close()
@@ -225,7 +254,7 @@ class KafkaProvider(Provider):
         params = self.transfer.src if isinstance(
             self.transfer.src, KafkaSourceParams) else self.transfer.dst
         try:
-            client = KafkaClient(params.brokers)
+            client = _make_client(params)
             client.metadata()
             client.close()
             result.add("metadata")

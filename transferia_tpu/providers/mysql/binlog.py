@@ -28,6 +28,7 @@ from transferia_tpu.abstract.kinds import Kind
 from transferia_tpu.abstract.schema import TableID, TableSchema
 from transferia_tpu.columnar.batch import ColumnBatch
 from transferia_tpu.coordinator.interface import Coordinator
+from transferia_tpu.providers.mysql.gtid import GtidSet
 from transferia_tpu.providers.mysql.wire import MySQLConnection, MySQLError
 
 logger = logging.getLogger(__name__)
@@ -43,6 +44,7 @@ EV_UPDATE_ROWS_V1 = 24
 EV_DELETE_ROWS_V1 = 25
 EV_WRITE_ROWS_V2 = 30
 EV_UPDATE_ROWS_V2 = 31
+EV_GTID = 33  # GTID_LOG_EVENT: flags(1) + sid(16) + gno(8 le)
 EV_DELETE_ROWS_V2 = 32
 
 COM_BINLOG_DUMP = 0x12
@@ -344,7 +346,15 @@ class BinlogReader:
             out.append(("rotate", new_file, new_pos))
             return out
         out.append(("pos", log_pos, ts))
-        if etype == EV_TABLE_MAP:
+        if etype == EV_GTID:
+            import uuid as _uuid
+
+            sid = str(_uuid.UUID(bytes=payload[1:17]))
+            gno = struct.unpack_from("<Q", payload, 17)[0]
+            out.append(("gtid", sid, gno))
+        elif etype == EV_XID:
+            out.append(("commit",))
+        elif etype == EV_TABLE_MAP:
             tid, tmap = _parse_table_map(payload)
             self.table_maps[tid] = tmap
         elif etype in (EV_WRITE_ROWS_V1, EV_WRITE_ROWS_V2,
@@ -358,8 +368,12 @@ class BinlogReader:
             pos = 13 + status_len
             schema = payload[pos:pos + slen].decode()
             query = payload[pos + slen + 1:].decode("utf-8", "replace")
-            if query not in ("BEGIN", "COMMIT"):
+            if query == "COMMIT":
+                out.append(("commit",))
+            elif query != "BEGIN":
+                # DDL implicitly commits its transaction
                 out.append(("ddl", schema, query))
+                out.append(("commit",))
         return out
 
     def _parse_rows(self, etype: int, payload: bytes):
@@ -422,6 +436,8 @@ class MySQLBinlogSource(Source):
         self.batch_rows = batch_rows
         self._stop = threading.Event()
         self._schemas: dict[tuple[str, str], TableSchema] = {}
+        self._gtid = GtidSet()
+        self._gtid_valid = False  # True only when baselined/resumed
 
     def _schema_for(self, schema: str, table: str,
                     catalog: MySQLConnection) -> Optional[TableSchema]:
@@ -460,8 +476,25 @@ class MySQLBinlogSource(Source):
                 "SELECT @@global.binlog_checksum"
             ) or "NONE").upper()
             checksum_bytes = 4 if checksum == "CRC32" else 0
-            file, pos = self._start_position(catalog)
-            self._dump(conn, file, pos)
+            file, pos, gtid_set = self._start_position(catalog)
+            if gtid_set:
+                # GTID resume survives source failover/renamed binlogs
+                # (sync_binlog_position.go / MysqlGtidState parity)
+                self._dump_gtid(conn, file, pos, gtid_set)
+                self._gtid = gtid_set
+            else:
+                self._dump(conn, file, pos)
+                # fresh start baselined self._gtid (+_gtid_valid) in
+                # _start_position; a legacy file+pos state leaves
+                # _gtid_valid False so checkpoints stay file+pos-only —
+                # a partial executed set would make a later GTID resume
+                # replay the whole retained history
+            # GTID lifecycle: a gtid becomes EXECUTED only when its
+            # transaction completes (XID/COMMIT/next GTID) — merging it
+            # at first sight would let a mid-transaction flush checkpoint
+            # it and a crash-restart skip the transaction's pushed tail
+            open_gtid: list = [None]
+            pending_gtids: list[tuple[str, int]] = []
 
             def table_filter(schema: str, table: str) -> bool:
                 return (not self.params.database
@@ -488,11 +521,19 @@ class MySQLBinlogSource(Source):
                 for f in futures:
                     f.result()
                 futures.clear()
-                if pending_pos != last_pos and self.cp is not None:
+                # completed-transaction gtids merge into the executed set
+                # only after the pushes above resolved (at-least-once)
+                for sid, gno in pending_gtids:
+                    self._gtid.add(sid, gno)
+                dirty = bool(pending_gtids) or pending_pos != last_pos
+                pending_gtids.clear()
+                if dirty and self.cp is not None:
+                    state = {"file": reader.binlog_file,
+                             "pos": pending_pos}
+                    if self._gtid_valid:
+                        state["gtid_set"] = str(self._gtid)
                     self.cp.set_transfer_state(self.transfer_id, {
-                        self.STATE_KEY: {
-                            "file": reader.binlog_file, "pos": pending_pos,
-                        },
+                        self.STATE_KEY: state,
                     })
                 last_pos = pending_pos
 
@@ -526,11 +567,21 @@ class MySQLBinlogSource(Source):
                         pending_pos = ev[2]
                         last_pos = ev[2]
                         if self.cp is not None:
+                            state = {"file": ev[1], "pos": ev[2]}
+                            if self._gtid_valid:
+                                state["gtid_set"] = str(self._gtid)
                             self.cp.set_transfer_state(self.transfer_id, {
-                                self.STATE_KEY: {
-                                    "file": ev[1], "pos": ev[2],
-                                },
+                                self.STATE_KEY: state,
                             })
+                    elif ev[0] == "gtid":
+                        # a new GTID implies the previous txn completed
+                        if open_gtid[0] is not None:
+                            pending_gtids.append(open_gtid[0])
+                        open_gtid[0] = (ev[1], ev[2])
+                    elif ev[0] == "commit":
+                        if open_gtid[0] is not None:
+                            pending_gtids.append(open_gtid[0])
+                            open_gtid[0] = None
                     elif ev[0] == "row":
                         _, schema, table, kind, values, old = ev
                         item = self._to_item(schema, table, kind, values,
@@ -551,13 +602,21 @@ class MySQLBinlogSource(Source):
             conn.close()
             catalog.close()
 
-    def _start_position(self, catalog: MySQLConnection) -> tuple[str, int]:
+    def _start_position(self, catalog: MySQLConnection
+                        ) -> tuple[str, int, Optional["GtidSet"]]:
         if self.cp is not None:
             state = self.cp.get_transfer_state(self.transfer_id).get(
                 self.STATE_KEY
             )
             if state:
-                return state["file"], int(state["pos"])
+                gtid = GtidSet.parse(state.get("gtid_set", ""))
+                if gtid:
+                    self._gtid_valid = True
+                    return state["file"], int(state["pos"]), gtid
+                # legacy file+pos state: no executed-set baseline exists;
+                # keep checkpointing file+pos only (_gtid_valid stays
+                # False) rather than fabricating a partial set
+                return state["file"], int(state["pos"]), None
         from transferia_tpu.providers.mysql.provider import MySQLStorage
 
         storage = MySQLStorage(self.params)
@@ -567,12 +626,28 @@ class MySQLBinlogSource(Source):
             raise MySQLError(
                 "cannot determine binlog position; is binary logging on?"
             )
-        return pos["binlog_file"], int(pos["binlog_pos"])
+        # fresh start: baseline the executed set so future checkpoints
+        # carry gtids (file+pos dump is still used for the first attach —
+        # the server streams everything after that position)
+        self._gtid = GtidSet.parse(pos.get("gtid_set", "") or "")
+        self._gtid_valid = True
+        return pos["binlog_file"], int(pos["binlog_pos"]), None
 
     def _dump(self, conn: MySQLConnection, file: str, pos: int) -> None:
         conn._seq = 0
         body = struct.pack("<BIHI", 0x12, max(4, pos), 0, self.server_id) \
             + file.encode()
+        conn._send_packet(body)
+
+    def _dump_gtid(self, conn: MySQLConnection, file: str, pos: int,
+                   gtid_set: "GtidSet") -> None:
+        """COM_BINLOG_DUMP_GTID (0x1e): resume from an executed set."""
+        conn._seq = 0
+        data = gtid_set.encode()
+        body = (struct.pack("<BHI", 0x1E, 0, self.server_id)
+                + struct.pack("<I", len(file)) + file.encode()
+                + struct.pack("<Q", max(4, pos))
+                + struct.pack("<I", len(data)) + data)
         conn._send_packet(body)
 
     def _to_item(self, schema: str, table: str, kind: Kind,

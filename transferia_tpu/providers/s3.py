@@ -15,7 +15,6 @@ straight into columnar batches — the ClickBench snapshot path.
 
 from __future__ import annotations
 
-import io
 import json
 import logging
 from dataclasses import dataclass, field

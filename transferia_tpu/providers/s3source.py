@@ -295,11 +295,14 @@ class S3ReplicationSource(Source):
                 f"unknown event_source {params.event_source!r} (sqs|poll)")
 
     def _full_path(self, key: str) -> str:
-        # SQS keys are bucket-relative; the poller returns full paths
-        if self.fs.exists(key):
-            return key
-        bucket = self.root.split("/", 1)[0]
-        return f"{bucket}/{key}"
+        # key shape is determined by the fetcher: SQS events carry
+        # bucket-relative keys, the poller lists full paths — never probe
+        # fs.exists (a relative key could resolve against the WRONG
+        # bucket that happens to exist)
+        if isinstance(self.fetcher, SQSObjectFetcher):
+            bucket = self.root.split("/", 1)[0]
+            return f"{bucket}/{key}"
+        return key
 
     def run(self, sink: AsyncSink) -> None:
         while not self._stop.is_set():
@@ -316,16 +319,34 @@ class S3ReplicationSource(Source):
 
     def _replicate_object(self, key: str, sink: AsyncSink) -> None:
         path = self._full_path(key)
-        if self._schema is None:
-            self._schema = self.reader.infer_schema(self.fs, path)
+        try:
+            if self._schema is None:
+                self._schema = self.reader.infer_schema(self.fs, path)
+        except FileNotFoundError:
+            # deleted between notification and processing (lifecycle
+            # rules): skip+commit — a poison message must not crash the
+            # worker on every redelivery (the reference fetcher skips
+            # missing objects too)
+            logger.warning("s3 object %s vanished before replication; "
+                           "skipping", path)
+            self.fetcher.commit(key)
+            return
         futures = []
 
         def pusher(batch):
             futures.append(sink.async_push(batch))
 
         t0 = time.monotonic()
-        self.reader.read(self.fs, path, self.table, self._schema,
-                         self.params.batch_rows, pusher)
+        try:
+            self.reader.read(self.fs, path, self.table, self._schema,
+                             self.params.batch_rows, pusher)
+        except FileNotFoundError:
+            logger.warning("s3 object %s vanished mid-read; skipping",
+                           path)
+            for f in futures:
+                f.result()  # partial rows already pushed stay pushed
+            self.fetcher.commit(key)
+            return
         for f in futures:
             f.result()  # at-least-once: commit only after durable push
         self.fetcher.commit(key)

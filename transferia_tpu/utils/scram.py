@@ -1,0 +1,113 @@
+"""Generic SCRAM-SHA-256/512 client exchange (RFC 5802/7677).
+
+Shared by wire clients that speak SCRAM over different carriers (Kafka
+SaslAuthenticate frames here; the PG/Mongo clients carry protocol-specific
+framing and predate this helper).  The exchange is transport-agnostic:
+the caller provides send_receive(client_msg) -> server_msg.
+
+A server-side verifier is included for the in-repo fakes so e2e suites
+can require real authentication.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import os
+from base64 import b64decode, b64encode
+from typing import Callable
+
+
+class ScramError(Exception):
+    pass
+
+
+def _algo(mechanism: str):
+    if mechanism == "SCRAM-SHA-256":
+        return hashlib.sha256
+    if mechanism == "SCRAM-SHA-512":
+        return hashlib.sha512
+    raise ScramError(f"unsupported mechanism {mechanism!r}")
+
+
+def client_exchange(mechanism: str, username: str, password: str,
+                    send_receive: Callable[[bytes], bytes]) -> None:
+    """Run the client side; raises ScramError on any verification fail."""
+    h = _algo(mechanism)
+    nonce = b64encode(os.urandom(18)).decode()
+    user = username.replace("=", "=3D").replace(",", "=2C")
+    first_bare = f"n={user},r={nonce}"
+    server_first = send_receive(b"n,," + first_bare.encode()).decode()
+    parts = dict(p.split("=", 1) for p in server_first.split(","))
+    r, s, i = parts["r"], parts["s"], int(parts["i"])
+    if not r.startswith(nonce):
+        raise ScramError("server nonce mismatch")
+    salted = hashlib.pbkdf2_hmac(h().name, password.encode(),
+                                 b64decode(s), i)
+    client_key = hmac.new(salted, b"Client Key", h).digest()
+    stored_key = h(client_key).digest()
+    without_proof = f"c={b64encode(b'n,,').decode()},r={r}"
+    auth_message = ",".join([first_bare, server_first, without_proof])
+    client_sig = hmac.new(stored_key, auth_message.encode(), h).digest()
+    proof = b64encode(bytes(a ^ b for a, b in
+                            zip(client_key, client_sig))).decode()
+    server_final = send_receive(
+        f"{without_proof},p={proof}".encode()).decode()
+    final = dict(p.split("=", 1) for p in server_final.split(","))
+    if "e" in final:
+        raise ScramError(f"server rejected auth: {final['e']}")
+    server_key = hmac.new(salted, b"Server Key", h).digest()
+    expect = hmac.new(server_key, auth_message.encode(), h).digest()
+    if b64decode(final.get("v", "")) != expect:
+        raise ScramError("server signature mismatch")
+
+
+class ServerVerifier:
+    """Server side for fakes: verify a client against (user, password)."""
+
+    def __init__(self, mechanism: str, username: str, password: str,
+                 iterations: int = 4096):
+        self.h = _algo(mechanism)
+        self.username = username
+        self.salt = os.urandom(12)
+        self.iterations = iterations
+        self.salted = hashlib.pbkdf2_hmac(
+            self.h().name, password.encode(), self.salt, iterations)
+        self._client_first_bare = ""
+        self._server_first = ""
+        self._nonce = ""
+
+    def first(self, client_first: bytes) -> bytes:
+        msg = client_first.decode()
+        if not msg.startswith("n,,"):
+            raise ScramError("bad gs2 header")
+        self._client_first_bare = msg[3:]
+        parts = dict(p.split("=", 1)
+                     for p in self._client_first_bare.split(","))
+        if parts.get("n") != self.username:
+            raise ScramError("unknown user")
+        self._nonce = parts["r"] + b64encode(os.urandom(12)).decode()
+        self._server_first = (
+            f"r={self._nonce},s={b64encode(self.salt).decode()},"
+            f"i={self.iterations}")
+        return self._server_first.encode()
+
+    def final(self, client_final: bytes) -> bytes:
+        msg = client_final.decode()
+        parts = dict(p.split("=", 1) for p in msg.split(","))
+        if parts.get("r") != self._nonce:
+            raise ScramError("nonce mismatch")
+        without_proof = msg[:msg.rindex(",p=")]
+        auth_message = ",".join([
+            self._client_first_bare, self._server_first, without_proof])
+        client_key = hmac.new(self.salted, b"Client Key", self.h).digest()
+        stored_key = self.h(client_key).digest()
+        client_sig = hmac.new(stored_key, auth_message.encode(),
+                              self.h).digest()
+        expect_proof = bytes(a ^ b for a, b in
+                             zip(client_key, client_sig))
+        if b64decode(parts.get("p", "")) != expect_proof:
+            raise ScramError("bad proof")
+        server_key = hmac.new(self.salted, b"Server Key", self.h).digest()
+        sig = hmac.new(server_key, auth_message.encode(), self.h).digest()
+        return f"v={b64encode(sig).decode()}".encode()
