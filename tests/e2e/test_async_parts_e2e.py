@@ -1,0 +1,177 @@
+"""Async part discovery + sharded-state handoff + main-restart detection
+(load_snapshot.go:496-671, table_part_provider/tpp_setter_async.go)."""
+
+import threading
+import time
+
+import pytest
+
+from transferia_tpu.abstract import Kind, TableID
+from transferia_tpu.abstract.errors import CodedError, Codes
+from transferia_tpu.abstract.interfaces import (
+    AsyncPartDiscovery,
+    ShardedStateStorage,
+    Storage,
+    TableInfo,
+)
+from transferia_tpu.abstract.schema import new_table_schema
+from transferia_tpu.abstract.table import (
+    OperationTablePart,
+    TableDescription,
+)
+from transferia_tpu.columnar.batch import ColumnBatch
+from transferia_tpu.coordinator import MemoryCoordinator
+from transferia_tpu.models import Transfer
+from transferia_tpu.models.transfer import Runtime, ShardingUploadParams
+from transferia_tpu.providers.memory import MemoryTargetParams, get_store
+from transferia_tpu.providers.registry import Provider, register_provider
+from transferia_tpu.models.endpoint import EndpointParams, register_endpoint
+from transferia_tpu.tasks import SnapshotLoader
+from dataclasses import dataclass
+
+SCHEMA = new_table_schema([("id", "int64", True), ("v", "utf8")])
+TID = TableID("slow", "t")
+
+
+@register_endpoint
+@dataclass
+class SlowSourceParams(EndpointParams):
+    PROVIDER = "slowdiscovery"
+    IS_SOURCE = True
+
+    n_parts: int = 6
+    rows_per_part: int = 10
+    discovery_delay: float = 0.15
+
+
+class SlowDiscoveryStorage(Storage, AsyncPartDiscovery,
+                           ShardedStateStorage):
+    """Parts trickle out with a delay; records when each part appeared and
+    when loads happened so tests can prove the overlap."""
+
+    events: list[tuple[str, float]] = []  # shared (class-level) log
+
+    def __init__(self, params: SlowSourceParams):
+        self.params = params
+        self.state: dict = {"lsn": 777}
+
+    def table_list(self, include=None):
+        return {TID: TableInfo(
+            eta_rows=self.params.n_parts * self.params.rows_per_part,
+            schema=SCHEMA)}
+
+    def table_schema(self, table):
+        return SCHEMA
+
+    def estimate_table_rows_count(self, table):
+        return 0
+
+    def iter_table_parts(self, table):
+        for i in range(self.params.n_parts):
+            time.sleep(self.params.discovery_delay)
+            SlowDiscoveryStorage.events.append(
+                (f"discovered:{i}", time.monotonic()))
+            yield TableDescription(id=table.id, filter=f"part:{i}",
+                                   eta_rows=self.params.rows_per_part)
+
+    def load_table(self, table, pusher):
+        idx = int(table.filter.split(":")[1])
+        SlowDiscoveryStorage.events.append(
+            (f"loaded:{idx}", time.monotonic()))
+        base = idx * self.params.rows_per_part
+        pusher(ColumnBatch.from_pydict(table.id, SCHEMA, {
+            "id": list(range(base, base + self.params.rows_per_part)),
+            "v": [f"v{i}" for i in range(self.params.rows_per_part)],
+        }))
+
+    # sharded-state handoff
+    def sharded_state(self) -> dict:
+        return dict(self.state)
+
+    def set_sharded_state(self, state: dict) -> None:
+        self.state = dict(state)
+        SlowDiscoveryStorage.events.append(
+            (f"state:{state.get('lsn')}", time.monotonic()))
+
+    def ping(self):
+        pass
+
+
+@register_provider
+class SlowDiscoveryProvider(Provider):
+    NAME = "slowdiscovery"
+
+    def storage(self):
+        return SlowDiscoveryStorage(self.transfer.src)
+
+
+def make_transfer(tid, sink_id, **kw):
+    return Transfer(
+        id=tid, src=SlowSourceParams(),
+        dst=MemoryTargetParams(sink_id=sink_id),
+        runtime=Runtime(sharding=ShardingUploadParams(process_count=3),
+                        **kw),
+    )
+
+
+def test_parts_upload_while_discovery_runs():
+    SlowDiscoveryStorage.events = []
+    store = get_store("async1")
+    store.clear()
+    cp = MemoryCoordinator()
+    SnapshotLoader(make_transfer("async1", "async1"), cp,
+                   operation_id="op-async1").upload_tables()
+    ids = sorted(r.value("id") for r in store.rows(TID))
+    assert ids == list(range(60))  # exactly once
+    # the overlap: some part LOADED before the LAST part was discovered
+    ev = SlowDiscoveryStorage.events
+    last_discovery = max(t for name, t in ev
+                         if name.startswith("discovered"))
+    first_load = min(t for name, t in ev if name.startswith("loaded"))
+    assert first_load < last_discovery, \
+        "upload did not overlap part discovery"
+    assert cp.get_operation_state("op-async1")["parts_discovery_done"]
+    prog = cp.operation_progress("op-async1")
+    assert prog.done and prog.completed_rows == 60
+    # sharded bracket control events surrounded the data
+    kinds = [c.kind for c in store.control_events()]
+    assert Kind.INIT_SHARDED_TABLE_LOAD in kinds
+    assert kinds[-1] == Kind.DONE_SHARDED_TABLE_LOAD
+
+
+def test_sharded_state_handoff_to_secondary():
+    SlowDiscoveryStorage.events = []
+    store = get_store("async2")
+    store.clear()
+    cp = MemoryCoordinator()
+
+    def run(idx):
+        t = make_transfer("async2", "async2", current_job=idx)
+        t.runtime.sharding.job_count = 2
+        SnapshotLoader(t, cp, operation_id="op-async2").upload_tables()
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(2)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    ids = sorted(r.value("id") for r in store.rows(TID))
+    assert ids == list(range(60))
+    # the secondary applied the main's consistent point
+    assert ("state:777", pytest.approx(
+        [e[1] for e in SlowDiscoveryStorage.events
+         if e[0] == "state:777"][0])) in SlowDiscoveryStorage.events
+    assert cp.get_operation_state("op-async2")["sharded_state"] == \
+        {"lsn": 777}
+
+
+def test_main_restart_raises_coded_error():
+    cp = MemoryCoordinator()
+    cp.create_operation_parts("op-r", [OperationTablePart(
+        operation_id="op-r", table_id=TID, part_index=0)])
+    t = make_transfer("async3", "async3")
+    t.runtime.sharding.job_count = 2
+    loader = SnapshotLoader(t, cp, operation_id="op-r")
+    with pytest.raises(CodedError) as ei:
+        loader.upload_tables()
+    assert ei.value.code == Codes.MAIN_WORKER_RESTART

@@ -1,0 +1,236 @@
+"""Container runner + Airbyte connector + dbt e2e.
+
+No docker in this environment, so connectors run via runtime="exec": the
+SAME protocol code paths (argv building aside) drive a real subprocess
+speaking the Airbyte line-JSON protocol / accepting dbt's CLI contract.
+The docker argv mapping is pinned by unit assertions.
+"""
+
+import json
+import os
+import stat
+import sys
+import textwrap
+
+import pytest
+
+from transferia_tpu.abstract import TableID
+from transferia_tpu.container import ContainerRunner, ContainerSpec
+from transferia_tpu.coordinator import MemoryCoordinator
+from transferia_tpu.models import Transfer
+from transferia_tpu.providers.airbyte import (
+    AirbyteSourceParams,
+    AirbyteStorage,
+)
+from transferia_tpu.providers.memory import MemoryTargetParams, get_store
+from transferia_tpu.tasks import activate_delivery
+
+CONNECTOR = textwrap.dedent("""\
+    import json, sys
+
+    def arg(name):
+        return (sys.argv[sys.argv.index(name) + 1]
+                if name in sys.argv else None)
+
+    mode = sys.argv[1]
+    CATALOG = {"streams": [{
+        "name": "users",
+        "json_schema": {"properties": {
+            "id": {"type": "integer"},
+            "email": {"type": ["null", "string"]},
+            "meta": {"type": "object"},
+        }},
+        "supported_sync_modes": ["full_refresh", "incremental"],
+        "source_defined_primary_key": [["id"]],
+    }]}
+    if mode == "check":
+        cfg = json.load(open(arg("--config")))
+        ok = cfg.get("api_key") == "k"
+        print(json.dumps({"type": "CONNECTION_STATUS",
+                          "connectionStatus": {
+                              "status": "SUCCEEDED" if ok else "FAILED",
+                              "message": "bad api_key"}}))
+    elif mode == "discover":
+        print(json.dumps({"type": "CATALOG", "catalog": CATALOG}))
+    elif mode == "read":
+        catalog = json.load(open(arg("--catalog")))
+        assert catalog["streams"][0]["stream"]["name"] == "users"
+        start = 0
+        state_file = arg("--state")
+        if state_file:
+            start = json.load(open(state_file)).get("cursor", 0)
+        print(json.dumps({"type": "LOG",
+                          "log": {"level": "INFO", "message": "hi"}}))
+        for i in range(start, start + 4):
+            print(json.dumps({"type": "RECORD", "record": {
+                "stream": "users", "emitted_at": 1,
+                "data": {"id": i, "email": f"u{i}@x.io",
+                         "meta": {"n": i}},
+            }}))
+        print(json.dumps({"type": "STATE",
+                          "state": {"cursor": start + 4}}))
+""")
+
+
+@pytest.fixture
+def connector(tmp_path):
+    p = tmp_path / "connector.py"
+    p.write_text(CONNECTOR)
+    return [sys.executable, str(p)]
+
+
+def make_params(connector, **kw):
+    return AirbyteSourceParams(
+        config={"api_key": "k"}, runtime="exec", exec_argv=connector,
+        sync_mode=kw.pop("sync_mode", "full_refresh"), **kw,
+    )
+
+
+def test_docker_argv_mapping():
+    runner = ContainerRunner("docker")
+    spec = ContainerSpec(
+        image="airbyte/source-x:1.0", args=["read", "--config",
+                                            "/data/config.json"],
+        env={"A": "1"}, mounts=[("/tmp/x", "/data")], network="host",
+    )
+    assert runner.argv(spec) == [
+        "docker", "run", "--rm", "-i", "-e", "A=1", "-v", "/tmp/x:/data",
+        "--network=host", "airbyte/source-x:1.0", "read", "--config",
+        "/data/config.json",
+    ]
+
+
+def test_airbyte_discover_and_schema(connector):
+    st = AirbyteStorage(make_params(connector))
+    tables = st.table_list()
+    tid = TableID("airbyte", "users")
+    assert tid in tables
+    schema = tables[tid].schema
+    assert schema.find("id").data_type.value == "int64"
+    assert schema.find("id").primary_key
+    assert schema.find("email").data_type.value == "utf8"
+    assert schema.find("meta").data_type.value == "any"
+
+
+def test_airbyte_check(connector):
+    AirbyteStorage(make_params(connector)).ping()
+    bad = AirbyteStorage(AirbyteSourceParams(
+        config={"api_key": "wrong"}, runtime="exec",
+        exec_argv=connector))
+    from transferia_tpu.providers.airbyte import AirbyteError
+
+    with pytest.raises(AirbyteError, match="bad api_key"):
+        bad.ping()
+
+
+def test_airbyte_snapshot_to_memory(connector):
+    store = get_store("ab1")
+    store.clear()
+    t = Transfer(id="ab1", src=make_params(connector),
+                 dst=MemoryTargetParams(sink_id="ab1"))
+    activate_delivery(t, MemoryCoordinator())
+    rows = store.rows(TableID("airbyte", "users"))
+    assert [r.value("id") for r in rows] == [0, 1, 2, 3]
+    assert rows[1].value("email") == "u1@x.io"
+
+
+def test_airbyte_incremental_state_resume(connector):
+    cp = MemoryCoordinator()
+    params = make_params(connector, sync_mode="incremental")
+    st = AirbyteStorage(params, "t-inc", cp)
+    got = []
+    from transferia_tpu.abstract.table import TableDescription
+
+    st.load_table(TableDescription(id=TableID("airbyte", "users")),
+                  got.append)
+    assert cp.get_transfer_state("t-inc")["airbyte_state"] == \
+        {"users": {"cursor": 4}}  # keyed per stream
+    # second run resumes from the cursor: ids 4..7
+    st2 = AirbyteStorage(params, "t-inc", cp)
+    got2 = []
+    st2.load_table(TableDescription(id=TableID("airbyte", "users")),
+                   got2.append)
+    ids = [v for b in got2 for v in b.to_pydict()["id"]]
+    assert ids == [4, 5, 6, 7]
+    assert cp.get_transfer_state("t-inc")["airbyte_state"] == \
+        {"users": {"cursor": 8}}
+
+
+def test_airbyte_needs_runtime():
+    from transferia_tpu.container import ContainerError
+
+    st = AirbyteStorage(AirbyteSourceParams(image="airbyte/source-x"))
+    if st.runner.available():  # docker present on this machine
+        pytest.skip("container runtime present")
+    with pytest.raises(ContainerError, match="no container runtime"):
+        st.table_list()
+
+
+DBT_FAKE = textwrap.dedent("""\
+    #!{python}
+    import json, os, sys
+    out = {{"argv": sys.argv[1:]}}
+    i = sys.argv.index("--profiles-dir")
+    out["profiles"] = open(os.path.join(sys.argv[i + 1],
+                                        "profiles.yml")).read()
+    open({record!r}, "w").write(json.dumps(out))
+    print("Completed successfully")
+""")
+
+
+def test_dbt_runs_after_snapshot(tmp_path):
+    from transferia_tpu.providers.postgres import PGTargetParams
+    from tests.recipes.fake_postgres import FakePG
+
+    record = str(tmp_path / "dbt_run.json")
+    script = tmp_path / "dbt"
+    script.write_text(DBT_FAKE.format(python=sys.executable,
+                                      record=record))
+    script.chmod(script.stat().st_mode | stat.S_IEXEC)
+    project = tmp_path / "proj"
+    project.mkdir()
+
+    pg = FakePG().start()
+    try:
+        store_src = __import__(
+            "transferia_tpu.providers.sample",
+            fromlist=["SampleSourceParams"],
+        ).SampleSourceParams(preset="users", table="users", rows=10,
+                             batch_rows=5)
+        t = Transfer(
+            id="dbt1", src=store_src,
+            dst=PGTargetParams(host="127.0.0.1", port=pg.port,
+                               database="dw", user="u"),
+            transformation={"transformers": [{"dbt": {
+                "project_path": str(project),
+                "operation": "build",
+                "runtime": "exec",
+                "exec_argv": [sys.executable, str(script)],
+            }}]},
+        )
+        activate_delivery(t, MemoryCoordinator())
+        assert os.path.exists(record), "dbt step did not run"
+        rec = json.loads(open(record).read())
+        assert rec["argv"][0] == "build"
+        assert str(project) in rec["argv"]
+        assert 'type: "postgres"' in rec["profiles"]
+        assert f"port: {pg.port}" in rec["profiles"]
+        # the snapshot landed BEFORE dbt ran
+        assert sum(len(tb.rows) for tb in pg.tables.values()) == 10
+    finally:
+        pg.stop()
+
+
+def test_dbt_never_joins_row_plans():
+    from transferia_tpu.transform import build_chain
+
+    chain = build_chain({"transformers": [
+        {"dbt": {"project_path": "/x", "runtime": "exec"}},
+        {"rename_tables": {"tables": [
+            {"from": "a.b", "to": "c.d"}]}},
+    ]})
+    from transferia_tpu.abstract.schema import new_table_schema
+
+    plan = chain.plan_for(TableID("a", "b"),
+                          new_table_schema([("id", "int64", True)]))
+    assert [s.TYPE for s in plan.steps] == ["rename_tables"]

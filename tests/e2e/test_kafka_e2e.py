@@ -178,8 +178,14 @@ def test_gzip_compression_roundtrip(broker):
     got, _hw = client.fetch("gz", 0, 0)
     assert len(got) == 50
     assert got[7].value == b"v7" * 20
-    # the stored batch really is gzip-framed (codec bits set)
-    raw = broker.records("gz", 0)
+    # the produced batch really is gzip-framed (codec attribute bits
+    # set at offset 21: base_offset 8 + len 4 + epoch 4 + magic 1 + crc 4)
+    import struct as _struct
+
+    blob = encode_record_batch(records, compression="gzip")
+    attrs = _struct.unpack_from("!h", blob, 21)[0]
+    assert attrs & 0x07 == 1, "gzip codec bit not set on the wire"
+    assert len(blob) < len(encode_record_batch(records))  # it compressed
     client.close()
 
 

@@ -60,15 +60,14 @@ def test_delta_source_reads_live_files(tmp_path):
     assert ids == [1, 2, 3, 4]  # removed file's 9s are gone
 
 
-def test_airbyte_gated():
-    from transferia_tpu.providers.misc_providers import (
-        AirbyteSourceParams,
-        AirbyteStorage,
-    )
+def test_airbyte_moved_to_real_module():
+    # the stub is gone; the real implementation lives in providers/airbyte
+    from transferia_tpu.providers import airbyte
 
-    st = AirbyteStorage(AirbyteSourceParams(image="airbyte/source-x"))
-    with pytest.raises(NotImplementedError, match="container runtime"):
-        st.table_list()
+    assert hasattr(airbyte.AirbyteStorage, "load_table")
+    import transferia_tpu.providers.misc_providers as mp
+
+    assert not hasattr(mp, "AirbyteStorage")
 
 
 def test_elastic_roundtrip_with_fake():

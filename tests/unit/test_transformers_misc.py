@@ -126,11 +126,12 @@ def test_yt_dict():
     assert out.to_pydict()["j"] == ['{"a": 2, "z": 1}']
 
 
-def test_dbt_gated():
+def test_dbt_is_a_config_carrier_not_a_row_transformer():
+    # real execution lives in transform/plugins/dbt.py (container runner,
+    # post-load hook); it must never join row plans
     from transferia_tpu.transform import make_transformer
 
-    t = make_transformer("dbt", {"profile": "x"})
+    t = make_transformer("dbt", {"project_path": "/x"})
     schema = new_table_schema([("id", "int64", True)])
-    b = ColumnBatch.from_pydict(TID, schema, {"id": [1]})
-    with pytest.raises(NotImplementedError, match="container"):
-        t.apply(b)
+    assert not t.suitable(TID, schema)
+    assert t.describe() == "dbt(run)"

@@ -162,6 +162,29 @@ class ShardingStorage(abc.ABC):
         ...
 
 
+class AsyncPartDiscovery(abc.ABC):
+    """Streams a table's parts while upload is already running — huge
+    object listings must not serialize activation
+    (table_part_provider/tpp_setter_async.go, storage.go:379-399)."""
+
+    @abc.abstractmethod
+    def iter_table_parts(self, table: TableDescription):
+        """Yield TableDescription parts lazily."""
+
+
+class ShardedStateStorage(abc.ABC):
+    """Consistent-point handoff from the main worker's storage to the
+    secondaries' (load_snapshot.go:607-671 SetShardedStateToSource)."""
+
+    @abc.abstractmethod
+    def sharded_state(self) -> dict:
+        ...
+
+    @abc.abstractmethod
+    def set_sharded_state(self, state: dict) -> None:
+        ...
+
+
 class SnapshotableStorage(abc.ABC):
     """Transactionally consistent snapshot bracket (storage.go:359)."""
 

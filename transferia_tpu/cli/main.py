@@ -189,12 +189,20 @@ def _load_transfer(args):
     return transfer
 
 
-def main(argv=None) -> int:
-    # die quietly when piped into head & co.
+def cli() -> int:
+    """Console-script entry (trtpu).  Process-wide signal tweaks live
+    HERE, not in main(): tests call main() in-process and a leaked
+    SIGPIPE=SIG_DFL would turn any broken-pipe write later in the run
+    into silent process death."""
     try:
+        # die quietly when piped into head & co.
         signal.signal(signal.SIGPIPE, signal.SIG_DFL)
     except (AttributeError, ValueError):
-        pass  # non-POSIX or non-main thread (tests)
+        pass  # non-POSIX
+    return main()
+
+
+def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     _setup(args)
 
@@ -366,4 +374,4 @@ def cmd_describe(args) -> int:
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    sys.exit(cli())

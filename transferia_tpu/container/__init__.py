@@ -1,0 +1,9 @@
+"""Container job launcher (reference: pkg/container/container.go)."""
+
+from transferia_tpu.container.runner import (
+    ContainerError,
+    ContainerRunner,
+    ContainerSpec,
+)
+
+__all__ = ["ContainerError", "ContainerRunner", "ContainerSpec"]

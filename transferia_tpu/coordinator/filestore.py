@@ -113,9 +113,30 @@ class FileStoreCoordinator(Coordinator):
                 cur.pop(k, None)
             self._write_json(p, cur)
 
+    # -- operation state ----------------------------------------------------
+    def set_operation_state(self, operation_id: str,
+                            state: dict[str, Any]) -> None:
+        p = os.path.join(self._odir(operation_id), "state.json")
+        with self._locked(p):
+            cur = self._read_json(p, {})
+            cur.update(state)
+            self._write_json(p, cur)
+
+    def get_operation_state(self, operation_id: str) -> dict[str, Any]:
+        p = os.path.join(self._odir(operation_id), "state.json")
+        return self._read_json(p, {})
+
     # -- operation parts ----------------------------------------------------
     def _parts_path(self, operation_id: str) -> str:
         return os.path.join(self._odir(operation_id), "parts.json")
+
+    def add_operation_parts(self, operation_id: str,
+                            parts: list[OperationTablePart]) -> None:
+        p = self._parts_path(operation_id)
+        with self._locked(p):
+            cur = self._read_json(p, [])
+            cur.extend(x.to_json() for x in parts)
+            self._write_json(p, cur)
 
     def create_operation_parts(self, operation_id: str,
                                parts: list[OperationTablePart]) -> None:

@@ -80,11 +80,27 @@ class Coordinator(abc.ABC):
                               keys: list[str]) -> None:
         ...
 
+    # -- operation state KV (OperationState group, coordinator.go:5-14) -----
+    def set_operation_state(self, operation_id: str,
+                            state: dict[str, Any]) -> None:
+        """Merge keys into the operation's state (e.g. the async-parts
+        discovery-done flag, sharded source state handoff)."""
+        raise NotImplementedError
+
+    def get_operation_state(self, operation_id: str) -> dict[str, Any]:
+        raise NotImplementedError
+
     # -- sharded snapshot operations (operation.go:40-68) --------------------
     @abc.abstractmethod
     def create_operation_parts(self, operation_id: str,
                                parts: list[OperationTablePart]) -> None:
         """Main worker publishes the part work-queue."""
+
+    def add_operation_parts(self, operation_id: str,
+                            parts: list[OperationTablePart]) -> None:
+        """Append parts to an existing queue (async part discovery streams
+        parts while upload runs — table_part_provider/tpp_setter_async.go)."""
+        raise NotImplementedError
 
     @abc.abstractmethod
     def assign_operation_part(self, operation_id: str,

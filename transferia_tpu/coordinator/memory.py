@@ -20,6 +20,7 @@ class MemoryCoordinator(Coordinator):
         self._status: dict[str, TransferStatus] = {}
         self._state: dict[str, dict[str, Any]] = {}
         self._parts: dict[str, list[OperationTablePart]] = {}
+        self._op_state: dict[str, dict[str, Any]] = {}
         self._messages: dict[str, list[tuple[str, str]]] = {}
         self.health_reports: list[tuple] = []
 
@@ -60,6 +61,16 @@ class MemoryCoordinator(Coordinator):
             for k in keys:
                 st.pop(k, None)
 
+    # -- operation state ----------------------------------------------------
+    def set_operation_state(self, operation_id: str,
+                            state: dict[str, Any]) -> None:
+        with self._lock:
+            self._op_state.setdefault(operation_id, {}).update(state)
+
+    def get_operation_state(self, operation_id: str) -> dict[str, Any]:
+        with self._lock:
+            return dict(self._op_state.get(operation_id, {}))
+
     # -- operation parts ----------------------------------------------------
     def create_operation_parts(self, operation_id: str,
                                parts: list[OperationTablePart]) -> None:
@@ -67,6 +78,13 @@ class MemoryCoordinator(Coordinator):
             self._parts[operation_id] = [
                 OperationTablePart.from_json(p.to_json()) for p in parts
             ]
+
+    def add_operation_parts(self, operation_id: str,
+                            parts: list[OperationTablePart]) -> None:
+        with self._lock:
+            self._parts.setdefault(operation_id, []).extend(
+                OperationTablePart.from_json(p.to_json()) for p in parts
+            )
 
     def assign_operation_part(self, operation_id: str, worker_index: int
                               ) -> Optional[OperationTablePart]:

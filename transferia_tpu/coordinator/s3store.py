@@ -141,7 +141,28 @@ class S3Coordinator(Coordinator):
 
         self._merge_json(key, drop)
 
+    # -- operation state ----------------------------------------------------
+    def set_operation_state(self, operation_id: str,
+                            state: dict[str, Any]) -> None:
+        key = self._key("operations", operation_id, "state.json")
+
+        def merge(cur: dict) -> dict:
+            cur.update(state)
+            return cur
+
+        self._merge_json(key, merge)
+
+    def get_operation_state(self, operation_id: str) -> dict[str, Any]:
+        d, _ = self._get_json(
+            self._key("operations", operation_id, "state.json"), {})
+        return d
+
     # -- operation parts ----------------------------------------------------
+    def add_operation_parts(self, operation_id: str,
+                            parts: list[OperationTablePart]) -> None:
+        # per-part objects: appending IS creating more objects
+        self.create_operation_parts(operation_id, parts)
+
     def _part_key_for(self, operation_id: str, schema: str, table: str,
                       part_index: int) -> str:
         import urllib.parse as _up
@@ -152,6 +173,13 @@ class S3Coordinator(Coordinator):
 
     def create_operation_parts(self, operation_id: str,
                                parts: list[OperationTablePart]) -> None:
+        # create REPLACES the queue: clear leftovers from a previous
+        # activation of the same operation id first (memory/filestore
+        # overwrite wholesale; per-part objects need explicit deletion)
+        prefix = self._key("operations", operation_id, "parts", "")
+        for obj in self.client.list(prefix):
+            self.client.delete(obj.key)
+        self._done_keys.pop(operation_id, None)
         for part in parts:
             key = self._part_key_for(
                 operation_id, part.table_id.namespace,

@@ -37,12 +37,48 @@ class DebeziumEmitter:
                  connector: str = "transferia-tpu",
                  include_schema: bool = True,
                  emit_tombstones: bool = False,
-                 source_db_type: str = "postgresql"):
+                 source_db_type: str = "postgresql",
+                 packer: str = "",
+                 topic: str = "",
+                 schema_registry_url: str = "",
+                 schema_registry_user: str = "",
+                 schema_registry_password: str = ""):
+        """packer: '' -> include_schema flag decides (include_schema /
+        skip_schema); 'schema_registry' -> Confluent wire format
+        (pkg/debezium/packer/ parity).  topic: the sink's FIXED topic when
+        it writes into one topic — SR subjects derive from the topic the
+        messages actually land on (TopicNameStrategy); default is the
+        kafka sink's per-table naming '<namespace>.<table>'."""
+        self.sink_topic = topic
         self.topic_prefix = topic_prefix
         self.connector = connector
         self.include_schema = include_schema
         self.emit_tombstones = emit_tombstones
         self.source_db_type = source_db_type
+        self.key_packer = self.value_packer = None
+        if packer == "schema_registry":
+            from transferia_tpu.debezium.packer import SchemaRegistryPacker
+            from transferia_tpu.schemaregistry import SchemaRegistryClient
+
+            client = SchemaRegistryClient(
+                schema_registry_url, user=schema_registry_user,
+                password=schema_registry_password)
+            self.key_packer = SchemaRegistryPacker(client, is_key=True)
+            self.value_packer = SchemaRegistryPacker(client, is_key=False)
+        elif packer not in ("", "include_schema", "skip_schema"):
+            raise ValueError(f"unknown debezium packer {packer!r}")
+        elif packer:
+            self.include_schema = packer == "include_schema"
+
+    def topic_for(self, item: ChangeItem) -> str:
+        """The topic this item's message lands on: the sink's fixed topic
+        when configured, else the kafka sink's per-table '<ns>.<table>'.
+        SR subject names must match this (TopicNameStrategy), or
+        consumers looking up '<actual-topic>-value' find nothing."""
+        if self.sink_topic:
+            return self.sink_topic
+        return f"{item.schema}.{item.table}" if item.schema \
+            else item.table
 
     # -- schema blocks (cached per table schema fingerprint) ---------------
     def _value_schema(self, item: ChangeItem, schema: TableSchema) -> dict:
@@ -154,6 +190,17 @@ class DebeziumEmitter:
             "op": op,
             "ts_ms": int(time.time() * 1000),
         }
+        if self.value_packer is not None:
+            # Confluent wire format: schemas live in the registry
+            topic = self.topic_for(item)
+            key_b = self.key_packer.pack(
+                topic, self._key_schema(item, schema), key_vals)
+            value_b = self.value_packer.pack(
+                topic, self._value_schema(item, schema), value_payload)
+            out = [(key_b, value_b)]
+            if item.kind == Kind.DELETE and self.emit_tombstones:
+                out.append((key_b, None))
+            return out
         if self.include_schema:
             key_obj = {"schema": self._key_schema(item, schema),
                        "payload": key_vals}

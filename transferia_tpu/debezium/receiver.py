@@ -28,8 +28,11 @@ _OPS = {"c": Kind.INSERT, "r": Kind.INSERT, "u": Kind.UPDATE,
 
 
 class DebeziumReceiver:
-    def __init__(self):
+    def __init__(self, unpacker=None):
+        """unpacker: debezium.packer.Unpacker for Confluent wire-format
+        messages (magic 0x00 + schema id frame); plain JSON otherwise."""
         self._schema_cache: dict[str, TableSchema] = {}
+        self.unpacker = unpacker
 
     # -- schema -------------------------------------------------------------
     def _connect_to_colschema(self, f: dict, keys: set[str]) -> ColSchema:
@@ -104,8 +107,20 @@ class DebeziumReceiver:
         """One Debezium value (+key) -> ChangeItem (None for tombstones)."""
         if not value:
             return None
-        obj = json.loads(value)
-        key_obj = json.loads(key) if key else None
+        if value[:1] == b"\x00" and self.unpacker is not None:
+            vblock, payload_obj = self.unpacker.unpack(value)
+            obj = ({"schema": vblock, "payload": payload_obj}
+                   if vblock is not None else payload_obj)
+            key_obj = None
+            if key and key[:1] == b"\x00":
+                kblock, kpayload = self.unpacker.unpack(key)
+                key_obj = ({"schema": kblock, "payload": kpayload}
+                           if kblock is not None else kpayload)
+            elif key:
+                key_obj = json.loads(key)
+        else:
+            obj = json.loads(value)
+            key_obj = json.loads(key) if key else None
 
         if isinstance(obj, dict) and "payload" in obj and "schema" in obj:
             payload = obj["payload"]

@@ -79,10 +79,21 @@ class DebeziumParser(Parser):
     """Debezium envelopes -> ChangeItems -> columnar blocks
     (registry/debezium + engine)."""
 
-    def __init__(self, **kw):
+    def __init__(self, schema_registry_url: str = "",
+                 schema_registry_user: str = "",
+                 schema_registry_password: str = "", **kw):
         from transferia_tpu.debezium import DebeziumReceiver
 
-        self.receiver = DebeziumReceiver()
+        unpacker = None
+        if schema_registry_url:
+            # Confluent wire-format messages (0x00 + schema id frame)
+            from transferia_tpu.debezium.packer import Unpacker
+            from transferia_tpu.schemaregistry import SchemaRegistryClient
+
+            unpacker = Unpacker(SchemaRegistryClient(
+                schema_registry_url, user=schema_registry_user,
+                password=schema_registry_password))
+        self.receiver = DebeziumReceiver(unpacker=unpacker)
 
     def do_batch(self, messages: Sequence[Message]) -> ParseResult:
         items: list[ChangeItem] = []

@@ -32,6 +32,7 @@ def load_builtin_providers() -> None:
         stdout,
     )
     from transferia_tpu.providers import (  # noqa: F401
+        airbyte,
         clickhouse,
         elastic,
         greenplum,

@@ -176,9 +176,12 @@ class KafkaSinker(Sinker):
     def __init__(self, params: KafkaTargetParams):
         self.params = params
         self.client = _make_client(params)
-        self.serializer = make_queue_serializer(
-            params.serializer, **(params.serializer_config or {})
-        )
+        cfg = dict(params.serializer_config or {})
+        if params.serializer == "debezium" and params.topic:
+            # single-topic sinks: SR subjects must derive from the real
+            # topic (TopicNameStrategy)
+            cfg.setdefault("topic", params.topic)
+        self.serializer = make_queue_serializer(params.serializer, **cfg)
         self._partitions: dict[str, list[int]] = {}
 
     def _topic_partitions(self, topic: str) -> list[int]:

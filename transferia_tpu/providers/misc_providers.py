@@ -1,7 +1,7 @@
 """Remaining inventory providers: BigQuery, Delta Lake, log-shipping
-sinks (Coralogix/Datadog), and the container-gated Airbyte runner.
+sinks (Coralogix/Datadog).  Airbyte lives in providers/airbyte.py.
 
-Reference parity: pkg/providers/{bigquery,delta,coralogix,datadog,airbyte}.
+Reference parity: pkg/providers/{bigquery,delta,coralogix,datadog}.
 """
 
 from __future__ import annotations
@@ -339,60 +339,4 @@ class DatadogProvider(Provider):
         return None
 
 
-# ---------------------------------------------------------------------------
-# Airbyte runner (pkg/providers/airbyte + pkg/container) — container-gated
-# ---------------------------------------------------------------------------
-
-@register_endpoint
-@dataclass
-class AirbyteSourceParams(EndpointParams):
-    PROVIDER = "airbyte"
-    IS_SOURCE = True
-
-    image: str = ""              # airbyte connector container image
-    config: dict = field(default_factory=dict)
-    table: str = "airbyte"
-
-
-class AirbyteStorage(Storage):
-    """Runs an Airbyte connector container (docker/podman) in `read` mode
-    and ingests its AirbyteRecordMessage stream.  This environment ships no
-    container runtime; construction validates config and run fails with a
-    clear gating error (docs/architecture-overview.md:232-255)."""
-
-    def __init__(self, params: AirbyteSourceParams):
-        self.params = params
-        self.table = TableID("airbyte", params.table)
-
-    def _runtime(self) -> str:
-        import shutil
-
-        for rt in ("docker", "podman"):
-            if shutil.which(rt):
-                return rt
-        raise NotImplementedError(
-            "airbyte provider needs a container runtime (docker/podman) on "
-            "the worker; none found in PATH"
-        )
-
-    def table_list(self, include=None):
-        self._runtime()
-        return {}
-
-    def table_schema(self, table: TableID) -> TableSchema:
-        self._runtime()
-        raise NotImplementedError
-
-    def load_table(self, table: TableDescription, pusher: Pusher) -> None:
-        self._runtime()
-        raise NotImplementedError
-
-
-@register_provider
-class AirbyteProvider(Provider):
-    NAME = "airbyte"
-
-    def storage(self):
-        if isinstance(self.transfer.src, AirbyteSourceParams):
-            return AirbyteStorage(self.transfer.src)
-        return None
+# Airbyte moved to providers/airbyte.py (real container runner)

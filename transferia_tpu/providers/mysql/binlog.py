@@ -641,10 +641,13 @@ class MySQLBinlogSource(Source):
 
     def _dump_gtid(self, conn: MySQLConnection, file: str, pos: int,
                    gtid_set: "GtidSet") -> None:
-        """COM_BINLOG_DUMP_GTID (0x1e): resume from an executed set."""
+        """COM_BINLOG_DUMP_GTID (0x1e): resume from an executed set.
+
+        flags carries BINLOG_THROUGH_GTID (0x04) — without it a real
+        server ignores the GTID data and resumes by file+pos."""
         conn._seq = 0
         data = gtid_set.encode()
-        body = (struct.pack("<BHI", 0x1E, 0, self.server_id)
+        body = (struct.pack("<BHI", 0x1E, 0x04, self.server_id)
                 + struct.pack("<I", len(file)) + file.encode()
                 + struct.pack("<Q", max(4, pos))
                 + struct.pack("<I", len(data)) + data)

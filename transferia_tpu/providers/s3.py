@@ -22,6 +22,7 @@ from typing import Optional
 
 from transferia_tpu.abstract.errors import CategorizedError
 from transferia_tpu.abstract.interfaces import (
+    AsyncPartDiscovery,
     Batch,
     Pusher,
     ShardingStorage,
@@ -123,7 +124,7 @@ def _fs_for(url: str, params) -> tuple[object, str]:
     return fs, path
 
 
-class S3Storage(Storage, ShardingStorage):
+class S3Storage(Storage, ShardingStorage, AsyncPartDiscovery):
     def __init__(self, params: S3SourceParams):
         self.params = params
         self.table = TableID(params.namespace, params.table)
@@ -194,6 +195,16 @@ class S3Storage(Storage, ShardingStorage):
             out.append(TableDescription(id=table.id, filter=f"obj:{f}",
                                         eta_rows=eta))
         return out
+
+    def iter_table_parts(self, table: TableDescription):
+        """Stream per-object parts while upload runs (huge listings must
+        not serialize activation — tpp_setter_async.go parity)."""
+        for f in self.files():
+            eta = 0
+            if self.params.format == "parquet":
+                eta = self.reader.estimate_rows(self.fs, f)
+            yield TableDescription(id=table.id, filter=f"obj:{f}",
+                                   eta_rows=eta)
 
     def load_table(self, table: TableDescription, pusher: Pusher) -> None:
         files = [table.filter[4:]] if table.filter.startswith("obj:") \

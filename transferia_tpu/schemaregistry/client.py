@@ -30,14 +30,17 @@ class SchemaRegistryClient:
         self.timeout = timeout
         self._cache: dict[int, dict] = {}
 
-    def _get(self, path: str) -> dict:
+    def _request(self, method: str, path: str,
+                 body: Optional[dict] = None) -> dict:
         import http.client
 
         cls = http.client.HTTPSConnection if self.secure \
             else http.client.HTTPConnection
         conn = cls(self.host, self.port, timeout=self.timeout)
         try:
-            headers = {}
+            headers = {
+                "Content-Type": "application/vnd.schemaregistry.v1+json",
+            }
             if self.user:
                 import base64
 
@@ -45,7 +48,10 @@ class SchemaRegistryClient:
                     f"{self.user}:{self.password}".encode()
                 ).decode()
                 headers["Authorization"] = f"Basic {cred}"
-            conn.request("GET", self.base + path, headers=headers)
+            payload = json.dumps(body).encode() if body is not None \
+                else None
+            conn.request(method, self.base + path, body=payload,
+                         headers=headers)
             resp = conn.getresponse()
             data = resp.read()
             if resp.status != 200:
@@ -59,6 +65,19 @@ class SchemaRegistryClient:
                           f"schema registry unreachable: {e}") from e
         finally:
             conn.close()
+
+    def _get(self, path: str) -> dict:
+        return self._request("GET", path)
+
+    def register_schema(self, subject: str, schema: str,
+                        schema_type: str = "JSON") -> int:
+        """POST /subjects/<subject>/versions -> schema id (idempotent on
+        the registry side for identical schemas)."""
+        out = self._request(
+            "POST", f"/subjects/{subject}/versions",
+            {"schema": schema, "schemaType": schema_type},
+        )
+        return int(out["id"])
 
     def schema_by_id(self, schema_id: int) -> dict:
         """Raw registry entry: {"schema": "...", "schemaType": "JSON"|...}"""

@@ -83,6 +83,16 @@ def activate_delivery(transfer, coordinator: Coordinator,
                     ActivateCallbacks(cleanup_cb, lambda _t: None,
                                       rollbacks)
                 )
+        # dbt steps run against the target once the snapshot landed
+        # (reference: registry/dbt pluggable_transformer at sink Close,
+        # main worker only) — never for replication-only transfers where
+        # no snapshot exists to transform
+        if transfer.type != TransferType.INCREMENT_ONLY:
+            from transferia_tpu.transform.plugins.dbt import (
+                run_dbt_transformations,
+            )
+
+            run_dbt_transformations(transfer, coordinator)
         rollbacks.cancel()
         coordinator.set_status(transfer.id, TransferStatus.ACTIVATED)
         coordinator.set_transfer_state(transfer.id, {"status": "activated"})

@@ -26,11 +26,18 @@ from transferia_tpu.abstract.change_item import (
     init_sharded_table_load,
     init_table_load,
 )
-from transferia_tpu.abstract.errors import TableUploadError, is_fatal
+from transferia_tpu.abstract.errors import (
+    CodedError,
+    Codes,
+    TableUploadError,
+    is_fatal,
+)
 from transferia_tpu.abstract.interfaces import (
+    AsyncPartDiscovery,
     IncrementalStorage,
     IncrementalTable,
     PositionalStorage,
+    ShardedStateStorage,
     SnapshotableStorage,
     Storage,
     resolve_all,
@@ -132,15 +139,49 @@ class SnapshotLoader:
                         self.transfer.id, {"snapshot_position": pos}
                     )
             tables, next_inc_state = self._apply_incremental(storage, tables)
-            parts = split_tables(storage, tables, self.transfer,
-                                 self.operation_id)
-            self.cp.create_operation_parts(self.operation_id, parts)
-            self.table_stats.total_parts.set(len(parts))
-            self.table_stats.eta_rows.set(sum(p.eta_rows for p in parts))
-
-            multi_part = {
-                p.table_id for p in parts if p.parts_count > 1
-            }
+            # main-worker restart detection (load_snapshot.go:496-501):
+            # an INCOMPLETE queue means a previous main crashed mid-
+            # operation with secondaries possibly still attached.  A fully
+            # completed queue is just the previous successful activation —
+            # recreate and run (re-activation must not wedge).
+            existing = self.cp.operation_parts(self.operation_id) \
+                if self.job_count() > 1 else []
+            if existing and not all(p.completed for p in existing):
+                raise CodedError(
+                    Codes.MAIN_WORKER_RESTART,
+                    f"operation {self.operation_id} has incomplete parts: "
+                    f"the main worker restarted mid-operation",
+                )
+            if isinstance(storage, ShardedStateStorage) and \
+                    self.job_count() > 1:
+                # consistent-point handoff to secondaries' storages
+                self.cp.set_operation_state(self.operation_id, {
+                    "sharded_state": storage.sharded_state(),
+                })
+            # a fresh run must reset the discovery flag (a re-activation
+            # would otherwise see the previous run's True and drain early)
+            self.cp.set_operation_state(self.operation_id,
+                                        {"parts_discovery_done": False})
+            discovery = None
+            if isinstance(storage, AsyncPartDiscovery):
+                # reset the queue (re-activation leftovers) before parts
+                # stream in via add_operation_parts
+                self.cp.create_operation_parts(self.operation_id, [])
+                discovery = self._start_async_discovery(storage, tables)
+                parts = []
+                multi_part = {td.id for td in tables}
+            else:
+                parts = split_tables(storage, tables, self.transfer,
+                                     self.operation_id)
+                self.cp.create_operation_parts(self.operation_id, parts)
+                self.cp.set_operation_state(self.operation_id,
+                                            {"parts_discovery_done": True})
+                self.table_stats.total_parts.set(len(parts))
+                self.table_stats.eta_rows.set(
+                    sum(p.eta_rows for p in parts))
+                multi_part = {
+                    p.table_id for p in parts if p.parts_count > 1
+                }
             schemas = {td.id: storage.table_schema(td.id) for td in tables}
             sink = make_async_sink(self.transfer, self.metrics,
                                    snapshot_stage=True)
@@ -153,6 +194,10 @@ class SnapshotLoader:
                 ]
                 resolve_all(futs)
                 self._do_upload_tables(storage, schemas)
+                if discovery is not None:
+                    discovery.join()
+                    if self._discovery_error:
+                        raise self._discovery_error
                 if self.job_count() > 1:
                     self._wait_all_parts_done()
                 futs = [
@@ -177,6 +222,62 @@ class SnapshotLoader:
     def job_count(self) -> int:
         return max(1, self.transfer.runtime.sharding.job_count)
 
+    # -- async part discovery (tpp_setter_async.go) -------------------------
+    def _start_async_discovery(self, storage: AsyncPartDiscovery,
+                               tables: list[TableDescription]
+                               ) -> threading.Thread:
+        """Publish parts concurrently with upload: huge table/object lists
+        must not serialize activation.  Upload workers spin on the part
+        queue until parts_discovery_done flips."""
+        self._discovery_error: Optional[BaseException] = None
+
+        def discover():
+            total = 0
+            eta = 0
+            try:
+                for td in tables:
+                    batch: list[OperationTablePart] = []
+                    last_flush = time.monotonic()
+                    for part_td in storage.iter_table_parts(td):
+                        batch.append(OperationTablePart(
+                            operation_id=self.operation_id,
+                            table_id=td.id,
+                            part_index=total,
+                            parts_count=0,  # unknown until drained
+                            eta_rows=part_td.eta_rows,
+                            filter=part_td.filter,
+                        ))
+                        total += 1
+                        eta += part_td.eta_rows
+                        # flush by count OR age: workers must see parts
+                        # promptly even when discovery trickles
+                        if len(batch) >= 64 or \
+                                time.monotonic() - last_flush > 0.1:
+                            self.cp.add_operation_parts(
+                                self.operation_id, batch)
+                            batch = []
+                            last_flush = time.monotonic()
+                    if batch:
+                        self.cp.add_operation_parts(self.operation_id,
+                                                    batch)
+                self.table_stats.total_parts.set(total)
+                self.table_stats.eta_rows.set(eta)
+                logger.info("async discovery: %d parts published", total)
+            except BaseException as e:  # propagate into the main flow
+                self._discovery_error = e
+            finally:
+                self.cp.set_operation_state(
+                    self.operation_id, {"parts_discovery_done": True})
+
+        t = threading.Thread(target=discover, name="part-discovery",
+                             daemon=True)
+        t.start()
+        return t
+
+    def _discovery_open(self) -> bool:
+        return not self.cp.get_operation_state(self.operation_id).get(
+            "parts_discovery_done")
+
     def _wait_all_parts_done(self, poll: float = 0.5,
                              timeout: float = 24 * 3600.0) -> None:
         """Main worker waits for secondaries to drain the queue
@@ -196,7 +297,8 @@ class SnapshotLoader:
     # -- secondary worker -------------------------------------------------------
     def _secondary_flow(self, storage: Storage) -> None:
         """Sharded secondary (load_snapshot.go:607): wait for the part queue,
-        clear stale self-assignments (restart recovery), pull and upload."""
+        apply the main's sharded source state, clear stale
+        self-assignments (restart recovery), pull and upload."""
         deadline = time.monotonic() + 600
         while not self.cp.operation_parts(self.operation_id):
             if time.monotonic() > deadline:
@@ -205,6 +307,13 @@ class SnapshotLoader:
                     f"published parts"
                 )
             time.sleep(0.2)
+        if isinstance(storage, ShardedStateStorage):
+            state = self.cp.get_operation_state(self.operation_id).get(
+                "sharded_state")
+            if state is not None:
+                # read from the main's consistent point
+                # (SetShardedStateToSource, load_snapshot.go:607-671)
+                storage.set_sharded_state(state)
         released = self.cp.clear_assigned_parts(self.operation_id,
                                                 self.worker_index)
         if released:
@@ -221,7 +330,10 @@ class SnapshotLoader:
         errors: list[BaseException] = []
         err_lock = threading.Lock()
 
+        discovery_done = [False]  # latched: the flag never reverts
+
         def worker():
+            idle_sleep = 0.05
             while True:
                 with err_lock:
                     if errors:
@@ -230,7 +342,18 @@ class SnapshotLoader:
                     self.operation_id, self.worker_index
                 )
                 if part is None:
+                    if not discovery_done[0]:
+                        if not self._discovery_open():
+                            discovery_done[0] = True
+                            continue  # drain race: one last assign pass
+                        # async discovery still streaming parts in;
+                        # back off so a slow listing doesn't turn N
+                        # drained workers into a coordinator hot loop
+                        time.sleep(idle_sleep)
+                        idle_sleep = min(1.0, idle_sleep * 2)
+                        continue
                     return
+                idle_sleep = 0.05
                 try:
                     self._upload_part_with_retry(storage, part, schemas)
                 except BaseException as e:

@@ -7,6 +7,7 @@ reference's init() side-effect registration.
 from transferia_tpu.transform.plugins import (  # noqa: F401
     ch_sql,
     convert,
+    dbt,
     filter as filter_plugin,
     lambda_tf,
     logger_tf,

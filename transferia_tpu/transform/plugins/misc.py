@@ -321,38 +321,6 @@ def _custom(cfg: dict) -> Transformer:
     return LambdaTransformer(**cfg)
 
 
-@register_transformer("dbt")
-class DbtTransformer(Transformer):
-    """dbt-in-container transform (registry/dbt + pkg/container).
-
-    Requires a container runtime, which this environment does not ship —
-    construction succeeds (configs validate) but activation fails with a
-    clear gating error rather than a silent no-op.
-    """
-
-    def __init__(self, profile: str = "", project_path: str = "",
-                 operation: str = "run", **_):
-        self.profile = profile
-        self.project_path = project_path
-        self.operation = operation
-
-    def suitable(self, table: TableID, schema: TableSchema) -> bool:
-        return True
-
-    def apply(self, batch: ColumnBatch) -> TransformResult:
-        import shutil
-
-        if shutil.which("docker") is None and \
-                shutil.which("podman") is None:
-            raise NotImplementedError(
-                "dbt transformer needs a container runtime (docker/podman) "
-                "on the worker; none found"
-            )
-        raise NotImplementedError(
-            "dbt container execution is not wired in this build"
-        )
-
-
 @register_transformer("yt_dict")
 class YtDictTransformer(Transformer):
     """YT dict/any normalization (registry/yt_dict): stringifies ANY
