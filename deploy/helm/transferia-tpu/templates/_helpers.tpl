@@ -1,0 +1,26 @@
+{{- define "transferia.fullname" -}}
+{{- .Release.Name | trunc 53 | trimSuffix "-" -}}
+{{- end -}}
+
+{{/* the shared trtpu argv tail: coordinator + sharding + observability */}}
+{{- define "transferia.commonFlags" -}}
+--coordinator {{ .Values.coordinator.type }}
+{{- if eq .Values.coordinator.type "s3" }} --coordinator-bucket "$(COORDINATOR_BUCKET)" --coordinator-endpoint "$(COORDINATOR_ENDPOINT)" --coordinator-region {{ .Values.coordinator.region }} --coordinator-prefix "{{ .Values.coordinator.prefix }}"{{ end }}
+{{- if eq .Values.coordinator.type "filestore" }} --coordinator-dir /coordinator{{ end }}
+ --process-count {{ .Values.parallelism.processCount }} --metrics-port {{ .Values.metricsPort }} --health-port {{ .Values.healthPort }}
+{{- end -}}
+
+{{- define "transferia.env" -}}
+- name: COORDINATOR_BUCKET
+  value: {{ .Values.coordinator.bucket | quote }}
+- name: COORDINATOR_ENDPOINT
+  value: {{ .Values.coordinator.endpoint | quote }}
+{{- if eq .Values.coordinator.type "s3" }}
+- name: AWS_ACCESS_KEY_ID
+  valueFrom:
+    secretKeyRef: {name: {{ .Values.coordinator.credentialsSecret }}, key: access_key}
+- name: AWS_SECRET_ACCESS_KEY
+  valueFrom:
+    secretKeyRef: {name: {{ .Values.coordinator.credentialsSecret }}, key: secret_key}
+{{- end }}
+{{- end -}}

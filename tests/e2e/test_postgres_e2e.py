@@ -133,3 +133,52 @@ def test_pg_cdc_rows_applied(fake_pg):
     rows = fake_pg.tables[("public", "cdc")].rows
     assert rows == [{"id": "2", "v": "b2"}]
     sinker.close()
+
+
+def test_pg_ddl_objects_transfer(fake_pg):
+    """pg_dump.go parity: indexes/views/sequences move to a PG target
+    after the snapshot (pk indexes skipped, idempotent forms)."""
+    from transferia_tpu.coordinator import MemoryCoordinator
+    from transferia_tpu.models import Transfer
+    from transferia_tpu.providers.postgres import (
+        PGSourceParams,
+        PGTargetParams,
+    )
+    from transferia_tpu.tasks import activate_delivery
+    from tests.recipes.fake_postgres import FakePG
+
+    fake_pg.indexes.extend([
+        ("public", "src_t", "src_t_pkey",
+         "CREATE UNIQUE INDEX src_t_pkey ON public.src_t (id)"),
+        ("public", "src_t", "src_t_v_idx",
+         "CREATE INDEX src_t_v_idx ON public.src_t (v)"),
+    ])
+    fake_pg.views.append(
+        ("public", "v_active", "SELECT id, v FROM public.src_t"))
+    fake_pg.sequences.append(("public", "src_t_id_seq", 1, 1, 42))
+
+    dst = FakePG().start()
+    try:
+        t = Transfer(
+            id="ddl1",
+            src=PGSourceParams(host="127.0.0.1", port=fake_pg.port,
+                               database="db", user="u",
+                               transfer_ddl=True),
+            dst=PGTargetParams(host="127.0.0.1", port=dst.port,
+                               database="dw", user="u"),
+        )
+        activate_delivery(t, MemoryCoordinator())
+        ddl = dst.executed_ddl
+        assert any("CREATE INDEX IF NOT EXISTS src_t_v_idx" in s
+                   for s in ddl), ddl
+        assert not any("src_t_pkey" in s for s in ddl)  # pk skipped
+        assert any('CREATE OR REPLACE VIEW "public"."v_active"' in s
+                   for s in ddl)
+        assert any('CREATE SEQUENCE IF NOT EXISTS "public".'
+                   '"src_t_id_seq"' in s for s in ddl)
+        assert any('setval(\'"public"."src_t_id_seq"\', 42)' in s
+                   for s in ddl)
+        # and the rows landed before the DDL hook ran
+        assert sum(len(tb.rows) for tb in dst.tables.values()) > 0
+    finally:
+        dst.stop()

@@ -50,6 +50,14 @@ class FakePG:
         self.wal: list[tuple[int, bytes]] = []   # (lsn, wal2json payload)
         self.flushed_lsn = 0                     # last standby-status flush
         self.wal_event = threading.Event()
+        # DDL-object catalog served via pg_indexes/pg_views/pg_sequences
+        self.indexes: list[tuple[str, str, str, str]] = []
+        #   (schema, table, indexname, indexdef)
+        self.views: list[tuple[str, str, str]] = []
+        #   (schema, viewname, definition)
+        self.sequences: list[tuple[str, str, int, int, int]] = []
+        #   (schema, seqname, start, increment, last_value)
+        self.executed_ddl: list[str] = []
 
     def feed_wal(self, payload: bytes, lsn: int | None = None) -> None:
         """Append one wal2json message for streaming to subscribers."""
@@ -296,6 +304,35 @@ class _Session:
             return self.copy_out(sql)
         if low.startswith("copy ") and "from stdin" in low:
             return self.copy_in(sql)
+        if "from pg_indexes" in low:
+            with fake.lock:
+                rows = [[s_, t_, n_, d_] for s_, t_, n_, d_
+                        in fake.indexes]
+            return self.send_rows(
+                ["schemaname", "tablename", "indexname", "indexdef"],
+                rows)
+        if "from pg_views" in low:
+            with fake.lock:
+                rows = [[s_, v_, d_] for s_, v_, d_ in fake.views]
+            return self.send_rows(
+                ["schemaname", "viewname", "definition"], rows)
+        if "from pg_sequences" in low:
+            with fake.lock:
+                rows = [[s_, n_, st, inc, lv] for s_, n_, st, inc, lv
+                        in fake.sequences]
+            return self.send_rows(
+                ["schemaname", "sequencename", "start_value",
+                 "increment_by", "last_value"], rows)
+        if low.startswith("select setval("):
+            with fake.lock:
+                fake.executed_ddl.append(sql)
+            return self.send_rows(["setval"], [[1]])
+        if low.startswith(("create index", "create unique index",
+                           "create or replace view",
+                           "create sequence")):
+            with fake.lock:
+                fake.executed_ddl.append(sql)
+            return self.send(b"C", b"OK\x00")
         if low.startswith(("create ", "drop ", "truncate ")):
             self.apply_ddl(sql)
             return self.send(b"C", b"OK\x00")

@@ -154,3 +154,58 @@ def test_elastic_roundtrip_with_fake():
         assert {d["msg"] for d in all_docs} == {"hello", "world"}
     finally:
         srv.shutdown()
+
+
+def test_memory_watchdog_thresholds():
+    from transferia_tpu.runtime.limits import (
+        MemoryWatchdog,
+        cgroup_memory_limit,
+    )
+
+    rss = {"v": 100}
+    pressured = []
+    wd = MemoryWatchdog(
+        1000, soft_fraction=0.8, hard_fraction=0.95, interval=999,
+        on_pressure=lambda r, lim: pressured.append((r, lim)),
+        rss_fn=lambda: rss["v"],
+    )
+    assert wd.check_once() == "ok"
+    rss["v"] = 850
+    assert wd.check_once() == "soft"
+    assert wd.soft_hits == 1 and not pressured
+    rss["v"] = 980
+    assert wd.check_once() == "hard"
+    assert pressured == [(980, 1000)]
+    # cgroup probe never raises, returns int or None
+    lim = cgroup_memory_limit()
+    assert lim is None or lim > 0
+
+
+def test_helm_chart_is_wellformed():
+    import os
+
+    import yaml
+
+    base = os.path.join(os.path.dirname(__file__), "..", "..",
+                        "deploy", "helm", "transferia-tpu")
+    chart = yaml.safe_load(open(os.path.join(base, "Chart.yaml")))
+    assert chart["name"] == "transferia-tpu"
+    values = yaml.safe_load(open(os.path.join(base, "values.yaml")))
+    assert values["coordinator"]["type"] == "s3"
+    assert values["parallelism"]["jobCount"] == 1
+    tpl = os.path.join(base, "templates")
+    names = set(os.listdir(tpl))
+    assert {"snapshot-job.yaml", "replication-statefulset.yaml",
+            "regular-snapshot-cronjob.yaml", "configmap.yaml",
+            "_helpers.tpl"} <= names
+    for f in names:
+        text = open(os.path.join(tpl, f)).read()
+        # every template control block opener has a matching end — an
+        # unbalanced pair would fail helm rendering in production
+        import re as _re
+
+        openers = len(_re.findall(
+            r"\{\{-?\s*(?:if|range|with|define)\b", text))
+        enders = len(_re.findall(r"\{\{-?\s*end\b", text))
+        assert openers == enders, f
+        assert "trtpu" in text or f.startswith("_") or "ConfigMap" in text

@@ -108,6 +108,10 @@ def _setup(args) -> None:
             logging.warning("prometheus_client missing; metrics disabled")
     if args.health_port:
         _start_health_server(args.health_port)
+    # cgroup-derived RAM budget (runtime/shared/limits.go parity)
+    from transferia_tpu.runtime.limits import apply_resource_limits
+
+    apply_resource_limits()
 
 
 def _start_health_server(port: int) -> None:

@@ -35,6 +35,7 @@ def load_builtin_providers() -> None:
         airbyte,
         clickhouse,
         elastic,
+        eventhub,
         greenplum,
         kafka,
         kinesis,

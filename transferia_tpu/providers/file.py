@@ -246,11 +246,16 @@ class FileSinker(Sinker):
         self._writers: dict[TableID, object] = {}
         self._counters: dict[TableID, int] = {}
 
+    def _base_name(self, tid: TableID) -> str:
+        # empty namespaces must not produce hidden ".name..." dotfiles
+        return f"{tid.namespace}.{tid.name}" if tid.namespace \
+            else tid.name
+
     def _out_path(self, tid: TableID, ext: str) -> str:
         self._counters[tid] = self._counters.get(tid, 0)
         return os.path.join(
             self.params.path,
-            f"{tid.namespace}.{tid.name}.{self._token}."
+            f"{self._base_name(tid)}.{self._token}."
             f"{self._counters[tid]:06d}.{ext}",
         )
 
@@ -288,7 +293,7 @@ class FileSinker(Sinker):
         elif self.params.format == "jsonl":
             path = os.path.join(
                 self.params.path,
-                f"{tid.namespace}.{tid.name}.{self._token}.jsonl",
+                f"{self._base_name(tid)}.{self._token}.jsonl",
             )
             with open(path, "a") as fh:
                 for row in batch.to_rows():

@@ -103,16 +103,29 @@ class _KafkaQueueClient:
 
     STATE_KEY = "kafka_offsets"
 
+    # one lock for ALL clients of a process: the partitioned strategy runs
+    # one client per partition against the same transfer-state blob, and a
+    # per-instance lock would let concurrent read-modify-writes lose
+    # another partition's committed offset
+    _commit_lock = threading.Lock()
+
     def __init__(self, params: KafkaSourceParams, transfer_id: str,
-                 coordinator: Optional[Coordinator]):
+                 coordinator: Optional[Coordinator],
+                 partitions: Optional[list[int]] = None):
+        """partitions: restrict to a subset (the partitioned replication
+        strategy runs one client per partition)."""
         self.params = params
         self.transfer_id = transfer_id
         self.cp = coordinator
         self.client = _make_client(params)
         meta = self.client.metadata([params.topic])
-        partitions = meta.get(params.topic)
-        if not partitions:
+        all_partitions = meta.get(params.topic)
+        if not all_partitions:
             raise KafkaError(f"topic {params.topic!r} not found")
+        if partitions is not None:
+            all_partitions = [p for p in all_partitions
+                              if p in set(partitions)]
+        partitions = all_partitions
         saved = {}
         if self.cp is not None:
             saved = self.cp.get_transfer_state(transfer_id).get(
@@ -128,7 +141,6 @@ class _KafkaQueueClient:
                 self.positions[p] = self.client.list_offsets(
                     params.topic, p, ts
                 )
-        self._lock = threading.Lock()
 
     def fetch(self, max_messages: int = 1024) -> list[FetchedBatch]:
         out = []
@@ -159,7 +171,7 @@ class _KafkaQueueClient:
     def commit(self, topic: str, partition: int, offset: int) -> None:
         if self.cp is None:
             return
-        with self._lock:
+        with _KafkaQueueClient._commit_lock:
             state = self.cp.get_transfer_state(self.transfer_id).get(
                 self.STATE_KEY, {}
             )
@@ -170,6 +182,16 @@ class _KafkaQueueClient:
 
     def close(self) -> None:
         self.client.close()
+
+
+def topic_partitions(params: KafkaSourceParams) -> list[int]:
+    """Partition ids of the source topic (partitioned strategy fan-out)."""
+    client = _make_client(params)
+    try:
+        meta = client.metadata([params.topic])
+        return sorted(meta.get(params.topic) or [])
+    finally:
+        client.close()
 
 
 class KafkaSinker(Sinker):

@@ -104,6 +104,8 @@ class PGSourceParams(EndpointParams):
     # first host that accepts a connection wins
     hosts: list[str] = field(default_factory=list)
     schemas: list[str] = field(default_factory=lambda: ["public"])
+    transfer_ddl: bool = False    # move indexes/views/sequences to a PG
+    #                               target post-load (pg_dump.go parity)
     batch_rows: int = 131_072
     desired_part_size_bytes: int = 256 << 20  # ctid split target
     slot_name: str = ""                        # replication slot (CDC)
@@ -527,6 +529,38 @@ class PostgresProvider(Provider):
                 coordinator=self.coordinator,
             )
         return None
+
+    def transfer_ddl_objects(self, dst_params) -> int:
+        """Post-upload hook (activation task): apply the source's
+        indexes/views/sequences on a PG target (pg_dump.go)."""
+        src = self.transfer.src
+        if not isinstance(src, PGSourceParams) or not src.transfer_ddl:
+            return 0
+        if not isinstance(dst_params, PGTargetParams):
+            logger.warning(
+                "transfer_ddl is PG->PG only; destination is %s",
+                getattr(dst_params, "PROVIDER", "?"))
+            return 0
+        from transferia_tpu.providers.postgres.pg_dump import (
+            apply_ddl_objects,
+            dump_ddl_objects,
+        )
+
+        src_conn = _conn(src)
+        try:
+            statements = dump_ddl_objects(src_conn, src.schemas)
+        finally:
+            src_conn.close()
+        if not statements:
+            return 0
+        dst_conn = _conn(dst_params)
+        try:
+            applied = apply_ddl_objects(dst_conn, statements)
+        finally:
+            dst_conn.close()
+        logger.info("transferred %d/%d ddl objects to the target",
+                    applied, len(statements))
+        return applied
 
     def deactivate(self) -> None:
         """Drop the replication slot (postgres Deactivator)."""

@@ -1,0 +1,127 @@
+"""Worker resource-limit management (reference: pkg/runtime/shared/
+limits.go — derive the RAM budget from the cgroup and keep the runtime
+under it; Go uses debug.SetMemoryLimit/SetGCPercent, here the equivalent
+levers are gc pressure + a watchdog that reacts before the OOM killer).
+
+apply_resource_limits() is called by the CLI at worker startup:
+- reads the cgroup (v2 memory.max / v1 limit_in_bytes) or an explicit
+  limit;
+- starts a watchdog thread that samples RSS; above the soft fraction it
+  forces a full gc.collect() and logs; above the hard fraction it calls
+  the on_pressure callback (default: log loudly — sinks' bufferers also
+  see memory pressure through the memthrottle middleware).
+"""
+
+from __future__ import annotations
+
+import gc
+import logging
+import os
+import threading
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+
+def cgroup_memory_limit() -> Optional[int]:
+    """Container memory limit in bytes, None when unlimited/unknown."""
+    for path in ("/sys/fs/cgroup/memory.max",
+                 "/sys/fs/cgroup/memory/memory.limit_in_bytes"):
+        try:
+            raw = open(path).read().strip()
+        except OSError:
+            continue
+        if raw in ("max", ""):
+            return None
+        try:
+            limit = int(raw)
+        except ValueError:
+            continue
+        # v1 reports a huge number when unlimited
+        if limit >= 1 << 60:
+            return None
+        return limit
+    return None
+
+
+def process_rss() -> int:
+    """Resident set size in bytes (/proc self)."""
+    try:
+        with open("/proc/self/statm") as fh:
+            pages = int(fh.read().split()[1])
+        return pages * os.sysconf("SC_PAGE_SIZE")
+    except (OSError, IndexError, ValueError):
+        return 0
+
+
+class MemoryWatchdog:
+    def __init__(self, limit_bytes: int,
+                 soft_fraction: float = 0.8,
+                 hard_fraction: float = 0.95,
+                 interval: float = 5.0,
+                 on_pressure: Optional[Callable[[int, int], None]] = None,
+                 rss_fn: Callable[[], int] = process_rss):
+        self.limit = limit_bytes
+        self.soft = int(limit_bytes * soft_fraction)
+        self.hard = int(limit_bytes * hard_fraction)
+        self.interval = interval
+        self.on_pressure = on_pressure
+        self.rss_fn = rss_fn
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.soft_hits = 0
+        self.hard_hits = 0
+
+    def check_once(self) -> str:
+        """One sample; returns 'ok' | 'soft' | 'hard' (tests call this)."""
+        rss = self.rss_fn()
+        if rss >= self.hard:
+            self.hard_hits += 1
+            logger.error(
+                "memory watchdog: rss %dMiB >= %d%% of the %dMiB limit",
+                rss >> 20, int(100 * self.hard / self.limit),
+                self.limit >> 20)
+            gc.collect()
+            if self.on_pressure is not None:
+                self.on_pressure(rss, self.limit)
+            return "hard"
+        if rss >= self.soft:
+            self.soft_hits += 1
+            logger.warning(
+                "memory watchdog: rss %dMiB above soft threshold "
+                "(%dMiB of %dMiB)", rss >> 20, self.soft >> 20,
+                self.limit >> 20)
+            gc.collect()
+            return "soft"
+        return "ok"
+
+    def start(self) -> "MemoryWatchdog":
+        def loop():
+            while not self._stop.wait(self.interval):
+                self.check_once()
+
+        self._thread = threading.Thread(target=loop, daemon=True,
+                                        name="memory-watchdog")
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def apply_resource_limits(limit_bytes: Optional[int] = None,
+                          on_pressure: Optional[Callable] = None
+                          ) -> Optional[MemoryWatchdog]:
+    """Start the watchdog from an explicit or cgroup-derived limit.
+    Returns None (and does nothing) when no limit is discoverable —
+    bare-metal runs stay unmanaged, like the reference outside k8s."""
+    limit = limit_bytes if limit_bytes is not None \
+        else cgroup_memory_limit()
+    if not limit:
+        logger.info("no memory limit discovered; watchdog disabled")
+        return None
+    # tame the allocator a bit under a limit, like SetGCPercent
+    gc.set_threshold(400, 10, 10)
+    wd = MemoryWatchdog(limit, on_pressure=on_pressure).start()
+    logger.info("memory watchdog armed at %dMiB (cgroup)", limit >> 20)
+    return wd

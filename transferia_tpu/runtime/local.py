@@ -56,6 +56,99 @@ class LocalWorker:
             self.source.stop()
 
 
+def is_partitioned_replication(transfer) -> bool:
+    """queue -> object-storage replication runs one pipeline per
+    partition (replicationstrategy/partitioned_strategy.go, chosen by
+    IsQueueToS3Replication in replication_sync_runtime.go:134-136)."""
+    src_p = getattr(transfer.src, "PROVIDER", "")
+    dst_p = getattr(transfer.dst, "PROVIDER", "")
+    return src_p in ("kafka", "eventhub") and dst_p in ("s3", "fs")
+
+
+class PartitionedWorker:
+    """One independent source+sink pipeline per topic partition: a slow
+    object flush on one partition never backpressures the others, and
+    per-partition file rotation gets clean offset ranges."""
+
+    def __init__(self, transfer, coordinator: Coordinator,
+                 metrics: Optional[Metrics] = None):
+        self.transfer = transfer
+        self.cp = coordinator
+        self.metrics = metrics or Metrics()
+        self._pipelines: list = []  # (source, sink)
+        self._stopped = threading.Event()
+        self._plock = threading.Lock()  # guards pipelines vs stop()
+
+    def _kafka_params(self):
+        src = self.transfer.src
+        if getattr(src, "PROVIDER", "") == "eventhub":
+            return src.to_kafka_params()
+        return src
+
+    def run(self) -> None:
+        from transferia_tpu.providers.kafka.provider import (
+            _KafkaQueueClient,
+            topic_partitions,
+        )
+        from transferia_tpu.providers.queue_common import QueueSource
+
+        params = self._kafka_params()
+        partitions = topic_partitions(params)
+        if not partitions:
+            raise RuntimeError(f"topic {params.topic!r} has no partitions")
+        logger.info("partitioned replication: %d pipelines (%s)",
+                    len(partitions), partitions)
+        errors: list[BaseException] = []
+        err_lock = threading.Lock()
+        threads = []
+        for p in partitions:
+            if self._stopped.is_set():
+                break  # stop() fired while pipelines were being built
+            client = _KafkaQueueClient(params, self.transfer.id,
+                                       self.cp, partitions=[p])
+            source = QueueSource(
+                client, self.transfer.src.parser_config(),
+                parallelism=max(
+                    1, self.transfer.src.parallelism // len(partitions)),
+                metrics=self.metrics)
+            sink = make_async_sink(self.transfer, self.metrics,
+                                   snapshot_stage=False)
+            with self._plock:
+                self._pipelines.append((source, sink))
+                if self._stopped.is_set():
+                    # stop() already swept: this source would be missed
+                    source.stop()
+
+            def pump(src=source, snk=sink, part=p):
+                try:
+                    src.run(snk)
+                    if isinstance(snk, ErrorTracker) and snk.failure:
+                        raise snk.failure
+                except BaseException as e:
+                    with err_lock:
+                        errors.append(e)
+                    logger.warning("partition %d pipeline failed: %s",
+                                   part, e)
+                    self.stop()  # one failure restarts the attempt
+                finally:
+                    snk.close()
+
+            t = threading.Thread(target=pump, daemon=True,
+                                 name=f"partition-{p}")
+            threads.append(t)
+            t.start()
+        for t in threads:
+            t.join()
+        if errors:
+            raise errors[0]
+
+    def stop(self) -> None:
+        self._stopped.set()
+        with self._plock:
+            for source, _sink in self._pipelines:
+                source.stop()
+
+
 def run_replication(transfer, coordinator: Coordinator,
                     metrics: Optional[Metrics] = None,
                     stop_event: Optional[threading.Event] = None,
@@ -73,7 +166,9 @@ def run_replication(transfer, coordinator: Coordinator,
     attempt = 0
     while not stop_event.is_set():
         attempt += 1
-        worker = LocalWorker(transfer, coordinator, metrics)
+        worker = (PartitionedWorker(transfer, coordinator, metrics)
+                  if is_partitioned_replication(transfer)
+                  else LocalWorker(transfer, coordinator, metrics))
         coordinator.set_status(transfer.id, TransferStatus.RUNNING)
         stats.running.set(1)
 

@@ -83,6 +83,11 @@ def activate_delivery(transfer, coordinator: Coordinator,
                     ActivateCallbacks(cleanup_cb, lambda _t: None,
                                       rollbacks)
                 )
+        # pg_dump-style DDL objects (indexes/views/sequences) move to the
+        # target after rows land (pkg/providers/postgres/pg_dump.go)
+        if transfer.type != TransferType.INCREMENT_ONLY and \
+                hasattr(src_provider, "transfer_ddl_objects"):
+            src_provider.transfer_ddl_objects(transfer.dst)
         # dbt steps run against the target once the snapshot landed
         # (reference: registry/dbt pluggable_transformer at sink Close,
         # main worker only) — never for replication-only transfers where
