@@ -12,14 +12,17 @@ def hex_to_varwidth(hexes: np.ndarray, validity: Optional[np.ndarray]
     """(N, 64) hex digest matrix -> flat var-width column bytes+offsets.
 
     Invalid rows become empty strings (validity is preserved separately by
-    the caller's output Column).
+    the caller's output Column).  Caller contract: hexes is freshly owned
+    (device transfer / kernel output) — the all-valid fast path returns a
+    reshape VIEW instead of copying 64 bytes/row again.
     """
     n = hexes.shape[0]
     if validity is None:
         out_offsets = np.arange(n + 1, dtype=np.int64) * 64
         if out_offsets[-1] > 2**31 - 1:
             raise ValueError("hashed column exceeds 2GiB")
-        return hexes.reshape(-1).copy(), out_offsets.astype(np.int32)
+        flat = np.ascontiguousarray(hexes).reshape(-1)
+        return flat, out_offsets.astype(np.int32)
     lens = np.where(validity, 64, 0).astype(np.int64)
     out_offsets = np.zeros(n + 1, dtype=np.int64)
     np.cumsum(lens, out=out_offsets[1:])

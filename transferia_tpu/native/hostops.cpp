@@ -121,6 +121,114 @@ static inline uint32_t rotr32(uint32_t x, int n) {
     return (x >> n) | (x << (32 - n));
 }
 
+// ---- SHA-NI hardware path (x86 sha extensions; ~5-10x the scalar
+// compression).  Detected once at runtime; non-x86 or pre-SHA-NI CPUs
+// stay on the scalar path.
+#if defined(__x86_64__) || defined(__i386__)
+#include <immintrin.h>
+#include <cpuid.h>
+
+static int detect_sha_ni() {
+    unsigned int a, b, c, d;
+    if (__get_cpuid_count(7, 0, &a, &b, &c, &d)) {
+        return (b >> 29) & 1;  // EBX bit 29: SHA
+    }
+    return 0;
+}
+
+static int sha_ni_available() {
+    // magic-static init is thread-safe (ctypes calls run GIL-released,
+    // so concurrent first entries are real)
+    static const int cached = detect_sha_ni();
+    return cached;
+}
+
+__attribute__((target("sha,sse4.1")))
+static void sha256_compress_ni(uint32_t state[8], const uint8_t* p) {
+    const __m128i MASK = _mm_set_epi64x(0x0c0d0e0f08090a0bULL,
+                                        0x0405060700010203ULL);
+    // load state: ABEF/CDGH register layout
+    __m128i tmp = _mm_loadu_si128((const __m128i*)&state[0]);   // DCBA
+    __m128i s1  = _mm_loadu_si128((const __m128i*)&state[4]);   // HGFE
+    tmp = _mm_shuffle_epi32(tmp, 0xB1);                         // CDAB
+    s1  = _mm_shuffle_epi32(s1, 0x1B);                          // EFGH
+    __m128i st0 = _mm_alignr_epi8(tmp, s1, 8);                  // ABEF
+    __m128i st1 = _mm_blend_epi16(s1, tmp, 0xF0);               // CDGH
+    const __m128i abef_save = st0, cdgh_save = st1;
+
+    __m128i msg, msg0, msg1, msg2, msg3;
+#define QROUND(k_hi, k_lo, m)                                          \
+    msg = _mm_add_epi32(m, _mm_set_epi64x(k_hi, k_lo));                \
+    st1 = _mm_sha256rnds2_epu32(st1, st0, msg);                        \
+    msg = _mm_shuffle_epi32(msg, 0x0E);                                \
+    st0 = _mm_sha256rnds2_epu32(st0, st1, msg)
+
+    msg0 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i*)(p + 0)),
+                            MASK);
+    msg1 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i*)(p + 16)),
+                            MASK);
+    msg2 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i*)(p + 32)),
+                            MASK);
+    msg3 = _mm_shuffle_epi8(_mm_loadu_si128((const __m128i*)(p + 48)),
+                            MASK);
+
+    QROUND(0xE9B5DBA5B5C0FBCFULL, 0x71374491428A2F98ULL, msg0);
+    QROUND(0xAB1C5ED5923F82A4ULL, 0x59F111F13956C25BULL, msg1);
+    QROUND(0x550C7DC3243185BEULL, 0x12835B01D807AA98ULL, msg2);
+    QROUND(0xC19BF1749BDC06A7ULL, 0x80DEB1FE72BE5D74ULL, msg3);
+    for (int i = 0; i < 3; i++) {
+        msg0 = _mm_sha256msg1_epu32(msg0, msg1);
+        msg0 = _mm_add_epi32(msg0, _mm_alignr_epi8(msg3, msg2, 4));
+        msg0 = _mm_sha256msg2_epu32(msg0, msg3);
+        msg1 = _mm_sha256msg1_epu32(msg1, msg2);
+        msg1 = _mm_add_epi32(msg1, _mm_alignr_epi8(msg0, msg3, 4));
+        msg1 = _mm_sha256msg2_epu32(msg1, msg0);
+        msg2 = _mm_sha256msg1_epu32(msg2, msg3);
+        msg2 = _mm_add_epi32(msg2, _mm_alignr_epi8(msg1, msg0, 4));
+        msg2 = _mm_sha256msg2_epu32(msg2, msg1);
+        msg3 = _mm_sha256msg1_epu32(msg3, msg0);
+        msg3 = _mm_add_epi32(msg3, _mm_alignr_epi8(msg2, msg1, 4));
+        msg3 = _mm_sha256msg2_epu32(msg3, msg2);
+        switch (i) {
+        case 0:
+            QROUND(0x240CA1CC0FC19DC6ULL, 0xEFBE4786E49B69C1ULL, msg0);
+            QROUND(0x76F988DA5CB0A9DCULL, 0x4A7484AA2DE92C6FULL, msg1);
+            QROUND(0xBF597FC7B00327C8ULL, 0xA831C66D983E5152ULL, msg2);
+            QROUND(0x1429296706CA6351ULL, 0xD5A79147C6E00BF3ULL, msg3);
+            break;
+        case 1:
+            QROUND(0x53380D134D2C6DFCULL, 0x2E1B213827B70A85ULL, msg0);
+            QROUND(0x92722C8581C2C92EULL, 0x766A0ABB650A7354ULL, msg1);
+            QROUND(0xC76C51A3C24B8B70ULL, 0xA81A664BA2BFE8A1ULL, msg2);
+            QROUND(0x106AA070F40E3585ULL, 0xD6990624D192E819ULL, msg3);
+            break;
+        default:
+            QROUND(0x34B0BCB52748774CULL, 0x1E376C0819A4C116ULL, msg0);
+            QROUND(0x682E6FF35B9CCA4FULL, 0x4ED8AA4A391C0CB3ULL, msg1);
+            QROUND(0x8CC7020884C87814ULL, 0x78A5636F748F82EEULL, msg2);
+            QROUND(0xC67178F2BEF9A3F7ULL, 0xA4506CEB90BEFFFAULL, msg3);
+            break;
+        }
+    }
+#undef QROUND
+
+    st0 = _mm_add_epi32(st0, abef_save);
+    st1 = _mm_add_epi32(st1, cdgh_save);
+    // store back to HGFE/DCBA order
+    tmp = _mm_shuffle_epi32(st0, 0x1B);                         // FEBA
+    st1 = _mm_shuffle_epi32(st1, 0xB1);                         // DCHG
+    __m128i dcba = _mm_blend_epi16(tmp, st1, 0xF0);
+    __m128i hgfe = _mm_alignr_epi8(st1, tmp, 8);
+    _mm_storeu_si128((__m128i*)&state[0], dcba);
+    _mm_storeu_si128((__m128i*)&state[4], hgfe);
+}
+#else
+static int sha_ni_available() { return 0; }
+static void sha256_compress_ni(uint32_t state[8], const uint8_t* p) {
+    (void)state; (void)p;
+}
+#endif
+
 static inline uint32_t load_be32(const uint8_t* p) {
     return ((uint32_t)p[0] << 24) | ((uint32_t)p[1] << 16) |
            ((uint32_t)p[2] << 8) | (uint32_t)p[3];
@@ -151,6 +259,14 @@ static void sha256_compress(uint32_t h[8], const uint8_t* p) {
     h[4] += e; h[5] += f; h[6] += g; h[7] += hh;
 }
 
+static inline void sha256_block(uint32_t h[8], const uint8_t* p) {
+    if (sha_ni_available()) {
+        sha256_compress_ni(h, p);
+    } else {
+        sha256_compress(h, p);
+    }
+}
+
 static const char HEXD[] = "0123456789abcdef";
 
 // One SHA-256 compression of a 64-byte block from the initial state —
@@ -162,7 +278,7 @@ void sha256_block_state(const uint8_t* block, uint32_t* out_state) {
         0x510e527f, 0x9b05688c, 0x1f83d9ab, 0x5be0cd19,
     };
     memcpy(out_state, H0, 32);
-    sha256_compress(out_state, block);
+    sha256_block(out_state, block);
 }
 
 // Batched HMAC-SHA256 -> ascii hex.  inner/outer are the precomputed key
@@ -186,7 +302,7 @@ void hmac_sha256_hex(const uint8_t* data, const int32_t* offsets,
         memcpy(h, inner_state, 32);
         uint64_t off = 0;
         while (len - off >= 64) {
-            sha256_compress(h, msg + off);
+            sha256_block(h, msg + off);
             off += 64;
         }
         uint8_t tail[128];
@@ -199,8 +315,8 @@ void hmac_sha256_hex(const uint8_t* data, const int32_t* offsets,
         for (int k = 0; k < 8; k++) {
             tail[tail_len - 8 + k] = (uint8_t)(bits >> (8 * (7 - k)));
         }
-        sha256_compress(h, tail);
-        if (tail_len == 128) sha256_compress(h, tail + 64);
+        sha256_block(h, tail);
+        if (tail_len == 128) sha256_block(h, tail + 64);
         // outer: H(K^opad || inner_digest) — digest is 32 bytes, 1 block
         uint8_t oblk[64];
         for (int wi = 0; wi < 8; wi++) {
@@ -217,7 +333,7 @@ void hmac_sha256_hex(const uint8_t* data, const int32_t* offsets,
         }
         uint32_t ho[8];
         memcpy(ho, outer_state, 32);
-        sha256_compress(ho, oblk);
+        sha256_block(ho, oblk);
         for (int wi = 0; wi < 8; wi++) {
             uint32_t v = ho[wi];
             dst[8 * wi + 0] = HEXD[(v >> 28) & 0xF];

@@ -195,7 +195,14 @@ class FusedMaskFilterProgram:
             tuple(blocks_t), tuple(nblocks_t), tuple(self._states),
             dev_pred, tuple(mb_t),
         )
-        hexes = [np.asarray(h)[:n_rows] for h in hexes_dev]
+        hexes = []
+        for h in hexes_dev:
+            arr = np.asarray(h)
+            if arr.shape[0] != n_rows:
+                # slice-copy: a view would pin the bucket-padded buffer
+                # (up to 4x the live rows) for the batch's lifetime
+                arr = arr[:n_rows].copy()
+            hexes.append(arr)
         keep = (np.asarray(keep_dev)[:n_rows]
                 if self._pred_fn is not None else None)
         return hexes, keep
