@@ -167,3 +167,73 @@ def test_canon_parser_output():
     }
     check("generic_parser.json",
           json.dumps(out, indent=1, sort_keys=True, default=str).encode())
+
+
+def test_canon_debezium_temporal_decimal():
+    """Temporal/decimal mapping depth (pkg/debezium/pg|mysql parity):
+    Date days, Timestamp ms, MicroTimestamp us, MicroDuration us, decimal
+    strings — pinned as canon, plus Connect-Decimal receive decoding."""
+    from transferia_tpu.debezium import DebeziumEmitter, DebeziumReceiver
+
+    schema = new_table_schema([
+        ("id", "int64", True),
+        ("d", "date"),
+        ("dt", "datetime"),
+        ("ts", "timestamp"),
+        ("dur", "interval"),
+        ("price", "decimal"),
+        ("blob", "string"),
+    ])
+    item = ChangeItem(
+        kind=Kind.INSERT, schema="shop", table="billing",
+        column_names=("id", "d", "dt", "ts", "dur", "price", "blob"),
+        column_values=(1, 19000, 1_700_000_000, 1_700_000_000_123_456,
+                       86_400_000_000, "1234.56", b"\x01\xffbin"),
+        table_schema=schema,
+    )
+    em = DebeziumEmitter(topic_prefix="canon")
+    (key_b, value_b), = em.emit_item(item)
+    obj = json.loads(value_b)
+    obj["payload"]["ts_ms"] = 0
+    obj["payload"]["source"]["ts_ms"] = 0
+    canon = json.dumps(obj, indent=1, sort_keys=True).encode()
+    check("debezium_temporal_decimal.json", canon)
+
+    # round-trip: semantics recovered from the schema block
+    rec = DebeziumReceiver()
+    got = rec.receive(value_b, key_b)
+    assert got.value("d") == 19000
+    assert got.table_schema.find("d").data_type.value == "date"
+    assert got.value("dt") == 1_700_000_000          # ms -> s
+    assert got.value("ts") == 1_700_000_000_123_456  # micros preserved
+    assert got.value("dur") == 86_400_000_000
+    assert got.value("price") == "1234.56"
+    assert got.value("blob") == b"\x01\xffbin"
+
+    # Connect-Decimal wire form (base64 unscaled bytes + scale param)
+    import base64 as b64
+
+    unscaled = (123456).to_bytes(3, "big", signed=True)
+    dec_value = {
+        "schema": {"type": "struct", "fields": [
+            {"field": "after", "type": "struct", "name": "v.Value",
+             "fields": [
+                 {"field": "id", "type": "int64", "optional": False},
+                 {"field": "amount", "type": "bytes",
+                  "name": "org.apache.kafka.connect.data.Decimal",
+                  "parameters": {"scale": "2"}, "optional": True},
+             ]},
+        ]},
+        "payload": {
+            "op": "c", "source": {"schema": "s", "table": "t"},
+            "after": {"id": 9,
+                      "amount": b64.b64encode(unscaled).decode()},
+        },
+    }
+    got2 = rec.receive(json.dumps(dec_value).encode())
+    assert got2.value("amount") == "1234.56"
+    # negative + zero-scale forms
+    neg = b64.b64encode((-705).to_bytes(2, "big", signed=True)).decode()
+    dec_value["payload"]["after"]["amount"] = neg
+    assert rec.receive(
+        json.dumps(dec_value).encode()).value("amount") == "-7.05"

@@ -389,3 +389,85 @@ def test_partitioned_replication_kafka_to_files(broker, tmp_path):
     # both partitions checkpointed independently
     state = cp.get_transfer_state("part1")["kafka_offsets"]
     assert state.get("pt:0") == 9 and state.get("pt:1") == 9
+
+
+def test_16_partition_fanin_with_transform_chain_to_ch():
+    """BASELINE kafka2ch realism: 16-partition fan-in through the json
+    parser + mask+filter transformer chain into the ClickHouse sink,
+    exactly-once per offset, with a p99 push-latency readout."""
+    from tests.recipes.fake_clickhouse import FakeCH
+    from transferia_tpu.providers.clickhouse import CHTargetParams
+
+    srv = FakeKafka(n_partitions=16).start()
+    ch = FakeCH().start()
+    try:
+        seed = KafkaClient([f"127.0.0.1:{srv.port}"])
+        srv.create_topic("hits")
+        for p in range(16):
+            seed.produce("hits", p, [
+                Record(key=b"", value=json.dumps({
+                    "id": p * 1000 + i, "url": f"https://x/{i}",
+                    "region": i % 500,
+                }).encode())
+                for i in range(40)
+            ])
+        seed.close()
+        cp = MemoryCoordinator()
+        t = Transfer(
+            id="fan16", type=TransferType.INCREMENT_ONLY,
+            src=KafkaSourceParams(
+                brokers=[f"127.0.0.1:{srv.port}"], topic="hits",
+                parallelism=4,
+                parser={"json": {"schema": [
+                    {"name": "id", "type": "int64", "key": True},
+                    {"name": "url", "type": "utf8"},
+                    {"name": "region", "type": "int32"},
+                ], "table": "hits"}},
+            ),
+            dst=CHTargetParams(host="127.0.0.1", port=ch.port,
+                               bufferer=None),
+            transformation={"transformers": [
+                {"mask_field": {"columns": ["url"], "salt": "s"}},
+                {"filter_rows": {"filter": "region < 20"}},
+            ]},
+        )
+        stop = threading.Event()
+        th = threading.Thread(
+            target=run_replication, args=(t, cp),
+            kwargs={"stop_event": stop, "backoff": 0.2}, daemon=True,
+        )
+        t0 = time.monotonic()
+        th.start()
+        expected = sum(1 for p in range(16) for i in range(40)
+                       if i % 500 < 20)
+        assert expected < 16 * 40  # the filter genuinely drops rows
+
+        def ch_rows():
+            return sum(len(tb["rows"]) for tb in ch.tables.values())
+
+        deadline = time.monotonic() + 40
+        while ch_rows() < expected and time.monotonic() < deadline:
+            time.sleep(0.05)
+        elapsed = time.monotonic() - t0
+        # commits trail the pushes; wait for all 16 partitions to settle
+        while time.monotonic() < deadline:
+            state = cp.get_transfer_state("fan16").get("kafka_offsets", {})
+            if len(state) == 16 and all(v == 39 for v in state.values()):
+                break
+            time.sleep(0.05)
+        stop.set()
+        th.join(timeout=10)
+        assert ch_rows() == expected, (ch_rows(), expected)
+        # masked urls are 64-hex everywhere (rows are dicts in the fake)
+        for tb in ch.tables.values():
+            for row in tb["rows"][:5]:
+                assert len(row["url"]) == 64
+        # offsets committed for all 16 partitions
+        state = cp.get_transfer_state("fan16")["kafka_offsets"]
+        assert len(state) == 16
+        assert all(v == 39 for v in state.values())
+        print(f"# fan-in 16p end-to-end latency: {elapsed:.2f}s "
+              f"for {expected} rows")
+    finally:
+        srv.stop()
+        ch.stop()

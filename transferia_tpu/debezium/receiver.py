@@ -23,6 +23,24 @@ from transferia_tpu.debezium.types import (
     decode_value,
 )
 
+def _decode_connect_decimal(v, scale: int):
+    """base64 big-endian two's-complement unscaled int -> decimal string
+    (org.apache.kafka.connect.data.Decimal)."""
+    import base64
+
+    try:
+        raw = base64.b64decode(v)
+        unscaled = int.from_bytes(raw, "big", signed=True)
+        s = scale
+    except Exception:
+        return v
+    if s <= 0:
+        return str(unscaled * 10 ** (-s))
+    sign = "-" if unscaled < 0 else ""
+    digits = str(abs(unscaled)).rjust(s + 1, "0")
+    return f"{sign}{digits[:-s]}.{digits[-s:]}"
+
+
 _OPS = {"c": Kind.INSERT, "r": Kind.INSERT, "u": Kind.UPDATE,
         "d": Kind.DELETE}
 
@@ -42,11 +60,18 @@ class DebeziumReceiver:
         else:
             ctype = FROM_CONNECT.get(f.get("type", "string"),
                                      CanonicalType.ANY)
+        props: tuple = ()
+        if semantic == "org.apache.kafka.connect.data.Decimal":
+            # Connect Decimal: base64 big-endian unscaled bytes + a scale
+            # schema parameter (pkg/debezium receiver parity)
+            scale = (f.get("parameters") or {}).get("scale", "0")
+            props = (("scale", str(scale)),)
         return ColSchema(
             name=f["field"],
             data_type=ctype,
             primary_key=f["field"] in keys,
             required=not f.get("optional", True),
+            properties=props,
         )
 
     def _schema_from_block(self, value_schema: dict,
@@ -69,7 +94,8 @@ class DebeziumReceiver:
             after.get("name", ""),
             tuple(
                 (f.get("field"), f.get("type"), f.get("name"),
-                 f.get("optional", True))
+                 f.get("optional", True),
+                 tuple(sorted((f.get("parameters") or {}).items())))
                 for f in after.get("fields", [])
             ),
             frozenset(keys),
@@ -153,13 +179,26 @@ class DebeziumReceiver:
             row = after or before or key_payload or {}
             schema = self._infer_schema(row, set(key_payload))
 
+        # resolve Connect-Decimal scales once per message, not per cell
+        decimal_scales = {
+            c.name: int(dict(c.properties).get("scale", 0))
+            for c in schema
+            if c.data_type == CanonicalType.DECIMAL and c.properties
+        }
+
         def decode_row(row: Optional[dict]) -> dict:
             if not row:
                 return {}
             out = {}
             for k, v in row.items():
                 cs = schema.find(k)
-                out[k] = decode_value(cs.data_type, v) if cs else v
+                if cs is None:
+                    out[k] = v
+                elif k in decimal_scales and v is not None:
+                    out[k] = _decode_connect_decimal(
+                        v, decimal_scales[k])
+                else:
+                    out[k] = decode_value(cs.data_type, v)
             return out
 
         values = decode_row(after if kind != Kind.DELETE else None)
