@@ -294,9 +294,9 @@ class ConfluentSRParser(Parser):
     """Confluent wire format (magic byte 0 + 4-byte schema id + payload).
 
     Resolves schemas through a pluggable resolver (pkg/schemaregistry
-    equivalent); JSON-schema payloads decode via the generic parser.  Avro
-    requires an avro codec, which this image does not ship — such messages
-    are routed to _unparsed with a clear reason rather than guessed at.
+    equivalent).  JSON-schema payloads decode via the generic parser;
+    AVRO payloads decode with the in-repo schema-driven binary decoder
+    (schemaregistry/avro.py) using the registered writer schema.
     """
 
     def __init__(self, table: str = "data", namespace: str = "",
@@ -315,7 +315,98 @@ class ConfluentSRParser(Parser):
             resolver = sr_resolver(registry_url, user=registry_user,
                                    password=registry_password)
         self.resolver = resolver
+        self.registry_url = registry_url
+        self.registry_user = registry_user
+        self.registry_password = registry_password
         self._parsers: dict[int, GenericJsonParser] = {}
+        self._avro: dict[int, object] = {}
+        self._client = None
+
+    def _sr_client(self):
+        if self._client is None:
+            # reuse the resolver's client when it exposes one (sr_resolver
+            # does) — one connection/config/cache, not two
+            self._client = getattr(self.resolver, "client", None)
+        if self._client is None and self.registry_url:
+            from transferia_tpu.schemaregistry import SchemaRegistryClient
+
+            self._client = SchemaRegistryClient(
+                self.registry_url, user=self.registry_user,
+                password=self.registry_password)
+        return self._client
+
+    def _avro_for(self, schema_id: int):
+        """AvroSchema for a registered AVRO entry; None when the registry
+        says the id is NOT Avro (cached).  Transient registry failures
+        RAISE: dead-lettering valid data on an outage would consume the
+        offsets forever — the parse failure propagates so the runtime
+        retries the batch without committing (at-least-once)."""
+        if schema_id in self._avro:
+            return self._avro[schema_id]
+        client = self._sr_client()
+        avro = None
+        if client is not None:
+            entry = client.schema_by_id(schema_id)  # raises on outage
+            if entry.get("schemaType", "AVRO") == "AVRO":
+                from transferia_tpu.schemaregistry.avro import AvroSchema
+
+                try:
+                    avro = AvroSchema(entry["schema"])
+                except Exception as e:
+                    logger.warning("schema id %d: bad avro schema (%s)",
+                                   schema_id, e)
+                    avro = None  # permanently undecodable: cacheable
+        self._avro[schema_id] = avro
+        return avro
+
+    @staticmethod
+    def _avro_col_type(node) -> CanonicalType:
+        prim = {
+            "int": CanonicalType.INT32, "long": CanonicalType.INT64,
+            "float": CanonicalType.FLOAT, "double": CanonicalType.DOUBLE,
+            "boolean": CanonicalType.BOOLEAN,
+            "string": CanonicalType.UTF8, "bytes": CanonicalType.STRING,
+        }
+        if isinstance(node, str):
+            return prim.get(node, CanonicalType.ANY)
+        if node[0] == "union":
+            # only the nullable-field idiom has a single concrete type;
+            # multi-branch unions can carry any branch's value
+            concrete = [b for b in node[1] if b != "null"]
+            if len(concrete) == 1:
+                return ConfluentSRParser._avro_col_type(concrete[0])
+            return CanonicalType.ANY
+        if node[0] == "enum":
+            return CanonicalType.UTF8
+        if node[0] == "fixed":
+            return CanonicalType.STRING
+        return CanonicalType.ANY
+
+    def _avro_batch(self, avro, msgs: list[Message]) -> ParseResult:
+        result = ParseResult()
+        rows, bad, reasons = [], [], []
+        for m in msgs:
+            try:
+                rows.append(avro.decode(m.value))
+            except Exception as e:
+                bad.append(m)
+                reasons.append(f"avro: {e}")
+        if rows:
+            root = avro.root
+            if isinstance(root, list) and root[0] == "record":
+                cols = [(name, self._avro_col_type(t))
+                        for name, t in root[2]]
+            else:  # non-record root: single value column
+                cols = [("value", self._avro_col_type(root))]
+                rows = [{"value": r} for r in rows]
+            schema = TableSchema([ColSchema(n, t) for n, t in cols])
+            result.batches.append(ColumnBatch.from_pydict(
+                TableID(self.namespace, self.table), schema,
+                {n: [r.get(n) for r in rows] for n, _ in cols},
+            ))
+        if bad:
+            result.unparsed = unparsed_batch(bad, reasons)
+        return result
 
     def _parser_for(self, schema_id: int) -> GenericJsonParser:
         p = self._parsers.get(schema_id)
@@ -351,27 +442,39 @@ class ConfluentSRParser(Parser):
             if len(v) >= 5 and v[0] == 0:
                 schema_id = struct.unpack(">I", v[1:5])[0]
                 payload = v[5:]
-                if payload[:1] in (b"{", b"["):
-                    stripped = Message(
-                        value=payload, key=m.key, topic=m.topic,
-                        partition=m.partition, offset=m.offset,
-                        write_time_ns=m.write_time_ns,
-                    )
-                    if runs and runs[-1][0] == schema_id:
-                        runs[-1][1].append(stripped)
-                    else:
-                        runs.append((schema_id, [stripped]))
+                stripped = Message(
+                    value=payload, key=m.key, topic=m.topic,
+                    partition=m.partition, offset=m.offset,
+                    write_time_ns=m.write_time_ns,
+                )
+                # the registry's schemaType is authoritative: an Avro
+                # payload may begin with 0x7b ('{') by coincidence (e.g.
+                # a long field encoding -62), so byte-sniffing only
+                # decides when the id has no registered Avro schema
+                if self._avro_for(schema_id) is not None:
+                    kind = "avro"
+                elif payload[:1] in (b"{", b"["):
+                    kind = "json"
                 else:
                     bad.append(m)
                     reasons.append(
-                        "confluent-sr: non-JSON (avro?) payload unsupported"
+                        "confluent-sr: binary payload and no AVRO schema "
+                        "registered for this id"
                     )
+                    continue
+                if runs and runs[-1][0] == (schema_id, kind):
+                    runs[-1][1].append(stripped)
+                else:
+                    runs.append(((schema_id, kind), [stripped]))
             else:
                 bad.append(m)
                 reasons.append("confluent-sr: missing magic byte")
         result = ParseResult()
-        for schema_id, msgs in runs:
-            sub = self._parser_for(schema_id).do_batch(msgs)
+        for (schema_id, kind), msgs in runs:
+            if kind == "avro":
+                sub = self._avro_batch(self._avro_for(schema_id), msgs)
+            else:
+                sub = self._parser_for(schema_id).do_batch(msgs)
             result.batches.extend(sub.batches)
             if sub.unparsed is not None:
                 result.unparsed = sub.unparsed \

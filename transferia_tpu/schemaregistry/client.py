@@ -122,6 +122,13 @@ class SchemaRegistryClient:
 
 
 def sr_resolver(url: str, **kw):
-    """Resolver factory for the confluent_schema_registry parser config."""
+    """Resolver factory for the confluent_schema_registry parser config.
+    The underlying client is exposed as `.client` so the parser's Avro
+    path reuses the same connection/config and per-id cache."""
     client = SchemaRegistryClient(url, **kw)
-    return client.fields_for
+
+    def resolve(schema_id: int):
+        return client.fields_for(schema_id)
+
+    resolve.client = client
+    return resolve
