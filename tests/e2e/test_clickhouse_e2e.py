@@ -122,3 +122,70 @@ def test_ch_connection_error_is_categorized():
     client = CHClient(host="127.0.0.1", port=1)  # nothing listens
     with pytest.raises(CHError, match="connection failed"):
         client.ping()
+
+
+def test_cluster_topology_discovery_fanout():
+    """Topology discovery (reference clickhouse/topology/): shard layout
+    comes from system.clusters on the seed; inserts fan out per shard."""
+    from transferia_tpu.coordinator import MemoryCoordinator
+    from transferia_tpu.models import Transfer
+    from transferia_tpu.providers.clickhouse import CHTargetParams
+    from transferia_tpu.providers.clickhouse.provider import (
+        discover_cluster_shards,
+    )
+    from transferia_tpu.providers.sample import SampleSourceParams
+    from transferia_tpu.tasks import activate_delivery
+
+    seed = FakeCH().start()
+    try:
+        # discovery reuses the seed's HTTP port for every node (cluster
+        # nodes conventionally share one HTTP port; system.clusters only
+        # reports the NATIVE port)
+        seed.clusters = [
+            {"cluster": "main", "shard_num": 1, "replica_num": 1,
+             "host_name": "n1", "host_address": "10.0.0.1",
+             "port": 9000},
+            {"cluster": "main", "shard_num": 2, "replica_num": 1,
+             "host_name": "n2", "host_address": "10.0.0.2",
+             "port": 9000},
+        ]
+        params = CHTargetParams(host="127.0.0.1", port=seed.port,
+                                cluster="main", bufferer=None)
+        shards = discover_cluster_shards(params)
+        assert [s.name for s in shards] == ["shard1", "shard2"]
+        assert shards[0].hosts == [f"10.0.0.1:{seed.port}"]
+        assert shards[1].hosts == [f"10.0.0.2:{seed.port}"]
+        # replicas group under one shard
+        seed.clusters.append(
+            {"cluster": "main", "shard_num": 1, "replica_num": 2,
+             "host_name": "n1b", "host_address": "10.0.0.9",
+             "port": 9000})
+        shards2 = discover_cluster_shards(params)
+        assert len(shards2) == 2
+        assert len(shards2[0].hosts) == 2  # replica joined shard 1
+        # unknown cluster fails loudly
+        import pytest as _pytest
+
+        bad = CHTargetParams(host="127.0.0.1", port=seed.port,
+                             cluster="nope", bufferer=None)
+        with _pytest.raises(ValueError, match="not found"):
+            discover_cluster_shards(bad)
+
+        # end-to-end: both discovered shards (seed + seed, since ports
+        # are shared) receive fan-out inserts
+        seed.clusters = [
+            {"cluster": "solo", "shard_num": 1, "replica_num": 1,
+             "host_name": "n1", "host_address": "127.0.0.1",
+             "port": 9000},
+        ]
+        t = Transfer(
+            id="chtopo",
+            src=SampleSourceParams(preset="users", table="users",
+                                   rows=30, batch_rows=10),
+            dst=CHTargetParams(host="127.0.0.1", port=seed.port,
+                               cluster="solo", bufferer=None),
+        )
+        activate_delivery(t, MemoryCoordinator())
+        assert sum(len(tb["rows"]) for tb in seed.tables.values()) == 30
+    finally:
+        seed.stop()

@@ -19,6 +19,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 class FakeCH:
     def __init__(self):
         self.tables: dict[str, dict] = {}   # name -> {ddl, columns, rows}
+        # system.clusters rows for topology discovery tests:
+        # {cluster, shard_num, replica_num, host_name, host_address, port}
+        self.clusters: list[dict] = []
         self.queries: list[str] = []
         self.lock = threading.Lock()
         self._srv: ThreadingHTTPServer | None = None
@@ -70,6 +73,14 @@ class FakeCH:
         low = q.lower()
         if low == "select 1":
             return b"1\n"
+        if "from system.clusters" in low:
+            import json as _json
+
+            m = re.search(r"cluster = '([^']*)'", q)
+            name = m.group(1) if m else ""
+            with self.lock:
+                rows = [r for r in self.clusters if r["cluster"] == name]
+            return _json.dumps({"data": rows}).encode()
         m = re.match(r"create table if not exists `?(\w+)`?\s*\((.*)\)\s*"
                      r"engine\s*=\s*(.*?)\s+order by", low, re.S)
         if m:

@@ -104,8 +104,22 @@ class FakeMongo:
         if "find" in cmd:
             docs = sorted(
                 self.dbs.get(db, {}).get(cmd["find"], {}).values(),
-                key=lambda d: str(d.get("_id")),
+                key=lambda d: (str(type(d.get("_id"))),
+                               d.get("_id") if isinstance(
+                                   d.get("_id"), (int, float))
+                               else str(d.get("_id"))),
             )
+            filt = cmd.get("filter") or {}
+            idc = filt.get("_id")
+            if isinstance(idc, dict):
+                if "$gte" in idc:
+                    docs = [d for d in docs if d.get("_id") >= idc["$gte"]]
+                if "$lt" in idc:
+                    docs = [d for d in docs if d.get("_id") < idc["$lt"]]
+            proj = cmd.get("projection")
+            if proj:
+                keep = {k for k, v in proj.items() if v}
+                docs = [{k: d[k] for k in keep if k in d} for d in docs]
             return self._cursor_reply(db, cmd["find"], docs,
                                       cmd.get("batchSize", 101))
         if "getMore" in cmd:

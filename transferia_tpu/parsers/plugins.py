@@ -346,7 +346,18 @@ class ConfluentSRParser(Parser):
         client = self._sr_client()
         avro = None
         if client is not None:
-            entry = client.schema_by_id(schema_id)  # raises on outage
+            try:
+                entry = client.schema_by_id(schema_id)
+            except Exception as e:
+                if "404" in str(e):
+                    # PERMANENTLY absent id (deleted / foreign registry):
+                    # cache the miss so the message dead-letters instead
+                    # of poisoning the partition with endless retries
+                    logger.warning("schema id %d not registered (404)",
+                                   schema_id)
+                    self._avro[schema_id] = None
+                    return None
+                raise  # transient outage: abort the batch for retry
             if entry.get("schemaType", "AVRO") == "AVRO":
                 from transferia_tpu.schemaregistry.avro import AvroSchema
 

@@ -97,6 +97,7 @@ class CHTargetParams(EndpointParams):
     password: str = ""
     secure: bool = False
     shards: dict = field(default_factory=dict)   # name -> [host:port,...]
+    cluster: str = ""   # discover shards from system.clusters instead
     shard_by: str = ""                           # column; "" = first PK
     engine: str = ""                             # override table engine
     insert_settings: dict = field(default_factory=dict)
@@ -110,9 +111,46 @@ class CHTargetParams(EndpointParams):
         return self.bufferer
 
     def shard_list(self) -> list[CHShard]:
+        if not self.shards and self.cluster:
+            return discover_cluster_shards(self)
         if not self.shards:
             return [CHShard("default", [f"{self.host}:{self.port}"])]
         return [CHShard(n, list(h)) for n, h in self.shards.items()]
+
+
+def discover_cluster_shards(params: "CHTargetParams") -> list["CHShard"]:
+    """Topology discovery (reference clickhouse/topology/): read the
+    cluster's shard/replica layout from system.clusters on the seed host.
+    Replicas within a shard become the shard's failover host list."""
+    from transferia_tpu.providers.clickhouse.client import CHClient
+
+    client = CHClient(host=params.host, port=params.port,
+                      database=params.database, user=params.user,
+                      password=params.password, secure=params.secure)
+    rows = client.query_json(
+        "SELECT shard_num, host_name, host_address, port "
+        "FROM system.clusters "
+        f"WHERE cluster = '{params.cluster}' "
+        "ORDER BY shard_num, replica_num"
+    )
+    if not rows:
+        raise ValueError(
+            f"cluster {params.cluster!r} not found in system.clusters "
+            f"on {params.host}:{params.port}"
+        )
+    by_shard: dict[int, list[str]] = {}
+    for r in rows:
+        host = r.get("host_address") or r.get("host_name")
+        # system.clusters reports the NATIVE port; this provider speaks
+        # HTTP, and cluster nodes conventionally share one HTTP port —
+        # reuse the seed's (override with explicit `shards` otherwise)
+        by_shard.setdefault(int(r["shard_num"]), []).append(
+            f"{host}:{params.port}")
+    out = [CHShard(f"shard{num}", hosts)
+           for num, hosts in sorted(by_shard.items())]
+    logger.info("discovered cluster %r: %d shards", params.cluster,
+                len(out))
+    return out
 
 
 @register_endpoint

@@ -69,6 +69,7 @@ class MongoSourceParams(EndpointParams):
     database: str = ""
     collections: list[str] = field(default_factory=list)  # [] = all
     batch_rows: int = 1000
+    shard_parts: int = 0   # split big collections by _id ranges when > 1
 
 
 @register_endpoint
@@ -144,16 +145,80 @@ class MongoStorage(Storage, ShardingStorage):
         return self.conn.count(table.namespace, table.name)
 
     def shard_table(self, table: TableDescription) -> list[TableDescription]:
-        # each collection is one parallelization unit (the reference splits
-        # further by _id ranges for huge collections — future refinement)
-        return [table]
+        """_id-range splits (reference parallelization_unit*.go): walk the
+        sorted _id index and cut shard_parts ranges.  Only JSON-safe _id
+        types (str/int/float) split — exotic ids keep one part (the filter
+        travels as a string through the coordinator)."""
+        parts = self.params.shard_parts
+        total = table.eta_rows or self.exact_table_rows_count(table.id)
+        if parts <= 1 or total < parts * 2:
+            return [table]
+        chunk = (total + parts - 1) // parts
+        # one serial _id-projection pre-pass (analogous to an index scan;
+        # the reference's splitVector metadata path needs admin rights) —
+        # large batches keep round-trips low.  EVERY id's type is checked:
+        # MongoDB range queries are type-bracketed, so a mixed-type
+        # collection split at e.g. [25, "s0"] would silently drop the
+        # numbers above 25 — mixed types refuse to split.
+        boundaries: list = []
+        seen = 0
+        splittable = True
+        id_type = None
+        for docs in self.conn.find_all(
+                table.id.namespace, table.id.name,
+                sort={"_id": 1}, projection={"_id": 1},
+                batch_size=max(self.params.batch_rows, 10_000)):
+            for d in docs:
+                v = d.get("_id")
+                t = (int if isinstance(v, int)
+                     and not isinstance(v, bool) else type(v))
+                t = int if t is float else t  # numbers compare cross-type
+                if id_type is None:
+                    id_type = t
+                if t is not id_type or not isinstance(v, (str, int,
+                                                          float)) or \
+                        isinstance(v, bool):
+                    splittable = False
+                    break
+                if seen and seen % chunk == 0:
+                    boundaries.append(v)
+                seen += 1
+            if not splittable:
+                break
+        if not splittable or not boundaries:
+            return [table]
+        import json as _json
+
+        edges = [None] + boundaries + [None]
+        out = []
+        for i in range(len(edges) - 1):
+            rng = {}
+            if edges[i] is not None:
+                rng["gte"] = edges[i]
+            if edges[i + 1] is not None:
+                rng["lt"] = edges[i + 1]
+            out.append(TableDescription(
+                id=table.id, filter=f"idrange:{_json.dumps(rng)}",
+                eta_rows=chunk))
+        return out
 
     def load_table(self, table: TableDescription, pusher: Pusher) -> None:
         conn = _conn(self.params)  # dedicated cursor per part
+        filt = None
+        if table.filter.startswith("idrange:"):
+            import json as _json
+
+            rng = _json.loads(table.filter[len("idrange:"):])
+            cond = {}
+            if "gte" in rng:
+                cond["$gte"] = rng["gte"]
+            if "lt" in rng:
+                cond["$lt"] = rng["lt"]
+            filt = {"_id": cond}
         try:
             for docs in conn.find_all(
                     table.id.namespace, table.id.name,
-                    sort={"_id": 1},
+                    filter=filt, sort={"_id": 1},
                     batch_size=self.params.batch_rows):
                 pusher(_docs_to_batch(table.id, docs))
         finally:

@@ -143,6 +143,7 @@ class MongoConnection:
     def find_all(self, db: str, collection: str,
                  filter: Optional[dict] = None,
                  sort: Optional[dict] = None,
+                 projection: Optional[dict] = None,
                  batch_size: int = 1000) -> Iterator[list[dict]]:
         """Yields batches of documents until the cursor is exhausted."""
         cmd: dict[str, Any] = {
@@ -153,6 +154,8 @@ class MongoConnection:
             cmd["filter"] = filter
         if sort:
             cmd["sort"] = sort
+        if projection:
+            cmd["projection"] = projection
         out = self.command(db, cmd)
         cursor = out["cursor"]
         batch = cursor.get("firstBatch", [])

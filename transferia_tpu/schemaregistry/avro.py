@@ -76,13 +76,14 @@ class AvroSchema:
             for f in s.get("fields", []):
                 fields.append((f["name"], self._norm(f["type"], ns)))
             return node
-        if t == "enum":
-            node = ["enum", s.get("symbols", [])]
+        if t in ("enum", "fixed"):
+            node = (["enum", s.get("symbols", [])] if t == "enum"
+                    else ["fixed", int(s.get("size", 0))])
+            ns = s.get("namespace", namespace)
             self.named[s["name"]] = node
-            return node
-        if t == "fixed":
-            node = ["fixed", int(s.get("size", 0))]
-            self.named[s["name"]] = node
+            if ns and "." not in s["name"]:
+                # standard writers reference enums/fixed by fullname too
+                self.named[f"{ns}.{s['name']}"] = node
             return node
         if t == "array":
             return ["array", self._norm(s.get("items", "null"), namespace)]
