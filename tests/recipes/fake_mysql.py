@@ -169,7 +169,9 @@ class _MySession:
 
     # -- handshake ----------------------------------------------------------
     def run(self):
-        nonce = os.urandom(20)
+        # real MySQL scrambles are NUL-free printable bytes; a random
+        # 0x00 would be ambiguous with the protocol terminator
+        nonce = bytes((b % 94) + 33 for b in os.urandom(20))
         greeting = (
             b"\x0a" + b"8.0.0-fake\x00"
             + struct.pack("<I", 1)

@@ -125,7 +125,13 @@ class MySQLConnection:
             auth_len = greeting[pos]
             pos += 1 + 10     # auth len + reserved
             extra = max(13, auth_len - 8)
-            nonce += greeting[pos:pos + extra].rstrip(b"\x00")
+            part2 = greeting[pos:pos + extra]
+            if part2.endswith(b"\x00"):
+                # exactly ONE protocol NUL terminator: a scramble byte
+                # that happens to be 0x00 must survive (real servers send
+                # ASCII scrambles, but rstrip would eat it)
+                part2 = part2[:-1]
+            nonce += part2
             pos += extra
             nul = greeting.find(b"\x00", pos)
             if nul > pos:
