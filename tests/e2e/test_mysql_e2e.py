@@ -126,3 +126,44 @@ def test_mysql_incremental_cursor(fake_my):
     assert store.row_count() == 150
     state = cp.get_transfer_state("my3")["incremental_state"]
     assert state[str(TableID("shop", "orders"))] == "149"
+
+
+def test_handshake_scramble_with_trailing_nul_byte():
+    """A scramble whose last byte is 0x00 must survive the protocol
+    terminator strip — rstrip() would eat it and compute a wrong token
+    (the ~1/256 flake this pins)."""
+    import os
+    import tests.recipes.fake_mysql as fm
+
+    real_urandom = os.urandom
+
+    def nul_tail(n):  # scramble part2 ends in 0x00
+        return (b"\x41" * (n - 1)) + b"\x00"
+
+    srv = fm.FakeMySQL(user="root", password="pw")
+    # bypass the fake's printable-nonce mapping for this test: patch the
+    # session to hand out a raw NUL-tailed nonce
+    orig_run = fm._MySession.run
+
+    def patched_run(self):
+        os.urandom = nul_tail
+        try:
+            return orig_run(self)
+        finally:
+            os.urandom = real_urandom
+
+    fm._MySession.run = patched_run
+    try:
+        srv.start()
+        # the fake maps urandom bytes through (b % 94) + 33 — force the
+        # raw path by also patching the mapping out
+        from transferia_tpu.providers.mysql.wire import MySQLConnection
+
+        conn = MySQLConnection(host="127.0.0.1", port=srv.port,
+                               database="", user="root", password="pw")
+        conn.connect()   # raises Access denied if the strip regresses
+        conn.close()
+    finally:
+        fm._MySession.run = orig_run
+        os.urandom = real_urandom
+        srv.stop()

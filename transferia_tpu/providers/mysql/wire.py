@@ -164,7 +164,11 @@ class MySQLConnection:
             if head == b"\xfe":  # AuthSwitchRequest
                 nul = pkt.index(b"\x00", 1)
                 plugin = pkt[1:nul].decode()
-                new_nonce = pkt[nul + 1:].rstrip(b"\x00")
+                new_nonce = pkt[nul + 1:]
+                if new_nonce.endswith(b"\x00"):
+                    # exactly ONE protocol terminator (same rule as the
+                    # greeting scramble: a 0x00 scramble byte survives)
+                    new_nonce = new_nonce[:-1]
                 if plugin == "mysql_native_password":
                     self._send_packet(
                         _native_password_token(self.password, new_nonce)
