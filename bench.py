@@ -203,6 +203,46 @@ def _force_cpu_backend() -> bool:
     return force_virtual_cpu_mesh(1)
 
 
+def measure_transform_latency(n_batches: int = 16) -> list:
+    """Steady-state single-stream per-batch transform latency (the
+    BASELINE kafka2ch config's headline metric shape): one warm chain
+    instance, the first (compile-carrying) apply discarded, no competing
+    upload threads — unlike the throughput run, where apply windows
+    include cross-thread device queueing."""
+    from transferia_tpu.abstract.schema import TableID
+    from transferia_tpu.abstract.table import TableDescription
+    from transferia_tpu.factories import new_storage
+    from transferia_tpu.transform.chain import build_chain
+
+    transfer = make_transfer(process_count=1)
+    chain = build_chain(transfer.transformation)
+    storage = new_storage(transfer)
+    batches = []
+
+    class _Enough(Exception):
+        pass
+
+    def collect(batch):
+        batches.append(batch)
+        if len(batches) >= n_batches + 1:
+            raise _Enough()
+
+    try:
+        storage.load_table(
+            TableDescription(id=TableID("fs", "hits")), collect)
+    except _Enough:
+        pass
+    if not batches:
+        return []
+    chain.apply(batches[0])  # compile/warm — excluded from the stats
+    out = []
+    for b in batches[1:]:
+        t0 = time.perf_counter()
+        chain.apply(b)
+        out.append(time.perf_counter() - t0)
+    return out
+
+
 def main() -> None:
     fallback = None
     if not _device_available():
@@ -232,6 +272,7 @@ def main() -> None:
 
     rows, dt = run_pipeline()
     rps = rows / dt
+    latencies = measure_transform_latency()
     result = {
         "metric": "clickbench_snapshot_rows_per_sec",
         "value": round(rps),
@@ -241,11 +282,21 @@ def main() -> None:
     if fallback:
         result["fallback"] = fallback
     print(json.dumps(result))
+    lat_note = ""
+    if latencies:
+        import math
+
+        lat = sorted(latencies)
+        n = len(lat)
+        p50 = lat[max(0, math.ceil(0.50 * n) - 1)] * 1000
+        p99 = lat[max(0, math.ceil(0.99 * n) - 1)] * 1000  # nearest rank
+        lat_note = (f" transform_latency_ms=p50:{p50:.2f}/p99:{p99:.2f}"
+                    f" ({n} single-stream batches, steady state)")
     print(
         f"# rows={rows} time={dt:.2f}s warmup={warm_s:.1f}s "
         f"gen={gen_s:.1f}s batch={BATCH_ROWS} "
-        f"backend={'cpu-fallback' if fallback else 'device'} "
-        f"dataset={PARQUET}",
+        f"backend={'cpu-fallback' if fallback else 'device'}"
+        f"{lat_note} dataset={PARQUET}",
         file=sys.stderr,
     )
 

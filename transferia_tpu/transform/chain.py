@@ -157,12 +157,16 @@ class Transformation:
         return current
 
     def _apply_columnar(self, batch: ColumnBatch) -> Batch:
+        import time as _time
+
         plan = self.plan_for(batch.table_id, batch.schema)
         if not plan.steps:
             return batch
         self.stats.rows_in.inc(batch.n_rows)
+        _t0 = _time.monotonic()
         outputs: list[ColumnBatch] = []
         current = self._run_steps(batch, plan.steps, outputs)
+        self.stats.time.observe(_time.monotonic() - _t0)
         result: list[ColumnBatch] = []
         if current is not None and current.n_rows:
             self.stats.rows_out.inc(current.n_rows)
