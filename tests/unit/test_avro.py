@@ -95,6 +95,11 @@ def test_decode_errors():
     bad_union = zz(5) + zz(9)  # id then invalid union index
     with pytest.raises(AvroError):
         schema.decode(bad_union)
+    # negative string length (corrupt varint) must raise, not move the
+    # cursor backwards and return garbage
+    neg_name = zz(5) + zz(1) + zz(-3)
+    with pytest.raises(AvroError, match="negative length"):
+        schema.decode(neg_name)
 
 
 def test_nested_record_reference():

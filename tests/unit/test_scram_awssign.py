@@ -1,0 +1,74 @@
+"""SCRAM client hardening + SigV4 canonical-header edge cases.
+
+Covers the round-2 advisor findings: SASLprep of credentials, mandatory
+server extensions (m=), and internal-whitespace collapse in canonical
+headers (SigV4 spec step 4).
+"""
+
+import datetime
+
+import pytest
+
+from transferia_tpu.utils.awssign import sign_request
+from transferia_tpu.utils.scram import (
+    ScramError,
+    ServerVerifier,
+    client_exchange,
+    saslprep,
+)
+
+
+def _run_exchange(mechanism, client_user, client_pw, server_user,
+                  server_pw):
+    srv = ServerVerifier(mechanism, server_user, server_pw)
+    state = {"step": 0}
+
+    def send_receive(msg: bytes) -> bytes:
+        state["step"] += 1
+        return srv.first(msg) if state["step"] == 1 else srv.final(msg)
+
+    client_exchange(mechanism, client_user, client_pw, send_receive)
+
+
+def test_scram_roundtrip_ascii():
+    _run_exchange("SCRAM-SHA-256", "alice", "s3cret", "alice", "s3cret")
+
+
+def test_scram_saslprep_normalizes_credentials():
+    # NFKC: ﬁ (U+FB01) normalizes to "fi"; both sides must agree even
+    # when one passes the composed form and the other the compat form
+    _run_exchange("SCRAM-SHA-512", "ﬁona", "pa­ss",  # soft hyphen
+                  "fiona", "pass")
+
+
+def test_saslprep_rules():
+    assert saslprep("plain") == "plain"
+    assert saslprep("a b") == "a b"  # non-ASCII space -> space
+    assert saslprep("Ⅸ") == "IX"  # NFKC
+    with pytest.raises(ScramError):
+        saslprep("bad\x00byte")
+    with pytest.raises(ScramError):
+        saslprep("ab")
+    with pytest.raises(ScramError):
+        saslprep("אa")  # RandALCat mixed with LCat
+
+
+def test_scram_rejects_mandatory_extension():
+    def send_receive(msg: bytes) -> bytes:
+        return b"r=xyz,s=AAAA,i=4096,m=must-understand"
+
+    with pytest.raises(ScramError, match="m="):
+        client_exchange("SCRAM-SHA-256", "u", "p", send_receive)
+
+
+def test_sigv4_collapses_internal_header_whitespace():
+    now = datetime.datetime(2026, 1, 2, 3, 4, 5,
+                            tzinfo=datetime.timezone.utc)
+    kw = dict(method="GET", host="s3.test", path="/b/k", query={},
+              body=b"", region="us-east-1", service="s3",
+              access_key="AK", secret_key="SK", now=now)
+    multi = sign_request(headers={"x-meta": "a   b  c"}, **kw)
+    single = sign_request(headers={"x-meta": "a b c"}, **kw)
+    assert multi["authorization"] == single["authorization"]
+    padded = sign_request(headers={"x-meta": "  a b c  "}, **kw)
+    assert padded["authorization"] == single["authorization"]

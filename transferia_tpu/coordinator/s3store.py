@@ -220,6 +220,14 @@ class S3Coordinator(Coordinator):
                 self._put_json(key, d, if_match=etag)
             except PreconditionFailed:
                 continue  # another worker claimed it first
+            if not self._conditional:
+                # make the duplicate-part risk visible on every claim,
+                # not only at degrade time (e.g. legacy MinIO endpoints)
+                logger.warning(
+                    "part claim %s by worker %d is last-writer-wins "
+                    "(no conditional writes): a racing worker may "
+                    "duplicate this part on non-idempotent sinks",
+                    key, worker_index)
             return OperationTablePart.from_json(d)
         return None
 

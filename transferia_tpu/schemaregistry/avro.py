@@ -45,6 +45,8 @@ class Reader:
         return (result >> 1) ^ -(result & 1)  # zigzag
 
     def take(self, n: int) -> bytes:
+        if n < 0:
+            raise AvroError("negative length")
         if self.pos + n > len(self.buf):
             raise AvroError("truncated data")
         out = self.buf[self.pos:self.pos + n]

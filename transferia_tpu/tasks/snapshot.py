@@ -301,6 +301,13 @@ class SnapshotLoader:
         self-assignments (restart recovery), pull and upload."""
         deadline = time.monotonic() + 600
         while not self.cp.operation_parts(self.operation_id):
+            if self.cp.get_operation_state(self.operation_id).get(
+                    "parts_discovery_done"):
+                # async discovery legitimately found zero parts: nothing
+                # to upload — exit cleanly alongside the main worker
+                logger.info("secondary %d: discovery done with empty "
+                            "part queue", self.worker_index)
+                return
             if time.monotonic() > deadline:
                 raise TimeoutError(
                     f"operation {self.operation_id}: main worker never "

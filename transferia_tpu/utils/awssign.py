@@ -62,7 +62,7 @@ def sign_request(method: str, host: str, path: str,
     signed = ";".join(sorted(out))
     canonical = "\n".join([
         method, path, canonical_query(query),
-        "".join(f"{k}:{out[k].strip()}\n" for k in sorted(out)),
+        "".join(f"{k}:{' '.join(out[k].split())}\n" for k in sorted(out)),
         signed, payload_hash,
     ])
     scope = f"{date_stamp}/{region}/{service}/aws4_request"

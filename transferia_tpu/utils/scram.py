@@ -14,12 +14,51 @@ from __future__ import annotations
 import hashlib
 import hmac
 import os
+import unicodedata
 from base64 import b64decode, b64encode
 from typing import Callable
 
 
 class ScramError(Exception):
     pass
+
+
+def saslprep(s: str) -> str:
+    """RFC 4013 SASLprep of usernames/passwords (stored-string profile).
+
+    Map non-ASCII spaces to space, drop commonly-mapped-to-nothing code
+    points, NFKC-normalize, then reject prohibited output (control chars,
+    non-character/surrogate code points) and RandALCat/LCat bidi mixes.
+    ASCII strings pass through unchanged.
+    """
+    if s.isascii():
+        if any(ord(c) < 0x20 or ord(c) == 0x7F for c in s):
+            raise ScramError("control character in SCRAM credential")
+        return s
+    mapped = []
+    for c in s:
+        if unicodedata.category(c) == "Zs":
+            mapped.append(" ")
+        elif c in "­͏᠆᠋᠌᠍​‌‍⁠︀︁︂︃︄︅︆︇︈︉︊︋︌︍︎️﻿":
+            continue  # mapped to nothing (RFC 3454 B.1)
+        else:
+            mapped.append(c)
+    out = unicodedata.normalize("NFKC", "".join(mapped))
+    has_r = has_l = False
+    for c in out:
+        cp = ord(c)
+        cat = unicodedata.category(c)
+        if cat in ("Cc", "Cf", "Co", "Cs") or cp in (0xFFFD,) \
+                or 0xFDD0 <= cp <= 0xFDEF or (cp & 0xFFFE) == 0xFFFE:
+            raise ScramError("prohibited code point in SCRAM credential")
+        bidi = unicodedata.bidirectional(c)
+        if bidi in ("R", "AL"):
+            has_r = True
+        elif bidi == "L":
+            has_l = True
+    if has_r and has_l:
+        raise ScramError("mixed-direction SCRAM credential")
+    return out
 
 
 def _algo(mechanism: str):
@@ -34,11 +73,18 @@ def client_exchange(mechanism: str, username: str, password: str,
                     send_receive: Callable[[bytes], bytes]) -> None:
     """Run the client side; raises ScramError on any verification fail."""
     h = _algo(mechanism)
+    username = saslprep(username)
+    password = saslprep(password)
     nonce = b64encode(os.urandom(18)).decode()
     user = username.replace("=", "=3D").replace(",", "=2C")
     first_bare = f"n={user},r={nonce}"
     server_first = send_receive(b"n,," + first_bare.encode()).decode()
     parts = dict(p.split("=", 1) for p in server_first.split(","))
+    if "m" in parts:
+        # RFC 5802: m= marks a mandatory extension; clients that don't
+        # understand it MUST fail the exchange rather than ignore it
+        raise ScramError(
+            f"server requires unsupported extension m={parts['m']!r}")
     r, s, i = parts["r"], parts["s"], int(parts["i"])
     if not r.startswith(nonce):
         raise ScramError("server nonce mismatch")
@@ -68,11 +114,12 @@ class ServerVerifier:
     def __init__(self, mechanism: str, username: str, password: str,
                  iterations: int = 4096):
         self.h = _algo(mechanism)
-        self.username = username
+        self.username = saslprep(username)
         self.salt = os.urandom(12)
         self.iterations = iterations
         self.salted = hashlib.pbkdf2_hmac(
-            self.h().name, password.encode(), self.salt, iterations)
+            self.h().name, saslprep(password).encode(), self.salt,
+            iterations)
         self._client_first_bare = ""
         self._server_first = ""
         self._nonce = ""
