@@ -98,6 +98,11 @@ def _setup(args) -> None:
         level=getattr(logging, args.log_level.upper()),
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
     )
+    # secret redaction + value truncation on every handler
+    # (internal/logger/sanitizer_encoder.go + json_truncator.go parity)
+    from transferia_tpu.utils.logsanitize import install as _install_san
+
+    _install_san()
     if args.metrics_port:
         try:
             from prometheus_client import start_http_server
