@@ -39,10 +39,12 @@ def load_builtin_providers() -> None:
         greenplum,
         kafka,
         kinesis,
+        logbroker,
         misc_providers,
         mongo,
         mysql,
         postgres,
         s3,
         ydb,
+        yds,
     )
