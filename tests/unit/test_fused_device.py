@@ -268,3 +268,32 @@ def test_fixed_width_mask_target_not_fused():
     batch = make_batch(12)
     batches_equal(run_chain(config, batch, fused=False),
                   run_chain(config, batch, fused=True))
+
+
+def test_pipelined_chunked_dispatch_parity():
+    """Chunked double-buffered dispatch (ops/fused._run_pipelined) must be
+    byte-identical to the single-launch path, including ragged chunk
+    tails and empty keep results."""
+    from transferia_tpu.ops.fused import set_chunk_rows
+
+    batch = make_batch(1000)  # 1000 rows, chunk=256 -> 3 full + 1 tail
+    host = run_chain(CONFIG, batch, fused=False)
+    set_chunk_rows(256)
+    try:
+        dev = run_chain(CONFIG, batch, fused=True)
+    finally:
+        set_chunk_rows(None)
+    batches_equal(host, dev)
+
+
+def test_pipelined_chunk_exact_multiple():
+    from transferia_tpu.ops.fused import set_chunk_rows
+
+    batch = make_batch(512)
+    host = run_chain(CONFIG, batch, fused=False)
+    set_chunk_rows(128)
+    try:
+        dev = run_chain(CONFIG, batch, fused=True)
+    finally:
+        set_chunk_rows(None)
+    batches_equal(host, dev)

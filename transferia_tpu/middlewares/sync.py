@@ -176,6 +176,9 @@ class Transformation(_Wrap):
         self.chain = chain
 
     def push(self, batch: Batch) -> None:
-        out = self.chain.apply(batch)
+        from transferia_tpu.stats import stagetimer
+
+        with stagetimer.stage("transform"):
+            out = self.chain.apply(batch)
         if batch_len(out) or not batch_len(batch):
             self.inner.push(out)

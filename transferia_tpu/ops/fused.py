@@ -21,6 +21,7 @@ hashlib on the virtual mesh).
 from __future__ import annotations
 
 import functools
+import time as _time
 from typing import Optional, Sequence
 
 import numpy as np
@@ -34,6 +35,40 @@ from transferia_tpu.ops.sha256 import (
     hmac_device_core,
     prepare_padded_blocks,
 )
+from transferia_tpu.stats import stagetimer
+
+_chunk_rows_cached: Optional[int] = None
+
+
+def _chunk_rows() -> int:
+    """Chunk size for pipelined dispatch; 0 disables chunking.
+
+    Defaults to 32768 rows on an accelerator backend (enough work per
+    launch to amortize it, small enough for >=4 chunks per 131k batch);
+    0 on the CPU backend, where "device" compute shares the host cores
+    and pipelining only adds overhead.  TRANSFERIA_TPU_CHUNK_ROWS
+    overrides (0 = off).
+    """
+    global _chunk_rows_cached
+    if _chunk_rows_cached is None:
+        import os
+
+        env = os.environ.get("TRANSFERIA_TPU_CHUNK_ROWS")
+        if env is not None:
+            _chunk_rows_cached = max(0, int(env))
+        else:
+            try:
+                backend = jax.default_backend()
+            except Exception:
+                backend = "cpu"
+            _chunk_rows_cached = 0 if backend == "cpu" else 32768
+    return _chunk_rows_cached
+
+
+def set_chunk_rows(n: Optional[int]) -> None:
+    """Force the pipelined-dispatch chunk size (None = re-detect)."""
+    global _chunk_rows_cached
+    _chunk_rows_cached = n
 
 
 def _pallas_pack_enabled() -> bool:
@@ -146,10 +181,25 @@ class FusedMaskFilterProgram:
         """mask_cols: per masked column (flat uint8 data, int32 offsets).
         pred_cols: name -> (fixed-width data, validity or None).
         Returns ([hex (n_rows, 64) per masked column], keep mask or None).
+
+        On an accelerator backend, large batches run as a chunked
+        double-buffered pipeline: the host packs+dispatches chunk k+1
+        while the device computes chunk k and the host drains chunk k-1
+        (D2H), so H2D / compute / D2H / pack overlap instead of
+        serializing per batch.  One chunk size -> one compiled program.
         """
+        chunk = _chunk_rows()
+        if chunk and n_rows > chunk and not _pallas_pack_enabled():
+            return self._run_pipelined(mask_cols, pred_cols, n_rows,
+                                       chunk)
+        return self._run_single(mask_cols, pred_cols, n_rows)
+
+    def _dispatch(self, mask_cols, pred_cols, n_rows, bucket):
+        """Pack on host and launch the jitted program (async); returns
+        the device handles without blocking on the result."""
         use_pallas_pack = _pallas_pack_enabled()
-        bucket = bucket_rows(n_rows)
         blocks_t, nblocks_t, mb_t = [], [], []
+        pack_t0 = _time.perf_counter()
         for data, offsets in mask_cols:
             lens = offsets[1:] - offsets[:-1]
             max_len = int(lens.max()) if n_rows else 0
@@ -191,18 +241,84 @@ class FusedMaskFilterProgram:
                 data = np.pad(data, (0, bucket - n_rows))
                 validity = np.pad(validity, (0, bucket - n_rows))
             dev_pred[name] = (jnp.asarray(data), jnp.asarray(validity))
-        hexes_dev, keep_dev = self._jit(
-            tuple(blocks_t), tuple(nblocks_t), tuple(self._states),
-            dev_pred, tuple(mb_t),
-        )
+        stagetimer.add("pack", _time.perf_counter() - pack_t0)
+        with stagetimer.stage("device_dispatch"):
+            hexes_dev, keep_dev = self._jit(
+                tuple(blocks_t), tuple(nblocks_t), tuple(self._states),
+                dev_pred, tuple(mb_t),
+            )
+        return hexes_dev, keep_dev
+
+    def _collect(self, hexes_dev, keep_dev, n_rows
+                 ) -> tuple[list[np.ndarray], Optional[np.ndarray]]:
+        """Block on D2H and trim bucket padding."""
         hexes = []
-        for h in hexes_dev:
-            arr = np.asarray(h)
-            if arr.shape[0] != n_rows:
-                # slice-copy: a view would pin the bucket-padded buffer
-                # (up to 4x the live rows) for the batch's lifetime
-                arr = arr[:n_rows].copy()
-            hexes.append(arr)
-        keep = (np.asarray(keep_dev)[:n_rows]
-                if self._pred_fn is not None else None)
+        with stagetimer.stage("device_wait"):
+            for h in hexes_dev:
+                arr = np.asarray(h)
+                if arr.shape[0] != n_rows:
+                    # slice-copy: a view would pin the bucket-padded
+                    # buffer (up to 4x the live rows) for the batch's
+                    # lifetime
+                    arr = arr[:n_rows].copy()
+                hexes.append(arr)
+            keep = (np.asarray(keep_dev)[:n_rows]
+                    if self._pred_fn is not None else None)
+        return hexes, keep
+
+    def _run_single(self, mask_cols, pred_cols, n_rows):
+        hexes_dev, keep_dev = self._dispatch(mask_cols, pred_cols,
+                                             n_rows, bucket_rows(n_rows))
+        return self._collect(hexes_dev, keep_dev, n_rows)
+
+    def _run_pipelined(self, mask_cols, pred_cols, n_rows, chunk,
+                       depth: int = 2):
+        """Split the batch into fixed-size chunks and keep `depth` device
+        launches in flight: pack(k+1) overlaps compute(k) and D2H(k-1)."""
+        from collections import deque
+
+        inflight: deque = deque()
+        hex_parts: list[list[np.ndarray]] = []
+        keep_parts: list[np.ndarray] = []
+
+        def drain_one():
+            h_dev, k_dev, rows = inflight.popleft()
+            hexes, keep = self._collect(h_dev, k_dev, rows)
+            hex_parts.append(hexes)
+            if keep is not None:
+                keep_parts.append(keep)
+
+        for lo in range(0, n_rows, chunk):
+            hi = min(lo + chunk, n_rows)
+            rows = hi - lo
+            sub_mask = []
+            for data, offsets in mask_cols:
+                base = int(offsets[lo])
+                sub_off = (offsets[lo:hi + 1] - base).astype(
+                    offsets.dtype, copy=False)
+                sub_mask.append(
+                    (data[base:int(offsets[hi])], sub_off))
+            sub_pred = {}
+            for name, (data, validity) in pred_cols.items():
+                sub_pred[name] = (
+                    data[lo:hi],
+                    validity[lo:hi] if validity is not None else None,
+                )
+            h_dev, k_dev = self._dispatch(sub_mask, sub_pred, rows,
+                                          bucket_rows(rows))
+            inflight.append((h_dev, k_dev, rows))
+            while len(inflight) > depth:
+                drain_one()
+        while inflight:
+            drain_one()
+        n_mask = len(mask_cols)
+        hexes = [
+            np.concatenate([p[i] for p in hex_parts])
+            if hex_parts else np.empty((0, 64), dtype=np.uint8)
+            for i in range(n_mask)
+        ]
+        keep = (np.concatenate(keep_parts)
+                if self._pred_fn is not None and keep_parts else
+                (np.empty(0, dtype=np.bool_)
+                 if self._pred_fn is not None else None))
         return hexes, keep

@@ -173,12 +173,20 @@ class FileStorage(Storage, ShardingStorage):
                          schema: TableSchema, pusher: Pusher) -> None:
         import pyarrow.parquet as pq
 
+        from transferia_tpu.stats import stagetimer
+
         pf = pq.ParquetFile(path)
-        for rb in pf.iter_batches(batch_size=self.params.batch_rows,
-                                  row_groups=list(range(lo, hi))):
+        it = pf.iter_batches(batch_size=self.params.batch_rows,
+                             row_groups=list(range(lo, hi)))
+        while True:
+            with stagetimer.stage("source_decode"):
+                rb = next(it, None)
+            if rb is None:
+                return
             if rb.num_rows:
-                batch = ColumnBatch.from_arrow(rb, tid, schema)
-                batch.read_bytes = rb.nbytes
+                with stagetimer.stage("pivot"):
+                    batch = ColumnBatch.from_arrow(rb, tid, schema)
+                    batch.read_bytes = rb.nbytes
                 pusher(batch)
 
     def _load_file(self, path: str, tid: TableID, schema: TableSchema,

@@ -122,15 +122,19 @@ class DeviceFusedStep(Transformer):
         hexes, keep = self.program.run(
             mask_inputs, pred_inputs, batch.n_rows
         )
-        cols = dict(batch.columns)
-        for (name, _key), hx in zip(self.mask_entries, hexes):
-            validity = batch.column(name).validity
-            data, offsets = hex_to_varwidth(hx, validity)
-            cols[name] = Column(name, CanonicalType.UTF8, data, offsets,
-                                validity)
-        out = batch.with_columns(cols, self.result_schema(batch.schema))
-        if keep is not None and not keep.all():
-            out = out.filter(keep)
+        from transferia_tpu.stats import stagetimer
+
+        with stagetimer.stage("host_post"):
+            cols = dict(batch.columns)
+            for (name, _key), hx in zip(self.mask_entries, hexes):
+                validity = batch.column(name).validity
+                data, offsets = hex_to_varwidth(hx, validity)
+                cols[name] = Column(name, CanonicalType.UTF8, data,
+                                    offsets, validity)
+            out = batch.with_columns(cols,
+                                     self.result_schema(batch.schema))
+            if keep is not None and not keep.all():
+                out = out.filter(keep)
         return TransformResult(out)
 
 
