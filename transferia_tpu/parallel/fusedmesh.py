@@ -1,0 +1,218 @@
+"""Mesh-sharded fused transform program for arbitrary schemas.
+
+This is the multi-chip form of ops/fused.FusedMaskFilterProgram — the
+PRODUCTION chain step, not a demo: N HMAC-masked var-width columns (each
+with its own block width) + a compiled predicate over arbitrary numeric
+columns, jitted once per (rows-per-device bucket, block widths) and
+shard_map'd over the mesh.
+
+Sharding layout (scaling-book recipe: pick a mesh, annotate shardings,
+let XLA insert collectives):
+- the ROW axis shards over every mesh axis (('data','model')) — the
+  mask+filter step is row-parallel, so all chips contribute;
+- per-column SHA block matrices stay per-device-local (no resharding);
+- the only cross-chip traffic is two psums: the global kept-row count
+  and the target-shard histogram (digest % n_shards) that a sharded
+  ClickHouse writer uses to balance inserts (providers/clickhouse).
+  On hardware these lower to ICI all-reduces.
+
+Integration: transform/fused.DeviceFusedStep builds this program instead
+of the single-device one when >1 jax device is visible (and the batch is
+large enough to shard), so `build_chain` output is mesh-sharded with no
+caller changes.  Byte parity with the host path is pinned by
+tests/unit/test_parallel_fused.py and the multi-device e2e.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional, Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from transferia_tpu.columnar.batch import bucket_rows
+from transferia_tpu.ops.fused import (
+    hex_device,
+    pack_hmac_blocks,
+    pow2_blocks,
+)
+from transferia_tpu.ops.sha256 import _hmac_key_states, hmac_device_core
+from transferia_tpu.stats import stagetimer
+
+
+def _shard_map(fn, mesh, in_specs, out_specs):
+    try:
+        from jax import shard_map as sm
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map as sm
+    try:  # jax >= 0.8 renamed check_rep -> check_vma
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_vma=False)
+    except TypeError:
+        return sm(fn, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                  check_rep=False)
+
+
+def default_mesh(devices=None) -> Mesh:
+    """1×N row-parallel view is folded into the standard 2D mesh."""
+    from transferia_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(devices=devices)
+
+
+class ShardedFusedProgram:
+    """Row-sharded HMAC mask + predicate over a device mesh.
+
+    Same host-side contract as FusedMaskFilterProgram.run(); adds two
+    collective outputs kept as run() side-stats: global kept-row count
+    and the digest shard histogram (`last_kept`, `last_shard_hist`).
+    """
+
+    def __init__(self, mask_keys: Sequence[bytes], pred_node,
+                 mesh: Optional[Mesh] = None, n_shards: int = 16):
+        self.mesh = mesh or default_mesh()
+        self.n_dev = int(np.prod(list(self.mesh.shape.values())))
+        self.n_shards = n_shards
+        self._states = []
+        for key in mask_keys:
+            inner, outer = _hmac_key_states(bytes(key))
+            self._states.append((jnp.asarray(inner[0]),
+                                 jnp.asarray(outer[0])))
+        self._pred_fn = None
+        if pred_node is not None:
+            from transferia_tpu.predicate.device import compile_mask_jnp
+
+            self._pred_fn = compile_mask_jnp(pred_node)
+        self.last_kept: int = 0
+        self.last_shard_hist: Optional[np.ndarray] = None
+        self._lock = threading.Lock()
+        self._compiled: dict = {}
+
+        row_axes = tuple(self.mesh.axis_names)  # rows over the full mesh
+
+        def per_device(blocks_t, nblocks_t, states_t, pred_cols,
+                       valid, max_blocks_t):
+            rows_local = valid.shape[0]
+            hexes = tuple(
+                hex_device(hmac_device_core(b, nb, st[0], st[1], mb))
+                for b, nb, st, mb in zip(
+                    blocks_t, nblocks_t, states_t, max_blocks_t
+                )
+            )
+            if self._pred_fn is not None:
+                keep = self._pred_fn(pred_cols, rows_local) & valid
+            else:
+                keep = valid
+            # cross-chip collectives: global kept count + target-shard
+            # histogram over the first masked column's digest words
+            digest0 = hmac_device_core(
+                blocks_t[0], nblocks_t[0], states_t[0][0],
+                states_t[0][1], max_blocks_t[0])
+            shard = (digest0[:, 0] % jnp.uint32(self.n_shards)).astype(
+                jnp.int32)
+            hist = jnp.zeros((self.n_shards,), dtype=jnp.int32).at[
+                shard].add(keep.astype(jnp.int32))
+            hist = jax.lax.psum(hist, axis_name=row_axes)
+            kept = jax.lax.psum(keep.sum(), axis_name=row_axes)
+            out_keep = (keep if self._pred_fn is not None
+                        else jnp.zeros((0,), dtype=jnp.bool_))
+            return hexes, out_keep, hist, kept
+
+        self._per_device = per_device
+
+    def _get_compiled(self, n_mask: int, pred_names: tuple):
+        key = (n_mask, pred_names)
+        fn = self._compiled.get(key)
+        if fn is not None:
+            return fn
+        with self._lock:
+            fn = self._compiled.get(key)
+            if fn is None:
+                row_axes = tuple(self.mesh.axis_names)
+                rows = P(row_axes)
+                in_specs = (
+                    (P(row_axes, None),) * n_mask,   # blocks per column
+                    (rows,) * n_mask,                # n_blocks per column
+                    tuple((P(), P()) for _ in range(n_mask)),  # key states
+                    {n: (rows, rows) for n in pred_names},
+                    rows,                            # valid mask
+                    tuple(P() for _ in range(n_mask)),  # static-ish mb
+                )
+                out_specs = (
+                    (P(row_axes, None),) * n_mask,
+                    rows if self._pred_fn is not None else P(row_axes),
+                    P(),                             # histogram
+                    P(),                             # kept count
+                )
+                # max_blocks must stay static: strip it from specs and
+                # close over it per call instead
+                def wrapper(blocks_t, nblocks_t, states_t, pred_cols,
+                            valid, max_blocks_t):
+                    body = _shard_map(
+                        lambda b, nb, st, pc, v: self._per_device(
+                            b, nb, st, pc, v, max_blocks_t),
+                        self.mesh,
+                        in_specs[:5],
+                        out_specs,
+                    )
+                    return body(blocks_t, nblocks_t, states_t,
+                                pred_cols, valid)
+
+                fn = jax.jit(wrapper, static_argnums=(5,))
+                self._compiled[key] = fn
+        return fn
+
+    def run(self, mask_cols: Sequence[tuple[np.ndarray, np.ndarray]],
+            pred_cols: dict[str, tuple[np.ndarray, Optional[np.ndarray]]],
+            n_rows: int) -> tuple[list[np.ndarray], Optional[np.ndarray]]:
+        """Same contract as FusedMaskFilterProgram.run()."""
+        # pad the global row count to n_dev * per-device bucket so every
+        # shard is equal-sized and the per-device program is shape-stable
+        per_dev = bucket_rows(max(1, -(-n_rows // self.n_dev)))
+        total = per_dev * self.n_dev
+        blocks_t, nblocks_t, mb_t = [], [], []
+        pack_t0 = None
+        import time as _time
+
+        pack_t0 = _time.perf_counter()
+        for data, offsets in mask_cols:
+            lens = offsets[1:] - offsets[:-1]
+            max_len = int(lens.max()) if n_rows else 0
+            mb = pow2_blocks(max_len)
+            blocks, n_blocks = pack_hmac_blocks(data, offsets, mb)
+            if total != n_rows:
+                blocks = np.pad(blocks, ((0, total - n_rows), (0, 0)))
+                n_blocks = np.pad(n_blocks, (0, total - n_rows))
+            blocks_t.append(blocks)
+            nblocks_t.append(n_blocks)
+            mb_t.append(mb)
+        dev_pred = {}
+        for name, (data, validity) in pred_cols.items():
+            if validity is None:
+                validity = np.ones(n_rows, dtype=np.bool_)
+            if total != n_rows:
+                data = np.pad(data, (0, total - n_rows))
+                validity = np.pad(validity, (0, total - n_rows))
+            dev_pred[name] = (data, validity)
+        valid = np.zeros(total, dtype=np.bool_)
+        valid[:n_rows] = True
+        stagetimer.add("pack", _time.perf_counter() - pack_t0)
+        fn = self._get_compiled(len(mask_cols), tuple(sorted(dev_pred)))
+        with stagetimer.stage("device_dispatch"):
+            hexes_dev, keep_dev, hist, kept = fn(
+                tuple(blocks_t), tuple(nblocks_t), tuple(self._states),
+                dev_pred, valid, tuple(mb_t),
+            )
+        with stagetimer.stage("device_wait"):
+            hexes = [np.asarray(h)[:n_rows].copy()
+                     if total != n_rows else np.asarray(h)
+                     for h in hexes_dev]
+            keep = (np.asarray(keep_dev)[:n_rows]
+                    if self._pred_fn is not None else None)
+            self.last_shard_hist = np.asarray(hist)
+            self.last_kept = int(kept)
+        return hexes, keep

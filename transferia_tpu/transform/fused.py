@@ -80,9 +80,20 @@ class DeviceFusedStep(Transformer):
         self.mask_entries = list(mask_entries)
         self.pred_node = pred_node
         self.pred_cols = sorted(pred_node.columns()) if pred_node else []
-        self.program = FusedMaskFilterProgram(
-            [key for _, key in mask_entries], pred_node
-        )
+        keys = [key for _, key in mask_entries]
+        self.program = FusedMaskFilterProgram(keys, pred_node)
+        # >1 visible device: also build the mesh-sharded program and
+        # route large batches through it (parallel/fusedmesh.py)
+        self.sharded_program = None
+        self._sharded_min_rows = 0
+        if _mesh_devices() > 1:
+            from transferia_tpu.parallel.fusedmesh import (
+                ShardedFusedProgram,
+            )
+
+            self.sharded_program = ShardedFusedProgram(keys, pred_node)
+            # below ~1k rows/device the launch+collective overhead wins
+            self._sharded_min_rows = 1024 * _mesh_devices()
 
     def suitable(self, table: TableID, schema: TableSchema) -> bool:
         # constructed at plan time from already-suitable members
@@ -119,7 +130,11 @@ class DeviceFusedStep(Transformer):
         for name in self.pred_cols:
             col = batch.column(name)
             pred_inputs[name] = (col.data, col.validity)
-        hexes, keep = self.program.run(
+        program = self.program
+        if (self.sharded_program is not None
+                and batch.n_rows >= self._sharded_min_rows):
+            program = self.sharded_program
+        hexes, keep = program.run(
             mask_inputs, pred_inputs, batch.n_rows
         )
         from transferia_tpu.stats import stagetimer
@@ -136,6 +151,16 @@ class DeviceFusedStep(Transformer):
             if keep is not None and not keep.all():
                 out = out.filter(keep)
         return TransformResult(out)
+
+
+def _mesh_devices() -> int:
+    """Visible jax device count (0 when jax is absent/uninitializable)."""
+    try:
+        import jax
+
+        return len(jax.devices())
+    except Exception:
+        return 0
 
 
 def _mask_target_cols(step: MaskField, schema: TableSchema) -> list[str]:
