@@ -47,4 +47,5 @@ def load_builtin_providers() -> None:
         s3,
         ydb,
         yds,
+        yt,
     )

@@ -1,0 +1,18 @@
+from transferia_tpu.providers.yt.client import YTClient, YTError
+from transferia_tpu.providers.yt.provider import (
+    YTProvider,
+    YTSourceParams,
+    YTStaticSinker,
+    YTStaticTargetParams,
+    YTStorage,
+)
+
+__all__ = [
+    "YTClient",
+    "YTError",
+    "YTProvider",
+    "YTSourceParams",
+    "YTStaticSinker",
+    "YTStaticTargetParams",
+    "YTStorage",
+]

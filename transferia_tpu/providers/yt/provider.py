@@ -1,0 +1,363 @@
+"""YTsaurus provider: snapshot source + static-table sink.
+
+Reference parity: /root/reference/pkg/providers/yt/ — cypress listing
+(cypress.go), range-sharded static-table reads (storage/), static-table
+sink with schema creation and append writes
+(model_ytsaurus_static_destination.go, sink/static_sink*).  The
+reference rides the Go SDK (go.ytsaurus.tech/yt/go); this implementation
+speaks the public HTTP proxy API directly (providers/yt/client.py) and
+keeps the columnar batch as the internal currency — read_table row
+batches pivot straight into ColumnBatch, never per-row ChangeItems.
+
+Table identity: a cypress table ``//home/dir/name`` maps to
+TableID(namespace="//home/dir", name="name"); the sink writes to
+``<dir>/<name>`` under its configured target directory.
+
+Binary values: the YT JSON wire format carries binary strings as
+latin-1-escaped text; STRING columns encode/decode with latin-1 on the
+boundary so arbitrary bytes round-trip.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass, field
+from typing import Optional
+
+from transferia_tpu.abstract.errors import CategorizedError
+from transferia_tpu.abstract.interfaces import (
+    Batch,
+    Pusher,
+    ShardingStorage,
+    Sinker,
+    Storage,
+    TableInfo,
+    is_columnar,
+)
+from transferia_tpu.abstract.schema import (
+    CanonicalType,
+    ColSchema,
+    TableID,
+    TableSchema,
+)
+from transferia_tpu.abstract.table import TableDescription
+from transferia_tpu.columnar.batch import ColumnBatch
+from transferia_tpu.models import CleanupPolicy
+from transferia_tpu.models.endpoint import EndpointParams, register_endpoint
+from transferia_tpu.providers.registry import (
+    Provider,
+    TestResult,
+    register_provider,
+)
+from transferia_tpu.providers.yt.client import YTClient, YTError
+from transferia_tpu.typesystem.rules import (
+    register_source_rules,
+    register_target_rules,
+)
+
+logger = logging.getLogger(__name__)
+
+# the canonical lattice IS the YT schema type set (SURVEY §2.1: typesystem
+# keys on YT schema.Type) — the maps are near-identity
+register_source_rules("yt", {
+    "int8": CanonicalType.INT8, "int16": CanonicalType.INT16,
+    "int32": CanonicalType.INT32, "int64": CanonicalType.INT64,
+    "uint8": CanonicalType.UINT8, "uint16": CanonicalType.UINT16,
+    "uint32": CanonicalType.UINT32, "uint64": CanonicalType.UINT64,
+    "float": CanonicalType.FLOAT, "double": CanonicalType.DOUBLE,
+    "boolean": CanonicalType.BOOLEAN, "bool": CanonicalType.BOOLEAN,
+    "string": CanonicalType.STRING, "utf8": CanonicalType.UTF8,
+    "date": CanonicalType.DATE, "datetime": CanonicalType.DATETIME,
+    "timestamp": CanonicalType.TIMESTAMP,
+    "interval": CanonicalType.INTERVAL,
+    "any": CanonicalType.ANY, "json": CanonicalType.ANY,
+    "*": CanonicalType.ANY,
+})
+
+register_target_rules("yt", {
+    CanonicalType.INT8: "int8", CanonicalType.INT16: "int16",
+    CanonicalType.INT32: "int32", CanonicalType.INT64: "int64",
+    CanonicalType.UINT8: "uint8", CanonicalType.UINT16: "uint16",
+    CanonicalType.UINT32: "uint32", CanonicalType.UINT64: "uint64",
+    CanonicalType.FLOAT: "float", CanonicalType.DOUBLE: "double",
+    CanonicalType.BOOLEAN: "boolean",
+    CanonicalType.STRING: "string", CanonicalType.UTF8: "utf8",
+    CanonicalType.DATE: "date", CanonicalType.DATETIME: "datetime",
+    CanonicalType.TIMESTAMP: "timestamp",
+    CanonicalType.INTERVAL: "interval",
+    # parametrized decimal needs type_v3; utf8 preserves exactness
+    CanonicalType.DECIMAL: "utf8",
+    CanonicalType.ANY: "any",
+})
+
+
+@register_endpoint
+@dataclass
+class YTSourceParams(EndpointParams):
+    PROVIDER = "yt"
+    IS_SOURCE = True
+
+    proxy: str = "localhost:80"
+    paths: list[str] = field(default_factory=list)  # tables or map_nodes
+    token: str = ""
+    secure: bool = False
+    batch_rows: int = 65_536
+    desired_part_rows: int = 1_000_000  # range-shard granularity
+
+
+@register_endpoint
+@dataclass
+class YTStaticTargetParams(EndpointParams):
+    PROVIDER = "yt"
+    IS_TARGET = True
+
+    proxy: str = "localhost:80"
+    dir: str = "//home/transfer"  # target cypress directory
+    token: str = ""
+    secure: bool = False
+    cleanup_policy: CleanupPolicy = CleanupPolicy.DROP
+    optimize_for: str = "scan"    # scan (columnar chunks) | lookup
+
+
+def _split_path(path: str) -> TableID:
+    parent, _, name = path.rpartition("/")
+    return TableID(parent, name)
+
+
+def _join_path(dir_path: str, table: TableID) -> str:
+    return f"{dir_path.rstrip('/')}/{table.name}"
+
+
+def _schema_from_yt(attr: list[dict]) -> TableSchema:
+    from transferia_tpu.typesystem.rules import map_source_type
+
+    cols = []
+    for c in attr:
+        cols.append(ColSchema(
+            c["name"],
+            map_source_type("yt", c.get("type", "any")),
+            primary_key=bool(c.get("sort_order")),
+            required=bool(c.get("required")),
+            original_type=f"yt:{c.get('type', 'any')}",
+        ))
+    return TableSchema(cols)
+
+
+def _schema_to_yt(schema: TableSchema) -> list[dict]:
+    from transferia_tpu.typesystem.rules import map_target_type
+
+    out = []
+    for c in schema.columns:
+        entry = {"name": c.name,
+                 "type": map_target_type("yt", c.data_type)}
+        if c.primary_key:
+            entry["sort_order"] = "ascending"
+        out.append(entry)
+    # YT requires key columns to be a prefix of the schema
+    out.sort(key=lambda e: 0 if "sort_order" in e else 1)
+    return out
+
+
+def _decode_rows(rows: list[dict], schema: TableSchema) -> dict:
+    """Column-pivot read_table rows, restoring latin-1 binary strings."""
+    data: dict[str, list] = {c.name: [] for c in schema.columns}
+    str_cols = {c.name for c in schema.columns
+                if c.data_type == CanonicalType.STRING}
+    for r in rows:
+        for c in schema.columns:
+            v = r.get(c.name)
+            if v is not None and c.name in str_cols \
+                    and isinstance(v, str):
+                v = v.encode("latin-1", "replace")
+            data[c.name].append(v)
+    return data
+
+
+def _encode_value(v, is_binary: bool):
+    if isinstance(v, bytes):
+        return v.decode("latin-1") if is_binary \
+            else v.decode("utf-8", "replace")
+    return v
+
+
+class YTStorage(Storage, ShardingStorage):
+    """Snapshot reads over the HTTP proxy with row-range sharding."""
+
+    def __init__(self, params: YTSourceParams):
+        self.params = params
+        self.client = YTClient(params.proxy, token=params.token,
+                               secure=params.secure)
+        self._schemas: dict[TableID, TableSchema] = {}
+
+    # -- discovery ----------------------------------------------------------
+    def _table_paths(self) -> list[str]:
+        out = []
+        for p in self.params.paths:
+            node_type = self.client.get(f"{p}/@type", default=None)
+            if node_type == "table":
+                out.append(p)
+            elif node_type == "map_node":
+                for child in sorted(self.client.list(p)):
+                    cp = f"{p}/{child}"
+                    if self.client.get(f"{cp}/@type",
+                                       default=None) == "table":
+                        out.append(cp)
+            elif node_type is None:
+                raise YTError(f"path {p!r} does not exist")
+        return out
+
+    def table_list(self, include=None):
+        tables = {}
+        for path in self._table_paths():
+            tid = _split_path(path)
+            if include and not any(tid.include_matches(p)
+                                   for p in include):
+                continue
+            rows = int(self.client.get(f"{path}/@row_count", default=0))
+            tables[tid] = TableInfo(eta_rows=rows,
+                                    schema=self.table_schema(tid))
+        return tables
+
+    def table_schema(self, table: TableID) -> TableSchema:
+        schema = self._schemas.get(table)
+        if schema is None:
+            attr = self.client.get(
+                f"{table.namespace}/{table.name}/@schema")
+            if isinstance(attr, dict):  # {"$attributes":…, "$value":[…]}
+                attr = attr.get("$value", [])
+            schema = _schema_from_yt(attr)
+            self._schemas[table] = schema
+        return schema
+
+    def exact_table_rows_count(self, table: TableID) -> int:
+        return int(self.client.get(
+            f"{table.namespace}/{table.name}/@row_count", default=0))
+
+    def table_exists(self, table: TableID) -> bool:
+        return self.client.exists(f"{table.namespace}/{table.name}")
+
+    # -- sharding -----------------------------------------------------------
+    def shard_table(self, table: TableDescription
+                    ) -> list[TableDescription]:
+        total = self.exact_table_rows_count(table.id)
+        step = max(1, self.params.desired_part_rows)
+        if total <= step:
+            return [table]
+        parts = []
+        for lo in range(0, total, step):
+            hi = min(lo + step, total)
+            parts.append(TableDescription(
+                id=table.id, filter=f"rows:{lo}:{hi}",
+                eta_rows=hi - lo,
+            ))
+        return parts
+
+    # -- load ---------------------------------------------------------------
+    def load_table(self, table: TableDescription, pusher: Pusher) -> None:
+        schema = self.table_schema(table.id)
+        path = f"{table.id.namespace}/{table.id.name}"
+        if table.filter.startswith("rows:"):
+            _, lo, hi = table.filter.split(":")
+            path = f"{path}[#{lo}:#{hi}]"
+        for rows in self.client.read_table(
+                path, batch_rows=self.params.batch_rows):
+            batch = ColumnBatch.from_pydict(
+                table.id, schema, _decode_rows(rows, schema))
+            pusher(batch)
+
+    def ping(self) -> None:
+        self.client.ping()
+
+
+class YTStaticSinker(Sinker):
+    """Static-table sink: create-with-schema on first push, append
+    writes (the reference's static sink commits via a transaction per
+    part; the HTTP proxy's write_table is atomic per request, which is
+    the same per-push unit here)."""
+
+    def __init__(self, params: YTStaticTargetParams):
+        self.params = params
+        self.client = YTClient(params.proxy, token=params.token,
+                               secure=params.secure)
+        self._created: set[TableID] = set()
+
+    def _ensure_table(self, table: TableID, schema: TableSchema) -> None:
+        if table in self._created:
+            return
+        path = _join_path(self.params.dir, table)
+        if not self.client.exists(path):
+            self.client.create("table", path, attributes={
+                "schema": _schema_to_yt(schema),
+                "optimize_for": self.params.optimize_for,
+            }, recursive=True, ignore_existing=True)
+        self._created.add(table)
+
+    def push(self, batch: Batch) -> None:
+        if not is_columnar(batch):
+            rows = [it for it in batch if it.is_row_event()]
+            if not rows:
+                return
+            batch = ColumnBatch.from_rows(rows)
+        if batch.n_rows == 0:
+            return
+        self._ensure_table(batch.table_id, batch.schema)
+        binary = {c.name for c in batch.schema.columns
+                  if c.data_type == CanonicalType.STRING}
+        data = batch.to_pydict()
+        names = list(data)
+        out_rows = [
+            {n: _encode_value(data[n][i], n in binary) for n in names}
+            for i in range(batch.n_rows)
+        ]
+        self.client.write_table(
+            _join_path(self.params.dir, batch.table_id), out_rows,
+            append=True)
+
+
+@register_provider
+class YTProvider(Provider):
+    NAME = "yt"
+
+    def storage(self):
+        if isinstance(self.transfer.src, YTSourceParams):
+            return YTStorage(self.transfer.src)
+        return None
+
+    def sinker(self):
+        if isinstance(self.transfer.dst, YTStaticTargetParams):
+            return YTStaticSinker(self.transfer.dst)
+        return None
+
+    def cleanup(self, tables: list) -> None:
+        params = self.transfer.dst
+        if not isinstance(params, YTStaticTargetParams):
+            return
+        client = YTClient(params.proxy, token=params.token,
+                          secure=params.secure)
+        for td in tables or []:
+            tid = td.id if hasattr(td, "id") else td
+            path = _join_path(params.dir, tid)
+            if not client.exists(path):
+                continue
+            if params.cleanup_policy == CleanupPolicy.DROP:
+                client.remove(path)
+            elif params.cleanup_policy == CleanupPolicy.TRUNCATE:
+                client.write_table(path, [], append=False)
+
+    def test(self) -> TestResult:
+        result = TestResult(ok=True)
+        params = self.transfer.src if isinstance(
+            self.transfer.src, YTSourceParams) else self.transfer.dst
+        try:
+            YTClient(params.proxy, token=params.token,
+                     secure=params.secure).ping()
+            result.add("ping")
+        except Exception as e:
+            result.add("ping", e)
+        if isinstance(params, YTSourceParams):
+            try:
+                n = len(YTStorage(params)._table_paths())
+                result.add(f"list_tables({n})")
+            except Exception as e:
+                result.add("list_tables", e)
+        return result
