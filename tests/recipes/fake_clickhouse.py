@@ -88,10 +88,14 @@ class FakeCH:
                 r"CREATE TABLE IF NOT EXISTS `?(\w+)`?", q, re.I
             ).group(1)
             cols = self._parse_ddl_cols(q)
+            mo = re.search(r"ORDER BY \(([^)]*)\)", q, re.I)
+            order_by = [c.strip().strip("`")
+                        for c in mo.group(1).split(",")] if mo else []
             with self.lock:
                 if name not in self.tables:
                     self.tables[name] = {
                         "ddl": q, "columns": cols, "rows": [],
+                        "order_by": [c for c in order_by if c],
                     }
             return b""
         m = re.match(r"(drop|truncate) table if exists `?(\w+)`?", low)
@@ -121,7 +125,7 @@ class FakeCH:
                     dict(zip(col_names, r)) for r in rows
                 )
             return b""
-        m = re.match(r"select (.*) from `?(\w+)`?\s*(?:where .*)?"
+        m = re.match(r"select (.*) from `?(\w+)`?\s*(.*?)\s*"
                      r"format rowbinary", low, re.S)
         if m:
             name = re.search(r"FROM `?(\w+)`?", q, re.I).group(1)
@@ -136,32 +140,100 @@ class FakeCH:
                     mm = re.match(r"toString\(`(\w+)`\) AS", expr)
                     cols.append(mm.group(1) if mm
                                 else expr.strip("`"))
+                rows = self._filter_rows(t["rows"], q)
                 return _encode_rowbinary_rows(
-                    t["rows"], cols,
+                    rows, cols,
                     [t["columns"][c] for c in cols],
                 )
-        m = re.match(r"select count\(\) from `?(\w+)`?", low)
-        if m:
-            with self.lock:
-                n = len(self.tables.get(m.group(1), {}).get("rows", []))
-            return json.dumps({"data": [[n]]}).encode()
         if "from system.tables" in low:
+            mn = re.search(r"name = '(\w+)'", q)
             with self.lock:
+                if mn and low.startswith("select count()"):
+                    n = 1 if mn.group(1) in self.tables else 0
+                    return json.dumps({"data": [[n]]}).encode()
                 data = [
                     {"name": n, "total_rows": len(t["rows"])}
                     for n, t in self.tables.items()
                 ]
             return json.dumps({"data": data}).encode()
+        if "from system.parts" in low:
+            m = re.search(r"table = '(\w+)'", q)
+            with self.lock:
+                t = self.tables.get(m.group(1)) if m else None
+                size = len(t["rows"]) * 100 if t else 0
+            return json.dumps({"data": [[size]]}).encode()
+        m = re.match(r"select count\(\) from `?(\w+)`?", low)
+        if m:
+            with self.lock:
+                n = len(self.tables.get(m.group(1), {}).get("rows", []))
+            return json.dumps({"data": [[n]]}).encode()
         if "from system.columns" in low:
             m = re.search(r"table = '(\w+)'", q)
             with self.lock:
                 t = self.tables.get(m.group(1)) if m else None
+                keys = t.get("order_by", []) if t else []
                 data = [
-                    {"name": c, "type": typ, "is_in_primary_key": 0}
+                    {"name": c, "type": typ,
+                     "is_in_primary_key": 1 if c in keys else 0}
                     for c, typ in (t["columns"].items() if t else [])
                 ]
             return json.dumps({"data": data}).encode()
         raise ValueError(f"fake CH: unhandled query: {q[:120]}")
+
+    @staticmethod
+    def _filter_rows(rows: list[dict], sql: str) -> list[dict]:
+        """Evaluate the WHERE/ORDER BY/LIMIT shapes the storage emits
+        (checksum sampling: rand() cutoff, ORed key equality, top/bottom
+        ordering)."""
+        rows = list(rows)
+        mw = re.search(r"WHERE (.*?)(?: ORDER BY | LIMIT | FORMAT )",
+                       sql, re.S | re.I)
+        if mw:
+            cond = mw.group(1).strip()
+            if "rand()" in cond:
+                rows = rows[::7]   # deterministic "random" subsample
+            elif "` = " in cond:
+                keysets = []
+                for group in re.findall(r"\(([^()]*)\)", cond):
+                    want = {}
+                    for eq in group.split(" AND "):
+                        mk = re.match(r"\s*`(\w+)`\s*=\s*(.+)\s*", eq)
+                        if mk:
+                            want[mk.group(1)] = mk.group(2).strip()
+                    if want:
+                        keysets.append(want)
+
+                def lit(v):
+                    if v is None:
+                        return "NULL"
+                    if isinstance(v, bool):
+                        return "1" if v else "0"
+                    if isinstance(v, (int, float)):
+                        return str(v)
+                    s = str(v).replace("\\", "\\\\").replace("'", "\\'")
+                    return f"'{s}'"
+
+                rows = [
+                    r for r in rows
+                    if any(all(lit(r.get(k)) == v for k, v in ks.items())
+                           for ks in keysets)
+                ]
+        mo = re.search(r"ORDER BY (.+?)(?: LIMIT | FORMAT )", sql,
+                       re.S | re.I)
+        if mo:
+            for part in reversed(mo.group(1).split(",")):
+                part = part.strip()
+                desc = part.upper().endswith(" DESC")
+                name = part.split()[0].strip("`")
+                rows = sorted(
+                    rows,
+                    key=lambda r: (r.get(name) is None, r.get(name)),
+                    reverse=desc,
+                )
+        ml = re.search(r"LIMIT (\d+)", sql, re.I)
+        if ml:
+            rows = rows[: int(ml.group(1))]
+        return rows
 
     @staticmethod
     def _parse_ddl_cols(ddl: str) -> dict[str, str]:

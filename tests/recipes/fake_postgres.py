@@ -300,7 +300,7 @@ class _Session:
             return self.send_rows(["size"], [[size]])
         if "relpages" in low:
             return self.send_rows(["relpages"], [[1]])
-        if low.startswith("copy (select") and "to stdout" in low:
+        if low.startswith("copy (") and "to stdout" in low:
             return self.copy_out(sql)
         if low.startswith("copy ") and "from stdin" in low:
             return self.copy_in(sql)
@@ -374,7 +374,16 @@ class _Session:
                 _time.sleep(0.02)
 
     # -- COPY ---------------------------------------------------------------
-    def copy_out(self, sql: str):
+    def _eval_select(self, sql: str) -> tuple[list[str], list[dict]]:
+        """Evaluate the SELECT shapes the provider emits: plain scans,
+        checksum top/bottom UNION ALL samples, random()-filtered samples,
+        and ORed key-set lookups with ORDER BY/LIMIT."""
+        sql = sql.strip()
+        if sql.startswith("(") and " UNION ALL " in sql:
+            left, _, right = sql.partition(" UNION ALL ")
+            lc, lr = self._eval_select(left.strip()[1:-1])
+            _, rr = self._eval_select(right.strip()[1:-1])
+            return lc, lr + rr
         m = re.search(r"FROM \"?(\w+)\"?\.\"?(\w+)\"?", sql)
         t = self.fake.tables.get((m.group(1), m.group(2))) if m else None
         if t is None:
@@ -383,8 +392,78 @@ class _Session:
         m2 = re.search(r"SELECT (.*?) FROM", sql, re.S)
         if m2 and m2.group(1).strip() != "*":
             cols = [c.strip().strip('"') for c in m2.group(1).split(",")]
+        rows = list(t.rows)
+        mw = re.search(
+            r"WHERE (.*?)(?: ORDER BY | LIMIT |$)", sql, re.S)
+        if mw:
+            cond = mw.group(1).strip()
+            if "random()" in cond:
+                rows = rows[::7]  # deterministic "random" subsample
+            elif "ctid" in cond:
+                pass  # single-page tables: every part sees all rows
+            elif '" = ' in cond or '"=' in cond:
+                keysets = []
+                for group in re.findall(r"\(([^()]*)\)", cond):
+                    want = {}
+                    for eq in group.split(" AND "):
+                        mk = re.match(r'\s*"(\w+)"\s*=\s*(.+)\s*', eq)
+                        if mk:
+                            want[mk.group(1)] = mk.group(2).strip()
+                    if want:
+                        keysets.append(want)
+
+                def lit(v):
+                    if v is None:
+                        return "NULL"
+                    if isinstance(v, bool):
+                        return "TRUE" if v else "FALSE"
+                    if isinstance(v, (int, float)):
+                        return str(v)
+                    return "'" + str(v).replace("'", "''") + "'"
+
+                rows = [
+                    r for r in rows
+                    if any(all(lit(r.get(k)) == v for k, v in ks.items())
+                           for ks in keysets)
+                ]
+            elif re.match(r'"\w+" > ', cond):
+                mk = re.match(r'"(\w+)" > (.+)', cond)
+                col, raw = mk.group(1), mk.group(2).strip().strip("'")
+
+                def gt(v):
+                    if v is None:
+                        return False
+                    try:
+                        return float(v) > float(raw)
+                    except (TypeError, ValueError):
+                        return str(v) > raw
+                rows = [r for r in rows if gt(r.get(col))]
+        mo = re.search(r"ORDER BY (.+?)(?: LIMIT |$)", sql, re.S)
+        if mo:
+            for part in reversed(mo.group(1).split(",")):
+                part = part.strip()
+                desc = part.upper().endswith(" DESC")
+                name = part.split()[0].strip('"')
+
+                def sort_key(r, _n=name):
+                    v = r.get(_n)
+                    if v is None:
+                        return (2, 0)
+                    try:
+                        return (0, float(v))
+                    except (TypeError, ValueError):
+                        return (1, str(v))
+                rows = sorted(rows, key=sort_key, reverse=desc)
+        ml = re.search(r"LIMIT (\d+)", sql)
+        if ml:
+            rows = rows[: int(ml.group(1))]
+        return cols, rows
+
+    def copy_out(self, sql: str):
+        inner = re.search(r"COPY \((.*)\) TO STDOUT", sql, re.S)
+        cols, rows = self._eval_select(inner.group(1) if inner else sql)
         self.send(b"H", struct.pack("!bh", 0, 0))
-        for row in t.rows:
+        for row in rows:
             out = io.StringIO()
             csv.writer(out, lineterminator="\n").writerow(
                 ["" if row.get(c) is None else row.get(c) for c in cols]

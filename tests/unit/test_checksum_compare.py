@@ -1,0 +1,170 @@
+"""Type-aware checksum comparators (reference checksum.go:35-50, 861+)."""
+
+import datetime as dt
+
+import pytest
+
+from transferia_tpu.abstract.schema import CanonicalType, ColSchema
+from transferia_tpu.tasks.checksum import (
+    ChecksumParameters,
+    ComparisonError,
+    compare_checksum,
+    compare_pg_geometry,
+    compare_pg_interval,
+    compare_pg_lseg,
+    heterogeneous_data_types,
+    try_compare,
+    values_equal,
+)
+
+
+def col(name="c", ctype=CanonicalType.UTF8, orig=""):
+    return ColSchema(name=name, data_type=ctype, original_type=orig)
+
+
+class TestScalars:
+    def test_identical_repr_fast_path(self):
+        assert try_compare(1, None, 1, None)
+        assert try_compare("x", None, "x", None)
+
+    def test_nulls(self):
+        assert try_compare(None, None, None, None)
+        assert not try_compare(None, None, 0, None)
+        assert not try_compare("", None, None, None)
+
+    def test_bools_cross_type(self):
+        assert try_compare(True, None, 1, None)
+        assert try_compare(False, None, "false", None)
+        assert not try_compare(True, None, 0, None)
+
+    def test_float_rounding_12_significant_digits(self):
+        # differs only past the 12th significant digit -> equal
+        assert try_compare(1.4142135623730951, None,
+                           1.4142135623730999, None)
+        assert not try_compare(1.41421, None, 1.41422, None)
+
+    def test_float_vs_int_and_string(self):
+        assert try_compare(1.0, None, 1, None)
+        f = col(ctype=CanonicalType.DOUBLE)
+        assert try_compare("1.50", f, 1.5, f)
+
+    def test_nan_equals_nan(self):
+        assert try_compare(float("nan"), None, float("nan"), None)
+
+    def test_bytes_vs_str(self):
+        assert try_compare(b"abc", None, "abc", None)
+        assert try_compare("\\x616263", None, b"abc", None)
+        assert not try_compare(b"abc", None, "abd", None)
+
+
+class TestTemporal:
+    def test_tz_normalization(self):
+        a = col(orig="pg:timestamp with time zone")
+        assert try_compare("2024-01-02 03:04:05+00", a,
+                           "2024-01-02 06:04:05+03", a)
+
+    def test_datetime_vs_string(self):
+        a = col(orig="pg:timestamp without time zone")
+        assert try_compare(dt.datetime(2024, 1, 2, 3, 4, 5), a,
+                           "2024-01-02T03:04:05", a)
+
+    def test_date_vs_datetime_midnight(self):
+        a = col(orig="mysql:date")
+        assert try_compare(dt.date(2024, 1, 2), a, "2024-01-02", a)
+
+    def test_fractional_seconds(self):
+        a = col(orig="ch:DateTime64(6)")
+        assert not try_compare("2024-01-02 03:04:05.000001", a,
+                               "2024-01-02 03:04:05.000002", a)
+
+
+class TestPGText:
+    def test_interval_trailing_zeros(self):
+        assert compare_pg_interval("1 day", "1 days")
+        assert compare_pg_interval("01:00", "01:00:00")
+        assert not compare_pg_interval("01:00", "01:00:01")
+        a = col(orig="pg:interval")
+        assert try_compare("1 day", a, "1 days 00:00", a)
+
+    def test_geometry_rounding(self):
+        assert compare_pg_geometry(
+            "(1.414213562373095,1.414213562373095)",
+            "(1.4142135623730951,1.4142135623730951)")
+        assert not compare_pg_geometry("(1,2)", "(1,3)")
+        a = col(orig="pg:box")
+        assert try_compare("(2,2),(0,0)", a, "(2.0,2.0),(0.0,0.0)", a)
+
+    def test_lseg_brackets(self):
+        assert compare_pg_lseg("[(0,0),(1,1)]", "((0,0),(1,1))")
+
+
+class TestArrays:
+    def test_elementwise(self):
+        a = col(orig="pg:double precision[]", ctype=CanonicalType.ANY)
+        assert try_compare([1.0, 2.0], a, [1, 2], a)
+        assert not try_compare([1, 2], a, [1, 2, 3], a)
+        assert not try_compare([1, 2], a, [1, 3], a)
+
+    def test_nested(self):
+        assert try_compare([[1, 2], [3]], None, [[1, 2], [3]], None)
+
+
+class TestPriorityComparators:
+    def test_priority_comparator_wins(self):
+        def always_equal(lv, ls, rv, rs, into_array):
+            return True, True
+
+        assert try_compare("a", None, "b", None, [always_equal])
+
+    def test_values_equal_never_raises(self):
+        assert not values_equal(object(), object())
+
+
+class TestTypeFamilies:
+    def test_families(self):
+        assert heterogeneous_data_types("utf8", "string")
+        assert heterogeneous_data_types("decimal", "string")
+        assert heterogeneous_data_types("int32", "int64")
+        assert heterogeneous_data_types("timestamp", "datetime")
+        assert not heterogeneous_data_types("double", "int64")
+        assert not heterogeneous_data_types("boolean", "int8")
+
+
+class TestStreamingCompare:
+    """compare_checksum over memory storages exercises the bounded-memory
+    full-compare path (chunked key-set flushes)."""
+
+    def _mk(self, sid, rows=120, corrupt_at=None):
+        from transferia_tpu.abstract.schema import TableID
+        from transferia_tpu.factories import new_storage
+        from transferia_tpu.models import Transfer
+        from transferia_tpu.providers.memory import (
+            MemorySourceParams,
+            seed_source,
+        )
+        from transferia_tpu.providers.sample import make_batch
+
+        tid = TableID("sample", "users")
+        b = make_batch("users", tid, 0, rows, seed=3)
+        if corrupt_at is not None:
+            b.columns["score"].data[corrupt_at] += 0.5
+        seed_source(sid, [b])
+        return new_storage(Transfer(id=sid, src=MemorySourceParams(
+            source_id=sid)))
+
+    def test_chunked_full_compare_ok(self):
+        src = self._mk("cs_src")
+        dst = self._mk("cs_dst")
+        params = ChecksumParameters(keyset_chunk=16)
+        report = compare_checksum(src, dst, params=params)
+        assert report.ok, report.summary()
+        assert report.tables[0].compared_rows == 120
+        assert report.tables[0].strategy == "full"
+
+    def test_chunked_full_compare_detects_diff(self):
+        src = self._mk("cs_src2")
+        dst = self._mk("cs_dst2", corrupt_at=77)
+        params = ChecksumParameters(keyset_chunk=16)
+        report = compare_checksum(src, dst, params=params)
+        assert not report.ok
+        assert any("score" in m for m in report.tables[0].mismatches)

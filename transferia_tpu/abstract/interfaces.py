@@ -137,6 +137,10 @@ class Storage(abc.ABC):
     def table_exists(self, table: TableID) -> bool:
         return table in self.table_list(include=[table])
 
+    def table_size_in_bytes(self, table: TableID) -> int:
+        """On-disk size estimate; 0 = unknown (storage.go SizeableStorage)."""
+        return 0
+
     def ping(self) -> None:
         ...
 
@@ -241,8 +245,9 @@ class SampleableStorage(abc.ABC):
 
     @abc.abstractmethod
     def load_sample_by_set(self, table: TableDescription,
-                           keys: Sequence[ChangeItem], pusher: Pusher) -> None:
-        ...
+                           key_set: Sequence[dict], pusher: Pusher) -> None:
+        """Load exactly the rows whose primary keys appear in key_set
+        (each entry maps key column name -> value; storage.go:335)."""
 
     def table_accessible(self, table: TableDescription) -> bool:
         return True

@@ -71,6 +71,18 @@ def build_parser() -> argparse.ArgumentParser:
     up.add_argument("--table", action="append", default=[],
                     help="table to upload (repeatable), e.g. ns.name")
     add_transfer_cmd("check", "run checksum comparison source vs target")
+    chk = add_transfer_cmd(
+        "checksum", "full data-validation task (sampling, type-aware "
+        "comparators; worker/tasks/checksum.go)")
+    chk.add_argument("--table", action="append", default=[],
+                     help="restrict to a table (repeatable), e.g. ns.name")
+    chk.add_argument("--size-threshold", type=int, default=None,
+                     help="bytes above which tables are compared by "
+                          "sampling instead of a full scan "
+                          "(default 20 MiB; 0 = always sample)")
+    chk.add_argument("--strict-types", action="store_true",
+                     help="require exact canonical type equality instead "
+                          "of family-level equivalence")
     add_transfer_cmd("validate", "parse and validate the transfer config")
     add_transfer_cmd("deactivate",
                      "release source resources (replication slots etc.)")
@@ -247,6 +259,9 @@ def main(argv=None) -> int:
     if args.command == "check":
         return cmd_check(transfer)
 
+    if args.command == "checksum":
+        return cmd_checksum(args, transfer)
+
     if args.command == "deactivate":
         from transferia_tpu.providers.registry import get_provider
 
@@ -305,12 +320,51 @@ def cmd_check(transfer) -> int:
 
     src_storage = new_storage(transfer)
     dst_provider = get_provider(transfer.dst_provider(), transfer)
-    dst_storage = dst_provider.storage()
+    dst_storage = dst_provider.destination_storage()
     if dst_storage is None:
-        print("destination provider has no storage view; cannot checksum",
-              file=sys.stderr)
+        print("destination provider has no storage view of the target; "
+              "cannot checksum", file=sys.stderr)
         return 2
     report = checksum(src_storage, dst_storage)
+    print(report.summary())
+    return 0 if report.ok else 1
+
+
+def cmd_checksum(args, transfer) -> int:
+    """Full validation task (checksum.go Checksum): sampling storages,
+    type-aware comparators, streaming compare."""
+    from transferia_tpu.abstract.schema import TableID
+    from transferia_tpu.factories.storage import new_storage
+    from transferia_tpu.providers.registry import get_provider
+    from transferia_tpu.tasks.checksum import (
+        ChecksumParameters,
+        compare_checksum,
+        heterogeneous_data_types,
+    )
+
+    src_storage = new_storage(transfer)
+    dst_provider = get_provider(transfer.dst_provider(), transfer)
+    # never fall back to .storage(): that reads transfer.src and would
+    # vacuously compare the source against itself
+    dst_storage = dst_provider.destination_storage()
+    if dst_storage is None:
+        print("destination provider has no storage view of the target; "
+              "cannot checksum", file=sys.stderr)
+        return 2
+    params = ChecksumParameters()
+    if args.size_threshold is not None:
+        params.table_size_threshold = args.size_threshold
+    tables = None
+    if args.table:
+        tables = []
+        for spec in args.table:
+            ns, _, name = spec.rpartition(".")
+            tables.append(TableID(ns, name))
+    same = transfer.src_provider() == transfer.dst_provider()
+    eq = ((lambda a, b: a == b) if (args.strict_types or same)
+          else heterogeneous_data_types)
+    report = compare_checksum(src_storage, dst_storage, tables,
+                              params, equal_data_types=eq)
     print(report.summary())
     return 0 if report.ok else 1
 

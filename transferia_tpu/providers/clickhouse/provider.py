@@ -16,6 +16,7 @@ import numpy as np
 from transferia_tpu.abstract.interfaces import (
     Batch,
     Pusher,
+    SampleableStorage,
     Sinker,
     Storage,
     TableInfo,
@@ -35,7 +36,7 @@ from transferia_tpu.models.endpoint import (
     EndpointParams,
     register_endpoint,
 )
-from transferia_tpu.providers.clickhouse.client import CHClient
+from transferia_tpu.providers.clickhouse.client import CHClient, CHError
 from transferia_tpu.providers.clickhouse.rowbinary import encode_rowbinary
 from transferia_tpu.providers.registry import (
     Provider,
@@ -274,7 +275,7 @@ class CHSinker(Sinker):
             self._client(i).execute(f"{stmt} `{ch_table_name(table)}`")
 
 
-class CHStorage(Storage):
+class CHStorage(Storage, SampleableStorage):
     """Snapshot source over SELECT (storage + storage_sharding.go)."""
 
     def __init__(self, params: CHSourceParams):
@@ -284,6 +285,7 @@ class CHStorage(Storage):
             user=params.user, password=params.password,
             secure=params.secure,
         )
+        self._name_cache: dict[TableID, str] = {}
 
     def table_list(self, include=None):
         rows = self.client.query_json(
@@ -298,13 +300,35 @@ class CHStorage(Storage):
             out[tid] = TableInfo(eta_rows=int(r.get("total_rows") or 0))
         return out
 
+    def _resolve_name(self, table: TableID) -> str:
+        """Resolve a foreign TableID to this database's table name.
+
+        The CH sink flattens "ns"."t" into `ns__t` (ch_table_name); a
+        checksum against a CH target must find rows under that name when
+        the bare name is absent."""
+        name = table.name
+        if not table.namespace or table.namespace == self.params.database:
+            return name
+        cached = self._name_cache.get(table)
+        if cached is not None:
+            return cached
+        flat = f"{table.namespace}__{table.name}"
+        n = self.client.scalar(
+            "SELECT count() FROM system.tables "
+            f"WHERE database = '{self.params.database}' "
+            f"AND name = '{flat}'"
+        )
+        resolved = flat if int(n or 0) else name
+        self._name_cache[table] = resolved
+        return resolved
+
     def table_schema(self, table: TableID) -> TableSchema:
         from transferia_tpu.typesystem.rules import map_source_type
 
         rows = self.client.query_json(
             f"SELECT name, type, is_in_primary_key FROM system.columns "
             f"WHERE database = '{self.params.database}' "
-            f"AND table = '{table.name}'"
+            f"AND table = '{self._resolve_name(table)}'"
         )
         cols = []
         for r in rows:
@@ -322,7 +346,7 @@ class CHStorage(Storage):
 
     def exact_table_rows_count(self, table: TableID) -> int:
         return int(self.client.scalar(
-            f"SELECT count() FROM `{table.name}`"
+            f"SELECT count() FROM `{self._resolve_name(table)}`"
         ) or 0)
 
     def estimate_table_rows_count(self, table: TableID) -> int:
@@ -337,26 +361,102 @@ class CHStorage(Storage):
         return f"`{c.name}`"
 
     def load_table(self, table: TableDescription, pusher: Pusher) -> None:
+        where = f" WHERE {table.filter}" if table.filter else ""
+        self._load_select(table.id, where_order_limit=where, pusher=pusher)
+
+    def _load_select(self, tid: TableID, where_order_limit: str,
+                     pusher: Pusher) -> None:
         from transferia_tpu.providers.clickhouse.rowbinary import (
             decode_rowbinary_stream,
         )
 
-        schema = self.table_schema(table.id)
+        schema = self.table_schema(tid)
         nullable = {c.name: not c.required for c in schema}
         cols = ", ".join(self._select_expr(c) for c in schema)
-        where = f" WHERE {table.filter}" if table.filter else ""
         read_fn, close_fn = self.client.execute_stream(
-            f"SELECT {cols} FROM `{table.id.name}`{where} FORMAT RowBinary"
+            f"SELECT {cols} FROM `{self._resolve_name(tid)}`"
+            f"{where_order_limit} FORMAT RowBinary"
         )
         try:
             for batch in decode_rowbinary_stream(
                     read_fn, schema, nullable,
                     batch_rows=self.params.batch_rows):
-                out = ColumnBatch(table.id, schema, batch.columns)
+                out = ColumnBatch(tid, schema, batch.columns)
                 out.read_bytes = out.nbytes()
                 pusher(out)
         finally:
             close_fn()
+
+    # -- checksum sampling (clickhouse/storage_sampleable.go) ---------------
+    RANDOM_SAMPLE_LIMIT = 2000
+    TOP_BOTTOM_LIMIT = 1000
+
+    def table_size_in_bytes(self, table: TableID) -> int:
+        v = self.client.scalar(
+            "SELECT sum(bytes_on_disk) FROM system.parts "
+            f"WHERE database = '{self.params.database}' "
+            f"AND table = '{self._resolve_name(table)}' AND active"
+        )
+        try:
+            return int(v or 0)
+        except (TypeError, ValueError):
+            return 0
+
+    def _order_cols(self, tid: TableID) -> list[str]:
+        schema = self.table_schema(tid)
+        return [c.name for c in schema.key_columns()]
+
+    def load_random_sample(self, table: TableDescription,
+                           pusher: Pusher) -> None:
+        order = self._order_cols(table.id)
+        by = " ORDER BY " + ", ".join(f"`{c}`" for c in order) if order \
+            else ""
+        # rand() is uniform over UInt32; 0.05 of the range
+        cutoff = int(0.05 * 0xFFFFFFFF)
+        self._load_select(
+            table.id,
+            f" WHERE rand() <= {cutoff}{by} "
+            f"LIMIT {self.RANDOM_SAMPLE_LIMIT}",
+            pusher,
+        )
+
+    def load_top_bottom_sample(self, table: TableDescription,
+                               pusher: Pusher) -> None:
+        order = self._order_cols(table.id)
+        if not order:
+            raise CHError(f"no sorting key on {table.id.name}; "
+                          "cannot take top/bottom sample")
+        asc = ", ".join(f"`{c}`" for c in order)
+        desc = ", ".join(f"`{c}` DESC" for c in order)
+        n = self.TOP_BOTTOM_LIMIT
+        self._load_select(
+            table.id, f" ORDER BY {asc} LIMIT {n}", pusher)
+        self._load_select(
+            table.id, f" ORDER BY {desc} LIMIT {n}", pusher)
+
+    @staticmethod
+    def _ch_literal(v) -> str:
+        if v is None:
+            return "NULL"
+        if isinstance(v, bool):
+            return "1" if v else "0"
+        if isinstance(v, (int, float)):
+            return str(v)
+        if isinstance(v, bytes):
+            v = v.decode("utf-8", "replace")
+        s = str(v).replace("\\", "\\\\").replace("'", "\\'")
+        return f"'{s}'"
+
+    def load_sample_by_set(self, table: TableDescription, key_set,
+                           pusher: Pusher) -> None:
+        conds = [
+            "(" + " AND ".join(
+                f"`{name}` = {self._ch_literal(val)}"
+                for name, val in key.items()) + ")"
+            for key in key_set
+        ]
+        where = " OR ".join(conds) if conds else "0"
+        self._load_select(table.id, f" WHERE {where}", pusher)
 
     def ping(self) -> None:
         self.client.ping()
@@ -369,6 +469,15 @@ class ClickHouseProvider(Provider):
     def storage(self):
         if isinstance(self.transfer.src, CHSourceParams):
             return CHStorage(self.transfer.src)
+        return None
+
+    def destination_storage(self):
+        dst = self.transfer.dst
+        if isinstance(dst, CHTargetParams):
+            return CHStorage(CHSourceParams(
+                host=dst.host, port=dst.port, database=dst.database,
+                user=dst.user, password=dst.password, secure=dst.secure,
+            ))
         return None
 
     def sinker(self):

@@ -220,6 +220,13 @@ class MemoryProvider(Provider):
             return MemoryStorage(self.transfer.src)
         return None
 
+    def destination_storage(self):
+        if isinstance(self.transfer.dst, MemoryTargetParams):
+            # stores are shared by id: read back what the sink wrote
+            return MemoryStorage(MemorySourceParams(
+                source_id=self.transfer.dst.sink_id))
+        return None
+
     def sinker(self):
         if isinstance(self.transfer.dst, MemoryTargetParams):
             return MemorySinker(self.transfer.dst)

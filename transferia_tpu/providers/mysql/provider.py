@@ -8,6 +8,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from transferia_tpu.abstract.interfaces import (
+    SampleableStorage,
     Batch,
     IncrementalStorage,
     PositionalStorage,
@@ -153,7 +154,8 @@ def _coerce(cs: ColSchema, v: Optional[str]):
     return v
 
 
-class MySQLStorage(Storage, PositionalStorage, IncrementalStorage):
+class MySQLStorage(Storage, PositionalStorage, IncrementalStorage,
+                   SampleableStorage):
     def __init__(self, params: MySQLSourceParams):
         self.params = params
         self._c: Optional[MySQLConnection] = None
@@ -304,6 +306,73 @@ class MySQLStorage(Storage, PositionalStorage, IncrementalStorage):
         }
         pusher(ColumnBatch.from_pydict(tid, schema, data))
 
+    # -- checksum sampling (mysql/sampleable_storage.go) --------------------
+    RANDOM_SAMPLE_LIMIT = 2000
+    TOP_BOTTOM_LIMIT = 1000
+
+    def table_size_in_bytes(self, table: TableID) -> int:
+        v = self.conn.scalar(
+            "SELECT DATA_LENGTH + INDEX_LENGTH "
+            "FROM information_schema.TABLES "
+            f"WHERE TABLE_SCHEMA = '{table.namespace}' "
+            f"AND TABLE_NAME = '{table.name}'"
+        )
+        return int(v or 0)
+
+    def _sample_query(self, tid: TableID, schema: TableSchema, sql: str,
+                      pusher: Pusher) -> None:
+        rows = self.conn.query(sql)
+        if rows:
+            self._push_rows(rows, schema, tid, pusher)
+
+    def _sample_parts(self, tid: TableID):
+        schema = self.table_schema(tid)
+        cols = ", ".join(f"`{c.name}`" for c in schema)
+        order = ", ".join(f"`{c.name}`" for c in schema.key_columns())
+        ref = f"`{tid.namespace}`.`{tid.name}`"
+        return schema, cols, order, ref
+
+    def load_random_sample(self, table: TableDescription,
+                           pusher: Pusher) -> None:
+        schema, cols, order, ref = self._sample_parts(table.id)
+        by = f" ORDER BY {order}" if order else ""
+        self._sample_query(
+            table.id, schema,
+            f"SELECT {cols} FROM {ref} WHERE RAND() <= 0.05{by} "
+            f"LIMIT {self.RANDOM_SAMPLE_LIMIT}",
+            pusher,
+        )
+
+    def load_top_bottom_sample(self, table: TableDescription,
+                               pusher: Pusher) -> None:
+        schema, cols, order, ref = self._sample_parts(table.id)
+        if not order:
+            raise MySQLError(f"no primary key on {ref}; "
+                             "cannot take top/bottom sample")
+        desc = ", ".join(f"{c} DESC" for c in order.split(", "))
+        n = self.TOP_BOTTOM_LIMIT
+        self._sample_query(
+            table.id, schema,
+            f"(SELECT {cols} FROM {ref} ORDER BY {order} LIMIT {n}) "
+            f"UNION ALL "
+            f"(SELECT {cols} FROM {ref} ORDER BY {desc} LIMIT {n})",
+            pusher,
+        )
+
+    def load_sample_by_set(self, table: TableDescription, key_set,
+                           pusher: Pusher) -> None:
+        schema, cols, _, ref = self._sample_parts(table.id)
+        conds = [
+            "(" + " AND ".join(
+                f"`{name}` = {_sql_literal(val)}"
+                for name, val in key.items()) + ")"
+            for key in key_set
+        ]
+        where = " OR ".join(conds) if conds else "FALSE"
+        self._sample_query(
+            table.id, schema,
+            f"SELECT {cols} FROM {ref} WHERE {where}", pusher)
+
     # -- IncrementalStorage -------------------------------------------------
     def get_increment_state(self, tables, state):
         out = []
@@ -450,6 +519,15 @@ class MySQLProvider(Provider):
     def storage(self):
         if isinstance(self.transfer.src, MySQLSourceParams):
             return MySQLStorage(self.transfer.src)
+        return None
+
+    def destination_storage(self):
+        dst = self.transfer.dst
+        if isinstance(dst, MySQLTargetParams):
+            return MySQLStorage(MySQLSourceParams(
+                host=dst.host, port=dst.port, database=dst.database,
+                user=dst.user, password=dst.password,
+            ))
         return None
 
     def source(self):

@@ -18,6 +18,7 @@ from transferia_tpu.abstract.interfaces import (
     IncrementalStorage,
     PositionalStorage,
     Pusher,
+    SampleableStorage,
     ShardingStorage,
     Sinker,
     Storage,
@@ -154,8 +155,21 @@ def _conn(params) -> PGConnection:
     raise PGError(f"no postgres host reachable: {last}")
 
 
+def _pg_literal(v) -> str:
+    if v is None:
+        return "NULL"
+    if isinstance(v, bool):
+        return "TRUE" if v else "FALSE"
+    if isinstance(v, (int, float)):
+        return str(v)
+    if isinstance(v, bytes):
+        return f"'\\x{v.hex()}'::bytea"
+    s = str(v).replace("'", "''")
+    return f"'{s}'"
+
+
 class PGStorage(Storage, ShardingStorage, PositionalStorage,
-                IncrementalStorage):
+                IncrementalStorage, SampleableStorage):
     def __init__(self, params: PGSourceParams):
         self.params = params
         self._c: Optional[PGConnection] = None
@@ -299,8 +313,15 @@ class PGStorage(Storage, ShardingStorage, PositionalStorage,
         schema = self.table_schema(table.id)
         cols = ", ".join(f'"{c.name}"' for c in schema)
         where = f" WHERE {table.filter}" if table.filter else ""
+        self._copy_select(
+            f"SELECT {cols} FROM {table.id.fqtn()}{where}",
+            table.id, schema, pusher,
+        )
+
+    def _copy_select(self, select_sql: str, tid: TableID,
+                     schema: TableSchema, pusher: Pusher) -> None:
         sql = (
-            f"COPY (SELECT {cols} FROM {table.id.fqtn()}{where}) "
+            f"COPY ({select_sql}) "
             f"TO STDOUT WITH (FORMAT csv, HEADER false)"
         )
         # dedicated connection: parts stream in parallel threads
@@ -312,13 +333,71 @@ class PGStorage(Storage, ShardingStorage, PositionalStorage,
                 buf.write(chunk)
                 nbytes += len(chunk)
                 if nbytes >= 32 << 20:
-                    self._flush_csv(buf, table.id, schema, pusher)
+                    self._flush_csv(buf, tid, schema, pusher)
                     buf = io.BytesIO()
                     nbytes = 0
             if buf.tell():
-                self._flush_csv(buf, table.id, schema, pusher)
+                self._flush_csv(buf, tid, schema, pusher)
         finally:
             conn.close()
+
+    # -- checksum sampling (storage.go:984 LoadTopBottomSample etc.) --------
+    RANDOM_SAMPLE_LIMIT = 2000   # reference: "random()<=0.05 … limit 2000"
+    TOP_BOTTOM_LIMIT = 1000
+
+    def table_size_in_bytes(self, table: TableID) -> int:
+        try:
+            return int(self.conn.scalar(
+                f"SELECT pg_relation_size('{table.fqtn()}')"
+            ) or 0)
+        except PGError:
+            return 0
+
+    def _sample_parts(self, tid: TableID):
+        schema = self.table_schema(tid)
+        cols = ", ".join(f'"{c.name}"' for c in schema)
+        order = ", ".join(f'"{c.name}"' for c in schema.key_columns())
+        return schema, cols, order
+
+    def load_random_sample(self, table: TableDescription,
+                           pusher: Pusher) -> None:
+        schema, cols, order = self._sample_parts(table.id)
+        by = f" ORDER BY {order}" if order else ""
+        self._copy_select(
+            f"SELECT {cols} FROM {table.id.fqtn()} "
+            f"WHERE random() <= 0.05{by} LIMIT {self.RANDOM_SAMPLE_LIMIT}",
+            table.id, schema, pusher,
+        )
+
+    def load_top_bottom_sample(self, table: TableDescription,
+                               pusher: Pusher) -> None:
+        schema, cols, order = self._sample_parts(table.id)
+        if not order:
+            raise PGError(f"no primary key on {table.id.fqtn()}; "
+                          "cannot take top/bottom sample")
+        desc = ", ".join(f"{c} DESC" for c in order.split(", "))
+        n = self.TOP_BOTTOM_LIMIT
+        self._copy_select(
+            f"(SELECT {cols} FROM {table.id.fqtn()} "
+            f"ORDER BY {order} LIMIT {n}) UNION ALL "
+            f"(SELECT {cols} FROM {table.id.fqtn()} "
+            f"ORDER BY {desc} LIMIT {n})",
+            table.id, schema, pusher,
+        )
+
+    def load_sample_by_set(self, table: TableDescription, key_set,
+                           pusher: Pusher) -> None:
+        schema, cols, order = self._sample_parts(table.id)
+        conds = []
+        for key in key_set:
+            conds.append("(" + " AND ".join(
+                f'"{name}" = {_pg_literal(val)}'
+                for name, val in key.items()) + ")")
+        where = " OR ".join(conds) if conds else "FALSE"
+        self._copy_select(
+            f"SELECT {cols} FROM {table.id.fqtn()} WHERE {where}",
+            table.id, schema, pusher,
+        )
 
     def _flush_csv(self, buf: io.BytesIO, tid: TableID,
                    schema: TableSchema, pusher: Pusher) -> None:
@@ -450,18 +529,7 @@ class PGSinker(Sinker):
             [payload],
         )
 
-    @staticmethod
-    def _sql_literal(v) -> str:
-        if v is None:
-            return "NULL"
-        if isinstance(v, bool):
-            return "TRUE" if v else "FALSE"
-        if isinstance(v, (int, float)):
-            return str(v)
-        if isinstance(v, bytes):
-            return f"'\\x{v.hex()}'::bytea"
-        s = str(v).replace("'", "''")
-        return f"'{s}'"
+    _sql_literal = staticmethod(lambda v: _pg_literal(v))
 
     def _apply_row(self, it) -> None:
         tid = it.table_id
@@ -510,6 +578,15 @@ class PostgresProvider(Provider):
     def storage(self):
         if isinstance(self.transfer.src, PGSourceParams):
             return PGStorage(self.transfer.src)
+        return None
+
+    def destination_storage(self):
+        dst = self.transfer.dst
+        if isinstance(dst, PGTargetParams):
+            return PGStorage(PGSourceParams(
+                host=dst.host, port=dst.port, database=dst.database,
+                user=dst.user, password=dst.password,
+            ))
         return None
 
     def sinker(self):

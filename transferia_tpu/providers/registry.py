@@ -69,6 +69,11 @@ class Provider(abc.ABC):
         """Snapshot capability."""
         return None
 
+    def destination_storage(self) -> Optional[Storage]:
+        """Storage view of the *target* endpoint, for checksum validation
+        (provider.go:84-88 Checksumable.DestinationChecksumableStorage)."""
+        return None
+
     def source(self) -> Optional[Source]:
         """Replication capability."""
         return None
