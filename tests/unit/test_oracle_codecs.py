@@ -1,0 +1,100 @@
+"""Oracle wire codecs: NUMBER (base-100), DATE/TIMESTAMP, TNS framing."""
+
+import datetime as dt
+import socket
+import threading
+
+import pytest
+
+from transferia_tpu.providers.oracle import tns
+
+
+class TestNumber:
+    @pytest.mark.parametrize("v", [
+        0, 1, -1, 99, 100, 123, -123, 65535, 10 ** 12, -10 ** 12,
+        0.5, -0.5, 0.005, 123.456, -99.99, 2 ** 40 + 1,
+    ])
+    def test_roundtrip(self, v):
+        decoded = tns.decode_number(tns.encode_number(v))
+        # wide/high-scale values come back as exact Decimal, not float
+        assert float(decoded) == pytest.approx(v)
+
+    def test_known_oracle_encodings(self):
+        # the canonical published examples for the NUMBER format
+        assert tns.encode_number(0) == b"\x80"
+        assert tns.encode_number(1) == b"\xc1\x02"
+        assert tns.encode_number(123) == b"\xc2\x02\x18"
+        assert tns.encode_number(-123) == b"\x3d\x64\x4e\x66"
+
+    def test_integers_decode_as_int(self):
+        assert isinstance(tns.decode_number(tns.encode_number(42)), int)
+
+    def test_fractions_decode_as_float(self):
+        assert isinstance(tns.decode_number(tns.encode_number(1.5)), float)
+
+
+class TestTemporal:
+    def test_date_roundtrip(self):
+        d = dt.datetime(2026, 7, 29, 13, 45, 59)
+        assert tns.decode_date(tns.encode_date(d)) == d
+
+    def test_date_bytes_are_oracle_layout(self):
+        b = tns.encode_date(dt.datetime(2003, 1, 1, 0, 0, 0))
+        # century+100, year+100, month, day, h+1, m+1, s+1
+        assert b == bytes([120, 103, 1, 1, 1, 1, 1])
+
+    def test_timestamp_micros(self):
+        t = dt.datetime(2026, 2, 3, 4, 5, 6, 789012)
+        assert tns.decode_timestamp(tns.encode_timestamp(t)) == t
+
+
+class TestValues:
+    def test_null_roundtrip(self):
+        buf = tns.encode_value(tns.ORA_VARCHAR2, None)
+        v, _ = tns.decode_value(tns.ORA_VARCHAR2, buf, 0)
+        assert v is None
+
+    def test_binary_double(self):
+        buf = tns.encode_value(tns.ORA_BINARY_DOUBLE, 3.25)
+        v, _ = tns.decode_value(tns.ORA_BINARY_DOUBLE, buf, 0)
+        assert v == 3.25
+
+    def test_large_string_chunding(self):
+        s = "x" * 10_000
+        buf = tns.encode_value(tns.ORA_VARCHAR2, s)
+        v, _ = tns.decode_value(tns.ORA_VARCHAR2, buf, 0)
+        assert v == s
+
+    def test_raw_bytes(self):
+        buf = tns.encode_value(tns.ORA_RAW, b"\x00\x01\xfe")
+        v, _ = tns.decode_value(tns.ORA_RAW, buf, 0)
+        assert v == b"\x00\x01\xfe"
+
+
+class TestFraming:
+    def test_connect_descriptor_roundtrip(self):
+        desc = tns.connect_descriptor("db.example", 1521,
+                                      service_name="ORCL")
+        cd = tns.parse_connect_data(desc)
+        assert cd["service_name"] == "ORCL"
+
+    def test_connect_packet_roundtrip(self):
+        desc = tns.connect_descriptor("h", 1521, sid="XE")
+        payload = tns.build_connect(desc)
+        assert tns.parse_connect(payload) == desc
+
+    def test_packet_over_socket(self):
+        a, b = socket.socketpair()
+        try:
+            msg = tns.pack_packet(tns.PKT_DATA, b"\x00\x00hello")
+            threading.Thread(target=a.sendall, args=(msg,)).start()
+            ptype, payload = tns.read_packet(b)
+            assert ptype == tns.PKT_DATA
+            assert payload == b"\x00\x00hello"
+        finally:
+            a.close()
+            b.close()
+
+    def test_refuse_roundtrip(self):
+        msg = tns.parse_refuse(tns.build_refuse("ORA-12514: no service"))
+        assert "12514" in msg

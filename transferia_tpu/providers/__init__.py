@@ -43,6 +43,7 @@ def load_builtin_providers() -> None:
         misc_providers,
         mongo,
         mysql,
+        oracle,
         postgres,
         s3,
         ydb,

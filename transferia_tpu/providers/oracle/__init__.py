@@ -1,0 +1,14 @@
+from transferia_tpu.providers.oracle.provider import (
+    OracleProvider,
+    OracleSourceParams,
+    OracleStorage,
+)
+from transferia_tpu.providers.oracle.wire import OracleConnection, OracleError
+
+__all__ = [
+    "OracleConnection",
+    "OracleError",
+    "OracleProvider",
+    "OracleSourceParams",
+    "OracleStorage",
+]
