@@ -1,0 +1,127 @@
+"""reupload / add_tables / remove_tables operations
+(reference: pkg/worker/tasks/{reupload,add_tables,remove_tables}.go)."""
+
+import pytest
+
+from transferia_tpu.abstract.schema import TableID
+from transferia_tpu.coordinator import MemoryCoordinator
+from transferia_tpu.coordinator.interface import TransferStatus
+from transferia_tpu.models import Transfer
+from transferia_tpu.models.transfer import DataObjects
+from transferia_tpu.providers.memory import (
+    MemorySourceParams,
+    MemoryTargetParams,
+    get_store,
+    seed_source,
+)
+from transferia_tpu.providers.sample import make_batch
+from transferia_tpu.tasks import (
+    activate_delivery,
+    add_tables,
+    apply_persisted_include_list,
+    remove_tables,
+    reupload,
+)
+
+
+def _seed(source_id: str, tables: list[str], rows: int = 30):
+    batches = []
+    for name in tables:
+        batches.append(make_batch("users", TableID("sample", name), 0,
+                                  rows, seed=5))
+    seed_source(source_id, batches)
+
+
+def _transfer(tid: str, source_id: str, sink_id: str,
+              include=None) -> Transfer:
+    return Transfer(
+        id=tid,
+        src=MemorySourceParams(source_id=source_id),
+        dst=MemoryTargetParams(sink_id=sink_id),
+        data_objects=DataObjects(include_object_ids=list(include or [])),
+    )
+
+
+def test_reupload_cleans_and_reloads():
+    _seed("op_src1", ["t1"])
+    store = get_store("op_sink1")
+    store.clear()
+    cp = MemoryCoordinator()
+    t = _transfer("op-re", "op_src1", "op_sink1")
+    activate_delivery(t, cp)
+    assert store.row_count(TableID("sample", "t1")) == 30
+    # reupload replaces, not duplicates
+    reupload(t, cp)
+    assert store.row_count(TableID("sample", "t1")) == 30
+    assert cp.get_status(t.id) == TransferStatus.ACTIVATED
+
+
+def test_reupload_forbidden_for_append_only_source():
+    # real queue sources carry the marker (reupload.go:13)
+    from transferia_tpu.providers.kafka.provider import KafkaSourceParams
+
+    t = Transfer(id="op-ao", src=KafkaSourceParams(),
+                 dst=MemoryTargetParams(sink_id="y"))
+    with pytest.raises(ValueError, match="append-only"):
+        reupload(t, MemoryCoordinator())
+
+
+def test_add_tables_loads_only_new_and_persists():
+    _seed("op_src2", ["t1", "t2", "t3"])
+    store = get_store("op_sink2")
+    store.clear()
+    cp = MemoryCoordinator()
+    t = _transfer("op-add", "op_src2", "op_sink2",
+                  include=["sample.t1"])
+    activate_delivery(t, cp)
+    assert store.row_count(TableID("sample", "t1")) == 30
+    assert store.row_count(TableID("sample", "t2")) == 0
+
+    add_tables(t, cp, ["sample.t2"])
+    assert store.row_count(TableID("sample", "t2")) == 30
+    # t1 was NOT reloaded (no duplicates)
+    assert store.row_count(TableID("sample", "t1")) == 30
+    assert t.data_objects.include_object_ids == ["sample.t1", "sample.t2"]
+
+    # a fresh worker picks the widened list up from the coordinator
+    t2 = _transfer("op-add", "op_src2", "op_sink2",
+                   include=["sample.t1"])
+    apply_persisted_include_list(t2, cp)
+    assert t2.data_objects.include_object_ids == ["sample.t1", "sample.t2"]
+
+
+def test_add_tables_requires_include_list():
+    t = _transfer("op-add2", "s", "k")
+    with pytest.raises(ValueError, match="include"):
+        add_tables(t, MemoryCoordinator(), ["sample.t9"])
+
+
+def test_add_tables_idempotent_for_known_tables():
+    _seed("op_src3", ["t1"])
+    store = get_store("op_sink3")
+    store.clear()
+    cp = MemoryCoordinator()
+    t = _transfer("op-add3", "op_src3", "op_sink3",
+                  include=["sample.t1"])
+    add_tables(t, cp, ["sample.t1"])  # already included: no-op
+    assert store.row_count(TableID("sample", "t1")) == 0
+
+
+def test_remove_tables_narrows_and_persists():
+    cp = MemoryCoordinator()
+    t = _transfer("op-rm", "s", "k",
+                  include=["sample.t1", "sample.t2"])
+    remove_tables(t, cp, ["sample.t2"])
+    assert t.data_objects.include_object_ids == ["sample.t1"]
+    t2 = _transfer("op-rm", "s", "k", include=["sample.t1", "sample.t2"])
+    apply_persisted_include_list(t2, cp)
+    assert t2.data_objects.include_object_ids == ["sample.t1"]
+
+
+def test_remove_tables_rejects_unknown_and_empty():
+    cp = MemoryCoordinator()
+    t = _transfer("op-rm2", "s", "k", include=["sample.t1"])
+    with pytest.raises(ValueError, match="not in the include list"):
+        remove_tables(t, cp, ["sample.nope"])
+    with pytest.raises(ValueError, match="empty"):
+        remove_tables(t, cp, ["sample.t1"])

@@ -70,6 +70,18 @@ def build_parser() -> argparse.ArgumentParser:
     up = add_transfer_cmd("upload", "ad-hoc copy of explicit tables")
     up.add_argument("--table", action="append", default=[],
                     help="table to upload (repeatable), e.g. ns.name")
+    add_transfer_cmd("reupload",
+                     "cleanup and re-snapshot every table "
+                     "(worker/tasks/reupload.go)")
+    at = add_transfer_cmd("add-tables",
+                          "snapshot new tables into a live transfer and "
+                          "widen its include list")
+    at.add_argument("--table", action="append", default=[], required=True,
+                    help="table to add (repeatable), e.g. ns.name")
+    rt = add_transfer_cmd("remove-tables",
+                          "narrow the include list (target data stays)")
+    rt.add_argument("--table", action="append", default=[], required=True,
+                    help="table to remove (repeatable), e.g. ns.name")
     add_transfer_cmd("check", "run checksum comparison source vs target")
     chk = add_transfer_cmd(
         "checksum", "full data-validation task (sampling, type-aware "
@@ -236,6 +248,11 @@ def main(argv=None) -> int:
 
     transfer = _load_transfer(args)
     cp = _coordinator(args)
+    # an include list widened/narrowed by add-/remove-tables overrides the
+    # spec on restart (add_tables.go persists through the coordinator)
+    from transferia_tpu.tasks import apply_persisted_include_list
+
+    apply_persisted_include_list(transfer, cp)
 
     if args.command == "activate":
         from transferia_tpu.tasks import activate_delivery
@@ -251,6 +268,28 @@ def main(argv=None) -> int:
         upload(transfer, cp, args.table,
                operation_id=args.operation_id or None)
         print(f"transfer {transfer.id}: uploaded {len(args.table)} table(s)")
+        return 0
+
+    if args.command == "reupload":
+        from transferia_tpu.tasks import reupload
+
+        reupload(transfer, cp, operation_id=args.operation_id or None)
+        print(f"transfer {transfer.id}: reuploaded")
+        return 0
+
+    if args.command == "add-tables":
+        from transferia_tpu.tasks import add_tables
+
+        add_tables(transfer, cp, args.table,
+                   operation_id=args.operation_id or None)
+        print(f"transfer {transfer.id}: added {len(args.table)} table(s)")
+        return 0
+
+    if args.command == "remove-tables":
+        from transferia_tpu.tasks import remove_tables
+
+        remove_tables(transfer, cp, args.table)
+        print(f"transfer {transfer.id}: removed {len(args.table)} table(s)")
         return 0
 
     if args.command == "replicate":

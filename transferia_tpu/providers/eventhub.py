@@ -32,6 +32,9 @@ logger = logging.getLogger(__name__)
 class EventHubSourceParams(EndpointParams):
     PROVIDER = "eventhub"
     IS_SOURCE = True
+    # queue sources cannot be re-read from scratch: reupload
+    # is forbidden (model/endpoint.go AppendOnlySource)
+    is_append_only = True
 
     namespace: str = ""          # <name>.servicebus.windows.net (or host)
     hub: str = ""                # the event hub (Kafka topic)

@@ -330,3 +330,25 @@ class FileProvider(Provider):
 
     def sinker(self):
         return FileSinker(self.transfer.dst)
+
+    def cleanup(self, tables: list) -> None:
+        """Drop the named tables' output files (every sink run writes
+        uniquely-suffixed files, so without cleanup a reupload would
+        duplicate data side by side).  Matches the exact
+        `<base>.<8-hex-token>.<6-digit-counter>.<ext>` layout so a table
+        named "A"."B" never deletes "A"."B.X" files."""
+        import re as _re
+
+        path = getattr(self.transfer.dst, "path", "")
+        if not path or not os.path.isdir(path):
+            return
+        for t in tables or []:
+            tid = getattr(t, "id", t)
+            base = f"{tid.namespace}.{tid.name}" if tid.namespace \
+                else tid.name
+            # parquet: base.token.counter.ext; jsonl: base.token.jsonl
+            pat = _re.compile(
+                _re.escape(base) + r"\.[0-9a-f]{8}(\.\d{6})?\.\w+$")
+            for fname in os.listdir(path):
+                if pat.fullmatch(fname):
+                    os.unlink(os.path.join(path, fname))

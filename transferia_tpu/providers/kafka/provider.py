@@ -37,6 +37,9 @@ logger = logging.getLogger(__name__)
 class KafkaSourceParams(EndpointParams):
     PROVIDER = "kafka"
     IS_SOURCE = True
+    # queue sources cannot be re-read from scratch: reupload
+    # is forbidden (model/endpoint.go AppendOnlySource)
+    is_append_only = True
 
     brokers: list[str] = field(default_factory=lambda: ["localhost:9092"])
     topic: str = ""

@@ -174,6 +174,9 @@ class KinesisClient:
 class KinesisSourceParams(EndpointParams):
     PROVIDER = "kinesis"
     IS_SOURCE = True
+    # queue sources cannot be re-read from scratch: reupload
+    # is forbidden (model/endpoint.go AppendOnlySource)
+    is_append_only = True
 
     stream: str = ""
     region: str = "us-east-1"

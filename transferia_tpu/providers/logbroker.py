@@ -66,6 +66,9 @@ def _resolve_parser(preset: str, parser: Optional[dict],
 class LogbrokerSourceParams(EndpointParams):
     PROVIDER = "logbroker"
     IS_SOURCE = True
+    # queue sources cannot be re-read from scratch: reupload
+    # is forbidden (model/endpoint.go AppendOnlySource)
+    is_append_only = True
 
     instance: str = ""      # cluster host (reference LbSource.Instance)
     topic: str = ""

@@ -92,6 +92,19 @@ class MemoryStore:
         with self.lock:
             self.batches.clear()
 
+    def drop_table(self, table: TableID) -> None:
+        with self.lock:
+            kept = []
+            for b in self.batches:
+                if is_columnar(b):
+                    if b.table_id != table:
+                        kept.append(b)
+                else:
+                    items = [it for it in b if it.table_id != table]
+                    if items:
+                        kept.append(items)
+            self.batches = kept
+
 
 def get_store(sink_id: str) -> MemoryStore:
     if sink_id not in _STORES:
@@ -219,6 +232,14 @@ class MemoryProvider(Provider):
         if isinstance(self.transfer.src, MemorySourceParams):
             return MemoryStorage(self.transfer.src)
         return None
+
+    def cleanup(self, tables: list) -> None:
+        if isinstance(self.transfer.dst, MemoryTargetParams):
+            store = get_store(self.transfer.dst.sink_id)
+            # empty list = no-op (like every other provider) — the store
+            # may be shared by other transfers
+            for t in tables or []:
+                store.drop_table(getattr(t, "id", t))
 
     def destination_storage(self):
         if isinstance(self.transfer.dst, MemoryTargetParams):

@@ -91,6 +91,9 @@ def get_broker(broker_id: str, n_partitions: int = 1) -> MemoryBroker:
 class MQSourceParams(EndpointParams):
     PROVIDER = "mq"
     IS_SOURCE = True
+    # queue sources cannot be re-read from scratch: reupload
+    # is forbidden (model/endpoint.go AppendOnlySource)
+    is_append_only = True
 
     broker_id: str = "default"
     topic: str = "topic"

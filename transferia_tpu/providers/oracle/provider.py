@@ -116,6 +116,16 @@ def _ora_literal(v) -> str:
         return "1" if v else "0"
     if isinstance(v, (int, float)):
         return str(v)
+    # temporal keys must not depend on the session NLS_DATE_FORMAT:
+    # render through explicit TO_DATE/TO_TIMESTAMP masks
+    if isinstance(v, dt.datetime):
+        if v.microsecond:
+            return (f"TO_TIMESTAMP('{v:%Y-%m-%d %H:%M:%S.%f}', "
+                    "'YYYY-MM-DD HH24:MI:SS.FF6')")
+        return (f"TO_DATE('{v:%Y-%m-%d %H:%M:%S}', "
+                "'YYYY-MM-DD HH24:MI:SS')")
+    if isinstance(v, dt.date):
+        return f"TO_DATE('{v:%Y-%m-%d}', 'YYYY-MM-DD')"
     s = str(v).replace("'", "''")
     return f"'{s}'"
 

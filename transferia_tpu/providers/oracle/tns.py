@@ -15,8 +15,9 @@ Faithful parts (public, documented formats):
 
 Simplified parts (documented here so nobody mistakes this for OCI parity):
 the TTC session layer uses these frames and value codecs but a reduced
-message vocabulary (see wire.py), and values are single-chunk
-length-prefixed (no 0xFE long-chunk continuation).
+message vocabulary (see wire.py), and long values use a 0xFE marker
+followed by one uint32 length — not Oracle's real repeated-chunk
+continuation encoding.
 """
 
 from __future__ import annotations

@@ -40,6 +40,9 @@ DEFAULT_ENDPOINT = "https://yds.serverless.yandexcloud.net"
 class YDSSourceParams(EndpointParams):
     PROVIDER = "yds"
     IS_SOURCE = True
+    # queue sources cannot be re-read from scratch: reupload
+    # is forbidden (model/endpoint.go AppendOnlySource)
+    is_append_only = True
 
     database: str = ""    # /region/folder/db path
     stream: str = ""

@@ -2,14 +2,24 @@
 
 from transferia_tpu.tasks.activate import activate_delivery
 from transferia_tpu.tasks.checksum import ChecksumReport, checksum
+from transferia_tpu.tasks.operations import (
+    add_tables,
+    apply_persisted_include_list,
+    remove_tables,
+    reupload,
+)
 from transferia_tpu.tasks.snapshot import SnapshotLoader
 from transferia_tpu.tasks.table_splitter import split_tables
 from transferia_tpu.tasks.upload import upload
 
 __all__ = [
     "activate_delivery",
+    "add_tables",
+    "apply_persisted_include_list",
     "checksum",
     "ChecksumReport",
+    "remove_tables",
+    "reupload",
     "SnapshotLoader",
     "split_tables",
     "upload",

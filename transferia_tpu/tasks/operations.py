@@ -1,0 +1,147 @@
+"""Transfer maintenance operations: reupload, add_tables, remove_tables.
+
+Reference parity: pkg/abstract/task_type.go (the operation enum),
+pkg/worker/tasks/reupload.go (stop job -> cleanup per policy -> full
+snapshot -> restart), add_tables.go (load only the new tables, then widen
+the endpoint's include list and persist it through the coordinator),
+remove_tables.go (narrow the include list; target data is left in place).
+
+The reference gates add/remove on pg sources (add_tables.go:19
+"obsolete and supported only for pg sources"); here any storage-capable
+source qualifies — the constraint was a legacy-endpoint artifact, not a
+semantic one, and the include-list lives on the Transfer (DataObjects)
+rather than inside provider params.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from transferia_tpu.abstract.schema import TableID
+from transferia_tpu.coordinator.interface import Coordinator, TransferStatus
+from transferia_tpu.factories import new_storage
+from transferia_tpu.models import CleanupPolicy
+from transferia_tpu.models.endpoint import capability
+from transferia_tpu.providers.registry import get_provider
+from transferia_tpu.stats.registry import Metrics
+from transferia_tpu.tasks.snapshot import SnapshotLoader
+
+logger = logging.getLogger(__name__)
+
+INCLUDE_STATE_KEY = "include_object_ids"
+
+
+def reupload(transfer, coordinator: Coordinator,
+             metrics: Optional[Metrics] = None,
+             operation_id: Optional[str] = None) -> None:
+    """Full re-snapshot of an activated transfer (reupload.go:20).
+
+    Forbidden for append-only sources (reupload.go:13): wiping the target
+    of a queue-backed transfer would lose history the source no longer
+    holds.
+    """
+    if capability(transfer.src, "is_append_only", False):
+        raise ValueError("reupload from an append-only source is not "
+                         "allowed (reupload.go:13)")
+    metrics = metrics or Metrics()
+    coordinator.set_status(transfer.id, TransferStatus.ACTIVATING)
+    try:
+        loader = SnapshotLoader(transfer, coordinator, metrics=metrics,
+                                operation_id=operation_id)
+        storage = new_storage(transfer, metrics)
+        try:
+            tables = loader.filtered_table_list(storage)
+        finally:
+            storage.close()
+        if transfer.dst.cleanup_policy != CleanupPolicy.DISABLED:
+            dst_provider = get_provider(transfer.dst_provider(), transfer,
+                                        metrics)
+            logger.info("reupload cleanup (%s): %d tables",
+                        transfer.dst.cleanup_policy.value, len(tables))
+            dst_provider.cleanup(tables)
+        loader.upload_tables(tables)
+        coordinator.set_status(transfer.id, TransferStatus.ACTIVATED)
+    except BaseException as e:
+        coordinator.set_status(transfer.id, TransferStatus.FAILED)
+        coordinator.open_status_message(transfer.id, "reupload", str(e))
+        raise
+
+
+def add_tables(transfer, coordinator: Coordinator, tables: list[str],
+               metrics: Optional[Metrics] = None,
+               operation_id: Optional[str] = None) -> None:
+    """Snapshot-load new tables into a live transfer, then widen its
+    include list (add_tables.go:26).
+
+    Only the added tables are loaded — existing target data is untouched
+    (no cleanup pass, matching the reference flow which transfers the new
+    tables' schema + data before updating the endpoint).
+    """
+    if not tables:
+        raise ValueError("add_tables: explicit table list required")
+    current = set(transfer.data_objects.include_object_ids)
+    if not current:
+        raise ValueError(
+            "add_tables requires a transfer with an explicit include "
+            "list (a transfer without one already moves every table)")
+    new = [t for t in tables if t not in current]
+    if not new:
+        logger.info("add_tables: all requested tables already included")
+        return
+    metrics = metrics or Metrics()
+    from transferia_tpu.abstract.table import TableDescription
+
+    loader = SnapshotLoader(transfer, coordinator, metrics=metrics,
+                            operation_id=operation_id)
+    loader.upload_tables([
+        TableDescription(id=TableID.parse(t)) for t in new
+    ])
+    transfer.data_objects.include_object_ids.extend(new)
+    _persist_include_list(transfer, coordinator)
+    logger.info("add_tables: loaded and registered %d tables", len(new))
+
+
+def remove_tables(transfer, coordinator: Coordinator,
+                  tables: list[str],
+                  metrics: Optional[Metrics] = None) -> None:
+    """Narrow the include list (remove_tables.go:20).  Target data for the
+    removed tables stays in place, as in the reference."""
+    if not tables:
+        raise ValueError("remove_tables: explicit table list required")
+    current = transfer.data_objects.include_object_ids
+    if not current:
+        raise ValueError(
+            "remove_tables requires a transfer with an explicit include "
+            "list")
+    drop = set(tables)
+    kept = [t for t in current if t not in drop]
+    missing = drop - set(current)
+    if missing:
+        raise ValueError(f"remove_tables: not in the include list: "
+                         f"{sorted(missing)}")
+    if not kept:
+        raise ValueError("remove_tables: refusing to empty the include "
+                         "list (deactivate the transfer instead)")
+    transfer.data_objects.include_object_ids = kept
+    _persist_include_list(transfer, coordinator)
+    logger.info("remove_tables: %d tables remain", len(kept))
+
+
+def _persist_include_list(transfer, coordinator: Coordinator) -> None:
+    """Store the effective include list in transfer state so restarted
+    workers see the updated table set (add_tables.go persists the endpoint
+    through cp.GetEndpoint/UpdateEndpoint; our include list is transfer-
+    level DataObjects, so it rides the transfer-state KV)."""
+    state = coordinator.get_transfer_state(transfer.id)
+    state[INCLUDE_STATE_KEY] = list(transfer.data_objects.include_object_ids)
+    coordinator.set_transfer_state(transfer.id, state)
+
+
+def apply_persisted_include_list(transfer, coordinator: Coordinator) -> None:
+    """Merge a previously persisted include list back onto the transfer
+    (called by the replicate/activate entry points on restart)."""
+    state = coordinator.get_transfer_state(transfer.id)
+    stored = state.get(INCLUDE_STATE_KEY)
+    if stored:
+        transfer.data_objects.include_object_ids = list(stored)
