@@ -1,0 +1,174 @@
+"""Serializer machinery: buffer pool, concurrency threshold, ordered
+parallel chunking, raw_column (reference pkg/serializer/batch.go,
+buffer/pool.go, queue/{debezium_multithreading,raw_column_serializer}.go).
+"""
+
+from transferia_tpu.abstract.change_item import ChangeItem
+from transferia_tpu.abstract.kinds import Kind
+from transferia_tpu.abstract.schema import (
+    CanonicalType,
+    ColSchema,
+    TableID,
+    TableSchema,
+)
+from transferia_tpu.serializers.batch import (
+    BufferPool,
+    ConcurrentBatchSerializer,
+    ConcurrentQueueSerializer,
+    RawColumnQueueSerializer,
+    split_rows,
+)
+from transferia_tpu.serializers.formats import (
+    make_queue_serializer,
+    make_serializer,
+)
+
+SCHEMA = TableSchema([
+    ColSchema(name="id", data_type=CanonicalType.INT64, primary_key=True),
+    ColSchema(name="v", data_type=CanonicalType.UTF8),
+])
+
+
+def rows(n, start=0):
+    return [
+        ChangeItem(kind=Kind.INSERT, schema="s", table="t",
+                   table_schema=SCHEMA, column_names=("id", "v"),
+                   column_values=(i, f"v{i}"))
+        for i in range(start, start + n)
+    ]
+
+
+class TestBufferPool:
+    def test_reuse_resets_contents(self):
+        pool = BufferPool(2)
+        b = pool.get()
+        b.write(b"stale")
+        pool.put(b)
+        b2 = pool.get()
+        assert b2.getvalue() == b""
+
+    def test_bounded(self):
+        pool = BufferPool(1)
+        b = pool.get()
+        import queue as q
+        assert pool._pool.qsize() == 0
+        pool.put(b)
+        assert pool._pool.qsize() == 1
+        assert isinstance(pool._pool, q.Queue)
+
+
+class TestSplit:
+    def test_split_preserves_order_and_rows(self):
+        items = rows(10)
+        parts = split_rows(items, 3)
+        assert [len(p) for p in parts] == [3, 3, 3, 1]
+        flat = [it for p in parts for it in p]
+        assert [it.column_values[0] for it in flat] == list(range(10))
+
+
+class TestConcurrentBatch:
+    def test_below_threshold_single_shot(self):
+        inner = make_serializer("json")
+        ser = ConcurrentBatchSerializer(inner, concurrency=4,
+                                        threshold=1000)
+        out = ser.serialize(rows(10))
+        assert out.count(b"\n") == 10
+
+    def test_parallel_output_identical_to_serial(self):
+        items = rows(500)
+        serial = make_serializer("json").serialize(items)
+        parallel = ConcurrentBatchSerializer(
+            make_serializer("json"), concurrency=4, threshold=100
+        ).serialize(items)
+        assert parallel == serial
+
+    def test_factory_wraps_with_concurrency(self):
+        ser = make_serializer("json", concurrency=4, threshold=50)
+        assert isinstance(ser, ConcurrentBatchSerializer)
+        # parquet is whole-file: never wrapped
+        ser2 = make_serializer("parquet", concurrency=4)
+        assert not isinstance(ser2, ConcurrentBatchSerializer)
+
+    def test_csv_parallel_matches_serial(self):
+        items = rows(300)
+        serial = make_serializer("csv").serialize(items)
+        parallel = make_serializer("csv", concurrency=3,
+                                   threshold=50).serialize(items)
+        assert parallel == serial
+
+
+class TestConcurrentQueue:
+    def test_ordered_merge(self):
+        items = rows(400)
+        serial = make_queue_serializer("json").serialize_messages(items)
+        parallel = make_queue_serializer(
+            "json", threads=4, threshold=100).serialize_messages(items)
+        assert parallel == serial
+        assert len(parallel) == 400
+
+    def test_one_inner_per_worker(self):
+        built = []
+
+        class Probe:
+            def serialize_messages(self, batch):
+                return [(b"k", b"v") for _ in batch]
+
+        def factory():
+            built.append(1)
+            return Probe()
+
+        ser = ConcurrentQueueSerializer(factory, concurrency=4,
+                                        threshold=10)
+        out = ser.serialize_messages(rows(100))
+        assert len(out) == 100
+        assert len(built) >= 2  # parallel path built per-worker inners
+
+    def test_debezium_multithreaded_matches_serial(self):
+        items = rows(120)
+        serial = make_queue_serializer("debezium").serialize_messages(items)
+        parallel = make_queue_serializer(
+            "debezium", threads=4, threshold=20).serialize_messages(items)
+        # debezium payloads embed no wall-clock-free nondeterminism except
+        # ts_ms; compare structure row by row
+        assert len(parallel) == len(serial) == 120
+        import json
+
+        for (ks, vs), (kp, vp) in zip(serial, parallel):
+            assert ks == kp
+            a, b = json.loads(vs), json.loads(vp)
+            for p in (a["payload"], b["payload"]):
+                p.pop("ts_ms", None)
+                if isinstance(p.get("source"), dict):
+                    p["source"].pop("ts_ms", None)
+            assert a == b
+
+
+class TestRawColumn:
+    def test_extracts_named_column(self):
+        ser = RawColumnQueueSerializer("v")
+        out = ser.serialize_messages(rows(3))
+        assert out == [(None, b"v0"), (None, b"v1"), (None, b"v2")]
+
+    def test_all_rows_missing_column_raises(self):
+        import pytest
+
+        ser = RawColumnQueueSerializer("nope")
+        with pytest.raises(KeyError, match="nope"):
+            ser.serialize_messages(rows(3))
+
+    def test_partial_missing_column_warns(self, caplog):
+        import logging
+
+        mixed = rows(2)
+        mixed.append(ChangeItem(kind=Kind.INSERT, schema="s", table="t",
+                                table_schema=SCHEMA,
+                                column_names=("id",), column_values=(9,)))
+        ser = RawColumnQueueSerializer("v")
+        with caplog.at_level(logging.WARNING):
+            out = ser.serialize_messages(mixed)
+        assert out == [(None, b"v0"), (None, b"v1")]
+        assert "skipped" in caplog.text
+
+    def test_registered_in_factory(self):
+        ser = make_queue_serializer("raw_column", column="v")
+        assert isinstance(ser, RawColumnQueueSerializer)

@@ -6,6 +6,12 @@ sinks (json/csv/parquet/raw), and `QueueSerializer.serialize_messages(batch)
 mirroring serializer/interface.go and serializer/queue/*.
 """
 
+from transferia_tpu.serializers.batch import (
+    BufferPool,
+    ConcurrentBatchSerializer,
+    ConcurrentQueueSerializer,
+    RawColumnQueueSerializer,
+)
 from transferia_tpu.serializers.formats import (
     BatchSerializer,
     CsvSerializer,
@@ -19,10 +25,14 @@ from transferia_tpu.serializers.formats import (
 
 __all__ = [
     "BatchSerializer",
+    "BufferPool",
+    "ConcurrentBatchSerializer",
+    "ConcurrentQueueSerializer",
     "CsvSerializer",
     "JsonSerializer",
     "ParquetSerializer",
     "QueueSerializer",
+    "RawColumnQueueSerializer",
     "RawSerializer",
     "make_serializer",
     "make_queue_serializer",

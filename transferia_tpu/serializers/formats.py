@@ -191,26 +191,64 @@ _SERIALIZERS = {
     "raw": RawSerializer,
 }
 
+def _raw_column_queue_serializer(**cfg):
+    from transferia_tpu.serializers.batch import RawColumnQueueSerializer
+
+    return RawColumnQueueSerializer(**cfg)
+
+
 _QUEUE_SERIALIZERS = {
     "json": JsonQueueSerializer,
     "native": NativeQueueSerializer,
     "debezium": DebeziumQueueSerializer,
     "mirror": MirrorQueueSerializer,
+    "raw_column": _raw_column_queue_serializer,
 }
 
 
-def make_serializer(fmt: str, **cfg) -> BatchSerializer:
+def make_serializer(fmt: str, concurrency: int = 1,
+                    threshold: int = 0, **cfg) -> BatchSerializer:
+    """Build a serializer; concurrency > 1 wraps row-shaped formats in the
+    threshold-gated parallel chunker (batch.go:28).  Parquet is a
+    whole-file format and is never wrapped."""
     if fmt not in _SERIALIZERS:
         raise KeyError(
             f"unknown serializer {fmt!r}; known: {sorted(_SERIALIZERS)}"
         )
-    return _SERIALIZERS[fmt](**cfg)
+    inner = _SERIALIZERS[fmt](**cfg)
+    # whole-file formats and headered csv must not be chunk-concatenated
+    # (every chunk would re-emit the header mid-file)
+    unwrappable = fmt == "parquet" or (fmt == "csv" and cfg.get("header"))
+    if concurrency > 1 and not unwrappable:
+        from transferia_tpu.serializers.batch import (
+            DEFAULT_THRESHOLD,
+            ConcurrentBatchSerializer,
+        )
+
+        return ConcurrentBatchSerializer(
+            inner, concurrency=concurrency,
+            threshold=threshold or DEFAULT_THRESHOLD)
+    return inner
 
 
-def make_queue_serializer(fmt: str, **cfg) -> QueueSerializer:
+def make_queue_serializer(fmt: str, threads: int = 1,
+                          threshold: int = 0, **cfg) -> QueueSerializer:
+    """Build a queue serializer; threads > 1 returns the ordered parallel
+    wrapper with one inner serializer per worker
+    (queue/debezium_multithreading.go)."""
     if fmt not in _QUEUE_SERIALIZERS:
         raise KeyError(
             f"unknown queue serializer {fmt!r}; known: "
             f"{sorted(_QUEUE_SERIALIZERS)}"
         )
+    if threads > 1:
+        from transferia_tpu.serializers.batch import (
+            DEFAULT_THRESHOLD,
+            ConcurrentQueueSerializer,
+        )
+
+        return ConcurrentQueueSerializer(
+            lambda: _QUEUE_SERIALIZERS[fmt](**cfg),
+            concurrency=threads,
+            threshold=threshold or DEFAULT_THRESHOLD)
     return _QUEUE_SERIALIZERS[fmt](**cfg)
