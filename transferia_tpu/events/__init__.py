@@ -9,9 +9,25 @@ providers plug in without a parallel pipeline:
   InsertBatchEvent        one ColumnBatch of inserts
   RowEvents               heterogeneous ChangeItem runs
   TableLoadEvent          Init/Done control markers
-  EventSource/EventTarget adapters to Source/AsyncSink
+  EventSource/EventTarget pipeline contracts (pipeline.py) with v1
+                          bridges both ways, Snapshot/Replication
+                          providers, progressable sources
 """
 
+from transferia_tpu.events.pipeline import (
+    AsyncSinkOverEventTarget,
+    DataObjectPart,
+    DataProvider,
+    EventSource,
+    EventSourceProgress,
+    EventTarget,
+    EventTargetOverAsyncSink,
+    LogPosition,
+    ProgressableEventSource,
+    ReplicationProvider,
+    SnapshotProvider,
+    StorageSnapshotSource,
+)
 from transferia_tpu.events.model import (
     Event,
     EventBatch,
@@ -24,7 +40,19 @@ from transferia_tpu.events.model import (
 )
 
 __all__ = [
+    "AsyncSinkOverEventTarget",
+    "DataObjectPart",
+    "DataProvider",
     "Event",
+    "EventSource",
+    "EventSourceProgress",
+    "EventTarget",
+    "EventTargetOverAsyncSink",
+    "LogPosition",
+    "ProgressableEventSource",
+    "ReplicationProvider",
+    "SnapshotProvider",
+    "StorageSnapshotSource",
     "EventBatch",
     "InsertBatchEvent",
     "RawItems",

@@ -1,0 +1,64 @@
+"""Native ClickHouse event-model-v2 target.
+
+Reference parity: pkg/providers/clickhouse/a2_*.go (the a2 sink that
+consumes typed events).  InsertBatchEvent columnar blocks drive the
+sharded RowBinary writer directly — no detour through v1 row items — and
+Init TableLoadEvents create the table from the schema they carry before
+the first block arrives, so wide inserts never race DDL.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+from typing import Sequence
+
+from transferia_tpu.events.model import (
+    Event,
+    InsertBatchEvent,
+    RawItems,
+    RowEvents,
+    TableLoadEvent,
+)
+from transferia_tpu.events.pipeline import EventTarget
+from transferia_tpu.providers.clickhouse.provider import (
+    CHSinker,
+    CHTargetParams,
+)
+
+logger = logging.getLogger(__name__)
+
+
+class CHEventTarget(EventTarget):
+    def __init__(self, params: CHTargetParams):
+        self.sinker = CHSinker(params)
+
+    def _precreate(self, ev: TableLoadEvent) -> None:
+        if ev.schema is None:
+            return
+        for shard_idx in range(len(self.sinker.shards)):
+            self.sinker.ensure_table(shard_idx, ev.table_id, ev.schema)
+
+    def async_push(self, events: Sequence[Event]
+                   ) -> "concurrent.futures.Future[None]":
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        try:
+            for ev in events:
+                if isinstance(ev, TableLoadEvent):
+                    if not ev.is_done:
+                        self._precreate(ev)
+                elif isinstance(ev, InsertBatchEvent):
+                    self.sinker.push(ev.batch)
+                elif isinstance(ev, (RowEvents, RawItems)):
+                    self.sinker.push(ev.items)
+                else:
+                    raise TypeError(
+                        f"CH a2 target: unknown event "
+                        f"{type(ev).__name__}")
+            fut.set_result(None)
+        except BaseException as e:  # noqa: BLE001 — relayed to caller
+            fut.set_exception(e)
+        return fut
+
+    def close(self) -> None:
+        self.sinker.close()

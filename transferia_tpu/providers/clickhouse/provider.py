@@ -221,12 +221,17 @@ class CHSinker(Sinker):
         return self._clients[shard_idx]
 
     def _ensure_table(self, shard_idx: int, batch: ColumnBatch) -> None:
-        name = ch_table_name(batch.table_id)
+        self.ensure_table(shard_idx, batch.table_id, batch.schema)
+
+    def ensure_table(self, shard_idx: int, table_id: TableID,
+                     schema: TableSchema) -> None:
+        """Create the target table on a shard once (also the a2 target's
+        Init-event DDL path — one key scheme, one DDL builder)."""
+        name = ch_table_name(table_id)
         key = f"{shard_idx}/{name}"
         if key in self._created:
             return
-        ddl = ddl_for_schema(batch.table_id, batch.schema,
-                             self.params.engine)
+        ddl = ddl_for_schema(table_id, schema, self.params.engine)
         self._client(shard_idx).execute(ddl)
         self._created.add(key)
 
@@ -478,6 +483,13 @@ class ClickHouseProvider(Provider):
                 host=dst.host, port=dst.port, database=dst.database,
                 user=dst.user, password=dst.password, secure=dst.secure,
             ))
+        return None
+
+    def event_target(self):
+        if isinstance(self.transfer.dst, CHTargetParams):
+            from transferia_tpu.providers.clickhouse.a2 import CHEventTarget
+
+            return CHEventTarget(self.transfer.dst)
         return None
 
     def sinker(self):

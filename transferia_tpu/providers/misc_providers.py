@@ -23,6 +23,12 @@ from transferia_tpu.abstract.interfaces import (
 from transferia_tpu.abstract.schema import TableID, TableSchema
 from transferia_tpu.abstract.table import TableDescription
 from transferia_tpu.columnar.batch import ColumnBatch, arrow_to_table_schema
+from transferia_tpu.events.pipeline import (
+    DataObjectPart,
+    EventSourceProgress,
+    ProgressableEventSource,
+    SnapshotProvider,
+)
 from transferia_tpu.models.endpoint import EndpointParams, register_endpoint
 from transferia_tpu.providers.registry import Provider, register_provider
 
@@ -125,6 +131,18 @@ class DeltaStorage(Storage):
         self.table = TableID(params.namespace, params.table)
         self._files: Optional[list[str]] = None
         self._schema: Optional[TableSchema] = None
+        self._file_rows: dict[str, int] = {}   # parquet footer cache
+
+    def file_row_count(self, path: str) -> int:
+        """num_rows from the parquet footer, read at most once per file
+        (table_list and a2 data_objects both need it)."""
+        if path not in self._file_rows:
+            import pyarrow.parquet as pq
+
+            fs, _ = self._fs()
+            with fs.open(path, "rb") as fh:
+                self._file_rows[path] = pq.ParquetFile(fh).metadata.num_rows
+        return self._file_rows[path]
 
     def _fs(self):
         from transferia_tpu.providers.s3 import _fs_for
@@ -177,13 +195,7 @@ class DeltaStorage(Storage):
         if include and not any(
                 self.table.include_matches(p) for p in include):
             return {}
-        import pyarrow.parquet as pq
-
-        fs, _ = self._fs()
-        eta = 0
-        for f in self._resolve():
-            with fs.open(f, "rb") as fh:
-                eta += pq.ParquetFile(fh).metadata.num_rows
+        eta = sum(self.file_row_count(f) for f in self._resolve())
         return {self.table: TableInfo(
             eta_rows=eta, schema=self.table_schema(self.table)
         )}
@@ -204,6 +216,94 @@ class DeltaStorage(Storage):
                         pusher(batch)
 
 
+class DeltaSnapshotProvider(SnapshotProvider):
+    """Event-model-v2 snapshot provider for Delta tables (the reference
+    ships delta as an abstract2 provider: pkg/providers/delta +
+    abstract2/transfer.go:212 SnapshotProvider).
+
+    Data objects: the table; parts: one per live parquet file from the
+    transaction log, so part-parallel loads never split a file."""
+
+    def __init__(self, params: DeltaSourceParams):
+        self.params = params
+        self.storage = DeltaStorage(params)
+
+    def init(self) -> None:
+        self.storage._resolve()
+
+    def ping(self) -> None:
+        self.storage._resolve()
+
+    def close(self) -> None:
+        pass
+
+    def begin_snapshot(self) -> None:
+        # the file list is the snapshot: resolve once, reads stay pinned
+        # to it even if the log advances mid-load
+        self.storage._resolve()
+
+    def end_snapshot(self) -> None:
+        self.storage._files = None
+
+    def data_objects(self, include=None):
+        tid = self.storage.table
+        if include and not any(tid.include_matches(p) for p in include):
+            return {}
+        parts = [
+            DataObjectPart(table=tid, part_key=f,
+                           eta_rows=self.storage.file_row_count(f))
+            for f in self.storage._resolve()
+        ]
+        return {tid: parts}
+
+    def table_schema(self, part) -> TableSchema:
+        return self.storage.table_schema(part.table)
+
+    def create_snapshot_source(self, part):
+        provider = self
+
+        class _FileSource(ProgressableEventSource):
+            def __init__(self):
+                self._progress = EventSourceProgress(total=part.eta_rows)
+                self._running = False
+
+            def start(self, target) -> None:
+                import pyarrow.parquet as pq
+
+                from transferia_tpu.abstract.interfaces import resolve_all
+                from transferia_tpu.events.model import InsertBatchEvent
+
+                self._running = True
+                futures = []
+                try:
+                    fs, _ = provider.storage._fs()
+                    schema = provider.storage.table_schema(part.table)
+                    with fs.open(part.part_key, "rb") as fh:
+                        pf = pq.ParquetFile(fh)
+                        for rb in pf.iter_batches(
+                                batch_size=provider.params.batch_rows):
+                            if not rb.num_rows:
+                                continue
+                            batch = ColumnBatch.from_arrow(
+                                rb, part.table, schema)
+                            batch.read_bytes = rb.nbytes
+                            futures.append(target.async_push(
+                                [InsertBatchEvent(batch)]))
+                            self._progress.current += rb.num_rows
+                    resolve_all(futures)
+                    self._progress.done = True
+                finally:
+                    self._running = False
+
+            def running(self) -> bool:
+                return self._running
+
+            def progress(self):
+                return self._progress
+
+        return _FileSource()
+
+
 @register_provider
 class DeltaProvider(Provider):
     NAME = "delta"
@@ -211,6 +311,11 @@ class DeltaProvider(Provider):
     def storage(self):
         if isinstance(self.transfer.src, DeltaSourceParams):
             return DeltaStorage(self.transfer.src)
+        return None
+
+    def snapshot_provider(self):
+        if isinstance(self.transfer.src, DeltaSourceParams):
+            return DeltaSnapshotProvider(self.transfer.src)
         return None
 
 

@@ -74,6 +74,16 @@ class Provider(abc.ABC):
         (provider.go:84-88 Checksumable.DestinationChecksumableStorage)."""
         return None
 
+    def snapshot_provider(self):
+        """Event-model-v2 snapshot capability (abstract2/transfer.go:212
+        SnapshotProvider); None = v1 Storage path only."""
+        return None
+
+    def event_target(self):
+        """Native event-model-v2 target (abstract2/transfer.go:201
+        EventTarget); None = v1 sink wrapped via EventTargetOverAsyncSink."""
+        return None
+
     def source(self) -> Optional[Source]:
         """Replication capability."""
         return None

@@ -60,6 +60,14 @@ def activate_delivery(transfer, coordinator: Coordinator,
                 dst_provider.cleanup(tbls or [])
 
         def upload_cb(tbls):
+            # a2 sources snapshot through the event pipeline
+            # (load_snapshot_v2.go path for IsAbstract2 transfers)
+            sp = src_provider.snapshot_provider()
+            if sp is not None:
+                from transferia_tpu.tasks.snapshot_v2 import upload_v2
+
+                upload_v2(transfer, coordinator, sp, metrics)
+                return
             loader.upload_tables(tbls)
 
         src_provider = get_provider(transfer.src_provider(), transfer,
