@@ -1,0 +1,211 @@
+"""Per-DB Debezium type-mapper depth: pg exotics (ranges, arrays, hstore,
+money, uuid, bit) and mysql edge cases (unsigned bigint, enum/set, year,
+time, bit) — reference pkg/debezium/pg/emitter.go + mysql/emitter.go case
+trees, round-tripped through the emitter/receiver pair.
+"""
+
+import json
+
+from transferia_tpu.abstract.change_item import ChangeItem
+from transferia_tpu.abstract.kinds import Kind
+from transferia_tpu.abstract.schema import (
+    CanonicalType,
+    ColSchema,
+    TableSchema,
+)
+from transferia_tpu.debezium import DebeziumEmitter, DebeziumReceiver
+from transferia_tpu.debezium.types import (
+    decode_value,
+    encode_value,
+    to_connect,
+)
+
+
+def col(name, ctype, orig, pk=False):
+    return ColSchema(name=name, data_type=ctype, original_type=orig,
+                     primary_key=pk)
+
+
+def emit_one(schema, names, values):
+    item = ChangeItem(kind=Kind.INSERT, schema="public", table="t",
+                      table_schema=schema, column_names=tuple(names),
+                      column_values=tuple(values))
+    emitter = DebeziumEmitter()
+    (key, value), = emitter.emit_item(item)
+    return json.loads(value)
+
+
+class TestPGSchemas:
+    def test_uuid_semantic(self):
+        t, sem, _ = to_connect(col("u", CanonicalType.UTF8, "pg:uuid"))
+        assert (t, sem) == ("string", "io.debezium.data.Uuid")
+
+    def test_hstore_is_json(self):
+        t, sem, _ = to_connect(col("h", CanonicalType.ANY, "pg:hstore"))
+        assert (t, sem) == ("string", "io.debezium.data.Json")
+
+    def test_ranges_are_strings(self):
+        for r in ("int4range", "int8range", "numrange", "tsrange",
+                  "tstzrange", "daterange"):
+            t, sem, _ = to_connect(col("r", CanonicalType.UTF8, f"pg:{r}"))
+            assert (t, sem) == ("string", None), r
+
+    def test_bit1_is_boolean_bitn_is_bits(self):
+        t, sem, _ = to_connect(col("b", CanonicalType.UINT64, "pg:bit(1)"))
+        assert (t, sem) == ("boolean", None)
+        t, sem, params = to_connect(
+            col("b", CanonicalType.STRING, "pg:bit(8)"))
+        assert (t, sem) == ("bytes", "io.debezium.data.Bits")
+        assert params == {"length": "8"}
+
+    def test_array_maps_to_connect_array(self):
+        t, sem, _ = to_connect(
+            col("a", CanonicalType.ANY, "pg:integer[]"))
+        assert isinstance(t, dict) and t["type"] == "array"
+        assert t["items"]["type"] == "int32"  # element type from pg rules
+
+
+class TestPGValues:
+    def test_money_normalization(self):
+        assert encode_value(CanonicalType.UTF8, "$1,234.50",
+                            "pg:money") == "1234.50"
+        assert encode_value(CanonicalType.UTF8, "-$99.00",
+                            "pg:money") == "-99.00"
+
+    def test_hstore_dict_encodes_json(self):
+        out = encode_value(CanonicalType.ANY, {"a": "1"}, "pg:hstore")
+        assert json.loads(out) == {"a": "1"}
+
+    def test_range_passthrough(self):
+        assert encode_value(CanonicalType.UTF8, "[1,10)",
+                            "pg:int4range") == "[1,10)"
+
+    def test_array_elementwise(self):
+        out = encode_value(CanonicalType.UTF8,
+                           ["a-b", "c"], "pg:uuid[]")
+        assert out == ["a-b", "c"]
+
+    def test_text_array_elements_not_double_encoded(self):
+        # the array column itself is ANY (wildcard rule) but elements
+        # must encode as their own type, not json-wrapped strings
+        out = encode_value(CanonicalType.ANY, ["a", "b"], "pg:text[]")
+        assert out == ["a", "b"]
+
+    def test_int_array_items_schema(self):
+        t, _, _ = to_connect(col("a", CanonicalType.ANY, "pg:integer[]"))
+        assert t["items"]["type"] == "int32"
+
+    def test_bits_value_encoding(self):
+        import base64
+
+        enc = encode_value(CanonicalType.ANY, "1010", "pg:bit(4)")
+        assert base64.b64decode(enc) == bytes([0b1010])
+        enc = encode_value(CanonicalType.UINT64, 5, "mysql:bit(8)")
+        assert base64.b64decode(enc) == bytes([5])
+
+    def test_negative_mysql_time(self):
+        enc = encode_value(CanonicalType.UTF8, "-01:30:00", "mysql:time")
+        assert enc == -5_400_000_000
+        assert decode_value(CanonicalType.UTF8, enc,
+                            "io.debezium.time.MicroTime") == "-01:30:00"
+        # sign survives the -00:MM case too
+        enc = encode_value(CanonicalType.UTF8, "-00:30:00", "mysql:time")
+        assert enc == -1_800_000_000
+
+
+class TestMySQLValues:
+    def test_unsigned_bigint_precise_decimal(self):
+        import base64
+
+        v = 2 ** 64 - 1   # overflows int64
+        # both COLUMN_TYPE forms: with display width (< 8.0.19) and bare
+        for orig in ("mysql:bigint(20) unsigned", "mysql:bigint unsigned"):
+            enc = encode_value(CanonicalType.UINT64, v, orig)
+            raw = base64.b64decode(enc)
+            assert int.from_bytes(raw, "big", signed=True) == v, orig
+            t, sem, params = to_connect(col("u", CanonicalType.UINT64,
+                                            orig))
+            assert sem == "org.apache.kafka.connect.data.Decimal", orig
+            assert params == {"scale": "0"}, orig
+
+    def test_enum_and_set(self):
+        t, sem, params = to_connect(
+            col("e", CanonicalType.UTF8, "mysql:enum('a','b')"))
+        assert sem == "io.debezium.data.Enum"
+        assert params == {"allowed": "'a','b'"}
+        t, sem, _ = to_connect(
+            col("s", CanonicalType.UTF8, "mysql:set('x','y')"))
+        assert sem == "io.debezium.data.EnumSet"
+
+    def test_year(self):
+        t, sem, _ = to_connect(col("y", CanonicalType.INT32, "mysql:year"))
+        assert (t, sem) == ("int32", "io.debezium.time.Year")
+        assert encode_value(CanonicalType.INT32, "2026",
+                            "mysql:year") == 2026
+
+    def test_time_microtime_roundtrip(self):
+        enc = encode_value(CanonicalType.UTF8, "13:45:59.250000",
+                           "mysql:time")
+        assert enc == (13 * 3600 + 45 * 60 + 59) * 1_000_000 + 250_000
+        back = decode_value(CanonicalType.UTF8, enc,
+                            "io.debezium.time.MicroTime")
+        assert back == "13:45:59.250000"
+
+    def test_bit_n(self):
+        t, sem, params = to_connect(
+            col("b", CanonicalType.UINT64, "mysql:bit(12)"))
+        assert sem == "io.debezium.data.Bits"
+        assert params == {"length": "12"}
+
+
+class TestEnvelopeRoundTrip:
+    def test_pg_exotics_through_emitter_receiver(self):
+        schema = TableSchema([
+            col("id", CanonicalType.INT64, "pg:bigint", pk=True),
+            col("u", CanonicalType.UTF8, "pg:uuid"),
+            col("m", CanonicalType.UTF8, "pg:money"),
+            col("r", CanonicalType.UTF8, "pg:int4range"),
+            col("h", CanonicalType.ANY, "pg:hstore"),
+        ])
+        item = ChangeItem(
+            kind=Kind.INSERT, schema="public", table="t",
+            table_schema=schema,
+            column_names=("id", "u", "m", "r", "h"),
+            column_values=(7, "de305d54-75b4-431b-adb2-eb6b9e546014",
+                           "$10.50", "[2,5)", {"k": "v"}),
+        )
+        emitter = DebeziumEmitter()
+        (key, value), = emitter.emit_item(item)
+        got = DebeziumReceiver().receive(value, key)
+        d = got.as_dict()
+        assert d["id"] == 7
+        assert d["u"] == "de305d54-75b4-431b-adb2-eb6b9e546014"
+        assert d["m"] == "10.50"
+        assert d["r"] == "[2,5)"
+        assert d["h"] == {"k": "v"}
+        by_name = {c.name: c for c in got.table_schema}
+        assert dict(by_name["u"].properties).get("semantic") == \
+            "io.debezium.data.Uuid"
+
+    def test_mysql_edge_cases_through_emitter_receiver(self):
+        schema = TableSchema([
+            col("id", CanonicalType.INT64, "mysql:bigint", pk=True),
+            col("ub", CanonicalType.UINT64, "mysql:bigint unsigned"),
+            col("e", CanonicalType.UTF8, "mysql:enum('on','off')"),
+            col("y", CanonicalType.INT32, "mysql:year"),
+            col("t", CanonicalType.UTF8, "mysql:time"),
+        ])
+        item = ChangeItem(
+            kind=Kind.INSERT, schema="db", table="t",
+            table_schema=schema,
+            column_names=("id", "ub", "e", "y", "t"),
+            column_values=(1, 2 ** 63 + 5, "on", 2026, "23:59:59"),
+        )
+        emitter = DebeziumEmitter(source_db_type="mysql")
+        (key, value), = emitter.emit_item(item)
+        got = DebeziumReceiver().receive(value, key)
+        d = got.as_dict()
+        assert d["ub"] == 2 ** 63 + 5      # survived beyond int64
+        assert d["e"] == "on"
+        assert d["y"] == 2026
+        assert d["t"] == "23:59:59"

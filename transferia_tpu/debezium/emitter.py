@@ -15,15 +15,22 @@ from transferia_tpu.abstract.change_item import ChangeItem
 from transferia_tpu.abstract.kinds import Kind
 from transferia_tpu.abstract.schema import TableSchema
 from transferia_tpu.columnar.batch import ColumnBatch
-from transferia_tpu.debezium.types import TO_CONNECT, encode_value
+from transferia_tpu.debezium.types import encode_value, to_connect
 
 
 def _field_schema(cs) -> dict:
-    ctype, semantic = TO_CONNECT[cs.data_type]
-    out = {"type": ctype, "optional": not cs.required, "field": cs.name}
+    ctype, semantic, params = to_connect(cs)
+    if isinstance(ctype, dict):  # Connect array: {"type","items"}
+        out = dict(ctype)
+        out.update({"optional": not cs.required, "field": cs.name})
+    else:
+        out = {"type": ctype, "optional": not cs.required,
+               "field": cs.name}
     if semantic:
         out["name"] = semantic
         out["version"] = 1
+    if params:
+        out["parameters"] = dict(params)
     return out
 
 
@@ -132,7 +139,8 @@ class DebeziumEmitter:
         out = {}
         for n, v in zip(names, values):
             cs = schema.find(n)
-            out[n] = encode_value(cs.data_type, v) if cs else v
+            out[n] = encode_value(cs.data_type, v,
+                                  cs.original_type) if cs else v
         return out
 
     def _source(self, item: ChangeItem, snapshot: bool) -> dict:
@@ -165,11 +173,12 @@ class DebeziumEmitter:
         for c in schema.key_columns():
             if item.kind == Kind.DELETE and item.old_keys.key_names:
                 key_vals[c.name] = encode_value(
-                    c.data_type, item.old_keys.as_dict().get(c.name)
+                    c.data_type, item.old_keys.as_dict().get(c.name),
+                    c.original_type,
                 )
             else:
                 key_vals[c.name] = encode_value(
-                    c.data_type, item.value(c.name)
+                    c.data_type, item.value(c.name), c.original_type,
                 )
 
         after = None

@@ -35,7 +35,9 @@ def _decode_connect_decimal(v, scale: int):
     except Exception:
         return v
     if s <= 0:
-        return str(unscaled * 10 ** (-s))
+        # scale-0 decimals are integers (e.g. mysql bigint unsigned in
+        # precise mode): return the int, not its string form
+        return unscaled * 10 ** (-s)
     sign = "-" if unscaled < 0 else ""
     digits = str(abs(unscaled)).rjust(s + 1, "0")
     return f"{sign}{digits[:-s]}.{digits[-s:]}"
@@ -60,18 +62,25 @@ class DebeziumReceiver:
         else:
             ctype = FROM_CONNECT.get(f.get("type", "string"),
                                      CanonicalType.ANY)
-        props: tuple = ()
+        props: list = []
+        if semantic:
+            props.append(("semantic", semantic))
+        if f.get("type") == "array":
+            items = f.get("items") or {}
+            props.append(("array_item_type", items.get("type", "string")))
+            if items.get("name"):
+                props.append(("array_item_semantic", items["name"]))
         if semantic == "org.apache.kafka.connect.data.Decimal":
             # Connect Decimal: base64 big-endian unscaled bytes + a scale
             # schema parameter (pkg/debezium receiver parity)
             scale = (f.get("parameters") or {}).get("scale", "0")
-            props = (("scale", str(scale)),)
+            props.append(("scale", str(scale)))
         return ColSchema(
             name=f["field"],
             data_type=ctype,
             primary_key=f["field"] in keys,
             required=not f.get("optional", True),
-            properties=props,
+            properties=tuple(props),
         )
 
     def _schema_from_block(self, value_schema: dict,
@@ -95,7 +104,9 @@ class DebeziumReceiver:
             tuple(
                 (f.get("field"), f.get("type"), f.get("name"),
                  f.get("optional", True),
-                 tuple(sorted((f.get("parameters") or {}).items())))
+                 tuple(sorted((f.get("parameters") or {}).items())),
+                 (f.get("items") or {}).get("type"),
+                 (f.get("items") or {}).get("name"))
                 for f in after.get("fields", [])
             ),
             frozenset(keys),
@@ -179,12 +190,24 @@ class DebeziumReceiver:
             row = after or before or key_payload or {}
             schema = self._infer_schema(row, set(key_payload))
 
-        # resolve Connect-Decimal scales once per message, not per cell
-        decimal_scales = {
-            c.name: int(dict(c.properties).get("scale", 0))
-            for c in schema
-            if c.data_type == CanonicalType.DECIMAL and c.properties
-        }
+        # resolve per-column decode plans once per message, not per cell
+        decimal_scales = {}
+        semantics = {}
+        array_items = {}
+        for c in schema:
+            props = dict(c.properties) if c.properties else {}
+            if c.data_type == CanonicalType.DECIMAL and props:
+                decimal_scales[c.name] = int(props.get("scale", 0))
+            if props.get("semantic"):
+                semantics[c.name] = props["semantic"]
+            if "array_item_type" in props:
+                array_items[c.name] = (
+                    FROM_SEMANTIC.get(
+                        props.get("array_item_semantic", ""),
+                        FROM_CONNECT.get(props["array_item_type"],
+                                         CanonicalType.ANY)),
+                    props.get("array_item_semantic", ""),
+                )
 
         def decode_row(row: Optional[dict]) -> dict:
             if not row:
@@ -197,8 +220,12 @@ class DebeziumReceiver:
                 elif k in decimal_scales and v is not None:
                     out[k] = _decode_connect_decimal(
                         v, decimal_scales[k])
+                elif k in array_items and isinstance(v, list):
+                    ictype, isem = array_items[k]
+                    out[k] = [decode_value(ictype, x, isem) for x in v]
                 else:
-                    out[k] = decode_value(cs.data_type, v)
+                    out[k] = decode_value(cs.data_type, v,
+                                          semantics.get(k, ""))
             return out
 
         values = decode_row(after if kind != Kind.DELETE else None)
