@@ -63,6 +63,17 @@ def farm():
         except ImportError:  # grpcio/protobuf absent: skip ydb pairs only
             ydb = None
 
+    from tests.recipes.fake_oracle import FakeOracle, FakeOraTable
+
+    ora = FakeOracle(service_name="XEPDB1", user="scott",
+                     password="tiger").start()
+    ora.add_table(FakeOraTable(
+        "SCOTT", "SRC_T",
+        [("ID", "NUMBER(10)", True, True),
+         ("V", "VARCHAR2(40)", False, False)],
+        [{"ID": i, "V": f"v{i}"} for i in range(ROWS)],
+    ))
+
     import tempfile
 
     s3dir = tempfile.mkdtemp(prefix="matrix_s3_")
@@ -70,14 +81,15 @@ def farm():
         for i in range(ROWS):
             fh.write(f"line-{i}\n")
     yield {"pg": pg, "mysql": my, "mongo": mg, "s3dir": s3dir,
-           "ydb": ydb}
-    for srv in (pg, my, mg):
+           "ydb": ydb, "oracle": ora}
+    for srv in (pg, my, mg, ora):
         srv.stop()
     if ydb is not None:
         ydb.stop()
 
 
-SOURCES = ["sample", "pg", "mysql", "mongo", "s3line", "ydb"]
+SOURCES = ["sample", "pg", "mysql", "mongo", "s3line", "ydb",
+           "oracle"]
 SINKS = ["ch", "pg", "mysql", "fs", "memory", "ydb"]
 
 
@@ -106,6 +118,13 @@ def _source(name, farm):
             _pytest.skip("protoc unavailable for the ydb fake")
         return YdbSourceParams(endpoint=farm["ydb"].endpoint,
                                database="/local", tables=["db/src_t"])
+    if name == "oracle":
+        from transferia_tpu.providers.oracle import OracleSourceParams
+
+        return OracleSourceParams(
+            host="127.0.0.1", port=farm["oracle"].port,
+            service_name="XEPDB1", user="scott", password="tiger",
+            owner="SCOTT", desired_shards=1)
     return MongoSourceParams(host="127.0.0.1", port=farm["mongo"].port,
                              database="db")
 

@@ -110,6 +110,8 @@ class FakeOracle:
         self.tables: dict[tuple[str, str], FakeOraTable] = {}
         self.queries: list[str] = []
         self.current_scn = 1000
+        # redo rows served via V$LOGMNR_CONTENTS between START/END_LOGMNR
+        self.redo: list[dict] = []
         self.lock = threading.RLock()
         self.port = 0
         self._srv = None
@@ -129,6 +131,39 @@ class FakeOracle:
             t.rows = rows
             t.versions.append((self.current_scn, list(rows)))
             return self.current_scn
+
+    def feed_redo(self, owner: str, table: str, op_code: int,
+                  sql_redo: str, xid: str = "1.2.3",
+                  csf_parts: int = 1) -> int:
+        """Append redo rows for LogMiner mining.  csf_parts > 1 splits the
+        statement across continuation rows (CSF=1 on all but the last) the
+        way V$LOGMNR_CONTENTS chunks long SQL."""
+        import datetime as dt
+
+        with self.lock:
+            self.current_scn += 1
+            scn = self.current_scn
+            ts = dt.datetime(2026, 7, 29, 12, 0, 0)
+            rs_id = f"0x{len(self.redo):06x}"
+            if csf_parts <= 1:
+                self.redo.append({
+                    "scn": scn, "ts": ts, "xid": xid, "op": op_code,
+                    "owner": owner.upper(), "table": table.upper(),
+                    "sql": sql_redo, "csf": 0, "rs_id": rs_id, "ssn": 0,
+                })
+                return scn
+            step = max(1, len(sql_redo) // csf_parts)
+            chunks = [sql_redo[i:i + step]
+                      for i in range(0, len(sql_redo), step)]
+            for i, chunk in enumerate(chunks):
+                self.redo.append({
+                    "scn": scn, "ts": ts, "xid": xid, "op": op_code,
+                    "owner": owner.upper(), "table": table.upper(),
+                    "sql": chunk,
+                    "csf": 0 if i == len(chunks) - 1 else 1,
+                    "rs_id": rs_id, "ssn": i,
+                })
+            return scn
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "FakeOracle":
@@ -255,6 +290,56 @@ class _Session:
     def execute(self, sql: str) -> None:
         low = " ".join(sql.lower().split())
         fake = self.fake
+        if low.startswith("begin dbms_logmnr.start_logmnr"):
+            m = re.search(r"STARTSCN\s*=>\s*(\d+)", sql, re.I)
+            self.logmnr_scn = int(m.group(1)) if m else 0
+            self.describe_and_rows([("RESULT", ORA_VARCHAR2)], [])
+            return
+        if low.startswith("begin dbms_logmnr.end_logmnr"):
+            self.logmnr_scn = None
+            self.describe_and_rows([("RESULT", ORA_VARCHAR2)], [])
+            return
+        if "v$logmnr_contents" in low:
+            if getattr(self, "logmnr_scn", None) is None:
+                raise ValueError(
+                    "ORA-01306: START_LOGMNR must be invoked first")
+            m = re.search(r"SCN >(=?) (\d+)", sql, re.I)
+            floor = int(m.group(2)) if m else 0
+            inclusive = bool(m and m.group(1))
+            mo = re.search(r"SEG_OWNER = '([^']*)'", sql, re.I)
+            owner = mo.group(1) if mo else ""
+            mc = re.search(r"OPERATION_CODE IN \(([^)]*)\)", sql, re.I)
+            ops = {int(x) for x in mc.group(1).split(",")} if mc else None
+            with fake.lock:
+                rows = [
+                    r for r in fake.redo
+                    if (r["scn"] >= floor if inclusive
+                        else r["scn"] > floor)
+                    and (not owner or r["owner"] == owner)
+                    and (ops is None or r["op"] in ops)
+                ]
+            encoded = [
+                [encode_value(ORA_NUMBER, r["scn"]),
+                 encode_value(ORA_VARCHAR2, r.get("rs_id", "")),
+                 encode_value(ORA_NUMBER, r.get("ssn", 0)),
+                 encode_value(ORA_DATE, r["ts"]),
+                 encode_value(ORA_VARCHAR2, r["xid"]),
+                 encode_value(ORA_NUMBER, r["op"]),
+                 encode_value(ORA_VARCHAR2, r["owner"]),
+                 encode_value(ORA_VARCHAR2, r["table"]),
+                 encode_value(ORA_VARCHAR2, r["sql"]),
+                 encode_value(ORA_NUMBER, r["csf"])]
+                for r in rows
+            ]
+            self.describe_and_rows(
+                [("SCN", ORA_NUMBER), ("RS_ID", ORA_VARCHAR2),
+                 ("SSN", ORA_NUMBER), ("TIMESTAMP", ORA_DATE),
+                 ("XID", ORA_VARCHAR2), ("OPERATION_CODE", ORA_NUMBER),
+                 ("SEG_OWNER", ORA_VARCHAR2),
+                 ("TABLE_NAME", ORA_VARCHAR2),
+                 ("SQL_REDO", ORA_VARCHAR2), ("CSF", ORA_NUMBER)],
+                encoded)
+            return
         if low == "select 1 from dual":
             self.describe_and_rows(
                 [("1", ORA_NUMBER)], [[encode_value(ORA_NUMBER, 1)]])

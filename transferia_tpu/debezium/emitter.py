@@ -63,6 +63,10 @@ class DebeziumEmitter:
         self.emit_tombstones = emit_tombstones
         self.source_db_type = source_db_type
         self.key_packer = self.value_packer = None
+        # id(schema) keys are safe: TableSchema objects are shared per
+        # batch and never mutated; an ALTER produces a new object
+        self._value_schema_cache: dict = {}
+        self._key_schema_cache: dict = {}
         if packer == "schema_registry":
             from transferia_tpu.debezium.packer import SchemaRegistryPacker
             from transferia_tpu.schemaregistry import SchemaRegistryClient
@@ -90,13 +94,16 @@ class DebeziumEmitter:
     # -- schema blocks (cached per table schema fingerprint) ---------------
     def _value_schema(self, item: ChangeItem, schema: TableSchema) -> dict:
         fqtn = f"{self.topic_prefix}.{item.schema}.{item.table}"
+        cached = self._value_schema_cache.get((fqtn, id(schema)))
+        if cached is not None:
+            return cached
         row_fields = [_field_schema(c) for c in schema]
         row_struct = lambda name: {  # noqa: E731
             "type": "struct", "optional": True, "field": name,
             "fields": row_fields,
             "name": f"{fqtn}.Value",
         }
-        return {
+        out = {
             "type": "struct",
             "name": f"{fqtn}.Envelope",
             "optional": False,
@@ -126,13 +133,20 @@ class DebeziumEmitter:
                 {"type": "int64", "optional": True, "field": "ts_ms"},
             ],
         }
+        self._value_schema_cache[(fqtn, id(schema))] = out
+        return out
 
     def _key_schema(self, item: ChangeItem, schema: TableSchema) -> dict:
         fqtn = f"{self.topic_prefix}.{item.schema}.{item.table}"
-        return {
+        cached = self._key_schema_cache.get((fqtn, id(schema)))
+        if cached is not None:
+            return cached
+        out = {
             "type": "struct", "optional": False, "name": f"{fqtn}.Key",
             "fields": [_field_schema(c) for c in schema.key_columns()],
         }
+        self._key_schema_cache[(fqtn, id(schema))] = out
+        return out
 
     # -- payload ------------------------------------------------------------
     def _row_payload(self, names, values, schema: TableSchema) -> dict:

@@ -147,6 +147,9 @@ def to_connect(cs) -> tuple[Any, Optional[str], dict]:
         if base == "time":
             return "int64", "io.debezium.time.MicroTime", {}
         if base == "bit":
+            if args in ("", "1"):
+                # the Debezium MySQL connector maps BIT(1) to boolean
+                return "boolean", None, {}
             return "bytes", "io.debezium.data.Bits", \
                 ({"length": args} if args else {})
 
@@ -247,10 +250,22 @@ def _encode_bits(v: Any, length_arg: str) -> str:
 
 
 def _normalize_money(v: Any) -> str:
-    """'$1,234.50' -> '1234.50' (pg/emitter.go money handling)."""
+    """Currency text -> plain decimal string (pg/emitter.go money).
+
+    Handles any symbol position ('$-99.00', '(1.00)') and comma-decimal
+    lc_monetary locales ('1.234,56' -> '1234.56'): the RIGHTMOST of
+    '.'/',' is the decimal separator when it is followed by exactly two
+    digits; every other separator is grouping."""
     s = str(v).strip()
-    neg = s.startswith("-") or s.startswith("($") or s.startswith("(")
-    s = re.sub(r"[^0-9.]", "", s)
+    neg = "-" in s or s.startswith("(")
+    s = re.sub(r"[^0-9.,]", "", s)
+    last_dot, last_comma = s.rfind("."), s.rfind(",")
+    sep = max(last_dot, last_comma)
+    if sep >= 0 and len(s) - sep - 1 == 2:
+        intpart = re.sub(r"[.,]", "", s[:sep])
+        s = f"{intpart}.{s[sep + 1:]}"
+    else:
+        s = re.sub(r"[.,]", "", s)
     return ("-" + s) if neg and s else s
 
 
@@ -293,6 +308,8 @@ def encode_value(ctype: CanonicalType, v: Any,
             if base in ("enum", "set"):
                 return str(v)
             if base == "bit":
+                if _args in ("", "1"):
+                    return v in (True, 1, "1", b"\x01", "t", "true")
                 return _encode_bits(v, _args)
     if ctype == CanonicalType.DATETIME:
         return int(v) * 1000  # seconds -> ms (io.debezium.time.Timestamp)

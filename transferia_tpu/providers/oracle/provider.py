@@ -5,8 +5,9 @@ types SID/ServiceName/TNS, ConvertNumberToInt64, include/exclude),
 snapshot/table_source.go:69 (SCN-consistent reads: ``select ... as of scn
 N``), provider/sharding_storage.go (ROWID-range intra-table splits),
 schema/ (ALL_TAB_COLUMNS-driven schema, type casts in snapshot/cast.go).
-LogMiner CDC replication (reference replication/) is not implemented yet;
-snapshot + SCN position checkpointing is.
+LogMiner CDC replication lives in logminer.py (reference
+replication/log_miner/); SCN position checkpointing is shared between
+the consistent snapshot and the CDC resume point.
 """
 
 from __future__ import annotations
@@ -453,6 +454,17 @@ class OracleProvider(Provider):
     def storage(self):
         if isinstance(self.transfer.src, OracleSourceParams):
             return OracleStorage(self.transfer.src)
+        return None
+
+    def source(self):
+        """LogMiner CDC (reference replication/log_miner/)."""
+        if isinstance(self.transfer.src, OracleSourceParams):
+            from transferia_tpu.providers.oracle.logminer import (
+                OracleLogMinerSource,
+            )
+
+            return OracleLogMinerSource(
+                self.transfer.src, self.transfer.id, self.coordinator)
         return None
 
     def test(self) -> TestResult:
