@@ -56,13 +56,19 @@ CONFIG = {"transformers": [
 ]}
 
 
-def run_chain(config, batch, fused: bool):
+def run_chain(config, batch, fused: bool, placement: str = "device"):
+    # placement pinned to "device" so fused=True really exercises the XLA
+    # program (auto would route the first batch to the host strategy)
+    from transferia_tpu.transform.fused import set_placement
+
     set_device_fusion(fused)
+    set_placement(placement)
     try:
         chain = build_chain(config)
         return chain.apply(batch)
     finally:
         set_device_fusion(None)
+        set_placement(None)
 
 
 def batches_equal(a: ColumnBatch, b: ColumnBatch):

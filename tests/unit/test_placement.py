@@ -1,0 +1,181 @@
+"""Link-aware placement of fused transform steps.
+
+The fused mask+filter step has two byte-identical strategies — the XLA
+device program and the host path (predicate pushdown + C++ SHA-NI).  The
+auto placement mode measures both on real batches and keeps the winner
+(transform/fused.py); the link profile (ops/linkprobe.py) informs device
+chunk sizing.  No reference analogue: the reference assumes a local
+accelerator; this framework must also run well against tunneled devices.
+"""
+
+import binascii
+import os
+
+import numpy as np
+import pytest
+
+from tests.unit.test_fused_device import (
+    CONFIG,
+    TID,
+    batches_equal,
+    make_batch,
+    run_chain,
+)
+from transferia_tpu.columnar.hexcol import digests_to_hex, hex_to_varwidth
+from transferia_tpu.ops import linkprobe
+from transferia_tpu.transform import build_chain
+from transferia_tpu.transform.fused import (
+    DeviceFusedStep,
+    set_device_fusion,
+    set_placement,
+)
+
+
+@pytest.fixture(autouse=True)
+def _reset_placement():
+    yield
+    set_placement(None)
+    set_device_fusion(None)
+
+
+def test_host_strategy_parity():
+    """Pushdown host strategy == plain host chain == device program."""
+    batch = make_batch()
+    plain = run_chain(CONFIG, batch, fused=False)
+    host = run_chain(CONFIG, batch, fused=True, placement="host")
+    dev = run_chain(CONFIG, batch, fused=True, placement="device")
+    batches_equal(plain, host)
+    batches_equal(plain, dev)
+
+
+def test_auto_measures_both_then_sticks():
+    set_device_fusion(True)
+    set_placement("auto")
+    chain = build_chain(CONFIG)
+    plain = run_chain(CONFIG, make_batch(), fused=False)
+    for _ in range(4):
+        out = chain.apply(make_batch())
+        batches_equal(plain, out)
+    step = chain.plan_for(TID, make_batch(4).schema).steps[0]
+    assert isinstance(step, DeviceFusedStep)
+    # both strategies were measured; a winner exists
+    assert step._ns_row["host"] > 0
+    assert step._ns_row["device"] > 0
+    assert step._pick_strategy() in ("host", "device")
+
+
+def test_auto_reprobes_loser():
+    set_device_fusion(True)
+    set_placement("auto")
+    chain = build_chain(CONFIG)
+    step = chain.plan_for(TID, make_batch(4).schema).steps[0]
+    # host wins but is slow enough that the link model allows a re-probe
+    step._ns_row = {"host": 50_000.0, "device": 90_000.0}
+    step._batch_no = DeviceFusedStep.REPROBE_EVERY - 1
+    assert step._pick_strategy(4096) == "device"  # loser gets a re-probe
+    step._batch_no = 1
+    assert step._pick_strategy(4096) == "host"
+
+
+def test_auto_gates_device_probe_on_slow_link(monkeypatch):
+    from transferia_tpu.ops import linkprobe as lp
+
+    slow = lp.LinkProfile(backend="tpu", launch_overhead_s=0.07,
+                          h2d_bytes_per_s=20e6, d2h_bytes_per_s=20e6,
+                          measured=True)
+    monkeypatch.setattr(lp, "probe_link", lambda force=False: slow)
+    set_device_fusion(True)
+    set_placement("auto")
+    chain = build_chain(CONFIG)
+    step = chain.plan_for(TID, make_batch(4).schema).steps[0]
+    step._ns_row = {"host": 200.0, "device": -1.0}  # host measured, fast
+    # a small batch through a 70ms-launch link: the device probe (which
+    # would cost ~1s of p99) must be gated by the prediction
+    assert step._pick_strategy(2048) == "host"
+    assert step._device_gated
+    # the re-probe path stays gated as well
+    step._ns_row = {"host": 200.0, "device": 25_000.0}
+    step._batch_no = DeviceFusedStep.REPROBE_EVERY - 1
+    assert step._pick_strategy(2048) == "host"
+
+
+def test_host_strategy_masks_only_surviving_rows(monkeypatch):
+    """Pushdown: the host hash must run on the post-filter row count."""
+    import transferia_tpu.transform.fused as fused_mod
+
+    seen = []
+    real = None
+    from transferia_tpu.transform.plugins import mask as mask_mod
+
+    real = mask_mod._host_hmac_hex
+
+    def spy(key, data, offsets, validity):
+        seen.append(len(offsets) - 1)
+        return real(key, data, offsets, validity)
+
+    monkeypatch.setattr(mask_mod, "_host_hmac_hex", spy)
+    batch = make_batch(512)
+    out = run_chain(CONFIG, batch, fused=True, placement="host")
+    assert seen, "host strategy did not reach the native hash"
+    assert seen[0] == out.n_rows
+    assert out.n_rows < batch.n_rows  # the filter really dropped rows
+
+
+def test_digests_to_hex_matches_binascii():
+    rng = np.random.default_rng(7)
+    words = rng.integers(0, 2**32, size=(17, 8), dtype=np.uint64).astype(
+        np.uint32)
+    out = digests_to_hex(words)
+    assert out.shape == (17, 64)
+    for i in range(17):
+        raw = words[i].astype(">u4").tobytes()
+        assert bytes(out[i]) == binascii.hexlify(raw)
+
+
+def test_hex_to_varwidth_partial_validity_gather():
+    hexes = np.arange(4 * 64, dtype=np.uint8).reshape(4, 64) % 16 + 97
+    validity = np.array([True, False, True, False])
+    data, offsets = hex_to_varwidth(hexes, validity)
+    assert offsets.tolist() == [0, 64, 64, 128, 128]
+    assert bytes(data[:64]) == bytes(hexes[0])
+    assert bytes(data[64:]) == bytes(hexes[2])
+
+
+def test_linkprobe_env_pin(monkeypatch):
+    monkeypatch.setenv("TRANSFERIA_TPU_LINK", "70,1200,20")
+    linkprobe.reset_link_cache()
+    try:
+        prof = linkprobe.probe_link()
+        assert not prof.measured
+        assert prof.launch_overhead_s == pytest.approx(0.070)
+        assert prof.h2d_bytes_per_s == pytest.approx(1.2e9)
+        assert prof.d2h_bytes_per_s == pytest.approx(20e6)
+        assert "pinned" in prof.describe()
+    finally:
+        linkprobe.reset_link_cache()
+
+
+def test_linkprobe_cpu_backend_is_inprocess():
+    linkprobe.reset_link_cache()
+    prof = linkprobe.probe_link()
+    # conftest pins the virtual CPU mesh in unit tests
+    assert prof.backend == "cpu"
+    assert not prof.measured
+    assert prof.launch_overhead_s < 0.001
+
+
+@pytest.mark.parametrize("launch_ms,expect", [(70.0, 0), (0.2, 32768)])
+def test_chunk_sizing_follows_launch_overhead(monkeypatch, launch_ms,
+                                              expect):
+    from transferia_tpu.ops import fused as ops_fused
+
+    prof = linkprobe.LinkProfile(
+        backend="tpu", launch_overhead_s=launch_ms / 1e3,
+        h2d_bytes_per_s=1.2e9, d2h_bytes_per_s=20e6, measured=True)
+    monkeypatch.setattr(linkprobe, "probe_link", lambda force=False: prof)
+    monkeypatch.delenv("TRANSFERIA_TPU_CHUNK_ROWS", raising=False)
+    ops_fused.set_chunk_rows(None)
+    try:
+        assert ops_fused._chunk_rows() == expect
+    finally:
+        ops_fused.set_chunk_rows(None)

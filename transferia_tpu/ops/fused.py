@@ -57,11 +57,18 @@ def _chunk_rows() -> int:
         if env is not None:
             _chunk_rows_cached = max(0, int(env))
         else:
-            try:
-                backend = jax.default_backend()
-            except Exception:
-                backend = "cpu"
-            _chunk_rows_cached = 0 if backend == "cpu" else 32768
+            from transferia_tpu.ops.linkprobe import probe_link
+
+            link = probe_link()
+            if link.backend in ("cpu", "none"):
+                _chunk_rows_cached = 0
+            elif link.launch_overhead_s > 0.005:
+                # high-latency link (tunneled device): per-chunk launches
+                # cost more than the overlap they buy — one launch per
+                # batch, overlap rides across batches instead
+                _chunk_rows_cached = 0
+            else:
+                _chunk_rows_cached = 32768
     return _chunk_rows_cached
 
 
@@ -87,14 +94,6 @@ def _pallas_pack_enabled() -> bool:
         return jax.default_backend() == "tpu"
     except Exception:
         return False
-
-
-def hex_device(h):
-    """(N, 8) uint32 digest words -> (N, 64) ascii-hex uint8, on device."""
-    shifts = jnp.arange(28, -1, -4, dtype=jnp.uint32)  # 28,24,...,0
-    nib = (h[:, :, None] >> shifts[None, None, :]) & jnp.uint32(0xF)
-    out = jnp.where(nib < 10, nib + 48, nib + 87).astype(jnp.uint8)
-    return out.reshape(h.shape[0], 64)
 
 
 def pow2_blocks(max_len: int) -> int:
@@ -159,8 +158,12 @@ class FusedMaskFilterProgram:
 
         def program(blocks_t, nblocks_t, states_t, pred_cols,
                     max_blocks_t):
-            hexes = tuple(
-                hex_device(hmac_device_core(b, nb, st[0], st[1], mb))
+            # raw (N, 8) u32 digests leave the device — 32 bytes/row vs
+            # 64 for hex; the host LUT-expands (columnar/hexcol.py).  On
+            # bandwidth-starved links (see ops/linkprobe.py) D2H is the
+            # bottleneck stage, so the return payload is kept minimal.
+            digests = tuple(
+                hmac_device_core(b, nb, st[0], st[1], mb)
                 for b, nb, st, mb in zip(
                     blocks_t, nblocks_t, states_t, max_blocks_t
                 )
@@ -171,7 +174,7 @@ class FusedMaskFilterProgram:
                 keep = self._pred_fn(pred_cols, blocks_t[0].shape[0])
             else:
                 keep = jnp.zeros((0,), dtype=jnp.bool_)  # unused sentinel
-            return hexes, keep
+            return digests, keep
 
         self._jit = jax.jit(program, static_argnums=(4,))
 
@@ -249,19 +252,18 @@ class FusedMaskFilterProgram:
             )
         return hexes_dev, keep_dev
 
-    def _collect(self, hexes_dev, keep_dev, n_rows
+    def _collect(self, digests_dev, keep_dev, n_rows
                  ) -> tuple[list[np.ndarray], Optional[np.ndarray]]:
-        """Block on D2H and trim bucket padding."""
+        """Block on D2H, trim bucket padding, hex-expand on host."""
+        from transferia_tpu.columnar.hexcol import digests_to_hex
+
         hexes = []
         with stagetimer.stage("device_wait"):
-            for h in hexes_dev:
-                arr = np.asarray(h)
-                if arr.shape[0] != n_rows:
-                    # slice-copy: a view would pin the bucket-padded
-                    # buffer (up to 4x the live rows) for the batch's
-                    # lifetime
-                    arr = arr[:n_rows].copy()
-                hexes.append(arr)
+            for h in digests_dev:
+                # digests_to_hex allocates fresh output, so the sliced
+                # view never pins the bucket-padded transfer buffer
+                arr = np.asarray(h)[:n_rows]
+                hexes.append(digests_to_hex(arr))
             keep = (np.asarray(keep_dev)[:n_rows]
                     if self._pred_fn is not None else None)
         return hexes, keep

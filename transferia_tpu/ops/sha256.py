@@ -219,16 +219,6 @@ def _words_to_bytes(h: np.ndarray) -> np.ndarray:
     return out
 
 
-_HEX = np.frombuffer(b"0123456789abcdef", dtype=np.uint8)
-
-
-def _hex_encode(digests: np.ndarray) -> np.ndarray:
-    """(N, 32) uint8 -> (N, 64) ascii hex uint8."""
-    hi = _HEX[digests >> 4]
-    lo = _HEX[digests & 0x0F]
-    return np.stack([hi, lo], axis=2).reshape(digests.shape[0], 64)
-
-
 @functools.lru_cache(maxsize=64)
 def _hmac_key_states(key: bytes) -> tuple[np.ndarray, np.ndarray]:
     """Precompute the per-key inner/outer states (one compression each)."""
@@ -325,8 +315,12 @@ def hmac_sha256_hex_batch(key: bytes, data: np.ndarray,
         jnp.asarray(blocks), jnp.asarray(n_blocks),
         (jnp.asarray(inner), jnp.asarray(outer)), max_blocks,
     )
-    hexes = _hex_encode(_words_to_bytes(np.asarray(h)[:n]))  # (N, 64)
-    from transferia_tpu.columnar.hexcol import hex_to_varwidth
+    from transferia_tpu.columnar.hexcol import (
+        digests_to_hex,
+        hex_to_varwidth,
+    )
+
+    hexes = digests_to_hex(np.asarray(h)[:n])  # (N, 64)
 
     return hex_to_varwidth(hexes, validity)
 

@@ -35,8 +35,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from transferia_tpu.columnar.batch import bucket_rows
+from transferia_tpu.columnar.hexcol import digests_to_hex
 from transferia_tpu.ops.fused import (
-    hex_device,
     pack_hmac_blocks,
     pow2_blocks,
 )
@@ -97,8 +97,10 @@ class ShardedFusedProgram:
         def per_device(blocks_t, nblocks_t, states_t, pred_cols,
                        valid, max_blocks_t):
             rows_local = valid.shape[0]
-            hexes = tuple(
-                hex_device(hmac_device_core(b, nb, st[0], st[1], mb))
+            # raw digest words leave the device (32 B/row, host LUT hex
+            # expansion — same contract as FusedMaskFilterProgram)
+            digests = tuple(
+                hmac_device_core(b, nb, st[0], st[1], mb)
                 for b, nb, st, mb in zip(
                     blocks_t, nblocks_t, states_t, max_blocks_t
                 )
@@ -109,10 +111,8 @@ class ShardedFusedProgram:
                 keep = valid
             # cross-chip collectives: global kept count + target-shard
             # histogram over the first masked column's digest words
-            digest0 = hmac_device_core(
-                blocks_t[0], nblocks_t[0], states_t[0][0],
-                states_t[0][1], max_blocks_t[0])
-            shard = (digest0[:, 0] % jnp.uint32(self.n_shards)).astype(
+            # (digests[0] is already computed above — XLA CSEs the reuse)
+            shard = (digests[0][:, 0] % jnp.uint32(self.n_shards)).astype(
                 jnp.int32)
             hist = jnp.zeros((self.n_shards,), dtype=jnp.int32).at[
                 shard].add(keep.astype(jnp.int32))
@@ -120,7 +120,7 @@ class ShardedFusedProgram:
             kept = jax.lax.psum(keep.sum(), axis_name=row_axes)
             out_keep = (keep if self._pred_fn is not None
                         else jnp.zeros((0,), dtype=jnp.bool_))
-            return hexes, out_keep, hist, kept
+            return digests, out_keep, hist, kept
 
         self._per_device = per_device
 
@@ -203,14 +203,13 @@ class ShardedFusedProgram:
         stagetimer.add("pack", _time.perf_counter() - pack_t0)
         fn = self._get_compiled(len(mask_cols), tuple(sorted(dev_pred)))
         with stagetimer.stage("device_dispatch"):
-            hexes_dev, keep_dev, hist, kept = fn(
+            digests_dev, keep_dev, hist, kept = fn(
                 tuple(blocks_t), tuple(nblocks_t), tuple(self._states),
                 dev_pred, valid, tuple(mb_t),
             )
         with stagetimer.stage("device_wait"):
-            hexes = [np.asarray(h)[:n_rows].copy()
-                     if total != n_rows else np.asarray(h)
-                     for h in hexes_dev]
+            hexes = [digests_to_hex(np.asarray(h)[:n_rows])
+                     for h in digests_dev]
             keep = (np.asarray(keep_dev)[:n_rows]
                     if self._pred_fn is not None else None)
             self.last_shard_hist = np.asarray(hist)

@@ -66,10 +66,39 @@ def set_device_fusion(on: Optional[bool]) -> None:
     _enabled = on
 
 
+_placement: Optional[str] = None
+
+
+def placement_mode() -> str:
+    """Execution strategy for fused steps: auto | device | host.
+
+    auto (default) measures both strategies on real batches and keeps the
+    winner (re-probing the loser periodically) — on a PCIe-attached chip
+    the device program wins; through a high-latency tunneled device (see
+    ops/linkprobe.py) the host path with predicate pushdown wins.  The
+    device program stays compiled either way, and both strategies produce
+    byte-identical output (pinned by tests).
+    """
+    global _placement
+    if _placement is None:
+        mode = os.environ.get("TRANSFERIA_TPU_PLACEMENT", "auto").lower()
+        _placement = mode if mode in ("auto", "device", "host") else "auto"
+    return _placement
+
+
+def set_placement(mode: Optional[str]) -> None:
+    """Force the placement mode (None = re-read the env)."""
+    global _placement
+    _placement = mode
+
+
 class DeviceFusedStep(Transformer):
     """A fused run of mask_field/filter_rows steps, one device launch."""
 
     TYPE = "device_fused"
+
+    # auto placement: re-probe the losing strategy every this many batches
+    REPROBE_EVERY = 256
 
     def __init__(self, members: Sequence[Transformer],
                  mask_entries: Sequence[tuple[str, bytes]],
@@ -94,6 +123,18 @@ class DeviceFusedStep(Transformer):
             self.sharded_program = ShardedFusedProgram(keys, pred_node)
             # below ~1k rows/device the launch+collective overhead wins
             self._sharded_min_rows = 1024 * _mesh_devices()
+        # host strategy: vectorized predicate pushed down before the mask
+        self._host_pred_fn = None
+        if pred_node is not None:
+            from transferia_tpu.predicate import compile_mask
+
+            self._host_pred_fn = compile_mask(pred_node)
+        # auto-placement state (ns/row EMAs; -1 = not yet measured)
+        self._ns_row = {"host": -1.0, "device": -1.0}
+        self._batch_no = 0
+        self._dev_samples = 0
+        self._choice_logged = False
+        self._device_gated = False
 
     def suitable(self, table: TableID, schema: TableSchema) -> bool:
         # constructed at plan time from already-suitable members
@@ -120,8 +161,115 @@ class DeviceFusedStep(Transformer):
             for m in self.members:
                 out = m.apply(out).transformed
             return TransformResult(out)
+        strategy = self._pick_strategy(batch.n_rows)
+        if strategy == "host":
+            return self._apply_host(batch)
+        return self._apply_device(batch)
+
+    def _predict_device_ns_row(self, n_rows: int) -> float:
+        """Link-model estimate of the device strategy's cost per row.
+
+        Two syncs (dispatch + collect) pay the launch overhead; H2D moves
+        the padded SHA block matrices (~2 blocks/row typical) plus the
+        predicate columns; D2H returns 32 digest bytes/row per masked
+        column plus the keep mask.  Compute is taken from the measured
+        on-chip kernel rate's order (~10M rows/s — vanishingly small next
+        to a slow link, irrelevant next to a fast one).
+        """
+        from transferia_tpu.ops.linkprobe import probe_link
+
+        link = probe_link()
+        n_mask = max(len(self.mask_entries), 1)
+        h2d_bytes = n_rows * (128 * n_mask + 8 * len(self.pred_cols))
+        d2h_bytes = n_rows * (32 * n_mask + 1)
+        s = (2 * link.launch_overhead_s
+             + h2d_bytes / link.h2d_bytes_per_s
+             + d2h_bytes / link.d2h_bytes_per_s
+             + n_rows / 10e6)
+        return s * 1e9 / max(n_rows, 1)
+
+    # only probe the device strategy when the link model says it could
+    # plausibly win — an unconditional probe through a ~70ms-RTT tunneled
+    # device costs ~1s and lands straight in the p99
+    PROBE_HEADROOM = 4.0
+
+    def _pick_strategy(self, n_rows: int = 0) -> str:
+        mode = placement_mode()
+        if mode in ("device", "host"):
+            return mode
+        # auto: measure each strategy once, keep the winner, re-probe the
+        # loser every REPROBE_EVERY batches (links drift — see linkprobe)
+        host_ns, dev_ns = self._ns_row["host"], self._ns_row["device"]
+        if host_ns < 0:
+            return "host"
+        if dev_ns < 0:
+            predicted = self._predict_device_ns_row(max(n_rows, 1))
+            if predicted > host_ns * self.PROBE_HEADROOM:
+                if not self._device_gated:
+                    self._device_gated = True
+                    logger.info(
+                        "fused step %s placement: host (device gated by "
+                        "link model: predicted %.0fns/row vs host "
+                        "%.0fns/row)", self.describe(), predicted, host_ns)
+                return "host"
+            return "device"
+        winner = "host" if host_ns <= dev_ns else "device"
+        if self._batch_no % self.REPROBE_EVERY == self.REPROBE_EVERY - 1:
+            loser = "device" if winner == "host" else "host"
+            if loser == "device":
+                # the link model gates device re-probes too: through a
+                # slow tunnel a single probe batch costs ~1s of p99
+                predicted = self._predict_device_ns_row(max(n_rows, 1))
+                if predicted > host_ns * self.PROBE_HEADROOM:
+                    return winner
+            return loser
+        if not self._choice_logged:
+            self._choice_logged = True
+            logger.info(
+                "fused step %s placement: %s (host=%.0fns/row "
+                "device=%.0fns/row)", self.describe(), winner,
+                host_ns, dev_ns)
+        return winner
+
+    def _observe(self, strategy: str, seconds: float, n_rows: int) -> None:
+        self._batch_no += 1
+        if strategy == "device":
+            self._dev_samples += 1
+            if self._dev_samples == 1:
+                # the first device batch carries the XLA compile (seconds
+                # on TPU) — recording it would poison the EMA and pin the
+                # auto-tuner to host on hardware where device wins
+                return
+        ns = seconds * 1e9 / max(n_rows, 1)
+        prev = self._ns_row[strategy]
+        self._ns_row[strategy] = ns if prev < 0 else 0.7 * prev + 0.3 * ns
+
+    def placement_summary(self) -> str:
+        """Read-only diagnostics line (no probing side effects)."""
+        host_ns, dev_ns = self._ns_row["host"], self._ns_row["device"]
+        if host_ns < 0 and dev_ns < 0:
+            current = "unmeasured"
+        elif dev_ns < 0:
+            current = "host"
+        elif host_ns < 0:
+            current = "device"
+        else:
+            current = "host" if host_ns <= dev_ns else "device"
+        def fmt(v: float) -> str:
+            if v >= 0:
+                return f"{v:.0f}ns/row"
+            return ("gated-by-link-model" if self._device_gated
+                    else "unmeasured")
+
+        return (f"placement={current} host={fmt(host_ns)} "
+                f"device={fmt(dev_ns)}")
+
+    def _apply_device(self, batch: ColumnBatch) -> TransformResult:
+        import time as _time
+
         from transferia_tpu.ops.fused import hex_to_varwidth
 
+        t0 = _time.perf_counter()
         mask_inputs = []
         for name, _key in self.mask_entries:
             col = batch.column(name)
@@ -150,6 +298,41 @@ class DeviceFusedStep(Transformer):
                                      self.result_schema(batch.schema))
             if keep is not None and not keep.all():
                 out = out.filter(keep)
+        self._observe("device", _time.perf_counter() - t0, batch.n_rows)
+        return TransformResult(out)
+
+    def _apply_host(self, batch: ColumnBatch) -> TransformResult:
+        """Host strategy with predicate pushdown.
+
+        The fusion preconditions guarantee the predicate never reads a
+        column masked in this run, so filtering FIRST and hashing only the
+        surviving rows is byte-equivalent to the device program (which
+        hashes every row, then compacts) — it just skips the wasted
+        hashes.  The hash itself is the batched C++ SHA-NI path
+        (native/hostops.cpp), GIL-released so part threads overlap.
+        """
+        import time as _time
+
+        from transferia_tpu.stats import stagetimer
+        from transferia_tpu.transform.plugins.mask import _host_hmac_hex
+
+        t0 = _time.perf_counter()
+        cur = batch
+        if self._host_pred_fn is not None:
+            keep = self._host_pred_fn(batch)
+            if not keep.all():
+                cur = batch.filter(keep)
+        with stagetimer.stage("host_mask"):
+            cols = dict(cur.columns)
+            for name, key in self.mask_entries:
+                col = cur.column(name)
+                data, offsets = _host_hmac_hex(
+                    key, col.data, col.offsets, col.validity)
+                cols[name] = Column(name, CanonicalType.UTF8, data,
+                                    offsets, col.validity)
+            out = cur.with_columns(cols,
+                                   self.result_schema(batch.schema))
+        self._observe("host", _time.perf_counter() - t0, batch.n_rows)
         return TransformResult(out)
 
 
