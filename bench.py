@@ -282,6 +282,65 @@ def measure_device_kernel(rows: int = 1 << 20) -> Optional[dict]:
     }
 
 
+def measure_fingerprint(n_batches: int = 15) -> Optional[dict]:
+    """Checksum-fingerprint throughput over the ClickBench batches.
+
+    The checksum task's fingerprint method (tasks/checksum.py,
+    ops/rowhash.py): order-independent two-lane digest, backend chosen
+    by measurement (device reduction when the link supports it, the C++
+    single-pass polyhash otherwise).  Full-table validation speed is a
+    first-class metric for a data-transfer framework — this one runs at
+    memory-bandwidth-adjacent speed on the host path.
+    """
+    from transferia_tpu.abstract.schema import TableID
+    from transferia_tpu.abstract.table import TableDescription
+    from transferia_tpu.factories import new_storage
+    from transferia_tpu.ops.rowhash import TableFingerprinter
+
+    transfer = make_transfer(process_count=1)
+    storage = new_storage(transfer)
+    batches = []
+
+    class _Enough(Exception):
+        pass
+
+    def collect(batch):
+        batches.append(batch)
+        if len(batches) >= n_batches:
+            raise _Enough()
+
+    try:
+        storage.load_table(
+            TableDescription(id=TableID("fs", "hits")), collect)
+    except _Enough:
+        pass
+    if not batches:
+        return None
+    # warm: let auto decide on real batches AND pay any device compile
+    # outside the timed window (the jit cache is module-global, so the
+    # timed instance reuses the compiled program)
+    warm = TableFingerprinter(backend="auto")
+    warm.push(batches[0])
+    warm.push(batches[0])
+    warm.result()
+    decided = warm._decided or "host"
+    fp = TableFingerprinter(backend=decided)
+    rows = sum(b.n_rows for b in batches)
+    t0 = time.perf_counter()
+    for b in batches:
+        fp.push(b)
+    agg = fp.result()
+    dt = time.perf_counter() - t0
+    return {
+        "metric": "checksum_fingerprint_rows_per_sec",
+        "value": round(rows / dt),
+        "unit": "rows/sec",
+        "rows": rows,
+        "backend": decided,
+        "digest": agg.digest(),
+    }
+
+
 def measure_transform_latency(n_batches: int = 16) -> list:
     """Steady-state single-stream per-batch transform latency (the
     BASELINE kafka2ch config's headline metric shape): one warm chain
@@ -528,6 +587,15 @@ def main() -> None:
         except Exception as e:
             print(f"# device kernel bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
+    try:
+        fprint = measure_fingerprint()
+        if fprint:
+            if fallback:
+                fprint["fallback"] = fallback
+            print(f"# {json.dumps(fprint)}", file=sys.stderr)
+    except Exception as e:
+        print(f"# fingerprint bench failed: {type(e).__name__}: {e}",
+              file=sys.stderr)
     # second BASELINE config: Kafka->CH replication-path latency
     if os.environ.get("BENCH_SKIP_KAFKA2CH") != "1":
         try:

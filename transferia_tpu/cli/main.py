@@ -95,6 +95,16 @@ def build_parser() -> argparse.ArgumentParser:
     chk.add_argument("--strict-types", action="store_true",
                      help="require exact canonical type equality instead "
                           "of family-level equivalence")
+    chk.add_argument("--method", choices=["compare", "fingerprint"],
+                     default="compare",
+                     help="fingerprint: order-independent digest per "
+                          "table (device-reduced when profitable, O(1) "
+                          "memory); row-level compare runs only on "
+                          "digest mismatch")
+    chk.add_argument("--fingerprint-backend",
+                     choices=["auto", "host", "device"], default="auto",
+                     help="where the fingerprint reduction runs "
+                          "(auto measures, see ops/linkprobe.py)")
     add_transfer_cmd("validate", "parse and validate the transfer config")
     add_transfer_cmd("deactivate",
                      "release source resources (replication slots etc.)")
@@ -393,6 +403,8 @@ def cmd_checksum(args, transfer) -> int:
     params = ChecksumParameters()
     if args.size_threshold is not None:
         params.table_size_threshold = args.size_threshold
+    params.method = args.method
+    params.fingerprint_backend = args.fingerprint_backend
     tables = None
     if args.table:
         tables = []

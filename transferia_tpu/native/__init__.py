@@ -49,6 +49,10 @@ def _bind(cdll: ctypes.CDLL) -> ctypes.CDLL:
     cdll.hmac_sha256_hex.restype = None
     cdll.sha256_block_state.argtypes = [u8, u32]
     cdll.sha256_block_state.restype = None
+    cdll.polyhash_varcol.argtypes = [
+        u8, i32, ctypes.c_int64, u32, u32, u32, u32,
+    ]
+    cdll.polyhash_varcol.restype = None
     return cdll
 
 

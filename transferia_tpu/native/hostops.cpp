@@ -348,4 +348,39 @@ void hmac_sha256_hex(const uint8_t* data, const int32_t* offsets,
     }
 }
 
+// Dual-lane polynomial row hash over a var-width column (ops/rowhash.py
+// host backend).  Semantically identical to hashing the SHA-style padded
+// block matrix (pack_sha_blocks with prefix_len=0) with per-byte powers:
+// zero padding contributes nothing to the sum, so only the row's real
+// bytes, the 0x80 terminator, and the 8 big-endian bit-length bytes at
+// the end of the row's last 64-byte block are touched.  pw1/pw2 are the
+// precomputed power tables (length >= the padded width of the longest
+// row); two lanes in one pass so the row bytes are read once.
+void polyhash_varcol(const uint8_t* data, const int32_t* offsets,
+                     int64_t n, const uint32_t* pw1, const uint32_t* pw2,
+                     uint32_t* out1, uint32_t* out2) {
+    for (int64_t i = 0; i < n; i++) {
+        const uint8_t* p = data + offsets[i];
+        int32_t len = offsets[i + 1] - offsets[i];
+        uint32_t a1 = 0, a2 = 0;
+        for (int32_t j = 0; j < len; j++) {
+            uint32_t b = p[j];
+            a1 += b * pw1[j];
+            a2 += b * pw2[j];
+        }
+        a1 += 0x80u * pw1[len];
+        a2 += 0x80u * pw2[len];
+        int32_t nb = (len + 9 + 63) / 64;
+        uint64_t bits = (uint64_t)len * 8;
+        int32_t base = nb * 64 - 8;
+        for (int k = 0; k < 8; k++) {
+            uint32_t b = (uint32_t)((bits >> (8 * (7 - k))) & 0xFF);
+            a1 += b * pw1[base + k];
+            a2 += b * pw2[base + k];
+        }
+        out1[i] = a1;
+        out2[i] = a2;
+    }
+}
+
 }  // extern "C"

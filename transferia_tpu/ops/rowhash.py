@@ -1,0 +1,468 @@
+"""Order-independent table fingerprints (device-reducible checksums).
+
+The checksum task (tasks/checksum.py; reference
+pkg/worker/tasks/checksum.go) compares tables by sampling rows and
+comparing values host-side.  This module adds the complementary
+*fingerprint* method: every row hashes to two 32-bit lanes, and a table's
+fingerprint is the order-independent reduction (per-lane sum mod 2^32,
+per-lane xor, row count) — O(1) state per table, mergeable across
+snapshot shards (each worker fingerprints its parts; the coordinator
+merge is `FingerprintAggregate.merge`), and reduction-shaped for the
+device: the whole batch ships H2D once and 20 bytes come back.
+
+The hash is NOT cryptographic — it is a table-equality witness, like
+pt-table-checksum's CRC aggregation, not a defense against adversarial
+collisions.  Two independent lanes (different polynomial bases and
+finalizers) put an accidental-collision floor around 2^-64 per table
+pair.
+
+Canonicalization (identical in both backends, pinned by parity tests):
+- var-width columns: the SHA-style padded block matrix from
+  native/hostops.cpp pack_sha_blocks(prefix_len=0) — zero fill, 0x80
+  terminator, big-endian bit length — an injective fixed-width encoding;
+- fixed-width columns: the 64-bit bit pattern, with -0.0 normalized to
+  +0.0 and NaNs to the canonical quiet NaN first (value semantics, not
+  representation semantics, for floats);
+- NULL values hash to a per-column constant (validity is part of the
+  fingerprint);
+- each column is seeded by crc32(name) so column swaps change the
+  fingerprint even between same-typed columns.
+"""
+
+from __future__ import annotations
+
+import functools
+import zlib
+from dataclasses import dataclass, field
+from typing import Optional, Sequence
+
+import numpy as np
+
+from transferia_tpu.columnar.batch import ColumnBatch
+
+M32 = np.uint32(0xFFFFFFFF)
+# lane polynomial bases (odd => invertible mod 2^32) and null sentinels
+_P1 = np.uint32(0x01000193)   # FNV-1a prime
+_P2 = np.uint32(0x8DA6B343)
+_NULL1 = np.uint32(0xA5A5A5A5)
+_NULL2 = np.uint32(0x5A5A5A5A)
+
+
+def _mix32_np(x: np.ndarray) -> np.ndarray:
+    """xorshift-multiply avalanche (lowbias32); exact u32 wraparound."""
+    x = x.astype(np.uint32, copy=True)
+    x ^= x >> np.uint32(16)
+    x *= np.uint32(0x7FEB352D)
+    x ^= x >> np.uint32(15)
+    x *= np.uint32(0x846CA68B)
+    x ^= x >> np.uint32(16)
+    return x
+
+
+def _col_seed(name: str, lane: int) -> np.uint32:
+    crc = zlib.crc32(name.encode("utf-8", errors="surrogatepass"))
+    return np.uint32((crc + 0x9E3779B9 * (lane + 1)) & 0xFFFFFFFF)
+
+
+@functools.lru_cache(maxsize=64)
+def _powers(width: int, base: int) -> np.ndarray:
+    """P^j mod 2^32 table; cached per (width, base) — do not mutate."""
+    out = np.empty(width, dtype=np.uint32)
+    acc = 1
+    for j in range(width):
+        out[j] = acc
+        acc = (acc * base) & 0xFFFFFFFF
+    out.setflags(write=False)
+    return out
+
+
+@dataclass
+class FingerprintAggregate:
+    """Mergeable order-independent table digest."""
+
+    sum1: int = 0
+    sum2: int = 0
+    xor1: int = 0
+    xor2: int = 0
+    count: int = 0
+
+    def merge(self, other: "FingerprintAggregate") -> None:
+        self.sum1 = (self.sum1 + other.sum1) & 0xFFFFFFFF
+        self.sum2 = (self.sum2 + other.sum2) & 0xFFFFFFFF
+        self.xor1 ^= other.xor1
+        self.xor2 ^= other.xor2
+        self.count += other.count
+
+    def digest(self) -> str:
+        return (f"{self.sum1:08x}{self.sum2:08x}"
+                f"{self.xor1:08x}{self.xor2:08x}:{self.count}")
+
+    def __eq__(self, other) -> bool:
+        if not isinstance(other, FingerprintAggregate):
+            return NotImplemented
+        return self.digest() == other.digest()
+
+
+@dataclass
+class _PreppedColumn:
+    """Backend-neutral canonical form of one column for one batch.
+
+    Var-width columns keep their (data, offsets) — the host backend
+    hashes them in place (native polyhash_varcol never materializes the
+    padded matrix); the device backend packs lazily via ensure_blocks().
+    """
+
+    name: str
+    kind: str                      # "fixed" | "var"
+    lo: Optional[np.ndarray] = None     # fixed: (N,) u32
+    hi: Optional[np.ndarray] = None     # fixed: (N,) u32
+    data: Optional[np.ndarray] = None    # var: flat u8
+    offsets: Optional[np.ndarray] = None  # var: (N+1,) i32
+    blocks: Optional[np.ndarray] = None  # var: (N, W) u8 (lazy)
+    width: int = 0
+    validity: Optional[np.ndarray] = None
+
+    def ensure_blocks(self) -> np.ndarray:
+        if self.blocks is None:
+            self.blocks = _pack_var(self.data, self.offsets, self.width)
+        return self.blocks
+
+
+def _pow2_width(max_len: int) -> int:
+    """Padded row width for var-width data (>= len + 9, pow2 of 64s)."""
+    nb = (max_len + 9 + 63) // 64
+    nb = 1 << (nb - 1).bit_length() if nb > 1 else 1
+    return nb * 64
+
+
+def _pack_var(data: np.ndarray, offsets: np.ndarray,
+              width: int) -> np.ndarray:
+    n = len(offsets) - 1
+    from transferia_tpu.native import lib as native_lib
+
+    cdll = native_lib()
+    out = np.empty((n, width), dtype=np.uint8)
+    nb = np.empty(n, dtype=np.int32)
+    if cdll is not None and n:
+        cdll.pack_sha_blocks(
+            np.ascontiguousarray(data),
+            np.ascontiguousarray(offsets, dtype=np.int32),
+            n, width, 0, out, nb,
+        )
+        return out
+    # numpy fallback: same layout as the C++ packer
+    out[:] = 0
+    for i in range(n):
+        row = data[offsets[i]:offsets[i + 1]]
+        ln = len(row)
+        out[i, :ln] = row
+        out[i, ln] = 0x80
+        blocks = (ln + 9 + 63) // 64
+        bits = ln * 8
+        out[i, blocks * 64 - 8:blocks * 64] = np.frombuffer(
+            int(bits).to_bytes(8, "big"), dtype=np.uint8)
+    return out
+
+
+def prep_batch(batch: ColumnBatch) -> tuple[list[_PreppedColumn], int]:
+    """Canonicalize a batch for either fingerprint backend."""
+    cols: list[_PreppedColumn] = []
+    for name in batch.schema.names():
+        col = batch.column(name)
+        if col.offsets is not None:
+            lens = col.offsets[1:] - col.offsets[:-1]
+            width = _pow2_width(int(lens.max()) if batch.n_rows else 0)
+            cols.append(_PreppedColumn(
+                name=name, kind="var",
+                data=np.ascontiguousarray(col.data),
+                offsets=np.ascontiguousarray(col.offsets,
+                                             dtype=np.int32),
+                width=width, validity=col.validity))
+            continue
+        data = col.data
+        if data.dtype.kind == "f":
+            data = data.astype(np.float64, copy=True)
+            data[data == 0.0] = 0.0          # -0.0 -> +0.0
+            data[np.isnan(data)] = np.nan    # canonical quiet NaN
+            bits = data.view(np.uint64)
+        elif data.dtype.kind == "b":
+            bits = data.astype(np.uint64)
+        else:
+            bits = data.astype(np.int64, copy=False).view(np.uint64)
+        cols.append(_PreppedColumn(
+            name=name, kind="fixed",
+            lo=(bits & np.uint64(0xFFFFFFFF)).astype(np.uint32),
+            hi=(bits >> np.uint64(32)).astype(np.uint32),
+            validity=col.validity))
+    return cols, batch.n_rows
+
+
+def _var_accs_host(col: _PreppedColumn,
+                   n_rows: int) -> tuple[np.ndarray, np.ndarray]:
+    """Both lanes' polynomial accumulators for a var-width column.
+
+    Native path: one C++ pass over the real bytes (zero padding of the
+    canonical block layout contributes nothing to the sum, so it is
+    never materialized).  Numpy fallback hashes the packed matrix — the
+    identical value, pinned by tests.
+    """
+    from transferia_tpu.native import lib as native_lib
+
+    cdll = native_lib()
+    if cdll is not None and n_rows:
+        pw1 = _powers(col.width, int(_P1))
+        pw2 = _powers(col.width, int(_P2))
+        a1 = np.empty(n_rows, dtype=np.uint32)
+        a2 = np.empty(n_rows, dtype=np.uint32)
+        cdll.polyhash_varcol(col.data, col.offsets, n_rows, pw1, pw2,
+                             a1, a2)
+        return a1, a2
+    blocks = col.ensure_blocks().astype(np.uint32)
+    a1 = (blocks * _powers(col.width, int(_P1))[None, :]).sum(
+        axis=1, dtype=np.uint32)
+    a2 = (blocks * _powers(col.width, int(_P2))[None, :]).sum(
+        axis=1, dtype=np.uint32)
+    return a1, a2
+
+
+def _col_lanes_host(col: _PreppedColumn, n_rows: int
+                    ) -> tuple[np.ndarray, np.ndarray]:
+    seed1, seed2 = _col_seed(col.name, 0), _col_seed(col.name, 1)
+    if col.kind == "fixed":
+        out = []
+        for seed in (seed1, seed2):
+            h = _mix32_np(col.lo ^ seed)
+            h = _mix32_np(h + _mix32_np(
+                col.hi ^ np.uint32(~int(seed) & 0xFFFFFFFF)))
+            out.append(h)
+        h1, h2 = out
+    else:
+        a1, a2 = _var_accs_host(col, n_rows)
+        h1 = _mix32_np(a1 ^ seed1)
+        h2 = _mix32_np(a2 ^ seed2)
+    if col.validity is not None:
+        h1 = np.where(col.validity, h1, _NULL1 ^ seed1)
+        h2 = np.where(col.validity, h2, _NULL2 ^ seed2)
+    return h1, h2
+
+
+def fingerprint_host(cols: Sequence[_PreppedColumn],
+                     n_rows: int) -> FingerprintAggregate:
+    """Host backend (exact twin of the device program)."""
+    r1 = np.zeros(n_rows, dtype=np.uint32)
+    r2 = np.zeros(n_rows, dtype=np.uint32)
+    for col in cols:
+        h1, h2 = _col_lanes_host(col, n_rows)
+        r1 += _mix32_np(h1)
+        r2 += _mix32_np(h2)
+    r1, r2 = _mix32_np(r1), _mix32_np(r2)
+    return FingerprintAggregate(
+        sum1=int(r1.sum(dtype=np.uint64) & 0xFFFFFFFF),
+        sum2=int(r2.sum(dtype=np.uint64) & 0xFFFFFFFF),
+        xor1=int(np.bitwise_xor.reduce(r1)) if n_rows else 0,
+        xor2=int(np.bitwise_xor.reduce(r2)) if n_rows else 0,
+        count=n_rows,
+    )
+
+
+class DeviceFingerprintProgram:
+    """Jitted device twin of fingerprint_host.
+
+    One launch per (buffered) batch run: H2D moves the canonical columns,
+    the reduction happens on device, and 5 scalars come back — the
+    profitable shape for high-latency links (ops/linkprobe.py).  Launches
+    are dispatched asynchronously; collect() blocks once at the end.
+    """
+
+    # compiled programs keyed by column signature — module-global so a
+    # fresh instance (one per table scan) reuses prior compilations
+    # instead of re-tracing identical schemas
+    _jit_cache: dict = {}
+
+    def __init__(self):
+        import jax  # presence check at construction, not first dispatch
+
+        self._pending: list = []
+
+    def _program_for(self, sig: tuple):
+        fn = self._jit_cache.get(sig)
+        if fn is not None:
+            return fn
+        import jax
+        import jax.numpy as jnp
+
+        def mix(x):
+            x = x ^ (x >> jnp.uint32(16))
+            x = x * jnp.uint32(0x7FEB352D)
+            x = x ^ (x >> jnp.uint32(15))
+            x = x * jnp.uint32(0x846CA68B)
+            return x ^ (x >> jnp.uint32(16))
+
+        def program(fixed_lo, fixed_hi, var_blocks, validities, rowmask,
+                    seeds1, seeds2, nulls1, nulls2, powers1, powers2):
+            n = rowmask.shape[0]
+            r1 = jnp.zeros(n, dtype=jnp.uint32)
+            r2 = jnp.zeros(n, dtype=jnp.uint32)
+            fi = vi = 0
+            for idx, kind in enumerate(sig_kinds):
+                for lane in (0, 1):
+                    seed = (seeds1 if lane == 0 else seeds2)[idx]
+                    null = (nulls1 if lane == 0 else nulls2)[idx]
+                    if kind == "fixed":
+                        lo, hi = fixed_lo[fi], fixed_hi[fi]
+                        h = mix(lo ^ seed)
+                        h = mix(h + mix(hi ^ (~seed)))
+                    else:
+                        pw = (powers1 if lane == 0 else powers2)[vi]
+                        b = var_blocks[vi].astype(jnp.uint32)
+                        h = mix((b * pw[None, :]).sum(
+                            axis=1, dtype=jnp.uint32) ^ seed)
+                    v = validities[idx]
+                    if v is not None:
+                        h = jnp.where(v, h, null ^ seed)
+                    if lane == 0:
+                        r1 = r1 + mix(h)
+                    else:
+                        r2 = r2 + mix(h)
+                if kind == "fixed":
+                    fi += 1
+                else:
+                    vi += 1
+            r1, r2 = mix(r1), mix(r2)
+            r1 = jnp.where(rowmask, r1, 0)
+            r2 = jnp.where(rowmask, r2, 0)
+            return (r1.sum(dtype=jnp.uint32), r2.sum(dtype=jnp.uint32),
+                    jnp.bitwise_xor.reduce(r1), jnp.bitwise_xor.reduce(r2),
+                    rowmask.sum(dtype=jnp.int32))
+
+        sig_kinds = [k for k, _ in sig]
+        fn = jax.jit(program)
+        DeviceFingerprintProgram._jit_cache[sig] = fn
+        return fn
+
+    def dispatch(self, cols: Sequence[_PreppedColumn],
+                 n_rows: int) -> None:
+        """Async-launch one batch; result lands in collect()."""
+        import jax.numpy as jnp
+
+        from transferia_tpu.columnar.batch import bucket_rows
+
+        bucket = bucket_rows(n_rows)
+        sig = tuple(
+            (c.kind, c.width if c.kind == "var" else 0) for c in cols)
+        fixed_lo, fixed_hi, var_blocks, validities = [], [], [], []
+        seeds1, seeds2, nulls1, nulls2 = [], [], [], []
+        powers1, powers2 = [], []
+        pad = bucket - n_rows
+
+        def padded(a, fill=0):
+            if pad:
+                return np.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1),
+                              constant_values=fill)
+            return a
+
+        for c in cols:
+            seeds1.append(_col_seed(c.name, 0))
+            seeds2.append(_col_seed(c.name, 1))
+            nulls1.append(_NULL1)
+            nulls2.append(_NULL2)
+            if c.kind == "fixed":
+                fixed_lo.append(jnp.asarray(padded(c.lo)))
+                fixed_hi.append(jnp.asarray(padded(c.hi)))
+            else:
+                var_blocks.append(jnp.asarray(padded(c.ensure_blocks())))
+                powers1.append(jnp.asarray(_powers(c.width, int(_P1))))
+                powers2.append(jnp.asarray(_powers(c.width, int(_P2))))
+            validities.append(
+                jnp.asarray(padded(c.validity))
+                if c.validity is not None else None)
+        rowmask = np.zeros(bucket, dtype=np.bool_)
+        rowmask[:n_rows] = True
+        fn = self._program_for(sig)
+        out = fn(tuple(fixed_lo), tuple(fixed_hi), tuple(var_blocks),
+                 tuple(validities), jnp.asarray(rowmask),
+                 jnp.asarray(np.array(seeds1, dtype=np.uint32)),
+                 jnp.asarray(np.array(seeds2, dtype=np.uint32)),
+                 jnp.asarray(np.array(nulls1, dtype=np.uint32)),
+                 jnp.asarray(np.array(nulls2, dtype=np.uint32)),
+                 tuple(powers1), tuple(powers2))
+        self._pending.append(out)
+
+    def collect(self) -> FingerprintAggregate:
+        """Block on every dispatched launch and merge the partials."""
+        agg = FingerprintAggregate()
+        for out in self._pending:
+            s1, s2, x1, x2, cnt = (np.asarray(o) for o in out)
+            agg.merge(FingerprintAggregate(
+                sum1=int(s1), sum2=int(s2), xor1=int(x1), xor2=int(x2),
+                count=int(cnt)))
+        self._pending.clear()
+        return agg
+
+
+class TableFingerprinter:
+    """Streaming fingerprint over batches, backend chosen by measurement.
+
+    backend="auto" times the host path on the first batch and predicts
+    the device path from the link profile (same gating idea as
+    transform/fused.py): reduction output is tiny, so the device is
+    profitable whenever H2D keeps up and batches amortize the launch.
+    """
+
+    def __init__(self, backend: str = "auto"):
+        self.backend = backend
+        self._agg = FingerprintAggregate()
+        self._device: Optional[DeviceFingerprintProgram] = None
+        self._host_ns_row = -1.0
+        self._decided: Optional[str] = None
+
+    def _device_available(self) -> bool:
+        try:
+            import jax  # noqa: F401
+
+            return True
+        except ImportError:
+            return False
+
+    def _choose(self, n_rows: int, row_bytes: int) -> str:
+        if self.backend in ("host", "device"):
+            return self.backend
+        if self._decided is not None:
+            return self._decided
+        if self._host_ns_row < 0 or not self._device_available():
+            return "host"
+        from transferia_tpu.ops.linkprobe import probe_link
+
+        link = probe_link()
+        pred_s = (2 * link.launch_overhead_s
+                  + n_rows * row_bytes / link.h2d_bytes_per_s
+                  + n_rows / 20e6)
+        pred_ns = pred_s * 1e9 / max(n_rows, 1)
+        self._decided = ("device" if pred_ns < self._host_ns_row
+                         else "host")
+        return self._decided
+
+    def push(self, batch: ColumnBatch) -> None:
+        if batch.n_rows == 0:
+            return
+        import time as _time
+
+        cols, n = prep_batch(batch)
+        row_bytes = sum(
+            (c.width if c.kind == "var" else 8) for c in cols)
+        choice = self._choose(n, row_bytes)
+        if choice == "device":
+            if self._device is None:
+                self._device = DeviceFingerprintProgram()
+            self._device.dispatch(cols, n)
+            return
+        t0 = _time.perf_counter()
+        self._agg.merge(fingerprint_host(cols, n))
+        ns = (_time.perf_counter() - t0) * 1e9 / n
+        self._host_ns_row = (ns if self._host_ns_row < 0
+                             else 0.7 * self._host_ns_row + 0.3 * ns)
+
+    def result(self) -> FingerprintAggregate:
+        if self._device is not None:
+            self._agg.merge(self._device.collect())
+        return self._agg

@@ -69,6 +69,14 @@ class ChecksumParameters:
     # cap on rows compared per table in the full strategy (0 = whole
     # table); the quick `check` command sets this from sample_rows
     max_rows: int = 0
+    # "compare" (the reference's row-by-row strategies) or "fingerprint":
+    # stream both tables through the order-independent device-reducible
+    # digest (ops/rowhash.py) and compare aggregates — O(1) memory per
+    # table, exact-representation semantics; on mismatch the row-level
+    # strategy runs for that table as the diagnostic pass
+    method: str = "compare"
+    # fingerprint backend: auto | host | device (ops/rowhash.py)
+    fingerprint_backend: str = "auto"
 
 
 # ---------------------------------------------------------------------------
@@ -118,8 +126,11 @@ class TableChecksum:
     source_rows: int = 0
     target_rows: int = 0
     compared_rows: int = 0
-    strategy: str = "full"      # "full" | "sample"
+    # "full" | "sample" | "fingerprint" | "fingerprint+{full,sample}"
+    strategy: str = "full"
     mismatches: list[str] = field(default_factory=list)
+    source_fingerprint: str = ""
+    target_fingerprint: str = ""
 
     @property
     def ok(self) -> bool:
@@ -703,11 +714,24 @@ def compare_checksum(src: Storage, dst: Storage,
             continue
 
         td = TableDescription(id=tid)
+        if params.method == "fingerprint" and \
+                tc.source_rows == tc.target_rows:
+            # differing row counts are already a verdict — skip the
+            # full-scan digest and go straight to row-level diagnosis
+            matched = _fingerprint_compare(tc, errors, src, dst, td,
+                                           params)
+            if matched:
+                continue
+            # aggregate mismatch: fall through to the row-level strategy
+            # below so the report pinpoints rows, not just the table
         size = _table_size(src, tid)
         sampled = (size > params.table_size_threshold
                    and isinstance(src, SampleableStorage)
                    and bool(lkeys))
-        tc.strategy = "sample" if sampled else "full"
+        tc.strategy = ("fingerprint+sample" if params.method ==
+                       "fingerprint" else "sample") if sampled else \
+            ("fingerprint+full" if params.method == "fingerprint"
+             else "full")
         try:
             if sampled:
                 _sampled_compare(tc, errors, src, dst, td, lkeys,
@@ -724,6 +748,53 @@ def compare_checksum(src: Storage, dst: Storage,
         if len(tc.mismatches) > 50:
             tc.mismatches = tc.mismatches[:50] + ["...truncated"]
     return report
+
+
+def _fingerprint_compare(tc: TableChecksum, errors: ErrorMap,
+                         src: Storage, dst: Storage,
+                         td: TableDescription,
+                         params: ChecksumParameters) -> bool:
+    """Order-independent digest compare (ops/rowhash.py).
+
+    Streams both tables through TableFingerprinter (device-reduced when
+    the link profile makes that profitable) and compares the aggregates.
+    Returns True when the table matched — the caller skips the row-level
+    pass; False on mismatch/error so row-level diagnosis runs.
+    """
+    from transferia_tpu.abstract.interfaces import is_columnar
+    from transferia_tpu.columnar.batch import ColumnBatch
+    from transferia_tpu.ops.rowhash import TableFingerprinter
+
+    def run(storage: Storage):
+        fp = TableFingerprinter(backend=params.fingerprint_backend)
+
+        def pusher(batch):
+            if is_columnar(batch):
+                fp.push(batch)
+                return
+            rows = [it for it in _iter_rows(batch)]
+            if rows:
+                fp.push(ColumnBatch.from_rows(rows))
+
+        storage.load_table(td, pusher)
+        return fp.result()
+
+    try:
+        left = run(src)
+        right = run(dst)
+    except Exception as e:
+        # an infrastructure error, not a data mismatch: record it in the
+        # error map only and let the row-level pass decide table equality
+        errors.add(tc.fqtn(), GENERIC_ERROR, f"fingerprint failed: {e}")
+        return False
+    tc.source_fingerprint = left.digest()
+    tc.target_fingerprint = right.digest()
+    if left == right:
+        tc.strategy = "fingerprint"
+        return True
+    tc.mismatches.append(
+        f"fingerprints differ: src={left.digest()} dst={right.digest()}")
+    return False
 
 
 def _positional_compare(tc: TableChecksum, errors: ErrorMap,
