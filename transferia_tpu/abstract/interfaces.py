@@ -232,6 +232,26 @@ class IncrementalTable:
         self.initial_state = initial_state
 
 
+class ScanPredicateStorage(abc.ABC):
+    """Scan-predicate pushdown capability.
+
+    A storage accepting a predicate pre-filters rows during the scan —
+    in whatever form is native to it (arrow compute on record batches
+    for the fs/S3 readers, a WHERE clause for SQL sources).  Pushdown is
+    advisory: the transformer chain re-applies the same predicate, so a
+    storage may filter partially or not at all and the output is still
+    correct; what it saves is pivot/transform work on rows that were
+    going to be dropped anyway.  (The reference's TableDescription
+    carries a WHERE-style Filter the SQL storages inline; this is the
+    capability-level generalization driven by the chain planner.)
+    """
+
+    @abc.abstractmethod
+    def set_scan_predicate(self, table: "TableID", node) -> bool:
+        """Install a predicate AST (predicate/ast.py) for scans of the
+        table; returns True when the storage will use it."""
+
+
 class SampleableStorage(abc.ABC):
     """Checksum sampling (storage.go:322-337)."""
 

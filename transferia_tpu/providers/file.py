@@ -15,6 +15,7 @@ from __future__ import annotations
 import glob as globmod
 import json
 import os
+import threading
 import uuid
 from dataclasses import dataclass
 from typing import Optional
@@ -24,6 +25,7 @@ import numpy as np
 from transferia_tpu.abstract.interfaces import (
     Batch,
     Pusher,
+    ScanPredicateStorage,
     ShardingStorage,
     Sinker,
     Storage,
@@ -71,11 +73,19 @@ def _expand(path: str) -> list[str]:
     return sorted(globmod.glob(path))
 
 
-class FileStorage(Storage, ShardingStorage):
+class FileStorage(Storage, ShardingStorage, ScanPredicateStorage):
     def __init__(self, params: FileSourceParams):
         self.params = params
         self.table = TableID(params.namespace, params.table)
         self._schema: Optional[TableSchema] = None
+        self._scan_predicates: dict[TableID, object] = {}
+        self._pruned_lock = threading.Lock()
+        self.scan_rows_pruned = 0
+
+    def _count_pruned(self, n: int) -> None:
+        # upload workers share this storage across threads
+        with self._pruned_lock:
+            self.scan_rows_pruned += n
 
     def _files(self) -> list[str]:
         files = _expand(self.params.path)
@@ -169,6 +179,65 @@ class FileStorage(Storage, ShardingStorage):
         for f in files:
             self._load_file(f, table.id, schema, pusher)
 
+    def set_scan_predicate(self, table: TableID, node) -> bool:
+        """ScanPredicateStorage: arrow-side pre-filter of record batches
+        before the columnar pivot (predicate/arroweval.py).  Advisory —
+        batches where arrow evaluation bails flow through unfiltered and
+        the chain's own filter drops the rows instead."""
+        self._scan_predicates[table] = node
+        return True
+
+    def _scan_filter(self, tid: TableID, rb):
+        node = self._scan_predicates.get(tid)
+        if node is None or rb.num_rows == 0:
+            return rb
+        from transferia_tpu.predicate.arroweval import eval_mask
+
+        mask = eval_mask(node, rb)
+        if mask is None:
+            return rb
+        filtered = rb.filter(mask)  # null mask entries drop (SQL 3VL)
+        self._count_pruned(rb.num_rows - filtered.num_rows)
+        return filtered
+
+    def _prune_row_groups(self, pf, groups: list[int],
+                          tid: TableID) -> list[int]:
+        """Zone-map pruning: drop whole row groups whose min/max stats
+        disprove the scan predicate (predicate/stats.py) — the only form
+        of pushdown that skips DECODE, not just pivot/transform."""
+        node = self._scan_predicates.get(tid)
+        if node is None:
+            return groups
+        from transferia_tpu.predicate.stats import (
+            ColumnRange,
+            range_disproves,
+        )
+
+        pred_cols = node.columns()
+        kept = []
+        for g in groups:
+            rg = pf.metadata.row_group(g)
+            ranges = {}
+            for ci in range(rg.num_columns):
+                col = rg.column(ci)
+                if col.path_in_schema not in pred_cols:
+                    continue  # wide tables: only the predicate's columns
+                st = col.statistics
+                if st is None or not st.has_min_max:
+                    continue
+                ranges[col.path_in_schema] = ColumnRange(
+                    min=st.min, max=st.max,
+                    null_count=(st.null_count
+                                if st.has_null_count else None))
+            try:
+                if ranges and range_disproves(node, ranges):
+                    self._count_pruned(rg.num_rows)
+                    continue
+            except Exception:
+                pass  # odd stats types: scan the group normally
+            kept.append(g)
+        return kept
+
     def _load_row_groups(self, path: str, lo: int, hi: int, tid: TableID,
                          schema: TableSchema, pusher: Pusher) -> None:
         import pyarrow.parquet as pq
@@ -176,11 +245,16 @@ class FileStorage(Storage, ShardingStorage):
         from transferia_tpu.stats import stagetimer
 
         pf = pq.ParquetFile(path)
+        groups = self._prune_row_groups(pf, list(range(lo, hi)), tid)
+        if not groups:
+            return
         it = pf.iter_batches(batch_size=self.params.batch_rows,
-                             row_groups=list(range(lo, hi)))
+                             row_groups=groups)
         while True:
             with stagetimer.stage("source_decode"):
                 rb = next(it, None)
+                if rb is not None:
+                    rb = self._scan_filter(tid, rb)
             if rb is None:
                 return
             if rb.num_rows:
@@ -207,6 +281,7 @@ class FileStorage(Storage, ShardingStorage):
                 ),
             ) as reader:
                 for rb in reader:
+                    rb = self._scan_filter(tid, rb)
                     if rb.num_rows:
                         batch = ColumnBatch.from_arrow(rb, tid, schema)
                         batch.read_bytes = rb.nbytes

@@ -72,6 +72,9 @@ class SnapshotLoader:
         self.process_count = max(1, transfer.runtime.sharding.process_count)
         self.is_main = transfer.runtime.is_main
         self._progress_lock = threading.Lock()
+        # tables whose scan predicate has been computed (set-once; reads
+        # and adds race benignly — worst case one repeat computation)
+        self._pushdown_done: set = set()
 
     # -- entry points ---------------------------------------------------------
     def upload_tables(self, tables: Optional[list[TableDescription]] = None
@@ -330,10 +333,47 @@ class SnapshotLoader:
         self._do_upload_tables(storage, schemas)
 
     # -- the hot loop -------------------------------------------------------
+    def _setup_scan_pushdown(self, storage: Storage,
+                             schemas: dict) -> None:
+        """Push the chain's leading row filter into the scan when the
+        storage supports it (ScanPredicateStorage).  Advisory: the chain
+        re-applies the predicate, so a storage that ignores or only
+        partially applies it stays correct — this just avoids decoding,
+        pivoting, and transforming rows that are about to be dropped."""
+        for tid, schema in schemas.items():
+            self._push_scan_predicate(storage, tid, schema)
+
+    def _push_scan_predicate(self, storage: Storage, tid,
+                             schema) -> None:
+        """Install the pushable predicate for one table (set-once; also
+        the lazy path for secondary workers, whose schemas dict starts
+        empty and fills as parts arrive in _upload_part)."""
+        from transferia_tpu.abstract.interfaces import (
+            ScanPredicateStorage,
+        )
+
+        if not isinstance(storage, ScanPredicateStorage):
+            return
+        if tid in self._pushdown_done:
+            return
+        self._pushdown_done.add(tid)
+        from transferia_tpu.transform.chain import build_chain
+
+        chain = build_chain(self.transfer.transformation)
+        if chain is None or schema is None:
+            return
+        try:
+            node = chain.pushable_predicate(tid, schema)
+        except Exception:
+            return
+        if node is not None and storage.set_scan_predicate(tid, node):
+            logger.info("scan pushdown for %s: %s", tid, node)
+
     def _do_upload_tables(self, storage: Storage,
                           schemas: dict) -> None:
         """DoUploadTables (load_snapshot.go:893): ProcessCount workers pull
         parts from the coordinator until the queue drains."""
+        self._setup_scan_pushdown(storage, schemas)
         errors: list[BaseException] = []
         err_lock = threading.Lock()
 
@@ -404,6 +444,7 @@ class SnapshotLoader:
         if schema is None:
             schema = storage.table_schema(tid)
             schemas[tid] = schema
+        self._push_scan_predicate(storage, tid, schema)
         part_id = part.part_id() if part.parts_count > 1 else ""
         sink = make_async_sink(self.transfer, self.metrics,
                                snapshot_stage=True)

@@ -85,6 +85,43 @@ class Transformation:
         plan = self.plan_for(table, schema)
         return plan.out_table, plan.out_schema
 
+    def pushable_predicate(self, table: TableID, schema: TableSchema):
+        """The first row-filter predicate that may legally run inside the
+        source scan (ScanPredicateStorage), or None.
+
+        Legal when every step before the filter is *transparent*: it
+        alters only known columns (mask_field) and the predicate reads
+        none of them.  A fused mask+filter run qualifies by construction
+        — its predicate evaluates on the run's input batch.  Any opaque
+        step (rename, sharder, custom plugins...) stops the walk: it
+        might reshape rows in ways the scan cannot anticipate.  The
+        chain re-applies the predicate regardless, so pushdown is purely
+        work-avoidance, never load-bearing.
+        """
+        from transferia_tpu.transform.fused import DeviceFusedStep
+        from transferia_tpu.transform.plugins.filter import FilterRows
+        from transferia_tpu.transform.plugins.mask import MaskField
+
+        plan = self.plan_for(table, schema)
+        modified: set[str] = set()
+        for step in plan.steps:
+            if isinstance(step, DeviceFusedStep):
+                if step.pred_node is not None:
+                    if step.pred_node.columns() & modified:
+                        return None
+                    return step.pred_node
+                modified.update(n for n, _ in step.mask_entries)
+                continue
+            if isinstance(step, FilterRows):
+                if step.node.columns() & modified:
+                    return None
+                return step.node
+            if isinstance(step, MaskField):
+                modified.update(step.columns)
+                continue
+            return None
+        return None
+
     def apply(self, batch: Batch) -> Batch:
         """Transform a batch; row-item batches are pivoted to columnar first
         (control/system batches pass through untouched).  Mixed-table or
