@@ -79,10 +79,13 @@ def set_chunk_rows(n: Optional[int]) -> None:
 
 
 def _pallas_pack_enabled() -> bool:
-    """Opt-in TPU-side ragged pack (ops/ragged_pallas.py).
+    """Opt-in device-side ragged pack (ops/raggedpack.py; the env name
+    is historical — the implementation is XLA after hardware profiling
+    retired the Pallas kernel, see that module's docstring).
 
-    Off by default until the per-row DMA pattern is profiled on real
-    hardware; the portable C++/numpy host pack is the default feed path.
+    Off by default: it halves H2D bytes for short strings on
+    PCIe-attached devices, but costs an extra launch — through a
+    high-latency tunnel the host C++ pack + padded H2D wins.
     """
     import os
 
@@ -208,7 +211,7 @@ class FusedMaskFilterProgram:
             max_len = int(lens.max()) if n_rows else 0
             mb = pow2_blocks(max_len)
             if use_pallas_pack:
-                from transferia_tpu.ops.ragged_pallas import (
+                from transferia_tpu.ops.raggedpack import (
                     pack_blocks_device,
                 )
 
