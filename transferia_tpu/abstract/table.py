@@ -48,6 +48,10 @@ class OperationTablePart:
     read_bytes: int = 0
     completed: bool = False
     worker_index: Optional[int] = None  # assignee
+    # inline-validation digest of this part's post-transform rows
+    # (FingerprintAggregate.digest(); merged per table at read time —
+    # per-part writes keep the coordinator update race-free)
+    fingerprint: str = ""
 
     def key(self) -> str:
         return f"{self.operation_id}/{self.table_id}/{self.part_index}"
@@ -78,6 +82,7 @@ class OperationTablePart:
             "read_bytes": self.read_bytes,
             "completed": self.completed,
             "worker_index": self.worker_index,
+            "fingerprint": self.fingerprint,
         }
 
     @staticmethod
@@ -94,6 +99,7 @@ class OperationTablePart:
             read_bytes=d.get("read_bytes", 0),
             completed=d.get("completed", False),
             worker_index=d.get("worker_index"),
+            fingerprint=d.get("fingerprint", ""),
         )
 
     @staticmethod

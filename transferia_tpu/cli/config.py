@@ -47,6 +47,7 @@ _ENV_RE = re.compile(r"\$\{([A-Za-z_][A-Za-z0-9_]*)(?::([^}]*))?\}")
 _KNOWN_KEYS = {
     "id", "type", "src", "dst", "transformation", "data_objects",
     "regular_snapshot", "runtime", "type_system_version", "labels",
+    "validation",
 }
 
 
@@ -139,6 +140,7 @@ def parse_transfer_yaml(text: str) -> Transfer:
             raw.get("type_system_version", LATEST_VERSION)
         ),
         labels=dict(raw.get("labels") or {}),
+        validation=raw.get("validation"),
     )
 
 

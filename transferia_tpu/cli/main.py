@@ -105,6 +105,11 @@ def build_parser() -> argparse.ArgumentParser:
                      choices=["auto", "host", "device"], default="auto",
                      help="where the fingerprint reduction runs "
                           "(auto measures, see ops/linkprobe.py)")
+    chk.add_argument("--against-operation", default="",
+                     help="compare the TARGET against the table "
+                          "fingerprints a snapshot recorded inline "
+                          "(validation: {fingerprint: true}) under this "
+                          "operation id — no source re-read")
     add_transfer_cmd("validate", "parse and validate the transfer config")
     add_transfer_cmd("deactivate",
                      "release source resources (replication slots etc.)")
@@ -379,6 +384,53 @@ def cmd_check(transfer) -> int:
     return 0 if report.ok else 1
 
 
+def _checksum_against_operation(args, dst_storage) -> int:
+    """Target-only validation: fingerprint every table the snapshot
+    recorded (inline validation digests in the operation state) and
+    compare — the source is never re-read."""
+    from transferia_tpu.abstract.interfaces import is_columnar
+    from transferia_tpu.abstract.schema import TableID
+    from transferia_tpu.abstract.table import TableDescription
+    from transferia_tpu.columnar.batch import ColumnBatch
+    from transferia_tpu.ops.rowhash import TableFingerprinter
+
+    cp = _coordinator(args)
+    state = cp.get_operation_state(args.against_operation)
+    recorded = state.get("table_fingerprints") or {}
+    if not recorded:
+        print(f"operation {args.against_operation}: no recorded "
+              f"fingerprints (was the snapshot run with validation: "
+              f"{{fingerprint: true}}?)", file=sys.stderr)
+        return 2
+    rc = 0
+    for fqtn, want in sorted(recorded.items()):
+        tid = TableID.parse(fqtn)
+        fp = TableFingerprinter(backend=args.fingerprint_backend)
+
+        def pusher(batch):
+            if is_columnar(batch):
+                fp.push(batch)
+                return
+            rows = [it for it in batch if it.is_row_event()]
+            if rows:
+                fp.push(ColumnBatch.from_rows(rows))
+
+        try:
+            dst_storage.load_table(TableDescription(id=tid), pusher)
+        except Exception as e:
+            print(f"{fqtn}: ERROR reading target: {e}")
+            rc = 1
+            continue
+        got = fp.result().digest()
+        if got == want:
+            print(f"{fqtn}: OK [fingerprint] {got}")
+        else:
+            print(f"{fqtn}: MISMATCH [fingerprint] uploaded={want} "
+                  f"target={got}")
+            rc = 1
+    return rc
+
+
 def cmd_checksum(args, transfer) -> int:
     """Full validation task (checksum.go Checksum): sampling storages,
     type-aware comparators, streaming compare."""
@@ -391,7 +443,6 @@ def cmd_checksum(args, transfer) -> int:
         heterogeneous_data_types,
     )
 
-    src_storage = new_storage(transfer)
     dst_provider = get_provider(transfer.dst_provider(), transfer)
     # never fall back to .storage(): that reads transfer.src and would
     # vacuously compare the source against itself
@@ -400,6 +451,9 @@ def cmd_checksum(args, transfer) -> int:
         print("destination provider has no storage view of the target; "
               "cannot checksum", file=sys.stderr)
         return 2
+    if args.against_operation:
+        return _checksum_against_operation(args, dst_storage)
+    src_storage = new_storage(transfer)
     params = ChecksumParameters()
     if args.size_threshold is not None:
         params.table_size_threshold = args.size_threshold

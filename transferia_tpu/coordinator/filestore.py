@@ -190,6 +190,7 @@ class FileStoreCoordinator(Coordinator):
                     d["read_bytes"] = upd.read_bytes
                     d["completed"] = upd.completed
                     d["worker_index"] = upd.worker_index
+                    d["fingerprint"] = upd.fingerprint
             self._write_json(p, cur)
 
     def operation_parts(self, operation_id: str) -> list[OperationTablePart]:

@@ -116,6 +116,7 @@ class MemoryCoordinator(Coordinator):
                     cur.read_bytes = upd.read_bytes
                     cur.completed = upd.completed
                     cur.worker_index = upd.worker_index
+                    cur.fingerprint = upd.fingerprint
 
     def operation_parts(self, operation_id: str) -> list[OperationTablePart]:
         with self._lock:

@@ -259,6 +259,7 @@ class S3Coordinator(Coordinator):
             d["read_bytes"] = upd.read_bytes
             d["completed"] = upd.completed
             d["worker_index"] = upd.worker_index
+            d["fingerprint"] = upd.fingerprint
             # progress flush is owner-only: last-writer-wins is safe
             self._put_json(key, d)
             if upd.completed:

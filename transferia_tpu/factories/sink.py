@@ -53,7 +53,8 @@ def _system_table_filter(tid: TableID) -> bool:
 
 def make_sinker(transfer, metrics: Optional[Metrics] = None,
                 snapshot_stage: bool = False,
-                stats: Optional[SinkerStats] = None) -> Sinker:
+                stats: Optional[SinkerStats] = None,
+                post_transform_wrap=None) -> Sinker:
     """Build the synchronous middleware stack over the provider's raw sink."""
     metrics = metrics or Metrics()
     provider = get_provider(transfer.dst_provider(), transfer, metrics)
@@ -85,6 +86,10 @@ def make_sinker(transfer, metrics: Optional[Metrics] = None,
     s = Statistician(s, stats or SinkerStats(metrics))
     s = Filter(s, _system_table_filter)
     s = NonRowSeparator(s)
+    if post_transform_wrap is not None:
+        # injection point for observers of post-transform data (the
+        # snapshot loader's inline fingerprint tap)
+        s = post_transform_wrap(s)
     chain = build_chain(transfer.transformation)
     if chain is not None:
         s = TransformationMW(s, chain)
@@ -97,19 +102,23 @@ def make_sinker(transfer, metrics: Optional[Metrics] = None,
 
 def make_async_sink(transfer, metrics: Optional[Metrics] = None,
                     snapshot_stage: bool = False,
-                    stats: Optional[SinkerStats] = None) -> AsyncSink:
+                    stats: Optional[SinkerStats] = None,
+                    post_transform_wrap=None) -> AsyncSink:
     """MakeAsyncSink (sink_factory.go:31): full async pipeline.
 
     Providers may supply a native AsyncSink (constructBaseAsyncSink:173);
     otherwise the sync stack is wrapped with Bufferer (when the destination
-    opts in via `bufferer_config`) or Synchronizer.
+    opts in via `bufferer_config`) or Synchronizer.  A native async sink
+    has no sync stack to host post_transform_wrap; inline validation is
+    skipped there (the provider owns its own pipeline).
     """
     metrics = metrics or Metrics()
     provider = get_provider(transfer.dst_provider(), transfer, metrics)
     native = provider.async_sink()
     if native is not None:
         return ErrorTracker(native)
-    sync_stack = make_sinker(transfer, metrics, snapshot_stage, stats)
+    sync_stack = make_sinker(transfer, metrics, snapshot_stage, stats,
+                             post_transform_wrap=post_transform_wrap)
     buf_cfg = capability(transfer.dst, "bufferer_config", None)
     if buf_cfg is not None and not isinstance(buf_cfg, BuffererConfig):
         buf_cfg = BuffererConfig(**buf_cfg) if isinstance(buf_cfg, dict) \

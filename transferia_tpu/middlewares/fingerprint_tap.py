@@ -1,0 +1,83 @@
+"""Inline snapshot validation: fingerprint post-transform batches.
+
+A pass-through sink middleware that streams every row batch it forwards
+through the order-independent table fingerprint (ops/rowhash.py).  The
+snapshot loader inserts it after the transformer chain, stamps each
+part's digest onto its coordinator part record when the part completes,
+and merges the per-part digests into per-table fingerprints at the end
+— O(1) extra state per part, race-free (each part record has a single
+writer), and valid under any part/batch/row ordering because the
+aggregate is order-independent by construction.
+
+The resulting table digests are the content address of what the
+snapshot actually wrote: `trtpu checksum --method fingerprint` against
+the target later compares to them without re-reading the source.  No
+reference analogue — checksum.go always re-reads both sides.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from transferia_tpu.abstract.interfaces import Batch, Sinker, is_columnar
+from transferia_tpu.abstract.schema import TableID
+from transferia_tpu.columnar.batch import ColumnBatch
+from transferia_tpu.ops.rowhash import (
+    FingerprintAggregate,
+    TableFingerprinter,
+)
+
+
+class FingerprintTap(Sinker):
+    def __init__(self, inner: Sinker, backend: str = "auto"):
+        self.inner = inner
+        self._backend = backend
+        self._lock = threading.Lock()
+        self._tables: dict[TableID, TableFingerprinter] = {}
+
+    def _tap(self, batch: Batch) -> None:
+        if is_columnar(batch):
+            blocks = [batch]
+        else:
+            rows = [it for it in batch if it.is_row_event()]
+            if not rows:
+                return
+            blocks = [ColumnBatch.from_rows(run)
+                      for run in _homogeneous_runs(rows)]
+        for b in blocks:
+            if b.n_rows == 0:
+                continue
+            with self._lock:
+                fp = self._tables.get(b.table_id)
+                if fp is None:
+                    fp = TableFingerprinter(backend=self._backend)
+                    self._tables[b.table_id] = fp
+                fp.push(b)
+
+    def push(self, batch: Batch) -> None:
+        self._tap(batch)
+        self.inner.push(batch)
+
+    def aggregates(self) -> dict[TableID, FingerprintAggregate]:
+        with self._lock:
+            return {tid: fp.result() for tid, fp in self._tables.items()}
+
+    def close(self) -> None:
+        self.inner.close()
+
+    def __getattr__(self, name):
+        # transparent passthrough for optional sink surface
+        # (bufferer_config, snapshot hooks, ...)
+        return getattr(self.inner, name)
+
+
+def _homogeneous_runs(items):
+    runs, key = [], None
+    for it in items:
+        k = (it.table_id, id(it.table_schema))
+        if not runs or k != key:
+            runs.append([])
+            key = k
+        runs[-1].append(it)
+    return runs

@@ -101,6 +101,13 @@ class Transfer:
     runtime: Runtime = field(default_factory=Runtime)
     type_system_version: int = LATEST_VERSION
     labels: dict[str, str] = field(default_factory=dict)
+    # {"fingerprint": true}: snapshot workers fingerprint post-transform
+    # batches inline (ops/rowhash.py), per-part aggregates merge through
+    # the coordinator, and the table digests land in the operation state
+    validation: Optional[dict[str, Any]] = None
+
+    def fingerprint_validation(self) -> bool:
+        return bool(self.validation and self.validation.get("fingerprint"))
 
     # -- convenience --------------------------------------------------------
     def src_provider(self) -> str:

@@ -97,6 +97,19 @@ class FingerprintAggregate:
         return (f"{self.sum1:08x}{self.sum2:08x}"
                 f"{self.xor1:08x}{self.xor2:08x}:{self.count}")
 
+    @classmethod
+    def parse(cls, digest: str) -> "FingerprintAggregate":
+        """Inverse of digest() — lets per-part digests stored as strings
+        (coordinator part records) merge at read time."""
+        hexes, _, count = digest.partition(":")
+        if len(hexes) != 32 or not count:
+            raise ValueError(f"malformed fingerprint digest: {digest!r}")
+        return cls(
+            sum1=int(hexes[0:8], 16), sum2=int(hexes[8:16], 16),
+            xor1=int(hexes[16:24], 16), xor2=int(hexes[24:32], 16),
+            count=int(count),
+        )
+
     def __eq__(self, other) -> bool:
         if not isinstance(other, FingerprintAggregate):
             return NotImplemented

@@ -224,6 +224,51 @@ class MemoryStorage(Storage, IncrementalStorage):
         return out
 
 
+class MemoryStoreStorage(Storage):
+    """Storage view over a sink's captured pushes (the TARGET side).
+
+    The seed space (_SOURCES, via seed_source) and the capture space
+    (_STORES, written by MemorySinker) are distinct; destination_storage
+    must read the latter or target validation vacuously compares seeds.
+    """
+
+    def __init__(self, sink_id: str):
+        self._store = get_store(sink_id)
+
+    def _by_table(self) -> dict[TableID, list]:
+        out: dict[TableID, list] = {}
+        for it in self._store.rows():
+            out.setdefault(it.table_id, []).append(it)
+        return out
+
+    def table_list(self, include=None):
+        out = {}
+        for tid, items in self._by_table().items():
+            if include and not any(tid.include_matches(p)
+                                   for p in include):
+                continue
+            out[tid] = TableInfo(eta_rows=len(items),
+                                 schema=items[0].table_schema)
+        return out
+
+    def table_schema(self, table: TableID) -> TableSchema:
+        return self._by_table()[table][0].table_schema
+
+    def load_table(self, table: TableDescription, pusher: Pusher) -> None:
+        items = self._by_table().get(table.id, [])
+        mask_fn = None
+        if table.filter:
+            from transferia_tpu.predicate import compile_mask, parse
+
+            mask_fn = compile_mask(parse(table.filter))
+        for lo in range(0, len(items), 4096):
+            b = ColumnBatch.from_rows(items[lo:lo + 4096])
+            if mask_fn is not None:
+                b = b.filter(mask_fn(b))
+            if b.n_rows:
+                pusher(b)
+
+
 @register_provider
 class MemoryProvider(Provider):
     NAME = "memory"
@@ -243,9 +288,9 @@ class MemoryProvider(Provider):
 
     def destination_storage(self):
         if isinstance(self.transfer.dst, MemoryTargetParams):
-            # stores are shared by id: read back what the sink wrote
-            return MemoryStorage(MemorySourceParams(
-                source_id=self.transfer.dst.sink_id))
+            # read back what the sink actually captured (checksum /
+            # --against-operation read the TARGET, not the seed space)
+            return MemoryStoreStorage(self.transfer.dst.sink_id)
         return None
 
     def sinker(self):

@@ -218,9 +218,39 @@ class SnapshotLoader:
                     self.transfer.id,
                     {"incremental_state": next_inc_state},
                 )
+            self._publish_fingerprints()
         finally:
             if isinstance(storage, SnapshotableStorage):
                 storage.end_snapshot()
+
+    def _publish_fingerprints(self) -> None:
+        """Merge per-part fingerprints into per-table snapshot digests
+        (order-independent, so shard/batch ordering is irrelevant) and
+        record them in the operation state — the content address of what
+        this snapshot wrote, comparable later by `trtpu checksum
+        --method fingerprint` without re-reading the source."""
+        if not self.transfer.fingerprint_validation():
+            return
+        from transferia_tpu.ops.rowhash import FingerprintAggregate
+
+        per_table: dict[str, FingerprintAggregate] = {}
+        for part in self.cp.operation_parts(self.operation_id):
+            if not part.fingerprint:
+                continue
+            agg = per_table.setdefault(part.table_id.fqtn(),
+                                       FingerprintAggregate())
+            try:
+                agg.merge(FingerprintAggregate.parse(part.fingerprint))
+            except ValueError:
+                logger.warning("part %s carries a malformed fingerprint",
+                               part.key())
+        if not per_table:
+            return
+        digests = {t: a.digest() for t, a in per_table.items()}
+        self.cp.set_operation_state(self.operation_id,
+                                    {"table_fingerprints": digests})
+        for t, d in sorted(digests.items()):
+            logger.info("snapshot fingerprint %s: %s", t, d)
 
     def job_count(self) -> int:
         return max(1, self.transfer.runtime.sharding.job_count)
@@ -446,8 +476,21 @@ class SnapshotLoader:
             schemas[tid] = schema
         self._push_scan_predicate(storage, tid, schema)
         part_id = part.part_id() if part.parts_count > 1 else ""
+        tap = None
+        wrap = None
+        if self.transfer.fingerprint_validation():
+            from transferia_tpu.middlewares.fingerprint_tap import (
+                FingerprintTap,
+            )
+
+            def wrap(inner):
+                nonlocal tap
+                tap = FingerprintTap(inner)
+                return tap
+
         sink = make_async_sink(self.transfer, self.metrics,
-                               snapshot_stage=True)
+                               snapshot_stage=True,
+                               post_transform_wrap=wrap)
         rows_done = 0
         read_bytes = 0
         try:
@@ -485,6 +528,15 @@ class SnapshotLoader:
         part.completed_rows = rows_done
         part.read_bytes = read_bytes
         part.worker_index = self.worker_index
+        if tap is not None:
+            # merge every output table's aggregate (transforms may rename
+            # or fan out): the part digest covers what the part WROTE
+            from transferia_tpu.ops.rowhash import FingerprintAggregate
+
+            agg = FingerprintAggregate()
+            for a in tap.aggregates().values():
+                agg.merge(a)
+            part.fingerprint = agg.digest()
         with self._progress_lock:
             self.cp.update_operation_parts(self.operation_id, [part])
             self.table_stats.completed_parts.inc()
