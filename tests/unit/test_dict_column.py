@@ -1,0 +1,236 @@
+"""Dictionary-encoded columns (columnar/batch.py DictEnc).
+
+Pins the LowCardinality-style invariants: zero-copy adoption of arrow
+DictionaryArrays, lazy flat materialization that is byte-identical to the
+plain path, code-only take/filter, O(unique) HMAC masking with flat-path
+byte parity (incl. null rows = empty bytes), and dict-preserving to_arrow
+export (reference analogue: ClickHouse LowCardinality columns flowing
+through pkg/providers/clickhouse sink marshalling).
+"""
+
+import numpy as np
+import pyarrow as pa
+import pytest
+
+from transferia_tpu.abstract.schema import (
+    CanonicalType,
+    ColSchema,
+    TableID,
+    TableSchema,
+)
+from transferia_tpu.columnar.batch import Column, ColumnBatch, DictEnc
+
+TID = TableID("d", "t")
+
+
+def _schema():
+    return TableSchema((
+        ColSchema("s", CanonicalType.UTF8),
+        ColSchema("n", CanonicalType.INT64),
+    ))
+
+
+def _dict_rb(values, codes, n_col=None):
+    pool = pa.array(values, type=pa.string())
+    idx = pa.array(codes, type=pa.int32())
+    d = pa.DictionaryArray.from_arrays(idx, pool)
+    n = n_col if n_col is not None else list(range(len(codes)))
+    return pa.RecordBatch.from_arrays(
+        [d, pa.array(n, type=pa.int64())], names=["s", "n"])
+
+
+class TestAdoption:
+    def test_from_arrow_keeps_dict(self):
+        rb = _dict_rb(["aa", "bb", "cc"], [2, 0, 1, 0, 2])
+        b = ColumnBatch.from_arrow(rb, TID, _schema())
+        col = b.column("s")
+        assert col.is_lazy_dict
+        assert col.n_rows == 5
+        assert col.to_pylist() == ["cc", "aa", "bb", "aa", "cc"]
+        # reading values above must not have materialized the flat buffers
+        assert col.is_lazy_dict
+
+    def test_materialization_matches_plain(self):
+        rb = _dict_rb(["x", "yy", ""], [0, 1, 2, 1])
+        b = ColumnBatch.from_arrow(rb, TID, _schema())
+        col = b.column("s")
+        plain = Column.from_pylist("s", CanonicalType.UTF8,
+                                   ["x", "yy", "", "yy"])
+        np.testing.assert_array_equal(col.data, plain.data)
+        np.testing.assert_array_equal(col.offsets, plain.offsets)
+
+    def test_nulls_become_empty_bytes(self):
+        pool = pa.array(["v0", "v1"], type=pa.string())
+        idx = pa.array([0, None, 1], type=pa.int32())
+        d = pa.DictionaryArray.from_arrays(idx, pool)
+        rb = pa.RecordBatch.from_arrays(
+            [d, pa.array([1, 2, 3], type=pa.int64())], names=["s", "n"])
+        b = ColumnBatch.from_arrow(rb, TID, _schema())
+        col = b.column("s")
+        assert col.to_pylist() == ["v0", None, "v1"]
+        # canonical null repr: zero bytes (same as the flat import path)
+        assert col.offsets[2] - col.offsets[1] == 0
+
+    def test_int_dictionary_decodes(self):
+        # non-string pools fall back to the arrow cast path
+        pool = pa.array([10, 20], type=pa.int64())
+        idx = pa.array([1, 0, 1], type=pa.int32())
+        d = pa.DictionaryArray.from_arrays(idx, pool)
+        rb = pa.RecordBatch.from_arrays(
+            [pa.array(["a", "b", "c"], type=pa.string()), d.cast(pa.int64())],
+            names=["s", "n"])
+        b = ColumnBatch.from_arrow(rb, TID, _schema())
+        assert b.column("n").to_pylist() == [20, 10, 20]
+
+
+class TestOps:
+    def _col(self):
+        enc = DictEnc(
+            np.array([0, 1, 2, 1, 0], dtype=np.int32),
+            np.frombuffer(b"aabbbcccc", dtype=np.uint8).copy(),
+            np.array([0, 2, 5, 9], dtype=np.int32),
+        )
+        return Column("s", CanonicalType.UTF8, dict_enc=enc)
+
+    def test_take_stays_dict(self):
+        out = self._col().take(np.array([4, 2, 0]))
+        assert out.is_lazy_dict
+        assert out.to_pylist() == ["aa", "cccc", "aa"]
+
+    def test_filter_stays_dict(self):
+        out = self._col().filter(
+            np.array([True, False, True, False, True]))
+        assert out.is_lazy_dict
+        assert out.to_pylist() == ["aa", "cccc", "aa"]
+
+    def test_batch_filter_keeps_dict_and_values(self):
+        b = ColumnBatch(TID, _schema(), {
+            "s": self._col(),
+            "n": Column("n", CanonicalType.INT64,
+                        np.arange(5, dtype=np.int64)),
+        })
+        out = b.filter(np.array([False, True, True, False, True]))
+        assert out.column("s").is_lazy_dict
+        assert out.column("s").to_pylist() == ["bbb", "cccc", "aa"]
+        assert out.column("n").to_pylist() == [1, 2, 4]
+
+    def test_nbytes_counts_encoding(self):
+        c = self._col()
+        assert c.nbytes() == c.dict_enc.nbytes()
+
+    def test_renamed_preserves_laziness(self):
+        out = self._col().renamed("z")
+        assert out.name == "z"
+        assert out.is_lazy_dict
+
+    def test_concat_materializes_correctly(self):
+        b1 = ColumnBatch(TID, _schema(), {
+            "s": self._col(),
+            "n": Column("n", CanonicalType.INT64,
+                        np.arange(5, dtype=np.int64)),
+        })
+        out = ColumnBatch.concat([b1, b1])
+        assert out.column("s").to_pylist() == [
+            "aa", "bbb", "cccc", "bbb", "aa"] * 2
+
+
+class TestMaskParity:
+    def _batch(self, with_nulls=False):
+        pool = pa.array(["hello", "", "world"], type=pa.string())
+        codes = [0, 2, 1, 2, 0]
+        idx = pa.array(
+            [None if (with_nulls and i == 1) else c
+             for i, c in enumerate(codes)], type=pa.int32())
+        d = pa.DictionaryArray.from_arrays(idx, pool)
+        rb = pa.RecordBatch.from_arrays(
+            [d, pa.array(list(range(5)), type=pa.int64())],
+            names=["s", "n"])
+        return ColumnBatch.from_arrow(rb, TID, _schema())
+
+    @pytest.mark.parametrize("with_nulls", [False, True])
+    def test_mask_dict_matches_flat(self, with_nulls):
+        from transferia_tpu.transform.plugins.mask import MaskField
+
+        tf = MaskField(columns=["s"], salt="pepper")
+        dict_b = self._batch(with_nulls)
+        flat_b = ColumnBatch.from_pydict(
+            TID, _schema(),
+            {"s": dict_b.column("s").to_pylist(),
+             "n": list(range(5))})
+        out_d = tf.apply(dict_b).transformed.column("s")
+        out_f = tf.apply(flat_b).transformed.column("s")
+        assert out_d.is_lazy_dict  # the O(unique) path actually ran
+        np.testing.assert_array_equal(out_d.data, out_f.data)
+        np.testing.assert_array_equal(out_d.offsets, out_f.offsets)
+        assert out_d.to_pylist() == out_f.to_pylist()
+
+    def test_mask_hex_is_hmac(self):
+        import hashlib
+        import hmac
+
+        from transferia_tpu.transform.plugins.mask import MaskField
+
+        tf = MaskField(columns=["s"], salt="pepper")
+        out = tf.apply(self._batch()).transformed.column("s")
+        want = hmac.new(b"pepper", b"hello", hashlib.sha256).hexdigest()
+        assert out.value(0) == want
+
+
+class TestArrowExport:
+    def test_to_arrow_emits_dictionary(self):
+        rb = _dict_rb(["aa", "bb"], [0, 1, 0])
+        b = ColumnBatch.from_arrow(rb, TID, _schema())
+        out = b.to_arrow()
+        assert pa.types.is_dictionary(out.schema.field("s").type)
+        assert out.column(0).to_pylist() == ["aa", "bb", "aa"]
+
+    def test_parquet_roundtrip_keeps_dict(self, tmp_path):
+        import pyarrow.parquet as pq
+
+        rb = _dict_rb(["aa", "bb"], [0, 1, 0, 0])
+        b = ColumnBatch.from_arrow(rb, TID, _schema())
+        out = b.to_arrow()
+        path = str(tmp_path / "d.parquet")
+        pq.write_table(pa.Table.from_batches([out]), path)
+        back = pq.read_table(path)
+        assert back.column("s").to_pylist() == ["aa", "bb", "aa", "aa"]
+        rb2 = back.combine_chunks().to_batches()[0]
+        b2 = ColumnBatch.from_arrow(rb2, TID, _schema())
+        assert b2.column("s").is_lazy_dict
+
+    def test_parquet_sink_mixed_dict_flat_batches(self, tmp_path):
+        """One table, first batch dict-encoded, second flat: the fs sink
+        must cast to the file's schema instead of crashing (encoding can
+        vary per row group through the native decoder)."""
+        import pyarrow.parquet as pq
+
+        from transferia_tpu.providers.file import (
+            FileSinker,
+            FileTargetParams,
+        )
+
+        dict_b = ColumnBatch.from_arrow(
+            _dict_rb(["aa", "bb"], [0, 1, 0], n_col=[1, 2, 3]),
+            TID, _schema())
+        flat_b = ColumnBatch.from_pydict(
+            TID, _schema(), {"s": ["cc", "dd"], "n": [4, 5]})
+        sink = FileSinker(FileTargetParams(path=str(tmp_path),
+                                           format="parquet"))
+        sink.push(dict_b)
+        sink.push(flat_b)   # flat after dict
+        sink.close()
+        files = [f for f in tmp_path.iterdir()
+                 if f.suffix == ".parquet"]
+        back = pq.read_table(str(files[0]))
+        assert back.column("s").to_pylist() == ["aa", "bb", "aa",
+                                                "cc", "dd"]
+
+    def test_to_arrow_with_nulls(self):
+        pool = pa.array(["v0"], type=pa.string())
+        idx = pa.array([0, None], type=pa.int32())
+        d = pa.DictionaryArray.from_arrays(idx, pool)
+        rb = pa.RecordBatch.from_arrays(
+            [d, pa.array([1, 2], type=pa.int64())], names=["s", "n"])
+        b = ColumnBatch.from_arrow(rb, TID, _schema())
+        out = b.to_arrow()
+        assert out.column(0).to_pylist() == ["v0", None]

@@ -1,0 +1,195 @@
+"""Native parquet chunk decoder (native/parquetdec.cpp +
+providers/parquet_native.py) — differential tests against pyarrow.
+
+The decoder is the snapshot path's host hot loop (reference methodology
+docs/benchmarks.md: rows/sec on ClickBench-shaped parquet); correctness
+is pinned by decoding every supported shape both ways and comparing
+values, including null runs, unicode, dict fallback mid-chunk, and
+uncompressed + snappy codecs.
+"""
+
+import os
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.parquet as pq
+import pytest
+
+from transferia_tpu.columnar.batch import arrow_to_table_schema
+from transferia_tpu.providers.parquet_native import (
+    NativeParquetReader,
+    slice_columns,
+)
+
+
+def _native_available():
+    from transferia_tpu.native import lib
+
+    cdll = lib()
+    return cdll is not None and hasattr(cdll, "pq_decode_fixed")
+
+
+pytestmark = pytest.mark.skipif(
+    not _native_available(), reason="native lib unavailable")
+
+
+def _roundtrip(table, tmp_path, **write_kw):
+    path = str(tmp_path / "t.parquet")
+    pq.write_table(table, path, **write_kw)
+    pf = pq.ParquetFile(path)
+    schema = arrow_to_table_schema(pf.schema_arrow)
+    rdr = NativeParquetReader.open(path, pf, schema)
+    assert rdr is not None
+    return pf, rdr
+
+
+def _assert_matches(pf, rdr, table):
+    for g in range(pf.metadata.num_row_groups):
+        cols = rdr.read_row_group(g)
+        assert cols is not None
+        ref = pf.read_row_group(g, use_threads=False)
+        for name in table.schema.names:
+            got = cols[name].to_pylist()
+            want = ref.column(name).to_pylist()
+            ftype = table.schema.field(name).type
+            if pa.types.is_timestamp(ftype):
+                # canonical DATETIME = seconds, TIMESTAMP = microseconds
+                scale = 1 if ftype.unit == "s" else 1_000_000
+                want = [round(v.timestamp() * scale) for v in want]
+            assert got == want, (g, name)
+
+
+@pytest.mark.parametrize("codec", ["snappy", "NONE"])
+def test_all_supported_types_match_pyarrow(tmp_path, codec):
+    rng = np.random.default_rng(3)
+    n = 20_000
+    pool = ["alpha", "", "котики", "x" * 200, "middling"]
+    t = pa.table({
+        "i64": pa.array(rng.integers(0, 2**60, n), type=pa.int64()),
+        "i32": pa.array(rng.integers(0, 100, n).astype(np.int32)),
+        "i8": pa.array(rng.integers(0, 3, n).astype(np.int8)),
+        "i16": pa.array(rng.integers(0, 999, n).astype(np.int16)),
+        "f32": pa.array(rng.random(n).astype(np.float32)),
+        "f64": pa.array(rng.random(n)),
+        "ts_s": pa.array((1_700_000_000
+                          + rng.integers(0, 1000, n)).astype(
+                              "datetime64[s]")),
+        "ts_us": pa.array((1_700_000_000_000_000
+                           + rng.integers(0, 1000, n)).astype(
+                               "datetime64[us]")),
+        "low_str": pa.array([pool[i % 5] for i in range(n)]),
+        "hi_str": pa.array([f"url-{i}-{'x' * (i % 37)}"
+                            for i in range(n)]),
+        "null_str": pa.array([None if i % 11 == 0 else pool[i % 3]
+                              for i in range(n)]),
+        "null_int": pa.array([None if i % 13 == 0 else i
+                              for i in range(n)], type=pa.int64()),
+    })
+    pf, rdr = _roundtrip(t, tmp_path, row_group_size=8192,
+                         compression=codec)
+    _assert_matches(pf, rdr, t)
+
+
+def test_dict_fallback_mid_chunk(tmp_path):
+    # tiny dictionary page limit forces PLAIN fallback pages after the
+    # dict page fills: the chunk mixes dict-coded and plain pages and the
+    # decoder must flatten the dict prefix retroactively
+    n = 30_000
+    t = pa.table({
+        "s": pa.array([f"value-{i % 5000}-{'y' * (i % 23)}"
+                       for i in range(n)]),
+        "k": pa.array(list(range(n)), type=pa.int64()),
+    })
+    pf, rdr = _roundtrip(t, tmp_path, row_group_size=n,
+                         compression="snappy",
+                         dictionary_pagesize_limit=4096,
+                         data_page_size=8192)
+    _assert_matches(pf, rdr, t)
+
+
+def test_all_null_column(tmp_path):
+    t = pa.table({
+        "s": pa.array([None] * 1000, type=pa.string()),
+        "i": pa.array([None] * 1000, type=pa.int64()),
+    })
+    pf, rdr = _roundtrip(t, tmp_path)
+    cols = rdr.read_row_group(0)
+    assert cols["s"].to_pylist() == [None] * 1000
+    assert cols["i"].to_pylist() == [None] * 1000
+
+
+def test_unsupported_codec_falls_back(tmp_path):
+    t = pa.table({"i": pa.array(list(range(100)), type=pa.int64())})
+    path = str(tmp_path / "z.parquet")
+    pq.write_table(t, path, compression="zstd")
+    pf = pq.ParquetFile(path)
+    schema = arrow_to_table_schema(pf.schema_arrow)
+    rdr = NativeParquetReader.open(path, pf, schema)
+    # per-column fallback lands on arrow and still returns correct rows
+    cols = rdr.read_row_group(0)
+    assert cols["i"].to_pylist() == list(range(100))
+
+
+def test_slice_columns_views(tmp_path):
+    n = 5000
+    t = pa.table({
+        "s": pa.array([f"s{i % 7}" for i in range(n)]),
+        "i": pa.array(list(range(n)), type=pa.int64()),
+        "ns": pa.array([None if i % 3 == 0 else f"v{i % 11}"
+                        for i in range(n)]),
+    })
+    pf, rdr = _roundtrip(t, tmp_path, row_group_size=n)
+    cols = rdr.read_row_group(0)
+    sl = slice_columns(cols, 100, 164)
+    assert sl["i"].to_pylist() == list(range(100, 164))
+    assert sl["s"].to_pylist() == [f"s{i % 7}" for i in range(100, 164)]
+    assert sl["ns"].to_pylist() == [
+        None if i % 3 == 0 else f"v{i % 11}" for i in range(100, 164)]
+    # dict slices share the pool object
+    if cols["s"].is_lazy_dict:
+        assert sl["s"].dict_enc.pool is cols["s"].dict_enc.pool
+
+
+def test_file_storage_end_to_end_matches_arrow(tmp_path):
+    """The fs provider's native path and forced-arrow path must produce
+    identical batches (values and row order)."""
+    from transferia_tpu.abstract.schema import TableID
+    from transferia_tpu.abstract.table import TableDescription
+    from transferia_tpu.providers.file import (
+        FileSourceParams,
+        FileStorage,
+    )
+
+    n = 40_000
+    t = pa.table({
+        "URL": pa.array([f"https://e.test/{i % 997}" for i in range(n)]),
+        "RegionID": pa.array(
+            (np.arange(n) % 500).astype(np.int32)),
+    })
+    path = str(tmp_path / "hits.parquet")
+    pq.write_table(t, path, row_group_size=8192)
+
+    def run(disable_native):
+        if disable_native:
+            os.environ["TRANSFERIA_TPU_NATIVE_PARQUET"] = "0"
+        else:
+            os.environ.pop("TRANSFERIA_TPU_NATIVE_PARQUET", None)
+        try:
+            st = FileStorage(FileSourceParams(
+                path=path, format="parquet", table="hits",
+                batch_rows=4096))
+            out = []
+            st.load_table(TableDescription(id=TableID("fs", "hits")),
+                          out.append)
+            rows = []
+            for b in out:
+                rows.extend(zip(b.column("URL").to_pylist(),
+                                b.column("RegionID").to_pylist()))
+            return rows
+        finally:
+            os.environ.pop("TRANSFERIA_TPU_NATIVE_PARQUET", None)
+
+    native = run(False)
+    arrow = run(True)
+    assert native == arrow
+    assert len(native) == n

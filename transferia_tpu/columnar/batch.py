@@ -18,6 +18,7 @@ pkg/abstract/changeitem as the bulk currency; ChangeItems remain the row view.
 from __future__ import annotations
 
 import json
+import threading
 from dataclasses import dataclass, field
 from typing import Any, Iterable, Optional, Sequence
 
@@ -54,6 +55,37 @@ def _offsets_from_lengths(lengths) -> np.ndarray:
     return off64.astype(np.int32)
 
 
+def _gather_varwidth(data: np.ndarray, offsets: np.ndarray,
+                     indices: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Gather var-width rows by index: C++ fast path, numpy fallback.
+
+    Shared by Column.take and DictEnc.materialize (a dict materialization
+    IS a gather of the pool by the code array)."""
+    from transferia_tpu.native import lib as _native_lib
+
+    n = len(indices)
+    lens = (offsets[1:] - offsets[:-1])[indices].astype(np.int64)
+    new_offsets = _offsets_from_lengths(lens)  # guards the 2GiB limit
+    total = int(new_offsets[-1])
+    cdll = _native_lib()
+    if cdll is not None and total:
+        out = np.empty(total, dtype=np.uint8)
+        out_offsets = np.empty(n + 1, dtype=np.int32)
+        cdll.gather_varwidth(
+            np.ascontiguousarray(data),
+            np.ascontiguousarray(offsets, dtype=np.int32),
+            np.ascontiguousarray(indices, dtype=np.int64),
+            n, out, out_offsets,
+        )
+        return out, out_offsets
+    starts = offsets[:-1][indices].astype(np.int64)
+    intra = np.arange(total, dtype=np.int64) - np.repeat(
+        new_offsets[:-1].astype(np.int64), lens)
+    src = np.repeat(starts, lens) + intra
+    out = data[src] if total else np.zeros(0, dtype=np.uint8)
+    return out, new_offsets
+
+
 def bucket_rows(n: int) -> int:
     """Smallest standard bucket >= n (caps XLA recompiles)."""
     for b in _BUCKETS:
@@ -64,7 +96,107 @@ def bucket_rows(n: int) -> int:
     return ((n + top - 1) // top) * top
 
 
-@dataclass
+class DictPool:
+    """The value pool of a dictionary encoding, shareable across batches.
+
+    values_data/values_offsets: the pool as flat uint8 bytes + (k+1) int32.
+    null_code: index of the designated empty-bytes sentinel entry, if one
+    was appended at adoption (nulls materialize as empty bytes — the
+    canonical null representation of the flat path).
+    memos: per-pool computation cache, e.g. the HMAC'd hex pool keyed by
+    mask key — a pool shared by many batches is hashed once.
+    """
+
+    __slots__ = ("values_data", "values_offsets", "null_code", "_memos",
+                 "_keepalive")
+
+    def __init__(self, values_data: np.ndarray, values_offsets: np.ndarray,
+                 null_code: Optional[int] = None, keepalive=None):
+        self.values_data = values_data
+        self.values_offsets = values_offsets
+        self.null_code = null_code
+        self._memos: dict = {}
+        self._keepalive = keepalive  # pins adopted arrow buffers
+
+    @property
+    def n_values(self) -> int:
+        return len(self.values_offsets) - 1
+
+    def nbytes(self) -> int:
+        return self.values_data.nbytes + self.values_offsets.nbytes
+
+    def value_bytes(self, code: int) -> bytes:
+        return bytes(self.values_data[
+            self.values_offsets[code]:self.values_offsets[code + 1]])
+
+    def memo_get(self, key):
+        return self._memos.get(key)
+
+    def memo_set(self, key, value) -> None:
+        self._memos[key] = value
+
+
+# Adopted arrow dictionaries keyed by buffer identity: batch slices of one
+# row group share the same dict buffers, so they share one DictPool (and
+# its memos).  Entries pin the arrow pool array, which is what makes the
+# address a valid identity.  Bounded FIFO; lock guards the loader's
+# concurrent part threads.
+_POOL_CACHE: dict = {}
+_POOL_CACHE_MAX = 64
+_POOL_CACHE_LOCK = threading.Lock()
+
+
+class DictEnc:
+    """Dictionary encoding of a variable-width column (ClickHouse
+    LowCardinality / Arrow DictionaryArray analogue).
+
+    indices: (n,) int32 codes into the shared value pool.
+
+    Columns carrying a DictEnc stay dictionary-encoded end-to-end: parquet
+    dict pages adopt zero-copy on read (from_arrow), filters gather int32
+    codes instead of strings, the HMAC mask hashes the pool once instead
+    of every row, and to_arrow re-emits a DictionaryArray so parquet sinks
+    write dict pages back.  Flat (data, offsets) materialize lazily the
+    first time a consumer asks — correctness never depends on a consumer
+    knowing about the encoding.
+    """
+
+    __slots__ = ("indices", "pool")
+
+    def __init__(self, indices: np.ndarray,
+                 values_data: Optional[np.ndarray] = None,
+                 values_offsets: Optional[np.ndarray] = None,
+                 pool: Optional[DictPool] = None):
+        self.indices = indices
+        self.pool = pool if pool is not None else DictPool(
+            values_data, values_offsets)
+
+    # -- pool passthroughs ---------------------------------------------------
+    @property
+    def values_data(self) -> np.ndarray:
+        return self.pool.values_data
+
+    @property
+    def values_offsets(self) -> np.ndarray:
+        return self.pool.values_offsets
+
+    @property
+    def n_values(self) -> int:
+        return self.pool.n_values
+
+    def value_bytes(self, code: int) -> bytes:
+        return self.pool.value_bytes(code)
+
+    def nbytes(self) -> int:
+        return self.indices.nbytes + self.pool.nbytes()
+
+    def materialize(self) -> tuple[np.ndarray, np.ndarray]:
+        """Flatten to (data, offsets): a gather of the pool by codes."""
+        return _gather_varwidth(self.pool.values_data,
+                                self.pool.values_offsets,
+                                self.indices.astype(np.int64))
+
+
 class Column:
     """One column of a batch.
 
@@ -72,28 +204,76 @@ class Column:
           variable-width -> (total_bytes,) uint8 buffer
     offsets: (n+1,) int32 — only for variable-width columns
     validity: (n,) bool (True = present) or None meaning all-valid
+    dict_enc: optional dictionary encoding (var-width only); when set with
+          data=None the flat buffers materialize lazily on first access
     """
 
-    name: str
-    ctype: CanonicalType
-    data: np.ndarray
-    offsets: Optional[np.ndarray] = None
-    validity: Optional[np.ndarray] = None
+    __slots__ = ("name", "ctype", "_data", "_offsets", "validity",
+                 "dict_enc")
 
-    def __post_init__(self):
-        if self.ctype.is_variable_width and self.offsets is None:
-            raise ValueError(f"column {self.name}: var-width requires offsets")
+    def __init__(self, name: str, ctype: CanonicalType,
+                 data: Optional[np.ndarray] = None,
+                 offsets: Optional[np.ndarray] = None,
+                 validity: Optional[np.ndarray] = None,
+                 dict_enc: Optional[DictEnc] = None):
+        self.name = name
+        self.ctype = ctype
+        self._data = data
+        self._offsets = offsets
+        self.validity = validity
+        self.dict_enc = dict_enc
+        if ctype.is_variable_width:
+            if offsets is None and dict_enc is None:
+                raise ValueError(
+                    f"column {name}: var-width requires offsets")
+        elif data is None:
+            raise ValueError(f"column {name}: fixed-width requires data")
+
+    def _materialize(self) -> None:
+        if self._data is None:
+            self._data, self._offsets = self.dict_enc.materialize()
+
+    @property
+    def data(self) -> np.ndarray:
+        if self._data is None:
+            self._materialize()
+        return self._data
+
+    @data.setter
+    def data(self, v: np.ndarray) -> None:
+        self._data = v
+
+    @property
+    def offsets(self) -> Optional[np.ndarray]:
+        if self._offsets is None and self.dict_enc is not None:
+            self._materialize()
+        return self._offsets
+
+    @offsets.setter
+    def offsets(self, v: Optional[np.ndarray]) -> None:
+        self._offsets = v
+
+    @property
+    def is_lazy_dict(self) -> bool:
+        """True while dictionary-encoded with no flat copy materialized —
+        the state dict-aware fast paths (mask, to_arrow, take) look for."""
+        return self.dict_enc is not None and self._data is None
 
     @property
     def n_rows(self) -> int:
-        if self.offsets is not None:
-            return len(self.offsets) - 1
-        return len(self.data)
+        if self.dict_enc is not None and self._offsets is None:
+            return len(self.dict_enc.indices)
+        if self._offsets is not None:
+            return len(self._offsets) - 1
+        return len(self._data)
 
     def nbytes(self) -> int:
-        n = self.data.nbytes
-        if self.offsets is not None:
-            n += self.offsets.nbytes
+        if self.is_lazy_dict:
+            n = self.dict_enc.nbytes()
+        else:
+            n = self._data.nbytes
+            if self._offsets is not None:
+                n += self._offsets.nbytes
         if self.validity is not None:
             n += self.validity.nbytes
         return n
@@ -106,6 +286,9 @@ class Column:
         """Python value at row i (None when invalid)."""
         if not self.is_valid(i):
             return None
+        if self.is_lazy_dict:
+            raw = self.dict_enc.value_bytes(int(self.dict_enc.indices[i]))
+            return _decode_varwidth(self.ctype, raw)
         if self.offsets is not None:
             raw = bytes(self.data[self.offsets[i]:self.offsets[i + 1]])
             return _decode_varwidth(self.ctype, raw)
@@ -122,36 +305,26 @@ class Column:
     def to_pylist(self) -> list[Any]:
         return [self.value(i) for i in range(self.n_rows)]
 
+    def renamed(self, name: str) -> "Column":
+        """Copy under a new name (laziness and buffers preserved)."""
+        return Column(name, self.ctype, self._data, self._offsets,
+                      self.validity, self.dict_enc)
+
     # -- functional ops -----------------------------------------------------
     def take(self, indices: np.ndarray) -> "Column":
         """Gather rows (host-side; device path uses ops.strings.take_bytes)."""
         validity = self.validity[indices] if self.validity is not None else None
+        if self.is_lazy_dict:
+            # dictionary stays shared; only the int32 codes gather
+            enc = self.dict_enc
+            return Column(
+                self.name, self.ctype, validity=validity,
+                dict_enc=DictEnc(enc.indices[indices], pool=enc.pool))
         if self.offsets is None:
             return Column(self.name, self.ctype, self.data[indices], None, validity)
-        lens = (self.offsets[1:] - self.offsets[:-1])[indices].astype(np.int64)
-        new_offsets = _offsets_from_lengths(lens)  # guards the 2GiB limit
-        total = int(new_offsets[-1])
-        from transferia_tpu.native import lib as _native_lib
-
-        cdll = _native_lib()
-        if cdll is not None and total:
-            out = np.empty(total, dtype=np.uint8)
-            out_offsets = np.empty(len(indices) + 1, dtype=np.int32)
-            cdll.gather_varwidth(
-                np.ascontiguousarray(self.data),
-                np.ascontiguousarray(self.offsets, dtype=np.int32),
-                np.ascontiguousarray(indices, dtype=np.int64),
-                len(indices), out, out_offsets,
-            )
-            return Column(self.name, self.ctype, out, out_offsets,
-                          validity)
-        # numpy fallback: flat gather via repeat/arange
-        starts = self.offsets[:-1][indices].astype(np.int64)
-        intra = np.arange(total, dtype=np.int64) - np.repeat(
-            new_offsets[:-1].astype(np.int64), lens
-        )
-        src = np.repeat(starts, lens) + intra
-        out = self.data[src] if total else np.zeros(0, dtype=np.uint8)
+        out, new_offsets = _gather_varwidth(
+            self.data, self.offsets,
+            np.ascontiguousarray(indices, dtype=np.int64))
         return Column(self.name, self.ctype, out, new_offsets, validity)
 
     def filter(self, mask: np.ndarray) -> "Column":
@@ -486,6 +659,30 @@ class ColumnBatch:
             if c is None:
                 continue
             pa_type = _ARROW_TYPES[cs.data_type]
+            if c.is_lazy_dict:
+                # dictionary-encoded end-to-end: parquet sinks write dict
+                # pages straight from the pool, no flat materialization;
+                # the arrow pool array memoizes on the shared DictPool so
+                # batch slices of one row group serialize it once
+                enc = c.dict_enc
+                memo_key = ("arrow_pool", str(pa_type))
+                pool = enc.pool.memo_get(memo_key)
+                if pool is None:
+                    pool = pa.Array.from_buffers(
+                        pa_type, enc.n_values,
+                        [None,
+                         pa.py_buffer(
+                             enc.values_offsets.astype(np.int32)
+                             .tobytes()),
+                         pa.py_buffer(enc.values_data.tobytes())])
+                    enc.pool.memo_set(memo_key, pool)
+                mask = (~c.validity) if c.validity is not None else None
+                idx = pa.array(enc.indices, type=pa.int32(), mask=mask)
+                arrays.append(pa.DictionaryArray.from_arrays(idx, pool))
+                fields.append(pa.field(
+                    cs.name, pa.dictionary(pa.int32(), pa_type),
+                    nullable=not cs.required))
+                continue
             if c.offsets is not None:
                 buf_data = pa.py_buffer(c.data.tobytes())
                 buf_off = pa.py_buffer(c.offsets.astype(np.int32).tobytes())
@@ -569,6 +766,62 @@ def _arrow_validity(validity: Optional[np.ndarray], n: int):
     return pa.py_buffer(bits.tobytes())
 
 
+def _adopt_string_buffers(arr) -> tuple[np.ndarray, np.ndarray]:
+    """Zero-ish-copy adoption of a pyarrow string/binary array's buffers."""
+    bufs = arr.buffers()
+    off = np.frombuffer(bufs[1], dtype=np.int32,
+                        count=len(arr) + 1 + arr.offset)
+    data = np.frombuffer(bufs[2], dtype=np.uint8) if bufs[2] is not None \
+        else np.zeros(0, dtype=np.uint8)
+    if arr.offset:
+        off = off[arr.offset:]
+    if off[0] != 0:
+        data = data[off[0]:off[-1]]
+        off = off - off[0]
+    return np.ascontiguousarray(data), np.ascontiguousarray(off)
+
+
+def _adopt_dict_pool(pool_arr, vt, pt, pa) -> DictPool:
+    """Adopt an arrow dictionary as a shared DictPool.
+
+    Keyed by buffer identity: all batch slices of one row group reference
+    the same dictionary buffers, so they get one DictPool object — and one
+    set of memos (the HMAC mask hashes a shared pool exactly once).  The
+    cache entry pins the arrow array, keeping the address a valid key.
+    An empty-bytes sentinel entry is appended for null rows (null_code).
+    """
+    # key on the ORIGINAL array's buffers: casting large_string allocates
+    # fresh buffers each call, which would make the key never repeat
+    orig = pool_arr
+    bufs = orig.buffers()
+    key = (
+        bufs[2].address if bufs[2] is not None else 0,
+        bufs[1].address if bufs[1] is not None else 0,
+        len(orig), orig.offset, str(orig.type),
+    )
+    with _POOL_CACHE_LOCK:
+        hit = _POOL_CACHE.get(key)
+        if hit is not None:
+            return hit[0]
+    if pt.is_large_string(vt) or pt.is_large_binary(vt):
+        pool_arr = pool_arr.cast(
+            pa.string() if pt.is_large_string(vt) else pa.binary())
+    pool_data, pool_off = _adopt_string_buffers(pool_arr)
+    # append the null sentinel (empty bytes) at index n_values
+    pool_off = np.append(pool_off, pool_off[-1]).astype(np.int32)
+    dpool = DictPool(pool_data, pool_off, null_code=len(pool_arr),
+                     keepalive=pool_arr)
+    with _POOL_CACHE_LOCK:
+        hit = _POOL_CACHE.get(key)
+        if hit is not None:
+            return hit[0]
+        while len(_POOL_CACHE) >= _POOL_CACHE_MAX:
+            _POOL_CACHE.pop(next(iter(_POOL_CACHE)), None)
+        # pin the ORIGINAL array: its buffer addresses are the key
+        _POOL_CACHE[key] = (dpool, orig)
+    return dpool
+
+
 def _arrow_to_column(cs: ColSchema, arr) -> Column:
     import pyarrow as pa
     import pyarrow.types as pt
@@ -577,6 +830,28 @@ def _arrow_to_column(cs: ColSchema, arr) -> Column:
     if arr.null_count:
         validity = np.asarray(arr.is_valid())
     t = arr.type
+    if pt.is_dictionary(t):
+        vt = t.value_type
+        if cs.data_type.is_variable_width and (
+                pt.is_string(vt) or pt.is_large_string(vt)
+                or pt.is_binary(vt) or pt.is_large_binary(vt)):
+            pool_arr = arr.dictionary
+            if pool_arr.null_count == 0:
+                dpool = _adopt_dict_pool(pool_arr, vt, pt, pa)
+                idx = arr.indices
+                if idx.null_count:
+                    idx = idx.fill_null(0)
+                codes = np.asarray(idx.cast(pa.int32()))
+                if validity is not None:
+                    # canonical null representation is empty bytes (matches
+                    # the flat path): null rows point at the pool's empty
+                    # sentinel so lazy materialization is byte-identical
+                    codes = np.where(validity, codes,
+                                     dpool.null_code).astype(np.int32)
+                return Column(cs.name, cs.data_type, validity=validity,
+                              dict_enc=DictEnc(codes, pool=dpool))
+        # non-string pool or pool nulls: decode in arrow C++ and re-enter
+        return _arrow_to_column(cs, arr.cast(t.value_type))
     if pt.is_string(t) or pt.is_large_string(t) or pt.is_binary(t) \
             or pt.is_large_binary(t):
         if pt.is_large_string(t) or pt.is_large_binary(t):
@@ -625,6 +900,9 @@ def arrow_to_table_schema(pa_schema) -> TableSchema:
     cols = []
     for f in pa_schema:
         t = f.type
+        if pt.is_dictionary(t):
+            t = t.value_type  # canonical type is the value type;
+            # the encoding itself travels as Column.dict_enc
         if pt.is_int8(t):
             ct = CanonicalType.INT8
         elif pt.is_int16(t):

@@ -53,6 +53,22 @@ def _bind(cdll: ctypes.CDLL) -> ctypes.CDLL:
         u8, i32, ctypes.c_int64, u32, u32, u32, u32,
     ]
     cdll.polyhash_varcol.restype = None
+    # parquet-decoder symbols are OPTIONAL: a prebuilt .so from an older
+    # source must keep serving the ops above rather than failing the load
+    if hasattr(cdll, "pq_decode_fixed"):
+        cdll.pq_decode_fixed.argtypes = [
+            u8, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32,
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_void_p,
+            ctypes.c_void_p,
+        ]
+        cdll.pq_decode_fixed.restype = ctypes.c_int64
+        cdll.pq_decode_bytearray.argtypes = [
+            u8, ctypes.c_int64, ctypes.c_int32, ctypes.c_int64,
+            ctypes.c_int32, u8, ctypes.c_int64, i32, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_int32),
+            ctypes.POINTER(ctypes.c_int64),
+        ]
+        cdll.pq_decode_bytearray.restype = ctypes.c_int64
     return cdll
 
 
@@ -61,12 +77,14 @@ def build(force: bool = False) -> bool:
     import shutil
     import subprocess
 
-    src = _DIR / "hostops.cpp"
-    if not src.exists():
-        # source pruned from the deployment: use a prebuilt .so as-is
+    srcs = [_DIR / "hostops.cpp", _DIR / "parquetdec.cpp"]
+    srcs = [s for s in srcs if s.exists()]
+    if not srcs:
+        # sources pruned from the deployment: use a prebuilt .so as-is
         return _SO.exists()
     if (_SO.exists() and not force
-            and _SO.stat().st_mtime >= src.stat().st_mtime):
+            and _SO.stat().st_mtime >= max(s.stat().st_mtime
+                                           for s in srcs)):
         return True
     cxx = shutil.which("g++") or shutil.which("clang++")
     if cxx is None:
@@ -74,7 +92,8 @@ def build(force: bool = False) -> bool:
         return _SO.exists()
     try:
         subprocess.run(
-            [cxx, "-O3", "-shared", "-fPIC", "-o", str(_SO), str(src)],
+            [cxx, "-O3", "-shared", "-fPIC", "-o", str(_SO)]
+            + [str(s) for s in srcs],
             check=True, capture_output=True, timeout=120,
         )
         return True

@@ -1,0 +1,760 @@
+// Native parquet column-chunk decoder (C++, ctypes-bound).
+//
+// The reference's ingest hot loop is hand-optimized Go per provider; here
+// the analogous hot loop is parquet decode on the snapshot north-star path
+// (providers/file.py -> ColumnBatch).  Arrow's general-purpose reader
+// spends most of its single-core time in dictionary unification and
+// dict-index materialization; this decoder goes straight from the column
+// chunk bytes to the engine's columnar layout (flat values, or int32 codes
+// + value pool adopted as DictEnc) with no intermediate representation.
+//
+// Scope (everything else returns an error and the caller falls back to
+// arrow for that column):
+//   - page header: thrift compact protocol, DataPage v1 + DictionaryPage
+//   - codecs: UNCOMPRESSED, SNAPPY (decoder below)
+//   - encodings: PLAIN, RLE_DICTIONARY/PLAIN_DICTIONARY, RLE def-levels
+//   - physical types: INT32, INT64, FLOAT, DOUBLE, BYTE_ARRAY
+//   - max_definition_level <= 1 (flat schemas), no repetition levels
+//
+// Error contract: negative return = unsupported/corrupt (caller falls
+// back); PQ_E_GROW with *needed set = output buffer too small, retry.
+
+#include <cstdint>
+#include <cstring>
+#include <cstdlib>
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// byte reader with bounds checking
+
+struct Reader {
+    const uint8_t* p;
+    const uint8_t* end;
+    bool fail = false;
+
+    int64_t left() const { return end - p; }
+    bool need(int64_t n) {
+        if (left() < n) { fail = true; return false; }
+        return true;
+    }
+    uint8_t u8() {
+        if (!need(1)) return 0;
+        return *p++;
+    }
+    uint64_t uvarint() {
+        uint64_t v = 0;
+        int shift = 0;
+        while (shift < 64) {
+            if (!need(1)) return 0;
+            uint8_t b = *p++;
+            v |= (uint64_t)(b & 0x7F) << shift;
+            if (!(b & 0x80)) return v;
+            shift += 7;
+        }
+        fail = true;
+        return 0;
+    }
+    int64_t zigzag() {
+        uint64_t v = uvarint();
+        return (int64_t)(v >> 1) ^ -(int64_t)(v & 1);
+    }
+    bool skip(int64_t n) {
+        if (!need(n)) return false;
+        p += n;
+        return true;
+    }
+};
+
+// ---------------------------------------------------------------------------
+// thrift compact protocol: parse PageHeader, generically skipping unknown
+// fields (statistics etc.)
+
+enum TType {
+    T_STOP = 0, T_TRUE = 1, T_FALSE = 2, T_BYTE = 3, T_I16 = 4,
+    T_I32 = 5, T_I64 = 6, T_DOUBLE = 7, T_BINARY = 8, T_LIST = 9,
+    T_SET = 10, T_MAP = 11, T_STRUCT = 12,
+};
+
+void thrift_skip(Reader& r, int ttype);
+
+void thrift_skip_struct(Reader& r) {
+    for (;;) {
+        if (r.fail) return;
+        uint8_t b = r.u8();
+        if (b == 0) return;  // STOP
+        int ttype = b & 0x0F;
+        if ((b >> 4) == 0) r.zigzag();  // long-form field id
+        thrift_skip(r, ttype);
+    }
+}
+
+void thrift_skip(Reader& r, int ttype) {
+    switch (ttype) {
+    case T_TRUE: case T_FALSE: return;
+    case T_BYTE: r.u8(); return;
+    case T_I16: case T_I32: case T_I64: r.zigzag(); return;
+    case T_DOUBLE: r.skip(8); return;
+    case T_BINARY: { uint64_t n = r.uvarint(); r.skip((int64_t)n); return; }
+    case T_LIST: case T_SET: {
+        uint8_t sh = r.u8();
+        int64_t n = sh >> 4;
+        int et = sh & 0x0F;
+        if (n == 15) n = (int64_t)r.uvarint();
+        for (int64_t i = 0; i < n && !r.fail; i++) thrift_skip(r, et);
+        return;
+    }
+    case T_MAP: {
+        uint64_t n = r.uvarint();
+        if (n == 0) return;
+        uint8_t kv = r.u8();
+        for (uint64_t i = 0; i < n && !r.fail; i++) {
+            thrift_skip(r, kv >> 4);
+            thrift_skip(r, kv & 0x0F);
+        }
+        return;
+    }
+    case T_STRUCT: thrift_skip_struct(r); return;
+    default: r.fail = true; return;
+    }
+}
+
+struct PageHeader {
+    int32_t type = -1;              // 0 data, 2 dict, 3 data v2
+    int32_t uncompressed_size = -1;
+    int32_t compressed_size = -1;
+    // data page v1
+    int32_t num_values = -1;
+    int32_t encoding = -1;
+    // dictionary page
+    int32_t dict_num_values = -1;
+    int32_t dict_encoding = -1;
+};
+
+// parse one struct level with a field callback
+bool parse_page_header(Reader& r, PageHeader& h) {
+    int16_t fid = 0;
+    for (;;) {
+        if (r.fail) return false;
+        uint8_t b = r.u8();
+        if (b == 0) break;
+        int ttype = b & 0x0F;
+        int delta = b >> 4;
+        if (delta == 0) fid = (int16_t)r.zigzag();
+        else fid = (int16_t)(fid + delta);
+        if (ttype == T_TRUE || ttype == T_FALSE) continue;
+        switch (fid) {
+        case 1: h.type = (int32_t)r.zigzag(); break;
+        case 2: h.uncompressed_size = (int32_t)r.zigzag(); break;
+        case 3: h.compressed_size = (int32_t)r.zigzag(); break;
+        case 5: {  // DataPageHeader struct
+            if (ttype != T_STRUCT) { thrift_skip(r, ttype); break; }
+            int16_t f2 = 0;
+            for (;;) {
+                uint8_t b2 = r.u8();
+                if (b2 == 0 || r.fail) break;
+                int tt2 = b2 & 0x0F;
+                int d2 = b2 >> 4;
+                if (d2 == 0) f2 = (int16_t)r.zigzag();
+                else f2 = (int16_t)(f2 + d2);
+                if (tt2 == T_TRUE || tt2 == T_FALSE) continue;
+                if (f2 == 1) h.num_values = (int32_t)r.zigzag();
+                else if (f2 == 2) h.encoding = (int32_t)r.zigzag();
+                else thrift_skip(r, tt2);
+            }
+            break;
+        }
+        case 7: {  // DictionaryPageHeader struct
+            if (ttype != T_STRUCT) { thrift_skip(r, ttype); break; }
+            int16_t f2 = 0;
+            for (;;) {
+                uint8_t b2 = r.u8();
+                if (b2 == 0 || r.fail) break;
+                int tt2 = b2 & 0x0F;
+                int d2 = b2 >> 4;
+                if (d2 == 0) f2 = (int16_t)r.zigzag();
+                else f2 = (int16_t)(f2 + d2);
+                if (tt2 == T_TRUE || tt2 == T_FALSE) continue;
+                if (f2 == 1) h.dict_num_values = (int32_t)r.zigzag();
+                else if (f2 == 2) h.dict_encoding = (int32_t)r.zigzag();
+                else thrift_skip(r, tt2);
+            }
+            break;
+        }
+        default:
+            thrift_skip(r, ttype);
+        }
+    }
+    return !r.fail && h.type >= 0 && h.compressed_size >= 0;
+}
+
+// ---------------------------------------------------------------------------
+// snappy raw-format decompressor
+
+// returns decompressed length or -1
+int64_t snappy_decompress(const uint8_t* src, int64_t src_len,
+                          uint8_t* dst, int64_t dst_cap) {
+    Reader r{src, src + src_len};
+    uint64_t out_len = r.uvarint();
+    if (r.fail || (int64_t)out_len > dst_cap) return -1;
+    uint8_t* op = dst;
+    uint8_t* op_end = dst + out_len;
+    while (r.p < r.end) {
+        uint8_t tag = *r.p++;
+        if ((tag & 3) == 0) {  // literal
+            int64_t lenm1 = tag >> 2;
+            if (lenm1 >= 60) {
+                int nb = (int)lenm1 - 59;  // 1..4 extra length bytes
+                if (!r.need(nb)) return -1;
+                uint64_t l = 0;
+                for (int i = 0; i < nb; i++) l |= (uint64_t)r.p[i] << (8 * i);
+                r.p += nb;
+                lenm1 = (int64_t)l;
+            }
+            int64_t len = lenm1 + 1;
+            if (!r.need(len) || op + len > op_end) return -1;
+            memcpy(op, r.p, (size_t)len);
+            r.p += len;
+            op += len;
+        } else {
+            int64_t len, offset;
+            if ((tag & 3) == 1) {
+                len = ((tag >> 2) & 7) + 4;
+                if (!r.need(1)) return -1;
+                offset = ((int64_t)(tag >> 5) << 8) | *r.p++;
+            } else if ((tag & 3) == 2) {
+                len = (tag >> 2) + 1;
+                if (!r.need(2)) return -1;
+                offset = (int64_t)r.p[0] | ((int64_t)r.p[1] << 8);
+                r.p += 2;
+            } else {
+                len = (tag >> 2) + 1;
+                if (!r.need(4)) return -1;
+                offset = (int64_t)r.p[0] | ((int64_t)r.p[1] << 8)
+                       | ((int64_t)r.p[2] << 16) | ((int64_t)r.p[3] << 24);
+                r.p += 4;
+            }
+            if (offset <= 0 || op - dst < offset || op + len > op_end)
+                return -1;
+            const uint8_t* cp = op - offset;
+            if (offset >= len) {
+                memcpy(op, cp, (size_t)len);
+                op += len;
+            } else {
+                for (int64_t i = 0; i < len; i++) *op++ = *cp++;
+            }
+        }
+    }
+    return (op == op_end) ? (int64_t)out_len : -1;
+}
+
+// ---------------------------------------------------------------------------
+// RLE/bit-packed hybrid decoder (def levels + dict indices)
+
+struct RleDecoder {
+    Reader r;
+    int bit_width;
+    // current run
+    int64_t rle_count = 0;
+    uint32_t rle_value = 0;
+    int64_t bp_count = 0;       // remaining values in bit-packed run
+    uint64_t bit_buf = 0;
+    int bit_cnt = 0;
+
+    bool next_run() {
+        if (r.p >= r.end) return false;
+        uint64_t header = r.uvarint();
+        if (r.fail) return false;
+        if (header & 1) {
+            bp_count = (int64_t)(header >> 1) * 8;
+            bit_buf = 0;
+            bit_cnt = 0;
+        } else {
+            rle_count = (int64_t)(header >> 1);
+            int nb = (bit_width + 7) / 8;
+            if (!r.need(nb)) return false;
+            rle_value = 0;
+            for (int i = 0; i < nb; i++)
+                rle_value |= (uint32_t)r.p[i] << (8 * i);
+            r.p += nb;
+        }
+        return true;
+    }
+
+    // decode n values into out (int32); returns false on error
+    bool get(int32_t* out, int64_t n) {
+        while (n > 0) {
+            if (rle_count > 0) {
+                int64_t take = n < rle_count ? n : rle_count;
+                for (int64_t i = 0; i < take; i++) out[i] = (int32_t)rle_value;
+                out += take; n -= take; rle_count -= take;
+            } else if (bp_count > 0) {
+                int64_t take = n < bp_count ? n : bp_count;
+                for (int64_t i = 0; i < take; i++) {
+                    while (bit_cnt < bit_width) {
+                        // bit-packed runs may overhang the last byte
+                        uint8_t byte = (r.p < r.end) ? *r.p++ : 0;
+                        bit_buf |= (uint64_t)byte << bit_cnt;
+                        bit_cnt += 8;
+                    }
+                    out[i] = (int32_t)(bit_buf & ((1u << bit_width) - 1));
+                    bit_buf >>= bit_width;
+                    bit_cnt -= bit_width;
+                }
+                out += take; n -= take; bp_count -= take;
+            } else if (!next_run()) {
+                return false;
+            }
+        }
+        return true;
+    }
+};
+
+// ---------------------------------------------------------------------------
+// shared chunk-walk state
+
+enum {
+    PQ_OK = 0,
+    PQ_E_UNSUPPORTED = -1,
+    PQ_E_CORRUPT = -3,
+    PQ_E_GROW = -2,
+};
+
+enum { CODEC_RAW = 0, CODEC_SNAPPY = 1 };
+enum { ENC_PLAIN = 0, ENC_PLAIN_DICT = 2, ENC_RLE = 3, ENC_RLE_DICT = 8 };
+
+struct Scratch {
+    uint8_t* buf = nullptr;
+    int64_t cap = 0;
+    ~Scratch() { free(buf); }
+    uint8_t* ensure(int64_t n) {
+        if (n > cap) {
+            free(buf);
+            buf = (uint8_t*)malloc((size_t)n);
+            cap = buf ? n : 0;
+        }
+        return buf;
+    }
+};
+
+// decompress one page's data into scratch (or return pointer into the
+// chunk when uncompressed); nullptr on error
+const uint8_t* page_bytes(Reader& r, const PageHeader& h, int codec,
+                          Scratch& scratch) {
+    if (!r.need(h.compressed_size)) return nullptr;
+    const uint8_t* raw = r.p;
+    r.p += h.compressed_size;
+    if (codec == CODEC_RAW) return raw;
+    uint8_t* dst = scratch.ensure(h.uncompressed_size);
+    if (!dst) return nullptr;
+    if (snappy_decompress(raw, h.compressed_size, dst,
+                          h.uncompressed_size) != h.uncompressed_size)
+        return nullptr;
+    return dst;
+}
+
+// def-levels: fills validity[0..n) (1/0), returns count of defined values,
+// advances *pp past the level bytes.  v1 layout: u32 len + RLE(bitwidth 1).
+int64_t read_def_levels(const uint8_t*& p, const uint8_t* end,
+                        int32_t max_def, int64_t n, uint8_t* validity,
+                        int64_t validity_off) {
+    if (max_def == 0) {
+        if (validity) memset(validity + validity_off, 1, (size_t)n);
+        return n;
+    }
+    if (end - p < 4) return -1;
+    uint32_t len = (uint32_t)p[0] | ((uint32_t)p[1] << 8)
+                 | ((uint32_t)p[2] << 16) | ((uint32_t)p[3] << 24);
+    p += 4;
+    if (end - p < (int64_t)len) return -1;
+    RleDecoder rd;
+    rd.r = Reader{p, p + len};
+    rd.bit_width = 1;  // max_def == 1
+    p += len;
+    int64_t defined = 0;
+    // decode levels in blocks to avoid a big temp
+    int32_t tmp[1024];
+    int64_t done = 0;
+    while (done < n) {
+        int64_t take = n - done < 1024 ? n - done : 1024;
+        if (!rd.get(tmp, take)) return -1;
+        for (int64_t i = 0; i < take; i++) {
+            uint8_t v = (uint8_t)(tmp[i] != 0);
+            validity[validity_off + done + i] = v;
+            defined += v;
+        }
+        done += take;
+    }
+    return defined;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Fixed-width chunk decode (INT32/INT64/FLOAT/DOUBLE: width 4 or 8).
+//
+// out_values: num_values*width bytes, row-aligned (null slots zeroed).
+// out_validity: num_values bytes (1=valid) or NULL when max_def==0.
+// Returns number of rows decoded, or a PQ_E_* error.
+int64_t pq_decode_fixed(const uint8_t* chunk, int64_t chunk_len,
+                        int32_t codec, int32_t width, int64_t num_values,
+                        int32_t max_def, uint8_t* out_values,
+                        uint8_t* out_validity) {
+    if (codec != CODEC_RAW && codec != CODEC_SNAPPY) return PQ_E_UNSUPPORTED;
+    if (width != 4 && width != 8) return PQ_E_UNSUPPORTED;
+    if (max_def > 1) return PQ_E_UNSUPPORTED;
+    Reader r{chunk, chunk + chunk_len};
+    Scratch scratch, dict;
+    int64_t dict_n = 0;
+    int64_t row = 0;
+    int32_t idx_buf[4096];
+    while (row < num_values && r.p < r.end) {
+        PageHeader h;
+        if (!parse_page_header(r, h)) return PQ_E_CORRUPT;
+        if (h.type == 2) {  // dictionary page
+            if (h.dict_encoding != ENC_PLAIN
+                && h.dict_encoding != ENC_PLAIN_DICT)
+                return PQ_E_UNSUPPORTED;
+            const uint8_t* pb = page_bytes(r, h, codec, scratch);
+            if (!pb) return PQ_E_CORRUPT;
+            dict_n = h.uncompressed_size / width;
+            if (!dict.ensure(h.uncompressed_size)) return PQ_E_CORRUPT;
+            memcpy(dict.buf, pb, (size_t)h.uncompressed_size);
+            continue;
+        }
+        if (h.type != 0) return PQ_E_UNSUPPORTED;  // v2 etc.
+        const uint8_t* pb = page_bytes(r, h, codec, scratch);
+        if (!pb) return PQ_E_CORRUPT;
+        const uint8_t* pend = pb + h.uncompressed_size;
+        int64_t n = h.num_values;
+        if (n < 0 || row + n > num_values) return PQ_E_CORRUPT;
+        int64_t defined = read_def_levels(pb, pend, max_def, n,
+                                          out_validity, row);
+        if (defined < 0) return PQ_E_CORRUPT;
+        uint8_t* dst = out_values + row * width;
+        if (h.encoding == ENC_PLAIN) {
+            if (pend - pb < defined * width) return PQ_E_CORRUPT;
+            if (defined == n) {
+                memcpy(dst, pb, (size_t)(n * width));
+            } else {
+                memset(dst, 0, (size_t)(n * width));
+                const uint8_t* src = pb;
+                for (int64_t i = 0; i < n; i++) {
+                    if (out_validity[row + i]) {
+                        memcpy(dst + i * width, src, (size_t)width);
+                        src += width;
+                    }
+                }
+            }
+        } else if (h.encoding == ENC_RLE_DICT
+                   || h.encoding == ENC_PLAIN_DICT) {
+            if (pend - pb < 1) return PQ_E_CORRUPT;
+            RleDecoder rd;
+            rd.bit_width = *pb++;
+            if (rd.bit_width > 32) return PQ_E_CORRUPT;
+            rd.r = Reader{pb, pend};
+            if (defined < n) memset(dst, 0, (size_t)(n * width));
+            int64_t i = 0;
+            while (i < n) {
+                // count the defined rows in this block, decode their
+                // codes, scatter via the dictionary
+                int64_t block = n - i < 4096 ? n - i : 4096;
+                int64_t nd = 0;
+                if (defined == n) {
+                    nd = block;
+                } else {
+                    for (int64_t k = 0; k < block; k++)
+                        nd += out_validity[row + i + k];
+                }
+                if (!rd.get(idx_buf, nd)) return PQ_E_CORRUPT;
+                int64_t ci = 0;
+                if (width == 4) {
+                    const uint32_t* dv = (const uint32_t*)dict.buf;
+                    uint32_t* d32 = (uint32_t*)(out_values) + row + i;
+                    for (int64_t k = 0; k < block; k++) {
+                        if (defined != n && !out_validity[row + i + k])
+                            continue;
+                        uint32_t code = (uint32_t)idx_buf[ci++];
+                        if ((int64_t)code >= dict_n) return PQ_E_CORRUPT;
+                        d32[k] = dv[code];
+                    }
+                } else {
+                    const uint64_t* dv = (const uint64_t*)dict.buf;
+                    uint64_t* d64 = (uint64_t*)(out_values) + row + i;
+                    for (int64_t k = 0; k < block; k++) {
+                        if (defined != n && !out_validity[row + i + k])
+                            continue;
+                        uint32_t code = (uint32_t)idx_buf[ci++];
+                        if ((int64_t)code >= dict_n) return PQ_E_CORRUPT;
+                        d64[k] = dv[code];
+                    }
+                }
+                i += block;
+            }
+        } else {
+            return PQ_E_UNSUPPORTED;
+        }
+        row += n;
+    }
+    return row;
+}
+
+// ---------------------------------------------------------------------------
+// BYTE_ARRAY chunk decode.
+//
+// Result forms (out_kind):
+//   1 = dictionary: every data page was dict-encoded.  out_codes[r] holds
+//       the code per row (null rows get n_pool — the caller's sentinel),
+//       the pool lands in out_data/out_offsets (n_pool+1 offsets), and
+//       the return value is n_pool.
+//   0 = flat: out_data/out_offsets hold per-row bytes (null rows empty);
+//       return value is total data bytes.  Mixed dict+plain chunks land
+//       here (dict parts gather through the pool).
+// PQ_E_GROW with *needed set: out_data too small — retry with that cap.
+int64_t pq_decode_bytearray(const uint8_t* chunk, int64_t chunk_len,
+                            int32_t codec, int64_t num_values,
+                            int32_t max_def,
+                            uint8_t* out_data, int64_t out_data_cap,
+                            int32_t* out_offsets, int32_t* out_codes,
+                            uint8_t* out_validity, int32_t* out_kind,
+                            int64_t* needed) {
+    if (codec != CODEC_RAW && codec != CODEC_SNAPPY) return PQ_E_UNSUPPORTED;
+    if (max_def > 1) return PQ_E_UNSUPPORTED;
+    Reader r{chunk, chunk + chunk_len};
+    Scratch scratch;
+    // dictionary pool (decompressed PLAIN bytes, parsed on arrival)
+    Scratch dict_raw;
+    int64_t pool_n = 0;
+    int64_t pool_bytes = 0;
+    // pool offsets live at the head of dict_idx scratch
+    Scratch pool_off_s;
+    int32_t* pool_off = nullptr;
+    const uint8_t* pool_data = nullptr;
+    bool all_dict = true;
+    bool any_rows = false;
+    int64_t row = 0;
+    int64_t flat_pos = 0;  // bytes written to out_data in flat mode
+    int32_t idx_buf[4096];
+
+    while (row < num_values && r.p < r.end) {
+        PageHeader h;
+        if (!parse_page_header(r, h)) return PQ_E_CORRUPT;
+        if (h.type == 2) {
+            if (h.dict_encoding != ENC_PLAIN
+                && h.dict_encoding != ENC_PLAIN_DICT)
+                return PQ_E_UNSUPPORTED;
+            const uint8_t* pb = page_bytes(r, h, codec, scratch);
+            if (!pb) return PQ_E_CORRUPT;
+            if (!dict_raw.ensure(h.uncompressed_size)) return PQ_E_CORRUPT;
+            memcpy(dict_raw.buf, pb, (size_t)h.uncompressed_size);
+            // parse [len u32][bytes]... into offsets
+            pool_n = h.dict_num_values;
+            if (pool_n < 0) {
+                // count entries when the header omits the count
+                pool_n = 0;
+                const uint8_t* q = dict_raw.buf;
+                const uint8_t* qe = q + h.uncompressed_size;
+                while (q + 4 <= qe) {
+                    uint32_t l = (uint32_t)q[0] | ((uint32_t)q[1] << 8)
+                               | ((uint32_t)q[2] << 16)
+                               | ((uint32_t)q[3] << 24);
+                    q += 4 + l;
+                    if (q > qe) return PQ_E_CORRUPT;
+                    pool_n++;
+                }
+            }
+            if (!pool_off_s.ensure((pool_n + 1) * 4)) return PQ_E_CORRUPT;
+            pool_off = (int32_t*)pool_off_s.buf;
+            {
+                const uint8_t* q = dict_raw.buf;
+                const uint8_t* qe = q + h.uncompressed_size;
+                pool_off[0] = 0;
+                // compact the pool in place: strip the length prefixes
+                uint8_t* w = dict_raw.buf;
+                for (int64_t i = 0; i < pool_n; i++) {
+                    if (qe - q < 4) return PQ_E_CORRUPT;
+                    uint32_t l = (uint32_t)q[0] | ((uint32_t)q[1] << 8)
+                               | ((uint32_t)q[2] << 16)
+                               | ((uint32_t)q[3] << 24);
+                    q += 4;
+                    if (qe - q < (int64_t)l) return PQ_E_CORRUPT;
+                    memmove(w, q, l);
+                    w += l;
+                    q += l;
+                    pool_off[i + 1] = (int32_t)(w - dict_raw.buf);
+                }
+                pool_bytes = w - dict_raw.buf;
+                pool_data = dict_raw.buf;
+            }
+            continue;
+        }
+        if (h.type != 0) return PQ_E_UNSUPPORTED;
+        const uint8_t* pb = page_bytes(r, h, codec, scratch);
+        if (!pb) return PQ_E_CORRUPT;
+        const uint8_t* pend = pb + h.uncompressed_size;
+        int64_t n = h.num_values;
+        if (n < 0 || row + n > num_values) return PQ_E_CORRUPT;
+        int64_t defined = read_def_levels(pb, pend, max_def, n,
+                                          out_validity, row);
+        if (defined < 0) return PQ_E_CORRUPT;
+        bool page_dict = (h.encoding == ENC_RLE_DICT
+                          || h.encoding == ENC_PLAIN_DICT);
+        if (!page_dict && h.encoding != ENC_PLAIN) return PQ_E_UNSUPPORTED;
+
+        if (page_dict && all_dict) {
+            if (!pool_data) return PQ_E_CORRUPT;
+            // decode codes straight into out_codes
+            if (pend - pb < 1) return PQ_E_CORRUPT;
+            RleDecoder rd;
+            rd.bit_width = *pb++;
+            if (rd.bit_width > 32) return PQ_E_CORRUPT;
+            rd.r = Reader{pb, pend};
+            int64_t i = 0;
+            while (i < n) {
+                int64_t block = n - i < 4096 ? n - i : 4096;
+                int64_t nd = 0;
+                if (defined == n) nd = block;
+                else for (int64_t k = 0; k < block; k++)
+                    nd += out_validity[row + i + k];
+                if (!rd.get(idx_buf, nd)) return PQ_E_CORRUPT;
+                int64_t ci = 0;
+                for (int64_t k = 0; k < block; k++) {
+                    if (defined != n && !out_validity[row + i + k]) {
+                        out_codes[row + i + k] = (int32_t)pool_n;
+                        continue;
+                    }
+                    int32_t code = idx_buf[ci++];
+                    if (code < 0 || code >= pool_n) return PQ_E_CORRUPT;
+                    out_codes[row + i + k] = code;
+                }
+                i += block;
+            }
+            any_rows = true;
+            row += n;
+            continue;
+        }
+
+        // flat mode (PLAIN page, or a fallback page after dict pages).
+        // Offsets are int32 (the engine's columnar layout): a chunk whose
+        // flat bytes could pass 2GiB falls back to arrow, which splits —
+        // never truncate silently.
+        if (flat_pos + (int64_t)h.uncompressed_size > 0x7FFFFFFFLL)
+            return PQ_E_UNSUPPORTED;
+        if (all_dict && any_rows) {
+            // retroactively flatten the dict-coded prefix
+            int64_t need = 0;
+            for (int64_t i = 0; i < row; i++) {
+                int32_t c = out_codes[i];
+                if (c < pool_n) need += pool_off[c + 1] - pool_off[c];
+            }
+            if (need > 0x7FFFFFFFLL) return PQ_E_UNSUPPORTED;
+            if (need > out_data_cap) {
+                if (needed) *needed = need + (pend - pb) * 2 + (int64_t)1;
+                return PQ_E_GROW;
+            }
+            int64_t pos = 0;
+            out_offsets[0] = 0;
+            for (int64_t i = 0; i < row; i++) {
+                int32_t c = out_codes[i];
+                if (c < pool_n) {
+                    int32_t l = pool_off[c + 1] - pool_off[c];
+                    memcpy(out_data + pos, pool_data + pool_off[c],
+                           (size_t)l);
+                    pos += l;
+                }
+                out_offsets[i + 1] = (int32_t)pos;
+            }
+            flat_pos = pos;
+        }
+        all_dict = false;
+        if (row == 0) out_offsets[0] = 0;
+
+        if (page_dict) {
+            // dict-coded page in flat mode: gather through the pool
+            if (!pool_data || pend - pb < 1) return PQ_E_CORRUPT;
+            RleDecoder rd;
+            rd.bit_width = *pb++;
+            rd.r = Reader{pb, pend};
+            int64_t i = 0;
+            while (i < n) {
+                int64_t block = n - i < 4096 ? n - i : 4096;
+                int64_t nd = 0;
+                if (defined == n) nd = block;
+                else for (int64_t k = 0; k < block; k++)
+                    nd += out_validity[row + i + k];
+                if (!rd.get(idx_buf, nd)) return PQ_E_CORRUPT;
+                int64_t ci = 0;
+                for (int64_t k = 0; k < block; k++) {
+                    int64_t ri = row + i + k;
+                    if (defined != n && !out_validity[ri]) {
+                        out_offsets[ri + 1] = (int32_t)flat_pos;
+                        continue;
+                    }
+                    int32_t code = idx_buf[ci++];
+                    if (code < 0 || code >= pool_n) return PQ_E_CORRUPT;
+                    int32_t l = pool_off[code + 1] - pool_off[code];
+                    // dict gather expands beyond page bytes: re-check
+                    // the int32 offset ceiling per write
+                    if (flat_pos + (int64_t)l > 0x7FFFFFFFLL)
+                        return PQ_E_UNSUPPORTED;
+                    if (flat_pos + l > out_data_cap) {
+                        if (needed) *needed = (flat_pos + l) * 2
+                            + (num_values - ri) * 8;
+                        return PQ_E_GROW;
+                    }
+                    memcpy(out_data + flat_pos, pool_data + pool_off[code],
+                           (size_t)l);
+                    flat_pos += l;
+                    out_offsets[ri + 1] = (int32_t)flat_pos;
+                }
+                i += block;
+            }
+        } else {
+            // PLAIN page: [len u32][bytes]...
+            const uint8_t* q = pb;
+            for (int64_t i = 0; i < n; i++) {
+                int64_t ri = row + i;
+                if (defined != n && !out_validity[ri]) {
+                    out_offsets[ri + 1] = (int32_t)flat_pos;
+                    continue;
+                }
+                if (pend - q < 4) return PQ_E_CORRUPT;
+                uint32_t l = (uint32_t)q[0] | ((uint32_t)q[1] << 8)
+                           | ((uint32_t)q[2] << 16) | ((uint32_t)q[3] << 24);
+                q += 4;
+                if (pend - q < (int64_t)l) return PQ_E_CORRUPT;
+                if (flat_pos + (int64_t)l > out_data_cap) {
+                    if (needed) *needed = (flat_pos + l) * 2
+                        + (num_values - ri) * 8;
+                    return PQ_E_GROW;
+                }
+                memcpy(out_data + flat_pos, q, l);
+                q += l;
+                flat_pos += l;
+                out_offsets[ri + 1] = (int32_t)flat_pos;
+            }
+        }
+        any_rows = true;
+        row += n;
+    }
+    if (row != num_values) return PQ_E_CORRUPT;
+    if (all_dict && pool_data) {
+        // out_offsets holds num_values+1 slots; a pool with unreferenced
+        // extra entries beyond that can't be returned in dict form
+        if (pool_n > num_values) return PQ_E_UNSUPPORTED;
+        if (pool_bytes > out_data_cap) {
+            if (needed) *needed = pool_bytes;
+            return PQ_E_GROW;
+        }
+        memcpy(out_data, pool_data, (size_t)pool_bytes);
+        memcpy(out_offsets, pool_off, (size_t)((pool_n + 1) * 4));
+        *out_kind = 1;
+        return pool_n;
+    }
+    *out_kind = 0;
+    return flat_pos;
+}
+
+}  // extern "C"

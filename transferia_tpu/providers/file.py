@@ -79,6 +79,7 @@ class FileStorage(Storage, ShardingStorage, ScanPredicateStorage):
         self.table = TableID(params.namespace, params.table)
         self._schema: Optional[TableSchema] = None
         self._scan_predicates: dict[TableID, object] = {}
+        self._pred_fns: dict[TableID, object] = {}
         self._pruned_lock = threading.Lock()
         self.scan_rows_pruned = 0
 
@@ -238,6 +239,80 @@ class FileStorage(Storage, ShardingStorage, ScanPredicateStorage):
             kept.append(g)
         return kept
 
+    def _batch_filter(self, tid: TableID, batch: ColumnBatch
+                      ) -> ColumnBatch:
+        """Scan-predicate filter over an already-pivoted batch (native
+        decode path) — numpy compiler, same 3VL as the chain's filter."""
+        node = self._scan_predicates.get(tid)
+        if node is None or batch.n_rows == 0:
+            return batch
+        from transferia_tpu.predicate.compile import compile_mask
+
+        fn = self._pred_fns.get(tid)
+        if fn is None:
+            fn = compile_mask(node)
+            self._pred_fns[tid] = fn
+        keep = fn(batch)
+        if keep.all():
+            return batch
+        out = batch.filter(keep)
+        self._count_pruned(batch.n_rows - out.n_rows)
+        return out
+
+    def _has_huge_row_groups(self, pf, groups: list[int]) -> bool:
+        """Row groups too large to materialize whole per part thread
+        (externally-written files can carry ~1M-row groups): both the
+        native and arrow paths stream those through iter_batches."""
+        max_rg_rows = max(
+            pf.metadata.row_group(g).num_rows for g in groups)
+        return max_rg_rows > max(8 * self.params.batch_rows, 1 << 20)
+
+    def _load_groups_native(self, pf, path: str, groups: list[int],
+                            tid: TableID, schema: TableSchema,
+                            pusher: Pusher) -> bool:
+        """Decode row groups via the C++ chunk decoder; False -> use arrow."""
+        from transferia_tpu.providers.parquet_native import (
+            NativeParquetReader,
+            slice_columns,
+        )
+        from transferia_tpu.stats import stagetimer
+
+        if self._has_huge_row_groups(pf, groups):
+            return False  # stream huge row groups through arrow instead
+        reader = NativeParquetReader.open(path, pf, schema)
+        if reader is None:
+            return False
+        for g in groups:
+            with stagetimer.stage("source_decode"):
+                cols = reader.read_row_group(g)
+            n = pf.metadata.row_group(g).num_rows
+            for b_lo in range(0, n, self.params.batch_rows):
+                b_hi = min(b_lo + self.params.batch_rows, n)
+                with stagetimer.stage("pivot"):
+                    batch = ColumnBatch(
+                        tid, schema, slice_columns(cols, b_lo, b_hi))
+                    batch.read_bytes = batch.nbytes()
+                with stagetimer.stage("source_decode"):
+                    batch = self._batch_filter(tid, batch)
+                if batch.n_rows:
+                    pusher(batch)
+        return True
+
+    def _load_group_arrow(self, pf, g: int, tid: TableID,
+                          schema: TableSchema, pusher: Pusher) -> None:
+        from transferia_tpu.stats import stagetimer
+
+        with stagetimer.stage("source_decode"):
+            tbl = pf.read_row_group(g, use_threads=False)
+        for rb in tbl.to_batches(max_chunksize=self.params.batch_rows):
+            with stagetimer.stage("source_decode"):
+                rb = self._scan_filter(tid, rb)
+            if rb.num_rows:
+                with stagetimer.stage("pivot"):
+                    batch = ColumnBatch.from_arrow(rb, tid, schema)
+                    batch.read_bytes = rb.nbytes
+                pusher(batch)
+
     def _load_row_groups(self, path: str, lo: int, hi: int, tid: TableID,
                          schema: TableSchema, pusher: Pusher) -> None:
         import pyarrow.parquet as pq
@@ -248,20 +323,34 @@ class FileStorage(Storage, ShardingStorage, ScanPredicateStorage):
         groups = self._prune_row_groups(pf, list(range(lo, hi)), tid)
         if not groups:
             return
-        it = pf.iter_batches(batch_size=self.params.batch_rows,
-                             row_groups=groups)
-        while True:
-            with stagetimer.stage("source_decode"):
-                rb = next(it, None)
-                if rb is not None:
-                    rb = self._scan_filter(tid, rb)
-            if rb is None:
-                return
-            if rb.num_rows:
-                with stagetimer.stage("pivot"):
-                    batch = ColumnBatch.from_arrow(rb, tid, schema)
-                    batch.read_bytes = rb.nbytes
-                pusher(batch)
+        if self._load_groups_native(pf, path, groups, tid, schema,
+                                    pusher):
+            return
+        # whole-row-group reads beat iter_batches for dict-heavy files
+        # (one dictionary unification per group, not per batch) and the
+        # batch slices share dictionary buffers — which is what lets the
+        # columnar layer pool-cache them (batch.py _adopt_dict_pool).
+        # Externally-written files can carry huge row groups (pyarrow
+        # default ~1M rows); cap the per-part materialization and stream
+        # those through iter_batches instead.
+        if self._has_huge_row_groups(pf, groups):
+            it = pf.iter_batches(batch_size=self.params.batch_rows,
+                                 row_groups=groups)
+            while True:
+                with stagetimer.stage("source_decode"):
+                    rb = next(it, None)
+                    if rb is not None:
+                        rb = self._scan_filter(tid, rb)
+                if rb is None:
+                    return
+                if rb.num_rows:
+                    with stagetimer.stage("pivot"):
+                        batch = ColumnBatch.from_arrow(rb, tid, schema)
+                        batch.read_bytes = rb.nbytes
+                    pusher(batch)
+            return
+        for g in groups:
+            self._load_group_arrow(pf, g, tid, schema, pusher)
 
     def _load_file(self, path: str, tid: TableID, schema: TableSchema,
                    pusher: Pusher) -> None:
@@ -372,6 +461,12 @@ class FileSinker(Sinker):
                     self._out_path(tid, "parquet"), rb.schema
                 )
                 self._writers[tid] = w
+            if rb.schema != w.schema:
+                # encoding can vary per batch (dictionary-encoded row
+                # groups decode as dict, fallback/plain groups as flat
+                # strings) — cast to the writer's schema (arrow C++
+                # dict<->string casts) so one file stays one schema
+                rb = rb.cast(w.schema)
             w.write_batch(rb)
         elif self.params.format == "jsonl":
             path = os.path.join(

@@ -1,0 +1,255 @@
+"""Native parquet row-group reader: column chunks -> Columns directly.
+
+The snapshot north-star's host decode stage (reference methodology:
+docs/benchmarks.md rows/sec on ClickBench `hits`) is bound by parquet
+decode on a single core.  This reader pairs pyarrow's *metadata* (footer
+parsing, row-group/chunk layout, schema) with the C++ chunk decoder
+(native/parquetdec.cpp): snappy + PLAIN/RLE_DICTIONARY pages go straight
+into the engine's columnar layout — flat (data, offsets) buffers, or
+int32 codes + pool adopted as DictEnc with no dictionary unification or
+index materialization.  Anything outside the decoder's envelope
+(unsupported codec/encoding/type, nested columns, v2 pages) falls back to
+arrow per column, so the reader is never less capable than pyarrow.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+import numpy as np
+
+from transferia_tpu.abstract.schema import CanonicalType, TableSchema
+from transferia_tpu.columnar.batch import Column, DictEnc, DictPool
+
+logger = logging.getLogger(__name__)
+
+_CODECS = {"UNCOMPRESSED": 0, "SNAPPY": 1}
+_FIXED_WIDTH = {"INT32": 4, "INT64": 8, "FLOAT": 4, "DOUBLE": 8}
+
+# physical view dtype per canonical type for fixed-width reinterpretation
+_VIEW_DTYPES = {
+    CanonicalType.INT8: (4, np.int32),
+    CanonicalType.INT16: (4, np.int32),
+    CanonicalType.INT32: (4, np.int32),
+    CanonicalType.INT64: (8, np.int64),
+    CanonicalType.UINT8: (4, np.uint32),
+    CanonicalType.UINT16: (4, np.uint32),
+    CanonicalType.UINT32: (4, np.uint32),
+    CanonicalType.UINT64: (8, np.uint64),
+    CanonicalType.FLOAT: (4, np.float32),
+    CanonicalType.DOUBLE: (8, np.float64),
+    CanonicalType.DATE: (4, np.int32),
+    CanonicalType.DATETIME: (8, np.int64),
+    CanonicalType.TIMESTAMP: (8, np.int64),
+}
+
+
+class NativeParquetReader:
+    """Per-file reader; None from open() when the native lib is absent."""
+
+    def __init__(self, path: str, pf, schema: TableSchema, cdll):
+        self._pf = pf
+        self._meta = pf.metadata
+        self._schema = schema
+        self._cdll = cdll
+        self._mm = np.memmap(path, dtype=np.uint8, mode="r")
+        # column index by name (flat schemas only — nested fall back)
+        self._col_idx = {}
+        for i in range(self._meta.num_columns):
+            name = self._meta.row_group(0).column(i).path_in_schema
+            self._col_idx[name] = i
+        self._pq_schema = pf.schema
+        # arrow logical types (timestamp units etc.)
+        self._arrow_fields = {f.name: f for f in pf.schema_arrow}
+
+    @classmethod
+    def open(cls, path: str, pf,
+             schema: TableSchema) -> Optional["NativeParquetReader"]:
+        from transferia_tpu.native import lib as native_lib
+
+        import os
+
+        if os.environ.get("TRANSFERIA_TPU_NATIVE_PARQUET", "1") == "0":
+            return None
+        cdll = native_lib()
+        if cdll is None or not hasattr(cdll, "pq_decode_fixed"):
+            return None
+        if pf.metadata.num_row_groups == 0:
+            return None
+        try:
+            return cls(path, pf, schema, cdll)
+        except (OSError, ValueError):
+            return None
+
+    # -- per-column decode ---------------------------------------------------
+    def _chunk_range(self, col) -> tuple[int, int]:
+        start = col.data_page_offset
+        if (col.dictionary_page_offset is not None
+                and col.dictionary_page_offset >= 0):
+            start = min(start, col.dictionary_page_offset)
+        return start, col.total_compressed_size
+
+    def _decode_column(self, g: int, cs) -> Optional[Column]:
+        """Native decode of one column chunk; None -> caller falls back."""
+        idx = self._col_idx.get(cs.name)
+        if idx is None:
+            return None
+        col = self._meta.row_group(g).column(idx)
+        codec = _CODECS.get(col.compression)
+        if codec is None:
+            return None
+        sc = self._pq_schema.column(idx)
+        max_def = sc.max_definition_level
+        max_rep = sc.max_repetition_level
+        if max_rep != 0 or max_def > 1:
+            return None
+        n = col.num_values
+        start, length = self._chunk_range(col)
+        if start < 0 or start + length > len(self._mm):
+            return None
+        chunk = self._mm[start:start + length]
+        ptype = col.physical_type
+        validity = (np.empty(n, dtype=np.uint8) if max_def else None)
+        if ptype in _FIXED_WIDTH:
+            spec = _VIEW_DTYPES.get(cs.data_type)
+            if spec is None:
+                return None
+            width, view_dt = spec
+            if width != _FIXED_WIDTH[ptype]:
+                return None
+            out = np.empty(n * width, dtype=np.uint8)
+            rc = self._cdll.pq_decode_fixed(
+                np.ascontiguousarray(chunk), length, codec, width, n,
+                max_def, out.ctypes.data,
+                validity.ctypes.data if validity is not None else None)
+            if rc != n:
+                return None
+            vals = out.view(view_dt)
+            return self._finish_fixed(cs, vals, validity)
+        if ptype == "BYTE_ARRAY" and cs.data_type.is_variable_width:
+            return self._decode_bytearray(chunk, length, codec, n,
+                                          max_def, col, cs, validity)
+        return None
+
+    def _finish_fixed(self, cs, vals: np.ndarray,
+                      validity: Optional[np.ndarray]) -> Column:
+        v = None
+        if validity is not None and not validity.all():
+            v = validity.astype(np.bool_)
+        ct = cs.data_type
+        f = self._arrow_fields.get(cs.name)
+        if ct in (CanonicalType.DATETIME, CanonicalType.TIMESTAMP) \
+                and f is not None:
+            import pyarrow.types as pt
+
+            unit = f.type.unit if pt.is_timestamp(f.type) else "us"
+            vals = vals.astype(np.int64, copy=False)
+            if ct == CanonicalType.DATETIME:
+                div = {"s": 1, "ms": 1_000, "us": 1_000_000,
+                       "ns": 1_000_000_000}[unit]
+                vals = vals // div
+            else:
+                scale = {"s": 1_000_000, "ms": 1_000, "us": 1, "ns": 1}[unit]
+                vals = (vals * scale if unit in ("s", "ms")
+                        else vals // (1000 if unit == "ns" else 1))
+        elif ct.np_dtype != vals.dtype:
+            vals = vals.astype(ct.np_dtype)
+        return Column(cs.name, ct, np.ascontiguousarray(vals), None, v)
+
+    def _decode_bytearray(self, chunk, length, codec, n, max_def, col,
+                          cs, validity) -> Optional[Column]:
+        import ctypes
+
+        cap = max(col.total_uncompressed_size, 4096)
+        offsets = np.empty(n + 1, dtype=np.int32)
+        codes = np.empty(n, dtype=np.int32)
+        for _attempt in range(4):
+            data = np.empty(cap, dtype=np.uint8)
+            kind = ctypes.c_int32(-1)
+            needed = ctypes.c_int64(0)
+            rc = self._cdll.pq_decode_bytearray(
+                np.ascontiguousarray(chunk), length, codec, n, max_def,
+                data, cap, offsets, codes.ctypes.data,
+                validity.ctypes.data if validity is not None else None,
+                ctypes.byref(kind), ctypes.byref(needed))
+            if rc == -2:  # grow
+                cap = max(needed.value, cap * 2)
+                continue
+            if rc < 0:
+                return None
+            v = None
+            if validity is not None and not validity.all():
+                v = validity.astype(np.bool_)
+            if kind.value == 1:
+                # dict result: rc == n_pool; codes hold n_pool for nulls
+                n_pool = rc
+                pool_off = np.append(offsets[:n_pool + 1],
+                                     offsets[n_pool]).astype(np.int32)
+                pool_data = data[:offsets[n_pool]].copy()
+                dpool = DictPool(pool_data, pool_off, null_code=n_pool)
+                return Column(cs.name, cs.data_type, validity=v,
+                              dict_enc=DictEnc(codes, pool=dpool))
+            return Column(cs.name, cs.data_type, data[:rc].copy(),
+                          offsets, v)
+        return None
+
+    # -- public --------------------------------------------------------------
+    def read_row_group(self, g: int) -> dict[str, Column]:
+        """All schema columns for one row group.
+
+        Columns outside the native envelope (unsupported codec/encoding/
+        type, nested, >2GiB flat) are filled through an arrow read of just
+        those columns — the result is always complete."""
+        cols: dict[str, Column] = {}
+        fallback: list[str] = []
+        for cs in self._schema:
+            if cs.name not in self._col_idx:
+                continue
+            try:
+                c = self._decode_column(g, cs)
+            except Exception:  # corrupt chunk etc: arrow decides
+                logger.debug("native decode failed for %s", cs.name,
+                             exc_info=True)
+                c = None
+            if c is None:
+                fallback.append(cs.name)
+            else:
+                cols[cs.name] = c
+        if fallback:
+            from transferia_tpu.columnar.batch import _arrow_to_column
+
+            tbl = self._pf.read_row_group(g, columns=fallback,
+                                          use_threads=False)
+            by_name = {cs.name: cs for cs in self._schema}
+            for name in fallback:
+                arr = tbl.column(name).combine_chunks()
+                cols[name] = _arrow_to_column(by_name[name], arr)
+        return cols
+
+
+def slice_columns(cols: dict[str, Column], lo: int,
+                  hi: int) -> dict[str, Column]:
+    """Row-range views over decoded columns (no gathers).
+
+    Fixed-width slices are numpy views; var-width rebases offsets (small
+    copy); dictionary columns slice codes and share the pool — which is
+    what makes per-batch slicing of a decoded row group nearly free."""
+    out = {}
+    for name, c in cols.items():
+        validity = c.validity[lo:hi] if c.validity is not None else None
+        if c.is_lazy_dict:
+            out[name] = Column(
+                name, c.ctype, validity=validity,
+                dict_enc=DictEnc(c.dict_enc.indices[lo:hi],
+                                 pool=c.dict_enc.pool))
+        elif c.offsets is not None:
+            base = int(c.offsets[lo])
+            off = (c.offsets[lo:hi + 1] - base).astype(np.int32)
+            out[name] = Column(name, c.ctype,
+                               c.data[base:int(c.offsets[hi])], off,
+                               validity)
+        else:
+            out[name] = Column(name, c.ctype, c.data[lo:hi], None,
+                               validity)
+    return out

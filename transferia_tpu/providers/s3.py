@@ -259,6 +259,10 @@ class S3Sinker(Sinker):
                 self._writers[tid] = w
                 self._handles[tid] = fh
                 self._rows_in_file[tid] = 0
+            if rb.schema != w.schema:
+                # dict-encoded vs flat batches of one table (see the fs
+                # sink): cast to the file's schema
+                rb = rb.cast(w.schema)
             w.write_batch(rb)
             self._rows_in_file[tid] += batch.n_rows
             if self._rows_in_file[tid] >= self.params.max_rows_per_file:

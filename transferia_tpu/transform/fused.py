@@ -314,7 +314,10 @@ class DeviceFusedStep(Transformer):
         import time as _time
 
         from transferia_tpu.stats import stagetimer
-        from transferia_tpu.transform.plugins.mask import _host_hmac_hex
+        from transferia_tpu.transform.plugins.mask import (
+            _host_hmac_hex,
+            mask_dict_column,
+        )
 
         t0 = _time.perf_counter()
         cur = batch
@@ -326,6 +329,12 @@ class DeviceFusedStep(Transformer):
             cols = dict(cur.columns)
             for name, key in self.mask_entries:
                 col = cur.column(name)
+                if col.is_lazy_dict:
+                    # O(unique) hash: pool once, codes stay
+                    masked = mask_dict_column(key, col)
+                    if masked is not None:
+                        cols[name] = masked
+                        continue
                 data, offsets = _host_hmac_hex(
                     key, col.data, col.offsets, col.validity)
                 cols[name] = Column(name, CanonicalType.UTF8, data,

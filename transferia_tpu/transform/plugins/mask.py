@@ -20,7 +20,13 @@ from transferia_tpu.abstract.schema import (
     TableID,
     TableSchema,
 )
-from transferia_tpu.columnar.batch import Column, ColumnBatch, _offsets_from_lengths
+from transferia_tpu.columnar.batch import (
+    Column,
+    ColumnBatch,
+    DictEnc,
+    DictPool,
+    _offsets_from_lengths,
+)
 from transferia_tpu.transform.base import TransformResult, Transformer
 from transferia_tpu.transform.registry import register_transformer
 
@@ -105,6 +111,56 @@ def _native_hmac_hex(key: bytes, data: np.ndarray, offsets: np.ndarray,
     return hex_to_varwidth(out_hex, validity)
 
 
+def mask_dict_column(key: bytes, col: Column) -> Optional[Column]:
+    """HMAC a dictionary-encoded column by hashing its value POOL once and
+    keeping the row codes — O(unique) hash instead of O(rows), and the
+    hashed pool memoizes on the shared DictPool so batches slicing the
+    same dictionary hash it exactly once.  Output bytes are identical to
+    the flat path: valid rows get the 64-char hex of their value; null
+    rows get empty bytes (the pool's null sentinel hexes to empty, or an
+    appended entry when the pool carries no sentinel).  Returns None when
+    the pool is so much larger than the batch that flat row hashing is
+    cheaper (no memo hit and n_values >> n_rows)."""
+    enc = col.dict_enc
+    pool = enc.pool
+    memo_key = ("hmac_hex", key)
+    hexed = pool.memo_get(memo_key)
+    if hexed is None:
+        # a pool bigger than ~2 batches of rows won't pay for itself
+        # unless it is shared (then the memo amortizes it — but we can't
+        # know the future; 2x covers the filtered-batch case)
+        if pool.n_values > 2 * max(col.n_rows, 1):
+            return None
+        pool_hex, pool_hex_off = _host_hmac_hex(
+            key, pool.values_data, pool.values_offsets, None)
+        if pool.null_code is not None:
+            # sentinel hexes to empty bytes, not HMAC("")
+            lens = np.diff(pool_hex_off).astype(np.int64)
+            lens[pool.null_code] = 0
+            new_off = _offsets_from_lengths(lens)
+            keep_mask = np.ones(len(pool_hex), dtype=bool)
+            s, e = (int(pool_hex_off[pool.null_code]),
+                    int(pool_hex_off[pool.null_code + 1]))
+            keep_mask[s:e] = False
+            pool_hex = pool_hex[keep_mask]
+            pool_hex_off = new_off
+        hexed = DictPool(pool_hex, pool_hex_off,
+                         null_code=pool.null_code)
+        pool.memo_set(memo_key, hexed)
+    codes = enc.indices
+    if (hexed.null_code is None and col.validity is not None
+            and not col.validity.all()):
+        # manually-built pool without a sentinel: append one now
+        data = hexed.values_data
+        off = np.append(hexed.values_offsets,
+                        hexed.values_offsets[-1]).astype(np.int32)
+        hexed = DictPool(data, off, null_code=hexed.n_values)
+        codes = np.where(col.validity, codes,
+                         hexed.null_code).astype(np.int32)
+    return Column(col.name, CanonicalType.UTF8, validity=col.validity,
+                  dict_enc=DictEnc(codes, pool=hexed))
+
+
 @register_transformer("mask_field")
 class MaskField(Transformer):
     """Replace column values with HMAC-SHA256(salt, value) hex digests.
@@ -138,6 +194,10 @@ class MaskField(Transformer):
         })
 
     def _mask_column(self, col: Column) -> Column:
+        if col.is_lazy_dict and _hash_backend is None:
+            out = mask_dict_column(self.key, col)
+            if out is not None:
+                return out
         if col.offsets is None:
             # stringify fixed-width values, then hash
             strs = [

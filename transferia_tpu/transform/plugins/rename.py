@@ -59,12 +59,10 @@ class RenameColumns(Transformer):
         return schema.rename(self.columns)
 
     def apply(self, batch: ColumnBatch) -> TransformResult:
-        from dataclasses import replace
-
         cols = {}
         for name, col in batch.columns.items():
             new = self.columns.get(name, name)
-            cols[new] = replace(col, name=new) if new != name else col
+            cols[new] = col.renamed(new) if new != name else col
         return TransformResult(
             batch.with_columns(cols, self.result_schema(batch.schema))
         )
