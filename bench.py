@@ -479,6 +479,66 @@ def measure_device_kernel(rows: int = 1 << 20) -> Optional[dict]:
     }
 
 
+def measure_mesh_1dev(rows: int = 1 << 17) -> Optional[dict]:
+    """ShardedFusedProgram on a 1-device mesh on the REAL chip, vs the
+    plain fused device program on the same inputs.
+
+    The mesh path's correctness is pinned on the virtual CPU mesh
+    (tests + dryrun_multichip); this line gives it hardware execution
+    evidence and quantifies the mesh wrapper's overhead at N=1 — the
+    delta an operator pays to run the multichip-shaped program before
+    adding chips.
+    """
+    import jax
+
+    if jax.default_backend() == "cpu":
+        return None
+    from transferia_tpu.ops.fused import FusedMaskFilterProgram
+    from transferia_tpu.parallel.fusedmesh import ShardedFusedProgram
+    from transferia_tpu.predicate.parser import parse as pred_parse
+
+    rng = np.random.default_rng(21)
+    urls = np.char.add("https://example-",
+                       rng.integers(0, 997, rows).astype("U4"))
+    flat = "".join(urls.tolist()).encode()
+    lens = np.array([len(u) for u in urls], dtype=np.int64)
+    offsets = np.zeros(rows + 1, dtype=np.int32)
+    np.cumsum(lens, out=offsets[1:])
+    data = np.frombuffer(flat, dtype=np.uint8)
+    region = rng.integers(0, 500, rows).astype(np.int32)
+    node = pred_parse("RegionID < 400")
+    mask_cols = [(data, offsets)]
+    pred_cols = {"RegionID": (region, None)}
+
+    def timed(program):
+        program.run(mask_cols, pred_cols, rows)  # compile + warm
+        t0 = time.perf_counter()
+        iters = 3
+        for _ in range(iters):
+            out = program.run(mask_cols, pred_cols, rows)
+        dt = (time.perf_counter() - t0) / iters
+        return dt, out
+
+    plain_s, _ = timed(FusedMaskFilterProgram([b"bench-salt"], node))
+    sharded = ShardedFusedProgram([b"bench-salt"], node)
+    mesh_s, (hexes, keep) = timed(sharded)
+    kept = int(keep.sum()) if keep is not None else rows
+    if sharded.last_kept != kept:
+        raise AssertionError(
+            f"mesh psum kept {sharded.last_kept} != host keep {kept}")
+    return {
+        "metric": "mesh1_fused_ms_per_batch",
+        "unit": "ms",
+        "value": round(mesh_s * 1000, 2),
+        "plain_device_ms": round(plain_s * 1000, 2),
+        "mesh_overhead_pct": round(100 * (mesh_s - plain_s)
+                                   / max(plain_s, 1e-9), 1),
+        "rows": rows,
+        "devices": sharded.n_dev,
+        "kept": kept,
+    }
+
+
 def measure_fingerprint(n_batches: int = 15) -> Optional[dict]:
     """Checksum-fingerprint throughput over the ClickBench batches.
 
@@ -702,6 +762,238 @@ def measure_kafka2ch(n_partitions: int = 16,
         ch.stop()
 
 
+_bench_lambda_jit = {}
+
+
+def bench_lambda(arrays: dict) -> dict:
+    """User lambda for the SR fan-in config: a jax.jit columns transform
+    (sign-flip ids outside the region window) — the `lambda` transformer
+    resolves it by "bench:bench_lambda"."""
+    import jax
+    import jax.numpy as jnp
+
+    fn = _bench_lambda_jit.get("fn")
+    if fn is None:
+        fn = jax.jit(lambda ids, region:
+                     jnp.where(region < 400, ids, -ids))
+        _bench_lambda_jit["fn"] = fn
+    return {"id": np.asarray(fn(arrays["id"], arrays["region"]))}
+
+
+def measure_pg2ch(rows: int = 300_000) -> dict:
+    """BASELINE pg2ch config: PG COPY snapshot -> SQL-predicate
+    transformer -> ClickHouse sink, through the real activate path
+    against the in-repo fake wire servers."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tests.recipes.fake_clickhouse import FakeCH
+    from tests.recipes.fake_postgres import FakePG, FakeTable
+    from transferia_tpu.coordinator import MemoryCoordinator
+    from transferia_tpu.models import Transfer
+    from transferia_tpu.providers.clickhouse import CHTargetParams
+    from transferia_tpu.providers.postgres import PGSourceParams
+    from transferia_tpu.tasks import activate_delivery
+
+    pg = FakePG().start()
+    ch = FakeCH().start()
+    try:
+        pg.add_table(FakeTable(
+            "public", "hits",
+            [("id", "bigint", True, True),
+             ("url", "text", False, False),
+             ("region", "integer", False, False),
+             ("score", "double precision", False, False)],
+            [{"id": str(i), "url": f"https://e.test/{i % 997}",
+              "region": str(i % 500), "score": f"{(i % 91) * 1.5}"}
+             for i in range(rows)],
+        ))
+        t = Transfer(
+            id="bench-pg2ch",
+            src=PGSourceParams(host="127.0.0.1", port=pg.port,
+                               database="db", user="u"),
+            dst=CHTargetParams(host="127.0.0.1", port=ch.port,
+                               bufferer=None),
+            transformation={"transformers": [
+                {"filter_rows": {
+                    "filter": "region < 400 AND score >= 10"}},
+            ]},
+        )
+        t0 = time.perf_counter()
+        activate_delivery(t, MemoryCoordinator())
+        dt = time.perf_counter() - t0
+        got = sum(len(tb["rows"]) for tb in ch.tables.values())
+        expected = sum(1 for i in range(rows)
+                       if i % 500 < 400 and (i % 91) * 1.5 >= 10)
+        if got != expected:
+            raise AssertionError(f"pg2ch row loss: {got} != {expected}")
+        return {"metric": "pg2ch_snapshot_rows_per_sec",
+                "value": round(rows / dt), "unit": "rows/sec",
+                "rows": rows, "sink_rows": got,
+                "seconds": round(dt, 2)}
+    finally:
+        pg.stop()
+        ch.stop()
+
+
+def measure_mysql2kafka(rows: int = 200_000,
+                        n_partitions: int = 16) -> dict:
+    """BASELINE mysql2kafka config: MySQL snapshot -> PII mask ->
+    Debezium-envelope serializer -> partitioned Kafka producer across 16
+    partitions (the CDC envelope path at snapshot volume; binlog-tail
+    latency is covered by the replication e2e suite)."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tests.recipes.fake_kafka import FakeKafka
+    from tests.recipes.fake_mysql import FakeMySQL, FakeMyTable
+    from transferia_tpu.coordinator import MemoryCoordinator
+    from transferia_tpu.models import Transfer
+    from transferia_tpu.providers.kafka.provider import KafkaTargetParams
+    from transferia_tpu.providers.mysql import MySQLSourceParams
+    from transferia_tpu.tasks import activate_delivery
+
+    my = FakeMySQL().start()
+    kf = FakeKafka(n_partitions=n_partitions).start()
+    try:
+        my.add_table(FakeMyTable(
+            "db", "users",
+            [("id", "bigint", "bigint", True, True),
+             ("email", "varchar", "varchar(255)", False, False),
+             ("region", "int", "int", False, False)],
+            [{"id": i, "email": f"user{i}@example.test",
+              "region": i % 500} for i in range(rows)],
+        ))
+        t = Transfer(
+            id="bench-my2kf",
+            src=MySQLSourceParams(host="127.0.0.1", port=my.port,
+                                  database="db", user="root"),
+            dst=KafkaTargetParams(
+                brokers=[f"127.0.0.1:{kf.port}"], topic="cdc",
+                serializer="debezium"),
+            transformation={"transformers": [
+                {"mask_field": {"columns": ["email"],
+                                "salt": "bench"}},
+            ]},
+        )
+        t0 = time.perf_counter()
+        activate_delivery(t, MemoryCoordinator())
+        dt = time.perf_counter() - t0
+        got = sum(len(p) for p in kf.topics.get("cdc", []))
+        if got != rows:
+            raise AssertionError(f"mysql2kafka row loss: {got} != {rows}")
+        return {"metric": "mysql2kafka_debezium_rows_per_sec",
+                "value": round(rows / dt), "unit": "rows/sec",
+                "rows": rows, "partitions": n_partitions,
+                "seconds": round(dt, 2)}
+    finally:
+        my.stop()
+        kf.stop()
+
+
+def measure_kafka_sr2ch(n_partitions: int = 64,
+                        msgs_per_partition: int = 1200) -> dict:
+    """BASELINE Kafka+Confluent-SR -> CH config: 64-partition fan-in of
+    confluent-wire AVRO records resolved through the fake schema
+    registry, a user jax.jit lambda transformer, ClickHouse sink."""
+    import threading
+
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    from tests.recipes.fake_clickhouse import FakeCH
+    from tests.recipes.fake_kafka import FakeKafka
+    from tests.recipes.fake_sr import FakeSchemaRegistry
+    from transferia_tpu.coordinator import MemoryCoordinator
+    from transferia_tpu.models import Transfer, TransferType
+    from transferia_tpu.providers.clickhouse import CHTargetParams
+    from transferia_tpu.providers.kafka.client import KafkaClient, Record
+    from transferia_tpu.providers.kafka.provider import KafkaSourceParams
+    from transferia_tpu.runtime.local import run_replication
+
+    def zz(n: int) -> bytes:
+        u = (n << 1) ^ (n >> 63) if n < 0 else (n << 1)
+        out = bytearray()
+        while True:
+            b = u & 0x7F
+            u >>= 7
+            out.append(b | (0x80 if u else 0))
+            if not u:
+                return bytes(out)
+
+    schema_json = json.dumps({
+        "type": "record", "name": "Hit", "fields": [
+            {"name": "id", "type": "long"},
+            {"name": "url", "type": "string"},
+            {"name": "region", "type": "int"},
+        ]})
+    sr = FakeSchemaRegistry().start()
+    srv = FakeKafka(n_partitions=n_partitions).start()
+    ch = FakeCH().start()
+    try:
+        import urllib.request
+
+        req = urllib.request.Request(
+            sr.url + "/subjects/hits-value/versions",
+            data=json.dumps({"schema": schema_json}).encode(),
+            headers={"Content-Type":
+                     "application/vnd.schemaregistry.v1+json"})
+        sid = json.loads(urllib.request.urlopen(req,
+                                                timeout=10).read())["id"]
+        seed = KafkaClient([f"127.0.0.1:{srv.port}"])
+        srv.create_topic("hits")
+        header = b"\x00" + sid.to_bytes(4, "big")
+        for p in range(n_partitions):
+            recs = []
+            for i in range(msgs_per_partition):
+                rid = p * msgs_per_partition + i
+                url = f"https://e.test/{rid % 997}".encode()
+                body = (zz(rid) + zz(len(url)) + url
+                        + zz(rid % 500))
+                recs.append(Record(key=b"", value=header + body))
+            seed.produce("hits", p, recs)
+        seed.close()
+        t = Transfer(
+            id="bench-sr2ch", type=TransferType.INCREMENT_ONLY,
+            src=KafkaSourceParams(
+                brokers=[f"127.0.0.1:{srv.port}"], topic="hits",
+                parallelism=4,
+                parser={"confluent_schema_registry": {
+                    "registry_url": sr.url, "table": "hits"}},
+            ),
+            dst=CHTargetParams(host="127.0.0.1", port=ch.port,
+                               bufferer=None),
+            transformation={"transformers": [
+                # user lambda as a jax.jit program (bench_lambda below)
+                {"lambda": {"function": "bench:bench_lambda"}},
+            ]},
+        )
+        expected = n_partitions * msgs_per_partition
+        cp = MemoryCoordinator()
+        stop = threading.Event()
+        th = threading.Thread(
+            target=run_replication, args=(t, cp),
+            kwargs={"stop_event": stop, "backoff": 0.2}, daemon=True)
+        t0 = time.perf_counter()
+        th.start()
+
+        def ch_rows():
+            return sum(len(tb["rows"]) for tb in ch.tables.values())
+
+        deadline = time.monotonic() + 180
+        while ch_rows() < expected and time.monotonic() < deadline:
+            time.sleep(0.05)
+        dt = time.perf_counter() - t0
+        stop.set()
+        th.join(timeout=10)
+        got = ch_rows()
+        if got != expected:
+            raise AssertionError(
+                f"kafka-sr2ch row loss: {got} != {expected}")
+        return {"metric": "kafka_sr64_2ch_rows_per_sec",
+                "value": round(got / dt), "unit": "rows/sec",
+                "rows": got, "partitions": n_partitions,
+                "seconds": round(dt, 2)}
+    finally:
+        sr.stop()
+        srv.stop()
+        ch.stop()
+
+
 def main() -> None:
     from transferia_tpu.stats import stagetimer
 
@@ -737,9 +1029,12 @@ def main() -> None:
 
     # headline: the ClickBench-shaped wide dataset (~70 cols) — the shape
     # the 10M rows/s target is defined on (reference docs/benchmarks.md)
+    from transferia_tpu.stats.profiler import profile as cpu_profile
+
     stagetimer.enable(True)
     stagetimer.reset()
-    rows, dt = run_pipeline(parquet=WIDE_PARQUET, total_rows=WIDE_ROWS)
+    with cpu_profile() as prof:
+        rows, dt = run_pipeline(parquet=WIDE_PARQUET, total_rows=WIDE_ROWS)
     stage_note = stagetimer.format_breakdown(dt)
     rps = rows / dt
     # continuity line: the r01-r03 10-col dataset (own warmup so its
@@ -779,6 +1074,9 @@ def main() -> None:
           file=sys.stderr)
     if stage_note:
         print(f"# stages: {stage_note}", file=sys.stderr)
+    if prof.report is not None and prof.report.samples:
+        for line in prof.report.format(10).splitlines():
+            print(f"# profile: {line}", file=sys.stderr)
     try:
         from transferia_tpu.ops.linkprobe import probe_link
 
@@ -796,6 +1094,13 @@ def main() -> None:
         except Exception as e:
             print(f"# device kernel bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
+        try:
+            mesh1 = measure_mesh_1dev()
+            if mesh1:
+                print(f"# {json.dumps(mesh1)}", file=sys.stderr)
+        except Exception as e:
+            print(f"# mesh 1-dev bench failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
     try:
         fprint = measure_fingerprint()
         if fprint:
@@ -805,16 +1110,29 @@ def main() -> None:
     except Exception as e:
         print(f"# fingerprint bench failed: {type(e).__name__}: {e}",
               file=sys.stderr)
-    # second BASELINE config: Kafka->CH replication-path latency
+    # remaining BASELINE configs (each prints one tail line; failures
+    # never mask the headline, which already printed)
     if os.environ.get("BENCH_SKIP_KAFKA2CH") != "1":
         try:
             k2ch = measure_kafka2ch()
             if fallback:
                 k2ch["fallback"] = fallback
             print(f"# {json.dumps(k2ch)}", file=sys.stderr)
-        except Exception as e:  # the headline metric already printed
+        except Exception as e:
             print(f"# kafka2ch bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
+    if os.environ.get("BENCH_SKIP_CONFIGS") != "1":
+        for name, fn in (("pg2ch", measure_pg2ch),
+                         ("mysql2kafka", measure_mysql2kafka),
+                         ("kafka_sr64", measure_kafka_sr2ch)):
+            try:
+                out = fn()
+                if fallback:
+                    out["fallback"] = fallback
+                print(f"# {json.dumps(out)}", file=sys.stderr)
+            except Exception as e:
+                print(f"# {name} bench failed: "
+                      f"{type(e).__name__}: {e}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -190,3 +190,48 @@ dst:
     out = capsys.readouterr().out
     assert rc == 0, out
     assert "OK" in out
+
+
+def test_fingerprint_representation_drift_downgraded(farm):
+    """Exact-representation fingerprint drift with zero row-level
+    differences (float differs past the 12th significant digit) must not
+    fail the table — it is reported as a note, not a mismatch."""
+    pg, ch = farm
+    src, dst = _storages(pg, ch)
+    row = ch.tables["public__users"]["rows"][50]
+    original = row["score"]
+    assert float(original) == 75.0
+    row["score"] = "75.0000000000001"  # tolerant comparators: equal
+    try:
+        report = compare_checksum(
+            src, dst,
+            params=ChecksumParameters(method="fingerprint",
+                                      keyset_chunk=64),
+            equal_data_types=heterogeneous_data_types)
+    finally:
+        row["score"] = original
+    tc = report.tables[0]
+    assert report.ok, report.summary()
+    assert tc.notes and "representation-only" in tc.notes[0]
+    assert "fingerprints differ" in tc.notes[0]
+
+
+def test_fingerprint_real_mismatch_still_fails(farm):
+    pg, ch = farm
+    src, dst = _storages(pg, ch)
+    row = ch.tables["public__users"]["rows"][51]
+    original = row["name"]
+    row["name"] = "really-different"
+    try:
+        report = compare_checksum(
+            src, dst,
+            params=ChecksumParameters(method="fingerprint",
+                                      keyset_chunk=64),
+            equal_data_types=heterogeneous_data_types)
+    finally:
+        row["name"] = original
+    tc = report.tables[0]
+    assert not report.ok
+    assert any("name" in m for m in tc.mismatches)
+    # the fingerprint line stays a mismatch when rows actually differ
+    assert any("fingerprints differ" in m for m in tc.mismatches)

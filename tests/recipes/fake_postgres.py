@@ -463,12 +463,26 @@ class _Session:
         inner = re.search(r"COPY \((.*)\) TO STDOUT", sql, re.S)
         cols, rows = self._eval_select(inner.group(1) if inner else sql)
         self.send(b"H", struct.pack("!bh", 0, 0))
+        # frame rows in bulk: per-row sendall would cap the fake at far
+        # below what the client under test can ingest (bench runs pump
+        # hundreds of thousands of rows through this path)
+        buf = bytearray()
         for row in rows:
-            out = io.StringIO()
-            csv.writer(out, lineterminator="\n").writerow(
-                ["" if row.get(c) is None else row.get(c) for c in cols]
-            )
-            self.send(b"d", out.getvalue().encode())
+            vals = ["" if row.get(c) is None else str(row.get(c))
+                    for c in cols]
+            if any('"' in v or "," in v or "\n" in v or "\r" in v
+                   for v in vals):
+                out = io.StringIO()
+                csv.writer(out, lineterminator="\n").writerow(vals)
+                payload = out.getvalue().encode()
+            else:
+                payload = (",".join(vals) + "\n").encode()
+            buf += b"d" + struct.pack("!I", len(payload) + 4) + payload
+            if len(buf) >= 1 << 18:
+                self.sock.sendall(buf)
+                buf.clear()
+        if buf:
+            self.sock.sendall(buf)
         self.send(b"c")
         self.send(b"C", b"COPY\x00")
 

@@ -33,7 +33,7 @@ TID = TableID("sample", "users")
 
 def _run_snapshot(sid: str, rows: int = 600, process_count: int = 4,
                   transformation=None) -> MemoryCoordinator:
-    batches = [make_batch("users", TID, lo, min(lo + 150, rows), seed=5)
+    batches = [make_batch("users", TID, lo, min(150, rows - lo), seed=5)
                for lo in range(0, rows, 150)]
     seed_source(sid, batches)
     t = Transfer(
@@ -103,3 +103,20 @@ def test_no_validation_no_fingerprints():
 def test_digest_parse_roundtrip():
     a = FingerprintAggregate(sum1=1, sum2=2, xor1=3, xor2=4, count=99)
     assert FingerprintAggregate.parse(a.digest()) == a
+
+
+def test_rename_chain_publishes_under_output_table():
+    """A renaming transform must publish the digest under the OUTPUT
+    table's name — `checksum --against-operation` looks tables up by
+    what the snapshot wrote, not by the source name."""
+    cp = _run_snapshot("fpval4", transformation={"transformers": [
+        {"rename_tables": {"tables": [
+            {"from": "sample.users", "to": "sample.people"}]}},
+    ]})
+    state = cp.get_operation_state("op-fpval4")
+    digests = state["table_fingerprints"]
+    out_fqtn = TableID("sample", "people").fqtn()
+    assert out_fqtn in digests
+    assert TID.fqtn() not in digests
+    count = int(digests[out_fqtn].rsplit(":", 1)[1])
+    assert count == 600

@@ -71,3 +71,37 @@ def test_mixed_table_row_batch_through_chain():
     out = chain.apply(items)  # must not raise on mixed tables
     tables = sorted(it.table_id.name for it in out)
     assert tables == ["t2", "u"]
+
+
+def test_in_with_null_literal():
+    # SQL: x IN (v, NULL) is TRUE on match, UNKNOWN otherwise;
+    # x NOT IN (v, NULL) is FALSE on match, UNKNOWN otherwise
+    assert mask("name IN ('alpha', NULL)") == [False, True, False]
+    assert mask("name NOT IN ('alpha', NULL)") == [False, False, False]
+
+
+def test_arrow_eval_matches_numpy_for_in_lists():
+    """Pushdown parity: the arrow evaluator's kept set must equal the
+    numpy compiler's for every IN/NOT IN variant, incl. NULL literals
+    (the advisory scan filter would otherwise keep rows the chain
+    drops, silently defeating pruning accounting)."""
+    import pyarrow as pa
+
+    from transferia_tpu.predicate.arroweval import eval_mask
+
+    rb = pa.RecordBatch.from_arrays(
+        [pa.array([1, 2, 3], type=pa.int64()),
+         pa.array([None, "alpha", "beta"], type=pa.string()),
+         pa.array([None, 1.0, 2.0])],
+        names=["id", "name", "x"])
+    for text in ("name IN ('alpha')",
+                 "name NOT IN ('alpha')",
+                 "name IN ('alpha', NULL)",
+                 "name NOT IN ('alpha', NULL)",
+                 "name IN (NULL)",
+                 "name NOT IN (NULL)"):
+        want = mask(text)
+        m = eval_mask(parse(text), rb)
+        assert m is not None, text
+        got = [bool(v.as_py()) if v.is_valid else False for v in m]
+        assert got == want, (text, got, want)

@@ -1,0 +1,61 @@
+"""Sampling CPU profiler (stats/profiler.py) + /debug/profile endpoint.
+
+Reference parity: always-on pprof on the health port
+(cmd/trcli/main.go:62-64); the perf methodology depends on it
+(docs/benchmarks.md:44-60).
+"""
+
+import time
+import urllib.request
+
+from transferia_tpu.stats.profiler import Sampler, profile, sample_seconds
+
+
+def _burn(deadline):
+    x = 0
+    while time.perf_counter() < deadline:
+        for i in range(2000):
+            x += i * i
+    return x
+
+
+def test_sampler_attributes_hot_function():
+    with profile(hz=250) as p:
+        _burn(time.perf_counter() + 0.4)
+    rep = p.report
+    assert rep.samples > 20
+    top = rep.top(5)
+    assert top, "no samples collected"
+    assert any("_burn" in loc for loc, _, _ in top), top
+    # self seconds sum to ~wall for single-threaded work
+    assert 0.1 < sum(s for _, s, _ in rep.top(100)) <= rep.seconds + 0.1
+
+
+def test_format_renders_table():
+    with profile(hz=250) as p:
+        _burn(time.perf_counter() + 0.2)
+    text = p.report.format(5)
+    assert "self" in text and "location" in text
+    assert "Hz" in text
+
+
+def test_sample_seconds_caps():
+    rep = sample_seconds(0.1, hz=200)
+    assert rep.seconds < 1.0
+
+
+def test_debug_profile_endpoint():
+    import threading
+
+    from transferia_tpu.cli.main import _start_health_server
+
+    port = _start_health_server(0)
+    stop = time.perf_counter() + 1.5
+    th = threading.Thread(target=_burn, args=(stop,), daemon=True)
+    th.start()
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/debug/profile?seconds=0.4",
+        timeout=10).read().decode()
+    th.join()
+    assert "location" in body
+    assert "_burn" in body

@@ -158,13 +158,30 @@ def _setup(args) -> None:
     apply_resource_limits()
 
 
-def _start_health_server(port: int) -> None:
-    """Minimal /health endpoint (pkg/serverutil healthcheck)."""
+def _start_health_server(port: int) -> int:
+    """Minimal /health endpoint (pkg/serverutil healthcheck).
+
+    Returns the bound port (port=0 binds an ephemeral one — tests)."""
     import http.server
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):
-            if self.path == "/debug/threads":
+            if self.path.startswith("/debug/profile"):
+                # sampling CPU profile (reference: always-on pprof,
+                # cmd/trcli/main.go:62-64); ?seconds=N caps at 60
+                from urllib.parse import parse_qs, urlparse
+
+                from transferia_tpu.stats.profiler import sample_seconds
+
+                q = parse_qs(urlparse(self.path).query)
+                try:
+                    secs = float(q.get("seconds", ["5"])[0])
+                except ValueError:
+                    secs = 5.0
+                body = sample_seconds(secs).format(30).encode()
+                ctype = "text/plain"
+                status = 200
+            elif self.path == "/debug/threads":
                 # pprof-style stack dump (reference serves pprof on :8080)
                 import traceback
 
@@ -194,6 +211,7 @@ def _start_health_server(port: int) -> None:
 
     srv = http.server.ThreadingHTTPServer(("0.0.0.0", port), Handler)
     threading.Thread(target=srv.serve_forever, daemon=True).start()
+    return srv.server_address[1]
 
 
 def _coordinator(args):

@@ -422,27 +422,39 @@ class TableFingerprinter:
     profitable whenever H2D keeps up and batches amortize the launch.
     """
 
+    # re-evaluate the backend decision periodically: links drift, and a
+    # decision pinned off one skewed sample would fix a bad backend for
+    # a whole table scan (same policy as DeviceFusedStep.REPROBE_EVERY)
+    REPROBE_EVERY = 256
+
     def __init__(self, backend: str = "auto"):
         self.backend = backend
         self._agg = FingerprintAggregate()
         self._device: Optional[DeviceFingerprintProgram] = None
         self._host_ns_row = -1.0
+        self._host_samples = 0
+        self._batch_no = 0
         self._decided: Optional[str] = None
 
-    def _device_available(self) -> bool:
+    def _accel_available(self) -> bool:
+        """A device backend only pays when it is a real accelerator —
+        jax-on-CPU shares the host cores and adds jit overhead."""
         try:
-            import jax  # noqa: F401
+            import jax
 
-            return True
-        except ImportError:
+            return jax.default_backend() not in ("cpu",)
+        except Exception:
             return False
 
     def _choose(self, n_rows: int, row_bytes: int) -> str:
         if self.backend in ("host", "device"):
             return self.backend
-        if self._decided is not None:
+        if (self._decided is not None
+                and self._batch_no % self.REPROBE_EVERY != 0):
             return self._decided
-        if self._host_ns_row < 0 or not self._device_available():
+        # need >=2 host samples: the first carries one-off warmup (native
+        # lib build, cold caches) and is never recorded
+        if self._host_samples < 2 or not self._accel_available():
             return "host"
         from transferia_tpu.ops.linkprobe import probe_link
 
@@ -463,6 +475,7 @@ class TableFingerprinter:
         cols, n = prep_batch(batch)
         row_bytes = sum(
             (c.width if c.kind == "var" else 8) for c in cols)
+        self._batch_no += 1
         choice = self._choose(n, row_bytes)
         if choice == "device":
             if self._device is None:
@@ -472,6 +485,9 @@ class TableFingerprinter:
         t0 = _time.perf_counter()
         self._agg.merge(fingerprint_host(cols, n))
         ns = (_time.perf_counter() - t0) * 1e9 / n
+        self._host_samples += 1
+        if self._host_samples == 1:
+            return  # warmup-contaminated: measure, don't record
         self._host_ns_row = (ns if self._host_ns_row < 0
                              else 0.7 * self._host_ns_row + 0.3 * ns)
 

@@ -72,7 +72,15 @@ def _eval(node: Node, rb):
         return ops[node.op](c, pa.scalar(v))
     if isinstance(node, InList):
         c = col(node.column)
-        mask = pc.is_in(c, value_set=pa.array(list(node.values)))
+        non_null = [v for v in node.values if v is not None]
+        mask = pc.is_in(c, value_set=pa.array(non_null, type=c.type))
+        if len(non_null) != len(node.values):
+            # SQL: a NULL literal in the list makes every non-match
+            # UNKNOWN (x != NULL is unknown), not FALSE — matching the
+            # numpy compiler's _eval3 so pushdown never diverges from
+            # the chain's filter (NOT IN would otherwise KEEP rows the
+            # chain drops)
+            mask = pc.if_else(mask, mask, pa.scalar(None, pa.bool_()))
         # arrow is_in returns false (not null) for null inputs; SQL IN
         # with NULL input is unknown -> mark nulls unknown explicitly
         mask = pc.if_else(pc.is_null(c), pa.scalar(None, pa.bool_()),

@@ -145,24 +145,33 @@ def encode_record_batch(records: list[Record],
     """Records -> one RecordBatch v2 blob (optionally gzip-compressed)."""
     now = int(time.time() * 1000)
     base_ts = records[0].timestamp_ms or now if records else now
-    recs = b""
+    # accumulate in a list: += on bytes is O(total^2) and a 20k-record
+    # batch would copy gigabytes
+    parts: list[bytes] = []
     for i, r in enumerate(records):
-        body = b"\x00"  # attributes
-        body += enc_varint((r.timestamp_ms or now) - base_ts)
-        body += enc_varint(i)  # offset delta
+        body = [b"\x00"]  # attributes
+        body.append(enc_varint((r.timestamp_ms or now) - base_ts))
+        body.append(enc_varint(i))  # offset delta
         if r.key is None:
-            body += enc_varint(-1)
+            body.append(enc_varint(-1))
         else:
-            body += enc_varint(len(r.key)) + r.key
+            body.append(enc_varint(len(r.key)))
+            body.append(r.key)
         if r.value is None:
-            body += enc_varint(-1)
+            body.append(enc_varint(-1))
         else:
-            body += enc_varint(len(r.value)) + r.value
-        body += enc_varint(len(r.headers))
+            body.append(enc_varint(len(r.value)))
+            body.append(r.value)
+        body.append(enc_varint(len(r.headers)))
         for hk, hv in r.headers:
-            body += enc_varint(len(hk)) + hk
-            body += enc_varint(len(hv)) + hv
-        recs += enc_varint(len(body)) + body
+            body.append(enc_varint(len(hk)))
+            body.append(hk)
+            body.append(enc_varint(len(hv)))
+            body.append(hv)
+        blob = b"".join(body)
+        parts.append(enc_varint(len(blob)))
+        parts.append(blob)
+    recs = b"".join(parts)
     attrs = 0
     if compression == "gzip":
         import gzip as _gzip

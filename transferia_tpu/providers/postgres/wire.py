@@ -83,9 +83,13 @@ class PGConnection:
 
     # -- connection ---------------------------------------------------------
     def connect(self) -> "PGConnection":
-        self.sock = socket.create_connection((self.host, self.port),
-                                             timeout=self.timeout)
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        from transferia_tpu.utils.net import BufferedSock
+
+        raw = socket.create_connection((self.host, self.port),
+                                       timeout=self.timeout)
+        raw.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # buffered reads: COPY streams arrive as one small frame per row
+        self.sock = BufferedSock(raw)
         params = {
             "user": self.user,
             "database": self.database,

@@ -233,17 +233,32 @@ class SnapshotLoader:
             return
         from transferia_tpu.ops.rowhash import FingerprintAggregate
 
+        import json as _json
+
         per_table: dict[str, FingerprintAggregate] = {}
         for part in self.cp.operation_parts(self.operation_id):
             if not part.fingerprint:
                 continue
-            agg = per_table.setdefault(part.table_id.fqtn(),
-                                       FingerprintAggregate())
-            try:
-                agg.merge(FingerprintAggregate.parse(part.fingerprint))
-            except ValueError:
-                logger.warning("part %s carries a malformed fingerprint",
-                               part.key())
+            if part.fingerprint.startswith("{"):
+                # JSON mapping of output-table fqtn -> digest (renaming /
+                # fan-out chains); compact form implies output == source
+                try:
+                    mapping = _json.loads(part.fingerprint)
+                except ValueError:
+                    logger.warning(
+                        "part %s carries a malformed fingerprint map",
+                        part.key())
+                    continue
+            else:
+                mapping = {part.table_id.fqtn(): part.fingerprint}
+            for fqtn, dg in mapping.items():
+                agg = per_table.setdefault(fqtn, FingerprintAggregate())
+                try:
+                    agg.merge(FingerprintAggregate.parse(dg))
+                except ValueError:
+                    logger.warning(
+                        "part %s carries a malformed fingerprint",
+                        part.key())
         if not per_table:
             return
         digests = {t: a.digest() for t, a in per_table.items()}
@@ -529,14 +544,20 @@ class SnapshotLoader:
         part.read_bytes = read_bytes
         part.worker_index = self.worker_index
         if tap is not None:
-            # merge every output table's aggregate (transforms may rename
-            # or fan out): the part digest covers what the part WROTE
-            from transferia_tpu.ops.rowhash import FingerprintAggregate
+            # digests are keyed by OUTPUT table (transforms may rename or
+            # fan out); a single output matching the source keeps the
+            # compact legacy form, anything else stores a JSON mapping so
+            # `checksum --against-operation` compares target tables under
+            # their own names instead of the source's
+            aggs = tap.aggregates()
+            if len(aggs) == 1 and next(iter(aggs)) == tid:
+                part.fingerprint = next(iter(aggs.values())).digest()
+            elif aggs:
+                import json as _json
 
-            agg = FingerprintAggregate()
-            for a in tap.aggregates().values():
-                agg.merge(a)
-            part.fingerprint = agg.digest()
+                part.fingerprint = _json.dumps(
+                    {out.fqtn(): a.digest() for out, a in aggs.items()},
+                    sort_keys=True)
         with self._progress_lock:
             self.cp.update_operation_parts(self.operation_id, [part])
             self.table_stats.completed_parts.inc()
