@@ -46,11 +46,7 @@ def _auto_process_count() -> int:
     (pkg/abstract/runtime.go:105-107)."""
     if os.environ.get("BENCH_PROCESS_COUNT"):
         return int(os.environ["BENCH_PROCESS_COUNT"])
-    try:
-        cores = len(os.sched_getaffinity(0))
-    except (AttributeError, OSError):
-        cores = os.cpu_count() or 1
-    return max(1, min(4, cores))
+    return max(1, min(4, int(_effective_cpus())))
 
 
 def generate_dataset() -> None:
@@ -1031,11 +1027,15 @@ def main() -> None:
     # the 10M rows/s target is defined on (reference docs/benchmarks.md)
     from transferia_tpu.stats.profiler import profile as cpu_profile
 
+    from transferia_tpu.providers import parquet_native
+
+    parquet_native.reset_fallback_stats()
     stagetimer.enable(True)
     stagetimer.reset()
     with cpu_profile() as prof:
         rows, dt = run_pipeline(parquet=WIDE_PARQUET, total_rows=WIDE_ROWS)
     stage_note = stagetimer.format_breakdown(dt)
+    native_fallbacks = parquet_native.fallback_stats()
     rps = rows / dt
     # continuity line: the r01-r03 10-col dataset (own warmup so its
     # differently-shaped programs never compile inside the timed window)
@@ -1048,10 +1048,18 @@ def main() -> None:
         "value": round(rps),
         "unit": "rows/sec",
         "vs_baseline": round(rps / 10_000_000, 4),
+        "cpu_count": _effective_cpus(),
+        "dataset": {"rows": rows, "cols": _dataset_cols(WIDE_PARQUET)},
+        "native_fallback_cols": len(native_fallbacks),
+        "stages": stage_note or None,
     }
+    if native_fallbacks:
+        result["native_fallbacks"] = native_fallbacks
     if fallback:
         result["fallback"] = fallback
-    print(json.dumps(result))
+    # crash-safety copy: the official line prints LAST (the driver tails
+    # the output), but an OOM in an aux bench must not erase the headline
+    print(f"# headline(early): {json.dumps(result)}", file=sys.stderr)
     lat_note = ""
     if latencies:
         import math
@@ -1133,6 +1141,33 @@ def main() -> None:
             except Exception as e:
                 print(f"# {name} bench failed: "
                       f"{type(e).__name__}: {e}", file=sys.stderr)
+    # the ONE stdout JSON line, last so tail-capture always records it
+    print(json.dumps(result))
+
+
+def _effective_cpus() -> float:
+    """Cores this process can actually use (affinity ∩ cgroup quota)."""
+    try:
+        n = float(len(os.sched_getaffinity(0)))
+    except (AttributeError, OSError):
+        n = float(os.cpu_count() or 1)
+    try:  # cgroup v2: "max 100000" or "<quota> <period>"
+        with open("/sys/fs/cgroup/cpu.max") as fh:
+            quota_s, period_s = fh.read().split()
+        if quota_s != "max":
+            n = min(n, int(quota_s) / int(period_s))
+    except (OSError, ValueError):
+        pass
+    return round(n, 2)
+
+
+def _dataset_cols(path: str) -> Optional[int]:
+    try:
+        import pyarrow.parquet as pq
+
+        return pq.ParquetFile(path).metadata.num_columns
+    except Exception:
+        return None
 
 
 if __name__ == "__main__":

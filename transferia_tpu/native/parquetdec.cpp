@@ -126,6 +126,7 @@ struct PageHeader {
     // data page v1
     int32_t num_values = -1;
     int32_t encoding = -1;
+    int32_t def_level_encoding = 3;  // RLE unless the header says otherwise
     // dictionary page
     int32_t dict_num_values = -1;
     int32_t dict_encoding = -1;
@@ -160,6 +161,8 @@ bool parse_page_header(Reader& r, PageHeader& h) {
                 if (tt2 == T_TRUE || tt2 == T_FALSE) continue;
                 if (f2 == 1) h.num_values = (int32_t)r.zigzag();
                 else if (f2 == 2) h.encoding = (int32_t)r.zigzag();
+                else if (f2 == 3)
+                    h.def_level_encoding = (int32_t)r.zigzag();
                 else thrift_skip(r, tt2);
             }
             break;
@@ -297,7 +300,8 @@ struct RleDecoder {
                         bit_buf |= (uint64_t)byte << bit_cnt;
                         bit_cnt += 8;
                     }
-                    out[i] = (int32_t)(bit_buf & ((1u << bit_width) - 1));
+                    out[i] = (int32_t)(bit_buf
+                                       & (uint32_t)((1ull << bit_width) - 1));
                     bit_buf >>= bit_width;
                     bit_cnt -= bit_width;
                 }
@@ -341,10 +345,17 @@ struct Scratch {
 // chunk when uncompressed); nullptr on error
 const uint8_t* page_bytes(Reader& r, const PageHeader& h, int codec,
                           Scratch& scratch) {
+    if (h.compressed_size < 0 || h.uncompressed_size < 0) return nullptr;
     if (!r.need(h.compressed_size)) return nullptr;
     const uint8_t* raw = r.p;
     r.p += h.compressed_size;
-    if (codec == CODEC_RAW) return raw;
+    if (codec == CODEC_RAW) {
+        // callers treat the page as uncompressed_size bytes long; a corrupt
+        // header with uncompressed_size > compressed_size would walk past
+        // the mmap'd chunk
+        if (h.uncompressed_size != h.compressed_size) return nullptr;
+        return raw;
+    }
     uint8_t* dst = scratch.ensure(h.uncompressed_size);
     if (!dst) return nullptr;
     if (snappy_decompress(raw, h.compressed_size, dst,
@@ -425,6 +436,10 @@ int64_t pq_decode_fixed(const uint8_t* chunk, int64_t chunk_len,
             continue;
         }
         if (h.type != 0) return PQ_E_UNSUPPORTED;  // v2 etc.
+        // legacy BIT_PACKED def levels have a different layout; only RLE
+        // is parsed here — anything else must fall back, not misparse
+        if (max_def > 0 && h.def_level_encoding != ENC_RLE)
+            return PQ_E_UNSUPPORTED;
         const uint8_t* pb = page_bytes(r, h, codec, scratch);
         if (!pb) return PQ_E_CORRUPT;
         const uint8_t* pend = pb + h.uncompressed_size;
@@ -591,6 +606,8 @@ int64_t pq_decode_bytearray(const uint8_t* chunk, int64_t chunk_len,
             continue;
         }
         if (h.type != 0) return PQ_E_UNSUPPORTED;
+        if (max_def > 0 && h.def_level_encoding != ENC_RLE)
+            return PQ_E_UNSUPPORTED;
         const uint8_t* pb = page_bytes(r, h, codec, scratch);
         if (!pb) return PQ_E_CORRUPT;
         const uint8_t* pend = pb + h.uncompressed_size;
@@ -676,6 +693,7 @@ int64_t pq_decode_bytearray(const uint8_t* chunk, int64_t chunk_len,
             if (!pool_data || pend - pb < 1) return PQ_E_CORRUPT;
             RleDecoder rd;
             rd.bit_width = *pb++;
+            if (rd.bit_width > 32) return PQ_E_CORRUPT;
             rd.r = Reader{pb, pend};
             int64_t i = 0;
             while (i < n) {

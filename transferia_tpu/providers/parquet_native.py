@@ -24,6 +24,24 @@ from transferia_tpu.columnar.batch import Column, DictEnc, DictPool
 
 logger = logging.getLogger(__name__)
 
+# bench/diagnostic visibility: which columns fell out of the native
+# envelope (and how often) — silent arrow fallbacks regress the headline
+# without this.  Upload workers share a reader across threads, so the
+# counter update takes a lock.
+_fallback_columns: dict[str, int] = {}
+_fallback_lock = __import__("threading").Lock()
+
+
+def fallback_stats() -> dict[str, int]:
+    with _fallback_lock:
+        return dict(_fallback_columns)
+
+
+def reset_fallback_stats() -> None:
+    with _fallback_lock:
+        _fallback_columns.clear()
+
+
 _CODECS = {"UNCOMPRESSED": 0, "SNAPPY": 1}
 _FIXED_WIDTH = {"INT32": 4, "INT64": 8, "FLOAT": 4, "DOUBLE": 8}
 
@@ -218,6 +236,11 @@ class NativeParquetReader:
                 cols[cs.name] = c
         if fallback:
             from transferia_tpu.columnar.batch import _arrow_to_column
+
+            with _fallback_lock:
+                for name in fallback:
+                    _fallback_columns[name] = (
+                        _fallback_columns.get(name, 0) + 1)
 
             tbl = self._pf.read_row_group(g, columns=fallback,
                                           use_threads=False)

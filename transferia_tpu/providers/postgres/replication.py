@@ -97,9 +97,15 @@ class ReplicationConnection(PGConnection):
         import select
 
         while True:
-            readable, _, _ = select.select([self.sock], [], [], timeout)
-            if not readable:
-                return
+            # BufferedSock may have whole messages already buffered in
+            # userspace (a 256KiB refill can pull several replication
+            # frames at once); select on the raw fd would block past them
+            # and stall CDC delivery / keepalive replies until fresh wire
+            # bytes arrive.  Drain the buffer before probing the kernel.
+            if self.sock.pending() == 0:
+                readable, _, _ = select.select([self.sock], [], [], timeout)
+                if not readable:
+                    return
             t, payload = self._recv_message()
             if t != b"d":
                 if t == b"Z":

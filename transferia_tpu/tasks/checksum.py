@@ -751,14 +751,18 @@ def compare_checksum(src: Storage, dst: Storage,
         except Exception as e:
             errors.add(tc.fqtn(), GENERIC_ERROR, f"compare failed: {e}")
             tc.mismatches.append(f"compare failed: {e}")
-        if (len(tc.mismatches) == pre_row_mismatches
+        if (not sampled
+                and len(tc.mismatches) == pre_row_mismatches
                 and tc.mismatches
                 and all(m.startswith("fingerprints differ")
                         for m in tc.mismatches)):
             # the exact-representation digest flagged drift but the
             # (family-level, tolerant) row comparators found zero row
-            # differences: that is encoding drift, not a data mismatch —
-            # report it without failing the table
+            # differences across a FULL-coverage pass: that is encoding
+            # drift, not a data mismatch — report it without failing the
+            # table.  Under fingerprint+sample the row compare only saw a
+            # sample, so the digest mismatch stands (the difference may
+            # live in unsampled rows).
             tc.notes.extend(
                 m + " (representation-only: row-level compare found "
                     "no differences)" for m in tc.mismatches)
