@@ -69,6 +69,13 @@ def _bind(cdll: ctypes.CDLL) -> ctypes.CDLL:
             ctypes.POINTER(ctypes.c_int64),
         ]
         cdll.pq_decode_bytearray.restype = ctypes.c_int64
+    if hasattr(cdll, "pq_decode_rowgroup"):
+        cdll.pq_decode_rowgroup.argtypes = [
+            u8, ctypes.c_int64, i64, ctypes.c_int64,
+        ]
+        cdll.pq_decode_rowgroup.restype = ctypes.c_int64
+        cdll.pq_codec_supported.argtypes = [ctypes.c_int32]
+        cdll.pq_codec_supported.restype = ctypes.c_int32
     return cdll
 
 
@@ -82,9 +89,11 @@ def build(force: bool = False) -> bool:
     if not srcs:
         # sources pruned from the deployment: use a prebuilt .so as-is
         return _SO.exists()
+    # staleness must consider #included parts too, not just the TUs
+    deps = srcs + [p for p in [_DIR / "parquetdec_ba.inc"] if p.exists()]
     if (_SO.exists() and not force
             and _SO.stat().st_mtime >= max(s.stat().st_mtime
-                                           for s in srcs)):
+                                           for s in deps)):
         return True
     cxx = shutil.which("g++") or shutil.which("clang++")
     if cxx is None:
@@ -93,7 +102,7 @@ def build(force: bool = False) -> bool:
     try:
         subprocess.run(
             [cxx, "-O3", "-shared", "-fPIC", "-o", str(_SO)]
-            + [str(s) for s in srcs],
+            + [str(s) for s in srcs] + ["-ldl"],
             check=True, capture_output=True, timeout=120,
         )
         return True

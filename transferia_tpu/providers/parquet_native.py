@@ -4,17 +4,29 @@ The snapshot north-star's host decode stage (reference methodology:
 docs/benchmarks.md rows/sec on ClickBench `hits`) is bound by parquet
 decode on a single core.  This reader pairs pyarrow's *metadata* (footer
 parsing, row-group/chunk layout, schema) with the C++ chunk decoder
-(native/parquetdec.cpp): snappy + PLAIN/RLE_DICTIONARY pages go straight
-into the engine's columnar layout — flat (data, offsets) buffers, or
-int32 codes + pool adopted as DictEnc with no dictionary unification or
-index materialization.  Anything outside the decoder's envelope
-(unsupported codec/encoding/type, nested columns, v2 pages) falls back to
-arrow per column, so the reader is never less capable than pyarrow.
+(native/parquetdec.cpp): pages go straight into the engine's columnar
+layout — flat (data, offsets) buffers, or int32 codes + pool adopted as
+DictEnc with no dictionary unification or index materialization.
+
+The decode envelope: DataPage v1+v2; UNCOMPRESSED/SNAPPY/GZIP/ZSTD
+codecs (GZIP and ZSTD ride dlopen'd system zlib/libzstd); PLAIN,
+RLE_DICTIONARY, DELTA_BINARY_PACKED, DELTA_LENGTH_BYTE_ARRAY and
+DELTA_BYTE_ARRAY encodings; BOOLEAN/INT32/INT64/FLOAT/DOUBLE/BYTE_ARRAY
+physical types; flat schemas (max_def <= 1, no repetition).  Anything
+outside falls back to arrow per column, so the reader is never less
+capable than pyarrow.
+
+All columns of a row group decode in ONE ctypes call
+(pq_decode_rowgroup): the per-column Python + pyarrow-metadata overhead
+was ~40% of decode wall on the wide ClickBench-shaped bench.  ctypes
+releases the GIL for the call, so upload worker threads overlap decode
+with sink pushes.
 """
 
 from __future__ import annotations
 
 import logging
+import threading
 from typing import Optional
 
 import numpy as np
@@ -29,7 +41,7 @@ logger = logging.getLogger(__name__)
 # without this.  Upload workers share a reader across threads, so the
 # counter update takes a lock.
 _fallback_columns: dict[str, int] = {}
-_fallback_lock = __import__("threading").Lock()
+_fallback_lock = threading.Lock()
 
 
 def fallback_stats() -> dict[str, int]:
@@ -42,25 +54,38 @@ def reset_fallback_stats() -> None:
         _fallback_columns.clear()
 
 
-_CODECS = {"UNCOMPRESSED": 0, "SNAPPY": 1}
+# parquet CompressionCodec enum values (GZIP/ZSTD support is probed at
+# runtime — they need the system zlib/libzstd)
+_CODECS = {"UNCOMPRESSED": 0, "SNAPPY": 1, "GZIP": 2, "ZSTD": 6}
 _FIXED_WIDTH = {"INT32": 4, "INT64": 8, "FLOAT": 4, "DOUBLE": 8}
 
-# physical view dtype per canonical type for fixed-width reinterpretation
+# (physical width, output width, output view dtype) per canonical type.
+# Narrow logical ints (int8/16) truncate DURING the native decode
+# (little-endian low bytes == two's-complement truncation), so no numpy
+# astype pass runs afterwards.
 _VIEW_DTYPES = {
-    CanonicalType.INT8: (4, np.int32),
-    CanonicalType.INT16: (4, np.int32),
-    CanonicalType.INT32: (4, np.int32),
-    CanonicalType.INT64: (8, np.int64),
-    CanonicalType.UINT8: (4, np.uint32),
-    CanonicalType.UINT16: (4, np.uint32),
-    CanonicalType.UINT32: (4, np.uint32),
-    CanonicalType.UINT64: (8, np.uint64),
-    CanonicalType.FLOAT: (4, np.float32),
-    CanonicalType.DOUBLE: (8, np.float64),
-    CanonicalType.DATE: (4, np.int32),
-    CanonicalType.DATETIME: (8, np.int64),
-    CanonicalType.TIMESTAMP: (8, np.int64),
+    CanonicalType.INT8: (4, 1, np.int8),
+    CanonicalType.INT16: (4, 2, np.int16),
+    CanonicalType.INT32: (4, 4, np.int32),
+    CanonicalType.INT64: (8, 8, np.int64),
+    CanonicalType.UINT8: (4, 1, np.uint8),
+    CanonicalType.UINT16: (4, 2, np.uint16),
+    CanonicalType.UINT32: (4, 4, np.uint32),
+    CanonicalType.UINT64: (8, 8, np.uint64),
+    CanonicalType.FLOAT: (4, 4, np.float32),
+    CanonicalType.DOUBLE: (8, 8, np.float64),
+    CanonicalType.DATE: (4, 4, np.int32),
+    CanonicalType.DATETIME: (8, 8, np.int64),
+    CanonicalType.TIMESTAMP: (8, 8, np.int64),
 }
+
+# task-array columns for pq_decode_rowgroup (native/parquetdec.cpp)
+_T_OFF, _T_LEN, _T_CODEC, _T_KIND, _T_WIDTH, _T_NVAL, _T_MAXDEF = range(7)
+_T_VALUES, _T_CAP, _T_OFFSETS, _T_CODES, _T_VALIDITY = range(7, 12)
+_T_RESULT, _T_OUTKIND, _T_NEEDED, _T_NULLS = range(12, 16)
+_T_FIELDS = 16
+
+_E_GROW = -2
 
 
 class NativeParquetReader:
@@ -80,6 +105,10 @@ class NativeParquetReader:
         self._pq_schema = pf.schema
         # arrow logical types (timestamp units etc.)
         self._arrow_fields = {f.name: f for f in pf.schema_arrow}
+        self._codec_ok_cache: dict[int, bool] = {}
+        # (tasks template, specs, static fallback names) per row group
+        self._task_cache: dict[int, tuple] = {}
+        self._cache_lock = threading.Lock()
 
     @classmethod
     def open(cls, path: str, pf,
@@ -91,7 +120,7 @@ class NativeParquetReader:
         if os.environ.get("TRANSFERIA_TPU_NATIVE_PARQUET", "1") == "0":
             return None
         cdll = native_lib()
-        if cdll is None or not hasattr(cdll, "pq_decode_fixed"):
+        if cdll is None or not hasattr(cdll, "pq_decode_rowgroup"):
             return None
         if pf.metadata.num_row_groups == 0:
             return None
@@ -100,7 +129,14 @@ class NativeParquetReader:
         except (OSError, ValueError):
             return None
 
-    # -- per-column decode ---------------------------------------------------
+    def _codec_ok(self, codec: int) -> bool:
+        ok = self._codec_ok_cache.get(codec)
+        if ok is None:
+            ok = bool(self._cdll.pq_codec_supported(codec))
+            self._codec_ok_cache[codec] = ok
+        return ok
+
+    # -- row-group task preparation -----------------------------------------
     def _chunk_range(self, col) -> tuple[int, int]:
         start = col.data_page_offset
         if (col.dictionary_page_offset is not None
@@ -108,52 +144,70 @@ class NativeParquetReader:
             start = min(start, col.dictionary_page_offset)
         return start, col.total_compressed_size
 
-    def _decode_column(self, g: int, cs) -> Optional[Column]:
-        """Native decode of one column chunk; None -> caller falls back."""
-        idx = self._col_idx.get(cs.name)
-        if idx is None:
-            return None
-        col = self._meta.row_group(g).column(idx)
-        codec = _CODECS.get(col.compression)
-        if codec is None:
-            return None
-        sc = self._pq_schema.column(idx)
-        max_def = sc.max_definition_level
-        max_rep = sc.max_repetition_level
-        if max_rep != 0 or max_def > 1:
-            return None
-        n = col.num_values
-        start, length = self._chunk_range(col)
-        if start < 0 or start + length > len(self._mm):
-            return None
-        chunk = self._mm[start:start + length]
-        ptype = col.physical_type
-        validity = (np.empty(n, dtype=np.uint8) if max_def else None)
-        if ptype in _FIXED_WIDTH:
-            spec = _VIEW_DTYPES.get(cs.data_type)
-            if spec is None:
-                return None
-            width, view_dt = spec
-            if width != _FIXED_WIDTH[ptype]:
-                return None
-            out = np.empty(n * width, dtype=np.uint8)
-            rc = self._cdll.pq_decode_fixed(
-                np.ascontiguousarray(chunk), length, codec, width, n,
-                max_def, out.ctypes.data,
-                validity.ctypes.data if validity is not None else None)
-            if rc != n:
-                return None
-            vals = out.view(view_dt)
-            return self._finish_fixed(cs, vals, validity)
-        if ptype == "BYTE_ARRAY" and cs.data_type.is_variable_width:
-            return self._decode_bytearray(chunk, length, codec, n,
-                                          max_def, col, cs, validity)
-        return None
+    def _rg_tasks(self, g: int) -> tuple:
+        with self._cache_lock:
+            cached = self._task_cache.get(g)
+        if cached is not None:
+            return cached
+        rg = self._meta.row_group(g)
+        specs: list[tuple] = []
+        static_fb: list[str] = []
+        rows: list[list[int]] = []
+        for cs in self._schema:
+            idx = self._col_idx.get(cs.name)
+            if idx is None:
+                continue  # column absent from the file entirely
+            col = rg.column(idx)
+            codec = _CODECS.get(col.compression)
+            sc = self._pq_schema.column(idx)
+            kind = width = ow = None
+            view_dt = None
+            ok = (codec is not None and self._codec_ok(codec)
+                  and sc.max_repetition_level == 0
+                  and sc.max_definition_level <= 1)
+            if ok:
+                ptype = col.physical_type
+                if ptype in _FIXED_WIDTH:
+                    spec = _VIEW_DTYPES.get(cs.data_type)
+                    if spec is None or spec[0] != _FIXED_WIDTH[ptype]:
+                        ok = False
+                    else:
+                        kind, (width, ow, view_dt) = 0, spec
+                elif (ptype == "BOOLEAN"
+                      and cs.data_type == CanonicalType.BOOLEAN):
+                    kind, width, ow, view_dt = 2, 1, 1, np.bool_
+                elif (ptype == "BYTE_ARRAY"
+                      and cs.data_type.is_variable_width):
+                    kind, width, ow = 1, 0, 0
+                else:
+                    ok = False
+            if ok:
+                start, length = self._chunk_range(col)
+                if start < 0 or start + length > len(self._mm):
+                    ok = False
+            if not ok:
+                static_fb.append(cs.name)
+                continue
+            n = col.num_values
+            max_def = sc.max_definition_level
+            # field 8: data cap for byte arrays, output width for fixed
+            cap = (max(col.total_uncompressed_size, 4096)
+                   if kind == 1 else ow)
+            rows.append([start, length, codec, kind, width, n, max_def,
+                         0, cap, 0, 0, 0, 0, 0, 0, 0])
+            specs.append((cs, kind, ow, n, max_def, cap, view_dt))
+        tasks = (np.array(rows, dtype=np.int64)
+                 if rows else np.zeros((0, _T_FIELDS), dtype=np.int64))
+        out = (tasks, specs, static_fb)
+        with self._cache_lock:
+            self._task_cache[g] = out
+        return out
 
+    # -- per-column post-processing -----------------------------------------
     def _finish_fixed(self, cs, vals: np.ndarray,
                       validity: Optional[np.ndarray]) -> Column:
         v = None
-        if validity is not None and not validity.all():
+        if validity is not None:
             v = validity.astype(np.bool_)
         ct = cs.data_type
         f = self._arrow_fields.get(cs.name)
@@ -175,11 +229,48 @@ class NativeParquetReader:
             vals = vals.astype(ct.np_dtype)
         return Column(cs.name, ct, np.ascontiguousarray(vals), None, v)
 
-    def _decode_bytearray(self, chunk, length, codec, n, max_def, col,
-                          cs, validity) -> Optional[Column]:
+    def _finish_bytearray(self, cs, rc: int, out_kind: int, n: int,
+                          data: np.ndarray, offsets: np.ndarray,
+                          codes: np.ndarray,
+                          validity: Optional[np.ndarray]) -> Column:
+        v = validity.astype(np.bool_) if validity is not None else None
+        if out_kind == 1:
+            # dict result: rc == n_pool; codes hold n_pool for nulls.
+            # The pool slice is a view into the cap-sized decode buffer
+            # (cap covers code pages too, not just the dict page): keep
+            # the view only while it fills most of the buffer, else copy
+            # so a small pool doesn't pin megabytes through the pipeline.
+            n_pool = rc
+            pool_off = np.append(offsets[:n_pool + 1],
+                                 offsets[n_pool]).astype(np.int32)
+            pool_bytes = int(offsets[n_pool])
+            pool_data = data[:pool_bytes]
+            if pool_bytes * 2 < data.nbytes:
+                pool_data = pool_data.copy()
+            dpool = DictPool(pool_data, pool_off, null_code=n_pool)
+            return Column(cs.name, cs.data_type, validity=v,
+                          dict_enc=DictEnc(codes, pool=dpool))
+        flat = data[:rc]
+        if rc * 2 < data.nbytes:
+            flat = flat.copy()
+        return Column(cs.name, cs.data_type, flat, offsets, v)
+
+    def _retry_bytearray(self, g: int, cs, cap: int) -> Optional[Column]:
+        """GROW retry: single-column decode with an enlarged data cap."""
         import ctypes
 
-        cap = max(col.total_uncompressed_size, 4096)
+        idx = self._col_idx[cs.name]
+        col = self._meta.row_group(g).column(idx)
+        codec = _CODECS.get(col.compression)
+        if codec is None:
+            return None
+        sc = self._pq_schema.column(idx)
+        max_def = sc.max_definition_level
+        n = col.num_values
+        start, length = self._chunk_range(col)
+        chunk = np.ascontiguousarray(self._mm[start:start + length])
+        # the legacy single-column ABI seeds validity all-defined itself
+        validity = np.empty(n, dtype=np.uint8) if max_def else None
         offsets = np.empty(n + 1, dtype=np.int32)
         codes = np.empty(n, dtype=np.int32)
         for _attempt in range(4):
@@ -187,29 +278,20 @@ class NativeParquetReader:
             kind = ctypes.c_int32(-1)
             needed = ctypes.c_int64(0)
             rc = self._cdll.pq_decode_bytearray(
-                np.ascontiguousarray(chunk), length, codec, n, max_def,
+                chunk, length, codec, n, max_def,
                 data, cap, offsets, codes.ctypes.data,
                 validity.ctypes.data if validity is not None else None,
                 ctypes.byref(kind), ctypes.byref(needed))
-            if rc == -2:  # grow
+            if rc == _E_GROW:
                 cap = max(needed.value, cap * 2)
                 continue
             if rc < 0:
                 return None
-            v = None
-            if validity is not None and not validity.all():
-                v = validity.astype(np.bool_)
-            if kind.value == 1:
-                # dict result: rc == n_pool; codes hold n_pool for nulls
-                n_pool = rc
-                pool_off = np.append(offsets[:n_pool + 1],
-                                     offsets[n_pool]).astype(np.int32)
-                pool_data = data[:offsets[n_pool]].copy()
-                dpool = DictPool(pool_data, pool_off, null_code=n_pool)
-                return Column(cs.name, cs.data_type, validity=v,
-                              dict_enc=DictEnc(codes, pool=dpool))
-            return Column(cs.name, cs.data_type, data[:rc].copy(),
-                          offsets, v)
+            v = validity
+            if v is not None and v.all():
+                v = None
+            return self._finish_bytearray(cs, rc, kind.value, n, data,
+                                          offsets, codes, v)
         return None
 
     # -- public --------------------------------------------------------------
@@ -219,13 +301,57 @@ class NativeParquetReader:
         Columns outside the native envelope (unsupported codec/encoding/
         type, nested, >2GiB flat) are filled through an arrow read of just
         those columns — the result is always complete."""
+        template, specs, static_fb = self._rg_tasks(g)
+        tasks = template.copy()
+        holds: list[tuple] = []
+        for i, (cs, kind, ow, n, max_def, cap, view_dt) in enumerate(specs):
+            if kind == 1:
+                data = np.empty(cap, dtype=np.uint8)
+                offsets = np.empty(n + 1, dtype=np.int32)
+                codes = np.empty(n, dtype=np.int32)
+                tasks[i, _T_VALUES] = data.ctypes.data
+                tasks[i, _T_OFFSETS] = offsets.ctypes.data
+                tasks[i, _T_CODES] = codes.ctypes.data
+                bufs = (data, offsets, codes)
+            else:
+                out = np.empty(n, dtype=view_dt)
+                tasks[i, _T_VALUES] = out.ctypes.data
+                bufs = (out,)
+            if max_def:
+                val = np.empty(n, dtype=np.uint8)
+                tasks[i, _T_VALIDITY] = val.ctypes.data
+            else:
+                val = None
+            holds.append((bufs, val))
+        if len(specs):
+            self._cdll.pq_decode_rowgroup(self._mm, len(self._mm), tasks,
+                                          len(specs))
         cols: dict[str, Column] = {}
-        fallback: list[str] = []
-        for cs in self._schema:
-            if cs.name not in self._col_idx:
-                continue
+        fallback: list[str] = list(static_fb)
+        for i, (cs, kind, ow, n, max_def, cap, view_dt) in enumerate(specs):
+            rc = int(tasks[i, _T_RESULT])
+            nulls = int(tasks[i, _T_NULLS])
+            bufs, val = holds[i]
+            validity = val if (max_def and nulls > 0) else None
             try:
-                c = self._decode_column(g, cs)
+                if kind == 1:
+                    if rc == _E_GROW:
+                        c = self._retry_bytearray(
+                            g, cs, max(int(tasks[i, _T_NEEDED]), cap * 2))
+                    elif rc < 0:
+                        c = None
+                    else:
+                        c = self._finish_bytearray(
+                            cs, rc, int(tasks[i, _T_OUTKIND]), n,
+                            bufs[0], bufs[1], bufs[2], validity)
+                elif rc != n:
+                    c = None
+                elif kind == 2:
+                    c = Column(cs.name, cs.data_type, bufs[0], None,
+                               validity.astype(np.bool_)
+                               if validity is not None else None)
+                else:
+                    c = self._finish_fixed(cs, bufs[0], validity)
             except Exception:  # corrupt chunk etc: arrow decides
                 logger.debug("native decode failed for %s", cs.name,
                              exc_info=True)
