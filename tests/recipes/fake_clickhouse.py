@@ -32,6 +32,10 @@ class FakeCH:
         fake = self
 
         class Handler(BaseHTTPRequestHandler):
+            # real ClickHouse speaks HTTP/1.1 with keep-alive; the client
+            # pools per-thread connections, so the fake must match
+            protocol_version = "HTTP/1.1"
+
             def do_POST(self):
                 length = int(self.headers.get("Content-Length") or 0)
                 body = self.rfile.read(length)

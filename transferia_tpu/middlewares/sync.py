@@ -7,8 +7,11 @@ fallback,retrier,interval_throttler}.go and the Measurer
 
 from __future__ import annotations
 
+import collections
 import logging
+import threading
 import time
+import weakref
 from typing import Callable, Iterable, Optional, Sequence
 
 from transferia_tpu.abstract.errors import is_fatal
@@ -132,19 +135,60 @@ class Retrier(_Wrap):
 
 
 class Measurer(_Wrap):
-    """Logs slow pushes (middlewares/synchronizer/measurer.go)."""
+    """Logs slow pushes and keeps a push-latency window
+    (middlewares/synchronizer/measurer.go).
+
+    The window (bounded ring of recent push durations) backs quantile
+    reads for the bench and for regression tests bounding p99 push
+    latency — the 64-partition fan-in stall class (a near-minute push
+    hiding inside an otherwise-green run) is invisible to averages."""
+
+    WINDOW = 4096
+    # weak registry of live instances: the partitioned strategy builds
+    # one sink chain (one Measurer) per partition pipeline, and a stall
+    # in ANY of them must be visible to bench/tests.  Weak refs so a
+    # stopped transfer's sink chain isn't pinned in memory.
+    _instances: "weakref.WeakSet[Measurer]" = weakref.WeakSet()
 
     def __init__(self, inner: Sinker, warn_seconds: float = 30.0):
         super().__init__(inner)
         self.warn_seconds = warn_seconds
+        self._lat = collections.deque(maxlen=self.WINDOW)
+        self._lock = threading.Lock()
+        Measurer._instances.add(self)
 
     def push(self, batch: Batch) -> None:
         t0 = time.monotonic()
         self.inner.push(batch)
         dt = time.monotonic() - t0
+        with self._lock:
+            self._lat.append(dt)
         if dt > self.warn_seconds:
             logger.warning("slow sink push: %d rows took %.1fs",
                            batch_len(batch), dt)
+
+    def quantile(self, q: float) -> float:
+        """Push-latency quantile (seconds) over the recent window; 0.0
+        before any push."""
+        with self._lock:
+            lat = sorted(self._lat)
+        if not lat:
+            return 0.0
+        idx = min(len(lat) - 1, int(q * len(lat)))
+        return lat[idx]
+
+    @classmethod
+    def global_quantile(cls, q: float) -> float:
+        """Quantile over every live pipeline's recent window."""
+        lat: list[float] = []
+        for inst in list(cls._instances):
+            with inst._lock:
+                lat.extend(inst._lat)
+        if not lat:
+            return 0.0
+        lat.sort()
+        idx = min(len(lat) - 1, int(q * len(lat)))
+        return lat[idx]
 
 
 class IntervalThrottler(_Wrap):

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import http.client
 import logging
+import threading
 import urllib.parse
 from typing import Iterator, Optional
 
@@ -37,11 +38,31 @@ class CHClient:
         self.secure = secure
         self.timeout = timeout
         self.settings = settings or {}
+        # keep-alive: one persistent connection per thread (sink workers
+        # push concurrently) — a connect+teardown per INSERT dominated the
+        # small-batch replication profile
+        self._local = threading.local()
 
     def _connect(self) -> http.client.HTTPConnection:
         cls = http.client.HTTPSConnection if self.secure \
             else http.client.HTTPConnection
         return cls(self.host, self.port, timeout=self.timeout)
+
+    def _pooled(self) -> http.client.HTTPConnection:
+        conn = getattr(self._local, "conn", None)
+        if conn is None:
+            conn = self._connect()
+            self._local.conn = conn
+        return conn
+
+    def _drop_pooled(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+            self._local.conn = None
 
     def _params(self, query: str, extra: Optional[dict] = None) -> str:
         params = {
@@ -54,34 +75,54 @@ class CHClient:
 
     def execute(self, query: str, body: bytes = b"",
                 extra_params: Optional[dict] = None) -> bytes:
-        """Run a query; body carries INSERT payload bytes."""
-        conn = self._connect()
-        try:
-            headers = {"Content-Type": "application/octet-stream"}
-            if self.user:
-                import base64
+        """Run a query; body carries INSERT payload bytes.
 
-                cred = base64.b64encode(
-                    f"{self.user}:{self.password}".encode()
-                ).decode()
-                headers["Authorization"] = f"Basic {cred}"
-            conn.request(
-                "POST", "/?" + self._params(query, extra_params),
-                body=body, headers=headers,
-            )
-            resp = conn.getresponse()
-            data = resp.read()
+        Rides the thread's keep-alive connection; a dead/half-closed
+        connection (server restart, idle timeout) gets one transparent
+        retry on a fresh socket before the error surfaces."""
+        headers = {"Content-Type": "application/octet-stream"}
+        if self.user:
+            import base64
+
+            cred = base64.b64encode(
+                f"{self.user}:{self.password}".encode()
+            ).decode()
+            headers["Authorization"] = f"Basic {cred}"
+        path = "/?" + self._params(query, extra_params)
+        for attempt in (0, 1):
+            reused = getattr(self._local, "conn", None) is not None
+            conn = self._pooled()
+            sent = False
+            try:
+                conn.request("POST", path, body=body, headers=headers)
+                sent = True
+                resp = conn.getresponse()
+                data = resp.read()
+            except (ConnectionError, OSError,
+                    http.client.HTTPException) as e:
+                self._drop_pooled()
+                # Retry ONLY the stale-keep-alive race: a REUSED socket
+                # failing before the request went out (server closed the
+                # idle connection).  Once the body was sent the server
+                # may have executed a non-idempotent INSERT — resending
+                # would duplicate rows, so the error surfaces instead
+                # (the sink's retry policy owns that decision).
+                if attempt == 0 and reused and not sent:
+                    continue
+                raise CHError(f"clickhouse connection failed: {e}") from e
             if resp.status != 200:
+                # responses may close the stream on error statuses
+                if resp.will_close:
+                    self._drop_pooled()
                 raise CHError(
                     f"clickhouse HTTP {resp.status}: "
                     f"{data[:500].decode('utf-8', 'replace')}",
                     code=resp.status,
                 )
+            if resp.will_close:
+                self._drop_pooled()
             return data
-        except (ConnectionError, OSError, http.client.HTTPException) as e:
-            raise CHError(f"clickhouse connection failed: {e}") from e
-        finally:
-            conn.close()
+        raise CHError("clickhouse connection failed")  # unreachable
 
     def execute_stream(self, query: str):
         """Run a query and return (read_fn, close_fn) streaming the response

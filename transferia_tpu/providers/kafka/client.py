@@ -379,3 +379,66 @@ class KafkaClient:
         # the broker may return records below the requested offset (batch
         # alignment); trim client-side
         return [rec for rec in records if rec.offset >= offset], high
+
+    def fetch_multi(self, topic: str, offsets: dict[int, int],
+                    max_bytes: int = 8 << 20, max_wait_ms: int = 250,
+                    ) -> dict[int, tuple[list[Record], int]]:
+        """Fetch many partitions in few round-trips: partitions group by
+        leader and each leader gets ONE Fetch request carrying all of its
+        partitions (the wire format is multi-partition; issuing one
+        request per partition costs n_partitions round-trips per poll
+        cycle — the 64-partition fan-in killer).  Returns
+        {partition: (records, high_watermark)}; per-partition retriable
+        errors retry once through the single-partition path."""
+        by_node: dict[object, list[int]] = {}
+        for p in offsets:
+            by_node.setdefault(self._leader_node(topic, p), []).append(p)
+        out: dict[int, tuple[list[Record], int]] = {}
+        retry: list[int] = []
+        self._fetch_rotation = getattr(self, "_fetch_rotation", 0) + 1
+        for node, parts in by_node.items():
+            # Rotate the partition order per request: brokers fill
+            # partitions in request order until max_bytes runs out, so a
+            # fixed order lets one backlogged low partition starve the
+            # rest indefinitely (the KIP-74 fairness problem).
+            parts = sorted(parts)
+            rot = self._fetch_rotation % len(parts)
+            parts = parts[rot:] + parts[:rot]
+            body = struct.pack("!iiii", -1, max_wait_ms, 1, max_bytes)
+            body += b"\x00"                       # isolation level
+            body += struct.pack("!i", 1) + enc_str(topic)
+            body += struct.pack("!i", len(parts))
+            for p in parts:
+                body += struct.pack("!iqi", p, offsets[p], max_bytes)
+            try:
+                r = self._roundtrip(API_FETCH, 4, body, node)
+            except KafkaError:
+                retry.extend(parts)
+                continue
+            r.i32()  # throttle
+            for _ in range(r.i32()):
+                r.string()
+                for _ in range(r.i32()):
+                    p = r.i32()
+                    err = r.i16()
+                    high = r.i64()
+                    r.i64()              # last stable offset
+                    for _ in range(r.i32()):
+                        r.i64()          # aborted txn producer id
+                        r.i64()          # first offset
+                    blob = r.bytes_() or b""
+                    if err == ERR_OFFSET_OUT_OF_RANGE:
+                        raise KafkaError("offset out of range", code=err)
+                    if err != ERR_NONE:
+                        retry.append(p)
+                        continue
+                    off = offsets.get(p, 0)
+                    recs = [rec for rec in decode_record_batches(blob)
+                            if rec.offset >= off]
+                    out[p] = (recs, high)
+        for p in retry:
+            if p in offsets:
+                out[p] = self.fetch(topic, p, offsets[p],
+                                    max_bytes=max_bytes,
+                                    max_wait_ms=max_wait_ms)
+        return out

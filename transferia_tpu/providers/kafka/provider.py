@@ -146,12 +146,15 @@ class _KafkaQueueClient:
                 )
 
     def fetch(self, max_messages: int = 1024) -> list[FetchedBatch]:
+        # one multi-partition Fetch per leader (not one round-trip per
+        # partition: a 64-partition fan-in would pay 64 RTTs per cycle)
+        fetched = self.client.fetch_multi(
+            self.params.topic, dict(self.positions),
+            max_bytes=self.params.max_bytes_per_fetch,
+        )
         out = []
-        for p in sorted(self.positions):
-            records, high = self.client.fetch(
-                self.params.topic, p, self.positions[p],
-                max_bytes=self.params.max_bytes_per_fetch,
-            )
+        for p in sorted(fetched):
+            records, high = fetched[p]
             if not records:
                 continue
             records = records[:max_messages]
