@@ -29,22 +29,12 @@ from transferia_tpu.middlewares.sync import Measurer
 from transferia_tpu.models import Transfer, TransferType
 from transferia_tpu.providers.clickhouse import CHTargetParams
 from transferia_tpu.providers.kafka.client import KafkaClient, Record
+from transferia_tpu.providers.kafka.protocol import enc_varint as _zz
 from transferia_tpu.providers.kafka.provider import KafkaSourceParams
 from transferia_tpu.runtime.local import run_replication
 
 N_PARTITIONS = 16
 MSGS_PER_PARTITION = 150
-
-
-def _zz(n: int) -> bytes:
-    u = (n << 1) ^ (n >> 63) if n < 0 else (n << 1)
-    out = bytearray()
-    while True:
-        b = u & 0x7F
-        u >>= 7
-        out.append(b | (0x80 if u else 0))
-        if not u:
-            return bytes(out)
 
 
 def test_fanin_p99_push_latency_bounded():

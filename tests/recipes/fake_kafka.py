@@ -22,6 +22,118 @@ from transferia_tpu.providers.kafka.protocol import (
 )
 
 
+def _index_frames(blob: bytes) -> Optional[list]:
+    """[(frame_pos, record_count)] straight from the batch header(s) —
+    no decode.  recordCount sits at fixed offset 57 of each v2 frame."""
+    frames = []
+    pos = 0
+    n = len(blob)
+    while pos + 61 <= n:
+        batch_len = struct.unpack_from("!i", blob, pos + 8)[0]
+        magic = blob[pos + 16]
+        if magic != 2:
+            return None
+        frames.append((pos, struct.unpack_from("!i", blob, pos + 57)[0]))
+        pos += 12 + batch_len
+    if pos != n:
+        return None
+    return frames
+
+
+class _PartitionLog:
+    """Partition storage as a real broker keeps it: raw produced batch
+    blobs, decoded lazily when a fetch actually reads them.  Exposes the
+    list surface the fixtures/tests use (len, slicing, iteration,
+    append of decoded records)."""
+
+    def __init__(self):
+        # [base, count, blob|None, records|None]
+        self._segments: list[list] = []
+        self._n = 0
+
+    def __len__(self) -> int:
+        return self._n
+
+    def append_blob(self, blob: bytes) -> bool:
+        frames = _index_frames(blob)
+        if frames is None:
+            return False
+        total = sum(c for _, c in frames)
+        if not total:
+            return True
+        # assign offsets the broker way: rewrite each frame's baseOffset
+        # in place, so the stored bytes can be served verbatim on fetch
+        ba = bytearray(blob)
+        base = self._n
+        for pos, count in frames:
+            struct.pack_into("!q", ba, pos, base)
+            base += count
+        self._segments.append([self._n, total, bytes(ba), None])
+        self._n += total
+        return True
+
+    def raw_from(self, offset: int, max_records: int = 1000) -> bytes:
+        """Stored frames covering [offset, ...), served verbatim (the
+        client trims records below the requested offset, exactly as with
+        a real broker's batch-aligned responses)."""
+        out = []
+        taken = 0
+        for seg in self._segments:
+            if seg[0] + seg[1] <= offset:
+                continue
+            if taken >= max_records:
+                break
+            if seg[2] is not None:
+                out.append(seg[2])
+            else:
+                out.append(encode_record_batch(seg[3],
+                                               base_offset=seg[0]))
+            taken += seg[1]
+        return b"".join(out)
+
+    def append(self, rec) -> None:
+        rec.offset = self._n
+        if self._segments and self._segments[-1][2] is None:
+            seg = self._segments[-1]
+            seg[3].append(rec)
+            seg[1] += 1
+        else:
+            self._segments.append([self._n, 1, None, [rec]])
+        self._n += 1
+
+    def _records_of(self, seg: list) -> list:
+        if seg[3] is None:
+            recs = decode_record_batches(seg[2])
+            for i, r in enumerate(recs):
+                r.offset = seg[0] + i
+            seg[3] = recs
+        return seg[3]
+
+    def __getitem__(self, idx):
+        if isinstance(idx, slice):
+            lo, hi, step = idx.indices(self._n)
+            if step != 1:
+                return [self[i] for i in range(lo, hi, step)]
+            out = []
+            for seg in self._segments:
+                base, count = seg[0], seg[1]
+                if base + count <= lo or base >= hi:
+                    continue
+                recs = self._records_of(seg)
+                out.extend(recs[max(0, lo - base):hi - base])
+            return out
+        if idx < 0:
+            idx += self._n
+        for seg in self._segments:
+            if seg[0] <= idx < seg[0] + seg[1]:
+                return self._records_of(seg)[idx - seg[0]]
+        raise IndexError(idx)
+
+    def __iter__(self):
+        for seg in self._segments:
+            yield from self._records_of(seg)
+
+
 class FakeKafka:
     def __init__(self, n_partitions: int = 2,
                  auto_create_topics: bool = True,
@@ -31,8 +143,8 @@ class FakeKafka:
         tls_cert: (certfile, keyfile) to serve TLS."""
         self.n_partitions = n_partitions
         self.auto_create = auto_create_topics
-        # topic -> partition -> list[Record] (absolute offsets = index)
-        self.topics: dict[str, list[list]] = {}
+        # topic -> partition -> _PartitionLog (absolute offsets = index)
+        self.topics: dict[str, list[_PartitionLog]] = {}
         self.lock = threading.RLock()
         self.port = 0
         self._srv = None
@@ -50,7 +162,8 @@ class FakeKafka:
         with self.lock:
             if name not in self.topics:
                 self.topics[name] = [
-                    [] for _ in range(n_partitions or self.n_partitions)
+                    _PartitionLog()
+                    for _ in range(n_partitions or self.n_partitions)
                 ]
 
     def records(self, topic: str, partition: int = 0) -> list:
@@ -209,14 +322,16 @@ class FakeKafka:
             for _ in range(r.i32()):
                 partition = r.i32()
                 blob = r.bytes_() or b""
-                records = decode_record_batches(blob)
                 with self.lock:
                     self.create_topic(topic)
                     plist = self.topics[topic][partition]
                     base = len(plist)
-                    for i, rec in enumerate(records):
-                        rec.offset = base + i
-                        plist.append(rec)
+                    # store the raw blob (a real broker never decodes);
+                    # unparseable frames fall back to eager decode so
+                    # protocol tests still see their errors on produce
+                    if not plist.append_blob(blob):
+                        for rec in decode_record_batches(blob):
+                            plist.append(rec)
                 out_topics.append((topic, partition, base))
         out = struct.pack("!i", len(out_topics))
         for topic, partition, base in out_topics:
@@ -264,15 +379,15 @@ class FakeKafka:
                 r.i32()  # partition max bytes
                 with self.lock:
                     plist = self.topics.get(topic)
-                    records = plist[partition][offset:offset + 1000] \
-                        if plist else []
-                    high = len(plist[partition]) if plist else 0
-                if records:
-                    blob = encode_record_batch(
-                        records, base_offset=records[0].offset
-                    )
-                else:
-                    blob = b""
+                    if plist is not None:
+                        log = plist[partition]
+                        high = len(log)
+                        # stored frames serve verbatim (batch-aligned,
+                        # like a real broker; clients trim the head)
+                        blob = log.raw_from(offset)
+                    else:
+                        blob = b""
+                        high = 0
                 out += struct.pack("!ihqq", partition, 0, high, high)
                 out += struct.pack("!i", 0)   # aborted txns
                 out += struct.pack("!i", len(blob)) + blob

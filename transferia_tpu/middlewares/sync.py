@@ -149,13 +149,15 @@ class Measurer(_Wrap):
     # in ANY of them must be visible to bench/tests.  Weak refs so a
     # stopped transfer's sink chain isn't pinned in memory.
     _instances: "weakref.WeakSet[Measurer]" = weakref.WeakSet()
+    _registry_lock = threading.Lock()
 
     def __init__(self, inner: Sinker, warn_seconds: float = 30.0):
         super().__init__(inner)
         self.warn_seconds = warn_seconds
         self._lat = collections.deque(maxlen=self.WINDOW)
         self._lock = threading.Lock()
-        Measurer._instances.add(self)
+        with Measurer._registry_lock:
+            Measurer._instances.add(self)
 
     def push(self, batch: Batch) -> None:
         t0 = time.monotonic()
@@ -181,7 +183,9 @@ class Measurer(_Wrap):
     def global_quantile(cls, q: float) -> float:
         """Quantile over every live pipeline's recent window."""
         lat: list[float] = []
-        for inst in list(cls._instances):
+        with cls._registry_lock:
+            instances = list(cls._instances)
+        for inst in instances:
             with inst._lock:
                 lat.extend(inst._lat)
         if not lat:

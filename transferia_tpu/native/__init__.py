@@ -53,6 +53,14 @@ def _bind(cdll: ctypes.CDLL) -> ctypes.CDLL:
         u8, i32, ctypes.c_int64, u32, u32, u32, u32,
     ]
     cdll.polyhash_varcol.restype = None
+    if hasattr(cdll, "crc32c_buf"):
+        cdll.crc32c_buf.argtypes = [u8, ctypes.c_int64, ctypes.c_uint32]
+        cdll.crc32c_buf.restype = ctypes.c_uint32
+        cdll.kafka_encode_records.argtypes = [
+            u8, i64, ctypes.c_void_p, u8, i64, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_int64, u8, ctypes.c_int64,
+        ]
+        cdll.kafka_encode_records.restype = ctypes.c_int64
     # parquet-decoder symbols are OPTIONAL: a prebuilt .so from an older
     # source must keep serving the ops above rather than failing the load
     if hasattr(cdll, "pq_decode_fixed"):

@@ -383,4 +383,136 @@ void polyhash_varcol(const uint8_t* data, const int32_t* offsets,
     }
 }
 
+// ---------------------------------------------------------------------------
+// CRC32C (Castagnoli).  SSE4.2 hardware instruction when available,
+// software table otherwise.  Kafka RecordBatch v2 checksums every
+// produced batch; the Python table implementation was a visible slice of
+// the produce path.
+
+static uint32_t crc32c_table[256];
+static int crc32c_table_ready = 0;
+
+static void crc32c_init_table() {
+    for (uint32_t i = 0; i < 256; i++) {
+        uint32_t c = i;
+        for (int k = 0; k < 8; k++)
+            c = (c & 1) ? (0x82F63B78u ^ (c >> 1)) : (c >> 1);
+        crc32c_table[i] = c;
+    }
+    crc32c_table_ready = 1;
+}
+
+#if defined(__x86_64__)
+#include <cpuid.h>
+static int sse42_available() {
+    static int cached = -1;
+    if (cached < 0) {
+        unsigned a, b, c, d;
+        cached = __get_cpuid(1, &a, &b, &c, &d) ? ((c >> 20) & 1) : 0;
+    }
+    return cached;
+}
+
+__attribute__((target("sse4.2")))
+static uint32_t crc32c_hw(uint32_t crc, const uint8_t* p, int64_t n) {
+    uint64_t c = crc;
+    while (n >= 8) {
+        uint64_t w;
+        memcpy(&w, p, 8);
+        c = __builtin_ia32_crc32di(c, w);
+        p += 8;
+        n -= 8;
+    }
+    uint32_t c32 = (uint32_t)c;
+    while (n-- > 0) c32 = __builtin_ia32_crc32qi(c32, *p++);
+    return c32;
+}
+#else
+static int sse42_available() { return 0; }
+static uint32_t crc32c_hw(uint32_t crc, const uint8_t* p, int64_t n) {
+    (void)crc; (void)p; (void)n;
+    return 0;
+}
+#endif
+
+uint32_t crc32c_buf(const uint8_t* p, int64_t n, uint32_t init) {
+    uint32_t crc = init ^ 0xFFFFFFFFu;
+    if (sse42_available()) {
+        crc = crc32c_hw(crc, p, n);
+    } else {
+        if (!crc32c_table_ready) crc32c_init_table();
+        for (int64_t i = 0; i < n; i++)
+            crc = crc32c_table[(crc ^ p[i]) & 0xFF] ^ (crc >> 8);
+    }
+    return crc ^ 0xFFFFFFFFu;
+}
+
+// ---------------------------------------------------------------------------
+// Kafka RecordBatch v2 record-section encoder (the per-record varint
+// framing that dominated the produce path in Python).  Records carry no
+// headers (the sink emits none); ts_delta is per record.  Null keys or
+// values are flagged via the *_null arrays (varint -1 markers).
+// Returns bytes written, or -1 when out_cap is too small (caller sizes
+// out with the exact formula below, so -1 means a caller bug).
+
+static inline int64_t put_varint(uint8_t* out, int64_t v) {
+    uint64_t u = ((uint64_t)v << 1) ^ (uint64_t)(v >> 63);
+    int64_t i = 0;
+    while (u >= 0x80) {
+        out[i++] = (uint8_t)(u | 0x80);
+        u >>= 7;
+    }
+    out[i++] = (uint8_t)u;
+    return i;
+}
+
+int64_t kafka_encode_records(const uint8_t* key_data,
+                             const int64_t* key_off,
+                             const uint8_t* key_null,
+                             const uint8_t* val_data,
+                             const int64_t* val_off,
+                             const uint8_t* val_null,
+                             const int64_t* ts_delta,
+                             int64_t n, uint8_t* out, int64_t out_cap) {
+    uint8_t tmp[64];
+    int64_t pos = 0;
+    for (int64_t i = 0; i < n; i++) {
+        // body renders into tmp up to the key bytes; lengths first so the
+        // record-length prefix is known without a second pass
+        int64_t klen = key_null && key_null[i] ? -1
+                       : key_off[i + 1] - key_off[i];
+        int64_t vlen = val_null && val_null[i] ? -1
+                       : val_off[i + 1] - val_off[i];
+        int64_t hl = 0;
+        tmp[hl++] = 0;  // attributes
+        hl += put_varint(tmp + hl, ts_delta ? ts_delta[i] : 0);
+        hl += put_varint(tmp + hl, i);          // offset delta
+        hl += put_varint(tmp + hl, klen);
+        int64_t body_len = hl + (klen > 0 ? klen : 0);
+        // varint(vlen) + value + varint(0 headers)
+        uint8_t vtmp[16];
+        int64_t vl = put_varint(vtmp, vlen);
+        body_len += vl + (vlen > 0 ? vlen : 0) + 1;
+        uint8_t ltmp[16];
+        int64_t ll = put_varint(ltmp, body_len);
+        if (pos + ll + body_len > out_cap) return -1;
+        memcpy(out + pos, ltmp, (size_t)ll);
+        pos += ll;
+        memcpy(out + pos, tmp, (size_t)hl);
+        pos += hl;
+        if (klen > 0) {
+            memcpy(out + pos, key_data + key_off[i], (size_t)klen);
+            pos += klen;
+        }
+        memcpy(out + pos, vtmp, (size_t)vl);
+        pos += vl;
+        if (vlen > 0) {
+            memcpy(out + pos, val_data + val_off[i], (size_t)vlen);
+            pos += vlen;
+        }
+        out[pos++] = 0;  // header count varint(0)
+    }
+    return pos;
+}
+
 }  // extern "C"

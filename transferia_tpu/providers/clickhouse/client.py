@@ -40,8 +40,12 @@ class CHClient:
         self.settings = settings or {}
         # keep-alive: one persistent connection per thread (sink workers
         # push concurrently) — a connect+teardown per INSERT dominated the
-        # small-batch replication profile
+        # small-batch replication profile.  All pooled connections are
+        # tracked so close() can release them regardless of which thread
+        # created them.
         self._local = threading.local()
+        self._pool_lock = threading.Lock()
+        self._all_conns: list = []
 
     def _connect(self) -> http.client.HTTPConnection:
         cls = http.client.HTTPSConnection if self.secure \
@@ -53,6 +57,8 @@ class CHClient:
         if conn is None:
             conn = self._connect()
             self._local.conn = conn
+            with self._pool_lock:
+                self._all_conns.append(conn)
         return conn
 
     def _drop_pooled(self) -> None:
@@ -63,6 +69,22 @@ class CHClient:
             except OSError:
                 pass
             self._local.conn = None
+            with self._pool_lock:
+                try:
+                    self._all_conns.remove(conn)
+                except ValueError:
+                    pass
+
+    def close(self) -> None:
+        """Release every pooled connection (all threads)."""
+        with self._pool_lock:
+            conns, self._all_conns = self._all_conns, []
+        for conn in conns:
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._local = threading.local()
 
     def _params(self, query: str, extra: Optional[dict] = None) -> str:
         params = {

@@ -220,6 +220,11 @@ class CHSinker(Sinker):
             )
         return self._clients[shard_idx]
 
+    def close(self) -> None:
+        # keep-alive pools hold sockets until released
+        for client in self._clients.values():
+            client.close()
+
     def _ensure_table(self, shard_idx: int, batch: ColumnBatch) -> None:
         self.ensure_table(shard_idx, batch.table_id, batch.schema)
 
@@ -291,6 +296,9 @@ class CHStorage(Storage, SampleableStorage):
             secure=params.secure,
         )
         self._name_cache: dict[TableID, str] = {}
+
+    def close(self) -> None:
+        self.client.close()
 
     def table_list(self, include=None):
         rows = self.client.query_json(

@@ -18,7 +18,10 @@ try:
 
     def crc32c(data: bytes) -> int:
         return google_crc32c.value(data)
-except ImportError:  # pragma: no cover - slow pure-python fallback
+except ImportError:
+    # native SSE4.2 path (hostops.cpp crc32c_buf) with a pure-python
+    # table as the last resort; resolved lazily so importing this module
+    # never triggers a native build
     def _make_table():
         poly = 0x82F63B78
         table = []
@@ -29,13 +32,35 @@ except ImportError:  # pragma: no cover - slow pure-python fallback
             table.append(c)
         return table
 
-    _TABLE = _make_table()
-
-    def crc32c(data: bytes) -> int:
+    def _crc_py(data: bytes) -> int:
         crc = 0xFFFFFFFF
         for b in data:
             crc = _TABLE[(crc ^ b) & 0xFF] ^ (crc >> 8)
         return crc ^ 0xFFFFFFFF
+
+    _TABLE = _make_table()
+    _crc_impl = None
+
+    def crc32c(data: bytes) -> int:
+        global _crc_impl
+        if _crc_impl is None:
+            _crc_impl = _crc_py
+            try:
+                from transferia_tpu.native import lib as _native_lib
+
+                cdll = _native_lib()
+                if cdll is not None and hasattr(cdll, "crc32c_buf"):
+                    import numpy as _np
+
+                    def _crc_native(data: bytes,
+                                    _c=cdll.crc32c_buf, _np=_np) -> int:
+                        return int(_c(_np.frombuffer(data, _np.uint8),
+                                      len(data), 0))
+
+                    _crc_impl = _crc_native
+            except Exception:  # pragma: no cover - keep python fallback
+                pass
+        return _crc_impl(data)
 
 
 # -- primitive codecs --------------------------------------------------------
@@ -139,12 +164,65 @@ class Record:
 _CODEC_GZIP = 1
 
 
+def _encode_records_native(records: list[Record], now: int,
+                           base_ts: int) -> Optional[bytes]:
+    """Record section via the C encoder (hostops.cpp); None when out of
+    envelope (per-record headers) or the native lib is absent."""
+    try:
+        from transferia_tpu.native import lib as native_lib
+
+        cdll = native_lib()
+    except Exception:  # pragma: no cover
+        return None
+    if cdll is None or not hasattr(cdll, "kafka_encode_records"):
+        return None
+    if any(r.headers for r in records):
+        return None
+    import numpy as np
+
+    n = len(records)
+    key_parts = [r.key or b"" for r in records]
+    val_parts = [r.value or b"" for r in records]
+    key_off = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(k) for k in key_parts], out=key_off[1:])
+    val_off = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum([len(v) for v in val_parts], out=val_off[1:])
+    key_null = np.fromiter((r.key is None for r in records),
+                           dtype=np.uint8, count=n)
+    val_null = np.fromiter((r.value is None for r in records),
+                           dtype=np.uint8, count=n)
+    ts = [(r.timestamp_ms or now) - base_ts for r in records]
+    ts_arr = None
+    if any(ts):
+        ts_arr = np.asarray(ts, dtype=np.int64)
+    key_data = np.frombuffer(b"".join(key_parts), dtype=np.uint8) \
+        if key_off[-1] else np.zeros(0, dtype=np.uint8)
+    val_data = np.frombuffer(b"".join(val_parts), dtype=np.uint8) \
+        if val_off[-1] else np.zeros(0, dtype=np.uint8)
+    cap = int(key_off[-1] + val_off[-1]) + 64 * n + 64
+    out = np.empty(cap, dtype=np.uint8)
+    rc = cdll.kafka_encode_records(
+        key_data, key_off,
+        key_null.ctypes.data, val_data, val_off,
+        val_null.ctypes.data,
+        ts_arr.ctypes.data if ts_arr is not None else None,
+        n, out, cap)
+    if rc < 0:  # pragma: no cover - cap formula guarantees fit
+        return None
+    return out[:rc].tobytes()
+
+
 def encode_record_batch(records: list[Record],
                         base_offset: int = 0,
                         compression: str = "") -> bytes:
     """Records -> one RecordBatch v2 blob (optionally gzip-compressed)."""
     now = int(time.time() * 1000)
     base_ts = records[0].timestamp_ms or now if records else now
+    native = _encode_records_native(records, now, base_ts) \
+        if records else None
+    if native is not None:
+        return _finish_record_batch(records, native, base_offset,
+                                    compression, now, base_ts)
     # accumulate in a list: += on bytes is O(total^2) and a 20k-record
     # batch would copy gigabytes
     parts: list[bytes] = []
@@ -171,7 +249,13 @@ def encode_record_batch(records: list[Record],
         blob = b"".join(body)
         parts.append(enc_varint(len(blob)))
         parts.append(blob)
-    recs = b"".join(parts)
+    return _finish_record_batch(records, b"".join(parts), base_offset,
+                                compression, now, base_ts)
+
+
+def _finish_record_batch(records: list[Record], recs: bytes,
+                         base_offset: int, compression: str,
+                         now: int, base_ts: int) -> bytes:
     attrs = 0
     if compression == "gzip":
         import gzip as _gzip

@@ -541,13 +541,18 @@ class MySQLBinlogSource(Source):
 
             while not self._stop.is_set():
                 # probe with select; only read when a packet is pending so
-                # a short timeout can never abort mid-frame and desync
-                readable, _, _ = select.select([conn.sock], [], [], 0.3)
-                if not readable:
-                    if time.monotonic() - last_flush > 0.5:
-                        flush()
-                        last_flush = time.monotonic()
-                    continue
+                # a short timeout can never abort mid-frame and desync.
+                # BufferedSock may hold complete packets already pulled
+                # off the wire — drain those before consulting the kernel
+                # (select on the raw fd cannot see them)
+                if not getattr(conn.sock, "pending", lambda: 0)():
+                    readable, _, _ = select.select([conn.sock], [], [],
+                                                   0.3)
+                    if not readable:
+                        if time.monotonic() - last_flush > 0.5:
+                            flush()
+                            last_flush = time.monotonic()
+                        continue
                 pkt = conn._read_packet()
                 if pkt[:1] == b"\xff":
                     raise conn._err(pkt)

@@ -14,7 +14,7 @@ import struct
 from typing import Optional
 
 from transferia_tpu.abstract.errors import CategorizedError
-from transferia_tpu.utils.net import recv_exact
+from transferia_tpu.utils.net import BufferedSock, recv_exact
 
 CLIENT_LONG_PASSWORD = 0x1
 CLIENT_PROTOCOL_41 = 0x200
@@ -105,9 +105,14 @@ class MySQLConnection:
 
     # -- handshake ----------------------------------------------------------
     def connect(self) -> "MySQLConnection":
-        self.sock = socket.create_connection((self.host, self.port),
-                                             timeout=self.timeout)
-        self.sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        raw = socket.create_connection((self.host, self.port),
+                                       timeout=self.timeout)
+        raw.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # MySQL frames rows as individual packets: raw per-packet recv is
+        # 2+ syscalls per ROW during snapshots.  Buffered reads refill in
+        # 256KiB chunks (binlog.py probes pending() before select so
+        # buffered frames never stall the stream)
+        self.sock = BufferedSock(raw)
         self._seq = 0
         greeting = self._read_packet()
         if greeting[:1] == b"\xff":

@@ -177,6 +177,13 @@ class LambdaTransformer(Transformer):
             while m < n_rows:
                 m <<= 1
             if m != n_rows:
+                if not getattr(self, "_bucket_logged", False):
+                    self._bucket_logged = True
+                    logger.info(
+                        "lambda %s: shape bucketing active (inputs pad "
+                        "to power-of-2 rows; per-ROW fns only — a fn "
+                        "computing across the row axis must set "
+                        "bucket: false)", self.fn_name)
                 pad = m - n_rows
                 run_arrays = {
                     k: np.concatenate([v, np.zeros(pad, v.dtype)])
