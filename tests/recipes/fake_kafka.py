@@ -25,13 +25,22 @@ from transferia_tpu.providers.kafka.protocol import (
 def _index_frames(blob: bytes) -> Optional[list]:
     """[(frame_pos, record_count)] straight from the batch header(s) —
     no decode.  recordCount sits at fixed offset 57 of each v2 frame."""
+    from transferia_tpu.providers.kafka.protocol import crc32c
+
     frames = []
     pos = 0
     n = len(blob)
     while pos + 61 <= n:
         batch_len = struct.unpack_from("!i", blob, pos + 8)[0]
         magic = blob[pos + 16]
-        if magic != 2:
+        # a non-positive length would loop forever; corrupt frames must
+        # land on the eager-decode path, which raises on produce
+        if magic != 2 or batch_len <= 0 or pos + 12 + batch_len > n:
+            return None
+        # brokers validate the CRC at append time; so does this fake —
+        # a corrupt batch errors the PRODUCER, not a later consumer
+        expect = struct.unpack_from("!I", blob, pos + 17)[0]
+        if crc32c(blob[pos + 21:pos + 12 + batch_len]) != expect:
             return None
         frames.append((pos, struct.unpack_from("!i", blob, pos + 57)[0]))
         pos += 12 + batch_len

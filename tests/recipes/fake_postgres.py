@@ -12,6 +12,7 @@ import csv
 import hashlib
 import hmac
 import io
+import json
 import re
 import socket
 import socketserver
@@ -37,11 +38,16 @@ class FakeTable:
 
 
 class FakePG:
-    def __init__(self, password: str = "", scram: bool = False):
+    def __init__(self, password: str = "", scram: bool = False,
+                 echo_dml_to_wal: bool = False):
+        """echo_dml_to_wal: INSERT/UPDATE/DELETE statements also emit
+        wal2json events, like real logical decoding — the DBLog e2e needs
+        its signal-table writes echoed into the CDC stream."""
         self.tables: dict[tuple[str, str], FakeTable] = {}
         self.queries: list[str] = []
         self.password = password
         self.scram = scram
+        self.echo_dml_to_wal = echo_dml_to_wal
         self.lock = threading.RLock()
         self.port = 0
         self._srv = None
@@ -564,16 +570,41 @@ class _Session:
                     for v in re.split(r",(?=(?:[^']*'[^']*')*[^']*$)",
                                       m.group(4).split(" ON CONFLICT")[0])]
             t.rows.append(dict(zip(cols, vals)))
+            if fake.echo_dml_to_wal:
+                types = {c[0]: c[1] for c in t.columns}
+                fake.feed_wal(json.dumps({
+                    "action": "I",
+                    "schema": m.group(1), "table": m.group(2),
+                    "columns": [
+                        {"name": c, "type": types.get(c, "text"),
+                         "value": v}
+                        for c, v in zip(cols, vals)
+                    ],
+                    "pk": [{"name": c[0], "type": c[1]}
+                           for c in t.columns if c[2]],
+                }).encode())
             return
         m = re.match(r'delete from "?(\w+)"?\."?(\w+)"? where (.*)', sql,
                      re.I | re.S)
         if m:
             t = fake.tables.get((m.group(1), m.group(2)))
             cond = self._parse_where(m.group(3))
-            t.rows = [
-                r for r in t.rows
-                if not all(str(r.get(k)) == v for k, v in cond.items())
-            ]
+            gone = [r for r in t.rows
+                    if all(str(r.get(k)) == v for k, v in cond.items())]
+            t.rows = [r for r in t.rows if r not in gone]
+            if fake.echo_dml_to_wal:
+                types = {c[0]: c[1] for c in t.columns}
+                pks = [c[0] for c in t.columns if c[2]]
+                for r in gone:
+                    fake.feed_wal(json.dumps({
+                        "action": "D",
+                        "schema": m.group(1), "table": m.group(2),
+                        "identity": [
+                            {"name": k, "type": types.get(k, "text"),
+                             "value": r.get(k)} for k in pks],
+                        "pk": [{"name": k, "type": types.get(k, "text")}
+                               for k in pks],
+                    }).encode())
             return
         m = re.match(r'update "?(\w+)"?\."?(\w+)"? set (.*) where (.*)',
                      sql, re.I | re.S)
@@ -584,6 +615,24 @@ class _Session:
             for r in t.rows:
                 if all(str(r.get(k)) == v for k, v in cond.items()):
                     r.update(sets)
+                    if fake.echo_dml_to_wal:
+                        types = {c[0]: c[1] for c in t.columns}
+                        pks = [c[0] for c in t.columns if c[2]]
+                        fake.feed_wal(json.dumps({
+                            "action": "U",
+                            "schema": m.group(1), "table": m.group(2),
+                            "columns": [
+                                {"name": k,
+                                 "type": types.get(k, "text"),
+                                 "value": v} for k, v in r.items()],
+                            "identity": [
+                                {"name": k,
+                                 "type": types.get(k, "text"),
+                                 "value": r.get(k)} for k in pks],
+                            "pk": [{"name": k,
+                                    "type": types.get(k, "text")}
+                                   for k in pks],
+                        }).encode())
             return
 
     @staticmethod

@@ -86,6 +86,8 @@ class DebeziumEmitter:
         # batch and never mutated; an ALTER produces a new object
         self._value_schema_cache: dict = {}
         self._key_schema_cache: dict = {}
+        # rendered %s-templates for the vectorized columnar path
+        self._fast_tmpl_cache: dict = {}
         if packer == "schema_registry":
             from transferia_tpu.debezium.packer import SchemaRegistryPacker
             from transferia_tpu.schemaregistry import SchemaRegistryClient
@@ -397,24 +399,21 @@ class DebeziumEmitter:
             if frags is None:
                 return None
             frag_by_name[cs.name] = frags
+        return self._render_fast(batch, schema, names, key_cols,
+                                 frag_by_name, snapshot)
 
-        tid = batch.table_id
-        item_schema, item_table = tid.namespace, tid.name
-
+    def _build_templates(self, schema, names, key_cols, item_schema,
+                         item_table, snapshot) -> tuple:
+        """All static envelope bytes as %s-templates (cached upstream)."""
         def esc(s: str) -> str:
             # static json text going into a %-template
             return json.dumps(s, separators=(",", ":"),
                               default=str).replace("%", "%%")
 
-        # -- templates (all static bytes render once) -----------------------
         after_fmt = "{" + ",".join(esc(n) + ":%s" for n in names) + "}"
         key_payload_fmt = "{" + ",".join(
             esc(c.name) + ":%s" for c in key_cols) + "}"
-
         op = "r" if snapshot else "c"
-        now_ms = int(time.time() * 1000)
-
-        # source block: ts_ms/lsn/txId vary per row when sidecars exist
         src_fmt = (
             '{"version":' + esc(self.VERSION)
             + ',"connector":' + esc(self.connector)
@@ -426,6 +425,50 @@ class DebeziumEmitter:
             + ',"table":' + esc(item_table)
             + ',"lsn":%s,"txId":%s}'
         )
+        env_core = ('{"before":null,"after":%s,"source":%s,"op":"' + op
+                    + '","ts_ms":\x00TS\x00}')
+        if self.include_schema:
+            # only schema-block naming reads .schema/.table off the item
+            class _Shim:
+                schema = item_schema
+                table = item_table
+
+            shim = _Shim()
+            vschema = json.dumps(self._value_schema(shim, schema),
+                                 separators=(",", ":"), default=str)
+            kschema = json.dumps(self._key_schema(shim, schema),
+                                 separators=(",", ":"), default=str)
+            value_fmt = ('{"schema":' + vschema.replace("%", "%%")
+                         + ',"payload":' + env_core + "}")
+            key_fmt = ('{"schema":' + kschema.replace("%", "%%")
+                       + ',"payload":' + key_payload_fmt + "}")
+        else:
+            value_fmt = env_core
+            key_fmt = key_payload_fmt
+        return after_fmt, key_fmt, value_fmt, src_fmt
+
+    def _render_fast(self, batch: ColumnBatch, schema, names, key_cols,
+                     frag_by_name: dict, snapshot: bool) -> list:
+
+        tid = batch.table_id
+        item_schema, item_table = tid.namespace, tid.name
+        now_ms = int(time.time() * 1000)
+
+        # -- templates: ALL static bytes (incl. the full schema blocks)
+        # render once per (table, schema, mode) and cache — re-dumping a
+        # multi-KB schema json per small CDC batch would dwarf the row
+        # rendering this path accelerates.  \x00TS\x00 marks the
+        # envelope timestamp slot (a NUL can never appear in json text)
+        cache_key = (item_schema, item_table, id(schema), snapshot)
+        tmpl = self._fast_tmpl_cache.get(cache_key)
+        if tmpl is None:
+            tmpl = self._build_templates(schema, names, key_cols,
+                                         item_schema, item_table,
+                                         snapshot)
+            self._fast_tmpl_cache[cache_key] = tmpl
+        after_fmt, key_fmt_t, value_fmt_t, src_fmt = tmpl
+        key_fmt = key_fmt_t
+        value_fmt = value_fmt_t.replace("\x00TS\x00", str(now_ms))
         n = batch.n_rows
         if batch.commit_times is not None:
             ts_list = [str(t // 1_000_000) if t else str(now_ms)
@@ -451,28 +494,6 @@ class DebeziumEmitter:
             txn_it = txn_list or ["null"] * n
             src_strs = list(map(src_fmt.__mod__,
                                 zip(ts_it, lsn_it, txn_it)))
-
-        # ChangeItem carries a representative ChangeItem only for schema
-        # block naming — build the fqtn pieces directly
-        class _Shim:
-            schema = item_schema
-            table = item_table
-
-        shim = _Shim()
-        env_core = ('{"before":null,"after":%s,"source":%s,"op":"' + op
-                    + '","ts_ms":' + str(now_ms) + "}")
-        if self.include_schema:
-            vschema = json.dumps(self._value_schema(shim, schema),
-                                 separators=(",", ":"), default=str)
-            kschema = json.dumps(self._key_schema(shim, schema),
-                                 separators=(",", ":"), default=str)
-            value_fmt = ('{"schema":' + vschema.replace("%", "%%")
-                         + ',"payload":' + env_core + "}")
-            key_fmt = ('{"schema":' + kschema.replace("%", "%%")
-                       + ',"payload":' + key_payload_fmt + "}")
-        else:
-            value_fmt = env_core
-            key_fmt = key_payload_fmt
 
         col_frags = [frag_by_name[nm] for nm in names]
         after_strs = list(map(after_fmt.__mod__, zip(*col_frags)))

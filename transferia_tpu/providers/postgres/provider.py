@@ -110,6 +110,12 @@ class PGSourceParams(EndpointParams):
     batch_rows: int = 131_072
     desired_part_size_bytes: int = 256 << 20  # ctid split target
     slot_name: str = ""                        # replication slot (CDC)
+    # DBLog incremental snapshot (provider.go:443 DBLogUpload): chunked
+    # watermark-fenced snapshot interleaved with live replication.
+    # Tables need a single-column primary key; empty list = all tables.
+    dblog_snapshot: bool = False
+    dblog_chunk_rows: int = 10_000
+    dblog_tables: list[str] = field(default_factory=list)
 
 
 @register_endpoint

@@ -264,6 +264,7 @@ class PGReplicationSource(Source):
 
     def run(self, sink: AsyncSink) -> None:
         conn = self._connect()
+        dblog = None
         try:
             start_lsn = "0/0"
             if self.cp is not None:
@@ -273,6 +274,23 @@ class PGReplicationSource(Source):
             if start_lsn == "0/0":
                 start_lsn = self.ensure_slot(conn) or "0/0"
             conn.start_replication(self.slot, start_lsn)
+            if getattr(self.params, "dblog_snapshot", False):
+                from transferia_tpu.providers.postgres.dblog import (
+                    PGDBLogRunner,
+                )
+
+                # the runner's filter stays wired even after completion:
+                # residual signal-table echoes replayed from the slot
+                # must never reach the target
+                dblog = PGDBLogRunner(
+                    self.params, self.transfer_id, self.cp,
+                    chunk_rows=self.params.dblog_chunk_rows,
+                    tables=self.params.dblog_tables or None,
+                )
+                if not dblog.already_done():
+                    dblog.start()
+                else:
+                    dblog.done.set()
             items: list[ChangeItem] = []
             futures: list = []
             flushed = lsn_to_int(start_lsn) if start_lsn != "0/0" else 0
@@ -284,12 +302,23 @@ class PGReplicationSource(Source):
                 if not items:
                     return
                 for run in _split_homogeneous(items):
+                    batch: object
                     if run[0].is_row_event() and run[0].table_schema:
-                        futures.append(
-                            sink.async_push(ColumnBatch.from_rows(run))
-                        )
+                        batch = ColumnBatch.from_rows(run)
                     else:
-                        futures.append(sink.async_push(run))
+                        batch = run
+                    if dblog is not None:
+                        # watermark fencing: signal rows are consumed,
+                        # pending chunks emit inline at this position
+                        batch = dblog.filter(batch)
+                        if dblog.error is not None:
+                            raise dblog.error
+                        if isinstance(batch, list) and not batch:
+                            continue
+                        if (isinstance(batch, ColumnBatch)
+                                and batch.n_rows == 0):
+                            continue
+                    futures.append(sink.async_push(batch))
                 items = []
 
             def confirm():
