@@ -1,0 +1,142 @@
+"""In-process fake Greenplum: FakePG plus the segment side of gpfdist.
+
+The provider's segment-direct path issues only CONTROL statements over
+the master connection (CREATE EXTERNAL TABLE / INSERT...SELECT); the
+data moves between "segments" and the worker's gpfdist HTTP endpoint.
+This fake plays the segments: on INSERT INTO a writable external table
+it splits the source rows across n_segments and POSTs each share as CSV
+to the table's gpfdist location (with the X-GP headers and the final
+X-GP-DONE marker); on INSERT...SELECT from a readable external table it
+GETs CSV chunks until an empty body and stores the rows.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import re
+import threading
+import urllib.request
+
+from tests.recipes.fake_postgres import FakePG
+
+_CREATE_EXT = re.compile(
+    r"create (writable |readable )?external table "
+    r'"?([\w]+)"?\."?([\w]+)"? \((.*?)\) '
+    r"location \('gpfdist://([^']+)'\) format 'csv'", re.I)
+_LIKE = re.compile(r'like "?([\w]+)"?\."?([\w]+)"?', re.I)
+_DROP_EXT = re.compile(
+    r'drop external table (?:if exists )?"?([\w]+)"?\."?([\w]+)"?', re.I)
+_INSERT_SELECT = re.compile(
+    r'insert into "?([\w]+)"?\."?([\w]+)"?(?: \(([^)]*)\))? '
+    r'select (.*?) from "?([\w]+)"?\."?([\w]+)"?\s*$', re.I | re.S)
+
+
+class FakeGP(FakePG):
+    def __init__(self, n_segments: int = 4, **kw):
+        super().__init__(**kw)
+        self.n_segments = n_segments
+        # (ns, name) -> {"mode": "w"|"r", "url": ..., "like": (ns, name)}
+        self.ext_tables: dict[tuple, dict] = {}
+        self.sql_hook = self._gp_sql
+
+    # -- segment data plane --------------------------------------------------
+    def _segment_post(self, url: str, seg: int, rows: list[dict],
+                      columns: list[str]) -> None:
+        buf = io.StringIO()
+        w = csv.writer(buf, lineterminator="\n")
+        for r in rows:
+            w.writerow([r.get(c, "") for c in columns])
+        headers = {
+            "X-GP-XID": "fake-xid",
+            "X-GP-SEGMENT-ID": str(seg),
+            "X-GP-SEGMENT-COUNT": str(self.n_segments),
+            "Content-Type": "text/csv",
+        }
+        req = urllib.request.Request(
+            url, data=buf.getvalue().encode(), headers=headers,
+            method="POST")
+        urllib.request.urlopen(req, timeout=30).read()
+        done = urllib.request.Request(
+            url, data=b"", headers={**headers, "X-GP-DONE": "1"},
+            method="POST")
+        urllib.request.urlopen(done, timeout=30).read()
+
+    def _segment_get_all(self, url: str) -> list[list[str]]:
+        out: list[list[str]] = []
+        while True:
+            req = urllib.request.Request(url, headers={
+                "X-GP-XID": "fake-xid",
+                "X-GP-SEGMENT-ID": "0",
+                "X-GP-SEGMENT-COUNT": str(self.n_segments),
+            })
+            body = urllib.request.urlopen(req, timeout=30).read()
+            if not body:
+                return out
+            out.extend(csv.reader(io.StringIO(
+                body.decode("utf-8", "replace"))))
+
+    # -- control-plane hook ---------------------------------------------------
+    def _gp_sql(self, sql: str, low: str, session) -> bool:
+        if "from gp_segment_configuration" in low:
+            session.send_rows(["count"], [[self.n_segments]])
+            return True
+        m = _CREATE_EXT.match(" ".join(sql.split()))
+        if m:
+            mode = "r" if (m.group(1) or "").strip().lower() \
+                == "readable" else "w"
+            body = m.group(4)
+            lk = _LIKE.match(body.strip())
+            self.ext_tables[(m.group(2), m.group(3))] = {
+                "mode": mode,
+                "url": "http://" + m.group(5),
+                "like": (lk.group(1), lk.group(2)) if lk else None,
+            }
+            session.send(b"C", b"CREATE EXTERNAL TABLE\x00")
+            return True
+        m = _DROP_EXT.match(" ".join(sql.split()))
+        if m:
+            self.ext_tables.pop((m.group(1), m.group(2)), None)
+            session.send(b"C", b"DROP EXTERNAL TABLE\x00")
+            return True
+        m = _INSERT_SELECT.match(" ".join(sql.split()))
+        if m:
+            dst = (m.group(1), m.group(2))
+            src = (m.group(5), m.group(6))
+            ext = self.ext_tables.get(dst)
+            if ext is not None and ext["mode"] == "w":
+                # unload: play the segments POSTing the source's rows
+                table = self.tables[src]
+                cols = [c.strip().strip('"')
+                        for c in m.group(4).split(",")] \
+                    if m.group(4).strip() != "*" \
+                    else [c[0] for c in table.columns]
+                rows = list(table.rows)
+                n = self.n_segments
+                threads = []
+                for seg in range(n):
+                    share = rows[seg::n]
+                    th = threading.Thread(
+                        target=self._segment_post,
+                        args=(ext["url"], seg, share, cols),
+                        daemon=True)
+                    th.start()
+                    threads.append(th)
+                for th in threads:
+                    th.join(timeout=60)
+                session.send(b"C", f"INSERT 0 {len(rows)}\x00".encode())
+                return True
+            ext = self.ext_tables.get(src)
+            if ext is not None and ext["mode"] == "r":
+                # load: play the segments GETting chunks until EOF
+                target = self.tables[dst]
+                cols = [c.strip().strip('"')
+                        for c in m.group(3).split(",")] \
+                    if m.group(3) else [c[0] for c in target.columns]
+                got = self._segment_get_all(ext["url"])
+                for vals in got:
+                    target.rows.append(dict(zip(cols, vals)))
+                session.send(b"C",
+                             f"INSERT 0 {len(got)}\x00".encode())
+                return True
+        return False

@@ -246,6 +246,10 @@ class _Session:
     def dispatch(self, sql: str):
         low = " ".join(sql.lower().split())
         fake = self.fake
+        # subclass hook (FakeGP external tables etc.): truthy = handled
+        hook = getattr(fake, "sql_hook", None)
+        if hook is not None and hook(sql, low, self):
+            return None
         if low == "select 1":
             return self.send_rows(["?column?"], [[1]])
         if low == "identify_system":

@@ -158,7 +158,80 @@ class FakeYT:
                 return self._read_table(q["path"])
             if command == "write_table":
                 return self._write_table(q["path"], body)
+            if command == "mount_table":
+                node = self._node(q["path"])
+                if not node["attrs"].get("dynamic"):
+                    raise ValueError("cannot mount a static table")
+                node["attrs"]["tablet_state"] = "mounted"
+                node["attrs"].setdefault(
+                    "pivot_keys",
+                    node["attrs"].pop("_pivot_keys_on_mount", [[]]))
+                node.setdefault("keyed_rows", {})
+                return {}
+            if command == "unmount_table":
+                node = self._node(q["path"])
+                node["attrs"]["tablet_state"] = "unmounted"
+                return {}
+            if command == "insert_rows":
+                return self._insert_rows(q, body)
+            if command == "delete_rows":
+                return self._delete_rows(q, body)
         raise ValueError(f"unknown command {command}")
+
+    # -- dynamic tables -----------------------------------------------------
+    def _dyn_node(self, path: str) -> dict:
+        node = self._node(path)
+        if not node["attrs"].get("dynamic"):
+            raise ValueError(f"{path} is not dynamic")
+        if node["attrs"].get("tablet_state") != "mounted":
+            raise ValueError(f"{path} is not mounted")
+        return node
+
+    def _key_names(self, node: dict) -> list[str]:
+        return [c["name"] for c in node["attrs"].get("schema", [])
+                if c.get("sort_order")]
+
+    def _insert_rows(self, q: dict, body: bytes):
+        node = self._dyn_node(q["path"])
+        rows = [json.loads(line) for line in body.splitlines()
+                if line.strip()]
+        schema = {c["name"] for c in node["attrs"].get("schema", [])}
+        for r in rows:
+            unknown = set(r) - schema
+            if unknown:
+                raise ValueError(
+                    f"columns {sorted(unknown)} not in schema")
+        keys = self._key_names(node)
+        if keys:  # sorted dyntable: upsert by key
+            update = json.loads(q.get("update", "false"))
+            store = node.setdefault("keyed_rows", {})
+            for r in rows:
+                k = tuple(r.get(n) for n in keys)
+                if update and k in store:
+                    store[k].update(r)
+                else:
+                    store[k] = dict(r)
+            node["rows"] = [store[k] for k in sorted(
+                store, key=lambda t: tuple(
+                    (v is None, v) for v in t))]
+        else:     # ordered dyntable: append-only log
+            node["rows"].extend(rows)
+        return {}
+
+    def _delete_rows(self, q: dict, body: bytes):
+        node = self._dyn_node(q["path"])
+        keys = self._key_names(node)
+        if not keys:
+            raise ValueError("delete_rows needs a sorted table")
+        store = node.setdefault("keyed_rows", {})
+        for line in body.splitlines():
+            if not line.strip():
+                continue
+            r = json.loads(line)
+            store.pop(tuple(r.get(n) for n in keys), None)
+        node["rows"] = [store[k] for k in sorted(
+            store, key=lambda t: tuple((v is None, v) for v in t))]
+        return {}
 
     def _node(self, path: str) -> dict:
         node = self.nodes.get(path)

@@ -1,0 +1,175 @@
+"""In-process gpfdist endpoint: Greenplum's segment-direct data path.
+
+Reference: pkg/providers/greenplum/gpfdist/ + gpfdist_storage.go /
+gpfdist_sink.go — the reference shells out to the actual gpfdist binary
+and reads named pipes; here the worker IS the gpfdist endpoint: a small
+HTTP server speaking the protocol subset Greenplum segments use for
+external tables, so table data flows segment -> worker (unload) or
+worker -> segment (load) WITHOUT passing through the master connection.
+The master connection only runs the control statements (CREATE EXTERNAL
+TABLE / INSERT ... SELECT).
+
+Protocol subset (the simple gpfdist HTTP exchange):
+  - segments identify with X-GP-SEGMENT-ID / X-GP-SEGMENT-COUNT and a
+    transfer id X-GP-XID
+  - unload (WRITABLE EXTERNAL TABLE): each segment POSTs its rows as
+    CSV chunks to gpfdist://host:port/<slot>; a final empty POST with
+    X-GP-DONE: 1 closes that segment's stream
+  - load (READABLE EXTERNAL TABLE): segments GET the same URL; every
+    response hands out the next pending CSV chunk, an empty 200 body
+    means end of data
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Optional
+
+logger = logging.getLogger(__name__)
+
+
+class GpfdistServer:
+    """One slot per concurrent table transfer.
+
+    Unload: register_sink(slot, on_chunk) routes segment POST bodies to
+    the callback (called from server threads — callbacks synchronize);
+    wait_done(slot, n_segments) blocks until every segment finished.
+    Load: put_chunk(slot, data) queues CSV chunks; finish(slot) marks
+    EOF (subsequent GETs drain the queue, then read empty)."""
+
+    def __init__(self, host: str = "127.0.0.1"):
+        self.host = host
+        self._sinks: dict[str, Callable[[bytes], None]] = {}
+        self._done: dict[str, set] = {}
+        self._done_ev: dict[str, threading.Event] = {}
+        self._expect: dict[str, int] = {}
+        self._out: dict[str, queue.Queue] = {}
+        self._finished: set[str] = set()
+        self._lock = threading.Lock()
+        self.port = 0
+        self._srv: Optional[ThreadingHTTPServer] = None
+
+    # -- lifecycle -----------------------------------------------------------
+    def start(self) -> "GpfdistServer":
+        server = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def _slot(self):
+                return self.path.lstrip("/").split("?")[0]
+
+            def do_POST(self):
+                slot = self._slot()
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length)
+                seg = self.headers.get("X-GP-SEGMENT-ID", "0")
+                done = self.headers.get("X-GP-DONE")
+                try:
+                    server._on_post(slot, seg, body, bool(done))
+                except Exception as e:  # surfaces via wait_done timeout
+                    logger.error("gpfdist sink error: %s", e)
+                    self.send_response(500)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
+                self.send_response(200)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def do_GET(self):
+                slot = self._slot()
+                data = server._next_chunk(slot)
+                self.send_response(200)
+                self.send_header("Content-Type", "text/csv")
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                if data:
+                    self.wfile.write(data)
+
+            def log_message(self, *a):  # quiet
+                pass
+
+        self._srv = ThreadingHTTPServer((self.host, 0), Handler)
+        self.port = self._srv.server_address[1]
+        threading.Thread(target=self._srv.serve_forever,
+                         daemon=True).start()
+        return self
+
+    def stop(self) -> None:
+        if self._srv:
+            self._srv.shutdown()
+            self._srv.server_close()
+
+    def location(self, slot: str) -> str:
+        return f"gpfdist://{self.host}:{self.port}/{slot}"
+
+    # -- unload (segments POST) ----------------------------------------------
+    def register_sink(self, slot: str,
+                      on_chunk: Callable[[str, bytes, bool], None],
+                      n_segments: int) -> None:
+        """on_chunk(segment_id, body, done): bodies arrive PER SEGMENT at
+        arbitrary byte boundaries — reframing state must key on the
+        segment id, never be shared across segments."""
+        with self._lock:
+            self._sinks[slot] = on_chunk
+            self._done[slot] = set()
+            self._done_ev[slot] = threading.Event()
+            self._expect[slot] = n_segments
+
+    def _on_post(self, slot: str, seg: str, body: bytes,
+                 done: bool) -> None:
+        sink = self._sinks.get(slot)
+        if sink is None:
+            raise KeyError(f"unknown gpfdist slot {slot!r}")
+        if body or done:
+            sink(seg, body, done)
+        if done:
+            with self._lock:
+                self._done[slot].add(seg)
+                if len(self._done[slot]) >= self._expect[slot]:
+                    self._done_ev[slot].set()
+
+    def wait_done(self, slot: str, timeout: float = 600.0) -> None:
+        ev = self._done_ev[slot]
+        if not ev.wait(timeout):
+            with self._lock:
+                got = len(self._done.get(slot, ()))
+                want = self._expect.get(slot, 0)
+            raise TimeoutError(
+                f"gpfdist unload {slot}: {got}/{want} segments "
+                f"finished within {timeout}s")
+
+    def release(self, slot: str) -> None:
+        with self._lock:
+            self._sinks.pop(slot, None)
+            self._done.pop(slot, None)
+            self._done_ev.pop(slot, None)
+            self._expect.pop(slot, None)
+            self._out.pop(slot, None)
+            self._finished.discard(slot)
+
+    # -- load (segments GET) --------------------------------------------------
+    def put_chunk(self, slot: str, data: bytes) -> None:
+        with self._lock:
+            q = self._out.setdefault(slot, queue.Queue())
+        q.put(data)
+
+    def finish(self, slot: str) -> None:
+        with self._lock:
+            self._finished.add(slot)
+            self._out.setdefault(slot, queue.Queue())
+
+    def _next_chunk(self, slot: str) -> bytes:
+        with self._lock:
+            q = self._out.setdefault(slot, queue.Queue())
+        while True:
+            try:
+                return q.get(timeout=0.2)
+            except queue.Empty:
+                with self._lock:
+                    if slot in self._finished and q.empty():
+                        return b""

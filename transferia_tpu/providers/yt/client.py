@@ -189,5 +189,44 @@ class YTClient:
             headers={"X-YT-Input-Format": '"json"',
                      "Content-Type": "application/x-ndjson"})
 
+    # -- dynamic tables ------------------------------------------------------
+    def mount_table(self, path: str) -> None:
+        self._request("POST", "mount_table", {"path": path})
+
+    def unmount_table(self, path: str) -> None:
+        self._request("POST", "unmount_table", {"path": path})
+
+    def tablet_state(self, path: str) -> str:
+        return self.get(path + "/@tablet_state", "unmounted")
+
+    def pivot_keys(self, path: str) -> list:
+        """Per-tablet pivot keys of a mounted sorted dyntable (the first
+        tablet's pivot is the empty key)."""
+        return self.get(path + "/@pivot_keys", [[]])
+
+    def insert_rows(self, path: str, rows: list[dict],
+                    update: bool = False,
+                    atomicity: str = "full") -> None:
+        """Upsert into a mounted sorted dyntable (ordered tables append)."""
+        body = b"".join(
+            json.dumps(r, default=str).encode() + b"\n" for r in rows)
+        self._request(
+            "PUT", "insert_rows",
+            {"path": path, "update": update, "atomicity": atomicity},
+            body=body,
+            headers={"X-YT-Input-Format": '"json"',
+                     "Content-Type": "application/x-ndjson"})
+
+    def delete_rows(self, path: str, keys: list[dict],
+                    atomicity: str = "full") -> None:
+        """Delete by key from a mounted sorted dyntable."""
+        body = b"".join(
+            json.dumps(k, default=str).encode() + b"\n" for k in keys)
+        self._request(
+            "PUT", "delete_rows", {"path": path, "atomicity": atomicity},
+            body=body,
+            headers={"X-YT-Input-Format": '"json"',
+                     "Content-Type": "application/x-ndjson"})
+
     def ping(self) -> None:
         self.exists("//")

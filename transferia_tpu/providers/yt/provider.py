@@ -21,10 +21,12 @@ boundary so arbitrary bytes round-trip.
 from __future__ import annotations
 
 import logging
+import time
 from dataclasses import dataclass, field
 from typing import Optional
 
 from transferia_tpu.abstract.errors import CategorizedError
+from transferia_tpu.abstract.kinds import Kind
 from transferia_tpu.abstract.interfaces import (
     Batch,
     Pusher,
@@ -117,6 +119,32 @@ class YTStaticTargetParams(EndpointParams):
     secure: bool = False
     cleanup_policy: CleanupPolicy = CleanupPolicy.DROP
     optimize_for: str = "scan"    # scan (columnar chunks) | lookup
+
+
+@register_endpoint
+@dataclass
+class YTDynamicTargetParams(EndpointParams):
+    """Dynamic-table destination (reference:
+    pkg/providers/yt/model_ytsaurus_dynamic_destination.go + sink/):
+    sorted dyntables take CDC upserts/deletes via the tablet write API;
+    ordered dyntables append.  Tables are created dynamic, mounted, and
+    writes wait for the mounted tablet state."""
+
+    PROVIDER = "yt_dyn"
+    IS_TARGET = True
+
+    proxy: str = "localhost:80"
+    dir: str = "//home/transfer"
+    token: str = ""
+    secure: bool = False
+    cleanup_policy: CleanupPolicy = CleanupPolicy.DROP
+    ordered: bool = False        # True: ordered dyntable (append-only)
+    tablet_count: int = 0        # 0 = cluster default
+    atomicity: str = "full"      # full | none (per-tablet atomic only)
+    # per-request row cap; requests additionally split at tablet
+    # boundaries (pivot keys) so each write lands in one tablet
+    batch_rows: int = 20_000
+    mount_timeout: float = 60.0
 
 
 def _split_path(path: str) -> TableID:
@@ -314,6 +342,170 @@ class YTStaticSinker(Sinker):
             append=True)
 
 
+class YTDynamicSinker(Sinker):
+    """Sorted/ordered dynamic-table sink over the HTTP proxy
+    (reference: pkg/providers/yt/sink/ — per-tablet batched writes;
+    model_ytsaurus_dynamic_destination.go for the endpoint surface).
+
+    Sorted mode: INSERT/UPDATE upsert via insert_rows, DELETE removes by
+    key via delete_rows — the ReplacingMergeTree-free equivalent of the
+    reference's dyntable sink (dyntables ARE keyed stores, so CDC maps
+    1:1).  Kind runs flush in arrival order, preserving per-key
+    ordering.  Ordered mode: append-only insert_rows, no keys.
+
+    Tablet-aware batching: each request carries rows for ONE tablet
+    (split on the table's pivot keys), so the proxy never coordinates a
+    cross-tablet 2PC for bulk loads."""
+
+    def __init__(self, params: YTDynamicTargetParams):
+        self.params = params
+        self.client = YTClient(params.proxy, token=params.token,
+                               secure=params.secure)
+        self._ready: set[TableID] = set()
+        self._pivots: dict[TableID, list] = {}
+
+    # -- table lifecycle -----------------------------------------------------
+    def _ensure_table(self, table: TableID, schema: TableSchema) -> None:
+        if table in self._ready:
+            return
+        path = _join_path(self.params.dir, table)
+        if not self.client.exists(path):
+            yt_schema = _schema_to_yt(schema)
+            if self.params.ordered:
+                # ordered dyntables are keyless logs
+                for entry in yt_schema:
+                    entry.pop("sort_order", None)
+            attrs = {"schema": yt_schema, "dynamic": True}
+            if self.params.tablet_count:
+                attrs["tablet_count"] = self.params.tablet_count
+            self.client.create("table", path, attributes=attrs,
+                               recursive=True, ignore_existing=True)
+        if self.client.tablet_state(path) != "mounted":
+            self.client.mount_table(path)
+            deadline = time.monotonic() + self.params.mount_timeout
+            while self.client.tablet_state(path) != "mounted":
+                if time.monotonic() > deadline:
+                    raise YTError(
+                        f"{path}: tablets not mounted within "
+                        f"{self.params.mount_timeout}s")
+                time.sleep(0.1)
+        self._ready.add(table)
+
+    def _tablet_split(self, table: TableID, key_col: str,
+                      rows: list[dict]) -> list[list[dict]]:
+        """Split one request's rows at tablet boundaries (pivot keys).
+
+        Only single-component pivots split here; composite pivot keys
+        compare lexicographically across components, so first-component
+        bisection would mis-bucket boundary rows — those tables send
+        unsplit requests (correct, just cross-tablet)."""
+        pivots = self._pivots.get(table)
+        if pivots is None:
+            path = _join_path(self.params.dir, table)
+            pivots = self.client.pivot_keys(path) or [[]]
+            self._pivots[table] = pivots
+        if any(len(p) > 1 for p in pivots):
+            return [rows]
+        bounds = [p[0] for p in pivots[1:] if p]  # first pivot = empty
+        if not bounds:
+            return [rows]
+        import bisect
+
+        groups: dict[int, list[dict]] = {}
+        for r in rows:
+            idx = bisect.bisect_right(bounds, r.get(key_col))
+            groups.setdefault(idx, []).append(r)
+        return [groups[i] for i in sorted(groups)]
+
+    # -- push ----------------------------------------------------------------
+    def push(self, batch: Batch) -> None:
+        items = (batch.to_rows() if is_columnar(batch)
+                 else [it for it in batch])
+        rows = [it for it in items if it.is_row_event()]
+        if not rows:
+            return
+        # CDC batches may mix tables; group by table, preserving each
+        # table's arrival order
+        by_table: dict = {}
+        for it in rows:
+            by_table.setdefault(it.table_id, []).append(it)
+        for table, t_rows in by_table.items():
+            self._push_table(table, t_rows)
+
+    def _push_table(self, table: TableID, rows: list) -> None:
+        schema = rows[0].table_schema
+        self._ensure_table(table, schema)
+        path = _join_path(self.params.dir, table)
+        binary = {c.name for c in schema.columns
+                  if c.data_type == CanonicalType.STRING}
+        key_names = [c.name for c in schema.key_columns()]
+        if self.params.ordered:
+            out = [
+                {n: _encode_value(it.value(n), n in binary)
+                 for n in it.column_names}
+                for it in rows
+            ]
+            for lo in range(0, len(out), self.params.batch_rows):
+                self.client.insert_rows(
+                    path, out[lo:lo + self.params.batch_rows],
+                    atomicity=self.params.atomicity)
+            return
+        # sorted mode: expand items into (op, payload) — a key-changing
+        # UPDATE becomes delete(old key) + upsert(new key), since a bare
+        # upsert of the new key would leave the stale old-key row behind
+        ops: list[tuple[str, dict]] = []
+        for it in rows:
+            if it.kind == Kind.DELETE:
+                keys = (it.old_keys.as_dict()
+                        if it.old_keys.key_names else
+                        {n: it.value(n) for n in key_names})
+                ops.append(("del", {
+                    n: _encode_value(keys.get(n), n in binary)
+                    for n in key_names}))
+                continue
+            if it.kind == Kind.UPDATE and it.old_keys.key_names:
+                old = it.old_keys.as_dict()
+                if any(old.get(n) != it.value(n) for n in key_names
+                       if n in old):
+                    ops.append(("del", {
+                        n: _encode_value(old.get(n), n in binary)
+                        for n in key_names}))
+            ops.append(("ups", {
+                n: _encode_value(it.value(n), n in binary)
+                for n in it.column_names}))
+
+        # flush consecutive same-op runs in arrival order so a delete
+        # never reorders around an upsert of the same key
+        def flush(run_kind: str, buf: list[dict]) -> None:
+            if not buf:
+                return
+            key0 = key_names[0] if key_names else None
+            chunks = (self._tablet_split(table, key0, buf)
+                      if key0 else [buf])
+            for chunk in chunks:
+                for lo in range(0, len(chunk), self.params.batch_rows):
+                    part = chunk[lo:lo + self.params.batch_rows]
+                    if run_kind == "del":
+                        self.client.delete_rows(
+                            path, part, atomicity=self.params.atomicity)
+                    else:
+                        self.client.insert_rows(
+                            path, part, atomicity=self.params.atomicity)
+
+        run_kind = ""
+        buf: list[dict] = []
+        for kind, payload in ops:
+            if kind != run_kind:
+                flush(run_kind, buf)
+                buf = []
+                run_kind = kind
+            buf.append(payload)
+        flush(run_kind, buf)
+
+    def close(self) -> None:
+        pass
+
+
 @register_provider
 class YTProvider(Provider):
     NAME = "yt"
@@ -326,12 +518,16 @@ class YTProvider(Provider):
     def sinker(self):
         if isinstance(self.transfer.dst, YTStaticTargetParams):
             return YTStaticSinker(self.transfer.dst)
+        if isinstance(self.transfer.dst, YTDynamicTargetParams):
+            return YTDynamicSinker(self.transfer.dst)
         return None
 
     def cleanup(self, tables: list) -> None:
         params = self.transfer.dst
-        if not isinstance(params, YTStaticTargetParams):
+        if not isinstance(params, (YTStaticTargetParams,
+                                   YTDynamicTargetParams)):
             return
+        dynamic = isinstance(params, YTDynamicTargetParams)
         client = YTClient(params.proxy, token=params.token,
                           secure=params.secure)
         for td in tables or []:
@@ -342,7 +538,12 @@ class YTProvider(Provider):
             if params.cleanup_policy == CleanupPolicy.DROP:
                 client.remove(path)
             elif params.cleanup_policy == CleanupPolicy.TRUNCATE:
-                client.write_table(path, [], append=False)
+                if dynamic:
+                    # dyntables have no truncate; drop and let the sink
+                    # recreate+remount on first push
+                    client.remove(path)
+                else:
+                    client.write_table(path, [], append=False)
 
     def test(self) -> TestResult:
         result = TestResult(ok=True)
@@ -361,3 +562,11 @@ class YTProvider(Provider):
             except Exception as e:
                 result.add("list_tables", e)
         return result
+
+
+@register_provider
+class YTDynProvider(YTProvider):
+    """Provider identity for the dynamic-table destination; shares the
+    YT storage/sinker wiring (sinker() dispatches on params type)."""
+
+    NAME = "yt_dyn"
