@@ -93,7 +93,7 @@ def test_fanin_p99_push_latency_bounded():
         th.start()
 
         def ch_rows():
-            return sum(len(tb["rows"]) for tb in ch.tables.values())
+            return ch.total_rows()
 
         deadline = time.monotonic() + 90
         while ch_rows() < expected and time.monotonic() < deadline:

@@ -16,6 +16,35 @@ import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 
+class _LazyTable(dict):
+    """Table entry whose RowBinary inserts decode lazily.
+
+    INSERT bodies are structure-validated and row-COUNTED at insert time
+    (cheap walk), but full Python row objects materialize only when
+    someone reads ["rows"] — benches poll counts at high frequency and a
+    real server never builds Python rows at all."""
+
+    def __getitem__(self, key):
+        if key == "rows":
+            pend = dict.__getitem__(self, "pending")
+            if pend:
+                rows = dict.__getitem__(self, "rows")
+                for body, col_names, types in pend:
+                    decoded = _decode_rowbinary_rows(body, types)
+                    rows.extend(dict(zip(col_names, r)) for r in decoded)
+                pend.clear()
+        return dict.__getitem__(self, key)
+
+    def __setitem__(self, key, value):
+        if key == "rows":  # truncate: discard pending blobs too
+            dict.__getitem__(self, "pending").clear()
+            dict.__setitem__(self, "n", len(value))
+        dict.__setitem__(self, key, value)
+
+    def row_count(self) -> int:
+        return dict.__getitem__(self, "n")
+
+
 class FakeCH:
     def __init__(self):
         self.tables: dict[str, dict] = {}   # name -> {ddl, columns, rows}
@@ -26,6 +55,12 @@ class FakeCH:
         self.lock = threading.Lock()
         self._srv: ThreadingHTTPServer | None = None
         self.port = 0
+
+    def total_rows(self) -> int:
+        """Inserted-row count WITHOUT materializing rows (cheap to poll)."""
+        with self.lock:
+            return sum(t.row_count() if isinstance(t, _LazyTable)
+                       else len(t["rows"]) for t in self.tables.values())
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "FakeCH":
@@ -97,10 +132,11 @@ class FakeCH:
                         for c in mo.group(1).split(",")] if mo else []
             with self.lock:
                 if name not in self.tables:
-                    self.tables[name] = {
+                    self.tables[name] = _LazyTable({
                         "ddl": q, "columns": cols, "rows": [],
+                        "pending": [], "n": 0,
                         "order_by": [c for c in order_by if c],
-                    }
+                    })
             return b""
         m = re.match(r"(drop|truncate) table if exists `?(\w+)`?", low)
         if m:
@@ -122,12 +158,11 @@ class FakeCH:
                 table = self.tables.get(name)
                 if table is None:
                     raise ValueError(f"Table {name} does not exist")
-                rows = _decode_rowbinary_rows(
-                    body, [table["columns"][c] for c in col_names]
-                )
-                table["rows"].extend(
-                    dict(zip(col_names, r)) for r in rows
-                )
+                types = [table["columns"][c] for c in col_names]
+                # validate structure + count rows now; decode lazily
+                n = _count_rowbinary_rows(body, types)
+                table["pending"].append((body, col_names, types))
+                dict.__setitem__(table, "n", table.row_count() + n)
             return b""
         m = re.match(r"select (.*) from `?(\w+)`?\s*(.*?)\s*"
                      r"format rowbinary", low, re.S)
@@ -265,7 +300,12 @@ class FakeCH:
 
     def rows(self, table: str) -> list[dict]:
         with self.lock:
-            return list(self.tables.get(table, {}).get("rows", []))
+            t = self.tables.get(table)
+            if t is None:
+                return []
+            # NOTE: dict.get would bypass _LazyTable.__getitem__ and miss
+            # pending (undecoded) inserts — index, don't .get
+            return list(t["rows"])
 
 
 # -- independent minimal RowBinary decoder (not the framework's) ------------
@@ -318,6 +358,42 @@ def _encode_rowbinary_rows(rows: list[dict], cols: list[str],
                 raw = v if isinstance(v, bytes) else str(v or "").encode()
                 out += _encode_varint(len(raw)) + raw
     return out
+
+
+def _count_rowbinary_rows(data: bytes, types: list[str]) -> int:
+    """Walk-only structural validation + row count (no Python objects).
+    Raises on malformed payloads exactly where the decoder would."""
+    pos = 0
+    n = len(data)
+    count = 0
+    while pos < n:
+        for t in types:
+            nullable = t.startswith("Nullable(")
+            base = t[9:-1] if nullable else t
+            if nullable:
+                if data[pos] == 1:
+                    pos += 1
+                    continue
+                pos += 1
+            if base in _FIXED:
+                pos += _FIXED[base][1]
+            elif base == "String":
+                ln = 0
+                shift = 0
+                while True:
+                    b = data[pos]
+                    pos += 1
+                    ln |= (b & 0x7F) << shift
+                    if not b & 0x80:
+                        break
+                    shift += 7
+                pos += ln
+            else:
+                raise ValueError(f"fake CH decoder: type {t}")
+        if pos > n:
+            raise ValueError("rowbinary payload truncated")
+        count += 1
+    return count
 
 
 def _decode_rowbinary_rows(data: bytes, types: list[str]) -> list[list]:
