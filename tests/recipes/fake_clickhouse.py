@@ -29,7 +29,7 @@ class _LazyTable(dict):
             pend = dict.__getitem__(self, "pending")
             if pend:
                 rows = dict.__getitem__(self, "rows")
-                for body, col_names, types in pend:
+                for body, col_names, types, _count in pend:
                     decoded = _decode_rowbinary_rows(body, types)
                     rows.extend(dict(zip(col_names, r)) for r in decoded)
                 pend.clear()
@@ -38,11 +38,14 @@ class _LazyTable(dict):
     def __setitem__(self, key, value):
         if key == "rows":  # truncate: discard pending blobs too
             dict.__getitem__(self, "pending").clear()
-            dict.__setitem__(self, "n", len(value))
         dict.__setitem__(self, key, value)
 
     def row_count(self) -> int:
-        return dict.__getitem__(self, "n")
+        # materialized rows (tests may mutate that list directly) plus
+        # not-yet-decoded inserts
+        return (len(dict.__getitem__(self, "rows"))
+                + sum(c for _, _, _, c in
+                      dict.__getitem__(self, "pending")))
 
 
 class FakeCH:
@@ -134,7 +137,7 @@ class FakeCH:
                 if name not in self.tables:
                     self.tables[name] = _LazyTable({
                         "ddl": q, "columns": cols, "rows": [],
-                        "pending": [], "n": 0,
+                        "pending": [],
                         "order_by": [c for c in order_by if c],
                     })
             return b""
@@ -161,8 +164,7 @@ class FakeCH:
                 types = [table["columns"][c] for c in col_names]
                 # validate structure + count rows now; decode lazily
                 n = _count_rowbinary_rows(body, types)
-                table["pending"].append((body, col_names, types))
-                dict.__setitem__(table, "n", table.row_count() + n)
+                table["pending"].append((body, col_names, types, n))
             return b""
         m = re.match(r"select (.*) from `?(\w+)`?\s*(.*?)\s*"
                      r"format rowbinary", low, re.S)
@@ -204,7 +206,11 @@ class FakeCH:
         m = re.match(r"select count\(\) from `?(\w+)`?", low)
         if m:
             with self.lock:
-                n = len(self.tables.get(m.group(1), {}).get("rows", []))
+                t = self.tables.get(m.group(1))
+                # .get("rows") would bypass _LazyTable and miss pending
+                # undecoded inserts; row_count() covers them
+                n = (t.row_count() if isinstance(t, _LazyTable)
+                     else len(t["rows"]) if t else 0)
             return json.dumps({"data": [[n]]}).encode()
         if "from system.columns" in low:
             m = re.search(r"table = '(\w+)'", q)

@@ -1,0 +1,105 @@
+"""Device-side columnar decode kernels.
+
+BASELINE.json config 3's north star is literally "columnar decode on
+TPU": the hot shape of parquet decode is bit-unpack of RLE_DICTIONARY
+codes followed by a dictionary gather, and both map cleanly onto the
+chip — unpack is pure vectorized shift/mask arithmetic (VPU), the gather
+rides HBM bandwidth.  ops/placement keeps the END-TO-END decode on the
+host whenever the link model says transfers would swamp the chip (the
+tunneled dev environment), exactly as with the mask kernel; this module
+is the proof-point that the chip itself sustains the decode op, measured
+by bench.py as device_decode_rows_per_sec on resident buffers.
+
+Scope mirrors the native decoder's hot path (native/parquetdec.cpp
+RleDecoder + dict gather):
+  - unpack_bits: n fixed-width (<=32 bit) values from a little-endian
+    packed uint32 word stream — the body of a bit-packed RLE run
+  - decode_dict_run: unpack + jnp.take through the dictionary pool
+
+Run HEADERS (varint framing) stay on the host: they are a sequential
+byte-stream parse of a few bytes per ~hundreds of values, the opposite
+of device-shaped work.  The host splits runs; the device does the
+per-value arithmetic.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def unpack_bits(words: jax.Array, bit_width: int, n: int) -> jax.Array:
+    """values[i] = bits [i*bw, (i+1)*bw) of the packed little-endian
+    stream, as int32.  bit_width must be 0 < bw <= 32; a value spans at
+    most two 32-bit words ((hi:lo) >> off, shift-by-32 guarded)."""
+    if not 0 < bit_width <= 32:
+        raise ValueError(f"bit_width {bit_width} outside (0, 32]")
+    return _unpack_core(words, bit_width, n)
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def decode_dict_run(words: jax.Array, pool: jax.Array, bit_width: int,
+                    n: int) -> jax.Array:
+    """Bit-unpack n dictionary codes and gather their pool values —
+    the device half of an RLE_DICTIONARY data page."""
+    codes = unpack_bits(words, bit_width, n)
+    return jnp.take(pool, codes, axis=0, mode="clip")
+
+
+@functools.partial(jax.jit, static_argnums=(2, 3, 4))
+def decode_dict_loop(words: jax.Array, pool: jax.Array, bit_width: int,
+                     n: int, iters: int) -> jax.Array:
+    """`iters` back-to-back decodes in ONE launch (bench helper: a
+    tunneled link's ~100ms launch overhead would otherwise swamp an op
+    that is pure HBM traffic).  The carry perturbs the input words each
+    iteration so XLA cannot hoist or CSE the loop body; returns a
+    checksum the caller discards after sync."""
+    def body(i, acc):
+        w = words ^ (acc & jnp.uint32(1))
+        codes = _unpack_core(w, bit_width, n)
+        vals = jnp.take(pool, codes, axis=0, mode="clip")
+        return acc + vals.sum().astype(jnp.uint32)
+
+    return jax.lax.fori_loop(0, iters, body, jnp.uint32(0))
+
+
+def _unpack_core(w: jax.Array, bit_width: int, n: int) -> jax.Array:
+    """unpack_bits body without the jit wrapper (traced inline).
+
+    TPU gathers run orders of magnitude below HBM speed, so the hot
+    shape (n a multiple of 32) avoids them entirely: every group of 32
+    values consumes exactly bit_width words, so reshaping to
+    (n/32, bit_width) makes each lane's word indices STATIC — the
+    unpack becomes column slices + shifts, pure VPU work.  Ragged n
+    falls back to the gather form."""
+    w = w.astype(jnp.uint32)
+    bw = bit_width
+    if n % 32 == 0 and len(w.shape) == 1:
+        g = n // 32
+        need = g * bw
+        wg = w[:need].reshape(g, bw)
+        mask = (jnp.uint32((1 << bw) - 1) if bw < 32
+                else jnp.uint32(0xFFFFFFFF))
+        lanes = []
+        for j in range(32):
+            k, off = divmod(j * bw, 32)
+            lo = wg[:, k] >> jnp.uint32(off)
+            if off + bw > 32:
+                lo = lo | (wg[:, k + 1] << jnp.uint32(32 - off))
+            lanes.append(lo & mask)
+        return jnp.stack(lanes, axis=1).reshape(n).astype(jnp.int32)
+    starts = jnp.arange(n, dtype=jnp.uint32) * jnp.uint32(bw)
+    wi = (starts >> 5).astype(jnp.int32)
+    off = (starts & 31).astype(jnp.uint32)
+    lo = jnp.take(w, wi, mode="clip")
+    hi = jnp.take(w, wi + 1, mode="clip")
+    upper = jnp.where(off > 0,
+                      hi << ((jnp.uint32(32) - off) & jnp.uint32(31)),
+                      jnp.uint32(0))
+    v = (lo >> off) | upper
+    if bw < 32:
+        v = v & jnp.uint32((1 << bw) - 1)
+    return v.astype(jnp.int32)
