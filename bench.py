@@ -555,6 +555,136 @@ def measure_device_decode(rows: int = 1 << 22) -> Optional[dict]:
     }
 
 
+def _run_isolated(fn_name: str, timeout: float) -> Optional[dict]:
+    """Run one measure_* function in a subprocess with a hard timeout;
+    returns its JSON result or None.  Used for measures whose device
+    compiles could hang a wedged runtime."""
+    import subprocess
+
+    code = (
+        "import json, sys; sys.path.insert(0, %r); import bench; "
+        "out = bench.%s(); "
+        "print('@@RESULT@@' + json.dumps(out) if out else '')"
+        % (os.path.dirname(os.path.abspath(__file__)), fn_name)
+    )
+    try:
+        proc = subprocess.run([sys.executable, "-c", code],
+                              capture_output=True, timeout=timeout)
+    except subprocess.TimeoutExpired:
+        print(f"# {fn_name}: timed out after {timeout}s (skipped)",
+              file=sys.stderr)
+        return None
+    for line in proc.stdout.decode("utf-8", "replace").splitlines():
+        if line.startswith("@@RESULT@@"):
+            return json.loads(line[len("@@RESULT@@"):])
+    return None
+
+
+def measure_device_fingerprint(rows: int = 1 << 20) -> Optional[dict]:
+    """Sustained ON-CHIP checksum-fingerprint rate (ops/rowhash.py
+    DeviceFingerprintProgram) — the proof-point the mask and decode
+    kernels already have.  End-to-end fingerprinting stays on the host
+    here because 72 bytes/row H2D through the tunneled link loses to the
+    C++ polyhash (auto-placement's call); this isolates what the chip
+    sustains on resident buffers.  Shape: one int64 column + one 64-byte
+    var-width column, the checksum task's typical mix."""
+    import jax
+    import jax.numpy as jnp
+
+    backend = jax.default_backend()
+    if backend == "cpu":
+        return None
+    from transferia_tpu.abstract.schema import (
+        CanonicalType,
+        ColSchema,
+        TableID,
+        TableSchema,
+    )
+    from transferia_tpu.columnar.batch import Column, ColumnBatch
+    from transferia_tpu.ops import rowhash
+
+    rng = np.random.default_rng(17)
+    ids = rng.integers(0, 2**62, rows)
+    urls = [f"https://example.test/p/{i % 997:04d}/x" for i in range(256)]
+    data = np.frombuffer(("".join(urls[i % 256] for i in range(rows))
+                          ).encode(), dtype=np.uint8)
+    lens = np.array([len(urls[i % 256]) for i in range(rows)],
+                    dtype=np.int64)
+    offsets = np.zeros(rows + 1, dtype=np.int32)
+    np.cumsum(lens, out=offsets[1:])
+    schema = TableSchema([
+        ColSchema("id", CanonicalType.INT64, primary_key=True),
+        ColSchema("url", CanonicalType.UTF8),
+    ])
+    batch = ColumnBatch(TableID("b", "fp"), schema, {
+        "id": Column("id", CanonicalType.INT64, ids.astype(np.int64)),
+        "url": Column("url", CanonicalType.UTF8, data, offsets),
+    })
+    cols, n_rows = rowhash.prep_batch(batch)
+    prog = rowhash.DeviceFingerprintProgram()
+    # build the resident argument set exactly as dispatch() does, once
+    from transferia_tpu.columnar.batch import bucket_rows
+
+    bucket = bucket_rows(n_rows)
+    assert bucket == n_rows  # power-of-two rows: no padding
+    sig = tuple((c.kind, c.width if c.kind == "var" else 0)
+                for c in cols)
+    fn = prog._program_for(sig)
+    fixed_lo = tuple(jnp.asarray(c.lo) for c in cols
+                     if c.kind == "fixed")
+    fixed_hi = tuple(jnp.asarray(c.hi) for c in cols
+                     if c.kind == "fixed")
+    var_blocks = tuple(jnp.asarray(c.ensure_blocks()) for c in cols
+                       if c.kind == "var")
+    validities = tuple(None for _ in cols)
+    rowmask = jnp.ones(n_rows, dtype=jnp.bool_)
+    seeds1 = jnp.asarray(np.array(
+        [rowhash._col_seed(c.name, 0) for c in cols], dtype=np.uint32))
+    seeds2 = jnp.asarray(np.array(
+        [rowhash._col_seed(c.name, 1) for c in cols], dtype=np.uint32))
+    nulls1 = jnp.asarray(np.full(len(cols), rowhash._NULL1, np.uint32))
+    nulls2 = jnp.asarray(np.full(len(cols), rowhash._NULL2, np.uint32))
+    powers1 = tuple(jnp.asarray(rowhash._powers(c.width, int(rowhash._P1)))
+                    for c in cols if c.kind == "var")
+    powers2 = tuple(jnp.asarray(rowhash._powers(c.width, int(rowhash._P2)))
+                    for c in cols if c.kind == "var")
+
+    import functools
+
+    # NOTE: the big arrays ride as ARGUMENTS — captured as closure
+    # constants they embed into the program and compilation stalls
+    @functools.partial(jax.jit, static_argnums=(0,))
+    def loop(iters, flo, fhi, vb, rm, s1, s2, p1, p2):
+        def body(i, acc):
+            out = fn(flo, fhi, vb, validities, rm,
+                     s1 ^ (acc & jnp.uint32(1)), s2,
+                     nulls1, nulls2, p1, p2)
+            return acc + out[0]
+
+        return jax.lax.fori_loop(0, iters, body, jnp.uint32(0))
+
+    iters = 64
+    # compile + warm (value fetch = the only honest sync)
+    int(loop(2, fixed_lo, fixed_hi, var_blocks, rowmask,
+             seeds1, seeds2, powers1, powers2))
+    t0 = time.perf_counter()
+    int(loop(iters, fixed_lo, fixed_hi, var_blocks, rowmask,
+             seeds1, seeds2, powers1, powers2))
+    dt = time.perf_counter() - t0
+    rps = rows * iters / dt
+    return {
+        "metric": "device_fingerprint_rows_per_sec",
+        "value": round(rps),
+        "unit": "rows/sec",
+        "vs_baseline": round(rps / 10_000_000, 4),
+        "backend": backend,
+        "launch_rows": rows,
+        "loop_iters": iters,
+        "cols": "int64 + 64B var",
+        "note": "single-launch fori_loop on resident buffers",
+    }
+
+
 def measure_mesh_1dev(rows: int = 1 << 17) -> Optional[dict]:
     """ShardedFusedProgram on a 1-device mesh on the REAL chip, vs the
     plain fused device program on the same inputs.
@@ -1221,6 +1351,17 @@ def main() -> None:
         except Exception as e:
             print(f"# device decode bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
+        try:
+            # subprocess-isolated with a hard timeout: a wedged tunneled
+            # runtime can HANG a compile, and no aux metric is allowed
+            # to stall the bench tail
+            dfp = _run_isolated("measure_device_fingerprint",
+                                timeout=300)
+            if dfp:
+                print(f"# {json.dumps(dfp)}", file=sys.stderr)
+        except Exception as e:
+            print(f"# device fingerprint bench failed: "
+                  f"{type(e).__name__}: {e}", file=sys.stderr)
         try:
             mesh1 = measure_mesh_1dev()
             if mesh1:
