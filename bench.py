@@ -577,6 +577,11 @@ def _run_isolated(fn_name: str, timeout: float) -> Optional[dict]:
     for line in proc.stdout.decode("utf-8", "replace").splitlines():
         if line.startswith("@@RESULT@@"):
             return json.loads(line[len("@@RESULT@@"):])
+    # a crash must not masquerade as a benign skip
+    if proc.returncode != 0:
+        tail = proc.stderr.decode("utf-8", "replace")[-300:]
+        print(f"# {fn_name}: subprocess exited {proc.returncode}: {tail}",
+              file=sys.stderr)
     return None
 
 

@@ -53,6 +53,16 @@ def _bind(cdll: ctypes.CDLL) -> ctypes.CDLL:
         u8, i32, ctypes.c_int64, u32, u32, u32, u32,
     ]
     cdll.polyhash_varcol.restype = None
+    if hasattr(cdll, "kafka_scan_records"):
+        cdll.kafka_scan_records.argtypes = [
+            u8, ctypes.c_int64, i64, ctypes.c_int64,
+        ]
+        cdll.kafka_scan_records.restype = ctypes.c_int64
+    if hasattr(cdll, "avro_decode_flat"):
+        cdll.avro_decode_flat.argtypes = [
+            u8, i64, ctypes.c_int64, u8, u8, u8, ctypes.c_int64, i64,
+        ]
+        cdll.avro_decode_flat.restype = ctypes.c_int64
     if hasattr(cdll, "crc32c_buf"):
         cdll.crc32c_buf.argtypes = [u8, ctypes.c_int64, ctypes.c_uint32]
         cdll.crc32c_buf.restype = ctypes.c_uint32

@@ -515,4 +515,218 @@ int64_t kafka_encode_records(const uint8_t* key_data,
     return pos;
 }
 
+// ---------------------------------------------------------------------------
+// Flat-record Avro batch decoder (the Confluent-SR consume hot loop).
+//
+// Decodes n_msgs concatenated Avro binary records (payloads AFTER the
+// 5-byte Confluent header) whose schema is a flat record of primitive
+// fields, straight into columnar buffers — the Python per-row reader was
+// ~6.5us/row and the dominant cost of the 64-partition fan-in bench.
+//
+// field type codes (ftypes): 1 boolean, 2 int/long (zigzag varint),
+// 3 float, 4 double, 5 string/bytes (varint length + bytes).
+// fnullable[i] != 0 marks the ["null", T] union idiom; fnullbranch[i]
+// is WHICH branch is null (writers emit either order).
+//
+// Per-field output slots in `tasks` (n_fields x 6 int64 row-major):
+//   0 out_values ptr (i64 for 2, f32 for 3, f64 for 4, u8 for 1)
+//   1 out_data ptr (type 5)     2 out_offsets ptr (type 5, int32)
+//   3 out_data cap (type 5)     4 validity ptr (u8; may be 0 when
+//   5 (reserved)                  the field is not nullable)
+//
+// Returns n_msgs on success; -(i+1) when message i is malformed or out
+// of envelope (caller falls back to the exact per-row reader).
+
+static inline bool avro_varint(const uint8_t*& p, const uint8_t* end,
+                               int64_t* out) {
+    uint64_t u = 0;
+    int shift = 0;
+    while (shift < 64) {
+        if (p >= end) return false;
+        uint8_t b = *p++;
+        u |= (uint64_t)(b & 0x7F) << shift;
+        if (!(b & 0x80)) {
+            *out = (int64_t)(u >> 1) ^ -(int64_t)(u & 1);
+            return true;
+        }
+        shift += 7;
+    }
+    return false;
+}
+
+// ---------------------------------------------------------------------------
+// Kafka RecordBatch v2 scanner: the consume-side twin of
+// kafka_encode_records.  Walks uncompressed frames and emits SIX int64s
+// per record — key_start, key_end (-1/-1 for null), val_start, val_end,
+// absolute offset, timestamp_ms — all byte ranges referencing the blob
+// itself (zero copy; the Python caller slices).  Frames are CRC32C-
+// validated.  Returns the record count, -1 on corrupt input, or -2 when
+// a frame needs the Python path (compression, control semantics beyond
+// skipping, per-record headers).
+
+static inline int64_t be32(const uint8_t* p) {
+    return ((int64_t)p[0] << 24) | ((int64_t)p[1] << 16)
+         | ((int64_t)p[2] << 8) | (int64_t)p[3];
+}
+
+static inline int64_t be64(const uint8_t* p) {
+    int64_t v = 0;
+    for (int i = 0; i < 8; i++) v = (v << 8) | p[i];
+    return v;
+}
+
+int64_t kafka_scan_records(const uint8_t* blob, int64_t blob_len,
+                           int64_t* out, int64_t max_records) {
+    int64_t pos = 0;
+    int64_t count = 0;
+    while (pos + 61 <= blob_len) {
+        int64_t base_offset = be64(blob + pos);
+        int64_t batch_len = be32(blob + pos + 8);
+        if (batch_len <= 0) return -1;
+        int64_t end = pos + 12 + batch_len;
+        if (end > blob_len) break;  // partial frame at fetch tail
+        if (blob[pos + 16] != 2) return -2;  // magic
+        uint32_t expect = (uint32_t)((blob[pos + 17] << 24)
+                                     | (blob[pos + 18] << 16)
+                                     | (blob[pos + 19] << 8)
+                                     | blob[pos + 20]);
+        if (crc32c_buf(blob + pos + 21, end - (pos + 21), 0) != expect)
+            return -1;
+        int64_t attrs = (blob[pos + 21] << 8) | blob[pos + 22];
+        if (attrs & 0x07) return -2;  // compressed: python path
+        if (attrs & 0x20) { pos = end; continue; }  // control batch
+        int64_t base_ts = be64(blob + pos + 27);
+        int64_t n = be32(blob + pos + 57);
+        const uint8_t* p = blob + pos + 61;
+        const uint8_t* fend = blob + end;
+        for (int64_t i = 0; i < n; i++) {
+            int64_t body_len;
+            if (!avro_varint(p, fend, &body_len) || body_len <= 0
+                || fend - p < body_len) return -1;
+            const uint8_t* rec_end = p + body_len;
+            if (p >= rec_end) return -1;
+            p++;  // record attributes
+            int64_t ts_delta, off_delta;
+            if (!avro_varint(p, rec_end, &ts_delta)) return -1;
+            if (!avro_varint(p, rec_end, &off_delta)) return -1;
+            int64_t klen;
+            if (!avro_varint(p, rec_end, &klen)) return -1;
+            int64_t ks = -1, ke = -1;
+            if (klen >= 0) {
+                if (rec_end - p < klen) return -1;
+                ks = p - blob;
+                ke = ks + klen;
+                p += klen;
+            }
+            int64_t vlen;
+            if (!avro_varint(p, rec_end, &vlen)) return -1;
+            int64_t vs = -1, ve = -1;
+            if (vlen >= 0) {
+                if (rec_end - p < vlen) return -1;
+                vs = p - blob;
+                ve = vs + vlen;
+                p += vlen;
+            }
+            int64_t n_headers;
+            if (!avro_varint(p, rec_end, &n_headers)) return -1;
+            if (n_headers != 0) return -2;  // headers: python path
+            if (p != rec_end) return -1;
+            if (count >= max_records) return -1;
+            int64_t* o = out + count * 6;
+            o[0] = ks; o[1] = ke; o[2] = vs; o[3] = ve;
+            o[4] = base_offset + off_delta;
+            o[5] = base_ts + ts_delta;
+            count++;
+        }
+        pos = end;
+    }
+    return count;
+}
+
+int64_t avro_decode_flat(const uint8_t* data, const int64_t* offs,
+                         int64_t n_msgs,
+                         const uint8_t* ftypes,
+                         const uint8_t* fnullable,
+                         const uint8_t* fnullbranch,
+                         int64_t n_fields, int64_t* tasks) {
+    // var-width write positions start at 0 per field
+    for (int64_t f = 0; f < n_fields; f++) {
+        int32_t* off_out = (int32_t*)tasks[f * 6 + 2];
+        if (off_out) off_out[0] = 0;
+    }
+    for (int64_t i = 0; i < n_msgs; i++) {
+        const uint8_t* p = data + offs[i];
+        const uint8_t* end = data + offs[i + 1];
+        for (int64_t f = 0; f < n_fields; f++) {
+            int64_t* t = tasks + f * 6;
+            uint8_t* validity = (uint8_t*)t[4];
+            bool is_null = false;
+            if (fnullable[f]) {
+                int64_t branch;
+                if (!avro_varint(p, end, &branch)) return -(i + 1);
+                if (branch != 0 && branch != 1) return -(i + 1);
+                is_null = (branch == fnullbranch[f]);
+            }
+            if (validity) validity[i] = is_null ? 0 : 1;
+            int ft = ftypes[f];
+            if (ft == 5) {
+                int32_t* off_out = (int32_t*)t[2];
+                uint8_t* dout = (uint8_t*)t[1];
+                int64_t pos = off_out[i];
+                if (!is_null) {
+                    int64_t len;
+                    if (!avro_varint(p, end, &len) || len < 0
+                        || end - p < len) return -(i + 1);
+                    if (pos + len > t[3]) return -(i + 1);
+                    memcpy(dout + pos, p, (size_t)len);
+                    p += len;
+                    pos += len;
+                }
+                off_out[i + 1] = (int32_t)pos;
+                continue;
+            }
+            if (is_null) {
+                // fixed-width null slots zero
+                switch (ft) {
+                case 1: ((uint8_t*)t[0])[i] = 0; break;
+                case 2: ((int64_t*)t[0])[i] = 0; break;
+                case 3: ((float*)t[0])[i] = 0.0f; break;
+                case 4: ((double*)t[0])[i] = 0.0; break;
+                default: return -(i + 1);
+                }
+                continue;
+            }
+            switch (ft) {
+            case 1: {
+                if (p >= end) return -(i + 1);
+                ((uint8_t*)t[0])[i] = (*p++ != 0);
+                break;
+            }
+            case 2: {
+                int64_t v;
+                if (!avro_varint(p, end, &v)) return -(i + 1);
+                ((int64_t*)t[0])[i] = v;
+                break;
+            }
+            case 3: {
+                if (end - p < 4) return -(i + 1);
+                memcpy(&((float*)t[0])[i], p, 4);
+                p += 4;
+                break;
+            }
+            case 4: {
+                if (end - p < 8) return -(i + 1);
+                memcpy(&((double*)t[0])[i], p, 8);
+                p += 8;
+                break;
+            }
+            default:
+                return -(i + 1);
+            }
+        }
+        if (p != end) return -(i + 1);  // trailing bytes: not this schema
+    }
+    return n_msgs;
+}
+
 }  // extern "C"

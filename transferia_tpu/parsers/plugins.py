@@ -21,7 +21,7 @@ from transferia_tpu.abstract.schema import (
     TableID,
     TableSchema,
 )
-from transferia_tpu.columnar.batch import ColumnBatch
+from transferia_tpu.columnar.batch import Column, ColumnBatch
 from transferia_tpu.parsers.base import (
     Message,
     ParseResult,
@@ -393,7 +393,144 @@ class ConfluentSRParser(Parser):
             return CanonicalType.STRING
         return CanonicalType.ANY
 
+    # avro primitive -> (C type code, canonical type) for the flat-record
+    # native fast path (hostops.cpp avro_decode_flat)
+    _AVRO_C_TYPES = {
+        "boolean": (1, CanonicalType.BOOLEAN),
+        "int": (2, CanonicalType.INT32),
+        "long": (2, CanonicalType.INT64),
+        "float": (3, CanonicalType.FLOAT),
+        "double": (4, CanonicalType.DOUBLE),
+        "string": (5, CanonicalType.UTF8),
+        "bytes": (5, CanonicalType.STRING),
+    }
+
+    def _flat_spec(self, avro):
+        """(name, c_code, ctype, nullable, null_branch) per field when the
+        schema is a flat record of primitives (None = out of envelope);
+        cached per AvroSchema instance."""
+        # cached ON the schema object: an id()-keyed dict would serve a
+        # stale spec if a freed AvroSchema's address got reused
+        spec = getattr(avro, "_flat_spec_cache", False)
+        if spec is not False:
+            return spec
+        spec = None
+        root = avro.root
+        if isinstance(root, list) and root[0] == "record":
+            out = []
+            for name, t in root[2]:
+                nullable, null_branch = False, 0
+                node = t
+                if isinstance(node, list) and node[0] == "union" \
+                        and len(node[1]) == 2 and "null" in node[1]:
+                    nullable = True
+                    null_branch = node[1].index("null")
+                    node = node[1][1 - null_branch]
+                if not isinstance(node, str) \
+                        or node not in self._AVRO_C_TYPES:
+                    out = None
+                    break
+                code, ctype = self._AVRO_C_TYPES[node]
+                out.append((name, code, ctype, nullable, null_branch))
+            spec = out or None
+        try:
+            avro._flat_spec_cache = spec
+        except AttributeError:  # slotted schema object: just recompute
+            pass
+        return spec
+
+    def _avro_batch_native(self, avro, msgs: list[Message]):
+        """Columnar decode of a flat-record run via the C decoder; None
+        defers to the exact per-row path (out of envelope, native lib
+        absent, or any malformed message in the run)."""
+        from transferia_tpu.native import lib as native_lib
+
+        cdll = native_lib()
+        if cdll is None or not hasattr(cdll, "avro_decode_flat"):
+            return None
+        spec = self._flat_spec(avro)
+        if spec is None:
+            return None
+        import numpy as np
+
+        n = len(msgs)
+        payloads = [m.value for m in msgs]
+        data = np.frombuffer(b"".join(payloads), dtype=np.uint8)
+        offs = np.zeros(n + 1, dtype=np.int64)
+        np.cumsum([len(p) for p in payloads], out=offs[1:])
+        if int(offs[-1]) > 0x7FFF0000:
+            # var-width offsets are int32 in the C decoder
+            return None
+        ftypes = np.array([c for _, c, _, _, _ in spec], dtype=np.uint8)
+        fnull = np.array([1 if nl else 0 for *_, nl, _ in spec],
+                         dtype=np.uint8)
+        fbr = np.array([br for *_, br in spec], dtype=np.uint8)
+        tasks = np.zeros((len(spec), 6), dtype=np.int64)
+        holds = []
+        for i, (name, code, ctype, nullable, _br) in enumerate(spec):
+            validity = np.empty(n, dtype=np.uint8) if nullable else None
+            if code == 5:
+                cap = int(offs[-1])
+                vdata = np.empty(max(cap, 1), dtype=np.uint8)
+                voffs = np.empty(n + 1, dtype=np.int32)
+                tasks[i, 1] = vdata.ctypes.data
+                tasks[i, 2] = voffs.ctypes.data
+                tasks[i, 3] = cap
+                holds.append((vdata, voffs, validity))
+            else:
+                dt = {1: np.uint8, 2: np.int64, 3: np.float32,
+                      4: np.float64}[code]
+                out = np.empty(n, dtype=dt)
+                tasks[i, 0] = out.ctypes.data
+                holds.append((out, validity))
+            if validity is not None:
+                tasks[i, 4] = validity.ctypes.data
+        rc = cdll.avro_decode_flat(
+            data if data.size else np.zeros(1, dtype=np.uint8),
+            offs, n, ftypes, fnull, fbr, len(spec), tasks.reshape(-1))
+        if rc != n:
+            return None
+        cols = {}
+        for i, (name, code, ctype, nullable, _br) in enumerate(spec):
+            h = holds[i]
+            validity = h[-1]
+            v = None
+            if validity is not None and not validity.all():
+                v = validity.astype(np.bool_)
+            if code == 5:
+                vdata, voffs = h[0], h[1]
+                flat = vdata[:int(voffs[n])]
+                if ctype == CanonicalType.UTF8:
+                    # the exact path DECODES strings (and dead-letters
+                    # rows with invalid utf-8); one bulk validation over
+                    # the flat buffer keeps the classification identical
+                    try:
+                        flat.tobytes().decode("utf-8")
+                    except UnicodeDecodeError:
+                        return None
+                cols[name] = Column(name, ctype, flat, voffs, v)
+            else:
+                vals = h[0]
+                if ctype == CanonicalType.INT32:
+                    vals = vals.astype(np.int32)
+                elif ctype == CanonicalType.BOOLEAN:
+                    vals = vals.view(np.bool_)
+                cols[name] = Column(name, ctype, vals, None, v)
+        schema = TableSchema([
+            ColSchema(name, ctype) for name, _, ctype, _, _ in spec])
+        result = ParseResult()
+        result.batches.append(ColumnBatch(
+            TableID(self.namespace, self.table), schema, cols))
+        return result
+
     def _avro_batch(self, avro, msgs: list[Message]) -> ParseResult:
+        fast = None
+        try:
+            fast = self._avro_batch_native(avro, msgs)
+        except Exception:  # any surprise: the exact path decides
+            logger.debug("native avro fast path failed", exc_info=True)
+        if fast is not None:
+            return fast
         result = ParseResult()
         rows, bad, reasons = [], [], []
         for m in msgs:

@@ -288,8 +288,53 @@ def _finish_record_batch(records: list[Record], recs: bytes,
         + header + after_crc
 
 
+def _scan_records_native(data: bytes) -> Optional[list[Record]]:
+    """C fast path (hostops.cpp kafka_scan_records): zero-copy scan of
+    uncompressed, header-less frames; None defers to the Python walk."""
+    try:
+        from transferia_tpu.native import lib as native_lib
+
+        cdll = native_lib()
+    except Exception:  # pragma: no cover
+        return None
+    if cdll is None or not hasattr(cdll, "kafka_scan_records"):
+        return None
+    import numpy as np
+
+    # upper bound on records: sum of frame recordCount headers
+    max_n = 0
+    pos = 0
+    n = len(data)
+    while pos + 61 <= n:
+        batch_len = struct.unpack_from("!i", data, pos + 8)[0]
+        count = struct.unpack_from("!i", data, pos + 57)[0]
+        if batch_len <= 0 or count < 0 or data[pos + 16] != 2:
+            return None  # corrupt/foreign framing: python path decides
+        max_n += count
+        pos += 12 + batch_len
+    if max_n == 0:
+        return [] if pos else None
+    arr = np.empty(max_n * 6, dtype=np.int64)
+    blob = np.frombuffer(data, dtype=np.uint8)
+    rc = cdll.kafka_scan_records(blob, len(data), arr, max_n)
+    if rc < 0:
+        if rc == -1:
+            raise ValueError("record batch CRC mismatch or corrupt frame")
+        return None  # -2: compression/headers — python path handles
+    out = []
+    for ks, ke, vs, ve, off, ts in arr[:rc * 6].reshape(-1, 6).tolist():
+        out.append(Record(
+            key=data[ks:ke] if ks >= 0 else None,
+            value=data[vs:ve] if vs >= 0 else None,
+            offset=off, timestamp_ms=ts))
+    return out
+
+
 def decode_record_batches(data: bytes) -> list[Record]:
     """RecordBatch v2 blob(s) -> Records with absolute offsets."""
+    native = _scan_records_native(data)
+    if native is not None:
+        return native
     out: list[Record] = []
     pos = 0
     n = len(data)
