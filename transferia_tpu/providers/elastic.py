@@ -5,6 +5,13 @@ Reference parity: pkg/providers/elastic/ + opensearch/ — index dump
 stdlib HTTP against the REST API; the same implementation registers under
 both provider names (the reference's opensearch provider delegates to
 elastic the same way).
+
+Real-service behaviors intentionally NOT covered (the fakes mirror
+what is implemented, so e2e cannot prove these): deep mapping-edge
+handling (multi-fields, nested/join datatypes, dynamic-template
+interactions flatten to ANY), index aliases/rollover during a dump, and
+cross-version mapping migrations — schemas derive from the top-level
+property types only.
 """
 
 from __future__ import annotations

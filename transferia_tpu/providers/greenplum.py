@@ -21,6 +21,13 @@ POST their rows as CSV to the worker, which decodes through the same CSV
 (LIKE target); INSERT INTO target SELECT * FROM ext — segments GET CSV
 chunks from the worker.  Filtered parts (predicate pushdown) keep the
 master path: gpfdist transfers are whole-table.
+
+Real-service behaviors intentionally NOT covered (FakeGP plays the
+segment side of the protocol, so e2e cannot prove these): the gpfdist
+TLS variant (gpfdists://), segment-host liveness monitoring during a
+long transfer (reference liveness_monitor.go restarts stalled
+segments), and multi-NIC worker addressing — gpfdist_host is a single
+address all segments must reach.
 """
 
 from __future__ import annotations

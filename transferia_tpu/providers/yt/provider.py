@@ -16,6 +16,13 @@ TableID(namespace="//home/dir", name="name"); the sink writes to
 Binary values: the YT JSON wire format carries binary strings as
 latin-1-escaped text; STRING columns encode/decode with latin-1 on the
 boundary so arbitrary bytes round-trip.
+
+Real-service behaviors intentionally NOT covered (the fake proxy
+mirrors what is implemented, so e2e cannot prove these): copy/merge
+operation scheduling (reference copy/ + mergejob/ run map-reduce
+operations; here sinks write directly), lfstaging, type_v3 composite
+columns (decimal ships as utf8), tablet-transaction atomicity semantics
+beyond per-request ordering, and replicated/chaos dyntables.
 """
 
 from __future__ import annotations
