@@ -293,12 +293,23 @@ class _MySession:
             )
             self.send_packet(defn)
         self.send_eof()
+        # frame each row as its own packet (protocol requirement) but
+        # coalesce socket writes — a sendall per row capped the fake far
+        # below what the buffered client ingests
+        buf = bytearray()
         for row in rows:
             pkt = b"".join(
                 _lenenc(None if v is None else str(v).encode())
                 for v in row
             )
-            self.send_packet(pkt)
+            buf += struct.pack("<I", len(pkt))[:3] + bytes([self.seq])
+            self.seq = (self.seq + 1) & 0xFF
+            buf += pkt
+            if len(buf) >= 1 << 18:
+                self.sock.sendall(buf)
+                buf.clear()
+        if buf:
+            self.sock.sendall(buf)
         self.send_eof()
 
     # -- SQL dispatch -------------------------------------------------------
