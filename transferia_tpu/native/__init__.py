@@ -117,16 +117,22 @@ def build(force: bool = False) -> bool:
     if cxx is None:
         # no compiler: a stale-but-working prebuilt .so beats no library
         return _SO.exists()
-    try:
-        subprocess.run(
-            [cxx, "-O3", "-shared", "-fPIC", "-o", str(_SO)]
-            + [str(s) for s in srcs] + ["-ldl"],
-            check=True, capture_output=True, timeout=120,
-        )
-        return True
-    except (subprocess.CalledProcessError, subprocess.TimeoutExpired) as e:
-        logger.warning("hostops build failed: %s", e)
-        return False
+    # -march=native is safe here: the library builds lazily ON the box
+    # that runs it (the .so is gitignored); odd toolchains that reject
+    # the flag fall back to the portable build
+    for extra in (["-march=native"], []):
+        try:
+            subprocess.run(
+                [cxx, "-O3", *extra, "-shared", "-fPIC", "-o", str(_SO)]
+                + [str(s) for s in srcs] + ["-ldl"],
+                check=True, capture_output=True, timeout=120,
+            )
+            return True
+        except (subprocess.CalledProcessError,
+                subprocess.TimeoutExpired) as e:
+            last_err = e
+    logger.warning("hostops build failed: %s", last_err)
+    return False
 
 
 def lib() -> Optional[ctypes.CDLL]:
