@@ -53,6 +53,9 @@ def _bind(cdll: ctypes.CDLL) -> ctypes.CDLL:
         u8, i32, ctypes.c_int64, u32, u32, u32, u32,
     ]
     cdll.polyhash_varcol.restype = None
+    if hasattr(cdll, "crc32c_batch"):
+        cdll.crc32c_batch.argtypes = [u8, i64, ctypes.c_int64, u32]
+        cdll.crc32c_batch.restype = None
     if hasattr(cdll, "kafka_scan_records"):
         cdll.kafka_scan_records.argtypes = [
             u8, ctypes.c_int64, i64, ctypes.c_int64,
@@ -117,22 +120,19 @@ def build(force: bool = False) -> bool:
     if cxx is None:
         # no compiler: a stale-but-working prebuilt .so beats no library
         return _SO.exists()
-    # -march=native is safe here: the library builds lazily ON the box
-    # that runs it (the .so is gitignored); odd toolchains that reject
-    # the flag fall back to the portable build
-    for extra in (["-march=native"], []):
-        try:
-            subprocess.run(
-                [cxx, "-O3", *extra, "-shared", "-fPIC", "-o", str(_SO)]
-                + [str(s) for s in srcs] + ["-ldl"],
-                check=True, capture_output=True, timeout=120,
-            )
-            return True
-        except (subprocess.CalledProcessError,
-                subprocess.TimeoutExpired) as e:
-            last_err = e
-    logger.warning("hostops build failed: %s", last_err)
-    return False
+    # NOTE: -march=native was tried and measured SLOWER on the v5e bench
+    # box (AVX-512 codegen/downclocking on the byte-wise hot loops);
+    # plain -O3 with the runtime SSE4.2/SHA-NI dispatch stays the build
+    try:
+        subprocess.run(
+            [cxx, "-O3", "-shared", "-fPIC", "-o", str(_SO)]
+            + [str(s) for s in srcs] + ["-ldl"],
+            check=True, capture_output=True, timeout=120,
+        )
+        return True
+    except (subprocess.CalledProcessError, subprocess.TimeoutExpired) as e:
+        logger.warning("hostops build failed: %s", e)
+        return False
 
 
 def lib() -> Optional[ctypes.CDLL]:

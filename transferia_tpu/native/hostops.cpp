@@ -554,6 +554,16 @@ static inline bool avro_varint(const uint8_t*& p, const uint8_t* end,
     return false;
 }
 
+// batched CRC32C over a var-width column (kafka key->partition routing:
+// one call per push instead of one ctypes round-trip per row)
+void crc32c_batch(const uint8_t* data, const int64_t* offsets, int64_t n,
+                  uint32_t* out) {
+    for (int64_t i = 0; i < n; i++) {
+        out[i] = crc32c_buf(data + offsets[i],
+                            offsets[i + 1] - offsets[i], 0);
+    }
+}
+
 // ---------------------------------------------------------------------------
 // Kafka RecordBatch v2 scanner: the consume-side twin of
 // kafka_encode_records.  Walks uncompressed frames and emits SIX int64s

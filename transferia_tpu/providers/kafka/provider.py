@@ -218,6 +218,29 @@ class KafkaSinker(Sinker):
             self._partitions[topic] = meta.get(topic) or [0]
         return self._partitions[topic]
 
+    @staticmethod
+    def _key_partitions(pairs, n_parts: int):
+        """crc32c(key) % n_parts per pair, batched through the native lib
+        when present."""
+        import numpy as np
+
+        from transferia_tpu.native import lib as native_lib
+
+        cdll = native_lib()
+        keys = [bytes(k or b"") for k, _ in pairs]
+        if cdll is not None and hasattr(cdll, "crc32c_batch"):
+            data = np.frombuffer(b"".join(keys), dtype=np.uint8)
+            offs = np.zeros(len(keys) + 1, dtype=np.int64)
+            np.cumsum([len(k) for k in keys], out=offs[1:])
+            out = np.empty(len(keys), dtype=np.uint32)
+            cdll.crc32c_batch(
+                data if data.size else np.zeros(1, dtype=np.uint8),
+                offs, len(keys), out)
+            return out % n_parts
+        from transferia_tpu.providers.kafka.protocol import crc32c
+
+        return [crc32c(k) % n_parts for k in keys]
+
     def push(self, batch: Batch) -> None:
         pairs = self.serializer.serialize_messages(batch)
         if not pairs:
@@ -239,16 +262,16 @@ class KafkaSinker(Sinker):
             col_parts = hash_column_to_shards(
                 batch.column(self.params.partition_by), n_parts
             )
-        from transferia_tpu.providers.kafka.protocol import crc32c
-
+        if col_parts is not None:
+            part_idx = col_parts
+        else:
+            # deterministic key hash (crc32c): built-in hash() is
+            # randomized per process and would break per-key partition
+            # affinity across restarts.  One batched native call when
+            # available; the per-key fallback is the same function.
+            part_idx = self._key_partitions(pairs, n_parts)
         for i, (key, value) in enumerate(pairs):
-            if col_parts is not None:
-                p = partitions[int(col_parts[i])]
-            else:
-                # deterministic key hash: built-in hash() is randomized per
-                # process and would break per-key partition affinity across
-                # restarts
-                p = partitions[crc32c(bytes(key or b"")) % n_parts]
+            p = partitions[int(part_idx[i])]
             per_partition.setdefault(p, []).append(
                 Record(key=key, value=value)
             )
