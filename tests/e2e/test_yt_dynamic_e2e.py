@@ -141,3 +141,26 @@ def test_dyntable_ordered_append(yt):
     # keyless schema: appends keep arrival order, duplicates included
     assert all("sort_order" not in c for c in node["attrs"]["schema"])
     assert [r["id"] for r in node["rows"]] == [3, 1, 2, 1]
+
+
+def test_yt_dyn_endpoint_through_activate(yt):
+    """The yt_dyn provider registration end to end: sample source ->
+    factories -> YTDynamicSinker via the real activate path."""
+    from transferia_tpu.coordinator import MemoryCoordinator
+    from transferia_tpu.models import Transfer
+    from transferia_tpu.providers.sample import SampleSourceParams
+    from transferia_tpu.tasks import activate_delivery
+
+    t = Transfer(
+        id="yt-dyn-act",
+        src=SampleSourceParams(preset="users", rows=500),
+        dst=YTDynamicTargetParams(proxy=f"127.0.0.1:{yt.port}",
+                                  dir="//home/act"),
+    )
+    activate_delivery(t, MemoryCoordinator())
+    tables = [p for p in yt.nodes if p.startswith("//home/act/")]
+    assert tables, "no dyntable created through the factory path"
+    node = yt.nodes[tables[0]]
+    assert node["attrs"]["dynamic"] is True
+    assert node["attrs"]["tablet_state"] == "mounted"
+    assert len(node["rows"]) == 500
