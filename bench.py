@@ -669,8 +669,10 @@ def measure_device_fingerprint(rows: int = 1 << 20) -> Optional[dict]:
         return jax.lax.fori_loop(0, iters, body, jnp.uint32(0))
 
     iters = 64
-    # compile + warm (value fetch = the only honest sync)
-    int(loop(2, fixed_lo, fixed_hi, var_blocks, rowmask,
+    # ONE compiled shape: the warm call uses the same static iters (a
+    # second compile through a cold tunnel could eat the subprocess
+    # timeout); value fetch = the only honest sync on this runtime
+    int(loop(iters, fixed_lo, fixed_hi, var_blocks, rowmask,
              seeds1, seeds2, powers1, powers2))
     t0 = time.perf_counter()
     int(loop(iters, fixed_lo, fixed_hi, var_blocks, rowmask,
