@@ -5,6 +5,11 @@ axon sitecustomize) — so setting JAX_PLATFORMS here is not enough.  The
 shared recipe lives in transferia_tpu.testing (also used by the driver's
 __graft_entry__ dry run — keep one copy).  Benchmarks (bench.py) do NOT
 import this and run on the real TPU.
+
+If a WEDGED tunneled-TPU runtime ever makes `import jax` itself hang
+(observed when the local axon relay process dies), run the suite with
+``env -u PYTHONPATH`` to drop the axon site hook — this conftest forces
+the CPU mesh either way.
 """
 
 import os
