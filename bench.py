@@ -1229,6 +1229,21 @@ def measure_kafka_sr2ch(n_partitions: int = 64,
         ch.stop()
 
 
+def _trace_out_path() -> str:
+    """Timeline artifact control: `--trace[=path]` argv or BENCH_TRACE
+    env.  When set, the headline window records pipeline spans
+    (stats/trace.py) and writes a Perfetto-loadable trace.json next to
+    the usual stderr diagnostics — every benchmark run can then ship a
+    timeline artifact alongside its numbers."""
+    out = os.environ.get("BENCH_TRACE", "")
+    for a in sys.argv[1:]:
+        if a == "--trace":
+            out = out or os.path.join(DATA_DIR, "bench_trace.json")
+        elif a.startswith("--trace="):
+            out = a.split("=", 1)[1]
+    return out
+
+
 def main() -> None:
     from transferia_tpu.stats import stagetimer
 
@@ -1269,11 +1284,26 @@ def main() -> None:
     from transferia_tpu.providers import parquet_native
 
     parquet_native.reset_fallback_stats()
+    trace_out = _trace_out_path()
+    if trace_out:
+        from transferia_tpu.stats import trace as _trace
+
+        _trace.reset()
+        _trace.enable(True)
     stagetimer.enable(True)
     stagetimer.reset()
     with cpu_profile() as prof:
         rows, dt = run_pipeline(parquet=WIDE_PARQUET, total_rows=WIDE_ROWS)
     stage_note = stagetimer.format_breakdown(dt)
+    if trace_out:
+        from transferia_tpu.stats import trace as _trace
+
+        _trace.enable(False)
+        n_events = _trace.write_chrome_trace(trace_out)
+        print(f"# trace: {n_events} events -> {trace_out}",
+              file=sys.stderr)
+        for line in _trace.format_summary(dt).splitlines():
+            print(f"# trace: {line}", file=sys.stderr)
     native_fallbacks = parquet_native.fallback_stats()
     rps = rows / dt
     # continuity line: the r01-r03 10-col dataset (own warmup so its

@@ -129,6 +129,22 @@ def build_parser() -> argparse.ArgumentParser:
                          help="generate per-provider typesystem.md files")
     tsd.add_argument("--out", default="docs/typesystem",
                      help="output directory")
+    trc = sub.add_parser(
+        "trace",
+        help="run a transfer with pipeline tracing on; write a "
+             "Perfetto-loadable timeline + per-stage summary")
+    trc.add_argument("--transfer", default="",
+                     help="path to transfer.yaml (default: built-in "
+                          "sample->stdout demo with a fused mask+filter "
+                          "chain)")
+    trc.add_argument("--out", default="trace.json",
+                     help="Chrome trace-event JSON output path "
+                          "(open in Perfetto / chrome://tracing)")
+    trc.add_argument("--seconds", type=float, default=10.0,
+                     help="capture window for replication transfers "
+                          "(snapshot transfers run to completion)")
+    trc.add_argument("--rows", type=int, default=50_000,
+                     help="demo source rows (only without --transfer)")
     return p
 
 
@@ -158,6 +174,17 @@ def _setup(args) -> None:
     apply_resource_limits()
 
 
+def _query_seconds(path: str, default: float = 5.0) -> float:
+    """?seconds=N off a debug-endpoint path (callers cap at 60)."""
+    from urllib.parse import parse_qs, urlparse
+
+    q = parse_qs(urlparse(path).query)
+    try:
+        return float(q.get("seconds", [default])[0])
+    except ValueError:
+        return default
+
+
 def _start_health_server(port: int) -> int:
     """Minimal /health endpoint (pkg/serverutil healthcheck).
 
@@ -166,18 +193,22 @@ def _start_health_server(port: int) -> int:
 
     class Handler(http.server.BaseHTTPRequestHandler):
         def do_GET(self):
-            if self.path.startswith("/debug/profile"):
+            if self.path.startswith("/debug/trace"):
+                # span timeline capture (stats/trace.py): enables tracing
+                # for ?seconds=N (cap 60), returns Chrome trace-event
+                # JSON loadable in Perfetto / chrome://tracing
+                from transferia_tpu.stats import trace
+
+                secs = _query_seconds(self.path)
+                body = json.dumps(trace.capture_seconds(secs)).encode()
+                ctype = "application/json"
+                status = 200
+            elif self.path.startswith("/debug/profile"):
                 # sampling CPU profile (reference: always-on pprof,
                 # cmd/trcli/main.go:62-64); ?seconds=N caps at 60
-                from urllib.parse import parse_qs, urlparse
-
                 from transferia_tpu.stats.profiler import sample_seconds
 
-                q = parse_qs(urlparse(self.path).query)
-                try:
-                    secs = float(q.get("seconds", ["5"])[0])
-                except ValueError:
-                    secs = 5.0
+                secs = _query_seconds(self.path)
                 body = sample_seconds(secs).format(30).encode()
                 ctype = "text/plain"
                 status = 200
@@ -278,6 +309,8 @@ def main(argv=None) -> int:
         return cmd_validate(args)
     if args.command == "typesystem-docs":
         return cmd_typesystem_docs(args)
+    if args.command == "trace":
+        return cmd_trace(args)
 
     transfer = _load_transfer(args)
     cp = _coordinator(args)
@@ -490,6 +523,79 @@ def cmd_checksum(args, transfer) -> int:
                               params, equal_data_types=eq)
     print(report.summary())
     return 0 if report.ok else 1
+
+
+def _demo_trace_transfer(rows: int):
+    """sample->stdout snapshot with a fusable mask+filter chain: a
+    self-contained timeline demo that exercises source decode, the
+    fused device transform (mask+filter), the row pivot (verbose stdout
+    sink unpivots a slice), and the sink — no external services."""
+    from transferia_tpu.models import Transfer, TransferType
+    from transferia_tpu.providers.sample import SampleSourceParams
+    from transferia_tpu.providers.stdout import StdoutTargetParams
+
+    return Transfer(
+        id="trace-demo",
+        type=TransferType.SNAPSHOT_ONLY,
+        src=SampleSourceParams(preset="iot", rows=rows),
+        dst=StdoutTargetParams(verbose=True, max_rows_printed=2),
+        transformation={"transformers": [
+            {"mask_field": {"columns": ["device_id"], "salt": "trace"}},
+            {"filter_rows": {"filter": "event_id >= 0"}},
+        ]},
+    )
+
+
+def cmd_trace(args) -> int:
+    """Run one transfer with tracing enabled; write trace.json (Chrome
+    trace-event format, open in Perfetto) and print the stage summary
+    (p50/p99 per stage, overlap factor, bytes moved) plus the device
+    telemetry counters."""
+    import time as _time
+
+    from transferia_tpu.stats import trace
+    from transferia_tpu.stats.registry import Metrics
+
+    if args.transfer:
+        transfer = _load_transfer(args)
+    else:
+        transfer = _demo_trace_transfer(args.rows)
+    cp = _coordinator(args)
+    metrics = Metrics()
+    trace.reset()
+    trace.TELEMETRY.reset()  # fresh counters for this one-shot run
+    trace.enable(True)
+    t0 = _time.perf_counter()
+    try:
+        if transfer.type.has_replication:
+            from transferia_tpu.runtime import run_replication
+
+            stop = threading.Event()
+            timer = threading.Timer(max(0.5, args.seconds), stop.set)
+            timer.daemon = True
+            timer.start()
+            try:
+                run_replication(transfer, cp, metrics=metrics,
+                                stop_event=stop)
+            finally:
+                timer.cancel()
+        else:
+            from transferia_tpu.tasks import SnapshotLoader
+
+            SnapshotLoader(transfer, cp, metrics=metrics).upload_tables()
+    finally:
+        # export in the finally: a failed transfer is exactly when the
+        # timeline matters most — the spans up to the failure survive
+        wall = _time.perf_counter() - t0
+        trace.enable(False)
+        trace.TELEMETRY.fold_into(metrics)  # prometheus exposure
+        n_events = trace.write_chrome_trace(args.out)
+        print(f"trace: {n_events} events -> {args.out} "
+              f"(open in https://ui.perfetto.dev or chrome://tracing)")
+        print(trace.format_summary(wall))
+        print("device telemetry: "
+              + json.dumps(trace.TELEMETRY.snapshot()))
+    return 0
 
 
 def cmd_validate(args) -> int:

@@ -464,6 +464,16 @@ class ColumnBatch:
         BASELINE.json north star describes (ChangeItem rows -> column
         buffers).
         """
+        from transferia_tpu.stats import trace
+
+        sp = trace.span("pivot")
+        if sp:
+            sp.add(rows=len(items), direction="rows_to_columns")
+        with sp:
+            return ColumnBatch._from_rows_impl(items)
+
+    @staticmethod
+    def _from_rows_impl(items: Sequence[ChangeItem]) -> "ColumnBatch":
         if not items:
             raise ValueError("from_rows: empty batch")
         first = items[0]
@@ -523,6 +533,15 @@ class ColumnBatch:
     # -- row view -----------------------------------------------------------
     def to_rows(self) -> list[ChangeItem]:
         """Unpivot to ChangeItems (row-oriented edges only)."""
+        from transferia_tpu.stats import trace
+
+        sp = trace.span("pivot")
+        if sp:
+            sp.add(rows=self.n_rows, direction="columns_to_rows")
+        with sp:
+            return self._to_rows_impl()
+
+    def _to_rows_impl(self) -> list[ChangeItem]:
         names = tuple(self.columns.keys())
         cols = list(self.columns.values())
         out = []

@@ -82,8 +82,13 @@ class DebeziumEmitter:
         self.emit_tombstones = emit_tombstones
         self.source_db_type = source_db_type
         self.key_packer = self.value_packer = None
-        # id(schema) keys are safe: TableSchema objects are shared per
-        # batch and never mutated; an ALTER produces a new object
+        # keyed on schema.fingerprint(), never id(schema): a freed
+        # TableSchema's address can be reused by a new schema for the
+        # same table (same column count after a rename/type change),
+        # which would silently serve a stale envelope — the exact trap
+        # parsers/plugins.py _flat_spec avoids by caching on the object
+        # (TableSchema is slotted, so the fingerprint key is the
+        # equivalent here; it is computed once and cached on the schema)
         self._value_schema_cache: dict = {}
         self._key_schema_cache: dict = {}
         # rendered %s-templates for the vectorized columnar path
@@ -115,7 +120,7 @@ class DebeziumEmitter:
     # -- schema blocks (cached per table schema fingerprint) ---------------
     def _value_schema(self, item: ChangeItem, schema: TableSchema) -> dict:
         fqtn = f"{self.topic_prefix}.{item.schema}.{item.table}"
-        cached = self._value_schema_cache.get((fqtn, id(schema)))
+        cached = self._value_schema_cache.get((fqtn, schema.fingerprint()))
         if cached is not None:
             return cached
         row_fields = [_field_schema(c) for c in schema]
@@ -154,19 +159,19 @@ class DebeziumEmitter:
                 {"type": "int64", "optional": True, "field": "ts_ms"},
             ],
         }
-        self._value_schema_cache[(fqtn, id(schema))] = out
+        self._value_schema_cache[(fqtn, schema.fingerprint())] = out
         return out
 
     def _key_schema(self, item: ChangeItem, schema: TableSchema) -> dict:
         fqtn = f"{self.topic_prefix}.{item.schema}.{item.table}"
-        cached = self._key_schema_cache.get((fqtn, id(schema)))
+        cached = self._key_schema_cache.get((fqtn, schema.fingerprint()))
         if cached is not None:
             return cached
         out = {
             "type": "struct", "optional": False, "name": f"{fqtn}.Key",
             "fields": [_field_schema(c) for c in schema.key_columns()],
         }
-        self._key_schema_cache[(fqtn, id(schema))] = out
+        self._key_schema_cache[(fqtn, schema.fingerprint())] = out
         return out
 
     # -- payload ------------------------------------------------------------
@@ -459,7 +464,8 @@ class DebeziumEmitter:
         # multi-KB schema json per small CDC batch would dwarf the row
         # rendering this path accelerates.  \x00TS\x00 marks the
         # envelope timestamp slot (a NUL can never appear in json text)
-        cache_key = (item_schema, item_table, id(schema), snapshot)
+        cache_key = (item_schema, item_table, schema.fingerprint(),
+                     snapshot)
         tmpl = self._fast_tmpl_cache.get(cache_key)
         if tmpl is None:
             tmpl = self._build_templates(schema, names, key_cols,

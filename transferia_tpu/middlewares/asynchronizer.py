@@ -32,6 +32,7 @@ from transferia_tpu.middlewares.helpers import (
     batch_len,
     is_control_batch,
 )
+from transferia_tpu.stats import trace
 from transferia_tpu.stats.registry import BuffererStats
 
 logger = logging.getLogger(__name__)
@@ -66,7 +67,8 @@ class Asynchronizer(AsyncSink):
                 return
             batch, fut = item
             try:
-                self.inner.push(batch)
+                with trace.span("sink_push"):
+                    self.inner.push(batch)
                 fut.set_result(None)
             except BaseException as e:
                 fut.set_exception(e)
@@ -218,11 +220,18 @@ class Bufferer(AsyncSink):
     def _flush_locked(self) -> None:
         buf, self._buf = self._buf, []
         rows, self._rows = self._rows, 0
-        self._bytes = 0
+        nbytes, self._bytes = self._bytes, 0
         self.stats.buffered_rows.set(0)
         self.stats.buffered_bytes.set(0)
         if not buf:
             return
+        sp = trace.span("bufferer_flush")
+        if sp:
+            sp.add(rows=rows, bytes=nbytes, units=len(buf))
+        with sp:
+            self._flush_groups(buf)
+
+    def _flush_groups(self, buf: list[tuple[Batch, "Future"]]) -> None:
         # merge adjacent compatible units into big pushes
         groups: list[tuple[list[Batch], list[Future]]] = []
         for batch, fut in buf:

@@ -22,6 +22,7 @@ from transferia_tpu.middlewares.helpers import (
     batch_len,
     split_rows_controls,
 )
+from transferia_tpu.stats import trace
 from transferia_tpu.stats.registry import SinkerStats
 from transferia_tpu.utils.backoff import retry_with_backoff
 
@@ -50,9 +51,13 @@ class Statistician(_Wrap):
         n = batch_len(batch)
         nbytes = batch_bytes(batch)
         self.stats.inflight_rows.inc(n)
+        sp = trace.span("sink")
+        if sp:
+            sp.add(rows=n, bytes=nbytes)
         t0 = time.monotonic()
         try:
-            self.inner.push(batch)
+            with sp:
+                self.inner.push(batch)
         except BaseException:
             self.stats.errors.inc()
             raise
@@ -226,7 +231,10 @@ class Transformation(_Wrap):
     def push(self, batch: Batch) -> None:
         from transferia_tpu.stats import stagetimer
 
-        with stagetimer.stage("transform"):
+        sp = trace.span("transform")
+        if sp:
+            sp.add(rows=batch_len(batch))
+        with stagetimer.stage("transform"), sp:
             out = self.chain.apply(batch)
         if batch_len(out) or not batch_len(batch):
             self.inner.push(out)

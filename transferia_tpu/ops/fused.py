@@ -35,7 +35,10 @@ from transferia_tpu.ops.sha256 import (
     hmac_device_core,
     prepare_padded_blocks,
 )
-from transferia_tpu.stats import stagetimer
+from transferia_tpu.stats import stagetimer, trace
+from transferia_tpu.stats.trace import TELEMETRY
+
+trace.install_jit_hooks()  # compile-event telemetry rides jax monitoring
 
 _chunk_rows_cached: Optional[int] = None
 
@@ -206,6 +209,27 @@ class FusedMaskFilterProgram:
         use_pallas_pack = _pallas_pack_enabled()
         blocks_t, nblocks_t, mb_t = [], [], []
         pack_t0 = _time.perf_counter()
+        with trace.span("pack"):
+            self._pack_inputs(mask_cols, n_rows, bucket,
+                              use_pallas_pack, blocks_t, nblocks_t, mb_t)
+            dev_pred = self._pack_pred(pred_cols, n_rows, bucket)
+        stagetimer.add("pack", _time.perf_counter() - pack_t0)
+        h2d = (sum(int(b.nbytes) + int(nb.nbytes)
+                   for b, nb in zip(blocks_t, nblocks_t))
+               + sum(int(d.nbytes) + int(v.nbytes)
+                     for d, v in dev_pred.values()))
+        TELEMETRY.record_h2d(h2d)
+        TELEMETRY.record_launch()
+        with stagetimer.stage("device_dispatch"), \
+                trace.span("device_dispatch", bytes=h2d, rows=n_rows):
+            hexes_dev, keep_dev = self._jit(
+                tuple(blocks_t), tuple(nblocks_t), tuple(self._states),
+                dev_pred, tuple(mb_t),
+            )
+        return hexes_dev, keep_dev
+
+    def _pack_inputs(self, mask_cols, n_rows, bucket,
+                     use_pallas_pack, blocks_t, nblocks_t, mb_t):
         for data, offsets in mask_cols:
             lens = offsets[1:] - offsets[:-1]
             max_len = int(lens.max()) if n_rows else 0
@@ -239,6 +263,8 @@ class FusedMaskFilterProgram:
             blocks_t.append(jnp.asarray(blocks))
             nblocks_t.append(jnp.asarray(n_blocks))
             mb_t.append(mb)
+
+    def _pack_pred(self, pred_cols, n_rows, bucket) -> dict:
         dev_pred = {}
         for name, (data, validity) in pred_cols.items():
             if validity is None:
@@ -247,13 +273,7 @@ class FusedMaskFilterProgram:
                 data = np.pad(data, (0, bucket - n_rows))
                 validity = np.pad(validity, (0, bucket - n_rows))
             dev_pred[name] = (jnp.asarray(data), jnp.asarray(validity))
-        stagetimer.add("pack", _time.perf_counter() - pack_t0)
-        with stagetimer.stage("device_dispatch"):
-            hexes_dev, keep_dev = self._jit(
-                tuple(blocks_t), tuple(nblocks_t), tuple(self._states),
-                dev_pred, tuple(mb_t),
-            )
-        return hexes_dev, keep_dev
+        return dev_pred
 
     def _collect(self, digests_dev, keep_dev, n_rows
                  ) -> tuple[list[np.ndarray], Optional[np.ndarray]]:
@@ -261,7 +281,9 @@ class FusedMaskFilterProgram:
         from transferia_tpu.columnar.hexcol import digests_to_hex
 
         hexes = []
-        with stagetimer.stage("device_wait"):
+        t0 = _time.perf_counter()
+        with stagetimer.stage("device_wait"), \
+                trace.span("device_wait") as sp:
             for h in digests_dev:
                 # digests_to_hex allocates fresh output, so the sliced
                 # view never pins the bucket-padded transfer buffer
@@ -269,6 +291,13 @@ class FusedMaskFilterProgram:
                 hexes.append(digests_to_hex(arr))
             keep = (np.asarray(keep_dev)[:n_rows]
                     if self._pred_fn is not None else None)
+            d2h = sum(int(h.nbytes) for h in digests_dev)
+            if keep_dev is not None and self._pred_fn is not None:
+                d2h += int(keep_dev.nbytes)
+            if sp:  # args must attach before the span ends
+                sp.add(bytes=d2h, rows=n_rows)
+        TELEMETRY.record_d2h(d2h)
+        TELEMETRY.record_kernel(_time.perf_counter() - t0)
         return hexes, keep
 
     def _run_single(self, mask_cols, pred_cols, n_rows):

@@ -41,7 +41,8 @@ from transferia_tpu.ops.fused import (
     pow2_blocks,
 )
 from transferia_tpu.ops.sha256 import _hmac_key_states, hmac_device_core
-from transferia_tpu.stats import stagetimer
+from transferia_tpu.stats import stagetimer, trace
+from transferia_tpu.stats.trace import TELEMETRY
 
 
 def _shard_map(fn, mesh, in_specs, out_specs):
@@ -202,16 +203,35 @@ class ShardedFusedProgram:
         valid[:n_rows] = True
         stagetimer.add("pack", _time.perf_counter() - pack_t0)
         fn = self._get_compiled(len(mask_cols), tuple(sorted(dev_pred)))
-        with stagetimer.stage("device_dispatch"):
+        h2d = (sum(int(b.nbytes) + int(nb.nbytes)
+                   for b, nb in zip(blocks_t, nblocks_t))
+               + sum(int(d.nbytes) + int(v.nbytes)
+                     for d, v in dev_pred.values())
+               + int(valid.nbytes))
+        TELEMETRY.record_h2d(h2d)
+        TELEMETRY.record_launch()
+        with stagetimer.stage("device_dispatch"), \
+                trace.span("device_dispatch", bytes=h2d, rows=n_rows,
+                           mesh=self.n_dev):
             digests_dev, keep_dev, hist, kept = fn(
                 tuple(blocks_t), tuple(nblocks_t), tuple(self._states),
                 dev_pred, valid, tuple(mb_t),
             )
-        with stagetimer.stage("device_wait"):
+        t_wait0 = _time.perf_counter()
+        with stagetimer.stage("device_wait"), \
+                trace.span("device_wait") as sp:
             hexes = [digests_to_hex(np.asarray(h)[:n_rows])
                      for h in digests_dev]
             keep = (np.asarray(keep_dev)[:n_rows]
                     if self._pred_fn is not None else None)
             self.last_shard_hist = np.asarray(hist)
             self.last_kept = int(kept)
+            d2h = (sum(int(h.nbytes) for h in digests_dev)
+                   + int(hist.nbytes))
+            if keep_dev is not None and self._pred_fn is not None:
+                d2h += int(keep_dev.nbytes)
+            if sp:  # args must attach before the span ends
+                sp.add(bytes=d2h, rows=n_rows)
+        TELEMETRY.record_d2h(d2h)
+        TELEMETRY.record_kernel(_time.perf_counter() - t_wait0)
         return hexes, keep

@@ -8,6 +8,7 @@ import threading
 from typing import Any, Callable, Generic, Optional, Sequence, TypeVar
 
 from transferia_tpu.abstract.interfaces import AsyncSink, Batch
+from transferia_tpu.stats import trace
 
 logger = logging.getLogger(__name__)
 
@@ -76,7 +77,10 @@ class ParseQueue(Generic[T]):
 
     # -- internals ----------------------------------------------------------
     def _safe_parse(self, raw: T):
-        return self.parse_fn(raw)
+        # the parser layer runs here (parse workers): decode raw broker
+        # messages into batches — the source_decode stage of the timeline
+        with trace.span("source_decode"):
+            return self.parse_fn(raw)
 
     def _push_loop(self) -> None:
         while True:
@@ -96,12 +100,18 @@ class ParseQueue(Generic[T]):
                     parsed = parse_fut.result()
                     batches = parsed if isinstance(parsed, list) \
                         else [parsed]
-                    futs = []
-                    for b in batches:
-                        if b is not None and _batch_len(b):
-                            futs.append(self.sink.async_push(b))
-                    for f in futs:
-                        f.result()
+                    # "sink_wait", not "sink_push": the actual push
+                    # executes (and is spanned) inside the async sink's
+                    # own worker — this span is the ordered-delivery
+                    # wait, and naming them apart keeps the stage
+                    # summary from double-counting the push
+                    with trace.span("sink_wait"):
+                        futs = []
+                        for b in batches:
+                            if b is not None and _batch_len(b):
+                                futs.append(self.sink.async_push(b))
+                        for f in futs:
+                            f.result()
                 except BaseException as e:
                     err = e
             try:

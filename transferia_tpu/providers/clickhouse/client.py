@@ -10,6 +10,7 @@ from __future__ import annotations
 import http.client
 import logging
 import threading
+import time
 import urllib.parse
 from typing import Iterator, Optional
 
@@ -25,11 +26,22 @@ class CHError(CategorizedError):
 
 
 class CHClient:
+    # retire pooled sockets idle longer than this before sending.  The
+    # common stale-keep-alive failure mode is request() writing into a
+    # half-closed socket successfully and getresponse() failing — a path
+    # that can never be retried safely (the body may have executed), so
+    # it always surfaced a CHError to the outer retrier.  Proactively
+    # reconnecting under the server's keep_alive_timeout (3s on older
+    # ClickHouse releases, 10s on newer) avoids ever entering that race
+    # while keeping the conservative no-retry-after-send policy.
+    KEEP_ALIVE_IDLE = 2.5
+
     def __init__(self, host: str = "localhost", port: int = 8123,
                  database: str = "default", user: str = "default",
                  password: str = "", secure: bool = False,
                  timeout: float = 300.0,
-                 settings: Optional[dict] = None):
+                 settings: Optional[dict] = None,
+                 keep_alive_idle: Optional[float] = None):
         self.host = host
         self.port = port
         self.database = database
@@ -38,6 +50,9 @@ class CHClient:
         self.secure = secure
         self.timeout = timeout
         self.settings = settings or {}
+        self.keep_alive_idle = (self.KEEP_ALIVE_IDLE
+                                if keep_alive_idle is None
+                                else keep_alive_idle)
         # keep-alive: one persistent connection per thread (sink workers
         # push concurrently) — a connect+teardown per INSERT dominated the
         # small-batch replication profile.  All pooled connections are
@@ -52,14 +67,26 @@ class CHClient:
             else http.client.HTTPConnection
         return cls(self.host, self.port, timeout=self.timeout)
 
-    def _pooled(self) -> http.client.HTTPConnection:
+    def _pooled(self) -> tuple[http.client.HTTPConnection, bool]:
+        """(connection, reused): reused reflects the RETURNED socket —
+        a proactively retired idle connection hands back a fresh one,
+        which must not qualify for the stale-keep-alive retry."""
         conn = getattr(self._local, "conn", None)
+        if conn is not None and self.keep_alive_idle > 0 and \
+                time.monotonic() - getattr(conn, "_last_use", 0.0) \
+                > self.keep_alive_idle:
+            # idle past the server keep-alive window: the socket may be
+            # half-closed server-side; drop it before sending
+            self._drop_pooled()
+            conn = None
+        reused = conn is not None
         if conn is None:
             conn = self._connect()
+            conn._last_use = time.monotonic()
             self._local.conn = conn
             with self._pool_lock:
                 self._all_conns.append(conn)
-        return conn
+        return conn, reused
 
     def _drop_pooled(self) -> None:
         conn = getattr(self._local, "conn", None)
@@ -112,8 +139,7 @@ class CHClient:
             headers["Authorization"] = f"Basic {cred}"
         path = "/?" + self._params(query, extra_params)
         for attempt in (0, 1):
-            reused = getattr(self._local, "conn", None) is not None
-            conn = self._pooled()
+            conn, reused = self._pooled()
             sent = False
             try:
                 conn.request("POST", path, body=body, headers=headers)
@@ -143,6 +169,8 @@ class CHClient:
                 )
             if resp.will_close:
                 self._drop_pooled()
+            else:
+                conn._last_use = time.monotonic()
             return data
         raise CHError("clickhouse connection failed")  # unreachable
 

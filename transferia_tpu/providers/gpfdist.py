@@ -25,6 +25,7 @@ from __future__ import annotations
 import logging
 import queue
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Optional
 
@@ -83,6 +84,15 @@ class GpfdistServer:
             def do_GET(self):
                 slot = self._slot()
                 data = server._next_chunk(slot)
+                if data is None:
+                    # unregistered slot (stray GET after release(), a
+                    # typo'd location) or a wedged load past the drain
+                    # deadline — 404 fails the segment scan instead of
+                    # pinning a server thread or faking a clean EOF
+                    self.send_response(404)
+                    self.send_header("Content-Length", "0")
+                    self.end_headers()
+                    return
                 self.send_response(200)
                 self.send_header("Content-Type", "text/csv")
                 self.send_header("Content-Length", str(len(data)))
@@ -163,9 +173,23 @@ class GpfdistServer:
             self._finished.add(slot)
             self._out.setdefault(slot, queue.Queue())
 
-    def _next_chunk(self, slot: str) -> bytes:
+    # a load that stalls longer than this between chunks is wedged; the
+    # deadline keeps stray segment GETs from pinning server threads
+    DRAIN_TIMEOUT = 600.0
+
+    def _next_chunk(self, slot: str) -> Optional[bytes]:
+        """Next pending CSV chunk for a segment GET.
+
+        Returns None for slots never registered via put_chunk/finish
+        (the handler answers 404); b"" signals end-of-data.  Never
+        recreates a released slot's queue — before the deadline was
+        added, a GET arriving after release() would setdefault a fresh
+        queue and spin forever."""
         with self._lock:
-            q = self._out.setdefault(slot, queue.Queue())
+            q = self._out.get(slot)
+        if q is None:
+            return None
+        deadline = time.monotonic() + self.DRAIN_TIMEOUT
         while True:
             try:
                 return q.get(timeout=0.2)
@@ -173,3 +197,14 @@ class GpfdistServer:
                 with self._lock:
                     if slot in self._finished and q.empty():
                         return b""
+                    if slot not in self._out:
+                        return None  # released mid-drain
+                if time.monotonic() > deadline:
+                    # 404, NOT the b"" end-of-data sentinel: a clean
+                    # EOF here would let the INSERT..SELECT commit a
+                    # partial table; erroring the segment GET fails the
+                    # load loudly instead
+                    logger.warning(
+                        "gpfdist load %s: no chunk within %.0fs; "
+                        "failing the stream", slot, self.DRAIN_TIMEOUT)
+                    return None

@@ -206,11 +206,18 @@ class SampleStorage(Storage, ShardingStorage):
             lo, hi = int(lo_s), int(hi_s)
         else:
             lo, hi = 0, self.params.rows
+        from transferia_tpu.stats import trace
+
         bs = self.params.batch_rows
         for start in range(lo, hi, bs):
             n = min(bs, hi - start)
-            pusher(make_batch(self.params.preset, table.id, start, n,
-                              self.params.seed))
+            sp = trace.span("source_decode")
+            if sp:
+                sp.add(rows=n)
+            with sp:
+                batch = make_batch(self.params.preset, table.id, start, n,
+                                   self.params.seed)
+            pusher(batch)
 
 
 class SampleReplicationSource(Source):

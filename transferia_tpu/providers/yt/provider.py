@@ -419,9 +419,15 @@ class YTDynamicSinker(Sinker):
         import bisect
 
         groups: dict[int, list[dict]] = {}
-        for r in rows:
-            idx = bisect.bisect_right(bounds, r.get(key_col))
-            groups.setdefault(idx, []).append(r)
+        try:
+            for r in rows:
+                idx = bisect.bisect_right(bounds, r.get(key_col))
+                groups.setdefault(idx, []).append(r)
+        except TypeError:
+            # pivot/row key type mismatch (yson vs string pivots, None
+            # keys): degrade to one unsplit request — correct, just
+            # cross-tablet — instead of failing the push
+            return [rows]
         return [groups[i] for i in sorted(groups)]
 
     # -- push ----------------------------------------------------------------
@@ -492,12 +498,23 @@ class YTDynamicSinker(Sinker):
             for chunk in chunks:
                 for lo in range(0, len(chunk), self.params.batch_rows):
                     part = chunk[lo:lo + self.params.batch_rows]
-                    if run_kind == "del":
-                        self.client.delete_rows(
-                            path, part, atomicity=self.params.atomicity)
-                    else:
-                        self.client.insert_rows(
-                            path, part, atomicity=self.params.atomicity)
+                    try:
+                        if run_kind == "del":
+                            self.client.delete_rows(
+                                path, part,
+                                atomicity=self.params.atomicity)
+                        else:
+                            self.client.insert_rows(
+                                path, part,
+                                atomicity=self.params.atomicity)
+                    except YTError:
+                        # a reshard/remount voids the cached pivot keys
+                        # (the one-tablet-per-request invariant would
+                        # silently break); drop them so the sink retry
+                        # re-reads tablet boundaries and mount state
+                        self._pivots.pop(table, None)
+                        self._ready.discard(table)
+                        raise
 
         run_kind = ""
         buf: list[dict] = []

@@ -17,6 +17,7 @@ from transferia_tpu.abstract.errors import is_fatal
 from transferia_tpu.coordinator.interface import Coordinator, TransferStatus
 from transferia_tpu.factories import make_async_sink, new_source
 from transferia_tpu.middlewares.asynchronizer import ErrorTracker
+from transferia_tpu.stats import trace
 from transferia_tpu.stats.registry import Metrics, ReplicationStats
 
 logger = logging.getLogger(__name__)
@@ -43,8 +44,14 @@ class LocalWorker:
                                     snapshot_stage=False)
         self.source = new_source(self.transfer, self.metrics,
                                  coordinator=self.cp)
+        # root span for the whole attempt: per-batch spans recorded by
+        # parsequeue / middlewares on worker threads share its timeline
+        sp = trace.span("replication_attempt")
+        if sp:
+            sp.add(transfer_id=self.transfer.id)
         try:
-            self.source.run(self.sink)
+            with sp:
+                self.source.run(self.sink)
             # surface sink-side failures latched by the error tracker
             if isinstance(self.sink, ErrorTracker) and self.sink.failure:
                 raise self.sink.failure
@@ -178,7 +185,7 @@ def run_replication(transfer, coordinator: Coordinator,
         stopper.start()
         heartbeat = threading.Thread(
             target=_heartbeat_loop,
-            args=(stop_event, coordinator, transfer.id),
+            args=(stop_event, coordinator, transfer.id, metrics),
             daemon=True,
         )
         heartbeat.start()
@@ -247,6 +254,12 @@ def _stop_on_event(stop_event: threading.Event, worker: LocalWorker) -> None:
 
 
 def _heartbeat_loop(stop_event: threading.Event, cp: Coordinator,
-                    transfer_id: str) -> None:
+                    transfer_id: str,
+                    metrics: Optional[Metrics] = None) -> None:
     while not stop_event.wait(HEARTBEAT_SECONDS):
         cp.transfer_health(transfer_id, healthy=True)
+        if metrics is not None:
+            # device counters ride the heartbeat onto this pipeline's
+            # metrics so long replications expose them, not just the
+            # one-shot trace/snapshot paths
+            trace.TELEMETRY.fold_into(metrics)

@@ -29,6 +29,7 @@ from transferia_tpu.serializers.formats import (
     QueueSerializer,
     _rows_of,
 )
+from transferia_tpu.stats import trace
 
 logger = logging.getLogger(__name__)
 
@@ -82,6 +83,13 @@ class ConcurrentBatchSerializer(BatchSerializer):
 
     def serialize(self, batch: Batch) -> bytes:
         rows = _rows_of(batch)
+        sp = trace.span("serialize")
+        if sp:
+            sp.add(rows=len(rows))
+        with sp:
+            return self._serialize_rows(rows)
+
+    def _serialize_rows(self, rows) -> bytes:
         if self.concurrency < 2 or len(rows) <= self.threshold:
             return self.inner.serialize(rows)
         chunk = (len(rows) + self.concurrency - 1) // self.concurrency
@@ -129,6 +137,13 @@ class ConcurrentQueueSerializer(QueueSerializer):
 
     def serialize_messages(self, batch: Batch):
         rows = _rows_of(batch)
+        sp = trace.span("serialize")
+        if sp:
+            sp.add(rows=len(rows))
+        with sp:
+            return self._serialize_rows(rows)
+
+    def _serialize_rows(self, rows):
         if self.concurrency < 2 or len(rows) <= self.threshold:
             return self._inner(0).serialize_messages(rows)
         chunk = (len(rows) + self.concurrency - 1) // self.concurrency

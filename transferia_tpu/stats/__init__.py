@@ -7,9 +7,9 @@ sinker.go:12, middleware.go) and are exposed on the CLI's /metrics port.
 """
 
 from transferia_tpu.stats.registry import Metrics, SinkerStats, SourceStats, \
-    BuffererStats, ReplicationStats, TableStats, TransformStats
+    BuffererStats, DeviceStats, ReplicationStats, TableStats, TransformStats
 
 __all__ = [
     "Metrics", "SourceStats", "SinkerStats", "BuffererStats",
-    "ReplicationStats", "TableStats", "TransformStats",
+    "DeviceStats", "ReplicationStats", "TableStats", "TransformStats",
 ]

@@ -45,6 +45,13 @@ def _is_idle(qualname: str, filename: str) -> bool:
     return (leaf, filename) in _IDLE_FRAMES
 
 
+def _qualname(code) -> str:
+    # co_qualname is 3.11+; co_name keeps 3.10 samplers alive (the
+    # attribute error killed the sampler thread on its first tick,
+    # silently producing empty profiles)
+    return getattr(code, "co_qualname", None) or code.co_name
+
+
 @dataclass
 class ProfileReport:
     seconds: float = 0.0
@@ -58,8 +65,9 @@ class ProfileReport:
     def top(self, n: int = 10) -> list[tuple[str, float, float]]:
         """[(location, self_cpu_seconds, self_pct)] — hottest first.
 
-        Weights are CPU seconds (per-thread /proc deltas) on Linux, or
-        one sampling tick per busy sample in the wall fallback."""
+        Weights are CPU seconds (per-thread POSIX CPU-clock deltas) on
+        POSIX, or one sampling tick per busy sample in the wall
+        fallback."""
         total = sum(self.self_counts.values())
         if not total:
             return []
@@ -85,57 +93,14 @@ class ProfileReport:
         return "\n".join(lines)
 
 
-def _thread_cpu_seconds() -> Optional[dict[int, float]]:
-    """native_id -> CPU seconds (utime+stime) from /proc/self/task.
-
-    This is what turns the wall sampler into a real CPU profiler: a
-    thread blocked in recv()/select() accrues no CPU, so its frames get
-    zero weight — without this, on a busy multi-threaded process most
-    samples land on parked threads and the hot code drowns.  Returns
-    None off Linux (callers fall back to wall weighting)."""
-    import os
-
-    try:
-        tids = os.listdir("/proc/self/task")
-    except OSError:
-        return None
-    hz = _clk_tck()
-    out: dict[int, float] = {}
-    for tid in tids:
-        try:
-            with open(f"/proc/self/task/{tid}/stat", "rb") as fh:
-                raw = fh.read()
-        except OSError:
-            continue  # thread exited between listdir and read
-        # comm can contain spaces/parens: split after the LAST ')'
-        rest = raw[raw.rfind(b")") + 2:].split()
-        utime, stime = int(rest[11]), int(rest[12])
-        out[int(tid)] = (utime + stime) / hz
-    return out
-
-
-_CLK = None
-
-
-def _clk_tck() -> float:
-    global _CLK
-    if _CLK is None:
-        import os
-
-        try:
-            _CLK = float(os.sysconf("SC_CLK_TCK"))
-        except (ValueError, OSError, AttributeError):
-            _CLK = 100.0
-    return _CLK
-
-
 class Sampler:
     """Background sampling thread; use via profile() or start/stop.
 
     Each tick attributes every thread's current Python frame weighted by
-    that thread's CPU-time delta since the previous tick (Linux); ticks
-    where a thread burned no CPU count as idle.  Off Linux it degrades
-    to plain wall sampling with a frame-based idle heuristic.
+    that thread's CPU-time delta since the previous tick (POSIX
+    per-thread CPU clocks); ticks where a thread burned no CPU count as
+    idle.  Without pthread_getcpuclockid it degrades to plain wall
+    sampling with a frame-based idle heuristic.
     """
 
     def __init__(self, hz: float = 97.0,
@@ -149,17 +114,22 @@ class Sampler:
         self._t0 = 0.0
 
     def _loop(self) -> None:
+        # CPU-time source: per-thread POSIX CPU clocks read via
+        # time.clock_gettime — these do NOT release the GIL, unlike the
+        # /proc/self/task stat reads the first version used.  Under a
+        # busy interpreter every GIL release costs up to the 5ms switch
+        # interval to win back, so a /proc-based tick (6+ syscalls)
+        # degraded the sampler to ~20Hz and starved the profile; the
+        # clock reads keep the loop at its configured rate and resolve
+        # in nanoseconds instead of the 10ms /proc quantum.
         interval = 1.0 / self.hz
         my_ident = threading.get_ident()
         rep = self._report
-        prev_cpu = _thread_cpu_seconds()
-        cpu_mode = prev_cpu is not None
+        cpu_mode = hasattr(time, "pthread_getcpuclockid")
+        clk: dict[int, int] = {}
+        prev: dict[int, float] = {}
         while not self._stop.wait(interval):
             frames = sys._current_frames()
-            cpu = _thread_cpu_seconds() if cpu_mode else None
-            ident_to_nid = {
-                t.ident: t.native_id for t in threading.enumerate()
-            } if cpu_mode else {}
             for ident, frame in frames.items():
                 if ident == my_ident:
                     continue
@@ -169,32 +139,52 @@ class Sampler:
                 fname = code.co_filename.rsplit("/", 1)[-1]
                 weight = 1.0 / self.hz  # wall fallback: one tick
                 if cpu_mode:
-                    nid = ident_to_nid.get(ident)
-                    delta = 0.0
-                    if nid is not None and cpu is not None:
-                        delta = (cpu.get(nid, 0.0)
-                                 - prev_cpu.get(nid, 0.0))
-                    if delta <= 0.0:
+                    delta = self._cpu_delta(ident, clk, prev)
+                    if delta is None or delta <= 0.0:
                         rep.idle_samples += 1
                         continue
                     weight = delta
-                elif _is_idle(code.co_qualname, fname):
+                elif _is_idle(_qualname(code), fname):
                     rep.idle_samples += 1
                     continue
-                loc = (f"{code.co_qualname} ({fname}:{frame.f_lineno})")
+                loc = (f"{_qualname(code)} ({fname}:{frame.f_lineno})")
                 rep.self_counts[loc] += weight
                 rep.samples += 1
                 seen = set()
                 f = frame
                 while f is not None:
                     c = f.f_code
-                    cum = f"{c.co_qualname} ({c.co_filename.rsplit('/', 1)[-1]})"
+                    cum = (f"{_qualname(c)} "
+                           f"({c.co_filename.rsplit('/', 1)[-1]})")
                     if cum not in seen:  # recursion counts once
                         rep.cum_counts[cum] += weight
                         seen.add(cum)
                     f = f.f_back
-            if cpu_mode:
-                prev_cpu = cpu
+
+    @staticmethod
+    def _cpu_delta(ident: int, clk: dict, prev: dict) -> Optional[float]:
+        """CPU seconds this thread burned since its previous tick; None
+        on the first sighting (no baseline yet) or for exited threads
+        (clock ids die with their pthread — stale cache entries surface
+        as OSError and are dropped; an ident reuse recomputes)."""
+        c = clk.get(ident)
+        if c is None:
+            try:
+                c = time.pthread_getcpuclockid(ident)
+                clk[ident] = c
+                prev[ident] = time.clock_gettime(c)
+            except (OSError, AttributeError):
+                pass
+            return None
+        try:
+            now = time.clock_gettime(c)
+        except OSError:
+            clk.pop(ident, None)
+            prev.pop(ident, None)
+            return None
+        delta = now - prev.get(ident, now)
+        prev[ident] = now
+        return delta
 
     def start(self) -> "Sampler":
         self._t0 = time.perf_counter()

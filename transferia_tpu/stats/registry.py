@@ -164,6 +164,23 @@ class TransformStats(_Bundle):
         self.compiles = self.m.counter("transform_plan_compiles")
 
 
+class DeviceStats(_Bundle):
+    """Device-link counters (stats/trace.py DeviceTelemetry folds its
+    deltas in here so /metrics exposes the link physics: H2D/D2H bytes
+    and transfer counts, launches, XLA compiles, kernel wall time)."""
+
+    def __init__(self, metrics: Optional[Metrics] = None):
+        super().__init__(metrics)
+        self.h2d_bytes = self.m.counter("device_h2d_bytes")
+        self.h2d_transfers = self.m.counter("device_h2d_transfers")
+        self.d2h_bytes = self.m.counter("device_d2h_bytes")
+        self.d2h_transfers = self.m.counter("device_d2h_transfers")
+        self.launches = self.m.counter("device_launches")
+        self.compiles = self.m.counter("device_xla_compiles")
+        self.compile_seconds = self.m.counter("device_xla_compile_seconds")
+        self.kernel_seconds = self.m.counter("device_kernel_seconds")
+
+
 class TableStats(_Bundle):
     """Per-table progress gauges (pkg/stats/table.go)."""
 

@@ -1,0 +1,474 @@
+"""End-to-end pipeline tracing: spans, device telemetry, Perfetto export.
+
+The sampling profiler (stats/profiler.py) answers "which frame burns
+CPU"; stagetimer answers "how much wall per stage".  Neither shows the
+*timeline*: whether device waits overlap host packing, where a batch
+stalls between parsequeue and the sink, or when an XLA recompile lands
+inside the measured window.  This module records begin/end spans into a
+bounded ring buffer and exports Chrome trace-event JSON loadable in
+Perfetto / `chrome://tracing` — the span-level attribution Thallus-style
+transport analysis needs (PAPERS.md) and the per-stage transfer
+accounting the Arrow Flight benchmarking work shows wire-speed columnar
+systems live or die on.
+
+Design constraints:
+
+- near-zero overhead when disabled: `span()` does ONE module-bool check
+  and returns a shared no-op singleton — no allocation, no lock;
+- thread-safe when enabled: per-thread span stacks (nesting + self-time
+  attribution need no lock), one lock only around ring appends;
+- monotonic clocks (`time.perf_counter`), microsecond timestamps
+  relative to the capture epoch (what the trace-event format expects);
+- bounded memory: a `deque(maxlen=capacity)` ring — a forgotten-enabled
+  tracer on a long replication run costs a fixed buffer, never OOM.
+
+Span taxonomy (see ARCHITECTURE.md "Tracing & device telemetry"):
+roots `part` / `batch` / `replication_attempt` carry identity args
+(transfer_id, table, part, batch_seq); stage spans `source_decode`,
+`pivot`, `pack`, `device_dispatch`, `device_wait`, `host_post`,
+`transform`, `serialize`, `bufferer_flush`, `sink_push`, `sink` nest
+under them.  `device_dispatch`/`device_wait` carry byte counts as args.
+
+`DeviceTelemetry` is the always-on counter half: H2D/D2H bytes and
+transfer counts, device launches, XLA compile events (hooked via jax's
+monitoring events — fired exactly on jit-cache misses that reach the
+backend compiler), and per-kernel wall time.  It folds into the
+prometheus `Metrics` facade via `fold_into()` (stats/registry.py
+DeviceStats).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Optional
+
+DEFAULT_CAPACITY = 200_000  # spans; ~100 bytes each -> bounded ~20MB
+
+_enabled = False
+_epoch = 0.0
+_lock = threading.Lock()
+_ring: deque = deque(maxlen=DEFAULT_CAPACITY)
+_tls = threading.local()
+
+
+class _NoopSpan:
+    """Shared disabled-path singleton: falsy, allocation-free."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def __bool__(self):
+        return False
+
+    def add(self, **args) -> None:
+        pass
+
+
+_NOOP = _NoopSpan()
+
+
+class Span:
+    __slots__ = ("name", "args", "_t0", "_child")
+
+    def __init__(self, name: str, args: Optional[dict] = None):
+        self.name = name
+        self.args = args
+        self._t0 = 0.0
+        self._child = 0.0  # seconds covered by nested spans
+
+    def __bool__(self):
+        return True
+
+    def add(self, **args) -> None:
+        """Attach args discovered mid-span (bytes moved, row counts)."""
+        if self.args is None:
+            self.args = args
+        else:
+            self.args.update(args)
+
+    def __enter__(self):
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        stack.append(self)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = time.perf_counter()
+        dur = t1 - self._t0
+        stack = _tls.stack
+        stack.pop()
+        depth = len(stack)
+        if depth:
+            stack[-1]._child += dur
+        t = threading.current_thread()
+        with _lock:
+            _ring.append((
+                self.name, t.ident, t.name,
+                self._t0 - _epoch, dur, max(0.0, dur - self._child),
+                depth, self.args,
+            ))
+        return False
+
+
+def enable(on: bool = True, capacity: Optional[int] = None) -> None:
+    global _enabled, _epoch, _ring
+    if capacity is not None and capacity != _ring.maxlen:
+        with _lock:
+            _ring = deque(_ring, maxlen=capacity)
+    if on and not _enabled and _epoch == 0.0:
+        _epoch = time.perf_counter()
+    _enabled = on
+    if on:
+        install_jit_hooks()
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def reset() -> None:
+    """Clear the span ring and restart the capture epoch.  Does NOT
+    touch TELEMETRY: the device counters are cumulative process state
+    (a /metrics scrape depends on them); reset those explicitly."""
+    global _epoch
+    with _lock:
+        _ring.clear()
+    _epoch = time.perf_counter()
+
+
+def span(name: str, **args):
+    """The ONE per-site call.  Disabled: one bool check, shared no-op
+    singleton back (hot sites attach args via `if sp: sp.add(...)` so
+    the disabled path allocates nothing)."""
+    if not _enabled:
+        return _NOOP
+    return Span(name, args or None)
+
+
+def instant(name: str, **args) -> None:
+    """Point event (XLA compiles, retries, flush triggers)."""
+    if not _enabled:
+        return
+    t = threading.current_thread()
+    with _lock:
+        _ring.append((name, t.ident, t.name,
+                      time.perf_counter() - _epoch, 0.0, 0.0, -1,
+                      args or None))
+
+
+def current() -> Optional[str]:
+    """Innermost active span name on this thread (tests, debugging)."""
+    stack = getattr(_tls, "stack", None)
+    return stack[-1].name if stack else None
+
+
+def spans() -> list[tuple]:
+    """Raw recorded tuples (name, tid, tname, t0_s, dur_s, self_s,
+    depth, args) — depth -1 marks instants."""
+    with _lock:
+        return list(_ring)
+
+
+# -- export -----------------------------------------------------------------
+
+def export_chrome_trace() -> dict:
+    """Chrome trace-event JSON (dict; json.dump it).  Loadable in
+    Perfetto and chrome://tracing: "X" complete events with tid/ts/dur
+    in microseconds, thread-name metadata, instants as "i"."""
+    recorded = spans()
+    events: list[dict] = []
+    seen_threads: dict[int, str] = {}
+    for name, tid, tname, t0, dur, _self_s, depth, args in recorded:
+        if tid not in seen_threads:
+            seen_threads[tid] = tname
+        ev = {
+            "name": name,
+            "cat": "pipeline",
+            "pid": 1,
+            "tid": tid,
+            "ts": round(t0 * 1e6, 1),
+        }
+        if depth < 0:
+            ev["ph"] = "i"
+            ev["s"] = "t"
+        else:
+            ev["ph"] = "X"
+            ev["dur"] = round(dur * 1e6, 1)
+        if args:
+            ev["args"] = {k: _jsonable(v) for k, v in args.items()}
+        events.append(ev)
+    meta = [
+        {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+         "args": {"name": "transferia-tpu"}},
+    ]
+    for tid, tname in sorted(seen_threads.items()):
+        meta.append({"name": "thread_name", "ph": "M", "pid": 1,
+                     "tid": tid, "args": {"name": tname}})
+    counters = TELEMETRY.snapshot()
+    return {
+        "traceEvents": meta + events,
+        "displayTimeUnit": "ms",
+        "otherData": {"device_telemetry": counters},
+    }
+
+
+def _jsonable(v):
+    try:
+        json.dumps(v)
+        return v
+    except (TypeError, ValueError):
+        return str(v)
+
+
+def write_chrome_trace(path: str) -> int:
+    """Dump the trace to a file; returns the number of events."""
+    doc = export_chrome_trace()
+    with open(path, "w") as fh:
+        json.dump(doc, fh)
+    return len(doc["traceEvents"])
+
+
+def stage_summary(wall_seconds: Optional[float] = None) -> dict:
+    """Per-stage aggregation: calls, p50/p99 ms, total and self seconds,
+    bytes moved (summed from span `bytes` args), plus wall span and the
+    overlap factor (sum of self-times / wall — >1 means stages overlap
+    across threads; the ratio between stages is the signal)."""
+    recorded = [s for s in spans() if s[6] >= 0]
+    per: dict[str, dict] = {}
+    t_min, t_max = None, None
+    for name, _tid, _tn, t0, dur, self_s, _depth, args in recorded:
+        d = per.setdefault(name, {"calls": 0, "total_s": 0.0,
+                                  "self_s": 0.0, "bytes": 0,
+                                  "durs": []})
+        d["calls"] += 1
+        d["total_s"] += dur
+        d["self_s"] += self_s
+        d["durs"].append(dur)
+        if args and isinstance(args.get("bytes"), (int, float)):
+            d["bytes"] += int(args["bytes"])
+        t_min = t0 if t_min is None else min(t_min, t0)
+        t_max = max(t_max or 0.0, t0 + dur)
+    wall = wall_seconds if wall_seconds else (
+        (t_max - t_min) if recorded else 0.0)
+    out: dict[str, dict] = {}
+    for name, d in per.items():
+        durs = sorted(d.pop("durs"))
+        n = len(durs)
+        d["p50_ms"] = round(durs[max(0, (n + 1) // 2 - 1)] * 1000, 3)
+        d["p99_ms"] = round(
+            durs[max(0, min(n - 1, int(0.99 * n)))] * 1000, 3)
+        d["total_s"] = round(d["total_s"], 4)
+        d["self_s"] = round(d["self_s"], 4)
+        out[name] = d
+    total_self = sum(d["self_s"] for d in out.values())
+    return {
+        "wall_s": round(wall, 4),
+        "overlap_factor": round(total_self / wall, 3) if wall else 0.0,
+        "stages": dict(sorted(out.items(),
+                              key=lambda kv: -kv[1]["self_s"])),
+    }
+
+
+def format_summary(wall_seconds: Optional[float] = None) -> str:
+    """Human table for `trtpu trace` / bench output."""
+    s = stage_summary(wall_seconds)
+    lines = [
+        f"wall={s['wall_s']:.2f}s overlap_factor={s['overlap_factor']}",
+        f"{'stage':<18} {'calls':>7} {'p50_ms':>9} {'p99_ms':>9} "
+        f"{'total_s':>8} {'self_s':>8} {'bytes':>12}",
+    ]
+    for name, d in s["stages"].items():
+        lines.append(
+            f"{name:<18} {d['calls']:>7} {d['p50_ms']:>9.2f} "
+            f"{d['p99_ms']:>9.2f} {d['total_s']:>8.2f} "
+            f"{d['self_s']:>8.2f} {d['bytes']:>12}")
+    tel = TELEMETRY.snapshot()
+    if tel["device_launches"] or tel["compile_events"]:
+        lines.append(
+            f"device: launches={tel['device_launches']} "
+            f"h2d={tel['h2d_bytes']}B/{tel['h2d_transfers']}x "
+            f"d2h={tel['d2h_bytes']}B/{tel['d2h_transfers']}x "
+            f"kernel={tel['kernel_seconds']:.3f}s "
+            f"compiles={tel['compile_events']} "
+            f"({tel['compile_seconds']:.2f}s)")
+    return "\n".join(lines)
+
+
+_capture_lock = threading.Lock()
+
+
+def capture_seconds(seconds: float) -> dict:
+    """The `/debug/trace?seconds=N` implementation.  Runs in an HTTP
+    worker thread, so blocking here never stalls the pipeline.
+
+    When tracing is already on (a `trtpu trace` run, bench --trace, or
+    an operator who enabled it), the ring belongs to that capture:
+    sample the window WITHOUT resetting — destroying an in-progress
+    capture from a debug endpoint would be hostile.  Only a
+    tracing-off process gets the reset/enable/disable cycle, and
+    concurrent requests serialize so they can't clobber each other's
+    enable-state restore."""
+    wait = max(0.05, min(seconds, 60.0))
+    with _capture_lock:
+        if _enabled:
+            time.sleep(wait)
+            return export_chrome_trace()
+        reset()
+        enable(True)
+        time.sleep(wait)
+        doc = export_chrome_trace()
+        enable(False)
+        return doc
+
+
+# -- device telemetry --------------------------------------------------------
+
+class DeviceTelemetry:
+    """Always-on device-side counters (increments are per-dispatch, not
+    per-row — a lock'd int add is noise next to a device launch).
+
+    The sampling profiler cannot see any of these: device waits look
+    like idle, H2D/D2H time hides inside jnp.asarray/np.asarray calls,
+    and a jit recompile inside a measured window silently poisons it.
+    On the measured v5e link (~70 ms/launch, 5-40 MB/s D2H) these
+    counters ARE the performance model's inputs.
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            self.h2d_bytes = 0
+            self.h2d_transfers = 0
+            self.d2h_bytes = 0
+            self.d2h_transfers = 0
+            self.device_launches = 0
+            self.compile_events = 0
+            self.compile_seconds = 0.0
+            self.kernel_seconds = 0.0
+            # per-target fold baselines: several pipelines may each
+            # fold the (process-global) counters into their own
+            # Metrics; one shared baseline would split deltas between
+            # them arbitrarily
+            self._folded: "weakref.WeakKeyDictionary" = \
+                weakref.WeakKeyDictionary()
+
+    def record_h2d(self, nbytes: int) -> None:
+        with self._lock:
+            self.h2d_bytes += int(nbytes)
+            self.h2d_transfers += 1
+
+    def record_d2h(self, nbytes: int) -> None:
+        with self._lock:
+            self.d2h_bytes += int(nbytes)
+            self.d2h_transfers += 1
+
+    def record_launch(self, n: int = 1) -> None:
+        with self._lock:
+            self.device_launches += n
+
+    def record_kernel(self, seconds: float) -> None:
+        with self._lock:
+            self.kernel_seconds += seconds
+
+    def record_compile(self, seconds: float) -> None:
+        with self._lock:
+            self.compile_events += 1
+            self.compile_seconds += seconds
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "h2d_bytes": self.h2d_bytes,
+                "h2d_transfers": self.h2d_transfers,
+                "d2h_bytes": self.d2h_bytes,
+                "d2h_transfers": self.d2h_transfers,
+                "device_launches": self.device_launches,
+                "compile_events": self.compile_events,
+                "compile_seconds": round(self.compile_seconds, 4),
+                "kernel_seconds": round(self.kernel_seconds, 4),
+            }
+
+    def fold_into(self, metrics) -> None:
+        """Publish deltas since this target's last fold into the
+        prometheus Metrics facade (stats/registry.py DeviceStats) —
+        counters only inc, so folds carry the delta, making repeated
+        folds safe.  The counters are process-global (the device is
+        shared), so every pipeline's metrics sees full device
+        activity."""
+        from transferia_tpu.stats.registry import DeviceStats
+
+        ds = DeviceStats(metrics)
+        with self._lock:
+            # counters AND baseline read/update under ONE lock hold: a
+            # snapshot taken outside it could be stale by the time the
+            # baseline updates, regressing prev and re-publishing
+            # already-counted deltas on the next fold
+            snap = {
+                "h2d_bytes": self.h2d_bytes,
+                "h2d_transfers": self.h2d_transfers,
+                "d2h_bytes": self.d2h_bytes,
+                "d2h_transfers": self.d2h_transfers,
+                "device_launches": self.device_launches,
+                "compile_events": self.compile_events,
+                "compile_seconds": self.compile_seconds,
+                "kernel_seconds": self.kernel_seconds,
+            }
+            prev = self._folded.setdefault(metrics, {})
+            for key, counter in (
+                ("h2d_bytes", ds.h2d_bytes),
+                ("h2d_transfers", ds.h2d_transfers),
+                ("d2h_bytes", ds.d2h_bytes),
+                ("d2h_transfers", ds.d2h_transfers),
+                ("device_launches", ds.launches),
+                ("compile_events", ds.compiles),
+                ("compile_seconds", ds.compile_seconds),
+                ("kernel_seconds", ds.kernel_seconds),
+            ):
+                delta = snap[key] - prev.get(key, 0)
+                if delta > 0:
+                    counter.inc(delta)
+                prev[key] = snap[key]
+
+
+TELEMETRY = DeviceTelemetry()
+
+_hooks_installed = False
+_hooks_lock = threading.Lock()
+
+
+def install_jit_hooks() -> None:
+    """Route jax's compile-duration monitoring events into TELEMETRY
+    (+ a trace instant).  The backend-compile event fires exactly when a
+    jit cache miss reaches the XLA compiler — the recompile signal a
+    bucketed-shape engine must watch (ARCHITECTURE.md shape
+    discipline).  Idempotent; silently a no-op without jax."""
+    global _hooks_installed
+    with _hooks_lock:
+        if _hooks_installed:
+            return
+        try:
+            from jax import monitoring as _mon
+        except ImportError:  # pragma: no cover - jax optional
+            return
+
+        def _on_duration(event: str, duration: float, **kw) -> None:
+            if event.endswith("backend_compile_duration"):
+                TELEMETRY.record_compile(duration)
+                instant("xla_compile", seconds=round(duration, 4))
+
+        _mon.register_event_duration_secs_listener(_on_duration)
+        _hooks_installed = True

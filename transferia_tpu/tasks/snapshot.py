@@ -46,6 +46,7 @@ from transferia_tpu.abstract.schema import TableID
 from transferia_tpu.abstract.table import OperationTablePart, TableDescription
 from transferia_tpu.coordinator.interface import Coordinator
 from transferia_tpu.factories import make_async_sink, new_storage
+from transferia_tpu.stats import trace
 from transferia_tpu.stats.registry import Metrics, TableStats
 from transferia_tpu.tasks.table_splitter import split_tables
 from transferia_tpu.utils.backoff import retry_with_backoff
@@ -508,30 +509,51 @@ class SnapshotLoader:
                                post_transform_wrap=wrap)
         rows_done = 0
         read_bytes = 0
+        batch_seq = 0
+        # root span per part: every stage span a batch triggers on this
+        # thread (source decode, transform, device dispatch, sink) nests
+        # under it in the exported timeline
+        part_sp = trace.span("part")
+        if part_sp:
+            part_sp.add(transfer_id=self.transfer.id, table=str(tid),
+                        part=part.key())
         try:
-            futures = []
-            sink.async_push(
-                [init_table_load(tid, schema, part_id)]
-            ).result()
+            with part_sp:
+                futures = []
+                sink.async_push(
+                    [init_table_load(tid, schema, part_id)]
+                ).result()
 
-            def pusher(batch):
-                nonlocal rows_done, read_bytes
-                if hasattr(batch, "n_rows"):
-                    batch.part_id = part_id
-                    rows_done += batch.n_rows
-                    read_bytes += batch.read_bytes or batch.nbytes()
-                else:
-                    rows_done += len(batch)
-                futures.append(sink.async_push(batch))
-                # bounded in-flight window
-                while len(futures) > 32:
-                    futures.pop(0).result()
+                def pusher(batch):
+                    nonlocal rows_done, read_bytes, batch_seq
+                    sp = trace.span("batch")
+                    with sp:
+                        if hasattr(batch, "n_rows"):
+                            batch.part_id = part_id
+                            rows_done += batch.n_rows
+                            read_bytes += batch.read_bytes or batch.nbytes()
+                            if sp:
+                                sp.add(table=str(tid), part=part.key(),
+                                       batch_seq=batch_seq,
+                                       rows=batch.n_rows,
+                                       bytes=batch.nbytes())
+                        else:
+                            rows_done += len(batch)
+                            if sp:
+                                sp.add(table=str(tid), part=part.key(),
+                                       batch_seq=batch_seq,
+                                       rows=len(batch))
+                        batch_seq += 1
+                        futures.append(sink.async_push(batch))
+                        # bounded in-flight window
+                        while len(futures) > 32:
+                            futures.pop(0).result()
 
-            storage.load_table(part.to_description(), pusher)
-            resolve_all(futures)
-            sink.async_push(
-                [done_table_load(tid, schema, part_id)]
-            ).result()
+                storage.load_table(part.to_description(), pusher)
+                resolve_all(futures)
+                sink.async_push(
+                    [done_table_load(tid, schema, part_id)]
+                ).result()
         except BaseException as e:
             raise TableUploadError(
                 f"part {part.key()} failed after {rows_done} rows: {e}",
@@ -562,5 +584,8 @@ class SnapshotLoader:
             self.cp.update_operation_parts(self.operation_id, [part])
             self.table_stats.completed_parts.inc()
             self.table_stats.completed_rows.inc(rows_done)
+        # device counters surface on this pipeline's metrics as parts
+        # complete (H2D/D2H bytes, launches, XLA compiles)
+        trace.TELEMETRY.fold_into(self.metrics)
         logger.info("part %s done: %d rows, %d bytes",
                     part.key(), rows_done, read_bytes)

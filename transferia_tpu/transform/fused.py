@@ -285,9 +285,9 @@ class DeviceFusedStep(Transformer):
         hexes, keep = program.run(
             mask_inputs, pred_inputs, batch.n_rows
         )
-        from transferia_tpu.stats import stagetimer
+        from transferia_tpu.stats import stagetimer, trace
 
-        with stagetimer.stage("host_post"):
+        with stagetimer.stage("host_post"), trace.span("host_post"):
             cols = dict(batch.columns)
             for (name, _key), hx in zip(self.mask_entries, hexes):
                 validity = batch.column(name).validity
@@ -313,7 +313,7 @@ class DeviceFusedStep(Transformer):
         """
         import time as _time
 
-        from transferia_tpu.stats import stagetimer
+        from transferia_tpu.stats import stagetimer, trace
         from transferia_tpu.transform.plugins.mask import (
             _host_hmac_hex,
             mask_dict_column,
@@ -325,7 +325,7 @@ class DeviceFusedStep(Transformer):
             keep = self._host_pred_fn(batch)
             if not keep.all():
                 cur = batch.filter(keep)
-        with stagetimer.stage("host_mask"):
+        with stagetimer.stage("host_mask"), trace.span("host_mask"):
             cols = dict(cur.columns)
             for name, key in self.mask_entries:
                 col = cur.column(name)
