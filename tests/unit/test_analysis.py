@@ -1,0 +1,566 @@
+"""Tests for the `trtpu check` static-analysis engine.
+
+One true-positive, one suppressed, and one clean fixture per rule, plus
+baseline round-trip, CLI exit codes, and the registry-contract check run
+against the REAL provider/transformer/parser registries (that last one
+is the compile-time guard the registries themselves can't provide).
+"""
+
+import ast
+import json
+import textwrap
+
+import pytest
+
+from transferia_tpu.analysis import baseline as baseline_mod
+from transferia_tpu.analysis.engine import (
+    Finding,
+    Suppressions,
+    run_rules,
+)
+from transferia_tpu.analysis.rules import (
+    DevicePurityRule,
+    ExceptionHygieneRule,
+    LockDisciplineRule,
+    RegistryContractRule,
+    ResourceSafetyRule,
+)
+
+
+def check_src(rule, src, path="transferia_tpu/ops/fixture.py"):
+    """Run one rule over a snippet, honoring pragmas like the engine."""
+    src = textwrap.dedent(src)
+    tree = ast.parse(src)
+    supp = Suppressions.scan(src)
+    if not rule.applies_to(path):
+        return []
+    return [f for f in rule.check_file(path, tree, src.splitlines())
+            if not supp.suppressed(f)]
+
+
+# -- TPU001 device purity ---------------------------------------------------
+
+TPU_BAD = """
+    import jax, functools
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def kernel(x, n):
+        if x > 0:          # data-dependent branch
+            return x.item()  # host sync
+        return x
+"""
+
+TPU_SUPPRESSED = """
+    import jax
+
+    @jax.jit
+    def kernel(x):
+        return x.item()  # trtpu: ignore[TPU001]
+"""
+
+TPU_CLEAN = """
+    import jax, jax.numpy as jnp, functools
+
+    @functools.partial(jax.jit, static_argnums=(1,))
+    def kernel(x, n):
+        if n > 2:              # static arg: concrete at trace time
+            x = x * 2
+        if x.ndim == 2:        # shape metadata: trace-time concrete
+            x = x.sum(axis=1)
+        return jnp.where(x > 0, x, -x)
+"""
+
+
+class TestDevicePurity:
+    def test_true_positive(self):
+        found = check_src(DevicePurityRule(), TPU_BAD)
+        assert len(found) == 2
+        msgs = " ".join(f.message for f in found)
+        assert "data-dependent" in msgs and ".item()" in msgs
+        assert all(f.rule == "TPU001" and f.severity == "error"
+                   for f in found)
+
+    def test_suppressed(self):
+        assert check_src(DevicePurityRule(), TPU_SUPPRESSED) == []
+
+    def test_clean(self):
+        assert check_src(DevicePurityRule(), TPU_CLEAN) == []
+
+    def test_jit_call_idiom(self):
+        # fn = jax.jit(program) — the dominant idiom in ops/fused.py
+        src = """
+            import jax
+
+            def program(a, flag):
+                return float(a) if flag else a
+
+            fn = jax.jit(program, static_argnames="flag")
+        """
+        found = check_src(DevicePurityRule(), src)
+        assert [f.message.split("(")[0].strip() for f in found] == \
+            ["float"]
+
+    def test_out_of_scope_path_ignored(self):
+        # host-side modules may branch on values after device_get
+        found = check_src(DevicePurityRule(), TPU_BAD,
+                          path="transferia_tpu/runtime/local.py")
+        assert found == []
+
+
+# -- LCK001 lock discipline -------------------------------------------------
+
+LCK_BAD = """
+    import threading, time
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+
+        def inc(self):
+            with self._lock:
+                self.n += 1
+                time.sleep(0.1)
+
+        def reset(self):
+            self.n = 0
+"""
+
+LCK_SUPPRESSED = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+
+        def inc(self):
+            with self._lock:
+                self.n += 1
+
+        def reset_unsafe(self):
+            self.n = 0  # trtpu: ignore[LCK001]
+"""
+
+LCK_CLEAN = """
+    import threading
+
+    class Counter:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self.n = 0
+
+        def inc(self):
+            with self._lock:
+                self._inc_locked()
+
+        def _inc_locked(self):
+            self.n += 1   # _locked suffix: caller holds the lock
+"""
+
+
+class TestLockDiscipline:
+    def test_true_positive(self):
+        found = check_src(LockDisciplineRule(), LCK_BAD)
+        kinds = sorted(f.severity for f in found)
+        assert kinds == ["error", "warning"]  # racy write + sleep
+        racy = [f for f in found if f.severity == "error"][0]
+        assert "Counter.n" in racy.message
+
+    def test_suppressed(self):
+        assert check_src(LockDisciplineRule(), LCK_SUPPRESSED) == []
+
+    def test_clean_locked_convention(self):
+        assert check_src(LockDisciplineRule(), LCK_CLEAN) == []
+
+    def test_blocking_call_in_with_header(self):
+        # the connect in the with-items runs while the lock is held;
+        # `with connect(), self._lock:` (acquired after) does not
+        src = """
+            import socket, threading
+
+            class C:
+                def __init__(self):
+                    self._lock = threading.Lock()
+
+                def bad(self):
+                    with self._lock, socket.create_connection(("h", 1)) as s:
+                        return s
+
+                def ok(self):
+                    with socket.create_connection(("h", 1)) as s, self._lock:
+                        return s
+        """
+        found = check_src(LockDisciplineRule(), src)
+        assert len(found) == 1
+        assert "create_connection" in found[0].message
+
+    def test_no_lock_no_findings(self):
+        src = """
+            class Plain:
+                def set(self, v):
+                    self.v = v
+        """
+        assert check_src(LockDisciplineRule(), src) == []
+
+
+# -- EXC001 exception hygiene -----------------------------------------------
+
+EXC_BAD = """
+    def f():
+        try:
+            g()
+        except Exception:
+            pass
+"""
+
+EXC_SUPPRESSED = """
+    def f():
+        try:
+            g()
+        except Exception:  # trtpu: ignore[EXC001]
+            pass  # best-effort teardown
+"""
+
+EXC_CLEAN = """
+    import logging
+
+    def f():
+        try:
+            g()
+        except Exception as e:
+            logging.getLogger(__name__).debug("g failed: %s", e)
+"""
+
+
+class TestExceptionHygiene:
+    def test_true_positive(self):
+        found = check_src(ExceptionHygieneRule(), EXC_BAD)
+        assert len(found) == 1 and found[0].rule == "EXC001"
+
+    def test_suppressed(self):
+        assert check_src(ExceptionHygieneRule(), EXC_SUPPRESSED) == []
+
+    def test_clean(self):
+        assert check_src(ExceptionHygieneRule(), EXC_CLEAN) == []
+
+    def test_bare_except_flagged(self):
+        src = """
+            def f():
+                try:
+                    g()
+                except:
+                    continue_ = None
+                    pass
+        """
+        # non-noop body that neither logs nor raises is NOT flagged
+        # (only silent swallows and device-dispatch wraps are)
+        assert check_src(ExceptionHygieneRule(), src) == []
+
+    def test_device_dispatch_wrap(self):
+        src = """
+            def f(mesh, batch):
+                try:
+                    out = mesh.device_dispatch(batch)
+                except Exception:
+                    out = None
+                return out
+        """
+        found = check_src(ExceptionHygieneRule(), src)
+        assert len(found) == 1
+        assert "device dispatch" in found[0].message
+
+
+# -- NET001 resource safety -------------------------------------------------
+
+NET_BAD = """
+    import socket, json
+
+    def f(path):
+        s = socket.create_connection(("host", 9000))
+        return json.load(open(path))
+"""
+
+NET_SUPPRESSED = """
+    import socket
+
+    def f():
+        s = socket.create_connection(("host", 9000))  # trtpu: ignore[NET001]
+        return s
+"""
+
+NET_CLEAN = """
+    import socket, json
+
+    def f(path):
+        s = socket.create_connection(("host", 9000), timeout=30.0)
+        with open(path) as fh:
+            return json.load(fh)
+"""
+
+
+class TestResourceSafety:
+    def test_true_positive(self):
+        found = check_src(ResourceSafetyRule(), NET_BAD)
+        assert len(found) == 2
+        msgs = " ".join(f.message for f in found)
+        assert "timeout" in msgs and "with open" in msgs
+
+    def test_suppressed(self):
+        assert check_src(ResourceSafetyRule(), NET_SUPPRESSED) == []
+
+    def test_clean(self):
+        assert check_src(ResourceSafetyRule(), NET_CLEAN) == []
+
+    def test_http_connection_without_timeout(self):
+        src = """
+            import http.client
+
+            def f(host):
+                return http.client.HTTPSConnection(host)
+        """
+        found = check_src(ResourceSafetyRule(), src)
+        assert len(found) == 1 and "HTTPSConnection" in found[0].message
+
+
+# -- REG001 registry contract -----------------------------------------------
+
+class TestRegistryContract:
+    def _project_findings(self, sources: dict[str, str]):
+        rule = RegistryContractRule()
+        rule.do_import_check = False
+        files = {}
+        for path, src in sources.items():
+            src = textwrap.dedent(src)
+            files[path] = (ast.parse(src), src.splitlines())
+        return rule.check_project("/tmp", files)
+
+    def test_duplicate_transformer_key(self):
+        found = self._project_findings({
+            "a.py": """
+                @register_transformer("mask_field")
+                class A:
+                    pass
+            """,
+            "b.py": """
+                @register_transformer("mask_field")
+                class B:
+                    pass
+            """,
+        })
+        assert len(found) == 1
+        assert "duplicate transformer key 'mask_field'" in found[0].message
+
+    def test_provider_without_name(self):
+        found = self._project_findings({
+            "p.py": """
+                @register_provider
+                class P:
+                    pass
+            """,
+        })
+        assert len(found) == 1 and "without a literal NAME" \
+            in found[0].message
+
+    def test_unique_keys_clean(self):
+        found = self._project_findings({
+            "a.py": """
+                @register_transformer("x")
+                class A:
+                    pass
+
+                @register_parser("x")
+                class B:
+                    pass
+            """,
+        })
+        assert found == []  # same key, different registries: fine
+
+    def test_real_registries_hold_contract(self):
+        """The load pass against the actual provider/transformer/parser
+        registries: unique keys, concrete classes, NAME == key."""
+        findings = RegistryContractRule().import_check()
+        assert findings == [], [f.message for f in findings]
+
+    def test_real_tree_has_no_duplicate_keys(self):
+        result = run_rules(["transferia_tpu"],
+                           [_no_import_reg()], root=_repo_root())
+        assert result.findings == [], \
+            [f.format() for f in result.findings]
+
+
+def _no_import_reg():
+    rule = RegistryContractRule()
+    rule.do_import_check = False
+    return rule
+
+
+def _repo_root():
+    import os
+
+    import transferia_tpu
+
+    return os.path.dirname(os.path.dirname(transferia_tpu.__file__))
+
+
+# -- engine plumbing --------------------------------------------------------
+
+class TestSuppressions:
+    def test_file_level(self):
+        src = "# trtpu: ignore-file[EXC001]\nx = 1\n"
+        supp = Suppressions.scan(src)
+        assert supp.suppressed(Finding("EXC001", "warning", "f.py",
+                                       2, 1, "m"))
+        assert not supp.suppressed(Finding("NET001", "warning", "f.py",
+                                           2, 1, "m"))
+
+    def test_bare_ignore_suppresses_all(self):
+        src = "x = 1  # trtpu: ignore\n"
+        supp = Suppressions.scan(src)
+        assert supp.suppressed(Finding("TPU001", "error", "f.py",
+                                       1, 1, "m"))
+
+    def test_wrong_line_does_not_suppress(self):
+        src = "x = 1  # trtpu: ignore[EXC001]\ny = 2\n"
+        supp = Suppressions.scan(src)
+        assert not supp.suppressed(Finding("EXC001", "warning", "f.py",
+                                           2, 1, "m"))
+
+
+class TestBaseline:
+    def test_round_trip(self, tmp_path):
+        f1 = Finding("EXC001", "warning", "a.py", 10, 1, "m",
+                     snippet="except Exception:")
+        f2 = Finding("NET001", "warning", "b.py", 4, 1, "m",
+                     snippet="open(p)")
+        path = str(tmp_path / "base.json")
+        assert baseline_mod.save(path, [f1, f2]) == 2
+        known = baseline_mod.load(path)
+        new, old = baseline_mod.split([f1, f2], known)
+        assert new == [] and len(old) == 2
+
+    def test_line_shift_keeps_match(self, tmp_path):
+        f1 = Finding("EXC001", "warning", "a.py", 10, 1, "m",
+                     snippet="except Exception:")
+        path = str(tmp_path / "base.json")
+        baseline_mod.save(path, [f1])
+        shifted = Finding("EXC001", "warning", "a.py", 99, 1, "m",
+                          snippet="except Exception:")
+        new, old = baseline_mod.split([shifted],
+                                      baseline_mod.load(path))
+        assert new == [] and old == [shifted]
+
+    def test_new_finding_detected(self, tmp_path):
+        path = str(tmp_path / "base.json")
+        baseline_mod.save(path, [])
+        fresh = Finding("LCK001", "error", "c.py", 3, 1, "m",
+                        snippet="self.x = 1")
+        new, old = baseline_mod.split([fresh], baseline_mod.load(path))
+        assert len(new) == 1 and old == []
+
+    def test_duplicate_snippets_disambiguated(self):
+        a = Finding("EXC001", "warning", "a.py", 5, 1, "m",
+                    snippet="except Exception:")
+        b = Finding("EXC001", "warning", "a.py", 50, 1, "m",
+                    snippet="except Exception:")
+        fps = baseline_mod.fingerprints([a, b])
+        assert len(set(fps)) == 2
+
+
+class TestEngineAndCli:
+    def test_run_rules_on_fixture_tree(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "bad.py").write_text(textwrap.dedent(EXC_BAD))
+        (pkg / "skip.py").write_text(
+            "# trtpu: ignore-file[EXC001]\n" + textwrap.dedent(EXC_BAD))
+        result = run_rules(["pkg"], [ExceptionHygieneRule()],
+                           root=str(tmp_path))
+        assert result.files_checked == 2
+        assert [f.path for f in result.findings] == ["pkg/bad.py"]
+
+    def test_parse_error_reported_not_fatal(self, tmp_path):
+        (tmp_path / "broken.py").write_text("def f(:\n")
+        result = run_rules(["broken.py"], [ExceptionHygieneRule()],
+                           root=str(tmp_path))
+        assert result.files_checked == 0
+        assert result.parse_errors[0].rule == "PARSE"
+
+    def test_cli_strict_exit_codes(self, tmp_path, capsys, monkeypatch):
+        from transferia_tpu.analysis import cli as check_cli
+
+        bad = tmp_path / "transferia_tpu"
+        bad.mkdir()
+        (bad / "bad.py").write_text(textwrap.dedent(EXC_BAD))
+        monkeypatch.setattr(check_cli, "repo_root", lambda: str(tmp_path))
+        # not strict: reports but exits 0
+        assert check_cli.main(["--baseline", "none"]) == 0
+        out = capsys.readouterr().out
+        assert "EXC001" in out and "1 new finding(s)" in out
+        # strict: new finding -> 1
+        assert check_cli.main(["--strict", "--baseline", "none"]) == 1
+        capsys.readouterr()
+        # baseline it -> strict passes again
+        base = str(tmp_path / "base.json")
+        assert check_cli.main(["--update-baseline",
+                               "--baseline", base]) == 0
+        assert check_cli.main(["--strict", "--baseline", base]) == 0
+
+    def test_cli_json_output(self, tmp_path, capsys, monkeypatch):
+        from transferia_tpu.analysis import cli as check_cli
+
+        pkg = tmp_path / "transferia_tpu"
+        pkg.mkdir()
+        (pkg / "bad.py").write_text(textwrap.dedent(NET_BAD))
+        monkeypatch.setattr(check_cli, "repo_root", lambda: str(tmp_path))
+        assert check_cli.main(["--json", "--baseline", "none"]) == 0
+        data = json.loads(capsys.readouterr().out)
+        assert {f["rule"] for f in data["new"]} == {"NET001"}
+        assert data["files_checked"] == 1
+
+    def test_update_baseline_refuses_narrowed_run(self, tmp_path,
+                                                  capsys, monkeypatch):
+        # a subset run must not clobber the tree-wide baseline
+        from transferia_tpu.analysis import cli as check_cli
+
+        pkg = tmp_path / "transferia_tpu"
+        pkg.mkdir()
+        (pkg / "ok.py").write_text("x = 1\n")
+        monkeypatch.setattr(check_cli, "repo_root", lambda: str(tmp_path))
+        base = str(tmp_path / "base.json")
+        assert check_cli.main(["transferia_tpu", "--update-baseline",
+                               "--baseline", base]) == 2
+        assert check_cli.main(["--rules", "EXC001", "--update-baseline",
+                               "--baseline", base]) == 2
+        assert "full run" in capsys.readouterr().err
+        assert check_cli.main(["--update-baseline",
+                               "--baseline", base]) == 0
+
+    def test_cli_unknown_rule(self, capsys):
+        from transferia_tpu.analysis.cli import main
+
+        assert main(["--rules", "NOPE42"]) == 2
+        assert "unknown rule id" in capsys.readouterr().err
+
+    def test_cli_list_rules(self, capsys):
+        from transferia_tpu.analysis.cli import main
+
+        assert main(["--list-rules"]) == 0
+        out = capsys.readouterr().out
+        for rid in ("TPU001", "LCK001", "EXC001", "NET001", "REG001"):
+            assert rid in out
+
+    def test_trtpu_check_subcommand_wired(self, capsys):
+        from transferia_tpu.cli.main import main
+
+        assert main(["check", "--list-rules"]) == 0
+        assert "TPU001" in capsys.readouterr().out
+
+
+@pytest.mark.slow
+class TestWholeTree:
+    def test_tree_is_clean_under_committed_baseline(self):
+        """Acceptance: `trtpu check --strict` on the real tree."""
+        from transferia_tpu.analysis.cli import main
+
+        assert main(["--strict"]) == 0

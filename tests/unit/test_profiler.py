@@ -5,6 +5,7 @@ Reference parity: always-on pprof on the health port
 (docs/benchmarks.md:44-60).
 """
 
+import threading
 import time
 import urllib.request
 
@@ -20,7 +21,10 @@ def _burn(deadline):
 
 
 def test_sampler_attributes_hot_function():
-    with profile(hz=250) as p:
+    # pin sampling to this thread: daemon threads leaked by earlier
+    # tests in the shared pytest process otherwise absorb CPU-clock
+    # deltas and break the single-threaded sum-to-wall invariant
+    with profile(hz=250, threads={threading.get_ident()}) as p:
         _burn(time.perf_counter() + 0.4)
     rep = p.report
     assert rep.samples > 20

@@ -1,0 +1,30 @@
+"""Framework-aware static analysis (`trtpu check`).
+
+An AST-based lint engine purpose-built for this codebase's hazard
+classes: device purity inside jit/pallas kernels (TPU001), lock
+discipline in threaded modules (LCK001), exception hygiene (EXC001),
+socket/file resource safety (NET001), and the plugin-registry contract
+(REG001).  See ARCHITECTURE.md "Static analysis" for the suppression
+syntax and baseline workflow.
+"""
+
+from transferia_tpu.analysis.engine import (
+    CheckResult,
+    Finding,
+    ProjectRule,
+    Rule,
+    Suppressions,
+    run_rules,
+)
+from transferia_tpu.analysis.rules import ALL_RULE_CLASSES, default_rules
+
+__all__ = [
+    "CheckResult",
+    "Finding",
+    "ProjectRule",
+    "Rule",
+    "Suppressions",
+    "run_rules",
+    "ALL_RULE_CLASSES",
+    "default_rules",
+]

@@ -1,0 +1,37 @@
+"""Built-in rule set for `trtpu check`."""
+
+from __future__ import annotations
+
+from transferia_tpu.analysis.engine import Rule
+from transferia_tpu.analysis.rules.device_purity import DevicePurityRule
+from transferia_tpu.analysis.rules.exception_hygiene import (
+    ExceptionHygieneRule,
+)
+from transferia_tpu.analysis.rules.lock_discipline import LockDisciplineRule
+from transferia_tpu.analysis.rules.registry_contract import (
+    RegistryContractRule,
+)
+from transferia_tpu.analysis.rules.resource_safety import ResourceSafetyRule
+
+ALL_RULE_CLASSES: tuple[type, ...] = (
+    DevicePurityRule,
+    LockDisciplineRule,
+    ExceptionHygieneRule,
+    ResourceSafetyRule,
+    RegistryContractRule,
+)
+
+
+def default_rules() -> list[Rule]:
+    return [cls() for cls in ALL_RULE_CLASSES]
+
+
+__all__ = [
+    "ALL_RULE_CLASSES",
+    "default_rules",
+    "DevicePurityRule",
+    "LockDisciplineRule",
+    "ExceptionHygieneRule",
+    "ResourceSafetyRule",
+    "RegistryContractRule",
+]

@@ -1,0 +1,254 @@
+"""TPU001 — device purity inside jit/pallas-traced functions.
+
+A `.item()`, `float()`, `np.asarray()` or data-dependent Python `if`
+inside a `@jax.jit`/pallas function forces a device→host sync (or a
+retrace per branch): the silent throughput killers the PR-1 tracer can
+only observe after the fact.  This rule finds them at parse time.
+
+Traced contexts recognized:
+  - decorators: `@jax.jit`, `@jit`, `@functools.partial(jax.jit, ...)`,
+    `@partial(jax.jit, ...)`, `@pl.pallas_call(...)`, `@pallas_call(...)`
+  - functions/lambdas passed to a `jax.jit(...)` call anywhere in the
+    same module (`fn = jax.jit(program)` — the dominant idiom in
+    ops/fused.py, ops/rowhash.py, parallel/mesh.py)
+
+`static_argnums=` / `static_argnames=` on the jit call are honored:
+branching on a static argument is concrete at trace time and is NOT
+flagged.  `x is None` / `x is not None` / `isinstance(x, ...)` tests
+are likewise trace-time concrete.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Optional, Sequence
+
+from transferia_tpu.analysis.engine import Finding, Rule, dotted_name
+
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+_HOST_SYNC_CALLS = {
+    ("jax", "device_get"): "jax.device_get() copies the value to host",
+    ("np", "asarray"): "np.asarray() on a traced value syncs to host",
+    ("np", "array"): "np.array() on a traced value syncs to host",
+    ("numpy", "asarray"): "numpy.asarray() on a traced value syncs to host",
+    ("numpy", "array"): "numpy.array() on a traced value syncs to host",
+}
+
+
+_dotted = dotted_name
+
+
+def _is_jit_ref(node: ast.AST) -> bool:
+    return _dotted(node) in ("jax.jit", "jit")
+
+
+def _is_pallas_ref(node: ast.AST) -> bool:
+    d = _dotted(node)
+    return d is not None and (
+        d.endswith("pallas_call") or ".pallas." in d or
+        d.startswith("pl.") or d in ("pl", "pallas"))
+
+
+class _JitCall:
+    """One `jax.jit(...)` / `functools.partial(jax.jit, ...)` call with
+    its static_argnums / static_argnames extracted."""
+
+    def __init__(self, call: ast.Call):
+        self.static_nums: set[int] = set()
+        self.static_names: set[str] = set()
+        for kw in call.keywords:
+            if kw.arg == "static_argnums":
+                self.static_nums = set(_int_tuple(kw.value))
+            elif kw.arg == "static_argnames":
+                self.static_names = set(_str_tuple(kw.value))
+
+    def static_params(self, fn: ast.AST) -> set[str]:
+        args = getattr(fn, "args", None)
+        if args is None:
+            return set(self.static_names)
+        names = [a.arg for a in args.posonlyargs + args.args]
+        out = set(self.static_names)
+        for i in self.static_nums:
+            if 0 <= i < len(names):
+                out.add(names[i])
+        return out
+
+
+def _int_tuple(node: ast.AST) -> list[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, int)]
+    return []
+
+
+def _str_tuple(node: ast.AST) -> list[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant)
+                and isinstance(e.value, str)]
+    return []
+
+
+def _decorator_jit(fn: ast.AST) -> Optional[_JitCall]:
+    """A _JitCall if fn carries a jit/pallas decorator, else None."""
+    for dec in getattr(fn, "decorator_list", []):
+        if _is_jit_ref(dec):
+            return _JitCall(ast.Call(func=dec, args=[], keywords=[]))
+        if isinstance(dec, ast.Call):
+            if _is_jit_ref(dec.func):
+                return _JitCall(dec)
+            # functools.partial(jax.jit, static_argnums=...)
+            if _dotted(dec.func) in ("functools.partial", "partial") \
+                    and dec.args and _is_jit_ref(dec.args[0]):
+                return _JitCall(dec)
+            if _is_pallas_ref(dec.func):
+                return _JitCall(dec)
+        if _is_pallas_ref(dec):
+            return _JitCall(ast.Call(func=dec, args=[], keywords=[]))
+    return None
+
+
+class DevicePurityRule(Rule):
+    id = "TPU001"
+    severity = "error"
+    description = ("host-sync call or data-dependent Python branch "
+                   "inside a jit/pallas-traced function")
+    # where the jitted kernels live; host-side modules branch on array
+    # values legitimately (after an explicit device_get)
+    paths = ("ops/", "parallel/", "transform/plugins/")
+
+    def check_file(self, relpath: str, tree: ast.AST,
+                   lines: Sequence[str]) -> list[Finding]:
+        findings: list[Finding] = []
+        # pass 1: module-wide map of names handed to jax.jit(...)
+        jitted_names: dict[str, _JitCall] = {}
+        jitted_lambdas: list[tuple[ast.Lambda, _JitCall]] = []
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) and _is_jit_ref(node.func) \
+                    and node.args:
+                target, jc = node.args[0], _JitCall(node)
+                if isinstance(target, ast.Name):
+                    jitted_names[target.id] = jc
+                elif isinstance(target, ast.Lambda):
+                    jitted_lambdas.append((target, jc))
+        # pass 2: visit every traced function body
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                jc = _decorator_jit(node) or jitted_names.get(node.name)
+                if jc is not None:
+                    self._check_traced(relpath, node, jc, lines, findings)
+        for lam, jc in jitted_lambdas:
+            self._check_traced(relpath, lam, jc, lines, findings)
+        return findings
+
+    def _check_traced(self, relpath: str, fn: ast.AST, jc: _JitCall,
+                      lines: Sequence[str],
+                      findings: list[Finding]) -> None:
+        static = jc.static_params(fn)
+        args = getattr(fn, "args", None)
+        traced_params = set()
+        if args is not None:
+            traced_params = {a.arg for a in
+                             args.posonlyargs + args.args +
+                             args.kwonlyargs} - static
+        body = fn.body if isinstance(fn.body, list) else [fn.body]
+        for stmt in body:
+            for node in ast.walk(stmt):
+                self._check_node(relpath, node, traced_params, static,
+                                 lines, findings)
+
+    def _check_node(self, relpath: str, node: ast.AST,
+                    traced: set[str], static: set[str],
+                    lines: Sequence[str],
+                    findings: list[Finding]) -> None:
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) \
+                    and fn.attr in _HOST_SYNC_METHODS and not node.args:
+                findings.append(self.finding(
+                    relpath, node,
+                    f".{fn.attr}() forces a device->host sync inside a "
+                    f"traced function", lines))
+                return
+            key_msg = _HOST_SYNC_CALLS.get(
+                tuple((_dotted(fn) or "").rsplit(".", 1)[-2:])
+                if _dotted(fn) and "." in _dotted(fn) else ("", ""))
+            if key_msg:
+                findings.append(self.finding(
+                    relpath, node, f"{key_msg} inside a traced function",
+                    lines))
+                return
+            if isinstance(fn, ast.Name) and fn.id in ("float", "int") \
+                    and len(node.args) == 1 \
+                    and not isinstance(node.args[0], ast.Constant) \
+                    and _mentions(node.args[0], traced):
+                findings.append(self.finding(
+                    relpath, node,
+                    f"{fn.id}() on a traced value concretizes it "
+                    f"(device->host sync); use jnp casts instead",
+                    lines))
+                return
+        if isinstance(node, (ast.If, ast.IfExp, ast.While)):
+            test = node.test
+            if _is_trace_time_test(test, static):
+                return
+            if _mentions(test, traced):
+                kind = ("while" if isinstance(node, ast.While) else "if")
+                findings.append(self.finding(
+                    relpath, node,
+                    f"data-dependent Python `{kind}` on a traced value "
+                    f"(concretization error or silent retrace); use "
+                    f"jnp.where/lax.cond or mark the argument static",
+                    lines))
+
+    def applies_to(self, relpath: str) -> bool:
+        # linkprobe deliberately measures host<->device syncs
+        if relpath.endswith("ops/linkprobe.py"):
+            return False
+        return super().applies_to(relpath)
+
+
+def _mentions(node: ast.AST, names: set[str]) -> bool:
+    if not names:
+        return False
+    return any(isinstance(n, ast.Name) and n.id in names
+               for n in ast.walk(node))
+
+
+def _is_trace_time_test(test: ast.AST, static: set[str]) -> bool:
+    """Tests that are concrete at trace time: `x is None`,
+    `isinstance(...)`, comparisons of static params, `len(...)` of a
+    static, attribute tests like `x.ndim == 2` (shape metadata)."""
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        return _is_trace_time_test(test.operand, static)
+    if isinstance(test, ast.BoolOp):
+        return all(_is_trace_time_test(v, static) for v in test.values)
+    if isinstance(test, ast.Call):
+        d = _dotted(test.func) or ""
+        return d in ("isinstance", "len", "callable", "hasattr")
+    if isinstance(test, ast.Compare):
+        if any(isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            return True
+        operands = [test.left] + list(test.comparators)
+        # shape/dtype metadata and len() are trace-time concrete
+        meta = ("shape", "ndim", "dtype", "size")
+        for o in operands:
+            if isinstance(o, ast.Call) \
+                    and (_dotted(o.func) or "") == "len":
+                return True
+            if isinstance(o, ast.Attribute) and o.attr in meta:
+                return True
+            if isinstance(o, ast.Subscript) \
+                    and isinstance(o.value, ast.Attribute) \
+                    and o.value.attr in meta:
+                return True
+    if isinstance(test, ast.Name) and test.id in static:
+        return True
+    if isinstance(test, ast.Attribute):
+        return test.attr in ("shape", "ndim", "dtype", "size")
+    return False

@@ -82,7 +82,14 @@ def build_parser() -> argparse.ArgumentParser:
                           "narrow the include list (target data stays)")
     rt.add_argument("--table", action="append", default=[], required=True,
                     help="table to remove (repeatable), e.g. ns.name")
-    add_transfer_cmd("check", "run checksum comparison source vs target")
+    chk_static = sub.add_parser(
+        "check",
+        help="static analysis: device purity, lock discipline, "
+             "exception/resource hygiene, registry contracts "
+             "(see --list-rules; data validation moved to `checksum`)")
+    from transferia_tpu.analysis.cli import add_check_args
+
+    add_check_args(chk_static)
     chk = add_transfer_cmd(
         "checksum", "full data-validation task (sampling, type-aware "
         "comparators; worker/tasks/checksum.go)")
@@ -311,6 +318,10 @@ def main(argv=None) -> int:
         return cmd_typesystem_docs(args)
     if args.command == "trace":
         return cmd_trace(args)
+    if args.command == "check":
+        from transferia_tpu.analysis.cli import run_check
+
+        return run_check(args)
 
     transfer = _load_transfer(args)
     cp = _coordinator(args)
@@ -360,9 +371,6 @@ def main(argv=None) -> int:
 
     if args.command == "replicate":
         return cmd_replicate(args, transfer, cp)
-
-    if args.command == "check":
-        return cmd_check(transfer)
 
     if args.command == "checksum":
         return cmd_checksum(args, transfer)
@@ -416,23 +424,6 @@ def cmd_replicate(args, transfer, cp) -> int:
     run_replication(transfer, cp, stop_event=stop,
                     max_attempts=args.max_attempts)
     return 0
-
-
-def cmd_check(transfer) -> int:
-    from transferia_tpu.factories.storage import new_storage
-    from transferia_tpu.providers.registry import get_provider
-    from transferia_tpu.tasks import checksum
-
-    src_storage = new_storage(transfer)
-    dst_provider = get_provider(transfer.dst_provider(), transfer)
-    dst_storage = dst_provider.destination_storage()
-    if dst_storage is None:
-        print("destination provider has no storage view of the target; "
-              "cannot checksum", file=sys.stderr)
-        return 2
-    report = checksum(src_storage, dst_storage)
-    print(report.summary())
-    return 0 if report.ok else 1
 
 
 def _checksum_against_operation(args, dst_storage) -> int:

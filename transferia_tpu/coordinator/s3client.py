@@ -13,6 +13,7 @@ coordinator_s3.go:236-268).
 from __future__ import annotations
 
 import http.client
+import logging
 import threading
 import urllib.parse
 import xml.etree.ElementTree as ET
@@ -86,8 +87,9 @@ class S3Client:
         if conn is not None:
             try:
                 conn.close()
-            except Exception:
-                pass
+            except Exception as e:
+                logging.getLogger(__name__).debug(
+                    "closing stale s3 connection failed: %s", e)
             self._local.conn = None
 
     def _request(self, method: str, key: str, query: dict[str, str],

@@ -234,7 +234,7 @@ class FileStorage(Storage, ShardingStorage, ScanPredicateStorage):
                 if ranges and range_disproves(node, ranges):
                     self._count_pruned(rg.num_rows)
                     continue
-            except Exception:
+            except Exception:  # trtpu: ignore[EXC001]
                 pass  # odd stats types: scan the group normally
             kept.append(g)
         return kept

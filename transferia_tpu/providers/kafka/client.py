@@ -100,7 +100,8 @@ class KafkaClient:
     # -- SASL (SaslHandshake v1 + SaslAuthenticate v1 frames) ---------------
     def _raw_roundtrip(self, sock: socket.socket, api_key: int,
                        api_version: int, body: bytes) -> Reader:
-        self._corr += 1
+        # only reached from _conn_for(), i.e. under self._lock
+        self._corr += 1  # trtpu: ignore[LCK001]
         corr = self._corr
         header = struct.pack("!hhi", api_key, api_version, corr) \
             + enc_str(self.client_id)
@@ -196,10 +197,14 @@ class KafkaClient:
             header = struct.pack("!hhi", api_key, api_version, corr) \
                 + enc_str(self.client_id)
             msg = header + body
+            # I/O under self._lock is the design: the lock serializes
+            # request/response framing on the single broker socket
             try:
-                sock.sendall(struct.pack("!i", len(msg)) + msg)
-                size = struct.unpack("!i", recv_exact(sock, 4))[0]
-                payload = recv_exact(sock, size)
+                sock.sendall(  # trtpu: ignore[LCK001]
+                    struct.pack("!i", len(msg)) + msg)
+                size = struct.unpack(
+                    "!i", recv_exact(sock, 4))[0]  # trtpu: ignore[LCK001]
+                payload = recv_exact(sock, size)  # trtpu: ignore[LCK001]
             except (OSError, ConnectionError) as e:
                 self._drop_conn(node)
                 raise KafkaError(f"kafka io error (node {node}): {e}") from e

@@ -58,8 +58,12 @@ except ImportError:
                                       len(data), 0))
 
                     _crc_impl = _crc_native
-            except Exception:  # pragma: no cover - keep python fallback
-                pass
+            except Exception as e:  # pragma: no cover - python fallback
+                import logging
+
+                logging.getLogger(__name__).debug(
+                    "native crc32c unavailable (%s); using python "
+                    "fallback", e)
         return _crc_impl(data)
 
 

@@ -68,12 +68,15 @@ class MongoConnection:
             self._req += 1
             req = self._req
             header = struct.pack("<iiii", 16 + len(payload), req, 0, OP_MSG)
+            # I/O under self._lock is the design: the lock serializes
+            # request/response framing on the single wire connection
             try:
-                self.sock.sendall(header + payload)
+                self.sock.sendall(header + payload)  # trtpu: ignore[LCK001]
                 resp_len = struct.unpack(
-                    "<i", recv_exact(self.sock, 4)
+                    "<i", recv_exact(self.sock, 4)  # trtpu: ignore[LCK001]
                 )[0]
-                resp = recv_exact(self.sock, resp_len - 4)
+                resp = recv_exact(  # trtpu: ignore[LCK001]
+                    self.sock, resp_len - 4)
             except (OSError, ConnectionError) as e:
                 raise MongoError(f"mongo io error: {e}") from e
         # resp: requestID(4) responseTo(4) opCode(4) flags(4) kind(1) doc

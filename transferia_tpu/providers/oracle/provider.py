@@ -147,9 +147,10 @@ class OracleStorage(Storage, PositionalStorage, ShardingStorage,
             return self._c
 
     def close(self) -> None:
-        if self._c is not None:
-            self._c.close()
-            self._c = None
+        with self._conn_lock:
+            c, self._c = self._c, None
+        if c is not None:
+            c.close()
 
     def ping(self) -> None:
         self.conn.scalar("SELECT 1 FROM dual")

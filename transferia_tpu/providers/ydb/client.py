@@ -264,8 +264,8 @@ class TopicReadSession:
             self._requests.put(None)
             try:
                 self._stream.cancel()
-            except Exception:
-                pass
+            except Exception as e:
+                logger.debug("cancelling ydb topic stream failed: %s", e)
 
 
 def yql_quote_ident(name: str) -> str:

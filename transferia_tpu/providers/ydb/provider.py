@@ -554,8 +554,12 @@ class YdbChangefeedSource(Source):
                     isinstance(row.get(c.name), str):
                 try:
                     row[c.name] = base64.b64decode(row[c.name])
-                except Exception:
-                    pass
+                except Exception as e:
+                    # keep the raw string; the sink will surface a type
+                    # error if it actually matters downstream
+                    logger.debug("changefeed column %s: not valid "
+                                 "base64 (%s); keeping raw value",
+                                 c.name, e)
         names = [n for n in schema.names() if n in row]
         return ChangeItem(
             kind=Kind.UPDATE if ev.get("update") is not None

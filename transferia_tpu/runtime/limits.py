@@ -28,7 +28,8 @@ def cgroup_memory_limit() -> Optional[int]:
     for path in ("/sys/fs/cgroup/memory.max",
                  "/sys/fs/cgroup/memory/memory.limit_in_bytes"):
         try:
-            raw = open(path).read().strip()
+            with open(path) as fh:
+                raw = fh.read().strip()
         except OSError:
             continue
         if raw in ("max", ""):

@@ -203,10 +203,15 @@ class Sampler:
 
 
 class profile:
-    """Context manager: `with profile() as p: ...; print(p.report.format())`"""
+    """Context manager: `with profile() as p: ...; print(p.report.format())`
 
-    def __init__(self, hz: float = 97.0):
-        self._sampler = Sampler(hz=hz)
+    `threads={ident, ...}` restricts sampling to those threads — e.g.
+    `{threading.get_ident()}` to profile just the calling thread in a
+    process where unrelated daemon threads also burn CPU."""
+
+    def __init__(self, hz: float = 97.0,
+                 threads: Optional[set[int]] = None):
+        self._sampler = Sampler(hz=hz, threads=threads)
         self.report: Optional[ProfileReport] = None
 
     def __enter__(self) -> "profile":
