@@ -1281,9 +1281,10 @@ def main() -> None:
     # the 10M rows/s target is defined on (reference docs/benchmarks.md)
     from transferia_tpu.stats.profiler import profile as cpu_profile
 
-    from transferia_tpu.providers import parquet_native
+    from transferia_tpu.providers import parquet_native, readahead
 
     parquet_native.reset_fallback_stats()
+    readahead.reset_stats()
     trace_out = _trace_out_path()
     if trace_out:
         from transferia_tpu.stats import trace as _trace
@@ -1295,6 +1296,16 @@ def main() -> None:
     with cpu_profile() as prof:
         rows, dt = run_pipeline(parquet=WIDE_PARQUET, total_rows=WIDE_ROWS)
     stage_note = stagetimer.format_breakdown(dt)
+    ra = readahead.snapshot_stats()
+    if ra["prefetched_groups"]:
+        # queue-depth evidence that decode overlapped downstream work —
+        # rides the stages string so BENCH_*.json captures it
+        stage_note += (
+            f" readahead_groups={ra['prefetched_groups']}"
+            f" readahead_depth_avg={ra['avg_depth']}"
+            f" readahead_depth_max={ra['max_depth']}"
+            f" readahead_inflight_mb_max="
+            f"{ra['max_inflight_bytes'] / 1e6:.0f}")
     if trace_out:
         from transferia_tpu.stats import trace as _trace
 
@@ -1443,19 +1454,11 @@ def main() -> None:
 
 
 def _effective_cpus() -> float:
-    """Cores this process can actually use (affinity ∩ cgroup quota)."""
-    try:
-        n = float(len(os.sched_getaffinity(0)))
-    except (AttributeError, OSError):
-        n = float(os.cpu_count() or 1)
-    try:  # cgroup v2: "max 100000" or "<quota> <period>"
-        with open("/sys/fs/cgroup/cpu.max") as fh:
-            quota_s, period_s = fh.read().split()
-        if quota_s != "max":
-            n = min(n, int(quota_s) / int(period_s))
-    except (OSError, ValueError):
-        pass
-    return round(n, 2)
+    """Cores this process can actually use (affinity ∩ cgroup quota) —
+    shared with the fs provider's decode auto-knobs."""
+    from transferia_tpu.runtime.limits import effective_cpus
+
+    return effective_cpus()
 
 
 def _dataset_cols(path: str) -> Optional[int]:

@@ -403,7 +403,8 @@ static void crc32c_init_table() {
 }
 
 #if defined(__x86_64__)
-#include <cpuid.h>
+// cpuid.h already included above (SHA-NI detection); gcc 10's header
+// carries no include guard, so a second include is a redefinition error
 static int sse42_available() {
     static int cached = -1;
     if (cached < 0) {

@@ -51,6 +51,23 @@ class FileSourceParams(EndpointParams):
     table: str = "data"       # logical table name
     namespace: str = "fs"
     batch_rows: int = 65_536
+    # decode-pipeline knobs (ARCHITECTURE.md "Decode pipeline"):
+    # decode_threads: column-parallel native decode width; 0 = auto
+    # (effective CPUs / upload workers — parts already decode in
+    # parallel across workers, so K only widens when cores are spare).
+    # readahead_groups: decoded row groups in flight per part (the one
+    # the consumer holds + queued + decoding); -1 = auto (2 with >1
+    # effective CPU, else 0), 0 = serial decode.  readahead_bytes adds
+    # an optional in-flight decoded-payload cap on top (0 = none).
+    # rowgroups_per_part: consecutive row groups per shard part; 0 =
+    # auto (1 with readahead off — today's per-group parts — else up to
+    # 4, keeping ~4 parts queued per upload worker).  Parts spanning
+    # several groups are what give the per-part readahead a g+1 to
+    # prefetch.
+    decode_threads: int = 0
+    readahead_groups: int = -1
+    readahead_bytes: int = 0
+    rowgroups_per_part: int = 0
 
 
 @register_endpoint
@@ -74,7 +91,8 @@ def _expand(path: str) -> list[str]:
 
 
 class FileStorage(Storage, ShardingStorage, ScanPredicateStorage):
-    def __init__(self, params: FileSourceParams):
+    def __init__(self, params: FileSourceParams, metrics=None,
+                 upload_workers: int = 1):
         self.params = params
         self.table = TableID(params.namespace, params.table)
         self._schema: Optional[TableSchema] = None
@@ -82,6 +100,46 @@ class FileStorage(Storage, ShardingStorage, ScanPredicateStorage):
         self._pred_fns: dict[TableID, object] = {}
         self._pruned_lock = threading.Lock()
         self.scan_rows_pruned = 0
+        self._upload_workers = max(1, upload_workers)
+        self._readahead_gauges = None
+        if metrics is not None:
+            from transferia_tpu.stats.registry import DeviceStats
+
+            ds = DeviceStats(metrics)
+            self._readahead_gauges = (ds.readahead_depth,
+                                      ds.readahead_bytes)
+
+    # -- decode-pipeline knob resolution ------------------------------------
+    def _decode_threads(self) -> int:
+        env = os.environ.get("TRANSFERIA_TPU_DECODE_THREADS")
+        k = int(env) if env else self.params.decode_threads
+        if k <= 0:
+            # auto: each upload worker already runs a consumer thread
+            # and a readahead decoder, so claim only half the per-worker
+            # core share — K = cpus/(2*workers), measured neutral at 4
+            # workers on 24 cores where cpus/workers oversubscribed ~9%
+            from transferia_tpu.runtime.limits import effective_cpus
+
+            k = int(effective_cpus()) // (2 * self._upload_workers)
+        return max(1, min(8, k))
+
+    def _readahead_groups(self) -> int:
+        env = os.environ.get("TRANSFERIA_TPU_READAHEAD_GROUPS")
+        n = int(env) if env else self.params.readahead_groups
+        if n < 0:  # auto: overlap decode unless there's a single core
+            from transferia_tpu.runtime.limits import effective_cpus
+
+            n = 2 if effective_cpus() >= 2 else 0
+        return n
+
+    def _readahead(self, groups, decode, nbytes):
+        from transferia_tpu.providers.readahead import RowGroupReadahead
+
+        return RowGroupReadahead(
+            groups, decode,
+            max_groups=self._readahead_groups(),
+            max_bytes=self.params.readahead_bytes or None,
+            nbytes=nbytes, gauges=self._readahead_gauges)
 
     def _count_pruned(self, n: int) -> None:
         # upload workers share this storage across threads
@@ -146,7 +204,20 @@ class FileStorage(Storage, ShardingStorage, ScanPredicateStorage):
         info = self.table_list().get(self.table)
         return info.eta_rows if info else 0
 
-    # -- sharding: parquet shards per row group, other formats per file -----
+    # -- sharding: parquet shards per row-group run, other formats per file -
+    def _groups_per_part(self, n_groups: int) -> int:
+        """Row groups per shard part.  One group per part (the original
+        sharding) maximizes worker-level parallelism but starves the
+        per-part readahead — there is no g+1 inside a single-group part.
+        Auto keeps ~4 parts queued per upload worker and caps the run
+        at 4 groups so one straggler part never serializes the tail."""
+        p = self.params.rowgroups_per_part
+        if p <= 0:
+            if self._readahead_groups() <= 0:
+                return 1  # serial decode: per-group parts, as before
+            p = min(4, max(1, n_groups // (4 * self._upload_workers)))
+        return max(1, p)
+
     def shard_table(self, table: TableDescription) -> list[TableDescription]:
         files = self._files()
         out = []
@@ -156,10 +227,13 @@ class FileStorage(Storage, ShardingStorage, ScanPredicateStorage):
 
                 meta = pq.ParquetFile(f).metadata
                 n_groups = meta.num_row_groups
-                for g in range(n_groups):
+                step = self._groups_per_part(n_groups)
+                for lo in range(0, n_groups, step):
+                    hi = min(lo + step, n_groups)
                     out.append(TableDescription(
-                        id=table.id, filter=f"rg:{g}:{g + 1}:{f}",
-                        eta_rows=meta.row_group(g).num_rows,
+                        id=table.id, filter=f"rg:{lo}:{hi}:{f}",
+                        eta_rows=sum(meta.row_group(g).num_rows
+                                     for g in range(lo, hi)),
                     ))
             else:
                 out.append(TableDescription(id=table.id, filter=f"file:{f}"))
@@ -279,39 +353,55 @@ class FileStorage(Storage, ShardingStorage, ScanPredicateStorage):
 
         if self._has_huge_row_groups(pf, groups):
             return False  # stream huge row groups through arrow instead
-        reader = NativeParquetReader.open(path, pf, schema)
+        reader = NativeParquetReader.open(
+            path, pf, schema, decode_threads=self._decode_threads())
         if reader is None:
             return False
-        for g in groups:
+
+        def decode(g):
             with stagetimer.stage("source_decode"):
-                cols = reader.read_row_group(g)
-            n = pf.metadata.row_group(g).num_rows
-            for b_lo in range(0, n, self.params.batch_rows):
-                b_hi = min(b_lo + self.params.batch_rows, n)
-                with stagetimer.stage("pivot"):
-                    batch = ColumnBatch(
-                        tid, schema, slice_columns(cols, b_lo, b_hi))
-                    batch.read_bytes = batch.nbytes()
-                with stagetimer.stage("source_decode"):
-                    batch = self._batch_filter(tid, batch)
-                if batch.n_rows:
-                    pusher(batch)
+                return reader.read_row_group(g)
+
+        def cols_nbytes(cols):
+            return sum(c.nbytes() for c in cols.values())
+
+        with self._readahead(groups, decode, cols_nbytes) as ra:
+            for g, cols in ra:
+                n = pf.metadata.row_group(g).num_rows
+                for b_lo in range(0, n, self.params.batch_rows):
+                    b_hi = min(b_lo + self.params.batch_rows, n)
+                    with stagetimer.stage("pivot"):
+                        batch = ColumnBatch(
+                            tid, schema, slice_columns(cols, b_lo, b_hi))
+                        batch.read_bytes = batch.nbytes()
+                    with stagetimer.stage("source_decode"):
+                        batch = self._batch_filter(tid, batch)
+                    if batch.n_rows:
+                        pusher(batch)
         return True
 
-    def _load_group_arrow(self, pf, g: int, tid: TableID,
-                          schema: TableSchema, pusher: Pusher) -> None:
+    def _load_groups_arrow(self, pf, groups: list[int], tid: TableID,
+                           schema: TableSchema, pusher: Pusher) -> None:
+        """Arrow decode with the same row-group readahead as the native
+        path: whole-group reads release the GIL inside arrow C++, so
+        dict-heavy/nested files overlap decode with downstream too."""
         from transferia_tpu.stats import stagetimer
 
-        with stagetimer.stage("source_decode"):
-            tbl = pf.read_row_group(g, use_threads=False)
-        for rb in tbl.to_batches(max_chunksize=self.params.batch_rows):
+        def decode(g):
             with stagetimer.stage("source_decode"):
-                rb = self._scan_filter(tid, rb)
-            if rb.num_rows:
-                with stagetimer.stage("pivot"):
-                    batch = ColumnBatch.from_arrow(rb, tid, schema)
-                    batch.read_bytes = rb.nbytes
-                pusher(batch)
+                return pf.read_row_group(g, use_threads=False)
+
+        with self._readahead(groups, decode, lambda t: t.nbytes) as ra:
+            for g, tbl in ra:
+                for rb in tbl.to_batches(
+                        max_chunksize=self.params.batch_rows):
+                    with stagetimer.stage("source_decode"):
+                        rb = self._scan_filter(tid, rb)
+                    if rb.num_rows:
+                        with stagetimer.stage("pivot"):
+                            batch = ColumnBatch.from_arrow(rb, tid, schema)
+                            batch.read_bytes = rb.nbytes
+                        pusher(batch)
 
     def _load_row_groups(self, path: str, lo: int, hi: int, tid: TableID,
                          schema: TableSchema, pusher: Pusher) -> None:
@@ -349,8 +439,7 @@ class FileStorage(Storage, ShardingStorage, ScanPredicateStorage):
                         batch.read_bytes = rb.nbytes
                     pusher(batch)
             return
-        for g in groups:
-            self._load_group_arrow(pf, g, tid, schema, pusher)
+        self._load_groups_arrow(pf, groups, tid, schema, pusher)
 
     def _load_file(self, path: str, tid: TableID, schema: TableSchema,
                    pusher: Pusher) -> None:
@@ -496,7 +585,9 @@ class FileProvider(Provider):
     NAME = "fs"
 
     def storage(self):
-        return FileStorage(self.transfer.src)
+        return FileStorage(
+            self.transfer.src, metrics=self.metrics,
+            upload_workers=self.transfer.runtime.sharding.process_count)
 
     def sinker(self):
         return FileSinker(self.transfer.dst)

@@ -1,0 +1,265 @@
+"""Row-group decode readahead: overlap host decode with the downstream
+pipeline.
+
+The snapshot hot loop was strictly serial per part: decode row group g
+to completion, push its batches through filter -> transform -> sink,
+only then touch g+1 — so host decode never overlapped device dispatch
+or sink I/O (r05 profile: `source_decode` 68% of wall).  The decode
+calls release the GIL (ctypes into the C++ chunk decoder, arrow C++
+reads), so a single background thread decoding g+1 while g's batches
+flow downstream buys genuine overlap without processes.
+
+`RowGroupReadahead` is that bounded prefetcher:
+
+- one worker thread decodes groups IN ORDER; the consumer iterates
+  `(group, item)` pairs in the same order (batch ordering downstream is
+  unchanged);
+- bounded in-flight: at most `max_groups` decoded groups exist at once
+  (the one the consumer holds + the queue + the one being decoded
+  counts toward the cap), and optionally at most `max_bytes` of decoded
+  payload — a part never holds more than ~2 row groups of decoded
+  columns by default;
+- a worker exception is re-raised to the consumer on its next pull (so
+  it propagates to the `upload_tables` caller exactly like a serial
+  decode error would);
+- a consumer/pusher error cancels outstanding prefetches: `close()`
+  (the context-manager exit) stops the worker before its next decode
+  and drops queued groups;
+- `max_groups <= 0` (or a single group) degrades to inline decode on
+  the caller's thread — zero new threads, exactly the serial behavior.
+
+Observability: each prefetch decode runs inside a `decode_readahead`
+trace span on the worker thread (stage timers taken inside the decode
+callable fold into the global stagetimer totals — per-thread accounting
+is already how overlap_factor is defined); consumer stalls are
+accounted as a `decode_wait` stage; queue depth and in-flight decoded
+bytes feed optional gauges (stats/registry.py DeviceStats) plus the
+module-level aggregate `snapshot_stats()` that `bench.py` appends to
+its stages line.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Iterable, Optional
+
+# process-wide aggregates (bench/diagnostic visibility, like
+# parquet_native.fallback_stats): how deep the queue ran and how much
+# decoded payload was in flight, across every prefetcher in the run
+_agg_lock = threading.Lock()
+_agg = {
+    "prefetched_groups": 0,
+    "prefetched_bytes": 0,
+    "depth_sum": 0,
+    "depth_samples": 0,
+    "max_depth": 0,
+    "max_inflight_bytes": 0,
+    "cancelled_groups": 0,
+}
+
+
+def snapshot_stats() -> dict:
+    with _agg_lock:
+        out = dict(_agg)
+    n = out.pop("depth_sum"), out.pop("depth_samples")
+    out["avg_depth"] = round(n[0] / n[1], 2) if n[1] else 0.0
+    return out
+
+
+def reset_stats() -> None:
+    with _agg_lock:
+        for k in _agg:
+            _agg[k] = 0
+
+
+class RowGroupReadahead:
+    """Bounded background decode of an ordered group list.
+
+    with RowGroupReadahead(groups, decode, max_groups=2) as ra:
+        for g, item in ra:
+            ...push item's batches downstream...
+
+    `decode(g)` runs on the worker thread (it must release the GIL to
+    be useful — both the native parquet decoder and arrow reads do);
+    `nbytes(item)` sizes an item for the byte cap and the gauges.
+    `gauges` is an optional (depth_gauge, bytes_gauge) pair with
+    prometheus inc/dec semantics (inc/dec compose across concurrent
+    prefetchers where set() would fight).
+    """
+
+    def __init__(self, groups: Iterable, decode: Callable,
+                 *, max_groups: int = 2,
+                 max_bytes: Optional[int] = None,
+                 nbytes: Optional[Callable] = None,
+                 gauges: Optional[tuple] = None,
+                 name: str = "decode-readahead"):
+        self._groups = list(groups)
+        self._decode = decode
+        self._max_groups = max_groups
+        self._max_bytes = max_bytes
+        self._nbytes = nbytes
+        self._gauges = gauges
+        self._cond = threading.Condition()
+        self._queue: deque = deque()  # (group, item, nbytes)
+        self._inflight_bytes = 0
+        self._handed: Optional[tuple] = None  # (group, nbytes) at consumer
+        self._error: Optional[BaseException] = None
+        self._closed = False
+        self._done = False
+        self._pos = 0  # inline-mode cursor
+        self._thread: Optional[threading.Thread] = None
+        # max_groups=1 can never overlap (the cap counts the group the
+        # consumer holds, stalling the worker whenever the consumer is
+        # busy) — inline serial decode is strictly better there too
+        if max_groups > 1 and len(self._groups) > 1:
+            self._thread = threading.Thread(target=self._run, name=name,
+                                            daemon=True)
+            self._thread.start()
+
+    # -- worker ------------------------------------------------------------
+    def _stalled_locked(self) -> bool:
+        """Caller holds self._cond.  True while decoding one more group
+        would bust a cap.  A lone group always proceeds (a single group
+        larger than max_bytes must still decode, or nothing ever
+        flows)."""
+        inflight = len(self._queue) + (1 if self._handed is not None else 0)
+        if inflight == 0:
+            return False
+        if inflight + 1 > self._max_groups:
+            return True
+        return (self._max_bytes is not None
+                and self._inflight_bytes >= self._max_bytes)
+
+    def _run(self) -> None:
+        from transferia_tpu.stats import trace
+
+        try:
+            for g in self._groups:
+                with self._cond:
+                    while not self._closed and self._stalled_locked():
+                        self._cond.wait()
+                    if self._closed:
+                        return
+                sp = trace.span("decode_readahead")
+                if sp:
+                    sp.add(group=g)
+                with sp:
+                    item = self._decode(g)
+                nb = int(self._nbytes(item)) if self._nbytes else 0
+                with self._cond:
+                    if self._closed:
+                        return  # consumer bailed mid-decode: drop
+                    self._queue.append((g, item, nb))
+                    self._inflight_bytes += nb
+                    self._account_enqueue_locked(nb)
+                    self._cond.notify_all()
+        except BaseException as e:  # re-raised on the consumer thread
+            with self._cond:
+                self._error = e
+                self._cond.notify_all()
+        finally:
+            with self._cond:
+                self._done = True
+                self._cond.notify_all()
+
+    def _account_enqueue_locked(self, nb: int) -> None:
+        depth = len(self._queue)
+        if self._gauges is not None:
+            self._gauges[0].inc()
+            if nb:
+                self._gauges[1].inc(nb)
+        with _agg_lock:
+            _agg["prefetched_groups"] += 1
+            _agg["prefetched_bytes"] += nb
+            _agg["depth_sum"] += depth
+            _agg["depth_samples"] += 1
+            _agg["max_depth"] = max(_agg["max_depth"], depth)
+            _agg["max_inflight_bytes"] = max(_agg["max_inflight_bytes"],
+                                             self._inflight_bytes)
+
+    # -- consumer ----------------------------------------------------------
+    def _release_handed_locked(self) -> None:
+        if self._handed is None:
+            return
+        _, nb = self._handed
+        self._handed = None
+        self._inflight_bytes -= nb
+        if self._gauges is not None and nb:
+            self._gauges[1].dec(nb)
+
+    def __iter__(self) -> "RowGroupReadahead":
+        return self
+
+    def __next__(self) -> tuple:
+        if self._thread is None:
+            return self._next_inline()
+        waited = 0.0
+        try:
+            with self._cond:
+                self._release_handed_locked()
+                self._cond.notify_all()
+                while True:
+                    if self._queue:
+                        g, item, nb = self._queue.popleft()
+                        self._handed = (g, nb)
+                        if self._gauges is not None:
+                            self._gauges[0].dec()
+                        break
+                    if self._error is not None:
+                        raise self._error
+                    if self._done:
+                        raise StopIteration
+                    t0 = time.perf_counter()
+                    self._cond.wait()
+                    waited += time.perf_counter() - t0
+        finally:
+            if waited:
+                from transferia_tpu.stats import stagetimer
+
+                stagetimer.add("decode_wait", waited)
+        return g, item
+
+    def _next_inline(self) -> tuple:
+        # serial fallback: no worker, no queue — decode on demand.  The
+        # error/cancel semantics hold trivially (decode raises in place;
+        # close() just ends iteration).
+        if self._closed or self._pos >= len(self._groups):
+            raise StopIteration
+        g = self._groups[self._pos]
+        self._pos += 1
+        return g, self._decode(g)
+
+    # -- lifecycle ---------------------------------------------------------
+    def close(self) -> None:
+        """Cancel outstanding prefetches and join the worker.  Called by
+        the context-manager exit — a pusher error inside the consumer
+        loop lands here, so the worker stops before its next decode."""
+        t = self._thread
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        if t is not None:
+            t.join()
+        with self._cond:
+            self._release_handed_locked()
+            dropped = 0
+            while self._queue:
+                _g, _item, nb = self._queue.popleft()
+                self._inflight_bytes -= nb
+                dropped += 1
+                if self._gauges is not None:
+                    self._gauges[0].dec()
+                    if nb:
+                        self._gauges[1].dec(nb)
+        if dropped:
+            with _agg_lock:
+                _agg["cancelled_groups"] += dropped
+
+    def __enter__(self) -> "RowGroupReadahead":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

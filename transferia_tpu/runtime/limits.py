@@ -23,6 +23,27 @@ from typing import Callable, Optional
 logger = logging.getLogger(__name__)
 
 
+def effective_cpus() -> float:
+    """Cores this process can actually use (affinity ∩ cgroup quota).
+
+    The sizing input for host-parallel work: bench.py's worker count and
+    the fs provider's column-parallel decode / readahead auto-knobs all
+    derive from it, so a 1-core CI box degrades to serial behavior
+    instead of thrashing."""
+    try:
+        n = float(len(os.sched_getaffinity(0)))
+    except (AttributeError, OSError):
+        n = float(os.cpu_count() or 1)
+    try:  # cgroup v2: "max 100000" or "<quota> <period>"
+        with open("/sys/fs/cgroup/cpu.max") as fh:
+            quota_s, period_s = fh.read().split()
+        if quota_s != "max":
+            n = min(n, int(quota_s) / int(period_s))
+    except (OSError, ValueError):
+        pass
+    return round(n, 2)
+
+
 def cgroup_memory_limit() -> Optional[int]:
     """Container memory limit in bytes, None when unlimited/unknown."""
     for path in ("/sys/fs/cgroup/memory.max",

@@ -179,6 +179,13 @@ class DeviceStats(_Bundle):
         self.compiles = self.m.counter("device_xla_compiles")
         self.compile_seconds = self.m.counter("device_xla_compile_seconds")
         self.kernel_seconds = self.m.counter("device_kernel_seconds")
+        # decode-pipeline readahead (providers/readahead.py): prefetch
+        # queue depth and in-flight decoded bytes — host-side gauges,
+        # but they live with the link physics because overlapping host
+        # decode with device dispatch is what the prefetcher buys
+        self.readahead_depth = self.m.gauge("decode_readahead_depth")
+        self.readahead_bytes = self.m.gauge(
+            "decode_readahead_inflight_bytes")
 
 
 class TableStats(_Bundle):

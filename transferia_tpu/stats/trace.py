@@ -28,6 +28,9 @@ roots `part` / `batch` / `replication_attempt` carry identity args
 `pivot`, `pack`, `device_dispatch`, `device_wait`, `host_post`,
 `transform`, `serialize`, `bufferer_flush`, `sink_push`, `sink` nest
 under them.  `device_dispatch`/`device_wait` carry byte counts as args.
+`decode_readahead` spans live on the prefetcher worker threads
+(providers/readahead.py) — decode running there shows as its own
+track, overlapping the part's downstream spans.
 
 `DeviceTelemetry` is the always-on counter half: H2D/D2H bytes and
 transfer counts, device launches, XLA compile events (hooked via jax's

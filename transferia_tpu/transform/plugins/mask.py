@@ -49,13 +49,18 @@ def _host_hmac_hex(key: bytes, data: np.ndarray, offsets: np.ndarray,
     native = _native_hmac_hex(key, data, offsets, validity, n)
     if native is not None:
         return native
-    raw = data.tobytes()
+    # zero-copy row slices (memoryview over the column buffer — hmac
+    # takes any buffer) and hoisted per-row int conversions: the numpy
+    # scalar indexing was most of the non-hash time here
+    raw = memoryview(np.ascontiguousarray(data))
+    off = offsets.tolist()
+    valid = validity.tolist() if validity is not None else None
     outs = []
     for i in range(n):
-        if validity is not None and not validity[i]:
+        if valid is not None and not valid[i]:
             outs.append(b"")
             continue
-        msg = raw[offsets[i]:offsets[i + 1]]
+        msg = raw[off[i]:off[i + 1]]
         outs.append(
             hmac_mod.new(key, msg, hashlib.sha256).hexdigest().encode()
         )
