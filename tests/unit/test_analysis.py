@@ -547,7 +547,8 @@ class TestEngineAndCli:
 
         assert main(["--list-rules"]) == 0
         out = capsys.readouterr().out
-        for rid in ("TPU001", "LCK001", "EXC001", "NET001", "REG001"):
+        for rid in ("TPU001", "LCK001", "EXC001", "NET001", "REG001",
+                    "FPT001"):
             assert rid in out
 
     def test_trtpu_check_subcommand_wired(self, capsys):
@@ -555,6 +556,91 @@ class TestEngineAndCli:
 
         assert main(["check", "--list-rules"]) == 0
         assert "TPU001" in capsys.readouterr().out
+
+
+class TestFailpointContract:
+    """FPT001: literal, registered, uniquely-owned failpoint sites."""
+
+    def _project_findings(self, sources: dict[str, str],
+                          catalog=("sink.push", "storage.part.read")):
+        from transferia_tpu.analysis.rules import FailpointContractRule
+
+        rule = FailpointContractRule()
+        rule.known_sites = frozenset(catalog)
+        files = {}
+        # the dead-entry pass only runs when the catalog file itself is
+        # in the analyzed set (narrowed runs can't judge coverage)
+        sources.setdefault("transferia_tpu/chaos/sites.py", "SITES = {}\n")
+        for path, src in sources.items():
+            src = textwrap.dedent(src)
+            files[path] = (ast.parse(src), src.splitlines())
+        return rule.check_project("/tmp", files)
+
+    def test_clean_tree(self):
+        found = self._project_findings({
+            "transferia_tpu/a.py": 'failpoint("sink.push")\n',
+            "transferia_tpu/b.py":
+                'fp.torn_rows("storage.part.read", n)\n',
+        })
+        assert found == [], [f.message for f in found]
+
+    def test_non_literal_site_name(self):
+        found = self._project_findings({
+            "transferia_tpu/a.py": 'failpoint("sink.push")\n'
+                                   'failpoint(SITE)\n',
+            "transferia_tpu/b.py":
+                'torn_rows("storage.part.read", n)\n',
+        })
+        assert len(found) == 1
+        assert "string literal" in found[0].message
+
+    def test_unregistered_site(self):
+        found = self._project_findings({
+            "transferia_tpu/a.py": 'failpoint("sink.push")\n'
+                                   'failpoint("made.up.site")\n',
+            "transferia_tpu/b.py":
+                'torn_rows("storage.part.read", n)\n',
+        })
+        assert len(found) == 1
+        assert "not registered" in found[0].message
+
+    def test_duplicate_ownership(self):
+        found = self._project_findings({
+            "transferia_tpu/a.py": 'failpoint("sink.push")\n',
+            "transferia_tpu/b.py": 'failpoint("sink.push")\n'
+                                   'failpoint("storage.part.read")\n',
+        })
+        assert len(found) == 1
+        assert "already instrumented" in found[0].message
+
+    def test_dead_catalog_entry(self):
+        found = self._project_findings({
+            "transferia_tpu/a.py": 'failpoint("sink.push")\n',
+        })
+        assert len(found) == 1
+        assert "no call site references it" in found[0].message
+
+    def test_chaos_package_and_tests_exempt(self):
+        found = self._project_findings({
+            "transferia_tpu/chaos/failpoints.py":
+                'failpoint(whatever)\n',
+            "tests/unit/test_x.py": 'failpoint("bogus.site")\n',
+            "transferia_tpu/a.py": 'failpoint("sink.push")\n',
+            "transferia_tpu/b.py":
+                'torn_rows("storage.part.read", n)\n',
+        })
+        assert found == [], [f.message for f in found]
+
+    def test_real_tree_holds_contract(self):
+        """Every instrumented site in the real tree is literal,
+        registered, uniquely owned, and no catalog entry is dead."""
+        from transferia_tpu.analysis.rules import FailpointContractRule
+
+        result = run_rules(["transferia_tpu"],
+                           [FailpointContractRule()],
+                           root=_repo_root())
+        assert result.findings == [], \
+            [f.format() for f in result.findings]
 
 
 @pytest.mark.slow

@@ -1,0 +1,539 @@
+"""Chaos plane: failpoint spec/trigger semantics, delivery-invariant
+auditor true/false positives, backoff jitter + stop_event, partitioned
+pump error propagation, and one end-to-end seeded trial per mode."""
+
+import random
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from transferia_tpu.abstract.errors import (
+    FatalError,
+    TableUploadError,
+    is_retriable,
+)
+from transferia_tpu.abstract.schema import TableID
+from transferia_tpu.chaos import failpoints as fp
+from transferia_tpu.chaos import invariants as inv
+from transferia_tpu.columnar.batch import ColumnBatch
+from transferia_tpu.coordinator.memory import MemoryCoordinator
+from transferia_tpu.providers.sample import make_batch
+from transferia_tpu.utils.backoff import retry_with_backoff
+
+
+@pytest.fixture(autouse=True)
+def _clean_failpoints():
+    fp.reset()
+    yield
+    fp.reset()
+
+
+def _batch(start=0, n=64, seed=3):
+    return make_batch("users", TableID("sample", "users"), start, n, seed)
+
+
+# -- spec parsing ------------------------------------------------------------
+
+class TestSpecParsing:
+    def test_full_grammar(self):
+        sites = fp.parse_spec(
+            "sink.push=after:2,every:3,times:4,raise:ConnectionError;"
+            "storage.part.read=prob:0.25;"
+            "transform.chain=delay:15;"
+            "sink.push.torn=truncate:0.5")
+        assert sites["sink.push"].after == 2
+        assert sites["sink.push"].every == 3
+        assert sites["sink.push"].times == 4
+        assert sites["sink.push"].arg is ConnectionError
+        assert sites["storage.part.read"].prob == 0.25
+        assert sites["transform.chain"].action == "delay"
+        assert sites["transform.chain"].arg == pytest.approx(0.015)
+        assert sites["sink.push.torn"].action == "truncate"
+
+    def test_bare_site_always_fires(self):
+        sites = fp.parse_spec("sink.push")
+        fired = [sites["sink.push"].should_fire() for _ in range(5)]
+        assert fired == [True] * 5
+
+    @pytest.mark.parametrize("bad", [
+        "unknown.site=times:1",          # unregistered site
+        "sink.push=prob:1.5",            # out-of-range probability
+        "sink.push=raise:NoSuchError",   # unknown error class
+        "sink.push=after:x",             # non-numeric
+        "sink.push=frobnicate:1",        # unknown term
+        "sink.push=times",               # missing value separator
+        "sink.push=truncate:0",          # truncation must keep > 0 rows
+        "sink.push=times:1;sink.push=times:2",  # armed twice
+    ])
+    def test_rejects_malformed(self, bad):
+        with pytest.raises(fp.FailpointSpecError):
+            fp.parse_spec(bad)
+
+    def test_env_activation(self):
+        assert not fp.activate_from_env({})
+        assert fp.activate_from_env({
+            fp.ENV_SPEC: "sink.push=times:1", fp.ENV_SEED: "11"})
+        assert fp.is_enabled()
+        with pytest.raises(fp.ChaosInjectedError):
+            fp.failpoint("sink.push")
+
+
+# -- triggers ----------------------------------------------------------------
+
+class TestTriggers:
+    def _fires(self, clause, hits, seed=0):
+        site = clause.split("=")[0]
+        fp.configure(clause, seed=seed)
+        out = []
+        for i in range(1, hits + 1):
+            try:
+                fp.failpoint(site)
+            except Exception:
+                out.append(i)
+        fp.reset()
+        return out
+
+    def test_after_every_times(self):
+        # after:2 skips hits 1-2; every:2 fires eligible hits 2,4,...
+        # (absolute 4,6,8,...); times:3 caps it
+        assert self._fires("sink.push=after:2,every:2,times:3",
+                           12) == [4, 6, 8]
+
+    def test_prob_deterministic_under_seed(self):
+        a = self._fires("sink.push=prob:0.3", 50, seed=7)
+        b = self._fires("sink.push=prob:0.3", 50, seed=7)
+        c = self._fires("sink.push=prob:0.3", 50, seed=8)
+        assert a == b
+        assert a != c
+        assert 0 < len(a) < 50  # actually probabilistic
+
+    def test_per_site_rng_streams_independent(self):
+        fp.configure("sink.push=prob:0.5;storage.part.read=prob:0.5",
+                     seed=7)
+        pushes, reads = [], []
+        for i in range(40):
+            try:
+                fp.failpoint("sink.push")
+            except Exception:
+                pushes.append(i)
+            try:
+                fp.failpoint("storage.part.read")
+            except Exception:
+                reads.append(i)
+        assert pushes != reads  # distinct per-site streams
+
+    def test_delay_action_sleeps_without_raising(self):
+        fp.configure("sink.push=delay:30,times:1")
+        t0 = time.monotonic()
+        fp.failpoint("sink.push")  # fires: sleeps, no raise
+        assert time.monotonic() - t0 >= 0.025
+        assert fp.fire_counts()["sink.push"] == 1
+
+    def test_torn_rows_semantics(self):
+        fp.configure("sink.push.torn=truncate:0.5,every:2")
+        # torn never fires through failpoint() — and a truncate-armed
+        # site doesn't count failpoint() passes as hits (a site has
+        # exactly one owning call site, enforced by FPT001)
+        fp.failpoint("sink.push.torn")
+        assert fp.torn_rows("sink.push.torn", 100) is None  # hit 1
+        assert fp.torn_rows("sink.push.torn", 100) == 50    # hit 2 fires
+        assert fp.torn_rows("sink.push.torn", 100) is None
+        # n_rows < 2 can't tear: needs >=1 kept and >=1 lost
+        assert fp.torn_rows("sink.push.torn", 1) is None
+        # truncation result is clamped to [1, n-1]
+        fp.configure("sink.push.torn=truncate:1.0")
+        assert fp.torn_rows("sink.push.torn", 10) == 9
+
+    def test_fire_log_records_hit_indices(self):
+        self_fires = self._fires("sink.push=after:1,every:3", 10)
+        fp.configure("sink.push=after:1,every:3")
+        for _ in range(10):
+            try:
+                fp.failpoint("sink.push")
+            except Exception:
+                pass
+        assert fp.fire_log()["sink.push"] == self_fires
+
+
+class TestDisabledPath:
+    def test_noop_when_disabled(self):
+        assert not fp.is_enabled()
+        # even unregistered names pass through silently: the disabled
+        # path must be a flag check, not a catalog lookup
+        assert fp.failpoint("not.even.a.site") is None
+        assert fp.torn_rows("not.even.a.site", 100) is None
+        assert fp.fire_counts() == {}
+
+    def test_no_hit_accounting_when_disabled(self):
+        fp.configure("sink.push=times:1")
+        fp.reset()
+        fp.failpoint("sink.push")
+        assert fp.hit_counts() == {}  # registry empty after reset
+
+    def test_fold_into_metrics(self):
+        from transferia_tpu.stats.registry import Metrics
+
+        fp.configure("sink.push=every:1,times:3")
+        for _ in range(3):
+            with pytest.raises(fp.ChaosInjectedError):
+                fp.failpoint("sink.push")
+        m = Metrics()
+        fp.fold_into(m)
+        fp.fold_into(m)  # idempotent: deltas, not re-adds
+        assert m.value("chaos_fires_sink_push") == 3
+        assert m.value("chaos_fires") == 3
+
+
+# -- invariants --------------------------------------------------------------
+
+class TestInvariants:
+    def test_row_keys_match_fingerprint_reduction(self):
+        from transferia_tpu.ops.rowhash import (
+            fingerprint_host,
+            prep_batch,
+        )
+
+        b = _batch(n=100)
+        keys = inv.batch_row_keys(b)
+        assert len(keys) == 100
+        assert len(set(keys.tolist())) == 100  # users rows are distinct
+        from collections import Counter
+
+        agg = inv.keys_fingerprint(Counter(keys.tolist()))
+        assert agg == fingerprint_host(*prep_batch(b))
+
+    def test_auditor_passes_on_exact_delivery(self):
+        ref = inv.DeliveryReference.from_batches([_batch(n=64)])
+        v = inv.audit_delivery(ref, [_batch(n=64)], max_multiplicity=1)
+        assert v.passed, v.summary()
+        assert v.duplicate_rows == 0
+
+    def test_auditor_accepts_bounded_duplicates(self):
+        ref = inv.DeliveryReference.from_batches([_batch(n=64)])
+        dup = _batch(n=64).slice(0, 16)
+        v = inv.audit_delivery(ref, [_batch(n=64), dup],
+                               max_multiplicity=2)
+        assert v.passed, v.summary()
+        assert v.duplicate_rows == 16
+        assert v.max_multiplicity == 2
+
+    def test_auditor_detects_lost_rows(self):
+        ref = inv.DeliveryReference.from_batches([_batch(n=64)])
+        v = inv.audit_delivery(ref, [_batch(n=64).slice(0, 60)],
+                               max_multiplicity=3)
+        assert not v.passed
+        assert any(x.invariant == "at-least-once"
+                   for x in v.violations)
+
+    def test_auditor_detects_unbounded_duplicates(self):
+        ref = inv.DeliveryReference.from_batches([_batch(n=64)])
+        dup = _batch(n=64).slice(0, 8)
+        v = inv.audit_delivery(ref, [_batch(n=64), dup, dup, dup],
+                               max_multiplicity=2)
+        assert not v.passed
+        assert any(x.invariant == "bounded-duplication"
+                   for x in v.violations)
+
+    def test_bound_scales_with_reference_multiplicity(self):
+        # a source that LEGITIMATELY delivers identical content twice
+        # (duplicate rows in the clean run) must not trip the bound
+        ref = inv.DeliveryReference.from_batches(
+            [_batch(n=32), _batch(n=32)])
+        v = inv.audit_delivery(ref, [_batch(n=32), _batch(n=32)],
+                               max_multiplicity=1)
+        assert v.passed, v.summary()
+        v = inv.audit_delivery(ref, [_batch(n=32)] * 4,
+                               max_multiplicity=1)
+        assert not v.passed
+        assert any(x.invariant == "bounded-duplication"
+                   for x in v.violations)
+
+    def test_auditor_detects_invented_rows(self):
+        ref = inv.DeliveryReference.from_batches([_batch(n=64)])
+        v = inv.audit_delivery(
+            ref, [_batch(n=64), _batch(start=1000, n=4)],
+            max_multiplicity=3)
+        assert not v.passed
+        assert any(x.invariant == "no-inventions" for x in v.violations)
+
+    def test_monotonicity_tracker(self):
+        tr = inv.MonotonicityTracker()
+        tr.record("commit:t:0", 5)
+        tr.record("commit:t:0", 5)
+        tr.record("commit:t:0", 9)
+        assert not tr.violations
+        tr.record("commit:t:0", 3)
+        assert len(tr.violations) == 1
+        tr.reset_mark("commit:t:0")
+        tr.record("commit:t:0", 0)  # re-based epoch is legal
+        assert len(tr.violations) == 1
+        ref = inv.DeliveryReference.from_batches([_batch(n=8)])
+        v = inv.audit_delivery(ref, [_batch(n=8)], 1, checkpoints=tr)
+        assert not v.passed
+        assert any(x.invariant == "checkpoint-monotonicity"
+                   for x in v.violations)
+
+    def test_auditing_coordinator_forwards_and_tracks(self):
+        from transferia_tpu.abstract.table import OperationTablePart
+
+        cp = inv.AuditingCoordinator(MemoryCoordinator())
+        cp.set_transfer_state("t", {"k": 1})
+        assert cp.get_transfer_state("t") == {"k": 1}
+        assert cp.state_writes == 1
+        parts = [OperationTablePart(
+            operation_id="op", table_id=TableID("a", "b"),
+            part_index=i, parts_count=2) for i in range(2)]
+        cp.create_operation_parts("op", parts)
+        got = cp.assign_operation_part("op", 0)
+        got.completed = True
+        got.completed_rows = 5
+        cp.update_operation_parts("op", [got])
+        assert cp.operation_progress("op").completed_parts == 1
+        assert not cp.tracker.violations
+
+
+# -- satellite: backoff jitter + stop_event ---------------------------------
+
+class TestBackoff:
+    def test_full_jitter_draws_uniform(self):
+        sleeps = []
+        calls = [0]
+
+        def fn():
+            calls[0] += 1
+            raise ConnectionError("x")
+
+        class Rng:
+            def __init__(self):
+                self.draws = []
+
+            def uniform(self, lo, hi):
+                self.draws.append((lo, hi))
+                return 0.0  # no actual sleeping in tests
+
+        rng = Rng()
+        with pytest.raises(ConnectionError):
+            retry_with_backoff(fn, attempts=4, base_delay=0.5,
+                               max_delay=30.0, rng=rng)
+        assert calls[0] == 4
+        # full jitter: uniform(0, cap) with cap doubling per retry
+        assert rng.draws == [(0.0, 0.5), (0.0, 1.0), (0.0, 2.0)]
+
+    def test_jitter_off_restores_deterministic_schedule(self, monkeypatch):
+        slept = []
+        monkeypatch.setattr(time, "sleep", slept.append)
+        with pytest.raises(ConnectionError):
+            retry_with_backoff(
+                lambda: (_ for _ in ()).throw(ConnectionError("x")),
+                attempts=3, base_delay=0.5, jitter=False)
+        assert slept == [0.5, 1.0]
+
+    def test_stop_event_aborts_backoff_immediately(self):
+        stop = threading.Event()
+        stop.set()
+        calls = [0]
+
+        def fn():
+            calls[0] += 1
+            raise ConnectionError("x")
+
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            retry_with_backoff(fn, attempts=5, base_delay=30.0,
+                               stop_event=stop)
+        assert calls[0] == 1  # no second attempt after stop
+        assert time.monotonic() - t0 < 1.0
+
+    def test_stop_event_interrupts_sleep(self):
+        stop = threading.Event()
+        calls = [0]
+
+        def fn():
+            calls[0] += 1
+            raise ConnectionError("x")
+
+        threading.Timer(0.05, stop.set).start()
+        t0 = time.monotonic()
+        with pytest.raises(ConnectionError):
+            retry_with_backoff(fn, attempts=3, base_delay=60.0,
+                               jitter=False, stop_event=stop)
+        assert time.monotonic() - t0 < 5.0
+        assert calls[0] == 1
+
+
+# -- satellite: fail-fast retry predicate ------------------------------------
+
+class TestRetriablePredicate:
+    def test_fatal_and_programming_errors_fail_fast(self):
+        assert not is_retriable(FatalError("bad creds"))
+        assert not is_retriable(TypeError("schema drift"))
+        assert is_retriable(ConnectionError("blip"))
+        assert is_retriable(fp.ChaosInjectedError("injected"))
+
+    def test_walks_table_upload_cause_chain(self):
+        wrapped = TableUploadError("part failed",
+                                   cause=TypeError("bad column"))
+        assert not is_retriable(wrapped)
+        wrapped = TableUploadError("part failed",
+                                   cause=ConnectionError("blip"))
+        assert is_retriable(wrapped)
+
+    def test_snapshot_part_retry_fails_fast_on_fatal(self):
+        from transferia_tpu.tasks import snapshot as snap_mod
+
+        calls = [0]
+
+        def fn():
+            calls[0] += 1
+            raise TableUploadError("part x", cause=FatalError("abort"))
+
+        with pytest.raises(TableUploadError):
+            retry_with_backoff(fn, attempts=snap_mod.PART_RETRIES,
+                               base_delay=0.0, retriable=is_retriable)
+        assert calls[0] == 1
+
+
+# -- satellite: partitioned pump error propagation ---------------------------
+
+class TestPartitionedWorkerErrors:
+    def _worker(self, monkeypatch, close_error=None, run_error=None):
+        from transferia_tpu.models import Transfer, TransferType
+        from transferia_tpu.providers.memory import MemoryTargetParams
+        from transferia_tpu.runtime import local as local_mod
+
+        class FakeSource:
+            def __init__(self):
+                self._stop = threading.Event()
+
+            def run(self, sink):
+                if run_error is not None:
+                    raise run_error
+                self._stop.wait(5)
+
+            def stop(self):
+                self._stop.set()
+
+        class FakeSink:
+            def close(self):
+                if close_error is not None:
+                    raise close_error
+
+        class FakeParams:
+            PROVIDER = "kafka"
+            topic = "t"
+            parallelism = 2
+
+            def parser_config(self):
+                return None
+
+        transfer = Transfer(id="pw", type=TransferType.INCREMENT_ONLY,
+                            src=FakeParams(),
+                            dst=MemoryTargetParams(sink_id="pw"))
+        w = local_mod.PartitionedWorker(transfer, MemoryCoordinator())
+        monkeypatch.setattr(
+            "transferia_tpu.providers.kafka.provider.topic_partitions",
+            lambda params: [0, 1], raising=False)
+        monkeypatch.setattr(
+            "transferia_tpu.providers.kafka.provider._KafkaQueueClient",
+            lambda *a, **kw: object(), raising=False)
+        monkeypatch.setattr(
+            "transferia_tpu.providers.queue_common.QueueSource",
+            lambda *a, **kw: FakeSource())
+        monkeypatch.setattr(local_mod, "make_async_sink",
+                            lambda *a, **kw: FakeSink())
+        return w
+
+    def test_close_errors_propagate_to_run(self, monkeypatch):
+        w = self._worker(monkeypatch,
+                         close_error=RuntimeError("flush failed"))
+        threading.Timer(0.2, w.stop).start()
+        with pytest.raises(RuntimeError, match="flush failed"):
+            w.run()
+        assert isinstance(w.failure, RuntimeError)
+
+    def test_run_errors_propagate_and_latch(self, monkeypatch):
+        w = self._worker(monkeypatch,
+                         run_error=ConnectionError("partition died"))
+        with pytest.raises(ConnectionError, match="partition died"):
+            w.run()
+        assert isinstance(w.failure, ConnectionError)
+
+    def test_clean_stop_has_no_failure(self, monkeypatch):
+        w = self._worker(monkeypatch)
+        threading.Timer(0.2, w.stop).start()
+        w.run()
+        assert w.failure is None
+
+
+# -- end-to-end seeded trials ------------------------------------------------
+
+class TestEndToEndTrials:
+    def test_snapshot_trial_seeded(self):
+        from transferia_tpu.chaos import runner
+
+        with runner._fast_retries():
+            ref = runner._snapshot_reference(512)
+            r = runner.run_snapshot_trial(0, 7, 512, ref,
+                                          device_ok=False)
+        assert r.passed, r.verdict.summary()
+        assert sum(1 for n in r.fire_counts.values() if n) >= 2
+        assert r.verdict.delivered_rows >= 512
+
+    def test_snapshot_trial_fire_log_replays_with_seed(self):
+        from transferia_tpu.chaos import runner
+
+        with runner._fast_retries():
+            ref = runner._snapshot_reference(512)
+            a = runner.run_snapshot_trial(1, 7, 512, ref,
+                                          device_ok=False)
+            b = runner.run_snapshot_trial(1, 7, 512, ref,
+                                          device_ok=False)
+            c = runner.run_snapshot_trial(1, 8, 512, ref,
+                                          device_ok=False)
+        assert a.spec == b.spec
+        assert a.fire_log == b.fire_log
+        assert (c.spec, c.fire_log) != (a.spec, a.fire_log)
+
+    def test_replication_trial_seeded(self):
+        from transferia_tpu.chaos import runner
+
+        with runner._fast_retries():
+            ref = runner._replication_reference(80)
+            r = runner.run_replication_trial(0, 7, 80, ref)
+        assert r.passed, r.verdict.summary()
+        assert sum(1 for n in r.fire_counts.values() if n) >= 1
+        assert r.verdict.delivered_rows >= 80
+
+    def test_trial_detects_genuinely_lost_rows(self):
+        """False-positive guard for the whole harness: a sink that
+        silently drops rows (no error, no retry signal) must FAIL the
+        at-least-once audit."""
+        from transferia_tpu.chaos import runner
+        from transferia_tpu.providers.memory import (
+            MemorySinker,
+            get_store,
+        )
+
+        real_push = MemorySinker.push
+        drop = {"left": 1}
+
+        def lossy_push(self, batch):
+            if hasattr(batch, "n_rows") and batch.n_rows > 4 \
+                    and drop["left"]:
+                drop["left"] -= 1
+                return real_push(self, batch.slice(0, batch.n_rows - 4))
+            return real_push(self, batch)
+
+        with runner._fast_retries():
+            ref = runner._snapshot_reference(512)
+            MemorySinker.push = lossy_push
+            try:
+                r = runner.run_snapshot_trial(
+                    0, 7, 512, ref, spec="", device_ok=False)
+            finally:
+                MemorySinker.push = real_push
+        assert not r.passed
+        assert any(v.invariant == "at-least-once"
+                   for v in r.verdict.violations)

@@ -77,3 +77,27 @@ def is_fatal(err: BaseException) -> bool:
             return True
         cur = cur.__cause__ or getattr(cur, "cause", None)
     return False
+
+
+# Programming/schema errors: retrying re-executes the identical code on
+# the identical input — burning the whole backoff schedule to fail with
+# the same traceback.  Walked through the cause chain like is_fatal, so
+# a TableUploadError wrapping a TypeError fails fast too.
+_NON_RETRIABLE_TYPES = (TypeError, AttributeError, NameError, KeyError,
+                        IndexError, AssertionError)
+
+
+def is_retriable(err: BaseException) -> bool:
+    """The single retry predicate: fatal errors (is_fatal semantics) and
+    programming/schema errors anywhere in the cause chain fail fast;
+    everything else gets the backoff schedule."""
+    if is_fatal(err):
+        return False
+    seen = set()
+    cur: Optional[BaseException] = err
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        if isinstance(cur, _NON_RETRIABLE_TYPES):
+            return False
+        cur = cur.__cause__ or getattr(cur, "cause", None)
+    return True

@@ -7,6 +7,9 @@ from transferia_tpu.analysis.rules.device_purity import DevicePurityRule
 from transferia_tpu.analysis.rules.exception_hygiene import (
     ExceptionHygieneRule,
 )
+from transferia_tpu.analysis.rules.failpoint_contract import (
+    FailpointContractRule,
+)
 from transferia_tpu.analysis.rules.lock_discipline import LockDisciplineRule
 from transferia_tpu.analysis.rules.registry_contract import (
     RegistryContractRule,
@@ -19,6 +22,7 @@ ALL_RULE_CLASSES: tuple[type, ...] = (
     ExceptionHygieneRule,
     ResourceSafetyRule,
     RegistryContractRule,
+    FailpointContractRule,
 )
 
 
@@ -32,6 +36,7 @@ __all__ = [
     "DevicePurityRule",
     "LockDisciplineRule",
     "ExceptionHygieneRule",
+    "FailpointContractRule",
     "ResourceSafetyRule",
     "RegistryContractRule",
 ]

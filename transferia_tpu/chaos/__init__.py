@@ -1,0 +1,22 @@
+"""Deterministic fault injection + delivery-invariant verification.
+
+Three planes (keep imports light — production call sites import only
+`failpoints`):
+
+- `failpoints` — named injection sites compiled into the hot path at
+  zero cost when disabled; seeded trigger/action specs via
+  TRANSFERIA_TPU_FAILPOINTS or the programmatic API;
+- `invariants` — the delivery auditor: at-least-once, bounded
+  duplication, checkpoint monotonicity, post-retry fingerprint
+  equality, all over the order-independent row fingerprints
+  (ops/rowhash.py);
+- `runner` — `trtpu chaos`: seeded fault schedules over the built-in
+  snapshot and replication transfers, replayable with --seed.
+
+Site catalog: `chaos/sites.py` (enforced by `trtpu check` rule FPT001).
+"""
+
+from transferia_tpu.chaos import failpoints
+from transferia_tpu.chaos.sites import SITES, site_names
+
+__all__ = ["failpoints", "SITES", "site_names"]

@@ -1,0 +1,314 @@
+"""Delivery-invariant verification for chaos trials.
+
+The engine's contract is at-least-once delivery with checkpointed
+resume; this module turns that sentence into checkable predicates over
+a faulted run:
+
+- **at-least-once**: every row the reference (fault-free) run delivered
+  is present in the faulted run's target;
+- **no inventions**: the faulted target contains no row the reference
+  never produced (retries may duplicate, never fabricate);
+- **post-retry fingerprint equality**: deduplicating the faulted target
+  by row content and reducing with the order-independent table
+  fingerprint (ops/rowhash.py) reproduces the reference digest exactly;
+- **bounded duplication**: no single row is delivered more often than
+  the retry machinery can explain (sink-push retries x part retries x
+  run restarts for snapshots; one redelivery per restart whose resume
+  checkpoint precedes the row for replication);
+- **checkpoint monotonicity**: commit offsets / snapshot progress never
+  move backwards (`MonotonicityTracker`, fed by `AuditingCoordinator`
+  and the broker-commit hook in the runner).
+
+Row identity reuses the fingerprint canonicalization itself
+(`ops/rowhash.row_lanes`): a row's key is its two finalized 32-bit
+lanes — so "same row" here means exactly what the table digest means by
+it, and the dedup-then-reduce check is internally consistent with the
+per-part digests the snapshot engine already publishes.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+import numpy as np
+
+from transferia_tpu.abstract.interfaces import is_columnar
+from transferia_tpu.columnar.batch import ColumnBatch
+from transferia_tpu.coordinator.interface import Coordinator
+from transferia_tpu.ops.rowhash import (
+    FingerprintAggregate,
+    prep_batch,
+    row_lanes,
+)
+
+
+def batch_row_keys(batch: ColumnBatch) -> np.ndarray:
+    """64-bit content keys, one per row, under the fingerprint
+    canonicalization (see ops/rowhash.row_lanes)."""
+    if batch.n_rows == 0:
+        return np.empty(0, dtype=np.uint64)
+    cols, n = prep_batch(batch)
+    r1, r2 = row_lanes(cols, n)
+    return (r1.astype(np.uint64) << np.uint64(32)) | r2.astype(np.uint64)
+
+
+def keys_fingerprint(counter: "Counter[int]") -> FingerprintAggregate:
+    """Order-independent aggregate over a DEDUPLICATED key multiset —
+    by construction equal to `fingerprint_host` over the distinct rows
+    (sum/xor of the finalized lanes is all the reduction does)."""
+    agg = FingerprintAggregate()
+    for key in counter:
+        r1 = (key >> 32) & 0xFFFFFFFF
+        r2 = key & 0xFFFFFFFF
+        agg.merge(FingerprintAggregate(sum1=r1, sum2=r2, xor1=r1,
+                                       xor2=r2, count=1))
+    return agg
+
+
+def _batches_to_counter(batches) -> "Counter[int]":
+    """Key multiset of a batch list (ChangeItem lists pivot first)."""
+    out: Counter = Counter()
+    for b in batches:
+        if not is_columnar(b):
+            rows = [it for it in b if it.is_row_event()]
+            if not rows:
+                continue
+            for run in _homogeneous_runs(rows):
+                b2 = ColumnBatch.from_rows(run)
+                out.update(batch_row_keys(b2).tolist())
+            continue
+        if b.n_rows:
+            out.update(batch_row_keys(b).tolist())
+    return out
+
+
+def _homogeneous_runs(items):
+    runs, key = [], None
+    for it in items:
+        k = (it.table_id, it.table_schema.fingerprint()
+             if it.table_schema is not None else None)
+        if not runs or k != key:
+            runs.append([])
+            key = k
+        runs[-1].append(it)
+    return runs
+
+
+@dataclass
+class DeliveryReference:
+    """What a fault-free run delivered: the ground truth multiset."""
+
+    keys: "Counter[int]"
+    fingerprint: str
+    rows: int
+
+    @classmethod
+    def from_batches(cls, batches) -> "DeliveryReference":
+        keys = _batches_to_counter(batches)
+        return cls(keys=keys,
+                   fingerprint=keys_fingerprint(keys).digest(),
+                   rows=sum(keys.values()))
+
+
+@dataclass
+class Violation:
+    invariant: str
+    detail: str
+
+    def __str__(self) -> str:
+        return f"{self.invariant}: {self.detail}"
+
+
+@dataclass
+class AuditVerdict:
+    passed: bool
+    violations: list[Violation]
+    delivered_rows: int = 0
+    distinct_rows: int = 0
+    duplicate_rows: int = 0
+    max_multiplicity: int = 0
+
+    def summary(self) -> str:
+        head = "PASS" if self.passed else "FAIL"
+        s = (f"{head}: {self.delivered_rows} delivered, "
+             f"{self.distinct_rows} distinct, "
+             f"{self.duplicate_rows} duplicate(s), "
+             f"max multiplicity {self.max_multiplicity}")
+        for v in self.violations:
+            s += f"\n  - {v}"
+        return s
+
+
+def audit_delivery(reference: DeliveryReference, observed_batches,
+                   max_multiplicity: int,
+                   checkpoints: Optional["MonotonicityTracker"] = None,
+                   ) -> AuditVerdict:
+    """Check every delivery invariant of a faulted run against the
+    fault-free reference.  `max_multiplicity` is the retry-machinery
+    bound the caller derives from its run (attempts x retries)."""
+    observed = _batches_to_counter(observed_batches)
+    violations: list[Violation] = []
+
+    missing = {k: n for k, n in reference.keys.items()
+               if observed.get(k, 0) < 1}
+    if missing:
+        violations.append(Violation(
+            "at-least-once",
+            f"{len(missing)} source row(s) never reached the sink"))
+
+    invented = {k: n for k, n in observed.items()
+                if k not in reference.keys}
+    if invented:
+        violations.append(Violation(
+            "no-inventions",
+            f"{len(invented)} sink row(s) match no source row"))
+
+    dupes = {k: n for k, n in observed.items()
+             if n > reference.keys.get(k, 0) and k in reference.keys}
+    worst = max(observed.values(), default=0)
+    # the bound scales with the REFERENCE multiplicity: a source whose
+    # fault-free run legitimately delivers identical content m times may
+    # see m * bound copies under retry, not bound.  Keys absent from the
+    # reference are already reported as inventions above.
+    over = {k: n for k, n in observed.items()
+            if k in reference.keys
+            and n > reference.keys[k] * max_multiplicity}
+    if over:
+        violations.append(Violation(
+            "bounded-duplication",
+            f"{len(over)} row(s) delivered more than the retry bound "
+            f"({max_multiplicity}x reference multiplicity) allows; "
+            f"worst {worst}x"))
+
+    if not missing and not invented:
+        got = keys_fingerprint(observed).digest()
+        if got != reference.fingerprint:
+            violations.append(Violation(
+                "fingerprint-equality",
+                f"deduplicated sink fingerprint {got} != reference "
+                f"{reference.fingerprint}"))
+
+    if checkpoints is not None:
+        for detail in checkpoints.violations:
+            violations.append(Violation("checkpoint-monotonicity",
+                                        detail))
+
+    return AuditVerdict(
+        passed=not violations,
+        violations=violations,
+        delivered_rows=sum(observed.values()),
+        distinct_rows=len(observed),
+        duplicate_rows=sum(n - reference.keys.get(k, 0)
+                           for k, n in dupes.items()),
+        max_multiplicity=worst,
+    )
+
+
+class MonotonicityTracker:
+    """Named watermarks that must never decrease (commit offsets,
+    completed-part counts).  Violations collect instead of raising —
+    the auditor reports them with everything else at trial end."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._marks: dict[str, Any] = {}
+        self.violations: list[str] = []
+
+    def record(self, name: str, value) -> None:
+        with self._lock:
+            prev = self._marks.get(name)
+            if prev is not None and value < prev:
+                self.violations.append(
+                    f"{name} moved backwards: {prev!r} -> {value!r}")
+            else:
+                self._marks[name] = value
+
+    def reset_mark(self, name: str) -> None:
+        """A legitimate epoch reset (e.g. re-activation recreating the
+        part queue) re-bases the watermark."""
+        with self._lock:
+            self._marks.pop(name, None)
+
+
+class AuditingCoordinator(Coordinator):
+    """Transparent coordinator proxy feeding a MonotonicityTracker.
+
+    Watches the two checkpoint-shaped streams the snapshot engine
+    produces: completed-part progress per operation (must only grow
+    within an operation epoch; `create_operation_parts` starts a new
+    epoch) and state-KV write counts.  Everything else forwards as-is.
+    """
+
+    def __init__(self, inner: Coordinator,
+                 tracker: Optional[MonotonicityTracker] = None):
+        self.inner = inner
+        self.tracker = tracker or MonotonicityTracker()
+        self.state_writes = 0
+
+    # -- watched methods ----------------------------------------------------
+    def create_operation_parts(self, operation_id, parts):
+        self.tracker.reset_mark(f"op:{operation_id}:completed_parts")
+        return self.inner.create_operation_parts(operation_id, parts)
+
+    def update_operation_parts(self, operation_id, parts):
+        out = self.inner.update_operation_parts(operation_id, parts)
+        progress = self.inner.operation_progress(operation_id)
+        self.tracker.record(f"op:{operation_id}:completed_parts",
+                            progress.completed_parts)
+        return out
+
+    def set_transfer_state(self, transfer_id, state):
+        self.state_writes += 1
+        return self.inner.set_transfer_state(transfer_id, state)
+
+    def set_operation_state(self, operation_id, state):
+        self.state_writes += 1
+        return self.inner.set_operation_state(operation_id, state)
+
+    # -- plain forwards ------------------------------------------------------
+    def set_status(self, transfer_id, status):
+        return self.inner.set_status(transfer_id, status)
+
+    def get_status(self, transfer_id):
+        return self.inner.get_status(transfer_id)
+
+    def open_status_message(self, transfer_id, category, message):
+        return self.inner.open_status_message(transfer_id, category,
+                                              message)
+
+    def close_status_messages(self, transfer_id, category):
+        return self.inner.close_status_messages(transfer_id, category)
+
+    def get_transfer_state(self, transfer_id):
+        return self.inner.get_transfer_state(transfer_id)
+
+    def remove_transfer_state(self, transfer_id, keys):
+        return self.inner.remove_transfer_state(transfer_id, keys)
+
+    def get_operation_state(self, operation_id):
+        return self.inner.get_operation_state(operation_id)
+
+    def add_operation_parts(self, operation_id, parts):
+        return self.inner.add_operation_parts(operation_id, parts)
+
+    def assign_operation_part(self, operation_id, worker_index):
+        return self.inner.assign_operation_part(operation_id,
+                                                worker_index)
+
+    def clear_assigned_parts(self, operation_id, worker_index):
+        return self.inner.clear_assigned_parts(operation_id,
+                                               worker_index)
+
+    def operation_parts(self, operation_id):
+        return self.inner.operation_parts(operation_id)
+
+    def operation_health(self, operation_id, worker_index, payload=None):
+        return self.inner.operation_health(operation_id, worker_index,
+                                           payload)
+
+    def transfer_health(self, transfer_id, worker_index=0, healthy=True):
+        return self.inner.transfer_health(transfer_id, worker_index,
+                                          healthy)

@@ -1,0 +1,528 @@
+"""Seeded chaos trials over the built-in sample transfers.
+
+`trtpu chaos` (cli/main.py) drives this module: for each trial it arms
+a seed-derived fault schedule across the instrumented sites, runs the
+built-in snapshot (sample -> memory) and/or replication (mq -> memory)
+transfer through the REAL engine paths (SnapshotLoader with part
+retries, run_replication with the restart loop, the full sink
+middleware stack), then audits the target against a fault-free
+reference run with the delivery invariants (chaos/invariants.py).
+
+Everything is derived from `--seed`: the per-trial schedule (which
+sites are armed, their after/every/times triggers, torn-write
+fractions) comes from `random.Random(f"{seed}:{mode}:{trial}")`, and
+the armed failpoints draw from per-site PRNGs seeded the same way — so
+a failing trial replays exactly with its seed.
+
+Trials shrink the retry backoff constants (middlewares Retrier, part
+retry) for the duration of the run: the schedule and the recovery
+machinery are under test, not the production sleep lengths.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import random
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from transferia_tpu.chaos import failpoints
+from transferia_tpu.chaos.invariants import (
+    AuditingCoordinator,
+    AuditVerdict,
+    DeliveryReference,
+    MonotonicityTracker,
+    Violation,
+    audit_delivery,
+)
+from transferia_tpu.coordinator.memory import MemoryCoordinator
+from transferia_tpu.models import Transfer, TransferType
+
+logger = logging.getLogger(__name__)
+
+SNAPSHOT_ROWS = 4096
+REPLICATION_MESSAGES = 300
+TRIAL_TIMEOUT = 60.0
+MAX_SNAPSHOT_RUNS = 6  # outer re-activations after coordinator faults
+
+# sites armed per mode (subset of chaos/sites.py that sits on each
+# trial's actual path; `spec=` on the CLI overrides the whole schedule)
+SNAPSHOT_SITES = (
+    "storage.part.open",
+    "storage.part.read",
+    "transform.chain",
+    "device.dispatch",
+    "sink.push",
+    "sink.push.torn",
+    "coordinator.set_op_state",
+)
+REPLICATION_SITES = (
+    "replication.pump",
+    "parsequeue.parse",
+    "transform.chain",
+    "sink.push",
+    "sink.push.torn",
+)
+
+
+@dataclass
+class TrialResult:
+    mode: str
+    trial: int
+    seed: int
+    spec: str
+    verdict: AuditVerdict
+    fire_counts: dict[str, int] = field(default_factory=dict)
+    fire_log: dict[str, list[int]] = field(default_factory=dict)
+    restarts: int = 0
+    seconds: float = 0.0
+
+    @property
+    def passed(self) -> bool:
+        return self.verdict.passed
+
+    def to_dict(self) -> dict:
+        return {
+            "mode": self.mode, "trial": self.trial, "seed": self.seed,
+            "spec": self.spec, "passed": self.passed,
+            "restarts": self.restarts,
+            "seconds": round(self.seconds, 3),
+            "fire_counts": {k: v for k, v in self.fire_counts.items()
+                            if v},
+            "fire_log": {k: v for k, v in self.fire_log.items() if v},
+            "violations": [str(v) for v in self.verdict.violations],
+            "delivered_rows": self.verdict.delivered_rows,
+            "duplicate_rows": self.verdict.duplicate_rows,
+        }
+
+
+@dataclass
+class ChaosReport:
+    results: list[TrialResult] = field(default_factory=list)
+
+    @property
+    def passed(self) -> bool:
+        return bool(self.results) and all(r.passed for r in self.results)
+
+    def sites_fired(self) -> dict[str, int]:
+        out: dict[str, int] = {}
+        for r in self.results:
+            for site, n in r.fire_counts.items():
+                if n:
+                    out[site] = out.get(site, 0) + n
+        return out
+
+    def to_dict(self) -> dict:
+        return {
+            "passed": self.passed,
+            "trials": len(self.results),
+            "failed_trials": [r.trial for r in self.results
+                              if not r.passed],
+            "sites_fired": self.sites_fired(),
+            "results": [r.to_dict() for r in self.results],
+        }
+
+    def format_summary(self) -> str:
+        lines = []
+        by_mode: dict[str, list[TrialResult]] = {}
+        for r in self.results:
+            by_mode.setdefault(r.mode, []).append(r)
+        for mode, rs in sorted(by_mode.items()):
+            ok = sum(1 for r in rs if r.passed)
+            dup = sum(r.verdict.duplicate_rows for r in rs)
+            restarts = sum(r.restarts for r in rs)
+            lines.append(
+                f"{mode}: {ok}/{len(rs)} trials passed, "
+                f"{restarts} restart(s), {dup} duplicate row(s) "
+                f"absorbed")
+            for r in rs:
+                if not r.passed:
+                    lines.append(f"  trial {r.trial} (seed {r.seed}) "
+                                 f"FAILED [{r.spec}]")
+                    for v in r.verdict.violations:
+                        lines.append(f"    - {v}")
+        fired = self.sites_fired()
+        lines.append(f"sites fired: {len(fired)}")
+        for site, n in sorted(fired.items()):
+            lines.append(f"  {site}: {n}")
+        verdict = "PASS" if self.passed else "FAIL"
+        lines.append(f"chaos verdict: {verdict}")
+        return "\n".join(lines)
+
+
+@contextlib.contextmanager
+def _fast_retries():
+    """Shrink retry sleeps for trial wall time (restored on exit)."""
+    from transferia_tpu.middlewares import sync as sync_mod
+    from transferia_tpu.tasks import snapshot as snapshot_mod
+
+    old_sink = sync_mod.RETRY_BASE_DELAY
+    old_part = snapshot_mod.PART_RETRY_BASE_DELAY
+    sync_mod.RETRY_BASE_DELAY = 0.01
+    snapshot_mod.PART_RETRY_BASE_DELAY = 0.01
+    try:
+        yield
+    finally:
+        sync_mod.RETRY_BASE_DELAY = old_sink
+        snapshot_mod.PART_RETRY_BASE_DELAY = old_part
+
+
+def _device_fusion_available() -> bool:
+    try:
+        from transferia_tpu.transform.fused import device_fusion_enabled
+
+        return device_fusion_enabled()
+    except Exception:
+        return False
+
+
+@contextlib.contextmanager
+def _forced_device_placement():
+    """Route the fused mask+filter chain through the device so the
+    device.dispatch site sits on the trial path; restored on exit."""
+    if not _device_fusion_available():
+        yield False
+        return
+    from transferia_tpu.transform.fused import placement_mode, set_placement
+
+    prev = placement_mode()
+    set_placement("device")
+    try:
+        yield True
+    finally:
+        set_placement(prev)
+
+
+# -- schedules ---------------------------------------------------------------
+
+def default_schedule(mode: str, trial: int, seed: int,
+                     device_ok: bool = True) -> str:
+    """Derive one trial's spec string from the seed.  Count-based
+    triggers only (after/every/times): the fire sequence is then exact
+    per site-hit-index, which is what `--seed` replay promises."""
+    rng = random.Random(f"{seed}:{mode}:{trial}")
+    sites = SNAPSHOT_SITES if mode == "snapshot" else REPLICATION_SITES
+    clauses = []
+    for site in sites:
+        if site == "device.dispatch" and not device_ok:
+            continue
+        if site == "sink.push.torn":
+            frac = rng.choice((0.25, 0.5, 0.75))
+            clauses.append(
+                f"{site}=after:{rng.randrange(0, 3)},times:1,"
+                f"truncate:{frac}")
+            continue
+        # low-traffic sites (a handful of hits per attempt) need small
+        # `after` gates or they never fire; the whole replication
+        # pipeline is low-traffic (a 300-message topic drains in ~one
+        # fetched batch per partition per attempt)
+        low_traffic = mode == "replication" or site in (
+            "coordinator.set_op_state", "storage.part.open")
+        after = rng.randrange(0, 3 if low_traffic else 8)
+        times = 1 if low_traffic else rng.randrange(1, 3)
+        err = rng.choice(("ConnectionError", "TimeoutError",
+                          "ChaosInjectedError"))
+        if site == "transform.chain" and rng.random() < 0.3:
+            clauses.append(f"{site}=after:{after},times:{times},delay:2")
+        else:
+            clauses.append(
+                f"{site}=after:{after},times:{times},raise:{err}")
+    return ";".join(clauses)
+
+
+# -- snapshot mode -----------------------------------------------------------
+
+def _snapshot_transfer(rows: int, sink_id: str) -> Transfer:
+    from transferia_tpu.providers.memory import MemoryTargetParams
+    from transferia_tpu.providers.sample import SampleSourceParams
+
+    t = Transfer(
+        id="chaos-snapshot",
+        type=TransferType.SNAPSHOT_ONLY,
+        src=SampleSourceParams(preset="iot", table="events", rows=rows,
+                               batch_rows=max(64, rows // 8),
+                               shard_parts=4),
+        dst=MemoryTargetParams(sink_id=sink_id),
+        transformation={"transformers": [
+            {"mask_field": {"columns": ["device_id"], "salt": "chaos"}},
+            {"filter_rows": {"filter": "temperature > -1000"}},
+        ]},
+        validation={"fingerprint": True},
+    )
+    # single upload worker: part claim order (and so per-site hit
+    # order) is deterministic, which --seed replay relies on
+    t.runtime.sharding.process_count = 1
+    return t
+
+
+def _run_snapshot_once(transfer, cp) -> None:
+    from transferia_tpu.tasks.snapshot import SnapshotLoader
+
+    SnapshotLoader(transfer, cp).upload_tables()
+
+
+def _snapshot_reference(rows: int) -> DeliveryReference:
+    from transferia_tpu.providers.memory import get_store
+
+    store = get_store("chaos-snap-ref")
+    store.clear()
+    _run_snapshot_once(_snapshot_transfer(rows, "chaos-snap-ref"),
+                       MemoryCoordinator())
+    ref = DeliveryReference.from_batches(store.batches)
+    store.clear()
+    return ref
+
+
+def run_snapshot_trial(trial: int, seed: int, rows: int,
+                       reference: DeliveryReference,
+                       spec: Optional[str] = None,
+                       device_ok: bool = True) -> TrialResult:
+    from transferia_tpu.providers.memory import get_store
+    from transferia_tpu.tasks.snapshot import PART_RETRIES
+
+    sink_id = "chaos-snap-trial"
+    store = get_store(sink_id)
+    store.clear()
+    spec = spec if spec is not None else default_schedule(
+        "snapshot", trial, seed, device_ok)
+    tracker = MonotonicityTracker()
+    cp = AuditingCoordinator(MemoryCoordinator(), tracker)
+    transfer = _snapshot_transfer(rows, sink_id)
+    restarts = 0
+    run_error: Optional[BaseException] = None
+    t0 = time.monotonic()
+    with failpoints.active(spec, seed=seed * 1000 + trial):
+        # the outer re-activation loop an operator/controller provides
+        # in production: coordinator faults kill a whole run, and the
+        # at-least-once contract is exactly that re-running is safe
+        for attempt in range(MAX_SNAPSHOT_RUNS):
+            try:
+                _run_snapshot_once(transfer, cp)
+                run_error = None
+                break
+            except Exception as e:
+                run_error = e
+                restarts += 1
+                logger.info("chaos snapshot run %d failed (%s); "
+                            "re-activating", attempt + 1, e)
+        fires = failpoints.fire_counts()
+        log = failpoints.fire_log()
+    seconds = time.monotonic() - t0
+    from transferia_tpu.middlewares.sync import SINK_PUSH_ATTEMPTS
+
+    # sink Retrier x part retries x completed runs
+    bound = (restarts + 1) * PART_RETRIES * SINK_PUSH_ATTEMPTS
+    verdict = audit_delivery(reference, store.batches, bound, tracker)
+    if run_error is not None:
+        verdict.passed = False
+        verdict.violations.append(Violation(
+            "run-completed",
+            f"snapshot never completed in {MAX_SNAPSHOT_RUNS} runs: "
+            f"{run_error}"))
+    store.clear()
+    return TrialResult(mode="snapshot", trial=trial, seed=seed,
+                       spec=spec, verdict=verdict, fire_counts=fires,
+                       fire_log=log, restarts=restarts, seconds=seconds)
+
+
+# -- replication mode --------------------------------------------------------
+
+_REPL_PARSER = {"json": {
+    "schema": [
+        {"name": "id", "type": "int64", "key": True},
+        {"name": "payload", "type": "utf8"},
+        {"name": "amount", "type": "double"},
+    ],
+    "table": "chaos_events",
+    # no _timestamp/_partition/_offset system columns: row identity must
+    # be pure message content so the reference run (its own broker,
+    # seeded at a different wall-clock) and every trial agree on keys
+    "add_system_cols": False,
+}}
+
+
+def _replication_transfer(broker_id: str, sink_id: str) -> Transfer:
+    from transferia_tpu.providers.memory import MemoryTargetParams
+    from transferia_tpu.providers.mq import MQSourceParams
+
+    return Transfer(
+        id="chaos-replication",
+        type=TransferType.INCREMENT_ONLY,
+        src=MQSourceParams(broker_id=broker_id, topic="chaos-topic",
+                           parser=_REPL_PARSER, n_partitions=2,
+                           parallelism=1),
+        dst=MemoryTargetParams(sink_id=sink_id),
+        transformation={"transformers": [
+            {"mask_field": {"columns": ["payload"], "salt": "chaos"}},
+        ]},
+    )
+
+
+def _seed_broker(broker_id: str, messages: int):
+    import json as _json
+
+    from transferia_tpu.providers.mq import get_broker
+
+    broker = get_broker(broker_id, n_partitions=2)
+    if broker.size("chaos-topic") == 0:
+        for i in range(messages):
+            broker.produce("chaos-topic", str(i).encode(), _json.dumps({
+                "id": i, "payload": f"evt-{i}", "amount": i * 0.5,
+            }).encode(), partition=i % 2)
+    return broker
+
+
+def _run_replication(transfer, cp, store, expected_distinct: int,
+                     timeout: float) -> tuple[int, Optional[BaseException]]:
+    """Run the real retry loop until the target holds every expected
+    row (or timeout); returns (restarts, error)."""
+    from transferia_tpu.chaos.invariants import _batches_to_counter
+    from transferia_tpu.runtime.local import run_replication
+    from transferia_tpu.stats.registry import Metrics
+
+    metrics = Metrics()
+    stop = threading.Event()
+    err: list[BaseException] = []
+
+    def target():
+        try:
+            run_replication(transfer, cp, metrics=metrics,
+                            stop_event=stop, backoff=0.05)
+        except BaseException as e:
+            err.append(e)
+
+    th = threading.Thread(target=target, daemon=True,
+                          name="chaos-replication")
+    th.start()
+    deadline = time.monotonic() + timeout
+    done = False
+    while time.monotonic() < deadline and not err:
+        with store.lock:
+            total = sum(
+                b.n_rows if hasattr(b, "n_rows") else len(b)
+                for b in store.batches)
+        if total >= expected_distinct:
+            if len(_batches_to_counter(store.batches)) >= \
+                    expected_distinct:
+                done = True
+                break
+        time.sleep(0.05)
+    stop.set()
+    th.join(timeout=10)
+    restarts = int(metrics.value("replication_restarts"))
+    if err:
+        return restarts, err[0]
+    if not done:
+        return restarts, TimeoutError(
+            f"target incomplete after {timeout:.0f}s")
+    return restarts, None
+
+
+def _replication_reference(messages: int) -> DeliveryReference:
+    from transferia_tpu.providers.memory import get_store
+
+    _seed_broker("chaos-repl-ref", messages)
+    store = get_store("chaos-repl-ref-store")
+    store.clear()
+    transfer = _replication_transfer("chaos-repl-ref",
+                                     "chaos-repl-ref-store")
+    restarts, err = _run_replication(
+        transfer, MemoryCoordinator(), store, messages, TRIAL_TIMEOUT)
+    if err is not None:
+        raise RuntimeError(
+            f"clean replication reference run failed: {err}") from err
+    ref = DeliveryReference.from_batches(store.batches)
+    store.clear()
+    return ref
+
+
+def run_replication_trial(trial: int, seed: int, messages: int,
+                          reference: DeliveryReference,
+                          spec: Optional[str] = None) -> TrialResult:
+    from transferia_tpu.providers.memory import get_store
+
+    broker_id = f"chaos-repl-{seed}-{trial}"
+    broker = _seed_broker(broker_id, messages)
+    sink_id = "chaos-repl-trial"
+    store = get_store(sink_id)
+    store.clear()
+    spec = spec if spec is not None else default_schedule(
+        "replication", trial, seed)
+    tracker = MonotonicityTracker()
+    orig_commit = broker.commit
+
+    def audited_commit(group, topic, partition, offset):
+        tracker.record(f"commit:{topic}:{partition}", offset)
+        return orig_commit(group, topic, partition, offset)
+
+    broker.commit = audited_commit
+    transfer = _replication_transfer(broker_id, sink_id)
+    t0 = time.monotonic()
+    with failpoints.active(spec, seed=seed * 1000 + trial):
+        restarts, err = _run_replication(
+            transfer, MemoryCoordinator(), store, reference.rows,
+            TRIAL_TIMEOUT)
+        fires = failpoints.fire_counts()
+        log = failpoints.fire_log()
+    seconds = time.monotonic() - t0
+    # resume-from-checkpoint redelivers at most once per attempt
+    bound = restarts + 1
+    verdict = audit_delivery(reference, store.batches, bound, tracker)
+    if err is not None:
+        verdict.passed = False
+        verdict.violations.append(Violation(
+            "run-completed", f"replication trial errored: {err}"))
+    store.clear()
+    return TrialResult(mode="replication", trial=trial, seed=seed,
+                       spec=spec, verdict=verdict, fire_counts=fires,
+                       fire_log=log, restarts=restarts, seconds=seconds)
+
+
+# -- entry point -------------------------------------------------------------
+
+def run_trials(trials: int = 5, seed: int = 7, mode: str = "both",
+               rows: int = SNAPSHOT_ROWS,
+               messages: int = REPLICATION_MESSAGES,
+               spec: Optional[str] = None,
+               metrics=None) -> ChaosReport:
+    """Run N seeded chaos trials per requested mode and audit each."""
+    failpoints.reset()  # trials own the registry for their duration
+    report = ChaosReport()
+    modes = ("snapshot", "replication") if mode == "both" else (mode,)
+    with _fast_retries(), _forced_device_placement() as device_ok:
+        if "snapshot" in modes:
+            ref = _snapshot_reference(rows)
+            for t in range(trials):
+                r = run_snapshot_trial(t, seed, rows, ref, spec=spec,
+                                       device_ok=bool(device_ok))
+                report.results.append(r)
+                logger.info("chaos snapshot trial %d: %s", t,
+                            r.verdict.summary().splitlines()[0])
+        if "replication" in modes:
+            ref = _replication_reference(messages)
+            for t in range(trials):
+                r = run_replication_trial(t, seed, messages, ref,
+                                          spec=spec)
+                report.results.append(r)
+                logger.info("chaos replication trial %d: %s", t,
+                            r.verdict.summary().splitlines()[0])
+    if metrics is not None:
+        _fold_report(report, metrics)
+    return report
+
+
+def _fold_report(report: ChaosReport, metrics) -> None:
+    from transferia_tpu.stats.registry import ChaosStats
+
+    stats = ChaosStats(metrics)
+    stats.trials.inc(len(report.results))
+    for r in report.results:
+        if not r.passed:
+            stats.invariant_failures.inc()
+        stats.duplicates_absorbed.inc(r.verdict.duplicate_rows)
+        stats.restarts.inc(r.restarts)
+    for site, n in report.sites_fired().items():
+        stats.record_site(site, n)

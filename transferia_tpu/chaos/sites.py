@@ -1,0 +1,83 @@
+"""Failpoint site catalog.
+
+Every injection site in the tree is declared HERE, once, with the layer
+it lives in and what failing there simulates.  `failpoints.configure`
+rejects spec strings naming unknown sites, and the FPT001 static rule
+(`trtpu check`) asserts that every `failpoint("...")` call site uses a
+string literal that appears in this catalog and that each site name is
+owned by exactly one call site — so the catalog below is the complete,
+greppable map of where chaos can strike.
+
+Site naming: `<layer>.<component>[.<event>]`, dots only (they map to
+`chaos_fires_<name with _>` counters in the stats registry).
+"""
+
+from __future__ import annotations
+
+# name -> (layer, what a fault here simulates)
+SITES: dict[str, tuple[str, str]] = {
+    "storage.part.open": (
+        "providers/sample.py",
+        "source part handle failing to open (connection refused, "
+        "missing object) before any row is read"),
+    "storage.part.read": (
+        "providers/sample.py",
+        "mid-part read error: the source dies after some batches of a "
+        "part already reached the sink"),
+    "storage.file.open": (
+        "providers/file.py",
+        "parquet footer/open failure on a file part (truncated upload, "
+        "transient FS error)"),
+    "decode.native.rowgroup": (
+        "providers/parquet_native.py",
+        "native C++ row-group decode failing (corrupt page, codec "
+        "error) — exercises the arrow/native fallback seams"),
+    "decode.readahead.worker": (
+        "providers/readahead.py",
+        "prefetch worker dying mid-decode: the error must re-raise on "
+        "the consumer thread, never vanish with the worker"),
+    "transform.chain": (
+        "middlewares/sync.py",
+        "transformer chain blowing up on a batch (bad cast, device "
+        "error surfaced through the fused step)"),
+    "device.dispatch": (
+        "ops/fused.py",
+        "fused mask/filter device launch failing (XLA error, device "
+        "OOM, link reset)"),
+    "device.mesh_dispatch": (
+        "parallel/fusedmesh.py",
+        "multi-chip sharded launch failing on the mesh path"),
+    "sink.push": (
+        "middlewares/sync.py",
+        "sink write failing cleanly: nothing of the batch landed"),
+    "sink.push.torn": (
+        "middlewares/sync.py",
+        "torn write: a PREFIX of the batch lands in the target, then "
+        "the push errors — the retry must tolerate the duplicates"),
+    "coordinator.set_state": (
+        "coordinator/memory.py",
+        "transfer-state checkpoint write failing (coordinator KV "
+        "unavailable) — cursors/positions must not silently regress"),
+    "coordinator.set_op_state": (
+        "coordinator/memory.py",
+        "operation-state write failing mid-snapshot (discovery flags, "
+        "sharded handoff, fingerprint publication)"),
+    "replication.pump": (
+        "providers/queue_common.py",
+        "replication source pump dying between fetch and enqueue — the "
+        "retry loop must resume from the last committed offset"),
+    "parsequeue.parse": (
+        "parsequeue/queue.py",
+        "parse worker failing on a fetched batch: the failure must "
+        "latch and surface on the source thread, offsets uncommitted"),
+    "client.s3.request": (
+        "coordinator/s3client.py",
+        "S3 wire request failing (timeout, 5xx, connection reset)"),
+    "client.kafka.roundtrip": (
+        "providers/kafka/client.py",
+        "kafka broker roundtrip failing (broken socket, leader moved)"),
+}
+
+
+def site_names() -> frozenset:
+    return frozenset(SITES)

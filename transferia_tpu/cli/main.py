@@ -152,6 +152,29 @@ def build_parser() -> argparse.ArgumentParser:
                           "(snapshot transfers run to completion)")
     trc.add_argument("--rows", type=int, default=50_000,
                      help="demo source rows (only without --transfer)")
+    cha = sub.add_parser(
+        "chaos",
+        help="seeded fault-injection trials over the built-in sample "
+             "transfers; audits at-least-once delivery, bounded "
+             "duplication, checkpoint monotonicity and post-retry "
+             "fingerprint equality (chaos/)")
+    cha.add_argument("--trials", type=int, default=5,
+                     help="trials per mode")
+    cha.add_argument("--seed", type=int, default=7,
+                     help="master seed: derives every trial's fault "
+                          "schedule and PRNG draws (replayable)")
+    cha.add_argument("--mode", default="both",
+                     choices=["snapshot", "replication", "both"])
+    cha.add_argument("--rows", type=int, default=0,
+                     help="snapshot source rows (default 4096)")
+    cha.add_argument("--messages", type=int, default=0,
+                     help="replication broker messages (default 300)")
+    cha.add_argument("--spec", default=None,
+                     help="explicit failpoint spec for every trial "
+                          "(overrides the seed-derived schedule; "
+                          "grammar: chaos/failpoints.py)")
+    cha.add_argument("--json", action="store_true", dest="as_json",
+                     help="machine-readable report")
     return p
 
 
@@ -322,6 +345,8 @@ def main(argv=None) -> int:
         from transferia_tpu.analysis.cli import run_check
 
         return run_check(args)
+    if args.command == "chaos":
+        return cmd_chaos(args)
 
     transfer = _load_transfer(args)
     cp = _coordinator(args)
@@ -587,6 +612,39 @@ def cmd_trace(args) -> int:
         print("device telemetry: "
               + json.dumps(trace.TELEMETRY.snapshot()))
     return 0
+
+
+def cmd_chaos(args) -> int:
+    """Seeded chaos trials + delivery-invariant audit (chaos/runner.py).
+
+    Exit 0 when every trial upholds every invariant; 1 otherwise.
+    Embedded soaks fold per-site fire counts into their own registry
+    via runner.run_trials(metrics=...) / failpoints.fold_into; the
+    one-shot CLI just prints the report."""
+    from transferia_tpu.chaos import runner as chaos_runner
+    from transferia_tpu.chaos.failpoints import (
+        FailpointSpecError,
+        parse_spec,
+    )
+
+    if args.spec:
+        try:
+            parse_spec(args.spec)
+        except FailpointSpecError as e:
+            print(f"bad --spec: {e}", file=sys.stderr)
+            return 2
+    kwargs = dict(trials=args.trials, seed=args.seed, mode=args.mode,
+                  spec=args.spec)
+    if args.rows:
+        kwargs["rows"] = args.rows
+    if args.messages:
+        kwargs["messages"] = args.messages
+    report = chaos_runner.run_trials(**kwargs)
+    if args.as_json:
+        print(json.dumps(report.to_dict(), indent=1))
+    else:
+        print(report.format_summary())
+    return 0 if report.passed else 1
 
 
 def cmd_validate(args) -> int:

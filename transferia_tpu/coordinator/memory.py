@@ -11,6 +11,7 @@ import threading
 from typing import Any, Optional
 
 from transferia_tpu.abstract.table import OperationTablePart
+from transferia_tpu.chaos.failpoints import failpoint
 from transferia_tpu.coordinator.interface import Coordinator, TransferStatus
 
 
@@ -47,6 +48,7 @@ class MemoryCoordinator(Coordinator):
     # -- state KV -----------------------------------------------------------
     def set_transfer_state(self, transfer_id: str,
                            state: dict[str, Any]) -> None:
+        failpoint("coordinator.set_state")  # before the lock: may sleep
         with self._lock:
             self._state.setdefault(transfer_id, {}).update(state)
 
@@ -64,6 +66,7 @@ class MemoryCoordinator(Coordinator):
     # -- operation state ----------------------------------------------------
     def set_operation_state(self, operation_id: str,
                             state: dict[str, Any]) -> None:
+        failpoint("coordinator.set_op_state")  # before the lock: may sleep
         with self._lock:
             self._op_state.setdefault(operation_id, {}).update(state)
 

@@ -96,6 +96,9 @@ class S3Client:
                  body: bytes = b"",
                  extra_headers: Optional[dict[str, str]] = None
                  ) -> tuple[int, dict, bytes]:
+        from transferia_tpu.chaos.failpoints import failpoint
+
+        failpoint("client.s3.request")
         path = f"/{self.bucket}"
         if key:
             path += "/" + urllib.parse.quote(key, safe="/-_.~")

@@ -14,9 +14,14 @@ import time
 import weakref
 from typing import Callable, Iterable, Optional, Sequence
 
-from transferia_tpu.abstract.errors import is_fatal
+from transferia_tpu.abstract.errors import is_retriable
 from transferia_tpu.abstract.interfaces import Batch, Sinker, is_columnar
 from transferia_tpu.abstract.schema import TableID
+from transferia_tpu.chaos.failpoints import (
+    TornWriteError,
+    failpoint,
+    torn_rows,
+)
 from transferia_tpu.middlewares.helpers import (
     batch_bytes,
     batch_len,
@@ -27,6 +32,13 @@ from transferia_tpu.stats.registry import SinkerStats
 from transferia_tpu.utils.backoff import retry_with_backoff
 
 logger = logging.getLogger(__name__)
+
+# snapshot-stage sink-push retry knobs (chaos trials shrink the delay
+# so 20-trial runs measure the schedule, not the sleeps; the chaos
+# duplication bound multiplies by the attempt count, so both live here
+# as the single source of truth)
+RETRY_BASE_DELAY = 0.5
+SINK_PUSH_ATTEMPTS = 3
 
 
 class _Wrap(Sinker):
@@ -47,6 +59,10 @@ class Statistician(_Wrap):
         super().__init__(inner)
         self.stats = stats
 
+    @staticmethod
+    def _prefix(batch: Batch, k: int) -> Batch:
+        return batch.slice(0, k) if is_columnar(batch) else batch[:k]
+
     def push(self, batch: Batch) -> None:
         n = batch_len(batch)
         nbytes = batch_bytes(batch)
@@ -57,6 +73,13 @@ class Statistician(_Wrap):
         t0 = time.monotonic()
         try:
             with sp:
+                failpoint("sink.push")
+                torn = torn_rows("sink.push.torn", n)
+                if torn is not None:
+                    # torn write: land a prefix, then fail — the
+                    # at-least-once duplicate generator for chaos runs
+                    self.inner.push(self._prefix(batch, torn))
+                    raise TornWriteError("sink.push.torn", torn, n)
                 self.inner.push(batch)
         except BaseException:
             self.stats.errors.inc()
@@ -121,8 +144,8 @@ class Retrier(_Wrap):
     """Retries non-fatal push errors with exponential backoff
     (middlewares/retrier.go; snapshot-stage only, sink_factory.go:181)."""
 
-    def __init__(self, inner: Sinker, attempts: int = 3,
-                 base_delay: float = 0.5):
+    def __init__(self, inner: Sinker, attempts: int = SINK_PUSH_ATTEMPTS,
+                 base_delay: Optional[float] = None):
         super().__init__(inner)
         self.attempts = attempts
         self.base_delay = base_delay
@@ -131,8 +154,9 @@ class Retrier(_Wrap):
         retry_with_backoff(
             lambda: self.inner.push(batch),
             attempts=self.attempts,
-            base_delay=self.base_delay,
-            retriable=lambda e: not is_fatal(e),
+            base_delay=self.base_delay if self.base_delay is not None
+            else RETRY_BASE_DELAY,
+            retriable=is_retriable,
             on_retry=lambda i, e: logger.warning(
                 "sink push retry %d/%d after error: %s", i, self.attempts, e
             ),
@@ -235,6 +259,7 @@ class Transformation(_Wrap):
         if sp:
             sp.add(rows=batch_len(batch))
         with stagetimer.stage("transform"), sp:
+            failpoint("transform.chain")
             out = self.chain.apply(batch)
         if batch_len(out) or not batch_len(batch):
             self.inner.push(out)

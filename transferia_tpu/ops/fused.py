@@ -150,6 +150,17 @@ class FusedMaskFilterProgram:
     predicate columns as (data, validity) arrays.
     """
 
+    # jitted wrappers shared across instances, keyed by the predicate
+    # AST repr (frozen dataclasses — the repr is the full content).  The
+    # HMAC key states are traced ARGUMENTS, not closure constants, so
+    # every per-part sink chain (the snapshot loader builds one chain
+    # per part) reuses the same compiled program instead of paying an
+    # XLA compile per part.  Bounded FIFO: a long-lived worker cycling
+    # through transfers with distinct predicate constants must not pin
+    # an executable per constant forever.
+    _jit_cache: dict = {}
+    _JIT_CACHE_MAX = 64
+
     def __init__(self, mask_keys: Sequence[bytes], pred_node=None):
         self._states = []
         for key in mask_keys:
@@ -161,6 +172,14 @@ class FusedMaskFilterProgram:
             from transferia_tpu.predicate.device import compile_mask_jnp
 
             self._pred_fn = compile_mask_jnp(pred_node)
+        cache_key = repr(pred_node)
+        cached = FusedMaskFilterProgram._jit_cache.get(cache_key)
+        if cached is not None:
+            self._jit = cached
+            return
+        # bind the closure to THIS instance's pred_fn (an equal AST
+        # compiles to an identical mask fn, so cache sharing is sound)
+        pred_fn = self._pred_fn
 
         def program(blocks_t, nblocks_t, states_t, pred_cols,
                     max_blocks_t):
@@ -174,15 +193,19 @@ class FusedMaskFilterProgram:
                     blocks_t, nblocks_t, states_t, max_blocks_t
                 )
             )
-            if self._pred_fn is not None:
+            if pred_fn is not None:
                 # bucketed batch length is static under this trace; a
                 # fused run always has >= 1 masked column
-                keep = self._pred_fn(pred_cols, blocks_t[0].shape[0])
+                keep = pred_fn(pred_cols, blocks_t[0].shape[0])
             else:
                 keep = jnp.zeros((0,), dtype=jnp.bool_)  # unused sentinel
             return digests, keep
 
         self._jit = jax.jit(program, static_argnums=(4,))
+        cache = FusedMaskFilterProgram._jit_cache
+        while len(cache) >= FusedMaskFilterProgram._JIT_CACHE_MAX:
+            cache.pop(next(iter(cache)))
+        cache[cache_key] = self._jit
 
     def run(self, mask_cols: Sequence[tuple[np.ndarray, np.ndarray]],
             pred_cols: dict[str, tuple[np.ndarray, Optional[np.ndarray]]],
@@ -197,6 +220,9 @@ class FusedMaskFilterProgram:
         (D2H), so H2D / compute / D2H / pack overlap instead of
         serializing per batch.  One chunk size -> one compiled program.
         """
+        from transferia_tpu.chaos.failpoints import failpoint
+
+        failpoint("device.dispatch")
         chunk = _chunk_rows()
         if chunk and n_rows > chunk and not _pallas_pack_enabled():
             return self._run_pipelined(mask_cols, pred_cols, n_rows,

@@ -259,16 +259,27 @@ def _col_lanes_host(col: _PreppedColumn, n_rows: int
     return h1, h2
 
 
-def fingerprint_host(cols: Sequence[_PreppedColumn],
-                     n_rows: int) -> FingerprintAggregate:
-    """Host backend (exact twin of the device program)."""
+def row_lanes(cols: Sequence[_PreppedColumn],
+              n_rows: int) -> tuple[np.ndarray, np.ndarray]:
+    """Finalized per-row lane values (u32 each) — the pre-reduction state
+    of `fingerprint_host`.  `(r1[i] << 32) | r2[i]` is a 64-bit content
+    key for row i under the same canonicalization as the table
+    fingerprint; the chaos delivery auditor (chaos/invariants.py) uses
+    it to count per-row delivery multiplicities where the aggregate
+    alone can only witness set equality."""
     r1 = np.zeros(n_rows, dtype=np.uint32)
     r2 = np.zeros(n_rows, dtype=np.uint32)
     for col in cols:
         h1, h2 = _col_lanes_host(col, n_rows)
         r1 += _mix32_np(h1)
         r2 += _mix32_np(h2)
-    r1, r2 = _mix32_np(r1), _mix32_np(r2)
+    return _mix32_np(r1), _mix32_np(r2)
+
+
+def fingerprint_host(cols: Sequence[_PreppedColumn],
+                     n_rows: int) -> FingerprintAggregate:
+    """Host backend (exact twin of the device program)."""
+    r1, r2 = row_lanes(cols, n_rows)
     return FingerprintAggregate(
         sum1=int(r1.sum(dtype=np.uint64) & 0xFFFFFFFF),
         sum2=int(r2.sum(dtype=np.uint64) & 0xFFFFFFFF),

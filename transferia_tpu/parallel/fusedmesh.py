@@ -171,6 +171,9 @@ class ShardedFusedProgram:
             pred_cols: dict[str, tuple[np.ndarray, Optional[np.ndarray]]],
             n_rows: int) -> tuple[list[np.ndarray], Optional[np.ndarray]]:
         """Same contract as FusedMaskFilterProgram.run()."""
+        from transferia_tpu.chaos.failpoints import failpoint
+
+        failpoint("device.mesh_dispatch")
         # pad the global row count to n_dev * per-device bucket so every
         # shard is equal-sized and the per-device program is shape-stable
         per_dev = bucket_rows(max(1, -(-n_rows // self.n_dev)))

@@ -79,6 +79,9 @@ class ParseQueue(Generic[T]):
     def _safe_parse(self, raw: T):
         # the parser layer runs here (parse workers): decode raw broker
         # messages into batches — the source_decode stage of the timeline
+        from transferia_tpu.chaos.failpoints import failpoint
+
+        failpoint("parsequeue.parse")
         with trace.span("source_decode"):
             return self.parse_fn(raw)
 

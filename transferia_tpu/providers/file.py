@@ -407,8 +407,10 @@ class FileStorage(Storage, ShardingStorage, ScanPredicateStorage):
                          schema: TableSchema, pusher: Pusher) -> None:
         import pyarrow.parquet as pq
 
+        from transferia_tpu.chaos.failpoints import failpoint
         from transferia_tpu.stats import stagetimer
 
+        failpoint("storage.file.open")
         pf = pq.ParquetFile(path)
         groups = self._prune_row_groups(pf, list(range(lo, hi)), tid)
         if not groups:

@@ -190,6 +190,9 @@ class KafkaClient:
 
     def _roundtrip(self, api_key: int, api_version: int, body: bytes,
                    node="boot") -> Reader:
+        from transferia_tpu.chaos.failpoints import failpoint
+
+        failpoint("client.kafka.roundtrip")  # before the lock: may sleep
         with self._lock:
             sock = self._conn_for(node)
             self._corr += 1

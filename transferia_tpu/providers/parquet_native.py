@@ -348,6 +348,9 @@ class NativeParquetReader:
         Columns outside the native envelope (unsupported codec/encoding/
         type, nested, >2GiB flat) are filled through an arrow read of just
         those columns — the result is always complete."""
+        from transferia_tpu.chaos.failpoints import failpoint
+
+        failpoint("decode.native.rowgroup")
         template, specs, static_fb = self._rg_tasks(g)
         tasks = template.copy()
         holds: list[tuple] = []

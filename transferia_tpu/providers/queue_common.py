@@ -16,6 +16,7 @@ from dataclasses import dataclass
 from typing import Callable, Optional, Sequence
 
 from transferia_tpu.abstract.interfaces import AsyncSink, Source
+from transferia_tpu.chaos.failpoints import failpoint
 from transferia_tpu.parsequeue import ParseQueue
 from transferia_tpu.parsers import Message, Parser, make_parser
 from transferia_tpu.stats.registry import Metrics, SourceStats
@@ -118,6 +119,7 @@ class QueueSource(Source):
                     self._stop.wait(self.stop_poll)
                     continue
                 for fb in fetched:
+                    failpoint("replication.pump")
                     self.stats.changeitems.inc(len(fb.messages))
                     self.stats.read_bytes.inc(
                         sum(len(m.value) for m in fb.messages)

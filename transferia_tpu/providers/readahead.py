@@ -133,6 +133,7 @@ class RowGroupReadahead:
                 and self._inflight_bytes >= self._max_bytes)
 
     def _run(self) -> None:
+        from transferia_tpu.chaos.failpoints import failpoint
         from transferia_tpu.stats import trace
 
         try:
@@ -142,6 +143,7 @@ class RowGroupReadahead:
                         self._cond.wait()
                     if self._closed:
                         return
+                failpoint("decode.readahead.worker")
                 sp = trace.span("decode_readahead")
                 if sp:
                     sp.add(group=g)

@@ -201,6 +201,9 @@ class SampleStorage(Storage, ShardingStorage):
         return out
 
     def load_table(self, table: TableDescription, pusher: Pusher) -> None:
+        from transferia_tpu.chaos.failpoints import failpoint
+
+        failpoint("storage.part.open")
         if table.filter.startswith("rows:"):
             _, lo_s, hi_s = table.filter.split(":")
             lo, hi = int(lo_s), int(hi_s)
@@ -211,6 +214,7 @@ class SampleStorage(Storage, ShardingStorage):
         bs = self.params.batch_rows
         for start in range(lo, hi, bs):
             n = min(bs, hi - start)
+            failpoint("storage.part.read")
             sp = trace.span("source_decode")
             if sp:
                 sp.add(rows=n)

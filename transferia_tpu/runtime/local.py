@@ -85,6 +85,11 @@ class PartitionedWorker:
         self._pipelines: list = []  # (source, sink)
         self._stopped = threading.Event()
         self._plock = threading.Lock()  # guards pipelines vs stop()
+        # every pump-thread failure (run, latched sink, close) lands
+        # here; run() re-raises the first one.  Readable after stop()
+        # so callers never lose an error to a daemon thread's death.
+        self.failures: list[BaseException] = []
+        self._err_lock = threading.Lock()
 
     def _kafka_params(self):
         src = self.transfer.src
@@ -105,8 +110,6 @@ class PartitionedWorker:
             raise RuntimeError(f"topic {params.topic!r} has no partitions")
         logger.info("partitioned replication: %d pipelines (%s)",
                     len(partitions), partitions)
-        errors: list[BaseException] = []
-        err_lock = threading.Lock()
         threads = []
         for p in partitions:
             if self._stopped.is_set():
@@ -132,13 +135,17 @@ class PartitionedWorker:
                     if isinstance(snk, ErrorTracker) and snk.failure:
                         raise snk.failure
                 except BaseException as e:
-                    with err_lock:
-                        errors.append(e)
-                    logger.warning("partition %d pipeline failed: %s",
-                                   part, e)
+                    self._record_failure(part, e)
                     self.stop()  # one failure restarts the attempt
                 finally:
-                    snk.close()
+                    # a close() error (flush of buffered rows, broken
+                    # connection teardown) must surface on run() like
+                    # any pump error — not die with the daemon thread
+                    try:
+                        snk.close()
+                    except BaseException as e:
+                        self._record_failure(part, e)
+                        self.stop()
 
             t = threading.Thread(target=pump, daemon=True,
                                  name=f"partition-{p}")
@@ -146,8 +153,19 @@ class PartitionedWorker:
             t.start()
         for t in threads:
             t.join()
-        if errors:
-            raise errors[0]
+        if self.failures:
+            raise self.failures[0]
+
+    def _record_failure(self, partition: int, err: BaseException) -> None:
+        with self._err_lock:
+            self.failures.append(err)
+        logger.warning("partition %d pipeline failed: %s", partition, err)
+
+    @property
+    def failure(self) -> Optional[BaseException]:
+        """First pump failure, if any (readable after stop())."""
+        with self._err_lock:
+            return self.failures[0] if self.failures else None
 
     def stop(self) -> None:
         self._stopped.set()

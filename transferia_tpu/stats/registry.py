@@ -188,6 +188,35 @@ class DeviceStats(_Bundle):
             "decode_readahead_inflight_bytes")
 
 
+class ChaosStats(_Bundle):
+    """Fault-injection counters (chaos/).  Per-site fire counts land as
+    `chaos_fires_<site with dots -> underscores>` so a chaos soak's
+    injection activity is visible on the same /metrics surface as the
+    delivery counters it perturbs."""
+
+    def __init__(self, metrics: Optional[Metrics] = None):
+        super().__init__(metrics)
+        self.fires = self.m.counter("chaos_fires")
+        self.trials = self.m.counter("chaos_trials")
+        self.invariant_failures = self.m.counter(
+            "chaos_invariant_failures")
+        self.duplicates_absorbed = self.m.counter(
+            "chaos_duplicates_absorbed")
+        self.restarts = self.m.counter("chaos_restarts")
+
+    @staticmethod
+    def site_counter_name(site: str) -> str:
+        """chaos/failpoints.fold_into shares this naming — keep single."""
+        return "chaos_fires_" + site.replace(".", "_")
+
+    def record_site(self, site: str, fires: int) -> None:
+        if fires <= 0:
+            return
+        self.m.counter(self.site_counter_name(site),
+                       f"chaos fires at {site}").inc(fires)
+        self.fires.inc(fires)
+
+
 class TableStats(_Bundle):
     """Per-table progress gauges (pkg/stats/table.go)."""
 

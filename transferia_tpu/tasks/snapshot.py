@@ -30,7 +30,7 @@ from transferia_tpu.abstract.errors import (
     CodedError,
     Codes,
     TableUploadError,
-    is_fatal,
+    is_retriable,
 )
 from transferia_tpu.abstract.interfaces import (
     AsyncPartDiscovery,
@@ -54,6 +54,9 @@ from transferia_tpu.utils.backoff import retry_with_backoff
 logger = logging.getLogger(__name__)
 
 PART_RETRIES = 3  # load_snapshot.go:1070-1086
+# per-part retry backoff base (chaos trials shrink this: the retry
+# schedule is under test there, not the sleep lengths)
+PART_RETRY_BASE_DELAY = 1.0
 
 
 class SnapshotLoader:
@@ -471,11 +474,15 @@ class SnapshotLoader:
         def attempt():
             self._upload_part(storage, part, schemas)
 
+        # abstract/errors.is_retriable: fatal AND programming/schema
+        # errors anywhere in the cause chain fail the part immediately
+        # instead of burning the full backoff schedule on a guaranteed
+        # re-failure (the TableUploadError wrapper preserves the chain)
         retry_with_backoff(
             attempt,
             attempts=PART_RETRIES,
-            base_delay=1.0,
-            retriable=lambda e: not is_fatal(e),
+            base_delay=PART_RETRY_BASE_DELAY,
+            retriable=is_retriable,
             on_retry=lambda i, e: logger.warning(
                 "part %s retry %d/%d: %s", part.key(), i, PART_RETRIES, e
             ),

@@ -1,7 +1,20 @@
-"""Exponential backoff retry (pkg/util backoff helpers)."""
+"""Exponential backoff retry (pkg/util backoff helpers).
+
+Full jitter by default (AWS architecture-blog style): the i-th wait is
+uniform(0, min(max_delay, base * 2^(i-1))) instead of the deterministic
+cap itself.  A pure-exponential schedule synchronizes retry storms —
+N upload workers knocked over by the same sink hiccup all come back on
+the same tick and knock it over again; jitter de-correlates them.
+
+`stop_event` makes backoff shutdown-aware: the wait runs on
+`Event.wait`, so a stop request interrupts the sleep immediately and
+the last error re-raises instead of blocking shutdown mid-schedule.
+"""
 
 from __future__ import annotations
 
+import random
+import threading
 import time
 from typing import Callable, Optional, TypeVar
 
@@ -15,13 +28,19 @@ def retry_with_backoff(
     max_delay: float = 30.0,
     retriable: Callable[[BaseException], bool] = lambda e: True,
     on_retry: Optional[Callable[[int, BaseException], None]] = None,
+    jitter: bool = True,
+    stop_event: Optional[threading.Event] = None,
+    rng: Optional[random.Random] = None,
 ) -> T:
     """Run fn with up to `attempts` tries; exponential backoff between tries.
 
-    Re-raises the last error when attempts are exhausted or when `retriable`
-    returns False (e.g. fatal errors, abstract.IsFatal semantics).
+    Re-raises the last error when attempts are exhausted, when `retriable`
+    returns False (e.g. fatal errors, abstract.is_retriable semantics), or
+    when `stop_event` is set (shutdown must not block in a backoff sleep).
+    `jitter=False` restores the deterministic schedule; `rng` pins the
+    jitter draw for tests.
     """
-    delay = base_delay
+    cap = base_delay
     last: Optional[BaseException] = None
     for i in range(1, attempts + 1):
         try:
@@ -32,8 +51,18 @@ def retry_with_backoff(
             last = e
             if i >= attempts or not retriable(e):
                 raise
+            if stop_event is not None and stop_event.is_set():
+                raise
             if on_retry:
                 on_retry(i, e)
-            time.sleep(min(delay, max_delay))
-            delay *= 2
+            delay = min(cap, max_delay)
+            if jitter:
+                delay = (rng.uniform if rng else random.uniform)(
+                    0.0, delay)
+            if stop_event is not None:
+                if stop_event.wait(delay):
+                    raise  # stop requested mid-backoff: abort the retry
+            else:
+                time.sleep(delay)
+            cap *= 2
     raise last  # pragma: no cover - unreachable
