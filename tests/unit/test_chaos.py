@@ -258,6 +258,34 @@ class TestInvariants:
         assert not v.passed
         assert any(x.invariant == "no-inventions" for x in v.violations)
 
+    def test_fencing_violations(self):
+        ok = [("op/t/0", 1, 1), ("op/t/1", 2, 2), ("op/t/0", 1, 1)]
+        assert inv.fencing_violations(ok) == []
+        double = [("op/t/0", 1, 1), ("op/t/0", 2, 2)]
+        out = inv.fencing_violations(double)
+        assert len(out) == 1
+        assert out[0].invariant == "epoch-fencing"
+        assert "op/t/0" in str(out[0])
+
+    def test_auditing_coordinator_records_completions_and_fences(self):
+        from transferia_tpu.abstract.table import OperationTablePart
+
+        cp = inv.AuditingCoordinator(MemoryCoordinator(lease_seconds=30))
+        parts = [OperationTablePart(
+            operation_id="op", table_id=TableID("a", "b"),
+            part_index=i, parts_count=2) for i in range(2)]
+        cp.create_operation_parts("op", parts)
+        got = cp.assign_operation_part("op", 0)
+        got.completed = True
+        assert cp.update_operation_parts("op", [got]) == []
+        assert cp.completions == [(got.key(), 1, 0)]
+        stale = OperationTablePart.from_json(got.to_json())
+        stale.assignment_epoch = 0  # a dead epoch
+        assert cp.update_operation_parts("op", [stale]) == [stale.key()]
+        assert cp.fence_rejections == 1
+        assert len(cp.completions) == 1  # rejected != accepted
+        assert inv.fencing_violations(cp.completions) == []
+
     def test_monotonicity_tracker(self):
         tr = inv.MonotonicityTracker()
         tr.record("commit:t:0", 5)
@@ -505,6 +533,44 @@ class TestEndToEndTrials:
         assert r.passed, r.verdict.summary()
         assert sum(1 for n in r.fire_counts.values() if n) >= 1
         assert r.verdict.delivered_rows >= 80
+
+    def test_worker_crash_trial_kills_steals_and_fences(self):
+        from transferia_tpu.chaos import runner
+
+        with runner._fast_retries():
+            ref = runner._snapshot_reference(512)
+            r = runner.run_worker_crash_trial(0, 7, 512, ref)
+        assert r.passed, r.verdict.summary()
+        assert r.kills == 1
+        assert len(r.steal_log) == 1
+        key, dead_worker, epoch = r.steal_log[0]
+        assert dead_worker == 1 and epoch == 2
+        assert r.fence_rejected == 1
+        assert r.fire_counts["snapshot.part.batch"] == 1
+
+    def test_worker_crash_fire_and_steal_logs_replay_with_seed(self):
+        """The acceptance bar: same seed -> identical fire sequence AND
+        identical reclaim (steal) sequence; a different seed diverges."""
+        from transferia_tpu.chaos import runner
+
+        with runner._fast_retries():
+            ref = runner._snapshot_reference(512)
+            a = runner.run_worker_crash_trial(2, 7, 512, ref)
+            b = runner.run_worker_crash_trial(2, 7, 512, ref)
+            c = runner.run_worker_crash_trial(2, 11, 512, ref)
+        assert a.passed and b.passed and c.passed
+        assert a.spec == b.spec
+        assert a.fire_log == b.fire_log
+        assert a.steal_log == b.steal_log
+        assert (c.spec, c.steal_log) != (a.spec, a.steal_log) or \
+            c.fire_log != a.fire_log
+
+    def test_worker_kill_action_registered(self):
+        fps = fp.parse_spec(
+            "snapshot.part.batch=times:1,raise:WorkerKilledError")
+        from transferia_tpu.abstract.errors import WorkerKilledError
+
+        assert fps["snapshot.part.batch"].arg is WorkerKilledError
 
     def test_trial_detects_genuinely_lost_rows(self):
         """False-positive guard for the whole harness: a sink that

@@ -1,6 +1,9 @@
-"""Coordinator implementations: memory + filestore + s3 parity."""
+"""Coordinator implementations: memory + filestore + s3 parity,
+including the lease plane (expiry, reclamation, renewal, epoch
+fencing) every backend must implement identically."""
 
 import threading
+import time
 
 import pytest
 
@@ -89,6 +92,151 @@ class TestCoordinator:
         assert prog.completed_parts == 1
         assert prog.completed_rows == 99
         assert not prog.done
+
+    def test_assign_stamps_lease_and_epoch(self, cp):
+        cp.lease_seconds = 30.0
+        cp.create_operation_parts("op1", make_parts(n=1))
+        p = cp.assign_operation_part("op1", 3)
+        assert p.assignment_epoch == 1
+        assert p.lease_expires_at > time.time()
+        assert p.stolen_from is None
+        # durable: the stored copy carries the same lease
+        stored = cp.operation_parts("op1")[0]
+        assert stored.assignment_epoch == 1
+        assert stored.lease_expires_at == pytest.approx(
+            p.lease_expires_at)
+
+    def test_live_lease_not_stealable(self, cp):
+        cp.lease_seconds = 30.0
+        cp.create_operation_parts("op1", make_parts(n=1))
+        assert cp.assign_operation_part("op1", 1) is not None
+        assert cp.assign_operation_part("op1", 2) is None
+
+    def test_expired_lease_reclaimed_with_epoch_bump(self, cp):
+        cp.lease_seconds = 0.15
+        cp.create_operation_parts("op1", make_parts(n=1))
+        first = cp.assign_operation_part("op1", 1)
+        time.sleep(0.3)
+        stolen = cp.assign_operation_part("op1", 2)
+        assert stolen is not None
+        assert stolen.part_index == first.part_index
+        assert stolen.worker_index == 2
+        assert stolen.stolen_from == 1
+        assert stolen.assignment_epoch == first.assignment_epoch + 1
+
+    def test_renew_extends_lease(self, cp):
+        # generous margins (TTL >> renew period): loaded CI runners must
+        # not turn a scheduler pause into a spurious lease expiry
+        cp.lease_seconds = 0.6
+        cp.create_operation_parts("op1", make_parts(n=1))
+        assert cp.assign_operation_part("op1", 1) is not None
+        # keep renewing past the original TTL: no steal possible
+        for _ in range(4):
+            time.sleep(0.2)
+            assert cp.renew_lease("op1", 1) == 1
+            assert cp.assign_operation_part("op1", 2) is None
+        # stop renewing: the part becomes reclaimable
+        time.sleep(0.7)
+        assert cp.assign_operation_part("op1", 2) is not None
+        # the old holder has nothing left to renew
+        assert cp.renew_lease("op1", 1) == 0
+
+    def test_renew_skips_completed_parts(self, cp):
+        cp.lease_seconds = 30.0
+        cp.create_operation_parts("op1", make_parts(n=2))
+        a = cp.assign_operation_part("op1", 1)
+        b = cp.assign_operation_part("op1", 1)
+        a.completed = True
+        assert cp.update_operation_parts("op1", [a]) == []
+        assert cp.renew_lease("op1", 1) == 1  # only b's lease
+        assert b is not None
+
+    def test_stale_epoch_update_fenced(self, cp):
+        cp.lease_seconds = 0.15
+        cp.create_operation_parts("op1", make_parts(n=1))
+        zombie = cp.assign_operation_part("op1", 1)
+        time.sleep(0.3)
+        stolen = cp.assign_operation_part("op1", 2)
+        assert stolen is not None
+        # the zombie wakes and claims completion with its dead epoch
+        zombie.completed = True
+        zombie.completed_rows = 999
+        rejected = cp.update_operation_parts("op1", [zombie])
+        assert rejected == [zombie.key()]
+        stored = cp.operation_parts("op1")[0]
+        assert not stored.completed
+        assert stored.worker_index == 2
+        assert cp.operation_progress("op1").completed_parts == 0
+        # the live owner's completion lands
+        stolen.completed = True
+        stolen.completed_rows = 10
+        assert cp.update_operation_parts("op1", [stolen]) == []
+        assert cp.operation_progress("op1").done
+
+    def test_disabled_leasing_clears_stale_deadline(self, cp):
+        # a queue stamped by a leased run, then reassigned with leasing
+        # disabled: the stale deadline must be cleared, or every assign
+        # would re-steal the part and fence the real owner forever
+        cp.lease_seconds = 0.15
+        cp.create_operation_parts("op1", make_parts(n=1))
+        assert cp.assign_operation_part("op1", 1) is not None
+        time.sleep(0.3)  # stamp is now expired
+        cp.lease_seconds = 0.0
+        owner = cp.assign_operation_part("op1", 2)
+        assert owner is not None
+        assert owner.lease_expires_at == 0.0  # permanent claim
+        assert cp.assign_operation_part("op1", 3) is None  # no re-steal
+        owner.completed = True
+        assert cp.update_operation_parts("op1", [owner]) == []
+        assert cp.operation_progress("op1").done
+
+    def test_clear_assigned_resets_lease(self, cp):
+        cp.lease_seconds = 30.0
+        cp.create_operation_parts("op1", make_parts(n=1))
+        assert cp.assign_operation_part("op1", 1) is not None
+        assert cp.clear_assigned_parts("op1", 1) == 1
+        stored = cp.operation_parts("op1")[0]
+        assert stored.worker_index is None
+        assert stored.lease_expires_at == 0.0
+        # reassignment after a clean release is NOT a steal
+        again = cp.assign_operation_part("op1", 2)
+        assert again.stolen_from is None
+        assert again.assignment_epoch == 2
+
+    def test_concurrent_steal_single_winner(self, cp, request):
+        if "s3-lww" in request.node.name:
+            pytest.skip("last-writer-wins endpoints may double-claim "
+                        "(reference semantics)")
+        cp.lease_seconds = 0.15
+        cp.create_operation_parts("op1", make_parts(n=1))
+        assert cp.assign_operation_part("op1", 0) is not None
+        time.sleep(0.3)
+        got = []
+        lock = threading.Lock()
+
+        def steal(widx):
+            p = cp.assign_operation_part("op1", widx)
+            if p is not None:
+                with lock:
+                    got.append((widx, p.assignment_epoch))
+
+        threads = [threading.Thread(target=steal, args=(i,))
+                   for i in range(1, 5)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(got) == 1  # exactly one thief wins the expired lease
+        assert got[0][1] == 2
+
+    def test_operation_health_latest_per_worker(self, cp):
+        cp.operation_health("op1", 0, {"phase": "uploading"})
+        cp.operation_health("op1", 0, {"phase": "waiting"})
+        cp.operation_health("op1", 1, {"phase": "uploading"})
+        health = cp.get_operation_health("op1")
+        assert set(health) == {0, 1}
+        assert health[0]["payload"]["phase"] == "waiting"
+        assert health[0]["ts"] <= time.time()
 
     def test_concurrent_assignment_no_duplicates(self, cp, request):
         if "s3-lww" in request.node.name:

@@ -1,0 +1,313 @@
+"""Snapshot-engine worker-liveness plane: lease heartbeats, expired-
+lease reclamation through the real upload loop, epoch-fence handling,
+and the lease-aware main join (tasks/snapshot.py)."""
+
+import threading
+import time
+
+import pytest
+
+from transferia_tpu.abstract.errors import (
+    CodedError,
+    Codes,
+    TableUploadError,
+    WorkerKilledError,
+    is_retriable,
+    is_worker_kill,
+)
+from transferia_tpu.abstract.schema import TableID
+from transferia_tpu.chaos import failpoints
+from transferia_tpu.coordinator import MemoryCoordinator
+from transferia_tpu.models import Transfer, TransferType
+from transferia_tpu.models.transfer import Runtime, ShardingUploadParams
+from transferia_tpu.providers.memory import MemoryTargetParams, get_store
+from transferia_tpu.providers.sample import SampleSourceParams
+from transferia_tpu.tasks import snapshot as snapshot_mod
+from transferia_tpu.tasks.snapshot import SnapshotLoader, SnapshotTuning
+from transferia_tpu.tasks.table_splitter import split_tables
+
+
+def make_transfer(tid="lease-t", rows=200, shard_parts=2,
+                  current_job=0, job_count=2, sink_id="lease_sink"):
+    return Transfer(
+        id=tid,
+        type=TransferType.SNAPSHOT_ONLY,
+        src=SampleSourceParams(preset="users", table="users", rows=rows,
+                               batch_rows=64, shard_parts=shard_parts),
+        dst=MemoryTargetParams(sink_id=sink_id),
+        runtime=Runtime(
+            current_job=current_job,
+            sharding=ShardingUploadParams(job_count=job_count,
+                                          process_count=1),
+        ),
+    )
+
+
+@pytest.fixture
+def fast_tuning(monkeypatch):
+    monkeypatch.setattr(snapshot_mod, "TUNING", SnapshotTuning(
+        secondary_bootstrap_timeout=5.0,
+        wait_poll=0.02,
+        wait_timeout=20.0,
+        stall_timeout=0.3,
+        heartbeat_interval=0.02,
+    ))
+
+
+def publish_parts(cp, transfer, op_id):
+    """The main's control-plane role, without its upload loop."""
+    from transferia_tpu.factories import new_storage
+
+    storage = new_storage(transfer)
+    try:
+        tables = SnapshotLoader(transfer, cp,
+                                operation_id=op_id).filtered_table_list(
+                                    storage)
+        parts = split_tables(storage, tables, transfer, op_id)
+    finally:
+        storage.close()
+    cp.create_operation_parts(op_id, parts)
+    cp.set_operation_state(op_id, {"parts_discovery_done": True})
+    return parts
+
+
+# -- tuning knobs ------------------------------------------------------------
+
+def test_tuning_env_overrides():
+    t = SnapshotTuning.from_env({
+        "TRANSFERIA_TPU_SNAPSHOT_BOOTSTRAP_TIMEOUT": "12.5",
+        "TRANSFERIA_TPU_SNAPSHOT_WAIT_POLL": "0.1",
+        "TRANSFERIA_TPU_SNAPSHOT_WAIT_TIMEOUT": "60",
+        "TRANSFERIA_TPU_SNAPSHOT_STALL_TIMEOUT": "30",
+        "TRANSFERIA_TPU_HEARTBEAT_INTERVAL": "2",
+    })
+    assert t.secondary_bootstrap_timeout == 12.5
+    assert t.wait_poll == 0.1
+    assert t.wait_timeout == 60.0
+    assert t.stall_timeout == 30.0
+    assert t.heartbeat_interval == 2.0
+    bad = SnapshotTuning.from_env(
+        {"TRANSFERIA_TPU_SNAPSHOT_WAIT_POLL": "nope"})
+    assert bad.wait_poll == 0.5  # defaults survive garbage
+
+
+def test_worker_killed_error_not_retriable():
+    assert not is_retriable(WorkerKilledError("kill"))
+    wrapped = TableUploadError("part x failed",
+                               cause=WorkerKilledError("kill"))
+    assert not is_retriable(wrapped)
+    assert is_worker_kill(wrapped)
+    assert not is_worker_kill(ConnectionError("net"))
+
+
+# -- heartbeat ---------------------------------------------------------------
+
+def test_heartbeat_renews_and_reports(fast_tuning):
+    cp = MemoryCoordinator(lease_seconds=30.0)
+    t = make_transfer(current_job=1)
+    loader = SnapshotLoader(t, cp, operation_id="op-hb")
+    cp.create_operation_parts("op-hb", publish_parts_stub())
+    assert cp.assign_operation_part("op-hb", 1) is not None
+    stop = threading.Event()
+    th = threading.Thread(target=loader._heartbeat_loop, args=(stop,),
+                          daemon=True)
+    th.start()
+    deadline = time.monotonic() + 2.0
+    while time.monotonic() < deadline and \
+            loader.metrics.value("lease_renewals") < 2:
+        time.sleep(0.01)
+    stop.set()
+    th.join(timeout=2.0)
+    assert loader.metrics.value("lease_renewals") >= 2
+    health = cp.get_operation_health("op-hb")
+    assert 1 in health
+    assert "phase" in health[1]["payload"]
+
+
+def publish_parts_stub(n=2, op="op-hb"):
+    from transferia_tpu.abstract.table import OperationTablePart
+
+    return [OperationTablePart(operation_id=op,
+                               table_id=TableID("s", "t"),
+                               part_index=i, parts_count=n, eta_rows=1)
+            for i in range(n)]
+
+
+def test_heartbeat_tolerates_transient_renew_failures(fast_tuning):
+    cp = MemoryCoordinator(lease_seconds=30.0)
+    loader = SnapshotLoader(make_transfer(current_job=1), cp,
+                            operation_id="op-hb2")
+    stop = threading.Event()
+    with failpoints.active("snapshot.lease_renew=every:2"):
+        th = threading.Thread(target=loader._heartbeat_loop,
+                              args=(stop,), daemon=True)
+        th.start()
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline and \
+                loader.metrics.value("lease_heartbeat_failures") < 2:
+            time.sleep(0.01)
+        assert th.is_alive()  # transient failures never kill the beat
+        stop.set()
+        th.join(timeout=2.0)
+    assert loader.metrics.value("lease_heartbeat_failures") >= 2
+
+
+def test_heartbeat_dies_on_worker_kill(fast_tuning):
+    cp = MemoryCoordinator(lease_seconds=30.0)
+    loader = SnapshotLoader(make_transfer(current_job=1), cp,
+                            operation_id="op-hb3")
+    stop = threading.Event()
+    spec = "snapshot.lease_renew=times:1,raise:WorkerKilledError"
+    with failpoints.active(spec):
+        th = threading.Thread(target=loader._heartbeat_loop,
+                              args=(stop,), daemon=True)
+        th.start()
+        th.join(timeout=2.0)  # exits on its own: the worker is a zombie
+        assert not th.is_alive()
+
+
+# -- reclamation through the real upload loop --------------------------------
+
+def test_secondary_steals_dead_workers_parts(fast_tuning):
+    store = get_store("lease_steal_sink")
+    store.clear()
+    cp = MemoryCoordinator(lease_seconds=0.1)
+    op_id = "op-steal"
+    t_main = make_transfer(rows=200, shard_parts=2, current_job=0,
+                           sink_id="lease_steal_sink")
+    parts = publish_parts(cp, t_main, op_id)
+    assert len(parts) == 2
+    # a worker that died mid-operation: parts leased, never renewed
+    assert cp.assign_operation_part(op_id, 9) is not None
+    assert cp.assign_operation_part(op_id, 9) is not None
+
+    t_sec = make_transfer(rows=200, shard_parts=2, current_job=1,
+                          sink_id="lease_steal_sink")
+    loader = SnapshotLoader(t_sec, cp, operation_id=op_id)
+    loader.upload_tables()  # lingers on the live leases, then reclaims
+
+    final = cp.operation_parts(op_id)
+    assert all(p.completed for p in final)
+    assert all(p.worker_index == 1 for p in final)
+    assert all(p.stolen_from == 9 for p in final)
+    assert all(p.assignment_epoch == 2 for p in final)
+    assert loader.metrics.value("lease_steals") == 2
+    assert store.row_count(TableID("sample", "users")) == 200
+    # the main's join sees a drained queue instantly
+    SnapshotLoader(t_main, cp, operation_id=op_id)._wait_all_parts_done()
+
+
+def test_zombie_completion_fenced_after_steal(fast_tuning):
+    store = get_store("lease_fence_sink")
+    store.clear()
+    cp = MemoryCoordinator(lease_seconds=0.1)
+    op_id = "op-fence"
+    t_main = make_transfer(rows=100, shard_parts=1, current_job=0,
+                           sink_id="lease_fence_sink")
+    publish_parts(cp, t_main, op_id)
+    zombie_part = cp.assign_operation_part(op_id, 9)
+    assert zombie_part is not None
+
+    t_sec = make_transfer(rows=100, shard_parts=1, current_job=1,
+                          sink_id="lease_fence_sink")
+    SnapshotLoader(t_sec, cp, operation_id=op_id).upload_tables()
+    assert cp.operation_progress(op_id).done
+
+    # the dead worker wakes and flushes its stale completion
+    zombie_part.completed = True
+    zombie_part.completed_rows = 1
+    rejected = cp.update_operation_parts(op_id, [zombie_part])
+    assert rejected == [zombie_part.key()]
+    final = cp.operation_parts(op_id)[0]
+    assert final.worker_index == 1
+    assert final.completed_rows == 100
+
+
+def test_leaseless_mode_worker_exits_instead_of_lingering(fast_tuning):
+    """TRANSFERIA_TPU_LEASE_SECONDS=0 (legacy permanent claims): claims
+    never expire, so a drained worker must exit as the pre-lease engine
+    did — not poll forever on another worker's pending part."""
+    store = get_store("leaseless_sink")
+    store.clear()
+    cp = MemoryCoordinator(lease_seconds=0)
+    op_id = "op-leaseless"
+    t_main = make_transfer(rows=200, shard_parts=2, current_job=0,
+                           sink_id="leaseless_sink")
+    publish_parts(cp, t_main, op_id)
+    held = cp.assign_operation_part(op_id, 9)  # permanent claim
+    assert held.lease_expires_at == 0.0
+
+    t_sec = make_transfer(rows=200, shard_parts=2, current_job=1,
+                          sink_id="leaseless_sink")
+    loader = SnapshotLoader(t_sec, cp, operation_id=op_id)
+    done = threading.Event()
+
+    def run():
+        loader.upload_tables()
+        done.set()
+
+    threading.Thread(target=run, daemon=True).start()
+    assert done.wait(timeout=15.0), \
+        "worker lingered on a lease-less permanent claim"
+    final = {p.part_index: p for p in cp.operation_parts(op_id)}
+    assert not final[held.part_index].completed  # never stolen
+    assert final[held.part_index].worker_index == 9
+
+
+# -- lease-aware main join ---------------------------------------------------
+
+def test_wait_fails_fast_with_orphan_diagnostic(fast_tuning):
+    cp = MemoryCoordinator(lease_seconds=0.05)
+    op_id = "op-orphan"
+    parts = publish_parts_stub(n=2, op=op_id)
+    cp.create_operation_parts(op_id, parts)
+    cp.set_operation_state(op_id, {"parts_discovery_done": True})
+    dead = cp.assign_operation_part(op_id, 7)
+    cp.operation_health(op_id, 7, {"phase": "uploading"})
+    loader = SnapshotLoader(make_transfer(current_job=0), cp,
+                            operation_id=op_id)
+    t0 = time.monotonic()
+    with pytest.raises(CodedError) as ei:
+        loader._wait_all_parts_done()
+    assert time.monotonic() - t0 < 10.0  # fail fast, not 24h
+    msg = str(ei.value)
+    assert Codes.SNAPSHOT_PARTS_ORPHANED in msg
+    assert dead.key() in msg
+    assert "worker 7" in msg
+    assert "never claimed" in msg  # the unassigned part is named too
+    assert "last heartbeat" in msg
+
+
+def test_wait_does_not_fail_fast_on_never_claimed_queue(fast_tuning):
+    """Secondaries slow to arrive (pods pending) leave the whole queue
+    unclaimed — that is not a dead fleet, the main must keep waiting
+    (here until its explicit timeout), not raise parts_orphaned."""
+    cp = MemoryCoordinator(lease_seconds=0.05)
+    op_id = "op-unclaimed"
+    cp.create_operation_parts(op_id, publish_parts_stub(n=2, op=op_id))
+    cp.set_operation_state(op_id, {"parts_discovery_done": True})
+    loader = SnapshotLoader(make_transfer(current_job=0), cp,
+                            operation_id=op_id)
+    with pytest.raises(TimeoutError):  # NOT CodedError/parts_orphaned
+        loader._wait_all_parts_done(timeout=1.0)
+
+
+def test_wait_keeps_waiting_while_lease_is_live(fast_tuning):
+    cp = MemoryCoordinator(lease_seconds=30.0)
+    op_id = "op-live"
+    cp.create_operation_parts(op_id, publish_parts_stub(n=1, op=op_id))
+    cp.set_operation_state(op_id, {"parts_discovery_done": True})
+    held = cp.assign_operation_part(op_id, 3)
+    loader = SnapshotLoader(make_transfer(current_job=0), cp,
+                            operation_id=op_id)
+
+    def complete_later():
+        time.sleep(0.5)
+        held.completed = True
+        cp.update_operation_parts(op_id, [held])
+
+    th = threading.Thread(target=complete_later, daemon=True)
+    th.start()
+    loader._wait_all_parts_done()  # live lease: no stall fail-fast
+    th.join()
+    assert cp.operation_progress(op_id).done

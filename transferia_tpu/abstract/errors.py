@@ -23,6 +23,13 @@ class AbortTransferError(FatalError):
     """Operator-visible abort (bad config, incompatible schema)."""
 
 
+class WorkerKilledError(TransferError):
+    """The worker thread/process is dying (pod eviction, OOM-kill, chaos
+    `worker_crash` trials).  Deliberately NOT retriable: the part must be
+    left mid-flight with its lease intact so a surviving worker reclaims
+    it after expiry — retrying locally would mask the death."""
+
+
 class CodedError(TransferError):
     """Error with a stable code (pkg/errors/coded)."""
 
@@ -41,6 +48,7 @@ class Codes:
     DIAL_TIMEOUT = "network.dial_timeout"
     DROP_NOT_ALLOWED = "target.drop_not_allowed"
     TABLE_SPLIT_FAILED = "storage.table_split_failed"
+    SNAPSHOT_PARTS_ORPHANED = "snapshot.parts_orphaned"
 
 
 class TableUploadError(TransferError):
@@ -84,7 +92,20 @@ def is_fatal(err: BaseException) -> bool:
 # the same traceback.  Walked through the cause chain like is_fatal, so
 # a TableUploadError wrapping a TypeError fails fast too.
 _NON_RETRIABLE_TYPES = (TypeError, AttributeError, NameError, KeyError,
-                        IndexError, AssertionError)
+                        IndexError, AssertionError, WorkerKilledError)
+
+
+def is_worker_kill(err: BaseException) -> bool:
+    """True when a WorkerKilledError sits anywhere in the cause chain
+    (the snapshot loader wraps part failures in TableUploadError)."""
+    seen = set()
+    cur: Optional[BaseException] = err
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        if isinstance(cur, WorkerKilledError):
+            return True
+        cur = cur.__cause__ or getattr(cur, "cause", None)
+    return False
 
 
 def is_retriable(err: BaseException) -> bool:

@@ -48,6 +48,16 @@ class OperationTablePart:
     read_bytes: int = 0
     completed: bool = False
     worker_index: Optional[int] = None  # assignee
+    # Lease plane (coordinator-owned): a part claim is a lease, not a
+    # permanent grant.  `assignment_epoch` bumps on every (re)assignment
+    # and fences stale completions (a zombie worker whose lease expired
+    # carries the old epoch and is rejected by update_operation_parts);
+    # `lease_expires_at` is a wall-clock deadline renewed by the worker
+    # heartbeat (0 = no lease: legacy claims never expire); `stolen_from`
+    # records the previous holder when an expired lease is reclaimed.
+    assignment_epoch: int = 0
+    lease_expires_at: float = 0.0
+    stolen_from: Optional[int] = None
     # inline-validation digest of this part's post-transform rows
     # (FingerprintAggregate.digest(); merged per table at read time —
     # per-part writes keep the coordinator update race-free)
@@ -82,6 +92,9 @@ class OperationTablePart:
             "read_bytes": self.read_bytes,
             "completed": self.completed,
             "worker_index": self.worker_index,
+            "assignment_epoch": self.assignment_epoch,
+            "lease_expires_at": self.lease_expires_at,
+            "stolen_from": self.stolen_from,
             "fingerprint": self.fingerprint,
         }
 
@@ -99,6 +112,9 @@ class OperationTablePart:
             read_bytes=d.get("read_bytes", 0),
             completed=d.get("completed", False),
             worker_index=d.get("worker_index"),
+            assignment_epoch=d.get("assignment_epoch", 0),
+            lease_expires_at=d.get("lease_expires_at", 0.0),
+            stolen_from=d.get("stolen_from"),
             fingerprint=d.get("fingerprint", ""),
         )
 

@@ -58,6 +58,7 @@ from transferia_tpu.abstract.errors import (
     CodedError,
     FatalError,
     TransferError,
+    WorkerKilledError,
 )
 from transferia_tpu.chaos.sites import site_names
 
@@ -97,6 +98,9 @@ _ERROR_CLASSES = {
     "ValueError": ValueError,
     "FatalError": FatalError,
     "AbortTransferError": AbortTransferError,
+    # kill-worker-thread action: not retriable, the snapshot worker dies
+    # mid-part and its lease strands for reclamation (chaos worker_crash)
+    "WorkerKilledError": WorkerKilledError,
 }
 
 

@@ -17,7 +17,12 @@ a faulted run:
   checkpoint precedes the row for replication);
 - **checkpoint monotonicity**: commit offsets / snapshot progress never
   move backwards (`MonotonicityTracker`, fed by `AuditingCoordinator`
-  and the broker-commit hook in the runner).
+  and the broker-commit hook in the runner);
+- **epoch fencing**: no part's completion is accepted under two
+  different assignment epochs — a reclaimed part is completed exactly
+  once, by its latest owner, and a zombie's stale-epoch completion is
+  rejected (`fencing_violations` over the accepted-completion log the
+  `AuditingCoordinator` records).
 
 Row identity reuses the fingerprint canonicalization itself
 (`ops/rowhash.row_lanes`): a row's key is its two finalized 32-bit
@@ -233,13 +238,35 @@ class MonotonicityTracker:
             self._marks.pop(name, None)
 
 
+def fencing_violations(completions: list[tuple]) -> list[Violation]:
+    """Epoch-fencing invariant over the accepted-completion log
+    (`AuditingCoordinator.completions`): a part may be completed under
+    exactly one assignment epoch.  Two accepted completions with
+    different epochs mean a zombie slipped past the fence."""
+    out: list[Violation] = []
+    seen: dict[str, tuple] = {}
+    for key, epoch, worker in completions:
+        prev = seen.get(key)
+        if prev is not None and prev[0] != epoch:
+            out.append(Violation(
+                "epoch-fencing",
+                f"part {key} completed under epoch {prev[0]} (worker "
+                f"{prev[1]}) and again under epoch {epoch} (worker "
+                f"{worker})"))
+        else:
+            seen[key] = (epoch, worker)
+    return out
+
+
 class AuditingCoordinator(Coordinator):
     """Transparent coordinator proxy feeding a MonotonicityTracker.
 
     Watches the two checkpoint-shaped streams the snapshot engine
     produces: completed-part progress per operation (must only grow
     within an operation epoch; `create_operation_parts` starts a new
-    epoch) and state-KV write counts.  Everything else forwards as-is.
+    epoch) and state-KV write counts, plus the accepted-completion log
+    (part key, assignment epoch, worker) that `fencing_violations`
+    audits.  Everything else forwards as-is.
     """
 
     def __init__(self, inner: Coordinator,
@@ -247,6 +274,10 @@ class AuditingCoordinator(Coordinator):
         self.inner = inner
         self.tracker = tracker or MonotonicityTracker()
         self.state_writes = 0
+        self._lock = threading.Lock()
+        # accepted completions: (part key, assignment_epoch, worker)
+        self.completions: list[tuple] = []
+        self.fence_rejections = 0
 
     # -- watched methods ----------------------------------------------------
     def create_operation_parts(self, operation_id, parts):
@@ -254,11 +285,18 @@ class AuditingCoordinator(Coordinator):
         return self.inner.create_operation_parts(operation_id, parts)
 
     def update_operation_parts(self, operation_id, parts):
-        out = self.inner.update_operation_parts(operation_id, parts)
+        rejected = self.inner.update_operation_parts(operation_id, parts)
+        rejected_keys = set(rejected or [])
+        with self._lock:
+            self.fence_rejections += len(rejected_keys)
+            for p in parts:
+                if p.completed and p.key() not in rejected_keys:
+                    self.completions.append(
+                        (p.key(), p.assignment_epoch, p.worker_index))
         progress = self.inner.operation_progress(operation_id)
         self.tracker.record(f"op:{operation_id}:completed_parts",
                             progress.completed_parts)
-        return out
+        return rejected
 
     def set_transfer_state(self, transfer_id, state):
         self.state_writes += 1
@@ -298,6 +336,9 @@ class AuditingCoordinator(Coordinator):
         return self.inner.assign_operation_part(operation_id,
                                                 worker_index)
 
+    def renew_lease(self, operation_id, worker_index):
+        return self.inner.renew_lease(operation_id, worker_index)
+
     def clear_assigned_parts(self, operation_id, worker_index):
         return self.inner.clear_assigned_parts(operation_id,
                                                worker_index)
@@ -308,6 +349,9 @@ class AuditingCoordinator(Coordinator):
     def operation_health(self, operation_id, worker_index, payload=None):
         return self.inner.operation_health(operation_id, worker_index,
                                            payload)
+
+    def get_operation_health(self, operation_id):
+        return self.inner.get_operation_health(operation_id)
 
     def transfer_health(self, transfer_id, worker_index=0, healthy=True):
         return self.inner.transfer_health(transfer_id, worker_index,

@@ -47,6 +47,9 @@ SNAPSHOT_ROWS = 4096
 REPLICATION_MESSAGES = 300
 TRIAL_TIMEOUT = 60.0
 MAX_SNAPSHOT_RUNS = 6  # outer re-activations after coordinator faults
+# worker_crash mode: tiny leases so reclamation happens at trial speed
+TRIAL_LEASE_SECONDS = 0.25
+TRIAL_HEARTBEAT_INTERVAL = 0.05
 
 # sites armed per mode (subset of chaos/sites.py that sits on each
 # trial's actual path; `spec=` on the CLI overrides the whole schedule)
@@ -79,6 +82,11 @@ class TrialResult:
     fire_log: dict[str, list[int]] = field(default_factory=dict)
     restarts: int = 0
     seconds: float = 0.0
+    # worker_crash mode: deliberate worker deaths, the reclaim log
+    # [(part key, dead worker, new epoch)], and fenced zombie updates
+    kills: int = 0
+    steal_log: list = field(default_factory=list)
+    fence_rejected: int = 0
 
     @property
     def passed(self) -> bool:
@@ -90,6 +98,9 @@ class TrialResult:
             "spec": self.spec, "passed": self.passed,
             "restarts": self.restarts,
             "seconds": round(self.seconds, 3),
+            "kills": self.kills,
+            "steal_log": [list(s) for s in self.steal_log],
+            "fence_rejected": self.fence_rejected,
             "fire_counts": {k: v for k, v in self.fire_counts.items()
                             if v},
             "fire_log": {k: v for k, v in self.fire_log.items() if v},
@@ -134,10 +145,16 @@ class ChaosReport:
             ok = sum(1 for r in rs if r.passed)
             dup = sum(r.verdict.duplicate_rows for r in rs)
             restarts = sum(r.restarts for r in rs)
-            lines.append(
-                f"{mode}: {ok}/{len(rs)} trials passed, "
-                f"{restarts} restart(s), {dup} duplicate row(s) "
-                f"absorbed")
+            line = (f"{mode}: {ok}/{len(rs)} trials passed, "
+                    f"{restarts} restart(s), {dup} duplicate row(s) "
+                    f"absorbed")
+            if mode == "worker_crash":
+                kills = sum(r.kills for r in rs)
+                steals = sum(len(r.steal_log) for r in rs)
+                fenced = sum(r.fence_rejected for r in rs)
+                line += (f", {kills} worker(s) killed, {steals} part(s) "
+                         f"reclaimed, {fenced} zombie update(s) fenced")
+            lines.append(line)
             for r in rs:
                 if not r.passed:
                     lines.append(f"  trial {r.trial} (seed {r.seed}) "
@@ -155,19 +172,31 @@ class ChaosReport:
 
 @contextlib.contextmanager
 def _fast_retries():
-    """Shrink retry sleeps for trial wall time (restored on exit)."""
+    """Shrink retry sleeps and snapshot deadlines for trial wall time
+    (restored on exit).  The schedules and the liveness machinery are
+    under test, not the production sleep lengths: leases/heartbeats run
+    at millisecond scale so a 20-trial run finishes in seconds."""
     from transferia_tpu.middlewares import sync as sync_mod
     from transferia_tpu.tasks import snapshot as snapshot_mod
 
     old_sink = sync_mod.RETRY_BASE_DELAY
     old_part = snapshot_mod.PART_RETRY_BASE_DELAY
+    old_tuning = snapshot_mod.TUNING
     sync_mod.RETRY_BASE_DELAY = 0.01
     snapshot_mod.PART_RETRY_BASE_DELAY = 0.01
+    snapshot_mod.TUNING = snapshot_mod.SnapshotTuning(
+        secondary_bootstrap_timeout=10.0,
+        wait_poll=0.02,
+        wait_timeout=TRIAL_TIMEOUT,
+        stall_timeout=3.0,
+        heartbeat_interval=TRIAL_HEARTBEAT_INTERVAL,
+    )
     try:
         yield
     finally:
         sync_mod.RETRY_BASE_DELAY = old_sink
         snapshot_mod.PART_RETRY_BASE_DELAY = old_part
+        snapshot_mod.TUNING = old_tuning
 
 
 def _device_fusion_available() -> bool:
@@ -326,6 +355,184 @@ def run_snapshot_trial(trial: int, seed: int, rows: int,
     return TrialResult(mode="snapshot", trial=trial, seed=seed,
                        spec=spec, verdict=verdict, fire_counts=fires,
                        fire_log=log, restarts=restarts, seconds=seconds)
+
+
+# -- worker_crash mode -------------------------------------------------------
+#
+# Kills a sharded-secondary worker mid-part and proves the lease plane
+# recovers: the dead worker's lease expires, a surviving worker reclaims
+# and completes the part (real assign/steal path), the sharded main's
+# join observes completion, and a zombie replay of the dead worker's
+# completion is fenced by its stale assignment epoch.
+#
+# Determinism: the victim uploads ALONE (the runner plays the main's
+# control-plane role: split + publish parts), so its batch sequence —
+# and therefore which part is mid-flight when `snapshot.part.batch`
+# fires — is a pure function of the seed.  The survivor starts only
+# after the victim is dead, so the steal log replays exactly.
+
+def worker_crash_schedule(trial: int, seed: int) -> str:
+    """Seed-derived spec: a kill-worker action at a seeded batch index,
+    plus (sometimes) transient lease-renewal failures the heartbeat must
+    absorb without anyone dying."""
+    rng = random.Random(f"{seed}:worker_crash:{trial}")
+    clauses = [
+        # 4 parts x 2 batches = 8 victim batch hits; after<=5 guarantees
+        # the kill fires mid-queue with work left for the survivor
+        f"snapshot.part.batch=after:{rng.randrange(0, 6)},times:1,"
+        f"raise:WorkerKilledError",
+    ]
+    if rng.random() < 0.5:
+        clauses.append(
+            f"snapshot.lease_renew=after:{rng.randrange(0, 2)},times:1,"
+            f"raise:ChaosInjectedError")
+    return ";".join(clauses)
+
+
+def run_worker_crash_trial(trial: int, seed: int, rows: int,
+                           reference: DeliveryReference,
+                           spec: Optional[str] = None) -> TrialResult:
+    from transferia_tpu.abstract.errors import is_worker_kill
+    from transferia_tpu.abstract.table import OperationTablePart
+    from transferia_tpu.chaos.invariants import fencing_violations
+    from transferia_tpu.factories import new_storage
+    from transferia_tpu.middlewares.sync import SINK_PUSH_ATTEMPTS
+    from transferia_tpu.providers.memory import get_store
+    from transferia_tpu.stats.registry import LeaseStats, Metrics
+    from transferia_tpu.tasks.snapshot import PART_RETRIES, SnapshotLoader
+    from transferia_tpu.tasks.table_splitter import split_tables
+
+    sink_id = "chaos-crash-trial"
+    store = get_store(sink_id)
+    store.clear()
+    spec = spec if spec is not None else worker_crash_schedule(trial, seed)
+    tracker = MonotonicityTracker()
+    cp = AuditingCoordinator(
+        MemoryCoordinator(lease_seconds=TRIAL_LEASE_SECONDS), tracker)
+    op_id = "op-chaos-crash"
+    metrics = Metrics()
+    lease_stats = LeaseStats(metrics)
+
+    def mk_transfer(job: int):
+        t = _snapshot_transfer(rows, sink_id)
+        t.id = "chaos-crash"
+        t.runtime.current_job = job
+        t.runtime.sharding.job_count = 3
+        return t
+
+    def mk_loader(job: int) -> SnapshotLoader:
+        return SnapshotLoader(mk_transfer(job), cp, operation_id=op_id,
+                              metrics=metrics)
+
+    # the main's control-plane role: split and publish the part queue
+    # (keeping the main out of the claim pool keeps the victim's batch
+    # sequence deterministic; its join loop is exercised below)
+    main_t = mk_transfer(0)
+    storage = new_storage(main_t, metrics)
+    try:
+        tables = mk_loader(0).filtered_table_list(storage)
+        parts = split_tables(storage, tables, main_t, op_id)
+    finally:
+        storage.close()
+    cp.create_operation_parts(op_id, parts)
+    cp.set_operation_state(op_id, {"parts_discovery_done": True})
+
+    def run_loader(job: int, errs: list):
+        try:
+            mk_loader(job).upload_tables()
+        except BaseException as e:
+            errs.append(e)
+
+    violations: list[Violation] = []
+    kills = 0
+    fence_rejected = 0
+    t0 = time.monotonic()
+    with failpoints.active(spec, seed=seed * 1000 + trial):
+        # phase 1: the victim secondary drains the queue alone until the
+        # armed kill fires mid-part
+        victim_errs: list = []
+        vt = threading.Thread(target=run_loader, args=(1, victim_errs),
+                              name="chaos-victim", daemon=True)
+        vt.start()
+        vt.join(TRIAL_TIMEOUT)
+        victim_killed = bool(victim_errs) and is_worker_kill(
+            victim_errs[0])
+        kills = int(victim_killed)
+        if victim_errs and not victim_killed:
+            violations.append(Violation(
+                "run-completed",
+                f"victim died of a non-kill error: {victim_errs[0]}"))
+        # the victim's mid-flight parts: leased to worker 1, incomplete
+        inflight = [p for p in cp.operation_parts(op_id)
+                    if not p.completed and p.worker_index == 1]
+        if victim_killed and not inflight:
+            violations.append(Violation(
+                "worker-crash",
+                "victim died but left no leased in-flight part"))
+        # phase 2: a surviving secondary drains the rest — including the
+        # victim's parts once their leases expire (real reclaim path)
+        survivor_errs: list = []
+        st = threading.Thread(target=run_loader, args=(2, survivor_errs),
+                              name="chaos-survivor", daemon=True)
+        st.start()
+        st.join(TRIAL_TIMEOUT)
+        if survivor_errs:
+            violations.append(Violation(
+                "run-completed",
+                f"survivor failed: {survivor_errs[0]}"))
+        # phase 3: the sharded main's join must observe completion fast
+        # (lease-aware wait), not spin out its timeout
+        try:
+            mk_loader(0)._wait_all_parts_done()
+        except Exception as e:
+            violations.append(Violation(
+                "main-join", f"main wait failed: {e}"))
+        # phase 4: the zombie wakes — replay the dead worker's
+        # completion with its stale epoch; the fence must reject it
+        for p in inflight:
+            zombie = OperationTablePart.from_json(p.to_json())
+            zombie.completed = True
+            zombie.completed_rows = 1
+            rejected = cp.update_operation_parts(op_id, [zombie])
+            fence_rejected += len(rejected)
+            if not rejected:
+                violations.append(Violation(
+                    "epoch-fencing",
+                    f"zombie completion of {zombie.key()} (epoch "
+                    f"{zombie.assignment_epoch}) was accepted"))
+        lease_stats.fence_rejected.inc(fence_rejected)
+        fires = failpoints.fire_counts()
+        log = failpoints.fire_log()
+    seconds = time.monotonic() - t0
+
+    final_parts = cp.operation_parts(op_id)
+    steal_log = sorted(
+        (p.key(), p.stolen_from, p.assignment_epoch)
+        for p in final_parts if p.stolen_from is not None)
+    if victim_killed and inflight and not steal_log:
+        violations.append(Violation(
+            "reclamation",
+            f"victim's in-flight part(s) "
+            f"{[p.key() for p in inflight]} were never reclaimed"))
+    if not all(p.completed for p in final_parts):
+        violations.append(Violation(
+            "run-completed",
+            f"{sum(1 for p in final_parts if not p.completed)} part(s) "
+            f"never completed"))
+    violations.extend(fencing_violations(cp.completions))
+
+    # per-part deliveries: (kill + 1) x the retry machinery per run
+    bound = (kills + 1) * PART_RETRIES * SINK_PUSH_ATTEMPTS
+    verdict = audit_delivery(reference, store.batches, bound, tracker)
+    if violations:
+        verdict.passed = False
+        verdict.violations.extend(violations)
+    store.clear()
+    return TrialResult(mode="worker_crash", trial=trial, seed=seed,
+                       spec=spec, verdict=verdict, fire_counts=fires,
+                       fire_log=log, seconds=seconds, kills=kills,
+                       steal_log=steal_log,
+                       fence_rejected=fence_rejected)
 
 
 # -- replication mode --------------------------------------------------------
@@ -491,7 +698,12 @@ def run_trials(trials: int = 5, seed: int = 7, mode: str = "both",
     """Run N seeded chaos trials per requested mode and audit each."""
     failpoints.reset()  # trials own the registry for their duration
     report = ChaosReport()
-    modes = ("snapshot", "replication") if mode == "both" else (mode,)
+    if mode == "both":
+        modes = ("snapshot", "replication")
+    elif mode == "all":
+        modes = ("snapshot", "replication", "worker_crash")
+    else:
+        modes = (mode,)
     with _fast_retries(), _forced_device_placement() as device_ok:
         if "snapshot" in modes:
             ref = _snapshot_reference(rows)
@@ -500,6 +712,13 @@ def run_trials(trials: int = 5, seed: int = 7, mode: str = "both",
                                        device_ok=bool(device_ok))
                 report.results.append(r)
                 logger.info("chaos snapshot trial %d: %s", t,
+                            r.verdict.summary().splitlines()[0])
+        if "worker_crash" in modes:
+            ref = _snapshot_reference(rows)
+            for t in range(trials):
+                r = run_worker_crash_trial(t, seed, rows, ref, spec=spec)
+                report.results.append(r)
+                logger.info("chaos worker_crash trial %d: %s", t,
                             r.verdict.summary().splitlines()[0])
         if "replication" in modes:
             ref = _replication_reference(messages)

@@ -62,6 +62,18 @@ SITES: dict[str, tuple[str, str]] = {
         "coordinator/memory.py",
         "operation-state write failing mid-snapshot (discovery flags, "
         "sharded handoff, fingerprint publication)"),
+    "snapshot.lease_renew": (
+        "tasks/snapshot.py",
+        "heartbeat lease renewal failing (coordinator unreachable): "
+        "transient failures must be absorbed by the lease TTL; with "
+        "raise:WorkerKilledError the heartbeat dies and the worker "
+        "becomes a zombie whose parts get reclaimed"),
+    "snapshot.part.batch": (
+        "tasks/snapshot.py",
+        "worker thread dying between batches mid-part (OOM-kill, pod "
+        "eviction) — armed with raise:WorkerKilledError this is the "
+        "worker_crash generator: the part's lease must expire and a "
+        "surviving worker must reclaim and complete it"),
     "replication.pump": (
         "providers/queue_common.py",
         "replication source pump dying between fetch and enqueue — the "

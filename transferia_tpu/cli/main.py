@@ -164,7 +164,12 @@ def build_parser() -> argparse.ArgumentParser:
                      help="master seed: derives every trial's fault "
                           "schedule and PRNG draws (replayable)")
     cha.add_argument("--mode", default="both",
-                     choices=["snapshot", "replication", "both"])
+                     choices=["snapshot", "replication", "worker_crash",
+                              "both", "all"],
+                     help="worker_crash kills a sharded worker mid-part "
+                          "and audits lease reclamation + epoch "
+                          "fencing; both = snapshot+replication; all "
+                          "adds worker_crash")
     cha.add_argument("--rows", type=int, default=0,
                      help="snapshot source rows (default 4096)")
     cha.add_argument("--messages", type=int, default=0,

@@ -24,12 +24,24 @@ import time
 from typing import Any, Optional
 
 from transferia_tpu.abstract.table import OperationTablePart
-from transferia_tpu.coordinator.interface import Coordinator, TransferStatus
+from transferia_tpu.coordinator.interface import (
+    Coordinator,
+    TransferStatus,
+    deadline_expired,
+    default_lease_seconds,
+)
+
+# health files keep latest-per-worker plus a bounded rolling history —
+# long operations must not grow them without limit
+HEALTH_HISTORY_LIMIT = 128
 
 
 class FileStoreCoordinator(Coordinator):
-    def __init__(self, root: str):
+    def __init__(self, root: str,
+                 lease_seconds: Optional[float] = None):
         self.root = root
+        self.lease_seconds = (default_lease_seconds()
+                              if lease_seconds is None else lease_seconds)
         os.makedirs(os.path.join(root, "transfers"), exist_ok=True)
         os.makedirs(os.path.join(root, "operations"), exist_ok=True)
         os.makedirs(os.path.join(root, "health"), exist_ok=True)
@@ -147,14 +159,45 @@ class FileStoreCoordinator(Coordinator):
     def assign_operation_part(self, operation_id: str, worker_index: int
                               ) -> Optional[OperationTablePart]:
         p = self._parts_path(operation_id)
+        now = time.time()
         with self._locked(p):
             parts = self._read_json(p, [])
             for d in parts:
-                if d.get("worker_index") is None and not d.get("completed"):
-                    d["worker_index"] = worker_index
-                    self._write_json(p, parts)
-                    return OperationTablePart.from_json(d)
+                if d.get("completed"):
+                    continue
+                holder = d.get("worker_index")
+                stolen = holder is not None and deadline_expired(
+                    d.get("lease_expires_at") or 0.0, now)
+                if holder is not None and not stolen:
+                    continue
+                d["stolen_from"] = holder if stolen else None
+                d["worker_index"] = worker_index
+                d["assignment_epoch"] = d.get("assignment_epoch", 0) + 1
+                # unconditional: a stale stamp under disabled leasing
+                # would look expired forever and re-steal every assign
+                d["lease_expires_at"] = (now + self.lease_seconds
+                                         if self.lease_seconds > 0
+                                         else 0.0)
+                self._write_json(p, parts)
+                return OperationTablePart.from_json(d)
             return None
+
+    def renew_lease(self, operation_id: str, worker_index: int) -> int:
+        if self.lease_seconds <= 0:
+            return 0
+        p = self._parts_path(operation_id)
+        renewed = 0
+        now = time.time()
+        with self._locked(p):
+            parts = self._read_json(p, [])
+            for d in parts:
+                if d.get("worker_index") == worker_index \
+                        and not d.get("completed"):
+                    d["lease_expires_at"] = now + self.lease_seconds
+                    renewed += 1
+            if renewed:
+                self._write_json(p, parts)
+        return renewed
 
     def clear_assigned_parts(self, operation_id: str,
                              worker_index: int) -> int:
@@ -166,14 +209,17 @@ class FileStoreCoordinator(Coordinator):
                 if d.get("worker_index") == worker_index \
                         and not d.get("completed"):
                     d["worker_index"] = None
+                    d["lease_expires_at"] = 0.0
                     released += 1
             if released:
                 self._write_json(p, parts)
         return released
 
     def update_operation_parts(self, operation_id: str,
-                               parts: list[OperationTablePart]) -> None:
+                               parts: list[OperationTablePart]
+                               ) -> list[str]:
         p = self._parts_path(operation_id)
+        rejected: list[str] = []
         with self._locked(p):
             cur = self._read_json(p, [])
             by_key = {
@@ -184,14 +230,20 @@ class FileStoreCoordinator(Coordinator):
             for upd in parts:
                 k = (upd.operation_id, upd.table_id.namespace,
                      upd.table_id.name, upd.part_index)
-                if k in by_key:
-                    d = by_key[k]
-                    d["completed_rows"] = upd.completed_rows
-                    d["read_bytes"] = upd.read_bytes
-                    d["completed"] = upd.completed
-                    d["worker_index"] = upd.worker_index
-                    d["fingerprint"] = upd.fingerprint
+                if k not in by_key:
+                    continue
+                d = by_key[k]
+                if upd.assignment_epoch != d.get("assignment_epoch", 0):
+                    # epoch fence (see coordinator/interface.py)
+                    rejected.append(upd.key())
+                    continue
+                d["completed_rows"] = upd.completed_rows
+                d["read_bytes"] = upd.read_bytes
+                d["completed"] = upd.completed
+                d["worker_index"] = upd.worker_index
+                d["fingerprint"] = upd.fingerprint
             self._write_json(p, cur)
+        return rejected
 
     def operation_parts(self, operation_id: str) -> list[OperationTablePart]:
         return [
@@ -199,20 +251,37 @@ class FileStoreCoordinator(Coordinator):
             for d in self._read_json(self._parts_path(operation_id), [])
         ]
 
+    def _write_health(self, path: str, worker_index: int,
+                      payload) -> None:
+        """Latest-per-worker + bounded history (never an unbounded
+        append: a long operation heartbeats for hours)."""
+        entry = {"worker": worker_index, "ts": time.time(),
+                 "payload": payload}
+        with self._locked(path):
+            cur = self._read_json(path, {})
+            if not isinstance(cur, dict):  # pre-lease .jsonl era file
+                cur = {}
+            cur.setdefault("workers", {})[str(worker_index)] = entry
+            hist = cur.setdefault("history", [])
+            hist.append(entry)
+            del hist[:-HEALTH_HISTORY_LIMIT]
+            self._write_json(path, cur)
+
     def operation_health(self, operation_id: str, worker_index: int,
                          payload: Optional[dict] = None) -> None:
-        p = os.path.join(self.root, "health", f"op_{operation_id}.jsonl")
-        with self._locked(p), open(p, "a") as fh:
-            fh.write(json.dumps({
-                "worker": worker_index, "ts": time.time(),
-                "payload": payload,
-            }) + "\n")
+        p = os.path.join(self.root, "health", f"op_{operation_id}.json")
+        self._write_health(p, worker_index, payload)
+
+    def get_operation_health(self, operation_id: str) -> dict[int, dict]:
+        p = os.path.join(self.root, "health", f"op_{operation_id}.json")
+        cur = self._read_json(p, {})
+        workers = cur.get("workers", {}) if isinstance(cur, dict) else {}
+        return {
+            int(w): {"ts": rep.get("ts"), "payload": rep.get("payload")}
+            for w, rep in workers.items()
+        }
 
     def transfer_health(self, transfer_id: str, worker_index: int = 0,
                         healthy: bool = True) -> None:
-        p = os.path.join(self.root, "health", f"tr_{transfer_id}.jsonl")
-        with self._locked(p), open(p, "a") as fh:
-            fh.write(json.dumps({
-                "worker": worker_index, "ts": time.time(),
-                "healthy": healthy,
-            }) + "\n")
+        p = os.path.join(self.root, "health", f"tr_{transfer_id}.json")
+        self._write_health(p, worker_index, {"healthy": healthy})

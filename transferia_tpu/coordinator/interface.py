@@ -4,10 +4,47 @@ from __future__ import annotations
 
 import abc
 import enum
+import os
+import time
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from transferia_tpu.abstract.table import OperationTablePart
+
+# Part-claim lease TTL (seconds).  A claim is a lease: the holding worker
+# renews it from its heartbeat thread (SnapshotLoader), and an expired
+# lease makes the part assignable again — any live worker reclaims a dead
+# worker's parts instead of the queue stranding forever.  0 disables
+# leasing (legacy permanent claims).
+DEFAULT_LEASE_SECONDS = 60.0
+ENV_LEASE_SECONDS = "TRANSFERIA_TPU_LEASE_SECONDS"
+
+
+def env_float(environ, key: str, default: float) -> float:
+    """Float env knob with garbage falling back to the default (shared
+    by the lease TTL here and the SnapshotTuning knobs)."""
+    try:
+        return float(environ.get(key, default))
+    except (TypeError, ValueError):
+        return default
+
+
+def default_lease_seconds(environ=os.environ) -> float:
+    return env_float(environ, ENV_LEASE_SECONDS, DEFAULT_LEASE_SECONDS)
+
+
+def deadline_expired(expires_at: float,
+                     now: Optional[float] = None) -> bool:
+    """The single lease-expiry rule (0 = no lease, never expires).
+    Wall clock (`time.time()`): leases cross process/host boundaries."""
+    if expires_at <= 0:
+        return False
+    return expires_at < (time.time() if now is None else now)
+
+
+def lease_expired(part: OperationTablePart,
+                  now: Optional[float] = None) -> bool:
+    return deadline_expired(part.lease_expires_at, now)
 
 
 class TransferStatus(str, enum.Enum):
@@ -106,7 +143,18 @@ class Coordinator(abc.ABC):
     def assign_operation_part(self, operation_id: str,
                               worker_index: int
                               ) -> Optional[OperationTablePart]:
-        """Atomically claim the next unassigned part (None = queue drained)."""
+        """Atomically claim the next assignable part (None = nothing
+        assignable right now).  Assignable = unassigned, OR incomplete
+        with an expired lease (reclamation: the previous holder is
+        presumed dead).  Every (re)assignment bumps `assignment_epoch`
+        and stamps a fresh `lease_expires_at`; a reclaim records the
+        previous holder in `stolen_from`."""
+
+    def renew_lease(self, operation_id: str, worker_index: int) -> int:
+        """Heartbeat: extend the lease on every incomplete part this
+        worker holds.  Returns the number of leases renewed (0 for
+        lease-less backends — their claims never expire)."""
+        return 0
 
     @abc.abstractmethod
     def clear_assigned_parts(self, operation_id: str,
@@ -116,8 +164,16 @@ class Coordinator(abc.ABC):
 
     @abc.abstractmethod
     def update_operation_parts(self, operation_id: str,
-                               parts: list[OperationTablePart]) -> None:
-        """Progress/completion flush (UpdateOperationTablesParts)."""
+                               parts: list[OperationTablePart]
+                               ) -> list[str]:
+        """Progress/completion flush (UpdateOperationTablesParts).
+
+        Epoch fencing: an update whose `assignment_epoch` does not match
+        the stored part's is rejected — a zombie worker that wakes after
+        its lease expired and its part was reclaimed cannot mark the
+        reassigned part complete or corrupt progress/fingerprints.
+        Returns the keys (part.key()) of rejected updates (empty =
+        everything applied)."""
 
     @abc.abstractmethod
     def operation_parts(self, operation_id: str) -> list[OperationTablePart]:
@@ -136,6 +192,12 @@ class Coordinator(abc.ABC):
     def operation_health(self, operation_id: str, worker_index: int,
                          payload: Optional[dict] = None) -> None:
         ...
+
+    def get_operation_health(self, operation_id: str) -> dict[int, dict]:
+        """Latest heartbeat per worker: {worker_index: {"ts": ...,
+        "payload": {...}}}.  Read by the main worker's join loop to name
+        last-seen workers in orphaned-part diagnostics."""
+        return {}
 
     def transfer_health(self, transfer_id: str, worker_index: int = 0,
                         healthy: bool = True) -> None:

@@ -8,22 +8,39 @@ tests/helpers/sharded_snapshot_workers.go).
 from __future__ import annotations
 
 import threading
+import time
+from collections import deque
 from typing import Any, Optional
 
 from transferia_tpu.abstract.table import OperationTablePart
 from transferia_tpu.chaos.failpoints import failpoint
-from transferia_tpu.coordinator.interface import Coordinator, TransferStatus
+from transferia_tpu.coordinator.interface import (
+    Coordinator,
+    TransferStatus,
+    default_lease_seconds,
+    lease_expired,
+)
+
+# bounded health history: long operations heartbeat for hours — keep the
+# latest report per (scope, worker) plus a small rolling window, not an
+# unbounded append
+HEALTH_HISTORY_LIMIT = 256
 
 
 class MemoryCoordinator(Coordinator):
-    def __init__(self):
+    def __init__(self, lease_seconds: Optional[float] = None):
         self._lock = threading.RLock()
         self._status: dict[str, TransferStatus] = {}
         self._state: dict[str, dict[str, Any]] = {}
         self._parts: dict[str, list[OperationTablePart]] = {}
         self._op_state: dict[str, dict[str, Any]] = {}
         self._messages: dict[str, list[tuple[str, str]]] = {}
-        self.health_reports: list[tuple] = []
+        self.lease_seconds = (default_lease_seconds()
+                              if lease_seconds is None else lease_seconds)
+        # rolling window of (scope, worker, payload) tuples; latest
+        # report per (scope, worker) kept separately for readers
+        self.health_reports: deque = deque(maxlen=HEALTH_HISTORY_LIMIT)
+        self._health_latest: dict[tuple[str, int], dict] = {}
 
     # -- status -------------------------------------------------------------
     def set_status(self, transfer_id: str, status: TransferStatus) -> None:
@@ -91,12 +108,37 @@ class MemoryCoordinator(Coordinator):
 
     def assign_operation_part(self, operation_id: str, worker_index: int
                               ) -> Optional[OperationTablePart]:
+        now = time.time()
         with self._lock:
             for p in self._parts.get(operation_id, []):
-                if p.worker_index is None and not p.completed:
-                    p.worker_index = worker_index
-                    return OperationTablePart.from_json(p.to_json())
+                if p.completed:
+                    continue
+                stolen = p.worker_index is not None \
+                    and lease_expired(p, now)
+                if p.worker_index is not None and not stolen:
+                    continue
+                p.stolen_from = p.worker_index if stolen else None
+                p.worker_index = worker_index
+                p.assignment_epoch += 1
+                # unconditional: leasing disabled must CLEAR any stale
+                # deadline (a leftover stamp would look expired forever
+                # and every assign would re-steal the part)
+                p.lease_expires_at = (now + self.lease_seconds
+                                      if self.lease_seconds > 0 else 0.0)
+                return OperationTablePart.from_json(p.to_json())
             return None
+
+    def renew_lease(self, operation_id: str, worker_index: int) -> int:
+        if self.lease_seconds <= 0:
+            return 0
+        renewed = 0
+        now = time.time()
+        with self._lock:
+            for p in self._parts.get(operation_id, []):
+                if p.worker_index == worker_index and not p.completed:
+                    p.lease_expires_at = now + self.lease_seconds
+                    renewed += 1
+        return renewed
 
     def clear_assigned_parts(self, operation_id: str,
                              worker_index: int) -> int:
@@ -105,21 +147,31 @@ class MemoryCoordinator(Coordinator):
             for p in self._parts.get(operation_id, []):
                 if p.worker_index == worker_index and not p.completed:
                     p.worker_index = None
+                    p.lease_expires_at = 0.0
                     released += 1
         return released
 
     def update_operation_parts(self, operation_id: str,
-                               parts: list[OperationTablePart]) -> None:
+                               parts: list[OperationTablePart]
+                               ) -> list[str]:
+        rejected: list[str] = []
         with self._lock:
             by_key = {p.key(): p for p in self._parts.get(operation_id, [])}
             for upd in parts:
                 cur = by_key.get(upd.key())
-                if cur is not None:
-                    cur.completed_rows = upd.completed_rows
-                    cur.read_bytes = upd.read_bytes
-                    cur.completed = upd.completed
-                    cur.worker_index = upd.worker_index
-                    cur.fingerprint = upd.fingerprint
+                if cur is None:
+                    continue
+                if upd.assignment_epoch != cur.assignment_epoch:
+                    # epoch fence: the part was reclaimed since this
+                    # worker's claim — its update is from a dead epoch
+                    rejected.append(upd.key())
+                    continue
+                cur.completed_rows = upd.completed_rows
+                cur.read_bytes = upd.read_bytes
+                cur.completed = upd.completed
+                cur.worker_index = upd.worker_index
+                cur.fingerprint = upd.fingerprint
+        return rejected
 
     def operation_parts(self, operation_id: str) -> list[OperationTablePart]:
         with self._lock:
@@ -130,8 +182,26 @@ class MemoryCoordinator(Coordinator):
 
     def operation_health(self, operation_id: str, worker_index: int,
                          payload: Optional[dict] = None) -> None:
-        self.health_reports.append((operation_id, worker_index, payload))
+        with self._lock:
+            self.health_reports.append((operation_id, worker_index,
+                                        payload))
+            self._health_latest[(operation_id, worker_index)] = {
+                "ts": time.time(), "payload": payload,
+            }
+
+    def get_operation_health(self, operation_id: str) -> dict[int, dict]:
+        with self._lock:
+            return {
+                widx: dict(rep)
+                for (scope, widx), rep in self._health_latest.items()
+                if scope == operation_id
+            }
 
     def transfer_health(self, transfer_id: str, worker_index: int = 0,
                         healthy: bool = True) -> None:
-        self.health_reports.append((transfer_id, worker_index, healthy))
+        with self._lock:
+            self.health_reports.append((transfer_id, worker_index,
+                                        healthy))
+            self._health_latest[(transfer_id, worker_index)] = {
+                "ts": time.time(), "payload": {"healthy": healthy},
+            }

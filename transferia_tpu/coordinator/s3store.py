@@ -29,7 +29,12 @@ import time
 from typing import Any, Optional
 
 from transferia_tpu.abstract.table import OperationTablePart
-from transferia_tpu.coordinator.interface import Coordinator, TransferStatus
+from transferia_tpu.coordinator.interface import (
+    Coordinator,
+    TransferStatus,
+    deadline_expired,
+    default_lease_seconds,
+)
 from transferia_tpu.coordinator.s3client import (
     ConditionalUnsupported,
     PreconditionFailed,
@@ -42,7 +47,8 @@ logger = logging.getLogger(__name__)
 class S3Coordinator(Coordinator):
     def __init__(self, bucket: str, endpoint: str = "",
                  region: str = "us-east-1", access_key: str = "",
-                 secret_key: str = "", prefix: str = ""):
+                 secret_key: str = "", prefix: str = "",
+                 lease_seconds: Optional[float] = None):
         access_key = access_key or os.environ.get("AWS_ACCESS_KEY_ID", "")
         secret_key = secret_key or os.environ.get(
             "AWS_SECRET_ACCESS_KEY", "")
@@ -50,8 +56,15 @@ class S3Coordinator(Coordinator):
                                access_key=access_key,
                                secret_key=secret_key)
         self.prefix = prefix.rstrip("/") + "/" if prefix else ""
+        self.lease_seconds = (default_lease_seconds()
+                              if lease_seconds is None else lease_seconds)
         self._conditional = True  # flips off on ConditionalUnsupported
         self._done_keys: dict[str, set] = {}  # op -> completed part keys
+        # op -> part keys THIS instance claimed and still holds: the
+        # heartbeat renews only these (O(claimed) GET+PUT per beat, not
+        # a LIST + GET over the whole queue).  One coordinator instance
+        # per worker process, so the memo is authoritative for renewal.
+        self._claimed: dict[str, set] = {}
 
     # -- helpers ------------------------------------------------------------
     def _key(self, *parts: str) -> str:
@@ -180,6 +193,7 @@ class S3Coordinator(Coordinator):
         for obj in self.client.list(prefix):
             self.client.delete(obj.key)
         self._done_keys.pop(operation_id, None)
+        self._claimed.pop(operation_id, None)
         for part in parts:
             key = self._part_key_for(
                 operation_id, part.table_id.namespace,
@@ -209,17 +223,28 @@ class S3Coordinator(Coordinator):
         # memo completed parts: completion never reverts, so skipping
         # their GETs keeps claim cost O(in-flight), not O(all parts)
         done = self._done_keys.setdefault(operation_id, set())
+        now = time.time()
         for key, d, etag in self._list_parts_raw(operation_id, skip=done):
             if d.get("completed"):
                 done.add(key)
                 continue
-            if d.get("worker_index") is not None:
+            holder = d.get("worker_index")
+            stolen = holder is not None and deadline_expired(
+                d.get("lease_expires_at") or 0.0, now)
+            if holder is not None and not stolen:
                 continue
+            d["stolen_from"] = holder if stolen else None
             d["worker_index"] = worker_index
+            d["assignment_epoch"] = d.get("assignment_epoch", 0) + 1
+            # unconditional: a stale stamp under disabled leasing would
+            # look expired forever and re-steal on every assign
+            d["lease_expires_at"] = (now + self.lease_seconds
+                                     if self.lease_seconds > 0 else 0.0)
             try:
                 self._put_json(key, d, if_match=etag)
             except PreconditionFailed:
-                continue  # another worker claimed it first
+                continue  # another worker claimed/stole it first
+            self._claimed.setdefault(operation_id, set()).add(key)
             if not self._conditional:
                 # make the duplicate-part risk visible on every claim,
                 # not only at degrade time (e.g. legacy MinIO endpoints)
@@ -231,6 +256,35 @@ class S3Coordinator(Coordinator):
             return OperationTablePart.from_json(d)
         return None
 
+    def renew_lease(self, operation_id: str, worker_index: int) -> int:
+        if self.lease_seconds <= 0:
+            return 0
+        claimed = self._claimed.get(operation_id)
+        if not claimed:
+            return 0
+        renewed = 0
+        now = time.time()
+        for key in sorted(claimed):
+            got = self.client.get(key)
+            if got is None:
+                claimed.discard(key)
+                continue
+            body, etag = got
+            try:
+                d = json.loads(body)
+            except json.JSONDecodeError:
+                continue
+            if d.get("completed") or d.get("worker_index") != worker_index:
+                claimed.discard(key)  # finished or stolen: not ours
+                continue
+            d["lease_expires_at"] = now + self.lease_seconds
+            try:
+                self._put_json(key, d, if_match=etag)
+                renewed += 1
+            except PreconditionFailed:
+                continue  # updated under us: re-examined next beat
+        return renewed
+
     def clear_assigned_parts(self, operation_id: str,
                              worker_index: int) -> int:
         released = 0
@@ -238,32 +292,62 @@ class S3Coordinator(Coordinator):
             if d.get("worker_index") == worker_index \
                     and not d.get("completed"):
                 d["worker_index"] = None
+                d["lease_expires_at"] = 0.0
                 try:
                     self._put_json(key, d, if_match=etag)
                     released += 1
+                    self._claimed.get(operation_id, set()).discard(key)
                 except PreconditionFailed:
                     continue
         return released
 
     def update_operation_parts(self, operation_id: str,
-                               parts: list[OperationTablePart]) -> None:
+                               parts: list[OperationTablePart]
+                               ) -> list[str]:
+        rejected: list[str] = []
         for upd in parts:
             # part keys are derivable — no listing, one GET+PUT per part
             key = self._part_key_for(
                 operation_id, upd.table_id.namespace,
                 upd.table_id.name, upd.part_index)
-            d, _etag = self._get_json(key, None)
-            if d is None:
-                continue
-            d["completed_rows"] = upd.completed_rows
-            d["read_bytes"] = upd.read_bytes
-            d["completed"] = upd.completed
-            d["worker_index"] = upd.worker_index
-            d["fingerprint"] = upd.fingerprint
-            # progress flush is owner-only: last-writer-wins is safe
-            self._put_json(key, d)
-            if upd.completed:
-                self._done_keys.setdefault(operation_id, set()).add(key)
+            fenced = False
+            applied = False
+            for _ in range(16):
+                d, etag = self._get_json(key, None)
+                if d is None:
+                    applied = True  # unknown part: nothing to fence
+                    break
+                if upd.assignment_epoch != d.get("assignment_epoch", 0):
+                    fenced = True  # epoch fence (coordinator/interface)
+                    break
+                d["completed_rows"] = upd.completed_rows
+                d["read_bytes"] = upd.read_bytes
+                d["completed"] = upd.completed
+                d["worker_index"] = upd.worker_index
+                d["fingerprint"] = upd.fingerprint
+                try:
+                    # conditional on the read ETag: a steal racing this
+                    # flush bumps the epoch, and the retry re-reads and
+                    # fences instead of clobbering the new owner
+                    self._put_json(key, d, if_match=etag)
+                    applied = True
+                except PreconditionFailed:
+                    time.sleep(0.05)
+                    continue
+                if upd.completed:
+                    self._done_keys.setdefault(operation_id,
+                                               set()).add(key)
+                    self._claimed.get(operation_id, set()).discard(key)
+                break
+            if fenced:
+                rejected.append(upd.key())
+            elif not applied:
+                # CAS contention is NOT a fence: reporting it as one
+                # would make the caller silently drop a legitimately
+                # owned completion — surface it as a retriable failure
+                raise TimeoutError(
+                    f"part update CAS on {key} did not converge")
+        return rejected
 
     def operation_parts(self, operation_id: str) -> list[OperationTablePart]:
         return [OperationTablePart.from_json(d)
@@ -277,6 +361,22 @@ class S3Coordinator(Coordinator):
                       f"{worker_index}.json"),
             {"worker": worker_index, "ts": time.time(),
              "payload": payload})
+
+    def get_operation_health(self, operation_id: str) -> dict[int, dict]:
+        # already latest-per-worker: one object per worker index
+        prefix = self._key("health", f"op_{operation_id}", "")
+        out: dict[int, dict] = {}
+        for obj in self.client.list(prefix):
+            d, _ = self._get_json(obj.key, None)
+            if d is None:
+                continue
+            try:
+                widx = int(d.get("worker", obj.key.rsplit("/", 1)[-1]
+                           .removesuffix(".json")))
+            except (TypeError, ValueError):
+                continue
+            out[widx] = {"ts": d.get("ts"), "payload": d.get("payload")}
+        return out
 
     def transfer_health(self, transfer_id: str, worker_index: int = 0,
                         healthy: bool = True) -> None:

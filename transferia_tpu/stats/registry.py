@@ -217,6 +217,21 @@ class ChaosStats(_Bundle):
         self.fires.inc(fires)
 
 
+class LeaseStats(_Bundle):
+    """Worker-liveness plane counters (coordinator leases + epoch
+    fencing, tasks/snapshot.py).  `fence_rejected` is the operator's
+    zombie alarm: a nonzero count means a worker tried to complete a
+    part after its lease expired and the part was reclaimed."""
+
+    def __init__(self, metrics: Optional[Metrics] = None):
+        super().__init__(metrics)
+        self.renewals = self.m.counter("lease_renewals")
+        self.steals = self.m.counter("lease_steals")
+        self.heartbeat_failures = self.m.counter(
+            "lease_heartbeat_failures")
+        self.fence_rejected = self.m.counter("fence_rejected")
+
+
 class TableStats(_Bundle):
     """Per-table progress gauges (pkg/stats/table.go)."""
 

@@ -16,8 +16,11 @@ BuildTPP local/remote split).
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
+from collections import deque
+from dataclasses import dataclass
 from typing import Optional
 
 from transferia_tpu.abstract.change_item import (
@@ -30,6 +33,7 @@ from transferia_tpu.abstract.errors import (
     CodedError,
     Codes,
     TableUploadError,
+    WorkerKilledError,
     is_retriable,
 )
 from transferia_tpu.abstract.interfaces import (
@@ -44,10 +48,15 @@ from transferia_tpu.abstract.interfaces import (
 )
 from transferia_tpu.abstract.schema import TableID
 from transferia_tpu.abstract.table import OperationTablePart, TableDescription
-from transferia_tpu.coordinator.interface import Coordinator
+from transferia_tpu.chaos.failpoints import failpoint
+from transferia_tpu.coordinator.interface import (
+    Coordinator,
+    env_float,
+    lease_expired,
+)
 from transferia_tpu.factories import make_async_sink, new_storage
 from transferia_tpu.stats import trace
-from transferia_tpu.stats.registry import Metrics, TableStats
+from transferia_tpu.stats.registry import LeaseStats, Metrics, TableStats
 from transferia_tpu.tasks.table_splitter import split_tables
 from transferia_tpu.utils.backoff import retry_with_backoff
 
@@ -57,6 +66,44 @@ PART_RETRIES = 3  # load_snapshot.go:1070-1086
 # per-part retry backoff base (chaos trials shrink this: the retry
 # schedule is under test there, not the sleep lengths)
 PART_RETRY_BASE_DELAY = 1.0
+
+
+@dataclass
+class SnapshotTuning:
+    """Deadline/poll knobs formerly hardcoded in the engine.  Chaos
+    trials shrink these the same way they shrink PART_RETRY_BASE_DELAY
+    (the schedules are under test, not the production sleep lengths);
+    operators override via environment."""
+
+    # secondary waiting for the main to publish the part queue
+    secondary_bootstrap_timeout: float = 600.0
+    # main's join loop over secondaries draining the queue
+    wait_poll: float = 0.5
+    wait_timeout: float = 24 * 3600.0
+    # fail-fast window: no progress AND no live lease for this long
+    # means every worker holding work is dead and nobody is reclaiming
+    stall_timeout: float = 600.0
+    # lease-renewal heartbeat period (leases themselves are coordinator
+    # TTLs: coordinator/interface.py DEFAULT_LEASE_SECONDS)
+    heartbeat_interval: float = 5.0
+
+    @classmethod
+    def from_env(cls, environ=os.environ) -> "SnapshotTuning":
+        pfx = "TRANSFERIA_TPU_SNAPSHOT_"
+        return cls(
+            secondary_bootstrap_timeout=env_float(
+                environ, pfx + "BOOTSTRAP_TIMEOUT", 600.0),
+            wait_poll=env_float(environ, pfx + "WAIT_POLL", 0.5),
+            wait_timeout=env_float(
+                environ, pfx + "WAIT_TIMEOUT", 24 * 3600.0),
+            stall_timeout=env_float(
+                environ, pfx + "STALL_TIMEOUT", 600.0),
+            heartbeat_interval=env_float(
+                environ, "TRANSFERIA_TPU_HEARTBEAT_INTERVAL", 5.0),
+        )
+
+
+TUNING = SnapshotTuning.from_env()
 
 
 class SnapshotLoader:
@@ -72,10 +119,15 @@ class SnapshotLoader:
         self.operation_id = operation_id or f"op-{transfer.id}"
         self.metrics = metrics or Metrics()
         self.table_stats = TableStats(self.metrics)
+        self.lease_stats = LeaseStats(self.metrics)
         self.worker_index = transfer.runtime.current_job
         self.process_count = max(1, transfer.runtime.sharding.process_count)
         self.is_main = transfer.runtime.is_main
         self._progress_lock = threading.Lock()
+        # heartbeat-visible progress (folded into operation_health)
+        self._phase = "init"
+        self._local_parts_done = 0
+        self._local_rows_done = 0
         # tables whose scan predicate has been computed (set-once; reads
         # and adds race benignly — worst case one repeat computation)
         self._pushdown_done: set = set()
@@ -330,20 +382,96 @@ class SnapshotLoader:
         return not self.cp.get_operation_state(self.operation_id).get(
             "parts_discovery_done")
 
-    def _wait_all_parts_done(self, poll: float = 0.5,
-                             timeout: float = 24 * 3600.0) -> None:
+    def _wait_all_parts_done(self, poll: Optional[float] = None,
+                             timeout: Optional[float] = None) -> None:
         """Main worker waits for secondaries to drain the queue
-        (load_snapshot.go sharded main join)."""
+        (load_snapshot.go sharded main join).
+
+        Lease-aware: instead of spinning silently for the full timeout,
+        the loop watches part leases and progress.  While any pending
+        part carries a live lease (or progress advances) somebody is
+        alive and working — keep waiting.  When nothing has a live lease
+        and nothing changes for `stall_timeout`, every worker holding
+        work is dead and nobody reclaimed: fail fast with a diagnostic
+        naming the orphaned parts and their last-seen workers."""
+        poll = TUNING.wait_poll if poll is None else poll
+        timeout = TUNING.wait_timeout if timeout is None else timeout
+        self._phase = "waiting"
         deadline = time.monotonic() + timeout
+        last_sig = None
+        last_change = time.monotonic()
         while time.monotonic() < deadline:
-            progress = self.cp.operation_progress(self.operation_id)
-            if progress.done:
+            parts = self.cp.operation_parts(self.operation_id)
+            pending = [p for p in parts if not p.completed]
+            if not pending and (parts or not self._discovery_open()):
                 return
+            now = time.time()
+            sig = (
+                len(parts),
+                sum(1 for p in parts if p.completed),
+                sum(p.completed_rows for p in parts),
+                sum(p.assignment_epoch for p in parts),
+                max((p.lease_expires_at for p in pending), default=0.0),
+            )
+            if sig != last_sig:
+                last_sig = sig
+                last_change = time.monotonic()
+            # a claim without a lease deadline (legacy backend) gives no
+            # liveness signal — treat it as live, never fail fast on it
+            live = [p for p in pending
+                    if p.worker_index is not None
+                    and not lease_expired(p, now)]
+            # fail fast only for a fleet that WAS here and died: some
+            # part must have been claimed at least once.  An entirely
+            # unclaimed queue means secondaries are merely slow to
+            # arrive (pod pending, image pull) — keep waiting.
+            claimed_ever = any(p.assignment_epoch > 0 for p in pending)
+            stalled = time.monotonic() - last_change
+            if not live and claimed_ever and \
+                    stalled > TUNING.stall_timeout:
+                raise CodedError(
+                    Codes.SNAPSHOT_PARTS_ORPHANED,
+                    self._orphan_diagnostic(pending, now, stalled),
+                )
             self.cp.operation_health(self.operation_id, self.worker_index,
-                                     {"phase": "waiting"})
+                                     {"phase": "waiting",
+                                      "pending_parts": len(pending)})
             time.sleep(poll)
         raise TimeoutError(
             f"operation {self.operation_id}: parts not drained in time"
+        )
+
+    def _orphan_diagnostic(self, pending: list[OperationTablePart],
+                           now: float, stalled: float) -> str:
+        """Name each orphaned part, its last-seen worker, and that
+        worker's last heartbeat — the on-call page for a dead fleet."""
+        health = {}
+        try:
+            health = self.cp.get_operation_health(self.operation_id)
+        except Exception:  # diagnostics must not mask the failure
+            logger.exception("operation health read failed")
+        lines = []
+        for p in sorted(pending, key=lambda p: p.key()):
+            holder = p.worker_index if p.worker_index is not None \
+                else p.stolen_from
+            if holder is None:
+                lines.append(f"{p.key()}: never claimed")
+                continue
+            age = now - p.lease_expires_at if p.lease_expires_at > 0 \
+                else None
+            rep = health.get(holder) or {}
+            beat = rep.get("ts")
+            lines.append(
+                f"{p.key()}: last seen on worker {holder}"
+                + (f", lease expired {age:.1f}s ago" if age is not None
+                   else ", no lease")
+                + (f", last heartbeat {now - beat:.1f}s ago"
+                   if beat else ", no heartbeat on record"))
+        return (
+            f"operation {self.operation_id}: {len(lines)} part(s) "
+            f"orphaned — no live lease and no progress for "
+            f"{stalled:.1f}s, and no surviving worker reclaimed them: "
+            + "; ".join(lines)
         )
 
     # -- secondary worker -------------------------------------------------------
@@ -351,7 +479,8 @@ class SnapshotLoader:
         """Sharded secondary (load_snapshot.go:607): wait for the part queue,
         apply the main's sharded source state, clear stale
         self-assignments (restart recovery), pull and upload."""
-        deadline = time.monotonic() + 600
+        self._phase = "bootstrap"
+        deadline = time.monotonic() + TUNING.secondary_bootstrap_timeout
         while not self.cp.operation_parts(self.operation_id):
             if self.cp.get_operation_state(self.operation_id).get(
                     "parts_discovery_done"):
@@ -418,15 +547,83 @@ class SnapshotLoader:
         if node is not None and storage.set_scan_predicate(tid, node):
             logger.info("scan pushdown for %s: %s", tid, node)
 
+    # -- worker liveness: lease-renewal heartbeat ---------------------------
+    def _heartbeat_loop(self, stop: threading.Event) -> None:
+        """Renew this worker's part leases and fold phase/progress into
+        the coordinator's operation_health reports.  Transient renewal
+        failures are tolerated (the lease TTL absorbs several missed
+        beats); a WorkerKilledError kills the heartbeat — the worker is
+        then a zombie whose leases expire and get reclaimed."""
+        while not stop.wait(TUNING.heartbeat_interval):
+            try:
+                failpoint("snapshot.lease_renew")
+                renewed = self.cp.renew_lease(self.operation_id,
+                                              self.worker_index)
+                self.lease_stats.renewals.inc(renewed)
+                with self._progress_lock:
+                    payload = {
+                        "phase": self._phase,
+                        "parts_done": self._local_parts_done,
+                        "rows": self._local_rows_done,
+                        "leases": renewed,
+                    }
+                self.cp.operation_health(self.operation_id,
+                                         self.worker_index, payload)
+            except WorkerKilledError:
+                logger.error(
+                    "worker %d heartbeat killed: lease renewals stop, "
+                    "parts will be reclaimed after expiry",
+                    self.worker_index)
+                return
+            except Exception as e:
+                self.lease_stats.heartbeat_failures.inc()
+                logger.warning("worker %d heartbeat failed "
+                               "(lease TTL absorbs it): %s",
+                               self.worker_index, e)
+
     def _do_upload_tables(self, storage: Storage,
                           schemas: dict) -> None:
         """DoUploadTables (load_snapshot.go:893): ProcessCount workers pull
-        parts from the coordinator until the queue drains."""
+        parts from the coordinator until the queue drains.  A claim is a
+        lease: drained workers linger while other workers hold live
+        leases and reclaim their parts if the leases expire."""
         self._setup_scan_pushdown(storage, schemas)
+        self._phase = "uploading"
         errors: list[BaseException] = []
         err_lock = threading.Lock()
 
         discovery_done = [False]  # latched: the flag never reverts
+
+        def linger_wait() -> bool:
+            """Nothing assignable right now.  True = keep looping (other
+            workers hold live leases — they may die and their parts
+            become stealable), False = queue genuinely done for us."""
+            pending = [p for p in
+                       self.cp.operation_parts(self.operation_id)
+                       if not p.completed]
+            if not pending:
+                return False
+            if all(p.worker_index == self.worker_index
+                   for p in pending):
+                # held by this worker's own sibling threads: they will
+                # finish or error (an error stops every thread above)
+                return False
+            now = time.time()
+            expiries = [p.lease_expires_at - now for p in pending
+                        if p.lease_expires_at > 0]
+            if not expiries:
+                if any(p.worker_index is None for p in pending):
+                    # assign race (e.g. a concurrent clear): the part
+                    # is claimable on the next pass
+                    time.sleep(0.05)
+                    return True
+                # lease-less claims (lease_seconds=0 legacy mode) never
+                # expire — there is nothing to reclaim, so exit as the
+                # pre-lease engine did instead of polling forever
+                return False
+            wait = min(expiries)
+            time.sleep(min(1.0, max(0.05, wait)))
+            return True
 
         def worker():
             idle_sleep = 0.05
@@ -448,8 +645,16 @@ class SnapshotLoader:
                         time.sleep(idle_sleep)
                         idle_sleep = min(1.0, idle_sleep * 2)
                         continue
+                    if linger_wait():
+                        continue
                     return
                 idle_sleep = 0.05
+                if part.stolen_from is not None:
+                    self.lease_stats.steals.inc()
+                    logger.warning(
+                        "part %s reclaimed from worker %d (lease "
+                        "expired; epoch now %d)", part.key(),
+                        part.stolen_from, part.assignment_epoch)
                 try:
                     self._upload_part_with_retry(storage, part, schemas)
                 except BaseException as e:
@@ -457,14 +662,25 @@ class SnapshotLoader:
                         errors.append(e)
                     return
 
-        threads = [
-            threading.Thread(target=worker, name=f"upload-{i}", daemon=True)
-            for i in range(self.process_count)
-        ]
-        for t in threads:
-            t.start()
-        for t in threads:
-            t.join()
+        hb_stop = threading.Event()
+        hb = threading.Thread(target=self._heartbeat_loop,
+                              args=(hb_stop,),
+                              name=f"heartbeat-{self.worker_index}",
+                              daemon=True)
+        hb.start()
+        try:
+            threads = [
+                threading.Thread(target=worker, name=f"upload-{i}",
+                                 daemon=True)
+                for i in range(self.process_count)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+        finally:
+            hb_stop.set()
+            hb.join(timeout=5.0)
         if errors:
             raise errors[0]
 
@@ -524,15 +740,19 @@ class SnapshotLoader:
         if part_sp:
             part_sp.add(transfer_id=self.transfer.id, table=str(tid),
                         part=part.key())
+        futures: deque = deque()
         try:
             with part_sp:
-                futures = []
                 sink.async_push(
                     [init_table_load(tid, schema, part_id)]
                 ).result()
 
                 def pusher(batch):
                     nonlocal rows_done, read_bytes, batch_seq
+                    # worker-death injection point (chaos worker_crash:
+                    # raise:WorkerKilledError kills this worker mid-part,
+                    # leaving the lease to expire for reclamation)
+                    failpoint("snapshot.part.batch")
                     sp = trace.span("batch")
                     with sp:
                         if hasattr(batch, "n_rows"):
@@ -552,9 +772,10 @@ class SnapshotLoader:
                                        rows=len(batch))
                         batch_seq += 1
                         futures.append(sink.async_push(batch))
-                        # bounded in-flight window
+                        # bounded in-flight window (deque: the window
+                        # slides O(1) per batch, not O(n) list shifts)
                         while len(futures) > 32:
-                            futures.pop(0).result()
+                            futures.popleft().result()
 
                 storage.load_table(part.to_description(), pusher)
                 resolve_all(futures)
@@ -567,6 +788,20 @@ class SnapshotLoader:
                 cause=e,
             ) from e
         finally:
+            # drain/cancel in-flight pushes BEFORE close: on a pusher
+            # error, close() must not race pushes still running in the
+            # sink's executor (a torn close can double-land a batch)
+            while futures:
+                f = futures.popleft()
+                if not f.cancel():
+                    try:
+                        f.result(timeout=60.0)
+                    # deliberate swallow: this is the error path's drain —
+                    # the first failure is already propagating as
+                    # TableUploadError above; secondary push errors here
+                    # would only mask it
+                    except Exception:  # trtpu: ignore[EXC001]
+                        pass
             sink.close()
         part.completed = True
         part.completed_rows = rows_done
@@ -588,9 +823,25 @@ class SnapshotLoader:
                     {out.fqtn(): a.digest() for out, a in aggs.items()},
                     sort_keys=True)
         with self._progress_lock:
-            self.cp.update_operation_parts(self.operation_id, [part])
-            self.table_stats.completed_parts.inc()
-            self.table_stats.completed_rows.inc(rows_done)
+            rejected = self.cp.update_operation_parts(
+                self.operation_id, [part])
+            if not rejected:
+                self.table_stats.completed_parts.inc()
+                self.table_stats.completed_rows.inc(rows_done)
+                self._local_parts_done += 1
+                self._local_rows_done += rows_done
+        if rejected:
+            # epoch fence: our lease expired mid-part and the part was
+            # reclaimed — the new owner's completion is authoritative,
+            # our rows are at-least-once duplicates.  Do NOT fail the
+            # worker: drop the stale result and claim the next part
+            # (which re-leases us).
+            self.lease_stats.fence_rejected.inc(len(rejected))
+            logger.warning(
+                "part %s completion fenced (stale epoch %d): lease "
+                "expired and the part was reclaimed; dropping result",
+                part.key(), part.assignment_epoch)
+            return
         # device counters surface on this pipeline's metrics as parts
         # complete (H2D/D2H bytes, launches, XLA compiles)
         trace.TELEMETRY.fold_into(self.metrics)
