@@ -1244,8 +1244,31 @@ def _trace_out_path() -> str:
     return out
 
 
+def measure_interchange() -> dict:
+    """`--interchange`: the Arrow interchange plane's shard-handoff
+    stage — identical sample batches moved via the row-pivot baseline
+    (ChangeItems out and back), the Arrow IPC stream, the shared-memory
+    segment, and loopback Flight; reports rows/s per path plus the
+    zero-copy buffer ratio (interchange/bench.py).  The acceptance bar
+    is the IPC-or-shm path beating the pivot baseline by >= 2x."""
+    from transferia_tpu.interchange.bench import run_interchange_bench
+
+    rows = int(os.environ.get("BENCH_INTERCHANGE_ROWS", 500_000))
+    return run_interchange_bench(rows=rows, batch_rows=65_536)
+
+
 def main() -> None:
     from transferia_tpu.stats import stagetimer
+
+    if "--interchange" in sys.argv[1:]:
+        # standalone stage: one stdout JSON line, diagnostics on stderr
+        from transferia_tpu.interchange.bench import format_report
+
+        report = measure_interchange()
+        for line in format_report(report).splitlines():
+            print(f"# {line}", file=sys.stderr)
+        print(json.dumps(report))
+        return
 
     fallback = None
     if not _device_available():
@@ -1426,6 +1449,13 @@ def main() -> None:
     except Exception as e:
         print(f"# fingerprint bench failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+    if os.environ.get("BENCH_SKIP_INTERCHANGE") != "1":
+        try:
+            ichg = measure_interchange()
+            print(f"# {json.dumps(ichg)}", file=sys.stderr)
+        except Exception as e:
+            print(f"# interchange bench failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
     # remaining BASELINE configs (each prints one tail line; failures
     # never mask the headline, which already printed)
     if os.environ.get("BENCH_SKIP_KAFKA2CH") != "1":

@@ -26,3 +26,19 @@ try:
         )
 except ImportError:  # pragma: no cover - jax is an optional extra;
     pass  # non-jax test files still run without it
+
+
+def pytest_collection_modifyitems(config, items):
+    """Auto-skip `requires_pyarrow`-marked tests when pyarrow is absent
+    (pyarrow is an optional extra: `pip install 'transferia-tpu[arrow]'`)."""
+    from transferia_tpu.interchange._pyarrow import have_pyarrow
+
+    if have_pyarrow():
+        return
+    import pytest
+
+    skip = pytest.mark.skip(
+        reason="pyarrow not installed; pip install 'transferia-tpu[arrow]'")
+    for item in items:
+        if "requires_pyarrow" in item.keywords:
+            item.add_marker(skip)

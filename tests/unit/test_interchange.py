@@ -1,0 +1,605 @@
+"""Arrow interchange plane tests (transferia_tpu/interchange/).
+
+Covers: property-style ColumnBatch→Arrow→ColumnBatch round trips over
+every CanonicalType (nulls, empty batches, var-width spanning many
+offset pages), zero-copy proof via buffer pointer identity in BOTH
+directions, IPC stream/file/fd framing, the arrow_ipc provider through
+the real snapshot engine, shared-memory handoff, and a Flight loopback
+end-to-end (wire path, shm negotiation, re-put replacement, failpoint
+propagation).
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import tempfile
+
+import numpy as np
+import pytest
+
+from transferia_tpu.abstract.schema import (
+    CanonicalType,
+    ColSchema,
+    TableID,
+    TableSchema,
+)
+from transferia_tpu.columnar.batch import Column, ColumnBatch
+from transferia_tpu.interchange.telemetry import TELEMETRY
+
+requires_pyarrow = pytest.mark.requires_pyarrow
+
+TID = TableID("ns", "t")
+
+_SAMPLES = {
+    CanonicalType.INT8: [1, -2, None, 127],
+    CanonicalType.INT16: [300, None, -300, 0],
+    CanonicalType.INT32: [1 << 20, None, -5, 7],
+    CanonicalType.INT64: [1 << 40, -(1 << 40), None, 0],
+    CanonicalType.UINT8: [0, 255, None, 3],
+    CanonicalType.UINT16: [0, 65_535, None, 9],
+    CanonicalType.UINT32: [0, 1 << 31, None, 2],
+    CanonicalType.UINT64: [0, 1 << 60, None, 4],
+    CanonicalType.FLOAT: [1.5, None, -2.25, 0.0],
+    CanonicalType.DOUBLE: [1e300, None, -0.5, 3.25],
+    CanonicalType.BOOLEAN: [True, False, None, True],
+    CanonicalType.DATE: [19_000, None, 0, 1],
+    CanonicalType.DATETIME: [1_700_000_000, None, 0, -1],
+    CanonicalType.TIMESTAMP: [1_700_000_000_000_000, None, 0, 5],
+    CanonicalType.INTERVAL: [86_400_000_000, None, -1, 0],
+    CanonicalType.STRING: [b"bytes", b"", None, "é".encode()],
+    CanonicalType.UTF8: ["hello", "", None, "é世界"],
+    CanonicalType.ANY: [{"k": [1, 2]}, None, "str", 3],
+    CanonicalType.DECIMAL: ["3.14", None, "-0.001", "0"],
+}
+
+
+def _one_col_batch(ctype: CanonicalType, values) -> ColumnBatch:
+    schema = TableSchema([ColSchema(name="c", data_type=ctype)])
+    return ColumnBatch.from_pydict(TID, schema, {"c": values})
+
+
+@requires_pyarrow
+@pytest.mark.parametrize("ctype", list(_SAMPLES))
+def test_roundtrip_every_canonical_type(ctype):
+    from transferia_tpu.interchange.convert import (
+        arrow_to_batch,
+        batch_to_arrow,
+    )
+
+    b = _one_col_batch(ctype, _SAMPLES[ctype])
+    back = arrow_to_batch(batch_to_arrow(b))
+    assert back.table_id == TID
+    # canonical type survives (no UTF8 degradation for ANY/DECIMAL/STRING)
+    assert back.schema.find("c").data_type == ctype
+    assert back.to_pydict() == b.to_pydict()
+
+
+@requires_pyarrow
+@pytest.mark.parametrize("ctype", list(_SAMPLES))
+def test_roundtrip_no_nulls(ctype):
+    from transferia_tpu.interchange.convert import (
+        arrow_to_batch,
+        batch_to_arrow,
+    )
+
+    values = [v for v in _SAMPLES[ctype] if v is not None]
+    b = _one_col_batch(ctype, values)
+    back = arrow_to_batch(batch_to_arrow(b))
+    assert back.to_pydict() == b.to_pydict()
+    assert back.columns["c"].validity is None
+
+
+@requires_pyarrow
+def test_roundtrip_empty_batch():
+    from transferia_tpu.interchange.convert import (
+        arrow_to_batch,
+        batch_to_arrow,
+    )
+
+    for ctype in (CanonicalType.INT64, CanonicalType.UTF8):
+        b = _one_col_batch(ctype, [])
+        back = arrow_to_batch(batch_to_arrow(b))
+        assert back.n_rows == 0
+        assert back.to_pydict() == b.to_pydict()
+
+
+@requires_pyarrow
+def test_roundtrip_large_varwidth():
+    """Var-width data far beyond one offsets page keeps exact bytes."""
+    from transferia_tpu.interchange.convert import (
+        arrow_to_batch,
+        batch_to_arrow,
+    )
+
+    rng = np.random.default_rng(7)
+    values = ["x" * int(n) for n in rng.integers(0, 300, 5000)]
+    b = _one_col_batch(CanonicalType.UTF8, values)
+    back = arrow_to_batch(batch_to_arrow(b))
+    assert back.to_pydict() == b.to_pydict()
+
+
+@requires_pyarrow
+def test_zero_copy_pointer_identity_both_directions():
+    from transferia_tpu.interchange.convert import (
+        arrow_to_batch,
+        batch_to_arrow,
+    )
+
+    schema = TableSchema([
+        ColSchema(name="i", data_type=CanonicalType.INT64),
+        ColSchema(name="f", data_type=CanonicalType.DOUBLE),
+        ColSchema(name="s", data_type=CanonicalType.UTF8),
+    ])
+    b = ColumnBatch.from_pydict(TID, schema, {
+        "i": list(range(1000)),
+        "f": [float(i) for i in range(1000)],
+        "s": [f"v{i}" for i in range(1000)],
+    })
+    TELEMETRY.reset()
+    rb = batch_to_arrow(b)
+    # forward: the arrow buffers ARE the numpy buffers
+    for name in ("i", "f"):
+        idx = rb.schema.get_field_index(name)
+        assert rb.column(idx).buffers()[1].address == \
+            b.columns[name].data.ctypes.data
+    sidx = rb.schema.get_field_index("s")
+    sbufs = rb.column(sidx).buffers()
+    assert sbufs[1].address == b.columns["s"].offsets.ctypes.data
+    assert sbufs[2].address == b.columns["s"].data.ctypes.data
+    # backward: the numpy views address the arrow buffers
+    back = arrow_to_batch(rb)
+    for name in ("i", "f"):
+        idx = rb.schema.get_field_index(name)
+        assert back.columns[name].data.__array_interface__["data"][0] \
+            == rb.column(idx).buffers()[1].address
+    assert back.columns["s"].data.__array_interface__["data"][0] \
+        == sbufs[2].address
+    snap = TELEMETRY.snapshot()
+    assert snap["zero_copy_buffers"] > 0
+    assert snap["copied_buffers"] == 0
+
+
+@requires_pyarrow
+def test_sliced_arrow_batch_imports_correctly():
+    import pyarrow as pa
+
+    from transferia_tpu.interchange.convert import arrow_to_batch
+
+    rb = pa.record_batch({
+        "i": pa.array(range(100), type=pa.int64()),
+        "s": pa.array([f"s{i}" for i in range(100)]),
+    })
+    sliced = rb.slice(10, 20)
+    b = arrow_to_batch(sliced, table_id=TID)
+    assert b.n_rows == 20
+    assert b.columns["i"].to_pylist() == list(range(10, 30))
+    assert b.columns["s"].to_pylist() == [f"s{i}" for i in range(10, 30)]
+
+
+@requires_pyarrow
+def test_cdc_sidecars_roundtrip():
+    from transferia_tpu.interchange.convert import (
+        arrow_to_batch,
+        batch_to_arrow,
+    )
+
+    schema = TableSchema([ColSchema(name="c",
+                                    data_type=CanonicalType.INT32)])
+    b = ColumnBatch.from_pydict(
+        TID, schema, {"c": [1, 2, 3]},
+        kinds=np.array([0, 1, 2], dtype=np.int8),
+        lsns=np.array([10, 11, 12], dtype=np.int64),
+        commit_times=np.array([7, 8, 9], dtype=np.int64),
+        part_id="t_0_4",
+    )
+    back = arrow_to_batch(batch_to_arrow(b))
+    assert back.kinds.tolist() == [0, 1, 2]
+    assert back.lsns.tolist() == [10, 11, 12]
+    assert back.commit_times.tolist() == [7, 8, 9]
+    assert back.part_id == "t_0_4"
+    # sidecars never leak into user-visible columns
+    assert set(back.columns) == {"c"}
+
+
+@requires_pyarrow
+def test_dict_encoded_column_roundtrip():
+    """A lazily dict-encoded column crosses as a DictionaryArray and
+    comes back dict-encoded (pool shared, no flat materialization)."""
+    import pyarrow as pa
+
+    from transferia_tpu.interchange.convert import (
+        arrow_to_batch,
+        batch_to_arrow,
+    )
+
+    dict_arr = pa.DictionaryArray.from_arrays(
+        pa.array([0, 1, 0, 2, 1], type=pa.int32()),
+        pa.array(["aa", "bb", "cc"]))
+    rb = pa.record_batch([dict_arr], names=["d"])
+    b = arrow_to_batch(rb, table_id=TID)
+    assert b.columns["d"].is_lazy_dict
+    rb2 = batch_to_arrow(b)
+    assert pa.types.is_dictionary(rb2.column(0).type)
+    back = arrow_to_batch(rb2, table_id=TID)
+    assert back.columns["d"].to_pylist() == \
+        ["aa", "bb", "aa", "cc", "bb"]
+
+
+# -- ipc framing -------------------------------------------------------------
+
+@requires_pyarrow
+def test_ipc_stream_roundtrip_buffer_and_fd():
+    from transferia_tpu.interchange import ipc
+    from transferia_tpu.providers.sample import make_batch
+
+    tid = TableID("sample", "events")
+    batches = [make_batch("iot", tid, i * 100, 100, 7) for i in range(3)]
+    buf = io.BytesIO()
+    w = ipc.StreamWriter(buf)
+    for b in batches:
+        w.write(b)
+    w.finish()
+    payload = buf.getvalue()
+    back = list(ipc.iter_stream(io.BytesIO(payload)))
+    assert sum(b.n_rows for b in back) == 300
+    assert back[0].table_id == tid
+    assert back[0].to_pydict() == batches[0].to_pydict()
+
+    # fd-backed: write the stream through a pipe
+    r_fd, w_fd = os.pipe()
+    with ipc.open_location(f"fd://{w_fd}", "wb") as fh:
+        fh.write(payload)
+    with ipc.open_location(f"fd://{r_fd}", "rb") as fh:
+        back2 = list(ipc.iter_stream(fh))
+    assert sum(b.n_rows for b in back2) == 300
+
+
+@requires_pyarrow
+def test_arrow_ipc_fd_source_rejects_reread():
+    """A pipe-backed stream cannot rewind: a part retry must fail
+    loudly instead of silently resuming mid-stream with rows missing."""
+    from transferia_tpu.abstract.table import TableDescription
+    from transferia_tpu.interchange import ipc
+    from transferia_tpu.providers.arrow_ipc import (
+        ArrowIpcSourceParams,
+        ArrowIpcStorage,
+    )
+    from transferia_tpu.providers.sample import make_batch
+
+    tid = TableID("sample", "events")
+    buf = io.BytesIO()
+    w = ipc.StreamWriter(buf)
+    w.write(make_batch("iot", tid, 0, 50, 7))
+    w.finish()
+    r_fd, w_fd = os.pipe()
+    with os.fdopen(w_fd, "wb") as fh:
+        fh.write(buf.getvalue())
+    st = ArrowIpcStorage(ArrowIpcSourceParams(path=f"fd://{r_fd}"))
+    got = []
+    st.load_table(TableDescription(id=tid), got.append)
+    assert sum(b.n_rows for b in got) == 50
+    with pytest.raises(RuntimeError, match="single-shot"):
+        st.load_table(TableDescription(id=tid), got.append)
+
+
+@requires_pyarrow
+def test_arrow_ipc_provider_snapshot_to_memory():
+    from transferia_tpu.coordinator.memory import MemoryCoordinator
+    from transferia_tpu.interchange import ipc
+    from transferia_tpu.models import Transfer, TransferType
+    from transferia_tpu.providers.arrow_ipc import ArrowIpcSourceParams
+    from transferia_tpu.providers.memory import (
+        MemoryTargetParams,
+        get_store,
+    )
+    from transferia_tpu.providers.sample import make_batch
+    from transferia_tpu.tasks import SnapshotLoader
+
+    tid = TableID("sample", "events")
+    with tempfile.TemporaryDirectory() as d:
+        for p in range(2):
+            ipc.write_stream(
+                os.path.join(d, f"part{p}.arrows"),
+                [make_batch("iot", tid, p * 500, 500, 7)])
+        store = get_store("test-ipc-e2e")
+        store.clear()
+        t = Transfer(
+            id="test-ipc-e2e", type=TransferType.SNAPSHOT_ONLY,
+            src=ArrowIpcSourceParams(path=d),
+            dst=MemoryTargetParams(sink_id="test-ipc-e2e"))
+        SnapshotLoader(t, MemoryCoordinator()).upload_tables()
+        assert store.row_count() == 1000
+        assert store.tables() == {tid}
+        store.clear()
+
+
+@requires_pyarrow
+def test_arrow_ipc_sink_writes_readable_streams():
+    from transferia_tpu.abstract.table import TableDescription
+    from transferia_tpu.providers.arrow_ipc import (
+        ArrowIpcSinker,
+        ArrowIpcSourceParams,
+        ArrowIpcStorage,
+        ArrowIpcTargetParams,
+    )
+    from transferia_tpu.providers.sample import make_batch
+
+    tid = TableID("sample", "events")
+    with tempfile.TemporaryDirectory() as d:
+        sink = ArrowIpcSinker(ArrowIpcTargetParams(path=d + os.sep))
+        sink.push(make_batch("iot", tid, 0, 400, 7))
+        sink.push(make_batch("iot", tid, 400, 400, 7))
+        sink.close()
+        st = ArrowIpcStorage(ArrowIpcSourceParams(path=d))
+        rows = []
+        st.load_table(TableDescription(id=tid), rows.append)
+        assert sum(b.n_rows for b in rows) == 800
+
+
+@requires_pyarrow
+def test_arrow_ipc_single_stream_rejects_second_table():
+    from transferia_tpu.providers.arrow_ipc import (
+        ArrowIpcSinker,
+        ArrowIpcTargetParams,
+    )
+    from transferia_tpu.providers.sample import make_batch
+
+    with tempfile.TemporaryDirectory() as d:
+        sink = ArrowIpcSinker(ArrowIpcTargetParams(
+            path=os.path.join(d, "one.arrows")))
+        sink.push(make_batch("iot", TableID("a", "t1"), 0, 10, 7))
+        with pytest.raises(ValueError, match="single"):
+            sink.push(make_batch("iot", TableID("a", "t2"), 0, 10, 7))
+        sink.close()
+
+
+# -- shm ---------------------------------------------------------------------
+
+@requires_pyarrow
+def test_shm_segment_roundtrip():
+    from transferia_tpu.interchange import shm
+    from transferia_tpu.providers.sample import make_batch
+
+    b = make_batch("users", TableID("s", "u"), 0, 1000, 3)
+    handle = shm.write_segment([b])
+    try:
+        att = shm.attach(handle)
+        got = att.batches()
+        assert len(got) == 1
+        assert got[0].to_pydict() == b.to_pydict()
+        # the adopted buffers are read-only views over the mapping
+        assert not got[0].columns["user_id"].data.flags.writeable
+        del got
+        att.close()
+    finally:
+        shm.unlink_segment(handle)
+
+
+@requires_pyarrow
+def test_shm_attach_missing_segment_raises():
+    from transferia_tpu.interchange import shm
+
+    with pytest.raises(FileNotFoundError):
+        shm.attach(shm.ShmHandle(name="trtpu-nonexistent-seg", size=64))
+
+
+# -- flight ------------------------------------------------------------------
+
+@requires_pyarrow
+def test_flight_loopback_end_to_end():
+    fl = pytest.importorskip("pyarrow.flight")  # noqa: F841
+
+    from transferia_tpu.interchange.flight import (
+        FlightShardClient,
+        ShardFlightServer,
+    )
+    from transferia_tpu.providers.sample import make_batch
+
+    tid = TableID("sample", "events")
+    b = make_batch("iot", tid, 0, 2000, 7)
+    with ShardFlightServer(enable_shm=True) as srv:
+        with FlightShardClient(srv.location) as cli:
+            assert cli.put_part("sample.events/0",
+                                [b.slice(0, 1000), b.slice(1000, 2000)]) \
+                == 2000
+            assert cli.keys() == ["sample.events/0"]
+            # shm-negotiated local path
+            got = cli.get_part("sample.events/0")
+            assert sum(g.n_rows for g in got) == 2000
+            assert ColumnBatch.concat(got).to_pydict() == b.to_pydict()
+            # forced wire path
+            cli.allow_shm = False
+            got_wire = cli.get_part("sample.events/0")
+            assert ColumnBatch.concat(got_wire).to_pydict() == \
+                b.to_pydict()
+            # re-put REPLACES (retry semantics), never appends
+            cli.put_part("sample.events/0", [b.slice(0, 500)])
+            got2 = cli.get_part("sample.events/0")
+            assert sum(g.n_rows for g in got2) == 500
+            infos = cli.list_parts()
+            assert [i.total_records for i in infos] == [500]
+            cli.drop("sample.events/0")
+            assert cli.keys() == []
+
+
+@requires_pyarrow
+def test_flight_provider_snapshot_to_memory():
+    pytest.importorskip("pyarrow.flight")
+
+    from transferia_tpu.coordinator.memory import MemoryCoordinator
+    from transferia_tpu.interchange.flight import ShardFlightServer
+    from transferia_tpu.models import Transfer, TransferType
+    from transferia_tpu.providers.flight import (
+        FlightSourceParams,
+        part_key,
+    )
+    from transferia_tpu.providers.memory import (
+        MemoryTargetParams,
+        get_store,
+    )
+    from transferia_tpu.providers.sample import make_batch
+    from transferia_tpu.tasks import SnapshotLoader
+
+    tid = TableID("sample", "events")
+    with ShardFlightServer() as srv:
+        for p in range(3):
+            srv.publish(part_key(tid, str(p)),
+                        [make_batch("iot", tid, p * 300, 300, 7)])
+        store = get_store("test-flight-e2e")
+        store.clear()
+        t = Transfer(
+            id="test-flight-e2e", type=TransferType.SNAPSHOT_ONLY,
+            src=FlightSourceParams(uri=srv.location, allow_shm=False),
+            dst=MemoryTargetParams(sink_id="test-flight-e2e"))
+        SnapshotLoader(t, MemoryCoordinator()).upload_tables()
+        assert store.row_count() == 900
+        store.clear()
+
+
+@requires_pyarrow
+def test_flight_failpoint_propagates_to_client():
+    fl = pytest.importorskip("pyarrow.flight")
+
+    from transferia_tpu.chaos import failpoints
+    from transferia_tpu.interchange.flight import (
+        FlightShardClient,
+        ShardFlightServer,
+    )
+    from transferia_tpu.providers.sample import make_batch
+
+    b = make_batch("iot", TableID("s", "e"), 0, 100, 7)
+    with ShardFlightServer() as srv:
+        srv.publish("s.e/0", [b])
+        with failpoints.active(
+                "interchange.flight.do_get=after:0,times:1,"
+                "raise:ConnectionError", seed=1):
+            with FlightShardClient(srv.location, allow_shm=False) as cli:
+                with pytest.raises(fl.FlightError):
+                    cli.get_part("s.e/0")
+                # the injected fault is one-shot: the retry succeeds
+                got = cli.get_part("s.e/0")
+                assert sum(g.n_rows for g in got) == 100
+
+
+# -- telemetry / stats -------------------------------------------------------
+
+@requires_pyarrow
+def test_telemetry_folds_into_metrics():
+    from transferia_tpu.interchange.convert import (
+        arrow_to_batch,
+        batch_to_arrow,
+    )
+    from transferia_tpu.stats.registry import Metrics
+
+    TELEMETRY.reset()
+    b = _one_col_batch(CanonicalType.INT64, list(range(100)))
+    arrow_to_batch(batch_to_arrow(b))
+    m = Metrics()
+    TELEMETRY.fold_into(m)
+    assert m.value("interchange_zero_copy_buffers") > 0
+    assert m.value("interchange_batches_in") == 1
+    assert m.value("interchange_batches_out") == 1
+    before = m.value("interchange_zero_copy_buffers")
+    TELEMETRY.fold_into(m)  # idempotent: no new deltas
+    assert m.value("interchange_zero_copy_buffers") == before
+
+
+def test_providers_registered():
+    from transferia_tpu.providers.registry import registered_providers
+
+    names = registered_providers()
+    assert "arrow_ipc" in names
+    assert "flight" in names
+
+
+@requires_pyarrow
+def test_interchange_bench_smoke():
+    """The bench harness itself (tiny rows): every path present, the
+    zero-copy counter nonzero — the acceptance-criteria probes."""
+    from transferia_tpu.interchange.bench import run_interchange_bench
+
+    r = run_interchange_bench(rows=2000, batch_rows=1000,
+                              with_flight=False)
+    assert r["paths"]["pivot"]["rows_per_sec"] > 0
+    assert r["paths"]["ipc"]["rows_per_sec"] > 0
+    assert r["paths"]["shm"]["rows_per_sec"] > 0
+    assert r["zero_copy_buffers"] > 0
+
+
+# -- Column.take fast paths (no pyarrow needed) ------------------------------
+
+class TestTakeFastPaths:
+    def _fixed(self, n=64):
+        return Column("x", CanonicalType.INT64,
+                      np.arange(n, dtype=np.int64))
+
+    def _var(self):
+        vals = [f"v{i}".encode() for i in range(50)]
+        c = Column.from_pylist("s", CanonicalType.STRING, vals)
+        return c, vals
+
+    def test_contiguous_fixed_returns_view(self):
+        c = self._fixed()
+        t = c.take(np.arange(10, 30))
+        assert np.shares_memory(t.data, c.data)
+        assert t.to_pylist() == list(range(10, 30))
+
+    def test_contiguous_varwidth_data_stays_view(self):
+        c, vals = self._var()
+        t = c.take(np.arange(5, 20))
+        assert np.shares_memory(t.data, c.data)
+        assert t.to_pylist() == vals[5:20]
+
+    def test_prefix_varwidth_offsets_stay_view(self):
+        c, vals = self._var()
+        t = c.take(np.arange(0, 20))
+        assert np.shares_memory(t.offsets, c.offsets)
+        assert t.to_pylist() == vals[:20]
+
+    def test_out_of_bounds_contiguous_range_still_raises(self):
+        # the view fast path must not clamp what numpy used to reject
+        c = self._fixed(6)
+        with pytest.raises(IndexError):
+            c.take(np.array([4, 5, 6, 7], dtype=np.int64))
+
+    def test_out_of_bounds_gather_raises(self):
+        c = self._fixed(6)
+        with pytest.raises(IndexError):
+            c.take(np.array([0, 99], dtype=np.int64))
+
+    def test_negative_indices_keep_numpy_semantics(self):
+        c = self._fixed(10)
+        assert c.take(np.array([-1, 0, -2], dtype=np.int64)) \
+            .to_pylist() == [9, 0, 8]
+
+    def test_noncontiguous_gather_matches_numpy(self):
+        c = self._fixed(200)
+        idx = np.array([5, 3, 199, 0, 77, 77], dtype=np.int64)
+        assert c.take(idx).to_pylist() == \
+            c.data[idx].tolist()
+
+    def test_every_fixed_width_gathers(self):
+        idx = np.array([3, 0, 2], dtype=np.int64)
+        for ctype in (CanonicalType.INT8, CanonicalType.INT16,
+                      CanonicalType.INT32, CanonicalType.INT64,
+                      CanonicalType.FLOAT, CanonicalType.DOUBLE,
+                      CanonicalType.BOOLEAN):
+            c = Column.from_pylist("c", ctype, [1, 0, 1, 1])
+            assert c.take(idx).to_pylist() == \
+                [c.value(int(i)) for i in idx]
+
+    def test_validity_follows_fast_paths(self):
+        c = Column.from_pylist("c", CanonicalType.INT64,
+                               [1, None, 3, None, 5])
+        t = c.take(np.arange(1, 4))
+        assert t.to_pylist() == [None, 3, None]
+
+    def test_batch_slice_uses_views(self):
+        schema = TableSchema([
+            ColSchema(name="i", data_type=CanonicalType.INT64)])
+        b = ColumnBatch.from_pydict(TID, schema,
+                                    {"i": list(range(100))})
+        s = b.slice(10, 40)
+        assert np.shares_memory(s.columns["i"].data, b.columns["i"].data)
+        assert s.n_rows == 30

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import contextlib
 import logging
+import os
 import random
 import threading
 import time
@@ -68,6 +69,14 @@ REPLICATION_SITES = (
     "transform.chain",
     "sink.push",
     "sink.push.torn",
+)
+ARROW_IPC_SITES = (
+    "interchange.ipc.read",
+    "transform.chain",
+    "device.dispatch",
+    "sink.push",
+    "sink.push.torn",
+    "coordinator.set_op_state",
 )
 
 
@@ -233,7 +242,11 @@ def default_schedule(mode: str, trial: int, seed: int,
     triggers only (after/every/times): the fire sequence is then exact
     per site-hit-index, which is what `--seed` replay promises."""
     rng = random.Random(f"{seed}:{mode}:{trial}")
-    sites = SNAPSHOT_SITES if mode == "snapshot" else REPLICATION_SITES
+    sites = {
+        "snapshot": SNAPSHOT_SITES,
+        "replication": REPLICATION_SITES,
+        "arrow_ipc": ARROW_IPC_SITES,
+    }.get(mode, SNAPSHOT_SITES)
     clauses = []
     for site in sites:
         if site == "device.dispatch" and not device_ok:
@@ -248,7 +261,7 @@ def default_schedule(mode: str, trial: int, seed: int,
         # `after` gates or they never fire; the whole replication
         # pipeline is low-traffic (a 300-message topic drains in ~one
         # fetched batch per partition per attempt)
-        low_traffic = mode == "replication" or site in (
+        low_traffic = mode in ("replication", "arrow_ipc") or site in (
             "coordinator.set_op_state", "storage.part.open")
         after = rng.randrange(0, 3 if low_traffic else 8)
         times = 1 if low_traffic else rng.randrange(1, 3)
@@ -353,6 +366,118 @@ def run_snapshot_trial(trial: int, seed: int, rows: int,
             f"{run_error}"))
     store.clear()
     return TrialResult(mode="snapshot", trial=trial, seed=seed,
+                       spec=spec, verdict=verdict, fire_counts=fires,
+                       fire_log=log, restarts=restarts, seconds=seconds)
+
+
+# -- arrow_ipc mode ----------------------------------------------------------
+#
+# The same snapshot delivery audit over the Arrow interchange plane:
+# the source is an `arrow_ipc` stream directory (4 shardable stream
+# files of the deterministic sample data) instead of the generator, so
+# faults hit the IPC read path (`interchange.ipc.read`) next to the
+# usual transform/sink/coordinator sites and the auditor proves the
+# zero-copy wire upholds the same at-least-once contract.
+
+def _arrow_ipc_dataset(rows: int) -> str:
+    """Write the sample table as 4 IPC stream files; returns the dir."""
+    import tempfile
+
+    from transferia_tpu.abstract.schema import TableID
+    from transferia_tpu.interchange import ipc
+    from transferia_tpu.providers.sample import make_batch
+
+    d = tempfile.mkdtemp(prefix="chaos-arrow-ipc-")
+    tid = TableID("sample", "events")
+    parts = 4
+    per = (rows + parts - 1) // parts
+    bs = max(64, rows // 8)
+    for p in range(parts):
+        lo, hi = p * per, min(rows, (p + 1) * per)
+        if lo >= hi:
+            break
+        batches = [make_batch("iot", tid, start, min(bs, hi - start), 7)
+                   for start in range(lo, hi, bs)]
+        ipc.write_stream(
+            os.path.join(d, f"sample.events.part{p}.arrows"), batches)
+    return d
+
+
+def _arrow_ipc_transfer(dataset_dir: str, sink_id: str) -> Transfer:
+    from transferia_tpu.providers.arrow_ipc import ArrowIpcSourceParams
+    from transferia_tpu.providers.memory import MemoryTargetParams
+
+    t = Transfer(
+        id="chaos-arrow-ipc",
+        type=TransferType.SNAPSHOT_ONLY,
+        src=ArrowIpcSourceParams(path=dataset_dir),
+        dst=MemoryTargetParams(sink_id=sink_id),
+        transformation={"transformers": [
+            {"mask_field": {"columns": ["device_id"], "salt": "chaos"}},
+            {"filter_rows": {"filter": "temperature > -1000"}},
+        ]},
+        validation={"fingerprint": True},
+    )
+    t.runtime.sharding.process_count = 1
+    return t
+
+
+def _arrow_ipc_reference(dataset_dir: str) -> DeliveryReference:
+    from transferia_tpu.providers.memory import get_store
+
+    store = get_store("chaos-ipc-ref")
+    store.clear()
+    _run_snapshot_once(_arrow_ipc_transfer(dataset_dir, "chaos-ipc-ref"),
+                       MemoryCoordinator())
+    ref = DeliveryReference.from_batches(store.batches)
+    store.clear()
+    return ref
+
+
+def run_arrow_ipc_trial(trial: int, seed: int, dataset_dir: str,
+                        reference: DeliveryReference,
+                        spec: Optional[str] = None,
+                        device_ok: bool = True) -> TrialResult:
+    from transferia_tpu.providers.memory import get_store
+    from transferia_tpu.tasks.snapshot import PART_RETRIES
+
+    sink_id = "chaos-ipc-trial"
+    store = get_store(sink_id)
+    store.clear()
+    spec = spec if spec is not None else default_schedule(
+        "arrow_ipc", trial, seed, device_ok)
+    tracker = MonotonicityTracker()
+    cp = AuditingCoordinator(MemoryCoordinator(), tracker)
+    transfer = _arrow_ipc_transfer(dataset_dir, sink_id)
+    restarts = 0
+    run_error: Optional[BaseException] = None
+    t0 = time.monotonic()
+    with failpoints.active(spec, seed=seed * 1000 + trial):
+        for attempt in range(MAX_SNAPSHOT_RUNS):
+            try:
+                _run_snapshot_once(transfer, cp)
+                run_error = None
+                break
+            except Exception as e:
+                run_error = e
+                restarts += 1
+                logger.info("chaos arrow_ipc run %d failed (%s); "
+                            "re-activating", attempt + 1, e)
+        fires = failpoints.fire_counts()
+        log = failpoints.fire_log()
+    seconds = time.monotonic() - t0
+    from transferia_tpu.middlewares.sync import SINK_PUSH_ATTEMPTS
+
+    bound = (restarts + 1) * PART_RETRIES * SINK_PUSH_ATTEMPTS
+    verdict = audit_delivery(reference, store.batches, bound, tracker)
+    if run_error is not None:
+        verdict.passed = False
+        verdict.violations.append(Violation(
+            "run-completed",
+            f"arrow_ipc snapshot never completed in {MAX_SNAPSHOT_RUNS} "
+            f"runs: {run_error}"))
+    store.clear()
+    return TrialResult(mode="arrow_ipc", trial=trial, seed=seed,
                        spec=spec, verdict=verdict, fire_counts=fires,
                        fire_log=log, restarts=restarts, seconds=seconds)
 
@@ -701,9 +826,15 @@ def run_trials(trials: int = 5, seed: int = 7, mode: str = "both",
     if mode == "both":
         modes = ("snapshot", "replication")
     elif mode == "all":
-        modes = ("snapshot", "replication", "worker_crash")
+        modes = ("snapshot", "replication", "worker_crash", "arrow_ipc")
     else:
         modes = (mode,)
+    if "arrow_ipc" in modes:
+        from transferia_tpu.interchange._pyarrow import have_pyarrow
+
+        if not have_pyarrow():
+            logger.warning("chaos: skipping arrow_ipc mode (no pyarrow)")
+            modes = tuple(m for m in modes if m != "arrow_ipc")
     with _fast_retries(), _forced_device_placement() as device_ok:
         if "snapshot" in modes:
             ref = _snapshot_reference(rows)
@@ -720,6 +851,21 @@ def run_trials(trials: int = 5, seed: int = 7, mode: str = "both",
                 report.results.append(r)
                 logger.info("chaos worker_crash trial %d: %s", t,
                             r.verdict.summary().splitlines()[0])
+        if "arrow_ipc" in modes:
+            import shutil
+
+            dataset = _arrow_ipc_dataset(rows)
+            try:
+                ref = _arrow_ipc_reference(dataset)
+                for t in range(trials):
+                    r = run_arrow_ipc_trial(t, seed, dataset, ref,
+                                            spec=spec,
+                                            device_ok=bool(device_ok))
+                    report.results.append(r)
+                    logger.info("chaos arrow_ipc trial %d: %s", t,
+                                r.verdict.summary().splitlines()[0])
+            finally:
+                shutil.rmtree(dataset, ignore_errors=True)
         if "replication" in modes:
             ref = _replication_reference(messages)
             for t in range(trials):

@@ -82,6 +82,22 @@ SITES: dict[str, tuple[str, str]] = {
         "parsequeue/queue.py",
         "parse worker failing on a fetched batch: the failure must "
         "latch and surface on the source thread, offsets uncommitted"),
+    "interchange.ipc.read": (
+        "providers/arrow_ipc.py",
+        "Arrow IPC stream read failing mid-table (truncated stream, "
+        "pipe peer death) after some batches already reached the sink"),
+    "interchange.flight.do_get": (
+        "interchange/flight.py",
+        "Flight DoGet stream failing server-side mid-shard — the "
+        "client's part retry must re-fetch without losing rows"),
+    "interchange.flight.do_put": (
+        "interchange/flight.py",
+        "Flight DoPut upload failing server-side after a prefix of the "
+        "stream landed — the retried put must replace, not append"),
+    "interchange.shm.attach": (
+        "interchange/shm.py",
+        "shared-memory segment attach failing (segment reaped, name "
+        "raced) — the client must fall back to the Flight wire path"),
     "client.s3.request": (
         "coordinator/s3client.py",
         "S3 wire request failing (timeout, 5xx, connection reset)"),

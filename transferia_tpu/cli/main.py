@@ -165,11 +165,13 @@ def build_parser() -> argparse.ArgumentParser:
                           "schedule and PRNG draws (replayable)")
     cha.add_argument("--mode", default="both",
                      choices=["snapshot", "replication", "worker_crash",
-                              "both", "all"],
+                              "arrow_ipc", "both", "all"],
                      help="worker_crash kills a sharded worker mid-part "
                           "and audits lease reclamation + epoch "
-                          "fencing; both = snapshot+replication; all "
-                          "adds worker_crash")
+                          "fencing; arrow_ipc audits the zero-copy "
+                          "interchange wire (arrow_ipc source → "
+                          "memory); both = snapshot+replication; all "
+                          "adds worker_crash + arrow_ipc")
     cha.add_argument("--rows", type=int, default=0,
                      help="snapshot source rows (default 4096)")
     cha.add_argument("--messages", type=int, default=0,
@@ -180,6 +182,32 @@ def build_parser() -> argparse.ArgumentParser:
                           "grammar: chaos/failpoints.py)")
     cha.add_argument("--json", action="store_true", dest="as_json",
                      help="machine-readable report")
+    fli = sub.add_parser(
+        "flight",
+        help="Arrow Flight shard-handoff server over the interchange "
+             "plane (interchange/flight.py): `serve` publishes parts "
+             "for worker→worker DoGet at wire speed, `bench` measures "
+             "pivot vs IPC vs shm vs Flight on this host")
+    fli.add_argument("action", choices=["serve", "bench"])
+    fli.add_argument("--host", default="127.0.0.1",
+                     help="serve: bind address")
+    fli.add_argument("--port", type=int, default=8815,
+                     help="serve: bind port (0 = ephemeral)")
+    fli.add_argument("--shm", action="store_true",
+                     help="enable the same-host shared-memory fast "
+                          "path (co-located clients map segments "
+                          "instead of pulling the gRPC stream)")
+    fli.add_argument("--path", default="",
+                     help="serve: preload parts from Arrow IPC "
+                          "stream(s) at this file/dir/glob")
+    fli.add_argument("--uri", default="",
+                     help="bench: benchmark against an existing server "
+                          "(default: self-hosted loopback)")
+    fli.add_argument("--rows", type=int, default=200_000,
+                     help="bench: rows moved per path")
+    fli.add_argument("--batch-rows", type=int, default=16_384)
+    fli.add_argument("--json", action="store_true", dest="as_json",
+                     help="bench: machine-readable report")
     return p
 
 
@@ -352,6 +380,8 @@ def main(argv=None) -> int:
         return run_check(args)
     if args.command == "chaos":
         return cmd_chaos(args)
+    if args.command == "flight":
+        return cmd_flight(args)
 
     transfer = _load_transfer(args)
     cp = _coordinator(args)
@@ -650,6 +680,70 @@ def cmd_chaos(args) -> int:
     else:
         print(report.format_summary())
     return 0 if report.passed else 1
+
+
+def cmd_flight(args) -> int:
+    """Arrow Flight shard-handoff server / loopback benchmark."""
+    from transferia_tpu.interchange._pyarrow import (
+        PyArrowUnavailable,
+        have_flight,
+    )
+
+    if not have_flight():
+        try:
+            from transferia_tpu.interchange._pyarrow import flight
+
+            flight("trtpu flight")
+        except PyArrowUnavailable as e:
+            print(str(e), file=sys.stderr)
+            return 2
+    if args.action == "bench":
+        from transferia_tpu.interchange.bench import (
+            format_report,
+            run_interchange_bench,
+        )
+
+        report = run_interchange_bench(
+            rows=args.rows, batch_rows=args.batch_rows,
+            flight_uri=args.uri or None)
+        if args.as_json:
+            print(json.dumps(report, indent=1))
+        else:
+            print(format_report(report))
+        return 0
+
+    from transferia_tpu.interchange.flight import ShardFlightServer
+
+    server = ShardFlightServer(f"grpc://{args.host}:{args.port}",
+                               enable_shm=args.shm)
+    try:
+        if args.path:
+            from transferia_tpu.providers.arrow_ipc import (
+                ArrowIpcSourceParams,
+                ArrowIpcStorage,
+            )
+            from transferia_tpu.providers.flight import part_key
+
+            storage = ArrowIpcStorage(ArrowIpcSourceParams(path=args.path))
+            from transferia_tpu.abstract.table import TableDescription
+
+            for tid in storage.table_list():
+                desc = TableDescription(id=tid)
+                for i, part in enumerate(storage.shard_table(desc)):
+                    batches: list = []
+                    storage.load_table(part, batches.append)
+                    rows = server.publish(part_key(tid, str(i)), batches)
+                    logging.info("flight: published %s part %d (%d rows)",
+                                 tid, i, rows)
+        print(f"flight: serving on grpc://{args.host}:{server.port}"
+              + (" (shm handoff enabled)" if args.shm else ""))
+        stop = threading.Event()
+        signal.signal(signal.SIGINT, lambda *a: stop.set())
+        signal.signal(signal.SIGTERM, lambda *a: stop.set())
+        stop.wait()
+        return 0
+    finally:
+        server.close()
 
 
 def cmd_validate(args) -> int:

@@ -86,6 +86,47 @@ def _gather_varwidth(data: np.ndarray, offsets: np.ndarray,
     return out, new_offsets
 
 
+def _contiguous_span(indices) -> Optional[tuple[int, int]]:
+    """[lo, hi) when indices is exactly lo, lo+1, ..., hi-1; else None.
+
+    The O(n) monotonicity check only runs after the O(1) endpoints test
+    matches, so random gathers pay two scalar reads."""
+    n = len(indices)
+    if n == 0 or not isinstance(indices, np.ndarray) \
+            or indices.dtype.kind not in "iu":
+        return None
+    lo = int(indices[0])
+    hi = int(indices[-1]) + 1
+    if hi - lo != n or lo < 0:
+        return None
+    if n > 1 and not bool((np.diff(indices) == 1).all()):
+        return None
+    return lo, hi
+
+
+def _gather_fixed(data: np.ndarray, indices: np.ndarray) -> np.ndarray:
+    """Fixed-width gather: native width-specialized loop, numpy fallback."""
+    from transferia_tpu.native import lib as _native_lib
+
+    n = len(indices)
+    cdll = _native_lib()
+    width = data.dtype.itemsize
+    if (cdll is None or not hasattr(cdll, "gather_fixed") or n == 0
+            or not data.flags.c_contiguous
+            or not isinstance(indices, np.ndarray)
+            or indices.dtype.kind not in "iu"):
+        return data[indices]
+    # the C loop is unchecked: out-of-range / negative indices must keep
+    # numpy's semantics (raise / wrap) instead of reading stray memory
+    if int(indices.min()) < 0 or int(indices.max()) >= len(data):
+        return data[indices]
+    out = np.empty(n, dtype=data.dtype)
+    cdll.gather_fixed(
+        data.view(np.uint8), np.ascontiguousarray(indices, dtype=np.int64),
+        n, width, out.view(np.uint8))
+    return out
+
+
 def bucket_rows(n: int) -> int:
     """Smallest standard bucket >= n (caps XLA recompiles)."""
     for b in _BUCKETS:
@@ -312,7 +353,17 @@ class Column:
 
     # -- functional ops -----------------------------------------------------
     def take(self, indices: np.ndarray) -> "Column":
-        """Gather rows (host-side; device path uses ops.strings.take_bytes)."""
+        """Gather rows (host-side; device path uses ops.strings.take_bytes).
+
+        Fast paths: a contiguous ascending index range (what slice() and
+        prefix/suffix filters produce) returns buffer VIEWS — no copy at
+        all; non-contiguous fixed-width gathers route through the native
+        width-specialized hostops loop when the library is present."""
+        span = _contiguous_span(indices)
+        if span is not None and span[1] <= self.n_rows:
+            # (out-of-range spans fall through so the gather raises the
+            # same IndexError numpy always did instead of clamping)
+            return self._take_contiguous(*span)
         validity = self.validity[indices] if self.validity is not None else None
         if self.is_lazy_dict:
             # dictionary stays shared; only the int32 codes gather
@@ -321,11 +372,34 @@ class Column:
                 self.name, self.ctype, validity=validity,
                 dict_enc=DictEnc(enc.indices[indices], pool=enc.pool))
         if self.offsets is None:
-            return Column(self.name, self.ctype, self.data[indices], None, validity)
+            return Column(self.name, self.ctype,
+                          _gather_fixed(self.data, indices), None, validity)
         out, new_offsets = _gather_varwidth(
             self.data, self.offsets,
             np.ascontiguousarray(indices, dtype=np.int64))
         return Column(self.name, self.ctype, out, new_offsets, validity)
+
+    def _take_contiguous(self, lo: int, hi: int) -> "Column":
+        """take() of [lo, hi) as views over the existing buffers."""
+        validity = self.validity[lo:hi] if self.validity is not None else None
+        if self.is_lazy_dict:
+            enc = self.dict_enc
+            return Column(
+                self.name, self.ctype, validity=validity,
+                dict_enc=DictEnc(enc.indices[lo:hi], pool=enc.pool))
+        if self.offsets is None:
+            return Column(self.name, self.ctype, self.data[lo:hi], None,
+                          validity)
+        off = self.offsets[lo:hi + 1]
+        if off[0] == 0:
+            # prefix range: offsets AND data are pure views
+            return Column(self.name, self.ctype,
+                          self.data[:off[-1]] if len(off) else self.data,
+                          off, validity)
+        # mid-range: data stays a view; only the small offsets rebase
+        return Column(self.name, self.ctype,
+                      self.data[off[0]:off[-1]],
+                      off - off[0], validity)
 
     def filter(self, mask: np.ndarray) -> "Column":
         return self.take(np.nonzero(mask)[0])

@@ -1,0 +1,31 @@
+"""Arrow interchange plane: zero-copy columnar wire for shard handoff.
+
+`ColumnBatch` (columnar/batch.py) already speaks Arrow semantics — flat
+buffers, int32 offsets, boolean validity — so the Arrow ecosystem's wire
+formats can map onto it without per-row work:
+
+- `convert.py`   ColumnBatch ⇄ pyarrow.RecordBatch with buffer *wrapping*
+                 (no memcpy for fixed-width columns; validity/boolean
+                 bitmaps are the only permitted materialization);
+- `ipc.py`       Arrow IPC stream framing over files and inherited fds —
+                 the `arrow_ipc` provider (providers/arrow_ipc.py) makes
+                 the format a first-class transfer endpoint;
+- `flight.py`    Arrow Flight shard transport (DoGet/DoPut, one stream
+                 per `OperationTablePart`) — wire-speed worker→worker
+                 handoff instead of re-decoding parquet per worker;
+- `shm.py`       same-host shared-memory handoff (IPC-framed segments in
+                 `multiprocessing.shared_memory`), selected automatically
+                 by the Flight client when both peers are co-located.
+
+Grounding: "Benchmarking Apache Arrow Flight" and "Zerrow: True
+Zero-Copy Arrow Pipelines" (PAPERS.md).  Buffer-ownership rules live in
+ARCHITECTURE.md "Arrow interchange plane".
+
+pyarrow is optional (`pip install transferia-tpu[arrow]`): everything
+here imports, registers, and fails with an actionable error only when a
+pyarrow-backed code path is actually exercised (`_pyarrow.py`).
+"""
+
+from transferia_tpu.interchange.telemetry import TELEMETRY
+
+__all__ = ["TELEMETRY"]

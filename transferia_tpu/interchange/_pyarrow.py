@@ -1,0 +1,66 @@
+"""Single pyarrow import seam for the interchange plane.
+
+Every interchange module that needs pyarrow goes through `pyarrow()` /
+`flight()` instead of importing at module scope, so:
+
+- the `arrow_ipc` / `flight` providers always *register* (the registry
+  is the user-visible capability map) and fail at use time with an
+  actionable install hint instead of an ImportError stack;
+- tests auto-skip via the `requires_pyarrow` marker (tests/conftest.py)
+  keyed off `have_pyarrow()` — one probe, no scattered try/imports.
+"""
+
+from __future__ import annotations
+
+_HINT = ("pip install 'transferia-tpu[arrow]'  (pyarrow>=14)")
+_FLIGHT_HINT = ("pip install 'pyarrow>=14' built with Flight support "
+                "(the default wheels include it)")
+
+
+class PyArrowUnavailable(RuntimeError):
+    """Raised when a pyarrow-backed interchange path runs without pyarrow."""
+
+
+def have_pyarrow() -> bool:
+    try:
+        import pyarrow  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def have_flight() -> bool:
+    try:
+        import pyarrow.flight  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def pyarrow(feature: str = "the Arrow interchange plane"):
+    """Return the pyarrow module or raise with an install hint."""
+    try:
+        import pyarrow as pa
+
+        return pa
+    except ImportError as e:
+        raise PyArrowUnavailable(
+            f"{feature} requires pyarrow, which is not installed; "
+            f"install it with: {_HINT}"
+        ) from e
+
+
+def flight(feature: str = "the Flight shard transport"):
+    """Return pyarrow.flight or raise with an install hint."""
+    pyarrow(feature)  # surface the base hint first when pyarrow is absent
+    try:
+        import pyarrow.flight as fl
+
+        return fl
+    except ImportError as e:
+        raise PyArrowUnavailable(
+            f"{feature} requires pyarrow.flight, which this pyarrow "
+            f"build lacks; {_FLIGHT_HINT}"
+        ) from e

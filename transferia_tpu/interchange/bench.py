@@ -1,0 +1,163 @@
+"""Interchange shard-handoff benchmark: pivot vs IPC vs shm vs Flight.
+
+Shared by `bench.py --interchange` (repo-root bench harness) and
+`trtpu flight bench` (CLI).  All paths move the SAME deterministic
+sample batches from a producer to a consumer that materializes
+ColumnBatches; what varies is the wire:
+
+- `pivot`   the row baseline: unpivot to ChangeItems and re-pivot —
+            what every handoff paid before the interchange plane;
+- `ipc`     Arrow IPC stream bytes through an in-memory buffer
+            (the arrow_ipc provider's file/fd path);
+- `shm`     shared-memory segment handoff (write once, map back);
+- `flight`  loopback Flight DoPut → DoGet over real gRPC.
+
+Reported per path: rows/s, MB/s, speedup vs pivot — plus the zero-copy
+buffer ratio observed on the interchange paths (telemetry.py), the
+plane's honesty metric.
+"""
+
+from __future__ import annotations
+
+import io
+import time
+from typing import Optional
+
+from transferia_tpu.abstract.schema import TableID
+from transferia_tpu.columnar.batch import ColumnBatch
+from transferia_tpu.interchange.telemetry import TELEMETRY
+
+
+def _mk_batches(rows: int, batch_rows: int, preset: str):
+    from transferia_tpu.providers.sample import make_batch
+
+    tid = TableID("bench", "interchange")
+    return [make_batch(preset, tid, start, min(batch_rows, rows - start), 7)
+            for start in range(0, rows, batch_rows)]
+
+
+def _time(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return time.perf_counter() - t0
+
+
+def run_interchange_bench(rows: int = 200_000, batch_rows: int = 16_384,
+                          preset: str = "iot",
+                          with_flight: bool = True,
+                          flight_uri: Optional[str] = None) -> dict:
+    """Run all paths over identical batches; returns the report dict."""
+    from transferia_tpu.interchange import ipc, shm
+    from transferia_tpu.interchange.convert import arrow_to_batch
+
+    batches = _mk_batches(rows, batch_rows, preset)
+    n_rows = sum(b.n_rows for b in batches)
+    n_bytes = sum(b.nbytes() for b in batches)
+
+    # pivot baseline: the ChangeItem row round trip every pre-interchange
+    # handoff paid (serialize rows out, pivot rows back in)
+    def pivot_path():
+        for b in batches:
+            ColumnBatch.from_rows(b.to_rows())
+
+    pivot_s = _time(pivot_path)
+
+    TELEMETRY.reset()
+
+    # Arrow IPC stream through a memory buffer (file/fd provider path)
+    def ipc_path():
+        buf = io.BytesIO()
+        w = ipc.StreamWriter(buf)
+        for b in batches:
+            w.write(b)
+        w.finish()
+        buf.seek(0)
+        for _ in ipc.iter_stream(buf):
+            pass
+
+    ipc_s = _time(ipc_path)
+
+    # shared-memory segment handoff
+    def shm_path():
+        h = shm.write_segment(batches)
+        att = shm.attach(h)
+        att.batches()
+        att.close()
+        shm.unlink_segment(h)
+
+    shm_s = _time(shm_path)
+
+    flight_s = None
+    if with_flight:
+        from transferia_tpu.interchange.flight import (
+            FlightShardClient,
+            ShardFlightServer,
+        )
+
+        server = None
+        try:
+            if flight_uri is None:
+                server = ShardFlightServer()
+                flight_uri = server.location
+            with FlightShardClient(flight_uri, allow_shm=False) as cli:
+                def flight_path():
+                    cli.put_part("bench.interchange/0", batches)
+                    for _ in cli.get_part("bench.interchange/0"):
+                        pass
+
+                flight_s = _time(flight_path)
+                cli.drop("bench.interchange/0")
+        finally:
+            if server is not None:
+                server.close()
+
+    snap = TELEMETRY.snapshot()
+    zc_total = snap["zero_copy_buffers"] + snap["copied_buffers"]
+
+    def path_stats(seconds: Optional[float]):
+        if seconds is None:
+            return None
+        return {
+            "rows_per_sec": round(n_rows / seconds),
+            "mb_per_sec": round(n_bytes / seconds / 1e6, 1),
+            "speedup_vs_pivot": round(pivot_s / seconds, 2),
+        }
+
+    report = {
+        "metric": "interchange_shard_handoff",
+        "rows": n_rows,
+        "bytes": n_bytes,
+        "batch_rows": batch_rows,
+        "paths": {
+            "pivot": path_stats(pivot_s),
+            "ipc": path_stats(ipc_s),
+            "shm": path_stats(shm_s),
+            "flight": path_stats(flight_s),
+        },
+        "zero_copy_buffers": snap["zero_copy_buffers"],
+        "copied_buffers": snap["copied_buffers"],
+        "zero_copy_ratio": round(
+            snap["zero_copy_buffers"] / zc_total, 4) if zc_total else 0.0,
+    }
+    best = max(s["rows_per_sec"] for k, s in report["paths"].items()
+               if s is not None and k != "pivot")
+    report["value"] = best
+    report["unit"] = "rows/sec"
+    return report
+
+
+def format_report(report: dict) -> str:
+    lines = [f"interchange handoff: {report['rows']} rows, "
+             f"{report['bytes'] / 1e6:.1f} MB, "
+             f"batch={report['batch_rows']}"]
+    for name, s in report["paths"].items():
+        if s is None:
+            continue
+        lines.append(
+            f"  {name:>6}: {s['rows_per_sec']:>12,} rows/s  "
+            f"{s['mb_per_sec']:>8.1f} MB/s  "
+            f"{s['speedup_vs_pivot']:>6.2f}x vs pivot")
+    lines.append(
+        f"  zero-copy buffers: {report['zero_copy_buffers']} "
+        f"({report['zero_copy_ratio']:.0%} of adoptions)")
+    return "\n".join(lines)

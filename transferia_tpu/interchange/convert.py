@@ -1,0 +1,283 @@
+"""Zero-copy ColumnBatch ⇄ pyarrow.RecordBatch converters.
+
+`ColumnBatch` columns are already Arrow-shaped (flat numpy buffers,
+int32 offsets, boolean validity), so conversion is buffer *wrapping*,
+not rewriting:
+
+- ColumnBatch → Arrow: `pa.py_buffer(<numpy array>)` wraps each data /
+  offsets buffer in place (pyarrow pins the array through the buffer
+  protocol — no memcpy, no per-row Python).  The only materializations
+  are bitmaps: validity packs bool→bits and BOOLEAN columns pack their
+  byte-per-value data the same way (Arrow's bool layout is bit-packed).
+- Arrow → ColumnBatch: `np.frombuffer(<pa.Buffer>)` views each buffer
+  in place (numpy pins the pa.Buffer as `.base`, which pins the IPC
+  message / shm segment it came from).  Arrays adopted this way are
+  READ-ONLY views — the pipeline treats column buffers as immutable
+  (transforms replace columns, never mutate), so this is safe; anything
+  that must write takes a copy at that point.
+
+Canonical-schema fidelity: the Arrow schema's metadata carries the full
+`TableSchema` (`trtpu:schema`, TableSchema.to_json) plus the table
+identity and CDC sidecars, so ANY/DECIMAL/STRING round-trip exactly
+instead of degrading to UTF8 through arrow-type inference.  Foreign
+Arrow data without the metadata falls back to `arrow_to_table_schema`.
+
+CDC sidecars (kinds/lsns/commit_times) travel as extra `__trtpu_*`
+columns — wrapped zero-copy like any other fixed-width buffer and
+stripped on import.  Host-only sidecars (old_keys, txn_ids) do NOT
+cross the wire, same as they never ship to the device.
+
+Every buffer adoption is tallied in `telemetry.TELEMETRY`
+(`zero_copy_buffers` vs `copied_buffers`) — the plane's honesty metric.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Optional
+
+import numpy as np
+
+from transferia_tpu.abstract.schema import (
+    CanonicalType,
+    TableID,
+    TableSchema,
+)
+from transferia_tpu.columnar.batch import (
+    _ARROW_TYPES,
+    Column,
+    ColumnBatch,
+    _arrow_to_column,
+    arrow_to_table_schema,
+)
+from transferia_tpu.interchange._pyarrow import pyarrow
+from transferia_tpu.interchange.telemetry import TELEMETRY
+
+SCHEMA_KEY = b"trtpu:schema"
+TABLE_KEY = b"trtpu:table"
+PART_KEY = b"trtpu:part_id"
+_SIDECAR_KINDS = "__trtpu_kinds"
+_SIDECAR_LSNS = "__trtpu_lsns"
+_SIDECAR_COMMIT = "__trtpu_commit_times"
+_SIDECARS = (_SIDECAR_KINDS, _SIDECAR_LSNS, _SIDECAR_COMMIT)
+
+
+def _validity_buffer(pa, validity: Optional[np.ndarray]):
+    """Bool validity → Arrow bitmap buffer (the permitted materialization)."""
+    if validity is None:
+        return None
+    return pa.py_buffer(np.packbits(validity, bitorder="little").tobytes())
+
+
+def _wrap(pa, arr: np.ndarray):
+    """Wrap a numpy buffer as an Arrow buffer without copying.
+
+    Non-contiguous inputs (rare: sliced views with strides) compact
+    first and are tallied as copies."""
+    if not arr.flags.c_contiguous:
+        arr = np.ascontiguousarray(arr)
+        TELEMETRY.add(copied_buffers=1)
+    else:
+        TELEMETRY.add(zero_copy_buffers=1)
+    return pa.py_buffer(arr)
+
+
+def _column_to_arrow(pa, c: Column, pa_type) -> tuple[Any, Any]:
+    """One column → (pa.Array, pa field type); zero-copy where the
+    layouts already agree."""
+    n = c.n_rows
+    validity = _validity_buffer(pa, c.validity)
+    if c.is_lazy_dict:
+        # dictionary-encoded end-to-end: wrap the shared pool's buffers
+        # once (memoized on the DictPool so batch slices of one row
+        # group serialize one pool) and the int32 codes per batch
+        enc = c.dict_enc
+        memo_key = ("interchange_pool", str(pa_type))
+        pool = enc.pool.memo_get(memo_key)
+        if pool is None:
+            pool = pa.Array.from_buffers(
+                pa_type, enc.n_values,
+                [None, _wrap(pa, enc.values_offsets),
+                 _wrap(pa, enc.values_data)])
+            enc.pool.memo_set(memo_key, pool)
+        idx = pa.Array.from_buffers(
+            pa.int32(), n, [validity, _wrap(pa, enc.indices)])
+        arr = pa.DictionaryArray.from_arrays(idx, pool)
+        return arr, pa.dictionary(pa.int32(), pa_type)
+    if c.ctype.is_variable_width:
+        arr = pa.Array.from_buffers(
+            pa_type, n,
+            [validity, _wrap(pa, c.offsets), _wrap(pa, c.data)])
+        return arr, pa_type
+    if c.ctype == CanonicalType.BOOLEAN:
+        # Arrow bools are bit-packed: the data bitmap is the second (and
+        # last) permitted materialization next to validity
+        bits = pa.py_buffer(
+            np.packbits(c.data, bitorder="little").tobytes())
+        TELEMETRY.add(copied_buffers=1)
+        arr = pa.Array.from_buffers(pa_type, n, [validity, bits])
+        return arr, pa_type
+    arr = pa.Array.from_buffers(pa_type, n, [validity, _wrap(pa, c.data)])
+    return arr, pa_type
+
+
+def batch_to_arrow(batch: ColumnBatch):
+    """ColumnBatch → pyarrow.RecordBatch, wrapping the existing numpy
+    buffers (no per-row path, no memcpy for fixed-width columns)."""
+    pa = pyarrow("ColumnBatch→Arrow conversion")
+    arrays, fields = [], []
+    for cs in batch.schema:
+        c = batch.columns.get(cs.name)
+        if c is None:
+            continue
+        arr, ftype = _column_to_arrow(pa, c, _ARROW_TYPES[cs.data_type])
+        arrays.append(arr)
+        fields.append(pa.field(cs.name, ftype, nullable=not cs.required))
+    for name, data in (
+        (_SIDECAR_KINDS, batch.kinds),
+        (_SIDECAR_LSNS, batch.lsns),
+        (_SIDECAR_COMMIT, batch.commit_times),
+    ):
+        if data is None:
+            continue
+        pa_type = pa.int8() if data.dtype == np.int8 else pa.int64()
+        arrays.append(pa.Array.from_buffers(
+            pa_type, len(data), [None, _wrap(pa, data)]))
+        fields.append(pa.field(name, pa_type, nullable=False))
+    metadata = {
+        SCHEMA_KEY: json.dumps(batch.schema.to_json()).encode(),
+        TABLE_KEY: json.dumps({
+            "namespace": batch.table_id.namespace,
+            "name": batch.table_id.name,
+        }).encode(),
+    }
+    if batch.part_id:
+        metadata[PART_KEY] = batch.part_id.encode()
+    rb = pa.RecordBatch.from_arrays(
+        arrays, schema=pa.schema(fields, metadata=metadata))
+    TELEMETRY.add(batches_out=1, bytes_out=rb.nbytes)
+    return rb
+
+
+def _adopt_fixed(c_name: str, ctype: CanonicalType, arr,
+                 validity: Optional[np.ndarray]) -> Column:
+    """View a primitive Arrow array's data buffer in place."""
+    bufs = arr.buffers()
+    n = len(arr)
+    dt = ctype.np_dtype
+    if bufs[1] is None or n == 0:
+        data = np.zeros(0, dtype=dt)
+        TELEMETRY.add(zero_copy_buffers=1)  # nothing to copy either way
+    else:
+        data = np.frombuffer(bufs[1], dtype=dt,
+                             count=n + arr.offset)[arr.offset:]
+        TELEMETRY.add(zero_copy_buffers=1)
+    return Column(c_name, ctype, data, None, validity)
+
+
+def _adopt_varwidth(c_name: str, ctype: CanonicalType, arr,
+                    validity: Optional[np.ndarray]) -> Column:
+    """View a binary/string Arrow array's offsets+data buffers in place.
+
+    Sliced arrays (nonzero offset / nonzero first offset) rebase the
+    small offsets array; the data buffer stays a view either way."""
+    bufs = arr.buffers()
+    n = len(arr)
+    if bufs[1] is None:
+        return Column(c_name, ctype, np.zeros(0, dtype=np.uint8),
+                      np.zeros(1, dtype=np.int32), validity)
+    off = np.frombuffer(bufs[1], dtype=np.int32,
+                        count=n + 1 + arr.offset)[arr.offset:]
+    data = (np.frombuffer(bufs[2], dtype=np.uint8)
+            if bufs[2] is not None else np.zeros(0, dtype=np.uint8))
+    if off[0] != 0:
+        data = data[off[0]:off[-1]]
+        off = off - off[0]  # small rebase copy; data stays a view
+        TELEMETRY.add(copied_buffers=1, zero_copy_buffers=1)
+    else:
+        TELEMETRY.add(zero_copy_buffers=2)
+    return Column(c_name, ctype, data, off, validity)
+
+
+def _canonical_pa_type(pa, ctype: CanonicalType, t) -> bool:
+    """Does the arrow array's physical layout already match the
+    canonical device layout for ctype (no cast needed)?"""
+    return t.equals(_ARROW_TYPES[ctype])
+
+
+def arrow_to_batch(rb, table_id: Optional[TableID] = None,
+                   schema: Optional[TableSchema] = None) -> ColumnBatch:
+    """pyarrow.RecordBatch → ColumnBatch, viewing the Arrow buffers in
+    place (`np.frombuffer`); the Arrow side stays pinned via numpy
+    `.base` chains, so IPC messages / shm segments outlive the batch."""
+    pa = pyarrow("Arrow→ColumnBatch conversion")
+    md = rb.schema.metadata or {}
+    if schema is None:
+        if SCHEMA_KEY in md:
+            schema = TableSchema.from_json(json.loads(md[SCHEMA_KEY]))
+        else:
+            names = [f.name for f in rb.schema if f.name not in _SIDECARS]
+            schema = arrow_to_table_schema(
+                pa.schema([rb.schema.field(nm) for nm in names]))
+    if table_id is None:
+        if TABLE_KEY in md:
+            t = json.loads(md[TABLE_KEY])
+            table_id = TableID(t["namespace"], t["name"])
+        else:
+            table_id = TableID("arrow", "batch")
+    cols: dict[str, Column] = {}
+    for cs in schema:
+        idx = rb.schema.get_field_index(cs.name)
+        if idx < 0:
+            continue
+        arr = rb.column(idx)
+        t = arr.type
+        validity = np.asarray(arr.is_valid()) if arr.null_count else None
+        if pa.types.is_dictionary(t):
+            # shared-pool adoption (zero-copy, pool memoized) lives in
+            # columnar/batch.py — reuse it rather than fork the cache
+            cols[cs.name] = _arrow_to_column(cs, arr)
+            TELEMETRY.add(**({"copied_buffers": 1} if arr.null_count
+                             else {"zero_copy_buffers": 3}))
+            continue
+        if cs.data_type.is_variable_width \
+                and _canonical_pa_type(pa, cs.data_type, t):
+            cols[cs.name] = _adopt_varwidth(cs.name, cs.data_type, arr,
+                                            validity)
+            continue
+        if (not cs.data_type.is_variable_width
+                and cs.data_type != CanonicalType.BOOLEAN
+                and _canonical_pa_type(pa, cs.data_type, t)):
+            cols[cs.name] = _adopt_fixed(cs.name, cs.data_type, arr,
+                                         validity)
+            continue
+        # layout mismatch (foreign units, large_string, bool bitmaps):
+        # the normalizing importer copies into canonical form
+        cols[cs.name] = _arrow_to_column(cs, arr)
+        TELEMETRY.add(copied_buffers=1)
+    kinds = lsns = commit_times = None
+    for name in _SIDECARS:
+        idx = rb.schema.get_field_index(name)
+        if idx < 0:
+            continue
+        arr = rb.column(idx)
+        bufs = arr.buffers()
+        dt = np.int8 if name == _SIDECAR_KINDS else np.int64
+        data = (np.frombuffer(bufs[1], dtype=dt,
+                              count=len(arr) + arr.offset)[arr.offset:]
+                if bufs[1] is not None else np.zeros(0, dtype=dt))
+        TELEMETRY.add(zero_copy_buffers=1)
+        if name == _SIDECAR_KINDS:
+            kinds = data
+        elif name == _SIDECAR_LSNS:
+            lsns = data
+        else:
+            commit_times = data
+    batch = ColumnBatch(
+        table_id, schema, cols,
+        kinds=kinds, lsns=lsns, commit_times=commit_times,
+        part_id=md.get(PART_KEY, b"").decode(),
+        read_bytes=rb.nbytes,
+    )
+    TELEMETRY.add(batches_in=1, bytes_in=rb.nbytes)
+    return batch

@@ -1,0 +1,325 @@
+"""Arrow Flight shard transport: DoGet/DoPut, one stream per part.
+
+`ShardFlightServer` is the worker→worker handoff point for sharded
+snapshots: a producer (e.g. the decode plane) `put_part()`s each
+`OperationTablePart`'s batches once, and consumer workers `get_part()`
+them at wire speed instead of re-decoding parquet per worker.  Parts
+are keyed by `OperationTablePart.key()`-style strings (the provider
+layer uses `<namespace>.<table>/<part_index>`); a re-put of a key
+REPLACES the stored stream (retried uploads must not append duplicates).
+
+Co-located fast path: with `enable_shm=True` the server seals each part
+into a shared-memory segment (interchange/shm.py) on first local
+request, and clients on the same host map it instead of pulling the
+gRPC stream — the `shm_locate` action is the negotiation, and any
+failure (remote client, shm disabled, segment reaped) falls back to
+DoGet transparently.
+
+Everything is instrumented: `flight_do_get`/`flight_do_put` trace spans
+(stats/trace.py), `interchange_*` counters (telemetry.py), and the
+`interchange.flight.do_get` / `interchange.flight.do_put` /
+`interchange.shm.attach` chaos failpoints.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import threading
+from typing import Iterable, Optional
+from urllib.parse import urlparse
+
+from transferia_tpu.chaos.failpoints import failpoint
+from transferia_tpu.columnar.batch import ColumnBatch
+from transferia_tpu.interchange import shm as shm_mod
+from transferia_tpu.interchange._pyarrow import flight as _flight
+from transferia_tpu.interchange._pyarrow import pyarrow
+from transferia_tpu.interchange.convert import arrow_to_batch, batch_to_arrow
+from transferia_tpu.interchange.telemetry import TELEMETRY
+
+ACTION_SHM_LOCATE = "shm_locate"
+ACTION_DROP = "drop"
+ACTION_KEYS = "keys"
+
+_LOCAL_HOSTS = ("127.0.0.1", "localhost", "::1")
+
+
+def make_server(host: str = "127.0.0.1", port: int = 0,
+                enable_shm: bool = False) -> "ShardFlightServer":
+    return ShardFlightServer(f"grpc://{host}:{port}", enable_shm=enable_shm)
+
+
+class ShardFlightServer:
+    """In-process Flight server over a part store (see module doc)."""
+
+    def __init__(self, location: str = "grpc://127.0.0.1:0",
+                 enable_shm: bool = False):
+        fl = _flight("ShardFlightServer")
+        pa = pyarrow("ShardFlightServer")
+        self._pa = pa
+        self._fl = fl
+        self.enable_shm = enable_shm
+        self._lock = threading.Lock()
+        # key -> (schema, [RecordBatch], rows)
+        self._parts: dict[str, tuple] = {}
+        self._segments: dict[str, shm_mod.ShmHandle] = {}
+
+        outer = self
+
+        class _Impl(fl.FlightServerBase):
+            def do_put(self, context, descriptor, reader, writer):
+                outer._do_put(descriptor, reader)
+
+            def do_get(self, context, ticket):
+                return outer._do_get(ticket)
+
+            def list_flights(self, context, criteria):
+                return outer._list_flights()
+
+            def get_flight_info(self, context, descriptor):
+                return outer._flight_info(descriptor.path[0].decode())
+
+            def do_action(self, context, action):
+                return outer._do_action(action)
+
+        self._impl = _Impl(location)
+        self.port = self._impl.port
+        # advertise the BOUND host: FlightInfo endpoints built from
+        # this reach remote consumers (loopback only when bound there)
+        self._host = urlparse(location).hostname or "127.0.0.1"
+
+    @property
+    def location(self) -> str:
+        return f"grpc://{self._host}:{self.port}"
+
+    # -- handlers ------------------------------------------------------------
+    def _do_put(self, descriptor, reader) -> None:
+        from transferia_tpu.stats import trace
+
+        key = descriptor.path[0].decode()
+        failpoint("interchange.flight.do_put")
+        sp = trace.span("flight_do_put", part=key)
+        with sp:
+            rbs, rows, nbytes = [], 0, 0
+            for chunk in reader:
+                rbs.append(chunk.data)
+                rows += chunk.data.num_rows
+                nbytes += chunk.data.nbytes
+            with self._lock:
+                self._parts[key] = (reader.schema, rbs, rows)
+                stale = self._segments.pop(key, None)
+            if stale is not None:
+                shm_mod.unlink_segment(stale)  # re-put replaces, never appends
+            TELEMETRY.add(flight_streams=1, batches_in=len(rbs),
+                          bytes_in=nbytes)
+        if sp:
+            sp.add(rows=rows, bytes=nbytes)
+
+    def _do_get(self, ticket):
+        from transferia_tpu.stats import trace
+
+        key = ticket.ticket.decode()
+        failpoint("interchange.flight.do_get")
+        with self._lock:
+            entry = self._parts.get(key)
+        if entry is None:
+            raise KeyError(f"flight: unknown part {key!r}")
+        schema, rbs, rows = entry
+        nbytes = sum(rb.nbytes for rb in rbs)
+        TELEMETRY.add(flight_streams=1, batches_out=len(rbs),
+                      bytes_out=nbytes)
+        sp = trace.span("flight_do_get", part=key)
+        if sp:
+            sp.add(rows=rows, bytes=nbytes)
+        with sp:
+            return self._fl.RecordBatchStream(
+                self._pa.Table.from_batches(rbs, schema=schema))
+
+    def _list_flights(self):
+        with self._lock:
+            keys = sorted(self._parts)
+        for key in keys:
+            yield self._flight_info(key)
+
+    def _flight_info(self, key: str):
+        fl, pa = self._fl, self._pa
+        with self._lock:
+            entry = self._parts.get(key)
+        if entry is None:
+            raise KeyError(f"flight: unknown part {key!r}")
+        schema, rbs, rows = entry
+        descriptor = fl.FlightDescriptor.for_path(key)
+        endpoint = fl.FlightEndpoint(key.encode(), [self.location])
+        return fl.FlightInfo(schema, descriptor, [endpoint], rows,
+                             sum(rb.nbytes for rb in rbs))
+
+    def _do_action(self, action):
+        t = action.type
+        if t == ACTION_KEYS:
+            with self._lock:
+                body = json.dumps(sorted(self._parts)).encode()
+            return [self._fl.Result(self._pa.py_buffer(body))]
+        key = action.body.to_pybytes().decode()
+        if t == ACTION_DROP:
+            with self._lock:
+                self._parts.pop(key, None)
+                seg = self._segments.pop(key, None)
+            if seg is not None:
+                shm_mod.unlink_segment(seg)
+            return []
+        if t == ACTION_SHM_LOCATE:
+            if not self.enable_shm:
+                raise NotImplementedError("shm handoff disabled")
+            handle = self._shm_handle(key)
+            body = json.dumps(handle.to_json()).encode()
+            return [self._fl.Result(self._pa.py_buffer(body))]
+        raise NotImplementedError(f"unknown action {t!r}")
+
+    def _shm_handle(self, key: str) -> shm_mod.ShmHandle:
+        """Seal the part into a segment on first request (then shared
+        by every co-located reader).  The sealing memcpy runs OUTSIDE
+        the server lock — a multi-GB part must not stall every
+        concurrent DoGet/DoPut; a rare racing double-seal just unlinks
+        the loser."""
+        with self._lock:
+            handle = self._segments.get(key)
+            if handle is not None:
+                return handle
+            entry = self._parts.get(key)
+        if entry is None:
+            raise KeyError(f"flight: unknown part {key!r}")
+        _schema, rbs, _rows = entry
+        handle = shm_mod.write_segment(rbs)
+        with self._lock:
+            won = self._segments.setdefault(key, handle)
+        if won is not handle:
+            shm_mod.unlink_segment(handle)
+        return won
+
+    def publish(self, key: str, batches) -> int:
+        """Server-side direct publish (no wire): preloading parts from
+        IPC files (`trtpu flight serve --path`) and in-process
+        producers.  Returns rows published."""
+        rbs = [b if isinstance(b, self._pa.RecordBatch)
+               else batch_to_arrow(b) for b in batches]
+        if not rbs:
+            return 0
+        rows = sum(rb.num_rows for rb in rbs)
+        with self._lock:
+            self._parts[key] = (rbs[0].schema, rbs, rows)
+            stale = self._segments.pop(key, None)
+        if stale is not None:
+            shm_mod.unlink_segment(stale)
+        return rows
+
+    # -- lifecycle -----------------------------------------------------------
+    def close(self) -> None:
+        self._impl.shutdown()
+        with self._lock:
+            segments = list(self._segments.values())
+            self._segments.clear()
+            self._parts.clear()
+        for seg in segments:
+            shm_mod.unlink_segment(seg)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def is_local_uri(uri: str) -> bool:
+    host = urlparse(uri).hostname or ""
+    return host in _LOCAL_HOSTS or host == socket.gethostname()
+
+
+class FlightShardClient:
+    """Client side of the shard handoff.
+
+    `get_part` selects the transport automatically: co-located with the
+    server (local uri) it negotiates a shared-memory mapping first and
+    only falls back to the gRPC stream when shm is unavailable."""
+
+    def __init__(self, uri: str, allow_shm: Optional[bool] = None):
+        fl = _flight("FlightShardClient")
+        self._fl = fl
+        self._pa = pyarrow("FlightShardClient")
+        self.uri = uri
+        self._client = fl.connect(uri)
+        self.allow_shm = is_local_uri(uri) if allow_shm is None \
+            else allow_shm
+        self._attachments: list = []  # pin mapped segments we handed out
+
+    def begin_put(self, key: str, schema):
+        """Open a streaming DoPut for one part; caller writes
+        RecordBatches and closes.  The server stores the stream
+        atomically when it ends (a re-put of the key replaces it)."""
+        descriptor = self._fl.FlightDescriptor.for_path(key)
+        writer, _ = self._client.do_put(descriptor, schema)
+        return writer
+
+    def put_part(self, key: str, batches: Iterable[ColumnBatch]) -> int:
+        rbs = [b if isinstance(b, self._pa.RecordBatch)
+               else batch_to_arrow(b) for b in batches]
+        if not rbs:
+            return 0
+        rows = 0
+        with self.begin_put(key, rbs[0].schema) as writer:
+            for rb in rbs:
+                writer.write_batch(rb)
+                rows += rb.num_rows
+        return rows
+
+    def get_part(self, key: str) -> list[ColumnBatch]:
+        if self.allow_shm:
+            batches = self._try_shm(key)
+            if batches is not None:
+                return batches
+        reader = self._client.do_get(self._fl.Ticket(key.encode()))
+        out = []
+        for chunk in reader:
+            out.append(arrow_to_batch(chunk.data))
+        return out
+
+    def _try_shm(self, key: str) -> Optional[list[ColumnBatch]]:
+        try:
+            results = list(self._client.do_action(
+                (ACTION_SHM_LOCATE, key.encode())))
+            handle = shm_mod.ShmHandle.from_json(
+                json.loads(results[0].body.to_pybytes()))
+            att = shm_mod.attach(handle)
+        except Exception as e:
+            # UNIMPLEMENTED is definitive (server started without shm):
+            # stop paying a failed negotiation RPC per part; anything
+            # else (segment reaped, race) stays retryable
+            if isinstance(e, getattr(self._fl,
+                                     "FlightUnimplementedError", ())):
+                self.allow_shm = False
+            return None
+        self._attachments.append(att)
+        return att.batches()
+
+    def keys(self) -> list[str]:
+        results = list(self._client.do_action((ACTION_KEYS, b"")))
+        return json.loads(results[0].body.to_pybytes())
+
+    def drop(self, key: str) -> None:
+        list(self._client.do_action((ACTION_DROP, key.encode())))
+
+    def list_parts(self):
+        return list(self._client.list_flights())
+
+    def close(self) -> None:
+        self._client.close()
+        for att in self._attachments:
+            att.close()
+        self._attachments.clear()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,103 @@
+"""Arrow IPC stream framing over files and inherited fds.
+
+The IPC *stream* format (not the random-access file format) is the
+wire: it frames a schema message followed by record-batch messages, so
+it pipes — a producer can `trtpu activate` into `fd://3` while the
+consumer reads the other end of the pipe, and object-store "files" of
+it concatenate per table.  One stream carries ONE schema; the provider
+layer (providers/arrow_ipc.py) maps tables onto streams (one file per
+table in directory mode).
+
+Readers hand out `ColumnBatch`es whose buffers VIEW the IPC message
+(convert.arrow_to_batch) — the message stays pinned through numpy
+`.base` chains, so no copy lands between the wire and the device
+dispatch for fixed-width columns.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import IO, Iterator, Optional, Union
+
+from transferia_tpu.columnar.batch import ColumnBatch
+from transferia_tpu.interchange._pyarrow import pyarrow
+from transferia_tpu.interchange.convert import arrow_to_batch, batch_to_arrow
+from transferia_tpu.interchange.telemetry import TELEMETRY
+
+FD_PREFIX = "fd://"
+
+
+def is_fd_location(loc: str) -> bool:
+    return loc.startswith(FD_PREFIX)
+
+
+def open_location(loc: str, mode: str) -> IO[bytes]:
+    """Open a stream location: a filesystem path or `fd://N` (an
+    inherited file descriptor, e.g. a pipe from the parent process).
+
+    fd-backed streams are single-shot: the fd is consumed on first open
+    and closing the returned file closes the descriptor."""
+    if is_fd_location(loc):
+        try:
+            fd = int(loc[len(FD_PREFIX):])
+        except ValueError:
+            raise ValueError(f"bad fd location {loc!r}: fd://<int>")
+        return os.fdopen(fd, mode)
+    return open(loc, mode)
+
+
+class StreamWriter:
+    """IPC stream writer over one file object; the schema is taken from
+    the first batch (IPC streams are single-schema by format)."""
+
+    def __init__(self, fobj: IO[bytes]):
+        self._pa = pyarrow("Arrow IPC stream writing")
+        self._fobj = fobj
+        self._writer = None
+        self.batches_written = 0
+        self.rows_written = 0
+
+    def write(self, batch: ColumnBatch) -> None:
+        rb = batch_to_arrow(batch)
+        if self._writer is None:
+            self._writer = self._pa.ipc.new_stream(self._fobj, rb.schema)
+        self._writer.write_batch(rb)
+        self.batches_written += 1
+        self.rows_written += rb.num_rows
+
+    def finish(self) -> None:
+        """End the IPC stream (EOS marker) without closing the file
+        object — for buffer-backed streams the caller rewinds."""
+        if self._writer is not None:
+            self._writer.close()
+            self._writer = None
+
+    def close(self) -> None:
+        self.finish()
+        self._fobj.close()
+
+
+def write_stream(loc: str, batches) -> int:
+    """Write batches (one table) to a location; returns rows written."""
+    w = StreamWriter(open_location(loc, "wb"))
+    try:
+        for b in batches:
+            w.write(b)
+    finally:
+        w.close()
+    return w.rows_written
+
+
+def read_schema(fobj: IO[bytes]):
+    """Peek an IPC stream's Arrow schema (reads only the header)."""
+    pa = pyarrow("Arrow IPC stream reading")
+    return pa.ipc.open_stream(fobj).schema
+
+
+def iter_stream(fobj: IO[bytes],
+                table_id=None, schema=None) -> Iterator[ColumnBatch]:
+    """Yield ColumnBatches viewing the stream's messages in place."""
+    pa = pyarrow("Arrow IPC stream reading")
+    reader = pa.ipc.open_stream(fobj)
+    for rb in reader:
+        yield arrow_to_batch(rb, table_id=table_id, schema=schema)

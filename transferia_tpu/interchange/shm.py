@@ -1,0 +1,163 @@
+"""Same-host shared-memory shard handoff.
+
+When producer and consumer are co-located (decode plane feeding device
+dispatch in another process, or a Flight client talking to a server on
+the same host), the wire serializes once into a
+`multiprocessing.shared_memory` segment using Arrow IPC framing, and
+the consumer MAPS it: `attach()` returns ColumnBatches whose buffers
+view the segment in place (pa.BufferReader over the mapped bytes →
+np.frombuffer views), so the handoff costs one copy total (producer →
+segment) instead of producer → socket → kernel → socket → consumer.
+
+Ownership rules (ARCHITECTURE.md "Arrow interchange plane"):
+
+- the WRITER owns the segment name and is responsible for `unlink()`
+  after the consumer is done (the Flight server unlinks retired parts);
+- a reader must keep its `ShmAttachment` alive as long as any batch
+  adopted from it is alive — batches pin the attachment automatically
+  (numpy `.base` chains end at the mapped pa.Buffer, and the attachment
+  object is stitched onto the buffer keepalive), but `close()` on a
+  still-referenced attachment is the reader's bug;
+- segments are single-writer, many-reader, write-once (the IPC stream
+  inside is never appended after `seal`).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from multiprocessing import shared_memory
+from typing import Iterable, Optional
+
+from transferia_tpu.chaos.failpoints import failpoint
+from transferia_tpu.columnar.batch import ColumnBatch
+from transferia_tpu.interchange._pyarrow import pyarrow
+from transferia_tpu.interchange.convert import arrow_to_batch, batch_to_arrow
+from transferia_tpu.interchange.telemetry import TELEMETRY
+
+SHM_PREFIX = "trtpu-ichg-"
+
+
+@dataclass(frozen=True)
+class ShmHandle:
+    """Locator for a sealed segment (what crosses the control plane)."""
+
+    name: str
+    size: int
+
+    def to_json(self) -> dict:
+        return {"name": self.name, "size": self.size}
+
+    @staticmethod
+    def from_json(d: dict) -> "ShmHandle":
+        return ShmHandle(name=d["name"], size=int(d["size"]))
+
+
+def write_segment(batches: Iterable[ColumnBatch]) -> ShmHandle:
+    """Serialize batches (one table) into a fresh shm segment.
+
+    Sizes the segment exactly with a counting pass over the already-
+    wrapped Arrow batches (MockOutputStream measures framing without
+    writing), then streams into the mapped memory — the single copy of
+    the handoff."""
+    pa = pyarrow("the shared-memory handoff")
+    rbs = [b if isinstance(b, pa.RecordBatch) else batch_to_arrow(b)
+           for b in batches]
+    if not rbs:
+        raise ValueError("shm.write_segment: no batches")
+    mock = pa.MockOutputStream()
+    with pa.ipc.new_stream(mock, rbs[0].schema) as w:
+        for rb in rbs:
+            w.write_batch(rb)
+    size = mock.size()
+    seg = shared_memory.SharedMemory(create=True, size=size)
+    try:
+        _fill_segment(pa, seg, rbs)
+        TELEMETRY.add(shm_segments=1, bytes_out=size)
+        handle = ShmHandle(name=seg.name, size=size)
+    except BaseException:
+        seg.close()
+        seg.unlink()
+        raise
+    seg.close()  # the name stays valid until unlink()
+    return handle
+
+
+def _fill_segment(pa, seg, rbs) -> None:
+    """Stream into the mapping in its own scope: the pa.Buffer's export
+    on `seg.buf` must release before the caller's `seg.close()`."""
+    sink = pa.FixedSizeBufferWriter(pa.py_buffer(seg.buf))
+    with pa.ipc.new_stream(sink, rbs[0].schema) as w:
+        for rb in rbs:
+            w.write_batch(rb)
+    sink.close()
+
+
+def unlink_segment(handle: ShmHandle) -> None:
+    """Free a sealed segment (writer-side retirement)."""
+    try:
+        seg = shared_memory.SharedMemory(name=handle.name)
+    except FileNotFoundError:
+        return
+    seg.close()
+    seg.unlink()
+
+
+class ShmAttachment:
+    """A reader's mapping of a sealed segment.
+
+    `batches()` yields ColumnBatches viewing the mapped memory; each
+    batch pins this attachment (through the numpy `.base` chain to the
+    mapped buffer), so the segment stays mapped while any batch lives.
+    """
+
+    def __init__(self, handle: ShmHandle):
+        failpoint("interchange.shm.attach")
+        pa = pyarrow("the shared-memory handoff")
+        self.handle = handle
+        self._seg = shared_memory.SharedMemory(name=handle.name)
+        # pa.py_buffer holds the memoryview, which holds the mmap: every
+        # np.frombuffer view downstream roots here
+        self._buf = pa.py_buffer(self._seg.buf)[:handle.size]
+        self._pa = pa
+        TELEMETRY.add(bytes_in=handle.size)
+
+    def batches(self) -> list[ColumnBatch]:
+        reader = self._pa.ipc.open_stream(self._pa.BufferReader(self._buf))
+        return [arrow_to_batch(rb) for rb in reader]
+
+    def close(self) -> None:
+        """Unmap, or defer while adopted batches still view the mapping
+        (the deferred unmap happens on a later `reap_deferred()` — every
+        attach calls it — once the views die)."""
+        self._buf = None
+        seg, self._seg = self._seg, None
+        if seg is not None:
+            _close_or_defer(seg)
+
+
+# Mappings whose close raced live batch views: kept strongly referenced
+# (a GC'd SharedMemory with exported buffers warns loudly) and retried
+# whenever the module does shm work again.
+_DEFERRED: list = []
+_DEFERRED_LOCK = threading.Lock()
+
+
+def _close_or_defer(seg) -> None:
+    try:
+        seg.close()
+    except BufferError:
+        with _DEFERRED_LOCK:
+            _DEFERRED.append(seg)
+
+
+def reap_deferred() -> None:
+    with _DEFERRED_LOCK:
+        pending, _DEFERRED[:] = _DEFERRED[:], []
+    for seg in pending:
+        _close_or_defer(seg)
+
+
+def attach(handle: ShmHandle) -> ShmAttachment:
+    reap_deferred()
+    return ShmAttachment(handle)

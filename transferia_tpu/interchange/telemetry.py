@@ -1,0 +1,70 @@
+"""Always-on interchange counters (the DeviceTelemetry twin for the
+Arrow wire): bytes/batches in and out, zero-copy vs copied buffer
+adoptions, Flight streams and shm segments.
+
+Kept as plain ints under one lock (increments are per-batch/per-buffer,
+not per-row) and folded into the prometheus `Metrics` facade via
+`fold_into` → `InterchangeStats` (stats/registry.py), mirroring how
+stats/trace.py `DeviceTelemetry` reaches `DeviceStats`.
+"""
+
+from __future__ import annotations
+
+import threading
+
+_FIELDS = (
+    "bytes_in",
+    "bytes_out",
+    "batches_in",
+    "batches_out",
+    "zero_copy_buffers",
+    "copied_buffers",
+    "flight_streams",
+    "shm_segments",
+)
+
+
+class InterchangeTelemetry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._folded: dict[str, int] = {}
+        self.reset()
+
+    def reset(self) -> None:
+        with self._lock:
+            for f in _FIELDS:
+                setattr(self, f, 0)
+            self._folded = {f: 0 for f in _FIELDS}
+
+    def add(self, **deltas: int) -> None:
+        with self._lock:
+            for name, d in deltas.items():
+                setattr(self, name, getattr(self, name) + int(d))
+
+    def snapshot(self) -> dict[str, int]:
+        with self._lock:
+            return {f: getattr(self, f) for f in _FIELDS}
+
+    def zero_copy_ratio(self) -> float:
+        """Fraction of adopted buffers that crossed without a memcpy."""
+        snap = self.snapshot()
+        total = snap["zero_copy_buffers"] + snap["copied_buffers"]
+        return snap["zero_copy_buffers"] / total if total else 0.0
+
+    def fold_into(self, metrics) -> None:
+        """Apply counter deltas since the last fold into a Metrics
+        registry (idempotent across repeated folds, like
+        DeviceTelemetry.fold_into)."""
+        from transferia_tpu.stats.registry import InterchangeStats
+
+        stats = InterchangeStats(metrics)
+        with self._lock:
+            for f in _FIELDS:
+                cur = getattr(self, f)
+                delta = cur - self._folded.get(f, 0)
+                if delta > 0:
+                    getattr(stats, f).inc(delta)
+                self._folded[f] = cur
+
+
+TELEMETRY = InterchangeTelemetry()

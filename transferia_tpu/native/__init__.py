@@ -38,6 +38,12 @@ def _bind(cdll: ctypes.CDLL) -> ctypes.CDLL:
     cdll.scatter_bytes.restype = None
     cdll.gather_varwidth.argtypes = [u8, i32, i64, ctypes.c_int64, u8, i32]
     cdll.gather_varwidth.restype = ctypes.c_int64
+    # fixed-width gather is newer than some prebuilt .so files
+    if hasattr(cdll, "gather_fixed"):
+        cdll.gather_fixed.argtypes = [
+            u8, i64, ctypes.c_int64, ctypes.c_int32, u8,
+        ]
+        cdll.gather_fixed.restype = None
     cdll.pack_sha_blocks.argtypes = [
         u8, i32, ctypes.c_int64, ctypes.c_int32, ctypes.c_int32, u8, i32,
     ]

@@ -66,6 +66,43 @@ int64_t gather_varwidth(const uint8_t* src, const int32_t* src_offsets,
     return pos;
 }
 
+// Fixed-width row gather (Column.take host path): out row i gets the
+// `width` bytes at src[idx[i]*width].  Width-specialized loops for the
+// power-of-two widths every canonical fixed type uses (1/2/4/8) — the
+// numpy fancy-indexing equivalent pays per-element dispatch; this is a
+// straight typed copy loop.  memcpy fallback for exotic widths.
+void gather_fixed(const uint8_t* src, const int64_t* idx, int64_t n,
+                  int32_t width, uint8_t* out) {
+    switch (width) {
+    case 1:
+        for (int64_t i = 0; i < n; i++) out[i] = src[idx[i]];
+        break;
+    case 2: {
+        const uint16_t* s = (const uint16_t*)src;
+        uint16_t* o = (uint16_t*)out;
+        for (int64_t i = 0; i < n; i++) o[i] = s[idx[i]];
+        break;
+    }
+    case 4: {
+        const uint32_t* s = (const uint32_t*)src;
+        uint32_t* o = (uint32_t*)out;
+        for (int64_t i = 0; i < n; i++) o[i] = s[idx[i]];
+        break;
+    }
+    case 8: {
+        const uint64_t* s = (const uint64_t*)src;
+        uint64_t* o = (uint64_t*)out;
+        for (int64_t i = 0; i < n; i++) o[i] = s[idx[i]];
+        break;
+    }
+    default:
+        for (int64_t i = 0; i < n; i++) {
+            memcpy(out + i * (int64_t)width,
+                   src + idx[i] * (int64_t)width, (size_t)width);
+        }
+    }
+}
+
 // Pack var-width rows into padded SHA-256 block matrices (the host side of
 // the device HMAC path): row i of out gets src bytes, the 0x80 terminator,
 // zero fill, and the 8-byte big-endian bit length (including prefix_len

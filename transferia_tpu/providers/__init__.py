@@ -25,7 +25,9 @@ __all__ = [
 def load_builtin_providers() -> None:
     """Import all built-in providers (idempotent)."""
     from transferia_tpu.providers import (  # noqa: F401
+        arrow_ipc,
         file as file_p,
+        flight,
         memory,
         mq,
         sample,

@@ -1,0 +1,316 @@
+"""Arrow IPC stream provider: the interchange plane as a first-class
+endpoint (`arrow_ipc`).
+
+Source and sink speak the Arrow IPC *stream* format through
+`interchange/ipc.py`: file-backed (a path, directory, or glob; one
+stream per table, `<namespace>.<table>.arrows` in directory mode) or
+fd-backed (`fd://N`, an inherited pipe — the shard-handoff shape where
+a parent process feeds a worker directly).  Batches cross without a row
+pivot in either direction: the sink wraps ColumnBatch buffers into IPC
+messages and the source hands out ColumnBatches viewing the messages in
+place (convert.py), so `arrow_ipc → device` is memcpy-free for
+fixed-width columns.
+
+pyarrow is optional: the provider registers unconditionally and raises
+an actionable install hint only when a transfer actually exercises it
+(interchange/_pyarrow.py).
+"""
+
+from __future__ import annotations
+
+import glob as globmod
+import os
+from dataclasses import dataclass
+from typing import IO, Optional
+
+from transferia_tpu.abstract.interfaces import (
+    Batch,
+    Pusher,
+    ShardingStorage,
+    Sinker,
+    Storage,
+    TableInfo,
+    is_columnar,
+)
+from transferia_tpu.abstract.schema import TableID, TableSchema
+from transferia_tpu.abstract.table import TableDescription
+from transferia_tpu.chaos.failpoints import failpoint
+from transferia_tpu.columnar.batch import ColumnBatch
+from transferia_tpu.models.endpoint import EndpointParams, register_endpoint
+from transferia_tpu.providers.registry import Provider, register_provider
+
+STREAM_SUFFIX = ".arrows"
+
+
+@register_endpoint
+@dataclass
+class ArrowIpcSourceParams(EndpointParams):
+    PROVIDER = "arrow_ipc"
+    IS_SOURCE = True
+
+    path: str = ""          # file, dir, glob, or fd://N
+    # identity fallbacks for streams without trtpu metadata and
+    # filenames without a `<namespace>.<table>` stem
+    table: str = ""
+    namespace: str = "arrow"
+
+
+@register_endpoint
+@dataclass
+class ArrowIpcTargetParams(EndpointParams):
+    PROVIDER = "arrow_ipc"
+    IS_TARGET = True
+
+    path: str = ""          # file or fd://N (single table) or directory
+
+
+def _stem_table(path: str, params: ArrowIpcSourceParams) -> TableID:
+    stem = os.path.basename(path)
+    if stem.endswith(STREAM_SUFFIX):
+        stem = stem[:-len(STREAM_SUFFIX)]
+    if "." in stem:
+        ns, _, name = stem.rpartition(".")
+        return TableID(ns, name)
+    return TableID(params.namespace, params.table or stem)
+
+
+class ArrowIpcStorage(Storage, ShardingStorage):
+    """Snapshot storage over IPC streams; each FILE is a shardable part
+    (the format streams, so a file part re-read restarts cleanly).
+    `fd://N` streams are single-shot: a part retry cannot rewind a pipe,
+    so a second read attempt fails loudly instead of silently resuming
+    mid-stream with the already-consumed batches missing."""
+
+    def __init__(self, params: ArrowIpcSourceParams):
+        from transferia_tpu.interchange import ipc
+
+        self.params = params
+        self._ipc = ipc
+        self._fd_reader = None  # fd streams are single-shot: open once
+        self._fd_consumed = False
+        self._headers_cache = None  # immutable input: scan once
+
+    # -- layout -------------------------------------------------------------
+    def _files(self) -> list[str]:
+        p = self.params.path
+        if self._ipc.is_fd_location(p):
+            return [p]
+        if os.path.isdir(p):
+            return sorted(
+                os.path.join(p, f) for f in os.listdir(p)
+                if f.endswith(STREAM_SUFFIX))
+        if any(ch in p for ch in "*?["):
+            return sorted(globmod.glob(p))
+        return [p] if os.path.exists(p) else []
+
+    def _open_fd(self):
+        if self._fd_reader is None:
+            from transferia_tpu.interchange._pyarrow import pyarrow
+
+            pa = pyarrow("the arrow_ipc source")
+            fobj = self._ipc.open_location(self.params.path, "rb")
+            self._fd_reader = pa.ipc.open_stream(fobj)
+        return self._fd_reader
+
+    def _identity(self, path: str, pa_schema) -> tuple[TableID, TableSchema]:
+        from transferia_tpu.interchange import convert
+
+        md = pa_schema.metadata or {}
+        import json
+
+        if convert.TABLE_KEY in md:
+            t = json.loads(md[convert.TABLE_KEY])
+            tid = TableID(t["namespace"], t["name"])
+        else:
+            tid = _stem_table(path, self.params)
+        if convert.SCHEMA_KEY in md:
+            schema = TableSchema.from_json(json.loads(md[convert.SCHEMA_KEY]))
+        else:
+            from transferia_tpu.columnar.batch import arrow_to_table_schema
+
+            schema = arrow_to_table_schema(pa_schema)
+        return tid, schema
+
+    def _headers(self) -> dict[TableID, tuple[TableSchema, list[str]]]:
+        if self._headers_cache is not None:
+            return self._headers_cache
+        out: dict[TableID, tuple[TableSchema, list[str]]] = {}
+        for path in self._files():
+            if self._ipc.is_fd_location(path):
+                pa_schema = self._open_fd().schema
+            else:
+                with open(path, "rb") as fh:
+                    pa_schema = self._ipc.read_schema(fh)
+            tid, schema = self._identity(path, pa_schema)
+            if tid in out:
+                out[tid][1].append(path)
+            else:
+                out[tid] = (schema, [path])
+        self._headers_cache = out
+        return out
+
+    # -- Storage ------------------------------------------------------------
+    def table_list(self, include=None):
+        out = {}
+        for tid, (schema, _paths) in self._headers().items():
+            if include and not any(tid.include_matches(p) for p in include):
+                continue
+            out[tid] = TableInfo(eta_rows=0, schema=schema)
+        return out
+
+    def table_schema(self, table: TableID) -> TableSchema:
+        return self._headers()[table][0]
+
+    def shard_table(self, table: TableDescription) -> list[TableDescription]:
+        headers = self._headers()
+        if table.id not in headers:
+            return [table]
+        paths = headers[table.id][1]
+        if len(paths) <= 1:
+            return [table]
+        return [TableDescription(id=table.id, filter=f"file:{p}")
+                for p in paths]
+
+    def load_table(self, table: TableDescription, pusher: Pusher) -> None:
+        headers = self._headers()
+        if table.id not in headers:
+            raise KeyError(f"arrow_ipc: no stream for table {table.id}")
+        schema, paths = headers[table.id]
+        if table.filter.startswith("file:"):
+            paths = [table.filter[len("file:"):]]
+        for path in paths:
+            if self._ipc.is_fd_location(path):
+                if self._fd_consumed:
+                    raise RuntimeError(
+                        f"arrow_ipc: {path} is a single-shot pipe "
+                        f"already consumed by an earlier read — a part "
+                        f"retry cannot rewind it; use a file path for "
+                        f"retryable sources")
+                self._fd_consumed = True
+                self._push_reader(self._open_fd(), table.id, schema, pusher)
+                continue
+            with open(path, "rb") as fh:
+                from transferia_tpu.interchange._pyarrow import pyarrow
+
+                pa = pyarrow("the arrow_ipc source")
+                self._push_reader(pa.ipc.open_stream(fh), table.id,
+                                  schema, pusher)
+
+    def _push_reader(self, reader, tid: TableID, schema: TableSchema,
+                     pusher: Pusher) -> None:
+        from transferia_tpu.interchange.convert import arrow_to_batch
+        from transferia_tpu.stats import trace
+
+        for rb in reader:
+            failpoint("interchange.ipc.read")
+            sp = trace.span("source_decode")
+            if sp:
+                sp.add(rows=rb.num_rows, direction="arrow_ipc")
+            with sp:
+                batch = arrow_to_batch(rb, table_id=tid, schema=schema)
+            pusher(batch)
+
+    def close(self) -> None:
+        self._fd_reader = None
+
+
+class ArrowIpcSinker(Sinker):
+    """IPC stream sink: one writer per table (directory mode) or a
+    single-table stream (file / fd mode).  Columnar batches cross with
+    wrapped buffers; row batches pivot once here (the row-oriented edge,
+    same contract as the parquet sink)."""
+
+    def __init__(self, params: ArrowIpcTargetParams):
+        import uuid
+
+        from transferia_tpu.interchange import ipc
+
+        self.params = params
+        self._ipc = ipc
+        self._writers: dict[TableID, ipc.StreamWriter] = {}
+        self._single: Optional[TableID] = None
+        # the snapshot loader builds one sink pipeline per part in
+        # parallel: directory-mode file names embed an instance token so
+        # concurrent part sinks never clobber one table stream (same
+        # contract as the fs sink); stream metadata carries the real
+        # table identity, so readers ignore the token
+        self._token = uuid.uuid4().hex[:8]
+        p = params.path
+        self._dir_mode = bool(p) and not ipc.is_fd_location(p) \
+            and (os.path.isdir(p) or p.endswith(os.sep))
+
+    def _writer(self, tid: TableID):
+        w = self._writers.get(tid)
+        if w is not None:
+            return w
+        if self._dir_mode:
+            os.makedirs(self.params.path, exist_ok=True)
+            loc = os.path.join(
+                self.params.path,
+                f"{tid.namespace}.{tid.name}.{self._token}"
+                f"{STREAM_SUFFIX}")
+        else:
+            if self._single is not None and self._single != tid:
+                raise ValueError(
+                    f"arrow_ipc sink {self.params.path!r} is a single "
+                    f"stream but got tables {self._single} and {tid}; "
+                    f"point `path` at a directory for multi-table "
+                    f"transfers")
+            self._single = tid
+            loc = self.params.path
+        w = self._ipc.StreamWriter(self._ipc.open_location(loc, "wb"))
+        self._writers[tid] = w
+        return w
+
+    def push(self, batch: Batch) -> None:
+        from transferia_tpu.stats import trace
+
+        if is_columnar(batch):
+            blocks = [batch]
+        else:
+            rows = [it for it in batch if it.is_row_event()]
+            if not rows:
+                return  # control events don't land in the stream
+            by_table: dict[TableID, list] = {}
+            for it in rows:
+                by_table.setdefault(it.table_id, []).append(it)
+            blocks = [ColumnBatch.from_rows(its) for its in
+                      by_table.values()]
+        for b in blocks:
+            sp = trace.span("sink_push")
+            if sp:
+                sp.add(rows=b.n_rows, direction="arrow_ipc")
+            with sp:
+                self._writer(b.table_id).write(b)
+
+    def close(self) -> None:
+        errs = []
+        for w in self._writers.values():
+            try:
+                w.close()
+            except Exception as e:  # close every stream before raising
+                errs.append(e)
+        self._writers.clear()
+        if errs:
+            raise errs[0]
+
+
+@register_provider
+class ArrowIpcProvider(Provider):
+    NAME = "arrow_ipc"
+
+    def storage(self):
+        if isinstance(self.transfer.src, ArrowIpcSourceParams):
+            return ArrowIpcStorage(self.transfer.src)
+        return None
+
+    def destination_storage(self):
+        if isinstance(self.transfer.dst, ArrowIpcTargetParams):
+            return ArrowIpcStorage(ArrowIpcSourceParams(
+                path=self.transfer.dst.path))
+        return None
+
+    def sinker(self):
+        if isinstance(self.transfer.dst, ArrowIpcTargetParams):
+            return ArrowIpcSinker(self.transfer.dst)
+        return None

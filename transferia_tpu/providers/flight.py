@@ -1,0 +1,248 @@
+"""Arrow Flight provider: shard handoff endpoints as transfer sources
+and sinks (`flight`).
+
+The source reads parts published on a `ShardFlightServer`
+(interchange/flight.py): every Flight on the server is one
+`OperationTablePart`-shaped stream keyed `<namespace>.<table>/<part>`,
+each listed part becomes a shardable `TableDescription`, and co-located
+clients map the server's shared-memory segments instead of pulling the
+gRPC stream (automatic — interchange/shm.py).  The sink DoPuts each
+pushed batch as a part, which is how a decode-plane worker publishes
+shards for the fleet instead of every worker re-decoding parquet.
+
+pyarrow(+flight) is optional: the provider registers unconditionally
+and raises an actionable install hint at use time (_pyarrow.py).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+from transferia_tpu.abstract.interfaces import (
+    Batch,
+    Pusher,
+    ShardingStorage,
+    Sinker,
+    Storage,
+    TableInfo,
+    is_columnar,
+)
+from transferia_tpu.abstract.schema import TableID, TableSchema
+from transferia_tpu.abstract.table import TableDescription
+from transferia_tpu.columnar.batch import ColumnBatch
+from transferia_tpu.models.endpoint import EndpointParams, register_endpoint
+from transferia_tpu.providers.registry import Provider, register_provider
+
+_PART_FILTER = "flight_part:"
+
+
+@register_endpoint
+@dataclass
+class FlightSourceParams(EndpointParams):
+    PROVIDER = "flight"
+    IS_SOURCE = True
+
+    uri: str = ""            # grpc://host:port
+    # None = auto (shm negotiated when the server is co-located);
+    # False forces the gRPC wire path
+    allow_shm: Optional[bool] = None
+
+
+@register_endpoint
+@dataclass
+class FlightTargetParams(EndpointParams):
+    PROVIDER = "flight"
+    IS_TARGET = True
+
+    uri: str = ""
+
+
+def part_key(table_id: TableID, part: str) -> str:
+    return f"{table_id.namespace}.{table_id.name}/{part}"
+
+
+def split_part_key(key: str) -> tuple[TableID, str]:
+    path, _, part = key.rpartition("/")
+    ns, _, name = path.rpartition(".")
+    return TableID(ns, name), part
+
+
+class FlightStorage(Storage, ShardingStorage):
+    """Snapshot storage over a shard server's published parts."""
+
+    def __init__(self, params: FlightSourceParams):
+        from transferia_tpu.interchange.flight import FlightShardClient
+
+        self.params = params
+        self._client = FlightShardClient(params.uri,
+                                         allow_shm=params.allow_shm)
+        # the part list is immutable for the life of a snapshot shard
+        # plan: list_flights once, not per capability call
+        self._catalog_cache = None
+
+    def _catalog(self) -> dict[TableID, tuple[TableSchema, list[str], int]]:
+        from transferia_tpu.columnar.batch import arrow_to_table_schema
+        from transferia_tpu.interchange import convert
+
+        if self._catalog_cache is not None:
+            return self._catalog_cache
+        out: dict[TableID, tuple[TableSchema, list[str], int]] = {}
+        for info in self._client.list_parts():
+            key = info.descriptor.path[0].decode()
+            tid, _part = split_part_key(key)
+            md = info.schema.metadata or {}
+            if convert.SCHEMA_KEY in md:
+                schema = TableSchema.from_json(
+                    json.loads(md[convert.SCHEMA_KEY]))
+            else:
+                schema = arrow_to_table_schema(info.schema)
+            if tid in out:
+                prev = out[tid]
+                prev[1].append(key)
+                out[tid] = (prev[0], prev[1],
+                            prev[2] + max(0, info.total_records))
+            else:
+                out[tid] = (schema, [key], max(0, info.total_records))
+        self._catalog_cache = out
+        return out
+
+    def table_list(self, include=None):
+        out = {}
+        for tid, (schema, _keys, rows) in self._catalog().items():
+            if include and not any(tid.include_matches(p) for p in include):
+                continue
+            out[tid] = TableInfo(eta_rows=rows, schema=schema)
+        return out
+
+    def table_schema(self, table: TableID) -> TableSchema:
+        return self._catalog()[table][0]
+
+    def estimate_table_rows_count(self, table: TableID) -> int:
+        entry = self._catalog().get(table)
+        return entry[2] if entry else 0
+
+    def shard_table(self, table: TableDescription) -> list[TableDescription]:
+        entry = self._catalog().get(table.id)
+        if entry is None:
+            return [table]
+        return [TableDescription(id=table.id,
+                                 filter=f"{_PART_FILTER}{key}")
+                for key in entry[1]]
+
+    def load_table(self, table: TableDescription, pusher: Pusher) -> None:
+        if table.filter.startswith(_PART_FILTER):
+            keys = [table.filter[len(_PART_FILTER):]]
+        else:
+            entry = self._catalog().get(table.id)
+            if entry is None:
+                raise KeyError(f"flight: no parts for table {table.id}")
+            keys = entry[1]
+        for key in keys:
+            for batch in self._client.get_part(key):
+                pusher(batch.rename_table(table.id))
+
+    def close(self) -> None:
+        self._client.close()
+
+
+class FlightSinker(Sinker):
+    """Publishes pushed blocks as part streams: consecutive batches of
+    one part flow through a single held-open DoPut stream (closed when
+    the part changes or on close()).  Part identity is the batch's
+    `part_id` when the snapshot engine stamped one, else a per-table
+    sequence.  A RETRIED part re-puts its key, which REPLACES the
+    server-side stream — duplicates never append."""
+
+    def __init__(self, params: FlightTargetParams):
+        import uuid
+
+        from transferia_tpu.interchange.flight import FlightShardClient
+
+        self.params = params
+        self._client = FlightShardClient(params.uri)
+        self._seq: dict[TableID, int] = {}
+        # table -> (part key, open flight writer); push is serialized
+        # per sink instance (Sinker contract)
+        self._open: dict[TableID, tuple] = {}
+        self._lock = threading.Lock()
+        # sequence-keyed fallback parts embed an instance token: the
+        # loader runs one sink pipeline per part in parallel, and two
+        # instances both starting at seq 0 must not replace each
+        # other's streams (same contract as the fs sink's file token)
+        self._token = uuid.uuid4().hex[:8]
+
+    def push(self, batch: Batch) -> None:
+        if is_columnar(batch):
+            blocks = [batch]
+        else:
+            rows = [it for it in batch if it.is_row_event()]
+            if not rows:
+                return
+            by_table: dict[TableID, list] = {}
+            for it in rows:
+                by_table.setdefault(it.table_id, []).append(it)
+            blocks = [ColumnBatch.from_rows(its) for its in
+                      by_table.values()]
+        from transferia_tpu.interchange.convert import batch_to_arrow
+
+        for b in blocks:
+            rb = batch_to_arrow(b)
+            if b.part_id:
+                key = part_key(b.table_id, b.part_id)
+                cur = self._open.get(b.table_id)
+                if cur is not None and cur[0] != key:
+                    cur[1].close()
+                    cur = None
+                if cur is None:
+                    cur = (key, self._client.begin_put(key, rb.schema))
+                    self._open[b.table_id] = cur
+                cur[1].write_batch(rb)
+                continue
+            # no engine part identity: each push is its own part stream,
+            # and the sequence advances only AFTER the put succeeds — a
+            # sink-retried push re-puts the SAME key, which the server
+            # replaces (the dedup contract the class docstring promises)
+            with self._lock:
+                seq = self._seq.get(b.table_id, 0)
+            key = part_key(b.table_id, f"{self._token}-{seq}")
+            writer = self._client.begin_put(key, rb.schema)
+            writer.write_batch(rb)
+            writer.close()
+            with self._lock:
+                self._seq[b.table_id] = seq + 1
+
+    def close(self) -> None:
+        errs = []
+        for _key, writer in self._open.values():
+            try:
+                writer.close()
+            except Exception as e:
+                errs.append(e)
+        self._open.clear()
+        self._client.close()
+        if errs:
+            raise errs[0]
+
+
+@register_provider
+class FlightProvider(Provider):
+    NAME = "flight"
+
+    def storage(self):
+        if isinstance(self.transfer.src, FlightSourceParams):
+            return FlightStorage(self.transfer.src)
+        return None
+
+    def destination_storage(self):
+        if isinstance(self.transfer.dst, FlightTargetParams):
+            return FlightStorage(FlightSourceParams(
+                uri=self.transfer.dst.uri))
+        return None
+
+    def sinker(self):
+        if isinstance(self.transfer.dst, FlightTargetParams):
+            return FlightSinker(self.transfer.dst)
+        return None

@@ -188,6 +188,25 @@ class DeviceStats(_Bundle):
             "decode_readahead_inflight_bytes")
 
 
+class InterchangeStats(_Bundle):
+    """Arrow interchange plane counters (interchange/telemetry.py folds
+    its deltas in here).  `zero_copy_buffers` vs `copied_buffers` is the
+    plane's honesty metric: a wire that claims zero-copy but shows a
+    copied-buffer majority is pivoting after all."""
+
+    def __init__(self, metrics: Optional[Metrics] = None):
+        super().__init__(metrics)
+        self.bytes_in = self.m.counter("interchange_bytes_in")
+        self.bytes_out = self.m.counter("interchange_bytes_out")
+        self.batches_in = self.m.counter("interchange_batches_in")
+        self.batches_out = self.m.counter("interchange_batches_out")
+        self.zero_copy_buffers = self.m.counter(
+            "interchange_zero_copy_buffers")
+        self.copied_buffers = self.m.counter("interchange_copied_buffers")
+        self.flight_streams = self.m.counter("interchange_flight_streams")
+        self.shm_segments = self.m.counter("interchange_shm_segments")
+
+
 class ChaosStats(_Bundle):
     """Fault-injection counters (chaos/).  Per-site fire counts land as
     `chaos_fires_<site with dots -> underscores>` so a chaos soak's
