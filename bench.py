@@ -1244,6 +1244,111 @@ def _trace_out_path() -> str:
     return out
 
 
+def measure_dispatch() -> dict:
+    """`--dispatch`: the compressed dispatch plane's micro-bench —
+    identical dict-heavy mask+filter batches (the clickbench URL shape:
+    low-cardinality string column + int filter column) dispatched
+    through the fused device program with the encoding forced RAW vs
+    AUTO (ops/dispatch.py).  Reports rows/s per mode plus the
+    encoded-vs-raw-equivalent H2D compression ratio; the acceptance bar
+    is >=5x on the dict-heavy shape."""
+    from transferia_tpu.abstract import TableID
+    from transferia_tpu.abstract.schema import new_table_schema
+    from transferia_tpu.columnar.batch import (
+        Column,
+        ColumnBatch,
+        DictEnc,
+        DictPool,
+        _offsets_from_lengths,
+    )
+    from transferia_tpu.ops import dispatch as dsp
+    from transferia_tpu.stats.trace import TELEMETRY
+    from transferia_tpu.transform import build_chain
+    from transferia_tpu.transform.fused import (
+        set_device_fusion,
+        set_placement,
+    )
+
+    rows = int(os.environ.get("BENCH_DISPATCH_ROWS", 131_072))
+    n_batches = max(1, int(os.environ.get("BENCH_DISPATCH_BATCHES", 4)))
+    uniques = 4096
+    tid = TableID("bench", "dispatch")
+    schema = new_table_schema([("URL", "utf8"), ("RegionID", "int32")])
+    rng = np.random.default_rng(11)
+    vals = [f"https://bench{i}.example/path/{i % 97}/{i}"
+            for i in range(uniques)]
+    bufs = [v.encode() for v in vals]
+    pool_data = np.frombuffer(b"".join(bufs), dtype=np.uint8).copy()
+    pool_off = _offsets_from_lengths([len(b) for b in bufs] + [0])
+
+    # identical data for both modes: draw once, rebind per-mode pools
+    batch_data = [
+        (rng.integers(0, uniques, rows).astype(np.int32),
+         rng.integers(0, 500, rows).astype(np.int32))
+        for _ in range(n_batches)
+    ]
+
+    def batches(pool):
+        out = []
+        for codes, regions in batch_data:
+            url = Column("URL", schema.find("URL").data_type,
+                         dict_enc=DictEnc(codes, pool=pool))
+            region = Column(
+                "RegionID", schema.find("RegionID").data_type, regions)
+            out.append(ColumnBatch(tid, schema,
+                                   {"URL": url, "RegionID": region}))
+        return out
+
+    cfg = {"transformers": [
+        {"mask_field": {"columns": ["URL"], "salt": "bench-salt"}},
+        {"filter_rows": {"filter": "RegionID < 400"}},
+    ]}
+
+    def run_mode(mode: str) -> tuple[float, dict]:
+        # fresh pool per mode so neither rides the other's memo
+        pool = DictPool(pool_data, pool_off, null_code=uniques)
+        data = batches(pool)
+        dsp.set_dispatch_encoding(mode)
+        set_device_fusion(True)
+        set_placement("device")
+        try:
+            chain = build_chain(cfg)
+            chain.apply(data[0])  # warm: compiles + pool upload
+            TELEMETRY.reset()
+            t0 = time.perf_counter()
+            total = 0
+            for b in data:
+                out = chain.apply(b)
+                total += out.n_rows
+            dt = time.perf_counter() - t0
+            assert total > 0
+            return (n_batches * rows) / max(dt, 1e-9), \
+                TELEMETRY.snapshot()
+        finally:
+            set_device_fusion(None)
+            set_placement(None)
+            dsp.set_dispatch_encoding(None)
+
+    raw_rps, raw_snap = run_mode("raw")
+    enc_rps, enc_snap = run_mode("auto")
+    ratio = (enc_snap["h2d_raw_equiv_bytes"]
+             / max(enc_snap["h2d_encoded_bytes"], 1))
+    return {
+        "metric": "dispatch_encoded_rows_per_sec",
+        "unit": "rows/sec",
+        "value": round(enc_rps),
+        "raw_rows_per_sec": round(raw_rps),
+        "speedup_vs_raw": round(enc_rps / max(raw_rps, 1e-9), 2),
+        "compression_ratio": round(ratio, 1),
+        "h2d_encoded_bytes": enc_snap["h2d_encoded_bytes"],
+        "h2d_raw_equiv_bytes": enc_snap["h2d_raw_equiv_bytes"],
+        "h2d_raw_mode_bytes": raw_snap["h2d_bytes"],
+        "dict_pool_hits": enc_snap["dict_pool_hits"],
+        "rows_per_batch": rows,
+        "batches": n_batches,
+    }
+
+
 def measure_interchange() -> dict:
     """`--interchange`: the Arrow interchange plane's shard-handoff
     stage — identical sample batches moved via the row-pivot baseline
@@ -1267,6 +1372,16 @@ def main() -> None:
         report = measure_interchange()
         for line in format_report(report).splitlines():
             print(f"# {line}", file=sys.stderr)
+        print(json.dumps(report))
+        return
+
+    if "--dispatch" in sys.argv[1:]:
+        # standalone stage: encoded vs raw H2D dispatch (one JSON line)
+        report = measure_dispatch()
+        print(f"# dispatch: encoded {report['value']} rows/s vs raw "
+              f"{report['raw_rows_per_sec']} rows/s "
+              f"({report['speedup_vs_raw']}x), compression "
+              f"{report['compression_ratio']}x", file=sys.stderr)
         print(json.dumps(report))
         return
 
@@ -1404,6 +1519,13 @@ def main() -> None:
         link_note = probe_link().describe()
     except Exception as e:
         link_note = f"probe failed: {type(e).__name__}"
+    from transferia_tpu.stats.trace import TELEMETRY as _tel
+
+    _snap = _tel.snapshot()
+    if _snap["h2d_encoded_bytes"]:
+        link_note += (
+            f" dispatch_ratio={_snap['dispatch_compression_ratio']}"
+            f" dict_pool_hits={_snap['dict_pool_hits']}")
     print(f"# link: {link_note}"
           + (f" {_placement_note}" if _placement_note else ""),
           file=sys.stderr)
@@ -1455,6 +1577,15 @@ def main() -> None:
             print(f"# {json.dumps(ichg)}", file=sys.stderr)
         except Exception as e:
             print(f"# interchange bench failed: {type(e).__name__}: {e}",
+                  file=sys.stderr)
+    if os.environ.get("BENCH_SKIP_DISPATCH") != "1":
+        try:
+            disp = measure_dispatch()
+            if fallback:
+                disp["fallback"] = fallback
+            print(f"# {json.dumps(disp)}", file=sys.stderr)
+        except Exception as e:
+            print(f"# dispatch bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
     # remaining BASELINE configs (each prints one tail line; failures
     # never mask the headline, which already printed)

@@ -44,6 +44,12 @@ SITES: dict[str, tuple[str, str]] = {
         "ops/fused.py",
         "fused mask/filter device launch failing (XLA error, device "
         "OOM, link reset)"),
+    "dispatch.h2d": (
+        "ops/dispatch.py",
+        "encoded-dispatch H2D staging failing (device_put OOM, link "
+        "reset mid-transfer) before any kernel launches — the batch "
+        "must fail cleanly with no partial device state and retry "
+        "through the part machinery"),
     "device.mesh_dispatch": (
         "parallel/fusedmesh.py",
         "multi-chip sharded launch failing on the mesh path"),

@@ -364,13 +364,18 @@ class Column:
             # (out-of-range spans fall through so the gather raises the
             # same IndexError numpy always did instead of clamping)
             return self._take_contiguous(*span)
-        validity = self.validity[indices] if self.validity is not None else None
+        validity = (_gather_fixed(self.validity, indices)
+                    if self.validity is not None else None)
         if self.is_lazy_dict:
-            # dictionary stays shared; only the int32 codes gather
+            # dictionary stays shared; only the int32 codes gather —
+            # through the native width-specialized loop, so a filter on
+            # a DictEnc column never materializes the pool and never
+            # pays numpy's generic fancy-indexing path
             enc = self.dict_enc
             return Column(
                 self.name, self.ctype, validity=validity,
-                dict_enc=DictEnc(enc.indices[indices], pool=enc.pool))
+                dict_enc=DictEnc(_gather_fixed(enc.indices, indices),
+                                 pool=enc.pool))
         if self.offsets is None:
             return Column(self.name, self.ctype,
                           _gather_fixed(self.data, indices), None, validity)

@@ -49,6 +49,45 @@ def decode_dict_run(words: jax.Array, pool: jax.Array, bit_width: int,
     return jnp.take(pool, codes, axis=0, mode="clip")
 
 
+@functools.partial(jax.jit, static_argnums=(1,))
+def unpack_validity(words: jax.Array, n: int) -> jax.Array:
+    """Packed little-endian validity bitmap -> (n,) bool.
+
+    The width-1 specialization of unpack_bits: a batch's null bitmap
+    ships as n/8 bytes instead of n bool bytes (the compressed-dispatch
+    plane's cheapest win — ops/dispatch.py packs, this unpacks)."""
+    return _unpack_core(words, 1, n).astype(jnp.bool_)
+
+
+def delta_prefix_sum(words: jax.Array, base: jax.Array, bit_width: int,
+                     n: int) -> jax.Array:
+    """Zigzag-delta decode: values[i] = base + Σ deltas[0..i], int32.
+
+    The device half of the delta+bit-pack integer encoding
+    (ops/dispatch.encode_delta): deltas arrive zigzag-encoded so the
+    unpacked codes are non-negative; the prefix sum reconstructs the
+    column exactly (encode rejects widths > 30 bits, so every partial
+    sum fits int32 with no wraparound).  Traceable inline — callers
+    inside larger jitted programs use this form directly."""
+    zz = _unpack_core(words, bit_width, n)
+    deltas = (zz >> 1) ^ -(zz & 1)
+    return base.astype(jnp.int32) + jnp.cumsum(deltas, dtype=jnp.int32)
+
+
+delta_decode = jax.jit(delta_prefix_sum, static_argnums=(2, 3))
+
+
+def pack_mask_words(bits: jax.Array, n: int) -> jax.Array:
+    """(n,) bool -> packed little-endian uint32 words (device side).
+
+    The D2H twin of unpack_validity: the fused program returns its keep
+    mask as n/8 bytes instead of n bool bytes.  n must be a multiple of
+    32 (row buckets are — columnar.batch._BUCKETS).  Traceable inline."""
+    b = bits.reshape(n // 32, 32).astype(jnp.uint32)
+    weights = jnp.uint32(1) << jnp.arange(32, dtype=jnp.uint32)
+    return (b * weights).sum(axis=1).astype(jnp.uint32)
+
+
 @functools.partial(jax.jit, static_argnums=(2, 3, 4))
 def decode_dict_loop(words: jax.Array, pool: jax.Array, bit_width: int,
                      n: int, iters: int) -> jax.Array:

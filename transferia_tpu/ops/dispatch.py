@@ -1,0 +1,357 @@
+"""Compressed device dispatch: ship encoded columns, decode on device.
+
+The slow host↔device link is the governing bottleneck of the device
+plane (BENCH_r03/r04: h2d ≈ 21 MB/s, every fused step link-gated to
+`placement=host` while the mask kernel idles at 12-14M rows/s).  The
+fix is not a faster kernel — it is fewer bytes: columns cross the link
+in their compact encodings and the decode kernels in ops/decode.py
+reconstruct them on device, byte-identical to host decode.
+
+Encodings (selection is per column, per batch, host-side):
+
+- **dict pools** — a `DictEnc` masked column never ships row bytes at
+  all: the value pool uploads ONCE per (pool, HMAC key), hashes on
+  device, and the (k, 8) digest matrix comes back to become a hexed
+  `DictPool` memoized on the shared pool (`device_hmac_dict_pool`).
+  Batches slicing the same row group then mask for free — the row
+  codes never leave the host.
+- **validity bitmaps** — bit-packed to n/8 bytes (`encode_validity` /
+  `ops.decode.unpack_validity`); the keep mask returns the same way
+  (`ops.decode.pack_mask_words`), shrinking the predicate's D2H 8x.
+- **delta + bit-pack integers** — predicate columns whose zigzag'd
+  deltas fit <= 30 bits ship as base + packed deltas and reconstruct
+  via `ops.decode.delta_prefix_sum` (sorted ids, timestamps, dates).
+- **bool data** — bit-packed like validity.
+
+`TRANSFERIA_TPU_DISPATCH_ENCODING` picks the mode: `auto` (default —
+encode whenever it shrinks) or `raw` (the pre-compression wire, kept
+as the fallback and the A side of `bench.py --dispatch`).
+
+Grounding: Zerrow (PAPERS.md) keeps data in its compact columnar
+encoding across plane boundaries; Thallus shows transport cost, not
+compute, is what columnar pipelines must engineer around.  This module
+is host-side only (numpy packers + staging); the traced decode lives
+in ops/decode.py, and ops/fused.py composes both into the fused
+program.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+from transferia_tpu.stats import trace
+from transferia_tpu.stats.trace import TELEMETRY
+
+_mode_cached: Optional[str] = None
+
+# serializes dict-pool device hashing: concurrent part threads sharing
+# one DictPool must not each pay the pool upload the memo exists to
+# amortize.  One process-wide lock is enough — uploads happen once per
+# (pool, key), so contention is a startup transient, not steady state.
+_pool_hash_lock = threading.Lock()
+
+# zigzag'd deltas wider than this fall back to raw: the device prefix
+# sum runs in int32 and must never wrap (30 bits of |delta| keeps every
+# partial sum an exact int32), and past ~30 bits the shrink is gone
+_DELTA_MAX_BITS = 30
+# below this many rows the encode/decode round trip costs more than the
+# handful of saved bytes
+_DELTA_MIN_ROWS = 256
+
+
+def dispatch_encoding() -> str:
+    """auto (encode whenever it shrinks, default) | raw."""
+    global _mode_cached
+    if _mode_cached is None:
+        mode = os.environ.get(
+            "TRANSFERIA_TPU_DISPATCH_ENCODING", "auto").lower()
+        _mode_cached = mode if mode in ("auto", "raw") else "auto"
+    return _mode_cached
+
+
+def set_dispatch_encoding(mode: Optional[str]) -> None:
+    """Force the dispatch encoding mode (None = re-read the env)."""
+    global _mode_cached
+    _mode_cached = mode
+
+
+def encoding_enabled() -> bool:
+    return dispatch_encoding() != "raw"
+
+
+# -- host-side packers -------------------------------------------------------
+
+def pack_bits_host(values: np.ndarray, bit_width: int) -> np.ndarray:
+    """Non-negative values -> the little-endian packed uint32 word
+    stream ops/decode.unpack_bits consumes (value i occupies bits
+    [i*bw, (i+1)*bw) of the stream)."""
+    n = len(values)
+    if n == 0:
+        return np.zeros(0, dtype=np.uint32)
+    shifts = np.arange(bit_width, dtype=np.uint64)
+    bits = ((values.astype(np.uint64)[:, None] >> shifts) & 1).astype(
+        np.uint8)
+    packed = np.packbits(bits.reshape(-1), bitorder="little")
+    pad = (-len(packed)) % 4
+    if pad:
+        packed = np.pad(packed, (0, pad))
+    return packed.view(np.uint32)
+
+
+def encode_validity(validity: np.ndarray) -> np.ndarray:
+    """(n,) bool -> packed little-endian uint32 bitmap words."""
+    packed = np.packbits(np.ascontiguousarray(validity, dtype=np.uint8),
+                         bitorder="little")
+    pad = (-len(packed)) % 4
+    if pad:
+        packed = np.pad(packed, (0, pad))
+    return packed.view(np.uint32)
+
+
+def unpack_mask_host(words: np.ndarray, n: int) -> np.ndarray:
+    """Packed uint32 keep-mask words (D2H) -> (n,) bool, host side."""
+    bits = np.unpackbits(np.ascontiguousarray(words).view(np.uint8),
+                         bitorder="little")
+    return bits[:n].astype(np.bool_)
+
+
+def encode_delta(data: np.ndarray
+                 ) -> Optional[tuple[int, np.ndarray, int]]:
+    """Delta+bit-pack an integer array: (base, packed words, bit_width),
+    or None when the encoding would not shrink the transfer."""
+    n = len(data)
+    if data.dtype.kind not in "iu" or n < _DELTA_MIN_ROWS:
+        return None
+    v = data.astype(np.int64)
+    # the device prefix sum reconstructs VALUES in int32, not just
+    # deltas — every value (and the base) must fit int32 exactly, or a
+    # 64-bit column would decode wrapped (and np.int32(base) overflow)
+    if int(v.min()) < -2**31 or int(v.max()) > 2**31 - 1:
+        return None
+    base = int(v[0])
+    deltas = np.diff(v, prepend=base)
+    zz = ((deltas << 1) ^ (deltas >> 63)).astype(np.uint64)
+    bw = max(1, int(zz.max()).bit_length())
+    if bw > _DELTA_MAX_BITS:
+        return None
+    if bw * n >= data.nbytes * 8:  # no shrink over the raw dtype
+        return None
+    return base, pack_bits_host(zz, bw), bw
+
+
+# -- per-column dispatch encodings ------------------------------------------
+
+@dataclass(frozen=True)
+class PredEnc:
+    """Static half of one predicate column's dispatch encoding (a jit
+    static argument — the traced program's structure hangs off it).
+
+    kind: raw (dtype bytes as-is) | delta (base + packed zigzag deltas,
+    integer dtypes) | bits (bit-packed boolean data).
+    valid_mode: none (all-valid, synthesized on device) | bits
+    (bit-packed bitmap) | raw (bool bytes, the uncompressed wire).
+    """
+
+    name: str
+    dtype: str
+    kind: str
+    bit_width: int
+    valid_mode: str
+
+
+def encode_pred_column(name: str, data: np.ndarray,
+                       validity: Optional[np.ndarray], n_rows: int,
+                       bucket: int, encoded: bool
+                       ) -> tuple[PredEnc, tuple, int]:
+    """Encode one predicate column for dispatch.
+
+    Returns (spec, host arrays ready for H2D, raw_equiv_bytes — what the
+    uncompressed wire would have shipped).  Data pads to the bucket with
+    its edge value (keeps delta widths narrow); validity pads False, so
+    padded rows never pass the predicate regardless of data padding.
+    """
+    raw_equiv = bucket * data.dtype.itemsize + bucket  # data + bool bitmap
+    if bucket != n_rows:
+        data = np.pad(data, (0, bucket - n_rows),
+                      mode="edge" if n_rows else "constant")
+        if validity is not None:
+            validity = np.pad(validity, (0, bucket - n_rows))
+    if not encoded:
+        if validity is None:
+            validity = np.ones(bucket, dtype=np.bool_)
+        spec = PredEnc(name, str(data.dtype), "raw", 0, "raw")
+        return spec, (data, validity), raw_equiv
+    if validity is None:
+        valid_mode, val_arrays = "none", ()
+    else:
+        valid_mode, val_arrays = "bits", (encode_validity(validity),)
+    if data.dtype == np.bool_:
+        spec = PredEnc(name, str(data.dtype), "bits", 1, valid_mode)
+        return (spec, (encode_validity(data),) + val_arrays, raw_equiv)
+    delta = encode_delta(data)
+    if delta is not None:
+        base, words, bw = delta
+        spec = PredEnc(name, str(data.dtype), "delta", bw, valid_mode)
+        return (spec, (words, np.int32(base)) + val_arrays, raw_equiv)
+    spec = PredEnc(name, str(data.dtype), "raw", 0, valid_mode)
+    return spec, (data,) + val_arrays, raw_equiv
+
+
+def decode_pred_device(spec: PredEnc, arrays, bucket: int):
+    """Traced device decode of one encoded predicate column — runs
+    INSIDE the fused jitted program (spec is static there), emitting
+    the (data, validity) pair predicate/device.compile_mask_jnp eats."""
+    import jax.numpy as jnp
+
+    from transferia_tpu.ops.decode import (
+        delta_prefix_sum,
+        unpack_validity,
+    )
+
+    if spec.kind == "raw":
+        data = arrays[0]
+    elif spec.kind == "bits":
+        data = unpack_validity(arrays[0], bucket)
+    else:  # delta
+        data = delta_prefix_sum(arrays[0], arrays[1], spec.bit_width,
+                                bucket).astype(np.dtype(spec.dtype))
+    if spec.valid_mode == "none":
+        valid = jnp.ones(bucket, dtype=jnp.bool_)
+    elif spec.valid_mode == "bits":
+        valid = unpack_validity(arrays[-1], bucket)
+    else:
+        valid = arrays[-1]
+    return data, valid
+
+
+# -- H2D staging -------------------------------------------------------------
+
+def stage_h2d(arrays, raw_equiv_bytes: int, what: str = "batch",
+              put: bool = True):
+    """Stage a pytree of host arrays for upload — THE single H2D point
+    of the compressed dispatch plane: chaos's `dispatch.h2d` failpoint
+    and the encoded-vs-raw byte accounting both live here, so every
+    encoded transfer is injectable and audited.
+
+    put=True (default) device_puts eagerly (async) so a pipelined
+    caller controls when the transfer enqueues; put=False returns the
+    host arrays unchanged for callers whose jit does its own placement
+    (the mesh-sharded program: an eager put would land everything on
+    one device and force a reshard hop)."""
+    import jax
+
+    from transferia_tpu.chaos.failpoints import failpoint
+
+    failpoint("dispatch.h2d")
+    leaves = jax.tree_util.tree_leaves(arrays)
+    encoded = sum(int(getattr(a, "nbytes", 0)) for a in leaves)
+    with trace.span("device_decode", encoded_bytes=encoded,
+                    raw_equiv_bytes=int(raw_equiv_bytes), what=what):
+        dev = (jax.tree_util.tree_map(jax.device_put, arrays)
+               if put else arrays)
+    TELEMETRY.record_dispatch(encoded, int(raw_equiv_bytes))
+    return dev
+
+
+# -- device-resident dict-pool masking --------------------------------------
+
+def device_hmac_dict_pool(key: bytes, pool, n_rows: int):
+    """HMAC a DictPool's values ON DEVICE, once per (pool, key).
+
+    Returns the hexed pool (a DictPool of 64-char hex digests with the
+    null sentinel emptied), memoized on the shared pool under the SAME
+    memo key as the host path (transform/plugins/mask.mask_dict_column)
+    — whichever strategy touches a pool first pays; the other rides the
+    memo.  Row codes never cross the link: the caller keeps them and
+    rebinds them to the hexed pool.
+
+    Returns None when the pool is too large to pay for itself on this
+    batch (mirrors the host-path economics) — the caller falls back to
+    the flat blocks wire.
+    """
+    memo_key = ("hmac_hex", key)
+    hexed = pool.memo_get(memo_key)
+    if hexed is not None:
+        TELEMETRY.record_pool_hit()
+        _record_avoided_batch_bytes(pool, n_rows)
+        return hexed
+    if pool.n_values > 2 * max(n_rows, 1):
+        return None
+    with _pool_hash_lock:
+        return _hash_pool_locked(key, pool, n_rows, memo_key)
+
+
+def _hash_pool_locked(key: bytes, pool, n_rows: int, memo_key):
+    # double-checked: a racing part thread may have hashed this pool
+    # while we waited on the lock
+    hexed = pool.memo_get(memo_key)
+    if hexed is not None:
+        TELEMETRY.record_pool_hit()
+        _record_avoided_batch_bytes(pool, n_rows)
+        return hexed
+    import jax.numpy as jnp
+
+    from transferia_tpu.columnar.batch import bucket_rows
+    from transferia_tpu.columnar.hexcol import digests_to_hex
+    from transferia_tpu.ops.fused import pack_hmac_blocks, pow2_blocks
+    from transferia_tpu.ops.sha256 import (
+        _hmac_inner_outer,
+        _hmac_key_states,
+    )
+    from transferia_tpu.transform.plugins.mask import hexed_pool_from_flat
+
+    n_vals = pool.n_values
+    offsets = pool.values_offsets
+    lens = offsets[1:] - offsets[:-1]
+    max_len = int(lens.max()) if n_vals else 0
+    mb = pow2_blocks(max_len)
+    blocks, n_blocks = pack_hmac_blocks(pool.values_data, offsets, mb)
+    bucket = bucket_rows(max(n_vals, 1))
+    if bucket != n_vals:
+        blocks = np.pad(blocks, ((0, bucket - n_vals), (0, 0)))
+        n_blocks = np.pad(n_blocks, (0, bucket - n_vals))
+    inner, outer = _hmac_key_states(bytes(key))
+    with trace.span("pool_upload", values=n_vals,
+                    bytes=int(blocks.nbytes)):
+        dev_blocks, dev_nblocks = stage_h2d(
+            (blocks, n_blocks),
+            raw_equiv_bytes=int(blocks.nbytes) + int(n_blocks.nbytes),
+            what="dict_pool")
+        TELEMETRY.record_h2d(int(blocks.nbytes) + int(n_blocks.nbytes))
+        digests = _hmac_inner_outer(
+            dev_blocks, dev_nblocks,
+            (jnp.asarray(inner[0]), jnp.asarray(outer[0])), mb)
+        TELEMETRY.record_launch()
+        digest_rows = np.asarray(digests)[:n_vals]
+    TELEMETRY.record_d2h(int(digests.nbytes))
+    TELEMETRY.record_pool_upload()
+    hex_mat = digests_to_hex(digest_rows)
+    from transferia_tpu.columnar.hexcol import hex_to_varwidth
+
+    flat, flat_off = hex_to_varwidth(hex_mat, None)
+    hexed = hexed_pool_from_flat(pool, flat, flat_off)
+    pool.memo_set(memo_key, hexed)
+    _record_avoided_batch_bytes(pool, n_rows)
+    return hexed
+
+
+def _record_avoided_batch_bytes(pool, n_rows: int) -> None:
+    """Credit the dispatch accounting with the per-batch bytes the raw
+    wire WOULD have shipped for a pool-routed column: the bucket-padded
+    SHA block matrix plus per-row block counts.  (Block width estimated
+    from the pool's longest value — the per-row materialized max the
+    raw path would use is bounded by it.)"""
+    from transferia_tpu.columnar.batch import bucket_rows
+    from transferia_tpu.ops.fused import pow2_blocks
+
+    offs = pool.values_offsets
+    lens = offs[1:] - offs[:-1]
+    max_len = int(lens.max()) if pool.n_values else 0
+    mb = pow2_blocks(max_len)
+    bucket = bucket_rows(max(n_rows, 1))
+    TELEMETRY.record_dispatch(0, (mb * 64 + 4) * bucket)

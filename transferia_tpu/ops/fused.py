@@ -30,6 +30,14 @@ import jax
 import jax.numpy as jnp
 
 from transferia_tpu.columnar.batch import bucket_rows
+from transferia_tpu.ops.decode import pack_mask_words
+from transferia_tpu.ops.dispatch import (
+    decode_pred_device,
+    encode_pred_column,
+    encoding_enabled,
+    stage_h2d,
+    unpack_mask_host,
+)
 from transferia_tpu.ops.sha256 import (
     _hmac_key_states,
     hmac_device_core,
@@ -79,6 +87,21 @@ def set_chunk_rows(n: Optional[int]) -> None:
     """Force the pipelined-dispatch chunk size (None = re-detect)."""
     global _chunk_rows_cached
     _chunk_rows_cached = n
+
+
+def _dispatch_depth() -> int:
+    """Launches kept in flight by the pipelined path (H2D of chunk g+1
+    staged while chunk g computes and g-1 drains).
+    TRANSFERIA_TPU_DISPATCH_DEPTH overrides; floor 1."""
+    import os
+
+    env = os.environ.get("TRANSFERIA_TPU_DISPATCH_DEPTH")
+    if env is not None:
+        try:
+            return max(1, int(env))
+        except ValueError:
+            pass
+    return 2
 
 
 def _pallas_pack_enabled() -> bool:
@@ -181,12 +204,14 @@ class FusedMaskFilterProgram:
         # compiles to an identical mask fn, so cache sharing is sound)
         pred_fn = self._pred_fn
 
-        def program(blocks_t, nblocks_t, states_t, pred_cols,
-                    max_blocks_t):
-            # raw (N, 8) u32 digests leave the device — 32 bytes/row vs
-            # 64 for hex; the host LUT-expands (columnar/hexcol.py).  On
-            # bandwidth-starved links (see ops/linkprobe.py) D2H is the
-            # bottleneck stage, so the return payload is kept minimal.
+        def program(blocks_t, nblocks_t, states_t, pred_arrays, spec):
+            # spec (static): (bucket, max_blocks per column, PredEnc per
+            # predicate column, pack_keep).  Raw (N, 8) u32 digests leave
+            # the device — 32 bytes/row vs 64 for hex; the host
+            # LUT-expands (columnar/hexcol.py).  On bandwidth-starved
+            # links (see ops/linkprobe.py) the return payload is kept
+            # minimal: with pack_keep the keep mask returns bit-packed.
+            bucket, max_blocks_t, pred_specs, pack_keep = spec
             digests = tuple(
                 hmac_device_core(b, nb, st[0], st[1], mb)
                 for b, nb, st, mb in zip(
@@ -194,9 +219,15 @@ class FusedMaskFilterProgram:
                 )
             )
             if pred_fn is not None:
-                # bucketed batch length is static under this trace; a
-                # fused run always has >= 1 masked column
-                keep = pred_fn(pred_cols, blocks_t[0].shape[0])
+                # predicate columns arrive in their dispatch encodings
+                # (bit-packed validity, delta ints) and decode on device
+                cols = {
+                    ps.name: decode_pred_device(ps, arrs, bucket)
+                    for ps, arrs in zip(pred_specs, pred_arrays)
+                }
+                keep = pred_fn(cols, bucket)
+                if pack_keep:
+                    keep = pack_mask_words(keep, bucket)
             else:
                 keep = jnp.zeros((0,), dtype=jnp.bool_)  # unused sentinel
             return digests, keep
@@ -209,13 +240,17 @@ class FusedMaskFilterProgram:
 
     def run(self, mask_cols: Sequence[tuple[np.ndarray, np.ndarray]],
             pred_cols: dict[str, tuple[np.ndarray, Optional[np.ndarray]]],
-            n_rows: int) -> tuple[list[np.ndarray], Optional[np.ndarray]]:
+            n_rows: int, states: Optional[list] = None
+            ) -> tuple[list[np.ndarray], Optional[np.ndarray]]:
         """mask_cols: per masked column (flat uint8 data, int32 offsets).
         pred_cols: name -> (fixed-width data, validity or None).
+        states: HMAC key states parallel to mask_cols (defaults to the
+        constructor's full set — callers that peeled dict columns off to
+        the pool route pass the surviving subset).
         Returns ([hex (n_rows, 64) per masked column], keep mask or None).
 
         On an accelerator backend, large batches run as a chunked
-        double-buffered pipeline: the host packs+dispatches chunk k+1
+        double-buffered pipeline: the host packs+stages chunk k+1's H2D
         while the device computes chunk k and the host drains chunk k-1
         (D2H), so H2D / compute / D2H / pack overlap instead of
         serializing per batch.  One chunk size -> one compiled program.
@@ -226,33 +261,68 @@ class FusedMaskFilterProgram:
         chunk = _chunk_rows()
         if chunk and n_rows > chunk and not _pallas_pack_enabled():
             return self._run_pipelined(mask_cols, pred_cols, n_rows,
-                                       chunk)
-        return self._run_single(mask_cols, pred_cols, n_rows)
+                                       chunk, states=states)
+        return self._run_single(mask_cols, pred_cols, n_rows, states)
 
-    def _dispatch(self, mask_cols, pred_cols, n_rows, bucket):
-        """Pack on host and launch the jitted program (async); returns
-        the device handles without blocking on the result."""
+    def _stage(self, mask_cols, pred_cols, n_rows, bucket, states=None):
+        """Pack + encode on host and enqueue the (async) H2D for one
+        chunk — compute does NOT launch here, so a pipelined caller can
+        overlap this chunk's transfer with the previous chunk's
+        kernels.  Returns the staged device handles."""
+        states = self._states if states is None else list(states)
         use_pallas_pack = _pallas_pack_enabled()
         blocks_t, nblocks_t, mb_t = [], [], []
         pack_t0 = _time.perf_counter()
         with trace.span("pack"):
             self._pack_inputs(mask_cols, n_rows, bucket,
                               use_pallas_pack, blocks_t, nblocks_t, mb_t)
-            dev_pred = self._pack_pred(pred_cols, n_rows, bucket)
+            pred_specs, pred_arrays, pred_raw = self._encode_pred(
+                pred_cols, n_rows, bucket)
         stagetimer.add("pack", _time.perf_counter() - pack_t0)
-        h2d = (sum(int(b.nbytes) + int(nb.nbytes)
-                   for b, nb in zip(blocks_t, nblocks_t))
-               + sum(int(d.nbytes) + int(v.nbytes)
-                     for d, v in dev_pred.values()))
+        blocks_raw = sum(int(b.nbytes) + int(nb.nbytes)
+                         for b, nb in zip(blocks_t, nblocks_t))
+        dev_blocks, dev_nblocks, dev_pred = stage_h2d(
+            (tuple(blocks_t), tuple(nblocks_t), tuple(pred_arrays)),
+            raw_equiv_bytes=blocks_raw + pred_raw)
+        h2d = (blocks_raw
+               + sum(int(a.nbytes) for arrs in pred_arrays for a in arrs))
         TELEMETRY.record_h2d(h2d)
+        pack_keep = self._pred_fn is not None and encoding_enabled()
+        spec = (bucket, tuple(mb_t), tuple(pred_specs), pack_keep)
+        return (dev_blocks, dev_nblocks, dev_pred, tuple(states), spec,
+                n_rows, h2d)
+
+    def _launch(self, staged):
+        """Launch the jitted program over a staged chunk (async);
+        returns the device handles without blocking on the result."""
+        dev_blocks, dev_nblocks, dev_pred, states, spec, rows, h2d = staged
         TELEMETRY.record_launch()
         with stagetimer.stage("device_dispatch"), \
-                trace.span("device_dispatch", bytes=h2d, rows=n_rows):
+                trace.span("device_dispatch", bytes=h2d, rows=rows):
             hexes_dev, keep_dev = self._jit(
-                tuple(blocks_t), tuple(nblocks_t), tuple(self._states),
-                dev_pred, tuple(mb_t),
+                dev_blocks, dev_nblocks, states, dev_pred, spec,
             )
-        return hexes_dev, keep_dev
+        return hexes_dev, keep_dev, rows, spec[3]
+
+    def _dispatch(self, mask_cols, pred_cols, n_rows, bucket,
+                  states=None):
+        staged = self._stage(mask_cols, pred_cols, n_rows, bucket,
+                             states)
+        hexes_dev, keep_dev, _rows, packed = self._launch(staged)
+        return hexes_dev, keep_dev, packed
+
+    def _encode_pred(self, pred_cols, n_rows, bucket):
+        """Per-column dispatch encodings (ops/dispatch.py): bit-packed
+        validity + delta ints when they shrink, raw otherwise."""
+        enc = encoding_enabled()
+        specs, arrays, raw_total = [], [], 0
+        for name, (data, validity) in pred_cols.items():
+            spec, arrs, raw = encode_pred_column(
+                name, data, validity, n_rows, bucket, enc)
+            specs.append(spec)
+            arrays.append(arrs)
+            raw_total += raw
+        return specs, arrays, raw_total
 
     def _pack_inputs(self, mask_cols, n_rows, bucket,
                      use_pallas_pack, blocks_t, nblocks_t, mb_t):
@@ -286,22 +356,13 @@ class FusedMaskFilterProgram:
             if bucket != n_rows:
                 blocks = np.pad(blocks, ((0, bucket - n_rows), (0, 0)))
                 n_blocks = np.pad(n_blocks, (0, bucket - n_rows))
-            blocks_t.append(jnp.asarray(blocks))
-            nblocks_t.append(jnp.asarray(n_blocks))
+            # numpy here — the H2D is staged explicitly by stage_h2d so
+            # the pipelined path controls when the transfer enqueues
+            blocks_t.append(blocks)
+            nblocks_t.append(n_blocks)
             mb_t.append(mb)
 
-    def _pack_pred(self, pred_cols, n_rows, bucket) -> dict:
-        dev_pred = {}
-        for name, (data, validity) in pred_cols.items():
-            if validity is None:
-                validity = np.ones(n_rows, dtype=np.bool_)
-            if bucket != n_rows:
-                data = np.pad(data, (0, bucket - n_rows))
-                validity = np.pad(validity, (0, bucket - n_rows))
-            dev_pred[name] = (jnp.asarray(data), jnp.asarray(validity))
-        return dev_pred
-
-    def _collect(self, digests_dev, keep_dev, n_rows
+    def _collect(self, digests_dev, keep_dev, n_rows, packed_keep=False
                  ) -> tuple[list[np.ndarray], Optional[np.ndarray]]:
         """Block on D2H, trim bucket padding, hex-expand on host."""
         from transferia_tpu.columnar.hexcol import digests_to_hex
@@ -315,8 +376,12 @@ class FusedMaskFilterProgram:
                 # view never pins the bucket-padded transfer buffer
                 arr = np.asarray(h)[:n_rows]
                 hexes.append(digests_to_hex(arr))
-            keep = (np.asarray(keep_dev)[:n_rows]
-                    if self._pred_fn is not None else None)
+            if self._pred_fn is None:
+                keep = None
+            elif packed_keep:
+                keep = unpack_mask_host(np.asarray(keep_dev), n_rows)
+            else:
+                keep = np.asarray(keep_dev)[:n_rows]
             d2h = sum(int(h.nbytes) for h in digests_dev)
             if keep_dev is not None and self._pred_fn is not None:
                 d2h += int(keep_dev.nbytes)
@@ -326,24 +391,33 @@ class FusedMaskFilterProgram:
         TELEMETRY.record_kernel(_time.perf_counter() - t0)
         return hexes, keep
 
-    def _run_single(self, mask_cols, pred_cols, n_rows):
-        hexes_dev, keep_dev = self._dispatch(mask_cols, pred_cols,
-                                             n_rows, bucket_rows(n_rows))
-        return self._collect(hexes_dev, keep_dev, n_rows)
+    def _run_single(self, mask_cols, pred_cols, n_rows, states=None):
+        hexes_dev, keep_dev, packed = self._dispatch(
+            mask_cols, pred_cols, n_rows, bucket_rows(n_rows), states)
+        return self._collect(hexes_dev, keep_dev, n_rows, packed)
 
     def _run_pipelined(self, mask_cols, pred_cols, n_rows, chunk,
-                       depth: int = 2):
+                       depth: Optional[int] = None, states=None):
         """Split the batch into fixed-size chunks and keep `depth` device
-        launches in flight: pack(k+1) overlaps compute(k) and D2H(k-1)."""
+        launches in flight, with one chunk's H2D always staged AHEAD of
+        the compute launches: stage(k+1) overlaps compute(k) and
+        D2H(k-1), so the link and the chip work simultaneously."""
         from collections import deque
 
+        if depth is None:
+            depth = _dispatch_depth()
+        staged_q: deque = deque()
         inflight: deque = deque()
         hex_parts: list[list[np.ndarray]] = []
         keep_parts: list[np.ndarray] = []
 
+        def launch_oldest():
+            h_dev, k_dev, rows, packed = self._launch(staged_q.popleft())
+            inflight.append((h_dev, k_dev, rows, packed))
+
         def drain_one():
-            h_dev, k_dev, rows = inflight.popleft()
-            hexes, keep = self._collect(h_dev, k_dev, rows)
+            h_dev, k_dev, rows, packed = inflight.popleft()
+            hexes, keep = self._collect(h_dev, k_dev, rows, packed)
             hex_parts.append(hexes)
             if keep is not None:
                 keep_parts.append(keep)
@@ -364,11 +438,16 @@ class FusedMaskFilterProgram:
                     data[lo:hi],
                     validity[lo:hi] if validity is not None else None,
                 )
-            h_dev, k_dev = self._dispatch(sub_mask, sub_pred, rows,
-                                          bucket_rows(rows))
-            inflight.append((h_dev, k_dev, rows))
+            staged_q.append(self._stage(sub_mask, sub_pred, rows,
+                                        bucket_rows(rows), states))
+            # launch all but the freshest chunk: its H2D streams while
+            # the previous chunk's kernels run (double-buffered H2D)
+            while len(staged_q) > 1:
+                launch_oldest()
             while len(inflight) > depth:
                 drain_one()
+        while staged_q:
+            launch_oldest()
         while inflight:
             drain_one()
         n_mask = len(mask_cols)

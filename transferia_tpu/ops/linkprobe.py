@@ -23,6 +23,7 @@ returned without measuring.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
@@ -39,13 +40,19 @@ class LinkProfile:
     h2d_bytes_per_s: float
     d2h_bytes_per_s: float
     measured: bool  # False for env-pinned / in-process constants
+    degraded: bool = False  # wedged-runtime fallback: re-probed later
 
     def describe(self) -> str:
+        suffix = ""
+        if self.degraded:
+            suffix = " (degraded)"
+        elif not self.measured:
+            suffix = " (pinned)"
         return (
             f"backend={self.backend} launch={self.launch_overhead_s * 1e3:.1f}ms "
             f"h2d={self.h2d_bytes_per_s / 1e6:.0f}MB/s "
             f"d2h={self.d2h_bytes_per_s / 1e6:.0f}MB/s"
-            f"{'' if self.measured else ' (pinned)'}"
+            f"{suffix}"
         )
 
 
@@ -116,11 +123,53 @@ def _measure(backend: str) -> LinkProfile:
     )
 
 
+# a wedged-runtime fallback profile re-measures after this many
+# probe_link() reads (≈ batches) — a transiently dead runtime must not
+# pin the worst-case link, and with it host placement, forever
+_REPROBE_DEFAULT = 256
+_degraded_reads = 0
+
+
+def _reprobe_every() -> int:
+    env = os.environ.get("TRANSFERIA_TPU_LINK_REPROBE")
+    if env is not None:
+        try:
+            return max(0, int(env))  # 0 disables re-probing
+        except ValueError:
+            pass
+    return _REPROBE_DEFAULT
+
+
 def probe_link(force: bool = False) -> LinkProfile:
-    """The process-wide link profile (measured once, then cached)."""
-    global _cached
+    """The process-wide link profile (measured once, then cached).
+
+    A profile born from the wedged-runtime fallback is DEGRADED: it
+    re-measures after every TRANSFERIA_TPU_LINK_REPROBE reads (default
+    256) so a runtime that was only transiently unreachable regains its
+    real link model — and with it device placement eligibility —
+    without a process restart."""
+    global _cached, _degraded_reads
     if _cached is not None and not force:
-        return _cached
+        if not _cached.degraded:
+            return _cached
+        with _lock:
+            cur = _cached
+            if cur is not None:
+                if cur.degraded:
+                    _degraded_reads += 1
+                    every = _reprobe_every()
+                    if every and _degraded_reads >= every:
+                        _degraded_reads = 0
+                        try:
+                            _cached = _measure(cur.backend)
+                        except Exception:
+                            # still wedged: keep the worst-case
+                            # fallback and retry after another window
+                            logging.getLogger(__name__).debug(
+                                "link re-probe failed; runtime still "
+                                "wedged", exc_info=True)
+                return _cached
+            # raced with reset_link_cache: fall through and re-detect
     with _lock:
         if _cached is not None and not force:
             return _cached
@@ -142,12 +191,13 @@ def probe_link(force: bool = False) -> LinkProfile:
                     profile = LinkProfile(
                         backend=backend, launch_overhead_s=0.1,
                         h2d_bytes_per_s=1e7, d2h_bytes_per_s=1e6,
-                        measured=False)
+                        measured=False, degraded=True)
         _cached = profile
         return profile
 
 
 def reset_link_cache() -> None:
-    global _cached
+    global _cached, _degraded_reads
     with _lock:
         _cached = None
+        _degraded_reads = 0

@@ -212,13 +212,25 @@ class ShardedFusedProgram:
                      for d, v in dev_pred.values())
                + int(valid.nbytes))
         TELEMETRY.record_h2d(h2d)
+        # the mesh wire is (for now) uncompressed — stage through the
+        # shared dispatch site anyway so its transfers carry the same
+        # chaos failpoint and honest 1.0x byte accounting as the
+        # single-device plane (a mesh path claiming compression it
+        # doesn't do would poison the ratio gauge).  put=False: the
+        # sharded jit places each shard itself; an eager device_put
+        # would land everything on one device and pay a reshard hop
+        from transferia_tpu.ops.dispatch import stage_h2d
+
+        blocks_s, nblocks_s, pred_s, valid_s = stage_h2d(
+            (tuple(blocks_t), tuple(nblocks_t), dev_pred, valid),
+            raw_equiv_bytes=h2d, what="mesh", put=False)
         TELEMETRY.record_launch()
         with stagetimer.stage("device_dispatch"), \
                 trace.span("device_dispatch", bytes=h2d, rows=n_rows,
                            mesh=self.n_dev):
             digests_dev, keep_dev, hist, kept = fn(
-                tuple(blocks_t), tuple(nblocks_t), tuple(self._states),
-                dev_pred, valid, tuple(mb_t),
+                blocks_s, nblocks_s, tuple(self._states),
+                pred_s, valid_s, tuple(mb_t),
             )
         t_wait0 = _time.perf_counter()
         with stagetimer.stage("device_wait"), \

@@ -186,6 +186,17 @@ class DeviceStats(_Bundle):
         self.readahead_depth = self.m.gauge("decode_readahead_depth")
         self.readahead_bytes = self.m.gauge(
             "decode_readahead_inflight_bytes")
+        # compressed dispatch plane (ops/dispatch.py): encoded vs
+        # raw-equivalent H2D bytes — the ratio gauge IS the plane's
+        # honesty metric (a "compressed" wire showing ~1.0 is shipping
+        # flat buffers after all) — plus dict-pool residency counters
+        self.h2d_encoded_bytes = self.m.counter("h2d_encoded_bytes")
+        self.h2d_raw_equiv_bytes = self.m.counter("h2d_raw_equiv_bytes")
+        self.compression_ratio = self.m.gauge(
+            "dispatch_compression_ratio")
+        self.dict_pool_hits = self.m.counter("dict_pool_device_hits")
+        self.dict_pool_uploads = self.m.counter(
+            "dict_pool_device_uploads")
 
 
 class InterchangeStats(_Bundle):

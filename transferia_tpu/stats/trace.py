@@ -362,6 +362,13 @@ class DeviceTelemetry:
             self.compile_events = 0
             self.compile_seconds = 0.0
             self.kernel_seconds = 0.0
+            # compressed dispatch plane (ops/dispatch.py): actual bytes
+            # staged vs what the raw wire would have shipped, plus the
+            # dict-pool residency economics
+            self.h2d_encoded_bytes = 0
+            self.h2d_raw_equiv_bytes = 0
+            self.dict_pool_hits = 0
+            self.dict_pool_uploads = 0
             # per-target fold baselines: several pipelines may each
             # fold the (process-global) counters into their own
             # Metrics; one shared baseline would split deltas between
@@ -383,6 +390,23 @@ class DeviceTelemetry:
         with self._lock:
             self.device_launches += n
 
+    def record_dispatch(self, encoded_bytes: int,
+                        raw_equiv_bytes: int) -> None:
+        """One encoded H2D staging: what actually crossed the link vs
+        what the uncompressed wire would have shipped."""
+        with self._lock:
+            self.h2d_encoded_bytes += int(encoded_bytes)
+            self.h2d_raw_equiv_bytes += int(raw_equiv_bytes)
+
+    def record_pool_hit(self) -> None:
+        """A dict pool's hexed form was already device-memoized."""
+        with self._lock:
+            self.dict_pool_hits += 1
+
+    def record_pool_upload(self) -> None:
+        with self._lock:
+            self.dict_pool_uploads += 1
+
     def record_kernel(self, seconds: float) -> None:
         with self._lock:
             self.kernel_seconds += seconds
@@ -394,6 +418,8 @@ class DeviceTelemetry:
 
     def snapshot(self) -> dict:
         with self._lock:
+            ratio = (self.h2d_raw_equiv_bytes
+                     / max(self.h2d_encoded_bytes, 1))
             return {
                 "h2d_bytes": self.h2d_bytes,
                 "h2d_transfers": self.h2d_transfers,
@@ -403,6 +429,11 @@ class DeviceTelemetry:
                 "compile_events": self.compile_events,
                 "compile_seconds": round(self.compile_seconds, 4),
                 "kernel_seconds": round(self.kernel_seconds, 4),
+                "h2d_encoded_bytes": self.h2d_encoded_bytes,
+                "h2d_raw_equiv_bytes": self.h2d_raw_equiv_bytes,
+                "dispatch_compression_ratio": round(ratio, 2),
+                "dict_pool_hits": self.dict_pool_hits,
+                "dict_pool_uploads": self.dict_pool_uploads,
             }
 
     def fold_into(self, metrics) -> None:
@@ -429,6 +460,10 @@ class DeviceTelemetry:
                 "compile_events": self.compile_events,
                 "compile_seconds": self.compile_seconds,
                 "kernel_seconds": self.kernel_seconds,
+                "h2d_encoded_bytes": self.h2d_encoded_bytes,
+                "h2d_raw_equiv_bytes": self.h2d_raw_equiv_bytes,
+                "dict_pool_hits": self.dict_pool_hits,
+                "dict_pool_uploads": self.dict_pool_uploads,
             }
             prev = self._folded.setdefault(metrics, {})
             for key, counter in (
@@ -440,11 +475,20 @@ class DeviceTelemetry:
                 ("compile_events", ds.compiles),
                 ("compile_seconds", ds.compile_seconds),
                 ("kernel_seconds", ds.kernel_seconds),
+                ("h2d_encoded_bytes", ds.h2d_encoded_bytes),
+                ("h2d_raw_equiv_bytes", ds.h2d_raw_equiv_bytes),
+                ("dict_pool_hits", ds.dict_pool_hits),
+                ("dict_pool_uploads", ds.dict_pool_uploads),
             ):
                 delta = snap[key] - prev.get(key, 0)
                 if delta > 0:
                     counter.inc(delta)
                 prev[key] = snap[key]
+            # ratio is a gauge (an absolute, not a delta): raw-equiv
+            # over encoded across the process lifetime
+            if self.h2d_encoded_bytes:
+                ds.compression_ratio.set(
+                    self.h2d_raw_equiv_bytes / self.h2d_encoded_bytes)
 
 
 TELEMETRY = DeviceTelemetry()

@@ -161,27 +161,70 @@ class DeviceFusedStep(Transformer):
             for m in self.members:
                 out = m.apply(out).transformed
             return TransformResult(out)
-        strategy = self._pick_strategy(batch.n_rows)
+        strategy = self._pick_strategy(batch.n_rows, batch)
         if strategy == "host":
             return self._apply_host(batch)
         return self._apply_device(batch)
 
-    def _predict_device_ns_row(self, n_rows: int) -> float:
+    def _estimate_link_bytes(self, n_rows: int, batch=None
+                             ) -> tuple[float, float]:
+        """(h2d, d2h) bytes the device strategy would move for a batch,
+        accounting for the compressed dispatch plane (ops/dispatch.py):
+        a dict-encoded masked column whose hexed pool is already
+        device-resident costs ZERO link bytes; an unhashed pool costs
+        one pool upload (not per-row blocks); encoded predicate columns
+        ship their dtype bytes + an n/8 bitmap and return an n/8 keep
+        mask.  With encoding off (or no batch to inspect), the raw-wire
+        constants apply: ~128 SHA-block bytes/row per masked column in,
+        32 digest bytes/row out."""
+        from transferia_tpu.ops.dispatch import encoding_enabled
+
+        enc = encoding_enabled()
+        h2d = 0.0
+        d2h = 0.0
+        for name, key in self.mask_entries:
+            col = None
+            if batch is not None and name in batch.columns:
+                col = batch.column(name)
+            if enc and col is not None and col.is_lazy_dict:
+                pool = col.dict_enc.pool
+                if pool.memo_get(("hmac_hex", bytes(key))) is not None:
+                    continue  # hexed pool already resident: free
+                if pool.n_values <= 2 * max(n_rows, 1):
+                    # one pool upload (~2 SHA blocks/value) + pool
+                    # digests back — amortized across every batch that
+                    # shares the pool, but charged to this one
+                    h2d += 128.0 * pool.n_values
+                    d2h += 32.0 * pool.n_values
+                    continue
+            h2d += 128.0 * n_rows
+            d2h += 32.0 * n_rows
+        if self.pred_node is not None:
+            for name in self.pred_cols:
+                itemsize = 8
+                if (batch is not None and name in batch.columns
+                        and not batch.column(name).is_lazy_dict):
+                    itemsize = batch.column(name).data.dtype.itemsize
+                h2d += n_rows * itemsize
+                h2d += n_rows / 8 if enc else n_rows
+            d2h += n_rows / 8 if enc else n_rows  # the keep mask
+        return h2d, d2h
+
+    def _predict_device_ns_row(self, n_rows: int, batch=None) -> float:
         """Link-model estimate of the device strategy's cost per row.
 
-        Two syncs (dispatch + collect) pay the launch overhead; H2D moves
-        the padded SHA block matrices (~2 blocks/row typical) plus the
-        predicate columns; D2H returns 32 digest bytes/row per masked
-        column plus the keep mask.  Compute is taken from the measured
-        on-chip kernel rate's order (~10M rows/s — vanishingly small next
-        to a slow link, irrelevant next to a fast one).
+        Two syncs (dispatch + collect) pay the launch overhead; the
+        bytes-over-link terms come from _estimate_link_bytes, which
+        folds the dispatch compression ratio in — so `auto` placement
+        judges the ENCODED wire, not the raw one.  Compute is taken
+        from the measured on-chip kernel rate's order (~10M rows/s —
+        vanishingly small next to a slow link, irrelevant next to a
+        fast one).
         """
         from transferia_tpu.ops.linkprobe import probe_link
 
         link = probe_link()
-        n_mask = max(len(self.mask_entries), 1)
-        h2d_bytes = n_rows * (128 * n_mask + 8 * len(self.pred_cols))
-        d2h_bytes = n_rows * (32 * n_mask + 1)
+        h2d_bytes, d2h_bytes = self._estimate_link_bytes(n_rows, batch)
         s = (2 * link.launch_overhead_s
              + h2d_bytes / link.h2d_bytes_per_s
              + d2h_bytes / link.d2h_bytes_per_s
@@ -193,7 +236,7 @@ class DeviceFusedStep(Transformer):
     # device costs ~1s and lands straight in the p99
     PROBE_HEADROOM = 4.0
 
-    def _pick_strategy(self, n_rows: int = 0) -> str:
+    def _pick_strategy(self, n_rows: int = 0, batch=None) -> str:
         mode = placement_mode()
         if mode in ("device", "host"):
             return mode
@@ -203,7 +246,7 @@ class DeviceFusedStep(Transformer):
         if host_ns < 0:
             return "host"
         if dev_ns < 0:
-            predicted = self._predict_device_ns_row(max(n_rows, 1))
+            predicted = self._predict_device_ns_row(max(n_rows, 1), batch)
             if predicted > host_ns * self.PROBE_HEADROOM:
                 if not self._device_gated:
                     self._device_gated = True
@@ -219,7 +262,8 @@ class DeviceFusedStep(Transformer):
             if loser == "device":
                 # the link model gates device re-probes too: through a
                 # slow tunnel a single probe batch costs ~1s of p99
-                predicted = self._predict_device_ns_row(max(n_rows, 1))
+                predicted = self._predict_device_ns_row(max(n_rows, 1),
+                                                        batch)
                 if predicted > host_ns * self.PROBE_HEADROOM:
                     return winner
             return loser
@@ -267,33 +311,70 @@ class DeviceFusedStep(Transformer):
     def _apply_device(self, batch: ColumnBatch) -> TransformResult:
         import time as _time
 
+        from transferia_tpu.ops.dispatch import (
+            device_hmac_dict_pool,
+            encoding_enabled,
+        )
         from transferia_tpu.ops.fused import hex_to_varwidth
 
         t0 = _time.perf_counter()
-        mask_inputs = []
-        for name, _key in self.mask_entries:
-            col = batch.column(name)
-            mask_inputs.append((col.data, col.offsets))
-        pred_inputs = {}
-        for name in self.pred_cols:
-            col = batch.column(name)
-            pred_inputs[name] = (col.data, col.validity)
         program = self.program
         if (self.sharded_program is not None
                 and batch.n_rows >= self._sharded_min_rows):
             program = self.sharded_program
-        hexes, keep = program.run(
-            mask_inputs, pred_inputs, batch.n_rows
-        )
+        # device-resident dict masking: a DictEnc column's pool hashes
+        # ON DEVICE once per (pool, key) and the batch's row bytes never
+        # cross the link — the codes rebind to the hexed pool on the
+        # host.  (The mesh program shards per-row digests across chips,
+        # so the pool route only applies to the single-device program.)
+        dict_cols: dict[str, Column] = {}
+        mask_inputs = []
+        flat_entries = []
+        flat_states = []
+        use_pool_route = encoding_enabled() and program is self.program
+        for (name, key), states in zip(self.mask_entries,
+                                       self.program._states):
+            col = batch.column(name)
+            if use_pool_route and col.is_lazy_dict:
+                hexed = device_hmac_dict_pool(bytes(key),
+                                              col.dict_enc.pool,
+                                              col.n_rows)
+                if hexed is not None:
+                    from transferia_tpu.transform.plugins.mask import (
+                        dict_hex_column,
+                    )
+
+                    dict_cols[name] = dict_hex_column(col, hexed)
+                    continue
+            mask_inputs.append((col.data, col.offsets))
+            flat_entries.append(name)
+            flat_states.append(states)
+        pred_inputs = {}
+        for name in self.pred_cols:
+            col = batch.column(name)
+            pred_inputs[name] = (col.data, col.validity)
+        if mask_inputs or self.pred_node is not None:
+            if program is self.program:
+                hexes, keep = program.run(
+                    mask_inputs, pred_inputs, batch.n_rows,
+                    states=flat_states,
+                )
+            else:
+                hexes, keep = program.run(
+                    mask_inputs, pred_inputs, batch.n_rows
+                )
+        else:
+            hexes, keep = [], None  # everything rode the pool route
         from transferia_tpu.stats import stagetimer, trace
 
         with stagetimer.stage("host_post"), trace.span("host_post"):
             cols = dict(batch.columns)
-            for (name, _key), hx in zip(self.mask_entries, hexes):
+            for name, hx in zip(flat_entries, hexes):
                 validity = batch.column(name).validity
                 data, offsets = hex_to_varwidth(hx, validity)
                 cols[name] = Column(name, CanonicalType.UTF8, data,
                                     offsets, validity)
+            cols.update(dict_cols)
             out = batch.with_columns(cols,
                                      self.result_schema(batch.schema))
             if keep is not None and not keep.all():

@@ -116,6 +116,44 @@ def _native_hmac_hex(key: bytes, data: np.ndarray, offsets: np.ndarray,
     return hex_to_varwidth(out_hex, validity)
 
 
+def hexed_pool_from_flat(pool: DictPool, pool_hex: np.ndarray,
+                         pool_hex_off: np.ndarray) -> DictPool:
+    """Flat per-value hex digests -> the hexed DictPool, with the null
+    sentinel's slot emptied (null rows materialize as empty bytes, not
+    HMAC of empty).  Shared by the host hash path (mask_dict_column)
+    and the device-resident one (ops/dispatch.device_hmac_dict_pool) —
+    both must produce identical pools for the memo to be sound."""
+    if pool.null_code is not None:
+        lens = np.diff(pool_hex_off).astype(np.int64)
+        lens[pool.null_code] = 0
+        new_off = _offsets_from_lengths(lens)
+        keep_mask = np.ones(len(pool_hex), dtype=bool)
+        s, e = (int(pool_hex_off[pool.null_code]),
+                int(pool_hex_off[pool.null_code + 1]))
+        keep_mask[s:e] = False
+        pool_hex = pool_hex[keep_mask]
+        pool_hex_off = new_off
+    return DictPool(pool_hex, pool_hex_off, null_code=pool.null_code)
+
+
+def dict_hex_column(col: Column, hexed: DictPool) -> Column:
+    """Rebind a dict column's codes to its hexed pool (the masked
+    output column — still dictionary-encoded, codes untouched unless a
+    null sentinel has to be appended for a sentinel-less pool)."""
+    codes = col.dict_enc.indices
+    if (hexed.null_code is None and col.validity is not None
+            and not col.validity.all()):
+        # manually-built pool without a sentinel: append one now
+        data = hexed.values_data
+        off = np.append(hexed.values_offsets,
+                        hexed.values_offsets[-1]).astype(np.int32)
+        hexed = DictPool(data, off, null_code=hexed.n_values)
+        codes = np.where(col.validity, codes,
+                         hexed.null_code).astype(np.int32)
+    return Column(col.name, CanonicalType.UTF8, validity=col.validity,
+                  dict_enc=DictEnc(codes, pool=hexed))
+
+
 def mask_dict_column(key: bytes, col: Column) -> Optional[Column]:
     """HMAC a dictionary-encoded column by hashing its value POOL once and
     keeping the row codes — O(unique) hash instead of O(rows), and the
@@ -138,32 +176,9 @@ def mask_dict_column(key: bytes, col: Column) -> Optional[Column]:
             return None
         pool_hex, pool_hex_off = _host_hmac_hex(
             key, pool.values_data, pool.values_offsets, None)
-        if pool.null_code is not None:
-            # sentinel hexes to empty bytes, not HMAC("")
-            lens = np.diff(pool_hex_off).astype(np.int64)
-            lens[pool.null_code] = 0
-            new_off = _offsets_from_lengths(lens)
-            keep_mask = np.ones(len(pool_hex), dtype=bool)
-            s, e = (int(pool_hex_off[pool.null_code]),
-                    int(pool_hex_off[pool.null_code + 1]))
-            keep_mask[s:e] = False
-            pool_hex = pool_hex[keep_mask]
-            pool_hex_off = new_off
-        hexed = DictPool(pool_hex, pool_hex_off,
-                         null_code=pool.null_code)
+        hexed = hexed_pool_from_flat(pool, pool_hex, pool_hex_off)
         pool.memo_set(memo_key, hexed)
-    codes = enc.indices
-    if (hexed.null_code is None and col.validity is not None
-            and not col.validity.all()):
-        # manually-built pool without a sentinel: append one now
-        data = hexed.values_data
-        off = np.append(hexed.values_offsets,
-                        hexed.values_offsets[-1]).astype(np.int32)
-        hexed = DictPool(data, off, null_code=hexed.n_values)
-        codes = np.where(col.validity, codes,
-                         hexed.null_code).astype(np.int32)
-    return Column(col.name, CanonicalType.UTF8, validity=col.validity,
-                  dict_enc=DictEnc(codes, pool=hexed))
+    return dict_hex_column(col, hexed)
 
 
 @register_transformer("mask_field")
