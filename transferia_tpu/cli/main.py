@@ -165,13 +165,18 @@ def build_parser() -> argparse.ArgumentParser:
                           "schedule and PRNG draws (replayable)")
     cha.add_argument("--mode", default="both",
                      choices=["snapshot", "replication", "worker_crash",
-                              "arrow_ipc", "both", "all"],
+                              "scheduler_kill", "arrow_ipc", "both",
+                              "all"],
                      help="worker_crash kills a sharded worker mid-part "
                           "and audits lease reclamation + epoch "
-                          "fencing; arrow_ipc audits the zero-copy "
-                          "interchange wire (arrow_ipc source → "
-                          "memory); both = snapshot+replication; all "
-                          "adds worker_crash + arrow_ipc")
+                          "fencing; scheduler_kill kills a fleet "
+                          "worker slot at a dispatch decision and "
+                          "audits kill/rebalance (no transfer lost or "
+                          "double-admitted); arrow_ipc audits the "
+                          "zero-copy interchange wire (arrow_ipc "
+                          "source → memory); both = "
+                          "snapshot+replication; all adds "
+                          "worker_crash + scheduler_kill + arrow_ipc")
     cha.add_argument("--rows", type=int, default=0,
                      help="snapshot source rows (default 4096)")
     cha.add_argument("--messages", type=int, default=0,
@@ -208,6 +213,26 @@ def build_parser() -> argparse.ArgumentParser:
     fli.add_argument("--batch-rows", type=int, default=16_384)
     fli.add_argument("--json", action="store_true", dest="as_json",
                      help="bench: machine-readable report")
+    flt = sub.add_parser(
+        "fleet",
+        help="fleet control plane (fleet/): `bench` drives 100+ "
+             "concurrent sample→memory transfers through the "
+             "admission/fair-share scheduler and reports p50/p99 "
+             "dispatch latency, Jain fairness under a 10:1 tenant "
+             "skew, and the delivery audit")
+    flt.add_argument("action", choices=["bench"])
+    flt.add_argument("--transfers", type=int, default=120,
+                     help="bench: concurrent transfers to schedule")
+    flt.add_argument("--workers", type=int, default=8,
+                     help="bench: worker slots")
+    flt.add_argument("--lanes", type=int, default=2,
+                     help="bench: max in-flight transfers per worker")
+    flt.add_argument("--rows", type=int, default=256,
+                     help="bench: rows per transfer")
+    flt.add_argument("--seed", type=int, default=7,
+                     help="bench: tenant-mix shuffle seed")
+    flt.add_argument("--json", action="store_true", dest="as_json",
+                     help="machine-readable report")
     return p
 
 
@@ -274,6 +299,16 @@ def _start_health_server(port: int) -> int:
                 secs = _query_seconds(self.path)
                 body = sample_seconds(secs).format(30).encode()
                 ctype = "text/plain"
+                status = 200
+            elif self.path.startswith("/debug/fleet"):
+                # fleet control plane state: admission queues, per-
+                # tenant debt, dispatch latency percentiles, and the
+                # autoscaling hints (desired_workers) — the scrape
+                # surface an autoscaler reads (fleet/scheduler.py)
+                from transferia_tpu import fleet
+
+                body = json.dumps(fleet.debug_snapshot()).encode()
+                ctype = "application/json"
                 status = 200
             elif self.path == "/debug/threads":
                 # pprof-style stack dump (reference serves pprof on :8080)
@@ -382,6 +417,8 @@ def main(argv=None) -> int:
         return cmd_chaos(args)
     if args.command == "flight":
         return cmd_flight(args)
+    if args.command == "fleet":
+        return cmd_fleet(args)
 
     transfer = _load_transfer(args)
     cp = _coordinator(args)
@@ -744,6 +781,22 @@ def cmd_flight(args) -> int:
         return 0
     finally:
         server.close()
+
+
+def cmd_fleet(args) -> int:
+    """Fleet scheduler bench (fleet/bench.py).  Exit 0 only when every
+    transfer delivered, nothing was lost or double-admitted, and the
+    Jain fairness index held >= 0.9 under the skewed tenant mix."""
+    from transferia_tpu.fleet.bench import format_report, run_fleet_bench
+
+    report = run_fleet_bench(
+        transfers=args.transfers, workers=args.workers,
+        lanes=args.lanes, rows=args.rows, seed=args.seed)
+    if args.as_json:
+        print(json.dumps(report, indent=1))
+    else:
+        print(format_report(report))
+    return 0 if report["ok"] else 1
 
 
 def cmd_validate(args) -> int:

@@ -3,6 +3,14 @@
 Thread-safe; used for single-process runs and tests (including sharded-mode
 tests that spawn N worker threads in one process, cf.
 tests/helpers/sharded_snapshot_workers.go).
+
+Lock granularity: one lock PER OPERATION for the part queue + operation
+state (the fleet scheduler runs 100+ concurrent operations against one
+coordinator — a single global lock would serialize unrelated
+operations' claim/update traffic), one lock for the transfer-scoped
+maps (status/state/messages), and one for the health stream.  The
+per-operation lock object is created under `_ops_lock` exactly once
+and never removed, so holding it never races its own replacement.
 """
 
 from __future__ import annotations
@@ -27,20 +35,52 @@ from transferia_tpu.coordinator.interface import (
 HEALTH_HISTORY_LIMIT = 256
 
 
+class _OpState:
+    """One operation's slice of the coordinator: its own lock, part
+    queue, and state KV — claim/update traffic on operation A never
+    waits on operation B."""
+
+    __slots__ = ("lock", "parts", "state")
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.parts: list[OperationTablePart] = []
+        self.state: dict[str, Any] = {}
+
+
 class MemoryCoordinator(Coordinator):
     def __init__(self, lease_seconds: Optional[float] = None):
+        # transfer-scoped maps (status / state KV / messages)
         self._lock = threading.RLock()
         self._status: dict[str, TransferStatus] = {}
         self._state: dict[str, dict[str, Any]] = {}
-        self._parts: dict[str, list[OperationTablePart]] = {}
-        self._op_state: dict[str, dict[str, Any]] = {}
         self._messages: dict[str, list[tuple[str, str]]] = {}
+        # operation-scoped state: per-operation locks
+        self._ops_lock = threading.Lock()
+        self._ops: dict[str, _OpState] = {}
         self.lease_seconds = (default_lease_seconds()
                               if lease_seconds is None else lease_seconds)
         # rolling window of (scope, worker, payload) tuples; latest
         # report per (scope, worker) kept separately for readers
+        self._health_lock = threading.Lock()
         self.health_reports: deque = deque(maxlen=HEALTH_HISTORY_LIMIT)
         self._health_latest: dict[tuple[str, int], dict] = {}
+
+    def _op(self, operation_id: str) -> _OpState:
+        """Get-or-create the operation's state slot (the only place
+        the op map mutates; the returned slot is never replaced)."""
+        with self._ops_lock:
+            st = self._ops.get(operation_id)
+            if st is None:
+                st = self._ops[operation_id] = _OpState()
+            return st
+
+    def _op_peek(self, operation_id: str) -> Optional[_OpState]:
+        """Non-creating lookup for read paths: polling an unknown or
+        long-completed operation id must not grow the op map (the
+        fleet keeps one coordinator alive across thousands of ops)."""
+        with self._ops_lock:
+            return self._ops.get(operation_id)
 
     # -- status -------------------------------------------------------------
     def set_status(self, transfer_id: str, status: TransferStatus) -> None:
@@ -84,33 +124,42 @@ class MemoryCoordinator(Coordinator):
     def set_operation_state(self, operation_id: str,
                             state: dict[str, Any]) -> None:
         failpoint("coordinator.set_op_state")  # before the lock: may sleep
-        with self._lock:
-            self._op_state.setdefault(operation_id, {}).update(state)
+        op = self._op(operation_id)
+        with op.lock:
+            op.state.update(state)
 
     def get_operation_state(self, operation_id: str) -> dict[str, Any]:
-        with self._lock:
-            return dict(self._op_state.get(operation_id, {}))
+        op = self._op_peek(operation_id)
+        if op is None:
+            return {}
+        with op.lock:
+            return dict(op.state)
 
     # -- operation parts ----------------------------------------------------
     def create_operation_parts(self, operation_id: str,
                                parts: list[OperationTablePart]) -> None:
-        with self._lock:
-            self._parts[operation_id] = [
-                OperationTablePart.from_json(p.to_json()) for p in parts
-            ]
+        op = self._op(operation_id)
+        copies = [OperationTablePart.from_json(p.to_json())
+                  for p in parts]
+        with op.lock:
+            op.parts[:] = copies
 
     def add_operation_parts(self, operation_id: str,
                             parts: list[OperationTablePart]) -> None:
-        with self._lock:
-            self._parts.setdefault(operation_id, []).extend(
-                OperationTablePart.from_json(p.to_json()) for p in parts
-            )
+        op = self._op(operation_id)
+        copies = [OperationTablePart.from_json(p.to_json())
+                  for p in parts]
+        with op.lock:
+            op.parts.extend(copies)
 
     def assign_operation_part(self, operation_id: str, worker_index: int
                               ) -> Optional[OperationTablePart]:
         now = time.time()
-        with self._lock:
-            for p in self._parts.get(operation_id, []):
+        op = self._op_peek(operation_id)
+        if op is None:
+            return None
+        with op.lock:
+            for p in op.parts:
                 if p.completed:
                     continue
                 stolen = p.worker_index is not None \
@@ -133,8 +182,11 @@ class MemoryCoordinator(Coordinator):
             return 0
         renewed = 0
         now = time.time()
-        with self._lock:
-            for p in self._parts.get(operation_id, []):
+        op = self._op_peek(operation_id)
+        if op is None:
+            return 0
+        with op.lock:
+            for p in op.parts:
                 if p.worker_index == worker_index and not p.completed:
                     p.lease_expires_at = now + self.lease_seconds
                     renewed += 1
@@ -143,8 +195,11 @@ class MemoryCoordinator(Coordinator):
     def clear_assigned_parts(self, operation_id: str,
                              worker_index: int) -> int:
         released = 0
-        with self._lock:
-            for p in self._parts.get(operation_id, []):
+        op = self._op_peek(operation_id)
+        if op is None:
+            return 0
+        with op.lock:
+            for p in op.parts:
                 if p.worker_index == worker_index and not p.completed:
                     p.worker_index = None
                     p.lease_expires_at = 0.0
@@ -155,8 +210,11 @@ class MemoryCoordinator(Coordinator):
                                parts: list[OperationTablePart]
                                ) -> list[str]:
         rejected: list[str] = []
-        with self._lock:
-            by_key = {p.key(): p for p in self._parts.get(operation_id, [])}
+        op = self._op_peek(operation_id)
+        if op is None:
+            return rejected
+        with op.lock:
+            by_key = {p.key(): p for p in op.parts}
             for upd in parts:
                 cur = by_key.get(upd.key())
                 if cur is None:
@@ -174,15 +232,18 @@ class MemoryCoordinator(Coordinator):
         return rejected
 
     def operation_parts(self, operation_id: str) -> list[OperationTablePart]:
-        with self._lock:
+        op = self._op_peek(operation_id)
+        if op is None:
+            return []
+        with op.lock:
             return [
                 OperationTablePart.from_json(p.to_json())
-                for p in self._parts.get(operation_id, [])
+                for p in op.parts
             ]
 
     def operation_health(self, operation_id: str, worker_index: int,
                          payload: Optional[dict] = None) -> None:
-        with self._lock:
+        with self._health_lock:
             self.health_reports.append((operation_id, worker_index,
                                         payload))
             self._health_latest[(operation_id, worker_index)] = {
@@ -190,7 +251,7 @@ class MemoryCoordinator(Coordinator):
             }
 
     def get_operation_health(self, operation_id: str) -> dict[int, dict]:
-        with self._lock:
+        with self._health_lock:
             return {
                 widx: dict(rep)
                 for (scope, widx), rep in self._health_latest.items()
@@ -199,7 +260,7 @@ class MemoryCoordinator(Coordinator):
 
     def transfer_health(self, transfer_id: str, worker_index: int = 0,
                         healthy: bool = True) -> None:
-        with self._lock:
+        with self._health_lock:
             self.health_reports.append((transfer_id, worker_index,
                                         healthy))
             self._health_latest[(transfer_id, worker_index)] = {

@@ -1,0 +1,55 @@
+"""Fleet control plane: schedule N tenants x M transfers over a
+bounded worker pool (ROADMAP item 3).
+
+- `scheduler.py` — admission control (tenant queue quotas +
+  backpressure shed), weighted deficit-round-robin fair share with
+  per-transfer QoS classes, bounded in-flight dispatch onto worker
+  slots, kill/rebalance recovery, autoscaling hints.
+- `backpressure.py` — hysteresis gate over the data-plane load gauges
+  (readahead bytes/depth, sink in-flight rows, dispatch compression
+  ratio, fleet queue depth).
+- `bench.py` — `trtpu fleet bench` / `bench.py --fleet`: 100+
+  concurrent sample->memory transfers; p50/p99 dispatch latency and
+  the Jain fairness index are tracked bench metrics.
+
+Live schedulers register here so the health port can serve
+`/debug/fleet` without the CLI holding a reference.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from transferia_tpu.fleet.backpressure import (  # noqa: F401
+    BackpressureController,
+    SignalSpec,
+)
+from transferia_tpu.fleet.scheduler import (  # noqa: F401
+    FleetScheduler,
+    FleetTransfer,
+    QosClass,
+)
+
+_registry_lock = threading.Lock()
+_SCHEDULERS: list = []
+
+
+def register_scheduler(sched) -> None:
+    with _registry_lock:
+        if sched not in _SCHEDULERS:
+            _SCHEDULERS.append(sched)
+
+
+def unregister_scheduler(sched) -> None:
+    with _registry_lock:
+        if sched in _SCHEDULERS:
+            _SCHEDULERS.remove(sched)
+
+
+def debug_snapshot() -> dict:
+    """The `/debug/fleet` payload: every live scheduler's snapshot."""
+    with _registry_lock:
+        scheds = list(_SCHEDULERS)
+    return {
+        "schedulers": [s.snapshot() for s in scheds],
+    }

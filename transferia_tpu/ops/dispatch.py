@@ -120,28 +120,48 @@ def unpack_mask_host(words: np.ndarray, n: int) -> np.ndarray:
     return bits[:n].astype(np.bool_)
 
 
-def encode_delta(data: np.ndarray
-                 ) -> Optional[tuple[int, np.ndarray, int]]:
-    """Delta+bit-pack an integer array: (base, packed words, bit_width),
-    or None when the encoding would not shrink the transfer."""
-    n = len(data)
-    if data.dtype.kind not in "iu" or n < _DELTA_MIN_ROWS:
+def _delta_plan(values: np.ndarray
+                ) -> Optional[tuple[np.ndarray, np.ndarray, int]]:
+    """THE delta-encoding guard chain, shared by the single-device and
+    mesh wires (values: (n_shards, per) — one row per independently
+    decoded chunk).  Returns (bases int32 (n_shards,), zigzag'd deltas
+    uint64 (n_shards, per), bit_width) or None when any guard rejects.
+
+    Guards: the device prefix sum reconstructs VALUES in int32, not
+    just deltas — every value (and the base) must fit int32 exactly,
+    or a 64-bit column would decode wrapped; zigzag widths past
+    _DELTA_MAX_BITS could wrap a partial sum; and the packed form must
+    actually shrink the raw dtype.  Keep these HERE only — a guard
+    tweaked in one wire but not the other would silently diverge the
+    single-device and mesh decodes."""
+    n_shards, per = values.shape
+    if values.dtype.kind not in "iu" or per < _DELTA_MIN_ROWS:
         return None
-    v = data.astype(np.int64)
-    # the device prefix sum reconstructs VALUES in int32, not just
-    # deltas — every value (and the base) must fit int32 exactly, or a
-    # 64-bit column would decode wrapped (and np.int32(base) overflow)
+    v = values.astype(np.int64)
     if int(v.min()) < -2**31 or int(v.max()) > 2**31 - 1:
         return None
-    base = int(v[0])
-    deltas = np.diff(v, prepend=base)
+    bases = v[:, :1]
+    deltas = np.diff(v, axis=1, prepend=bases)
     zz = ((deltas << 1) ^ (deltas >> 63)).astype(np.uint64)
     bw = max(1, int(zz.max()).bit_length())
     if bw > _DELTA_MAX_BITS:
         return None
-    if bw * n >= data.nbytes * 8:  # no shrink over the raw dtype
+    if bw * per >= values.dtype.itemsize * 8 * per:
+        return None  # no shrink over the raw dtype
+    return bases[:, 0].astype(np.int32), zz, bw
+
+
+def encode_delta(data: np.ndarray
+                 ) -> Optional[tuple[int, np.ndarray, int]]:
+    """Delta+bit-pack an integer array: (base, packed words, bit_width),
+    or None when the encoding would not shrink the transfer."""
+    if data.ndim != 1:
         return None
-    return base, pack_bits_host(zz, bw), bw
+    plan = _delta_plan(data.reshape(1, -1))
+    if plan is None:
+        return None
+    bases, zz, bw = plan
+    return int(bases[0]), pack_bits_host(zz[0], bw), bw
 
 
 # -- per-column dispatch encodings ------------------------------------------
@@ -227,6 +247,93 @@ def decode_pred_device(spec: PredEnc, arrays, bucket: int):
     else:
         valid = arrays[-1]
     return data, valid
+
+
+# -- per-shard (mesh) dispatch encodings -------------------------------------
+#
+# The mesh wire ships every array with a leading device axis: each
+# device's contiguous row chunk encodes INDEPENDENTLY (a delta prefix
+# sum or a packed bitmap cannot span a shard boundary — each shard
+# decodes alone inside shard_map), with one uniform bit width across
+# shards so the decode program stays static.  parallel/fusedmesh.py is
+# the only consumer.
+
+def encode_validity_sharded(valid2d: np.ndarray) -> np.ndarray:
+    """(n_dev, per_dev) bool -> (n_dev, W) packed little-endian uint32
+    bitmap words, each shard packed independently."""
+    packed = np.packbits(np.ascontiguousarray(valid2d, dtype=np.uint8),
+                         axis=1, bitorder="little")
+    pad = (-packed.shape[1]) % 4
+    if pad:
+        packed = np.pad(packed, ((0, 0), (0, pad)))
+    return np.ascontiguousarray(packed).view(np.uint32)
+
+
+def _encode_delta_sharded(d2: np.ndarray
+                          ) -> Optional[tuple[np.ndarray, np.ndarray,
+                                              int]]:
+    """Per-shard delta+bit-pack: (bases (n_dev,) int32, words (n_dev,
+    W), bit_width) or None when the shared `_delta_plan` guards reject
+    (int32-exact values, <= 30-bit zigzag deltas, must shrink) — one
+    uniform bit width across shards keeps the decode program static."""
+    plan = _delta_plan(d2)
+    if plan is None:
+        return None
+    bases, zz, bw = plan
+    words = np.stack([pack_bits_host(row, bw) for row in zz])
+    return bases, words, bw
+
+
+def encode_pred_column_sharded(name: str, data: np.ndarray,
+                               validity: Optional[np.ndarray],
+                               n_rows: int, n_dev: int, per_dev: int,
+                               encoded: bool
+                               ) -> tuple[PredEnc, tuple, int]:
+    """Encode one predicate column for the mesh wire.
+
+    Returns (spec, arrays each with a leading (n_dev, ...) device
+    axis, raw_equiv_bytes).  Padding matches encode_pred_column: data
+    pads with its edge value (keeps delta widths narrow), validity
+    pads False so padded rows never pass the predicate."""
+    total = n_dev * per_dev
+    raw_equiv = total * data.dtype.itemsize + total  # data + bool map
+    if total != n_rows:
+        data = np.pad(data, (0, total - n_rows),
+                      mode="edge" if n_rows else "constant")
+        if validity is not None:
+            validity = np.pad(validity, (0, total - n_rows))
+    d2 = data.reshape(n_dev, per_dev)
+    v2 = (validity.reshape(n_dev, per_dev)
+          if validity is not None else None)
+    if not encoded:
+        if v2 is None:
+            v2 = np.ones((n_dev, per_dev), dtype=np.bool_)
+        return (PredEnc(name, str(data.dtype), "raw", 0, "raw"),
+                (d2, v2), raw_equiv)
+    if v2 is None:
+        valid_mode: str = "none"
+        val_arrays: tuple = ()
+    else:
+        valid_mode = "bits"
+        val_arrays = (encode_validity_sharded(v2),)
+    if data.dtype == np.bool_:
+        spec = PredEnc(name, str(data.dtype), "bits", 1, valid_mode)
+        return spec, (encode_validity_sharded(d2),) + val_arrays, \
+            raw_equiv
+    delta = _encode_delta_sharded(d2)
+    if delta is not None:
+        bases, words, bw = delta
+        spec = PredEnc(name, str(data.dtype), "delta", bw, valid_mode)
+        return spec, (words, bases) + val_arrays, raw_equiv
+    spec = PredEnc(name, str(data.dtype), "raw", 0, valid_mode)
+    return spec, (d2,) + val_arrays, raw_equiv
+
+
+def decode_pred_device_sharded(spec: PredEnc, arrays, bucket: int):
+    """Per-device decode of one mesh-encoded predicate column — runs
+    inside shard_map, where every array arrives as the local (1, ...)
+    shard; strip the shard axis and reuse the single-device decode."""
+    return decode_pred_device(spec, tuple(a[0] for a in arrays), bucket)
 
 
 # -- H2D staging -------------------------------------------------------------

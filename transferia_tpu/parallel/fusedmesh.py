@@ -95,9 +95,27 @@ class ShardedFusedProgram:
 
         row_axes = tuple(self.mesh.axis_names)  # rows over the full mesh
 
-        def per_device(blocks_t, nblocks_t, states_t, pred_cols,
-                       valid, max_blocks_t):
-            rows_local = valid.shape[0]
+        def per_device(blocks_t, nblocks_t, states_t, pred_arrays,
+                       valid_in, max_blocks_t, pred_specs, valid_mode,
+                       bucket):
+            from transferia_tpu.ops.decode import unpack_validity
+            from transferia_tpu.ops.dispatch import (
+                decode_pred_device_sharded,
+            )
+
+            # encoded wire: predicate columns and the run-validity mask
+            # arrive per-shard encoded (leading device axis of 1 locally)
+            # and reconstruct HERE, on device, before the predicate runs
+            if valid_mode == "raw":
+                valid = valid_in[0]
+            else:
+                valid = unpack_validity(valid_in[0], bucket)
+            pred_cols = {
+                name: decode_pred_device_sharded(
+                    spec, pred_arrays[name], bucket)
+                for name, spec in pred_specs
+            }
+            rows_local = bucket
             # raw digest words leave the device (32 B/row, host LUT hex
             # expansion — same contract as FusedMaskFilterProgram)
             digests = tuple(
@@ -125,8 +143,11 @@ class ShardedFusedProgram:
 
         self._per_device = per_device
 
-    def _get_compiled(self, n_mask: int, pred_names: tuple):
-        key = (n_mask, pred_names)
+    def _get_compiled(self, n_mask: int, pred_key: tuple,
+                      valid_mode: str):
+        """pred_key: ((name, PredEnc, n_arrays), ...) sorted by name —
+        the encoding shapes the traced program, so it keys the cache."""
+        key = (n_mask, pred_key, valid_mode)
         fn = self._compiled.get(key)
         if fn is not None:
             return fn
@@ -135,13 +156,17 @@ class ShardedFusedProgram:
             if fn is None:
                 row_axes = tuple(self.mesh.axis_names)
                 rows = P(row_axes)
+                pred_specs = tuple((name, spec)
+                                   for name, spec, _n in pred_key)
                 in_specs = (
                     (P(row_axes, None),) * n_mask,   # blocks per column
                     (rows,) * n_mask,                # n_blocks per column
                     tuple((P(), P()) for _ in range(n_mask)),  # key states
-                    {n: (rows, rows) for n in pred_names},
-                    rows,                            # valid mask
-                    tuple(P() for _ in range(n_mask)),  # static-ish mb
+                    # encoded pred arrays carry a leading device axis;
+                    # sharding it hands each device its own shard's words
+                    {name: tuple(rows for _ in range(n_arr))
+                     for name, _spec, n_arr in pred_key},
+                    rows,                            # valid (2-D / words)
                 )
                 out_specs = (
                     (P(row_axes, None),) * n_mask,
@@ -149,21 +174,22 @@ class ShardedFusedProgram:
                     P(),                             # histogram
                     P(),                             # kept count
                 )
-                # max_blocks must stay static: strip it from specs and
-                # close over it per call instead
-                def wrapper(blocks_t, nblocks_t, states_t, pred_cols,
-                            valid, max_blocks_t):
+                # max_blocks + bucket must stay static: strip them from
+                # specs and close over them per call instead
+                def wrapper(blocks_t, nblocks_t, states_t, pred_arrays,
+                            valid_arr, max_blocks_t, bucket):
                     body = _shard_map(
-                        lambda b, nb, st, pc, v: self._per_device(
-                            b, nb, st, pc, v, max_blocks_t),
+                        lambda b, nb, st, pa, v: self._per_device(
+                            b, nb, st, pa, v, max_blocks_t,
+                            pred_specs, valid_mode, bucket),
                         self.mesh,
-                        in_specs[:5],
+                        in_specs,
                         out_specs,
                     )
                     return body(blocks_t, nblocks_t, states_t,
-                                pred_cols, valid)
+                                pred_arrays, valid_arr)
 
-                fn = jax.jit(wrapper, static_argnums=(5,))
+                fn = jax.jit(wrapper, static_argnums=(5, 6))
                 self._compiled[key] = fn
         return fn
 
@@ -172,12 +198,19 @@ class ShardedFusedProgram:
             n_rows: int) -> tuple[list[np.ndarray], Optional[np.ndarray]]:
         """Same contract as FusedMaskFilterProgram.run()."""
         from transferia_tpu.chaos.failpoints import failpoint
+        from transferia_tpu.ops.dispatch import (
+            encode_pred_column_sharded,
+            encode_validity_sharded,
+            encoding_enabled,
+            stage_h2d,
+        )
 
         failpoint("device.mesh_dispatch")
         # pad the global row count to n_dev * per-device bucket so every
         # shard is equal-sized and the per-device program is shape-stable
         per_dev = bucket_rows(max(1, -(-n_rows // self.n_dev)))
         total = per_dev * self.n_dev
+        encoded = encoding_enabled()
         blocks_t, nblocks_t, mb_t = [], [], []
         pack_t0 = None
         import time as _time
@@ -194,43 +227,51 @@ class ShardedFusedProgram:
             blocks_t.append(blocks)
             nblocks_t.append(n_blocks)
             mb_t.append(mb)
-        dev_pred = {}
-        for name, (data, validity) in pred_cols.items():
-            if validity is None:
-                validity = np.ones(n_rows, dtype=np.bool_)
-            if total != n_rows:
-                data = np.pad(data, (0, total - n_rows))
-                validity = np.pad(validity, (0, total - n_rows))
-            dev_pred[name] = (data, validity)
-        valid = np.zeros(total, dtype=np.bool_)
-        valid[:n_rows] = True
+        # the SHA block matrices ship as-is (they are the payload being
+        # hashed); the predicate columns and both validity planes cross
+        # the mesh wire per-shard ENCODED — bit-packed bitmaps/bools,
+        # delta+bit-packed ints — and reconstruct inside the sharded
+        # program (ops/dispatch.py sharded encoders, decode on device)
+        raw_equiv = sum(int(b.nbytes) + int(nb.nbytes)
+                        for b, nb in zip(blocks_t, nblocks_t))
+        pred_key = []
+        pred_arrays: dict = {}
+        for name in sorted(pred_cols):
+            data, validity = pred_cols[name]
+            spec, arrays, req = encode_pred_column_sharded(
+                name, data, validity, n_rows, self.n_dev, per_dev,
+                encoded)
+            pred_key.append((name, spec, len(arrays)))
+            pred_arrays[name] = arrays
+            raw_equiv += req
+        valid_bool = np.zeros(total, dtype=np.bool_)
+        valid_bool[:n_rows] = True
+        v2 = valid_bool.reshape(self.n_dev, per_dev)
+        valid_arr = encode_validity_sharded(v2) if encoded else v2
+        valid_mode = "bits" if encoded else "raw"
+        raw_equiv += total  # the flat bool run-validity mask
         stagetimer.add("pack", _time.perf_counter() - pack_t0)
-        fn = self._get_compiled(len(mask_cols), tuple(sorted(dev_pred)))
-        h2d = (sum(int(b.nbytes) + int(nb.nbytes)
-                   for b, nb in zip(blocks_t, nblocks_t))
-               + sum(int(d.nbytes) + int(v.nbytes)
-                     for d, v in dev_pred.values())
-               + int(valid.nbytes))
+        fn = self._get_compiled(len(mask_cols), tuple(pred_key),
+                                valid_mode)
+        stage_tree = (tuple(blocks_t), tuple(nblocks_t), pred_arrays,
+                      valid_arr)
+        h2d = sum(int(leaf.nbytes)
+                  for leaf in jax.tree_util.tree_leaves(stage_tree))
         TELEMETRY.record_h2d(h2d)
-        # the mesh wire is (for now) uncompressed — stage through the
-        # shared dispatch site anyway so its transfers carry the same
-        # chaos failpoint and honest 1.0x byte accounting as the
-        # single-device plane (a mesh path claiming compression it
-        # doesn't do would poison the ratio gauge).  put=False: the
-        # sharded jit places each shard itself; an eager device_put
-        # would land everything on one device and pay a reshard hop
-        from transferia_tpu.ops.dispatch import stage_h2d
-
+        # put=False: the sharded jit places each shard itself; an eager
+        # device_put would land everything on one device and pay a
+        # reshard hop.  The shared staging site keeps the chaos
+        # failpoint and the encoded-vs-raw byte accounting honest.
         blocks_s, nblocks_s, pred_s, valid_s = stage_h2d(
-            (tuple(blocks_t), tuple(nblocks_t), dev_pred, valid),
-            raw_equiv_bytes=h2d, what="mesh", put=False)
+            stage_tree, raw_equiv_bytes=raw_equiv, what="mesh",
+            put=False)
         TELEMETRY.record_launch()
         with stagetimer.stage("device_dispatch"), \
                 trace.span("device_dispatch", bytes=h2d, rows=n_rows,
                            mesh=self.n_dev):
             digests_dev, keep_dev, hist, kept = fn(
                 blocks_s, nblocks_s, tuple(self._states),
-                pred_s, valid_s, tuple(mb_t),
+                pred_s, valid_s, tuple(mb_t), per_dev,
             )
         t_wait0 = _time.perf_counter()
         with stagetimer.stage("device_wait"), \

@@ -262,6 +262,28 @@ class LeaseStats(_Bundle):
         self.fence_rejected = self.m.counter("fence_rejected")
 
 
+class FleetStats(_Bundle):
+    """Fleet control plane counters (fleet/scheduler.py).  The pair to
+    watch is `shed` vs `admitted`: a fleet that sheds while
+    `desired_workers` exceeds the live worker count is asking the
+    autoscaler for capacity; one that sheds with idle workers is
+    backpressured by the data plane (see fleet/backpressure.py)."""
+
+    def __init__(self, metrics: Optional[Metrics] = None):
+        super().__init__(metrics)
+        self.admitted = self.m.counter("fleet_admitted")
+        self.shed = self.m.counter("fleet_shed")
+        self.completed = self.m.counter("fleet_completed")
+        self.failed = self.m.counter("fleet_failed")
+        self.rebalanced = self.m.counter("fleet_rebalanced")
+        self.worker_deaths = self.m.counter("fleet_worker_deaths")
+        self.queue_depth = self.m.gauge("fleet_queue_depth")
+        self.inflight = self.m.gauge("fleet_inflight")
+        self.desired_workers = self.m.gauge("fleet_desired_workers")
+        self.tenant_debt_max = self.m.gauge("fleet_tenant_debt_max")
+        self.dispatch_time = self.m.histogram("fleet_time_dispatch")
+
+
 class TableStats(_Bundle):
     """Per-table progress gauges (pkg/stats/table.go)."""
 
