@@ -661,7 +661,7 @@ def measure_device_fingerprint(rows: int = 1 << 20) -> Optional[dict]:
     @functools.partial(jax.jit, static_argnums=(0,))
     def loop(iters, flo, fhi, vb, rm, s1, s2, p1, p2):
         def body(i, acc):
-            out = fn(flo, fhi, vb, validities, rm,
+            out = fn(flo, fhi, vb, (), (), (), validities, rm,
                      s1 ^ (acc & jnp.uint32(1)), s2,
                      nulls1, nulls2, p1, p2)
             return acc + out[0]
@@ -1349,6 +1349,104 @@ def measure_dispatch() -> dict:
     }
 
 
+def measure_checksum_dict() -> dict:
+    """`--checksum-dict`: the dict-native reduction plane's A/B — the
+    SAME dict-heavy batches (clickbench URL shape: one low-cardinality
+    string column + one int64 id) fingerprinted flat (pre-materialized
+    buffers, the pre-PR wire) vs code-native (DictEnc columns, pool
+    accumulators + code gather).  Digest equality is asserted; the
+    acceptance bar is >=3x rows/s on this shape with ZERO flat
+    materializations on the dict run."""
+    from transferia_tpu.abstract import TableID
+    from transferia_tpu.abstract.schema import new_table_schema
+    from transferia_tpu.columnar.batch import (
+        Column,
+        ColumnBatch,
+        DictEnc,
+        DictPool,
+        _offsets_from_lengths,
+    )
+    from transferia_tpu.ops.rowhash import TableFingerprinter
+    from transferia_tpu.stats.trace import TELEMETRY
+
+    rows = int(os.environ.get("BENCH_CHECKSUM_DICT_ROWS", 262_144))
+    n_batches = max(1, int(os.environ.get("BENCH_CHECKSUM_DICT_BATCHES",
+                                          8)))
+    uniques = 4096
+    tid = TableID("bench", "checksum_dict")
+    # the ClickBench `hits` character: one wide id plus several
+    # low-cardinality string columns riding parquet dictionaries
+    dict_cols = ("URL", "Referer", "SearchPhrase")
+    schema = new_table_schema(
+        [("id", "int64", True)] + [(c, "utf8") for c in dict_cols])
+    rng = np.random.default_rng(13)
+    pools = {}
+    for ci, cname in enumerate(dict_cols):
+        vals = [f"https://bench{ci}-{i}.example/path/{i % 97}/{i}"
+                for i in range(uniques)]
+        bufs = [v.encode() for v in vals]
+        pool_data = np.frombuffer(b"".join(bufs), dtype=np.uint8).copy()
+        pool_off = _offsets_from_lengths([len(b) for b in bufs] + [0])
+        pools[cname] = DictPool(pool_data, pool_off, null_code=uniques)
+
+    batch_data = [
+        (np.arange(i * rows, (i + 1) * rows, dtype=np.int64),
+         {c: rng.integers(0, uniques, rows).astype(np.int32)
+          for c in dict_cols})
+        for i in range(n_batches)
+    ]
+    id_t = schema.find("id").data_type
+
+    def mk_batches(flat: bool):
+        out = []
+        for ids, codes in batch_data:
+            cols = {"id": Column("id", id_t, ids)}
+            for c in dict_cols:
+                enc = DictEnc(codes[c], pool=pools[c])
+                ct = schema.find(c).data_type
+                cols[c] = (Column(c, ct, *enc.materialize()) if flat
+                           else Column(c, ct, dict_enc=enc))
+            out.append(ColumnBatch(tid, schema, cols))
+        return out
+
+    dict_batches = mk_batches(flat=False)
+    flat_batches = mk_batches(flat=True)
+
+    def run(batches) -> tuple[float, str]:
+        fp = TableFingerprinter(backend="host")
+        fp.push(batches[0])  # warm: native lib load, acc memo
+        fp = TableFingerprinter(backend="host")
+        t0 = time.perf_counter()
+        for b in batches:
+            fp.push(b)
+        agg = fp.result()
+        dt = time.perf_counter() - t0
+        return (n_batches * rows) / max(dt, 1e-9), agg.digest()
+
+    flat_rps, flat_digest = run(flat_batches)
+    TELEMETRY.reset()
+    dict_rps, dict_digest = run(dict_batches)
+    snap = TELEMETRY.snapshot()
+    if dict_digest != flat_digest:
+        raise AssertionError(
+            f"dict-native digest {dict_digest} != flat {flat_digest}")
+    return {
+        "metric": "checksum_dict_fingerprint_rows_per_sec",
+        "unit": "rows/sec",
+        "value": round(dict_rps),
+        "flat_rows_per_sec": round(flat_rps),
+        "speedup_vs_flat": round(dict_rps / max(flat_rps, 1e-9), 2),
+        "digest": dict_digest,
+        "digest_match": True,
+        "dict_flat_materializations":
+            snap["dict_flat_materializations"],
+        "lazy_dict_preserved": snap["lazy_dict_preserved"],
+        "rows_per_batch": rows,
+        "batches": n_batches,
+        "pool_values": uniques,
+    }
+
+
 def measure_interchange() -> dict:
     """`--interchange`: the Arrow interchange plane's shard-handoff
     stage — identical sample batches moved via the row-pivot baseline
@@ -1399,6 +1497,19 @@ def main() -> None:
         report = measure_interchange()
         for line in format_report(report).splitlines():
             print(f"# {line}", file=sys.stderr)
+        print(json.dumps(report))
+        return
+
+    if "--checksum-dict" in sys.argv[1:]:
+        # standalone stage: flat vs code-native fingerprint (one JSON
+        # line, printed next to checksum_fingerprint_rows_per_sec's
+        # shape so the two headline checksum rates read together)
+        report = measure_checksum_dict()
+        print(f"# checksum-dict: code-native {report['value']} rows/s "
+              f"vs flat {report['flat_rows_per_sec']} rows/s "
+              f"({report['speedup_vs_flat']}x), "
+              f"flat_materializations="
+              f"{report['dict_flat_materializations']}", file=sys.stderr)
         print(json.dumps(report))
         return
 
@@ -1598,6 +1709,15 @@ def main() -> None:
     except Exception as e:
         print(f"# fingerprint bench failed: {type(e).__name__}: {e}",
               file=sys.stderr)
+    if os.environ.get("BENCH_SKIP_CHECKSUM_DICT") != "1":
+        try:
+            cdict = measure_checksum_dict()
+            if fallback:
+                cdict["fallback"] = fallback
+            print(f"# {json.dumps(cdict)}", file=sys.stderr)
+        except Exception as e:
+            print(f"# checksum-dict bench failed: {type(e).__name__}: "
+                  f"{e}", file=sys.stderr)
     if os.environ.get("BENCH_SKIP_INTERCHANGE") != "1":
         try:
             ichg = measure_interchange()

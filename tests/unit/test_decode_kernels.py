@@ -383,8 +383,13 @@ def test_placement_flips_to_device_on_slow_link_with_encoding(
         monkeypatch):
     """On the measured slow link (~70ms rtt, 21MB/s) a dict-heavy batch
     is affordable ENCODED (pool upload + codes-free masking + bitmap
-    pred) but hopeless RAW — auto placement must flip accordingly."""
+    pred) but hopeless RAW — auto placement must flip accordingly.
+
+    Pinned to the SINGLE-device program: the pool route (and so the
+    encoded-wire estimate) does not apply on the mesh route, and the
+    virtual 8-device test env would otherwise take it at this size."""
     step = _planned_step(monkeypatch)
+    step.sharded_program = None
     step._ns_row = {"host": 600.0, "device": -1.0}
     batch = _dict_batch(_fresh_pool(k=4096, null_sentinel=True),
                         n=131072, nulls=False)
@@ -401,8 +406,11 @@ def test_placement_flips_to_device_on_slow_link_with_encoding(
 
 def test_placement_memoized_pool_is_free(monkeypatch):
     """Once the hexed pool is device-resident the link model charges
-    ZERO mask bytes — an even smaller batch stays device-eligible."""
+    ZERO mask bytes — an even smaller batch stays device-eligible.
+    (Single-device program: the pool route does not exist on the mesh
+    route, so the virtual 8-device env must not shadow it.)"""
     step = _planned_step(monkeypatch)
+    step.sharded_program = None
     step._ns_row = {"host": 600.0, "device": -1.0}
     pool = _fresh_pool(k=4096)
     batch = _dict_batch(pool, n=131072, nulls=False)
@@ -412,6 +420,22 @@ def test_placement_memoized_pool_is_free(monkeypatch):
     h2d_warm, _ = step._estimate_link_bytes(batch.n_rows, batch)
     assert h2d_warm < h2d_cold
     assert step._pick_strategy(batch.n_rows, batch) == "device"
+
+
+def test_placement_mesh_route_charges_raw_wire(monkeypatch):
+    """A batch big enough for the MESH program flattens dict columns
+    onto the raw block wire (the pool route is single-device only) —
+    the link estimate must charge that, memoized pool or not."""
+    step = _planned_step(monkeypatch)
+    if step.sharded_program is None:
+        pytest.skip("needs the virtual multi-device mesh")
+    pool = _fresh_pool(k=4096)
+    pool.memo_set(("hmac_hex", b"s"), _fresh_pool(k=4096))
+    n = max(step._sharded_min_rows, 131072)
+    batch = _dict_batch(pool, n=n, nulls=False)
+    dsp.set_dispatch_encoding("auto")
+    h2d, _ = step._estimate_link_bytes(batch.n_rows, batch)
+    assert h2d >= 128.0 * n  # full block matrix, not the free memo
 
 
 # -- double-buffered pipelined dispatch -------------------------------------

@@ -383,3 +383,39 @@ def test_slice_columns_zero_base_is_view(tmp_path):
     assert not np.shares_memory(later["s"].offsets, cols["s"].offsets)
     assert int(later["s"].offsets[0]) == 0
     assert later["s"].to_pylist() == [f"row-{i}" for i in range(128, 256)]
+
+
+def test_footer_and_memmap_memoization(tmp_path):
+    """Multi-part loads parse the thrift footer and map the file ONCE
+    per (path, mtime, size); a rewritten file invalidates the entry."""
+    import os
+
+    from transferia_tpu.providers.parquet_native import (
+        _FOOTER_CACHE,
+        _MMAP_CACHE,
+        parquet_file_cached,
+        parquet_metadata,
+        reset_file_caches,
+        shared_memmap,
+    )
+
+    path = str(tmp_path / "memo.parquet")
+    t = pa.table({"i": pa.array(list(range(1000)), type=pa.int64())})
+    pq.write_table(t, path, row_group_size=250)
+    reset_file_caches()
+    try:
+        assert parquet_metadata(path).num_row_groups == 4
+        pf1 = parquet_file_cached(path)
+        pf2 = parquet_file_cached(path)
+        assert pf1 is not pf2  # distinct readers per part thread...
+        assert len(_FOOTER_CACHE) == 1  # ...one footer parse
+        assert pf2.read_row_group(1).num_rows == 250
+        assert shared_memmap(path) is shared_memmap(path)
+        assert len(_MMAP_CACHE) == 1
+        # rewrite -> new (mtime, size) key, fresh metadata
+        pq.write_table(t.slice(0, 100), path)
+        os.utime(path, ns=(12345, 12345))
+        assert parquet_metadata(path).num_rows == 100
+        assert len(_FOOTER_CACHE) == 2
+    finally:
+        reset_file_caches()

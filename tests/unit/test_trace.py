@@ -252,6 +252,45 @@ def test_telemetry_folds_into_metrics_facade():
     assert m.value("device_h2d_bytes") == 1024
 
 
+def test_concurrent_folds_one_metrics_never_duplicate_timeseries():
+    """One Metrics is shared by a loader's parallel part-upload threads;
+    each fold constructs a DeviceStats bundle, so the facade's
+    get-or-create must be atomic — a lost race re-registers a collector
+    and prometheus raises "Duplicated timeseries", failing the part."""
+    import sys
+
+    from transferia_tpu.stats.registry import Metrics
+
+    trace.TELEMETRY.reset()
+    trace.TELEMETRY.record_h2d(64)
+    prev_switch = sys.getswitchinterval()
+    # the unlocked facade loses this race ~96% of runs at this switch
+    # interval (vs ~never at the default 5ms — creation is microseconds)
+    sys.setswitchinterval(1e-6)
+    try:
+        for _ in range(20):
+            m = Metrics()
+            barrier = threading.Barrier(4)
+            errors = []
+
+            def fold():
+                try:
+                    barrier.wait(timeout=5)
+                    trace.TELEMETRY.fold_into(m)
+                except Exception as exc:  # noqa: BLE001 - collected
+                    errors.append(exc)
+
+            threads = [threading.Thread(target=fold) for _ in range(4)]
+            for th in threads:
+                th.start()
+            for th in threads:
+                th.join(timeout=10)
+            assert not errors, errors
+            assert m.value("device_h2d_bytes") == 64
+    finally:
+        sys.setswitchinterval(prev_switch)
+
+
 # -- endpoint ----------------------------------------------------------------
 
 def test_debug_trace_endpoint_round_trip():

@@ -28,7 +28,11 @@ Row identity reuses the fingerprint canonicalization itself
 (`ops/rowhash.row_lanes`): a row's key is its two finalized 32-bit
 lanes — so "same row" here means exactly what the table digest means by
 it, and the dedup-then-reduce check is internally consistent with the
-per-part digests the snapshot engine already publishes.
+per-part digests the snapshot engine already publishes.  Dictionary-
+encoded batches key DICT-NATIVELY (pool accumulators gathered by code,
+no flat materialization — ARCHITECTURE.md "Dict-native reductions");
+the keys are byte-identical either route, pinned by
+tests/unit/test_dict_reduction.py.
 """
 
 from __future__ import annotations

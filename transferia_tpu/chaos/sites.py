@@ -44,6 +44,12 @@ SITES: dict[str, tuple[str, str]] = {
         "ops/fused.py",
         "fused mask/filter device launch failing (XLA error, device "
         "OOM, link reset)"),
+    "rowhash.pool_accs": (
+        "ops/rowhash.py",
+        "dict-pool accumulator pass failing (corrupt pool offsets, "
+        "native lib fault) before the memo lands — the fingerprint "
+        "consumer must surface the error instead of publishing a "
+        "partial digest, and a retry must recompute cleanly"),
     "dispatch.h2d": (
         "ops/dispatch.py",
         "encoded-dispatch H2D staging failing (device_put OOM, link "

@@ -60,22 +60,47 @@ def _gather_varwidth(data: np.ndarray, offsets: np.ndarray,
     """Gather var-width rows by index: C++ fast path, numpy fallback.
 
     Shared by Column.take and DictEnc.materialize (a dict materialization
-    IS a gather of the pool by the code array)."""
+    IS a gather of the pool by the code array).  The native form is two
+    passes — lengths fold into offsets (gather_var_offsets), then one
+    memcpy loop (gather_var_bytes) — replacing the numpy lens gather +
+    int64 cumsum that profiled as ~5.5% of the snapshot wall."""
     from transferia_tpu.native import lib as _native_lib
 
     n = len(indices)
+    cdll = _native_lib()
+    if cdll is not None and n:
+        idx = np.ascontiguousarray(indices, dtype=np.int64)
+        # the C loops are unchecked: out-of-range / negative indices
+        # must keep numpy's semantics (raise / wrap) instead of
+        # reading stray memory — same guard as gather_fixed
+        if int(idx.min()) < 0 or int(idx.max()) >= len(offsets) - 1:
+            cdll = None
+    if cdll is not None and hasattr(cdll, "gather_var_offsets") and n:
+        src_off = np.ascontiguousarray(offsets, dtype=np.int32)
+        out_offsets = np.empty(n + 1, dtype=np.int32)
+        total = cdll.gather_var_offsets(src_off, idx, n, out_offsets)
+        if total > _INT32_MAX:
+            raise ValueError(
+                f"variable-width column exceeds 2GiB in one batch "
+                f"({int(total)} bytes); split the batch"
+            )
+        out = np.empty(int(total), dtype=np.uint8)
+        if total:
+            cdll.gather_var_bytes(np.ascontiguousarray(data), src_off,
+                                  idx, n, out_offsets, out)
+        return out, out_offsets
     lens = (offsets[1:] - offsets[:-1])[indices].astype(np.int64)
     new_offsets = _offsets_from_lengths(lens)  # guards the 2GiB limit
     total = int(new_offsets[-1])
-    cdll = _native_lib()
     if cdll is not None and total:
+        # prebuilt .so without the two-pass symbols: the one-pass gather
+        # still beats the numpy scatter chain (indices validated above)
         out = np.empty(total, dtype=np.uint8)
         out_offsets = np.empty(n + 1, dtype=np.int32)
         cdll.gather_varwidth(
             np.ascontiguousarray(data),
             np.ascontiguousarray(offsets, dtype=np.int32),
-            np.ascontiguousarray(indices, dtype=np.int64),
-            n, out, out_offsets,
+            idx, n, out, out_offsets,
         )
         return out, out_offsets
     starts = offsets[:-1][indices].astype(np.int64)
@@ -272,6 +297,12 @@ class Column:
 
     def _materialize(self) -> None:
         if self._data is None:
+            # counted: every flatten of a dict column is a defeat of the
+            # code-native pipeline — the dict_flat_materializations /
+            # lazy_dict_preserved pair makes regressions visible
+            from transferia_tpu.stats.trace import TELEMETRY
+
+            TELEMETRY.record_dict_materialize()
             self._data, self._offsets = self.dict_enc.materialize()
 
     @property
@@ -706,7 +737,23 @@ class ColumnBatch:
                     else np.ones(p.n_rows, dtype=np.bool_)
                     for p in parts
                 ])
-            if c0.offsets is not None:
+            if (c0.is_lazy_dict and all(p.is_lazy_dict for p in parts)
+                    and all(p.dict_enc.pool is c0.dict_enc.pool
+                            for p in parts)):
+                # bufferer flushes concat batch slices of one row group:
+                # they share one DictPool, so the concat is a pure int32
+                # code concat and the column stays encoded end-to-end
+                # (touching .offsets below would flatten every part)
+                from transferia_tpu.stats.trace import TELEMETRY
+
+                TELEMETRY.record_dict_preserved()
+                cols[name] = Column(
+                    name, c0.ctype, validity=validity,
+                    dict_enc=DictEnc(
+                        np.concatenate([p.dict_enc.indices
+                                        for p in parts]),
+                        pool=c0.dict_enc.pool))
+            elif c0.offsets is not None:
                 data = np.concatenate([p.data for p in parts])
                 lens = np.concatenate([
                     p.offsets[1:] - p.offsets[:-1] for p in parts
@@ -762,6 +809,9 @@ class ColumnBatch:
                 # pages straight from the pool, no flat materialization;
                 # the arrow pool array memoizes on the shared DictPool so
                 # batch slices of one row group serialize it once
+                from transferia_tpu.stats.trace import TELEMETRY
+
+                TELEMETRY.record_dict_preserved()
                 enc = c.dict_enc
                 memo_key = ("arrow_pool", str(pa_type))
                 pool = enc.pool.memo_get(memo_key)

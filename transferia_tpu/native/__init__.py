@@ -38,6 +38,14 @@ def _bind(cdll: ctypes.CDLL) -> ctypes.CDLL:
     cdll.scatter_bytes.restype = None
     cdll.gather_varwidth.argtypes = [u8, i32, i64, ctypes.c_int64, u8, i32]
     cdll.gather_varwidth.restype = ctypes.c_int64
+    # two-pass var-width gather is newer than some prebuilt .so files
+    if hasattr(cdll, "gather_var_offsets"):
+        cdll.gather_var_offsets.argtypes = [i32, i64, ctypes.c_int64, i32]
+        cdll.gather_var_offsets.restype = ctypes.c_int64
+        cdll.gather_var_bytes.argtypes = [
+            u8, i32, i64, ctypes.c_int64, i32, u8,
+        ]
+        cdll.gather_var_bytes.restype = None
     # fixed-width gather is newer than some prebuilt .so files
     if hasattr(cdll, "gather_fixed"):
         cdll.gather_fixed.argtypes = [
@@ -59,6 +67,27 @@ def _bind(cdll: ctypes.CDLL) -> ctypes.CDLL:
         u8, i32, ctypes.c_int64, u32, u32, u32, u32,
     ]
     cdll.polyhash_varcol.restype = None
+    # fused fingerprint lane kernels (newer than some prebuilt .so)
+    if hasattr(cdll, "rowhash_mix_fixed"):
+        cdll.rowhash_mix_fixed.argtypes = [
+            u32, u32, ctypes.c_int64, ctypes.c_uint32, ctypes.c_uint32,
+            u32, u32,
+        ]
+        cdll.rowhash_mix_fixed.restype = None
+        cdll.rowhash_mix_var.argtypes = [
+            u32, u32, ctypes.c_int64, ctypes.c_uint32, ctypes.c_uint32,
+            u32, u32,
+        ]
+        cdll.rowhash_mix_var.restype = None
+        cdll.rowhash_dict_lanes.argtypes = [
+            u32, u32, i32, ctypes.c_int64, ctypes.c_uint32,
+            ctypes.c_uint32, u32, u32,
+        ]
+        cdll.rowhash_dict_lanes.restype = None
+        cdll.rowhash_accum.argtypes = [
+            u32, u32, ctypes.c_int64, u32, u32,
+        ]
+        cdll.rowhash_accum.restype = None
     if hasattr(cdll, "crc32c_batch"):
         cdll.crc32c_batch.argtypes = [u8, i64, ctypes.c_int64, u32]
         cdll.crc32c_batch.restype = None

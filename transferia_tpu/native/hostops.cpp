@@ -66,6 +66,37 @@ int64_t gather_varwidth(const uint8_t* src, const int32_t* src_offsets,
     return pos;
 }
 
+// Var-width gather, two-pass form (Column.take / DictEnc.materialize).
+// Pass 1 (gather_var_offsets): out_offsets[i] = running byte total of the
+// gathered rows — replaces the numpy lens-gather + int64 cumsum +
+// int32 cast chain, which profiled as most of _gather_varwidth's
+// non-memcpy time.  Returns the TOTAL byte count as int64 so the Python
+// caller can enforce the 2 GiB int32-offset invariant itself (offsets
+// written past that point have wrapped and must be discarded).
+int64_t gather_var_offsets(const int32_t* src_offsets, const int64_t* idx,
+                           int64_t n, int32_t* out_offsets) {
+    int64_t pos = 0;
+    out_offsets[0] = 0;
+    for (int64_t i = 0; i < n; i++) {
+        int64_t j = idx[i];
+        pos += src_offsets[j + 1] - src_offsets[j];
+        out_offsets[i + 1] = (int32_t)pos;
+    }
+    return pos;
+}
+
+// Pass 2: byte copies into the exactly-sized output the caller
+// allocated from pass 1's total.
+void gather_var_bytes(const uint8_t* src, const int32_t* src_offsets,
+                      const int64_t* idx, int64_t n,
+                      const int32_t* out_offsets, uint8_t* out) {
+    for (int64_t i = 0; i < n; i++) {
+        int64_t j = idx[i];
+        memcpy(out + out_offsets[i], src + src_offsets[j],
+               (size_t)(src_offsets[j + 1] - src_offsets[j]));
+    }
+}
+
 // Fixed-width row gather (Column.take host path): out row i gets the
 // `width` bytes at src[idx[i]*width].  Width-specialized loops for the
 // power-of-two widths every canonical fixed type uses (1/2/4/8) — the
@@ -417,6 +448,67 @@ void polyhash_varcol(const uint8_t* data, const int32_t* offsets,
         }
         out1[i] = a1;
         out2[i] = a2;
+    }
+}
+
+// ---------------------------------------------------------------------------
+// Fingerprint lane kernels (ops/rowhash.py host backend).  The lane math
+// is a handful of xorshift-multiply mixes per row; in numpy each mix is
+// ~6 full-array passes, so a two-column batch walks ~50 temporaries and
+// the mixing dominates the profile once the polynomial hash is native.
+// These fuse a column's whole lane chain into ONE pass, exact uint32
+// wraparound, byte-identical to the numpy fallback (pinned by tests).
+
+static inline uint32_t mix32(uint32_t x) {
+    x ^= x >> 16;
+    x *= 0x7FEB352Du;
+    x ^= x >> 15;
+    x *= 0x846CA68Bu;
+    x ^= x >> 16;
+    return x;
+}
+
+// Fixed-width column: both finalized lanes from the 64-bit pattern halves.
+void rowhash_mix_fixed(const uint32_t* lo, const uint32_t* hi, int64_t n,
+                       uint32_t seed1, uint32_t seed2,
+                       uint32_t* out1, uint32_t* out2) {
+    for (int64_t i = 0; i < n; i++) {
+        uint32_t h1 = mix32(lo[i] ^ seed1);
+        out1[i] = mix32(h1 + mix32(hi[i] ^ ~seed1));
+        uint32_t h2 = mix32(lo[i] ^ seed2);
+        out2[i] = mix32(h2 + mix32(hi[i] ^ ~seed2));
+    }
+}
+
+// Var-width column: seed + mix over precomputed polynomial accumulators.
+void rowhash_mix_var(const uint32_t* a1, const uint32_t* a2, int64_t n,
+                     uint32_t seed1, uint32_t seed2,
+                     uint32_t* out1, uint32_t* out2) {
+    for (int64_t i = 0; i < n; i++) {
+        out1[i] = mix32(a1[i] ^ seed1);
+        out2[i] = mix32(a2[i] ^ seed2);
+    }
+}
+
+// Dict column: gather the POOL-entry accumulators by code and mix — the
+// whole per-row cost of a dictionary column's fingerprint contribution.
+void rowhash_dict_lanes(const uint32_t* acc1, const uint32_t* acc2,
+                        const int32_t* codes, int64_t n,
+                        uint32_t seed1, uint32_t seed2,
+                        uint32_t* out1, uint32_t* out2) {
+    for (int64_t i = 0; i < n; i++) {
+        int32_t c = codes[i];
+        out1[i] = mix32(acc1[c] ^ seed1);
+        out2[i] = mix32(acc2[c] ^ seed2);
+    }
+}
+
+// Row reduction step: r += mix(h), both lanes in one pass.
+void rowhash_accum(const uint32_t* h1, const uint32_t* h2, int64_t n,
+                   uint32_t* r1, uint32_t* r2) {
+    for (int64_t i = 0; i < n; i++) {
+        r1[i] += mix32(h1[i]);
+        r2[i] += mix32(h2[i]);
     }
 }
 

@@ -49,6 +49,17 @@ def decode_dict_run(words: jax.Array, pool: jax.Array, bit_width: int,
     return jnp.take(pool, codes, axis=0, mode="clip")
 
 
+def gather_pool_accumulators(accs: jax.Array,
+                             codes: jax.Array) -> jax.Array:
+    """Dict-native fingerprint gather (ops/rowhash.py device backend):
+    per-row lane accumulators from per-POOL-ENTRY accumulators by int32
+    code — the reduction plane consuming dict codes directly, the same
+    HBM-bandwidth shape as decode_dict_run's value gather.  Traceable
+    inline; codes padded past the pool clip to entry 0 (the caller's
+    rowmask zeroes those lanes)."""
+    return jnp.take(accs, codes, mode="clip")
+
+
 @functools.partial(jax.jit, static_argnums=(1,))
 def unpack_validity(words: jax.Array, n: int) -> jax.Array:
     """Packed little-endian validity bitmap -> (n,) bool.

@@ -378,8 +378,10 @@ def device_hmac_dict_pool(key: bytes, pool, n_rows: int):
     rebinds them to the hexed pool.
 
     Returns None when the pool is too large to pay for itself on this
-    batch (mirrors the host-path economics) — the caller falls back to
-    the flat blocks wire.
+    batch (mirrors the host-path economics) — the caller then hashes
+    the referenced value subset on the HOST (mask_dict_column), still
+    dict-encoded: zero link bytes either way, which is exactly what
+    DeviceFusedStep._estimate_link_bytes charges for a rejected pool.
     """
     memo_key = ("hmac_hex", key)
     hexed = pool.memo_get(memo_key)

@@ -27,6 +27,16 @@ Canonicalization (identical in both backends, pinned by parity tests):
   fingerprint);
 - each column is seeded by crc32(name) so column swaps change the
   fingerprint even between same-typed columns.
+
+Dict-native reduction (ARCHITECTURE.md "Dict-native reductions"): a
+dictionary-encoded column's per-row polynomial accumulator depends only
+on the row's own bytes — zero padding contributes nothing and the
+column seed mixes in AFTER the accumulator — so the accumulators are
+computed once per POOL ENTRY (O(pool bytes), memoized on the shared
+DictPool) and per-row lanes are an O(n_rows) int32 gather of
+accumulators by code.  Work drops from O(total row bytes) to
+O(pool bytes + n_rows), the digests stay byte-identical to the flat
+path (pinned by tests), and the column never flattens.
 """
 
 from __future__ import annotations
@@ -123,10 +133,13 @@ class _PreppedColumn:
     Var-width columns keep their (data, offsets) — the host backend
     hashes them in place (native polyhash_varcol never materializes the
     padded matrix); the device backend packs lazily via ensure_blocks().
+    Dict-encoded columns keep their int32 codes plus the POOL's per-entry
+    accumulators (memoized on the shared DictPool): both backends gather
+    accumulators by code instead of touching row bytes.
     """
 
     name: str
-    kind: str                      # "fixed" | "var"
+    kind: str                      # "fixed" | "var" | "dict"
     lo: Optional[np.ndarray] = None     # fixed: (N,) u32
     hi: Optional[np.ndarray] = None     # fixed: (N,) u32
     data: Optional[np.ndarray] = None    # var: flat u8
@@ -134,6 +147,9 @@ class _PreppedColumn:
     blocks: Optional[np.ndarray] = None  # var: (N, W) u8 (lazy)
     width: int = 0
     validity: Optional[np.ndarray] = None
+    codes: Optional[np.ndarray] = None   # dict: (N,) i32
+    acc1: Optional[np.ndarray] = None    # dict: (n_values,) u32
+    acc2: Optional[np.ndarray] = None    # dict: (n_values,) u32
 
     def ensure_blocks(self) -> np.ndarray:
         if self.blocks is None:
@@ -177,11 +193,73 @@ def _pack_var(data: np.ndarray, offsets: np.ndarray,
     return out
 
 
+# per-pool accumulator memo key: the accumulators depend only on the
+# pool BYTES (the column seed mixes in after the gather), so one pair
+# serves every column, batch, and HMAC key sharing the pool
+_ACC_MEMO_KEY = ("rowhash_accs",)
+
+
+def pool_accumulators(pool) -> tuple[np.ndarray, np.ndarray]:
+    """Both lanes' polynomial accumulators, one per pool ENTRY.
+
+    Identical to what `_var_accs_host` computes for a flat row carrying
+    the same bytes (zero padding of the canonical block matrix
+    contributes nothing to the sum, and the power-table indices a row
+    touches depend only on its own length — never on the batch-wide
+    padded width).  Memoized on the shared DictPool: batches slicing one
+    row group's dictionary hash its values exactly once."""
+    memo = pool.memo_get(_ACC_MEMO_KEY)
+    if memo is not None:
+        return memo
+    from transferia_tpu.chaos.failpoints import failpoint
+
+    failpoint("rowhash.pool_accs")
+    n_vals = pool.n_values
+    offs = np.ascontiguousarray(pool.values_offsets, dtype=np.int32)
+    lens = offs[1:] - offs[:-1]
+    width = _pow2_width(int(lens.max()) if n_vals else 0)
+    tmp = _PreppedColumn(
+        name="", kind="var",
+        data=np.ascontiguousarray(pool.values_data),
+        offsets=offs, width=width)
+    accs = _var_accs_host(tmp, n_vals)
+    pool.memo_set(_ACC_MEMO_KEY, accs)
+    return accs
+
+
 def prep_batch(batch: ColumnBatch) -> tuple[list[_PreppedColumn], int]:
     """Canonicalize a batch for either fingerprint backend."""
+    from transferia_tpu.stats.trace import TELEMETRY
+
     cols: list[_PreppedColumn] = []
     for name in batch.schema.names():
         col = batch.column(name)
+        if col.is_lazy_dict:
+            # dict-native: never touch col.data/col.offsets (that would
+            # flatten the pool per row); hash the pool once, gather by
+            # code.  Null rows are overridden by the validity constant
+            # in _col_lanes_host exactly as on the flat path, so the
+            # sentinel entry's accumulator (empty bytes) is only ever a
+            # don't-care placeholder there.
+            pool = col.dict_enc.pool
+            codes = np.ascontiguousarray(col.dict_enc.indices,
+                                         dtype=np.int32)
+            if len(codes):
+                # both backends gather UNCHECKED (native loop / device
+                # clip): a corrupt code must raise here, not hash
+                # stray memory into a plausible-looking digest
+                cmin, cmax = int(codes.min()), int(codes.max())
+                if cmin < 0 or cmax >= pool.n_values:
+                    raise IndexError(
+                        f"column {name}: dict codes [{cmin}, {cmax}] "
+                        f"out of range for pool of {pool.n_values} "
+                        f"values")
+            a1, a2 = pool_accumulators(pool)
+            TELEMETRY.record_dict_preserved()
+            cols.append(_PreppedColumn(
+                name=name, kind="dict",
+                codes=codes, acc1=a1, acc2=a2, validity=col.validity))
+            continue
         if col.offsets is not None:
             lens = col.offsets[1:] - col.offsets[:-1]
             width = _pow2_width(int(lens.max()) if batch.n_rows else 0)
@@ -238,10 +316,43 @@ def _var_accs_host(col: _PreppedColumn,
     return a1, a2
 
 
+def _lanes_lib():
+    """The native lib iff it carries the fused lane kernels (a prebuilt
+    .so from an older source keeps the numpy chain)."""
+    from transferia_tpu.native import lib as native_lib
+
+    cdll = native_lib()
+    if cdll is not None and hasattr(cdll, "rowhash_mix_fixed"):
+        return cdll
+    return None
+
+
 def _col_lanes_host(col: _PreppedColumn, n_rows: int
                     ) -> tuple[np.ndarray, np.ndarray]:
     seed1, seed2 = _col_seed(col.name, 0), _col_seed(col.name, 1)
-    if col.kind == "fixed":
+    cdll = _lanes_lib() if n_rows else None
+    if cdll is not None:
+        # fused C++ lane chains: one pass per column instead of the
+        # ~30 numpy temporaries the mix cascade walks (byte-identical;
+        # pinned by the fallback-parity tests)
+        h1 = np.empty(n_rows, dtype=np.uint32)
+        h2 = np.empty(n_rows, dtype=np.uint32)
+        s1, s2 = int(seed1), int(seed2)
+        if col.kind == "fixed":
+            cdll.rowhash_mix_fixed(
+                np.ascontiguousarray(col.lo),
+                np.ascontiguousarray(col.hi), n_rows, s1, s2, h1, h2)
+        elif col.kind == "dict":
+            cdll.rowhash_dict_lanes(
+                np.ascontiguousarray(col.acc1),
+                np.ascontiguousarray(col.acc2),
+                col.codes, n_rows, s1, s2, h1, h2)
+        else:
+            a1, a2 = _var_accs_host(col, n_rows)
+            cdll.rowhash_mix_var(
+                np.ascontiguousarray(a1), np.ascontiguousarray(a2),
+                n_rows, s1, s2, h1, h2)
+    elif col.kind == "fixed":
         out = []
         for seed in (seed1, seed2):
             h = _mix32_np(col.lo ^ seed)
@@ -249,6 +360,14 @@ def _col_lanes_host(col: _PreppedColumn, n_rows: int
                 col.hi ^ np.uint32(~int(seed) & 0xFFFFFFFF)))
             out.append(h)
         h1, h2 = out
+    elif col.kind == "dict":
+        # O(n_rows) gather of the memoized pool accumulators by code —
+        # byte-identical to hashing the materialized rows because the
+        # accumulator of a row IS the accumulator of its pool entry
+        from transferia_tpu.columnar.batch import _gather_fixed
+
+        h1 = _mix32_np(_gather_fixed(col.acc1, col.codes) ^ seed1)
+        h2 = _mix32_np(_gather_fixed(col.acc2, col.codes) ^ seed2)
     else:
         a1, a2 = _var_accs_host(col, n_rows)
         h1 = _mix32_np(a1 ^ seed1)
@@ -269,10 +388,15 @@ def row_lanes(cols: Sequence[_PreppedColumn],
     alone can only witness set equality."""
     r1 = np.zeros(n_rows, dtype=np.uint32)
     r2 = np.zeros(n_rows, dtype=np.uint32)
+    cdll = _lanes_lib() if n_rows else None
     for col in cols:
         h1, h2 = _col_lanes_host(col, n_rows)
-        r1 += _mix32_np(h1)
-        r2 += _mix32_np(h2)
+        if cdll is not None:
+            cdll.rowhash_accum(np.ascontiguousarray(h1),
+                               np.ascontiguousarray(h2), n_rows, r1, r2)
+        else:
+            r1 += _mix32_np(h1)
+            r2 += _mix32_np(h2)
     return _mix32_np(r1), _mix32_np(r2)
 
 
@@ -322,12 +446,13 @@ class DeviceFingerprintProgram:
             x = x * jnp.uint32(0x846CA68B)
             return x ^ (x >> jnp.uint32(16))
 
-        def program(fixed_lo, fixed_hi, var_blocks, validities, rowmask,
+        def program(fixed_lo, fixed_hi, var_blocks, dict_codes,
+                    dict_accs1, dict_accs2, validities, rowmask,
                     seeds1, seeds2, nulls1, nulls2, powers1, powers2):
             n = rowmask.shape[0]
             r1 = jnp.zeros(n, dtype=jnp.uint32)
             r2 = jnp.zeros(n, dtype=jnp.uint32)
-            fi = vi = 0
+            fi = vi = di = 0
             for idx, kind in enumerate(sig_kinds):
                 for lane in (0, 1):
                     seed = (seeds1 if lane == 0 else seeds2)[idx]
@@ -336,6 +461,19 @@ class DeviceFingerprintProgram:
                         lo, hi = fixed_lo[fi], fixed_hi[fi]
                         h = mix(lo ^ seed)
                         h = mix(h + mix(hi ^ (~seed)))
+                    elif kind == "dict":
+                        # codes + per-pool-entry accumulators crossed
+                        # the link (4 + 4·k/n bytes/row, not the padded
+                        # block matrix); the reduction consumes codes
+                        # directly via an HBM-speed gather
+                        from transferia_tpu.ops.decode import (
+                            gather_pool_accumulators,
+                        )
+
+                        acc = (dict_accs1 if lane == 0
+                               else dict_accs2)[di]
+                        h = mix(gather_pool_accumulators(
+                            acc, dict_codes[di]) ^ seed)
                     else:
                         pw = (powers1 if lane == 0 else powers2)[vi]
                         b = var_blocks[vi].astype(jnp.uint32)
@@ -350,6 +488,8 @@ class DeviceFingerprintProgram:
                         r2 = r2 + mix(h)
                 if kind == "fixed":
                     fi += 1
+                elif kind == "dict":
+                    di += 1
                 else:
                     vi += 1
             r1, r2 = mix(r1), mix(r2)
@@ -375,6 +515,7 @@ class DeviceFingerprintProgram:
         sig = tuple(
             (c.kind, c.width if c.kind == "var" else 0) for c in cols)
         fixed_lo, fixed_hi, var_blocks, validities = [], [], [], []
+        dict_codes, dict_accs1, dict_accs2 = [], [], []
         seeds1, seeds2, nulls1, nulls2 = [], [], [], []
         powers1, powers2 = [], []
         pad = bucket - n_rows
@@ -393,6 +534,19 @@ class DeviceFingerprintProgram:
             if c.kind == "fixed":
                 fixed_lo.append(jnp.asarray(padded(c.lo)))
                 fixed_hi.append(jnp.asarray(padded(c.hi)))
+            elif c.kind == "dict":
+                # accumulators pad to a row bucket too, so pool-size
+                # jitter re-traces per bucket, not per distinct pool;
+                # pad codes index entry 0 and rowmask zeroes their lanes
+                dict_codes.append(jnp.asarray(padded(c.codes)))
+                ab = bucket_rows(max(len(c.acc1), 1))
+                apad = ab - len(c.acc1)
+
+                def padded_acc(a):
+                    return np.pad(a, (0, apad)) if apad else a
+
+                dict_accs1.append(jnp.asarray(padded_acc(c.acc1)))
+                dict_accs2.append(jnp.asarray(padded_acc(c.acc2)))
             else:
                 var_blocks.append(jnp.asarray(padded(c.ensure_blocks())))
                 powers1.append(jnp.asarray(_powers(c.width, int(_P1))))
@@ -404,6 +558,8 @@ class DeviceFingerprintProgram:
         rowmask[:n_rows] = True
         fn = self._program_for(sig)
         out = fn(tuple(fixed_lo), tuple(fixed_hi), tuple(var_blocks),
+                 tuple(dict_codes), tuple(dict_accs1),
+                 tuple(dict_accs2),
                  tuple(validities), jnp.asarray(rowmask),
                  jnp.asarray(np.array(seeds1, dtype=np.uint32)),
                  jnp.asarray(np.array(seeds2, dtype=np.uint32)),

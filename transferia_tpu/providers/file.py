@@ -192,10 +192,12 @@ class FileStorage(Storage, ShardingStorage, ScanPredicateStorage):
             return {}
         eta = 0
         if self.params.format == "parquet":
-            import pyarrow.parquet as pq
+            from transferia_tpu.providers.parquet_native import (
+                parquet_metadata,
+            )
 
             for f in self._files():
-                eta += pq.ParquetFile(f).metadata.num_rows
+                eta += parquet_metadata(f).num_rows
         return {self.table: TableInfo(
             eta_rows=eta, schema=self.table_schema(self.table)
         )}
@@ -223,9 +225,11 @@ class FileStorage(Storage, ShardingStorage, ScanPredicateStorage):
         out = []
         for f in files:
             if self.params.format == "parquet":
-                import pyarrow.parquet as pq
+                from transferia_tpu.providers.parquet_native import (
+                    parquet_metadata,
+                )
 
-                meta = pq.ParquetFile(f).metadata
+                meta = parquet_metadata(f)
                 n_groups = meta.num_row_groups
                 step = self._groups_per_part(n_groups)
                 for lo in range(0, n_groups, step):
@@ -405,13 +409,17 @@ class FileStorage(Storage, ShardingStorage, ScanPredicateStorage):
 
     def _load_row_groups(self, path: str, lo: int, hi: int, tid: TableID,
                          schema: TableSchema, pusher: Pusher) -> None:
-        import pyarrow.parquet as pq
-
         from transferia_tpu.chaos.failpoints import failpoint
+        from transferia_tpu.providers.parquet_native import (
+            parquet_file_cached,
+        )
         from transferia_tpu.stats import stagetimer
 
         failpoint("storage.file.open")
-        pf = pq.ParquetFile(path)
+        # footer metadata memoizes per (path, mtime, size): a multi-part
+        # load re-opens the same file once per part, and the thrift
+        # footer parse was 3.9% of the BENCH_r05 profile
+        pf = parquet_file_cached(path)
         groups = self._prune_row_groups(pf, list(range(lo, hi)), tid)
         if not groups:
             return
@@ -447,9 +455,11 @@ class FileStorage(Storage, ShardingStorage, ScanPredicateStorage):
                    pusher: Pusher) -> None:
         fmt = self.params.format
         if fmt == "parquet":
-            import pyarrow.parquet as pq
+            from transferia_tpu.providers.parquet_native import (
+                parquet_metadata,
+            )
 
-            n_groups = pq.ParquetFile(path).metadata.num_row_groups
+            n_groups = parquet_metadata(path).num_row_groups
             self._load_row_groups(path, 0, n_groups, tid, schema, pusher)
         elif fmt == "csv":
             import pyarrow.csv as pacsv

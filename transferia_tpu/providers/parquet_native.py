@@ -26,6 +26,7 @@ with sink pushes.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from typing import Optional
 
@@ -35,6 +36,84 @@ from transferia_tpu.abstract.schema import CanonicalType, TableSchema
 from transferia_tpu.columnar.batch import Column, DictEnc, DictPool
 
 logger = logging.getLogger(__name__)
+
+
+# -- per-file footer/metadata + memmap memoization ---------------------------
+#
+# Multi-part loads open the SAME file once per part: sharding reads the
+# footer to enumerate row groups, then every part re-runs
+# `ParquetFile.__init__` (a full thrift footer parse — 3.9% of the
+# BENCH_r05 profile) and every NativeParquetReader re-creates the file
+# memmap (1.6%).  Both are pure functions of (path, mtime_ns, size), so
+# they memoize under that key; a rewritten file gets a fresh entry.
+# Bounded FIFO; the lock guards the loader's concurrent part threads.
+
+_FOOTER_CACHE: dict = {}     # (path, mtime_ns, size) -> FileMetaData
+_MMAP_CACHE: dict = {}       # (path, mtime_ns, size) -> np.memmap
+_FILE_CACHE_MAX = 32
+_FILE_CACHE_LOCK = threading.Lock()
+
+
+def _file_key(path: str) -> tuple:
+    st = os.stat(path)
+    return (os.path.abspath(path), st.st_mtime_ns, st.st_size)
+
+
+def parquet_file_cached(path: str):
+    """A fresh pyarrow ParquetFile whose footer parses at most once per
+    (path, mtime, size) — the FileMetaData is memoized and handed back
+    to `ParquetFile(metadata=...)`, so each caller still gets its OWN
+    reader object (pyarrow readers are not safe to share across part
+    threads) without re-running the thrift parse per part."""
+    import pyarrow.parquet as pq
+
+    key = _file_key(path)
+    with _FILE_CACHE_LOCK:
+        meta = _FOOTER_CACHE.get(key)
+    if meta is not None:
+        return pq.ParquetFile(path, metadata=meta)
+    pf = pq.ParquetFile(path)
+    with _FILE_CACHE_LOCK:
+        while len(_FOOTER_CACHE) >= _FILE_CACHE_MAX:
+            _FOOTER_CACHE.pop(next(iter(_FOOTER_CACHE)), None)
+        _FOOTER_CACHE[key] = pf.metadata
+    return pf
+
+
+def parquet_metadata(path: str):
+    """Memoized footer metadata only (sharding/row-count callers that
+    never read pages skip constructing a reader entirely)."""
+    key = _file_key(path)
+    with _FILE_CACHE_LOCK:
+        meta = _FOOTER_CACHE.get(key)
+    if meta is not None:
+        return meta
+    return parquet_file_cached(path).metadata
+
+
+def shared_memmap(path: str) -> np.ndarray:
+    """One read-only memmap per (path, mtime, size), shared by every
+    row-group reader of the file (readers only ever slice it)."""
+    key = _file_key(path)
+    with _FILE_CACHE_LOCK:
+        mm = _MMAP_CACHE.get(key)
+        if mm is not None:
+            return mm
+    mm = np.memmap(path, dtype=np.uint8, mode="r")
+    with _FILE_CACHE_LOCK:
+        hit = _MMAP_CACHE.get(key)
+        if hit is not None:
+            return hit
+        while len(_MMAP_CACHE) >= _FILE_CACHE_MAX:
+            _MMAP_CACHE.pop(next(iter(_MMAP_CACHE)), None)
+        _MMAP_CACHE[key] = mm
+    return mm
+
+
+def reset_file_caches() -> None:
+    with _FILE_CACHE_LOCK:
+        _FOOTER_CACHE.clear()
+        _MMAP_CACHE.clear()
 
 # bench/diagnostic visibility: which columns fell out of the native
 # envelope (and how often) — silent arrow fallbacks regress the headline
@@ -98,7 +177,7 @@ class NativeParquetReader:
         self._schema = schema
         self._cdll = cdll
         self._decode_threads = max(1, int(decode_threads))
-        self._mm = np.memmap(path, dtype=np.uint8, mode="r")
+        self._mm = shared_memmap(path)
         # column index by name (flat schemas only — nested fall back)
         self._col_idx = {}
         for i in range(self._meta.num_columns):
@@ -117,8 +196,6 @@ class NativeParquetReader:
              decode_threads: int = 1
              ) -> Optional["NativeParquetReader"]:
         from transferia_tpu.native import lib as native_lib
-
-        import os
 
         if os.environ.get("TRANSFERIA_TPU_NATIVE_PARQUET", "1") == "0":
             return None

@@ -50,6 +50,11 @@ class SampleSourceParams(EndpointParams):
     replication_batch: int = 1024
     seed: int = 7
     shard_parts: int = 0         # >0: advertise ShardingStorage parts
+    # emit the low-cardinality utf8 columns (iot status/device_id,
+    # users country) as DictEnc over a per-preset shared pool — the
+    # dict-heavy source shape the code-native reduction plane is
+    # measured against (bytes identical to the flat emission)
+    dict_encode: bool = False
 
 
 _IOT_SCHEMA = new_table_schema([
@@ -100,16 +105,59 @@ def _utf8_column(name: str, values: np.ndarray) -> Column:
     return Column(name, CanonicalType.UTF8, out, offsets)
 
 
+# per-(preset, column) shared DictPools for dict_encode batches: every
+# batch of a load references ONE pool object, so downstream memos
+# (hexed HMAC pool, rowhash accumulators) amortize across the whole
+# transfer exactly as parquet row-group dictionaries do
+_DICT_POOLS: dict = {}
+_DICT_POOL_LOCK = threading.Lock()
+
+
+def _shared_pool(key: str, values: list[str]):
+    from transferia_tpu.columnar.batch import (
+        DictPool,
+        _offsets_from_lengths,
+    )
+
+    with _DICT_POOL_LOCK:
+        pool = _DICT_POOLS.get(key)
+        if pool is None:
+            bufs = [v.encode() for v in values]
+            data = np.frombuffer(b"".join(bufs), dtype=np.uint8).copy()
+            # one extra empty-bytes sentinel entry for null rows (none
+            # in the sample presets, but the pool contract carries it)
+            off = _offsets_from_lengths([len(b) for b in bufs] + [0])
+            pool = DictPool(data, off, null_code=len(bufs))
+            _DICT_POOLS[key] = pool
+    return pool
+
+
+def _dict_column(name: str, key: str, values: list[str],
+                 codes: np.ndarray) -> Column:
+    from transferia_tpu.columnar.batch import DictEnc
+
+    pool = _shared_pool(key, values)
+    return Column(name, CanonicalType.UTF8,
+                  dict_enc=DictEnc(codes.astype(np.int32), pool=pool))
+
+
 def make_batch(preset: str, table: TableID, start: int, n: int,
-               seed: int) -> ColumnBatch:
-    """Deterministic batch of n rows with ids [start, start+n)."""
+               seed: int, dict_encode: bool = False) -> ColumnBatch:
+    """Deterministic batch of n rows with ids [start, start+n).
+
+    dict_encode=True emits the low-cardinality utf8 columns as DictEnc
+    over shared pools; materializing them yields byte-identical flat
+    buffers to the default emission (pinned by tests)."""
     rng = np.random.default_rng(seed + start)
     ids = np.arange(start, start + n, dtype=np.int64)
     if preset == "iot":
         dev = rng.integers(0, 1000, n)
+        dev_values = ["dev-" + str(i) for i in range(1000)]
         cols = {
             "event_id": Column("event_id", CanonicalType.INT64, ids),
-            "device_id": _utf8_column(
+            "device_id": _dict_column(
+                "device_id", "iot.device_id", dev_values, dev)
+            if dict_encode else _utf8_column(
                 "device_id",
                 np.char.add("dev-", dev.astype("U6")),
             ),
@@ -123,7 +171,10 @@ def make_batch(preset: str, table: TableID, start: int, n: int,
                 "humidity", CanonicalType.DOUBLE,
                 np.round(rng.uniform(0, 100, n), 3),
             ),
-            "status": _utf8_column(
+            "status": _dict_column(
+                "status", "iot.status", _STATUSES.tolist(),
+                rng.integers(0, 4, n))
+            if dict_encode else _utf8_column(
                 "status", _STATUSES[rng.integers(0, 4, n)].astype("U8")
             ),
         }
@@ -143,7 +194,10 @@ def make_batch(preset: str, table: TableID, start: int, n: int,
                           rng.integers(18, 90, n).astype(np.int32)),
             "score": Column("score", CanonicalType.DOUBLE,
                             np.round(rng.uniform(0, 1000, n), 2)),
-            "country": _utf8_column(
+            "country": _dict_column(
+                "country", "users.country", _COUNTRIES.tolist(),
+                rng.integers(0, 6, n))
+            if dict_encode else _utf8_column(
                 "country", _COUNTRIES[rng.integers(0, 6, n)].astype("U4")
             ),
         }
@@ -220,7 +274,8 @@ class SampleStorage(Storage, ShardingStorage):
                 sp.add(rows=n)
             with sp:
                 batch = make_batch(self.params.preset, table.id, start, n,
-                                   self.params.seed)
+                                   self.params.seed,
+                                   dict_encode=self.params.dict_encode)
             pusher(batch)
 
 

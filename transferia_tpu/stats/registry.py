@@ -2,6 +2,7 @@
 
 from __future__ import annotations
 
+import threading
 import time
 from typing import Optional
 
@@ -64,13 +65,20 @@ class Metrics:
             self.registry = None
         self.labels = labels or {}
         self._metrics: dict[str, object] = {}
+        # get-or-create must be atomic: one Metrics is shared by a
+        # loader's parallel part-upload threads (fold_into constructs a
+        # DeviceStats bundle per fold), and a lost race re-registers the
+        # collector — prometheus raises "Duplicated timeseries", the
+        # part retries, and an at-least-once sink shows duplicate rows
+        self._get_lock = threading.Lock()
 
     def _get(self, cls, name: str, doc: str, **kw):
-        if name not in self._metrics:
-            self._metrics[name] = cls(
-                name, doc, registry=self.registry, **kw
-            )
-        return self._metrics[name]
+        with self._get_lock:
+            if name not in self._metrics:
+                self._metrics[name] = cls(
+                    name, doc, registry=self.registry, **kw
+                )
+            return self._metrics[name]
 
     def counter(self, name: str, doc: str = "") -> "Counter":
         return self._get(Counter, name, doc or name)
@@ -197,6 +205,13 @@ class DeviceStats(_Bundle):
         self.dict_pool_hits = self.m.counter("dict_pool_device_hits")
         self.dict_pool_uploads = self.m.counter(
             "dict_pool_device_uploads")
+        # dict-native reduction plane (ops/rowhash.py, mask fast paths):
+        # columns that crossed a stage still code-encoded vs columns a
+        # consumer flattened — nonzero flat materializations on a
+        # dict-heavy pipeline mean a code-aware fast path leaked
+        self.lazy_dict_preserved = self.m.counter("lazy_dict_preserved")
+        self.dict_flat_materializations = self.m.counter(
+            "dict_flat_materializations")
 
 
 class InterchangeStats(_Bundle):

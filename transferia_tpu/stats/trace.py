@@ -369,6 +369,13 @@ class DeviceTelemetry:
             self.h2d_raw_equiv_bytes = 0
             self.dict_pool_hits = 0
             self.dict_pool_uploads = 0
+            # dict-native pipeline honesty pair: columns handled in
+            # their code+pool encoding end-to-end vs columns some
+            # consumer flattened (Column._materialize) — a dict-heavy
+            # snapshot that finishes with nonzero flat materializations
+            # has a leak in a code-aware fast path
+            self.lazy_dict_preserved = 0
+            self.dict_flat_materializations = 0
             # per-target fold baselines: several pipelines may each
             # fold the (process-global) counters into their own
             # Metrics; one shared baseline would split deltas between
@@ -407,6 +414,17 @@ class DeviceTelemetry:
         with self._lock:
             self.dict_pool_uploads += 1
 
+    def record_dict_preserved(self, n: int = 1) -> None:
+        """A dict column crossed a pipeline stage still code-encoded."""
+        with self._lock:
+            self.lazy_dict_preserved += n
+
+    def record_dict_materialize(self) -> None:
+        """A lazy dict column flattened to (data, offsets) — the event
+        the dict-native reduction plane exists to eliminate."""
+        with self._lock:
+            self.dict_flat_materializations += 1
+
     def record_kernel(self, seconds: float) -> None:
         with self._lock:
             self.kernel_seconds += seconds
@@ -434,6 +452,9 @@ class DeviceTelemetry:
                 "dispatch_compression_ratio": round(ratio, 2),
                 "dict_pool_hits": self.dict_pool_hits,
                 "dict_pool_uploads": self.dict_pool_uploads,
+                "lazy_dict_preserved": self.lazy_dict_preserved,
+                "dict_flat_materializations":
+                    self.dict_flat_materializations,
             }
 
     def fold_into(self, metrics) -> None:
@@ -464,6 +485,9 @@ class DeviceTelemetry:
                 "h2d_raw_equiv_bytes": self.h2d_raw_equiv_bytes,
                 "dict_pool_hits": self.dict_pool_hits,
                 "dict_pool_uploads": self.dict_pool_uploads,
+                "lazy_dict_preserved": self.lazy_dict_preserved,
+                "dict_flat_materializations":
+                    self.dict_flat_materializations,
             }
             prev = self._folded.setdefault(metrics, {})
             for key, counter in (
@@ -479,6 +503,9 @@ class DeviceTelemetry:
                 ("h2d_raw_equiv_bytes", ds.h2d_raw_equiv_bytes),
                 ("dict_pool_hits", ds.dict_pool_hits),
                 ("dict_pool_uploads", ds.dict_pool_uploads),
+                ("lazy_dict_preserved", ds.lazy_dict_preserved),
+                ("dict_flat_materializations",
+                 ds.dict_flat_materializations),
             ):
                 delta = snap[key] - prev.get(key, 0)
                 if delta > 0:

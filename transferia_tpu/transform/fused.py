@@ -180,13 +180,20 @@ class DeviceFusedStep(Transformer):
         from transferia_tpu.ops.dispatch import encoding_enabled
 
         enc = encoding_enabled()
+        # the pool route only exists on the single-device program: a
+        # batch large enough to take the mesh program flattens dict
+        # columns onto the raw block wire, and the estimate must charge
+        # that, not the single-device short-cut
+        mesh_route = (self.sharded_program is not None
+                      and n_rows >= self._sharded_min_rows)
         h2d = 0.0
         d2h = 0.0
         for name, key in self.mask_entries:
             col = None
             if batch is not None and name in batch.columns:
                 col = batch.column(name)
-            if enc and col is not None and col.is_lazy_dict:
+            if (enc and not mesh_route and col is not None
+                    and col.is_lazy_dict):
                 pool = col.dict_enc.pool
                 if pool.memo_get(("hmac_hex", bytes(key))) is not None:
                     continue  # hexed pool already resident: free
@@ -196,7 +203,8 @@ class DeviceFusedStep(Transformer):
                     # shares the pool, but charged to this one
                     h2d += 128.0 * pool.n_values
                     d2h += 32.0 * pool.n_values
-                    continue
+                continue  # economics-rejected pools subset-hash on
+                # the host inside the device strategy: zero link bytes
             h2d += 128.0 * n_rows
             d2h += 32.0 * n_rows
         if self.pred_node is not None:
@@ -346,6 +354,17 @@ class DeviceFusedStep(Transformer):
 
                     dict_cols[name] = dict_hex_column(col, hexed)
                     continue
+                # pool too large for this batch's economics: hash the
+                # referenced SUBSET on host instead of flattening the
+                # column into SHA blocks for the wire — the DictEnc
+                # column comes straight off the decode plane and stays
+                # encoded on the host route too
+                from transferia_tpu.transform.plugins.mask import (
+                    mask_dict_column,
+                )
+
+                dict_cols[name] = mask_dict_column(bytes(key), col)
+                continue
             mask_inputs.append((col.data, col.offsets))
             flat_entries.append(name)
             flat_states.append(states)
@@ -411,11 +430,10 @@ class DeviceFusedStep(Transformer):
             for name, key in self.mask_entries:
                 col = cur.column(name)
                 if col.is_lazy_dict:
-                    # O(unique) hash: pool once, codes stay
-                    masked = mask_dict_column(key, col)
-                    if masked is not None:
-                        cols[name] = masked
-                        continue
+                    # O(unique) hash: pool once (or the referenced
+                    # subset when the pool dwarfs the batch), codes stay
+                    cols[name] = mask_dict_column(key, col)
+                    continue
                 data, offsets = _host_hmac_hex(
                     key, col.data, col.offsets, col.validity)
                 cols[name] = Column(name, CanonicalType.UTF8, data,

@@ -116,6 +116,24 @@ def _native_hmac_hex(key: bytes, data: np.ndarray, offsets: np.ndarray,
     return hex_to_varwidth(out_hex, validity)
 
 
+def _hexed_pool(pool_hex: np.ndarray, pool_hex_off: np.ndarray,
+                null_code: Optional[int]) -> DictPool:
+    """Flat per-value hex digests -> a hexed DictPool with the null
+    sentinel's slot emptied (null rows materialize as empty bytes, not
+    HMAC of empty)."""
+    if null_code is not None:
+        lens = np.diff(pool_hex_off).astype(np.int64)
+        lens[null_code] = 0
+        new_off = _offsets_from_lengths(lens)
+        keep_mask = np.ones(len(pool_hex), dtype=bool)
+        s, e = (int(pool_hex_off[null_code]),
+                int(pool_hex_off[null_code + 1]))
+        keep_mask[s:e] = False
+        pool_hex = pool_hex[keep_mask]
+        pool_hex_off = new_off
+    return DictPool(pool_hex, pool_hex_off, null_code=null_code)
+
+
 def hexed_pool_from_flat(pool: DictPool, pool_hex: np.ndarray,
                          pool_hex_off: np.ndarray) -> DictPool:
     """Flat per-value hex digests -> the hexed DictPool, with the null
@@ -123,23 +141,18 @@ def hexed_pool_from_flat(pool: DictPool, pool_hex: np.ndarray,
     HMAC of empty).  Shared by the host hash path (mask_dict_column)
     and the device-resident one (ops/dispatch.device_hmac_dict_pool) —
     both must produce identical pools for the memo to be sound."""
-    if pool.null_code is not None:
-        lens = np.diff(pool_hex_off).astype(np.int64)
-        lens[pool.null_code] = 0
-        new_off = _offsets_from_lengths(lens)
-        keep_mask = np.ones(len(pool_hex), dtype=bool)
-        s, e = (int(pool_hex_off[pool.null_code]),
-                int(pool_hex_off[pool.null_code + 1]))
-        keep_mask[s:e] = False
-        pool_hex = pool_hex[keep_mask]
-        pool_hex_off = new_off
-    return DictPool(pool_hex, pool_hex_off, null_code=pool.null_code)
+    return _hexed_pool(pool_hex, pool_hex_off, pool.null_code)
 
 
 def dict_hex_column(col: Column, hexed: DictPool) -> Column:
     """Rebind a dict column's codes to its hexed pool (the masked
     output column — still dictionary-encoded, codes untouched unless a
-    null sentinel has to be appended for a sentinel-less pool)."""
+    null sentinel has to be appended for a sentinel-less pool).  Every
+    mask route that keeps the encoding ends here, so this is where the
+    lazy_dict_preserved counter ticks."""
+    from transferia_tpu.stats.trace import TELEMETRY
+
+    TELEMETRY.record_dict_preserved()
     codes = col.dict_enc.indices
     if (hexed.null_code is None and col.validity is not None
             and not col.validity.all()):
@@ -154,16 +167,48 @@ def dict_hex_column(col: Column, hexed: DictPool) -> Column:
                   dict_enc=DictEnc(codes, pool=hexed))
 
 
-def mask_dict_column(key: bytes, col: Column) -> Optional[Column]:
+def _mask_dict_subset(key: bytes, col: Column) -> Column:
+    """HMAC only the pool values THIS batch references (a pool much
+    larger than the batch must not be hashed whole, and the rows must
+    never flatten into per-row HMAC input — the old fallthrough that
+    made `_native_hmac_hex` over flat bytes the #2 profile entry).
+    O(unique-in-batch) hash + O(n_rows) code remap; output bytes are
+    identical to the flat path and the column STAYS dict-encoded over a
+    fresh subset pool."""
+    enc = col.dict_enc
+    pool = enc.pool
+    uniq, ranks = np.unique(enc.indices, return_inverse=True)
+    from transferia_tpu.columnar.batch import _gather_varwidth
+
+    sub_data, sub_off = _gather_varwidth(
+        pool.values_data,
+        np.ascontiguousarray(pool.values_offsets, dtype=np.int32),
+        uniq.astype(np.int64))
+    hex_data, hex_off = _host_hmac_hex(key, sub_data, sub_off, None)
+    sub_null = None
+    if pool.null_code is not None:
+        pos = int(np.searchsorted(uniq, pool.null_code))
+        if pos < len(uniq) and int(uniq[pos]) == pool.null_code:
+            sub_null = pos
+    sub = _hexed_pool(hex_data, hex_off, sub_null)
+    codes = ranks.astype(np.int32)
+    return dict_hex_column(
+        Column(col.name, col.ctype, validity=col.validity,
+               dict_enc=DictEnc(codes, pool=sub)),
+        sub)
+
+
+def mask_dict_column(key: bytes, col: Column) -> Column:
     """HMAC a dictionary-encoded column by hashing its value POOL once and
     keeping the row codes — O(unique) hash instead of O(rows), and the
     hashed pool memoizes on the shared DictPool so batches slicing the
     same dictionary hash it exactly once.  Output bytes are identical to
     the flat path: valid rows get the 64-char hex of their value; null
     rows get empty bytes (the pool's null sentinel hexes to empty, or an
-    appended entry when the pool carries no sentinel).  Returns None when
-    the pool is so much larger than the batch that flat row hashing is
-    cheaper (no memo hit and n_values >> n_rows)."""
+    appended entry when the pool carries no sentinel).  When the pool is
+    much larger than the batch (no memo hit and n_values >> n_rows) only
+    the REFERENCED subset hashes — the column never falls through to
+    flat per-row hashing either way."""
     enc = col.dict_enc
     pool = enc.pool
     memo_key = ("hmac_hex", key)
@@ -173,7 +218,7 @@ def mask_dict_column(key: bytes, col: Column) -> Optional[Column]:
         # unless it is shared (then the memo amortizes it — but we can't
         # know the future; 2x covers the filtered-batch case)
         if pool.n_values > 2 * max(col.n_rows, 1):
-            return None
+            return _mask_dict_subset(key, col)
         pool_hex, pool_hex_off = _host_hmac_hex(
             key, pool.values_data, pool.values_offsets, None)
         hexed = hexed_pool_from_flat(pool, pool_hex, pool_hex_off)
@@ -215,9 +260,7 @@ class MaskField(Transformer):
 
     def _mask_column(self, col: Column) -> Column:
         if col.is_lazy_dict and _hash_backend is None:
-            out = mask_dict_column(self.key, col)
-            if out is not None:
-                return out
+            return mask_dict_column(self.key, col)
         if col.offsets is None:
             # stringify fixed-width values, then hash
             strs = [
