@@ -1244,6 +1244,201 @@ def _trace_out_path() -> str:
     return out
 
 
+# -- regression gate (--against) ---------------------------------------------
+
+# every metric line printed this run (tail diagnostics + headline):
+# the --against gate compares THESE against a prior bench artifact
+_METRICS_EMITTED: list[dict] = []
+
+
+def _emit(obj: dict) -> None:
+    """One '#'-prefixed stderr metric line, remembered for --against."""
+    _METRICS_EMITTED.append(obj)
+    print(f"# {json.dumps(obj)}", file=sys.stderr)
+
+
+# metrics where smaller is the improvement (latencies); everything else
+# is a throughput/ratio where bigger is better
+_LOWER_IS_BETTER = ("_ms", "_ms_per_batch")
+
+# default band: a candidate may be up to this fraction WORSE than the
+# prior before the gate trips
+DEFAULT_TOLERANCE = 0.15
+
+# per-metric bands for the known-noisy lines (tunneled-link device
+# numbers swing with RTT; the fake-wire configs are scheduling-bound on
+# the 1-core bench boxes)
+TOLERANCE_OVERRIDES = {
+    "device_mask_kernel_rows_per_sec": 0.5,
+    "device_decode_rows_per_sec": 0.5,
+    "device_fingerprint_rows_per_sec": 0.5,
+    "mesh1_fused_ms_per_batch": 0.6,
+    "kafka2ch_transform_p99_ms": 0.6,
+    "kafka_sr64_2ch_rows_per_sec": 0.4,
+    "mysql2kafka_debezium_rows_per_sec": 0.4,
+    "pg2ch_snapshot_rows_per_sec": 0.4,
+    "fleet_transfers_per_sec": 0.4,
+}
+
+
+def load_bench_metrics(path: str) -> dict[str, dict]:
+    """{metric_name: metric_obj} out of a bench artifact.
+
+    Accepts all three shapes the repo carries: a driver-captured
+    BENCH_rNN.json wrapper (`{"tail": "...log lines..."}`), a raw bench
+    log (stderr '#' lines + the stdout headline), or a JSON-lines file
+    of metric objects.  The LAST occurrence of a metric wins (the
+    headline prints early as a crash-safety copy, then final)."""
+    with open(path) as fh:
+        text = fh.read()
+    out: dict[str, dict] = {}
+
+    def take(obj) -> None:
+        if isinstance(obj, dict) and isinstance(obj.get("metric"), str):
+            out[obj["metric"]] = obj
+
+    lines = text.splitlines()
+    try:
+        doc = json.loads(text)
+    except ValueError:
+        doc = None
+    if isinstance(doc, dict):
+        take(doc)
+        if isinstance(doc.get("tail"), str):
+            lines = doc["tail"].splitlines()
+        else:
+            lines = []
+    elif isinstance(doc, list):
+        for it in doc:
+            take(it)
+        lines = []
+    for ln in lines:
+        ln = ln.strip()
+        if ln.startswith("#"):
+            ln = ln.lstrip("# ").strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            take(json.loads(ln))
+        except ValueError:
+            continue
+    return out
+
+
+def compare_against(prior: dict[str, dict], current: dict[str, dict],
+                    tolerance: Optional[float] = None
+                    ) -> tuple[list[dict], list[str]]:
+    """Per-metric comparison with tolerance bands.
+
+    Returns (regressions, report_lines).  Only metrics present in BOTH
+    sets with numeric nonzero prior values are gated; the rest are
+    reported as skipped so a silently-vanished metric is visible."""
+    base_tol = DEFAULT_TOLERANCE if tolerance is None else tolerance
+    regressions: list[dict] = []
+    lines: list[str] = []
+    for name in sorted(prior):
+        p = prior[name].get("value")
+        c = (current.get(name) or {}).get("value")
+        if name not in current:
+            lines.append(f"{name}: SKIP (not emitted by this run)")
+            continue
+        if not isinstance(p, (int, float)) or \
+                not isinstance(c, (int, float)) or p <= 0:
+            lines.append(f"{name}: SKIP (non-comparable values "
+                         f"{p!r} -> {c!r})")
+            continue
+        tol = max(TOLERANCE_OVERRIDES.get(name, 0.0), base_tol)
+        lower_better = name.endswith(_LOWER_IS_BETTER)
+        if lower_better and c <= 0:
+            # a 0 latency is a broken measurement, not an infinite win
+            lines.append(f"{name}: SKIP (non-comparable values "
+                         f"{p!r} -> {c!r})")
+            continue
+        ratio = (p / c) if lower_better else (c / p)
+        verdict = "OK" if ratio >= 1.0 - tol else "REGRESSION"
+        lines.append(
+            f"{name}: {p} -> {c} "
+            f"({'x' if not lower_better else '/'}{ratio:.3f} vs "
+            f"floor {1.0 - tol:.2f}) {verdict}")
+        if verdict == "REGRESSION":
+            regressions.append({
+                "metric": name, "prior": p, "current": c,
+                "ratio": round(ratio, 4), "tolerance": tol,
+                "lower_is_better": lower_better,
+            })
+    for name in sorted(set(current) - set(prior)):
+        lines.append(f"{name}: NEW (no prior value)")
+    return regressions, lines
+
+
+def run_regression_gate(against_path: str,
+                        current: dict[str, dict],
+                        tolerance: Optional[float] = None) -> int:
+    try:
+        prior = load_bench_metrics(against_path)
+    except (OSError, UnicodeDecodeError) as e:
+        print(f"# against: unreadable artifact {against_path}: {e}",
+              file=sys.stderr)
+        return 2
+    if not prior:
+        print(f"# against: no metric lines found in {against_path}",
+              file=sys.stderr)
+        return 2
+    regressions, lines = compare_against(prior, current, tolerance)
+    for ln in lines:
+        print(f"# against: {ln}", file=sys.stderr)
+    verdict = {"metric": "bench_regression_gate",
+               "ok": not regressions,
+               "against": os.path.basename(against_path),
+               "compared": sum(1 for ln in lines if "SKIP" not in ln
+                               and "NEW" not in ln),
+               "regressions": regressions}
+    print(f"# {json.dumps(verdict)}", file=sys.stderr)
+    return 1 if regressions else 0
+
+
+def _against_args() -> tuple[Optional[str], Optional[str],
+                             Optional[float]]:
+    """(--against PATH, --candidate PATH, --tolerance F) off argv.
+    With --candidate the gate compares two artifacts and never runs a
+    benchmark (the verify-skill smoke); without it the gate runs after
+    whatever bench stage argv selected, over the metrics it emitted."""
+    against = candidate = None
+    tolerance = None
+    argv = sys.argv[1:]
+    for i, a in enumerate(argv):
+        if a == "--against" and i + 1 < len(argv):
+            against = argv[i + 1]
+        elif a.startswith("--against="):
+            against = a.split("=", 1)[1]
+        elif a == "--candidate" and i + 1 < len(argv):
+            candidate = argv[i + 1]
+        elif a.startswith("--candidate="):
+            candidate = a.split("=", 1)[1]
+        elif a == "--tolerance" and i + 1 < len(argv):
+            tolerance = _parse_tolerance(argv[i + 1])
+        elif a.startswith("--tolerance="):
+            tolerance = _parse_tolerance(a.split("=", 1)[1])
+    return against, candidate, tolerance
+
+
+def _parse_tolerance(raw: str) -> float:
+    """Bad input exits 2 (unusable input), NOT 1 — a CI wrapper keying
+    on the gate's exit codes must never read a flag typo as a perf
+    regression."""
+    try:
+        tol = float(raw)
+    except ValueError:
+        print(f"# against: invalid --tolerance {raw!r} "
+              f"(want a fraction like 0.15)", file=sys.stderr)
+        raise SystemExit(2)
+    if tol < 0:
+        print(f"# against: --tolerance must be >= 0, got {tol}",
+              file=sys.stderr)
+        raise SystemExit(2)
+    return tol
+
+
 def measure_dispatch() -> dict:
     """`--dispatch`: the compressed dispatch plane's micro-bench —
     identical dict-heavy mask+filter batches (the clickbench URL shape:
@@ -1477,18 +1672,65 @@ def measure_fleet() -> dict:
     )
 
 
-def main() -> None:
+def main() -> int:
     from transferia_tpu.stats import stagetimer
 
+    against, candidate, tolerance = _against_args()
+    if against and candidate:
+        # pure compare mode: two artifacts, no benchmark run — the
+        # verify-skill smoke and ad-hoc "did rNN regress vs rMM" checks
+        try:
+            cand = load_bench_metrics(candidate)
+        except (OSError, UnicodeDecodeError) as e:
+            print(f"# against: unreadable artifact {candidate}: {e}",
+                  file=sys.stderr)
+            return 2
+        if not cand:
+            # a truncated/empty candidate would turn every prior
+            # metric into a SKIP and pass the gate — a run that
+            # emitted nothing is unusable input, not a clean bill
+            print(f"# against: no metric lines found in {candidate}",
+                  file=sys.stderr)
+            return 2
+        return run_regression_gate(against, cand, tolerance)
+
+    def gated(rc: int = 0) -> int:
+        if against:
+            grc = run_regression_gate(
+                against, {m["metric"]: m for m in _METRICS_EMITTED},
+                tolerance)
+            return rc or grc
+        return rc
+
     if "--fleet" in sys.argv[1:]:
-        # standalone stage: scheduler latency + fairness (one JSON line)
+        # standalone stage: scheduler latency + fairness (one JSON
+        # line).  --trace[=path]/BENCH_TRACE wraps the whole fleet run
+        # in a capture: with causal propagation on, one transfer's
+        # admission → queue-wait → dispatch → parts → device work
+        # exports as a single linked timeline
         from transferia_tpu.fleet.bench import format_report as _fmt_fleet
 
-        report = measure_fleet()
+        trace_out = _trace_out_path()
+        if trace_out:
+            from transferia_tpu.stats import trace as _trace
+
+            _trace.reset()
+            _trace.enable(True)
+        try:
+            report = measure_fleet()
+        finally:
+            if trace_out:
+                from transferia_tpu.stats import trace as _trace
+
+                _trace.enable(False)
+                n_events = _trace.write_chrome_trace(trace_out)
+                print(f"# trace: {n_events} events -> {trace_out}",
+                      file=sys.stderr)
         for line in _fmt_fleet(report).splitlines():
             print(f"# {line}", file=sys.stderr)
+        _METRICS_EMITTED.append(report)
         print(json.dumps(report))
-        return
+        return gated(0 if report["ok"] else 1)
 
     if "--interchange" in sys.argv[1:]:
         # standalone stage: one stdout JSON line, diagnostics on stderr
@@ -1497,8 +1739,9 @@ def main() -> None:
         report = measure_interchange()
         for line in format_report(report).splitlines():
             print(f"# {line}", file=sys.stderr)
+        _METRICS_EMITTED.append(report)
         print(json.dumps(report))
-        return
+        return gated()
 
     if "--checksum-dict" in sys.argv[1:]:
         # standalone stage: flat vs code-native fingerprint (one JSON
@@ -1510,8 +1753,9 @@ def main() -> None:
               f"({report['speedup_vs_flat']}x), "
               f"flat_materializations="
               f"{report['dict_flat_materializations']}", file=sys.stderr)
+        _METRICS_EMITTED.append(report)
         print(json.dumps(report))
-        return
+        return gated()
 
     if "--dispatch" in sys.argv[1:]:
         # standalone stage: encoded vs raw H2D dispatch (one JSON line)
@@ -1520,8 +1764,9 @@ def main() -> None:
               f"{report['raw_rows_per_sec']} rows/s "
               f"({report['speedup_vs_raw']}x), compression "
               f"{report['compression_ratio']}x", file=sys.stderr)
+        _METRICS_EMITTED.append(report)
         print(json.dumps(report))
-        return
+        return gated()
 
     fallback = None
     if not _device_available():
@@ -1538,7 +1783,9 @@ def main() -> None:
             }))
             print("# jax backend already initialized and TPU wedged; "
                   "cannot fall back in-process", file=sys.stderr)
-            return
+            # 2, not 1: the regression gate reserves 1 for a real perf
+            # regression; a dead runtime is unusable environment
+            return 2
         print("# TPU runtime unavailable after retries; measuring on the "
               "host pipeline (CPU) as a labeled diagnostic fallback",
               file=sys.stderr)
@@ -1644,8 +1891,10 @@ def main() -> None:
         f"{lat_note} dataset={WIDE_PARQUET}",
         file=sys.stderr,
     )
-    print(f"# {json.dumps({'metric': 'clickbench10_snapshot_rows_per_sec', 'value': round(rows10 / dt10), 'unit': 'rows/sec', 'rows': rows10, 'note': 'r01-r03 continuity dataset (10 cols)'})}",
-          file=sys.stderr)
+    _emit({'metric': 'clickbench10_snapshot_rows_per_sec',
+           'value': round(rows10 / dt10), 'unit': 'rows/sec',
+           'rows': rows10,
+           'note': 'r01-r03 continuity dataset (10 cols)'})
     if stage_note:
         print(f"# stages: {stage_note}", file=sys.stderr)
     if prof.report is not None and prof.report.samples:
@@ -1671,14 +1920,14 @@ def main() -> None:
         try:
             kern = measure_device_kernel()
             if kern:
-                print(f"# {json.dumps(kern)}", file=sys.stderr)
+                _emit(kern)
         except Exception as e:
             print(f"# device kernel bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
         try:
             dk = measure_device_decode()
             if dk:
-                print(f"# {json.dumps(dk)}", file=sys.stderr)
+                _emit(dk)
         except Exception as e:
             print(f"# device decode bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
@@ -1689,14 +1938,14 @@ def main() -> None:
             dfp = _run_isolated("measure_device_fingerprint",
                                 timeout=300)
             if dfp:
-                print(f"# {json.dumps(dfp)}", file=sys.stderr)
+                _emit(dfp)
         except Exception as e:
             print(f"# device fingerprint bench failed: "
                   f"{type(e).__name__}: {e}", file=sys.stderr)
         try:
             mesh1 = measure_mesh_1dev()
             if mesh1:
-                print(f"# {json.dumps(mesh1)}", file=sys.stderr)
+                _emit(mesh1)
         except Exception as e:
             print(f"# mesh 1-dev bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
@@ -1705,7 +1954,7 @@ def main() -> None:
         if fprint:
             if fallback:
                 fprint["fallback"] = fallback
-            print(f"# {json.dumps(fprint)}", file=sys.stderr)
+            _emit(fprint)
     except Exception as e:
         print(f"# fingerprint bench failed: {type(e).__name__}: {e}",
               file=sys.stderr)
@@ -1714,14 +1963,14 @@ def main() -> None:
             cdict = measure_checksum_dict()
             if fallback:
                 cdict["fallback"] = fallback
-            print(f"# {json.dumps(cdict)}", file=sys.stderr)
+            _emit(cdict)
         except Exception as e:
             print(f"# checksum-dict bench failed: {type(e).__name__}: "
                   f"{e}", file=sys.stderr)
     if os.environ.get("BENCH_SKIP_INTERCHANGE") != "1":
         try:
             ichg = measure_interchange()
-            print(f"# {json.dumps(ichg)}", file=sys.stderr)
+            _emit(ichg)
         except Exception as e:
             print(f"# interchange bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
@@ -1730,7 +1979,7 @@ def main() -> None:
             disp = measure_dispatch()
             if fallback:
                 disp["fallback"] = fallback
-            print(f"# {json.dumps(disp)}", file=sys.stderr)
+            _emit(disp)
         except Exception as e:
             print(f"# dispatch bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
@@ -1741,7 +1990,7 @@ def main() -> None:
             k2ch = measure_kafka2ch()
             if fallback:
                 k2ch["fallback"] = fallback
-            print(f"# {json.dumps(k2ch)}", file=sys.stderr)
+            _emit(k2ch)
         except Exception as e:
             print(f"# kafka2ch bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
@@ -1753,12 +2002,14 @@ def main() -> None:
                 out = fn()
                 if fallback:
                     out["fallback"] = fallback
-                print(f"# {json.dumps(out)}", file=sys.stderr)
+                _emit(out)
             except Exception as e:
                 print(f"# {name} bench failed: "
                       f"{type(e).__name__}: {e}", file=sys.stderr)
     # the ONE stdout JSON line, last so tail-capture always records it
+    _METRICS_EMITTED.append(result)
     print(json.dumps(result))
+    return gated()
 
 
 def _effective_cpus() -> float:
@@ -1779,4 +2030,4 @@ def _dataset_cols(path: str) -> Optional[int]:
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main() or 0)

@@ -643,6 +643,115 @@ class TestFailpointContract:
             [f.format() for f in result.findings]
 
 
+class TestTraceContract:
+    """TRC001: every failpoint site's enclosing function must open a
+    span or emit a trace instant so chaos fires land on a timeline."""
+
+    def _findings(self, sources: dict[str, str], allow=()):
+        from transferia_tpu.analysis.rules import TraceContractRule
+
+        rule = TraceContractRule()
+        rule.allow_untraced = frozenset(allow)
+        files = {}
+        for path, src in sources.items():
+            src = textwrap.dedent(src)
+            files[path] = (ast.parse(src), src.splitlines())
+        return rule.check_project("/tmp", files)
+
+    def test_untraced_function_flagged(self):
+        found = self._findings({"transferia_tpu/a.py": """
+            def naked():
+                failpoint("some.site")
+        """})
+        assert len(found) == 1
+        assert "opens no span" in found[0].message
+        assert found[0].rule == "TRC001"
+
+    def test_span_in_function_passes(self):
+        found = self._findings({"transferia_tpu/a.py": """
+            def covered():
+                failpoint("some.site")
+                with trace.span("work"):
+                    pass
+        """})
+        assert found == [], [f.message for f in found]
+
+    def test_instant_in_function_passes(self):
+        found = self._findings({"transferia_tpu/a.py": """
+            def covered(t):
+                failpoint("some.site")
+                trace.instant("fired", at=t)
+        """})
+        assert found == []
+
+    def test_retroactive_complete_passes(self):
+        found = self._findings({"transferia_tpu/a.py": """
+            def covered(t0, dur):
+                failpoint("some.site")
+                trace.complete("wait", t0=t0, dur=dur)
+        """})
+        assert found == []
+
+    def test_adopted_alone_does_not_pass(self):
+        # adoption records nothing — the fire still needs a local
+        # span/instant for the timeline to show where it landed
+        found = self._findings({"transferia_tpu/a.py": """
+            def adopted_only(ctx):
+                with trace.adopted(ctx):
+                    failpoint("some.site")
+        """})
+        assert len(found) == 1
+
+    def test_torn_rows_sites_also_checked(self):
+        found = self._findings({"transferia_tpu/a.py": """
+            def naked(n):
+                return torn_rows("some.site", n)
+        """})
+        assert len(found) == 1
+
+    def test_module_level_site_flagged(self):
+        found = self._findings({"transferia_tpu/a.py":
+                                'failpoint("some.site")\n'})
+        assert len(found) == 1
+        assert "module level" in found[0].message
+
+    def test_chaos_and_tests_exempt(self):
+        found = self._findings({
+            "transferia_tpu/chaos/runner.py": """
+                def drive():
+                    failpoint("some.site")
+            """,
+            "tests/unit/test_x.py": """
+                def test_y():
+                    failpoint("some.site")
+            """,
+        })
+        assert found == []
+
+    def test_allowlist_suppresses(self):
+        found = self._findings({"transferia_tpu/a.py": """
+            def naked():
+                failpoint("allowed.site")
+        """}, allow=("allowed.site",))
+        assert found == []
+
+    def test_non_literal_sites_left_to_fpt001(self):
+        found = self._findings({"transferia_tpu/a.py": """
+            def naked(site):
+                failpoint(site)
+        """})
+        assert found == []
+
+    def test_real_tree_holds_contract(self):
+        from transferia_tpu.analysis.rules import TraceContractRule
+
+        result = run_rules(["transferia_tpu"],
+                           [TraceContractRule()],
+                           root=_repo_root())
+        assert result.findings == [], \
+            [f.format() for f in result.findings]
+
+
 @pytest.mark.slow
 class TestWholeTree:
     def test_tree_is_clean_under_committed_baseline(self):

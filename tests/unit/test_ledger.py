@@ -1,0 +1,254 @@
+"""Per-transfer resource ledger (stats/ledger.py): contextvar scoping,
+thread adoption, cardinality bounds, prometheus folds, the `trtpu top`
+rendering, and the conservation invariant against `DeviceTelemetry` —
+including under 4 concurrent real snapshot transfers.
+"""
+
+import threading
+
+from transferia_tpu.stats.ledger import (
+    LEDGER,
+    LedgerKey,
+    ResourceLedger,
+    UNATTRIBUTED,
+    format_top,
+)
+from transferia_tpu.stats.trace import TELEMETRY
+
+
+def setup_function(_fn):
+    LEDGER.reset()
+    TELEMETRY.reset()
+
+
+def teardown_function(_fn):
+    LEDGER.reset()
+    TELEMETRY.reset()
+
+
+# -- scoping -----------------------------------------------------------------
+
+def test_scope_attributes_and_inherits():
+    with LEDGER.context(transfer_id="t1", tenant="acme"):
+        LEDGER.add(rows_in=10)
+        # narrowing to a part inherits transfer+tenant
+        with LEDGER.context(part="ns.t/0"):
+            key = LEDGER.current_key()
+            assert key == LedgerKey("t1", "acme", "ns.t/0")
+            LEDGER.add(rows_out=7)
+        # scope restored on exit
+        assert LEDGER.current_key() == LedgerKey("t1", "acme",
+                                                 UNATTRIBUTED)
+    assert LEDGER.current_key() is None
+    snap = LEDGER.snapshot()
+    tr = snap["transfers"]["t1"]
+    assert tr["rows_in"] == 10 and tr["rows_out"] == 7
+    assert tr["tenant"] == "acme"
+    assert snap["tenants"]["acme"]["transfers"] == 1
+
+
+def test_unscoped_work_lands_in_unattributed_bucket():
+    LEDGER.add(rows_in=5)
+    snap = LEDGER.snapshot()
+    assert snap["transfers"][UNATTRIBUTED]["rows_in"] == 5
+
+
+def test_add_for_explicit_key():
+    LEDGER.add_for("tX", tenant="tn", retries=2)
+    assert LEDGER.snapshot()["transfers"]["tX"]["retries"] == 2
+
+
+def test_adopted_carries_scope_across_threads():
+    got = {}
+
+    with LEDGER.context(transfer_id="t1", tenant="acme"):
+        key = LEDGER.current_key()
+
+    def worker():
+        # no ambient scope on this thread until adoption
+        assert LEDGER.current_key() is None
+        with LEDGER.adopted(key):
+            LEDGER.add(bytes_out=64)
+            got["key"] = LEDGER.current_key()
+        assert LEDGER.current_key() is None
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert got["key"] == key
+    assert LEDGER.snapshot()["transfers"]["t1"]["bytes_out"] == 64
+
+
+# -- cardinality bound -------------------------------------------------------
+
+def test_overflow_folds_preserve_totals():
+    led = ResourceLedger(max_entries=8)
+    for i in range(20):
+        led.add_for(f"t{i:02d}", tenant="acme", rows_out=1,
+                    bytes_out=100)
+    snap = led.snapshot()
+    assert snap["entries"] <= 8
+    assert snap["overflow_folded"] > 0
+    # conservation of totals: nothing vanished in the folds
+    assert snap["totals"]["rows_out"] == 20
+    assert snap["totals"]["bytes_out"] == 2000
+    # shed detail landed in the tenant's ~overflow entry
+    assert "~overflow" in snap["transfers"]
+    assert snap["transfers"]["~overflow"]["rows_out"] > 0
+
+
+# -- conservation ------------------------------------------------------------
+
+def test_device_telemetry_routes_through_ledger():
+    with LEDGER.context(transfer_id="t1", tenant="acme"):
+        TELEMETRY.record_h2d(1000)
+        TELEMETRY.record_d2h(500)
+        TELEMETRY.record_launch(3)
+        TELEMETRY.record_dispatch(100, 800)
+        TELEMETRY.record_compile(0.5)
+    snap = LEDGER.snapshot()
+    tr = snap["transfers"]["t1"]
+    assert tr["h2d_bytes"] == 1000 and tr["d2h_bytes"] == 500
+    assert tr["launches"] == 3 and tr["compiles"] == 1
+    assert tr["h2d_encoded_bytes"] == 100
+    assert tr["h2d_raw_equiv_bytes"] == 800
+    cons = snap["conservation"]
+    assert cons["ok"], cons
+    for field in ("h2d_bytes", "d2h_bytes", "launches", "compiles"):
+        assert cons[field]["drift"] == 0
+
+
+def test_conservation_detects_drift():
+    # a telemetry bump recorded while the ledger was reset is exactly
+    # the drift the reconciliation exists to expose
+    TELEMETRY.record_h2d(1000)
+    LEDGER.reset()
+    cons = LEDGER.conservation()
+    assert not cons["ok"]
+    assert cons["h2d_bytes"]["drift"] == 1000
+
+
+def test_conservation_under_four_concurrent_transfers():
+    """Four real sample->memory snapshots on four threads: per-transfer
+    attribution is exact, and the ledger's totals reconcile with the
+    global DeviceTelemetry counters."""
+    from transferia_tpu.coordinator.memory import MemoryCoordinator
+    from transferia_tpu.models import Transfer, TransferType
+    from transferia_tpu.providers.memory import (
+        MemoryTargetParams,
+        get_store,
+    )
+    from transferia_tpu.providers.sample import SampleSourceParams
+    from transferia_tpu.stats.registry import Metrics
+    from transferia_tpu.tasks.snapshot import SnapshotLoader
+
+    rows = 200
+    cp = MemoryCoordinator()
+    errors = []
+
+    def one(i):
+        sink_id = f"ledger-cons-{i}"
+        get_store(sink_id).clear()
+        t = Transfer(
+            id=f"led-t{i}", type=TransferType.SNAPSHOT_ONLY,
+            src=SampleSourceParams(preset="iot", table="events",
+                                   rows=rows, batch_rows=64),
+            dst=MemoryTargetParams(sink_id=sink_id))
+        t.runtime.sharding.process_count = 1
+        try:
+            # the fleet lane sets the tenant; SnapshotLoader's own
+            # scope narrows to the transfer id underneath it
+            with LEDGER.context(tenant=f"tn{i % 2}"):
+                SnapshotLoader(t, cp, metrics=Metrics()).upload_tables()
+        except BaseException as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=one, args=(i,))
+               for i in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    snap = LEDGER.snapshot()
+    for i in range(4):
+        tr = snap["transfers"][f"led-t{i}"]
+        assert tr["rows_out"] == rows, tr
+        assert tr["rows_in"] == rows, tr
+        assert tr["tenant"] == f"tn{i % 2}"
+    assert snap["tenants"]["tn0"]["transfers"] == 2
+    assert snap["tenants"]["tn0"]["rows_out"] == 2 * rows
+    assert snap["conservation"]["ok"], snap["conservation"]
+
+
+# -- prometheus fold ---------------------------------------------------------
+
+def test_fold_into_metrics_bounded_and_idempotent():
+    from transferia_tpu.stats.registry import Metrics
+
+    led = ResourceLedger(max_entries=64)
+    led.add_for("t1", tenant="acme", rows_out=10, bytes_out=1000)
+    led.add_for("t2", tenant="bee-corp", rows_out=5, bytes_out=200)
+    m = Metrics()
+    led.fold_into(m)
+    assert m.value("ledger_rows_out") == 15
+    assert m.value("ledger_bytes_out") == 1200
+    assert m.value("ledger_tenant_acme_rows_out") == 10
+    assert m.value("ledger_tenant_bee_corp_rows_out") == 5
+    assert m.value("ledger_entries") == 2
+    # idempotent per target: a second fold adds nothing
+    led.fold_into(m)
+    assert m.value("ledger_rows_out") == 15
+    led.add_for("t1", tenant="acme", rows_out=1)
+    led.fold_into(m)
+    assert m.value("ledger_rows_out") == 16
+
+
+def test_fold_caps_per_tenant_series():
+    from transferia_tpu.stats.ledger import MAX_PROM_TENANTS
+    from transferia_tpu.stats.registry import Metrics
+
+    led = ResourceLedger(max_entries=4096)
+    for i in range(MAX_PROM_TENANTS + 10):
+        led.add_for(f"t{i}", tenant=f"tenant{i:03d}", bytes_out=i + 1)
+    m = Metrics()
+    led.fold_into(m)
+    # top-by-bytes_out tenants get named series; the tail does not
+    # (Metrics.value reads 0.0 for a never-registered series)
+    top = MAX_PROM_TENANTS + 9  # highest bytes_out
+    assert m.value(f"ledger_tenant_tenant{top:03d}_bytes_out") == top + 1
+    assert m.value("ledger_tenant_tenant000_bytes_out") == 0.0
+    # the aggregate still includes everyone
+    total = sum(i + 1 for i in range(MAX_PROM_TENANTS + 10))
+    assert m.value("ledger_bytes_out") == total
+
+
+# -- trtpu top rendering -----------------------------------------------------
+
+def test_format_top_renders_transfers_and_tenants():
+    led = ResourceLedger(max_entries=64)
+    led.add_for("transfer-big", tenant="acme", rows_in=100,
+                rows_out=90, bytes_in=5_000_000, bytes_out=4_000_000,
+                h2d_bytes=1_000_000, launches=4, retries=1)
+    led.add_for("transfer-small", tenant="bee", rows_out=5)
+    out = format_top(led.snapshot(), limit=10)
+    assert "transfer-big" in out
+    assert "acme" in out
+    assert "conservation" in out
+    # header row present
+    assert "rows_in" in out and "h2d_mb" in out
+
+
+def test_debug_ledger_endpoint_round_trip():
+    import json
+    import urllib.request
+
+    from transferia_tpu.cli.main import _start_health_server
+
+    LEDGER.add_for("t-ep", tenant="acme", rows_out=3)
+    port = _start_health_server(0)
+    body = urllib.request.urlopen(
+        f"http://127.0.0.1:{port}/debug/ledger", timeout=10).read()
+    doc = json.loads(body)
+    assert doc["transfers"]["t-ep"]["rows_out"] == 3
+    assert "conservation" in doc

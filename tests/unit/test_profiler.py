@@ -63,3 +63,86 @@ def test_debug_profile_endpoint():
     th.join()
     assert "location" in body
     assert "_burn" in body
+
+
+# -- native-frame attribution ------------------------------------------------
+
+def test_native_call_marker_scoped_and_reentrant():
+    from transferia_tpu.stats.profiler import active_native, native_call
+
+    ident = threading.get_ident()
+    assert active_native(ident) is None
+    with native_call("outer_sym"):
+        assert active_native(ident) == "outer_sym"
+        with native_call("inner_sym"):
+            assert active_native(ident) == "inner_sym"
+        assert active_native(ident) == "outer_sym"
+    assert active_native(ident) is None
+
+
+def test_sampler_tags_native_bound_frames():
+    """A sample landing while the thread is inside a (marked) native
+    call must blame the tagged native symbol, not the caller's Python
+    line — the mis-attribution that inflated mask.py:104 with pure C++
+    time in BENCH_r05."""
+    from transferia_tpu.stats.profiler import NATIVE_TAG, native_call
+
+    stop = threading.Event()
+
+    def burner():
+        with native_call("hmac_sha256_hex"):
+            x = 0
+            while not stop.is_set():
+                x += 1
+
+    th = threading.Thread(target=burner, name="native-burner")
+    th.start()
+    try:
+        s = Sampler(hz=250, threads={th.ident}).start()
+        time.sleep(0.4)
+        rep = s.stop()
+    finally:
+        stop.set()
+        th.join()
+    tagged = [loc for loc in rep.self_counts
+              if NATIVE_TAG in loc and "hmac_sha256_hex" in loc]
+    assert tagged, dict(rep.self_counts)
+    # the caller context is preserved after the tag, not lost
+    assert any("burner" in loc for loc in tagged)
+
+
+def test_profiled_lib_proxy_marks_calls_and_forwards():
+    from transferia_tpu.native import _ProfiledLib
+    from transferia_tpu.stats.profiler import active_native
+
+    class _FakeCdll:
+        version = 7
+
+    fake = _FakeCdll()
+    seen = {}
+
+    def myfn(x):
+        seen["during"] = active_native(threading.get_ident())
+        return x + 1
+
+    fake.myfn = myfn
+    lib = _ProfiledLib(fake)
+    assert lib.version == 7           # non-callables pass through
+    assert lib.myfn(41) == 42         # calls forward
+    assert seen["during"] == "myfn"   # marker live DURING the call
+    assert active_native(threading.get_ident()) is None  # and cleared
+    assert hasattr(lib, "myfn")
+    assert not hasattr(lib, "no_such_symbol")  # optional-symbol probes
+    assert lib.myfn is lib.myfn       # wrapper cached
+
+
+def test_real_native_lib_is_proxied_when_present():
+    from transferia_tpu.native import _ProfiledLib, lib
+
+    cdll = lib()
+    if cdll is None:
+        import pytest
+
+        pytest.skip("native hostops unavailable in this environment")
+    assert isinstance(cdll, _ProfiledLib)
+    assert hasattr(cdll, "polyhash_varcol")

@@ -15,6 +15,7 @@ from transferia_tpu.analysis.rules.registry_contract import (
     RegistryContractRule,
 )
 from transferia_tpu.analysis.rules.resource_safety import ResourceSafetyRule
+from transferia_tpu.analysis.rules.trace_contract import TraceContractRule
 
 ALL_RULE_CLASSES: tuple[type, ...] = (
     DevicePurityRule,
@@ -23,6 +24,7 @@ ALL_RULE_CLASSES: tuple[type, ...] = (
     ResourceSafetyRule,
     RegistryContractRule,
     FailpointContractRule,
+    TraceContractRule,
 )
 
 
@@ -39,4 +41,5 @@ __all__ = [
     "FailpointContractRule",
     "ResourceSafetyRule",
     "RegistryContractRule",
+    "TraceContractRule",
 ]

@@ -281,6 +281,19 @@ def activate_from_env(environ=os.environ) -> bool:
 
 # -- the call-site API -------------------------------------------------------
 
+def _record_fire(name: str, fp: Failpoint) -> None:
+    """A site fired: land a trace instant ON the active span (the
+    chaos plane stays visible in causal timelines — a kill trial's
+    injected fault shows up inside the exact span it perturbed) and
+    bill the ambient ledger scope's chaos_fires."""
+    from transferia_tpu.stats import trace
+    from transferia_tpu.stats.ledger import LEDGER
+
+    trace.instant("chaos_fire", site=name, action=fp.action,
+                  fire=fp.fires, hit=fp.hits)
+    LEDGER.add(chaos_fires=1)
+
+
 def failpoint(name: str) -> None:
     """The injection site.  Disabled: one module-flag check, return.
     Enabled: evaluate the site's trigger; on fire, raise the armed error
@@ -293,6 +306,7 @@ def failpoint(name: str) -> None:
         return
     if not fp.should_fire():
         return
+    _record_fire(name, fp)
     if fp.action == "delay":
         time.sleep(fp.arg)
         return
@@ -312,6 +326,7 @@ def torn_rows(name: str, n_rows: int) -> Optional[int]:
         return None
     if not fp.should_fire():
         return None
+    _record_fire(name, fp)
     return min(n_rows - 1, max(1, math.ceil(fp.arg * n_rows)))
 
 

@@ -233,6 +233,24 @@ def build_parser() -> argparse.ArgumentParser:
                      help="bench: tenant-mix shuffle seed")
     flt.add_argument("--json", action="store_true", dest="as_json",
                      help="machine-readable report")
+    top = sub.add_parser(
+        "top",
+        help="live per-transfer / per-tenant resource console: polls "
+             "GET /debug/ledger on a running worker's health port and "
+             "renders who is burning rows, bytes, H2D, launches, and "
+             "wait time (stats/ledger.py)")
+    top.add_argument("--url", default="http://127.0.0.1:8080",
+                     help="health server base URL of the worker "
+                          "(--health-port)")
+    top.add_argument("--interval", type=float, default=2.0,
+                     help="seconds between frames")
+    top.add_argument("--frames", type=int, default=0,
+                     help="stop after N frames (0 = until Ctrl-C)")
+    top.add_argument("--limit", type=int, default=20,
+                     help="transfer rows per frame")
+    top.add_argument("--json", action="store_true", dest="as_json",
+                     help="print one raw /debug/ledger snapshot and "
+                          "exit")
     return p
 
 
@@ -280,15 +298,49 @@ def _start_health_server(port: int) -> int:
     import http.server
 
     class Handler(http.server.BaseHTTPRequestHandler):
+        # chunked transfer encoding (the streamed /debug/trace) needs 1.1
+        protocol_version = "HTTP/1.1"
+
         def do_GET(self):
             if self.path.startswith("/debug/trace"):
                 # span timeline capture (stats/trace.py): enables tracing
                 # for ?seconds=N (cap 60), returns Chrome trace-event
-                # JSON loadable in Perfetto / chrome://tracing
+                # JSON loadable in Perfetto / chrome://tracing.  The
+                # window runs on a helper thread with a hard deadline
+                # (503 when it blows) and the multi-MB document STREAMS
+                # as chunks — a long capture must neither pin this
+                # worker forever nor materialize 100k events in one
+                # bytes blob
                 from transferia_tpu.stats import trace
 
                 secs = _query_seconds(self.path)
-                body = json.dumps(trace.capture_seconds(secs)).encode()
+                try:
+                    doc = trace.capture_seconds(secs)
+                except TimeoutError as e:
+                    body = json.dumps({"error": str(e)}).encode()
+                    self.send_response(503)
+                    self.send_header("Content-Type", "application/json")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                for chunk in trace.iter_chrome_trace_chunks(doc):
+                    data = chunk.encode()
+                    self.wfile.write(
+                        f"{len(data):X}\r\n".encode() + data + b"\r\n")
+                self.wfile.write(b"0\r\n\r\n")
+                return
+            elif self.path.startswith("/debug/ledger"):
+                # per-transfer/per-tenant resource attribution + the
+                # conservation reconciliation (stats/ledger.py); the
+                # `trtpu top` console polls this
+                from transferia_tpu.stats.ledger import LEDGER
+
+                body = json.dumps(LEDGER.snapshot()).encode()
                 ctype = "application/json"
                 status = 200
             elif self.path.startswith("/debug/profile"):
@@ -419,6 +471,8 @@ def main(argv=None) -> int:
         return cmd_flight(args)
     if args.command == "fleet":
         return cmd_fleet(args)
+    if args.command == "top":
+        return cmd_top(args)
 
     transfer = _load_transfer(args)
     cp = _coordinator(args)
@@ -642,6 +696,7 @@ def cmd_trace(args) -> int:
     import time as _time
 
     from transferia_tpu.stats import trace
+    from transferia_tpu.stats.ledger import LEDGER
     from transferia_tpu.stats.registry import Metrics
 
     if args.transfer:
@@ -677,6 +732,7 @@ def cmd_trace(args) -> int:
         wall = _time.perf_counter() - t0
         trace.enable(False)
         trace.TELEMETRY.fold_into(metrics)  # prometheus exposure
+        LEDGER.fold_into(metrics)
         n_events = trace.write_chrome_trace(args.out)
         print(f"trace: {n_events} events -> {args.out} "
               f"(open in https://ui.perfetto.dev or chrome://tracing)")
@@ -797,6 +853,48 @@ def cmd_fleet(args) -> int:
     else:
         print(format_report(report))
     return 0 if report["ok"] else 1
+
+
+def cmd_top(args) -> int:
+    """Live resource console over GET /debug/ledger (stats/ledger.py
+    format_top): one frame per --interval, ANSI clear between frames on
+    a tty, plain appended frames when piped."""
+    import time as _time
+    import urllib.request
+
+    from transferia_tpu.stats.ledger import format_top
+
+    url = args.url.rstrip("/") + "/debug/ledger"
+    frames = 0
+    try:
+        while True:
+            try:
+                with urllib.request.urlopen(url, timeout=10) as resp:
+                    snap = json.loads(resp.read())
+            except (OSError, ValueError) as e:
+                # ValueError: a 200 that isn't our JSON (wrong service
+                # or a proxy splash page on the port)
+                print(f"trtpu top: {url}: {e}", file=sys.stderr)
+                return 2
+            if not isinstance(snap, dict) or "totals" not in snap:
+                # valid JSON but not a ledger snapshot: same wrong-
+                # service story as a parse failure, same exit
+                print(f"trtpu top: {url}: response is not a "
+                      f"/debug/ledger snapshot (wrong service?)",
+                      file=sys.stderr)
+                return 2
+            if args.as_json:
+                print(json.dumps(snap, indent=1))
+                return 0
+            if frames and sys.stdout.isatty():
+                print("\x1b[2J\x1b[H", end="")
+            print(format_top(snap, limit=args.limit), flush=True)
+            frames += 1
+            if args.frames and frames >= args.frames:
+                return 0
+            _time.sleep(max(0.2, args.interval))
+    except KeyboardInterrupt:
+        return 0
 
 
 def cmd_validate(args) -> int:

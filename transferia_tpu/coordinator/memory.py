@@ -28,6 +28,7 @@ from transferia_tpu.coordinator.interface import (
     default_lease_seconds,
     lease_expired,
 )
+from transferia_tpu.stats import trace
 
 # bounded health history: long operations heartbeat for hours — keep the
 # latest report per (scope, worker) plus a small rolling window, not an
@@ -106,7 +107,10 @@ class MemoryCoordinator(Coordinator):
     def set_transfer_state(self, transfer_id: str,
                            state: dict[str, Any]) -> None:
         failpoint("coordinator.set_state")  # before the lock: may sleep
-        with self._lock:
+        # span covers the lock wait too: coordinator contention under a
+        # 100-transfer fleet shows up as coord_set_state time
+        with trace.span("coord_set_state", transfer=transfer_id), \
+                self._lock:
             self._state.setdefault(transfer_id, {}).update(state)
 
     def get_transfer_state(self, transfer_id: str) -> dict[str, Any]:
@@ -125,7 +129,8 @@ class MemoryCoordinator(Coordinator):
                             state: dict[str, Any]) -> None:
         failpoint("coordinator.set_op_state")  # before the lock: may sleep
         op = self._op(operation_id)
-        with op.lock:
+        with trace.span("coord_set_op_state", operation=operation_id), \
+                op.lock:
             op.state.update(state)
 
     def get_operation_state(self, operation_id: str) -> dict[str, Any]:

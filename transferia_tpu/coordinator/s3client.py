@@ -97,8 +97,13 @@ class S3Client:
                  extra_headers: Optional[dict[str, str]] = None
                  ) -> tuple[int, dict, bytes]:
         from transferia_tpu.chaos.failpoints import failpoint
+        from transferia_tpu.stats import trace
 
         failpoint("client.s3.request")
+        # coordinator lease renewals / CAS part claims ride this path:
+        # the span makes S3 control-plane latency attributable in the
+        # same timeline as the data plane it gates
+        sp = trace.span("s3_request", method=method, key=key)
         path = f"/{self.bucket}"
         if key:
             path += "/" + urllib.parse.quote(key, safe="/-_.~")
@@ -111,18 +116,22 @@ class S3Client:
         qs = canonical_query(query)
         url = path + (f"?{qs}" if qs else "")
         # one reconnect retry: a kept-alive connection may have gone stale
-        for attempt in (0, 1):
-            conn = self._conn()
-            try:
-                conn.request(method, url, body=body or None,
-                             headers=signed)
-                resp = conn.getresponse()
-                data = resp.read()
-                return resp.status, dict(resp.getheaders()), data
-            except (http.client.HTTPException, ConnectionError, OSError):
-                self._drop_conn()
-                if attempt:
-                    raise
+        with sp:
+            for attempt in (0, 1):
+                conn = self._conn()
+                try:
+                    conn.request(method, url, body=body or None,
+                                 headers=signed)
+                    resp = conn.getresponse()
+                    data = resp.read()
+                    if sp:
+                        sp.add(status=resp.status, bytes=len(data))
+                    return resp.status, dict(resp.getheaders()), data
+                except (http.client.HTTPException, ConnectionError,
+                        OSError):
+                    self._drop_conn()
+                    if attempt:
+                        raise
 
     # -- object ops ---------------------------------------------------------
     def put(self, key: str, body: bytes,

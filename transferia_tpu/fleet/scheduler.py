@@ -60,6 +60,8 @@ from typing import Any, Callable, Optional
 from transferia_tpu.abstract.errors import is_worker_kill
 from transferia_tpu.chaos.failpoints import failpoint
 from transferia_tpu.fleet.backpressure import BackpressureController
+from transferia_tpu.stats import trace
+from transferia_tpu.stats.ledger import LEDGER
 from transferia_tpu.stats.registry import FleetStats, Metrics
 
 logger = logging.getLogger(__name__)
@@ -104,8 +106,14 @@ class FleetTransfer:
     shed_reason: str = ""
     error: Optional[BaseException] = None
     submitted_at: float = 0.0
+    queued_at: float = 0.0         # last time the ticket entered a queue
     dispatched_at: float = 0.0
     finished_at: float = 0.0
+    # causal anchor: the admission span's context — every later
+    # lifecycle span/instant of this ticket (queue wait, dispatch,
+    # run, rebalance, kill) links to it, so the whole lifecycle is ONE
+    # trace no matter which lane thread touches the ticket
+    trace_ctx: Optional["trace.SpanContext"] = None
 
     @property
     def charged_cost(self) -> int:
@@ -113,9 +121,13 @@ class FleetTransfer:
 
     @property
     def dispatch_latency(self) -> float:
-        """Queue wait: admission -> dispatch decision (seconds)."""
-        if self.dispatched_at and self.submitted_at:
-            return self.dispatched_at - self.submitted_at
+        """Queue wait of the CURRENT attempt: last enqueue -> dispatch
+        decision (seconds).  queued_at resets on every requeue
+        (rebalance, transient fault, retry) so a rerun never bills the
+        prior attempt's wait or run time as queue wait."""
+        start = self.queued_at or self.submitted_at
+        if self.dispatched_at and start:
+            return self.dispatched_at - start
         return 0.0
 
 
@@ -293,34 +305,48 @@ class FleetScheduler:
         admission RPC itself fails (the `fleet.admit` chaos site) —
         callers retry, exactly as they would a coordinator call."""
         failpoint("fleet.admit")
-        # read the data-plane gauges OUTSIDE the scheduler lock: the
-        # controller takes its own lock and reads N metrics
-        hot = self.backpressure.overloaded() if self.backpressure else False
-        with self._cond:
-            tn = self._tenant_locked(ticket.tenant)
-            if tn.queued >= self.tenant_queue_quota:
-                ticket.state = "shed"
-                ticket.shed_reason = "shed-tenant-quota"
-            elif hot:
-                ticket.state = "shed"
-                ticket.shed_reason = "shed-backpressure"
-            else:
-                ticket.seq = self._seq
-                self._seq += 1
-                ticket.state = "queued"
-                ticket.submitted_at = time.perf_counter()
-                self._tickets[ticket.transfer_id] = ticket
-                self._pending += 1
-                tn.push(ticket)
-                if ticket.tenant not in self._active:
-                    self._active.append(ticket.tenant)
-                self.stats.admitted.inc()
-                self._update_gauges_locked()
-                self._cond.notify()
-                return "admitted"
-            tn.shed += 1
-            self.stats.shed.inc()
-            return ticket.shed_reason
+        # the ticket's trace root: queue-wait, dispatch, run and any
+        # rebalance/kill events all link back to this admission span
+        adm_sp = trace.span("fleet_admit",
+                            transfer_id=ticket.transfer_id,
+                            tenant=ticket.tenant, qos=ticket.qos.value)
+        with adm_sp:
+            if adm_sp:
+                ticket.trace_ctx = adm_sp.context()
+            # read the data-plane gauges OUTSIDE the scheduler lock: the
+            # controller takes its own lock and reads N metrics
+            hot = (self.backpressure.overloaded()
+                   if self.backpressure else False)
+            with self._cond:
+                tn = self._tenant_locked(ticket.tenant)
+                if tn.queued >= self.tenant_queue_quota:
+                    ticket.state = "shed"
+                    ticket.shed_reason = "shed-tenant-quota"
+                elif hot:
+                    ticket.state = "shed"
+                    ticket.shed_reason = "shed-backpressure"
+                else:
+                    ticket.seq = self._seq
+                    self._seq += 1
+                    ticket.state = "queued"
+                    ticket.submitted_at = time.perf_counter()
+                    ticket.queued_at = ticket.submitted_at
+                    self._tickets[ticket.transfer_id] = ticket
+                    self._pending += 1
+                    tn.push(ticket)
+                    if ticket.tenant not in self._active:
+                        self._active.append(ticket.tenant)
+                    self.stats.admitted.inc()
+                    self._update_gauges_locked()
+                    self._cond.notify()
+                    if adm_sp:
+                        adm_sp.add(decision="admitted")
+                    return "admitted"
+                tn.shed += 1
+                self.stats.shed.inc()
+                if adm_sp:
+                    adm_sp.add(decision=ticket.shed_reason)
+                return ticket.shed_reason
 
     def _tenant_locked(self, name: str) -> _Tenant:
         tn = self._tenants.get(name)
@@ -387,6 +413,14 @@ class FleetScheduler:
         lat = ticket.dispatch_latency
         self.dispatch_latencies.append(lat)
         self.stats.dispatch_time.observe(lat)
+        # the queue wait becomes a real span on the ticket trace,
+        # recorded retroactively now that it ended (admission →
+        # dispatch decision, regardless of which lane thread picked it)
+        trace.complete("fleet_queue_wait",
+                       t0=ticket.queued_at or ticket.submitted_at,
+                       dur=lat, parent=ticket.trace_ctx,
+                       transfer_id=ticket.transfer_id,
+                       tenant=ticket.tenant, attempt=ticket.attempts)
         return ticket
 
     def _next_dispatch(self, widx: int) -> Optional[FleetTransfer]:
@@ -420,6 +454,12 @@ class FleetScheduler:
                     self._update_gauges_locked()
                     continue
                 self._update_gauges_locked()
+                # dispatch decision as a point event on the ticket
+                # trace: together with the fleet_queue_wait span this
+                # marks where the scheduler handed the ticket to a lane
+                trace.instant("fleet_dispatch", ctx=ticket.trace_ctx,
+                              worker=widx,
+                              transfer_id=ticket.transfer_id)
                 return ticket
 
     # -- worker death & rebalance -------------------------------------------
@@ -432,6 +472,8 @@ class FleetScheduler:
             self._dead_workers.add(widx)
             self.kill_log.append((widx, ticket.transfer_id))
             self.stats.worker_deaths.inc()
+            trace.instant("fleet_worker_kill", ctx=ticket.trace_ctx,
+                          worker=widx, transfer_id=ticket.transfer_id)
         logger.warning("fleet worker %d killed holding %s; rebalancing",
                        widx, ticket.transfer_id)
         self._rebalance_locked(ticket, widx)
@@ -466,6 +508,12 @@ class FleetScheduler:
         self._running -= 1
         ticket.worker = None
         self.stats.rebalanced.inc()
+        trace.instant("fleet_rebalance", ctx=ticket.trace_ctx,
+                      transfer_id=ticket.transfer_id,
+                      dead_worker=dead_worker,
+                      attempt=ticket.attempts)
+        LEDGER.add_for(ticket.transfer_id, tenant=ticket.tenant,
+                       retries=1)
         self.rebalance_log.append(
             (ticket.transfer_id, dead_worker, ticket.attempts))
         if ticket.attempts >= self.max_attempts:
@@ -480,6 +528,7 @@ class FleetScheduler:
             return
         ticket.state = "queued"
         ticket.dispatched_at = 0.0
+        ticket.queued_at = time.perf_counter()
         tn.push(ticket, front=True)
         if ticket.tenant not in self._active:
             self._active.appendleft(ticket.tenant)
@@ -508,7 +557,7 @@ class FleetScheduler:
             if ticket is None:
                 return
             try:
-                ticket.run()
+                self._run_ticket(widx, ticket)
             except BaseException as e:
                 if is_worker_kill(e):
                     # the transfer died WITH its worker (OOM-kill, pod
@@ -522,6 +571,23 @@ class FleetScheduler:
                 self._finish(ticket, error=e)
             else:
                 self._finish(ticket)
+
+    def _run_ticket(self, widx: int, ticket: FleetTransfer) -> None:
+        """Run one dispatched ticket under its trace + ledger scope:
+        the engine's own spans (snapshot_op → part → batch → device)
+        flow onto the ticket trace, and every resource the run burns
+        bills (transfer_id, tenant) — queue wait included, so `trtpu
+        top` shows where a slow transfer's seconds actually went."""
+        with trace.adopted(ticket.trace_ctx), \
+                LEDGER.context(transfer_id=ticket.transfer_id,
+                               tenant=ticket.tenant):
+            LEDGER.add(queue_wait_seconds=ticket.dispatch_latency)
+            sp = trace.span("fleet_run",
+                            transfer_id=ticket.transfer_id,
+                            tenant=ticket.tenant, worker=widx,
+                            attempt=ticket.attempts)
+            with sp:
+                ticket.run()
 
     def _finish(self, ticket: FleetTransfer,
                 error: Optional[BaseException] = None) -> None:
@@ -538,6 +604,7 @@ class FleetScheduler:
                 ticket.state = "queued"
                 ticket.worker = None
                 ticket.dispatched_at = 0.0
+                ticket.queued_at = time.perf_counter()
                 tn.push(ticket, front=True)
                 if ticket.tenant not in self._active:
                     self._active.appendleft(ticket.tenant)

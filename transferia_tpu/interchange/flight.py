@@ -41,7 +41,54 @@ ACTION_SHM_LOCATE = "shm_locate"
 ACTION_DROP = "drop"
 ACTION_KEYS = "keys"
 
+# trace context rides DoGet/DoPut as gRPC metadata under this header;
+# the server adopts it so its spans parent to the CLIENT's span and the
+# exported timeline draws one flow across the wire (stats/trace.py)
+TRACE_HEADER = "x-trtpu-trace"
+
 _LOCAL_HOSTS = ("127.0.0.1", "localhost", "::1")
+
+
+def _trace_call_options(fl):
+    """FlightCallOptions carrying the caller's span context (None when
+    tracing is off — zero per-call overhead on the disabled path)."""
+    from transferia_tpu.stats import trace
+
+    wire = trace.wire_format(trace.current_context())
+    if not wire:
+        return None
+    return fl.FlightCallOptions(
+        headers=[(TRACE_HEADER.encode(), wire.encode())])
+
+
+def _make_trace_middleware(fl):
+    """Server middleware: parse the trace header once per call; the
+    handlers read `.trace_ctx` back via context.get_middleware."""
+
+    class _TraceMiddleware(fl.ServerMiddleware):
+        def __init__(self, ctx):
+            self.trace_ctx = ctx
+
+    class _Factory(fl.ServerMiddlewareFactory):
+        def start_call(self, info, headers):
+            from transferia_tpu.stats import trace
+
+            vals = headers.get(TRACE_HEADER) \
+                or headers.get(TRACE_HEADER.encode()) or []
+            return _TraceMiddleware(
+                trace.parse_wire(vals[0] if vals else ""))
+
+    return _Factory()
+
+
+def _wire_ctx(context):
+    """The caller-supplied trace context for one server call (None when
+    the client sent none or middleware is unavailable)."""
+    try:
+        mw = context.get_middleware("trtpu-trace")
+        return mw.trace_ctx if mw is not None else None
+    except Exception:
+        return None
 
 
 def make_server(host: str = "127.0.0.1", port: int = 0,
@@ -68,10 +115,10 @@ class ShardFlightServer:
 
         class _Impl(fl.FlightServerBase):
             def do_put(self, context, descriptor, reader, writer):
-                outer._do_put(descriptor, reader)
+                outer._do_put(descriptor, reader, _wire_ctx(context))
 
             def do_get(self, context, ticket):
-                return outer._do_get(ticket)
+                return outer._do_get(ticket, _wire_ctx(context))
 
             def list_flights(self, context, criteria):
                 return outer._list_flights()
@@ -82,7 +129,8 @@ class ShardFlightServer:
             def do_action(self, context, action):
                 return outer._do_action(action)
 
-        self._impl = _Impl(location)
+        self._impl = _Impl(
+            location, middleware={"trtpu-trace": _make_trace_middleware(fl)})
         self.port = self._impl.port
         # advertise the BOUND host: FlightInfo endpoints built from
         # this reach remote consumers (loopback only when bound there)
@@ -93,10 +141,17 @@ class ShardFlightServer:
         return f"grpc://{self._host}:{self.port}"
 
     # -- handlers ------------------------------------------------------------
-    def _do_put(self, descriptor, reader) -> None:
+    def _do_put(self, descriptor, reader, ctx=None) -> None:
         from transferia_tpu.stats import trace
 
         key = descriptor.path[0].decode()
+        # adopt the CLIENT's span context (rode in as gRPC metadata):
+        # the server-side span parents to the caller's flight_put span,
+        # so Perfetto draws one flow arrow across the wire
+        with trace.adopted(ctx):
+            self._do_put_adopted(key, reader, trace)
+
+    def _do_put_adopted(self, key, reader, trace) -> None:
         failpoint("interchange.flight.do_put")
         sp = trace.span("flight_do_put", part=key)
         with sp:
@@ -115,7 +170,7 @@ class ShardFlightServer:
         if sp:
             sp.add(rows=rows, bytes=nbytes)
 
-    def _do_get(self, ticket):
+    def _do_get(self, ticket, ctx=None):
         from transferia_tpu.stats import trace
 
         key = ticket.ticket.decode()
@@ -128,12 +183,13 @@ class ShardFlightServer:
         nbytes = sum(rb.nbytes for rb in rbs)
         TELEMETRY.add(flight_streams=1, batches_out=len(rbs),
                       bytes_out=nbytes)
-        sp = trace.span("flight_do_get", part=key)
-        if sp:
-            sp.add(rows=rows, bytes=nbytes)
-        with sp:
-            return self._fl.RecordBatchStream(
-                self._pa.Table.from_batches(rbs, schema=schema))
+        with trace.adopted(ctx):
+            sp = trace.span("flight_do_get", part=key)
+            if sp:
+                sp.add(rows=rows, bytes=nbytes)
+            with sp:
+                return self._fl.RecordBatchStream(
+                    self._pa.Table.from_batches(rbs, schema=schema))
 
     def _list_flights(self):
         with self._lock:
@@ -255,33 +311,60 @@ class FlightShardClient:
     def begin_put(self, key: str, schema):
         """Open a streaming DoPut for one part; caller writes
         RecordBatches and closes.  The server stores the stream
-        atomically when it ends (a re-put of the key replaces it)."""
+        atomically when it ends (a re-put of the key replaces it).
+        The caller's span context rides the call as gRPC metadata, so
+        the server-side flight_do_put span links back across the
+        wire."""
         descriptor = self._fl.FlightDescriptor.for_path(key)
-        writer, _ = self._client.do_put(descriptor, schema)
+        options = _trace_call_options(self._fl)
+        if options is not None:
+            writer, _ = self._client.do_put(descriptor, schema,
+                                            options=options)
+        else:
+            writer, _ = self._client.do_put(descriptor, schema)
         return writer
 
     def put_part(self, key: str, batches: Iterable[ColumnBatch]) -> int:
+        from transferia_tpu.stats import trace
+
         rbs = [b if isinstance(b, self._pa.RecordBatch)
                else batch_to_arrow(b) for b in batches]
         if not rbs:
             return 0
         rows = 0
-        with self.begin_put(key, rbs[0].schema) as writer:
-            for rb in rbs:
-                writer.write_batch(rb)
-                rows += rb.num_rows
+        sp = trace.span("flight_put", part=key)
+        with sp:
+            with self.begin_put(key, rbs[0].schema) as writer:
+                for rb in rbs:
+                    writer.write_batch(rb)
+                    rows += rb.num_rows
+            if sp:
+                sp.add(rows=rows,
+                       bytes=sum(rb.nbytes for rb in rbs))
         return rows
 
     def get_part(self, key: str) -> list[ColumnBatch]:
-        if self.allow_shm:
-            batches = self._try_shm(key)
-            if batches is not None:
-                return batches
-        reader = self._client.do_get(self._fl.Ticket(key.encode()))
-        out = []
-        for chunk in reader:
-            out.append(arrow_to_batch(chunk.data))
-        return out
+        from transferia_tpu.stats import trace
+
+        sp = trace.span("flight_get", part=key)
+        with sp:
+            if self.allow_shm:
+                batches = self._try_shm(key)
+                if batches is not None:
+                    if sp:
+                        sp.add(transport="shm")
+                    return batches
+            options = _trace_call_options(self._fl)
+            ticket = self._fl.Ticket(key.encode())
+            reader = (self._client.do_get(ticket, options=options)
+                      if options is not None
+                      else self._client.do_get(ticket))
+            out = []
+            for chunk in reader:
+                out.append(arrow_to_batch(chunk.data))
+            if sp:
+                sp.add(transport="grpc", batches=len(out))
+            return out
 
     def _try_shm(self, key: str) -> Optional[list[ColumnBatch]]:
         try:

@@ -37,6 +37,26 @@ from transferia_tpu.interchange.telemetry import TELEMETRY
 
 SHM_PREFIX = "trtpu-ichg-"
 
+# the writer's span context rides the segment's Arrow IPC schema
+# metadata under this key; `ShmAttachment.batches` adopts it so the
+# reader-side shm_map span links to the span that WROTE the segment —
+# the same causal stitch the Flight wire gets from gRPC metadata
+TRACE_META_KEY = b"__trtpu_trace"
+
+
+def _stamp_trace(rbs: list) -> list:
+    """Return batches whose schema metadata carries the current span
+    context (no-op when tracing is off or no span is open).  Metadata
+    must be stamped BEFORE the sizing pass: it changes the framing."""
+    from transferia_tpu.stats import trace
+
+    wire = trace.wire_format(trace.current_context())
+    if not wire:
+        return rbs
+    md = dict(rbs[0].schema.metadata or {})
+    md[TRACE_META_KEY] = wire.encode()
+    return [rb.replace_schema_metadata(md) for rb in rbs]
+
 
 @dataclass(frozen=True)
 class ShmHandle:
@@ -65,6 +85,7 @@ def write_segment(batches: Iterable[ColumnBatch]) -> ShmHandle:
            for b in batches]
     if not rbs:
         raise ValueError("shm.write_segment: no batches")
+    rbs = _stamp_trace(rbs)
     mock = pa.MockOutputStream()
     with pa.ipc.new_stream(mock, rbs[0].schema) as w:
         for rb in rbs:
@@ -112,7 +133,11 @@ class ShmAttachment:
     """
 
     def __init__(self, handle: ShmHandle):
+        from transferia_tpu.stats import trace
+
         failpoint("interchange.shm.attach")
+        trace.instant("shm_attach", segment=handle.name,
+                      bytes=handle.size)
         pa = pyarrow("the shared-memory handoff")
         self.handle = handle
         self._seg = shared_memory.SharedMemory(name=handle.name)
@@ -123,8 +148,18 @@ class ShmAttachment:
         TELEMETRY.add(bytes_in=handle.size)
 
     def batches(self) -> list[ColumnBatch]:
+        from transferia_tpu.stats import trace
+
         reader = self._pa.ipc.open_stream(self._pa.BufferReader(self._buf))
-        return [arrow_to_batch(rb) for rb in reader]
+        # the WRITER's span context rode the framing metadata: adopt it
+        # so the map span parents across the process/thread boundary
+        # (flow arrow in the export), exactly like the Flight header
+        md = reader.schema.metadata or {}
+        ctx = trace.parse_wire(md.get(TRACE_META_KEY, b""))
+        with trace.adopted(ctx):
+            with trace.span("shm_map", segment=self.handle.name,
+                            bytes=self.handle.size):
+                return [arrow_to_batch(rb) for rb in reader]
 
     def close(self) -> None:
         """Unmap, or defer while adopted batches still view the mapping

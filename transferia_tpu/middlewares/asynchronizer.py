@@ -13,6 +13,7 @@ preserving the Init/DoneTableLoad ordering contract.
 from __future__ import annotations
 
 import concurrent.futures
+import contextvars
 import logging
 import queue
 import threading
@@ -60,18 +61,29 @@ class Asynchronizer(AsyncSink):
         )
         self._worker.start()
 
+    def _push_one(self, batch, fut) -> None:
+        try:
+            with trace.span("sink_push"):
+                self.inner.push(batch)
+            fut.set_result(None)
+        except BaseException as e:
+            fut.set_exception(e)
+
     def _run(self):
         while True:
             item = self._q.get()
             if item is None:
                 return
-            batch, fut = item
-            try:
-                with trace.span("sink_push"):
-                    self.inner.push(batch)
-                fut.set_result(None)
-            except BaseException as e:
-                fut.set_exception(e)
+            batch, fut, cvctx = item
+            # run under the SUBMITTER's contextvars snapshot: the
+            # sink_push span parents to the submitting span (part /
+            # batch) and the push's resource events bill the
+            # submitter's ledger scope, even though this is the
+            # asynchronizer's own thread
+            if cvctx is not None:
+                cvctx.run(self._push_one, batch, fut)
+            else:
+                self._push_one(batch, fut)
 
     def async_push(self, batch: Batch) -> "Future[None]":
         fut: Future = Future()
@@ -81,7 +93,7 @@ class Asynchronizer(AsyncSink):
             if self._closed.is_set():
                 fut.set_exception(RuntimeError("asynchronizer closed"))
                 return fut
-            self._q.put((batch, fut))
+            self._q.put((batch, fut, contextvars.copy_context()))
         return fut
 
     def close(self) -> None:
@@ -184,7 +196,7 @@ class Bufferer(AsyncSink):
         self.cfg = cfg or BuffererConfig()
         self.stats = stats or BuffererStats()
         self._lock = threading.RLock()
-        self._buf: list[tuple[Batch, Future]] = []
+        self._buf: list[tuple] = []  # (batch, future, contextvars ctx)
         self._rows = 0
         self._bytes = 0
         self._closed = False
@@ -231,17 +243,17 @@ class Bufferer(AsyncSink):
         with sp:
             self._flush_groups(buf)
 
-    def _flush_groups(self, buf: list[tuple[Batch, "Future"]]) -> None:
+    def _flush_groups(self, buf: list[tuple]) -> None:
         # merge adjacent compatible units into big pushes
-        groups: list[tuple[list[Batch], list[Future]]] = []
-        for batch, fut in buf:
+        groups: list[tuple[list[Batch], list[Future], object]] = []
+        for batch, fut, cvctx in buf:
             if groups and self._mergeable(groups[-1][0][-1], batch):
                 groups[-1][0].append(batch)
                 groups[-1][1].append(fut)
             else:
-                groups.append(([batch], [fut]))
+                groups.append(([batch], [fut], cvctx))
         failed: Optional[BaseException] = None
-        for batches, futs in groups:
+        for batches, futs, cvctx in groups:
             if failed is not None:
                 for f in futs:
                     f.set_exception(failed)
@@ -253,7 +265,14 @@ class Bufferer(AsyncSink):
                     merged = ColumnBatch.concat(batches)
                 else:
                     merged = [it for b in batches for it in b]
-                self.inner.push(merged)
+                # a flush may run on the ticker thread or a later
+                # pusher's thread: push under the contextvars snapshot
+                # of the group's FIRST submitter so the merged write
+                # bills/links to the pipeline that buffered it
+                if cvctx is not None:
+                    cvctx.run(self.inner.push, merged)
+                else:
+                    self.inner.push(merged)
                 for f in futs:
                     f.set_result(None)
                 self.stats.flush_count.inc()
@@ -279,7 +298,7 @@ class Bufferer(AsyncSink):
                 except BaseException as e:
                     fut.set_exception(e)
                 return fut
-            self._buf.append((batch, fut))
+            self._buf.append((batch, fut, contextvars.copy_context()))
             self._rows += batch_len(batch)
             self._bytes += batch_bytes(batch)
             self.stats.buffered_rows.set(self._rows)

@@ -28,6 +28,7 @@ from transferia_tpu.middlewares.helpers import (
     split_rows_controls,
 )
 from transferia_tpu.stats import trace
+from transferia_tpu.stats.ledger import LEDGER
 from transferia_tpu.stats.registry import SinkerStats
 from transferia_tpu.utils.backoff import retry_with_backoff
 
@@ -89,6 +90,14 @@ class Statistician(_Wrap):
         self.stats.push_time.observe(time.monotonic() - t0)
         self.stats.rows.inc(n)
         self.stats.bytes.inc(nbytes)
+        # ledger attribution: delivered ROW events bill the ambient
+        # (transfer, tenant, part) scope — control items (Init/Done
+        # table loads) are delivery protocol, not tenant work, so they
+        # stay out of rows_out even though SinkerStats counts them; the
+        # asynchronizer/bufferer carried the submitter's contextvars
+        n_rows = n if is_columnar(batch) else sum(
+            1 for it in batch if it.is_row_event())
+        LEDGER.add(rows_out=n_rows, bytes_out=nbytes)
         if is_columnar(batch):
             self.stats.record_table(str(batch.table_id), n)
         else:

@@ -170,6 +170,41 @@ def build(force: bool = False) -> bool:
         return False
 
 
+class _ProfiledLib:
+    """CDLL proxy: every exported-function call publishes a "this
+    thread is inside native symbol S" marker for the sampling profiler
+    (stats/profiler.py native_call) — without it, samples landing in
+    the C++ kernels attribute to the CALLER's Python line and profiles
+    inflate lines like mask.py's hmac call with pure C++ time.
+
+    Everything else forwards to the wrapped CDLL: `hasattr` probes for
+    optional symbols and non-callable attributes behave identically.
+    The wrapper costs two dict operations per native CALL (calls are
+    per-batch/per-column, never per-row)."""
+
+    __slots__ = ("_cdll", "_wrapped")
+
+    def __init__(self, cdll: ctypes.CDLL):
+        self._cdll = cdll
+        self._wrapped: dict = {}
+
+    def __getattr__(self, name):
+        w = self._wrapped.get(name)
+        if w is not None:
+            return w
+        fn = getattr(self._cdll, name)  # AttributeError propagates
+        if not callable(fn):
+            return fn
+        from transferia_tpu.stats.profiler import native_call
+
+        def call(*args, _fn=fn, _name=name):
+            with native_call(_name):
+                return _fn(*args)
+
+        self._wrapped[name] = call
+        return call
+
+
 def lib() -> Optional[ctypes.CDLL]:
     """Load (building if needed); None when unavailable."""
     global _lib, _tried
@@ -184,7 +219,7 @@ def lib() -> Optional[ctypes.CDLL]:
         if not build():  # no-op when the .so is newer than the source
             return None
         try:
-            _lib = _bind(ctypes.CDLL(str(_SO)))
+            _lib = _ProfiledLib(_bind(ctypes.CDLL(str(_SO))))
         except (OSError, AttributeError) as e:
             # AttributeError: a prebuilt .so from an older source without
             # the newer symbols — honor the "None when unavailable" contract

@@ -258,11 +258,15 @@ class FusedMaskFilterProgram:
         from transferia_tpu.chaos.failpoints import failpoint
 
         failpoint("device.dispatch")
-        chunk = _chunk_rows()
-        if chunk and n_rows > chunk and not _pallas_pack_enabled():
-            return self._run_pipelined(mask_cols, pred_cols, n_rows,
-                                       chunk, states=states)
-        return self._run_single(mask_cols, pred_cols, n_rows, states)
+        # one parent span per batch run: pack / device_dispatch /
+        # device_wait nest under it, so a chunked pipelined run reads
+        # as one causally-grouped unit in the timeline
+        with trace.span("fused_run", rows=n_rows):
+            chunk = _chunk_rows()
+            if chunk and n_rows > chunk and not _pallas_pack_enabled():
+                return self._run_pipelined(mask_cols, pred_cols, n_rows,
+                                           chunk, states=states)
+            return self._run_single(mask_cols, pred_cols, n_rows, states)
 
     def _stage(self, mask_cols, pred_cols, n_rows, bucket, states=None):
         """Pack + encode on host and enqueue the (async) H2D for one

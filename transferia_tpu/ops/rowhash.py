@@ -212,8 +212,12 @@ def pool_accumulators(pool) -> tuple[np.ndarray, np.ndarray]:
     if memo is not None:
         return memo
     from transferia_tpu.chaos.failpoints import failpoint
+    from transferia_tpu.stats import trace
 
     failpoint("rowhash.pool_accs")
+    # once per shared pool: worth a point event (a chaos fire at the
+    # `rowhash.pool_accs` site lands next to it on the active span)
+    trace.instant("rowhash_pool_accs", values=pool.n_values)
     n_vals = pool.n_values
     offs = np.ascontiguousarray(pool.values_offsets, dtype=np.int32)
     lens = offs[1:] - offs[:-1]

@@ -421,6 +421,10 @@ class FileStorage(Storage, ShardingStorage, ScanPredicateStorage):
         # footer parse was 3.9% of the BENCH_r05 profile
         pf = parquet_file_cached(path)
         groups = self._prune_row_groups(pf, list(range(lo, hi)), tid)
+        from transferia_tpu.stats import trace
+
+        trace.instant("file_part_open", path=path, lo=lo, hi=hi,
+                      groups=len(groups))
         if not groups:
             return
         if self._load_groups_native(pf, path, groups, tid, schema,

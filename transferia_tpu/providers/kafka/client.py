@@ -191,9 +191,10 @@ class KafkaClient:
     def _roundtrip(self, api_key: int, api_version: int, body: bytes,
                    node="boot") -> Reader:
         from transferia_tpu.chaos.failpoints import failpoint
+        from transferia_tpu.stats import trace
 
         failpoint("client.kafka.roundtrip")  # before the lock: may sleep
-        with self._lock:
+        with trace.span("kafka_roundtrip", api=api_key), self._lock:
             sock = self._conn_for(node)
             self._corr += 1
             corr = self._corr

@@ -450,7 +450,11 @@ class NativeParquetReader:
             else:
                 val = None
             holds.append((bufs, val))
-        self._decode_tasks(tasks, len(specs))
+        from transferia_tpu.stats import trace
+
+        with trace.span("native_rowgroup_decode", group=g,
+                        cols=len(specs)):
+            self._decode_tasks(tasks, len(specs))
         cols: dict[str, Column] = {}
         fallback: list[str] = list(static_fb)
         for i, (cs, kind, ow, n, max_def, cap, view_dt) in enumerate(specs):

@@ -19,6 +19,7 @@ from transferia_tpu.abstract.interfaces import AsyncSink, Source
 from transferia_tpu.chaos.failpoints import failpoint
 from transferia_tpu.parsequeue import ParseQueue
 from transferia_tpu.parsers import Message, Parser, make_parser
+from transferia_tpu.stats import trace
 from transferia_tpu.stats.registry import Metrics, SourceStats
 
 logger = logging.getLogger(__name__)
@@ -120,6 +121,9 @@ class QueueSource(Source):
                     continue
                 for fb in fetched:
                     failpoint("replication.pump")
+                    trace.instant("replication_pump", topic=fb.topic,
+                                  partition=fb.partition,
+                                  messages=len(fb.messages))
                     self.stats.changeitems.inc(len(fb.messages))
                     self.stats.read_bytes.inc(
                         sum(len(m.value) for m in fb.messages)

@@ -110,6 +110,15 @@ class RowGroupReadahead:
         self._done = False
         self._pos = 0  # inline-mode cursor
         self._thread: Optional[threading.Thread] = None
+        # causal hop: the worker thread's decode spans must parent to
+        # the span that SUBMITTED the prefetch (the part span), and its
+        # resource events must bill the same (transfer, tenant, part)
+        # — capture both here, adopt them in _run
+        from transferia_tpu.stats import trace as _trace
+        from transferia_tpu.stats.ledger import LEDGER as _ledger
+
+        self._trace_ctx = _trace.current_context()
+        self._ledger_key = _ledger.current_key()
         # max_groups=1 can never overlap (the cap counts the group the
         # consumer holds, stalling the worker whenever the consumer is
         # busy) — inline serial decode is strictly better there too
@@ -135,7 +144,13 @@ class RowGroupReadahead:
     def _run(self) -> None:
         from transferia_tpu.chaos.failpoints import failpoint
         from transferia_tpu.stats import trace
+        from transferia_tpu.stats.ledger import LEDGER
 
+        with trace.adopted(self._trace_ctx), \
+                LEDGER.adopted(self._ledger_key):
+            self._run_adopted(failpoint, trace)
+
+    def _run_adopted(self, failpoint, trace) -> None:
         try:
             for g in self._groups:
                 with self._cond:
@@ -219,8 +234,10 @@ class RowGroupReadahead:
         finally:
             if waited:
                 from transferia_tpu.stats import stagetimer
+                from transferia_tpu.stats.ledger import LEDGER
 
                 stagetimer.add("decode_wait", waited)
+                LEDGER.add(decode_wait_seconds=waited)
         return g, item
 
     def _next_inline(self) -> tuple:

@@ -18,6 +18,7 @@ from transferia_tpu.coordinator.interface import Coordinator, TransferStatus
 from transferia_tpu.factories import make_async_sink, new_source
 from transferia_tpu.middlewares.asynchronizer import ErrorTracker
 from transferia_tpu.stats import trace
+from transferia_tpu.stats.ledger import LEDGER
 from transferia_tpu.stats.registry import Metrics, ReplicationStats
 
 logger = logging.getLogger(__name__)
@@ -279,5 +280,7 @@ def _heartbeat_loop(stop_event: threading.Event, cp: Coordinator,
         if metrics is not None:
             # device counters ride the heartbeat onto this pipeline's
             # metrics so long replications expose them, not just the
-            # one-shot trace/snapshot paths
+            # one-shot trace/snapshot paths; the attribution ledger
+            # folds on the same heartbeat
             trace.TELEMETRY.fold_into(metrics)
+            LEDGER.fold_into(metrics)

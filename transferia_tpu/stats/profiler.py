@@ -52,6 +52,54 @@ def _qualname(code) -> str:
     return getattr(code, "co_qualname", None) or code.co_name
 
 
+# -- native-frame attribution -------------------------------------------------
+#
+# A ctypes call into the C++ hostops kernels creates no Python frame:
+# a sample landing mid-kernel shows the CALLER's line, so profiles
+# silently inflated Python lines that were really C++ time (e.g.
+# `_native_hmac_hex (mask.py:104)` at 22.5% of BENCH_r05 was almost
+# entirely inside hmac_sha256_hex).  The native bindings
+# (native/__init__.py) publish "thread T is inside native symbol S"
+# around every exported call; the sampler reads the marker and tags
+# the sample explicitly instead of blaming the Python line.
+#
+# ident-keyed dict, not a threading.local: the SAMPLER thread must read
+# other threads' markers.  CPython dict get/set are atomic under the
+# GIL, so no lock is needed on this per-native-call hot path.
+_NATIVE_ACTIVE: dict[int, str] = {}
+
+NATIVE_TAG = "[native hostops]"
+
+
+class native_call:
+    """Marks the calling thread as executing the named C++ symbol for
+    the duration (re-entrant: nested native calls restore the outer
+    marker on exit)."""
+
+    __slots__ = ("_name", "_ident", "_prev")
+
+    def __init__(self, name: str):
+        self._name = name
+
+    def __enter__(self):
+        self._ident = threading.get_ident()
+        self._prev = _NATIVE_ACTIVE.get(self._ident)
+        _NATIVE_ACTIVE[self._ident] = self._name
+        return self
+
+    def __exit__(self, *exc):
+        if self._prev is None:
+            _NATIVE_ACTIVE.pop(self._ident, None)
+        else:
+            _NATIVE_ACTIVE[self._ident] = self._prev
+        return False
+
+
+def active_native(ident: int) -> Optional[str]:
+    """The native symbol thread `ident` is currently inside, if any."""
+    return _NATIVE_ACTIVE.get(ident)
+
+
 @dataclass
 class ProfileReport:
     seconds: float = 0.0
@@ -148,6 +196,11 @@ class Sampler:
                     rep.idle_samples += 1
                     continue
                 loc = (f"{_qualname(code)} ({fname}:{frame.f_lineno})")
+                native = _NATIVE_ACTIVE.get(ident)
+                if native is not None:
+                    # the thread is inside a C++ kernel: blame the
+                    # native symbol (tagged), not the Python call line
+                    loc = f"{native} {NATIVE_TAG} <- {loc}"
                 rep.self_counts[loc] += weight
                 rep.samples += 1
                 seen = set()

@@ -38,16 +38,30 @@ monitoring events — fired exactly on jit-cache misses that reach the
 backend compiler), and per-kernel wall time.  It folds into the
 prometheus `Metrics` facade via `fold_into()` (stats/registry.py
 DeviceStats).
+
+Causality (PR 10): every recorded span carries (trace_id, span_id,
+parent_id).  The active span context rides a `contextvars.ContextVar`,
+so nesting links parent→child automatically on one thread, and the
+capture/adopt pair carries it across thread hops (readahead workers,
+upload-part pool, fleet worker slots) and — via `wire_format` /
+`parse_wire` — across the Flight gRPC metadata and the shm framing
+metadata.  The Chrome export emits flow events for every parent link
+that crosses a thread, so one transfer renders as a single
+causally-linked timeline in Perfetto even when its spans live on six
+threads.
 """
 
 from __future__ import annotations
 
+import contextvars
+import itertools
 import json
+import os
 import threading
 import time
 import weakref
 from collections import deque
-from typing import Optional
+from typing import NamedTuple, Optional
 
 DEFAULT_CAPACITY = 200_000  # spans; ~100 bytes each -> bounded ~20MB
 
@@ -56,6 +70,24 @@ _epoch = 0.0
 _lock = threading.Lock()
 _ring: deque = deque(maxlen=DEFAULT_CAPACITY)
 _tls = threading.local()
+
+
+class SpanContext(NamedTuple):
+    """The propagation token: which trace, which span is 'current'.
+
+    Immutable and tiny on purpose — it crosses thread boundaries by
+    value and the wire as `"<trace_id>:<span_id>"`."""
+
+    trace_id: int
+    span_id: int
+
+
+# span/trace ids are process-unique counters offset by the pid so ids
+# minted on both ends of an in-host wire (Flight loopback, shm handoff
+# between forked workers) never collide in one merged timeline
+_ids = itertools.count(((os.getpid() & 0xFFFF) << 32) + 1)
+_ctx: "contextvars.ContextVar[Optional[SpanContext]]" = \
+    contextvars.ContextVar("trtpu_trace_ctx", default=None)
 
 
 class _NoopSpan:
@@ -75,18 +107,26 @@ class _NoopSpan:
     def add(self, **args) -> None:
         pass
 
+    def context(self) -> Optional[SpanContext]:
+        return None
+
 
 _NOOP = _NoopSpan()
 
 
 class Span:
-    __slots__ = ("name", "args", "_t0", "_child")
+    __slots__ = ("name", "args", "_t0", "_child",
+                 "trace_id", "span_id", "parent_id", "_token")
 
     def __init__(self, name: str, args: Optional[dict] = None):
         self.name = name
         self.args = args
         self._t0 = 0.0
         self._child = 0.0  # seconds covered by nested spans
+        self.trace_id = 0
+        self.span_id = 0
+        self.parent_id = 0
+        self._token = None
 
     def __bool__(self):
         return True
@@ -98,17 +138,32 @@ class Span:
         else:
             self.args.update(args)
 
+    def context(self) -> SpanContext:
+        """This span's propagation token (valid after __enter__)."""
+        return SpanContext(self.trace_id, self.span_id)
+
     def __enter__(self):
         stack = getattr(_tls, "stack", None)
         if stack is None:
             stack = _tls.stack = []
         stack.append(self)
+        parent = _ctx.get()
+        self.span_id = next(_ids)
+        if parent is not None:
+            self.trace_id = parent.trace_id
+            self.parent_id = parent.span_id
+        else:
+            self.trace_id = self.span_id  # a new root starts its trace
+        self._token = _ctx.set(SpanContext(self.trace_id, self.span_id))
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, *exc):
         t1 = time.perf_counter()
         dur = t1 - self._t0
+        if self._token is not None:
+            _ctx.reset(self._token)
+            self._token = None
         stack = _tls.stack
         stack.pop()
         depth = len(stack)
@@ -120,6 +175,7 @@ class Span:
                 self.name, t.ident, t.name,
                 self._t0 - _epoch, dur, max(0.0, dur - self._child),
                 depth, self.args,
+                self.trace_id, self.span_id, self.parent_id,
             ))
         return False
 
@@ -159,15 +215,42 @@ def span(name: str, **args):
     return Span(name, args or None)
 
 
-def instant(name: str, **args) -> None:
-    """Point event (XLA compiles, retries, flush triggers)."""
+def instant(name: str, ctx: Optional[SpanContext] = None,
+            **args) -> None:
+    """Point event (XLA compiles, retries, chaos fires).  Lands ON the
+    active span: the recorded tuple carries the current trace/span ids
+    (or an explicit `ctx`), so Perfetto shows the instant inside the
+    span that was running when it fired."""
     if not _enabled:
         return
+    at = ctx if ctx is not None else _ctx.get()
+    trace_id = at.trace_id if at else 0
+    parent_id = at.span_id if at else 0
     t = threading.current_thread()
     with _lock:
         _ring.append((name, t.ident, t.name,
                       time.perf_counter() - _epoch, 0.0, 0.0, -1,
-                      args or None))
+                      args or None, trace_id, 0, parent_id))
+
+
+def complete(name: str, t0: float, dur: float,
+             parent: Optional[SpanContext] = None, **args) -> None:
+    """Record a span RETROACTIVELY from wall measurements already taken
+    (`t0` in time.perf_counter seconds).  This is how queue-wait style
+    intervals — observed only once they end, on whatever thread ends
+    them — still land as real spans on the owning trace (fleet ticket
+    queue wait, admission→dispatch)."""
+    if not _enabled:
+        return
+    at = parent if parent is not None else _ctx.get()
+    span_id = next(_ids)
+    trace_id = at.trace_id if at else span_id
+    parent_id = at.span_id if at else 0
+    t = threading.current_thread()
+    with _lock:
+        _ring.append((name, t.ident, t.name, t0 - _epoch, dur,
+                      dur, 0, args or None, trace_id, span_id,
+                      parent_id))
 
 
 def current() -> Optional[str]:
@@ -176,9 +259,71 @@ def current() -> Optional[str]:
     return stack[-1].name if stack else None
 
 
+def current_context() -> Optional[SpanContext]:
+    """The active span's propagation token (None when tracing is off or
+    no span is open).  Capture this BEFORE handing work to another
+    thread; the worker re-enters it with `adopted()`."""
+    if not _enabled:
+        return None
+    return _ctx.get()
+
+
+class adopted:
+    """Re-enter a captured SpanContext on another thread:
+
+        ctx = trace.current_context()          # submitting thread
+        ...
+        with trace.adopted(ctx):               # worker thread
+            with trace.span("decode_readahead"):  # parents to ctx
+                ...
+
+    A None ctx is a no-op, so call sites never need to branch on
+    whether tracing was on at capture time."""
+
+    __slots__ = ("_ctx", "_token")
+
+    def __init__(self, ctx: Optional[SpanContext]):
+        self._ctx = ctx
+        self._token = None
+
+    def __enter__(self):
+        if self._ctx is not None and _enabled:
+            self._token = _ctx.set(self._ctx)
+        return self
+
+    def __exit__(self, *exc):
+        if self._token is not None:
+            _ctx.reset(self._token)
+            self._token = None
+        return False
+
+
+def wire_format(ctx: Optional[SpanContext]) -> str:
+    """Serialize a context for a wire hop (Flight gRPC metadata, shm
+    framing metadata).  Empty string when there is nothing to carry."""
+    if ctx is None:
+        return ""
+    return f"{ctx.trace_id}:{ctx.span_id}"
+
+
+def parse_wire(s) -> Optional[SpanContext]:
+    """Inverse of wire_format; tolerant of junk (a malformed header
+    must never fail the data-plane call it rode in on)."""
+    if not s:
+        return None
+    if isinstance(s, bytes):
+        s = s.decode("ascii", "replace")
+    trace_s, _, span_s = s.partition(":")
+    try:
+        return SpanContext(int(trace_s), int(span_s))
+    except ValueError:
+        return None
+
+
 def spans() -> list[tuple]:
     """Raw recorded tuples (name, tid, tname, t0_s, dur_s, self_s,
-    depth, args) — depth -1 marks instants."""
+    depth, args, trace_id, span_id, parent_id) — depth -1 marks
+    instants (span_id 0, parent_id = the span they fired on)."""
     with _lock:
         return list(_ring)
 
@@ -188,19 +333,28 @@ def spans() -> list[tuple]:
 def export_chrome_trace() -> dict:
     """Chrome trace-event JSON (dict; json.dump it).  Loadable in
     Perfetto and chrome://tracing: "X" complete events with tid/ts/dur
-    in microseconds, thread-name metadata, instants as "i"."""
+    in microseconds, thread-name metadata, instants as "i", and flow
+    events ("s"/"f" pairs keyed by the child span id) for every
+    parent→child link that crosses a thread — the arrows that stitch a
+    readahead worker's decode, a fleet lane's run, and a Flight
+    server-side span onto the submitting timeline."""
     recorded = spans()
     events: list[dict] = []
     seen_threads: dict[int, str] = {}
-    for name, tid, tname, t0, dur, _self_s, depth, args in recorded:
+    # span_id -> (tid, ts_us) for flow-arrow sources
+    located: dict[int, tuple[int, float]] = {}
+    for rec in recorded:
+        name, tid, tname, t0, dur, _self_s, depth, args = rec[:8]
+        trace_id, span_id, parent_id = rec[8:11]
         if tid not in seen_threads:
             seen_threads[tid] = tname
+        ts = round(t0 * 1e6, 1)
         ev = {
             "name": name,
             "cat": "pipeline",
             "pid": 1,
             "tid": tid,
-            "ts": round(t0 * 1e6, 1),
+            "ts": ts,
         }
         if depth < 0:
             ev["ph"] = "i"
@@ -208,9 +362,34 @@ def export_chrome_trace() -> dict:
         else:
             ev["ph"] = "X"
             ev["dur"] = round(dur * 1e6, 1)
+            if span_id:
+                located[span_id] = (tid, ts)
         if args:
             ev["args"] = {k: _jsonable(v) for k, v in args.items()}
+        if trace_id:
+            ids = ev.setdefault("args", {})
+            ids["trace_id"] = trace_id
+            if span_id:
+                ids["span_id"] = span_id
+            if parent_id:
+                ids["parent_id"] = parent_id
         events.append(ev)
+    flows: list[dict] = []
+    for rec in recorded:
+        _name, tid, _tn, t0, _dur, _s, depth, _a = rec[:8]
+        _trace_id, span_id, parent_id = rec[8:11]
+        if depth < 0 or not parent_id:
+            continue
+        src = located.get(parent_id)
+        if src is None or src[0] == tid:
+            continue  # same-thread nesting needs no arrow
+        ts = round(t0 * 1e6, 1)
+        flows.append({"name": "causal", "cat": "flow", "ph": "s",
+                      "id": span_id, "pid": 1, "tid": src[0],
+                      "ts": src[1]})
+        flows.append({"name": "causal", "cat": "flow", "ph": "f",
+                      "bp": "e", "id": span_id, "pid": 1, "tid": tid,
+                      "ts": ts})
     meta = [
         {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
          "args": {"name": "transferia-tpu"}},
@@ -220,7 +399,7 @@ def export_chrome_trace() -> dict:
                      "tid": tid, "args": {"name": tname}})
     counters = TELEMETRY.snapshot()
     return {
-        "traceEvents": meta + events,
+        "traceEvents": meta + events + flows,
         "displayTimeUnit": "ms",
         "otherData": {"device_telemetry": counters},
     }
@@ -250,7 +429,8 @@ def stage_summary(wall_seconds: Optional[float] = None) -> dict:
     recorded = [s for s in spans() if s[6] >= 0]
     per: dict[str, dict] = {}
     t_min, t_max = None, None
-    for name, _tid, _tn, t0, dur, self_s, _depth, args in recorded:
+    for name, _tid, _tn, t0, dur, self_s, _depth, args in (
+            s[:8] for s in recorded):
         d = per.setdefault(name, {"calls": 0, "total_s": 0.0,
                                   "self_s": 0.0, "bytes": 0,
                                   "durs": []})
@@ -311,19 +491,21 @@ def format_summary(wall_seconds: Optional[float] = None) -> str:
 _capture_lock = threading.Lock()
 
 
-def capture_seconds(seconds: float) -> dict:
-    """The `/debug/trace?seconds=N` implementation.  Runs in an HTTP
-    worker thread, so blocking here never stalls the pipeline.
-
-    When tracing is already on (a `trtpu trace` run, bench --trace, or
-    an operator who enabled it), the ring belongs to that capture:
-    sample the window WITHOUT resetting — destroying an in-progress
-    capture from a debug endpoint would be hostile.  Only a
-    tracing-off process gets the reset/enable/disable cycle, and
-    concurrent requests serialize so they can't clobber each other's
-    enable-state restore."""
-    wait = max(0.05, min(seconds, 60.0))
-    with _capture_lock:
+def _capture_window(wait: float, cancelled: threading.Event,
+                    lock_timeout: float) -> Optional[dict]:
+    """One capture cycle (see capture_seconds for the policy).  Holds
+    the capture lock for the whole window so concurrent requests can't
+    clobber each other's enable-state restore.  The lock acquire is
+    BOUNDED and the cancel flag is re-checked after it: an abandoned
+    helper whose caller already 503'd must exit instead of queueing
+    forever and then running a full reset/enable window nobody reads
+    (that both leaked one blocked thread per timed-out request and
+    kept clearing the span ring long after the clients were gone)."""
+    if not _capture_lock.acquire(timeout=lock_timeout):
+        return None
+    try:
+        if cancelled.is_set():
+            return None
         if _enabled:
             time.sleep(wait)
             return export_chrome_trace()
@@ -333,9 +515,105 @@ def capture_seconds(seconds: float) -> dict:
         doc = export_chrome_trace()
         enable(False)
         return doc
+    finally:
+        _capture_lock.release()
+
+
+def capture_seconds(seconds: float,
+                    deadline_grace: float = 15.0) -> dict:
+    """The `/debug/trace?seconds=N` implementation.
+
+    When tracing is already on (a `trtpu trace` run, bench --trace, or
+    an operator who enabled it), the ring belongs to that capture:
+    sample the window WITHOUT resetting — destroying an in-progress
+    capture from a debug endpoint would be hostile.  Only a
+    tracing-off process gets the reset/enable/disable cycle.
+
+    The window runs on a dedicated HELPER thread with a hard deadline:
+    a long capture must never pin the calling HTTP worker past
+    `seconds + grace` (earlier versions slept on the request thread
+    and, behind the shared capture lock or a keep-alive connection,
+    starved every other `/debug/*` endpoint — including `/debug/fleet`
+    mid kill-trial).  On deadline the helper is abandoned (it finishes
+    its cycle and restores the enable state on its own) and
+    TimeoutError is raised for the caller to turn into a 503."""
+    wait = max(0.05, min(seconds, 60.0))
+    # the helper may also queue behind another capture holding the
+    # lock for up to a full window — budget one extra window for that
+    deadline = 2 * wait + max(1.0, deadline_grace)
+    out: dict = {}
+    done = threading.Event()
+    cancelled = threading.Event()
+
+    def _run() -> None:
+        try:
+            out["doc"] = _capture_window(wait, cancelled,
+                                         lock_timeout=deadline)
+        except BaseException as e:  # surfaced on the caller
+            out["err"] = e
+        finally:
+            done.set()
+
+    t = threading.Thread(target=_run, name="trace-capture",
+                         daemon=True)
+    t.start()
+    if not done.wait(deadline):
+        cancelled.set()
+        raise TimeoutError(
+            f"trace capture exceeded its deadline "
+            f"({wait:.0f}s window); helper abandoned")
+    if "err" in out:
+        raise out["err"]
+    if out.get("doc") is None:
+        # the helper lost the lock race past its own deadline or was
+        # cancelled between acquire and check — same operator story
+        raise TimeoutError(
+            "trace capture could not take the capture lock "
+            "(another capture window in flight)")
+    return out["doc"]
+
+
+def iter_chrome_trace_chunks(doc: Optional[dict] = None,
+                             chunk_bytes: int = 64 * 1024):
+    """Yield the Chrome trace JSON as a sequence of ~chunk_bytes string
+    chunks — the `/debug/trace` endpoint streams these with chunked
+    transfer encoding instead of materializing one multi-MB `bytes`
+    (a 60s capture of a busy fleet is easily 100k+ events).  Events
+    are accumulated up to the chunk size before yielding: the
+    handler's wfile is unbuffered, so one yield per event would mean
+    one syscall/TCP segment per ~100-byte event."""
+    if doc is None:
+        doc = export_chrome_trace()
+    buf: list[str] = ['{"traceEvents":[']
+    size = len(buf[0])
+    first = True
+    for ev in doc["traceEvents"]:
+        piece = ("" if first else ",") + json.dumps(ev)
+        first = False
+        buf.append(piece)
+        size += len(piece)
+        if size >= chunk_bytes:
+            yield "".join(buf)
+            buf, size = [], 0
+    other = {k: v for k, v in doc.items() if k != "traceEvents"}
+    tail = json.dumps(other)
+    # splice the remaining top-level keys after the events array
+    buf.append("]" + ("," + tail[1:-1] if tail != "{}" else "") + "}")
+    yield "".join(buf)
 
 
 # -- device telemetry --------------------------------------------------------
+
+def _ledger():
+    """The attribution plane (stats/ledger.py LEDGER): device counters
+    route their increments through it under the ambient (transfer,
+    tenant, part) scope, which is what makes the ledger's conservation
+    invariant hold by construction.  Lazy import: ledger lazily reads
+    TELEMETRY back for reconciliation."""
+    from transferia_tpu.stats.ledger import LEDGER
+
+    return LEDGER
+
 
 class DeviceTelemetry:
     """Always-on device-side counters (increments are per-dispatch, not
@@ -383,17 +661,27 @@ class DeviceTelemetry:
             self._folded: "weakref.WeakKeyDictionary" = \
                 weakref.WeakKeyDictionary()
 
+    # Ledger adds happen BEFORE the telemetry increment (and the
+    # ledger reads telemetry first in its reconciliation): at any poll
+    # the ledger total is >= the telemetry counter for routed fields,
+    # so positive drift (telemetry ahead) always means a real
+    # attribution bypass, never an increment caught between the two
+    # locks.
+
     def record_h2d(self, nbytes: int) -> None:
+        _ledger().add(h2d_bytes=int(nbytes))
         with self._lock:
             self.h2d_bytes += int(nbytes)
             self.h2d_transfers += 1
 
     def record_d2h(self, nbytes: int) -> None:
+        _ledger().add(d2h_bytes=int(nbytes))
         with self._lock:
             self.d2h_bytes += int(nbytes)
             self.d2h_transfers += 1
 
     def record_launch(self, n: int = 1) -> None:
+        _ledger().add(launches=n)
         with self._lock:
             self.device_launches += n
 
@@ -401,6 +689,8 @@ class DeviceTelemetry:
                         raw_equiv_bytes: int) -> None:
         """One encoded H2D staging: what actually crossed the link vs
         what the uncompressed wire would have shipped."""
+        _ledger().add(h2d_encoded_bytes=int(encoded_bytes),
+                      h2d_raw_equiv_bytes=int(raw_equiv_bytes))
         with self._lock:
             self.h2d_encoded_bytes += int(encoded_bytes)
             self.h2d_raw_equiv_bytes += int(raw_equiv_bytes)
@@ -426,10 +716,12 @@ class DeviceTelemetry:
             self.dict_flat_materializations += 1
 
     def record_kernel(self, seconds: float) -> None:
+        _ledger().add(kernel_seconds=seconds)
         with self._lock:
             self.kernel_seconds += seconds
 
     def record_compile(self, seconds: float) -> None:
+        _ledger().add(compiles=1, compile_seconds=seconds)
         with self._lock:
             self.compile_events += 1
             self.compile_seconds += seconds

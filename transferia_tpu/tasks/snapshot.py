@@ -56,6 +56,7 @@ from transferia_tpu.coordinator.interface import (
 )
 from transferia_tpu.factories import make_async_sink, new_storage
 from transferia_tpu.stats import trace
+from transferia_tpu.stats.ledger import LEDGER
 from transferia_tpu.stats.registry import LeaseStats, Metrics, TableStats
 from transferia_tpu.tasks.table_splitter import split_tables
 from transferia_tpu.utils.backoff import retry_with_backoff
@@ -138,13 +139,21 @@ class SnapshotLoader:
         """UploadTables (load_snapshot.go:346): snapshot the given tables
         (None = all tables passing the transfer's include filter)."""
         storage = new_storage(self.transfer, self.metrics)
+        # the operation root: every part/batch/device span of this
+        # snapshot nests (or flows, across worker threads) under it,
+        # and every resource event bills this transfer in the ledger
+        # (tenant inherited from an enclosing fleet lane scope)
+        op_sp = trace.span("snapshot_op", transfer_id=self.transfer.id,
+                           operation_id=self.operation_id,
+                           worker=self.worker_index)
         try:
-            if tables is None:
-                tables = self.filtered_table_list(storage)
-            if self.is_main:
-                self._main_flow(storage, tables)
-            else:
-                self._secondary_flow(storage)
+            with op_sp, LEDGER.context(transfer_id=self.transfer.id):
+                if tables is None:
+                    tables = self.filtered_table_list(storage)
+                if self.is_main:
+                    self._main_flow(storage, tables)
+                else:
+                    self._secondary_flow(storage)
         finally:
             storage.close()
 
@@ -557,8 +566,12 @@ class SnapshotLoader:
         while not stop.wait(TUNING.heartbeat_interval):
             try:
                 failpoint("snapshot.lease_renew")
-                renewed = self.cp.renew_lease(self.operation_id,
-                                              self.worker_index)
+                sp = trace.span("lease_renew", worker=self.worker_index)
+                with sp:
+                    renewed = self.cp.renew_lease(self.operation_id,
+                                                  self.worker_index)
+                if sp:
+                    sp.add(renewed=renewed)
                 self.lease_stats.renewals.inc(renewed)
                 with self._progress_lock:
                     payload = {
@@ -625,7 +638,18 @@ class SnapshotLoader:
             time.sleep(min(1.0, max(0.05, wait)))
             return True
 
+        # causal hop: upload worker threads (and the heartbeat) adopt
+        # the submitting scope, so part spans parent to the operation
+        # span — and, under a fleet lane, to the ticket trace — and
+        # their resource events bill the right (transfer, tenant)
+        op_ctx = trace.current_context()
+        op_lkey = LEDGER.current_key()
+
         def worker():
+            with trace.adopted(op_ctx), LEDGER.adopted(op_lkey):
+                worker_loop()
+
+        def worker_loop():
             idle_sleep = 0.05
             while True:
                 with err_lock:
@@ -651,6 +675,10 @@ class SnapshotLoader:
                 idle_sleep = 0.05
                 if part.stolen_from is not None:
                     self.lease_stats.steals.inc()
+                    LEDGER.add(lease_steals=1)
+                    trace.instant("lease_steal", part=part.key(),
+                                  stolen_from=part.stolen_from,
+                                  epoch=part.assignment_epoch)
                     logger.warning(
                         "part %s reclaimed from worker %d (lease "
                         "expired; epoch now %d)", part.key(),
@@ -663,8 +691,12 @@ class SnapshotLoader:
                     return
 
         hb_stop = threading.Event()
-        hb = threading.Thread(target=self._heartbeat_loop,
-                              args=(hb_stop,),
+
+        def heartbeat():
+            with trace.adopted(op_ctx), LEDGER.adopted(op_lkey):
+                self._heartbeat_loop(hb_stop)
+
+        hb = threading.Thread(target=heartbeat,
                               name=f"heartbeat-{self.worker_index}",
                               daemon=True)
         hb.start()
@@ -694,14 +726,20 @@ class SnapshotLoader:
         # errors anywhere in the cause chain fail the part immediately
         # instead of burning the full backoff schedule on a guaranteed
         # re-failure (the TableUploadError wrapper preserves the chain)
+        def on_retry(i, e):
+            with LEDGER.context(part=part.key()):
+                LEDGER.add(retries=1)
+            trace.instant("part_retry", part=part.key(), attempt=i,
+                          error=type(e).__name__)
+            logger.warning("part %s retry %d/%d: %s", part.key(), i,
+                           PART_RETRIES, e)
+
         retry_with_backoff(
             attempt,
             attempts=PART_RETRIES,
             base_delay=PART_RETRY_BASE_DELAY,
             retriable=is_retriable,
-            on_retry=lambda i, e: logger.warning(
-                "part %s retry %d/%d: %s", part.key(), i, PART_RETRIES, e
-            ),
+            on_retry=on_retry,
         )
 
     def _upload_part(self, storage: Storage, part: OperationTablePart,
@@ -742,7 +780,7 @@ class SnapshotLoader:
                         part=part.key())
         futures: deque = deque()
         try:
-            with part_sp:
+            with part_sp, LEDGER.context(part=part.key()):
                 sink.async_push(
                     [init_table_load(tid, schema, part_id)]
                 ).result()
@@ -759,6 +797,9 @@ class SnapshotLoader:
                             batch.part_id = part_id
                             rows_done += batch.n_rows
                             read_bytes += batch.read_bytes or batch.nbytes()
+                            LEDGER.add(rows_in=batch.n_rows,
+                                       bytes_in=batch.read_bytes
+                                       or batch.nbytes())
                             if sp:
                                 sp.add(table=str(tid), part=part.key(),
                                        batch_seq=batch_seq,
@@ -766,6 +807,7 @@ class SnapshotLoader:
                                        bytes=batch.nbytes())
                         else:
                             rows_done += len(batch)
+                            LEDGER.add(rows_in=len(batch))
                             if sp:
                                 sp.add(table=str(tid), part=part.key(),
                                        batch_seq=batch_seq,
@@ -843,7 +885,10 @@ class SnapshotLoader:
                 part.key(), part.assignment_epoch)
             return
         # device counters surface on this pipeline's metrics as parts
-        # complete (H2D/D2H bytes, launches, XLA compiles)
+        # complete (H2D/D2H bytes, launches, XLA compiles) — the
+        # attribution ledger folds alongside so the ledger_* series
+        # track the same cadence
         trace.TELEMETRY.fold_into(self.metrics)
+        LEDGER.fold_into(self.metrics)
         logger.info("part %s done: %d rows, %d bytes",
                     part.key(), rows_done, read_bytes)
