@@ -565,6 +565,77 @@ class TestEndToEndTrials:
         assert (c.spec, c.steal_log) != (a.spec, a.steal_log) or \
             c.fire_log != a.fire_log
 
+    def test_exactly_once_trial_zero_dup_zero_loss(self):
+        """The staged-commit gauntlet on the memory backend: torn
+        write + kill + zombie replay, and the tightened audit — the
+        delivered multiset EQUALS the fault-free reference."""
+        from transferia_tpu.chaos import runner
+
+        with runner._fast_retries():
+            ref = runner._exactly_once_reference(512, "memory")
+            r = runner.run_exactly_once_trial(0, 7, 512, ref,
+                                              backend="memory")
+        assert r.passed, r.verdict.summary()
+        assert r.backend == "memory"
+        assert r.kills == 1
+        assert r.fence_rejected >= 1          # zombie stopped somewhere
+        assert any(not granted for _k, _e, granted in r.commit_log)
+        assert r.verdict.duplicate_rows == 0
+        assert r.verdict.max_multiplicity <= 1
+
+    def test_exactly_once_logs_replay_with_seed(self):
+        """Acceptance bar: same seed -> identical fire, steal AND
+        commit-decision sequences; a different seed diverges."""
+        from transferia_tpu.chaos import runner
+
+        with runner._fast_retries():
+            ref = runner._exactly_once_reference(512, "memory")
+            a = runner.run_exactly_once_trial(2, 7, 512, ref,
+                                              backend="memory")
+            b = runner.run_exactly_once_trial(2, 7, 512, ref,
+                                              backend="memory")
+            c = runner.run_exactly_once_trial(2, 11, 512, ref,
+                                              backend="memory")
+        assert a.passed and b.passed and c.passed
+        assert a.spec == b.spec
+        assert a.fire_log == b.fire_log
+        assert a.steal_log == b.steal_log
+        assert a.commit_log == b.commit_log
+        assert (c.spec, c.fire_log, c.commit_log) != \
+            (a.spec, a.fire_log, a.commit_log)
+
+    def test_exactly_once_detects_surviving_duplicate(self):
+        """False-positive guard: a delivery carrying one extra copy of
+        a reference row must FAIL the exactly-once audit even though it
+        passes the bounded-duplication check."""
+        from transferia_tpu.abstract.schema import TableID as TID
+        from transferia_tpu.columnar.batch import ColumnBatch
+
+        b = _batch(0, 64)
+        ref = inv.DeliveryReference.from_batches([b])
+        dup = ColumnBatch.concat([b, b.slice(0, 1)])
+        bounded = inv.audit_delivery(ref, [dup], max_multiplicity=4)
+        assert bounded.passed
+        strict = inv.audit_delivery(ref, [dup], max_multiplicity=4,
+                                    exactly_once=True)
+        assert not strict.passed
+        assert any(v.invariant == "exactly-once"
+                   for v in strict.violations)
+
+    def test_exactly_once_detects_lost_multiplicity(self):
+        """A key the reference delivers twice but the run delivers once
+        is a LOSS under exactly-once (at-least-once alone would pass)."""
+        from transferia_tpu.columnar.batch import ColumnBatch
+
+        b = _batch(0, 64)
+        ref = inv.DeliveryReference.from_batches(
+            [ColumnBatch.concat([b, b.slice(0, 4)])])
+        ok = inv.audit_delivery(ref, [b], max_multiplicity=4)
+        assert ok.passed
+        strict = inv.audit_delivery(ref, [b], max_multiplicity=4,
+                                    exactly_once=True)
+        assert not strict.passed
+
     def test_worker_kill_action_registered(self):
         fps = fp.parse_spec(
             "snapshot.part.batch=times:1,raise:WorkerKilledError")

@@ -1,0 +1,672 @@
+"""Staged two-phase sink commits (abstract/commit.py +
+providers/staging.py + Coordinator.commit_part): the dedup window, the
+sink-side epoch fences, staging invisibility and publish replacement
+per capable sink, the coordinator's fenced publish decision across
+memory/filestore/s3 backends, and the engine's stage → publish
+lifecycle (ARCHITECTURE.md "Exactly-once commits")."""
+
+import os
+
+import pytest
+
+from transferia_tpu.abstract.commit import StagedSinker, find_staged_sink
+from transferia_tpu.abstract.errors import (
+    StaleEpochPublishError,
+    is_retriable,
+)
+from transferia_tpu.abstract.schema import TableID
+from transferia_tpu.abstract.table import OperationTablePart
+from transferia_tpu.coordinator import (
+    FileStoreCoordinator,
+    MemoryCoordinator,
+    S3Coordinator,
+)
+from transferia_tpu.models import Transfer, TransferType
+from transferia_tpu.providers.memory import (
+    MemorySinker,
+    MemoryTargetParams,
+    get_store,
+)
+from transferia_tpu.providers.sample import SampleSourceParams, make_batch
+from transferia_tpu.providers.staging import (
+    DedupWindow,
+    DirectoryPartStage,
+    EpochFence,
+    PartStage,
+    part_slug,
+)
+
+TID = TableID("sample", "users")
+
+
+def _batch(start=0, n=64, seed=3):
+    return make_batch("users", TID, start, n, seed)
+
+
+# -- dedup window ------------------------------------------------------------
+
+class TestDedupWindow:
+    def test_armed_replay_prefix_dropped(self):
+        # torn write: the prefix landed, the push errored, the Retrier
+        # arms the window and re-pushes the WHOLE batch
+        w = DedupWindow()
+        b = _batch(0, 96)
+        out, dropped = w.filter(b.slice(0, 64))
+        assert dropped == 0 and out.n_rows == 64
+        w.arm_replay()
+        out2, dropped2 = w.filter(b)
+        assert dropped2 == 64 and out2.n_rows == 32
+
+    def test_armed_full_replay_dropped(self):
+        # the failure hit after the whole batch landed: the replay is
+        # an exact repeat and drops wholesale
+        w = DedupWindow()
+        b = _batch(0, 64)
+        w.filter(b)
+        w.arm_replay()
+        out, dropped = w.filter(b)
+        assert dropped == 64 and out.n_rows == 0
+
+    def test_unarmed_identical_batches_kept(self):
+        # constant-valued tables emit genuinely identical consecutive
+        # batches — source multiplicity, not replay.  Nothing failed
+        # (window never armed), so nothing may drop.
+        w = DedupWindow()
+        b = _batch(0, 64)
+        w.filter(b)
+        out, dropped = w.filter(b)
+        assert dropped == 0 and out.n_rows == 64
+
+    def test_armed_non_prefix_not_dropped(self):
+        # the failed push never landed (fault upstream of staging):
+        # the retried batch matches no staged prefix, stages in full
+        w = DedupWindow()
+        w.filter(_batch(0, 64))
+        w.arm_replay()
+        out, dropped = w.filter(_batch(200, 64))
+        assert dropped == 0 and out.n_rows == 64
+
+    def test_cross_batch_content_duplicates_kept(self):
+        # PK-less duplicates: rows content-identical to EARLIER staged
+        # rows arrive in a different batch — even armed, that is not
+        # an ordered prefix replay and must survive to publish
+        from transferia_tpu.columnar.batch import ColumnBatch
+
+        b = _batch(0, 64)
+        w = DedupWindow()
+        w.filter(b)
+        mixed = ColumnBatch.concat([_batch(200, 32), b.slice(0, 8)])
+        w.arm_replay()
+        out, dropped = w.filter(mixed)
+        assert dropped == 0 and out.n_rows == 40
+
+    def test_multi_tear_drops_each_landed_prefix(self):
+        # tear at 32, retry tears again at 64, final retry completes
+        b = _batch(0, 96)
+        w = DedupWindow()
+        w.filter(b.slice(0, 32))
+        w.arm_replay()
+        out, d = w.filter(b.slice(0, 64))
+        assert d == 32 and out.n_rows == 32
+        w.arm_replay()
+        out, d = w.filter(b)
+        assert d == 64 and out.n_rows == 32
+
+    def test_arm_not_consumed_by_control_batch(self):
+        from transferia_tpu.abstract.change_item import init_table_load
+
+        w = DedupWindow()
+        b = _batch(0, 64)
+        w.filter(b)
+        w.arm_replay()
+        ctl = [init_table_load(TID, None, 0)]
+        out, dropped = w.filter(ctl)
+        assert out is ctl and dropped == 0     # controls pass through
+        out2, d2 = w.filter(b)                 # ...and keep the arm
+        assert d2 == 64 and out2.n_rows == 0
+
+    def test_intra_batch_duplicates_kept(self):
+        # duplicates WITHIN one push are source content, not replay
+        from transferia_tpu.columnar.batch import ColumnBatch
+
+        b = _batch(0, 16)
+        doubled = ColumnBatch.concat([b, b])
+        w = DedupWindow()
+        out, dropped = w.filter(doubled)
+        assert dropped == 0 and out.n_rows == 32
+
+
+class TestPartStage:
+    def test_stage_accounts_and_buffers(self):
+        st = PartStage("p0", 1, hold=True)
+        st.stage(_batch(0, 64))
+        st.note_push_retry()     # Retrier signal before the replay
+        st.stage(_batch(0, 64))  # replay: dropped, empty slice staged
+        assert st.rows == 64
+        assert st.dedup_dropped == 64
+
+    def test_poisoned_after_downstream_failure(self):
+        # a staging write died AFTER the window recorded the batch: a
+        # push-level retry would silently lose the unwritten suffix,
+        # so the stage must refuse until the part restages
+        st = PartStage("p0", 1, hold=False)
+        st.stage(_batch(0, 64))
+        st.mark_failed()
+        with pytest.raises(ConnectionError, match="poisoned"):
+            st.stage(_batch(0, 64))
+
+
+class TestEpochFence:
+    def test_fence_semantics(self):
+        f = EpochFence()
+        assert f.check_and_advance("p0", 2) is None
+        assert f.check_and_advance("p0", 2) == 2   # idempotent republish
+        assert f.check_and_advance("p0", 3) == 2   # superseding owner
+        with pytest.raises(StaleEpochPublishError) as ei:
+            f.check_and_advance("p0", 1)           # zombie
+        assert ei.value.epoch == 1 and ei.value.published_epoch == 3
+        assert f.published_epoch("p0") == 3
+
+    def test_stale_publish_not_retriable(self):
+        # retrying would re-offer the same dead epoch forever
+        assert not is_retriable(StaleEpochPublishError("p0", 1, 2))
+
+
+# -- memory sink -------------------------------------------------------------
+
+class TestMemorySinkStaging:
+    def _sinker(self, sink_id):
+        store = get_store(sink_id)
+        store.clear()
+        return MemorySinker(MemoryTargetParams(sink_id=sink_id)), store
+
+    def test_staged_invisible_until_publish(self):
+        s, store = self._sinker("staged-vis")
+        s.begin_part("p0", 1)
+        s.push(_batch(0, 64))
+        assert store.row_count() == 0           # invisible while staged
+        assert store.staged_keys() == ["p0"]
+        assert s.publish_part("p0", 1) == 64
+        assert store.row_count() == 64
+        assert store.staged_keys() == []
+
+    def test_republish_replaces_not_appends(self):
+        # part retry against the memory sink must REPLACE, mirroring
+        # the Flight shard server's replace-on-reput semantics
+        s, store = self._sinker("staged-replace")
+        s.begin_part("p0", 1)
+        s.push(_batch(0, 64))
+        s.publish_part("p0", 1)
+        assert store.row_count() == 64
+        s.begin_part("p0", 1)                   # retried part restages
+        s.push(_batch(0, 64))
+        s.publish_part("p0", 1)
+        assert store.row_count() == 64          # replaced, not appended
+
+    def test_higher_epoch_publish_supersedes(self):
+        s, store = self._sinker("staged-super")
+        s.begin_part("p0", 1)
+        s.push(_batch(0, 64))
+        s.publish_part("p0", 1)
+        s.begin_part("p0", 2)                   # the part was stolen
+        s.push(_batch(100, 32))
+        s.publish_part("p0", 2)
+        assert store.row_count() == 32          # survivor's data only
+
+    def test_stale_epoch_publish_rejected(self):
+        s, store = self._sinker("staged-stale")
+        s.begin_part("p0", 2)
+        s.push(_batch(0, 64))
+        s.publish_part("p0", 2)                 # survivor published
+        z = MemorySinker(MemoryTargetParams(sink_id="staged-stale"))
+        z.begin_part("p0", 1)                   # zombie stages aside
+        z.push(_batch(100, 64))
+        assert store.row_count() == 64          # staging never leaked
+        with pytest.raises(StaleEpochPublishError):
+            z.publish_part("p0", 1)
+        assert store.row_count() == 64          # survivor's rows intact
+
+    def test_abort_discards_stage(self):
+        s, store = self._sinker("staged-abort")
+        s.begin_part("p0", 1)
+        s.push(_batch(0, 64))
+        s.abort_part("p0")
+        assert store.row_count() == 0
+        assert store.staged_keys() == []
+
+    def test_dedup_window_inside_stage(self):
+        s, store = self._sinker("staged-dedup")
+        s.begin_part("p0", 1)
+        b = _batch(0, 96)
+        s.push(b.slice(0, 64))                  # torn prefix landed
+        s.note_push_retry()                     # Retrier re-push signal
+        s.push(b)                               # replay of the batch
+        assert s.publish_part("p0", 1) == 96
+        assert s.last_dedup_dropped == 64
+        assert store.row_count() == 96
+
+    def test_unarmed_pushes_never_dedup(self):
+        # identical consecutive batches with no failure in between are
+        # source multiplicity and must all publish
+        s, store = self._sinker("staged-nodedup")
+        s.begin_part("p0", 1)
+        b = _batch(0, 64)
+        s.push(b)
+        s.push(b)
+        assert s.publish_part("p0", 1) == 128
+        assert s.last_dedup_dropped == 0
+        assert store.row_count() == 128
+
+
+# -- directory staging (fs / arrow_ipc) --------------------------------------
+
+class TestDirectoryStaging:
+    def _sinker(self, path):
+        from transferia_tpu.providers.file import (
+            FileSinker,
+            FileTargetParams,
+        )
+
+        return FileSinker(FileTargetParams(path=str(path),
+                                           format="jsonl"))
+
+    def test_staged_invisible_publish_renames(self, tmp_path):
+        s = self._sinker(tmp_path)
+        s.begin_part("op/s.t/0", 1)
+        s.push(_batch(0, 64))
+        visible = [f for f in os.listdir(tmp_path)
+                   if not f.startswith(".")]
+        assert visible == []                    # dotdir staging only
+        rows = s.publish_part("op/s.t/0", 1)
+        assert rows == 64
+        published = [f for f in os.listdir(tmp_path)
+                     if ".part-" in f]
+        assert published                        # part-keyed names
+
+    def test_republish_replaces_files(self, tmp_path):
+        key = "op/s.t/0"
+        for epoch in (1, 1, 2):                 # retry, retry, steal
+            s = self._sinker(tmp_path)
+            s.begin_part(key, epoch)
+            s.push(_batch(0, 64))
+            s.publish_part(key, epoch)
+        published = [f for f in os.listdir(tmp_path)
+                     if f".part-{part_slug(key)}." in f]
+        assert len(published) == 1              # replaced every time
+
+    def test_marker_fence_rejects_stale_epoch(self, tmp_path):
+        key = "op/s.t/0"
+        s = self._sinker(tmp_path)
+        s.begin_part(key, 3)
+        s.push(_batch(0, 64))
+        s.publish_part(key, 3)
+        z = self._sinker(tmp_path)
+        z.begin_part(key, 1)
+        z.push(_batch(100, 64))
+        with pytest.raises(StaleEpochPublishError):
+            z.publish_part(key, 1)
+        # survivor's published file untouched
+        assert [f for f in os.listdir(tmp_path) if ".part-" in f]
+
+    def test_close_with_open_stage_aborts(self, tmp_path):
+        s = self._sinker(tmp_path)
+        s.begin_part("op/s.t/0", 1)
+        s.push(_batch(0, 64))
+        s.close()                               # abandoned attempt
+        assert [f for f in os.listdir(tmp_path)
+                if not f.startswith(".")] == []
+
+    def test_poisoned_stage_after_write_failure(self, tmp_path):
+        class _Boom:
+            def push(self, batch):
+                raise OSError("disk full")
+
+            def close(self):
+                pass
+
+        stage = DirectoryPartStage(str(tmp_path), "p0", 1,
+                                   lambda d: _Boom())
+        with pytest.raises(OSError):
+            stage.push(_batch(0, 64))
+        with pytest.raises(ConnectionError, match="poisoned"):
+            stage.push(_batch(0, 64))
+        stage.abort()
+
+
+# -- mq sink -----------------------------------------------------------------
+
+class TestMQSinkStaging:
+    def _sinker(self, broker_id):
+        from transferia_tpu.providers.mq import (
+            MQSinker,
+            MQTargetParams,
+            get_broker,
+        )
+
+        broker = get_broker(broker_id)
+        broker.topics.clear()
+        broker.published_parts.clear()
+        return MQSinker(MQTargetParams(broker_id=broker_id,
+                                       topic="t")), broker
+
+    def test_publish_transactional_replace(self):
+        s, broker = self._sinker("staged-mq")
+        s.begin_part("p0", 1)
+        s.push(_batch(0, 64))
+        assert broker.size("t") == 0            # buffered sink-side
+        assert s.publish_part("p0", 1) == 64
+        assert broker.size("t") == 64
+        s.begin_part("p0", 1)                   # part retry
+        s.push(_batch(0, 64))
+        s.publish_part("p0", 1)
+        assert broker.size("t") == 64           # replaced, not appended
+
+    def test_republish_preserves_committed_offsets(self):
+        # a consumer group that committed offsets through the first
+        # publish must not lose or skip messages when the part
+        # republishes: superseded entries tombstone IN PLACE
+        s, broker = self._sinker("staged-mq-off")
+        s.begin_part("p0", 1)
+        s.push(_batch(0, 8))
+        s.publish_part("p0", 1)
+        msgs = broker.fetch_from("t", 0, 0, 100)
+        assert len(msgs) == 8
+        broker.commit("g", "t", 0, msgs[-1].offset)
+        tail = msgs[-1].offset + 1
+        s.begin_part("p0", 1)                  # part retry republishes
+        s.push(_batch(0, 8))
+        s.publish_part("p0", 1)
+        after = broker.fetch_from("t", 0, tail, 100)
+        assert len(after) == 8                 # the fresh copies only
+        assert all(m.offset >= tail for m in after)
+        assert broker.size("t") == 8           # tombstones not counted
+
+    def test_stale_epoch_rejected(self):
+        s, broker = self._sinker("staged-mq-fence")
+        s.begin_part("p0", 2)
+        s.push(_batch(0, 64))
+        s.publish_part("p0", 2)
+        z = type(s)(s.params)
+        z.begin_part("p0", 1)
+        z.push(_batch(100, 64))
+        with pytest.raises(StaleEpochPublishError):
+            z.publish_part("p0", 1)
+        assert broker.size("t") == 64
+
+
+# -- capability probe --------------------------------------------------------
+
+class TestFindStagedSink:
+    def test_walks_real_async_chain(self):
+        from transferia_tpu.factories import make_async_sink
+
+        store = get_store("staged-probe")
+        store.clear()
+        t = Transfer(
+            id="staged-probe", type=TransferType.SNAPSHOT_ONLY,
+            src=SampleSourceParams(preset="users", rows=64),
+            dst=MemoryTargetParams(sink_id="staged-probe"))
+        sink = make_async_sink(t, snapshot_stage=True)
+        try:
+            raw = find_staged_sink(sink)
+            assert isinstance(raw, MemorySinker)
+        finally:
+            sink.close()
+
+    def test_non_capable_sink_returns_none(self):
+        class Plain:
+            pass
+
+        class Wrapper:
+            inner = Plain()
+
+        assert find_staged_sink(Wrapper()) is None
+
+    def test_capability_gate_respected(self):
+        # a StagedSinker whose current config cannot stage is skipped
+        class Gated(StagedSinker):
+            def staged_commit_available(self):
+                return False
+
+            def begin_part(self, key, epoch):
+                pass
+
+            def publish_part(self, key, epoch):
+                return 0
+
+            def abort_part(self, key):
+                pass
+
+        assert find_staged_sink(Gated()) is None
+
+
+# -- coordinator commit_part across backends ---------------------------------
+
+@pytest.fixture(params=["memory", "filestore", "s3"])
+def cp3(request, tmp_path):
+    if request.param == "memory":
+        yield MemoryCoordinator()
+        return
+    if request.param == "filestore":
+        yield FileStoreCoordinator(root=str(tmp_path / "cp"))
+        return
+    from tests.recipes.fake_s3 import FakeS3
+
+    fake = FakeS3(conditional_writes=True, page_size=3).start()
+    try:
+        yield S3Coordinator(
+            bucket="cp-bucket", endpoint=fake.endpoint,
+            access_key="test-ak", secret_key="test-sk")
+    finally:
+        fake.stop()
+
+
+def _one_part(op="op-commit"):
+    return [OperationTablePart(operation_id=op, table_id=TableID("s", "t"),
+                               part_index=0, parts_count=1)]
+
+
+class TestCommitPartFencing:
+    """The satellite scenario on every backend: zombie completes after
+    a lease steal, its publish is fenced, the survivor's publish
+    wins."""
+
+    def test_grant_idempotent_and_recorded(self, cp3):
+        cp3.create_operation_parts("op-commit", _one_part())
+        p = cp3.assign_operation_part("op-commit", 1)
+        assert cp3.commit_part("op-commit", p) is True
+        # a worker retrying its publish re-asks: same epoch re-grants
+        assert cp3.commit_part("op-commit", p) is True
+        stored = cp3.operation_parts("op-commit")[0]
+        assert stored.commit_epoch == p.assignment_epoch
+
+    def test_zombie_fenced_survivor_wins(self, cp3):
+        import time as _time
+
+        cp3.lease_seconds = 0.15
+        cp3.create_operation_parts("op-commit", _one_part())
+        zombie = cp3.assign_operation_part("op-commit", 1)
+        _time.sleep(0.3)                        # lease expires
+        survivor = cp3.assign_operation_part("op-commit", 2)
+        assert survivor.assignment_epoch == zombie.assignment_epoch + 1
+        # the zombie wakes and asks to publish its stolen part: denied
+        assert cp3.commit_part("op-commit", zombie) is False
+        # the survivor's publish is granted and recorded
+        assert cp3.commit_part("op-commit", survivor) is True
+        stored = cp3.operation_parts("op-commit")[0]
+        assert stored.commit_epoch == survivor.assignment_epoch
+        # the zombie retrying after the survivor's grant stays fenced
+        assert cp3.commit_part("op-commit", zombie) is False
+
+    def test_unknown_part_never_granted(self, cp3):
+        cp3.create_operation_parts("op-commit", _one_part())
+        ghost = OperationTablePart(
+            operation_id="op-commit", table_id=TableID("s", "t"),
+            part_index=99, parts_count=1)
+        assert cp3.commit_part("op-commit", ghost) is False
+
+    def test_capability_probe(self, cp3):
+        assert cp3.supports_staged_commits()
+
+
+def test_zombie_sink_publish_fenced_after_steal():
+    """End-to-end satellite flow at the SINK layer: the survivor's
+    fenced publish lands, then the zombie — pretending it never heard
+    of the steal — stages and publishes at its dead epoch and must be
+    rejected by the sink's own fence with the survivor's rows
+    intact."""
+    cp = MemoryCoordinator(lease_seconds=0.15)
+    cp.create_operation_parts("op-z", _one_part("op-z"))
+    zombie_part = cp.assign_operation_part("op-z", 1)
+    import time as _time
+
+    _time.sleep(0.3)
+    survivor_part = cp.assign_operation_part("op-z", 2)
+
+    store = get_store("staged-zombie")
+    store.clear()
+    survivor = MemorySinker(MemoryTargetParams(sink_id="staged-zombie"))
+    key = survivor_part.key()
+    survivor.begin_part(key, survivor_part.assignment_epoch)
+    survivor.push(_batch(0, 64))
+    assert cp.commit_part("op-z", survivor_part) is True
+    survivor.publish_part(key, survivor_part.assignment_epoch)
+
+    zombie = MemorySinker(MemoryTargetParams(sink_id="staged-zombie"))
+    zombie.begin_part(key, zombie_part.assignment_epoch)
+    zombie.push(_batch(100, 64))
+    assert cp.commit_part("op-z", zombie_part) is False   # coord fence
+    with pytest.raises(StaleEpochPublishError):           # sink fence
+        zombie.publish_part(key, zombie_part.assignment_epoch)
+    assert store.row_count() == 64                        # survivor's
+
+
+# -- engine lifecycle --------------------------------------------------------
+
+class TestEngineLifecycle:
+    def _transfer(self, sink_id, rows=256):
+        return Transfer(
+            id=sink_id, type=TransferType.SNAPSHOT_ONLY,
+            src=SampleSourceParams(preset="users", table="users",
+                                   rows=rows, batch_rows=64),
+            dst=MemoryTargetParams(sink_id=sink_id))
+
+    def test_staged_snapshot_delivers_exactly_once(self):
+        from transferia_tpu.stats.registry import Metrics
+        from transferia_tpu.tasks.snapshot import SnapshotLoader
+
+        store = get_store("staged-engine")
+        store.clear()
+        metrics = Metrics()
+        SnapshotLoader(self._transfer("staged-engine"), MemoryCoordinator(),
+                       metrics=metrics).upload_tables()
+        assert store.row_count() == 256
+        assert store.staged_keys() == []        # nothing left staged
+        assert metrics.value("commit_published_parts") >= 1
+        assert metrics.value("commit_staged_parts") == \
+            metrics.value("commit_published_parts")
+        assert metrics.value("commit_fenced") == 0
+
+    def test_env_kill_switch_forces_legacy_path(self, monkeypatch):
+        from transferia_tpu.stats.registry import Metrics
+        from transferia_tpu.tasks.snapshot import (
+            ENV_STAGED_COMMIT,
+            SnapshotLoader,
+            staged_commits_enabled,
+        )
+
+        assert not staged_commits_enabled({ENV_STAGED_COMMIT: "off"})
+        assert staged_commits_enabled({ENV_STAGED_COMMIT: "auto"})
+        assert staged_commits_enabled({})
+        monkeypatch.setenv(ENV_STAGED_COMMIT, "off")
+        store = get_store("staged-legacy")
+        store.clear()
+        metrics = Metrics()
+        SnapshotLoader(self._transfer("staged-legacy"), MemoryCoordinator(),
+                       metrics=metrics).upload_tables()
+        assert store.row_count() == 256         # at-least-once path
+        assert metrics.value("commit_staged_parts") == 0
+
+    def test_torn_retry_dedups_through_real_chain(self, monkeypatch):
+        # the full middleware stack: a torn write lands a prefix at the
+        # raw sink, the Retrier arms the stage and re-pushes, and the
+        # dedup window drops exactly the landed prefix before publish
+        from transferia_tpu.chaos import failpoints
+        from transferia_tpu.factories import make_async_sink
+        from transferia_tpu.middlewares import sync as sync_mod
+
+        monkeypatch.setattr(sync_mod, "RETRY_BASE_DELAY", 0.01)
+        store = get_store("staged-torn-chain")
+        store.clear()
+        sink = make_async_sink(self._transfer("staged-torn-chain"),
+                               snapshot_stage=True)
+        raw = find_staged_sink(sink)
+        raw.begin_part("p0", 1)
+        try:
+            with failpoints.active(
+                    "sink.push.torn=after:0,times:1,truncate:0.5",
+                    seed=3):
+                sink.async_push(_batch(0, 64)).result()
+            assert raw.publish_part("p0", 1) == 64
+            assert 0 < raw.last_dedup_dropped < 64  # the landed prefix
+            assert store.row_count() == 64
+        finally:
+            sink.close()
+
+    def test_legacy_coordinator_keeps_at_least_once(self):
+        # a coordinator without commit_part: capability probe says no,
+        # the engine never opens the staged lifecycle
+        from transferia_tpu.coordinator.interface import Coordinator
+
+        class Legacy(MemoryCoordinator):
+            commit_part = Coordinator.commit_part
+
+        cp = Legacy()
+        assert not cp.supports_staged_commits()
+        from transferia_tpu.stats.registry import Metrics
+        from transferia_tpu.tasks.snapshot import SnapshotLoader
+
+        store = get_store("staged-legacy-cp")
+        store.clear()
+        metrics = Metrics()
+        SnapshotLoader(self._transfer("staged-legacy-cp"), cp,
+                       metrics=metrics).upload_tables()
+        assert store.row_count() == 256
+        assert metrics.value("commit_staged_parts") == 0
+
+
+# -- flight wire fence -------------------------------------------------------
+
+@pytest.mark.requires_pyarrow
+def test_flight_stale_epoch_put_fenced():
+    from transferia_tpu.interchange.convert import batch_to_arrow
+    from transferia_tpu.interchange.flight import (
+        FlightShardClient,
+        ShardFlightServer,
+        raise_if_stale_epoch,
+    )
+
+    b = make_batch("iot", TableID("sample", "events"), 0, 100, 7)
+    rb = batch_to_arrow(b)
+    with ShardFlightServer() as srv:
+        with FlightShardClient(srv.location, allow_shm=False) as cli:
+            def put(epoch, start):
+                rb2 = batch_to_arrow(
+                    make_batch("iot", TableID("sample", "events"),
+                               start, 100, 7))
+                with cli.begin_put("sample.events/p0", rb2.schema,
+                                   epoch=epoch) as w:
+                    w.write_batch(rb2)
+
+            put(2, 0)                           # survivor publishes
+            put(2, 100)                         # idempotent republish
+            with pytest.raises(Exception) as ei:
+                put(1, 200)                     # zombie fenced
+            with pytest.raises(StaleEpochPublishError):
+                raise_if_stale_epoch(ei.value, "sample.events/p0", 1)
+            # the server-side direct publish fences the same way
+            with pytest.raises(StaleEpochPublishError):
+                srv.publish("sample.events/p0", [rb], epoch=1)
+            # survivor's stream still serves its own (newest) data
+            got = cli.get_part("sample.events/p0")
+            assert sum(g.n_rows for g in got) == 100

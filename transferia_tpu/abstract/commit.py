@@ -1,0 +1,120 @@
+"""Staged two-phase sink commit contract (exactly-once delivery).
+
+The at-least-once contract (bounded duplication under retries, proved
+by the chaos auditor) upgrades to exactly-once for sinks that can
+stage: batches land in a **staging area** keyed by the part's
+`(operation, part, assignment_epoch)` and become visible only after a
+single coordinator-fenced `commit_part` decision grants the publish.
+
+Lifecycle (driven by the snapshot engine, tasks/snapshot.py):
+
+    begin_part(key, epoch)      # open/replace the part's staging area
+    push(...)*                  # batches stage (dedup window applied)
+    -- coordinator.commit_part(operation, part) --   epoch-fenced
+    publish_part(key, epoch)    # granted: staged data becomes visible
+    abort_part(key)             # fenced/failed: staged data discarded
+
+Invariants every implementation must uphold:
+
+- **stage replaces**: `begin_part` for a key discards anything
+  previously staged under that key — a retried part restages from
+  scratch and can never append duplicates into staging;
+- **publish replaces**: publishing a part key REPLACES any previously
+  published data for that key (the Flight shard server's
+  replace-on-reput semantics generalized) — an idempotent republish of
+  the same `(part, epoch)` is a no-op-equivalent, and a newer epoch's
+  publish supersedes an older one;
+- **publish fences**: a publish whose epoch is OLDER than the last
+  accepted publish for the key raises
+  `abstract.errors.StaleEpochPublishError` — a zombie that somehow got
+  past the coordinator fence (grant raced a steal) still cannot
+  clobber the survivor's published data;
+- **staged data is invisible**: nothing staged may be observable
+  through the sink's read/storage surface before `publish_part`.
+
+Sinks without the capability keep the existing at-least-once path with
+its bounded-duplication guarantee unchanged — `begin_part` is simply
+never called on them.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Optional
+
+from transferia_tpu.abstract.interfaces import Sinker
+
+
+class StagedSinker(abc.ABC):
+    """Capability mixin for sinks that support the staged two-phase
+    commit.  A sink both inherits this AND answers
+    `staged_commit_available()` (some modes of a sink cannot stage,
+    e.g. a single-shot pipe target)."""
+
+    supports_staged_commit = True
+
+    # rows the dedup window dropped during the most recent
+    # `publish_part` (replayed torn-write prefixes suppressed before
+    # visibility); implementations set it as they publish and the
+    # engine folds it into CommitStats
+    last_dedup_dropped: int = 0
+
+    def staged_commit_available(self) -> bool:
+        """True when THIS instance/configuration can stage (default).
+        Checked by the engine before `begin_part`."""
+        return True
+
+    @abc.abstractmethod
+    def begin_part(self, key: str, epoch: int) -> None:
+        """Open the staging area for a part under an assignment epoch,
+        replacing anything previously staged for `key`."""
+
+    @abc.abstractmethod
+    def publish_part(self, key: str, epoch: int) -> int:
+        """Make the staged data visible, replacing any previously
+        published data for `key`.  Returns rows published.  Raises
+        StaleEpochPublishError when `epoch` is older than the last
+        accepted publish for `key`."""
+
+    @abc.abstractmethod
+    def abort_part(self, key: str) -> None:
+        """Discard the staging area for `key` (fenced or failed part).
+        Idempotent; unknown keys are a no-op."""
+
+    def note_push_retry(self) -> None:
+        """Called by the sink Retrier right before it re-pushes a
+        FAILED batch: arms the open stage's dedup window so a replayed
+        torn-write prefix is recognized (the window only ever drops
+        when armed — an unarmed push can never be a replay).  No open
+        stage = no-op."""
+
+
+# wrapper attributes the middleware/async layers use to hold the next
+# sink down; walked in order by find_staged_sink
+_INNER_ATTRS = ("inner", "_sinker", "sinker", "_inner")
+
+
+def find_staged_sink(sink) -> Optional[StagedSinker]:
+    """Walk a middleware/async sink chain down to the raw sink and
+    return it when it is a staging-capable StagedSinker (and its
+    current configuration can stage), else None.
+
+    The stage/publish lifecycle is a property of the RAW sink (the
+    staging area lives in the target), so the engine needs the bottom
+    of the chain; middlewares transparently forward pushes and never
+    interpose on the commit protocol."""
+    seen = set()
+    cur = sink
+    while cur is not None and id(cur) not in seen:
+        seen.add(id(cur))
+        if isinstance(cur, StagedSinker):
+            return cur if cur.staged_commit_available() else None
+        nxt = None
+        for attr in _INNER_ATTRS:
+            cand = getattr(cur, attr, None)
+            if cand is not None and (isinstance(cand, (Sinker, StagedSinker))
+                                     or hasattr(cand, "async_push")):
+                nxt = cand
+                break
+        cur = nxt
+    return None

@@ -30,6 +30,23 @@ class WorkerKilledError(TransferError):
     it after expiry — retrying locally would mask the death."""
 
 
+class StaleEpochPublishError(TransferError):
+    """A staged-commit publish carried an assignment epoch older than
+    the sink's last accepted publish for the part: a zombie worker woke
+    after its lease expired, the part was reclaimed, and the new owner
+    already published.  Deliberately NOT retriable — retrying would
+    re-offer the same dead epoch; the engine drops the stale result the
+    same way it drops an epoch-fenced coordinator update."""
+
+    def __init__(self, key: str, epoch: int, published_epoch: int):
+        super().__init__(
+            f"stale publish of {key!r}: epoch {epoch} <= already "
+            f"published epoch {published_epoch}")
+        self.key = key
+        self.epoch = epoch
+        self.published_epoch = published_epoch
+
+
 class CodedError(TransferError):
     """Error with a stable code (pkg/errors/coded)."""
 
@@ -92,7 +109,8 @@ def is_fatal(err: BaseException) -> bool:
 # the same traceback.  Walked through the cause chain like is_fatal, so
 # a TableUploadError wrapping a TypeError fails fast too.
 _NON_RETRIABLE_TYPES = (TypeError, AttributeError, NameError, KeyError,
-                        IndexError, AssertionError, WorkerKilledError)
+                        IndexError, AssertionError, WorkerKilledError,
+                        StaleEpochPublishError)
 
 
 def is_worker_kill(err: BaseException) -> bool:

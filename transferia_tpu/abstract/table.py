@@ -58,6 +58,11 @@ class OperationTablePart:
     assignment_epoch: int = 0
     lease_expires_at: float = 0.0
     stolen_from: Optional[int] = None
+    # staged two-phase commit (abstract/commit.py): the assignment
+    # epoch under which the coordinator granted this part's publish
+    # (Coordinator.commit_part); None = never granted.  Audit trail —
+    # the grant itself is fenced against assignment_epoch at grant time.
+    commit_epoch: Optional[int] = None
     # inline-validation digest of this part's post-transform rows
     # (FingerprintAggregate.digest(); merged per table at read time —
     # per-part writes keep the coordinator update race-free)
@@ -95,6 +100,7 @@ class OperationTablePart:
             "assignment_epoch": self.assignment_epoch,
             "lease_expires_at": self.lease_expires_at,
             "stolen_from": self.stolen_from,
+            "commit_epoch": self.commit_epoch,
             "fingerprint": self.fingerprint,
         }
 
@@ -115,6 +121,7 @@ class OperationTablePart:
             assignment_epoch=d.get("assignment_epoch", 0),
             lease_expires_at=d.get("lease_expires_at", 0.0),
             stolen_from=d.get("stolen_from"),
+            commit_epoch=d.get("commit_epoch"),
             fingerprint=d.get("fingerprint", ""),
         )
 

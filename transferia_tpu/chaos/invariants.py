@@ -22,7 +22,13 @@ a faulted run:
   different assignment epochs — a reclaimed part is completed exactly
   once, by its latest owner, and a zombie's stale-epoch completion is
   rejected (`fencing_violations` over the accepted-completion log the
-  `AuditingCoordinator` records).
+  `AuditingCoordinator` records);
+- **exactly-once** (staged-commit sinks only, `exactly_once=True`):
+  the delivered multiset EQUALS the reference multiset — every row key
+  appears exactly as many times as the fault-free run produced it, no
+  duplicate survives the stage → fenced-publish pipeline
+  (ARCHITECTURE.md "Exactly-once commits").  The bounded-duplication
+  check collapses to multiplicity == reference multiplicity.
 
 Row identity reuses the fingerprint canonicalization itself
 (`ops/rowhash.row_lanes`): a row's key is its two finalized 32-bit
@@ -42,26 +48,13 @@ from collections import Counter
 from dataclasses import dataclass, field
 from typing import Any, Optional
 
-import numpy as np
-
 from transferia_tpu.abstract.interfaces import is_columnar
 from transferia_tpu.columnar.batch import ColumnBatch
 from transferia_tpu.coordinator.interface import Coordinator
 from transferia_tpu.ops.rowhash import (
     FingerprintAggregate,
-    prep_batch,
-    row_lanes,
+    batch_row_keys,
 )
-
-
-def batch_row_keys(batch: ColumnBatch) -> np.ndarray:
-    """64-bit content keys, one per row, under the fingerprint
-    canonicalization (see ops/rowhash.row_lanes)."""
-    if batch.n_rows == 0:
-        return np.empty(0, dtype=np.uint64)
-    cols, n = prep_batch(batch)
-    r1, r2 = row_lanes(cols, n)
-    return (r1.astype(np.uint64) << np.uint64(32)) | r2.astype(np.uint64)
 
 
 def keys_fingerprint(counter: "Counter[int]") -> FingerprintAggregate:
@@ -154,12 +147,38 @@ class AuditVerdict:
 def audit_delivery(reference: DeliveryReference, observed_batches,
                    max_multiplicity: int,
                    checkpoints: Optional["MonotonicityTracker"] = None,
+                   exactly_once: bool = False,
                    ) -> AuditVerdict:
     """Check every delivery invariant of a faulted run against the
     fault-free reference.  `max_multiplicity` is the retry-machinery
-    bound the caller derives from its run (attempts x retries)."""
+    bound the caller derives from its run (attempts x retries).
+    `exactly_once=True` (staged-commit capable sinks) tightens the
+    duplication bound to zero: observed multiplicity must EQUAL the
+    reference multiplicity per row key."""
     observed = _batches_to_counter(observed_batches)
     violations: list[Violation] = []
+
+    if exactly_once:
+        extra = {k: n for k, n in observed.items()
+                 if k in reference.keys and n > reference.keys[k]}
+        if extra:
+            worst_k = max(extra, key=lambda k: extra[k])
+            violations.append(Violation(
+                "exactly-once",
+                f"{len(extra)} row key(s) delivered more often than the "
+                f"reference (worst {extra[worst_k]}x vs "
+                f"{reference.keys[worst_k]}x): a duplicate survived the "
+                f"stage -> fenced-publish pipeline"))
+        # under-delivery of a multiplicity > 1 key: the at-least-once
+        # check below only proves >= 1 copy, exactly-once needs EQUAL
+        under = {k: n for k, n in observed.items()
+                 if k in reference.keys and 0 < n < reference.keys[k]}
+        if under:
+            violations.append(Violation(
+                "exactly-once",
+                f"{len(under)} row key(s) delivered fewer times than "
+                f"the reference: the dedup window or a publish replace "
+                f"dropped legitimate copies"))
 
     missing = {k: n for k, n in reference.keys.items()
                if observed.get(k, 0) < 1}
@@ -282,6 +301,9 @@ class AuditingCoordinator(Coordinator):
         # accepted completions: (part key, assignment_epoch, worker)
         self.completions: list[tuple] = []
         self.fence_rejections = 0
+        # staged-commit decisions: (part key, epoch, granted) — the
+        # per-seed replay surface for exactly_once trials
+        self.commit_log: list[tuple] = []
 
     # -- watched methods ----------------------------------------------------
     def create_operation_parts(self, operation_id, parts):
@@ -301,6 +323,16 @@ class AuditingCoordinator(Coordinator):
         self.tracker.record(f"op:{operation_id}:completed_parts",
                             progress.completed_parts)
         return rejected
+
+    def commit_part(self, operation_id, part):
+        granted = self.inner.commit_part(operation_id, part)
+        with self._lock:
+            self.commit_log.append(
+                (part.key(), part.assignment_epoch, bool(granted)))
+        return granted
+
+    def supports_staged_commits(self):
+        return self.inner.supports_staged_commits()
 
     def set_transfer_state(self, transfer_id, state):
         self.state_writes += 1

@@ -99,6 +99,13 @@ class TrialResult:
     # scheduler_kill mode: the fleet dispatch order (ticket ids) — the
     # per-seed replay surface alongside fire_log/steal_log
     dispatch_order: list = field(default_factory=list)
+    # exactly_once mode: which staged-commit sink backend the trial ran
+    # against, the coordinator's commit-decision log [(part key, epoch,
+    # granted)] — the third per-seed replay surface — and rows the
+    # staging dedup window dropped before publish
+    backend: str = ""
+    commit_log: list = field(default_factory=list)
+    dedup_dropped: int = 0
 
     @property
     def passed(self) -> bool:
@@ -114,6 +121,9 @@ class TrialResult:
             "steal_log": [list(s) for s in self.steal_log],
             "fence_rejected": self.fence_rejected,
             "dispatch_order": list(self.dispatch_order),
+            "backend": self.backend,
+            "commit_log": [list(c) for c in self.commit_log],
+            "dedup_dropped": self.dedup_dropped,
             "fire_counts": {k: v for k, v in self.fire_counts.items()
                             if v},
             "fire_log": {k: v for k, v in self.fire_log.items() if v},
@@ -172,11 +182,25 @@ class ChaosReport:
                 rebalances = sum(len(r.steal_log) for r in rs)
                 line += (f", {kills} worker slot(s) killed, "
                          f"{rebalances} transfer(s) rebalanced")
+            if mode == "exactly_once":
+                kills = sum(r.kills for r in rs)
+                steals = sum(len(r.steal_log) for r in rs)
+                fenced = sum(r.fence_rejected for r in rs)
+                granted = sum(
+                    1 for r in rs for c in r.commit_log if c[2])
+                dedup = sum(r.dedup_dropped for r in rs)
+                backends = sorted({r.backend for r in rs if r.backend})
+                line += (f" [{'/'.join(backends)}], {kills} worker(s) "
+                         f"killed, {steals} part(s) reclaimed, "
+                         f"{granted} publish(es) granted, {fenced} "
+                         f"stale publish(es) fenced, {dedup} replayed "
+                         f"row(s) dropped pre-publish")
             lines.append(line)
             for r in rs:
                 if not r.passed:
-                    lines.append(f"  trial {r.trial} (seed {r.seed}) "
-                                 f"FAILED [{r.spec}]")
+                    lines.append(f"  trial {r.trial} (seed {r.seed}"
+                                 f"{', ' + r.backend if r.backend else ''}"
+                                 f") FAILED [{r.spec}]")
                     for v in r.verdict.violations:
                         lines.append(f"    - {v}")
         fired = self.sites_fired()
@@ -286,7 +310,7 @@ def default_schedule(mode: str, trial: int, seed: int,
 
 # -- snapshot mode -----------------------------------------------------------
 
-def _snapshot_transfer(rows: int, sink_id: str) -> Transfer:
+def _snapshot_transfer(rows: int, sink_id: str, dst=None) -> Transfer:
     from transferia_tpu.providers.memory import MemoryTargetParams
     from transferia_tpu.providers.sample import SampleSourceParams
 
@@ -296,7 +320,8 @@ def _snapshot_transfer(rows: int, sink_id: str) -> Transfer:
         src=SampleSourceParams(preset="iot", table="events", rows=rows,
                                batch_rows=max(64, rows // 8),
                                shard_parts=4),
-        dst=MemoryTargetParams(sink_id=sink_id),
+        dst=dst if dst is not None else MemoryTargetParams(
+            sink_id=sink_id),
         transformation={"transformers": [
             {"mask_field": {"columns": ["device_id"], "salt": "chaos"}},
             {"filter_rows": {"filter": "temperature > -1000"}},
@@ -669,6 +694,339 @@ def run_worker_crash_trial(trial: int, seed: int, rows: int,
                        fence_rejected=fence_rejected)
 
 
+# -- exactly_once mode -------------------------------------------------------
+#
+# The staged two-phase commit gauntlet (abstract/commit.py,
+# ARCHITECTURE.md "Exactly-once commits"): the worker_crash scenario —
+# a victim secondary killed at a seeded point, the survivor reclaiming
+# through the real steal path, a zombie replay fenced — run against
+# staged-commit capable sinks with torn writes and transient
+# stage/publish/commit-RPC faults armed, and the delivery audit
+# TIGHTENED to exactly-once: the delivered multiset must EQUAL the
+# fault-free reference (zero duplicate AND zero lost row keys).
+#
+# Each trial runs per backend — the in-memory store and (with pyarrow)
+# the arrow_ipc staging-directory sink — and replays identically under
+# a seed on three surfaces: the failpoint fire log, the steal log, and
+# the coordinator's commit-decision log.  The zombie replay is proved
+# at BOTH fences: the coordinator's `commit_part` denies the stale
+# epoch, and a direct sink-layer publish at the stale epoch raises
+# StaleEpochPublishError instead of clobbering the survivor's data.
+
+EXACTLY_ONCE_BACKENDS = ("memory", "arrow_ipc")
+
+
+def exactly_once_schedule(trial: int, seed: int, backend: str) -> str:
+    """Seed-derived spec: one torn write into staging (the dedup window
+    must drop the replayed prefix), a victim kill either mid-part or
+    mid-publish, and (sometimes) transient staging / commit-RPC faults
+    the retry machinery must absorb by restaging from scratch."""
+    rng = random.Random(f"{seed}:exactly_once:{backend}:{trial}")
+    frac = rng.choice((0.25, 0.5, 0.75))
+    clauses = [
+        f"sink.push.torn=after:{rng.randrange(0, 4)},times:1,"
+        f"truncate:{frac}",
+    ]
+    if rng.random() < 0.5:
+        # mid-part kill: the victim dies between staged batches
+        clauses.append(
+            f"snapshot.part.batch=after:{rng.randrange(0, 6)},times:1,"
+            f"raise:WorkerKilledError")
+    else:
+        # mid-publish kill: the victim dies between the coordinator's
+        # grant and visibility — nothing of its part may be seen
+        clauses.append(
+            f"sink.publish=after:{rng.randrange(0, 3)},times:1,"
+            f"raise:WorkerKilledError")
+    if rng.random() < 0.5:
+        clauses.append(
+            f"sink.stage=after:{rng.randrange(0, 4)},times:1,"
+            f"raise:ChaosInjectedError")
+    if rng.random() < 0.5:
+        clauses.append(
+            f"coordinator.commit_part=after:{rng.randrange(0, 3)},"
+            f"times:1,raise:ChaosInjectedError")
+    return ";".join(clauses)
+
+
+def _read_ipc_dir(path: str) -> list:
+    """Published batches of an arrow_ipc directory target (the
+    `.staging` dotdir is invisible by construction)."""
+    from transferia_tpu.interchange import ipc
+
+    batches = []
+    for fname in sorted(os.listdir(path)):
+        full = os.path.join(path, fname)
+        if not fname.endswith(".arrows") or not os.path.isfile(full):
+            continue
+        with open(full, "rb") as fh:
+            batches.extend(list(ipc.iter_stream(fh)))
+    return batches
+
+
+def _exactly_once_dst(backend: str, sink_id: str, outdir: Optional[str]):
+    if backend == "memory":
+        from transferia_tpu.providers.memory import MemoryTargetParams
+
+        return MemoryTargetParams(sink_id=sink_id)
+    from transferia_tpu.providers.arrow_ipc import ArrowIpcTargetParams
+
+    return ArrowIpcTargetParams(path=outdir + os.sep)
+
+
+def _exactly_once_reference(rows: int, backend: str) -> DeliveryReference:
+    import shutil
+    import tempfile
+
+    if backend == "memory":
+        return _snapshot_reference(rows)
+    outdir = tempfile.mkdtemp(prefix="chaos-eo-ref-")
+    try:
+        t = _snapshot_transfer(
+            rows, "", dst=_exactly_once_dst(backend, "", outdir))
+        _run_snapshot_once(t, MemoryCoordinator())
+        return DeliveryReference.from_batches(_read_ipc_dir(outdir))
+    finally:
+        shutil.rmtree(outdir, ignore_errors=True)
+
+
+def run_exactly_once_trial(trial: int, seed: int, rows: int,
+                           reference: DeliveryReference,
+                           backend: str = "memory",
+                           spec: Optional[str] = None) -> TrialResult:
+    import shutil
+    import tempfile
+
+    from transferia_tpu.abstract.errors import (
+        StaleEpochPublishError,
+        is_worker_kill,
+    )
+    from transferia_tpu.abstract.table import OperationTablePart
+    from transferia_tpu.chaos.invariants import fencing_violations
+    from transferia_tpu.factories import new_storage
+    from transferia_tpu.middlewares.sync import SINK_PUSH_ATTEMPTS
+    from transferia_tpu.providers.memory import get_store
+    from transferia_tpu.stats.registry import Metrics
+    from transferia_tpu.tasks.snapshot import PART_RETRIES, SnapshotLoader
+    from transferia_tpu.tasks.table_splitter import split_tables
+
+    sink_id = f"chaos-eo-{backend}-trial"
+    outdir = None
+    store = None
+    if backend == "memory":
+        store = get_store(sink_id)
+        store.clear()
+    else:
+        outdir = tempfile.mkdtemp(prefix="chaos-eo-ipc-")
+    spec = spec if spec is not None else exactly_once_schedule(
+        trial, seed, backend)
+    tracker = MonotonicityTracker()
+    cp = AuditingCoordinator(
+        MemoryCoordinator(lease_seconds=TRIAL_LEASE_SECONDS), tracker)
+    op_id = f"op-chaos-eo-{backend}"
+    metrics = Metrics()
+
+    def mk_transfer(job: int):
+        t = _snapshot_transfer(
+            rows, sink_id,
+            dst=_exactly_once_dst(backend, sink_id, outdir))
+        t.id = "chaos-eo"
+        t.runtime.current_job = job
+        t.runtime.sharding.job_count = 3
+        return t
+
+    def mk_loader(job: int) -> SnapshotLoader:
+        return SnapshotLoader(mk_transfer(job), cp, operation_id=op_id,
+                              metrics=metrics)
+
+    # the main's control-plane role: split and publish the part queue
+    # (the victim then uploads ALONE, so its batch/stage/publish hit
+    # sequence — and which part is mid-flight at the kill — replays
+    # exactly under the seed)
+    main_t = mk_transfer(0)
+    storage = new_storage(main_t, metrics)
+    try:
+        tables = mk_loader(0).filtered_table_list(storage)
+        parts = split_tables(storage, tables, main_t, op_id)
+    finally:
+        storage.close()
+    cp.create_operation_parts(op_id, parts)
+    cp.set_operation_state(op_id, {"parts_discovery_done": True})
+
+    def run_loader(job: int, errs: list):
+        try:
+            mk_loader(job).upload_tables()
+        except BaseException as e:
+            errs.append(e)
+
+    violations: list[Violation] = []
+    kills = 0
+    fence_rejected = 0
+    t0 = time.monotonic()
+    try:
+        with failpoints.active(spec, seed=seed * 1000 + trial):
+            # phase 1: the victim secondary stages/publishes alone
+            # until the armed kill fires (mid-part or mid-publish)
+            victim_errs: list = []
+            vt = threading.Thread(target=run_loader,
+                                  args=(1, victim_errs),
+                                  name="chaos-eo-victim", daemon=True)
+            vt.start()
+            vt.join(TRIAL_TIMEOUT)
+            victim_killed = bool(victim_errs) and is_worker_kill(
+                victim_errs[0])
+            kills = int(victim_killed)
+            if victim_errs and not victim_killed:
+                violations.append(Violation(
+                    "run-completed",
+                    f"victim died of a non-kill error: "
+                    f"{victim_errs[0]}"))
+            inflight = [p for p in cp.operation_parts(op_id)
+                        if not p.completed and p.worker_index == 1]
+            # phase 2: the survivor drains the rest, stealing the
+            # victim's parts on lease expiry and REPLACING whatever the
+            # victim staged or published for them
+            survivor_errs: list = []
+            st = threading.Thread(target=run_loader,
+                                  args=(2, survivor_errs),
+                                  name="chaos-eo-survivor", daemon=True)
+            st.start()
+            st.join(TRIAL_TIMEOUT)
+            if survivor_errs:
+                violations.append(Violation(
+                    "run-completed",
+                    f"survivor failed: {survivor_errs[0]}"))
+            # phase 3: the sharded main's lease-aware join
+            try:
+                mk_loader(0)._wait_all_parts_done()
+            except Exception as e:
+                violations.append(Violation(
+                    "main-join", f"main wait failed: {e}"))
+            # phase 4: zombie replay, fenced at every layer.
+            for p in inflight:
+                cur = next((c for c in cp.operation_parts(op_id)
+                            if c.key() == p.key()), None)
+                if cur is None or cur.assignment_epoch <= \
+                        p.assignment_epoch:
+                    continue  # never reclaimed: nothing to fence
+                zombie = OperationTablePart.from_json(p.to_json())
+                # 4a. engine-level completion replay (stale epoch)
+                zombie.completed = True
+                zombie.completed_rows = 1
+                rejected = cp.update_operation_parts(op_id, [zombie])
+                fence_rejected += len(rejected)
+                if not rejected:
+                    violations.append(Violation(
+                        "epoch-fencing",
+                        f"zombie completion of {zombie.key()} (epoch "
+                        f"{zombie.assignment_epoch}) was accepted"))
+                # 4b. the coordinator's commit fence: the publish
+                # decision for the stolen epoch must be denied
+                granted = None
+                for _ in range(5):
+                    try:
+                        granted = cp.commit_part(op_id, zombie)
+                        break
+                    except Exception as e:  # trtpu: ignore[EXC001] — armed chaos faults are the point
+                        logger.debug("zombie commit_part fault: %s", e)
+                        continue
+                if granted is not False:
+                    violations.append(Violation(
+                        "commit-fencing",
+                        f"zombie commit_part of {zombie.key()} (epoch "
+                        f"{zombie.assignment_epoch}) returned "
+                        f"{granted!r}, expected False"))
+                fence_rejected += int(granted is False)
+                # 4c. the sink's own fence: a direct stale-epoch
+                # publish must raise, never replace the survivor's data
+                try:
+                    _zombie_sink_publish(backend, store, outdir,
+                                         zombie.key(),
+                                         zombie.assignment_epoch)
+                    violations.append(Violation(
+                        "sink-fencing",
+                        f"stale-epoch sink publish of {zombie.key()} "
+                        f"(epoch {zombie.assignment_epoch}) was "
+                        f"accepted"))
+                except StaleEpochPublishError:
+                    fence_rejected += 1
+            fires = failpoints.fire_counts()
+            log = failpoints.fire_log()
+        seconds = time.monotonic() - t0
+
+        final_parts = cp.operation_parts(op_id)
+        steal_log = sorted(
+            (p.key(), p.stolen_from, p.assignment_epoch)
+            for p in final_parts if p.stolen_from is not None)
+        if victim_killed and inflight and not steal_log:
+            violations.append(Violation(
+                "reclamation",
+                f"victim's in-flight part(s) "
+                f"{[p.key() for p in inflight]} were never reclaimed"))
+        if not all(p.completed for p in final_parts):
+            violations.append(Violation(
+                "run-completed",
+                f"{sum(1 for p in final_parts if not p.completed)} "
+                f"part(s) never completed"))
+        violations.extend(fencing_violations(cp.completions))
+        for p in final_parts:
+            if p.completed and p.commit_epoch != p.assignment_epoch:
+                violations.append(Violation(
+                    "commit-epoch",
+                    f"{p.key()} completed at epoch "
+                    f"{p.assignment_epoch} but its publish was granted "
+                    f"at {p.commit_epoch}"))
+
+        observed = store.batches if backend == "memory" \
+            else _read_ipc_dir(outdir)
+        bound = (kills + 1) * PART_RETRIES * SINK_PUSH_ATTEMPTS
+        verdict = audit_delivery(reference, observed, bound, tracker,
+                                 exactly_once=True)
+        if violations:
+            verdict.passed = False
+            verdict.violations.extend(violations)
+        return TrialResult(
+            mode="exactly_once", trial=trial, seed=seed, spec=spec,
+            verdict=verdict, fire_counts=fires, fire_log=log,
+            seconds=seconds, kills=kills, steal_log=steal_log,
+            fence_rejected=fence_rejected, backend=backend,
+            commit_log=list(cp.commit_log),
+            dedup_dropped=int(metrics.value(
+                "commit_dedup_rows_dropped")))
+    finally:
+        if store is not None:
+            store.clear()
+        if outdir is not None:
+            shutil.rmtree(outdir, ignore_errors=True)
+
+
+def _zombie_sink_publish(backend: str, store, outdir: Optional[str],
+                         key: str, epoch: int) -> None:
+    """Attempt a sink-layer publish of `key` at a stale `epoch` — the
+    sink's own fence must raise StaleEpochPublishError (the last line
+    of defense when a zombie got past the coordinator's grant)."""
+    if backend == "memory":
+        store.begin_stage(key, epoch)
+        try:
+            store.publish_stage(key, epoch)
+        finally:
+            store.abort_stage(key, epoch)
+        return
+    from transferia_tpu.providers.arrow_ipc import (
+        ArrowIpcSinker,
+        ArrowIpcTargetParams,
+    )
+    from transferia_tpu.providers.staging import DirectoryPartStage
+
+    stage = DirectoryPartStage(
+        outdir, key, epoch,
+        lambda d: ArrowIpcSinker(ArrowIpcTargetParams(path=d + os.sep)))
+    try:
+        stage.publish()
+    finally:
+        stage.abort()
+
+
 # -- scheduler_kill mode -----------------------------------------------------
 #
 # The fleet-level extension of worker_crash: N transfers from M tenants
@@ -994,7 +1352,7 @@ def run_trials(trials: int = 5, seed: int = 7, mode: str = "both",
         modes = ("snapshot", "replication")
     elif mode == "all":
         modes = ("snapshot", "replication", "worker_crash",
-                 "scheduler_kill", "arrow_ipc")
+                 "scheduler_kill", "arrow_ipc", "exactly_once")
     else:
         modes = (mode,)
     if "arrow_ipc" in modes:
@@ -1027,6 +1385,24 @@ def run_trials(trials: int = 5, seed: int = 7, mode: str = "both",
                 report.results.append(r)
                 logger.info("chaos scheduler_kill trial %d: %s", t,
                             r.verdict.summary().splitlines()[0])
+        if "exactly_once" in modes:
+            from transferia_tpu.interchange._pyarrow import have_pyarrow
+
+            backends = [b for b in EXACTLY_ONCE_BACKENDS
+                        if b == "memory" or have_pyarrow()]
+            if len(backends) < len(EXACTLY_ONCE_BACKENDS):
+                logger.warning("chaos: exactly_once running on %s only "
+                               "(no pyarrow)", backends)
+            for backend in backends:
+                ref = _exactly_once_reference(rows, backend)
+                for t in range(trials):
+                    r = run_exactly_once_trial(t, seed, rows, ref,
+                                               backend=backend,
+                                               spec=spec)
+                    report.results.append(r)
+                    logger.info(
+                        "chaos exactly_once[%s] trial %d: %s", backend,
+                        t, r.verdict.summary().splitlines()[0])
         if "arrow_ipc" in modes:
             import shutil
 

@@ -66,6 +66,23 @@ SITES: dict[str, tuple[str, str]] = {
         "middlewares/sync.py",
         "torn write: a PREFIX of the batch lands in the target, then "
         "the push errors — the retry must tolerate the duplicates"),
+    "sink.stage": (
+        "providers/staging.py",
+        "staged-commit stage write failing (staging area full, "
+        "staging I/O error) — the push must fail with nothing newly "
+        "staged visible and retry through the sink/part machinery; "
+        "a part retry restages from scratch (begin replaces)"),
+    "sink.publish": (
+        "providers/staging.py",
+        "staged-commit publish failing between the coordinator grant "
+        "and visibility — the target must be left either fully "
+        "unpublished or fully replaced (never torn), and the retried "
+        "part must republish idempotently under the same epoch"),
+    "coordinator.commit_part": (
+        "coordinator/memory.py",
+        "the fenced commit_part decision RPC failing (coordinator "
+        "unreachable at the worst moment) — nothing may become "
+        "visible, and the part retry must re-ask for the decision"),
     "coordinator.set_state": (
         "coordinator/memory.py",
         "transfer-state checkpoint write failing (coordinator KV "

@@ -165,8 +165,8 @@ def build_parser() -> argparse.ArgumentParser:
                           "schedule and PRNG draws (replayable)")
     cha.add_argument("--mode", default="both",
                      choices=["snapshot", "replication", "worker_crash",
-                              "scheduler_kill", "arrow_ipc", "both",
-                              "all"],
+                              "scheduler_kill", "arrow_ipc",
+                              "exactly_once", "both", "all"],
                      help="worker_crash kills a sharded worker mid-part "
                           "and audits lease reclamation + epoch "
                           "fencing; scheduler_kill kills a fleet "
@@ -174,9 +174,13 @@ def build_parser() -> argparse.ArgumentParser:
                           "audits kill/rebalance (no transfer lost or "
                           "double-admitted); arrow_ipc audits the "
                           "zero-copy interchange wire (arrow_ipc "
-                          "source → memory); both = "
-                          "snapshot+replication; all adds "
-                          "worker_crash + scheduler_kill + arrow_ipc")
+                          "source → memory); exactly_once audits the "
+                          "staged two-phase commit (zero duplicate/"
+                          "lost rows under torn writes, mid-publish "
+                          "kills and zombie replay, per capable sink "
+                          "backend); both = snapshot+replication; all "
+                          "adds worker_crash + scheduler_kill + "
+                          "arrow_ipc + exactly_once")
     cha.add_argument("--rows", type=int, default=0,
                      help="snapshot source rows (default 4096)")
     cha.add_argument("--messages", type=int, default=0,

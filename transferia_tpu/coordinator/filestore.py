@@ -215,6 +215,24 @@ class FileStoreCoordinator(Coordinator):
                 self._write_json(p, parts)
         return released
 
+    def commit_part(self, operation_id: str,
+                    part: OperationTablePart) -> Optional[bool]:
+        p = self._parts_path(operation_id)
+        with self._locked(p):
+            parts = self._read_json(p, [])
+            for d in parts:
+                if (d["operation_id"], d["schema"], d["table"],
+                        d["part_index"]) != (
+                            part.operation_id, part.table_id.namespace,
+                            part.table_id.name, part.part_index):
+                    continue
+                if part.assignment_epoch != d.get("assignment_epoch", 0):
+                    return False  # epoch fence (coordinator/interface)
+                d["commit_epoch"] = part.assignment_epoch
+                self._write_json(p, parts)
+                return True
+            return False
+
     def update_operation_parts(self, operation_id: str,
                                parts: list[OperationTablePart]
                                ) -> list[str]:

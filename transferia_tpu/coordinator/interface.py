@@ -175,6 +175,28 @@ class Coordinator(abc.ABC):
         Returns the keys (part.key()) of rejected updates (empty =
         everything applied)."""
 
+    # -- staged two-phase sink commits (abstract/commit.py) -----------------
+    def supports_staged_commits(self) -> bool:
+        """True when this backend implements `commit_part` (the engine
+        only opens the stage → publish lifecycle against coordinators
+        that can fence the publish decision)."""
+        return type(self).commit_part is not Coordinator.commit_part
+
+    def commit_part(self, operation_id: str,
+                    part: OperationTablePart) -> Optional[bool]:
+        """The single fenced publish decision of the staged commit.
+
+        Atomically checks `part.assignment_epoch` against the stored
+        part — exactly the `update_operation_parts` fence — and records
+        the grant (`commit_epoch`).  Returns True (granted: the caller
+        may publish its staged data), False (fenced: the part was
+        reclaimed since this worker's claim — abort and discard), or
+        None (backend has no staged-commit support; callers fall back
+        to the at-least-once path).  Re-granting the SAME epoch returns
+        True again: the publish step is idempotent and a worker retries
+        it after transient faults."""
+        return None
+
     @abc.abstractmethod
     def operation_parts(self, operation_id: str) -> list[OperationTablePart]:
         ...

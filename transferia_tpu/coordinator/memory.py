@@ -211,6 +211,28 @@ class MemoryCoordinator(Coordinator):
                     released += 1
         return released
 
+    def commit_part(self, operation_id: str,
+                    part: OperationTablePart) -> Optional[bool]:
+        # before the lock: may sleep/raise (a coordinator fault here
+        # must surface as a failed — retriable — commit RPC, with
+        # nothing published)
+        failpoint("coordinator.commit_part")
+        op = self._op_peek(operation_id)
+        if op is None:
+            return False
+        with trace.span("coord_commit_part", operation=operation_id,
+                        part=part.key(), epoch=part.assignment_epoch), \
+                op.lock:
+            for cur in op.parts:
+                if cur.key() != part.key():
+                    continue
+                if part.assignment_epoch != cur.assignment_epoch:
+                    # epoch fence: reclaimed since this worker's claim
+                    return False
+                cur.commit_epoch = part.assignment_epoch
+                return True
+            return False
+
     def update_operation_parts(self, operation_id: str,
                                parts: list[OperationTablePart]
                                ) -> list[str]:

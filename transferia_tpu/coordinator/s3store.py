@@ -301,6 +301,28 @@ class S3Coordinator(Coordinator):
                     continue
         return released
 
+    def commit_part(self, operation_id: str,
+                    part: OperationTablePart) -> Optional[bool]:
+        key = self._part_key_for(
+            operation_id, part.table_id.namespace, part.table_id.name,
+            part.part_index)
+        for _ in range(16):
+            d, etag = self._get_json(key, None)
+            if d is None:
+                return False  # unknown part: never grant a publish
+            if part.assignment_epoch != d.get("assignment_epoch", 0):
+                return False  # epoch fence (coordinator/interface)
+            d["commit_epoch"] = part.assignment_epoch
+            try:
+                # conditional on the read ETag: a steal racing this
+                # grant bumps the epoch, and the retry re-reads and
+                # fences instead of granting a publish to a zombie
+                self._put_json(key, d, if_match=etag)
+                return True
+            except PreconditionFailed:
+                time.sleep(0.05)
+        raise TimeoutError(f"commit_part CAS on {key} did not converge")
+
     def update_operation_parts(self, operation_id: str,
                                parts: list[OperationTablePart]
                                ) -> list[str]:

@@ -41,6 +41,10 @@ ACTION_SHM_LOCATE = "shm_locate"
 ACTION_DROP = "drop"
 ACTION_KEYS = "keys"
 
+# substring marker a stale-epoch put rejection carries across the gRPC
+# error (clients map it back to abstract.errors.StaleEpochPublishError)
+STALE_EPOCH_MARKER = "trtpu-stale-epoch-publish"
+
 # trace context rides DoGet/DoPut as gRPC metadata under this header;
 # the server adopts it so its spans parent to the CLIENT's span and the
 # exported timeline draws one flow across the wire (stats/trace.py)
@@ -110,6 +114,10 @@ class ShardFlightServer:
         # key -> (schema, [RecordBatch], rows)
         self._parts: dict[str, tuple] = {}
         self._segments: dict[str, shm_mod.ShmHandle] = {}
+        # staged-commit publish fence: key -> last accepted publish
+        # epoch (puts that carry an epoch in the descriptor are fenced;
+        # plain puts keep the legacy unfenced replace semantics)
+        self._part_epochs: dict[str, int] = {}
 
         outer = self
 
@@ -145,13 +153,22 @@ class ShardFlightServer:
         from transferia_tpu.stats import trace
 
         key = descriptor.path[0].decode()
+        # optional second path element: the staged-commit publish epoch
+        # (abstract/commit.py) — the server fences stale-epoch puts so
+        # a zombie worker cannot replace a survivor's published part
+        epoch = None
+        if len(descriptor.path) > 1:
+            try:
+                epoch = int(descriptor.path[1].decode())
+            except (ValueError, UnicodeDecodeError):
+                epoch = None
         # adopt the CLIENT's span context (rode in as gRPC metadata):
         # the server-side span parents to the caller's flight_put span,
         # so Perfetto draws one flow arrow across the wire
         with trace.adopted(ctx):
-            self._do_put_adopted(key, reader, trace)
+            self._do_put_adopted(key, reader, trace, epoch)
 
-    def _do_put_adopted(self, key, reader, trace) -> None:
+    def _do_put_adopted(self, key, reader, trace, epoch=None) -> None:
         failpoint("interchange.flight.do_put")
         sp = trace.span("flight_do_put", part=key)
         with sp:
@@ -161,6 +178,16 @@ class ShardFlightServer:
                 rows += chunk.data.num_rows
                 nbytes += chunk.data.nbytes
             with self._lock:
+                # fence + store are one critical section: the epoch
+                # check can never pass and then clobber a racing newer
+                # publish that landed in between
+                if epoch is not None:
+                    prev = self._part_epochs.get(key)
+                    if prev is not None and epoch < prev:
+                        raise self._fl.FlightServerError(
+                            f"{STALE_EPOCH_MARKER}: put of {key!r} at "
+                            f"epoch {epoch} <= published epoch {prev}")
+                    self._part_epochs[key] = epoch
                 self._parts[key] = (reader.schema, rbs, rows)
                 stale = self._segments.pop(key, None)
             if stale is not None:
@@ -252,16 +279,25 @@ class ShardFlightServer:
             shm_mod.unlink_segment(handle)
         return won
 
-    def publish(self, key: str, batches) -> int:
+    def publish(self, key: str, batches, epoch: Optional[int] = None
+                ) -> int:
         """Server-side direct publish (no wire): preloading parts from
         IPC files (`trtpu flight serve --path`) and in-process
-        producers.  Returns rows published."""
+        producers.  Returns rows published.  An `epoch` engages the
+        same staged-commit fence as an epoch-carrying DoPut."""
+        from transferia_tpu.abstract.errors import StaleEpochPublishError
+
         rbs = [b if isinstance(b, self._pa.RecordBatch)
                else batch_to_arrow(b) for b in batches]
         if not rbs:
             return 0
         rows = sum(rb.num_rows for rb in rbs)
         with self._lock:
+            if epoch is not None:
+                prev = self._part_epochs.get(key)
+                if prev is not None and epoch < prev:
+                    raise StaleEpochPublishError(key, epoch, prev)
+                self._part_epochs[key] = epoch
             self._parts[key] = (rbs[0].schema, rbs, rows)
             stale = self._segments.pop(key, None)
         if stale is not None:
@@ -286,6 +322,25 @@ class ShardFlightServer:
         return False
 
 
+def raise_if_stale_epoch(err: BaseException, key: str,
+                         epoch: int) -> None:
+    """Map a server-side stale-epoch put rejection (the marker rides
+    the gRPC error string) back to the typed StaleEpochPublishError the
+    staged-commit engine handles; re-raise anything else as-is."""
+    msg = str(err)
+    if STALE_EPOCH_MARKER in msg:
+        import re
+
+        from transferia_tpu.abstract.errors import StaleEpochPublishError
+
+        # recover the server's actual published epoch from the marker
+        # message; any epoch newer than ours is a truthful fallback
+        m = re.search(r"published epoch (\d+)", msg)
+        published = int(m.group(1)) if m else epoch + 1
+        raise StaleEpochPublishError(key, epoch, published) from err
+    raise err
+
+
 def is_local_uri(uri: str) -> bool:
     host = urlparse(uri).hostname or ""
     return host in _LOCAL_HOSTS or host == socket.gethostname()
@@ -308,14 +363,21 @@ class FlightShardClient:
             else allow_shm
         self._attachments: list = []  # pin mapped segments we handed out
 
-    def begin_put(self, key: str, schema):
+    def begin_put(self, key: str, schema, epoch: Optional[int] = None):
         """Open a streaming DoPut for one part; caller writes
         RecordBatches and closes.  The server stores the stream
         atomically when it ends (a re-put of the key replaces it).
-        The caller's span context rides the call as gRPC metadata, so
-        the server-side flight_do_put span links back across the
-        wire."""
-        descriptor = self._fl.FlightDescriptor.for_path(key)
+        An `epoch` rides as a second descriptor path element and
+        engages the server's staged-commit fence (a stale epoch is
+        rejected instead of replacing — map it back with
+        `raise_if_stale_epoch`).  The caller's span context rides the
+        call as gRPC metadata, so the server-side flight_do_put span
+        links back across the wire."""
+        if epoch is not None:
+            descriptor = self._fl.FlightDescriptor.for_path(
+                key, str(epoch))
+        else:
+            descriptor = self._fl.FlightDescriptor.for_path(key)
         options = _trace_call_options(self._fl)
         if options is not None:
             writer, _ = self._client.do_put(descriptor, schema,

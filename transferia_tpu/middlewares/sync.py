@@ -159,6 +159,20 @@ class Retrier(_Wrap):
         self.attempts = attempts
         self.base_delay = base_delay
 
+    def _on_retry(self, i: int, e: BaseException) -> None:
+        logger.warning(
+            "sink push retry %d/%d after error: %s", i, self.attempts, e)
+        # staged-commit sinks (abstract/commit.py): the re-push may
+        # replay a torn batch whose prefix already staged — arm the
+        # stage's dedup window so that prefix is dropped, not doubled.
+        # The window only ever drops when armed, so this signal is what
+        # distinguishes a replay from genuinely identical batches.
+        from transferia_tpu.abstract.commit import find_staged_sink
+
+        staged = find_staged_sink(self.inner)
+        if staged is not None:
+            staged.note_push_retry()
+
     def push(self, batch: Batch) -> None:
         retry_with_backoff(
             lambda: self.inner.push(batch),
@@ -166,9 +180,7 @@ class Retrier(_Wrap):
             base_delay=self.base_delay if self.base_delay is not None
             else RETRY_BASE_DELAY,
             retriable=is_retriable,
-            on_retry=lambda i, e: logger.warning(
-                "sink push retry %d/%d after error: %s", i, self.attempts, e
-            ),
+            on_retry=self._on_retry,
         )
 
 

@@ -417,6 +417,21 @@ def fingerprint_host(cols: Sequence[_PreppedColumn],
     )
 
 
+def batch_row_keys(batch: ColumnBatch) -> np.ndarray:
+    """64-bit content key per row: `(r1 << 32) | r2` of the finalized
+    lanes, under the same canonicalization as the table fingerprint.
+    Dict columns key code-natively (pool-accumulator gather, no flat
+    materialization).  Shared by the chaos delivery auditor (row
+    delivery multiplicities) and the staged-commit dedup window
+    (providers/staging.py: replayed torn-write prefixes are dropped
+    before publish by these keys)."""
+    if batch.n_rows == 0:
+        return np.empty(0, dtype=np.uint64)
+    cols, n = prep_batch(batch)
+    r1, r2 = row_lanes(cols, n)
+    return (r1.astype(np.uint64) << np.uint64(32)) | r2.astype(np.uint64)
+
+
 class DeviceFingerprintProgram:
     """Jitted device twin of fingerprint_host.
 

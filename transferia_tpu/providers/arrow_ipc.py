@@ -23,6 +23,7 @@ import os
 from dataclasses import dataclass
 from typing import IO, Optional
 
+from transferia_tpu.abstract.commit import StagedSinker
 from transferia_tpu.abstract.interfaces import (
     Batch,
     Pusher,
@@ -214,11 +215,18 @@ class ArrowIpcStorage(Storage, ShardingStorage):
         self._fd_reader = None
 
 
-class ArrowIpcSinker(Sinker):
+class ArrowIpcSinker(Sinker, StagedSinker):
     """IPC stream sink: one writer per table (directory mode) or a
     single-table stream (file / fd mode).  Columnar batches cross with
     wrapped buffers; row batches pivot once here (the row-oriented edge,
-    same contract as the parquet sink)."""
+    same contract as the parquet sink).
+
+    Staged-commit capable in DIRECTORY mode (abstract/commit.py): a
+    part stages its stream files under `<path>/.staging/<part>/` and an
+    epoch-fenced `publish_part` renames them into the directory,
+    replacing an earlier publish of the same part.  File and fd targets
+    cannot stage (a pipe has no invisible staging area) and keep the
+    at-least-once path."""
 
     def __init__(self, params: ArrowIpcTargetParams):
         import uuid
@@ -238,6 +246,41 @@ class ArrowIpcSinker(Sinker):
         p = params.path
         self._dir_mode = bool(p) and not ipc.is_fd_location(p) \
             and (os.path.isdir(p) or p.endswith(os.sep))
+        self._stage = None  # staging.DirectoryPartStage when open
+
+    # -- StagedSinker -------------------------------------------------------
+    def staged_commit_available(self) -> bool:
+        return self._dir_mode
+
+    def begin_part(self, key: str, epoch: int) -> None:
+        from transferia_tpu.providers.staging import DirectoryPartStage
+
+        if not self._dir_mode:
+            raise RuntimeError(
+                "arrow_ipc sink: staged commit needs directory mode")
+        os.makedirs(self.params.path, exist_ok=True)
+        self._stage = DirectoryPartStage(
+            self.params.path, key, epoch,
+            lambda d: ArrowIpcSinker(ArrowIpcTargetParams(
+                path=d + os.sep)))
+
+    def publish_part(self, key: str, epoch: int) -> int:
+        if self._stage is None:
+            raise RuntimeError(
+                f"arrow_ipc sink: no open stage for {key!r}")
+        rows = self._stage.publish()
+        self.last_dedup_dropped = self._stage.state.dedup_dropped
+        self._stage = None
+        return rows
+
+    def abort_part(self, key: str) -> None:
+        if self._stage is not None:
+            self._stage.abort()
+            self._stage = None
+
+    def note_push_retry(self) -> None:
+        if self._stage is not None:
+            self._stage.note_push_retry()
 
     def _writer(self, tid: TableID):
         w = self._writers.get(tid)
@@ -265,6 +308,9 @@ class ArrowIpcSinker(Sinker):
     def push(self, batch: Batch) -> None:
         from transferia_tpu.stats import trace
 
+        if self._stage is not None:
+            self._stage.push(batch)
+            return
         if is_columnar(batch):
             blocks = [batch]
         else:
@@ -284,6 +330,10 @@ class ArrowIpcSinker(Sinker):
                 self._writer(b.table_id).write(b)
 
     def close(self) -> None:
+        if self._stage is not None:
+            # unpublished stage at close = abandoned attempt: discard
+            self._stage.abort()
+            self._stage = None
         errs = []
         for w in self._writers.values():
             try:

@@ -22,6 +22,7 @@ from typing import Optional
 
 import numpy as np
 
+from transferia_tpu.abstract.commit import StagedSinker
 from transferia_tpu.abstract.interfaces import (
     Batch,
     Pusher,
@@ -506,7 +507,7 @@ class FileStorage(Storage, ShardingStorage, ScanPredicateStorage):
         pusher(batch)
 
 
-class FileSinker(Sinker):
+class FileSinker(Sinker, StagedSinker):
     """Writes per-table files; parquet goes through arrow zero-pivot.
 
     File names embed a per-sinker instance token: the snapshot loader builds
@@ -514,6 +515,11 @@ class FileSinker(Sinker):
     sinks), so concurrent instances must never share an output path —
     the same contract as the reference S3 sink's part-scoped file splitting
     (s3/sink/file_splitter.go).
+
+    Staged-commit capable (abstract/commit.py): with an open part stage
+    the batches write into `<path>/.staging/<part>/` and only an
+    epoch-fenced `publish_part` renames them into the output directory
+    (replacing any files an earlier publish of the same part landed).
     """
 
     def __init__(self, params: FileTargetParams):
@@ -522,6 +528,33 @@ class FileSinker(Sinker):
         self._token = uuid.uuid4().hex[:8]
         self._writers: dict[TableID, object] = {}
         self._counters: dict[TableID, int] = {}
+        self._stage = None  # staging.DirectoryPartStage when open
+
+    # -- StagedSinker -------------------------------------------------------
+    def begin_part(self, key: str, epoch: int) -> None:
+        from transferia_tpu.providers.staging import DirectoryPartStage
+
+        self._stage = DirectoryPartStage(
+            self.params.path, key, epoch,
+            lambda d: FileSinker(FileTargetParams(
+                path=d, format=self.params.format)))
+
+    def publish_part(self, key: str, epoch: int) -> int:
+        if self._stage is None:
+            raise RuntimeError(f"fs sink: no open stage for {key!r}")
+        rows = self._stage.publish()
+        self.last_dedup_dropped = self._stage.state.dedup_dropped
+        self._stage = None
+        return rows
+
+    def abort_part(self, key: str) -> None:
+        if self._stage is not None:
+            self._stage.abort()
+            self._stage = None
+
+    def note_push_retry(self) -> None:
+        if self._stage is not None:
+            self._stage.note_push_retry()
 
     def _base_name(self, tid: TableID) -> str:
         # empty namespaces must not produce hidden ".name..." dotfiles
@@ -537,6 +570,9 @@ class FileSinker(Sinker):
         )
 
     def push(self, batch: Batch) -> None:
+        if self._stage is not None:
+            self._stage.push(batch)
+            return
         if is_columnar(batch):
             self._write_columnar(batch)
             return
@@ -591,6 +627,11 @@ class FileSinker(Sinker):
             self._counters[tid] = self._counters.get(tid, 0) + 1
 
     def close(self) -> None:
+        if self._stage is not None:
+            # an unpublished stage at close is an abandoned attempt
+            # (error path / fenced part): discard, never auto-publish
+            self._stage.abort()
+            self._stage = None
         for w in self._writers.values():
             w.close()
         self._writers.clear()
@@ -624,8 +665,11 @@ class FileProvider(Provider):
             base = f"{tid.namespace}.{tid.name}" if tid.namespace \
                 else tid.name
             # parquet: base.token.counter.ext; jsonl: base.token.jsonl
+            # also matches staged-commit published names, which insert
+            # a `.part-<slug>` infix before the extension
             pat = _re.compile(
-                _re.escape(base) + r"\.[0-9a-f]{8}(\.\d{6})?\.\w+$")
+                _re.escape(base)
+                + r"\.[0-9a-f]{8}(\.\d{6})?(\.part-[\w.-]+)?\.\w+$")
             for fname in os.listdir(path):
                 if pat.fullmatch(fname):
                     os.unlink(os.path.join(path, fname))

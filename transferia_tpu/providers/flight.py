@@ -21,6 +21,7 @@ import threading
 from dataclasses import dataclass
 from typing import Optional
 
+from transferia_tpu.abstract.commit import StagedSinker
 from transferia_tpu.abstract.interfaces import (
     Batch,
     Pusher,
@@ -148,13 +149,26 @@ class FlightStorage(Storage, ShardingStorage):
         self._client.close()
 
 
-class FlightSinker(Sinker):
+class FlightSinker(Sinker, StagedSinker):
     """Publishes pushed blocks as part streams: consecutive batches of
     one part flow through a single held-open DoPut stream (closed when
     the part changes or on close()).  Part identity is the batch's
     `part_id` when the snapshot engine stamped one, else a per-table
     sequence.  A RETRIED part re-puts its key, which REPLACES the
-    server-side stream — duplicates never append."""
+    server-side stream — duplicates never append.
+
+    Staged-commit capable (abstract/commit.py): with an open part stage
+    the blocks buffer client-side and `publish_part` DoPuts them with
+    the assignment epoch in the descriptor — the shard server fences
+    stale epochs, so a zombie's publish of a reclaimed part is rejected
+    at the wire instead of replacing the survivor's stream.  Publish
+    atomicity is per wire key (one DoPut stream): snapshot parts are
+    single-table so one part = one stream = one atomic replace; a
+    multi-table part (CDC-shaped row batches) publishes one stream per
+    table sequentially, and a mid-publish wire failure can leave
+    earlier tables' streams visible until the part's idempotent
+    republish replaces them (each stream is still individually fenced,
+    so a zombie can never clobber any of the survivor's streams)."""
 
     def __init__(self, params: FlightTargetParams):
         import uuid
@@ -173,19 +187,75 @@ class FlightSinker(Sinker):
         # instances both starting at seq 0 must not replace each
         # other's streams (same contract as the fs sink's file token)
         self._token = uuid.uuid4().hex[:8]
+        self._stage = None  # staging.PartStage when open
+
+    @staticmethod
+    def _blocks(batch: Batch) -> list[ColumnBatch]:
+        if is_columnar(batch):
+            return [batch]
+        rows = [it for it in batch if it.is_row_event()]
+        if not rows:
+            return []
+        by_table: dict[TableID, list] = {}
+        for it in rows:
+            by_table.setdefault(it.table_id, []).append(it)
+        return [ColumnBatch.from_rows(its) for its in by_table.values()]
+
+    # -- StagedSinker -------------------------------------------------------
+    def begin_part(self, key: str, epoch: int) -> None:
+        from transferia_tpu.providers.staging import PartStage
+
+        self._stage = PartStage(key, epoch, hold=True)
+
+    def publish_part(self, key: str, epoch: int) -> int:
+        from transferia_tpu.interchange.convert import batch_to_arrow
+        from transferia_tpu.interchange.flight import raise_if_stale_epoch
+        from transferia_tpu.providers.staging import (
+            part_slug,
+            publish_guard,
+        )
+
+        if self._stage is None:
+            raise RuntimeError(f"flight sink: no open stage for {key!r}")
+        # group the staged blocks per table: one epoch-fenced DoPut
+        # stream per `<ns>.<table>/<part>` wire key, replacing whatever
+        # an earlier publish of this part streamed
+        by_table: dict[TableID, list] = {}
+        for batch in self._stage.batches:
+            for b in self._blocks(batch):
+                by_table.setdefault(b.table_id, []).append(b)
+        rows = 0
+        with publish_guard(key, epoch):
+            for tid, blocks in by_table.items():
+                wire_key = part_key(tid, f"part-{part_slug(key)}")
+                rbs = [batch_to_arrow(b) for b in blocks]
+                try:
+                    writer = self._client.begin_put(
+                        wire_key, rbs[0].schema, epoch=epoch)
+                    with writer:
+                        for rb in rbs:
+                            writer.write_batch(rb)
+                            rows += rb.num_rows
+                except Exception as e:
+                    raise_if_stale_epoch(e, wire_key, epoch)
+        self.last_dedup_dropped = self._stage.dedup_dropped
+        self._stage = None
+        return rows
+
+    def abort_part(self, key: str) -> None:
+        self._stage = None
+
+    def note_push_retry(self) -> None:
+        if self._stage is not None:
+            self._stage.note_push_retry()
 
     def push(self, batch: Batch) -> None:
-        if is_columnar(batch):
-            blocks = [batch]
-        else:
-            rows = [it for it in batch if it.is_row_event()]
-            if not rows:
-                return
-            by_table: dict[TableID, list] = {}
-            for it in rows:
-                by_table.setdefault(it.table_id, []).append(it)
-            blocks = [ColumnBatch.from_rows(its) for its in
-                      by_table.values()]
+        if self._stage is not None:
+            self._stage.stage(batch)
+            return
+        blocks = self._blocks(batch)
+        if not blocks:
+            return
         from transferia_tpu.interchange.convert import batch_to_arrow
 
         for b in blocks:

@@ -12,6 +12,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from transferia_tpu.abstract.change_item import ChangeItem
+from transferia_tpu.abstract.commit import StagedSinker
 from transferia_tpu.abstract.interfaces import (
     Batch,
     IncrementalStorage,
@@ -33,15 +34,93 @@ _SOURCES: dict[str, list[ColumnBatch]] = {}
 
 
 class MemoryStore:
-    """Captured pushes, with row-level views for assertions."""
+    """Captured pushes, with row-level views for assertions.
+
+    Staged-commit surface (abstract/commit.py): `begin_stage`/`stage`
+    buffer a part's batches invisibly, `publish_stage` makes them
+    visible atomically — REPLACING any batches previously published
+    under the same part key (a retried/superseded part never appends
+    duplicates) — behind a sink-side epoch fence (a zombie's stale-
+    epoch publish raises instead of clobbering the survivor's data)."""
 
     def __init__(self):
         self.lock = threading.Lock()
         self.batches: list[Batch] = []
+        # staged-commit state: (part key, epoch) -> PartStage.  Keyed
+        # by BOTH so a zombie and the survivor that reclaimed its part
+        # never share a staging area — each owner stages its own
+        # attempt and only the fenced publish decides whose wins.
+        self._staged: dict[tuple[str, int], object] = {}
+        self._published_by_part: dict[str, list[Batch]] = {}
+        self._fence = None  # lazily a staging.EpochFence
 
     def push(self, batch: Batch) -> None:
         with self.lock:
             self.batches.append(batch)
+
+    # -- staged two-phase commit -------------------------------------------
+    def begin_stage(self, key: str, epoch: int) -> None:
+        from transferia_tpu.providers.staging import EpochFence, PartStage
+
+        with self.lock:
+            if self._fence is None:
+                self._fence = EpochFence()
+            # begin replaces: a part retry restages from scratch
+            self._staged[(key, epoch)] = PartStage(key, epoch, hold=True)
+
+    def stage(self, key: str, epoch: int, batch: Batch) -> None:
+        with self.lock:
+            stage = self._staged.get((key, epoch))
+        if stage is None:
+            raise RuntimeError(f"memory sink: no open stage for {key!r}")
+        # dedup/buffering outside the store lock: stages are per
+        # (part, epoch) and each owner's pushes are serialized by its
+        # own sink pipeline
+        stage.stage(batch)
+
+    def publish_stage(self, key: str, epoch: int) -> tuple[int, int]:
+        """Returns (rows published, dedup-window rows dropped)."""
+        from transferia_tpu.providers.staging import publish_guard
+
+        with publish_guard(key, epoch):
+            with self.lock:
+                stage = self._staged.get((key, epoch))
+                if stage is None:
+                    raise RuntimeError(
+                        f"memory sink: nothing staged for {key!r}")
+                self._fence.check_and_advance(key, epoch)
+                # replace-on-republish: drop what an earlier publish of
+                # this part landed (identity-based: assertions hold
+                # batch objects, never copies)
+                prev = self._published_by_part.pop(key, None)
+                if prev:
+                    prev_ids = {id(b) for b in prev}
+                    self.batches = [b for b in self.batches
+                                    if id(b) not in prev_ids]
+                self.batches.extend(stage.batches)
+                self._published_by_part[key] = list(stage.batches)
+                del self._staged[(key, epoch)]
+                return stage.rows, stage.dedup_dropped
+
+    def arm_replay(self, key: str, epoch: int) -> None:
+        """Retry layer signal: the next staged push for this part may
+        replay a torn prefix (providers/staging.py DedupWindow)."""
+        with self.lock:
+            stage = self._staged.get((key, epoch))
+        if stage is not None:
+            stage.note_push_retry()
+
+    def abort_stage(self, key: str, epoch: Optional[int] = None) -> None:
+        with self.lock:
+            if epoch is not None:
+                self._staged.pop((key, epoch), None)
+            else:
+                for k in [k for k in self._staged if k[0] == key]:
+                    self._staged.pop(k, None)
+
+    def staged_keys(self) -> list[str]:
+        with self.lock:
+            return sorted({k for k, _e in self._staged})
 
     # -- assertion helpers --------------------------------------------------
     def rows(self, table: Optional[TableID] = None) -> list[ChangeItem]:
@@ -91,6 +170,9 @@ class MemoryStore:
     def clear(self) -> None:
         with self.lock:
             self.batches.clear()
+            self._staged.clear()
+            self._published_by_part.clear()
+            self._fence = None
 
     def drop_table(self, table: TableID) -> None:
         with self.lock:
@@ -140,11 +222,17 @@ class MemorySourceParams(EndpointParams):
     source_id: str = "default"
 
 
-class MemorySinker(Sinker):
+class MemorySinker(Sinker, StagedSinker):
+    """Capture sink; staged-commit capable (the engine opens the
+    stage → publish lifecycle via begin_part, otherwise pushes land
+    directly — the legacy at-least-once path)."""
+
     def __init__(self, params: MemoryTargetParams):
         self.params = params
         self.store = get_store(params.sink_id)
         self._fails_left = params.fail_pushes
+        self._stage_key: str = ""
+        self._stage_epoch: int = 0
 
     def push(self, batch: Batch) -> None:
         if self._fails_left > 0:
@@ -152,7 +240,34 @@ class MemorySinker(Sinker):
             raise ConnectionError(
                 f"injected failure ({self._fails_left} left)"
             )
-        self.store.push(batch)
+        if self._stage_key:
+            self.store.stage(self._stage_key, self._stage_epoch, batch)
+        else:
+            self.store.push(batch)
+
+    # -- StagedSinker -------------------------------------------------------
+    def begin_part(self, key: str, epoch: int) -> None:
+        self.store.begin_stage(key, epoch)
+        self._stage_key = key
+        self._stage_epoch = epoch
+
+    def publish_part(self, key: str, epoch: int) -> int:
+        rows, self.last_dedup_dropped = self.store.publish_stage(
+            key, epoch)
+        if self._stage_key == key:
+            # back to direct-push mode: the stage is gone (published)
+            self._stage_key = ""
+        return rows
+
+    def abort_part(self, key: str) -> None:
+        self.store.abort_stage(key, self._stage_epoch
+                               if self._stage_key == key else None)
+        if self._stage_key == key:
+            self._stage_key = ""
+
+    def note_push_retry(self) -> None:
+        if self._stage_key:
+            self.store.arm_replay(self._stage_key, self._stage_epoch)
 
 
 class MemoryStorage(Storage, IncrementalStorage):

@@ -15,6 +15,8 @@ import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from transferia_tpu.abstract.commit import StagedSinker
+from transferia_tpu.abstract.errors import StaleEpochPublishError
 from transferia_tpu.abstract.interfaces import Batch, Sinker
 from transferia_tpu.models.endpoint import EndpointParams, register_endpoint
 from transferia_tpu.parsers import Message
@@ -22,6 +24,12 @@ from transferia_tpu.providers.queue_common import FetchedBatch, QueueSource
 from transferia_tpu.providers.registry import Provider, register_provider
 from transferia_tpu.serializers import make_queue_serializer
 from transferia_tpu.transform.plugins.sharder import hash_column_to_shards
+
+
+# tombstone for a superseded staged-commit publish: entries are
+# REPLACED in place (never deleted) so partition offsets — list
+# indices consumers commit — stay stable across a part republish
+_SUPERSEDED = object()
 
 
 class MemoryBroker:
@@ -32,6 +40,12 @@ class MemoryBroker:
         self.n_partitions = n_partitions
         self.topics: dict[str, list[list[tuple]]] = {}
         self.committed: dict[tuple[str, str, int], int] = {}  # (group,t,p)
+        # staged-commit publish registry (the broker-side half of the
+        # mq offset-commit exactly-once path): part key -> (epoch,
+        # [(topic, partition, message tuple)]) of the LAST accepted
+        # publish, so a republish replaces instead of appending and a
+        # stale-epoch publish is rejected
+        self.published_parts: dict[str, tuple[int, list]] = {}
 
     def _topic(self, name: str) -> list[list[tuple]]:
         with self.lock:
@@ -52,13 +66,22 @@ class MemoryBroker:
     def fetch_from(self, topic: str, partition: int, offset: int,
                    max_messages: int) -> list[Message]:
         parts = self._topic(topic)
+        picked: list[tuple] = []
         with self.lock:
-            rows = parts[partition][offset:offset + max_messages]
+            plist = parts[partition]
+            # scan past superseded-publish tombstones: they hold their
+            # index (committed offsets stay valid) but carry no message
+            i = offset
+            while i < len(plist) and len(picked) < max_messages:
+                entry = plist[i]
+                if entry is not _SUPERSEDED:
+                    picked.append((i, entry))
+                i += 1
         return [
             Message(value=v if v is not None else b"", key=k or b"",
-                    topic=topic, partition=partition, offset=offset + i,
+                    topic=topic, partition=partition, offset=idx,
                     write_time_ns=ts)
-            for i, (k, v, ts) in enumerate(rows)
+            for idx, (k, v, ts) in picked
         ]
 
     def commit(self, group: str, topic: str, partition: int,
@@ -74,7 +97,41 @@ class MemoryBroker:
     def size(self, topic: str) -> int:
         parts = self._topic(topic)
         with self.lock:
-            return sum(len(p) for p in parts)
+            return sum(1 for p in parts for e in p
+                       if e is not _SUPERSEDED)
+
+    def publish_part(self, part_key: str, epoch: int,
+                     messages: list[tuple]) -> int:
+        """Transactional part publish: land `messages` — entries of
+        (topic, partition, key, value) — atomically, REPLACING whatever
+        an earlier publish of the same part key landed (the in-memory
+        twin of a kafka transaction + replace-on-republish), behind the
+        epoch fence.  Partition None routes by key hash, like
+        produce()."""
+        with self.lock:
+            prev = self.published_parts.get(part_key)
+            if prev is not None and epoch < prev[0]:
+                raise StaleEpochPublishError(part_key, epoch, prev[0])
+            if prev is not None:
+                # tombstone in place — NEVER delete: offsets are list
+                # indices and consumer groups have committed them
+                for topic, p, entry in prev[1]:
+                    plist = self._topic(topic)[p]
+                    for i, cur in enumerate(plist):
+                        if cur is entry:
+                            plist[i] = _SUPERSEDED
+                            break
+            landed = []
+            for topic, partition, key, value in messages:
+                parts = self._topic(topic)
+                if partition is None:
+                    partition = (hash(bytes(key or b"")) & 0x7FFFFFFF) \
+                        % len(parts)
+                entry = (key, value, time.time_ns())
+                parts[partition % len(parts)].append(entry)
+                landed.append((topic, partition % len(parts), entry))
+            self.published_parts[part_key] = (epoch, landed)
+            return len(landed)
 
 
 _BROKERS: dict[str, MemoryBroker] = {}
@@ -148,9 +205,15 @@ class _MQClient:
         pass
 
 
-class MQSinker(Sinker):
+class MQSinker(Sinker, StagedSinker):
     """Queue sink: serialize rows, partition by key/column hash
-    (reference kafka/sink.go + writer/)."""
+    (reference kafka/sink.go + writer/).
+
+    Staged-commit capable (abstract/commit.py): with an open part stage
+    the serialized messages buffer sink-side and land in the broker
+    through one epoch-fenced `MemoryBroker.publish_part` transaction —
+    the in-memory twin of a kafka transactional produce tied to the
+    offset-commit path."""
 
     def __init__(self, params: MQTargetParams):
         self.params = params
@@ -158,8 +221,12 @@ class MQSinker(Sinker):
         self.serializer = make_queue_serializer(
             params.serializer, **(params.serializer_config or {})
         )
+        self._stage = None  # staging.PartStage when open
+        self._staged_messages: list[tuple] = []
 
-    def push(self, batch: Batch) -> None:
+    def _messages_for(self, batch: Batch) -> list[tuple]:
+        """Serialize one batch to (topic, partition, key, value)
+        message tuples (partition None = key hash at produce time)."""
         from transferia_tpu.abstract.interfaces import is_columnar
 
         pairs = self.serializer.serialize_messages(batch)
@@ -182,11 +249,55 @@ class MQSinker(Sinker):
             topic = self.params.topic or (
                 str(rows[0].table_id) if rows else "controls"
             )
-        for i, (key, value) in enumerate(pairs):
-            self.broker.produce(
-                topic, key, value,
-                partition=partitions[i] if partitions is not None else None,
-            )
+        return [
+            (topic, partitions[i] if partitions is not None else None,
+             key, value)
+            for i, (key, value) in enumerate(pairs)
+        ]
+
+    def push(self, batch: Batch) -> None:
+        if self._stage is not None:
+            batch = self._stage.stage(batch)
+            try:
+                self._staged_messages.extend(self._messages_for(batch))
+            except BaseException:
+                # serialization died after the dedup window recorded
+                # the batch: only a full part restage is safe
+                self._stage.mark_failed()
+                raise
+            return
+        for topic, partition, key, value in self._messages_for(batch):
+            self.broker.produce(topic, key, value, partition=partition)
+
+    # -- StagedSinker -------------------------------------------------------
+    def begin_part(self, key: str, epoch: int) -> None:
+        from transferia_tpu.providers.staging import PartStage
+
+        # hold=False: the serialized message list is the buffer; the
+        # PartStage only runs the dedup window over the pushed batches
+        self._stage = PartStage(key, epoch, hold=False)
+        self._staged_messages = []
+
+    def publish_part(self, key: str, epoch: int) -> int:
+        from transferia_tpu.providers.staging import publish_guard
+
+        if self._stage is None:
+            raise RuntimeError(f"mq sink: no open stage for {key!r}")
+        with publish_guard(key, epoch):
+            n = self.broker.publish_part(key, epoch,
+                                         self._staged_messages)
+        self.last_dedup_dropped = self._stage.dedup_dropped
+        self._stage = None
+        self._staged_messages = []
+        return n
+
+    def abort_part(self, key: str) -> None:
+        self._stage = None
+        self._staged_messages = []
+
+    def note_push_retry(self) -> None:
+        if self._stage is not None:
+            self._stage.note_push_retry()
 
 
 @register_provider

@@ -1,0 +1,341 @@
+"""Shared staging machinery for staged-commit sinks.
+
+Every capable sink (memory, fs, arrow_ipc, flight, mq) drives its
+stage → publish lifecycle through this module so the protocol behaves
+identically across targets:
+
+- `PartStage` is one part's staging state: the `(key, epoch)` identity,
+  an optional in-memory batch buffer (sinks that publish from memory —
+  memory/flight/mq — hold; file-backed sinks write through to a staging
+  directory and only route batches through `stage()` for the dedup
+  window), and the torn-write **dedup window**;
+- the dedup window drops REPLAYED torn-write prefixes before publish.
+  A replay is recognized by two independent signals, both required:
+  the retry layer ARMS the window (`StagedSinker.note_push_retry`,
+  called by the sink Retrier before re-pushing a failed batch — only a
+  failure can cause a replay), and the incoming batch's row-key
+  sequence (`ops/rowhash.batch_row_keys`, the same dict-native lanes
+  the chaos auditor and table fingerprints use) starts with the
+  previously staged push's key sequence in ORDER — a torn write lands
+  a batch prefix, so its retry replays that exact ordered prefix.
+  Only the matched prefix drops.  Mere content equality never drops:
+  genuinely duplicate rows across batches of one part (PK-less
+  sources, constant-valued tables emitting identical consecutive
+  batches) are source multiplicity, not replay, and pass through
+  untouched;
+- `EpochFence` is the sink-side publish fence: the last accepted
+  publish epoch per part key; an older epoch raises
+  `StaleEpochPublishError` (zombie publish), an equal-or-newer epoch
+  replaces (idempotent republish / superseding owner);
+- `publish_guard` wraps every publish with the `sink.publish`
+  failpoint and a trace span; `PartStage.stage` owns `sink.stage` —
+  single call sites, per the FPT001 one-owner contract.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from transferia_tpu.abstract.errors import StaleEpochPublishError
+from transferia_tpu.abstract.interfaces import Batch, is_columnar
+from transferia_tpu.chaos.failpoints import failpoint
+from transferia_tpu.stats import trace
+from transferia_tpu.stats.ledger import LEDGER
+
+# cap on the row keys remembered from the last staged push (the only
+# state a replay can match against).  Batches are bufferer-flush sized
+# in practice; a push beyond the cap simply stops dedup-matching (safe
+# direction: duplicates land and the at-least-once bound covers them).
+DEDUP_WINDOW_ROWS = 1 << 20
+
+
+class DedupWindow:
+    """Torn-write replay detector over one part's staged pushes.
+
+    A torn write lands a PREFIX of a batch, then the push errors and
+    the sink-level Retrier re-pushes the WHOLE batch.  The replay is
+    recognized only when BOTH hold:
+
+    - the window was ARMED (`arm_replay`) since the last row push —
+      the Retrier arms it before every re-push, so an unarmed push can
+      never be a replay (nothing failed);
+    - the incoming batch's key sequence STARTS WITH the previously
+      staged push's key sequence, in order — the landed prefix.
+
+    Exactly the matched prefix is dropped.  Content membership alone
+    never drops anything: identical rows arriving in different batches
+    (PK-less duplicates, constant-valued tables) are legitimate source
+    multiplicity and exactly-once must preserve them."""
+
+    def __init__(self, max_rows: int = DEDUP_WINDOW_ROWS):
+        self.max_rows = max_rows
+        self._prev = None  # np.uint64 keys of the last staged row push
+        self._armed = False
+
+    def arm_replay(self) -> None:
+        """The next row push is a retry of a failed one (Retrier)."""
+        self._armed = True
+
+    def filter(self, batch: "Batch") -> tuple["Batch", int]:
+        """Drop the replayed prefix when this push is a recognized
+        replay, else pass through.  Returns (batch, rows dropped).
+        Control items pass through untouched (and do not consume the
+        armed flag) — they carry no row identity."""
+        import numpy as np
+
+        keys = _row_keys(batch)
+        if keys is None or len(keys) == 0:
+            return batch, 0
+        armed, self._armed = self._armed, False
+        prev = self._prev
+        dropped = 0
+        if armed and prev is not None and 0 < len(prev) <= len(keys) \
+                and np.array_equal(keys[:len(prev)], prev):
+            dropped = int(len(prev))
+            batch = _drop_prefix(batch, dropped)
+        # remember THIS push in full (a later tear replays it whole,
+        # dropped prefix included), up to the cap
+        self._prev = keys if len(keys) <= self.max_rows else None
+        return batch, dropped
+
+
+def _row_keys(batch: "Batch"):
+    """Content keys (np.uint64, row order) for a pushed batch; None =
+    no row content."""
+    from transferia_tpu.columnar.batch import ColumnBatch
+    from transferia_tpu.ops.rowhash import batch_row_keys
+
+    if is_columnar(batch):
+        if batch.n_rows == 0:
+            return None
+        return batch_row_keys(batch)
+    rows = [it for it in batch if it.is_row_event()]
+    if not rows or len(rows) != len(batch):
+        # mixed/control batch: NonRowSeparator upstream makes this rare;
+        # pass through rather than misattribute identities
+        return None
+    return batch_row_keys(ColumnBatch.from_rows(rows))
+
+
+def _drop_prefix(batch: "Batch", k: int) -> "Batch":
+    if is_columnar(batch):
+        return batch.slice(k, batch.n_rows)
+    return batch[k:]
+
+
+class PartStage:
+    """One part's staging state inside a sink.
+
+    `hold=True` buffers staged batches in memory (sinks that publish
+    from the buffer); `hold=False` only runs the dedup window and
+    returns the filtered batch for the sink to persist into its own
+    staging area (file-backed sinks)."""
+
+    def __init__(self, key: str, epoch: int, hold: bool = True,
+                 dedup_rows: int = DEDUP_WINDOW_ROWS):
+        self.key = key
+        self.epoch = epoch
+        self.hold = hold
+        self.batches: list[Batch] = []
+        self.rows = 0
+        self.dedup_dropped = 0
+        self.poisoned = False
+        self._window = DedupWindow(dedup_rows)
+
+    def mark_failed(self) -> None:
+        """Poison the stage after a failure DOWNSTREAM of the dedup
+        window (staging write / serialization died after the window
+        recorded the batch's keys).  The staged state is then unknown —
+        a push-level retry against this stage could silently drop the
+        unwritten suffix (its keys are already in the window), so every
+        further stage() fails until the PART retries and `begin_part`
+        replaces the whole stage."""
+        self.poisoned = True
+
+    def note_push_retry(self) -> None:
+        """The sink Retrier is about to re-push a failed batch: arm
+        the dedup window so the replayed prefix (if one landed) is
+        recognized and dropped."""
+        self._window.arm_replay()
+
+    def stage(self, batch: "Batch") -> "Batch":
+        """Route one pushed batch through the staging plane: fire the
+        `sink.stage` failpoint (a fault here must fail the push with
+        nothing newly visible), dedup against the window, account, and
+        (when holding) buffer."""
+        if self.poisoned:
+            raise ConnectionError(
+                f"stage for {self.key!r} poisoned by an earlier staging "
+                f"failure; the part must restage from scratch")
+        failpoint("sink.stage")
+        sp = trace.span("sink_stage", part=self.key, epoch=self.epoch)
+        with sp:
+            batch, dropped = self._window.filter(batch)
+            if dropped:
+                self.dedup_dropped += dropped
+                LEDGER.add(dedup_rows_dropped=dropped)
+            n = batch.n_rows if is_columnar(batch) else sum(
+                1 for it in batch if it.is_row_event())
+            self.rows += n
+            if sp:
+                sp.add(rows=n, dedup_dropped=dropped)
+            if self.hold:
+                self.batches.append(batch)
+        return batch
+
+
+class publish_guard:
+    """Context manager every `publish_part` implementation enters:
+    fires the `sink.publish` failpoint (a fault here must leave the
+    target either fully unpublished or fully replaced — never torn) and
+    records the publish as a trace span."""
+
+    def __init__(self, key: str, epoch: int):
+        self._sp = trace.span("sink_publish", part=key, epoch=epoch)
+        failpoint("sink.publish")
+
+    def __enter__(self):
+        self._sp.__enter__()
+        return self._sp
+
+    def __exit__(self, *exc):
+        return self._sp.__exit__(*exc)
+
+
+def part_slug(key: str) -> str:
+    """Filesystem/wire-safe stable identity for a part key (used in
+    published file names and Flight part keys, so replacement can find
+    an older publish of the same part regardless of epoch/token)."""
+    import re
+
+    return re.sub(r"[^A-Za-z0-9._-]", "_", key)
+
+
+class DirectoryPartStage:
+    """File-backed staging for directory sinks (fs, arrow_ipc).
+
+    An inner sink instance writes the part's batches into
+    `<root>/.staging/<slug>/` (dotdir: invisible to the storage
+    readers' globs); `publish` renames the staged files into `<root>`
+    under part-keyed names (`<staged name>.part-<slug>.<ext>`),
+    REPLACING any files a previous publish of the same part landed,
+    behind a marker-file epoch fence
+    (`<root>/.staging/.published.<slug>.json`)."""
+
+    def __init__(self, root: str, key: str, epoch: int, make_inner,
+                 dedup_rows: int = DEDUP_WINDOW_ROWS):
+        import os
+        import shutil
+
+        self.root = root
+        self.key = key
+        self.epoch = epoch
+        self.slug = part_slug(key)
+        # staging dir carries the epoch: a zombie and the survivor that
+        # reclaimed its part stage side by side and never clobber each
+        # other — only the fenced publish decides whose files land
+        self.dir = os.path.join(root, ".staging",
+                                f"{self.slug}.e{epoch}")
+        # begin replaces: wipe anything a crashed attempt left behind
+        shutil.rmtree(self.dir, ignore_errors=True)
+        os.makedirs(self.dir, exist_ok=True)
+        self.inner = make_inner(self.dir)
+        self.state = PartStage(key, epoch, hold=False,
+                               dedup_rows=dedup_rows)
+        self._closed = False
+
+    def push(self, batch: "Batch") -> None:
+        staged = self.state.stage(batch)
+        try:
+            self.inner.push(staged)
+        except BaseException:
+            # the staging write died after the dedup window recorded
+            # this batch: the staged files hold an unknown prefix, so
+            # only a full part restage (begin replaces) is safe
+            self.state.mark_failed()
+            raise
+
+    def note_push_retry(self) -> None:
+        self.state.note_push_retry()
+
+    def _marker(self) -> str:
+        import os
+
+        return os.path.join(self.root, ".staging",
+                            f".published.{self.slug}.json")
+
+    def publish(self) -> int:
+        import json
+        import os
+
+        with publish_guard(self.key, self.epoch):
+            if not self._closed:
+                self.inner.close()
+                self._closed = True
+            # sink-side epoch fence, persisted next to the staging area
+            marker = self._marker()
+            try:
+                with open(marker) as fh:
+                    prev = int(json.load(fh).get("epoch", -1))
+            except (FileNotFoundError, ValueError, OSError):
+                prev = None
+            if prev is not None and self.epoch < prev:
+                raise StaleEpochPublishError(self.key, self.epoch, prev)
+            # replace: drop what an older publish of this part landed
+            infix = f".part-{self.slug}."
+            for fname in os.listdir(self.root):
+                if infix in fname:
+                    os.remove(os.path.join(self.root, fname))
+            # then move the staged files in (same-fs atomic renames; a
+            # crash mid-loop is recovered by the retried part's
+            # republish, which replaces everything again)
+            for fname in sorted(os.listdir(self.dir)):
+                stem, dot, ext = fname.rpartition(".")
+                out = f"{stem}{infix}{ext}" if dot else \
+                    f"{fname}{infix}dat"
+                os.replace(os.path.join(self.dir, fname),
+                           os.path.join(self.root, out))
+            tmp = f"{marker}.tmp.{os.getpid()}"
+            with open(tmp, "w") as fh:
+                json.dump({"epoch": self.epoch, "key": self.key}, fh)
+            os.replace(tmp, marker)
+            os.rmdir(self.dir)
+            return self.state.rows
+
+    def abort(self) -> None:
+        import shutil
+
+        if not self._closed:
+            try:
+                self.inner.close()
+            except Exception:  # trtpu: ignore[EXC001] — best-effort abort
+                pass
+            self._closed = True
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+
+class EpochFence:
+    """Sink-side publish fence: last accepted publish epoch per key.
+
+    `check_and_advance` rejects epochs OLDER than the last accepted
+    publish (zombie) and records the new epoch otherwise; equal epochs
+    pass (idempotent republish — the publish itself replaces)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._published: dict[str, int] = {}
+
+    def check_and_advance(self, key: str, epoch: int) -> Optional[int]:
+        """Returns the previously published epoch (None = first
+        publish) or raises StaleEpochPublishError."""
+        with self._lock:
+            prev = self._published.get(key)
+            if prev is not None and epoch < prev:
+                raise StaleEpochPublishError(key, epoch, prev)
+            self._published[key] = epoch
+            return prev
+
+    def published_epoch(self, key: str) -> Optional[int]:
+        with self._lock:
+            return self._published.get(key)

@@ -66,6 +66,10 @@ FIELDS = (
     "launches", "compiles", "compile_seconds", "kernel_seconds",
     "decode_wait_seconds", "queue_wait_seconds",
     "retries", "lease_steals", "chaos_fires",
+    # staged two-phase sink commits (abstract/commit.py): granted
+    # publish decisions, fenced (stale-epoch) attempts, and rows the
+    # staging dedup window dropped before publish
+    "commits", "commit_fences", "dedup_rows_dropped",
 )
 
 _INT_FIELDS = frozenset(f for f in FIELDS if not f.endswith("_seconds"))

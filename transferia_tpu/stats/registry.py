@@ -277,6 +277,26 @@ class LeaseStats(_Bundle):
         self.fence_rejected = self.m.counter("fence_rejected")
 
 
+class CommitStats(_Bundle):
+    """Staged two-phase sink commit counters (abstract/commit.py,
+    tasks/snapshot.py).  The pair to watch is `commit_fenced` +
+    `publish_stale_rejected` vs `published_parts`: nonzero fences mean
+    zombies tried to publish reclaimed parts and were stopped — at the
+    coordinator's grant or at the sink's own epoch fence."""
+
+    def __init__(self, metrics: Optional[Metrics] = None):
+        super().__init__(metrics)
+        self.staged_parts = self.m.counter("commit_staged_parts")
+        self.published_parts = self.m.counter("commit_published_parts")
+        self.aborted_parts = self.m.counter("commit_aborted_parts")
+        self.commit_granted = self.m.counter("commit_granted")
+        self.commit_fenced = self.m.counter("commit_fenced")
+        self.publish_stale_rejected = self.m.counter(
+            "publish_stale_rejected")
+        self.dedup_rows_dropped = self.m.counter(
+            "commit_dedup_rows_dropped")
+
+
 class FleetStats(_Bundle):
     """Fleet control plane counters (fleet/scheduler.py).  The pair to
     watch is `shed` vs `admitted`: a fleet that sheds while

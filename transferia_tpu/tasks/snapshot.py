@@ -29,9 +29,11 @@ from transferia_tpu.abstract.change_item import (
     init_sharded_table_load,
     init_table_load,
 )
+from transferia_tpu.abstract.commit import find_staged_sink
 from transferia_tpu.abstract.errors import (
     CodedError,
     Codes,
+    StaleEpochPublishError,
     TableUploadError,
     WorkerKilledError,
     is_retriable,
@@ -57,7 +59,12 @@ from transferia_tpu.coordinator.interface import (
 from transferia_tpu.factories import make_async_sink, new_storage
 from transferia_tpu.stats import trace
 from transferia_tpu.stats.ledger import LEDGER
-from transferia_tpu.stats.registry import LeaseStats, Metrics, TableStats
+from transferia_tpu.stats.registry import (
+    CommitStats,
+    LeaseStats,
+    Metrics,
+    TableStats,
+)
 from transferia_tpu.tasks.table_splitter import split_tables
 from transferia_tpu.utils.backoff import retry_with_backoff
 
@@ -67,6 +74,16 @@ PART_RETRIES = 3  # load_snapshot.go:1070-1086
 # per-part retry backoff base (chaos trials shrink this: the retry
 # schedule is under test there, not the sleep lengths)
 PART_RETRY_BASE_DELAY = 1.0
+
+# Staged two-phase sink commits (abstract/commit.py): on by default
+# wherever both the sink and the coordinator are capable; "off"/"0"
+# forces every sink back to the at-least-once path.
+ENV_STAGED_COMMIT = "TRANSFERIA_TPU_STAGED_COMMIT"
+
+
+def staged_commits_enabled(environ=os.environ) -> bool:
+    return str(environ.get(ENV_STAGED_COMMIT, "auto")).lower() not in (
+        "off", "0", "false", "no")
 
 
 @dataclass
@@ -121,6 +138,11 @@ class SnapshotLoader:
         self.metrics = metrics or Metrics()
         self.table_stats = TableStats(self.metrics)
         self.lease_stats = LeaseStats(self.metrics)
+        self.commit_stats = CommitStats(self.metrics)
+        # staged two-phase commits need a coordinator that can fence
+        # the publish decision; the sink side is probed per part
+        self._staged_commits = staged_commits_enabled() and \
+            coordinator.supports_staged_commits()
         self.worker_index = transfer.runtime.current_job
         self.process_count = max(1, transfer.runtime.sharding.process_count)
         self.is_main = transfer.runtime.is_main
@@ -742,6 +764,53 @@ class SnapshotLoader:
             on_retry=on_retry,
         )
 
+    def _commit_and_publish(self, staged, part: OperationTablePart
+                            ) -> bool:
+        """Phase 2 of the staged commit: ask the coordinator for the
+        fenced publish decision, then publish the staged data.  True =
+        published (or deliberately published unfenced on a coordinator
+        that lost support mid-flight); False = fenced — the caller
+        aborts and drops the result."""
+        granted = self.cp.commit_part(self.operation_id, part)
+        if granted is False:
+            self.commit_stats.commit_fenced.inc()
+            LEDGER.add(commit_fences=1)
+            trace.instant("commit_fenced", part=part.key(),
+                          epoch=part.assignment_epoch)
+            return False
+        if granted is None:
+            # the coordinator cannot fence (capability probe raced a
+            # downgrade): publishing unfenced degrades this part to
+            # at-least-once — never strand staged rows invisibly
+            logger.warning(
+                "coordinator cannot fence commit of %s; publishing "
+                "unfenced (at-least-once for this part)", part.key())
+        else:
+            part.commit_epoch = part.assignment_epoch
+            self.commit_stats.commit_granted.inc()
+        try:
+            published = staged.publish_part(part.key(),
+                                            part.assignment_epoch)
+        except StaleEpochPublishError as e:
+            # the sink's own epoch fence caught a grant/steal race: a
+            # newer owner already published this part
+            self.commit_stats.publish_stale_rejected.inc()
+            LEDGER.add(commit_fences=1)
+            trace.instant("publish_stale_rejected", part=part.key(),
+                          epoch=part.assignment_epoch)
+            logger.warning("publish of %s rejected by sink fence: %s",
+                           part.key(), e)
+            return False
+        self.commit_stats.published_parts.inc()
+        dropped = getattr(staged, "last_dedup_dropped", 0)
+        if dropped:
+            self.commit_stats.dedup_rows_dropped.inc(dropped)
+        LEDGER.add(commits=1)
+        trace.instant("part_published", part=part.key(),
+                      epoch=part.assignment_epoch, rows=published,
+                      dedup_dropped=dropped)
+        return True
+
     def _upload_part(self, storage: Storage, part: OperationTablePart,
                      schemas: dict) -> None:
         """One part: fresh sink pipeline, init/rows/done, progress flush
@@ -768,6 +837,13 @@ class SnapshotLoader:
         sink = make_async_sink(self.transfer, self.metrics,
                                snapshot_stage=True,
                                post_transform_wrap=wrap)
+        # staged two-phase commit (abstract/commit.py): when both ends
+        # are capable, this part's batches land invisibly in the sink's
+        # staging area and publish only after the coordinator grants a
+        # fenced commit_part decision — the exactly-once path.  Either
+        # end lacking the capability keeps the at-least-once path.
+        staged = find_staged_sink(sink) if self._staged_commits else None
+        publish_fenced = False
         rows_done = 0
         read_bytes = 0
         batch_seq = 0
@@ -781,6 +857,11 @@ class SnapshotLoader:
         futures: deque = deque()
         try:
             with part_sp, LEDGER.context(part=part.key()):
+                if staged is not None:
+                    # a retried part restages from scratch: begin
+                    # REPLACES anything a previous attempt staged
+                    staged.begin_part(part.key(), part.assignment_epoch)
+                    self.commit_stats.staged_parts.inc()
                 sink.async_push(
                     [init_table_load(tid, schema, part_id)]
                 ).result()
@@ -824,7 +905,20 @@ class SnapshotLoader:
                 sink.async_push(
                     [done_table_load(tid, schema, part_id)]
                 ).result()
+                if staged is not None:
+                    # phase 2: the single fenced publish decision, then
+                    # the staged data becomes visible (or is aborted)
+                    publish_fenced = not self._commit_and_publish(
+                        staged, part)
         except BaseException as e:
+            if staged is not None:
+                # discard this attempt's staging; a retry re-begins
+                # (which replaces) — this only matters on final failure
+                try:
+                    staged.abort_part(part.key())
+                except Exception as abort_err:
+                    logger.warning("staged abort of %s failed: %s",
+                                   part.key(), abort_err)
             raise TableUploadError(
                 f"part {part.key()} failed after {rows_done} rows: {e}",
                 cause=e,
@@ -845,6 +939,25 @@ class SnapshotLoader:
                     except Exception:  # trtpu: ignore[EXC001]
                         pass
             sink.close()
+        if publish_fenced:
+            # staged-commit fence: the part was reclaimed since our
+            # claim (or our publish lost to a newer epoch at the sink).
+            # The new owner's publish is authoritative; our staged data
+            # was aborted and nothing of ours became visible.  Same
+            # engine contract as a fenced update_operation_parts: drop
+            # the result, do NOT fail the worker.
+            try:
+                staged.abort_part(part.key())
+            except Exception as abort_err:
+                logger.warning("staged abort of %s failed: %s",
+                               part.key(), abort_err)
+            self.commit_stats.aborted_parts.inc()
+            self.lease_stats.fence_rejected.inc()
+            logger.warning(
+                "part %s publish fenced (stale epoch %d): the part was "
+                "reclaimed; staged data discarded, nothing published",
+                part.key(), part.assignment_epoch)
+            return
         part.completed = True
         part.completed_rows = rows_done
         part.read_bytes = read_bytes
