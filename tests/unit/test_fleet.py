@@ -533,3 +533,44 @@ def test_scheduler_stays_live_after_last_slot_dies_idle():
         assert ran == [1]
     finally:
         sched.shutdown()
+
+
+def test_desired_workers_gauge_fresh_on_backpressure_tick():
+    """The desired_workers GAUGE must not go stale: it refreshes on
+    the backpressure tick and on shed decisions, so a drained-then-
+    idle fleet never keeps advertising its last busy value to the
+    autoscaler (ISSUE 12 satellite)."""
+    m = Metrics()
+    bp = BackpressureController(
+        m, signals=(SignalSpec("depth", "fleet_queue_depth",
+                               high=1e9, low=1e9),))
+    sched = FleetScheduler(workers=1, max_inflight_per_worker=1,
+                           backpressure=bp, metrics=m, name="test")
+    for i in range(6):
+        sched.submit(_ticket(i, "t"))
+    assert m.value("fleet_desired_workers") == 6
+    # drain the queue without going through dispatch bookkeeping
+    # events: pop everything under the lock, as a stall would leave it
+    with sched._cond:
+        for tn in sched._tenants.values():
+            while tn.queued:
+                t = tn.pop_head()
+                t.state = "done"
+                sched._pending -= 1
+        sched._active.clear()
+    # the gauge is stale now; the next backpressure tick refreshes it
+    bp.overloaded()
+    assert m.value("fleet_desired_workers") == 1
+    assert m.value("fleet_queue_depth") == 0
+
+
+def test_desired_workers_gauge_fresh_on_shed():
+    m = Metrics()
+    sched = FleetScheduler(workers=1, max_inflight_per_worker=1,
+                           tenant_queue_quota=2, metrics=m,
+                           name="test")
+    for i in range(3):
+        sched.submit(_ticket(i, "t"))
+    # the shed decision itself refreshed the gauges (2 queued tickets
+    # over 1 lane -> 2 workers wanted)
+    assert m.value("fleet_desired_workers") == 2

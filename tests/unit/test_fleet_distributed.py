@@ -1,0 +1,570 @@
+"""Distributed fleet: durable admission via DistributedFleetScheduler,
+FleetWorker claim/run/complete with preemption yield + resume, the
+WorkerSupervisor (thread mode), and the elastic autoscaler's
+hysteresis (fleet/distributed.py, fleet/worker.py, fleet/autoscaler.py).
+"""
+
+import threading
+import time
+
+import pytest
+
+from transferia_tpu.abstract.ticket import FleetTicket
+from transferia_tpu.coordinator.memory import MemoryCoordinator
+from transferia_tpu.fleet.autoscaler import FleetAutoscaler
+from transferia_tpu.fleet.distributed import (
+    DistributedFleetScheduler,
+    WdrrPicker,
+    charged_cost,
+)
+from transferia_tpu.fleet.worker import (
+    FleetWorker,
+    TicketRunContext,
+    WorkerSupervisor,
+)
+from transferia_tpu.stats.registry import Metrics
+
+
+def sample_ticket(i, tenant="a", qos="batch", rows=256, **extra):
+    from transferia_tpu.providers.memory import get_store
+
+    sink = f"tfd-{i}"
+    get_store(sink).clear()
+    payload = {"kind": "sample_snapshot", "rows": rows,
+               "sink_id": sink, "operation_id": f"op-tfd-{i}",
+               **extra}
+    return FleetTicket(ticket_id=f"t{i}", transfer_id=f"tr{i}",
+                       tenant=tenant, qos=qos, payload=payload)
+
+
+def noop_ticket(i, tenant="a", qos="batch", cost=1):
+    return FleetTicket(ticket_id=f"t{i}", tenant=tenant, qos=qos,
+                       cost=cost, payload={"kind": "noop"})
+
+
+def noop_runner(ticket, ctx):
+    pass
+
+
+class TestWdrrPicker:
+    def test_qos_then_seq_within_tenant(self):
+        p = WdrrPicker()
+        tickets = [noop_ticket(0, qos="scavenger"),
+                   noop_ticket(1, qos="interactive"),
+                   noop_ticket(2, qos="batch")]
+        for i, t in enumerate(tickets):
+            t.seq = i
+        order = []
+        pool = list(tickets)
+        while pool:
+            got = p.pick(pool)
+            p.charge(got)
+            order.append(got.ticket_id)
+            pool.remove(got)
+        assert order == ["t1", "t2", "t0"]
+
+    def test_weighted_fair_share(self):
+        # tenant "big" (weight 3) should drain ~3x faster than "small"
+        p = WdrrPicker(tenant_weights={"big": 3.0, "small": 1.0})
+        pool = []
+        for i in range(8):
+            t = noop_ticket(i, tenant="big")
+            t.seq = i
+            pool.append(t)
+        for i in range(8, 16):
+            t = noop_ticket(i, tenant="small")
+            t.seq = i
+            pool.append(t)
+        first8 = []
+        for _ in range(8):
+            got = p.pick(pool)
+            p.charge(got)
+            first8.append(got.tenant)
+            pool.remove(got)
+        assert first8.count("big") >= 5
+
+    def test_charged_cost_qos_factor(self):
+        assert charged_cost(noop_ticket(0, qos="interactive")) == 1
+        assert charged_cost(noop_ticket(0, qos="batch")) == 2
+        assert charged_cost(noop_ticket(0, qos="scavenger")) == 4
+        assert charged_cost(noop_ticket(0, qos="batch", cost=3)) == 6
+        assert charged_cost(noop_ticket(0, qos="bogus")) == 2
+
+    def test_empty_pool(self):
+        assert WdrrPicker().pick([]) is None
+
+
+class TestDistributedScheduler:
+    def test_requires_queue_capable_coordinator(self):
+        class NoQueue(MemoryCoordinator):
+            claim_ticket = \
+                MemoryCoordinator.__mro__[1].claim_ticket
+
+        with pytest.raises(ValueError):
+            DistributedFleetScheduler(NoQueue())
+
+    def test_submit_admits_and_is_idempotent(self):
+        cp = MemoryCoordinator()
+        s = DistributedFleetScheduler(cp, queue="q")
+        assert s.submit(noop_ticket(0)) == "admitted"
+        assert s.submit(noop_ticket(0)) == "admitted"
+        assert len(cp.list_tickets("q")) == 1
+        assert s.admission_log == ["t0"]
+
+    def test_submit_sheds_on_tenant_quota(self):
+        cp = MemoryCoordinator()
+        s = DistributedFleetScheduler(cp, queue="q",
+                                      tenant_queue_quota=2)
+        assert s.submit(noop_ticket(0)) == "admitted"
+        assert s.submit(noop_ticket(1)) == "admitted"
+        assert s.submit(noop_ticket(2)) == "shed-tenant-quota"
+        # other tenants are unaffected
+        assert s.submit(noop_ticket(3, tenant="b")) == "admitted"
+        assert s.shed_log == [("t2", "shed-tenant-quota")]
+
+    def test_failover_resumes_durable_queue(self):
+        cp = MemoryCoordinator()
+        a = DistributedFleetScheduler(cp, queue="q", name="a")
+        for i in range(3):
+            a.submit(noop_ticket(i))
+        del a  # replica A crashes; the queue is durable
+        b = DistributedFleetScheduler(cp, queue="q", name="b")
+        assert b.resume() == {"queued": 3, "claimed": 0, "done": 0,
+                              "failed": 0}
+        # and B can't double-admit what A already admitted
+        assert b.submit(noop_ticket(1)) == "admitted"
+        assert len(cp.list_tickets("q")) == 3
+
+    def test_desired_workers_tracks_queue_live(self):
+        cp = MemoryCoordinator()
+        s = DistributedFleetScheduler(cp, queue="q")
+        assert s.desired_workers() == 1
+        for i in range(4):
+            s.submit(noop_ticket(i))
+        assert s.desired_workers() == 4
+        # drain the queue out-of-band: the hint must fall back
+        # immediately (recomputed on read, no stale last-busy value)
+        for t in cp.list_tickets("q"):
+            won = cp.claim_ticket("q", t.ticket_id, "w0")
+            cp.complete_ticket("q", won)
+        assert s.desired_workers() == 1
+
+    def test_preempt_revokes_lowest_priority(self):
+        cp = MemoryCoordinator()
+        s = DistributedFleetScheduler(cp, queue="q",
+                                      capacity=lambda: 2)
+        for i, qos in enumerate(["batch", "scavenger"]):
+            s.submit(noop_ticket(i, qos=qos))
+        cp.claim_ticket("q", "t0", "w0")
+        cp.claim_ticket("q", "t1", "w1")
+        # no interactive queued: nothing to preempt
+        assert s.preempt_if_needed() is None
+        s.submit(noop_ticket(9, qos="interactive"))
+        # both lanes busy -> the scavenger (lowest priority) is revoked
+        assert s.preempt_if_needed() == "t1"
+        t1 = {t.ticket_id: t for t in cp.list_tickets("q")}["t1"]
+        assert t1.state == "queued"
+        assert t1.preempted_from == "w1"
+        assert s.preempt_log == [("t1", "w1", 2)]
+
+    def test_no_preempt_with_free_lane(self):
+        cp = MemoryCoordinator()
+        s = DistributedFleetScheduler(cp, queue="q",
+                                      capacity=lambda: 2)
+        s.submit(noop_ticket(0, qos="scavenger"))
+        cp.claim_ticket("q", "t0", "w0")
+        s.submit(noop_ticket(1, qos="interactive"))
+        assert s.preempt_if_needed() is None  # a lane is free
+
+    def test_preempt_skips_dead_workers_expired_claim(self):
+        """An expired-lease claim is a dead worker's — revoking it
+        would free no lane; the RUNNING lowest-priority ticket is the
+        victim, and the dead claim stays for the crash-reclaim path
+        (which records stolen_from)."""
+        cp = MemoryCoordinator(lease_seconds=0.15)
+        s = DistributedFleetScheduler(cp, queue="q",
+                                      capacity=lambda: 1)
+        s.submit(noop_ticket(0, qos="scavenger"))
+        s.submit(noop_ticket(1, qos="batch"))
+        cp.claim_ticket("q", "t0", "w-dead")
+        time.sleep(0.3)  # w-dead's lease expires (crashed)
+        cp.claim_ticket("q", "t1", "w-live")
+        s.submit(noop_ticket(2, qos="interactive"))
+        # t0 (scavenger, dead claim) would out-rank t1 as victim by
+        # qos — but it holds no lane; the live batch ticket yields
+        assert s.preempt_if_needed() == "t1"
+
+    def test_drain_empty_queue_is_drained(self):
+        cp = MemoryCoordinator()
+        s = DistributedFleetScheduler(cp, queue="q")
+        assert s.drain(timeout=1.0) is True
+
+    def test_no_preempt_same_rank(self):
+        cp = MemoryCoordinator()
+        s = DistributedFleetScheduler(cp, queue="q",
+                                      capacity=lambda: 1)
+        s.submit(noop_ticket(0, qos="batch"))
+        cp.claim_ticket("q", "t0", "w0")
+        s.submit(noop_ticket(1, qos="batch"))
+        assert s.preempt_if_needed() is None
+
+
+class TestFleetWorker:
+    def test_runs_tickets_and_completes(self):
+        cp = MemoryCoordinator()
+        ran = []
+        for i in range(3):
+            cp.enqueue_ticket("q", noop_ticket(i))
+        w = FleetWorker(cp, queue="q", worker_index=0,
+                        runners={"noop": lambda t, c:
+                                 ran.append(t.ticket_id)},
+                        idle_exit_seconds=0.3,
+                        heartbeat_interval=0.05)
+        w.run(threading.Event())
+        assert sorted(ran) == ["t0", "t1", "t2"]
+        assert all(t.state == "done" for t in cp.list_tickets("q"))
+        assert w.tickets_run == 3
+
+    def test_failing_ticket_retried_then_failed(self):
+        cp = MemoryCoordinator()
+        cp.enqueue_ticket("q", noop_ticket(0))
+        calls = []
+
+        def boom(t, c):
+            calls.append(t.attempts)
+            raise ConnectionError("flaky")
+
+        w = FleetWorker(cp, queue="q", worker_index=0,
+                        runners={"noop": boom}, max_attempts=3,
+                        idle_exit_seconds=0.3,
+                        heartbeat_interval=0.05)
+        w.run(threading.Event())
+        t = cp.list_tickets("q")[0]
+        assert t.state == "failed"
+        assert calls == [1, 2, 3]
+        assert "flaky" in t.error
+
+    def test_preempt_yields_do_not_burn_retry_budget(self):
+        """A ticket preempted (max_attempts - 1) times must still
+        survive one transient failure: yields are scheduler-initiated,
+        only failed RUN attempts count against the budget."""
+        from transferia_tpu.abstract.errors import (
+            TransferPreemptedError,
+        )
+
+        cp = MemoryCoordinator()
+        cp.enqueue_ticket("q", noop_ticket(0))
+        calls = []
+
+        def script(t, ctx):
+            calls.append((t.attempts, t.failures))
+            if len(calls) <= 2:
+                raise TransferPreemptedError("yield")  # 2 preempts
+            if len(calls) == 3:
+                raise ConnectionError("one transient blip")
+            # 4th claim succeeds
+
+        w = FleetWorker(cp, queue="q", worker_index=0,
+                        runners={"noop": script}, max_attempts=3,
+                        idle_exit_seconds=0.3,
+                        heartbeat_interval=0.05)
+        w.run(threading.Event())
+        t = cp.list_tickets("q")[0]
+        assert t.state == "done", (t.state, t.error, calls)
+        assert t.failures == 1
+        assert t.attempts == 4
+
+    def test_resume_flag_set_on_reclaim(self):
+        cp = MemoryCoordinator()
+        cp.enqueue_ticket("q", noop_ticket(0))
+        seen = []
+
+        def record(t, ctx):
+            seen.append((t.attempts, ctx.resume))
+            if t.attempts == 1:
+                raise ConnectionError("first attempt dies")
+
+        w = FleetWorker(cp, queue="q", worker_index=0,
+                        runners={"noop": record}, max_attempts=3,
+                        idle_exit_seconds=0.3,
+                        heartbeat_interval=0.05)
+        w.run(threading.Event())
+        assert seen == [(1, False), (2, True)]
+
+    def test_drain_requests_yield_and_exits(self):
+        cp = MemoryCoordinator()
+        cp.enqueue_ticket("q", noop_ticket(0))
+        started = threading.Event()
+
+        def slow(t, ctx):
+            started.set()
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                if ctx.preempted():
+                    from transferia_tpu.abstract.errors import (
+                        TransferPreemptedError,
+                    )
+
+                    raise TransferPreemptedError("yield")
+                time.sleep(0.01)
+            raise AssertionError("drain never signalled")
+
+        w = FleetWorker(cp, queue="q", worker_index=0,
+                        runners={"noop": slow},
+                        heartbeat_interval=0.05)
+        th = threading.Thread(target=w.run, args=(threading.Event(),))
+        th.start()
+        assert started.wait(5.0)
+        w.request_drain()
+        th.join(timeout=5.0)
+        assert not th.is_alive()
+        # the yielded ticket went back to the queue for a peer
+        assert cp.list_tickets("q")[0].state == "queued"
+
+
+class FakeSupervisor:
+    """Counts scale actions for hysteresis tests."""
+
+    def __init__(self, live=0):
+        self.live = live
+        self.actions = []
+
+    def reap(self):
+        return 0
+
+    def live_workers(self):
+        return self.live
+
+    def draining_workers(self):
+        return 0
+
+    def scale_to(self, n):
+        self.actions.append(("scale_to", n))
+        self.live = n
+
+    def retire_one(self):
+        self.actions.append(("retire", self.live - 1))
+        self.live -= 1
+        return self.live
+
+
+class FakeScheduler:
+    def __init__(self):
+        self.desired = 1
+        self.ticks = 0
+        self.stats = __import__(
+            "transferia_tpu.stats.registry",
+            fromlist=["DistributedFleetStats"],
+        ).DistributedFleetStats(Metrics())
+
+    def tick(self):
+        self.ticks += 1
+
+    def desired_workers(self):
+        return self.desired
+
+
+class TestAutoscalerHysteresis:
+    def mk(self, **kw):
+        sched = FakeScheduler()
+        sup = FakeSupervisor(live=kw.pop("live", 1))
+        scaler = FleetAutoscaler(sched, sup, min_workers=1,
+                                 max_workers=4, scale_up_after=2,
+                                 scale_down_after=3, **kw)
+        return sched, sup, scaler
+
+    def test_scale_up_needs_sustained_demand(self):
+        sched, sup, scaler = self.mk(live=1)
+        sched.desired = 3
+        assert scaler.step()["action"] == "hold"  # streak 1: no scale
+        assert sup.live == 1
+        assert scaler.step()["action"] == "up:3"  # streak 2: scale
+        assert sup.live == 3
+
+    def test_demand_blip_does_not_scale(self):
+        sched, sup, scaler = self.mk(live=1)
+        sched.desired = 3
+        scaler.step()
+        sched.desired = 1  # blip over: streak resets
+        scaler.step()
+        sched.desired = 3
+        scaler.step()
+        assert sup.live == 1  # never scaled
+
+    def test_scale_down_gradual_after_sustained_idle(self):
+        sched, sup, scaler = self.mk(live=4)
+        sched.desired = 1
+        for _ in range(2):
+            assert scaler.step()["action"] == "hold"
+        assert scaler.step()["action"].startswith("down")
+        assert sup.live == 3  # one worker per trigger, not a cliff
+        for _ in range(3):
+            scaler.step()
+        assert sup.live == 2
+
+    def test_floor_bypasses_hysteresis(self):
+        sched, sup, scaler = self.mk(live=0)
+        sched.desired = 1
+        assert scaler.step()["action"] == "floor:1"
+        assert sup.live == 1  # crash replacement is immediate
+
+    def test_clamped_to_max(self):
+        sched, sup, scaler = self.mk(live=1)
+        sched.desired = 100
+        scaler.step()
+        scaler.step()
+        assert sup.live == 4
+
+    def test_step_drives_scheduler_tick(self):
+        sched, sup, scaler = self.mk()
+        scaler.step()
+        assert sched.ticks == 1
+
+
+class TestSupervisorThreadMode:
+    def test_scale_up_reap_and_drain(self):
+        cp = MemoryCoordinator()
+
+        def factory(index):
+            return FleetWorker(cp, queue="q", worker_index=index,
+                               runners={"noop": noop_runner},
+                               idle_exit_seconds=60.0,
+                               heartbeat_interval=0.1)
+
+        sup = WorkerSupervisor(mode="thread", worker_factory=factory)
+        sup.scale_to(2)
+        assert sup.live_workers() == 2
+        assert sup.spawn_log == [0, 1]
+        sup.scale_to(1)  # drains one idle worker
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and sup.live_workers() > 1:
+            sup.reap()
+            time.sleep(0.02)
+        assert sup.live_workers() == 1
+        sup.shutdown(timeout=5.0)
+        assert sup.live_workers() == 0
+
+    def test_crashed_worker_respawned_by_scale_to(self):
+        cp = MemoryCoordinator()
+        cp.enqueue_ticket("q", noop_ticket(0))
+
+        def killer(t, ctx):
+            from transferia_tpu.abstract.errors import (
+                WorkerKilledError,
+            )
+
+            raise WorkerKilledError("chaos")
+
+        def factory(index):
+            return FleetWorker(cp, queue="q", worker_index=index,
+                               runners={"noop": killer},
+                               idle_exit_seconds=60.0,
+                               heartbeat_interval=0.1)
+
+        sup = WorkerSupervisor(mode="thread", worker_factory=factory)
+        sup.scale_to(1)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and sup.live_workers() > 0:
+            sup.reap()
+            time.sleep(0.02)
+        assert sup.live_workers() == 0  # the crash was observed
+        sup.scale_to(1)  # replacement worker (fresh index)
+        assert sup.live_workers() == 1
+        assert sup.spawn_log == [0, 1]
+        sup.shutdown(timeout=5.0)
+
+
+class TestEndToEndPreemption:
+    def test_preempted_transfer_resumes_from_committed_parts(self):
+        """The full tentpole invariant in miniature: a scavenger
+        transfer is revoked mid-run, the interactive arrival runs
+        first, the scavenger resumes from committed parts, and the
+        delivered multiset is exactly-once."""
+        from transferia_tpu.chaos.invariants import _batches_to_counter
+        from transferia_tpu.providers.memory import get_store
+
+        cp = MemoryCoordinator(lease_seconds=30)
+        sched = DistributedFleetScheduler(cp, queue="q",
+                                          capacity=lambda: 1)
+        get_store("tfd-scav").clear()
+        get_store("tfd-int").clear()
+        sched.submit(FleetTicket(
+            ticket_id="scav", transfer_id="scav", tenant="a",
+            qos="scavenger",
+            payload={"kind": "sample_snapshot", "rows": 1024,
+                     "shard_parts": 4, "sink_id": "tfd-scav",
+                     "operation_id": "op-scav"}))
+        fired = []
+
+        def hook(ticket, boundary):
+            if ticket.ticket_id == "scav" and boundary == 3 \
+                    and not fired:
+                fired.append(1)
+                sched.submit(FleetTicket(
+                    ticket_id="inter", transfer_id="inter",
+                    tenant="a", qos="interactive",
+                    payload={"kind": "sample_snapshot", "rows": 256,
+                             "sink_id": "tfd-int",
+                             "operation_id": "op-inter"}))
+                sched.preempt_if_needed()
+
+        w = FleetWorker(cp, queue="q", worker_index=0,
+                        idle_exit_seconds=1.0,
+                        part_boundary_hook=hook,
+                        heartbeat_interval=0.05)
+        w.run(threading.Event())
+        tickets = {t.ticket_id: t for t in cp.list_tickets("q")}
+        assert tickets["scav"].state == "done"
+        assert tickets["scav"].preemptions == 1
+        assert tickets["inter"].state == "done"
+        # the interactive arrival ran BEFORE the scavenger resumed
+        order = [c[0] for c in w.claim_log]
+        assert order == ["scav", "inter", "scav"]
+        obs = _batches_to_counter(get_store("tfd-scav").batches)
+        assert sum(obs.values()) == 1024
+        assert max(obs.values()) == 1  # exactly-once across the yield
+        get_store("tfd-scav").clear()
+        get_store("tfd-int").clear()
+
+
+class TestDebugSurfaces:
+    def test_debug_fleet_carries_commit_rollup(self):
+        from transferia_tpu import fleet
+
+        snap = fleet.debug_snapshot()
+        assert set(snap["commits"]) == {
+            "commit_parts", "commit_fences", "dedup_rows_dropped"}
+        assert "autoscalers" in snap
+
+    def test_format_top_shows_commit_columns(self):
+        from transferia_tpu.stats.ledger import FIELDS, format_top
+
+        entry = dict.fromkeys(FIELDS, 0)
+        entry.update(tenant="a", parts=1, commits=7, commit_fences=2,
+                     dedup_rows_dropped=13)
+        snap = {"entries": 1, "overflow_folded": 0,
+                "totals": {**dict.fromkeys(FIELDS, 0), "commits": 7,
+                           "commit_fences": 2,
+                           "dedup_rows_dropped": 13},
+                "conservation": {"ok": True},
+                "tenants": {}, "transfers": {"tr-1": entry}}
+        out = format_top(snap)
+        assert "commits 7 (2 fenced, 13 deduped)" in out
+        assert "commit" in out and "fence" in out and "dedup" in out
+        row = [ln for ln in out.splitlines()
+               if ln.lstrip().startswith("tr-1")][0]
+        assert row.split()[-3:] == ["7", "2", "13"]
+
+    def test_scheduler_snapshot_registered(self):
+        from transferia_tpu import fleet
+
+        cp = MemoryCoordinator()
+        s = DistributedFleetScheduler(cp, queue="q").register()
+        try:
+            s.submit(noop_ticket(0))
+            snap = fleet.debug_snapshot()
+            mine = [x for x in snap["schedulers"]
+                    if x.get("kind") == "distributed"]
+            assert mine and mine[0]["tickets"]["queued"] == 1
+        finally:
+            s.unregister()

@@ -1,0 +1,331 @@
+"""Durable fleet admission queue: coordinator conformance across
+memory / filestore / s3, including exactly-once claims under
+concurrent schedulers, stale-epoch fencing of zombie ticket
+completions, crash reclaim via lease expiry, and preemption revokes
+(coordinator/interface.py ticket APIs, abstract/ticket.py state
+machine)."""
+
+import threading
+import time
+
+import pytest
+
+from transferia_tpu.abstract.ticket import FleetTicket
+from transferia_tpu.coordinator import (
+    FileStoreCoordinator,
+    MemoryCoordinator,
+    S3Coordinator,
+)
+
+
+def make_ticket(i=0, tenant="a", qos="batch", **payload):
+    return FleetTicket(ticket_id=f"t{i}", transfer_id=f"tr{i}",
+                       tenant=tenant, qos=qos, payload=payload)
+
+
+@pytest.fixture(params=["memory", "filestore", "s3", "s3-lww"])
+def cp(request, tmp_path):
+    if request.param == "memory":
+        yield MemoryCoordinator()
+        return
+    if request.param == "filestore":
+        yield FileStoreCoordinator(root=str(tmp_path / "cp"))
+        return
+    from tests.recipes.fake_s3 import FakeS3
+
+    fake = FakeS3(
+        conditional_writes=(request.param == "s3"), page_size=3,
+    ).start()
+    try:
+        yield S3Coordinator(
+            bucket="cp-bucket", endpoint=fake.endpoint,
+            access_key="test-ak", secret_key="test-sk",
+        )
+    finally:
+        fake.stop()
+
+
+class TestTicketQueue:
+    def test_supports_ticket_queue(self, cp):
+        assert cp.supports_ticket_queue()
+
+    def test_enqueue_assigns_monotonic_seq(self, cp):
+        seqs = [cp.enqueue_ticket("q", make_ticket(i)).seq
+                for i in range(3)]
+        assert seqs == [0, 1, 2]
+        assert [t.ticket_id for t in cp.list_tickets("q")] == \
+            ["t0", "t1", "t2"]
+        assert all(t.state == "queued" for t in cp.list_tickets("q"))
+
+    def test_enqueue_idempotent_by_ticket_id(self, cp):
+        first = cp.enqueue_ticket("q", make_ticket(0, qos="scavenger"))
+        again = cp.enqueue_ticket("q", make_ticket(0, qos="batch"))
+        # the stored ticket wins wholesale: re-submission (scheduler
+        # replica, faulted-RPC retry) can never double-admit or mutate
+        assert again.seq == first.seq == 0
+        assert again.qos == "scavenger"
+        assert len(cp.list_tickets("q")) == 1
+
+    def test_queues_are_isolated(self, cp):
+        cp.enqueue_ticket("q1", make_ticket(0))
+        cp.enqueue_ticket("q2", make_ticket(1))
+        assert [t.ticket_id for t in cp.list_tickets("q1")] == ["t0"]
+        assert [t.ticket_id for t in cp.list_tickets("q2")] == ["t1"]
+
+    def test_claim_is_exclusive_and_stamps_lease(self, cp):
+        cp.lease_seconds = 30.0
+        cp.enqueue_ticket("q", make_ticket(0))
+        won = cp.claim_ticket("q", "t0", "w1")
+        assert won is not None
+        assert won.state == "claimed"
+        assert won.claimed_by == "w1"
+        assert won.claim_epoch == 1
+        assert won.attempts == 1
+        assert won.lease_expires_at > time.time()
+        # live lease: nobody else can claim
+        assert cp.claim_ticket("q", "t0", "w2") is None
+        # durable: the stored copy carries the claim
+        stored = cp.list_tickets("q")[0]
+        assert stored.claimed_by == "w1"
+        assert stored.claim_epoch == 1
+
+    def test_claim_unknown_ticket(self, cp):
+        assert cp.claim_ticket("q", "nope", "w1") is None
+
+    def test_crash_reclaim_after_lease_expiry(self, cp):
+        cp.lease_seconds = 0.15
+        cp.enqueue_ticket("q", make_ticket(0))
+        first = cp.claim_ticket("q", "t0", "w1")
+        time.sleep(0.3)
+        stolen = cp.claim_ticket("q", "t0", "w2")
+        assert stolen is not None
+        assert stolen.claimed_by == "w2"
+        assert stolen.stolen_from == "w1"
+        assert stolen.claim_epoch == first.claim_epoch + 1
+        assert stolen.attempts == 2
+
+    def test_renew_extends_lease(self, cp):
+        cp.lease_seconds = 0.6
+        cp.enqueue_ticket("q", make_ticket(0))
+        assert cp.claim_ticket("q", "t0", "w1") is not None
+        for _ in range(3):
+            time.sleep(0.2)
+            assert cp.renew_ticket_leases("q", "w1") == 1
+            assert cp.claim_ticket("q", "t0", "w2") is None
+        time.sleep(0.7)
+        assert cp.claim_ticket("q", "t0", "w2") is not None
+        assert cp.renew_ticket_leases("q", "w1") == 0
+
+    def test_renew_scoped_to_ticket_skips_strays(self, cp):
+        """A restarted worker that reuses its index must not keep a
+        dead predecessor's stranded claim alive: renewal scoped to the
+        ticket actually held leaves the stray lease to expire and be
+        reclaimed (the workers always pass ticket_id)."""
+        cp.lease_seconds = 0.15
+        cp.enqueue_ticket("q", make_ticket(0))  # predecessor's ticket
+        cp.enqueue_ticket("q", make_ticket(1))  # new incarnation's
+        assert cp.claim_ticket("q", "t0", "w1") is not None
+        # worker 1 "restarts" and claims t1; its heartbeat renews ONLY
+        # t1 — t0's stranded lease must keep aging
+        assert cp.claim_ticket("q", "t1", "w1") is not None
+        for _ in range(3):
+            time.sleep(0.1)
+            assert cp.renew_ticket_leases("q", "w1",
+                                          ticket_id="t1") == 1
+        reclaimed = cp.claim_ticket("q", "t0", "w2")
+        assert reclaimed is not None
+        assert reclaimed.stolen_from == "w1"
+        # unscoped renewal still renews everything held (legacy shape)
+        assert cp.renew_ticket_leases("q", "w1") == 1  # just t1 now
+
+    def test_concurrent_enqueue_same_id_single_admission(self, cp,
+                                                         request):
+        """N submitters racing the same ticket_id (a retry storm after
+        a faulted admission RPC) admit it exactly once, even while
+        other tickets churn the seq space."""
+        if "s3-lww" in request.node.name:
+            pytest.skip("last-writer-wins endpoints may double-admit "
+                        "(reference semantics)")
+        errs = []
+
+        def same(i):
+            try:
+                cp.enqueue_ticket("q", make_ticket(0))
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errs.append(e)
+
+        def other(i):
+            try:
+                cp.enqueue_ticket("q", make_ticket(i))
+            except Exception as e:  # noqa: BLE001 - surfaced below
+                errs.append(e)
+
+        threads = [threading.Thread(target=same, args=(i,))
+                   for i in range(3)]
+        threads += [threading.Thread(target=other, args=(i,))
+                    for i in range(1, 4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errs, errs
+        tickets = cp.list_tickets("q")
+        ids = [t.ticket_id for t in tickets]
+        assert ids.count("t0") == 1  # no double admission
+        assert sorted(ids) == ["t0", "t1", "t2", "t3"]
+        seqs = [t.seq for t in tickets]
+        assert len(set(seqs)) == len(seqs)  # seq slots unique
+
+    def test_renew_epoch_scoped_blocks_same_id_twin(self, cp):
+        """Two workers that ended up with the same id (pid-1
+        containers): the stale one's epoch-scoped renewal must not
+        renew the thief's claim — it sees 0 renewed and yields."""
+        cp.lease_seconds = 0.15
+        cp.enqueue_ticket("q", make_ticket(0))
+        first = cp.claim_ticket("q", "t0", "w1")
+        time.sleep(0.3)
+        second = cp.claim_ticket("q", "t0", "w1")  # twin, same id
+        assert second.claim_epoch == first.claim_epoch + 1
+        # the stale twin renews at ITS epoch: nothing renewed
+        assert cp.renew_ticket_leases(
+            "q", "w1", ticket_id="t0",
+            claim_epoch=first.claim_epoch) == 0
+        # the live twin renews fine
+        assert cp.renew_ticket_leases(
+            "q", "w1", ticket_id="t0",
+            claim_epoch=second.claim_epoch) == 1
+
+    def test_complete_fences_stale_epoch(self, cp):
+        cp.lease_seconds = 0.15
+        cp.enqueue_ticket("q", make_ticket(0))
+        zombie = cp.claim_ticket("q", "t0", "w1")
+        time.sleep(0.3)
+        survivor = cp.claim_ticket("q", "t0", "w2")
+        assert survivor is not None
+        # the zombie wakes and claims completion with its dead epoch
+        assert cp.complete_ticket("q", zombie) is False
+        stored = cp.list_tickets("q")[0]
+        assert stored.state == "claimed"
+        assert stored.claimed_by == "w2"
+        # the live owner's completion lands
+        assert cp.complete_ticket("q", survivor) is True
+        assert cp.list_tickets("q")[0].state == "done"
+        # completion is IDEMPOTENT under one epoch: a worker retrying
+        # a lost RPC response is acknowledged, not misreported as a
+        # zombie fence...
+        assert cp.complete_ticket("q", survivor) is True
+        # ...while the zombie's stale epoch stays fenced even after
+        # the ticket went terminal
+        assert cp.complete_ticket("q", zombie) is False
+
+    def test_complete_with_error_fails_ticket(self, cp):
+        cp.enqueue_ticket("q", make_ticket(0))
+        won = cp.claim_ticket("q", "t0", "w1")
+        assert cp.complete_ticket("q", won, error="boom") is True
+        stored = cp.list_tickets("q")[0]
+        assert stored.state == "failed"
+        assert stored.error == "boom"
+
+    def test_release_requeues_with_attempt_counted(self, cp):
+        cp.enqueue_ticket("q", make_ticket(0))
+        won = cp.claim_ticket("q", "t0", "w1")
+        assert cp.release_ticket("q", won) is True
+        stored = cp.list_tickets("q")[0]
+        assert stored.state == "queued"
+        assert stored.claimed_by == ""
+        assert stored.attempts == 1
+        again = cp.claim_ticket("q", "t0", "w2")
+        assert again.claim_epoch == 2
+        assert again.attempts == 2
+        assert again.stolen_from == ""  # clean release is not a steal
+
+    def test_revoke_preempts_and_fences_holder(self, cp):
+        cp.lease_seconds = 30.0
+        cp.enqueue_ticket("q", make_ticket(0, qos="scavenger"))
+        held = cp.claim_ticket("q", "t0", "w1")
+        revoked = cp.revoke_ticket("q", "t0")
+        assert revoked is not None
+        assert revoked.state == "queued"
+        assert revoked.preempted_from == "w1"
+        assert revoked.preemptions == 1
+        assert revoked.claim_epoch == held.claim_epoch + 1
+        # the preempted holder is fenced on both exits
+        assert cp.release_ticket("q", held) is False
+        assert cp.complete_ticket("q", held) is False
+        # and the holder's heartbeat sees nothing left to renew — the
+        # revocation signal the worker yields on
+        assert cp.renew_ticket_leases("q", "w1") == 0
+        # nothing claimed: revoke is a no-op
+        assert cp.revoke_ticket("q", "t0") is None
+
+    def test_concurrent_claim_single_winner(self, cp, request):
+        if "s3-lww" in request.node.name:
+            pytest.skip("last-writer-wins endpoints may double-claim "
+                        "(reference semantics)")
+        cp.enqueue_ticket("q", make_ticket(0))
+        got = []
+        lock = threading.Lock()
+
+        def claim(wid):
+            won = cp.claim_ticket("q", "t0", wid)
+            if won is not None:
+                with lock:
+                    got.append((wid, won.claim_epoch))
+
+        threads = [threading.Thread(target=claim, args=(f"w{i}",))
+                   for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert len(got) == 1  # exactly-once claim across N schedulers
+        assert got[0][1] == 1
+
+    def test_concurrent_drain_each_ticket_once(self, cp, request):
+        if "s3-lww" in request.node.name:
+            pytest.skip("last-writer-wins endpoints may double-claim "
+                        "(reference semantics)")
+        for i in range(8):
+            cp.enqueue_ticket("q", make_ticket(i))
+        ran = []
+        lock = threading.Lock()
+
+        def worker(wid):
+            while True:
+                mine = None
+                for t in cp.list_tickets("q"):
+                    if t.state != "queued":
+                        continue
+                    won = cp.claim_ticket("q", t.ticket_id, wid)
+                    if won is not None:
+                        mine = won
+                        break
+                if mine is None:
+                    return
+                with lock:
+                    ran.append(mine.ticket_id)
+                assert cp.complete_ticket("q", mine) is True
+
+        threads = [threading.Thread(target=worker, args=(f"w{i}",))
+                   for i in range(3)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert sorted(ran) == [f"t{i}" for i in range(8)]
+        assert all(t.state == "done" for t in cp.list_tickets("q"))
+
+    def test_queue_survives_coordinator_restart(self, cp, tmp_path):
+        """A scheduler restart resumes exactly where it left off: the
+        queue state is durable, not scheduler memory (memory backend:
+        same object, the scheduler holding it is what restarts)."""
+        cp.enqueue_ticket("q", make_ticket(0))
+        won = cp.claim_ticket("q", "t0", "w1")
+        cp.enqueue_ticket("q", make_ticket(1))
+        if isinstance(cp, FileStoreCoordinator):
+            cp = FileStoreCoordinator(root=cp.root)  # fresh process
+        tickets = {t.ticket_id: t for t in cp.list_tickets("q")}
+        assert tickets["t0"].state == "claimed"
+        assert tickets["t0"].claimed_by == "w1"
+        assert tickets["t1"].state == "queued"
+        assert cp.complete_ticket("q", won) is True

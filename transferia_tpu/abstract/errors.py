@@ -30,6 +30,15 @@ class WorkerKilledError(TransferError):
     it after expiry — retrying locally would mask the death."""
 
 
+class TransferPreemptedError(TransferError):
+    """The fleet revoked this worker's ticket lease (a higher-priority
+    arrival needed the lane) and the snapshot loader yielded at a part
+    boundary.  Deliberately NOT retriable locally: the completed parts
+    are committed, the ticket is already requeued, and the transfer
+    resumes from those parts when it is next claimed — retrying here
+    would keep occupying the lane the preemption exists to free."""
+
+
 class StaleEpochPublishError(TransferError):
     """A staged-commit publish carried an assignment epoch older than
     the sink's last accepted publish for the part: a zombie worker woke
@@ -90,18 +99,25 @@ class CategorizedError(TransferError):
         self.cause = cause
 
 
-def is_fatal(err: BaseException) -> bool:
-    """abstract.IsFatal — walks the cause chain."""
+def cause_chain(err: BaseException):
+    """Iterate an error and its causes (``__cause__`` or a ``cause``
+    attribute, cycle-safe) — THE walk every classification predicate
+    below shares, so `is_fatal`/`is_worker_kill`/`is_preemption`/
+    `is_retriable` can never disagree about what "anywhere in the
+    chain" means."""
     seen = set()
     cur: Optional[BaseException] = err
     while cur is not None and id(cur) not in seen:
         seen.add(id(cur))
-        if isinstance(cur, FatalError):
-            return True
-        if isinstance(cur, CodedError) and cur.fatal:
-            return True
+        yield cur
         cur = cur.__cause__ or getattr(cur, "cause", None)
-    return False
+
+
+def is_fatal(err: BaseException) -> bool:
+    """abstract.IsFatal — walks the cause chain."""
+    return any(isinstance(cur, FatalError)
+               or (isinstance(cur, CodedError) and cur.fatal)
+               for cur in cause_chain(err))
 
 
 # Programming/schema errors: retrying re-executes the identical code on
@@ -110,20 +126,21 @@ def is_fatal(err: BaseException) -> bool:
 # a TableUploadError wrapping a TypeError fails fast too.
 _NON_RETRIABLE_TYPES = (TypeError, AttributeError, NameError, KeyError,
                         IndexError, AssertionError, WorkerKilledError,
-                        StaleEpochPublishError)
+                        StaleEpochPublishError, TransferPreemptedError)
 
 
 def is_worker_kill(err: BaseException) -> bool:
     """True when a WorkerKilledError sits anywhere in the cause chain
     (the snapshot loader wraps part failures in TableUploadError)."""
-    seen = set()
-    cur: Optional[BaseException] = err
-    while cur is not None and id(cur) not in seen:
-        seen.add(id(cur))
-        if isinstance(cur, WorkerKilledError):
-            return True
-        cur = cur.__cause__ or getattr(cur, "cause", None)
-    return False
+    return any(isinstance(cur, WorkerKilledError)
+               for cur in cause_chain(err))
+
+
+def is_preemption(err: BaseException) -> bool:
+    """True when a TransferPreemptedError sits anywhere in the cause
+    chain (same walk as is_worker_kill — wrappers preserve the chain)."""
+    return any(isinstance(cur, TransferPreemptedError)
+               for cur in cause_chain(err))
 
 
 def is_retriable(err: BaseException) -> bool:
@@ -132,11 +149,5 @@ def is_retriable(err: BaseException) -> bool:
     everything else gets the backoff schedule."""
     if is_fatal(err):
         return False
-    seen = set()
-    cur: Optional[BaseException] = err
-    while cur is not None and id(cur) not in seen:
-        seen.add(id(cur))
-        if isinstance(cur, _NON_RETRIABLE_TYPES):
-            return False
-        cur = cur.__cause__ or getattr(cur, "cause", None)
-    return True
+    return not any(isinstance(cur, _NON_RETRIABLE_TYPES)
+                   for cur in cause_chain(err))

@@ -304,6 +304,12 @@ class AuditingCoordinator(Coordinator):
         # staged-commit decisions: (part key, epoch, granted) — the
         # per-seed replay surface for exactly_once trials
         self.commit_log: list[tuple] = []
+        # durable fleet queue decisions — the three replay surfaces of
+        # fleet_distributed trials: accepted enqueues in call order,
+        # won claims, and preemption revokes
+        self.enqueue_log: list[tuple] = []
+        self.ticket_claim_log: list[tuple] = []
+        self.ticket_revoke_log: list[tuple] = []
 
     # -- watched methods ----------------------------------------------------
     def create_operation_parts(self, operation_id, parts):
@@ -333,6 +339,49 @@ class AuditingCoordinator(Coordinator):
 
     def supports_staged_commits(self):
         return self.inner.supports_staged_commits()
+
+    # -- durable fleet queue (watched: the replay surfaces) -----------------
+    def supports_ticket_queue(self):
+        return self.inner.supports_ticket_queue()
+
+    def enqueue_ticket(self, queue, ticket):
+        stored = self.inner.enqueue_ticket(queue, ticket)
+        with self._lock:
+            self.enqueue_log.append((stored.ticket_id, stored.seq))
+        return stored
+
+    def claim_ticket(self, queue, ticket_id, worker_id):
+        won = self.inner.claim_ticket(queue, ticket_id, worker_id)
+        if won is not None:
+            with self._lock:
+                self.ticket_claim_log.append(
+                    (won.ticket_id, worker_id, won.claim_epoch,
+                     won.stolen_from))
+        return won
+
+    def revoke_ticket(self, queue, ticket_id):
+        revoked = self.inner.revoke_ticket(queue, ticket_id)
+        if revoked is not None:
+            with self._lock:
+                self.ticket_revoke_log.append(
+                    (revoked.ticket_id, revoked.preempted_from,
+                     revoked.claim_epoch))
+        return revoked
+
+    def list_tickets(self, queue):
+        return self.inner.list_tickets(queue)
+
+    def renew_ticket_leases(self, queue, worker_id, ticket_id=None,
+                            claim_epoch=None):
+        return self.inner.renew_ticket_leases(
+            queue, worker_id, ticket_id=ticket_id,
+            claim_epoch=claim_epoch)
+
+    def complete_ticket(self, queue, ticket, error=""):
+        return self.inner.complete_ticket(queue, ticket, error=error)
+
+    def release_ticket(self, queue, ticket, failed=False):
+        return self.inner.release_ticket(queue, ticket, failed=failed)
 
     def set_transfer_state(self, transfer_id, state):
         self.state_writes += 1

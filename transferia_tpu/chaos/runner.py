@@ -106,6 +106,9 @@ class TrialResult:
     backend: str = ""
     commit_log: list = field(default_factory=list)
     dedup_dropped: int = 0
+    # fleet_distributed mode: preemption revokes observed (the claim /
+    # admission logs ride in steal_log / dispatch_order)
+    preempts: int = 0
 
     @property
     def passed(self) -> bool:
@@ -124,6 +127,7 @@ class TrialResult:
             "backend": self.backend,
             "commit_log": [list(c) for c in self.commit_log],
             "dedup_dropped": self.dedup_dropped,
+            "preempts": self.preempts,
             "fire_counts": {k: v for k, v in self.fire_counts.items()
                             if v},
             "fire_log": {k: v for k, v in self.fire_log.items() if v},
@@ -182,6 +186,14 @@ class ChaosReport:
                 rebalances = sum(len(r.steal_log) for r in rs)
                 line += (f", {kills} worker slot(s) killed, "
                          f"{rebalances} transfer(s) rebalanced")
+            if mode == "fleet_distributed":
+                kills = sum(r.kills for r in rs)
+                steals = sum(
+                    1 for r in rs for c in r.steal_log if c[3])
+                preempts = sum(r.preempts for r in rs)
+                line += (f", {kills} worker(s) killed, {steals} "
+                         f"ticket(s) reclaimed, {preempts} "
+                         f"preemption(s), logs replayed x2")
             if mode == "exactly_once":
                 kills = sum(r.kills for r in rs)
                 steals = sum(len(r.steal_log) for r in rs)
@@ -1185,6 +1197,295 @@ def run_scheduler_kill_trial(trial: int, seed: int, rows: int,
         dispatch_order=list(sched.dispatch_log))
 
 
+# -- fleet_distributed mode --------------------------------------------------
+#
+# The distributed-fleet gauntlet (fleet/distributed.py, fleet/worker.py;
+# ARCHITECTURE.md "Distributed fleet"): tickets are admitted into the
+# COORDINATOR-backed durable queue by scheduler replica A, which then
+# dies; replica B fails over onto the same queue (no ticket lost, the
+# idempotent enqueue makes re-submission double-admission-proof).  A
+# victim worker is killed mid-part (armed `snapshot.part.batch` kill):
+# its ticket lease expires and a survivor RECLAIMS the ticket, resuming
+# the transfer from its committed parts.  Mid-run, an INTERACTIVE
+# ticket arrives with no free lane and replica B revokes the running
+# low-priority ticket's lease — the survivor yields at a part boundary,
+# runs the interactive arrival first, then resumes the preempted
+# transfer.  The audit is EXACTLY-ONCE per ticket (staged memory sink):
+# every delivered multiset must equal the fault-free reference.  The
+# whole scenario runs TWICE per trial under the same seed and the three
+# queue logs (admission order, won claims, preemption revokes) must
+# replay byte-identically.
+
+FLEET_DIST_TICKETS = 5
+FLEET_DIST_ROWS = 1024
+
+
+def fleet_distributed_schedule(trial: int, seed: int) -> str:
+    """Seed-derived spec: one mid-part worker kill, plus (sometimes)
+    transient admission / claim / completion / heartbeat RPC faults the
+    retry machinery must absorb."""
+    rng = random.Random(f"{seed}:fleet_distributed:{trial}")
+    clauses = [
+        # each ticket is 4 parts x 2 batches = 8 victim batch hits;
+        # after<=5 guarantees the kill fires inside the victim's first
+        # ticket with work left for the survivor
+        f"snapshot.part.batch=after:{rng.randrange(0, 6)},times:1,"
+        f"raise:WorkerKilledError",
+    ]
+    if rng.random() < 0.5:
+        clauses.append(
+            f"fleet.enqueue=after:{rng.randrange(0, 3)},times:1,"
+            f"raise:ChaosInjectedError")
+    if rng.random() < 0.5:
+        clauses.append(
+            f"fleet.claim=after:{rng.randrange(0, 3)},times:1,"
+            f"raise:ChaosInjectedError")
+    if rng.random() < 0.5:
+        clauses.append(
+            f"fleet.complete=after:{rng.randrange(0, 2)},times:1,"
+            f"raise:ChaosInjectedError")
+    return ";".join(clauses)
+
+
+def _fleet_dist_scenario(trial: int, seed: int, rows: int, spec: str,
+                         run_tag: str) -> dict:
+    """One full scenario execution (a trial runs this twice and diffs
+    the logs).  Returns the logs, ticket end states, per-sink observed
+    batches and fire accounting."""
+    from transferia_tpu.abstract.ticket import FleetTicket
+    from transferia_tpu.fleet.distributed import DistributedFleetScheduler
+    from transferia_tpu.fleet.worker import FleetWorker
+    from transferia_tpu.providers.memory import get_store
+    from transferia_tpu.stats.registry import Metrics
+
+    queue = f"chaos-fd-{trial}"
+    tracker = MonotonicityTracker()
+    cp = AuditingCoordinator(
+        MemoryCoordinator(lease_seconds=TRIAL_LEASE_SECONDS), tracker)
+    violations: list[Violation] = []
+    qos_cycle = ("batch", "scavenger")
+
+    def mk_ticket(i: int, qos: str) -> FleetTicket:
+        sink_id = f"chaos-fd-{trial}-{run_tag}-{i:02d}"
+        get_store(sink_id).clear()
+        return FleetTicket(
+            ticket_id=f"tk-{i:02d}", transfer_id=f"chaos-fd-{i:02d}",
+            tenant=f"tenant-{i % 2}", qos=qos,
+            payload={
+                "kind": "sample_snapshot", "rows": rows,
+                "shard_parts": 4, "sink_id": sink_id,
+                "operation_id": f"op-fd-{i:02d}",
+                "transformation": {"transformers": [
+                    {"mask_field": {"columns": ["device_id"],
+                                    "salt": "chaos"}},
+                    {"filter_rows": {"filter": "temperature > -1000"}},
+                ]},
+                "validation": {"fingerprint": True},
+            })
+
+    with failpoints.active(spec, seed=seed * 1000 + trial):
+        # replica A admits the batch/scavenger load, then "crashes"
+        # (dropped on the floor — the queue is durable, A holds nothing)
+        sched_a = DistributedFleetScheduler(
+            cp, queue=queue, metrics=Metrics(),
+            name=f"chaos-fd-a-{trial}")
+        for i in range(FLEET_DIST_TICKETS):
+            ticket = mk_ticket(i, qos_cycle[i % 2])
+            for _ in range(5):
+                # admission faults are the submitter's to retry; the
+                # idempotent enqueue makes the retry safe
+                try:
+                    decision = sched_a.submit(ticket)
+                    break
+                except Exception as e:
+                    logger.info("chaos fd admit fault for %s: %s",
+                                ticket.ticket_id, e)
+            else:
+                violations.append(Violation(
+                    "fleet-admission",
+                    f"{ticket.ticket_id} never admitted"))
+                continue
+            if decision != "admitted":
+                violations.append(Violation(
+                    "fleet-admission",
+                    f"{ticket.ticket_id} shed: {decision}"))
+        del sched_a
+        # replica B fails over onto the durable queue
+        sched_b = DistributedFleetScheduler(
+            cp, queue=queue, metrics=Metrics(), capacity=lambda: 1,
+            name=f"chaos-fd-b-{trial}")
+        inherited = sched_b.resume()
+        if inherited.get("queued", 0) != FLEET_DIST_TICKETS:
+            violations.append(Violation(
+                "scheduler-failover",
+                f"replica B inherited {inherited} — expected "
+                f"{FLEET_DIST_TICKETS} queued ticket(s)"))
+
+        # phase 1: the victim worker drains alone until the armed
+        # mid-part kill fires; its claimed ticket stays leased
+        victim = FleetWorker(cp, queue=queue, worker_index=1,
+                             metrics=Metrics(),
+                             heartbeat_interval=TRIAL_HEARTBEAT_INTERVAL,
+                             idle_exit_seconds=0.5)
+        victim.run(threading.Event())
+        killed_ticket = None
+        if victim.dead:
+            held = [t for t in cp.list_tickets(queue)
+                    if t.state == "claimed" and t.claimed_by == "w1"]
+            if not held:
+                violations.append(Violation(
+                    "worker-crash",
+                    "victim died but left no leased ticket"))
+            else:
+                killed_ticket = held[0]
+        # let the dead worker's lease expire BEFORE the survivor starts:
+        # the reclaim is then part of one deterministic WDRR sequence
+        time.sleep(TRIAL_LEASE_SECONDS + 0.15)
+
+        # phase 2: the survivor drains everything; at a fixed part
+        # boundary an INTERACTIVE ticket arrives and replica B preempts
+        # the running low-priority transfer
+        preempt_state = {"fired": False}
+
+        def boundary_hook(running, boundary):
+            if preempt_state["fired"] or boundary != 2:
+                return
+            if running.qos == "interactive":
+                return
+            preempt_state["fired"] = True
+            ticket = mk_ticket(90, "interactive")
+            ticket.ticket_id = "tk-int"
+            ticket.transfer_id = "chaos-fd-int"
+            for _ in range(5):
+                try:
+                    sched_b.submit(ticket)
+                    break
+                except Exception as e:
+                    logger.info("chaos fd interactive admit fault: %s",
+                                e)
+            sched_b.preempt_if_needed()
+
+        survivor = FleetWorker(
+            cp, queue=queue, worker_index=2, metrics=Metrics(),
+            heartbeat_interval=TRIAL_HEARTBEAT_INTERVAL,
+            idle_exit_seconds=1.5, part_boundary_hook=boundary_hook)
+        survivor.run(threading.Event())
+
+        drained = sched_b.drain(timeout=TRIAL_TIMEOUT)
+        if not drained:
+            violations.append(Violation(
+                "run-completed", "fleet queue did not drain in time"))
+        # zombie fence: the killed worker's completion replay with its
+        # dead claim epoch must be rejected
+        if killed_ticket is not None:
+            accepted = cp.complete_ticket(queue, killed_ticket)
+            if accepted:
+                violations.append(Violation(
+                    "ticket-fencing",
+                    f"zombie completion of {killed_ticket.ticket_id} "
+                    f"(epoch {killed_ticket.claim_epoch}) was "
+                    f"accepted"))
+        fires = failpoints.fire_counts()
+        log = failpoints.fire_log()
+
+    tickets = cp.list_tickets(queue)
+    by_id = {t.ticket_id: t for t in tickets}
+    if len(tickets) != len(by_id):
+        violations.append(Violation(
+            "double-admission",
+            "duplicate ticket ids in the durable queue"))
+    for t in tickets:
+        if t.state != "done":
+            violations.append(Violation(
+                "transfer-lost",
+                f"{t.ticket_id} ended {t.state!r} after {t.attempts} "
+                f"attempt(s): {t.error}"))
+    if preempt_state["fired"] and not cp.ticket_revoke_log:
+        violations.append(Violation(
+            "preemption",
+            "interactive arrival with no free lane never revoked a "
+            "running low-priority ticket"))
+    sinks = {t.ticket_id: t.payload.get("sink_id") for t in tickets}
+    return {
+        "violations": violations,
+        "tracker": tracker,
+        "kills": int(victim.dead),
+        "steals": sum(1 for c in cp.ticket_claim_log if c[3]),
+        "preempts": len(cp.ticket_revoke_log),
+        "fires": fires,
+        "fire_log": log,
+        "sinks": sinks,
+        "logs": {
+            "admission": list(cp.enqueue_log),
+            "claims": list(cp.ticket_claim_log),
+            "preempts": list(cp.ticket_revoke_log),
+        },
+    }
+
+
+def run_fleet_distributed_trial(trial: int, seed: int, rows: int,
+                                reference: DeliveryReference,
+                                spec: Optional[str] = None
+                                ) -> TrialResult:
+    from transferia_tpu.providers.memory import get_store
+
+    rows = min(rows, FLEET_DIST_ROWS)
+    spec = spec if spec is not None else fleet_distributed_schedule(
+        trial, seed)
+    t0 = time.monotonic()
+    # the same seeded scenario runs twice; the queue decision logs must
+    # replay byte-identically (the acceptance bar for this mode)
+    first = _fleet_dist_scenario(trial, seed, rows, spec, "r1")
+    second = _fleet_dist_scenario(trial, seed, rows, spec, "r2")
+    seconds = time.monotonic() - t0
+    violations = list(first["violations"])
+    for name in ("admission", "claims", "preempts"):
+        if first["logs"][name] != second["logs"][name]:
+            violations.append(Violation(
+                "seed-replay",
+                f"{name} log diverged between two runs of seed {seed}: "
+                f"{first['logs'][name]} vs {second['logs'][name]}"))
+    for v in second["violations"]:
+        violations.append(Violation(v.invariant, f"replay run: "
+                                    f"{v.detail}"))
+
+    # exactly-once delivery audit per ticket against the shared
+    # fault-free reference (staged memory sink: the delivered multiset
+    # must EQUAL the reference even across kill, reclaim and preempt).
+    # BOTH scenario runs are audited — a timing-dependent duplication
+    # in the replay run must fail the trial even when the decision
+    # logs still matched.
+    total_dup = 0
+    delivered = 0
+    for label, run in (("", first), ("replay run: ", second)):
+        for tid, sink_id in sorted(run["sinks"].items()):
+            store = get_store(sink_id)
+            v = audit_delivery(reference, store.batches, 1, None,
+                               exactly_once=True)
+            delivered += v.delivered_rows
+            total_dup += v.duplicate_rows
+            if not v.passed:
+                for viol in v.violations:
+                    violations.append(Violation(
+                        viol.invariant,
+                        f"{label}{tid}: {viol.detail}"))
+            store.clear()
+    for detail in first["tracker"].violations:
+        violations.append(Violation("checkpoint-monotonicity", detail))
+    verdict = AuditVerdict(passed=not violations,
+                           violations=violations,
+                           delivered_rows=delivered,
+                           duplicate_rows=total_dup)
+    return TrialResult(
+        mode="fleet_distributed", trial=trial, seed=seed, spec=spec,
+        verdict=verdict, fire_counts=first["fires"],
+        fire_log=first["fire_log"], seconds=seconds,
+        kills=first["kills"], preempts=first["preempts"],
+        steal_log=first["logs"]["claims"],
+        dispatch_order=[tid for tid, _seq in
+                        first["logs"]["admission"]])
+
+
 # -- replication mode --------------------------------------------------------
 
 _REPL_PARSER = {"json": {
@@ -1352,7 +1653,8 @@ def run_trials(trials: int = 5, seed: int = 7, mode: str = "both",
         modes = ("snapshot", "replication")
     elif mode == "all":
         modes = ("snapshot", "replication", "worker_crash",
-                 "scheduler_kill", "arrow_ipc", "exactly_once")
+                 "scheduler_kill", "fleet_distributed", "arrow_ipc",
+                 "exactly_once")
     else:
         modes = (mode,)
     if "arrow_ipc" in modes:
@@ -1384,6 +1686,14 @@ def run_trials(trials: int = 5, seed: int = 7, mode: str = "both",
                                              spec=spec)
                 report.results.append(r)
                 logger.info("chaos scheduler_kill trial %d: %s", t,
+                            r.verdict.summary().splitlines()[0])
+        if "fleet_distributed" in modes:
+            ref = _snapshot_reference(min(rows, FLEET_DIST_ROWS))
+            for t in range(trials):
+                r = run_fleet_distributed_trial(t, seed, rows, ref,
+                                                spec=spec)
+                report.results.append(r)
+                logger.info("chaos fleet_distributed trial %d: %s", t,
                             r.verdict.summary().splitlines()[0])
         if "exactly_once" in modes:
             from transferia_tpu.interchange._pyarrow import have_pyarrow

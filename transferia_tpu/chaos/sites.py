@@ -144,6 +144,41 @@ SITES: dict[str, tuple[str, str]] = {
         "requeue RPC failing while rebalancing a dead worker's "
         "transfer — the fault must be absorbed (logged + counted), "
         "never lose the transfer"),
+    "fleet.enqueue": (
+        "fleet/distributed.py",
+        "durable admission enqueue RPC failing before the ticket is "
+        "stored (coordinator unreachable) — submitters retry, and the "
+        "idempotent enqueue guarantees the retry can never "
+        "double-admit the ticket"),
+    "fleet.claim": (
+        "fleet/worker.py",
+        "ticket claim RPC failing at the WDRR pick (coordinator "
+        "unreachable as the worker asks for work) — the worker must "
+        "absorb it and re-pick; the ticket stays claimable and exactly "
+        "one claimer can ever win it"),
+    "fleet.complete": (
+        "fleet/worker.py",
+        "ticket completion RPC failing after the transfer delivered "
+        "(coordinator unreachable at the worst moment) — the worker "
+        "retries the fenced completion; a duplicate completion under "
+        "the same epoch is idempotent, a stale one is fenced"),
+    "fleet.preempt": (
+        "fleet/distributed.py",
+        "lease-revocation RPC failing as an INTERACTIVE arrival "
+        "preempts the lowest-priority in-flight ticket — the "
+        "preemption is dropped for this tick (the arrival waits one "
+        "lane-drain longer), never half-applied"),
+    "worker.spawn": (
+        "fleet/worker.py",
+        "worker process/thread spawn failing (fork limit, image pull "
+        "error) — the supervisor absorbs it and the autoscaler retries "
+        "on its next step; the fleet keeps running on the survivors"),
+    "worker.heartbeat": (
+        "fleet/worker.py",
+        "worker heartbeat failing (coordinator unreachable): transient "
+        "failures must be absorbed by the ticket lease TTL; with "
+        "raise:WorkerKilledError the heartbeat dies and the worker's "
+        "claimed ticket is reclaimed by a survivor after expiry"),
     "client.s3.request": (
         "coordinator/s3client.py",
         "S3 wire request failing (timeout, 5xx, connection reset)"),

@@ -165,14 +165,22 @@ def build_parser() -> argparse.ArgumentParser:
                           "schedule and PRNG draws (replayable)")
     cha.add_argument("--mode", default="both",
                      choices=["snapshot", "replication", "worker_crash",
-                              "scheduler_kill", "arrow_ipc",
-                              "exactly_once", "both", "all"],
+                              "scheduler_kill", "fleet_distributed",
+                              "arrow_ipc", "exactly_once", "both",
+                              "all"],
                      help="worker_crash kills a sharded worker mid-part "
                           "and audits lease reclamation + epoch "
                           "fencing; scheduler_kill kills a fleet "
                           "worker slot at a dispatch decision and "
                           "audits kill/rebalance (no transfer lost or "
-                          "double-admitted); arrow_ipc audits the "
+                          "double-admitted); fleet_distributed runs "
+                          "the durable-queue fleet gauntlet (scheduler "
+                          "failover, worker kill mid-part with ticket "
+                          "reclaim, interactive preemption with "
+                          "resume-from-committed-parts, exactly-once "
+                          "delivery, and byte-identical replay of the "
+                          "admission/claim/preempt logs across two "
+                          "runs of one seed); arrow_ipc audits the "
                           "zero-copy interchange wire (arrow_ipc "
                           "source → memory); exactly_once audits the "
                           "staged two-phase commit (zero duplicate/"
@@ -180,7 +188,8 @@ def build_parser() -> argparse.ArgumentParser:
                           "kills and zombie replay, per capable sink "
                           "backend); both = snapshot+replication; all "
                           "adds worker_crash + scheduler_kill + "
-                          "arrow_ipc + exactly_once")
+                          "fleet_distributed + arrow_ipc + "
+                          "exactly_once")
     cha.add_argument("--rows", type=int, default=0,
                      help="snapshot source rows (default 4096)")
     cha.add_argument("--messages", type=int, default=0,
@@ -237,6 +246,25 @@ def build_parser() -> argparse.ArgumentParser:
                      help="bench: tenant-mix shuffle seed")
     flt.add_argument("--json", action="store_true", dest="as_json",
                      help="machine-readable report")
+    wk = sub.add_parser(
+        "worker",
+        help="run a supervised fleet worker process: claim tickets "
+             "from the coordinator's durable admission queue (WDRR "
+             "fair share), run them through the snapshot engine, "
+             "heartbeat the ticket lease, drain gracefully on SIGTERM "
+             "(fleet/worker.py; pair with --coordinator filestore|s3 "
+             "so N processes share one queue)")
+    wk.add_argument("--queue", default="fleet",
+                    help="durable admission queue name")
+    wk.add_argument("--worker-index", type=int, default=-1,
+                    help="this worker's index (-1 = derive from pid)")
+    wk.add_argument("--heartbeat", type=float, default=1.0,
+                    help="ticket lease renewal interval (seconds)")
+    wk.add_argument("--idle-exit", type=float, default=0.0,
+                    help="exit after this many seconds with nothing "
+                         "claimable (0 = run until SIGTERM)")
+    wk.add_argument("--max-tickets", type=int, default=0,
+                    help="exit after running N tickets (0 = unbounded)")
     top = sub.add_parser(
         "top",
         help="live per-transfer / per-tenant resource console: polls "
@@ -475,6 +503,8 @@ def main(argv=None) -> int:
         return cmd_flight(args)
     if args.command == "fleet":
         return cmd_fleet(args)
+    if args.command == "worker":
+        return cmd_worker(args)
     if args.command == "top":
         return cmd_top(args)
 
@@ -857,6 +887,53 @@ def cmd_fleet(args) -> int:
     else:
         print(format_report(report))
     return 0 if report["ok"] else 1
+
+
+def cmd_worker(args) -> int:
+    """Run one fleet worker process against the durable admission
+    queue (`trtpu worker`, fleet/worker.py).  SIGTERM/SIGINT request a
+    graceful drain: the running transfer yields at its next part
+    boundary, the claim is released back to the queue, and the process
+    exits 0 — a peer resumes the transfer from its committed parts."""
+    import os
+
+    from transferia_tpu.fleet.worker import FleetWorker
+
+    cp = _coordinator(args)
+    if args.coordinator == "memory":
+        logging.warning(
+            "worker on a memory coordinator: the queue is invisible to "
+            "other processes (use --coordinator filestore or s3 for a "
+            "real fleet)")
+    if args.worker_index >= 0:
+        index = args.worker_index
+    else:
+        # random, not pid-derived: every containerized worker is pid 1,
+        # and two workers sharing an id could renew each other's claims
+        # (the epoch-scoped renewal also defends, but unique ids keep
+        # health reports and steal attribution readable)
+        index = int.from_bytes(os.urandom(3), "big") % 1_000_000
+    worker = FleetWorker(
+        cp, queue=args.queue, worker_index=index,
+        heartbeat_interval=args.heartbeat,
+        idle_exit_seconds=args.idle_exit,
+        max_tickets=args.max_tickets)
+    stop = threading.Event()
+
+    def handle_sig(signum, frame):
+        logging.info("signal %d: draining worker %s", signum,
+                     worker.worker_id)
+        worker.request_drain()
+        stop.set()
+
+    signal.signal(signal.SIGINT, handle_sig)
+    signal.signal(signal.SIGTERM, handle_sig)
+    logging.info("fleet worker %s serving queue %r", worker.worker_id,
+                 args.queue)
+    worker.run(stop)
+    print(f"worker {worker.worker_id}: {worker.tickets_run} ticket(s) "
+          f"run")
+    return 0
 
 
 def cmd_top(args) -> int:

@@ -24,6 +24,16 @@ import time
 from typing import Any, Optional
 
 from transferia_tpu.abstract.table import OperationTablePart
+from transferia_tpu.abstract.ticket import (
+    FleetTicket,
+    claim_in_place,
+    complete_in_place,
+    complete_is_duplicate,
+    fence_matches,
+    release_in_place,
+    revoke_in_place,
+    ticket_claimable,
+)
 from transferia_tpu.coordinator.interface import (
     Coordinator,
     TransferStatus,
@@ -45,6 +55,7 @@ class FileStoreCoordinator(Coordinator):
         os.makedirs(os.path.join(root, "transfers"), exist_ok=True)
         os.makedirs(os.path.join(root, "operations"), exist_ok=True)
         os.makedirs(os.path.join(root, "health"), exist_ok=True)
+        os.makedirs(os.path.join(root, "fleet"), exist_ok=True)
 
     # -- file helpers -------------------------------------------------------
     def _tdir(self, transfer_id: str) -> str:
@@ -268,6 +279,133 @@ class FileStoreCoordinator(Coordinator):
             OperationTablePart.from_json(d)
             for d in self._read_json(self._parts_path(operation_id), [])
         ]
+
+    # -- durable fleet admission queue --------------------------------------
+    # One flock'd JSON document per queue ({"next_seq": N, "tickets":
+    # [...]}) — claims/completions are read-modify-write under the same
+    # exclusive lock the part queue uses, so two worker PROCESSES can
+    # never claim the same ticket.
+
+    def _queue_path(self, queue: str) -> str:
+        safe = queue.replace(os.sep, "_")
+        return os.path.join(self.root, "fleet", f"{safe}.json")
+
+    def _queue_doc(self, path: str) -> dict:
+        doc = self._read_json(path, {})
+        if not isinstance(doc, dict) or "tickets" not in doc:
+            doc = {"next_seq": 0, "tickets": []}
+        return doc
+
+    def enqueue_ticket(self, queue: str,
+                       ticket: FleetTicket) -> FleetTicket:
+        p = self._queue_path(queue)
+        with self._locked(p):
+            doc = self._queue_doc(p)
+            for d in doc["tickets"]:
+                if d["ticket_id"] == ticket.ticket_id:
+                    # idempotent: the no-double-admission guarantee
+                    return FleetTicket.from_json(d)
+            d = ticket.to_json()
+            d["seq"] = doc["next_seq"]
+            doc["next_seq"] += 1
+            d["state"] = "queued"
+            d["enqueued_at"] = time.time()
+            doc["tickets"].append(d)
+            self._write_json(p, doc)
+            return FleetTicket.from_json(d)
+
+    def list_tickets(self, queue: str) -> list[FleetTicket]:
+        doc = self._queue_doc(self._queue_path(queue))
+        return [FleetTicket.from_json(d)
+                for d in sorted(doc["tickets"], key=lambda t: t["seq"])]
+
+    def claim_ticket(self, queue: str, ticket_id: str,
+                     worker_id: str) -> Optional[FleetTicket]:
+        p = self._queue_path(queue)
+        now = time.time()
+        with self._locked(p):
+            doc = self._queue_doc(p)
+            for d in doc["tickets"]:
+                if d["ticket_id"] != ticket_id:
+                    continue
+                if not ticket_claimable(d, now):
+                    return None
+                claim_in_place(d, worker_id, self.lease_seconds, now)
+                self._write_json(p, doc)
+                return FleetTicket.from_json(d)
+            return None
+
+    def renew_ticket_leases(self, queue: str, worker_id: str,
+                            ticket_id: Optional[str] = None,
+                            claim_epoch: Optional[int] = None) -> int:
+        if self.lease_seconds <= 0:
+            return 0
+        p = self._queue_path(queue)
+        renewed = 0
+        now = time.time()
+        with self._locked(p):
+            doc = self._queue_doc(p)
+            for d in doc["tickets"]:
+                if ticket_id is not None \
+                        and d["ticket_id"] != ticket_id:
+                    continue
+                if claim_epoch is not None \
+                        and d.get("claim_epoch", 0) != claim_epoch:
+                    continue
+                if d["state"] == "claimed" \
+                        and d["claimed_by"] == worker_id:
+                    d["lease_expires_at"] = now + self.lease_seconds
+                    renewed += 1
+            if renewed:
+                self._write_json(p, doc)
+        return renewed
+
+    def complete_ticket(self, queue: str, ticket: FleetTicket,
+                        error: str = "") -> bool:
+        p = self._queue_path(queue)
+        with self._locked(p):
+            doc = self._queue_doc(p)
+            for d in doc["tickets"]:
+                if d["ticket_id"] != ticket.ticket_id:
+                    continue
+                if complete_is_duplicate(d, ticket):
+                    return True  # idempotent retry of a lost response
+                if not fence_matches(d, ticket):
+                    return False  # zombie: reclaimed/revoked since
+                complete_in_place(d, error)
+                self._write_json(p, doc)
+                return True
+            return False
+
+    def release_ticket(self, queue: str, ticket: FleetTicket,
+                       failed: bool = False) -> bool:
+        p = self._queue_path(queue)
+        with self._locked(p):
+            doc = self._queue_doc(p)
+            for d in doc["tickets"]:
+                if d["ticket_id"] != ticket.ticket_id:
+                    continue
+                if not fence_matches(d, ticket):
+                    return False
+                release_in_place(d, failed=failed)
+                self._write_json(p, doc)
+                return True
+            return False
+
+    def revoke_ticket(self, queue: str,
+                      ticket_id: str) -> Optional[FleetTicket]:
+        p = self._queue_path(queue)
+        with self._locked(p):
+            doc = self._queue_doc(p)
+            for d in doc["tickets"]:
+                if d["ticket_id"] != ticket_id:
+                    continue
+                if d["state"] != "claimed":
+                    return None  # nothing to preempt
+                revoke_in_place(d)
+                self._write_json(p, doc)
+                return FleetTicket.from_json(d)
+            return None
 
     def _write_health(self, path: str, worker_index: int,
                       payload) -> None:

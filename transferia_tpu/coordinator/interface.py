@@ -10,6 +10,7 @@ from dataclasses import dataclass, field
 from typing import Any, Optional
 
 from transferia_tpu.abstract.table import OperationTablePart
+from transferia_tpu.abstract.ticket import FleetTicket
 
 # Part-claim lease TTL (seconds).  A claim is a lease: the holding worker
 # renews it from its heartbeat thread (SnapshotLoader), and an expired
@@ -209,6 +210,89 @@ class Coordinator(abc.ABC):
             total_eta_rows=sum(p.eta_rows for p in parts),
             completed_rows=sum(p.completed_rows for p in parts),
         )
+
+    # -- durable fleet admission queue (fleet/distributed.py) ----------------
+    #
+    # The distributed fleet keeps its admission queue HERE instead of in
+    # scheduler memory: tickets survive scheduler crashes, N scheduler
+    # replicas share one queue without double-admitting, and worker
+    # processes claim work with the same lease + epoch-fencing rules as
+    # snapshot parts (abstract/ticket.py holds the shared state machine).
+    # Backends without queue support keep the defaults (raise) — the
+    # distributed fleet refuses to run on them.
+
+    def supports_ticket_queue(self) -> bool:
+        return type(self).claim_ticket is not Coordinator.claim_ticket
+
+    def enqueue_ticket(self, queue: str,
+                       ticket: FleetTicket) -> FleetTicket:
+        """Durably append a ticket, assigning the next queue seq.
+        IDEMPOTENT by ticket_id: re-enqueueing an existing id returns
+        the stored ticket unchanged — this is the no-double-admission
+        guarantee across N scheduler replicas and across a submitter's
+        retry of a faulted admission RPC."""
+        raise NotImplementedError
+
+    def list_tickets(self, queue: str) -> list[FleetTicket]:
+        """Every ticket in the queue (any state), seq-ordered."""
+        raise NotImplementedError
+
+    def claim_ticket(self, queue: str, ticket_id: str,
+                     worker_id: str) -> Optional[FleetTicket]:
+        """Atomically claim one SPECIFIC ticket (pick policy — WDRR —
+        lives in the caller; the coordinator only arbitrates).  Claimable
+        = queued, or claimed with an expired lease (crash reclaim, which
+        records `stolen_from`).  Every claim bumps `claim_epoch` and
+        stamps a fresh lease.  None = lost the race / not claimable —
+        the caller picks its next candidate."""
+        raise NotImplementedError
+
+    def renew_ticket_leases(self, queue: str, worker_id: str,
+                            ticket_id: Optional[str] = None,
+                            claim_epoch: Optional[int] = None) -> int:
+        """Heartbeat: extend the lease on the ticket(s) this worker
+        holds.  Returns the number renewed — a worker holding a ticket
+        that sees 0 was revoked (preemption) or reclaimed (zombie) and
+        must yield at its next part boundary.
+
+        `ticket_id` scopes the renewal to the one ticket the caller is
+        actually RUNNING — the workers always pass it: renewing by
+        worker id alone would also renew a claim stranded by a dead
+        predecessor that reused this worker's index (k8s stable pod
+        identity), keeping that ticket wedged un-reclaimable forever.
+        `claim_epoch` additionally fences the renewal to the caller's
+        OWN claim: two workers that ended up with the same id (pid-1
+        containers) must not renew each other's claims — the stale one
+        then sees 0 renewed and yields instead of running the transfer
+        twice."""
+        return 0
+
+    def complete_ticket(self, queue: str, ticket: FleetTicket,
+                        error: str = "") -> bool:
+        """Epoch-fenced terminal transition (done, or failed when
+        `error` is set).  False = fenced: the ticket was reclaimed or
+        revoked since this worker's claim — the zombie's completion is
+        dropped, exactly like a stale part update."""
+        raise NotImplementedError
+
+    def release_ticket(self, queue: str, ticket: FleetTicket,
+                       failed: bool = False) -> bool:
+        """Epoch-fenced return-to-queue (graceful drain, transient
+        failure, preemption yield).  False = fenced (already revoked or
+        reclaimed — nothing to release).  `failed=True` records a
+        failed RUN attempt — only these count against the retry
+        budget; scheduler-initiated yields (preemption, drain) must
+        not walk the ticket toward permanent failure."""
+        raise NotImplementedError
+
+    def revoke_ticket(self, queue: str,
+                      ticket_id: str) -> Optional[FleetTicket]:
+        """Preemption: force a CLAIMED ticket back to the queue and
+        bump its epoch now, fencing the running holder (it yields at
+        its next part boundary; the transfer resumes from committed
+        parts).  Returns the revoked ticket, or None when it was not
+        claimed (nothing to preempt)."""
+        raise NotImplementedError
 
     # -- worker health (operation.go:30-36, replication.go:72-74) -----------
     def operation_health(self, operation_id: str, worker_index: int,

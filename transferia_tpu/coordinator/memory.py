@@ -21,6 +21,16 @@ from collections import deque
 from typing import Any, Optional
 
 from transferia_tpu.abstract.table import OperationTablePart
+from transferia_tpu.abstract.ticket import (
+    FleetTicket,
+    claim_in_place,
+    complete_in_place,
+    complete_is_duplicate,
+    fence_matches,
+    release_in_place,
+    revoke_in_place,
+    ticket_claimable,
+)
 from transferia_tpu.chaos.failpoints import failpoint
 from transferia_tpu.coordinator.interface import (
     Coordinator,
@@ -49,6 +59,19 @@ class _OpState:
         self.state: dict[str, Any] = {}
 
 
+class _QueueState:
+    """One fleet admission queue's slice: its own lock, the ticket
+    list (dict form — abstract/ticket.py helpers mutate in place), and
+    the durable seq counter."""
+
+    __slots__ = ("lock", "tickets", "next_seq")
+
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.tickets: list[dict] = []
+        self.next_seq = 0
+
+
 class MemoryCoordinator(Coordinator):
     def __init__(self, lease_seconds: Optional[float] = None):
         # transfer-scoped maps (status / state KV / messages)
@@ -59,6 +82,9 @@ class MemoryCoordinator(Coordinator):
         # operation-scoped state: per-operation locks
         self._ops_lock = threading.Lock()
         self._ops: dict[str, _OpState] = {}
+        # fleet admission queues: per-queue locks, same pattern
+        self._queues_lock = threading.Lock()
+        self._queues: dict[str, _QueueState] = {}
         self.lease_seconds = (default_lease_seconds()
                               if lease_seconds is None else lease_seconds)
         # rolling window of (scope, worker, payload) tuples; latest
@@ -267,6 +293,113 @@ class MemoryCoordinator(Coordinator):
                 OperationTablePart.from_json(p.to_json())
                 for p in op.parts
             ]
+
+    # -- durable fleet admission queue --------------------------------------
+    def _queue(self, queue: str) -> _QueueState:
+        with self._queues_lock:
+            st = self._queues.get(queue)
+            if st is None:
+                st = self._queues[queue] = _QueueState()
+            return st
+
+    def enqueue_ticket(self, queue: str,
+                       ticket: FleetTicket) -> FleetTicket:
+        q = self._queue(queue)
+        with q.lock:
+            for d in q.tickets:
+                if d["ticket_id"] == ticket.ticket_id:
+                    # idempotent: the no-double-admission guarantee
+                    return FleetTicket.from_json(d)
+            d = ticket.to_json()
+            d["seq"] = q.next_seq
+            q.next_seq += 1
+            d["state"] = "queued"
+            d["enqueued_at"] = time.time()
+            q.tickets.append(d)
+            return FleetTicket.from_json(d)
+
+    def list_tickets(self, queue: str) -> list[FleetTicket]:
+        q = self._queue(queue)
+        with q.lock:
+            return [FleetTicket.from_json(d)
+                    for d in sorted(q.tickets, key=lambda t: t["seq"])]
+
+    def claim_ticket(self, queue: str, ticket_id: str,
+                     worker_id: str) -> Optional[FleetTicket]:
+        q = self._queue(queue)
+        now = time.time()
+        with q.lock:
+            for d in q.tickets:
+                if d["ticket_id"] != ticket_id:
+                    continue
+                if not ticket_claimable(d, now):
+                    return None
+                claim_in_place(d, worker_id, self.lease_seconds, now)
+                return FleetTicket.from_json(d)
+            return None
+
+    def renew_ticket_leases(self, queue: str, worker_id: str,
+                            ticket_id: Optional[str] = None,
+                            claim_epoch: Optional[int] = None) -> int:
+        if self.lease_seconds <= 0:
+            return 0
+        q = self._queue(queue)
+        renewed = 0
+        now = time.time()
+        with q.lock:
+            for d in q.tickets:
+                if ticket_id is not None \
+                        and d["ticket_id"] != ticket_id:
+                    continue
+                if claim_epoch is not None \
+                        and d["claim_epoch"] != claim_epoch:
+                    continue
+                if d["state"] == "claimed" \
+                        and d["claimed_by"] == worker_id:
+                    d["lease_expires_at"] = now + self.lease_seconds
+                    renewed += 1
+        return renewed
+
+    def complete_ticket(self, queue: str, ticket: FleetTicket,
+                        error: str = "") -> bool:
+        q = self._queue(queue)
+        with q.lock:
+            for d in q.tickets:
+                if d["ticket_id"] != ticket.ticket_id:
+                    continue
+                if complete_is_duplicate(d, ticket):
+                    return True  # idempotent retry of a lost response
+                if not fence_matches(d, ticket):
+                    return False  # zombie: reclaimed/revoked since
+                complete_in_place(d, error)
+                return True
+            return False
+
+    def release_ticket(self, queue: str, ticket: FleetTicket,
+                       failed: bool = False) -> bool:
+        q = self._queue(queue)
+        with q.lock:
+            for d in q.tickets:
+                if d["ticket_id"] != ticket.ticket_id:
+                    continue
+                if not fence_matches(d, ticket):
+                    return False
+                release_in_place(d, failed=failed)
+                return True
+            return False
+
+    def revoke_ticket(self, queue: str,
+                      ticket_id: str) -> Optional[FleetTicket]:
+        q = self._queue(queue)
+        with q.lock:
+            for d in q.tickets:
+                if d["ticket_id"] != ticket_id:
+                    continue
+                if d["state"] != "claimed":
+                    return None  # nothing to preempt
+                revoke_in_place(d)
+                return FleetTicket.from_json(d)
+            return None
 
     def operation_health(self, operation_id: str, worker_index: int,
                          payload: Optional[dict] = None) -> None:

@@ -29,6 +29,16 @@ import time
 from typing import Any, Optional
 
 from transferia_tpu.abstract.table import OperationTablePart
+from transferia_tpu.abstract.ticket import (
+    FleetTicket,
+    claim_in_place,
+    complete_in_place,
+    complete_is_duplicate,
+    fence_matches,
+    release_in_place,
+    revoke_in_place,
+    ticket_claimable,
+)
 from transferia_tpu.coordinator.interface import (
     Coordinator,
     TransferStatus,
@@ -42,6 +52,14 @@ from transferia_tpu.coordinator.s3client import (
 )
 
 logger = logging.getLogger(__name__)
+
+# enqueue id-guard staleness: a guard this old whose ticket object
+# never appeared belongs to a replica that died between winning the
+# guard and writing the ticket — safe to take over.  Generous on
+# purpose: a merely slow owner must never be raced (that re-opens the
+# double admission the guard exists to close); a submitter that can't
+# wait simply gets a retriable TimeoutError.
+ENQUEUE_GUARD_STALE_SECONDS = 30.0
 
 
 class S3Coordinator(Coordinator):
@@ -59,6 +77,16 @@ class S3Coordinator(Coordinator):
         self.lease_seconds = (default_lease_seconds()
                               if lease_seconds is None else lease_seconds)
         self._conditional = True  # flips off on ConditionalUnsupported
+        # (queue, ticket_id) -> object key memo: lets the per-ticket
+        # paths (heartbeat renew, complete, release) do one GET instead
+        # of LIST + N GETs over the whole queue.  Purely a cache — a
+        # miss or a stale entry falls back to the listing.
+        self._ticket_keys: dict[tuple, str] = {}
+        # key -> terminal ticket body: done/failed never reverts, so a
+        # queue listing skips the GET for every ticket this instance
+        # has already seen terminal — per-poll cost stays O(active),
+        # not O(history) (full GC/retention is a roadmap item)
+        self._terminal_tickets: dict[str, dict] = {}
         self._done_keys: dict[str, set] = {}  # op -> completed part keys
         # op -> part keys THIS instance claimed and still holds: the
         # heartbeat renews only these (O(claimed) GET+PUT per beat, not
@@ -374,6 +402,301 @@ class S3Coordinator(Coordinator):
     def operation_parts(self, operation_id: str) -> list[OperationTablePart]:
         return [OperationTablePart.from_json(d)
                 for _, d, _ in self._list_parts_raw(operation_id)]
+
+    # -- durable fleet admission queue --------------------------------------
+    # Per-ticket objects (<prefix>fleet/<queue>/tickets/<seq>-<id>.json)
+    # so claims never contend on one blob: a claim is a single
+    # conditional PUT on the ticket's own object (If-Match on the read
+    # ETag; PreconditionFailed = another worker won the race).  Seq
+    # assignment uses If-None-Match object creation — two scheduler
+    # replicas racing the same seq slot see exactly one winner, the
+    # loser re-lists and takes the next slot.
+
+    def _ticket_prefix(self, queue: str) -> str:
+        import urllib.parse as _up
+
+        return self._key("fleet", _up.quote(queue, safe=""), "tickets",
+                         "")
+
+    def _ticket_id_guard(self, queue: str, ticket_id: str) -> str:
+        import urllib.parse as _up
+
+        return self._key("fleet", _up.quote(queue, safe=""), "ids",
+                         f"{_up.quote(ticket_id, safe='')}.json")
+
+    def _ticket_key(self, queue: str, seq: int) -> str:
+        # the key is the seq SLOT alone (ticket identity lives in the
+        # body): If-None-Match on this key is then a real slot
+        # arbitration — with the ticket_id embedded, two different
+        # tickets racing one slot would write different keys and both
+        # "win", yielding duplicate seqs
+        return self._ticket_prefix(queue) + f"{seq:08d}.json"
+
+    def _list_ticket_objs(self, queue: str
+                          ) -> list[tuple[str, dict, str]]:
+        out = []
+        for obj in self.client.list(self._ticket_prefix(queue)):
+            cached = self._terminal_tickets.get(obj.key)
+            if cached is not None:
+                # terminal never reverts: skip the GET ("" etag — a
+                # terminal ticket is never CAS-written again)
+                out.append((obj.key, dict(cached), ""))
+                continue
+            got = self.client.get(obj.key)
+            if got is None:
+                continue
+            body, etag = got
+            try:
+                d = json.loads(body)
+            except json.JSONDecodeError:
+                continue
+            if d.get("state") in ("done", "failed"):
+                self._terminal_tickets[obj.key] = dict(d)
+            out.append((obj.key, d, etag))
+        out.sort(key=lambda kde: kde[0])  # seq-prefixed keys
+        return out
+
+    def _find_ticket(self, queue: str, ticket_id: str
+                     ) -> Optional[tuple[str, dict, str]]:
+        # memoized fast path: one GET when this instance has seen the
+        # ticket's key before (every heartbeat renew lands here)
+        memo = self._ticket_keys.get((queue, ticket_id))
+        if memo is not None:
+            got = self.client.get(memo)
+            if got is not None:
+                body, etag = got
+                try:
+                    d = json.loads(body)
+                except json.JSONDecodeError:
+                    d = None
+                if d is not None and d.get("ticket_id") == ticket_id:
+                    return memo, d, etag
+            self._ticket_keys.pop((queue, ticket_id), None)  # stale
+        for key, d, etag in self._list_ticket_objs(queue):
+            tid = d.get("ticket_id")
+            if tid:
+                self._ticket_keys[(queue, tid)] = key
+            if tid == ticket_id:
+                return key, d, etag
+        return None
+
+    def _max_seq(self, queue: str) -> int:
+        """Highest occupied seq slot, from key NAMES alone — seq keys
+        are `{seq:08d}.json`, so no ticket bodies need downloading."""
+        max_seq = -1
+        for obj in self.client.list(self._ticket_prefix(queue)):
+            base = obj.key.rsplit("/", 1)[-1]
+            if not base.endswith(".json"):
+                continue
+            try:
+                max_seq = max(max_seq, int(base[:-5]))
+            except ValueError:
+                continue
+        return max_seq
+
+    def enqueue_ticket(self, queue: str,
+                       ticket: FleetTicket) -> FleetTicket:
+        # Two conditional creates, two distinct races: the per-TICKET-ID
+        # guard object is the idempotency fence (two replicas enqueueing
+        # the same ticket_id would otherwise compute DIFFERENT seq keys
+        # and both win their per-key If-None-Match — a double
+        # admission); the seq-keyed ticket object's If-None-Match then
+        # arbitrates the seq slot among different tickets.  One GET
+        # (guard) answers idempotency and the seq comes from key names,
+        # so the common case costs O(1) GETs, not a body download of
+        # the whole queue.
+        guard = self._ticket_id_guard(queue, ticket.ticket_id)
+        won_guard = False
+        for _ in range(32):
+            if not won_guard and self._conditional:
+                got = self.client.get(guard)
+                if got is None:
+                    try:
+                        self._put_json(guard,
+                                       {"ticket_id": ticket.ticket_id,
+                                        "ts": time.time()},
+                                       if_none_match=True)
+                        won_guard = True
+                    except PreconditionFailed:
+                        continue  # raced the create: re-read the guard
+                else:
+                    # another replica owns this ticket_id: return its
+                    # ticket once visible.  Takeover is by guard AGE,
+                    # not a fixed poll count — a merely SLOW owner
+                    # (S3 tail latency) re-opening the race would be
+                    # exactly the double admission the guard prevents;
+                    # only a guard older than the stale threshold
+                    # (owner died before writing its ticket) is taken
+                    # over, via CAS on the guard itself so one taker
+                    # wins.
+                    found = self._find_ticket(queue, ticket.ticket_id)
+                    if found is not None:
+                        return FleetTicket.from_json(found[1])
+                    body, etag = got
+                    try:
+                        ts = float(json.loads(body).get("ts", 0.0))
+                    except (json.JSONDecodeError, TypeError,
+                            ValueError):
+                        ts = 0.0
+                    if time.time() - ts > ENQUEUE_GUARD_STALE_SECONDS:
+                        try:
+                            self._put_json(
+                                guard,
+                                {"ticket_id": ticket.ticket_id,
+                                 "ts": time.time()},
+                                if_match=etag)
+                            won_guard = True
+                        except PreconditionFailed:
+                            time.sleep(0.05)
+                            continue  # another taker won: re-read
+                    else:
+                        time.sleep(0.05)
+                        continue
+            elif not self._conditional:
+                # LWW degrade: idempotency falls back to the body scan
+                found = self._find_ticket(queue, ticket.ticket_id)
+                if found is not None:
+                    return FleetTicket.from_json(found[1])
+            d = ticket.to_json()
+            d["seq"] = self._max_seq(queue) + 1
+            d["state"] = "queued"
+            d["enqueued_at"] = time.time()
+            key = self._ticket_key(queue, d["seq"])
+            try:
+                self._put_json(key, d, if_none_match=True)
+                if not self._conditional:
+                    # same visibility rule as the claim path: the
+                    # degrade must be loud — an unconditional seq-slot
+                    # put can overwrite (lose) a racing replica's
+                    # admitted ticket
+                    logger.warning(
+                        "ticket enqueue %s is last-writer-wins (no "
+                        "conditional writes): a racing enqueue may "
+                        "overwrite this seq slot and lose a ticket",
+                        key)
+                self._ticket_keys[(queue, ticket.ticket_id)] = key
+                return FleetTicket.from_json(d)
+            except PreconditionFailed:
+                time.sleep(0.05)  # a DIFFERENT ticket raced this seq
+                #                   slot; re-list and take the next one
+        raise TimeoutError(
+            f"enqueue_ticket race on queue {queue!r} did not converge")
+
+    def list_tickets(self, queue: str) -> list[FleetTicket]:
+        return [FleetTicket.from_json(d)
+                for _k, d, _e in self._list_ticket_objs(queue)]
+
+    def claim_ticket(self, queue: str, ticket_id: str,
+                     worker_id: str) -> Optional[FleetTicket]:
+        found = self._find_ticket(queue, ticket_id)
+        if found is None:
+            return None
+        key, d, etag = found
+        now = time.time()
+        if not ticket_claimable(d, now):
+            return None
+        claim_in_place(d, worker_id, self.lease_seconds, now)
+        try:
+            # conditional on the read ETag: exactly one claimer wins
+            self._put_json(key, d, if_match=etag)
+        except PreconditionFailed:
+            return None  # another worker claimed/stole it first
+        if not self._conditional:
+            logger.warning(
+                "ticket claim %s by %s is last-writer-wins (no "
+                "conditional writes): a racing worker may run this "
+                "ticket twice", key, worker_id)
+        return FleetTicket.from_json(d)
+
+    def renew_ticket_leases(self, queue: str, worker_id: str,
+                            ticket_id: Optional[str] = None,
+                            claim_epoch: Optional[int] = None) -> int:
+        if self.lease_seconds <= 0:
+            return 0
+        now = time.time()
+        if ticket_id is not None:
+            # the heartbeat path: one memoized GET + one PUT, not a
+            # full queue scan every interval
+            found = self._find_ticket(queue, ticket_id)
+            candidates = [found] if found is not None else []
+        else:
+            candidates = self._list_ticket_objs(queue)
+        renewed = 0
+        for key, d, etag in candidates:
+            if claim_epoch is not None \
+                    and d.get("claim_epoch", 0) != claim_epoch:
+                continue
+            if d.get("state") != "claimed" \
+                    or d.get("claimed_by") != worker_id:
+                continue
+            d["lease_expires_at"] = now + self.lease_seconds
+            try:
+                self._put_json(key, d, if_match=etag)
+                renewed += 1
+            except PreconditionFailed:
+                continue  # updated under us (revoke?): next beat sees it
+        return renewed
+
+    def _fenced_ticket_write(self, queue: str, ticket: FleetTicket,
+                             mutate,
+                             accept_terminal_retry: bool = False
+                             ) -> bool:
+        found = self._find_ticket(queue, ticket.ticket_id)
+        if found is None:
+            return False
+        key, d, etag = found
+        for _ in range(16):
+            if accept_terminal_retry and \
+                    complete_is_duplicate(d, ticket):
+                return True  # idempotent retry of a lost response
+            if not fence_matches(d, ticket):
+                return False  # zombie: reclaimed/revoked since
+            mutate(d)
+            try:
+                self._put_json(key, d, if_match=etag)
+                return True
+            except PreconditionFailed:
+                time.sleep(0.05)
+                got = self.client.get(key)
+                if got is None:
+                    return False
+                body, etag = got
+                try:
+                    d = json.loads(body)
+                except json.JSONDecodeError:
+                    return False
+        raise TimeoutError(
+            f"ticket CAS on {key} did not converge")
+
+    def complete_ticket(self, queue: str, ticket: FleetTicket,
+                        error: str = "") -> bool:
+        return self._fenced_ticket_write(
+            queue, ticket, lambda d: complete_in_place(d, error),
+            accept_terminal_retry=True)
+
+    def release_ticket(self, queue: str, ticket: FleetTicket,
+                       failed: bool = False) -> bool:
+        return self._fenced_ticket_write(
+            queue, ticket,
+            lambda d: release_in_place(d, failed=failed))
+
+    def revoke_ticket(self, queue: str,
+                      ticket_id: str) -> Optional[FleetTicket]:
+        for _ in range(16):
+            found = self._find_ticket(queue, ticket_id)
+            if found is None:
+                return None
+            key, d, etag = found
+            if d.get("state") != "claimed":
+                return None  # nothing to preempt
+            revoke_in_place(d)
+            try:
+                self._put_json(key, d, if_match=etag)
+                return FleetTicket.from_json(d)
+            except PreconditionFailed:
+                time.sleep(0.05)  # claim/renew raced: re-read and retry
+        raise TimeoutError(
+            f"revoke_ticket CAS for {ticket_id!r} did not converge")
 
     # -- health -------------------------------------------------------------
     def operation_health(self, operation_id: str, worker_index: int,

@@ -21,11 +21,14 @@ FALLS to the high-pressure mark and clear when it recovers.
 
 from __future__ import annotations
 
+import logging
 import threading
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
 from transferia_tpu.stats.registry import Metrics
+
+logger = logging.getLogger(__name__)
 
 
 @dataclass
@@ -94,6 +97,25 @@ class BackpressureController:
         self._probe = probe
         self._lock = threading.Lock()
         self._states = [SignalState(s) for s in signals]
+        # tick listeners: called (outside the signal lock) on every
+        # overloaded() evaluation — the scheduler hangs its gauge
+        # refresh here so fleet_queue_depth/desired_workers are fresh
+        # at the exact moment this controller reads them, not stale
+        # from the last dispatch
+        self._tick_listeners: list[Callable[[], None]] = []
+
+    def add_tick_listener(self, cb: Callable[[], None]) -> None:
+        with self._lock:
+            if cb not in self._tick_listeners:
+                self._tick_listeners.append(cb)
+
+    def remove_tick_listener(self, cb: Callable[[], None]) -> None:
+        """Unhook on scheduler shutdown: a long-lived shared controller
+        must not keep dead schedulers alive (and writing stale gauges)
+        through their listener references."""
+        with self._lock:
+            if cb in self._tick_listeners:
+                self._tick_listeners.remove(cb)
 
     def _read(self, metric: str) -> float:
         if self._probe is not None:
@@ -102,6 +124,14 @@ class BackpressureController:
 
     def overloaded(self) -> bool:
         """Re-evaluate every signal; True while any is latched."""
+        with self._lock:
+            listeners = list(self._tick_listeners)
+        for cb in listeners:
+            try:
+                cb()
+            except Exception as e:
+                # a broken listener must not block admission decisions
+                logger.debug("backpressure tick listener failed: %s", e)
         with self._lock:
             hot = False
             for st in self._states:

@@ -217,6 +217,12 @@ class FleetScheduler:
         if backpressure is True:
             backpressure = BackpressureController(self.metrics)
         self.backpressure = backpressure or None
+        if self.backpressure is not None:
+            # gauge freshness: the controller's evaluation is the
+            # "backpressure tick" — refresh queue_depth/desired_workers
+            # right before it reads them, so a drained-then-idle fleet
+            # never advertises its last busy desired_workers value
+            self.backpressure.add_tick_listener(self.refresh_gauges)
         self.quantum = quantum
         self.tenant_queue_quota = tenant_queue_quota
         self.max_attempts = max_attempts
@@ -279,6 +285,10 @@ class FleetScheduler:
             self._cond.notify_all()
         for t in self._threads:
             t.join(timeout=30.0)
+        if self.backpressure is not None:
+            # a shared long-lived controller must not keep this dead
+            # scheduler alive (or let it overwrite live gauges)
+            self.backpressure.remove_tick_listener(self.refresh_gauges)
         from transferia_tpu import fleet as fleet_mod
 
         fleet_mod.unregister_scheduler(self)
@@ -344,6 +354,9 @@ class FleetScheduler:
                     return "admitted"
                 tn.shed += 1
                 self.stats.shed.inc()
+                # sheds must refresh the gauges too: a shedding fleet
+                # is exactly when the autoscaler reads desired_workers
+                self._update_gauges_locked()
                 if adm_sp:
                     adm_sp.add(decision=ticket.shed_reason)
                 return ticket.shed_reason
@@ -644,6 +657,15 @@ class FleetScheduler:
             tn.queued for tn in self._tenants.values())
         per = self._lanes_per_worker
         return max(1, -(-pending // per))
+
+    def refresh_gauges(self) -> None:
+        """Recompute queue_depth/inflight/desired_workers NOW.  Called
+        from the backpressure tick (and free for any poller): the
+        gauges otherwise refresh on scheduler events (submit, dispatch,
+        completion), and a fleet that went idle between events would
+        keep advertising its last busy desired_workers value."""
+        with self._lock:
+            self._update_gauges_locked()
 
     def desired_workers(self) -> int:
         with self._lock:

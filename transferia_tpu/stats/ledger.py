@@ -407,6 +407,7 @@ _TOP_COLS = (
     ("transfer", 22), ("tenant", 10), ("rows_in", 9), ("rows_out", 9),
     ("mb_in", 8), ("mb_out", 8), ("h2d_mb", 8), ("launch", 7),
     ("wait_s", 7), ("retry", 6), ("steal", 6), ("fires", 6),
+    ("commit", 7), ("fence", 6), ("dedup", 6),
 )
 
 
@@ -421,6 +422,9 @@ def format_top(snapshot: dict, limit: int = 20) -> str:
         f"rows {tot['rows_in']}→{tot['rows_out']}  "
         f"h2d {tot['h2d_bytes'] / 1e6:.1f}MB  "
         f"launches {tot['launches']}  "
+        f"commits {tot.get('commits', 0)} "
+        f"({tot.get('commit_fences', 0)} fenced, "
+        f"{tot.get('dedup_rows_dropped', 0)} deduped)  "
         f"conservation {'OK' if cons.get('ok') else 'DRIFT'}")
     tenants = snapshot.get("tenants", {})
     if tenants:
@@ -443,7 +447,9 @@ def format_top(snapshot: dict, limit: int = 20) -> str:
                  f"{v['bytes_out'] / 1e6:.1f}",
                  f"{v['h2d_bytes'] / 1e6:.1f}", v["launches"],
                  f"{wait:.2f}", v["retries"], v["lease_steals"],
-                 v["chaos_fires"])
+                 v["chaos_fires"], v.get("commits", 0),
+                 v.get("commit_fences", 0),
+                 v.get("dedup_rows_dropped", 0))
         lines.append(" ".join(
             f"{c:>{w}}" for c, (_n, w) in zip(cells, _TOP_COLS)))
     if len(rows) > limit:

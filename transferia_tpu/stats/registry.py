@@ -319,6 +319,36 @@ class FleetStats(_Bundle):
         self.dispatch_time = self.m.histogram("fleet_time_dispatch")
 
 
+class DistributedFleetStats(_Bundle):
+    """Distributed fleet counters (fleet/distributed.py, fleet/worker.py,
+    fleet/autoscaler.py).  The pair to watch is `ticket_fences` +
+    `ticket_steals` vs `tickets_completed`: fences are zombies whose
+    completions were rejected after a crash reclaim or a preemption
+    revoke — nonzero fences with zero steals/preemptions means a lease
+    TTL is too short for the real part cadence."""
+
+    def __init__(self, metrics: Optional[Metrics] = None):
+        super().__init__(metrics)
+        self.enqueued = self.m.counter("fleet_tickets_enqueued")
+        self.claimed = self.m.counter("fleet_tickets_claimed")
+        self.completed = self.m.counter("fleet_tickets_completed")
+        self.failed = self.m.counter("fleet_tickets_failed")
+        self.released = self.m.counter("fleet_tickets_released")
+        self.fenced = self.m.counter("fleet_ticket_fences")
+        self.steals = self.m.counter("fleet_ticket_steals")
+        self.shed = self.m.counter("fleet_tickets_shed")
+        self.preemptions = self.m.counter("fleet_preemptions")
+        self.preempt_yields = self.m.counter("fleet_preempt_yields")
+        self.worker_spawns = self.m.counter("fleet_worker_spawns")
+        self.worker_exits = self.m.counter("fleet_worker_exits")
+        self.autoscale_ups = self.m.counter("fleet_autoscale_ups")
+        self.autoscale_downs = self.m.counter("fleet_autoscale_downs")
+        self.queued = self.m.gauge("fleet_dist_queued")
+        self.inflight = self.m.gauge("fleet_dist_inflight")
+        self.desired_workers = self.m.gauge("fleet_dist_desired_workers")
+        self.live_workers = self.m.gauge("fleet_dist_live_workers")
+
+
 class TableStats(_Bundle):
     """Per-table progress gauges (pkg/stats/table.go)."""
 

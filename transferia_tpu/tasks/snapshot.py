@@ -21,7 +21,7 @@ import threading
 import time
 from collections import deque
 from dataclasses import dataclass
-from typing import Optional
+from typing import Callable, Optional
 
 from transferia_tpu.abstract.change_item import (
     done_sharded_table_load,
@@ -35,7 +35,9 @@ from transferia_tpu.abstract.errors import (
     Codes,
     StaleEpochPublishError,
     TableUploadError,
+    TransferPreemptedError,
     WorkerKilledError,
+    is_preemption,
     is_retriable,
 )
 from transferia_tpu.abstract.interfaces import (
@@ -127,9 +129,19 @@ TUNING = SnapshotTuning.from_env()
 class SnapshotLoader:
     def __init__(self, transfer, coordinator: Coordinator,
                  operation_id: Optional[str] = None,
-                 metrics: Optional[Metrics] = None):
+                 metrics: Optional[Metrics] = None,
+                 preempted: "Optional[Callable[[], bool]]" = None,
+                 resume: bool = False):
         self.transfer = transfer
         self.cp = coordinator
+        # fleet preemption probe (fleet/worker.py): polled between
+        # parts; True = stop claiming and raise TransferPreemptedError
+        # — the committed parts stay, the transfer resumes elsewhere
+        self._preempted = preempted
+        # resume a previous attempt's operation: reuse an existing part
+        # queue instead of recreating it (recreating would reset the
+        # completed flags and replay the whole snapshot)
+        self.resume = resume
         # Deterministic default: sharded workers in separate processes must
         # agree on the operation id without a side channel (the reference
         # passes it via the k8s job spec; trtpu can override with
@@ -233,10 +245,16 @@ class SnapshotLoader:
             # an INCOMPLETE queue means a previous main crashed mid-
             # operation with secondaries possibly still attached.  A fully
             # completed queue is just the previous successful activation —
-            # recreate and run (re-activation must not wedge).
+            # recreate and run (re-activation must not wedge).  Under
+            # `resume` (fleet re-claim after a crash reclaim or a
+            # preemption revoke) an existing queue is instead REUSED:
+            # the committed parts are the checkpoint the transfer
+            # resumes from, recreating would replay the whole snapshot.
             existing = self.cp.operation_parts(self.operation_id) \
-                if self.job_count() > 1 else []
-            if existing and not all(p.completed for p in existing):
+                if (self.job_count() > 1 or self.resume) else []
+            resume_queue = bool(self.resume and existing)
+            if existing and not resume_queue \
+                    and not all(p.completed for p in existing):
                 raise CodedError(
                     Codes.MAIN_WORKER_RESTART,
                     f"operation {self.operation_id} has incomplete parts: "
@@ -248,6 +266,41 @@ class SnapshotLoader:
                 self.cp.set_operation_state(self.operation_id, {
                     "sharded_state": storage.sharded_state(),
                 })
+            if resume_queue:
+                # resume: the queue (and its completed flags) IS the
+                # checkpoint.  Release any claims a previous attempt of
+                # THIS worker index left leased (a zombie's leases; its
+                # later updates are epoch-fenced), then upload whatever
+                # is incomplete — nothing assignable means the previous
+                # attempt finished everything and only publication
+                # remained.
+                released = self.cp.clear_assigned_parts(
+                    self.operation_id, self.worker_index)
+                trace.instant("snapshot_resume",
+                              operation_id=self.operation_id,
+                              parts=len(existing),
+                              completed=sum(1 for p in existing
+                                            if p.completed),
+                              released=released)
+                logger.info(
+                    "resuming operation %s: %d/%d part(s) already "
+                    "committed (%d stale claim(s) released)",
+                    self.operation_id,
+                    sum(1 for p in existing if p.completed),
+                    len(existing), released)
+                self.cp.set_operation_state(
+                    self.operation_id, {"parts_discovery_done": True})
+                discovery = None
+                multi_part = {
+                    p.table_id for p in existing if p.parts_count > 1
+                }
+                # init brackets were sent by the FIRST attempt and are
+                # not re-sent on resume; everything else is the shared
+                # publish tail
+                self._upload_publish_tail(
+                    storage, tables, multi_part, discovery,
+                    next_inc_state, send_init=False)
+                return
             # a fresh run must reset the discovery flag (a re-activation
             # would otherwise see the previous run's True and drain early)
             self.cp.set_operation_state(self.operation_id,
@@ -258,7 +311,6 @@ class SnapshotLoader:
                 # stream in via add_operation_parts
                 self.cp.create_operation_parts(self.operation_id, [])
                 discovery = self._start_async_discovery(storage, tables)
-                parts = []
                 multi_part = {td.id for td in tables}
             else:
                 parts = split_tables(storage, tables, self.transfer,
@@ -272,10 +324,28 @@ class SnapshotLoader:
                 multi_part = {
                     p.table_id for p in parts if p.parts_count > 1
                 }
-            schemas = {td.id: storage.table_schema(td.id) for td in tables}
-            sink = make_async_sink(self.transfer, self.metrics,
-                                   snapshot_stage=True)
-            try:
+            self._upload_publish_tail(storage, tables, multi_part,
+                                      discovery, next_inc_state,
+                                      send_init=True)
+        finally:
+            if isinstance(storage, SnapshotableStorage):
+                storage.end_snapshot()
+
+    def _upload_publish_tail(self, storage: Storage, tables,
+                             multi_part: set, discovery,
+                             next_inc_state, send_init: bool) -> None:
+        """The shared back half of a snapshot run — upload, sharded
+        join, done-brackets, incremental cursors, fingerprints — used
+        by BOTH the fresh path and the fleet resume path so a change
+        here can never silently apply to one and not the other.
+        `send_init=False` on resume: the first attempt already sent
+        the init brackets, and re-sending could reset sink-side
+        sharded-table state."""
+        schemas = {td.id: storage.table_schema(td.id) for td in tables}
+        sink = make_async_sink(self.transfer, self.metrics,
+                               snapshot_stage=True)
+        try:
+            if send_init:
                 # sharded-table brackets (load_snapshot.go:821)
                 futs = [
                     sink.async_push([init_sharded_table_load(
@@ -283,32 +353,29 @@ class SnapshotLoader:
                     for tid in multi_part
                 ]
                 resolve_all(futs)
-                self._do_upload_tables(storage, schemas)
-                if discovery is not None:
-                    discovery.join()
-                    if self._discovery_error:
-                        raise self._discovery_error
-                if self.job_count() > 1:
-                    self._wait_all_parts_done()
-                futs = [
-                    sink.async_push([done_sharded_table_load(
-                        tid, schemas.get(tid))])
-                    for tid in multi_part
-                ]
-                resolve_all(futs)
-            finally:
-                sink.close()
-            if next_inc_state is not None:
-                # persist cursors only after the whole snapshot succeeded
-                # (load_snapshot.go:228-240)
-                self.cp.set_transfer_state(
-                    self.transfer.id,
-                    {"incremental_state": next_inc_state},
-                )
-            self._publish_fingerprints()
+            self._do_upload_tables(storage, schemas)
+            if discovery is not None:
+                discovery.join()
+                if self._discovery_error:
+                    raise self._discovery_error
+            if self.job_count() > 1:
+                self._wait_all_parts_done()
+            futs = [
+                sink.async_push([done_sharded_table_load(
+                    tid, schemas.get(tid))])
+                for tid in multi_part
+            ]
+            resolve_all(futs)
         finally:
-            if isinstance(storage, SnapshotableStorage):
-                storage.end_snapshot()
+            sink.close()
+        if next_inc_state is not None:
+            # persist cursors only after the whole snapshot succeeded
+            # (load_snapshot.go:228-240)
+            self.cp.set_transfer_state(
+                self.transfer.id,
+                {"incremental_state": next_inc_state},
+            )
+        self._publish_fingerprints()
 
     def _publish_fingerprints(self) -> None:
         """Merge per-part fingerprints into per-table snapshot digests
@@ -677,6 +744,23 @@ class SnapshotLoader:
                 with err_lock:
                     if errors:
                         return
+                # part-boundary preemption (fleet lease revocation /
+                # graceful drain): stop claiming BEFORE the next part —
+                # the parts already committed are the resume point, and
+                # a sibling thread mid-part finishes its part first
+                # (work done is never thrown away)
+                if self._preempted is not None and self._preempted():
+                    with self._progress_lock:
+                        done = self._local_parts_done
+                    trace.instant("snapshot_preempt_yield",
+                                  operation_id=self.operation_id,
+                                  parts_done=done)
+                    with err_lock:
+                        errors.append(TransferPreemptedError(
+                            f"transfer {self.transfer.id} yielded at a "
+                            f"part boundary ({done} part(s) committed "
+                            f"by this worker)"))
+                    return
                 part = self.cp.assign_operation_part(
                     self.operation_id, self.worker_index
                 )
@@ -736,6 +820,12 @@ class SnapshotLoader:
             hb_stop.set()
             hb.join(timeout=5.0)
         if errors:
+            if is_preemption(errors[0]):
+                # yield cleanly: release any claim a sibling left (its
+                # part completed or errored by now) so the resuming
+                # claimer never waits out this worker's leases
+                self.cp.clear_assigned_parts(self.operation_id,
+                                             self.worker_index)
             raise errors[0]
 
     def _upload_part_with_retry(self, storage: Storage,
