@@ -60,10 +60,15 @@ class FakeCH:
         self.port = 0
 
     def total_rows(self) -> int:
-        """Inserted-row count WITHOUT materializing rows (cheap to poll)."""
+        """Inserted-row count WITHOUT materializing rows (cheap to
+        poll).  Staging-plane tables (__trtpu_*: commits fence rows,
+        per-part staging) are transferia machinery, not delivered
+        data — excluded so pollers count what a consumer would see."""
         with self.lock:
             return sum(t.row_count() if isinstance(t, _LazyTable)
-                       else len(t["rows"]) for t in self.tables.values())
+                       else len(t["rows"])
+                       for n, t in self.tables.items()
+                       if not n.startswith("__trtpu"))
 
     # -- lifecycle ----------------------------------------------------------
     def start(self) -> "FakeCH":
@@ -149,6 +154,59 @@ class FakeCH:
                 elif m.group(2) in self.tables:
                     self.tables[m.group(2)]["rows"] = []
             return b""
+        m = re.match(r"alter table `?(\w+)`? replace partition id "
+                     r"'([^']*)' from `?(\w+)`?", low)
+        if m:
+            # the staged-commit publish: partition `slug` of the final
+            # table atomically becomes the staging table's rows (rows
+            # carry partition membership in their __trtpu_part value)
+            final = re.match(r"ALTER TABLE `?(\w+)`?", q, re.I).group(1)
+            src_name = re.search(r"FROM `?(\w+)`?\s*$", q, re.I).group(1)
+            slug = m.group(2)
+            with self.lock:
+                dst = self.tables.get(final)
+                src = self.tables.get(src_name)
+                if dst is None or src is None:
+                    raise ValueError("no such table for REPLACE PARTITION")
+                moved = []
+                for row in src["rows"]:
+                    row = dict(row)
+                    row["__trtpu_part"] = slug
+                    moved.append(row)
+                kept = [r for r in dst["rows"]
+                        if r.get("__trtpu_part") != slug]
+                dst["rows"] = kept + moved
+            return b""
+        m = re.match(r"alter table `?(\w+)`? drop partition id '([^']*)'",
+                     low)
+        if m:
+            final = re.match(r"ALTER TABLE `?(\w+)`?", q, re.I).group(1)
+            slug = m.group(2)
+            with self.lock:
+                dst = self.tables.get(final)
+                if dst is not None:
+                    dst["rows"] = [r for r in dst["rows"]
+                                   if r.get("__trtpu_part") != slug]
+            return b""
+        m = re.match(r"select max\(`?(\w+)`?\) from `?(\w+)`? "
+                     r"where `?(\w+)`? = '([^']*)'", low)
+        if m:
+            col_name = re.search(r"max\(`?(\w+)`?\)", q, re.I).group(1)
+            tbl = re.search(r"FROM `?(\w+)`?", q, re.I).group(1)
+            kcol = re.search(r"WHERE `?(\w+)`?", q, re.I).group(1)
+            kval = m.group(4)
+            with self.lock:
+                t = self.tables.get(tbl)
+                vals = []
+                if t is not None:
+                    for r in t["rows"]:
+                        rv = r.get(kcol)
+                        if isinstance(rv, bytes):
+                            rv = rv.decode()
+                        if rv == kval and r.get(col_name) is not None:
+                            vals.append(int(r[col_name]))
+            best = max(vals) if vals else None
+            return json.dumps({"data": [[best]]}).encode()
         m = re.match(r"insert into `?(\w+)`?\s*\((.*?)\)\s*format rowbinary",
                      low, re.S)
         if m:

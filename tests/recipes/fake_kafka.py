@@ -158,6 +158,13 @@ class FakeKafka:
         self.port = 0
         self._srv = None
         self.sasl = sasl
+        # transactional state (KIP-98 subset for the staged-commit
+        # sink): transactional id -> {"pid", "epoch", "published":
+        # [(topic, partition, segment)] of the LAST committed
+        # transaction}, so a republish SUPERSEDES instead of appending
+        # and a stale producer epoch is fenced
+        self.txns: dict[str, dict] = {}
+        self._next_pid = 1000
         self.auth_attempts = 0
         self._ssl_ctx = None
         if tls_cert is not None:
@@ -254,6 +261,7 @@ class FakeKafka:
             0: self._produce,
             1: self._fetch,
             2: self._list_offsets,
+            22: self._init_producer_id,
         }.get(api_key, lambda _r: b"")(r)
         return struct.pack("!i", corr) + body
 
@@ -321,31 +329,105 @@ class FakeKafka:
                     out += struct.pack("!i", 0)       # isr
         return out
 
+    def live_size(self, topic: str) -> int:
+        """Record count excluding superseded transactional segments
+        (offsets still cover them, like aborted-txn gaps on a real
+        broker)."""
+        with self.lock:
+            n = 0
+            for p in self.topics.get(topic, []):
+                for seg in p._segments:
+                    if seg[2] is None and seg[3] == []:
+                        continue
+                    n += seg[1]
+            return n
+
+    @staticmethod
+    def _frame_producer_epoch(blob: bytes) -> int:
+        """producerEpoch of the first v2 frame (offset 51 of the
+        frame: 12-byte outer header + 39 bytes to the epoch field)."""
+        if len(blob) < 61:
+            return -1
+        return struct.unpack_from("!h", blob, 51)[0]
+
+    def _init_producer_id(self, r: Reader) -> bytes:
+        """InitProducerId (KIP-360 shape): the client proposes its
+        epoch; an OLDER proposal than the id's current epoch is fenced
+        (error 90), else the id adopts the proposal."""
+        txn_id = r.string()
+        r.i32()              # transaction timeout
+        r.i64()              # producer id proposal (-1)
+        epoch = r.i16()
+        with self.lock:
+            state = self.txns.get(txn_id)
+            if state is None:
+                state = {"pid": self._next_pid, "epoch": epoch,
+                         "published": []}
+                self._next_pid += 1
+                self.txns[txn_id] = state
+            elif epoch < state["epoch"]:
+                # fenced: disclose the id's current epoch so the
+                # client's StaleEpochPublishError names the real winner
+                return struct.pack("!ihqh", 0, 90, -1, state["epoch"])
+            else:
+                state["epoch"] = epoch
+            return struct.pack("!ihqh", 0, 0, state["pid"],
+                               state["epoch"])
+
     def _produce(self, r: Reader) -> bytes:
-        r.string()           # transactional id
+        txn_id = r.string()  # transactional id (None = plain produce)
         r.i16()              # acks
         r.i32()              # timeout
-        out_topics = []
+        incoming = []
         for _ in range(r.i32()):
             topic = r.string()
             for _ in range(r.i32()):
                 partition = r.i32()
                 blob = r.bytes_() or b""
-                with self.lock:
+                incoming.append((topic, partition, blob))
+        err = 0
+        bases = {}
+        with self.lock:
+            state = self.txns.get(txn_id) if txn_id else None
+            if txn_id is not None:
+                if state is None:
+                    err = 47  # unknown producer for the txn id
+                else:
+                    for _t, _p, blob in incoming:
+                        if self._frame_producer_epoch(blob) \
+                                < state["epoch"]:
+                            err = 47  # stale producer epoch: fenced
+                            break
+            if not err:
+                if state is not None:
+                    # one transactional produce = one committed
+                    # transaction: SUPERSEDE the previous publish of
+                    # this transactional id in place (offsets keep
+                    # their slots, like aborted-txn gaps)
+                    for _t, _p, seg in state["published"]:
+                        seg[2] = None
+                        seg[3] = []
+                    state["published"] = []
+                for topic, partition, blob in incoming:
                     self.create_topic(topic)
                     plist = self.topics[topic][partition]
-                    base = len(plist)
+                    bases[(topic, partition)] = len(plist)
+                    segs_before = len(plist._segments)
                     # store the raw blob (a real broker never decodes);
                     # unparseable frames fall back to eager decode so
                     # protocol tests still see their errors on produce
                     if not plist.append_blob(blob):
                         for rec in decode_record_batches(blob):
                             plist.append(rec)
-                out_topics.append((topic, partition, base))
-        out = struct.pack("!i", len(out_topics))
-        for topic, partition, base in out_topics:
+                    if state is not None:
+                        for seg in plist._segments[segs_before:]:
+                            state["published"].append(
+                                (topic, partition, seg))
+        out = struct.pack("!i", len(incoming))
+        for topic, partition, _blob in incoming:
+            base = bases.get((topic, partition), -1)
             out += _enc_str(topic) + struct.pack("!i", 1)
-            out += struct.pack("!ihqq", partition, 0, base, -1)
+            out += struct.pack("!ihqq", partition, err, base, -1)
         out += struct.pack("!i", 0)  # throttle
         return out
 

@@ -343,13 +343,53 @@ class _Session:
             with fake.lock:
                 fake.executed_ddl.append(sql)
             return self.send(b"C", b"OK\x00")
-        if low.startswith(("create ", "drop ", "truncate ")):
+        if low.startswith(("create ", "drop ", "truncate ", "alter ")):
             self.apply_ddl(sql)
             return self.send(b"C", b"OK\x00")
+        if low.startswith("begin"):
+            return self.apply_transaction(sql)
         if low.startswith(("insert ", "update ", "delete ")):
             self.apply_dml(sql)
             return self.send(b"C", b"OK\x00")
+        if low.startswith("select "):
+            # generic single-table SELECT (fence reads etc.)
+            cols, rows = self._eval_select(sql)
+            return self.send_rows(
+                cols, [[r.get(c) for c in cols] for r in rows])
         raise ValueError(f"fake PG: unhandled query: {sql[:120]}")
+
+    def apply_transaction(self, sql: str):
+        """A `BEGIN; ...; COMMIT` simple-query block: apply the inner
+        statements atomically — all table mutations roll back when any
+        statement fails, like the implicit transaction a real server
+        wraps a multi-statement Q message in."""
+        import copy
+
+        stmts = [s.strip() for s in sql.split(";") if s.strip()]
+        fake = self.fake
+        with fake.lock:
+            snapshot = {
+                k: copy.deepcopy(t.rows) for k, t in fake.tables.items()
+            }
+            try:
+                for stmt in stmts:
+                    low = stmt.lower()
+                    if low in ("begin", "commit", "rollback"):
+                        continue
+                    if low.startswith(("insert ", "update ", "delete ")):
+                        self.apply_dml(stmt)
+                    elif low.startswith(("create ", "drop ",
+                                         "truncate ")):
+                        self.apply_ddl(stmt)
+                    else:
+                        raise ValueError(
+                            f"fake PG: unhandled txn stmt: {stmt[:80]}")
+            except Exception:
+                for k, rows in snapshot.items():
+                    if k in fake.tables:
+                        fake.tables[k].rows = rows
+                raise
+        return self.send(b"C", b"COMMIT\x00")
 
     # -- replication streaming ---------------------------------------------
     def stream_replication(self):
@@ -544,6 +584,15 @@ class _Session:
                                                 else "")))
                 fake.add_table(FakeTable(ns, name, cols))
             return
+        m = re.match(r'alter table "?(\w+)"?\."?(\w+)"? add column '
+                     r'if not exists "?(\w+)"? (\w+)', sql, re.I)
+        if m:
+            t = fake.tables.get((m.group(1), m.group(2)))
+            if t is None:
+                raise ValueError("relation does not exist")
+            if all(c[0] != m.group(3) for c in t.columns):
+                t.columns.append((m.group(3), m.group(4), False, False))
+            return
         m = re.match(r'drop table if exists "?(\w+)"?\."?(\w+)"?', sql, re.I)
         if m:
             fake.tables.pop((m.group(1), m.group(2)), None)
@@ -562,7 +611,30 @@ class _Session:
     def apply_dml(self, sql: str):
         fake = self.fake
         m = re.match(r'insert into "?(\w+)"?\."?(\w+)"? \((.*?)\) '
-                     r"values \((.*)\)", sql, re.I | re.S)
+                     r'select (.*?) from "?(\w+)"?\."?(\w+)"?\s*$',
+                     sql, re.I | re.S)
+        if m:
+            # INSERT ... SELECT (staged-commit publish): copy the source
+            # table's rows, evaluating literal select items ('slug')
+            dst = fake.tables.get((m.group(1), m.group(2)))
+            src = fake.tables.get((m.group(5), m.group(6)))
+            if dst is None or src is None:
+                raise ValueError("relation does not exist")
+            cols = [c.strip().strip('"') for c in m.group(3).split(",")]
+            items = [s.strip() for s in m.group(4).split(",")]
+            for row in list(src.rows):
+                out = {}
+                for col, item in zip(cols, items):
+                    if item.startswith("'") and item.endswith("'"):
+                        out[col] = item[1:-1].replace("''", "'")
+                    else:
+                        out[col] = row.get(item.strip('"'))
+                dst.rows.append(out)
+            return
+        m = re.match(r'insert into "?(\w+)"?\."?(\w+)"? \((.*?)\) '
+                     r"values \((.*)\)",
+                     re.split(r" ON CONFLICT", sql, flags=re.I)[0],
+                     re.I | re.S)
         if m:
             t = fake.tables.get((m.group(1), m.group(2)))
             if t is None:
@@ -570,7 +642,24 @@ class _Session:
             cols = [c.strip().strip('"') for c in m.group(3).split(",")]
             vals = [v.strip().strip("'")
                     for v in re.split(r",(?=(?:[^']*'[^']*')*[^']*$)",
-                                      m.group(4).split(" ON CONFLICT")[0])]
+                                      m.group(4))]
+            mc = re.search(r'ON CONFLICT \(([^)]*)\) DO '
+                           r'(NOTHING|UPDATE SET)', sql, re.I)
+            if mc:
+                # minimal upsert: conflict keys matched by value;
+                # DO NOTHING skips, DO UPDATE replaces (fence-table
+                # shapes)
+                keys = [k.strip().strip('"')
+                        for k in mc.group(1).split(",")]
+                new = dict(zip(cols, vals))
+                for r in t.rows:
+                    if all(str(r.get(k)) == str(new.get(k))
+                           for k in keys):
+                        if mc.group(2).upper() == "UPDATE SET":
+                            r.update(new)
+                        return
+                t.rows.append(new)
+                return
             t.rows.append(dict(zip(cols, vals)))
             if fake.echo_dml_to_wal:
                 types = {c[0]: c[1] for c in t.columns}

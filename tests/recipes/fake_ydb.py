@@ -149,6 +149,62 @@ class FakeYDB:
     def run_yql(self, yql: str):
         self.queries.append(yql)
         yql = yql.strip()
+        stmts = [s.strip() for s in yql.split(";") if s.strip()]
+        if len(stmts) > 1:
+            # multi-statement interactive transaction (staged-commit
+            # publish): apply atomically — roll every table back when
+            # any statement fails
+            import copy
+
+            with self.lock:
+                snapshot = {name: copy.deepcopy(t.rows)
+                            for name, t in self.tables.items()}
+                try:
+                    out = ([], 0)
+                    for stmt in stmts:
+                        out = self._run_one_yql(stmt)
+                    return out
+                except Exception:
+                    for name, rows in snapshot.items():
+                        if name in self.tables:
+                            self.tables[name].rows = rows
+                    raise
+        return self._run_one_yql(yql)
+
+    def _run_one_yql(self, yql: str):
+        m = re.match(r"UPSERT INTO `(.+?)` SELECT \*, (.+?) AS `(.+?)` "
+                     r"FROM `(.+?)`$", yql, re.DOTALL)
+        if m:
+            # staged-commit publish: copy the staging table's rows into
+            # the final table with the literal part column appended
+            dst = self._resolve(m.group(1))
+            src = self._resolve(m.group(4))
+            if dst is None or src is None:
+                raise ValueError(f"no such table in {yql[:120]}")
+            lit, _ = _parse_literal(m.group(2))
+            col = m.group(3)
+            with self.lock:
+                for row in list(src.rows.values()):
+                    row = dict(row)
+                    row[col] = lit
+                    dst.upsert(row, emit_cdc=False)
+            return [], 0
+        m = re.match(r"UPSERT INTO `(.+?)` \((.+?)\) VALUES \((.+)\)$",
+                     yql, re.DOTALL)
+        if m:
+            t = self._resolve(m.group(1))
+            if t is None:
+                raise ValueError(f"no such table {m.group(1)}")
+            cols = [c.strip().strip("`") for c in m.group(2).split(",")]
+            vals = []
+            rest = m.group(3)
+            while rest.strip():
+                v, ln = _parse_literal(rest)
+                vals.append(v)
+                rest = rest[ln:].lstrip().lstrip(",")
+            with self.lock:
+                t.upsert(dict(zip(cols, vals)), emit_cdc=False)
+            return [], 0
         m = re.match(r"SELECT MIN\(`(.+?)`\) AS lo, MAX\(`(.+?)`\) AS hi "
                      r"FROM `(.+?)`", yql)
         if m:
@@ -226,6 +282,17 @@ class FakeYDB:
             with self.lock:
                 if rel not in self.tables:
                     self.add_table(rel, cols, keys)
+            return
+        m = re.match(r"ALTER TABLE `(.+?)` ADD COLUMN `(.+?)` (\w+)$",
+                     yql)
+        if m:
+            t = self._resolve(m.group(1))
+            if t is None:
+                raise ValueError(f"no such table {m.group(1)}")
+            if any(c[0] == m.group(2) for c in t.columns):
+                raise ValueError(
+                    f"column {m.group(2)} already exists")
+            t.columns.append((m.group(2), m.group(3)))
             return
         m = re.match(r"DROP TABLE `(.+?)`$", yql)
         if m:

@@ -1,20 +1,180 @@
 """Compile+import the YDB proto subset (cross-validation side).
 
-protoc is part of the environment's native toolchain; the generated module
-is cached per test session in a temp dir.  Tests that need it call
-load_pb() and skip when protoc is unavailable.
+Two paths to the generated message classes:
+
+- `protoc` (the environment's native toolchain) when present — the
+  canonical cross-validation parser, byte-for-byte what ydb-api-protos
+  users run;
+- a dynamic-descriptor fallback when only the protobuf RUNTIME is
+  installed: `_parse_proto` is a minimal .proto parser covering exactly
+  the subset grammar ydb_subset.proto uses (proto3 messages, nested
+  oneofs, enums, repeated fields, one map<>), building a
+  FileDescriptorProto the runtime turns into real message classes.
+  Still an independent parser from the hand codec in
+  transferia_tpu/providers/ydb/wire.py — the cross-validation property
+  (both sides can't share one misread of the wire format) holds.
+
+Tests that need it call load_pb() and skip when neither path works.
 """
 
 from __future__ import annotations
 
 import importlib
 import os
+import re
 import shutil
 import subprocess
 import sys
 import tempfile
+import types
 
 _cached = None
+
+_SCALARS = {
+    # proto scalar -> FieldDescriptorProto.Type value
+    "double": 1, "float": 2, "int64": 3, "uint64": 4, "int32": 5,
+    "bool": 8, "string": 9, "bytes": 12, "uint32": 13,
+}
+_TYPE_MESSAGE = 11
+_TYPE_ENUM = 14
+_LABEL_OPTIONAL = 1
+_LABEL_REPEATED = 3
+
+
+def _strip_comments(text: str) -> str:
+    return re.sub(r"//[^\n]*", "", text)
+
+
+def _blocks(text: str, kind: str):
+    """Yield (name, body) for every top-level `kind name { ... }`."""
+    for m in re.finditer(rf"\b{kind}\s+(\w+)\s*\{{", text):
+        depth = 1
+        pos = m.end()
+        while depth:
+            ch = text[pos]
+            if ch == "{":
+                depth += 1
+            elif ch == "}":
+                depth -= 1
+            pos += 1
+        yield m.group(1), text[m.end():pos - 1]
+
+
+def _parse_proto(text: str, package: str):
+    """ydb_subset.proto -> FileDescriptorProto (subset grammar only)."""
+    from google.protobuf import descriptor_pb2
+
+    text = _strip_comments(text)
+    enums = dict(_blocks(text, "enum"))
+    messages = dict(_blocks(text, "message"))
+
+    fdp = descriptor_pb2.FileDescriptorProto()
+    fdp.name = "ydb_subset.proto"
+    fdp.package = package
+    fdp.syntax = "proto3"
+
+    for name, body in enums.items():
+        ed = fdp.enum_type.add()
+        ed.name = name
+        for vm in re.finditer(r"(\w+)\s*=\s*(\d+)\s*;", body):
+            val = ed.value.add()
+            val.name, val.number = vm.group(1), int(vm.group(2))
+
+    def add_field(msg, name, number, type_name, repeated, oneof_index):
+        f = msg.field.add()
+        f.name = name
+        f.number = number
+        f.label = _LABEL_REPEATED if repeated else _LABEL_OPTIONAL
+        if type_name in _SCALARS:
+            f.type = _SCALARS[type_name]
+        elif type_name in enums:
+            f.type = _TYPE_ENUM
+            f.type_name = f".{package}.{type_name}"
+        elif type_name in messages:
+            f.type = _TYPE_MESSAGE
+            f.type_name = f".{package}.{type_name}"
+        else:
+            raise ValueError(f"unknown proto type {type_name!r}")
+        if oneof_index is not None:
+            f.oneof_index = oneof_index
+        return f
+
+    field_re = re.compile(
+        r"(repeated\s+)?(map\s*<\s*(\w+)\s*,\s*(\w+)\s*>|\w+)\s+"
+        r"(\w+)\s*=\s*(\d+)\s*;")
+
+    for name, body in messages.items():
+        md = fdp.message_type.add()
+        md.name = name
+        # carve out oneof groups first; remaining text = plain fields
+        plain = body
+        oneof_parts = []
+        for om in re.finditer(r"oneof\s+(\w+)\s*\{([^}]*)\}", body):
+            oneof_parts.append((om.group(1), om.group(2)))
+            plain = plain.replace(om.group(0), "")
+        for oneof_name, oneof_body in oneof_parts:
+            idx = len(md.oneof_decl)
+            md.oneof_decl.add().name = oneof_name
+            for fm in field_re.finditer(oneof_body):
+                add_field(md, fm.group(5), int(fm.group(6)),
+                          fm.group(2), False, idx)
+        for fm in field_re.finditer(plain):
+            repeated = bool(fm.group(1))
+            if fm.group(3):  # map<K, V>: synthesized entry message
+                key_t, val_t = fm.group(3), fm.group(4)
+                entry = md.nested_type.add()
+                entry.name = "".join(
+                    p.capitalize() for p in fm.group(5).split("_")
+                ) + "Entry"
+                entry.options.map_entry = True
+                kf = entry.field.add()
+                kf.name, kf.number = "key", 1
+                kf.label = _LABEL_OPTIONAL
+                kf.type = _SCALARS[key_t]
+                vf = entry.field.add()
+                vf.name, vf.number = "value", 2
+                vf.label = _LABEL_OPTIONAL
+                if val_t in _SCALARS:
+                    vf.type = _SCALARS[val_t]
+                else:
+                    vf.type = _TYPE_MESSAGE
+                    vf.type_name = f".{package}.{val_t}"
+                f = md.field.add()
+                f.name = fm.group(5)
+                f.number = int(fm.group(6))
+                f.label = _LABEL_REPEATED
+                f.type = _TYPE_MESSAGE
+                f.type_name = f".{package}.{name}.{entry.name}"
+                continue
+            add_field(md, fm.group(5), int(fm.group(6)), fm.group(2),
+                      repeated, None)
+    return fdp
+
+
+def _dynamic_pb():
+    """Build the message classes with the protobuf runtime only (no
+    protoc binary).  Returns a module-like namespace exposing message
+    classes and top-level enum values, like a generated pb2 module."""
+    try:
+        from google.protobuf import descriptor_pool, message_factory
+    except ImportError:
+        return None
+    if not hasattr(message_factory, "GetMessageClass"):
+        return None  # ancient runtime: keep the protoc-only behavior
+    proto_path = os.path.join(os.path.dirname(__file__), "ydb_protos",
+                              "ydb_subset.proto")
+    with open(proto_path) as fh:
+        fdp = _parse_proto(fh.read(), "ydb_subset")
+    pool = descriptor_pool.DescriptorPool()
+    file_desc = pool.Add(fdp)
+    ns = types.SimpleNamespace()
+    for name in file_desc.message_types_by_name:
+        setattr(ns, name, message_factory.GetMessageClass(
+            pool.FindMessageTypeByName(f"ydb_subset.{name}")))
+    for enum in file_desc.enum_types_by_name.values():
+        for value in enum.values:
+            setattr(ns, value.name, value.number)
+    return ns
 
 
 def load_pb():
@@ -22,7 +182,8 @@ def load_pb():
     if _cached is not None:
         return _cached
     if shutil.which("protoc") is None:
-        return None
+        _cached = _dynamic_pb()
+        return _cached
     proto_dir = os.path.join(os.path.dirname(__file__), "ydb_protos")
     out_dir = tempfile.mkdtemp(prefix="ydb_pb_")
     subprocess.run(

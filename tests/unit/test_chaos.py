@@ -604,6 +604,60 @@ class TestEndToEndTrials:
         assert (c.spec, c.fire_log, c.commit_log) != \
             (a.spec, a.fire_log, a.commit_log)
 
+    def test_exactly_once_backend_matrix_names_wire_targets(self):
+        from transferia_tpu.chaos import runner, wire_backends
+
+        assert runner.EXACTLY_ONCE_BACKENDS == (
+            "memory", "arrow_ipc", "postgres", "clickhouse", "ydb",
+            "kafka", "s3")
+        assert set(runner.EXACTLY_ONCE_BACKENDS) <= set(
+            wire_backends.backend_names())
+        # every wire backend's publish site is in the FPT001 catalog
+        from transferia_tpu.chaos.sites import site_names
+
+        assert set(runner._EO_PUBLISH_SITES.values()) <= site_names()
+
+    def test_exactly_once_wire_backend_trial(self):
+        """The same gauntlet over a WIRE target (postgres): the zombie
+        is fenced at the target's own persisted primitive and the
+        delivered multiset equals the fault-free reference."""
+        from transferia_tpu.chaos import runner, wire_backends
+
+        ok, reason = wire_backends.backend_available("postgres")
+        if not ok:
+            pytest.skip(reason)
+        with runner._fast_retries():
+            ref = runner._exactly_once_reference(512, "postgres")
+            r = runner.run_exactly_once_trial(0, 7, 512, ref,
+                                              backend="postgres")
+        assert r.passed, r.verdict.summary()
+        assert r.backend == "postgres"
+        assert r.kills == 1
+        assert r.fence_rejected >= 1
+        assert r.verdict.duplicate_rows == 0
+        assert r.verdict.max_multiplicity <= 1
+
+    def test_exactly_once_wire_logs_replay_with_seed(self):
+        """Wire-backend determinism: same seed -> byte-identical fire,
+        steal and commit logs even with the protocol fake's sockets in
+        the loop."""
+        from transferia_tpu.chaos import runner, wire_backends
+
+        ok, reason = wire_backends.backend_available("s3")
+        if not ok:
+            pytest.skip(reason)
+        with runner._fast_retries():
+            ref = runner._exactly_once_reference(512, "s3")
+            a = runner.run_exactly_once_trial(1, 7, 512, ref,
+                                              backend="s3")
+            b = runner.run_exactly_once_trial(1, 7, 512, ref,
+                                              backend="s3")
+        assert a.passed and b.passed
+        assert a.spec == b.spec
+        assert a.fire_log == b.fire_log
+        assert a.steal_log == b.steal_log
+        assert a.commit_log == b.commit_log
+
     def test_exactly_once_detects_surviving_duplicate(self):
         """False-positive guard: a delivery carrying one extra copy of
         a reference row must FAIL the exactly-once audit even though it

@@ -437,3 +437,79 @@ class TestPoolAccsFailpoint:
         assert pool.memo_get(rowhash._ACC_MEMO_KEY) is None
         a1, a2 = pool_accumulators(pool)
         assert len(a1) == pool.n_values == len(a2)
+
+
+class TestDeviceRowKeys:
+    """Device-side dedup-window keys (ROADMAP item 2 remainder): the
+    jitted key program is byte-identical to the host gather for every
+    column kind — fixed, var-width, dict-native — including nulls, so
+    a dedup window fed by either backend recognizes the same torn-write
+    prefixes."""
+
+    def _tid(self):
+        return TableID("sample", "events")
+
+    @pytest.mark.parametrize("preset,n,dict_encode", [
+        ("iot", 257, False),      # fixed + var mix, non-pow2 rows
+        ("users", 512, False),
+        ("iot", 300, True),       # dict-native accumulator gather
+        ("users", 64, True),
+        ("iot", 1, False),        # single row
+    ])
+    def test_device_keys_byte_identical(self, preset, n, dict_encode):
+        from transferia_tpu.providers.sample import make_batch
+
+        b = make_batch(preset, self._tid(), 0, n, 7,
+                       dict_encode=dict_encode)
+        host = rowhash.batch_row_keys(b)
+        dev = rowhash.batch_row_keys_device(b)
+        assert np.array_equal(host, dev)
+
+    def test_device_keys_with_nulls(self):
+        from transferia_tpu.abstract.schema import TableSchema
+
+        schema = TableSchema([
+            ColSchema("a", CanonicalType.INT64),
+            ColSchema("s", CanonicalType.UTF8),
+        ])
+        b = ColumnBatch.from_pydict(self._tid(), schema, {
+            "a": [1, None, 3, None, 5],
+            "s": ["x", "y", None, None, "zz"],
+        })
+        assert np.array_equal(rowhash.batch_row_keys(b),
+                              rowhash.batch_row_keys_device(b))
+
+    def test_env_knob_routes_auto_to_device(self, monkeypatch):
+        from transferia_tpu.providers.sample import make_batch
+
+        b = make_batch("iot", self._tid(), 0, 128, 3)
+        host = rowhash.batch_row_keys(b)
+        monkeypatch.setenv("TRANSFERIA_TPU_DEDUP_KEYS", "device")
+        assert rowhash._device_keys_requested()
+        assert np.array_equal(rowhash.batch_row_keys(b), host)
+
+    def test_explicit_backends(self):
+        from transferia_tpu.providers.sample import make_batch
+
+        b = make_batch("users", self._tid(), 0, 96, 5)
+        assert np.array_equal(
+            rowhash.batch_row_keys(b, backend="host"),
+            rowhash.batch_row_keys(b, backend="device"))
+
+    def test_dedup_window_agrees_across_backends(self, monkeypatch):
+        """The staged-commit window behaves identically whichever
+        backend computed the keys: an armed replay of a torn prefix
+        drops either way."""
+        from transferia_tpu.providers.sample import make_batch
+        from transferia_tpu.providers.staging import DedupWindow
+
+        b = make_batch("iot", self._tid(), 0, 96, 7)
+        for device in (False, True):
+            if device:
+                monkeypatch.setenv("TRANSFERIA_TPU_DEDUP_KEYS",
+                                   "device")
+            w = DedupWindow()
+            w.filter(b.slice(0, 64))
+            w.arm_replay()
+            out, dropped = w.filter(b)
+            assert dropped == 64 and out.n_rows == 32

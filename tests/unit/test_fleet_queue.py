@@ -329,3 +329,151 @@ class TestTicketQueue:
         assert tickets["t0"].claimed_by == "w1"
         assert tickets["t1"].state == "queued"
         assert cp.complete_ticket("q", won) is True
+
+
+class TestTicketRetentionGC:
+    """gc_tickets conformance: terminal tickets past the retention
+    window prune on every backend; live tickets never do."""
+
+    def test_prunes_only_expired_terminal(self, cp):
+        won = []
+        for i in range(4):
+            cp.enqueue_ticket("q", make_ticket(i))
+        for i in (0, 1):
+            won.append(cp.claim_ticket("q", f"t{i}", "w1"))
+            assert cp.complete_ticket("q", won[-1]) is True
+        running = cp.claim_ticket("q", "t2", "w1")  # stays claimed
+        assert running is not None
+        # retention window still open: nothing prunes
+        assert cp.gc_tickets("q", retention_seconds=3600.0) == 0
+        # window closed: exactly the two terminal tickets prune
+        assert cp.gc_tickets("q", retention_seconds=0.0) == 2
+        left = {t.ticket_id: t.state for t in cp.list_tickets("q")}
+        assert left == {"t2": "claimed", "t3": "queued"}
+        # pruning is idempotent
+        assert cp.gc_tickets("q", retention_seconds=0.0) == 0
+
+    def test_completed_at_stamped_and_persisted(self, cp):
+        cp.enqueue_ticket("q", make_ticket(0))
+        won = cp.claim_ticket("q", "t0", "w1")
+        before = time.time()
+        assert cp.complete_ticket("q", won) is True
+        stored = cp.list_tickets("q")[0]
+        assert stored.completed_at >= before
+
+    def test_default_retention_from_env(self, monkeypatch):
+        from transferia_tpu.coordinator.interface import (
+            DEFAULT_TICKET_RETENTION,
+            ticket_retention_seconds,
+        )
+
+        assert ticket_retention_seconds({}) == DEFAULT_TICKET_RETENTION
+        assert ticket_retention_seconds(
+            {"TRANSFERIA_TPU_TICKET_RETENTION": "120"}) == 120.0
+        assert ticket_retention_seconds(
+            {"TRANSFERIA_TPU_TICKET_RETENTION": "junk"}) == \
+            DEFAULT_TICKET_RETENTION
+
+    def test_gc_spares_leader_lease_ticket(self, cp):
+        """The leader-election ticket is never terminal, so retention
+        GC must never age the election state out."""
+        from transferia_tpu.fleet.leader import LeaderLease
+
+        lease = LeaderLease(cp, queue="q", replica_id="r1")
+        assert lease.ensure()
+        assert cp.gc_tickets("q.leader", retention_seconds=0.0) == 0
+        assert lease.ensure()
+
+
+class TestLeaderLease:
+    """Scheduler-replica leader election over the ticket queue: one
+    winner, automatic failover on lease expiry, fenced renewals."""
+
+    def test_single_winner_among_replicas(self, cp):
+        from transferia_tpu.fleet.leader import LeaderLease
+
+        a = LeaderLease(cp, queue="q", replica_id="ra")
+        b = LeaderLease(cp, queue="q", replica_id="rb")
+        got = (a.ensure(), b.ensure())
+        assert got == (True, False)      # first claimer wins
+        assert a.ensure()                # renewal keeps the lease
+        assert not b.ensure()
+        assert b.leader_id() == "ra"
+
+    def test_failover_on_lease_expiry(self, cp):
+        from transferia_tpu.fleet.leader import LeaderLease
+
+        cp.lease_seconds = 0.15
+        a = LeaderLease(cp, queue="q", replica_id="ra")
+        b = LeaderLease(cp, queue="q", replica_id="rb")
+        assert a.ensure() and not b.ensure()
+        time.sleep(0.3)                  # leader dies silently
+        assert b.ensure()                # standby steals the claim
+        # the old leader's renew is (ticket, epoch)-fenced: it observes
+        # the loss and demotes instead of resurrecting its claim
+        assert not a.ensure() or b.leader_id() != "rb"
+        assert b.leader_id() == "rb"
+
+    def test_graceful_release_hands_over(self, cp):
+        from transferia_tpu.fleet.leader import LeaderLease
+
+        a = LeaderLease(cp, queue="q", replica_id="ra")
+        b = LeaderLease(cp, queue="q", replica_id="rb")
+        assert a.ensure() and not b.ensure()
+        a.release()
+        assert b.ensure()                # immediate takeover, no TTL
+        assert not a.ensure()
+
+    def test_autoscaler_standby_replica_does_not_tick(self, cp):
+        """Only the leader runs the preemption/autoscale tick; a
+        standby reaps its own workers and holds."""
+        from transferia_tpu.fleet.autoscaler import FleetAutoscaler
+        from transferia_tpu.fleet.distributed import (
+            DistributedFleetScheduler,
+        )
+        from transferia_tpu.fleet.leader import LeaderLease
+
+        class _Sup:
+            def __init__(self):
+                self.reaps = 0
+                self.scaled = []
+
+            def live_workers(self):
+                return 1
+
+            def draining_workers(self):
+                return 0
+
+            def reap(self):
+                self.reaps += 1
+
+            def scale_to(self, n):
+                self.scaled.append(n)
+
+            def retire_one(self):
+                return None
+
+        from transferia_tpu.stats.registry import Metrics
+
+        sched_a = DistributedFleetScheduler(
+            cp, queue="q", metrics=Metrics(), name="rep-a")
+        sched_b = DistributedFleetScheduler(
+            cp, queue="q", metrics=Metrics(), name="rep-b")
+        sup_a, sup_b = _Sup(), _Sup()
+        scaler_a = FleetAutoscaler(
+            sched_a, sup_a, min_workers=0, max_workers=2,
+            leader=LeaderLease(cp, queue="q", replica_id="ra"))
+        scaler_b = FleetAutoscaler(
+            sched_b, sup_b, min_workers=0, max_workers=2,
+            leader=LeaderLease(cp, queue="q", replica_id="rb"))
+        ra = scaler_a.step()
+        rb = scaler_b.step()
+        assert ra["action"] != "standby"
+        assert rb["action"] == "standby"
+        assert sup_b.reaps == 1          # local reaping continues
+        assert sup_b.scaled == []        # but no scaling decisions
+        assert scaler_a.snapshot()["leader"]["is_leader"]
+        assert not scaler_b.snapshot()["leader"]["is_leader"]
+        # stop() releases the lease; the standby leads its next step
+        scaler_a.stop()
+        assert scaler_b.step()["action"] != "standby"

@@ -70,6 +70,8 @@ class FleetTicket:
     preemptions: int = 0
     error: str = ""
     enqueued_at: float = 0.0
+    completed_at: float = 0.0           # wall clock of the terminal
+    #                                     transition (retention GC key)
 
     def key(self) -> str:
         return self.ticket_id
@@ -102,6 +104,7 @@ class FleetTicket:
             "preemptions": self.preemptions,
             "error": self.error,
             "enqueued_at": self.enqueued_at,
+            "completed_at": self.completed_at,
         }
 
     @classmethod
@@ -125,6 +128,7 @@ class FleetTicket:
             preemptions=int(d.get("preemptions", 0)),
             error=d.get("error", ""),
             enqueued_at=float(d.get("enqueued_at", 0.0)),
+            completed_at=float(d.get("completed_at", 0.0)),
         )
 
 
@@ -188,6 +192,20 @@ def complete_in_place(d: dict, error: str = "") -> None:
     d["state"] = "failed" if error else "done"
     d["error"] = error
     d["lease_expires_at"] = 0.0
+    d["completed_at"] = time.time()
+
+
+def ticket_expired(d: dict, retention_seconds: float,
+                   now: Optional[float] = None) -> bool:
+    """Retention rule shared by the three backends' GC: only TERMINAL
+    tickets age out, `retention_seconds` after their terminal
+    transition (tickets from before the completed_at field fall back
+    to enqueued_at — old terminal records, prunable either way)."""
+    if d.get("state") not in ("done", "failed"):
+        return False
+    ts = float(d.get("completed_at") or d.get("enqueued_at") or 0.0)
+    return ts + retention_seconds < (time.time() if now is None
+                                     else now)
 
 
 def release_in_place(d: dict, failed: bool = False) -> None:

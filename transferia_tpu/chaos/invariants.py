@@ -383,6 +383,10 @@ class AuditingCoordinator(Coordinator):
     def release_ticket(self, queue, ticket, failed=False):
         return self.inner.release_ticket(queue, ticket, failed=failed)
 
+    def gc_tickets(self, queue, retention_seconds=None):
+        return self.inner.gc_tickets(
+            queue, retention_seconds=retention_seconds)
+
     def set_transfer_state(self, transfer_id, state):
         self.state_writes += 1
         return self.inner.set_transfer_state(transfer_id, state)

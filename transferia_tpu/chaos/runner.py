@@ -717,22 +717,40 @@ def run_worker_crash_trial(trial: int, seed: int, rows: int,
 # TIGHTENED to exactly-once: the delivered multiset must EQUAL the
 # fault-free reference (zero duplicate AND zero lost row keys).
 #
-# Each trial runs per backend — the in-memory store and (with pyarrow)
-# the arrow_ipc staging-directory sink — and replays identically under
-# a seed on three surfaces: the failpoint fire log, the steal log, and
-# the coordinator's commit-decision log.  The zombie replay is proved
-# at BOTH fences: the coordinator's `commit_part` denies the stale
-# epoch, and a direct sink-layer publish at the stale epoch raises
-# StaleEpochPublishError instead of clobbering the survivor's data.
+# Each trial runs per backend — the in-memory store, (with pyarrow)
+# the arrow_ipc staging-directory sink, and the five WIRE targets
+# (postgres, clickhouse, ydb, kafka, s3 objects) against the in-repo
+# protocol fakes (chaos/wire_backends.py) — and replays identically
+# under a seed on three surfaces: the failpoint fire log, the steal
+# log, and the coordinator's commit-decision log.  The zombie replay
+# is proved at BOTH fences: the coordinator's `commit_part` denies the
+# stale epoch, and a direct sink-layer publish at the stale epoch
+# raises StaleEpochPublishError instead of clobbering the survivor's
+# data — for the wire targets that second fence is the TARGET's own
+# primitive (pg/ch/ydb `__trtpu_commits` rows, kafka producer fencing,
+# the s3 conditional marker object).
 
-EXACTLY_ONCE_BACKENDS = ("memory", "arrow_ipc")
+EXACTLY_ONCE_BACKENDS = ("memory", "arrow_ipc", "postgres",
+                         "clickhouse", "ydb", "kafka", "s3")
+
+# backend -> its wire-publish failpoint site (chaos/sites.py): a
+# transient fault here lands between the fence read and the target's
+# atomic flip — the retried part must republish idempotently
+_EO_PUBLISH_SITES = {
+    "postgres": "sink.pg.publish",
+    "clickhouse": "sink.ch.publish",
+    "ydb": "sink.ydb.publish",
+    "kafka": "sink.kafka.publish",
+    "s3": "sink.s3.publish",
+}
 
 
 def exactly_once_schedule(trial: int, seed: int, backend: str) -> str:
     """Seed-derived spec: one torn write into staging (the dedup window
     must drop the replayed prefix), a victim kill either mid-part or
-    mid-publish, and (sometimes) transient staging / commit-RPC faults
-    the retry machinery must absorb by restaging from scratch."""
+    mid-publish, and (sometimes) transient staging / commit-RPC /
+    wire-publish faults the retry machinery must absorb by restaging
+    from scratch."""
     rng = random.Random(f"{seed}:exactly_once:{backend}:{trial}")
     frac = rng.choice((0.25, 0.5, 0.75))
     clauses = [
@@ -758,78 +776,47 @@ def exactly_once_schedule(trial: int, seed: int, backend: str) -> str:
         clauses.append(
             f"coordinator.commit_part=after:{rng.randrange(0, 3)},"
             f"times:1,raise:ChaosInjectedError")
+    site = _EO_PUBLISH_SITES.get(backend)
+    if site is not None and rng.random() < 0.5:
+        # transient wire fault between the fence read and the target's
+        # atomic flip: the part retries and republishes idempotently
+        clauses.append(
+            f"{site}=after:{rng.randrange(0, 2)},times:1,"
+            f"raise:ChaosInjectedError")
     return ";".join(clauses)
 
 
-def _read_ipc_dir(path: str) -> list:
-    """Published batches of an arrow_ipc directory target (the
-    `.staging` dotdir is invisible by construction)."""
-    from transferia_tpu.interchange import ipc
-
-    batches = []
-    for fname in sorted(os.listdir(path)):
-        full = os.path.join(path, fname)
-        if not fname.endswith(".arrows") or not os.path.isfile(full):
-            continue
-        with open(full, "rb") as fh:
-            batches.extend(list(ipc.iter_stream(fh)))
-    return batches
-
-
-def _exactly_once_dst(backend: str, sink_id: str, outdir: Optional[str]):
-    if backend == "memory":
-        from transferia_tpu.providers.memory import MemoryTargetParams
-
-        return MemoryTargetParams(sink_id=sink_id)
-    from transferia_tpu.providers.arrow_ipc import ArrowIpcTargetParams
-
-    return ArrowIpcTargetParams(path=outdir + os.sep)
-
-
 def _exactly_once_reference(rows: int, backend: str) -> DeliveryReference:
-    import shutil
-    import tempfile
+    from transferia_tpu.chaos import wire_backends
 
-    if backend == "memory":
-        return _snapshot_reference(rows)
-    outdir = tempfile.mkdtemp(prefix="chaos-eo-ref-")
+    harness = wire_backends.make_backend(backend, "chaos-eo-ref")
     try:
-        t = _snapshot_transfer(
-            rows, "", dst=_exactly_once_dst(backend, "", outdir))
+        t = _snapshot_transfer(rows, "chaos-eo-ref", dst=harness.dst())
         _run_snapshot_once(t, MemoryCoordinator())
-        return DeliveryReference.from_batches(_read_ipc_dir(outdir))
+        return DeliveryReference.from_batches(harness.observed())
     finally:
-        shutil.rmtree(outdir, ignore_errors=True)
+        harness.close()
 
 
 def run_exactly_once_trial(trial: int, seed: int, rows: int,
                            reference: DeliveryReference,
                            backend: str = "memory",
                            spec: Optional[str] = None) -> TrialResult:
-    import shutil
-    import tempfile
-
     from transferia_tpu.abstract.errors import (
         StaleEpochPublishError,
         is_worker_kill,
     )
     from transferia_tpu.abstract.table import OperationTablePart
+    from transferia_tpu.chaos import wire_backends
     from transferia_tpu.chaos.invariants import fencing_violations
     from transferia_tpu.factories import new_storage
     from transferia_tpu.middlewares.sync import SINK_PUSH_ATTEMPTS
-    from transferia_tpu.providers.memory import get_store
     from transferia_tpu.stats.registry import Metrics
     from transferia_tpu.tasks.snapshot import PART_RETRIES, SnapshotLoader
     from transferia_tpu.tasks.table_splitter import split_tables
 
     sink_id = f"chaos-eo-{backend}-trial"
-    outdir = None
-    store = None
-    if backend == "memory":
-        store = get_store(sink_id)
-        store.clear()
-    else:
-        outdir = tempfile.mkdtemp(prefix="chaos-eo-ipc-")
+    harness = wire_backends.make_backend(backend, sink_id)
     spec = spec if spec is not None else exactly_once_schedule(
         trial, seed, backend)
     tracker = MonotonicityTracker()
@@ -839,9 +826,7 @@ def run_exactly_once_trial(trial: int, seed: int, rows: int,
     metrics = Metrics()
 
     def mk_transfer(job: int):
-        t = _snapshot_transfer(
-            rows, sink_id,
-            dst=_exactly_once_dst(backend, sink_id, outdir))
+        t = _snapshot_transfer(rows, sink_id, dst=harness.dst())
         t.id = "chaos-eo"
         t.runtime.current_job = job
         t.runtime.sharding.job_count = 3
@@ -952,9 +937,8 @@ def run_exactly_once_trial(trial: int, seed: int, rows: int,
                 # 4c. the sink's own fence: a direct stale-epoch
                 # publish must raise, never replace the survivor's data
                 try:
-                    _zombie_sink_publish(backend, store, outdir,
-                                         zombie.key(),
-                                         zombie.assignment_epoch)
+                    harness.zombie_publish(zombie.key(),
+                                           zombie.assignment_epoch)
                     violations.append(Violation(
                         "sink-fencing",
                         f"stale-epoch sink publish of {zombie.key()} "
@@ -989,8 +973,7 @@ def run_exactly_once_trial(trial: int, seed: int, rows: int,
                     f"{p.assignment_epoch} but its publish was granted "
                     f"at {p.commit_epoch}"))
 
-        observed = store.batches if backend == "memory" \
-            else _read_ipc_dir(outdir)
+        observed = harness.observed()
         bound = (kills + 1) * PART_RETRIES * SINK_PUSH_ATTEMPTS
         verdict = audit_delivery(reference, observed, bound, tracker,
                                  exactly_once=True)
@@ -1006,37 +989,7 @@ def run_exactly_once_trial(trial: int, seed: int, rows: int,
             dedup_dropped=int(metrics.value(
                 "commit_dedup_rows_dropped")))
     finally:
-        if store is not None:
-            store.clear()
-        if outdir is not None:
-            shutil.rmtree(outdir, ignore_errors=True)
-
-
-def _zombie_sink_publish(backend: str, store, outdir: Optional[str],
-                         key: str, epoch: int) -> None:
-    """Attempt a sink-layer publish of `key` at a stale `epoch` — the
-    sink's own fence must raise StaleEpochPublishError (the last line
-    of defense when a zombie got past the coordinator's grant)."""
-    if backend == "memory":
-        store.begin_stage(key, epoch)
-        try:
-            store.publish_stage(key, epoch)
-        finally:
-            store.abort_stage(key, epoch)
-        return
-    from transferia_tpu.providers.arrow_ipc import (
-        ArrowIpcSinker,
-        ArrowIpcTargetParams,
-    )
-    from transferia_tpu.providers.staging import DirectoryPartStage
-
-    stage = DirectoryPartStage(
-        outdir, key, epoch,
-        lambda d: ArrowIpcSinker(ArrowIpcTargetParams(path=d + os.sep)))
-    try:
-        stage.publish()
-    finally:
-        stage.abort()
+        harness.close()
 
 
 # -- scheduler_kill mode -----------------------------------------------------
@@ -1696,13 +1649,16 @@ def run_trials(trials: int = 5, seed: int = 7, mode: str = "both",
                 logger.info("chaos fleet_distributed trial %d: %s", t,
                             r.verdict.summary().splitlines()[0])
         if "exactly_once" in modes:
-            from transferia_tpu.interchange._pyarrow import have_pyarrow
+            from transferia_tpu.chaos import wire_backends
 
-            backends = [b for b in EXACTLY_ONCE_BACKENDS
-                        if b == "memory" or have_pyarrow()]
-            if len(backends) < len(EXACTLY_ONCE_BACKENDS):
-                logger.warning("chaos: exactly_once running on %s only "
-                               "(no pyarrow)", backends)
+            backends = []
+            for b in EXACTLY_ONCE_BACKENDS:
+                ok, reason = wire_backends.backend_available(b)
+                if ok:
+                    backends.append(b)
+                else:
+                    logger.warning("chaos: exactly_once skipping %s "
+                                   "(%s)", b, reason)
             for backend in backends:
                 ref = _exactly_once_reference(rows, backend)
                 for t in range(trials):

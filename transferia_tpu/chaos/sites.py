@@ -78,6 +78,32 @@ SITES: dict[str, tuple[str, str]] = {
         "and visibility — the target must be left either fully "
         "unpublished or fully replaced (never torn), and the retried "
         "part must republish idempotently under the same epoch"),
+    "sink.pg.publish": (
+        "providers/postgres/provider.py",
+        "postgres staged publish failing between the fence read and "
+        "the single-transaction INSERT...SELECT flip (server gone at "
+        "the worst moment) — the target must stay fully unpublished "
+        "and the retried part must republish idempotently"),
+    "sink.ch.publish": (
+        "providers/clickhouse/provider.py",
+        "clickhouse staged publish failing before the REPLACE "
+        "PARTITION flip — the final table's partition must be either "
+        "the old publish or the new one, never a mix"),
+    "sink.ydb.publish": (
+        "providers/ydb/provider.py",
+        "ydb staged publish failing before the interactive "
+        "transaction (delete + upsert + commit-marker row) commits — "
+        "nothing of the part may be visible, marker unmoved"),
+    "sink.kafka.publish": (
+        "providers/kafka/provider.py",
+        "kafka transactional publish failing before the epoch-keyed "
+        "transactional produce commits — no message of the part may "
+        "land, and the republish supersedes cleanly"),
+    "sink.s3.publish": (
+        "providers/s3.py",
+        "s3 staged publish failing before the batched copy-to-final "
+        "behind the conditional marker write — staged objects stay "
+        "invisible under .staging/ and the retry re-copies"),
     "coordinator.commit_part": (
         "coordinator/memory.py",
         "the fenced commit_part decision RPC failing (coordinator "

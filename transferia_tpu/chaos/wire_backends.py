@@ -1,0 +1,425 @@
+"""Exactly-once chaos backends: one harness per staged-commit sink.
+
+`run_exactly_once_trial` (chaos/runner.py) drives the same gauntlet —
+torn staging writes, mid-part and mid-publish kills, zombie replay
+after a real lease steal — against every staged-commit capable sink.
+Each backend differs only in plumbing: how its (fake) target comes up,
+what the transfer's dst params look like, how the delivered rows read
+back, and how a direct sink-layer stale-epoch publish is attempted.
+This module packages those four differences behind `EoBackend` so the
+trial body is backend-agnostic.
+
+The five WIRE backends (postgres, clickhouse, ydb, kafka, s3 objects)
+run against the in-repo protocol fakes under `tests/recipes/` — real
+sockets, the real provider clients, only the server side fake.  The
+fakes live in the test tree (imported lazily as a namespace package
+from the repo root); when they are not importable (an installed wheel
+without the repo checkout) or a fake's own dependency is missing (the
+YDB fake needs the protobuf runtime), the backend reports unavailable
+and the chaos matrix skips it with a warning — same contract as the
+pyarrow gating for arrow_ipc.
+
+Delivered rows are read STRAIGHT from each fake's storage (not through
+a destination-storage scan) and canonicalized by `rows_to_batch`: the
+reference run and the trial run read through the same function, so the
+delivery audit compares like with like.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import shutil
+import tempfile
+from typing import Optional
+
+logger = logging.getLogger(__name__)
+
+_SINK_TABLE = ("sample", "events")
+
+
+def rows_to_batch(rows: list[dict], table=_SINK_TABLE):
+    """Canonicalize delivered row dicts into one all-UTF8 ColumnBatch
+    (sorted column order, values stringified, staging-plane meta
+    columns dropped) — row identity for the delivery audit."""
+    from transferia_tpu.abstract.schema import (
+        CanonicalType,
+        ColSchema,
+        TableID,
+        TableSchema,
+    )
+    from transferia_tpu.columnar.batch import ColumnBatch
+    from transferia_tpu.providers.staging import is_meta_name
+
+    names = sorted({k for r in rows for k in r if not is_meta_name(k)})
+    schema = TableSchema([
+        ColSchema(name=n, data_type=CanonicalType.UTF8) for n in names
+    ])
+    data = {}
+    for n in names:
+        col = []
+        for r in rows:
+            v = r.get(n)
+            if isinstance(v, bytes):
+                v = v.decode("utf-8", "replace")
+            col.append(None if v is None else str(v))
+        data[n] = col
+    return ColumnBatch.from_pydict(TableID(*table), schema, data)
+
+
+class EoBackend:
+    """One exactly-once chaos backend: target lifecycle + the four
+    backend-specific hooks the trial needs."""
+
+    name = ""
+
+    @classmethod
+    def available(cls) -> tuple[bool, str]:
+        """(usable, reason-when-not) — checked before trials start."""
+        return True, ""
+
+    def dst(self):
+        """Target params for the trial's transfer."""
+        raise NotImplementedError
+
+    def observed(self) -> list:
+        """Delivered batches for the delivery audit."""
+        raise NotImplementedError
+
+    def zombie_publish(self, key: str, epoch: int) -> None:
+        """Attempt a direct sink-layer publish of `key` at a stale
+        `epoch`; the sink's own persisted fence must raise
+        StaleEpochPublishError."""
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+def _wire_fake(module: str, symbol: str):
+    """Import one tests/recipes fake lazily; None when unavailable."""
+    try:
+        mod = __import__(f"tests.recipes.{module}", fromlist=[symbol])
+        return getattr(mod, symbol)
+    except Exception as e:  # import error, missing dep, protoc...
+        logger.debug("wire fake %s unavailable: %s", module, e)
+        return None
+
+
+def _zombie_via_sinker(make_sinker, key: str, epoch: int) -> None:
+    """Shared zombie shape: open a stage at the stale epoch and try to
+    publish it — the persisted target-side fence must reject."""
+    sinker = make_sinker()
+    sinker.begin_part(key, epoch)
+    try:
+        sinker.publish_part(key, epoch)
+    finally:
+        try:
+            sinker.abort_part(key)
+        finally:
+            sinker.close()
+
+
+class MemoryBackend(EoBackend):
+    name = "memory"
+
+    def __init__(self, sink_id: str):
+        from transferia_tpu.providers.memory import get_store
+
+        self.sink_id = sink_id
+        self.store = get_store(sink_id)
+        self.store.clear()
+
+    def dst(self):
+        from transferia_tpu.providers.memory import MemoryTargetParams
+
+        return MemoryTargetParams(sink_id=self.sink_id)
+
+    def observed(self) -> list:
+        return self.store.batches
+
+    def zombie_publish(self, key: str, epoch: int) -> None:
+        self.store.begin_stage(key, epoch)
+        try:
+            self.store.publish_stage(key, epoch)
+        finally:
+            self.store.abort_stage(key, epoch)
+
+    def close(self) -> None:
+        self.store.clear()
+
+
+class ArrowIpcBackend(EoBackend):
+    name = "arrow_ipc"
+
+    @classmethod
+    def available(cls) -> tuple[bool, str]:
+        from transferia_tpu.interchange._pyarrow import have_pyarrow
+
+        return (True, "") if have_pyarrow() else (False, "no pyarrow")
+
+    def __init__(self, sink_id: str):
+        self.outdir = tempfile.mkdtemp(prefix=f"chaos-eo-{sink_id}-")
+
+    def dst(self):
+        from transferia_tpu.providers.arrow_ipc import ArrowIpcTargetParams
+
+        return ArrowIpcTargetParams(path=self.outdir + os.sep)
+
+    def observed(self) -> list:
+        from transferia_tpu.interchange import ipc
+
+        batches = []
+        for fname in sorted(os.listdir(self.outdir)):
+            full = os.path.join(self.outdir, fname)
+            if not fname.endswith(".arrows") or not os.path.isfile(full):
+                continue
+            with open(full, "rb") as fh:
+                batches.extend(list(ipc.iter_stream(fh)))
+        return batches
+
+    def zombie_publish(self, key: str, epoch: int) -> None:
+        from transferia_tpu.providers.arrow_ipc import (
+            ArrowIpcSinker,
+            ArrowIpcTargetParams,
+        )
+        from transferia_tpu.providers.staging import DirectoryPartStage
+
+        stage = DirectoryPartStage(
+            self.outdir, key, epoch,
+            lambda d: ArrowIpcSinker(
+                ArrowIpcTargetParams(path=d + os.sep)))
+        try:
+            stage.publish()
+        finally:
+            stage.abort()
+
+    def close(self) -> None:
+        shutil.rmtree(self.outdir, ignore_errors=True)
+
+
+class PostgresBackend(EoBackend):
+    name = "postgres"
+
+    @classmethod
+    def available(cls) -> tuple[bool, str]:
+        ok = _wire_fake("fake_postgres", "FakePG") is not None
+        return (True, "") if ok else (False, "tests.recipes fakes "
+                                      "not importable")
+
+    def __init__(self, sink_id: str):
+        fake_cls = _wire_fake("fake_postgres", "FakePG")
+        self.fake = fake_cls().start()
+
+    def dst(self):
+        from transferia_tpu.providers.postgres.provider import (
+            PGTargetParams,
+        )
+
+        return PGTargetParams(host="127.0.0.1", port=self.fake.port)
+
+    def observed(self) -> list:
+        with self.fake.lock:
+            rows = list(self.fake.tables.get(_SINK_TABLE,
+                                             _EMPTY).rows)
+        return [rows_to_batch(rows)] if rows else []
+
+    def zombie_publish(self, key: str, epoch: int) -> None:
+        from transferia_tpu.providers.postgres.provider import PGSinker
+
+        _zombie_via_sinker(lambda: PGSinker(self.dst()), key, epoch)
+
+    def close(self) -> None:
+        self.fake.stop()
+
+
+class _Empty:
+    rows: list = []
+
+
+_EMPTY = _Empty()
+
+
+class ClickHouseBackend(EoBackend):
+    name = "clickhouse"
+
+    @classmethod
+    def available(cls) -> tuple[bool, str]:
+        ok = _wire_fake("fake_clickhouse", "FakeCH") is not None
+        return (True, "") if ok else (False, "tests.recipes fakes "
+                                      "not importable")
+
+    def __init__(self, sink_id: str):
+        fake_cls = _wire_fake("fake_clickhouse", "FakeCH")
+        self.fake = fake_cls().start()
+
+    def dst(self):
+        from transferia_tpu.providers.clickhouse.provider import (
+            CHTargetParams,
+        )
+
+        # no bufferer: its timer-based flush would make batch
+        # boundaries (and so the failpoint hit sequence) wall-clock
+        # dependent, breaking byte-identical seed replay
+        return CHTargetParams(host="127.0.0.1", port=self.fake.port,
+                              bufferer=None)
+
+    def observed(self) -> list:
+        rows = self.fake.rows("__".join(_SINK_TABLE))
+        return [rows_to_batch(rows)] if rows else []
+
+    def zombie_publish(self, key: str, epoch: int) -> None:
+        from transferia_tpu.providers.clickhouse.provider import CHSinker
+
+        _zombie_via_sinker(lambda: CHSinker(self.dst()), key, epoch)
+
+    def close(self) -> None:
+        self.fake.stop()
+
+
+class YdbBackend(EoBackend):
+    name = "ydb"
+
+    @classmethod
+    def available(cls) -> tuple[bool, str]:
+        fake_cls = _wire_fake("fake_ydb", "FakeYDB")
+        if fake_cls is None:
+            return False, "tests.recipes fakes not importable"
+        try:
+            from tests.recipes.ydb_pb import load_pb
+
+            if load_pb() is None:
+                return False, "no protoc and no protobuf runtime"
+        except Exception as e:
+            return False, f"ydb pb unavailable: {e}"
+        return True, ""
+
+    def __init__(self, sink_id: str):
+        fake_cls = _wire_fake("fake_ydb", "FakeYDB")
+        self.fake = fake_cls(database="/local").start()
+
+    def dst(self):
+        from transferia_tpu.providers.ydb.provider import YdbTargetParams
+
+        return YdbTargetParams(endpoint=self.fake.endpoint,
+                               database="/local")
+
+    def observed(self) -> list:
+        with self.fake.lock:
+            t = self.fake.tables.get("/".join(_SINK_TABLE))
+            rows = list(t.rows.values()) if t is not None else []
+        return [rows_to_batch(rows)] if rows else []
+
+    def zombie_publish(self, key: str, epoch: int) -> None:
+        from transferia_tpu.providers.ydb.provider import YdbSinker
+
+        _zombie_via_sinker(lambda: YdbSinker(self.dst()), key, epoch)
+
+    def close(self) -> None:
+        self.fake.stop()
+
+
+class KafkaBackend(EoBackend):
+    name = "kafka"
+
+    @classmethod
+    def available(cls) -> tuple[bool, str]:
+        ok = _wire_fake("fake_kafka", "FakeKafka") is not None
+        return (True, "") if ok else (False, "tests.recipes fakes "
+                                      "not importable")
+
+    def __init__(self, sink_id: str):
+        fake_cls = _wire_fake("fake_kafka", "FakeKafka")
+        self.fake = fake_cls(n_partitions=2).start()
+        self.topic = ".".join(_SINK_TABLE)
+
+    def dst(self):
+        from transferia_tpu.providers.kafka.provider import (
+            KafkaTargetParams,
+        )
+
+        return KafkaTargetParams(
+            brokers=[f"127.0.0.1:{self.fake.port}"],
+            topic=self.topic, serializer="json")
+
+    def observed(self) -> list:
+        rows = []
+        with self.fake.lock:
+            logs = list(self.fake.topics.get(self.topic, []))
+        for log in logs:
+            for rec in log:
+                if rec.value:
+                    rows.append(json.loads(rec.value))
+        return [rows_to_batch(rows)] if rows else []
+
+    def zombie_publish(self, key: str, epoch: int) -> None:
+        from transferia_tpu.providers.kafka.provider import KafkaSinker
+
+        _zombie_via_sinker(lambda: KafkaSinker(self.dst()), key, epoch)
+
+    def close(self) -> None:
+        self.fake.stop()
+
+
+class S3Backend(EoBackend):
+    name = "s3"
+
+    @classmethod
+    def available(cls) -> tuple[bool, str]:
+        ok = _wire_fake("fake_s3", "FakeS3") is not None
+        return (True, "") if ok else (False, "tests.recipes fakes "
+                                      "not importable")
+
+    def __init__(self, sink_id: str):
+        fake_cls = _wire_fake("fake_s3", "FakeS3")
+        self.fake = fake_cls(conditional_writes=True,
+                             page_size=64).start()
+
+    def dst(self):
+        from transferia_tpu.providers.s3 import S3TargetParams
+
+        return S3TargetParams(
+            url="s3://chaos-eo/out", format="jsonl",
+            endpoint_url=self.fake.endpoint,
+            access_key="test-ak", secret_key="test-sk")
+
+    def observed(self) -> list:
+        rows = []
+        with self.fake.lock:
+            objects = {
+                k: body for k, (body, _etag) in self.fake.objects.items()
+                if k.startswith("out/") and "/.staging/" not in k
+            }
+        for _k, body in sorted(objects.items()):
+            for line in body.splitlines():
+                if line.strip():
+                    rows.append(json.loads(line))
+        return [rows_to_batch(rows)] if rows else []
+
+    def zombie_publish(self, key: str, epoch: int) -> None:
+        from transferia_tpu.providers.s3 import S3Sinker
+
+        _zombie_via_sinker(lambda: S3Sinker(self.dst()), key, epoch)
+
+    def close(self) -> None:
+        self.fake.stop()
+
+
+_BACKENDS = {
+    cls.name: cls
+    for cls in (MemoryBackend, ArrowIpcBackend, PostgresBackend,
+                ClickHouseBackend, YdbBackend, KafkaBackend, S3Backend)
+}
+
+
+def backend_names() -> tuple[str, ...]:
+    return tuple(_BACKENDS)
+
+
+def backend_available(name: str) -> tuple[bool, str]:
+    return _BACKENDS[name].available()
+
+
+def make_backend(name: str, sink_id: str) -> EoBackend:
+    return _BACKENDS[name](sink_id)

@@ -407,6 +407,27 @@ class FileStoreCoordinator(Coordinator):
                 return FleetTicket.from_json(d)
             return None
 
+    def gc_tickets(self, queue: str,
+                   retention_seconds: Optional[float] = None) -> int:
+        from transferia_tpu.abstract.ticket import ticket_expired
+        from transferia_tpu.coordinator.interface import (
+            ticket_retention_seconds,
+        )
+
+        retention = ticket_retention_seconds() \
+            if retention_seconds is None else retention_seconds
+        p = self._queue_path(queue)
+        now = time.time()
+        with self._locked(p):
+            doc = self._queue_doc(p)
+            keep = [d for d in doc["tickets"]
+                    if not ticket_expired(d, retention, now)]
+            pruned = len(doc["tickets"]) - len(keep)
+            if pruned:
+                doc["tickets"] = keep
+                self._write_json(p, doc)
+        return pruned
+
     def _write_health(self, path: str, worker_index: int,
                       payload) -> None:
         """Latest-per-worker + bounded history (never an unbounded

@@ -34,6 +34,17 @@ def default_lease_seconds(environ=os.environ) -> float:
     return env_float(environ, ENV_LEASE_SECONDS, DEFAULT_LEASE_SECONDS)
 
 
+# terminal fleet tickets older than this are GC-prunable (gc_tickets);
+# one day keeps a post-mortem window while multi-day fleets stay O(active)
+DEFAULT_TICKET_RETENTION = 86_400.0
+ENV_TICKET_RETENTION = "TRANSFERIA_TPU_TICKET_RETENTION"
+
+
+def ticket_retention_seconds(environ=os.environ) -> float:
+    return env_float(environ, ENV_TICKET_RETENTION,
+                     DEFAULT_TICKET_RETENTION)
+
+
 def deadline_expired(expires_at: float,
                      now: Optional[float] = None) -> bool:
     """The single lease-expiry rule (0 = no lease, never expires).
@@ -293,6 +304,18 @@ class Coordinator(abc.ABC):
         parts).  Returns the revoked ticket, or None when it was not
         claimed (nothing to preempt)."""
         raise NotImplementedError
+
+    def gc_tickets(self, queue: str,
+                   retention_seconds: Optional[float] = None) -> int:
+        """Retention GC: prune TERMINAL (done/failed) tickets whose
+        terminal transition is older than `retention_seconds` (default
+        TRANSFERIA_TPU_TICKET_RETENTION).  Multi-day fleets enqueue
+        forever; without pruning every queue scan — and on the s3
+        backend every LIST — grows with total history instead of
+        staying O(active).  Queued/claimed tickets are never touched;
+        the decision logs (AuditingCoordinator) are unaffected.
+        Returns tickets pruned."""
+        return 0
 
     # -- worker health (operation.go:30-36, replication.go:72-74) -----------
     def operation_health(self, operation_id: str, worker_index: int,

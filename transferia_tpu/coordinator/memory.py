@@ -401,6 +401,24 @@ class MemoryCoordinator(Coordinator):
                 return FleetTicket.from_json(d)
             return None
 
+    def gc_tickets(self, queue: str,
+                   retention_seconds: Optional[float] = None) -> int:
+        from transferia_tpu.abstract.ticket import ticket_expired
+        from transferia_tpu.coordinator.interface import (
+            ticket_retention_seconds,
+        )
+
+        retention = ticket_retention_seconds() \
+            if retention_seconds is None else retention_seconds
+        q = self._queue(queue)
+        now = time.time()
+        with q.lock:
+            keep = [d for d in q.tickets
+                    if not ticket_expired(d, retention, now)]
+            pruned = len(q.tickets) - len(keep)
+            q.tickets = keep
+        return pruned
+
     def operation_health(self, operation_id: str, worker_index: int,
                          payload: Optional[dict] = None) -> None:
         with self._health_lock:

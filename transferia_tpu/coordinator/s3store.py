@@ -698,6 +698,34 @@ class S3Coordinator(Coordinator):
         raise TimeoutError(
             f"revoke_ticket CAS for {ticket_id!r} did not converge")
 
+    def gc_tickets(self, queue: str,
+                   retention_seconds: Optional[float] = None) -> int:
+        from transferia_tpu.abstract.ticket import ticket_expired
+        from transferia_tpu.coordinator.interface import (
+            ticket_retention_seconds,
+        )
+
+        retention = ticket_retention_seconds() \
+            if retention_seconds is None else retention_seconds
+        now = time.time()
+        pruned = 0
+        # terminal bodies come from the cache (no GETs); deleting both
+        # the seq object and the id guard keeps enqueue idempotency
+        # honest for the retained window only — a pruned id could in
+        # principle re-enqueue, which is why retention defaults to a
+        # day, far past any admission retry
+        for key, d, _etag in self._list_ticket_objs(queue):
+            if not ticket_expired(d, retention, now):
+                continue
+            self.client.delete(key)
+            tid = d.get("ticket_id", "")
+            if tid:
+                self.client.delete(self._ticket_id_guard(queue, tid))
+                self._ticket_keys.pop((queue, tid), None)
+            self._terminal_tickets.pop(key, None)
+            pruned += 1
+        return pruned
+
     # -- health -------------------------------------------------------------
     def operation_health(self, operation_id: str, worker_index: int,
                          payload: Optional[dict] = None) -> None:

@@ -47,12 +47,20 @@ class FleetAutoscaler:
                  supervisor: WorkerSupervisor,
                  min_workers: int = 1, max_workers: int = 8,
                  scale_up_after: int = 2, scale_down_after: int = 5,
-                 interval: float = 1.0, name: str = "fleet-scaler"):
+                 interval: float = 1.0, name: str = "fleet-scaler",
+                 leader: "Optional[object]" = None):
         if min_workers < 0 or max_workers < max(1, min_workers):
             raise ValueError("need 0 <= min_workers <= max_workers "
                              "and max_workers >= 1")
         self.scheduler = scheduler
         self.supervisor = supervisor
+        # scheduler-replica leader election (fleet/leader.py): with a
+        # LeaderLease only ONE replica runs the preemption/autoscale
+        # tick per period; standby replicas keep reaping their own
+        # local workers (a crashed subprocess is this replica's to
+        # collect regardless of who leads) and take over automatically
+        # when the leader's lease expires
+        self.leader = leader
         # close the preemption loop: without a capacity probe the
         # scheduler skips its free-lane check and would revoke running
         # work even while an idle worker could absorb the arrival
@@ -83,9 +91,19 @@ class FleetAutoscaler:
 
     def step(self) -> dict:
         """One synchronous control step (reap → tick → hysteresis →
-        scale).  Returns the decision record `snapshot()` also shows."""
+        scale).  Returns the decision record `snapshot()` also shows.
+        With a leader lease configured, a standby replica only reaps —
+        it neither ticks the scheduler nor scales."""
         self._steps += 1
         self.supervisor.reap()
+        if self.leader is not None and not self.leader.ensure():
+            self._up_streak = self._down_streak = 0
+            self._last_action = "standby"
+            self.scheduler.stats.live_workers.set(
+                self.supervisor.live_workers())
+            return {"desired": None,
+                    "live": self.supervisor.live_workers(),
+                    "action": "standby"}
         self.scheduler.tick()
         desired = self.target()
         live = self.supervisor.live_workers()
@@ -155,14 +173,24 @@ class FleetAutoscaler:
         if self._thread is not None:
             self._thread.join(timeout=10.0)
             self._thread = None
+        if self.leader is not None:
+            # graceful step-down: a standby replica takes over on its
+            # next tick instead of waiting out the lease TTL
+            self.leader.release()
         from transferia_tpu import fleet as fleet_mod
 
         fleet_mod.unregister_autoscaler(self)
 
     def snapshot(self) -> dict:
         """/debug/fleet payload: the scaling policy's live state."""
+        if self.leader is not None:
+            leader = {"is_leader": self.leader.is_leader(),
+                      "replica_id": self.leader.replica_id}
+        else:
+            leader = None
         return {
             "name": self.name,
+            "leader": leader,
             "min_workers": self.min_workers,
             "max_workers": self.max_workers,
             "desired": self.target(),

@@ -178,6 +178,11 @@ class DistributedFleetScheduler:
         self.admission_log: list[str] = []
         self.preempt_log: list[tuple] = []
         self.shed_log: list[tuple] = []
+        # terminal-ticket retention GC cadence (tick may run at 1s;
+        # pruning is a day-scale policy and on s3 each prune is real
+        # DELETE traffic)
+        self.gc_interval = 60.0
+        self._last_gc = 0.0
 
     # -- admission -----------------------------------------------------------
     def submit(self, ticket: FleetTicket) -> str:
@@ -313,10 +318,25 @@ class DistributedFleetScheduler:
         gauge refresh — each list is LIST + N GETs on the s3 backend,
         so a 1s tick must not scan the queue four times.  A revoke
         flips one ticket claimed→queued after the snapshot; pending
-        (and so desired_workers) is unchanged by that."""
+        (and so desired_workers) is unchanged by that.  Terminal-ticket
+        retention GC rides the same loop at its own (60s) cadence so
+        multi-day fleets keep the queue — and every s3 poll — O(active)."""
         tickets = self.cp.list_tickets(self.queue)
         self.preempt_if_needed(tickets)
         self._refresh_gauges(tickets)
+        now = time.time()
+        if now - self._last_gc >= self.gc_interval:
+            self._last_gc = now
+            try:
+                pruned = self.cp.gc_tickets(self.queue)
+            except Exception as e:
+                logger.warning("ticket GC failed (retrying next "
+                               "cycle): %s", e)
+                return
+            if pruned:
+                self.stats.gc_pruned.inc(pruned)
+                logger.info("ticket GC pruned %d terminal ticket(s) "
+                            "from %r", pruned, self.queue)
 
     def counts(self, tickets: Optional[list] = None) -> dict[str, int]:
         out = {"queued": 0, "claimed": 0, "done": 0, "failed": 0}

@@ -417,19 +417,228 @@ def fingerprint_host(cols: Sequence[_PreppedColumn],
     )
 
 
-def batch_row_keys(batch: ColumnBatch) -> np.ndarray:
+def _device_keys_requested(environ=None) -> bool:
+    """TRANSFERIA_TPU_DEDUP_KEYS=device routes dedup-window keys
+    through the device program (profitable exactly when the part's
+    batches already ride the device — fused chains, device
+    fingerprinting — so codes/blocks are hot and the link cost is
+    amortized by the reduction plane)."""
+    import os as _os
+
+    env = _os.environ if environ is None else environ
+    return str(env.get("TRANSFERIA_TPU_DEDUP_KEYS", "")).lower() == \
+        "device"
+
+
+def _batch_device_resident(batch: ColumnBatch) -> bool:
+    """True when the batch's column buffers are already device arrays
+    (a jax.Array survived a device-side transform): keys then compute
+    where the data lives instead of gathering host-side."""
+    try:
+        cols = batch.columns.values()
+    except AttributeError:
+        return False
+    for c in cols:
+        data = getattr(c, "_data", None)
+        # jax arrays report module "jaxlib.xla_extension" (ArrayImpl),
+        # tracer types "jax...." — accept either root package
+        if data is not None and \
+                type(data).__module__.split(".")[0] in ("jax", "jaxlib"):
+            return True
+    return False
+
+
+def batch_row_keys(batch: ColumnBatch, backend: str = "auto"
+                   ) -> np.ndarray:
     """64-bit content key per row: `(r1 << 32) | r2` of the finalized
     lanes, under the same canonicalization as the table fingerprint.
     Dict columns key code-natively (pool-accumulator gather, no flat
     materialization).  Shared by the chaos delivery auditor (row
     delivery multiplicities) and the staged-commit dedup window
     (providers/staging.py: replayed torn-write prefixes are dropped
-    before publish by these keys)."""
+    before publish by these keys).
+
+    `backend="device"` (or `auto` with TRANSFERIA_TPU_DEDUP_KEYS=device
+    / a device-resident batch) computes the lanes on device through the
+    fingerprint plane's kernel family — byte-identical to the host
+    path (pinned by tests/unit/test_dict_reduction.py)."""
     if batch.n_rows == 0:
         return np.empty(0, dtype=np.uint64)
+    if backend == "auto" and (_device_keys_requested()
+                              or _batch_device_resident(batch)):
+        backend = "device"
+    if backend == "device":
+        try:
+            return batch_row_keys_device(batch)
+        except ImportError:
+            pass  # no jax: the host path is always correct
     cols, n = prep_batch(batch)
     r1, r2 = row_lanes(cols, n)
     return (r1.astype(np.uint64) << np.uint64(32)) | r2.astype(np.uint64)
+
+
+# per-signature jitted row-keys programs (module-global like the
+# fingerprint cache: identical schemas re-trace once per process)
+_keys_jit_cache: dict = {}
+
+
+def batch_row_keys_device(batch: ColumnBatch) -> np.ndarray:
+    """Device twin of the host key path: the SAME traced lane body as
+    DeviceFingerprintProgram (`_device_row_lanes` — one shared
+    implementation, so the two entry points cannot drift) but
+    returning the finalized per-row lanes instead of their reduction —
+    one launch, two u32 vectors D2H, keys assembled host-side
+    (padding rows trimmed)."""
+    import jax
+
+    cols, n_rows = prep_batch(batch)
+    sig = tuple(
+        (c.kind, c.width if c.kind == "var" else 0) for c in cols)
+
+    fn = _keys_jit_cache.get(sig)
+    if fn is None:
+        sig_kinds = [k for k, _ in sig]
+
+        def program(fixed_lo, fixed_hi, var_blocks, dict_codes,
+                    dict_accs1, dict_accs2, validities,
+                    seeds1, seeds2, nulls1, nulls2, powers1, powers2,
+                    n):
+            return _device_row_lanes(
+                sig_kinds, fixed_lo, fixed_hi, var_blocks, dict_codes,
+                dict_accs1, dict_accs2, validities, seeds1, seeds2,
+                nulls1, nulls2, powers1, powers2, n)
+
+        fn = jax.jit(program, static_argnames=("n",))
+        _keys_jit_cache[sig] = fn
+
+    args, bucket = _pack_device_lane_args(cols, n_rows)
+    r1, r2 = fn(*args, bucket)
+    r1 = np.asarray(r1)[:n_rows]
+    r2 = np.asarray(r2)[:n_rows]
+    return (r1.astype(np.uint64) << np.uint64(32)) | r2.astype(np.uint64)
+
+
+def _device_row_lanes(sig_kinds, fixed_lo, fixed_hi, var_blocks,
+                      dict_codes, dict_accs1, dict_accs2, validities,
+                      seeds1, seeds2, nulls1, nulls2, powers1, powers2,
+                      n):
+    """Traced per-row lane body shared by the fingerprint reduction
+    program and the dedup-key program — ONE implementation of the
+    device lane math (mix chains, var-width polynomial blocks, dict
+    accumulator gathers, null constants), so the two entry points
+    cannot drift apart.  Returns the finalized (r1, r2) u32 vectors."""
+    import jax.numpy as jnp
+
+    def mix(x):
+        x = x ^ (x >> jnp.uint32(16))
+        x = x * jnp.uint32(0x7FEB352D)
+        x = x ^ (x >> jnp.uint32(15))
+        x = x * jnp.uint32(0x846CA68B)
+        return x ^ (x >> jnp.uint32(16))
+
+    r1 = jnp.zeros(n, dtype=jnp.uint32)
+    r2 = jnp.zeros(n, dtype=jnp.uint32)
+    fi = vi = di = 0
+    for idx, kind in enumerate(sig_kinds):
+        for lane in (0, 1):
+            seed = (seeds1 if lane == 0 else seeds2)[idx]
+            null = (nulls1 if lane == 0 else nulls2)[idx]
+            if kind == "fixed":
+                lo, hi = fixed_lo[fi], fixed_hi[fi]
+                h = mix(lo ^ seed)
+                h = mix(h + mix(hi ^ (~seed)))
+            elif kind == "dict":
+                # codes + per-pool-entry accumulators crossed the
+                # link (4 + 4·k/n bytes/row, not the padded block
+                # matrix); the reduction consumes codes directly via
+                # an HBM-speed gather
+                from transferia_tpu.ops.decode import (
+                    gather_pool_accumulators,
+                )
+
+                acc = (dict_accs1 if lane == 0 else dict_accs2)[di]
+                h = mix(gather_pool_accumulators(
+                    acc, dict_codes[di]) ^ seed)
+            else:
+                pw = (powers1 if lane == 0 else powers2)[vi]
+                b = var_blocks[vi].astype(jnp.uint32)
+                h = mix((b * pw[None, :]).sum(
+                    axis=1, dtype=jnp.uint32) ^ seed)
+            v = validities[idx]
+            if v is not None:
+                h = jnp.where(v, h, null ^ seed)
+            if lane == 0:
+                r1 = r1 + mix(h)
+            else:
+                r2 = r2 + mix(h)
+        if kind == "fixed":
+            fi += 1
+        elif kind == "dict":
+            di += 1
+        else:
+            vi += 1
+    return mix(r1), mix(r2)
+
+
+def _pack_device_lane_args(cols: Sequence[_PreppedColumn],
+                           n_rows: int):
+    """Host-side argument packing shared by both device entry points:
+    bucket-padded column arrays + per-column seed/null/power vectors.
+    Returns (args tuple in _device_row_lanes order minus n, bucket)."""
+    import jax.numpy as jnp
+
+    from transferia_tpu.columnar.batch import bucket_rows
+
+    bucket = bucket_rows(n_rows)
+    fixed_lo, fixed_hi, var_blocks, validities = [], [], [], []
+    dict_codes, dict_accs1, dict_accs2 = [], [], []
+    seeds1, seeds2, nulls1, nulls2 = [], [], [], []
+    powers1, powers2 = [], []
+    pad = bucket - n_rows
+
+    def padded(a, fill=0):
+        if pad:
+            return np.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1),
+                          constant_values=fill)
+        return a
+
+    for c in cols:
+        seeds1.append(_col_seed(c.name, 0))
+        seeds2.append(_col_seed(c.name, 1))
+        nulls1.append(_NULL1)
+        nulls2.append(_NULL2)
+        if c.kind == "fixed":
+            fixed_lo.append(jnp.asarray(padded(c.lo)))
+            fixed_hi.append(jnp.asarray(padded(c.hi)))
+        elif c.kind == "dict":
+            # accumulators pad to a row bucket too, so pool-size
+            # jitter re-traces per bucket, not per distinct pool; pad
+            # codes index entry 0 (their lanes are masked or trimmed)
+            dict_codes.append(jnp.asarray(padded(c.codes)))
+            ab = bucket_rows(max(len(c.acc1), 1))
+            apad = ab - len(c.acc1)
+
+            def padded_acc(a):
+                return np.pad(a, (0, apad)) if apad else a
+
+            dict_accs1.append(jnp.asarray(padded_acc(c.acc1)))
+            dict_accs2.append(jnp.asarray(padded_acc(c.acc2)))
+        else:
+            var_blocks.append(jnp.asarray(padded(c.ensure_blocks())))
+            powers1.append(jnp.asarray(_powers(c.width, int(_P1))))
+            powers2.append(jnp.asarray(_powers(c.width, int(_P2))))
+        validities.append(
+            jnp.asarray(padded(c.validity))
+            if c.validity is not None else None)
+    args = (tuple(fixed_lo), tuple(fixed_hi), tuple(var_blocks),
+            tuple(dict_codes), tuple(dict_accs1), tuple(dict_accs2),
+            tuple(validities),
+            jnp.asarray(np.array(seeds1, dtype=np.uint32)),
+            jnp.asarray(np.array(seeds2, dtype=np.uint32)),
+            jnp.asarray(np.array(nulls1, dtype=np.uint32)),
+            jnp.asarray(np.array(nulls2, dtype=np.uint32)),
+            tuple(powers1), tuple(powers2))
+    return args, bucket
 
 
 class DeviceFingerprintProgram:
@@ -458,60 +667,13 @@ class DeviceFingerprintProgram:
         import jax
         import jax.numpy as jnp
 
-        def mix(x):
-            x = x ^ (x >> jnp.uint32(16))
-            x = x * jnp.uint32(0x7FEB352D)
-            x = x ^ (x >> jnp.uint32(15))
-            x = x * jnp.uint32(0x846CA68B)
-            return x ^ (x >> jnp.uint32(16))
-
         def program(fixed_lo, fixed_hi, var_blocks, dict_codes,
                     dict_accs1, dict_accs2, validities, rowmask,
                     seeds1, seeds2, nulls1, nulls2, powers1, powers2):
-            n = rowmask.shape[0]
-            r1 = jnp.zeros(n, dtype=jnp.uint32)
-            r2 = jnp.zeros(n, dtype=jnp.uint32)
-            fi = vi = di = 0
-            for idx, kind in enumerate(sig_kinds):
-                for lane in (0, 1):
-                    seed = (seeds1 if lane == 0 else seeds2)[idx]
-                    null = (nulls1 if lane == 0 else nulls2)[idx]
-                    if kind == "fixed":
-                        lo, hi = fixed_lo[fi], fixed_hi[fi]
-                        h = mix(lo ^ seed)
-                        h = mix(h + mix(hi ^ (~seed)))
-                    elif kind == "dict":
-                        # codes + per-pool-entry accumulators crossed
-                        # the link (4 + 4·k/n bytes/row, not the padded
-                        # block matrix); the reduction consumes codes
-                        # directly via an HBM-speed gather
-                        from transferia_tpu.ops.decode import (
-                            gather_pool_accumulators,
-                        )
-
-                        acc = (dict_accs1 if lane == 0
-                               else dict_accs2)[di]
-                        h = mix(gather_pool_accumulators(
-                            acc, dict_codes[di]) ^ seed)
-                    else:
-                        pw = (powers1 if lane == 0 else powers2)[vi]
-                        b = var_blocks[vi].astype(jnp.uint32)
-                        h = mix((b * pw[None, :]).sum(
-                            axis=1, dtype=jnp.uint32) ^ seed)
-                    v = validities[idx]
-                    if v is not None:
-                        h = jnp.where(v, h, null ^ seed)
-                    if lane == 0:
-                        r1 = r1 + mix(h)
-                    else:
-                        r2 = r2 + mix(h)
-                if kind == "fixed":
-                    fi += 1
-                elif kind == "dict":
-                    di += 1
-                else:
-                    vi += 1
-            r1, r2 = mix(r1), mix(r2)
+            r1, r2 = _device_row_lanes(
+                sig_kinds, fixed_lo, fixed_hi, var_blocks, dict_codes,
+                dict_accs1, dict_accs2, validities, seeds1, seeds2,
+                nulls1, nulls2, powers1, powers2, rowmask.shape[0])
             r1 = jnp.where(rowmask, r1, 0)
             r2 = jnp.where(rowmask, r2, 0)
             return (r1.sum(dtype=jnp.uint32), r2.sum(dtype=jnp.uint32),
@@ -528,63 +690,19 @@ class DeviceFingerprintProgram:
         """Async-launch one batch; result lands in collect()."""
         import jax.numpy as jnp
 
-        from transferia_tpu.columnar.batch import bucket_rows
-
-        bucket = bucket_rows(n_rows)
         sig = tuple(
             (c.kind, c.width if c.kind == "var" else 0) for c in cols)
-        fixed_lo, fixed_hi, var_blocks, validities = [], [], [], []
-        dict_codes, dict_accs1, dict_accs2 = [], [], []
-        seeds1, seeds2, nulls1, nulls2 = [], [], [], []
-        powers1, powers2 = [], []
-        pad = bucket - n_rows
-
-        def padded(a, fill=0):
-            if pad:
-                return np.pad(a, [(0, pad)] + [(0, 0)] * (a.ndim - 1),
-                              constant_values=fill)
-            return a
-
-        for c in cols:
-            seeds1.append(_col_seed(c.name, 0))
-            seeds2.append(_col_seed(c.name, 1))
-            nulls1.append(_NULL1)
-            nulls2.append(_NULL2)
-            if c.kind == "fixed":
-                fixed_lo.append(jnp.asarray(padded(c.lo)))
-                fixed_hi.append(jnp.asarray(padded(c.hi)))
-            elif c.kind == "dict":
-                # accumulators pad to a row bucket too, so pool-size
-                # jitter re-traces per bucket, not per distinct pool;
-                # pad codes index entry 0 and rowmask zeroes their lanes
-                dict_codes.append(jnp.asarray(padded(c.codes)))
-                ab = bucket_rows(max(len(c.acc1), 1))
-                apad = ab - len(c.acc1)
-
-                def padded_acc(a):
-                    return np.pad(a, (0, apad)) if apad else a
-
-                dict_accs1.append(jnp.asarray(padded_acc(c.acc1)))
-                dict_accs2.append(jnp.asarray(padded_acc(c.acc2)))
-            else:
-                var_blocks.append(jnp.asarray(padded(c.ensure_blocks())))
-                powers1.append(jnp.asarray(_powers(c.width, int(_P1))))
-                powers2.append(jnp.asarray(_powers(c.width, int(_P2))))
-            validities.append(
-                jnp.asarray(padded(c.validity))
-                if c.validity is not None else None)
+        args, bucket = _pack_device_lane_args(cols, n_rows)
         rowmask = np.zeros(bucket, dtype=np.bool_)
         rowmask[:n_rows] = True
         fn = self._program_for(sig)
-        out = fn(tuple(fixed_lo), tuple(fixed_hi), tuple(var_blocks),
-                 tuple(dict_codes), tuple(dict_accs1),
-                 tuple(dict_accs2),
-                 tuple(validities), jnp.asarray(rowmask),
-                 jnp.asarray(np.array(seeds1, dtype=np.uint32)),
-                 jnp.asarray(np.array(seeds2, dtype=np.uint32)),
-                 jnp.asarray(np.array(nulls1, dtype=np.uint32)),
-                 jnp.asarray(np.array(nulls2, dtype=np.uint32)),
-                 tuple(powers1), tuple(powers2))
+        (fixed_lo, fixed_hi, var_blocks, dict_codes, dict_accs1,
+         dict_accs2, validities, seeds1, seeds2, nulls1, nulls2,
+         powers1, powers2) = args
+        out = fn(fixed_lo, fixed_hi, var_blocks, dict_codes,
+                 dict_accs1, dict_accs2, validities,
+                 jnp.asarray(rowmask), seeds1, seeds2, nulls1, nulls2,
+                 powers1, powers2)
         self._pending.append(out)
 
     def collect(self) -> FingerprintAggregate:

@@ -13,6 +13,7 @@ from typing import Optional
 
 import numpy as np
 
+from transferia_tpu.abstract.commit import StagedSinker
 from transferia_tpu.abstract.interfaces import (
     Batch,
     Pusher,
@@ -170,8 +171,15 @@ class CHSourceParams(EndpointParams):
 
 
 def ddl_for_schema(table: TableID, schema: TableSchema,
-                   engine: str = "") -> str:
-    """CREATE TABLE DDL from canonical schema (clickhouse/schema/)."""
+                   engine: str = "", extra_cols: Optional[list] = None,
+                   partition_by: str = "") -> str:
+    """CREATE TABLE DDL from canonical schema (clickhouse/schema/).
+
+    `extra_cols` ([(name, ch type)]) and `partition_by` serve the
+    staged-commit sink: the final table carries the hidden
+    `__trtpu_part` column and partitions by it, so a part publish maps
+    onto ClickHouse's own atomic partition primitive
+    (REPLACE/DROP PARTITION)."""
     from transferia_tpu.typesystem.rules import map_target_type
 
     cols = []
@@ -180,14 +188,17 @@ def ddl_for_schema(table: TableID, schema: TableSchema,
         if not c.required and not c.primary_key:
             ch_type = f"Nullable({ch_type})"
         cols.append(f"`{c.name}` {ch_type}")
+    for name_, ch_type in extra_cols or []:
+        cols.append(f"`{name_}` {ch_type}")
     keys = [f"`{c.name}`" for c in schema.key_columns()]
     order = ", ".join(keys) if keys else "tuple()"
     eng = engine or "MergeTree()"
+    part = f" PARTITION BY `{partition_by}`" if partition_by else ""
     name = f"`{table.name}`" if not table.namespace \
         else f"`{table.namespace}__{table.name}`"
     return (
         f"CREATE TABLE IF NOT EXISTS {name} ({', '.join(cols)}) "
-        f"ENGINE = {eng} ORDER BY ({order})"
+        f"ENGINE = {eng}{part} ORDER BY ({order})"
     )
 
 
@@ -196,17 +207,36 @@ def ch_table_name(table: TableID) -> str:
         else f"{table.namespace}__{table.name}"
 
 
-class CHSinker(Sinker):
+class CHSinker(Sinker, StagedSinker):
     """Sharded insert sink (sink.go:24-100): rows fan out to shards by key
     hash; per-shard clients are lazy.  Deletes/updates collapse into
     ReplacingMergeTree semantics upstream (collapse middleware) — the sink
-    itself inserts."""
+    itself inserts.
+
+    Staged-commit capable on SINGLE-shard targets (abstract/commit.py):
+    batches land in a per-(part, epoch) staging table and publish maps
+    onto ClickHouse's atomic partition primitive — the final table is
+    `PARTITION BY` the hidden `__trtpu_part` column and the publish is
+    one `ALTER TABLE ... REPLACE PARTITION ID '<slug>' FROM <staging>`
+    (empty restage: `DROP PARTITION ID`), fenced by the persisted
+    max-epoch row per part in `__trtpu_commits`.  Multi-shard targets
+    keep the at-least-once path: a part's rows span shards and there is
+    no cross-shard atomic flip to map the publish onto.
+
+    Migration bound: a final table created by the at-least-once path
+    has no partition key, and ClickHouse cannot retrofit PARTITION BY
+    onto an existing MergeTree — the first staged publish against such
+    a table fails loudly at REPLACE PARTITION.  Recreate the table
+    (CleanupPolicy.DROP does this at activation) before switching a
+    pre-existing CH target to staged commits."""
 
     def __init__(self, params: CHTargetParams):
         self.params = params
         self.shards = params.shard_list()
         self._clients: dict[int, CHClient] = {}
         self._created: set[str] = set()
+        self._stage = None  # staging.WireStage when open
+        self._fence_ready = False
 
     def _client(self, shard_idx: int) -> CHClient:
         if shard_idx not in self._clients:
@@ -264,6 +294,9 @@ class CHSinker(Sinker):
                 "CH sink is insert-only; collapse updates/deletes upstream "
                 "or use a ReplacingMergeTree flow with version columns"
             )
+        if self._stage is not None:
+            self._stage_push(batch)
+            return
         shards = self._shard_of(batch)
         nullable = {
             c.name: (not c.required and not c.primary_key)
@@ -284,6 +317,159 @@ class CHSinker(Sinker):
         for i in range(len(self.shards)):
             self._client(i).execute(f"{stmt} `{ch_table_name(table)}`")
 
+    # -- StagedSinker (publish = atomic partition swap) ---------------------
+    def staged_commit_available(self) -> bool:
+        # a part's rows span shards on a sharded target: no single
+        # atomic partition flip exists to map the publish onto
+        return len(self.shards) == 1
+
+    def _ensure_fence_table(self) -> None:
+        from transferia_tpu.providers.staging import COMMITS_TABLE
+
+        if self._fence_ready:
+            return
+        self._client(0).execute(
+            f"CREATE TABLE IF NOT EXISTS `{COMMITS_TABLE}` "
+            f"(`part_key` String, `epoch` Int64) "
+            f"ENGINE = MergeTree() ORDER BY (`part_key`)")
+        self._fence_ready = True
+
+    def begin_part(self, key: str, epoch: int) -> None:
+        from transferia_tpu.providers.staging import (
+            WireStage,
+            stage_ident_prefix,
+        )
+
+        stage = WireStage(key, epoch)
+        # begin replaces — for EVERY epoch of this key (a crashed
+        # earlier owner's staging table would otherwise leak forever)
+        pfx = stage_ident_prefix(key)
+        for r in self._client(0).query_json(
+                "SELECT name, total_rows FROM system.tables "
+                f"WHERE database = '{self.params.database}'"):
+            if str(r.get("name", "")).startswith(pfx):
+                self._client(0).execute(
+                    f"DROP TABLE IF EXISTS `{r['name']}`")
+        self._ensure_fence_table()
+        self._stage = stage
+
+    def _stage_push(self, batch: ColumnBatch) -> None:
+        from transferia_tpu.providers.staging import META_COLUMN
+
+        stage = self._stage
+        staged = stage.state.stage(batch)
+        if stage.schema is None:
+            stage.tid = batch.table_id
+            stage.schema = batch.schema
+            # SAME structure + partition key as the final table
+            # (REPLACE PARTITION requires it); the part column
+            # DEFAULTs to this part's slug so inserts that omit it
+            # land the whole staging table in partition <slug>
+            self._client(0).execute(ddl_for_schema(
+                TableID("", stage.table), batch.schema,
+                self.params.engine,
+                extra_cols=[(META_COLUMN,
+                             f"String DEFAULT '{stage.slug}'")],
+                partition_by=META_COLUMN))
+        if staged.n_rows == 0:
+            return
+        nullable = {
+            c.name: (not c.required and not c.primary_key)
+            for c in staged.schema
+        }
+        try:
+            payload = encode_rowbinary(staged, nullable)
+            self._client(0).insert_rowbinary(
+                stage.table, list(staged.columns), payload)
+        except BaseException:
+            # the staging write died after the dedup window recorded
+            # this batch: only a full part restage is safe
+            stage.state.mark_failed()
+            raise
+
+    def _fence_epoch(self, slug: str):
+        from transferia_tpu.providers.staging import COMMITS_TABLE
+
+        v = self._client(0).scalar(
+            f"SELECT max(`epoch`) FROM `{COMMITS_TABLE}` "
+            f"WHERE `part_key` = '{slug}'")
+        return int(v) if v is not None else None
+
+    @staticmethod
+    def _fence_row(slug: str, epoch: int) -> bytes:
+        import struct
+
+        raw = slug.encode()
+        out = b""
+        n = len(raw)
+        while True:
+            b7 = n & 0x7F
+            n >>= 7
+            if n:
+                out += bytes([b7 | 0x80])
+            else:
+                out += bytes([b7])
+                break
+        return out + raw + struct.pack("<q", epoch)
+
+    def publish_part(self, key: str, epoch: int) -> int:
+        from transferia_tpu.abstract.errors import StaleEpochPublishError
+        from transferia_tpu.chaos.failpoints import failpoint
+        from transferia_tpu.providers.staging import (
+            COMMITS_TABLE,
+            META_COLUMN,
+            publish_guard,
+        )
+        from transferia_tpu.stats import trace
+
+        stage = self._stage
+        if stage is None or stage.key != key:
+            raise RuntimeError(f"ch sink: no open stage for {key!r}")
+        with publish_guard(key, epoch):
+            prev = self._fence_epoch(stage.slug)
+            if prev is not None and epoch < prev:
+                raise StaleEpochPublishError(key, epoch, prev)
+            trace.instant("ch_publish_partition", part=key, epoch=epoch,
+                          rows=stage.state.rows)
+            failpoint("sink.ch.publish")
+            client = self._client(0)
+            if stage.schema is not None:
+                final = ch_table_name(stage.tid)
+                client.execute(ddl_for_schema(
+                    stage.tid, stage.schema, self.params.engine,
+                    extra_cols=[(META_COLUMN, "String")],
+                    partition_by=META_COLUMN))
+                # the atomic flip: this part's partition of the final
+                # table becomes exactly the staged rows
+                client.execute(
+                    f"ALTER TABLE `{final}` REPLACE PARTITION ID "
+                    f"'{stage.slug}' FROM `{stage.table}`")
+            # persist the fence AFTER visibility: a crash in between
+            # republishes idempotently (REPLACE swaps the same rows in)
+            client.insert_rowbinary(
+                COMMITS_TABLE, ["part_key", "epoch"],
+                self._fence_row(stage.slug, epoch))
+            client.execute(f"DROP TABLE IF EXISTS `{stage.table}`")
+            self.last_dedup_dropped = stage.state.dedup_dropped
+            rows = stage.state.rows
+        self._stage = None
+        return rows
+
+    def abort_part(self, key: str) -> None:
+        stage = self._stage
+        if stage is None or stage.key != key:
+            return
+        self._stage = None
+        try:
+            self._client(0).execute(
+                f"DROP TABLE IF EXISTS `{stage.table}`")
+        except CHError as e:
+            logger.warning("ch staged abort of %s: %s", key, e)
+
+    def note_push_retry(self) -> None:
+        if self._stage is not None:
+            self._stage.state.note_push_retry()
+
 
 class CHStorage(Storage, SampleableStorage):
     """Snapshot source over SELECT (storage + storage_sharding.go)."""
@@ -301,12 +487,16 @@ class CHStorage(Storage, SampleableStorage):
         self.client.close()
 
     def table_list(self, include=None):
+        from transferia_tpu.providers.staging import is_meta_name
+
         rows = self.client.query_json(
             f"SELECT name, total_rows FROM system.tables "
             f"WHERE database = '{self.params.database}'"
         )
         out = {}
         for r in rows:
+            if is_meta_name(r["name"]):
+                continue  # staging/fence tables are not user data
             tid = TableID(self.params.database, r["name"])
             if include and not any(tid.include_matches(p) for p in include):
                 continue
@@ -343,8 +533,12 @@ class CHStorage(Storage, SampleableStorage):
             f"WHERE database = '{self.params.database}' "
             f"AND table = '{self._resolve_name(table)}'"
         )
+        from transferia_tpu.providers.staging import is_meta_name
+
         cols = []
         for r in rows:
+            if is_meta_name(r["name"]):
+                continue  # hidden staged-commit part column
             ch_type = r["type"]
             nullable = ch_type.startswith("Nullable(")
             base = ch_type[9:-1] if nullable else ch_type

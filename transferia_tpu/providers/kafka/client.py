@@ -38,14 +38,26 @@ API_PRODUCE = 0
 API_FETCH = 1
 API_LIST_OFFSETS = 2
 API_METADATA = 3
+API_INIT_PRODUCER_ID = 22
 
 ERR_NONE = 0
 ERR_OFFSET_OUT_OF_RANGE = 1
 ERR_UNKNOWN_TOPIC = 3
 ERR_LEADER_NOT_AVAILABLE = 5
 ERR_NOT_LEADER = 6
+ERR_INVALID_PRODUCER_EPOCH = 47
+ERR_PRODUCER_FENCED = 90
 
 _RETRIABLE = {ERR_LEADER_NOT_AVAILABLE, ERR_NOT_LEADER}
+_FENCED = {ERR_INVALID_PRODUCER_EPOCH, ERR_PRODUCER_FENCED}
+
+
+def is_producer_fenced(err: "KafkaError") -> bool:
+    """True when the broker rejected a transactional operation because
+    a NEWER producer epoch owns the transactional id (KIP-98 zombie
+    fencing) — the staged-commit publish maps this onto
+    StaleEpochPublishError."""
+    return err.code in _FENCED
 
 
 class KafkaError(CategorizedError):
@@ -319,6 +331,77 @@ class KafkaClient:
                 raise
             self.metadata([topic])
             return attempt()
+
+    # -- transactions (KIP-98 subset) ----------------------------------------
+    def init_producer(self, transactional_id: str,
+                      producer_epoch: int) -> tuple[int, int]:
+        """InitProducerId for an epoch-keyed transactional id.
+
+        KIP-360 shape: the client proposes its producer epoch (here the
+        part's assignment epoch — monotone per part key) and the broker
+        fences a proposal OLDER than the id's current epoch with
+        PRODUCER_FENCED, which is exactly the zombie-publish fence.
+        Returns (producer_id, accepted_epoch)."""
+        body = enc_str(transactional_id)
+        body += struct.pack("!i", 60_000)           # txn timeout
+        body += struct.pack("!qh", -1, producer_epoch)
+        r = self._roundtrip(API_INIT_PRODUCER_ID, 3, body)
+        r.i32()  # throttle
+        err = r.i16()
+        pid = r.i64()
+        epoch = r.i16()
+        if err != ERR_NONE:
+            e = KafkaError(
+                f"init_producer({transactional_id!r}) failed: "
+                f"error {err}", code=err)
+            # a fencing response carries the id's CURRENT epoch when
+            # the broker discloses it (the in-repo fake does; real
+            # brokers return -1) — the staged-commit publish maps it
+            # onto StaleEpochPublishError's published_epoch
+            e.fence_epoch = int(epoch) if epoch >= 0 else None
+            raise e
+        return pid, epoch
+
+    def txn_produce(self, transactional_id: str, producer_id: int,
+                    producer_epoch: int,
+                    messages: dict[tuple[str, int], list[Record]],
+                    acks: int = -1, timeout_ms: int = 30_000) -> int:
+        """One transactional produce: every (topic, partition) record
+        list lands in a single Produce request carrying the
+        transactional id and producer-epoch-stamped batches — the
+        broker applies it atomically and fences a stale epoch.
+        Returns records produced."""
+        by_topic: dict[str, list[tuple[int, list[Record]]]] = {}
+        for (topic, partition), records in sorted(messages.items()):
+            by_topic.setdefault(topic, []).append((partition, records))
+        body = enc_str(transactional_id)
+        body += struct.pack("!hi", acks, timeout_ms)
+        body += struct.pack("!i", len(by_topic))
+        total = 0
+        for topic, parts in sorted(by_topic.items()):
+            body += enc_str(topic)
+            body += struct.pack("!i", len(parts))
+            for partition, records in parts:
+                batch = encode_record_batch(
+                    records, producer_id=producer_id,
+                    producer_epoch=producer_epoch)
+                body += struct.pack("!i", partition)
+                body += enc_bytes(batch)
+                total += len(records)
+        r = self._roundtrip(API_PRODUCE, 3, body)
+        for _ in range(r.i32()):
+            r.string()
+            for _ in range(r.i32()):
+                r.i32()              # partition
+                err = r.i16()
+                r.i64()              # base offset
+                r.i64()              # log append time
+                if err != ERR_NONE:
+                    raise KafkaError(
+                        f"transactional produce failed: error {err}",
+                        code=err)
+        r.i32()  # throttle
+        return total
 
     # -- offsets ------------------------------------------------------------
     def list_offsets(self, topic: str, partition: int,

@@ -218,15 +218,22 @@ def _encode_records_native(records: list[Record], now: int,
 
 def encode_record_batch(records: list[Record],
                         base_offset: int = 0,
-                        compression: str = "") -> bytes:
-    """Records -> one RecordBatch v2 blob (optionally gzip-compressed)."""
+                        compression: str = "",
+                        producer_id: int = -1,
+                        producer_epoch: int = -1) -> bytes:
+    """Records -> one RecordBatch v2 blob (optionally gzip-compressed).
+
+    `producer_id`/`producer_epoch` stamp the batch header for
+    transactional produce (the broker fences a batch whose producer
+    epoch is older than the transactional id's current one)."""
     now = int(time.time() * 1000)
     base_ts = records[0].timestamp_ms or now if records else now
     native = _encode_records_native(records, now, base_ts) \
         if records else None
     if native is not None:
         return _finish_record_batch(records, native, base_offset,
-                                    compression, now, base_ts)
+                                    compression, now, base_ts,
+                                    producer_id, producer_epoch)
     # accumulate in a list: += on bytes is O(total^2) and a 20k-record
     # batch would copy gigabytes
     parts: list[bytes] = []
@@ -254,12 +261,19 @@ def encode_record_batch(records: list[Record],
         parts.append(enc_varint(len(blob)))
         parts.append(blob)
     return _finish_record_batch(records, b"".join(parts), base_offset,
-                                compression, now, base_ts)
+                                compression, now, base_ts,
+                                producer_id, producer_epoch)
+
+
+# attributes bit 4: this batch is part of a transaction
+_ATTR_TRANSACTIONAL = 0x10
 
 
 def _finish_record_batch(records: list[Record], recs: bytes,
                          base_offset: int, compression: str,
-                         now: int, base_ts: int) -> bytes:
+                         now: int, base_ts: int,
+                         producer_id: int = -1,
+                         producer_epoch: int = -1) -> bytes:
     attrs = 0
     if compression == "gzip":
         import gzip as _gzip
@@ -269,6 +283,8 @@ def _finish_record_batch(records: list[Record], recs: bytes,
     elif compression:
         raise ValueError(f"unsupported compression {compression!r} "
                          f"(only gzip ships dependency-free)")
+    if producer_id >= 0:
+        attrs |= _ATTR_TRANSACTIONAL
     # batch body after the crc field
     after_crc = (
         struct.pack("!h", attrs)                   # attributes
@@ -276,8 +292,8 @@ def _finish_record_batch(records: list[Record], recs: bytes,
         + struct.pack("!q", base_ts)
         + struct.pack("!q", (records[-1].timestamp_ms or now)
                       if records else now)
-        + struct.pack("!q", -1)                    # producerId
-        + struct.pack("!h", -1)                    # producerEpoch
+        + struct.pack("!q", producer_id)           # producerId
+        + struct.pack("!h", producer_epoch)        # producerEpoch
         + struct.pack("!i", -1)                    # baseSequence
         + struct.pack("!i", len(records))
         + recs

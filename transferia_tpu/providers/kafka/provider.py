@@ -14,6 +14,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Optional
 
+from transferia_tpu.abstract.commit import StagedSinker
 from transferia_tpu.abstract.interfaces import Batch, Sinker, is_columnar
 from transferia_tpu.coordinator.interface import Coordinator
 from transferia_tpu.models.endpoint import EndpointParams, register_endpoint
@@ -200,7 +201,27 @@ def topic_partitions(params: KafkaSourceParams) -> list[int]:
         client.close()
 
 
-class KafkaSinker(Sinker):
+class KafkaSinker(Sinker, StagedSinker):
+    """Produce sink; staged-commit capable (abstract/commit.py): with an
+    open part stage the serialized messages buffer sink-side and land in
+    the broker through ONE transactional produce tied to the part's
+    epoch-keyed transactional id (`trtpu.<part slug>`) — kafka's own
+    KIP-98 producer fencing rejects a zombie (its InitProducerId /
+    produce with the stale epoch fails PRODUCER_FENCED, surfaced as
+    StaleEpochPublishError), and a republish under the same
+    transactional id SUPERSEDES the previous publish instead of
+    appending duplicates.
+
+    Protocol bound: this speaks the KIP-98 SUBSET the in-repo fake
+    broker implements — one transactional Produce request = one
+    committed transaction, with broker-side supersede-in-place of the
+    id's previous publish.  A full Apache Kafka deployment additionally
+    needs AddPartitionsToTxn/EndTxn + commit markers and read_committed
+    consumers (its log is append-only: the republish-supersede there
+    would ride transaction aborts, not segment rewrite); until then
+    the exactly-once claim holds for the fake-backed wire, and real
+    brokers should keep the at-least-once path."""
+
     def __init__(self, params: KafkaTargetParams):
         self.params = params
         self.client = _make_client(params)
@@ -211,6 +232,9 @@ class KafkaSinker(Sinker):
             cfg.setdefault("topic", params.topic)
         self.serializer = make_queue_serializer(params.serializer, **cfg)
         self._partitions: dict[str, list[int]] = {}
+        self._stage = None  # staging.PartStage when open
+        self._stage_key = ""
+        self._staged: dict[tuple[str, int], list[Record]] = {}
 
     def _topic_partitions(self, topic: str) -> list[int]:
         if topic not in self._partitions:
@@ -241,10 +265,12 @@ class KafkaSinker(Sinker):
 
         return [crc32c(k) % n_parts for k in keys]
 
-    def push(self, batch: Batch) -> None:
+    def _partitioned_records(self, batch: Batch
+                             ) -> dict[tuple[str, int], list[Record]]:
+        """Serialize one batch into per-(topic, partition) records."""
         pairs = self.serializer.serialize_messages(batch)
         if not pairs:
-            return
+            return {}
         if is_columnar(batch):
             topic = self.params.topic or str(batch.table_id)
         else:
@@ -254,7 +280,6 @@ class KafkaSinker(Sinker):
             )
         partitions = self._topic_partitions(topic)
         n_parts = len(partitions)
-        per_partition: dict[int, list[Record]] = {}
         col_parts = None
         if is_columnar(batch) and self.params.partition_by and \
                 self.params.partition_by in batch.columns and \
@@ -270,15 +295,91 @@ class KafkaSinker(Sinker):
             # affinity across restarts.  One batched native call when
             # available; the per-key fallback is the same function.
             part_idx = self._key_partitions(pairs, n_parts)
+        out: dict[tuple[str, int], list[Record]] = {}
         for i, (key, value) in enumerate(pairs):
             p = partitions[int(part_idx[i])]
-            per_partition.setdefault(p, []).append(
+            out.setdefault((topic, p), []).append(
                 Record(key=key, value=value)
             )
-        for p, records in per_partition.items():
+        return out
+
+    def push(self, batch: Batch) -> None:
+        if self._stage is not None:
+            batch = self._stage.stage(batch)
+            try:
+                for tp, records in self._partitioned_records(
+                        batch).items():
+                    self._staged.setdefault(tp, []).extend(records)
+            except BaseException:
+                # serialization died after the dedup window recorded
+                # the batch: only a full part restage is safe
+                self._stage.mark_failed()
+                raise
+            return
+        for (topic, p), records in self._partitioned_records(
+                batch).items():
             self.client.produce(
                 topic, p, records,
                 compression=getattr(self.params, "compression", ""))
+
+    # -- StagedSinker (publish = one kafka transaction) ---------------------
+    def begin_part(self, key: str, epoch: int) -> None:
+        from transferia_tpu.providers.staging import PartStage
+
+        # hold=False: the serialized record buffer is the stage; the
+        # PartStage only runs the dedup window over the pushed batches
+        self._stage = PartStage(key, epoch, hold=False)
+        self._stage_key = key
+        self._staged = {}
+
+    def publish_part(self, key: str, epoch: int) -> int:
+        from transferia_tpu.abstract.errors import StaleEpochPublishError
+        from transferia_tpu.chaos.failpoints import failpoint
+        from transferia_tpu.providers.kafka.client import (
+            is_producer_fenced,
+        )
+        from transferia_tpu.providers.staging import part_slug, \
+            publish_guard
+        from transferia_tpu.stats import trace
+
+        stage = self._stage
+        if stage is None or self._stage_key != key:
+            raise RuntimeError(f"kafka sink: no open stage for {key!r}")
+        with publish_guard(key, epoch):
+            txn_id = f"trtpu.{part_slug(key)}"
+            trace.instant("kafka_publish_txn", part=key, epoch=epoch,
+                          rows=stage.rows)
+            failpoint("sink.kafka.publish")
+            try:
+                pid, accepted = self.client.init_producer(txn_id, epoch)
+                n = self.client.txn_produce(txn_id, pid, accepted,
+                                            self._staged)
+            except KafkaError as e:
+                if is_producer_fenced(e):
+                    # KIP-98 zombie fencing IS the sink-side epoch
+                    # fence: a newer owner holds the transactional id.
+                    # Brokers that don't disclose the winning epoch
+                    # (real ones return -1) get the epoch+1 lower bound
+                    won = getattr(e, "fence_epoch", None)
+                    raise StaleEpochPublishError(
+                        key, epoch,
+                        won if won is not None else epoch + 1) from e
+                raise
+            self.last_dedup_dropped = stage.dedup_dropped
+            rows = stage.rows
+        self._stage = None
+        self._stage_key = ""
+        self._staged = {}
+        return rows
+
+    def abort_part(self, key: str) -> None:
+        self._stage = None
+        self._stage_key = ""
+        self._staged = {}
+
+    def note_push_retry(self) -> None:
+        if self._stage is not None:
+            self._stage.note_push_retry()
 
     def close(self) -> None:
         self.client.close()

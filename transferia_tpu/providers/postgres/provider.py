@@ -13,6 +13,8 @@ import logging
 from dataclasses import dataclass, field
 from typing import Optional
 
+from transferia_tpu.abstract.commit import StagedSinker
+from transferia_tpu.abstract.errors import StaleEpochPublishError
 from transferia_tpu.abstract.interfaces import (
     Batch,
     IncrementalStorage,
@@ -196,6 +198,8 @@ class PGStorage(Storage, ShardingStorage, PositionalStorage,
 
     # -- catalog ------------------------------------------------------------
     def table_list(self, include=None):
+        from transferia_tpu.providers.staging import is_meta_name
+
         schemas = ", ".join(f"'{s}'" for s in self.params.schemas)
         rows = self.conn.query(
             "SELECT n.nspname AS ns, c.relname AS name, "
@@ -205,6 +209,8 @@ class PGStorage(Storage, ShardingStorage, PositionalStorage,
         )
         out = {}
         for r in rows:
+            if is_meta_name(r["name"]):
+                continue  # staging/fence tables are not user data
             tid = TableID(r["ns"], r["name"])
             if include and not any(tid.include_matches(p) for p in include):
                 continue
@@ -225,8 +231,12 @@ class PGStorage(Storage, ShardingStorage, PositionalStorage,
             f"'{table.fqtn()}'::regclass "
             "AND a.attnum > 0 AND NOT a.attisdropped ORDER BY a.attnum"
         )
+        from transferia_tpu.providers.staging import is_meta_name
+
         cols = []
         for r in rows:
+            if is_meta_name(r["name"]):
+                continue  # hidden staged-commit part column
             cols.append(ColSchema(
                 name=r["name"],
                 data_type=map_source_type("pg", r["typ"].lower()),
@@ -451,14 +461,35 @@ def _arrow_read_type(ctype: CanonicalType):
     return table.get(ctype, pa.string())
 
 
-class PGSinker(Sinker):
+class PGSinker(Sinker, StagedSinker):
     """COPY-based insert sink with DDL creation; updates/deletes via
-    simple-query statements (CDC slow path)."""
+    simple-query statements (CDC slow path).
+
+    Staged-commit capable (abstract/commit.py): with an open part stage
+    batches COPY into a per-(part, epoch) staging table
+    (`public.__trtpu_stg_<hash>`), and publish is postgres's own atomic
+    primitive — ONE transaction doing DELETE-part-rows + `INSERT ...
+    SELECT` from staging + an append-only epoch row into the
+    `__trtpu_commits` fence table (PK (part_key, epoch): the fence
+    value is max(epoch), monotone by construction — a zombie's row can
+    never regress it), so the target flips from "nothing of this part"
+    to "exactly this part" with no torn middle state.  The final table
+    carries a hidden `__trtpu_part` column (filtered out of every
+    PGStorage read) so a republish can address its own rows.
+
+    Fence bound: the epoch check reads before the publish transaction,
+    so two publishers racing the SAME instant can interleave their
+    data flips (last txn wins) — the coordinator's fenced commit_part
+    is the primary gate that keeps two live owners from publishing one
+    part concurrently; this sink fence is the zombie-PROCESS backstop
+    (a stale publisher arriving after the survivor always raises)."""
 
     def __init__(self, params: PGTargetParams):
         self.params = params
         self._c: Optional[PGConnection] = None
         self._created: set[TableID] = set()
+        self._stage = None  # staging.WireStage when open
+        self._fence_ready = False
 
     @property
     def conn(self) -> PGConnection:
@@ -471,9 +502,11 @@ class PGSinker(Sinker):
             self._c.close()
             self._c = None
 
-    def _ensure_table(self, tid: TableID, schema: TableSchema) -> None:
+    def _ensure_table(self, tid: TableID, schema: TableSchema,
+                      with_part_column: bool = False) -> None:
         if tid in self._created:
             return
+        from transferia_tpu.providers.staging import META_COLUMN
         from transferia_tpu.typesystem.rules import map_target_type
 
         cols = []
@@ -481,6 +514,8 @@ class PGSinker(Sinker):
             pg_type = map_target_type("pg", c.data_type)
             nn = " NOT NULL" if (c.required or c.primary_key) else ""
             cols.append(f'"{c.name}" {pg_type}{nn}')
+        if with_part_column:
+            cols.append(f'"{META_COLUMN}" text')
         keys = ", ".join(f'"{c.name}"' for c in schema.key_columns())
         pk = f", PRIMARY KEY ({keys})" if keys else ""
         if tid.namespace:
@@ -512,6 +547,9 @@ class PGSinker(Sinker):
             if not rows:
                 return
             batch = ColumnBatch.from_rows(rows)
+        if self._stage is not None:
+            self._stage_push(batch)
+            return
         self._ensure_table(batch.table_id, batch.schema)
         if batch.kinds is None:
             self._copy_insert(batch)
@@ -519,7 +557,8 @@ class PGSinker(Sinker):
             for it in batch.to_rows():
                 self._apply_row(it)
 
-    def _copy_insert(self, batch: ColumnBatch) -> None:
+    def _copy_insert(self, batch: ColumnBatch,
+                     target: Optional[str] = None) -> None:
         cols = ", ".join(f'"{n}"' for n in batch.columns)
         data = batch.to_pydict()
         names = list(batch.columns)
@@ -530,10 +569,158 @@ class PGSinker(Sinker):
             ))
         payload = ("\n".join(lines) + "\n").encode()
         self.conn.copy_in(
-            f"COPY {batch.table_id.fqtn()} ({cols}) "
+            f"COPY {target or batch.table_id.fqtn()} ({cols}) "
             f"FROM STDIN WITH (FORMAT csv)",
             [payload],
         )
+
+    # -- StagedSinker (exactly-once publish via one SQL transaction) --------
+    @staticmethod
+    def _stage_fqtn(stage) -> str:
+        return f'"public"."{stage.table}"'
+
+    def _commits_fqtn(self) -> str:
+        from transferia_tpu.providers.staging import COMMITS_TABLE
+
+        return f'"public"."{COMMITS_TABLE}"'
+
+    def _ensure_fence_table(self) -> None:
+        if self._fence_ready:
+            return
+        # APPEND-ONLY fence: one row per accepted (part, epoch), fence
+        # value = max(epoch) per part.  A zombie's publish can add its
+        # own (older) row but can never REGRESS the fence the way a
+        # keyed-by-part upsert could — monotone by construction
+        self.conn.query(
+            f"CREATE TABLE IF NOT EXISTS {self._commits_fqtn()} "
+            f"(\"part_key\" text, \"epoch\" bigint, "
+            f"PRIMARY KEY (\"part_key\", \"epoch\"))"
+        )
+        self._fence_ready = True
+
+    def begin_part(self, key: str, epoch: int) -> None:
+        from transferia_tpu.providers.staging import (
+            WireStage,
+            stage_ident_prefix,
+        )
+
+        stage = WireStage(key, epoch)
+        # begin replaces — for EVERY epoch of this key: a crashed
+        # earlier owner's staging table (different epoch, so a
+        # different name) would otherwise leak in the target forever
+        pfx = stage_ident_prefix(key)
+        rows = self.conn.query(
+            "SELECT n.nspname AS ns, c.relname AS name, "
+            "c.reltuples::bigint AS eta "
+            "FROM pg_class c JOIN pg_namespace n ON n.oid = "
+            "c.relnamespace "
+            "WHERE c.relkind IN ('r', 'p') AND n.nspname IN ('public')")
+        for r in rows:
+            if r["name"].startswith(pfx):
+                self.conn.query(
+                    f"DROP TABLE IF EXISTS \"public\".\"{r['name']}\"")
+        self._ensure_fence_table()
+        self._stage = stage
+
+    def _stage_push(self, batch: ColumnBatch) -> None:
+        from transferia_tpu.typesystem.rules import map_target_type
+
+        stage = self._stage
+        staged = stage.state.stage(batch)
+        if stage.schema is None:
+            stage.tid = batch.table_id
+            stage.schema = batch.schema
+            cols = ", ".join(
+                f'"{c.name}" {map_target_type("pg", c.data_type)}'
+                for c in batch.schema)
+            self.conn.query(
+                f"CREATE TABLE IF NOT EXISTS "
+                f"{self._stage_fqtn(stage)} ({cols})")
+        if staged.n_rows == 0:
+            return
+        try:
+            self._copy_insert(staged, target=self._stage_fqtn(stage))
+        except BaseException:
+            # the staging write died after the dedup window recorded
+            # this batch: only a full part restage is safe
+            stage.state.mark_failed()
+            raise
+
+    def _fence_epoch(self, slug: str):
+        rows = self.conn.query(
+            f"SELECT \"epoch\" FROM {self._commits_fqtn()} "
+            f"WHERE (\"part_key\" = '{slug}')")
+        epochs = [int(r["epoch"]) for r in rows
+                  if r.get("epoch") is not None]
+        return max(epochs) if epochs else None
+
+    def publish_part(self, key: str, epoch: int) -> int:
+        from transferia_tpu.chaos.failpoints import failpoint
+        from transferia_tpu.providers.staging import (
+            META_COLUMN,
+            publish_guard,
+        )
+        from transferia_tpu.stats import trace
+
+        stage = self._stage
+        if stage is None or stage.key != key:
+            raise RuntimeError(f"pg sink: no open stage for {key!r}")
+        with publish_guard(key, epoch):
+            prev = self._fence_epoch(stage.slug)
+            if prev is not None and epoch < prev:
+                raise StaleEpochPublishError(key, epoch, prev)
+            trace.instant("pg_publish_txn", part=key, epoch=epoch,
+                          rows=stage.state.rows)
+            failpoint("sink.pg.publish")
+            stmts = ["BEGIN"]
+            if stage.schema is not None:
+                self._ensure_table(stage.tid, stage.schema,
+                                   with_part_column=True)
+                # a final table created by the at-least-once path (or
+                # a pre-staged-commit run) lacks the part column; the
+                # retrofit is idempotent and outside the publish txn
+                self.conn.query(
+                    f"ALTER TABLE {stage.tid.fqtn()} ADD COLUMN IF "
+                    f"NOT EXISTS \"{META_COLUMN}\" text")
+                cols = ", ".join(f'"{c.name}"' for c in stage.schema)
+                stmts.append(
+                    f"DELETE FROM {stage.tid.fqtn()} "
+                    f"WHERE \"{META_COLUMN}\" = '{stage.slug}'")
+                stmts.append(
+                    f"INSERT INTO {stage.tid.fqtn()} "
+                    f"({cols}, \"{META_COLUMN}\") "
+                    f"SELECT {cols}, '{stage.slug}' "
+                    f"FROM {self._stage_fqtn(stage)}")
+            stmts.append(
+                f"INSERT INTO {self._commits_fqtn()} "
+                f"(\"part_key\", \"epoch\") "
+                f"VALUES ('{stage.slug}', {epoch}) "
+                f"ON CONFLICT (\"part_key\", \"epoch\") DO NOTHING")
+            stmts.append("COMMIT")
+            # one Q message = one implicit transaction block: postgres
+            # applies all statements atomically or rolls back together
+            self.conn.query("; ".join(stmts))
+            self.conn.query(
+                f"DROP TABLE IF EXISTS {self._stage_fqtn(stage)}")
+            self.last_dedup_dropped = stage.state.dedup_dropped
+            rows = stage.state.rows
+        self._stage = None
+        return rows
+
+    def abort_part(self, key: str) -> None:
+        stage = self._stage
+        if stage is None or stage.key != key:
+            return
+        self._stage = None
+        try:
+            self.conn.query(
+                f"DROP TABLE IF EXISTS {self._stage_fqtn(stage)}")
+        except PGError as e:
+            logger.warning("pg staged abort of %s: %s", key, e)
+
+    def note_push_retry(self) -> None:
+        if self._stage is not None:
+            self._stage.state.note_push_retry()
 
     _sql_literal = staticmethod(lambda v: _pg_literal(v))
 

@@ -20,6 +20,7 @@ import logging
 from dataclasses import dataclass, field
 from typing import Optional
 
+from transferia_tpu.abstract.commit import StagedSinker
 from transferia_tpu.abstract.errors import CategorizedError
 from transferia_tpu.abstract.interfaces import (
     AsyncPartDiscovery,
@@ -96,6 +97,12 @@ class S3TargetParams(EndpointParams):
     anon: bool = False
     storage_options: dict = field(default_factory=dict)
     max_rows_per_file: int = 1_000_000   # file splitting (file_splitter.go)
+    # -- staged-commit credentials (the exactly-once object path signs
+    # its own requests through the SigV4 client; fsspec's anonymous /
+    # ambient-credential modes stay on the at-least-once path)
+    access_key: str = ""
+    secret_key: str = ""
+    region: str = "us-east-1"
 
 
 def _fs_for(url: str, params) -> tuple[object, str]:
@@ -150,6 +157,10 @@ class S3Storage(Storage, ShardingStorage, AsyncPartDiscovery):
                 )
             else:
                 found = [self._root] if fs.exists(self._root) else []
+            # the staged-commit sink keeps in-flight parts and publish
+            # markers under `.staging/` in the same prefix; readers
+            # must never ingest them as table data
+            found = [p for p in found if "/.staging/" not in f"/{p}"]
             if not found:
                 raise FileNotFoundError(
                     f"s3 source: no objects match {self.params.url!r}"
@@ -218,19 +229,62 @@ class S3Storage(Storage, ShardingStorage, AsyncPartDiscovery):
         self.files()
 
 
-class S3Sinker(Sinker):
-    """Object sink with size-based file splitting (sink/file_splitter.go)."""
+def _s3_stage(key: str, epoch: int, prefix: str):
+    """One part's staging state inside the S3 object sink: the shared
+    WireStage plus the staging key prefix and an object sequence."""
+    from transferia_tpu.providers.staging import WireStage
+
+    stage = WireStage(key, epoch)
+    # slug is a path COMPONENT ("/" cannot appear in a slug), so one
+    # part's staging prefix can never prefix-match another's even for
+    # dotted slugs where "a.t" prefixes "a.t.z"
+    stage.dir = f"{prefix}.staging/{stage.slug}/e{epoch}/"
+    stage.seq = 0
+    return stage
+
+
+class S3Sinker(Sinker, StagedSinker):
+    """Object sink with size-based file splitting (sink/file_splitter.go).
+
+    Staged-commit capable on s3:// targets with explicit credentials
+    (abstract/commit.py): with an open part stage each pushed batch
+    lands as an object under `.staging/<part slug>.e<epoch>/` —
+    invisible to readers, which skip the `.staging/` prefix — and
+    publish FIRST advances the persisted
+    `.staging/.published.<slug>.json` marker with a CONDITIONAL PUT
+    (If-Match on the observed marker ETag / If-None-Match on first
+    publish), THEN does the batched copy-to-final (delete the part's
+    previous objects under `<prefix><slug>/`, copy the staged keys
+    in).  Racing publishers serialize at the store on the marker CAS:
+    a zombie raises StaleEpochPublishError before touching any final
+    object, and a crash between the marker and the copy is repaired by
+    the retried part republishing idempotently under the same epoch."""
 
     def __init__(self, params: S3TargetParams):
         import uuid as _uuid
 
         self.params = params
-        self.fs, self.root = _fs_for(params.url, params)
+        self._fs = None
+        self._root: Optional[str] = None
         self.token = _uuid.uuid4().hex[:8]
         self._counters: dict[TableID, int] = {}
         self._rows_in_file: dict[TableID, int] = {}
         self._writers: dict[TableID, object] = {}
         self._handles: dict[TableID, object] = {}
+        self._stage = None  # staging.WireStage (+ dir/seq) when open
+        self._client = None
+
+    @property
+    def fs(self):
+        if self._fs is None:
+            self._fs, self._root = _fs_for(self.params.url, self.params)
+        return self._fs
+
+    @property
+    def root(self) -> str:
+        if self._root is None:
+            self.fs  # resolves both
+        return self._root
 
     def _next_path(self, tid: TableID, ext: str) -> str:
         n = self._counters.get(tid, 0)
@@ -239,14 +293,18 @@ class S3Sinker(Sinker):
 
     def push(self, batch: Batch) -> None:
         if not is_columnar(batch):
-            for it in batch:
-                if it.kind in (Kind.DONE_TABLE_LOAD,
-                               Kind.DONE_SHARDED_TABLE_LOAD):
-                    self._finish(it.table_id)
+            if self._stage is None:
+                for it in batch:
+                    if it.kind in (Kind.DONE_TABLE_LOAD,
+                                   Kind.DONE_SHARDED_TABLE_LOAD):
+                        self._finish(it.table_id)
             rows = [it for it in batch if it.is_row_event()]
             if not rows:
                 return
             batch = ColumnBatch.from_rows(rows)
+        if self._stage is not None:
+            self._stage_push(batch)
+            return
         tid = batch.table_id
         if self.params.format == "parquet":
             import pyarrow.parquet as pq
@@ -296,6 +354,193 @@ class S3Sinker(Sinker):
     def close(self) -> None:
         for tid in set(list(self._writers) + list(self._handles)):
             self._finish(tid)
+
+    # -- StagedSinker (publish = batched copy behind a marker fence) --------
+    def _bucket_prefix(self) -> tuple[str, str]:
+        rest = self.params.url[len("s3://"):]
+        bucket, _, prefix = rest.partition("/")
+        prefix = prefix.strip("/")
+        return bucket, (prefix + "/") if prefix else ""
+
+    def staged_commit_available(self) -> bool:
+        if not self.params.url.startswith("s3://"):
+            return False
+        opts = self.params.storage_options or {}
+        if not ((self.params.access_key or opts.get("key"))
+                and (self.params.secret_key or opts.get("secret"))):
+            return False
+        if self.params.format == "parquet":
+            try:
+                import pyarrow  # noqa: F401
+            except ImportError:
+                return False
+        return self.params.format in ("parquet", "jsonl")
+
+    def _staged_client(self):
+        if self._client is None:
+            from transferia_tpu.coordinator.s3client import S3Client
+
+            opts = self.params.storage_options or {}
+            bucket, _ = self._bucket_prefix()
+            self._client = S3Client(
+                bucket=bucket,
+                endpoint=self.params.endpoint_url,
+                region=self.params.region,
+                access_key=self.params.access_key or opts.get("key", ""),
+                secret_key=self.params.secret_key
+                or opts.get("secret", ""),
+            )
+        return self._client
+
+    def _serialize_batch(self, batch: ColumnBatch) -> tuple[str, bytes]:
+        if self.params.format == "parquet":
+            import io
+
+            import pyarrow as pa
+            import pyarrow.parquet as pq
+
+            buf = io.BytesIO()
+            rb = batch.to_arrow()
+            pq.write_table(pa.Table.from_batches([rb]), buf)
+            return "parquet", buf.getvalue()
+        lines = [
+            json.dumps(row.as_dict(), default=str).encode() + b"\n"
+            for row in batch.to_rows()
+        ]
+        return "jsonl", b"".join(lines)
+
+    def begin_part(self, key: str, epoch: int) -> None:
+        _, prefix = self._bucket_prefix()
+        stage = _s3_stage(key, epoch, prefix)
+        client = self._staged_client()
+        # begin replaces — for EVERY epoch of this key: sweep crashed
+        # earlier attempts' staged objects too (all epochs live under
+        # the part's own `.staging/<slug>/`), or a steal's epoch bump
+        # would leak them forever
+        for obj in client.list(f"{prefix}.staging/{stage.slug}/"):
+            client.delete(obj.key)
+        self._stage = stage
+
+    def _stage_push(self, batch: ColumnBatch) -> None:
+        stage = self._stage
+        staged = stage.state.stage(batch)
+        if staged.n_rows == 0:
+            return
+        ext, body = self._serialize_batch(staged)
+        tid = staged.table_id
+        stage_key = (f"{stage.dir}{stage.seq:06d}."
+                     f"{tid.namespace}.{tid.name}.{ext}")
+        stage.seq += 1
+        try:
+            self._staged_client().put(stage_key, body)
+        except BaseException:
+            # the staging write died after the dedup window recorded
+            # this batch: only a full part restage is safe
+            stage.state.mark_failed()
+            raise
+
+    def _marker_key(self, slug: str) -> str:
+        _, prefix = self._bucket_prefix()
+        return f"{prefix}.staging/.published.{slug}.json"
+
+    def _advance_marker(self, key: str, epoch: int, slug: str) -> None:
+        """Persist the publish epoch with a conditional write; racing
+        publishers serialize at the store, the loser re-checks."""
+        from transferia_tpu.abstract.errors import StaleEpochPublishError
+        from transferia_tpu.coordinator.s3client import (
+            ConditionalUnsupported,
+            PreconditionFailed,
+        )
+
+        client = self._staged_client()
+        body = json.dumps({"epoch": epoch, "key": key}).encode()
+        for _ in range(8):
+            cur = client.get(self._marker_key(slug))
+            if cur is not None:
+                prev = int(json.loads(cur[0]).get("epoch", -1))
+                if epoch < prev:
+                    raise StaleEpochPublishError(key, epoch, prev)
+            try:
+                if cur is None:
+                    client.put(self._marker_key(slug), body,
+                               if_none_match=True)
+                else:
+                    client.put(self._marker_key(slug), body,
+                               if_match=cur[1])
+                return
+            except PreconditionFailed:
+                continue  # lost the race: re-read and re-fence
+            except ConditionalUnsupported:
+                # endpoint without conditional writes: last-writer-wins
+                # degrade, same contract as the s3 coordinator backend
+                logger.warning(
+                    "s3 target lacks conditional writes; publish "
+                    "marker for %s written last-writer-wins", key)
+                client.put(self._marker_key(slug), body)
+                return
+        raise CategorizedError(
+            CategorizedError.TARGET,
+            f"publish marker CAS for {key!r} did not converge")
+
+    def publish_part(self, key: str, epoch: int) -> int:
+        from transferia_tpu.chaos.failpoints import failpoint
+        from transferia_tpu.providers.staging import publish_guard
+        from transferia_tpu.stats import trace
+
+        stage = self._stage
+        if stage is None or stage.key != key:
+            raise RuntimeError(f"s3 sink: no open stage for {key!r}")
+        client = self._staged_client()
+        _, prefix = self._bucket_prefix()
+        with publish_guard(key, epoch):
+            trace.instant("s3_publish_copy", part=key, epoch=epoch,
+                          rows=stage.state.rows)
+            failpoint("sink.s3.publish")
+            # fence FIRST: the conditional marker write must win before
+            # any final object is touched, so a zombie raises here with
+            # the survivor's objects intact.  A crash after the marker
+            # but before the copy is repaired by the retried part
+            # republishing idempotently under the same epoch.
+            self._advance_marker(key, epoch, stage.slug)
+            # replace: drop what an older publish of this part landed.
+            # The part's final objects live under their own slug-keyed
+            # "directory", so the listing is O(this part) and cannot
+            # match another part's keys by substring accident.
+            part_prefix = f"{prefix}{stage.slug}/"
+            for obj in client.list(part_prefix):
+                client.delete(obj.key)
+            # batched copy-to-final: staged keys become
+            # `<prefix><slug>/<seq>.<ns>.<table>.<ext>` objects
+            staged_objs = sorted(client.list(stage.dir),
+                                 key=lambda o: o.key)
+            for obj in staged_objs:
+                got = client.get(obj.key)
+                if got is None:
+                    continue  # concurrent abort of a superseded stage
+                name = obj.key[len(stage.dir):]
+                client.put(f"{part_prefix}{name}", got[0])
+            for obj in staged_objs:
+                client.delete(obj.key)
+            self.last_dedup_dropped = stage.state.dedup_dropped
+            rows = stage.state.rows
+        self._stage = None
+        return rows
+
+    def abort_part(self, key: str) -> None:
+        stage = self._stage
+        if stage is None or stage.key != key:
+            return
+        self._stage = None
+        try:
+            client = self._staged_client()
+            for obj in client.list(stage.dir):
+                client.delete(obj.key)
+        except Exception as e:
+            logger.warning("s3 staged abort of %s: %s", key, e)
+
+    def note_push_retry(self) -> None:
+        if self._stage is not None:
+            self._stage.state.note_push_retry()
 
 
 @register_provider

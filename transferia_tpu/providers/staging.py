@@ -212,6 +212,76 @@ def part_slug(key: str) -> str:
     return re.sub(r"[^A-Za-z0-9._-]", "_", key)
 
 
+# -- wire-target staging conventions -----------------------------------------
+#
+# The five wire sinks (postgres, clickhouse, ydb, kafka, s3 objects)
+# share one naming scheme so an operator can recognize transferia's
+# transient state in any target:
+#
+# - `__trtpu_` prefixes every object the staged-commit plane creates in
+#   a target (staging tables, the commit fence table, the hidden part
+#   column).  Target-side READ paths (destination storages) hide these:
+#   a checksum of the delivered table never sees the machinery;
+# - `__trtpu_commits` is the per-target fence table: one row per part
+#   key carrying the last accepted publish epoch — the persisted twin
+#   of staging.EpochFence, so the fence survives sink restarts and
+#   fences ZOMBIE PROCESSES, not just stale objects;
+# - `__trtpu_part` is the hidden part-identity column database sinks
+#   add to the final table: "publish replaces" needs per-row part
+#   identity a DELETE/REPLACE PARTITION can address.
+
+META_PREFIX = "__trtpu"
+META_COLUMN = "__trtpu_part"
+COMMITS_TABLE = "__trtpu_commits"
+
+
+def is_meta_name(name: str) -> bool:
+    """True for identifiers owned by the staging plane (hidden from
+    destination-storage reads)."""
+    return name.startswith(META_PREFIX)
+
+
+def stage_ident_prefix(key: str, prefix: str = "__trtpu_stg_") -> str:
+    """Identifier prefix shared by ALL epochs' staging tables of one
+    part key — `begin_part` enumerates tables under it to sweep the
+    leftovers of crashed earlier attempts (a steal bumps the epoch, so
+    the crashed owner's staging would otherwise leak forever)."""
+    import hashlib
+
+    h = hashlib.sha1(key.encode()).hexdigest()[:12]
+    return f"{prefix}{h}_e"
+
+
+def stage_ident(key: str, epoch: int, prefix: str = "__trtpu_stg_") -> str:
+    """Short, identifier-safe staging-table name for (part key, epoch).
+
+    Hash-based: part keys embed operation ids and table fqtns that
+    overflow identifier limits (postgres truncates at 63 bytes, which
+    would silently collide two parts).  The epoch is IN the identity —
+    as a readable suffix on the key hash: a zombie and the survivor
+    that stole its part stage side by side and never clobber each
+    other's staging area, while any owner can ENUMERATE every epoch's
+    staging for the key (stage_ident_prefix) to sweep crashed
+    attempts' leftovers."""
+    return f"{stage_ident_prefix(key, prefix)}{epoch}"
+
+
+class WireStage:
+    """One part's staging state inside a wire sink (pg/ch/ydb/s3
+    share it): the (key, epoch) identity, its slug and staging-table
+    ident, the dedup-window PartStage, and the first staged batch's
+    table/schema (wire sinks learn the shape from the data)."""
+
+    def __init__(self, key: str, epoch: int):
+        self.key = key
+        self.epoch = epoch
+        self.slug = part_slug(key)
+        self.table = stage_ident(key, epoch)
+        self.state = PartStage(key, epoch, hold=False)
+        self.tid = None
+        self.schema = None
+
+
 class DirectoryPartStage:
     """File-backed staging for directory sinks (fs, arrow_ipc).
 
@@ -228,6 +298,8 @@ class DirectoryPartStage:
         import os
         import shutil
 
+        import re as _re
+
         self.root = root
         self.key = key
         self.epoch = epoch
@@ -237,8 +309,20 @@ class DirectoryPartStage:
         # other — only the fenced publish decides whose files land
         self.dir = os.path.join(root, ".staging",
                                 f"{self.slug}.e{epoch}")
-        # begin replaces: wipe anything a crashed attempt left behind
-        shutil.rmtree(self.dir, ignore_errors=True)
+        # begin replaces — for EVERY epoch of this key: a crashed
+        # earlier owner's staging dir (different epoch, different
+        # name) would otherwise leak forever.  Exact-match the epoch
+        # suffix so a dotted sibling slug can never be swept.
+        staging_root = os.path.join(root, ".staging")
+        pat = _re.compile(rf"^{_re.escape(self.slug)}\.e\d+$")
+        try:
+            leftovers = os.listdir(staging_root)
+        except OSError:
+            leftovers = []
+        for name in leftovers:
+            if pat.match(name):
+                shutil.rmtree(os.path.join(staging_root, name),
+                              ignore_errors=True)
         os.makedirs(self.dir, exist_ok=True)
         self.inner = make_inner(self.dir)
         self.state = PartStage(key, epoch, hold=False,

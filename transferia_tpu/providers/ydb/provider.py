@@ -22,6 +22,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from transferia_tpu.abstract.change_item import ChangeItem
+from transferia_tpu.abstract.commit import StagedSinker
 from transferia_tpu.abstract.errors import CategorizedError
 from transferia_tpu.abstract.interfaces import (
     AsyncSink,
@@ -199,7 +200,10 @@ class YdbStorage(Storage, ShardingStorage):
                     walk(name)
 
         walk("")
-        return sorted(out)
+        from transferia_tpu.providers.staging import is_meta_name
+
+        return sorted(p for p in out
+                      if not is_meta_name(p.rsplit("/", 1)[-1]))
 
     def _tid(self, path: str) -> TableID:
         if "/" in path:
@@ -213,6 +217,8 @@ class YdbStorage(Storage, ShardingStorage):
 
     def table_schema(self, table: TableID) -> TableSchema:
         if table not in self._schemas:
+            from transferia_tpu.providers.staging import is_meta_name
+
             desc = self.client.describe_table(
                 _full_path(self.params.database, self._path(table)))
             pkey = set(desc["primary_key"])
@@ -221,6 +227,7 @@ class YdbStorage(Storage, ShardingStorage):
                           primary_key=name in pkey,
                           original_type=f"ydb:{_type_name(t)}")
                 for name, t in desc["columns"]
+                if not is_meta_name(name)
             ]
             self._schemas[table] = TableSchema(cols)
             self._keys[table] = desc["primary_key"]
@@ -334,12 +341,29 @@ def _type_name(t) -> str:
 # -- sink (sink.go: BulkUpsert writer) ---------------------------------------
 
 
-class YdbSinker(Sinker):
+class YdbSinker(Sinker, StagedSinker):
+    """BulkUpsert sink.
+
+    Staged-commit capable (abstract/commit.py): with an open part stage
+    batches bulk-upsert into a per-(part, epoch) staging table and the
+    publish is ONE interactive transaction (a single multi-statement
+    ExecuteDataQuery) carrying the part epoch in a commit-marker row:
+    DELETE this part's previous rows from the final table (addressed by
+    the hidden `__trtpu_part` column), UPSERT the staged rows in, and
+    UPSERT the `(part_key, epoch)` marker into `__trtpu_commits` —
+    append-only (PK includes the epoch), so the max-epoch fence a
+    zombie's stale-epoch publish breaks on can never regress.  Same
+    fence bound as the PG sink: the epoch check reads before the
+    publish txn — the coordinator's commit_part keeps two LIVE owners
+    apart; this fence is the late-zombie backstop."""
+
     def __init__(self, params: YdbTargetParams):
         self.params = params
         self.client = YdbClient(params.endpoint, params.database,
                                 params.auth_token)
         self._created: set[TableID] = set()
+        self._stage = None  # staging.WireStage when open
+        self._fence_ready = False
 
     def _path(self, tid: TableID) -> str:
         name = f"{tid.namespace}/{tid.name}" if tid.namespace \
@@ -349,14 +373,21 @@ class YdbSinker(Sinker):
     def _ensure_table(self, tid: TableID, schema: TableSchema) -> None:
         if tid in self._created:
             return
-        cols = ", ".join(
+        from transferia_tpu.providers.staging import META_COLUMN
+
+        cols = [
             f"{_q(c.name)} {map_target_type('ydb', c.data_type, 'Utf8')}"
             for c in schema
-        )
+        ]
+        if self._stage is not None:
+            # staged lifecycle: the final table carries the hidden part
+            # column the transactional publish replaces by
+            cols.append(f"{_q(META_COLUMN)} Utf8")
         keys = [c.name for c in schema if c.primary_key] \
             or [schema.names()[0]]
         ddl = (f"CREATE TABLE IF NOT EXISTS {_q(self._path(tid))} "
-               f"({cols}, PRIMARY KEY ({', '.join(_q(k) for k in keys)}))")
+               f"({', '.join(cols)}, "
+               f"PRIMARY KEY ({', '.join(_q(k) for k in keys)}))")
         self.client.execute_scheme(ddl)
         self._created.add(tid)
 
@@ -376,6 +407,9 @@ class YdbSinker(Sinker):
 
     def push(self, batch: Batch) -> None:
         if is_columnar(batch):
+            if self._stage is not None:
+                self._stage_push(batch)
+                return
             self._push_rows(batch.table_id, batch.schema, batch.to_rows())
             return
         items = list(batch)
@@ -383,14 +417,25 @@ class YdbSinker(Sinker):
         for it in items:
             if it.kind in (Kind.INIT_TABLE_LOAD, Kind.INIT_SHARDED_TABLE_LOAD):
                 if it.table_schema is not None:
-                    if it.kind == Kind.INIT_SHARDED_TABLE_LOAD or \
-                            not it.part_id:
+                    if (it.kind == Kind.INIT_SHARDED_TABLE_LOAD or
+                            not it.part_id) and self._stage is None:
+                        # staged lifecycle skips the drop: "publish
+                        # replaces" makes it unnecessary, and a zombie's
+                        # late init must never destroy the survivor's
+                        # published rows
                         self._cleanup(it.table_id)
                     self._ensure_table(it.table_id, it.table_schema)
                 continue
             if not it.is_row_event():
                 continue
             rows.append(it)
+        if rows and self._stage is not None:
+            # row-event batches under an open stage route through the
+            # staging table like every other sink — a direct write
+            # would be visible pre-publish and unaddressable by the
+            # publish's DELETE (no part column)
+            self._stage_push(ColumnBatch.from_rows(rows))
+            return
         if rows:
             by_table: dict[TableID, list[ChangeItem]] = {}
             for it in rows:
@@ -420,7 +465,8 @@ class YdbSinker(Sinker):
             self._bulk_upsert(tid, schema, pending)
 
     def _bulk_upsert(self, tid: TableID, schema: TableSchema,
-                     upserts: list[ChangeItem]) -> None:
+                     upserts: list[ChangeItem],
+                     path: Optional[str] = None) -> None:
         members = []
         type_ids = []
         for c in schema:
@@ -443,7 +489,7 @@ class YdbSinker(Sinker):
                         v = json.dumps(v)
                     parts.append(w.value_primitive(type_id, v))
             rows.append(w.value_items(parts))
-        self.client.bulk_upsert(self._path(tid), row_type, rows)
+        self.client.bulk_upsert(path or self._path(tid), row_type, rows)
 
     def _delete(self, tid: TableID, schema: TableSchema,
                 it: ChangeItem) -> None:
@@ -465,6 +511,163 @@ class YdbSinker(Sinker):
 
     def close(self) -> None:
         self.client.close()
+
+    # -- StagedSinker (publish = one interactive transaction) ---------------
+    def _stage_path(self, stage) -> str:
+        return _full_path(self.params.database, stage.table)
+
+    def _commits_path(self) -> str:
+        from transferia_tpu.providers.staging import COMMITS_TABLE
+
+        return _full_path(self.params.database, COMMITS_TABLE)
+
+    def _ensure_fence_table(self) -> None:
+        if self._fence_ready:
+            return
+        # APPEND-ONLY fence (PK includes the epoch): a zombie's marker
+        # row never regresses the max-epoch fence value
+        self.client.execute_scheme(
+            f"CREATE TABLE IF NOT EXISTS {_q(self._commits_path())} "
+            f"(`part_key` Utf8, `epoch` Int64, "
+            f"PRIMARY KEY (`part_key`, `epoch`))")
+        self._fence_ready = True
+
+    def begin_part(self, key: str, epoch: int) -> None:
+        from transferia_tpu.providers.staging import (
+            WireStage,
+            stage_ident_prefix,
+        )
+
+        stage = WireStage(key, epoch)
+        # begin replaces — for EVERY epoch of this key (a crashed
+        # earlier owner's staging table would otherwise leak forever)
+        pfx = stage_ident_prefix(key)
+        try:
+            names = [e["name"] for e in
+                     self.client.list_directory(self.params.database)
+                     if e["type"] == 2]
+        except (YdbError, w.YdbOperationError):
+            names = [stage.table]  # listing degraded: current only
+        for name in names:
+            if not name.startswith(pfx):
+                continue
+            try:
+                self.client.execute_scheme(
+                    f"DROP TABLE "
+                    f"{_q(_full_path(self.params.database, name))}")
+            except (YdbError, w.YdbOperationError):
+                pass  # raced another sweeper / absent
+        self._ensure_fence_table()
+        self._stage = stage
+
+    def _stage_push(self, batch) -> None:
+        stage = self._stage
+        staged = stage.state.stage(batch)
+        if stage.schema is None:
+            stage.tid = batch.table_id
+            stage.schema = batch.schema
+            cols = ", ".join(
+                f"{_q(c.name)} "
+                f"{map_target_type('ydb', c.data_type, 'Utf8')}"
+                for c in batch.schema)
+            keys = [c.name for c in batch.schema if c.primary_key] \
+                or [batch.schema.names()[0]]
+            self.client.execute_scheme(
+                f"CREATE TABLE IF NOT EXISTS "
+                f"{_q(self._stage_path(stage))} ({cols}, "
+                f"PRIMARY KEY ({', '.join(_q(k) for k in keys)}))")
+        if staged.n_rows == 0:
+            return
+        try:
+            self._bulk_upsert(stage.tid, stage.schema, staged.to_rows(),
+                              path=self._stage_path(stage))
+        except BaseException:
+            # the staging write died after the dedup window recorded
+            # this batch: only a full part restage is safe
+            stage.state.mark_failed()
+            raise
+
+    def _fence_epoch(self, slug: str):
+        from transferia_tpu.providers.ydb.client import yql_literal
+
+        rs = self.client.execute_query(
+            f"SELECT `epoch` FROM {_q(self._commits_path())} "
+            f"WHERE `part_key` = {yql_literal(slug)}")
+        rows = rs[0]["rows"] if rs else []
+        epochs = [int(r[0]) for r in rows if r and r[0] is not None]
+        return max(epochs) if epochs else None
+
+    def publish_part(self, key: str, epoch: int) -> int:
+        from transferia_tpu.abstract.errors import StaleEpochPublishError
+        from transferia_tpu.chaos.failpoints import failpoint
+        from transferia_tpu.providers.staging import (
+            META_COLUMN,
+            publish_guard,
+        )
+        from transferia_tpu.providers.ydb.client import yql_literal
+        from transferia_tpu.stats import trace
+
+        stage = self._stage
+        if stage is None or stage.key != key:
+            raise RuntimeError(f"ydb sink: no open stage for {key!r}")
+        with publish_guard(key, epoch):
+            prev = self._fence_epoch(stage.slug)
+            if prev is not None and epoch < prev:
+                raise StaleEpochPublishError(key, epoch, prev)
+            trace.instant("ydb_publish_txn", part=key, epoch=epoch,
+                          rows=stage.state.rows)
+            failpoint("sink.ydb.publish")
+            slug_lit = yql_literal(stage.slug)
+            stmts = []
+            if stage.schema is not None:
+                self._ensure_table(stage.tid, stage.schema)
+                final = _q(self._path(stage.tid))
+                try:
+                    # retrofit the part column onto a final table an
+                    # at-least-once run created (idempotent: an
+                    # already-present column errors and is ignored)
+                    self.client.execute_scheme(
+                        f"ALTER TABLE {final} ADD COLUMN "
+                        f"{_q(META_COLUMN)} Utf8")
+                except (YdbError, w.YdbOperationError):
+                    pass  # column already exists
+                stmts.append(
+                    f"DELETE FROM {final} "
+                    f"WHERE {_q(META_COLUMN)} = {slug_lit}")
+                stmts.append(
+                    f"UPSERT INTO {final} "
+                    f"SELECT *, {slug_lit} AS {_q(META_COLUMN)} "
+                    f"FROM {_q(self._stage_path(stage))}")
+            stmts.append(
+                f"UPSERT INTO {_q(self._commits_path())} "
+                f"(`part_key`, `epoch`) VALUES ({slug_lit}, {epoch})")
+            # one interactive transaction: the part's replacement and
+            # its commit-marker row land atomically or not at all
+            self.client.execute_query(";\n".join(stmts))
+            try:
+                self.client.execute_scheme(
+                    f"DROP TABLE {_q(self._stage_path(stage))}")
+            except (YdbError, w.YdbOperationError):
+                pass  # empty part never created the staging table
+            self.last_dedup_dropped = stage.state.dedup_dropped
+            rows = stage.state.rows
+        self._stage = None
+        return rows
+
+    def abort_part(self, key: str) -> None:
+        stage = self._stage
+        if stage is None or stage.key != key:
+            return
+        self._stage = None
+        try:
+            self.client.execute_scheme(
+                f"DROP TABLE {_q(self._stage_path(stage))}")
+        except (YdbError, w.YdbOperationError):
+            pass  # nothing staged
+
+    def note_push_retry(self) -> None:
+        if self._stage is not None:
+            self._stage.state.note_push_retry()
 
 
 # -- changefeed CDC source (source.go + cdc_converter.go) --------------------

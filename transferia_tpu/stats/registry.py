@@ -343,6 +343,7 @@ class DistributedFleetStats(_Bundle):
         self.worker_exits = self.m.counter("fleet_worker_exits")
         self.autoscale_ups = self.m.counter("fleet_autoscale_ups")
         self.autoscale_downs = self.m.counter("fleet_autoscale_downs")
+        self.gc_pruned = self.m.counter("fleet_tickets_gc_pruned")
         self.queued = self.m.gauge("fleet_dist_queued")
         self.inflight = self.m.gauge("fleet_dist_inflight")
         self.desired_workers = self.m.gauge("fleet_dist_desired_workers")
