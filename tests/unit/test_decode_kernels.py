@@ -422,20 +422,42 @@ def test_placement_memoized_pool_is_free(monkeypatch):
     assert step._pick_strategy(batch.n_rows, batch) == "device"
 
 
-def test_placement_mesh_route_charges_raw_wire(monkeypatch):
-    """A batch big enough for the MESH program flattens dict columns
-    onto the raw block wire (the pool route is single-device only) —
-    the link estimate must charge that, memoized pool or not."""
+def test_placement_mesh_route_charges_dict_wire(monkeypatch):
+    """A batch big enough for the MESH program takes the dict-aware
+    mesh route: sharded int32 codes (4 B/row) + one pool digest upload
+    instead of the per-row block matrix — the link estimate must charge
+    the codes wire, far below the flat wire's 128 B/row."""
     step = _planned_step(monkeypatch)
     if step.sharded_program is None:
         pytest.skip("needs the virtual multi-device mesh")
     pool = _fresh_pool(k=4096)
-    pool.memo_set(("hmac_hex", b"s"), _fresh_pool(k=4096))
     n = max(step._sharded_min_rows, 131072)
     batch = _dict_batch(pool, n=n, nulls=False)
     dsp.set_dispatch_encoding("auto")
+    h2d_cold, d2h_cold = step._estimate_link_bytes(batch.n_rows, batch)
+    # cold pool: one upload (128 B/value) + the codes, never 128 B/row
+    assert h2d_cold < 64.0 * n
+    assert d2h_cold >= 32.0 * n  # gathered digest words still return
+    # digest matrix memoized: the pool upload term disappears
+    pool.memo_set(("hmac_digest_rows", b"s"),
+                  np.zeros((pool.n_values, 8), dtype=np.uint32))
+    h2d_warm, _ = step._estimate_link_bytes(batch.n_rows, batch)
+    assert h2d_warm < h2d_cold
+
+
+def test_placement_mesh_route_rejected_pool_charges_flat(monkeypatch):
+    """An economics-rejected pool (bigger than 2x the batch, no memo)
+    still flattens onto the mesh block wire — the estimate must charge
+    the full per-row block matrix for it."""
+    step = _planned_step(monkeypatch)
+    if step.sharded_program is None:
+        pytest.skip("needs the virtual multi-device mesh")
+    n = max(step._sharded_min_rows, 8192)
+    pool = _fresh_pool(k=4 * n)
+    batch = _dict_batch(pool, n=n, nulls=False)
+    dsp.set_dispatch_encoding("auto")
     h2d, _ = step._estimate_link_bytes(batch.n_rows, batch)
-    assert h2d >= 128.0 * n  # full block matrix, not the free memo
+    assert h2d >= 128.0 * n  # full block matrix, not the codes wire
 
 
 # -- double-buffered pipelined dispatch -------------------------------------

@@ -1,0 +1,681 @@
+"""Encoded-everywhere conformance suite (the PR-14 byte-identity net).
+
+Pins the three new encoded lanes against their flat references:
+ - source: parquet dict pages adopt as SHARED DictPools on the native
+   AND arrow paths (cross-row-group/cross-part interning, pool-copy
+   economics), with `dict_flat_materializations == 0` from file byte to
+   sink and digests identical to a forced-flat decode;
+ - wires: the dict-aware mesh mask route produces digests byte-identical
+   to the flat block wire (incl. all-null and empty pools), and the
+   pool-once Flight/IPC/shm wire ships each pool at most once per
+   stream, round-trips byte-identically, and stays correct when a
+   republish carries a DIFFERENT pool;
+ - frames: frame-of-reference delta frames reconstruct exactly on the
+   edge shapes (constants, negatives, INT32_MIN spans) and reject when
+   they would not shrink.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from transferia_tpu.abstract.schema import (
+    CanonicalType,
+    TableID,
+    new_table_schema,
+)
+from transferia_tpu.columnar.batch import (
+    Column,
+    ColumnBatch,
+    DictEnc,
+    DictPool,
+    _offsets_from_lengths,
+    intern_pool,
+    reset_intern_cache,
+)
+from transferia_tpu.ops import dispatch as dsp
+from transferia_tpu.stats.trace import TELEMETRY
+
+TID = TableID("ew", "t")
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    from transferia_tpu.columnar import batch as batch_mod
+
+    reset_intern_cache()
+    TELEMETRY.reset()
+    yield
+    reset_intern_cache()
+    with batch_mod._POOL_CACHE_LOCK:
+        # address-keyed arrow adoptions pin their source arrays; drop
+        # them so shm segments unmap before interpreter teardown
+        batch_mod._POOL_CACHE.clear()
+
+
+def _pool(values: list[bytes], sentinel: bool = True) -> DictPool:
+    data = np.frombuffer(b"".join(values), dtype=np.uint8).copy()
+    lens = [len(v) for v in values] + ([0] if sentinel else [])
+    off = _offsets_from_lengths(lens)
+    return DictPool(data, off,
+                    null_code=len(values) if sentinel else None)
+
+
+def _dict_batch(pool, codes, validity=None,
+                name: str = "s") -> ColumnBatch:
+    schema = new_table_schema([(name, "utf8")])
+    col = Column(name, CanonicalType.UTF8, validity=validity,
+                 dict_enc=DictEnc(np.asarray(codes, dtype=np.int32),
+                                  pool=pool))
+    return ColumnBatch(TID, schema, {name: col})
+
+
+# -- pool interning ----------------------------------------------------------
+
+class TestPoolInterning:
+    def test_identical_content_converges(self):
+        a = intern_pool(("k",), *_pool_bufs([b"aa", b"bb"]), null_code=2)
+        b = intern_pool(("k",), *_pool_bufs([b"aa", b"bb"]), null_code=2)
+        assert a is b
+        assert TELEMETRY.snapshot()["dict_pool_share_hits"] == 1
+
+    def test_changed_content_replaces(self):
+        a = intern_pool(("k",), *_pool_bufs([b"aa"]), null_code=1)
+        b = intern_pool(("k",), *_pool_bufs([b"zz"]), null_code=1)
+        assert a is not b
+
+    def test_null_code_is_part_of_identity(self):
+        a = intern_pool(None, *_pool_bufs([b"aa"]), null_code=1)
+        b = intern_pool(None, *_pool_bufs([b"aa"]), null_code=None)
+        assert a is not b
+
+    def test_sharing_kill_switch(self, monkeypatch):
+        monkeypatch.setenv("TRANSFERIA_TPU_POOL_SHARING", "0")
+        a = intern_pool(("k",), *_pool_bufs([b"aa"]), null_code=1)
+        b = intern_pool(("k",), *_pool_bufs([b"aa"]), null_code=1)
+        assert a is not b
+
+    def test_finalize_runs_only_on_store(self):
+        calls = []
+
+        def fin(d, o):
+            calls.append(1)
+            return d, o
+
+        intern_pool(("f",), *_pool_bufs([b"aa"]), null_code=1,
+                    finalize=fin)
+        intern_pool(("f",), *_pool_bufs([b"aa"]), null_code=1,
+                    finalize=fin)
+        assert len(calls) == 1  # the hit discarded its candidate
+
+    def test_sample_source_pools_stable_across_batches(self):
+        from transferia_tpu.providers.sample import make_batch
+
+        tid = TableID("sample", "events")
+        b1 = make_batch("iot", tid, 0, 64, seed=3, dict_encode=True)
+        b2 = make_batch("iot", tid, 64, 64, seed=3, dict_encode=True)
+        assert b1.columns["status"].dict_enc.pool \
+            is b2.columns["status"].dict_enc.pool
+        assert b1.columns["device_id"].dict_enc.pool \
+            is b2.columns["device_id"].dict_enc.pool
+
+
+def _pool_bufs(values):
+    data = np.frombuffer(b"".join(values), dtype=np.uint8).copy()
+    off = _offsets_from_lengths([len(v) for v in values] + [0])
+    return data, off
+
+
+# -- parquet source adoption -------------------------------------------------
+
+def _write_dict_parquet(tmp_path, rows=4000, row_group_size=1000,
+                        uniques=8):
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    # tile a fixed period so every row group's dictionary page carries
+    # the values in the SAME first-occurrence order — the file-level-
+    # identical pages the cross-row-group pool sharing keys on
+    s = [f"val-{i % uniques}" for i in range(rows)]
+    t = pa.table({"s": pa.array(s),
+                  "i": pa.array(np.arange(rows, dtype=np.int64))})
+    p = str(tmp_path / "dict.parquet")
+    pq.write_table(t, p, row_group_size=row_group_size,
+                   use_dictionary=True)
+    return p, s
+
+
+class TestParquetPoolSharing:
+    def test_native_pools_shared_across_row_groups_and_readers(
+            self, tmp_path):
+        from transferia_tpu.columnar.batch import arrow_to_table_schema
+        from transferia_tpu.providers.parquet_native import (
+            NativeParquetReader,
+            parquet_file_cached,
+        )
+
+        p, _ = _write_dict_parquet(tmp_path)
+        pf = parquet_file_cached(p)
+        schema = arrow_to_table_schema(pf.schema_arrow)
+        r = NativeParquetReader.open(p, pf, schema)
+        if r is None:
+            pytest.skip("native parquet lib unavailable")
+        pools = {id(r.read_row_group(g)["s"].dict_enc.pool)
+                 for g in range(pf.metadata.num_row_groups)}
+        assert len(pools) == 1
+        # a second reader (another part thread) rides the same pool
+        r2 = NativeParquetReader.open(p, parquet_file_cached(p), schema)
+        assert id(r2.read_row_group(0)["s"].dict_enc.pool) in pools
+
+    def test_permuted_pages_remap_onto_canonical_pool(self, tmp_path):
+        """Row groups whose dictionaries carry the same values in a
+        DIFFERENT first-occurrence order (what pyarrow writes when the
+        data pattern straddles row-group boundaries) still converge on
+        one pool — codes rewrite through the verified remap, values
+        byte-exact."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from transferia_tpu.columnar.batch import arrow_to_table_schema
+        from transferia_tpu.providers.parquet_native import (
+            NativeParquetReader,
+            parquet_file_cached,
+        )
+
+        rows, uniques, rg = 4000, 7, 1000  # 1000 % 7 != 0: permuted
+        vals = [f"value-{i % uniques}" for i in range(rows)]
+        p = str(tmp_path / "perm.parquet")
+        pq.write_table(pa.table({"s": pa.array(vals)}), p,
+                       row_group_size=rg, use_dictionary=True)
+        pf = parquet_file_cached(p)
+        r = NativeParquetReader.open(
+            p, pf, arrow_to_table_schema(pf.schema_arrow))
+        if r is None:
+            pytest.skip("native parquet lib unavailable")
+        cols = [r.read_row_group(g)["s"]
+                for g in range(pf.metadata.num_row_groups)]
+        assert len({id(c.dict_enc.pool) for c in cols}) == 1
+        got = [v for c in cols for v in c.to_pylist()]
+        assert got == vals
+        assert TELEMETRY.snapshot()["dict_pool_share_hits"] >= 3
+
+    def test_remap_rejects_new_dictionary(self, tmp_path):
+        """A page carrying a value OUTSIDE the canonical pool must not
+        remap — it re-interns (replacing the canonical), and every
+        value still decodes exactly."""
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from transferia_tpu.columnar.batch import arrow_to_table_schema
+        from transferia_tpu.providers.parquet_native import (
+            NativeParquetReader,
+            parquet_file_cached,
+        )
+
+        vals = [f"a-{i % 4}" for i in range(1000)] \
+            + [f"b-{i % 4}" for i in range(1000)]  # disjoint sets
+        p = str(tmp_path / "newdict.parquet")
+        pq.write_table(pa.table({"s": pa.array(vals)}), p,
+                       row_group_size=1000, use_dictionary=True)
+        pf = parquet_file_cached(p)
+        r = NativeParquetReader.open(
+            p, pf, arrow_to_table_schema(pf.schema_arrow))
+        if r is None:
+            pytest.skip("native parquet lib unavailable")
+        c0 = r.read_row_group(0)["s"]
+        c1 = r.read_row_group(1)["s"]
+        assert c0.dict_enc.pool is not c1.dict_enc.pool
+        assert c0.to_pylist() + c1.to_pylist() == vals
+
+    def test_arrow_path_adopts_and_shares(self, tmp_path, monkeypatch):
+        from transferia_tpu.abstract.table import TableDescription
+        from transferia_tpu.providers.file import (
+            FileSourceParams,
+            FileStorage,
+        )
+
+        monkeypatch.setenv("TRANSFERIA_TPU_NATIVE_PARQUET", "0")
+        p, vals = _write_dict_parquet(tmp_path)
+        st = FileStorage(FileSourceParams(path=p, table="t"))
+        batches = []
+        for d in st.shard_table(TableDescription(id=TableID("fs", "t"))):
+            st.load_table(d, batches.append)
+        assert all(b.columns["s"].is_lazy_dict for b in batches)
+        assert len({id(b.columns["s"].dict_enc.pool)
+                    for b in batches}) == 1
+        got = [v for b in batches
+               for v in b.columns["s"].to_pylist()]
+        assert got == vals
+
+    def test_dict_adopt_failpoint_recovers_via_arrow(self, tmp_path):
+        from transferia_tpu.chaos import failpoints
+        from transferia_tpu.columnar.batch import arrow_to_table_schema
+        from transferia_tpu.providers.parquet_native import (
+            NativeParquetReader,
+            parquet_file_cached,
+        )
+
+        p, vals = _write_dict_parquet(tmp_path)
+        pf = parquet_file_cached(p)
+        schema = arrow_to_table_schema(pf.schema_arrow)
+        r = NativeParquetReader.open(p, pf, schema)
+        if r is None:
+            pytest.skip("native parquet lib unavailable")
+        failpoints.configure("decode.dict_adopt=raise:IOError", seed=1)
+        try:
+            cols = r.read_row_group(0)
+        finally:
+            failpoints.reset()
+        # adoption failed -> the arrow fallback still completed the group
+        assert cols["s"].to_pylist() == vals[:1000]
+
+    def test_pool_copy_heuristic_counts_decisions(self, tmp_path):
+        from transferia_tpu.columnar.batch import arrow_to_table_schema
+        from transferia_tpu.providers.parquet_native import (
+            NativeParquetReader,
+            parquet_file_cached,
+        )
+
+        p, _ = _write_dict_parquet(tmp_path)
+        pf = parquet_file_cached(p)
+        schema = arrow_to_table_schema(pf.schema_arrow)
+        r = NativeParquetReader.open(p, pf, schema)
+        if r is None:
+            pytest.skip("native parquet lib unavailable")
+        r.read_row_group(0)
+        snap = TELEMETRY.snapshot()
+        # a tiny pool against a code-page-sized buffer must COPY out
+        # (never pin the decode buffer), and the decision is counted
+        assert snap["dict_pool_copied_bytes"] > 0 \
+            or snap["dict_pool_pinned_bytes"] > 0
+
+    def test_snapshot_zero_flats_and_digest_vs_flat_decode(
+            self, tmp_path, monkeypatch):
+        from transferia_tpu.abstract.table import TableDescription
+        from transferia_tpu.ops.rowhash import TableFingerprinter
+        from transferia_tpu.providers import parquet_native
+        from transferia_tpu.providers.file import (
+            FileSourceParams,
+            FileStorage,
+        )
+
+        p, _ = _write_dict_parquet(tmp_path)
+        tid = TableID("fs", "t")
+
+        def load(flat: bool):
+            if flat:
+                # forced-flat reference: arrow decode, dict reads off
+                monkeypatch.setenv("TRANSFERIA_TPU_NATIVE_PARQUET", "0")
+                monkeypatch.setattr(parquet_native,
+                                    "dict_encoded_columns",
+                                    lambda meta, names: ())
+            st = FileStorage(FileSourceParams(path=p, table="t"))
+            batches = []
+            for d in st.shard_table(TableDescription(id=tid)):
+                st.load_table(d, batches.append)
+            return batches
+
+        dict_batches = load(flat=False)
+        TELEMETRY.reset()
+        fp = TableFingerprinter(backend="host")
+        for b in dict_batches:
+            fp.push(b)
+        dict_digest = fp.result().digest()
+        assert TELEMETRY.snapshot()["dict_flat_materializations"] == 0
+        flat_batches = load(flat=True)
+        assert not any(c.is_lazy_dict for b in flat_batches
+                       for c in b.columns.values())
+        fp2 = TableFingerprinter(backend="host")
+        for b in flat_batches:
+            fp2.push(b)
+        assert fp2.result().digest() == dict_digest
+
+    def test_fs_snapshot_to_memory_sink_zero_flats(self, tmp_path):
+        from transferia_tpu.coordinator import MemoryCoordinator
+        from transferia_tpu.models import Transfer
+        from transferia_tpu.providers.memory import (
+            MemoryTargetParams,
+            get_store,
+        )
+        from transferia_tpu.providers.file import FileSourceParams
+        from transferia_tpu.tasks import SnapshotLoader
+
+        p, vals = _write_dict_parquet(tmp_path)
+        sid = "encoded-wire-snap"
+        t = Transfer(
+            id=sid,
+            src=FileSourceParams(path=p, table="t"),
+            dst=MemoryTargetParams(sink_id=sid),
+        )
+        TELEMETRY.reset()
+        SnapshotLoader(t, MemoryCoordinator(),
+                       operation_id=f"op-{sid}").upload_tables()
+        snap = TELEMETRY.snapshot()
+        assert snap["dict_flat_materializations"] == 0, snap
+        assert len(get_store(sid).rows()) == len(vals)
+
+
+# -- FOR delta frames --------------------------------------------------------
+
+class TestForFrames:
+    def _roundtrip(self, data, n=None):
+        n = len(data) if n is None else n
+        spec, arrays, _raw = dsp.encode_pred_column(
+            "c", data, None, len(data), n, True)
+        out, _ = dsp.decode_pred_device(spec, arrays, n)
+        return spec, np.asarray(out).astype(data.dtype)[:len(data)]
+
+    def test_for_kicks_in_where_delta_rejects(self):
+        # alternating far-apart clusters: zigzag deltas blow past 30
+        # bits (delta rejects) but the global span fits int32 (FOR wins)
+        data = np.where(np.arange(4096) % 2 == 0,
+                        np.int64(-2**31), np.int64(2**31 - 1))
+        spec, out = self._roundtrip(data)
+        assert spec.kind == "for"
+        np.testing.assert_array_equal(out, data)
+
+    def test_constants(self, monkeypatch):
+        # force FOR past the (better) delta wire to pin its own math
+        monkeypatch.setattr(dsp, "encode_delta", lambda d: None)
+        data = np.full(2048, -7, dtype=np.int64)
+        spec, out = self._roundtrip(data)
+        assert spec.kind == "for"
+        np.testing.assert_array_equal(out, data)
+
+    def test_negatives_and_int32_min(self, monkeypatch):
+        monkeypatch.setattr(dsp, "encode_delta", lambda d: None)
+        rng = np.random.default_rng(5)
+        data = (np.int64(-2**31)
+                + rng.integers(0, 1000, 2048)).astype(np.int64)
+        spec, out = self._roundtrip(data)
+        assert spec.kind == "for"
+        np.testing.assert_array_equal(out, data)
+
+    def test_out_of_int32_range_rejects_to_raw(self, monkeypatch):
+        monkeypatch.setattr(dsp, "encode_delta", lambda d: None)
+        data = np.array([2**40, 0] * 1024, dtype=np.int64)
+        spec, out = self._roundtrip(data)
+        assert spec.kind == "raw"
+        np.testing.assert_array_equal(out, data)
+
+    def test_no_shrink_rejects(self, monkeypatch):
+        monkeypatch.setattr(dsp, "encode_delta", lambda d: None)
+        # int32 raw with a full-width span: 32-bit remainders can't win
+        data = np.where(np.arange(4096) % 2 == 0,
+                        np.int32(-2**31), np.int32(2**31 - 1))
+        spec, _ = self._roundtrip(data)
+        assert spec.kind == "raw"
+
+    def test_frame_knob_off_disables(self, monkeypatch):
+        monkeypatch.setattr(dsp, "encode_delta", lambda d: None)
+        dsp.set_for_frame(0)
+        try:
+            data = np.full(2048, 9, dtype=np.int64)
+            spec, _ = self._roundtrip(data)
+            assert spec.kind == "raw"
+        finally:
+            dsp.set_for_frame(None)
+
+    def test_sharded_for_parity(self, monkeypatch):
+        monkeypatch.setattr(dsp, "_encode_delta_sharded",
+                            lambda d2: None)
+        rng = np.random.default_rng(9)
+        n_dev, per = 4, 1024
+        data = (np.int64(1_000_000)
+                + rng.integers(0, 5000, n_dev * per)).astype(np.int64)
+        spec, arrays, _ = dsp.encode_pred_column_sharded(
+            "c", data, None, n_dev * per, n_dev, per, True)
+        assert spec.kind == "for"
+        d2 = data.reshape(n_dev, per)
+        for s in range(n_dev):
+            out, _ = dsp.decode_pred_device_sharded(
+                spec, tuple(a[s:s + 1] for a in arrays), per)
+            np.testing.assert_array_equal(
+                np.asarray(out).astype(np.int64), d2[s])
+
+
+# -- mesh dict route ---------------------------------------------------------
+
+def _mesh_devices() -> int:
+    try:
+        import jax
+
+        return len(jax.devices())
+    except Exception:
+        return 0
+
+
+@pytest.mark.skipif(_mesh_devices() < 2,
+                    reason="needs the virtual multi-device mesh")
+class TestMeshDictRoute:
+    def _programs(self, key=b"salt"):
+        from transferia_tpu.parallel.fusedmesh import ShardedFusedProgram
+
+        return (ShardedFusedProgram([key], None),
+                ShardedFusedProgram([key], None))
+
+    def _parity(self, pool, codes, validity):
+        from transferia_tpu.parallel.fusedmesh import dict_mask_input
+
+        batch = _dict_batch(pool, codes, validity)
+        col = batch.columns["s"]
+        n = col.n_rows
+        flat_prog, dict_prog = self._programs()
+        data, offsets = col.dict_enc.materialize()
+        hex_flat, _ = flat_prog.run(
+            [(data, offsets.astype(np.int32))], {}, n)
+        dmi = dict_mask_input(b"salt", col)
+        assert dmi is not None
+        hex_dict, _ = dict_prog.run([dmi], {}, n)
+        np.testing.assert_array_equal(hex_flat[0], hex_dict[0])
+        np.testing.assert_array_equal(flat_prog.last_shard_hist,
+                                      dict_prog.last_shard_hist)
+        assert flat_prog.last_kept == dict_prog.last_kept
+
+    def test_digests_byte_identical(self):
+        rng = np.random.default_rng(2)
+        pool = _pool([f"v{i}".encode() for i in range(40)])
+        self._parity(pool, rng.integers(0, 40, 3000), None)
+
+    def test_all_null_column(self):
+        pool = _pool([b"aa", b"bb"])
+        n = 500
+        self._parity(pool, np.full(n, pool.null_code),
+                     np.zeros(n, dtype=np.bool_))
+
+    def test_empty_pool(self):
+        pool = _pool([])  # sentinel-only
+        n = 300
+        self._parity(pool, np.zeros(n, dtype=np.int32),
+                     np.zeros(n, dtype=np.bool_))
+
+    def test_economics_rejected_pool_returns_none(self):
+        from transferia_tpu.parallel.fusedmesh import dict_mask_input
+
+        pool = _pool([f"v{i}".encode() for i in range(1000)])
+        batch = _dict_batch(pool, np.zeros(10, dtype=np.int32))
+        assert dict_mask_input(b"k", batch.columns["s"]) is None
+
+    def test_wire_ships_codes_not_blocks(self):
+        from transferia_tpu.parallel.fusedmesh import dict_mask_input
+
+        rng = np.random.default_rng(4)
+        pool = _pool([f"value-{i:03d}".encode() for i in range(64)])
+        batch = _dict_batch(pool, rng.integers(0, 64, 8000))
+        col = batch.columns["s"]
+        flat_prog, dict_prog = self._programs()
+        TELEMETRY.reset()
+        dict_prog.run([dict_mask_input(b"salt", col)], {}, col.n_rows)
+        snap = TELEMETRY.snapshot()
+        # pool digests + codes are far below the raw block matrix
+        assert snap["dispatch_compression_ratio"] > 5
+
+
+# -- pool-once Flight/IPC/shm wire -------------------------------------------
+
+class TestEncodedFlightWire:
+    def _server_client(self):
+        from transferia_tpu.interchange.flight import (
+            FlightShardClient,
+            ShardFlightServer,
+        )
+
+        srv = ShardFlightServer(enable_shm=False)
+        cli = FlightShardClient(srv.location, allow_shm=False)
+        return srv, cli
+
+    def _batches(self, pool, n_batches=6, rows=400):
+        rng = np.random.default_rng(8)
+        k = max(pool.n_values - 1, 1)
+        return [_dict_batch(pool, rng.integers(0, k, rows))
+                for _ in range(n_batches)]
+
+    def test_pool_ships_once_per_stream(self):
+        from transferia_tpu.interchange.telemetry import (
+            TELEMETRY as ITEL,
+        )
+
+        pool = _pool([f"u{i}".encode() for i in range(30)])
+        batches = self._batches(pool)
+        srv, cli = self._server_client()
+        try:
+            ITEL.reset()
+            cli.put_part("ew.t/0", batches)
+            snap = ITEL.snapshot()
+            assert snap["pools_shipped"] == 1
+            assert snap["pool_bytes_shipped"] == pool.nbytes()
+            assert snap["codes_bytes_shipped"] == sum(
+                b.columns["s"].dict_enc.indices.nbytes
+                for b in batches)
+            assert snap["flat_equiv_bytes"] > \
+                snap["codes_bytes_shipped"]
+            out = cli.get_part("ew.t/0")
+            assert [v for b in out
+                    for v in b.columns["s"].to_pylist()] == \
+                [v for b in batches
+                 for v in b.columns["s"].to_pylist()]
+            # one shared pool on the import side too
+            assert len({id(b.columns["s"].dict_enc.pool)
+                        for b in out}) == 1
+        finally:
+            cli.close()
+            srv.close()
+
+    def test_all_null_and_empty_pool_round_trip(self):
+        pool = _pool([])
+        n = 50
+        batch = _dict_batch(pool, np.zeros(n, dtype=np.int32),
+                            np.zeros(n, dtype=np.bool_))
+        srv, cli = self._server_client()
+        try:
+            cli.put_part("ew.t/nulls", [batch])
+            out = cli.get_part("ew.t/nulls")
+            assert out[0].columns["s"].to_pylist() == [None] * n
+        finally:
+            cli.close()
+            srv.close()
+
+    def test_republish_with_different_pool(self):
+        from transferia_tpu.abstract.errors import (
+            StaleEpochPublishError,
+        )
+
+        pool_a = _pool([b"old-a", b"old-b"])
+        pool_b = _pool([b"new-a", b"new-b"])
+        srv, cli = self._server_client()
+        try:
+            srv.publish("ew.t/re",
+                        [_dict_batch(pool_a, [0, 1, 0])], epoch=1)
+            srv.publish("ew.t/re",
+                        [_dict_batch(pool_b, [1, 0])], epoch=2)
+            out = cli.get_part("ew.t/re")
+            assert out[0].columns["s"].to_pylist() == [b"new-b",
+                                                      b"new-a"] \
+                or out[0].columns["s"].to_pylist() == ["new-b",
+                                                      "new-a"]
+            with pytest.raises(StaleEpochPublishError):
+                srv.publish("ew.t/re",
+                            [_dict_batch(pool_a, [0])], epoch=1)
+        finally:
+            cli.close()
+            srv.close()
+
+    def test_encoded_wire_toggle_off_is_flat_and_identical(self):
+        from transferia_tpu.interchange import convert
+        from transferia_tpu.interchange.telemetry import (
+            TELEMETRY as ITEL,
+        )
+
+        pool = _pool([f"x{i}".encode() for i in range(10)])
+        batches = self._batches(pool, n_batches=3, rows=100)
+        want = [v for b in batches
+                for v in b.columns["s"].to_pylist()]
+        srv, cli = self._server_client()
+        try:
+            convert.set_encoded_wire(False)
+            ITEL.reset()
+            cli.put_part("ew.t/flat", batches)
+            assert ITEL.snapshot()["pools_shipped"] == 0
+            out = cli.get_part("ew.t/flat")
+            assert not any(b.columns["s"].is_lazy_dict for b in out)
+            assert [v for b in out
+                    for v in b.columns["s"].to_pylist()] == want
+            # the source columns stayed lazy (no shared-state flatten)
+            assert all(b.columns["s"].is_lazy_dict for b in batches)
+        finally:
+            convert.set_encoded_wire(None)
+            cli.close()
+            srv.close()
+
+    def test_pool_ship_failpoint_fails_whole_put(self):
+        from transferia_tpu.chaos import failpoints
+
+        pool = _pool([b"aa", b"bb"])
+        batches = self._batches(pool, n_batches=2, rows=20)
+        srv, cli = self._server_client()
+        try:
+            failpoints.configure("flight.pool_ship=raise:IOError",
+                                 seed=1)
+            try:
+                with pytest.raises(OSError):
+                    cli.put_part("ew.t/fp", batches)
+            finally:
+                failpoints.reset()
+            # nothing half-streamed is readable; the retry re-ships
+            assert cli.put_part("ew.t/fp", batches) == 40
+            out = cli.get_part("ew.t/fp")
+            assert sum(b.n_rows for b in out) == 40
+        finally:
+            cli.close()
+            srv.close()
+
+    def test_ipc_and_shm_streams_account_pool_once(self, tmp_path):
+        from transferia_tpu.interchange import ipc, shm
+        from transferia_tpu.interchange.telemetry import (
+            TELEMETRY as ITEL,
+        )
+
+        pool = _pool([f"i{i}".encode() for i in range(12)])
+        batches = self._batches(pool, n_batches=4, rows=64)
+        ITEL.reset()
+        loc = str(tmp_path / "s.arrows")
+        ipc.write_stream(loc, batches)
+        assert ITEL.snapshot()["pools_shipped"] == 1
+        with open(loc, "rb") as fh:
+            got = list(ipc.iter_stream(fh))
+        assert [v for b in got
+                for v in b.columns["s"].to_pylist()] == \
+            [v for b in batches
+             for v in b.columns["s"].to_pylist()]
+        ITEL.reset()
+        handle = shm.write_segment(batches)
+        try:
+            assert ITEL.snapshot()["pools_shipped"] == 1
+            att = shm.attach(handle)
+            got = att.batches()
+            assert sum(b.n_rows for b in got) == 4 * 64
+            del got
+            att.close()
+        finally:
+            shm.unlink_segment(handle)

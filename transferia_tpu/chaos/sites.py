@@ -32,6 +32,18 @@ SITES: dict[str, tuple[str, str]] = {
         "providers/parquet_native.py",
         "native C++ row-group decode failing (corrupt page, codec "
         "error) — exercises the arrow/native fallback seams"),
+    "decode.dict_adopt": (
+        "providers/parquet_native.py",
+        "dict-page pool adoption failing (corrupt dict page offsets, "
+        "interning fault) before the pool is shared — the row group "
+        "must fail cleanly into the arrow fallback/part retry, never "
+        "publish a half-adopted pool"),
+    "flight.pool_ship": (
+        "interchange/flight.py",
+        "encoded Flight wire failing exactly as a stream ships a dict "
+        "POOL (first batch referencing it) — the put must fail whole "
+        "and the retried stream must re-ship the pool; consumers never "
+        "see codes without their pool"),
     "decode.readahead.worker": (
         "providers/readahead.py",
         "prefetch worker dying mid-decode: the error must re-raise on "

@@ -211,6 +211,94 @@ _POOL_CACHE: dict = {}
 _POOL_CACHE_MAX = 64
 _POOL_CACHE_LOCK = threading.Lock()
 
+# Content-interned pools (intern_pool): every producer that re-creates a
+# value pool with identical bytes — the native parquet reader decoding
+# one file's per-row-group dict pages, the sample source re-emitting its
+# preset pools per batch — converges on ONE DictPool object, so the
+# pool-keyed memos (hexed HMAC pool, rowhash accumulators, device digest
+# matrices, arrow wrapping) amortize across row groups AND parts.
+_INTERN_CACHE: dict = {}   # key -> (content digest, DictPool)
+_INTERN_CACHE_MAX = 128
+
+
+def pool_sharing_enabled() -> bool:
+    """TRANSFERIA_TPU_POOL_SHARING=0 disables content interning (each
+    producer keeps private pools — the pre-sharing wire)."""
+    import os
+
+    return os.environ.get("TRANSFERIA_TPU_POOL_SHARING", "1") != "0"
+
+
+def _pool_digest(values_data: np.ndarray, values_offsets: np.ndarray,
+                 null_code: Optional[int]) -> bytes:
+    import hashlib
+
+    h = hashlib.sha1()
+    h.update(np.ascontiguousarray(values_data).tobytes())
+    h.update(np.ascontiguousarray(values_offsets).tobytes())
+    h.update(str(null_code).encode())
+    return h.digest()
+
+
+def intern_pool(key, values_data: np.ndarray, values_offsets: np.ndarray,
+                null_code: Optional[int] = None, keepalive=None,
+                finalize=None) -> "DictPool":
+    """A canonical DictPool per (key, content).
+
+    `key` scopes the cache entry (e.g. ``(path, column)`` for a parquet
+    file, ``("sample", preset, column)`` for the generator); a candidate
+    whose content digest matches the cached entry returns the CACHED
+    pool — object identity is what downstream memo/fast paths key on.
+    A changed digest under the same key replaces the entry (rewritten
+    file, new dictionary page).
+
+    `finalize(values_data, values_offsets)` runs only when the candidate
+    is actually kept (a hit discards the candidate buffers), letting the
+    caller defer a pin-avoiding copy of a decode-buffer view until it is
+    known the buffers will live on.  Returns the buffers to store.
+    """
+    from transferia_tpu.stats.trace import TELEMETRY
+
+    if not pool_sharing_enabled():
+        if finalize is not None:
+            values_data, values_offsets = finalize(values_data,
+                                                   values_offsets)
+        return DictPool(values_data, values_offsets, null_code, keepalive)
+    digest = _pool_digest(values_data, values_offsets, null_code)
+    if key is None:
+        key = digest  # pure content identity (no producer scope)
+    with _POOL_CACHE_LOCK:
+        hit = _INTERN_CACHE.get(key)
+        if hit is not None and hit[0] == digest:
+            TELEMETRY.record_pool_share_hit()
+            return hit[1]
+    if finalize is not None:
+        values_data, values_offsets = finalize(values_data, values_offsets)
+    pool = DictPool(values_data, values_offsets, null_code, keepalive)
+    with _POOL_CACHE_LOCK:
+        hit = _INTERN_CACHE.get(key)
+        if hit is not None and hit[0] == digest:
+            TELEMETRY.record_pool_share_hit()
+            return hit[1]
+        while len(_INTERN_CACHE) >= _INTERN_CACHE_MAX:
+            _INTERN_CACHE.pop(next(iter(_INTERN_CACHE)), None)
+        _INTERN_CACHE[key] = (digest, pool)
+    return pool
+
+
+def intern_peek(key) -> Optional["DictPool"]:
+    """The currently-interned pool under `key` (None when absent) —
+    lets a producer try an order-insensitive CODE REMAP onto the
+    canonical pool before falling back to exact-content interning."""
+    with _POOL_CACHE_LOCK:
+        hit = _INTERN_CACHE.get(key)
+    return hit[1] if hit is not None else None
+
+
+def reset_intern_cache() -> None:
+    with _POOL_CACHE_LOCK:
+        _INTERN_CACHE.clear()
+
 
 class DictEnc:
     """Dictionary encoding of a variable-width column (ClickHouse
@@ -957,8 +1045,16 @@ def _adopt_dict_pool(pool_arr, vt, pt, pa) -> DictPool:
     pool_data, pool_off = _adopt_string_buffers(pool_arr)
     # append the null sentinel (empty bytes) at index n_values
     pool_off = np.append(pool_off, pool_off[-1]).astype(np.int32)
-    dpool = DictPool(pool_data, pool_off, null_code=len(pool_arr),
-                     keepalive=pool_arr)
+    # content interning: arrow dictionaries re-read per row group carry
+    # identical bytes in fresh buffers — converge them on one DictPool
+    # so memos amortize across row groups exactly as on the native
+    # path.  The INTERNED pool owns copied buffers (finalize): a pool
+    # view into an IPC message / shm segment would otherwise pin the
+    # whole mapping for the cache entry's lifetime
+    dpool = intern_pool(
+        None, pool_data, pool_off, null_code=len(pool_arr),
+        finalize=lambda d, o: (np.ascontiguousarray(d).copy(),
+                               np.ascontiguousarray(o).copy()))
     with _POOL_CACHE_LOCK:
         hit = _POOL_CACHE.get(key)
         if hit is not None:

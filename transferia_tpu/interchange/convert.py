@@ -34,6 +34,7 @@ Every buffer adoption is tallied in `telemetry.TELEMETRY`
 from __future__ import annotations
 
 import json
+import os
 from typing import Any, Optional
 
 import numpy as np
@@ -62,6 +63,89 @@ _SIDECAR_COMMIT = "__trtpu_commit_times"
 _SIDECARS = (_SIDECAR_KINDS, _SIDECAR_LSNS, _SIDECAR_COMMIT)
 
 
+_encoded_wire_cached: Optional[bool] = None
+
+
+def encoded_wire_enabled() -> bool:
+    """TRANSFERIA_TPU_ENCODED_FLIGHT=0 forces dict columns FLAT on the
+    Arrow wire (the A side of `bench.py --encoded-wire`); default on —
+    dict columns cross as DictionaryArrays, and the IPC/Flight framing
+    ships each dictionary (pool) once per stream followed by codes-only
+    record batches."""
+    global _encoded_wire_cached
+    if _encoded_wire_cached is None:
+        _encoded_wire_cached = os.environ.get(
+            "TRANSFERIA_TPU_ENCODED_FLIGHT", "1") != "0"
+    return _encoded_wire_cached
+
+
+def set_encoded_wire(on: Optional[bool]) -> None:
+    """Force the encoded Arrow wire on/off (None = re-read the env)."""
+    global _encoded_wire_cached
+    _encoded_wire_cached = on
+
+
+class EncodedWireState:
+    """Per-STREAM accounting of the pool-once encoded wire.
+
+    One instance lives for the life of one IPC/Flight/shm stream; the
+    Arrow framing ships a stream's dictionary exactly once (and again
+    only on replacement), so `account()` tallies a pool's bytes the
+    first time a batch references it and codes-only bytes every batch —
+    the telemetry that lets tests/bench ASSERT "each pool shipped at
+    most once per stream" instead of trusting the framing.  Also counts
+    what the flat wire would have shipped (`flat_equiv`), the input to
+    the encoded_wire_ratio honesty gauge.
+
+    Tallies accumulate as PENDING and publish only on `commit()` —
+    called after the bytes actually reach the wire.  A failed put
+    drops its pending tallies with the state, so a retried stream
+    (fresh state) never double-counts a pool that never crossed."""
+
+    __slots__ = ("seen_pools", "_pool_b", "_codes_b", "_flat_b",
+                 "_new_pools")
+
+    def __init__(self):
+        self.seen_pools: set[int] = set()
+        self._pool_b = self._codes_b = self._flat_b = 0
+        self._new_pools = 0
+
+    def account(self, batch: "ColumnBatch") -> int:
+        """Stage one batch's tallies; returns how many pools NEWLY
+        ship with it (0 for a codes-only batch)."""
+        new_pools = 0
+        for c in batch.columns.values():
+            if not (c.is_lazy_dict and encoded_wire_enabled()):
+                continue
+            enc = c.dict_enc
+            self._codes_b += int(enc.indices.nbytes)
+            offs = enc.pool.values_offsets
+            lens = offs[1:] - offs[:-1]
+            self._flat_b += int(lens[enc.indices].sum()) \
+                + (len(enc.indices) + 1) * 4
+            if id(enc.pool) not in self.seen_pools:
+                self.seen_pools.add(id(enc.pool))
+                new_pools += 1
+                self._pool_b += enc.pool.nbytes()
+        self._new_pools += new_pools
+        return new_pools
+
+    def commit(self) -> None:
+        """Publish the staged tallies (the stream's bytes landed)."""
+        from transferia_tpu.stats.ledger import LEDGER
+
+        if not (self._pool_b or self._codes_b):
+            return
+        TELEMETRY.add(pool_bytes_shipped=self._pool_b,
+                      codes_bytes_shipped=self._codes_b,
+                      flat_equiv_bytes=self._flat_b,
+                      pools_shipped=self._new_pools)
+        LEDGER.add(pool_bytes_shipped=self._pool_b,
+                   codes_bytes_shipped=self._codes_b)
+        self._pool_b = self._codes_b = self._flat_b = 0
+        self._new_pools = 0
+
+
 def _validity_buffer(pa, validity: Optional[np.ndarray]):
     """Bool validity → Arrow bitmap buffer (the permitted materialization)."""
     if validity is None:
@@ -87,6 +171,15 @@ def _column_to_arrow(pa, c: Column, pa_type) -> tuple[Any, Any]:
     layouts already agree."""
     n = c.n_rows
     validity = _validity_buffer(pa, c.validity)
+    if c.is_lazy_dict and not encoded_wire_enabled():
+        # encoded wire forced off: serialize the gathered flat form
+        # (a LOCAL gather — the shared column object stays lazy-dict)
+        data, offsets = c.dict_enc.materialize()
+        arr = pa.Array.from_buffers(
+            pa_type, n,
+            [validity, _wrap(pa, offsets), _wrap(pa, data)])
+        TELEMETRY.add(copied_buffers=1)
+        return arr, pa_type
     if c.is_lazy_dict:
         # dictionary-encoded end-to-end: wrap the shared pool's buffers
         # once (memoized on the DictPool so batch slices of one row

@@ -387,10 +387,25 @@ class FlightShardClient:
         return writer
 
     def put_part(self, key: str, batches: Iterable[ColumnBatch]) -> int:
+        from transferia_tpu.interchange.convert import EncodedWireState
         from transferia_tpu.stats import trace
 
-        rbs = [b if isinstance(b, self._pa.RecordBatch)
-               else batch_to_arrow(b) for b in batches]
+        wire = EncodedWireState()
+        rbs = []
+        for b in batches:
+            if isinstance(b, self._pa.RecordBatch):
+                rbs.append(b)
+                continue
+            # pool-once accounting rides the stream: the first batch
+            # referencing a pool ships it (an Arrow dictionary batch),
+            # later batches are codes-only — and the ship point is
+            # chaos-injectable (a put must fail WHOLE, so a consumer
+            # never holds codes without their pool).  Tallies publish
+            # only after the stream lands (wire.commit) so a failed
+            # put never counts bytes that never crossed.
+            if wire.account(b):
+                failpoint("flight.pool_ship")
+            rbs.append(batch_to_arrow(b))
         if not rbs:
             return 0
         rows = 0
@@ -400,6 +415,7 @@ class FlightShardClient:
                 for rb in rbs:
                     writer.write_batch(rb)
                     rows += rb.num_rows
+            wire.commit()
             if sp:
                 sp.add(rows=rows,
                        bytes=sum(rb.nbytes for rb in rbs))

@@ -51,17 +51,22 @@ class StreamWriter:
     the first batch (IPC streams are single-schema by format)."""
 
     def __init__(self, fobj: IO[bytes]):
+        from transferia_tpu.interchange.convert import EncodedWireState
+
         self._pa = pyarrow("Arrow IPC stream writing")
         self._fobj = fobj
         self._writer = None
+        self._wire = EncodedWireState()  # pool-once per stream
         self.batches_written = 0
         self.rows_written = 0
 
     def write(self, batch: ColumnBatch) -> None:
+        self._wire.account(batch)
         rb = batch_to_arrow(batch)
         if self._writer is None:
             self._writer = self._pa.ipc.new_stream(self._fobj, rb.schema)
         self._writer.write_batch(rb)
+        self._wire.commit()  # tallies publish only for landed bytes
         self.batches_written += 1
         self.rows_written += rb.num_rows
 

@@ -80,9 +80,17 @@ def write_segment(batches: Iterable[ColumnBatch]) -> ShmHandle:
     wrapped Arrow batches (MockOutputStream measures framing without
     writing), then streams into the mapped memory — the single copy of
     the handoff."""
+    from transferia_tpu.interchange.convert import EncodedWireState
+
     pa = pyarrow("the shared-memory handoff")
-    rbs = [b if isinstance(b, pa.RecordBatch) else batch_to_arrow(b)
-           for b in batches]
+    wire = EncodedWireState()  # pool-once per segment (one IPC stream)
+    rbs = []
+    for b in batches:
+        if isinstance(b, pa.RecordBatch):
+            rbs.append(b)
+        else:
+            wire.account(b)
+            rbs.append(batch_to_arrow(b))
     if not rbs:
         raise ValueError("shm.write_segment: no batches")
     rbs = _stamp_trace(rbs)
@@ -94,6 +102,7 @@ def write_segment(batches: Iterable[ColumnBatch]) -> ShmHandle:
     seg = shared_memory.SharedMemory(create=True, size=size)
     try:
         _fill_segment(pa, seg, rbs)
+        wire.commit()  # pool-once tallies publish once the seal lands
         TELEMETRY.add(shm_segments=1, bytes_out=size)
         handle = ShmHandle(name=seg.name, size=size)
     except BaseException:

@@ -21,6 +21,14 @@ _FIELDS = (
     "copied_buffers",
     "flight_streams",
     "shm_segments",
+    # pool-once encoded wire (convert.EncodedWireState): each stream
+    # ships a dict pool at most once, then codes-only batches; the
+    # pools/pool-bytes vs codes-bytes split + the flat-equivalent bytes
+    # are what the encoded_wire_ratio honesty gauge derives from
+    "pools_shipped",
+    "pool_bytes_shipped",
+    "codes_bytes_shipped",
+    "flat_equiv_bytes",
 )
 
 
@@ -51,6 +59,15 @@ class InterchangeTelemetry:
         total = snap["zero_copy_buffers"] + snap["copied_buffers"]
         return snap["zero_copy_buffers"] / total if total else 0.0
 
+    def encoded_wire_ratio(self) -> float:
+        """Flat-equivalent bytes over what the encoded wire actually
+        shipped (pool once + codes) — > 1.0 means the pool-once wire is
+        genuinely smaller; ~1.0 on a dict-heavy stream means pools are
+        re-shipping or columns are crossing flat."""
+        snap = self.snapshot()
+        shipped = snap["pool_bytes_shipped"] + snap["codes_bytes_shipped"]
+        return snap["flat_equiv_bytes"] / shipped if shipped else 0.0
+
     def fold_into(self, metrics) -> None:
         """Apply counter deltas since the last fold into a Metrics
         registry (idempotent across repeated folds, like
@@ -65,6 +82,11 @@ class InterchangeTelemetry:
                 if delta > 0:
                     getattr(stats, f).inc(delta)
                 self._folded[f] = cur
+            shipped = self.pool_bytes_shipped + self.codes_bytes_shipped
+            if shipped:
+                # absolute gauge, not a delta (like the dispatch ratio)
+                stats.encoded_wire_ratio.set(
+                    self.flat_equiv_bytes / shipped)
 
 
 TELEMETRY = InterchangeTelemetry()

@@ -88,6 +88,29 @@ def delta_prefix_sum(words: jax.Array, base: jax.Array, bit_width: int,
 delta_decode = jax.jit(delta_prefix_sum, static_argnums=(2, 3))
 
 
+def for_frame_decode(words: jax.Array, mins: jax.Array, bit_width: int,
+                     frame: int, n: int) -> jax.Array:
+    """Frame-of-reference decode: values[i] = mins[i // frame] + rel[i].
+
+    The device half of the FOR integer encoding
+    (ops/dispatch.encode_for): clustered-but-unsorted ids whose zigzag
+    deltas are too wide for the delta wire still pack tightly once each
+    static-size frame subtracts its own minimum.  rel values arrive
+    bit-packed; int32 adds wrap two's-complement, so a 32-bit rel span
+    reconstructs exactly for any value that fits int32 (the encoder
+    guards that).  Traceable inline — callers inside larger jitted
+    programs use this form directly; n must be a multiple of `frame`
+    (row buckets are, for the power-of-two frame sizes the encoder
+    emits)."""
+    rel = _unpack_core(words, bit_width, n)
+    base = jnp.repeat(mins.astype(jnp.int32), frame,
+                      total_repeat_length=n)
+    return base + rel
+
+
+for_decode = jax.jit(for_frame_decode, static_argnums=(2, 3, 4))
+
+
 def pack_mask_words(bits: jax.Array, n: int) -> jax.Array:
     """(n,) bool -> packed little-endian uint32 words (device side).
 

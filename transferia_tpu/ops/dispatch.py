@@ -63,6 +63,29 @@ _DELTA_MAX_BITS = 30
 # handful of saved bytes
 _DELTA_MIN_ROWS = 256
 
+_for_frame_cached: Optional[int] = None
+
+
+def for_frame() -> int:
+    """Frame size of the frame-of-reference integer encoding
+    (TRANSFERIA_TPU_FOR_FRAME; default 256 — every row bucket is a
+    multiple; 0 disables FOR).  Static for jit: one frame size -> one
+    compiled decode."""
+    global _for_frame_cached
+    if _for_frame_cached is None:
+        env = os.environ.get("TRANSFERIA_TPU_FOR_FRAME")
+        try:
+            _for_frame_cached = max(0, int(env)) if env else 256
+        except ValueError:
+            _for_frame_cached = 256
+    return _for_frame_cached
+
+
+def set_for_frame(n: Optional[int]) -> None:
+    """Force the FOR frame size (None = re-read the env)."""
+    global _for_frame_cached
+    _for_frame_cached = n
+
 
 def dispatch_encoding() -> str:
     """auto (encode whenever it shrinks, default) | raw."""
@@ -164,6 +187,56 @@ def encode_delta(data: np.ndarray
     return int(bases[0]), pack_bits_host(zz[0], bw), bw
 
 
+def _for_plan(values: np.ndarray
+              ) -> Optional[tuple[np.ndarray, np.ndarray, int, int]]:
+    """THE frame-of-reference guard chain, shared by the single-device
+    and mesh wires (values: (n_shards, per)).  Returns (mins int32
+    (n_shards, n_frames), rel uint64 (n_shards, per), bit_width, frame)
+    or None when any guard rejects.
+
+    FOR closes the delta wire's "unprofitable reject" gap for
+    clustered-but-unsorted ints: per static-size frame, subtract the
+    frame minimum and bit-pack the non-negative remainders with ONE
+    uniform width (the max across frames/shards — the decode program
+    stays static).  Guards: every value must fit int32 exactly (the
+    device reconstructs in int32; wraparound adds are exact only
+    then), the frame size must divide the padded row count (buckets
+    are multiples of every power-of-two frame <= 256), and packed
+    remainders + per-frame mins must genuinely shrink the raw dtype."""
+    frame = for_frame()
+    n_shards, per = values.shape
+    if (frame <= 0 or values.dtype.kind not in "iu"
+            or per < _DELTA_MIN_ROWS or per % frame):
+        return None
+    v = values.astype(np.int64)
+    if int(v.min()) < -2**31 or int(v.max()) > 2**31 - 1:
+        return None
+    framed = v.reshape(n_shards, per // frame, frame)
+    mins = framed.min(axis=2)
+    rel = (framed - mins[:, :, None]).reshape(n_shards, per) \
+        .astype(np.uint64)
+    bw = max(1, int(rel.max()).bit_length())
+    if bw > 32:
+        return None
+    n_frames = per // frame
+    if bw * per + n_frames * 32 >= values.dtype.itemsize * 8 * per:
+        return None  # no shrink over the raw dtype
+    return mins.astype(np.int32), rel, bw, frame
+
+
+def encode_for(data: np.ndarray
+               ) -> Optional[tuple[np.ndarray, np.ndarray, int, int]]:
+    """FOR-encode an integer array: (mins (n_frames,) int32, packed
+    words, bit_width, frame), or None when the guards reject."""
+    if data.ndim != 1:
+        return None
+    plan = _for_plan(data.reshape(1, -1))
+    if plan is None:
+        return None
+    mins, rel, bw, frame = plan
+    return mins[0], pack_bits_host(rel[0], bw), bw, frame
+
+
 # -- per-column dispatch encodings ------------------------------------------
 
 @dataclass(frozen=True)
@@ -172,9 +245,12 @@ class PredEnc:
     static argument — the traced program's structure hangs off it).
 
     kind: raw (dtype bytes as-is) | delta (base + packed zigzag deltas,
-    integer dtypes) | bits (bit-packed boolean data).
+    sorted-ish integer dtypes) | for (per-frame mins + packed
+    remainders, clustered-but-unsorted integers) | bits (bit-packed
+    boolean data).
     valid_mode: none (all-valid, synthesized on device) | bits
     (bit-packed bitmap) | raw (bool bytes, the uncompressed wire).
+    frame: FOR frame size (0 for every other kind).
     """
 
     name: str
@@ -182,6 +258,7 @@ class PredEnc:
     kind: str
     bit_width: int
     valid_mode: str
+    frame: int = 0
 
 
 def encode_pred_column(name: str, data: np.ndarray,
@@ -218,6 +295,12 @@ def encode_pred_column(name: str, data: np.ndarray,
         base, words, bw = delta
         spec = PredEnc(name, str(data.dtype), "delta", bw, valid_mode)
         return (spec, (words, np.int32(base)) + val_arrays, raw_equiv)
+    forenc = encode_for(data)
+    if forenc is not None:
+        mins, words, bw, frame = forenc
+        spec = PredEnc(name, str(data.dtype), "for", bw, valid_mode,
+                       frame)
+        return (spec, (words, mins) + val_arrays, raw_equiv)
     spec = PredEnc(name, str(data.dtype), "raw", 0, valid_mode)
     return spec, (data,) + val_arrays, raw_equiv
 
@@ -230,6 +313,7 @@ def decode_pred_device(spec: PredEnc, arrays, bucket: int):
 
     from transferia_tpu.ops.decode import (
         delta_prefix_sum,
+        for_frame_decode,
         unpack_validity,
     )
 
@@ -237,6 +321,10 @@ def decode_pred_device(spec: PredEnc, arrays, bucket: int):
         data = arrays[0]
     elif spec.kind == "bits":
         data = unpack_validity(arrays[0], bucket)
+    elif spec.kind == "for":
+        data = for_frame_decode(arrays[0], arrays[1], spec.bit_width,
+                                spec.frame, bucket
+                                ).astype(np.dtype(spec.dtype))
     else:  # delta
         data = delta_prefix_sum(arrays[0], arrays[1], spec.bit_width,
                                 bucket).astype(np.dtype(spec.dtype))
@@ -284,6 +372,22 @@ def _encode_delta_sharded(d2: np.ndarray
     return bases, words, bw
 
 
+def _encode_for_sharded(d2: np.ndarray
+                        ) -> Optional[tuple[np.ndarray, np.ndarray,
+                                            int, int]]:
+    """Per-shard frame-of-reference pack: (mins (n_dev, n_frames)
+    int32, words (n_dev, W), bit_width, frame) or None when the shared
+    `_for_plan` guards reject — frames never span a shard boundary
+    (per_dev is a bucket, a multiple of the frame size) and one
+    uniform bit width across shards keeps the decode static."""
+    plan = _for_plan(d2)
+    if plan is None:
+        return None
+    mins, rel, bw, frame = plan
+    words = np.stack([pack_bits_host(row, bw) for row in rel])
+    return mins, words, bw, frame
+
+
 def encode_pred_column_sharded(name: str, data: np.ndarray,
                                validity: Optional[np.ndarray],
                                n_rows: int, n_dev: int, per_dev: int,
@@ -325,6 +429,12 @@ def encode_pred_column_sharded(name: str, data: np.ndarray,
         bases, words, bw = delta
         spec = PredEnc(name, str(data.dtype), "delta", bw, valid_mode)
         return spec, (words, bases) + val_arrays, raw_equiv
+    forenc = _encode_for_sharded(d2)
+    if forenc is not None:
+        mins, words, bw, frame = forenc
+        spec = PredEnc(name, str(data.dtype), "for", bw, valid_mode,
+                       frame)
+        return spec, (words, mins) + val_arrays, raw_equiv
     spec = PredEnc(name, str(data.dtype), "raw", 0, valid_mode)
     return spec, (d2,) + val_arrays, raw_equiv
 
@@ -403,16 +513,39 @@ def _hash_pool_locked(key: bytes, pool, n_rows: int, memo_key):
         TELEMETRY.record_pool_hit()
         _record_avoided_batch_bytes(pool, n_rows)
         return hexed
+    from transferia_tpu.columnar.hexcol import (
+        digests_to_hex,
+        hex_to_varwidth,
+    )
+    from transferia_tpu.transform.plugins.mask import hexed_pool_from_flat
+
+    digest_rows = _pool_digest_rows_locked(key, pool)
+    hex_mat = digests_to_hex(digest_rows)
+    flat, flat_off = hex_to_varwidth(hex_mat, None)
+    hexed = hexed_pool_from_flat(pool, flat, flat_off)
+    pool.memo_set(memo_key, hexed)
+    _record_avoided_batch_bytes(pool, n_rows)
+    return hexed
+
+
+def _pool_digest_rows_locked(key: bytes, pool) -> np.ndarray:
+    """The (n_values, 8) uint32 HMAC digest matrix of a pool's values,
+    computed ON DEVICE once per (pool, key) and memoized on the shared
+    pool — the common substrate of the hexed pool (single-device mask
+    route) and the mesh dict route's per-device digest gather.  Caller
+    holds `_pool_hash_lock`."""
+    memo_key = ("hmac_digest_rows", bytes(key))
+    rows = pool.memo_get(memo_key)
+    if rows is not None:
+        return rows
     import jax.numpy as jnp
 
     from transferia_tpu.columnar.batch import bucket_rows
-    from transferia_tpu.columnar.hexcol import digests_to_hex
     from transferia_tpu.ops.fused import pack_hmac_blocks, pow2_blocks
     from transferia_tpu.ops.sha256 import (
         _hmac_inner_outer,
         _hmac_key_states,
     )
-    from transferia_tpu.transform.plugins.mask import hexed_pool_from_flat
 
     n_vals = pool.n_values
     offsets = pool.values_offsets
@@ -436,17 +569,32 @@ def _hash_pool_locked(key: bytes, pool, n_rows: int, memo_key):
             dev_blocks, dev_nblocks,
             (jnp.asarray(inner[0]), jnp.asarray(outer[0])), mb)
         TELEMETRY.record_launch()
-        digest_rows = np.asarray(digests)[:n_vals]
+        digest_rows = np.ascontiguousarray(np.asarray(digests)[:n_vals])
     TELEMETRY.record_d2h(int(digests.nbytes))
     TELEMETRY.record_pool_upload()
-    hex_mat = digests_to_hex(digest_rows)
-    from transferia_tpu.columnar.hexcol import hex_to_varwidth
+    pool.memo_set(memo_key, digest_rows)
+    return digest_rows
 
-    flat, flat_off = hex_to_varwidth(hex_mat, None)
-    hexed = hexed_pool_from_flat(pool, flat, flat_off)
-    pool.memo_set(memo_key, hexed)
-    _record_avoided_batch_bytes(pool, n_rows)
-    return hexed
+
+def device_hmac_pool_digests(key: bytes, pool, n_rows: int
+                             ) -> Optional[np.ndarray]:
+    """The memoized (n_values, 8) uint32 digest matrix for the MESH
+    dict route (parallel/fusedmesh.py): the sharded program gathers
+    per-row digest words from it by int32 code — byte-identical to
+    HMAC'ing each row's flat bytes, because equal bytes hash equal and
+    the pool's null sentinel is the same empty-bytes entry the flat
+    wire ships for null rows.  None when the pool is too large to pay
+    for itself on this batch (same economics as the hexed-pool route:
+    the caller falls back to the flat block wire)."""
+    memo_key = ("hmac_digest_rows", bytes(key))
+    rows = pool.memo_get(memo_key)
+    if rows is not None:
+        TELEMETRY.record_pool_hit()
+        return rows
+    if pool.n_values > 2 * max(n_rows, 1):
+        return None
+    with _pool_hash_lock:
+        return _pool_digest_rows_locked(bytes(key), pool)
 
 
 def _record_avoided_batch_bytes(pool, n_rows: int) -> None:

@@ -65,6 +65,45 @@ def default_mesh(devices=None) -> Mesh:
     return make_mesh(devices=devices)
 
 
+class DictMaskInput:
+    """A dict-encoded masked column on the mesh wire: the row CODES
+    shard over the row axis (4 bytes/row) and the pool's memoized HMAC
+    digest matrix (ops/dispatch.device_hmac_pool_digests) replicates
+    per device — the sharded program gathers per-row digest words by
+    code instead of hashing per-row SHA block matrices, byte-identical
+    because equal bytes hash equal and null rows carry the pool's
+    empty-bytes sentinel code (exactly what the flat wire ships for a
+    null row).  `raw_block_bytes_per_row` is what the flat route would
+    have shipped for this column (the honesty number the compression
+    accounting charges)."""
+
+    __slots__ = ("codes", "digests", "raw_block_bytes_per_row")
+
+    def __init__(self, codes: np.ndarray, digests: np.ndarray,
+                 raw_block_bytes_per_row: int):
+        self.codes = np.ascontiguousarray(codes, dtype=np.int32)
+        self.digests = np.ascontiguousarray(digests, dtype=np.uint32)
+        self.raw_block_bytes_per_row = int(raw_block_bytes_per_row)
+
+
+def dict_mask_input(key: bytes, col) -> Optional[DictMaskInput]:
+    """Build the mesh wire form of a lazy-dict masked column, or None
+    when the pool's economics reject device hashing for this batch
+    (the caller then falls back to the flat block wire)."""
+    from transferia_tpu.ops.dispatch import device_hmac_pool_digests
+    from transferia_tpu.ops.fused import pow2_blocks
+
+    pool = col.dict_enc.pool
+    digests = device_hmac_pool_digests(bytes(key), pool, col.n_rows)
+    if digests is None:
+        return None
+    offs = pool.values_offsets
+    lens = offs[1:] - offs[:-1]
+    max_len = int(lens.max()) if pool.n_values else 0
+    mb = pow2_blocks(max_len)
+    return DictMaskInput(col.dict_enc.indices, digests, mb * 64 + 4)
+
+
 class ShardedFusedProgram:
     """Row-sharded HMAC mask + predicate over a device mesh.
 
@@ -95,9 +134,9 @@ class ShardedFusedProgram:
 
         row_axes = tuple(self.mesh.axis_names)  # rows over the full mesh
 
-        def per_device(blocks_t, nblocks_t, states_t, pred_arrays,
-                       valid_in, max_blocks_t, pred_specs, valid_mode,
-                       bucket):
+        def per_device(blocks_t, nblocks_t, states_t, codes_t, digs_t,
+                       pred_arrays, valid_in, max_blocks_t, pred_specs,
+                       valid_mode, bucket, routes):
             from transferia_tpu.ops.decode import unpack_validity
             from transferia_tpu.ops.dispatch import (
                 decode_pred_device_sharded,
@@ -117,13 +156,31 @@ class ShardedFusedProgram:
             }
             rows_local = bucket
             # raw digest words leave the device (32 B/row, host LUT hex
-            # expansion — same contract as FusedMaskFilterProgram)
-            digests = tuple(
+            # expansion — same contract as FusedMaskFilterProgram).
+            # Flat columns hash their sharded SHA block matrices; dict
+            # columns GATHER per-row digest words from the replicated
+            # pool digest matrix by their sharded int32 codes — equal
+            # bytes hash equal, so the outputs are byte-identical
+            flat_digests = [
                 hmac_device_core(b, nb, st[0], st[1], mb)
                 for b, nb, st, mb in zip(
                     blocks_t, nblocks_t, states_t, max_blocks_t
                 )
-            )
+            ]
+            dict_digests = [
+                jnp.take(dg, cd, axis=0, mode="clip")
+                for cd, dg in zip(codes_t, digs_t)
+            ]
+            fi = di = 0
+            ordered = []
+            for r in routes:  # reassemble the caller's column order
+                if r == "dict":
+                    ordered.append(dict_digests[di])
+                    di += 1
+                else:
+                    ordered.append(flat_digests[fi])
+                    fi += 1
+            digests = tuple(ordered)
             if self._pred_fn is not None:
                 keep = self._pred_fn(pred_cols, rows_local) & valid
             else:
@@ -143,14 +200,18 @@ class ShardedFusedProgram:
 
         self._per_device = per_device
 
-    def _get_compiled(self, n_mask: int, pred_key: tuple,
+    def _get_compiled(self, routes: tuple, pred_key: tuple,
                       valid_mode: str):
-        """pred_key: ((name, PredEnc, n_arrays), ...) sorted by name —
-        the encoding shapes the traced program, so it keys the cache."""
-        key = (n_mask, pred_key, valid_mode)
+        """routes: "flat"/"dict" per masked column in caller order;
+        pred_key: ((name, PredEnc, n_arrays), ...) sorted by name —
+        both shape the traced program, so they key the cache."""
+        key = (routes, pred_key, valid_mode)
         fn = self._compiled.get(key)
         if fn is not None:
             return fn
+        n_mask = len(routes)
+        n_flat = sum(1 for r in routes if r == "flat")
+        n_dict = n_mask - n_flat
         with self._lock:
             fn = self._compiled.get(key)
             if fn is None:
@@ -159,9 +220,13 @@ class ShardedFusedProgram:
                 pred_specs = tuple((name, spec)
                                    for name, spec, _n in pred_key)
                 in_specs = (
-                    (P(row_axes, None),) * n_mask,   # blocks per column
-                    (rows,) * n_mask,                # n_blocks per column
-                    tuple((P(), P()) for _ in range(n_mask)),  # key states
+                    (P(row_axes, None),) * n_flat,   # blocks per column
+                    (rows,) * n_flat,                # n_blocks per column
+                    tuple((P(), P()) for _ in range(n_flat)),  # key states
+                    (rows,) * n_dict,                # dict codes (total,)
+                    (P(),) * n_dict,                 # digest matrices,
+                    # replicated: every device holds the whole (small)
+                    # pool digest table its local codes gather from
                     # encoded pred arrays carry a leading device axis;
                     # sharding it hands each device its own shard's words
                     {name: tuple(rows for _ in range(n_arr))
@@ -176,27 +241,32 @@ class ShardedFusedProgram:
                 )
                 # max_blocks + bucket must stay static: strip them from
                 # specs and close over them per call instead
-                def wrapper(blocks_t, nblocks_t, states_t, pred_arrays,
-                            valid_arr, max_blocks_t, bucket):
+                def wrapper(blocks_t, nblocks_t, states_t, codes_t,
+                            digs_t, pred_arrays, valid_arr,
+                            max_blocks_t, bucket):
                     body = _shard_map(
-                        lambda b, nb, st, pa, v: self._per_device(
-                            b, nb, st, pa, v, max_blocks_t,
-                            pred_specs, valid_mode, bucket),
+                        lambda b, nb, st, cd, dg, pa, v:
+                        self._per_device(
+                            b, nb, st, cd, dg, pa, v, max_blocks_t,
+                            pred_specs, valid_mode, bucket, routes),
                         self.mesh,
                         in_specs,
                         out_specs,
                     )
-                    return body(blocks_t, nblocks_t, states_t,
-                                pred_arrays, valid_arr)
+                    return body(blocks_t, nblocks_t, states_t, codes_t,
+                                digs_t, pred_arrays, valid_arr)
 
-                fn = jax.jit(wrapper, static_argnums=(5, 6))
+                fn = jax.jit(wrapper, static_argnums=(7, 8))
                 self._compiled[key] = fn
         return fn
 
-    def run(self, mask_cols: Sequence[tuple[np.ndarray, np.ndarray]],
+    def run(self, mask_cols: Sequence,
             pred_cols: dict[str, tuple[np.ndarray, Optional[np.ndarray]]],
             n_rows: int) -> tuple[list[np.ndarray], Optional[np.ndarray]]:
-        """Same contract as FusedMaskFilterProgram.run()."""
+        """Same contract as FusedMaskFilterProgram.run().  mask_cols
+        entries are either (data, offsets) flat pairs or DictMaskInput
+        (the dict-aware wire: codes shard, the pool digest matrix
+        replicates — see dict_mask_input)."""
         from transferia_tpu.chaos.failpoints import failpoint
         from transferia_tpu.ops.dispatch import (
             encode_pred_column_sharded,
@@ -211,12 +281,26 @@ class ShardedFusedProgram:
         per_dev = bucket_rows(max(1, -(-n_rows // self.n_dev)))
         total = per_dev * self.n_dev
         encoded = encoding_enabled()
-        blocks_t, nblocks_t, mb_t = [], [], []
+        blocks_t, nblocks_t, mb_t, flat_states = [], [], [], []
+        codes_t, digs_t, routes = [], [], []
         pack_t0 = None
         import time as _time
 
         pack_t0 = _time.perf_counter()
-        for data, offsets in mask_cols:
+        raw_equiv = 0
+        for i, entry in enumerate(mask_cols):
+            if isinstance(entry, DictMaskInput):
+                codes = entry.codes
+                if total != n_rows:
+                    codes = np.pad(codes, (0, total - n_rows))
+                codes_t.append(codes)
+                digs_t.append(entry.digests)
+                routes.append("dict")
+                # honesty: charge what the flat wire would have shipped
+                # (bucket-padded SHA block matrix + per-row counts)
+                raw_equiv += entry.raw_block_bytes_per_row * total
+                continue
+            data, offsets = entry
             lens = offsets[1:] - offsets[:-1]
             max_len = int(lens.max()) if n_rows else 0
             mb = pow2_blocks(max_len)
@@ -227,13 +311,15 @@ class ShardedFusedProgram:
             blocks_t.append(blocks)
             nblocks_t.append(n_blocks)
             mb_t.append(mb)
-        # the SHA block matrices ship as-is (they are the payload being
-        # hashed); the predicate columns and both validity planes cross
+            flat_states.append(self._states[i])
+            routes.append("flat")
+            raw_equiv += int(blocks.nbytes) + int(n_blocks.nbytes)
+        # flat SHA block matrices ship as-is (they are the payload being
+        # hashed); dict columns ship codes + one replicated digest
+        # table; the predicate columns and both validity planes cross
         # the mesh wire per-shard ENCODED — bit-packed bitmaps/bools,
-        # delta+bit-packed ints — and reconstruct inside the sharded
+        # delta/FOR-packed ints — and reconstruct inside the sharded
         # program (ops/dispatch.py sharded encoders, decode on device)
-        raw_equiv = sum(int(b.nbytes) + int(nb.nbytes)
-                        for b, nb in zip(blocks_t, nblocks_t))
         pred_key = []
         pred_arrays: dict = {}
         for name in sorted(pred_cols):
@@ -251,9 +337,10 @@ class ShardedFusedProgram:
         valid_mode = "bits" if encoded else "raw"
         raw_equiv += total  # the flat bool run-validity mask
         stagetimer.add("pack", _time.perf_counter() - pack_t0)
-        fn = self._get_compiled(len(mask_cols), tuple(pred_key),
+        fn = self._get_compiled(tuple(routes), tuple(pred_key),
                                 valid_mode)
-        stage_tree = (tuple(blocks_t), tuple(nblocks_t), pred_arrays,
+        stage_tree = (tuple(blocks_t), tuple(nblocks_t),
+                      tuple(codes_t), tuple(digs_t), pred_arrays,
                       valid_arr)
         h2d = sum(int(leaf.nbytes)
                   for leaf in jax.tree_util.tree_leaves(stage_tree))
@@ -262,16 +349,16 @@ class ShardedFusedProgram:
         # device_put would land everything on one device and pay a
         # reshard hop.  The shared staging site keeps the chaos
         # failpoint and the encoded-vs-raw byte accounting honest.
-        blocks_s, nblocks_s, pred_s, valid_s = stage_h2d(
-            stage_tree, raw_equiv_bytes=raw_equiv, what="mesh",
-            put=False)
+        blocks_s, nblocks_s, codes_s, digs_s, pred_s, valid_s = \
+            stage_h2d(stage_tree, raw_equiv_bytes=raw_equiv,
+                      what="mesh", put=False)
         TELEMETRY.record_launch()
         with stagetimer.stage("device_dispatch"), \
                 trace.span("device_dispatch", bytes=h2d, rows=n_rows,
                            mesh=self.n_dev):
             digests_dev, keep_dev, hist, kept = fn(
-                blocks_s, nblocks_s, tuple(self._states),
-                pred_s, valid_s, tuple(mb_t), per_dev,
+                blocks_s, nblocks_s, tuple(flat_states), codes_s,
+                digs_s, pred_s, valid_s, tuple(mb_t), per_dev,
             )
         t_wait0 = _time.perf_counter()
         with stagetimer.stage("device_wait"), \

@@ -435,9 +435,13 @@ class FileStorage(Storage, ShardingStorage, ScanPredicateStorage):
         # (one dictionary unification per group, not per batch) and the
         # batch slices share dictionary buffers — which is what lets the
         # columnar layer pool-cache them (batch.py _adopt_dict_pool).
-        # Externally-written files can carry huge row groups (pyarrow
-        # default ~1M rows); cap the per-part materialization and stream
-        # those through iter_batches instead.
+        # Var-width columns read dict-PRESERVING (read_dictionary): dict
+        # pages surface as DictionaryArrays and adopt as shared
+        # DictPools instead of decoding flat just to re-encode
+        # downstream.  Externally-written files can carry huge row
+        # groups (pyarrow default ~1M rows); cap the per-part
+        # materialization and stream those through iter_batches instead.
+        pf = self._dict_preserving_reader(pf, path, schema)
         if self._has_huge_row_groups(pf, groups):
             it = pf.iter_batches(batch_size=self.params.batch_rows,
                                  row_groups=groups)
@@ -455,6 +459,26 @@ class FileStorage(Storage, ShardingStorage, ScanPredicateStorage):
                     pusher(batch)
             return
         self._load_groups_arrow(pf, groups, tid, schema, pusher)
+
+    @staticmethod
+    def _dict_preserving_reader(pf, path: str, schema: TableSchema):
+        """A reader whose dict-encoded var-width columns keep their
+        encoding (arrow paths only; the native path adopts pages
+        itself).  Only columns whose chunks actually carry dictionary
+        encodings qualify — read_dictionary on a PLAIN column would
+        make arrow BUILD a dictionary, a pure loss for
+        high-cardinality strings."""
+        from transferia_tpu.providers.parquet_native import (
+            dict_encoded_columns,
+            parquet_file_cached,
+        )
+
+        var_cols = [cs.name for cs in schema
+                    if cs.data_type.is_variable_width]
+        dict_cols = dict_encoded_columns(pf.metadata, var_cols)
+        if not dict_cols:
+            return pf
+        return parquet_file_cached(path, read_dictionary=dict_cols)
 
     def _load_file(self, path: str, tid: TableID, schema: TableSchema,
                    pusher: Pusher) -> None:

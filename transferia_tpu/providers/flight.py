@@ -227,8 +227,16 @@ class FlightSinker(Sinker, StagedSinker):
         rows = 0
         with publish_guard(key, epoch):
             for tid, blocks in by_table.items():
+                from transferia_tpu.interchange.convert import (
+                    EncodedWireState,
+                )
+
+                wire = EncodedWireState()  # pool-once per publish stream
                 wire_key = part_key(tid, f"part-{part_slug(key)}")
-                rbs = [batch_to_arrow(b) for b in blocks]
+                rbs = []
+                for b in blocks:
+                    wire.account(b)
+                    rbs.append(batch_to_arrow(b))
                 try:
                     writer = self._client.begin_put(
                         wire_key, rbs[0].schema, epoch=epoch)
@@ -236,6 +244,7 @@ class FlightSinker(Sinker, StagedSinker):
                         for rb in rbs:
                             writer.write_batch(rb)
                             rows += rb.num_rows
+                    wire.commit()  # only landed streams count
                 except Exception as e:
                     raise_if_stale_epoch(e, wire_key, epoch)
         self.last_dedup_dropped = self._stage.dedup_dropped
@@ -256,7 +265,10 @@ class FlightSinker(Sinker, StagedSinker):
         blocks = self._blocks(batch)
         if not blocks:
             return
-        from transferia_tpu.interchange.convert import batch_to_arrow
+        from transferia_tpu.interchange.convert import (
+            EncodedWireState,
+            batch_to_arrow,
+        )
 
         for b in blocks:
             rb = batch_to_arrow(b)
@@ -267,9 +279,12 @@ class FlightSinker(Sinker, StagedSinker):
                     cur[1].close()
                     cur = None
                 if cur is None:
-                    cur = (key, self._client.begin_put(key, rb.schema))
+                    cur = (key, self._client.begin_put(key, rb.schema),
+                           EncodedWireState())
                     self._open[b.table_id] = cur
+                cur[2].account(b)  # pool-once per held-open stream
                 cur[1].write_batch(rb)
+                cur[2].commit()  # tallies publish per landed batch
                 continue
             # no engine part identity: each push is its own part stream,
             # and the sequence advances only AFTER the put succeeds — a
@@ -286,7 +301,7 @@ class FlightSinker(Sinker, StagedSinker):
 
     def close(self) -> None:
         errs = []
-        for _key, writer in self._open.values():
+        for _key, writer, _wire in self._open.values():
             try:
                 writer.close()
             except Exception as e:

@@ -59,20 +59,29 @@ def _file_key(path: str) -> tuple:
     return (os.path.abspath(path), st.st_mtime_ns, st.st_size)
 
 
-def parquet_file_cached(path: str):
+def parquet_file_cached(path: str, read_dictionary=None):
     """A fresh pyarrow ParquetFile whose footer parses at most once per
     (path, mtime, size) — the FileMetaData is memoized and handed back
     to `ParquetFile(metadata=...)`, so each caller still gets its OWN
     reader object (pyarrow readers are not safe to share across part
-    threads) without re-running the thrift parse per part."""
+    threads) without re-running the thrift parse per part.
+
+    `read_dictionary` (a sequence of column names) makes the reader
+    surface those columns as arrow DictionaryArrays instead of decoding
+    dict pages to flat values — the arrow-path twin of the native
+    decoder's DictEnc adoption (the importer then adopts the dictionary
+    as a shared DictPool instead of re-encoding downstream)."""
     import pyarrow.parquet as pq
 
+    kw = {}
+    if read_dictionary:
+        kw["read_dictionary"] = list(read_dictionary)
     key = _file_key(path)
     with _FILE_CACHE_LOCK:
         meta = _FOOTER_CACHE.get(key)
     if meta is not None:
-        return pq.ParquetFile(path, metadata=meta)
-    pf = pq.ParquetFile(path)
+        return pq.ParquetFile(path, metadata=meta, **kw)
+    pf = pq.ParquetFile(path, **kw)
     with _FILE_CACHE_LOCK:
         while len(_FOOTER_CACHE) >= _FILE_CACHE_MAX:
             _FOOTER_CACHE.pop(next(iter(_FOOTER_CACHE)), None)
@@ -114,6 +123,32 @@ def reset_file_caches() -> None:
     with _FILE_CACHE_LOCK:
         _FOOTER_CACHE.clear()
         _MMAP_CACHE.clear()
+        _PAGE_POOL_CACHE.clear()
+
+
+# -- dict-page pool sharing --------------------------------------------------
+#
+# One decoded dict page -> one DictPool, shared by every reader of it.
+# Two layers:
+#  - identity: (path, mtime, size, column, dictionary_page_offset) — a
+#    part re-decoding the SAME page (multi-part loads re-open each row
+#    group's chunk once per part thread) reuses the pool with no digest;
+#  - content (columnar/batch.intern_pool keyed by (path, column)): row
+#    groups of one file usually carry byte-identical dict pages at
+#    different offsets, so their pools converge on one object and the
+#    pool-keyed memos (hexed HMAC pool, rowhash accumulators, device
+#    digest matrices) amortize across the whole file, parts included.
+_PAGE_POOL_CACHE: dict = {}
+_PAGE_POOL_CACHE_MAX = 256
+
+# copy-vs-view economics for the pool slice out of the cap-sized decode
+# buffer: keeping a view is free NOW but pins the whole buffer (cap
+# covers the code pages too) for as long as the pool lives — which,
+# with pool sharing, is the whole transfer.  Keep the view only when
+# the pinned remainder is small both relatively AND absolutely; the old
+# `pool_bytes * 2 < nbytes` test alone kept views that pinned megabytes
+# when the pool sat just under half the buffer.
+_POOL_PIN_MAX_WASTE = 256 * 1024
 
 # bench/diagnostic visibility: which columns fell out of the native
 # envelope (and how often) — silent arrow fallbacks regress the headline
@@ -178,6 +213,9 @@ class NativeParquetReader:
         self._cdll = cdll
         self._decode_threads = max(1, int(decode_threads))
         self._mm = shared_memmap(path)
+        self._path = path
+        self._file_key = _file_key(path)
+        self._fb_readers: dict[tuple, object] = {}
         # column index by name (flat schemas only — nested fall back)
         self._col_idx = {}
         for i in range(self._meta.num_columns):
@@ -275,7 +313,10 @@ class NativeParquetReader:
                    if kind == 1 else ow)
             rows.append([start, length, codec, kind, width, n, max_def,
                          0, cap, 0, 0, 0, 0, 0, 0, 0])
-            specs.append((cs, kind, ow, n, max_def, cap, view_dt))
+            dict_off = (col.dictionary_page_offset
+                        if col.dictionary_page_offset is not None else -1)
+            specs.append((cs, kind, ow, n, max_def, cap, view_dt,
+                          dict_off))
         tasks = (np.array(rows, dtype=np.int64)
                  if rows else np.zeros((0, _T_FIELDS), dtype=np.int64))
         out = (tasks, specs, static_fb)
@@ -312,28 +353,99 @@ class NativeParquetReader:
     def _finish_bytearray(self, cs, rc: int, out_kind: int, n: int,
                           data: np.ndarray, offsets: np.ndarray,
                           codes: np.ndarray,
-                          validity: Optional[np.ndarray]) -> Column:
+                          validity: Optional[np.ndarray],
+                          dict_off: int = -1) -> Column:
         v = validity.astype(np.bool_) if validity is not None else None
         if out_kind == 1:
-            # dict result: rc == n_pool; codes hold n_pool for nulls.
-            # The pool slice is a view into the cap-sized decode buffer
-            # (cap covers code pages too, not just the dict page): keep
-            # the view only while it fills most of the buffer, else copy
-            # so a small pool doesn't pin megabytes through the pipeline.
-            n_pool = rc
-            pool_off = np.append(offsets[:n_pool + 1],
-                                 offsets[n_pool]).astype(np.int32)
-            pool_bytes = int(offsets[n_pool])
-            pool_data = data[:pool_bytes]
-            if pool_bytes * 2 < data.nbytes:
-                pool_data = pool_data.copy()
-            dpool = DictPool(pool_data, pool_off, null_code=n_pool)
+            # dict result: rc == n_pool; codes hold n_pool for nulls
+            dpool, remap = self._adopt_dict_page(cs, rc, data, offsets,
+                                                 dict_off)
+            if remap is not None:
+                # order-insensitive sharing: this page carries the
+                # canonical pool's values in a different first-
+                # occurrence order — rewrite the codes onto it
+                codes = remap[codes]
             return Column(cs.name, cs.data_type, validity=v,
                           dict_enc=DictEnc(codes, pool=dpool))
         flat = data[:rc]
         if rc * 2 < data.nbytes:
             flat = flat.copy()
         return Column(cs.name, cs.data_type, flat, offsets, v)
+
+    def _adopt_dict_page(self, cs, n_pool: int, data: np.ndarray,
+                         offsets: np.ndarray, dict_off: int
+                         ) -> tuple[DictPool, Optional[np.ndarray]]:
+        """Decoded dict page -> (shared DictPool, optional code remap).
+
+        Sharing layers (module cache comment): identity by page offset;
+        then order-INSENSITIVE value matching against the column's
+        canonical pool — parquet writers build each row group's
+        dictionary in first-occurrence order, so pages across row
+        groups usually carry the same value SET permuted; a remap table
+        rewrites this page's codes onto the canonical pool (one
+        O(values) lookup per page, O(rows) int32 gather) so the
+        pool-keyed memos amortize file-wide; exact-content interning
+        covers the first page / changed dictionaries."""
+        from transferia_tpu.chaos.failpoints import failpoint
+        from transferia_tpu.columnar.batch import intern_peek, intern_pool
+        from transferia_tpu.stats import trace
+        from transferia_tpu.stats.trace import TELEMETRY
+
+        failpoint("decode.dict_adopt")
+        intern_key = self._file_key + (cs.name,)
+        page_key = None
+        if dict_off >= 0:
+            page_key = self._file_key + (cs.name, dict_off)
+            with _FILE_CACHE_LOCK:
+                hit = _PAGE_POOL_CACHE.get(page_key)
+            if hit is not None:
+                TELEMETRY.record_pool_share_hit()
+                return hit
+        pool_off = np.append(offsets[:n_pool + 1],
+                             offsets[n_pool]).astype(np.int32)
+        pool_bytes = int(offsets[n_pool])
+        trace.instant("dict_adopt", col=cs.name, values=n_pool,
+                      bytes=pool_bytes)
+        canon = intern_peek(intern_key)
+        if canon is not None:
+            remap = _remap_codes(canon, data, offsets, n_pool)
+            if remap is not None:
+                TELEMETRY.record_pool_share_hit()
+                if np.array_equal(remap,
+                                  np.arange(n_pool + 1,
+                                            dtype=np.int32)):
+                    remap = None  # identical order: skip the gather
+                out = (canon, remap)
+                self._cache_page_pool(page_key, out)
+                return out
+
+        def finalize(pdata, poff):
+            # the pool slice views the cap-sized decode buffer (cap
+            # covers the code pages too): keeping the view pins the
+            # whole buffer for the pool's lifetime, so copy out unless
+            # the pinned remainder is small relatively AND absolutely
+            waste = int(data.nbytes) - pool_bytes
+            if pool_bytes * 2 < data.nbytes \
+                    or waste > _POOL_PIN_MAX_WASTE:
+                TELEMETRY.record_pool_buffer(copied=pool_bytes)
+                return pdata.copy(), poff
+            TELEMETRY.record_pool_buffer(pinned=waste)
+            return pdata, poff
+
+        dpool = intern_pool(intern_key, data[:pool_bytes], pool_off,
+                            null_code=n_pool, finalize=finalize)
+        out = (dpool, None)
+        self._cache_page_pool(page_key, out)
+        return out
+
+    @staticmethod
+    def _cache_page_pool(page_key, entry) -> None:
+        if page_key is None:
+            return
+        with _FILE_CACHE_LOCK:
+            while len(_PAGE_POOL_CACHE) >= _PAGE_POOL_CACHE_MAX:
+                _PAGE_POOL_CACHE.pop(next(iter(_PAGE_POOL_CACHE)), None)
+            _PAGE_POOL_CACHE[page_key] = entry
 
     def _retry_bytearray(self, g: int, cs, cap: int) -> Optional[Column]:
         """GROW retry: single-column decode with an enlarged data cap."""
@@ -344,6 +456,8 @@ class NativeParquetReader:
         codec = _CODECS.get(col.compression)
         if codec is None:
             return None
+        dict_off = (col.dictionary_page_offset
+                    if col.dictionary_page_offset is not None else -1)
         sc = self._pq_schema.column(idx)
         max_def = sc.max_definition_level
         n = col.num_values
@@ -371,7 +485,7 @@ class NativeParquetReader:
             if v is not None and v.all():
                 v = None
             return self._finish_bytearray(cs, rc, kind.value, n, data,
-                                          offsets, codes, v)
+                                          offsets, codes, v, dict_off)
         return None
 
     def _decode_tasks(self, tasks: np.ndarray, n: int) -> None:
@@ -431,7 +545,8 @@ class NativeParquetReader:
         template, specs, static_fb = self._rg_tasks(g)
         tasks = template.copy()
         holds: list[tuple] = []
-        for i, (cs, kind, ow, n, max_def, cap, view_dt) in enumerate(specs):
+        for i, (cs, kind, ow, n, max_def, cap, view_dt,
+                _dict_off) in enumerate(specs):
             if kind == 1:
                 data = np.empty(cap, dtype=np.uint8)
                 offsets = np.empty(n + 1, dtype=np.int32)
@@ -457,7 +572,8 @@ class NativeParquetReader:
             self._decode_tasks(tasks, len(specs))
         cols: dict[str, Column] = {}
         fallback: list[str] = list(static_fb)
-        for i, (cs, kind, ow, n, max_def, cap, view_dt) in enumerate(specs):
+        for i, (cs, kind, ow, n, max_def, cap, view_dt,
+                dict_off) in enumerate(specs):
             rc = int(tasks[i, _T_RESULT])
             nulls = int(tasks[i, _T_NULLS])
             bufs, val = holds[i]
@@ -472,7 +588,8 @@ class NativeParquetReader:
                     else:
                         c = self._finish_bytearray(
                             cs, rc, int(tasks[i, _T_OUTKIND]), n,
-                            bufs[0], bufs[1], bufs[2], validity)
+                            bufs[0], bufs[1], bufs[2], validity,
+                            dict_off)
                 elif rc != n:
                     c = None
                 elif kind == 2:
@@ -497,13 +614,127 @@ class NativeParquetReader:
                     _fallback_columns[name] = (
                         _fallback_columns.get(name, 0) + 1)
 
-            tbl = self._pf.read_row_group(g, columns=fallback,
-                                          use_threads=False)
             by_name = {cs.name: cs for cs in self._schema}
+            # dict pages of var-width fallback columns stay encoded:
+            # the dict-preserving reader surfaces DictionaryArrays that
+            # _arrow_to_column adopts as shared DictPools — the arrow
+            # escape hatch no longer flattens what the rest of the
+            # pipeline would immediately re-encode
+            pf = self._fallback_reader(dict_encoded_columns(
+                self._meta,
+                [name for name in fallback
+                 if by_name[name].data_type.is_variable_width]))
+            tbl = pf.read_row_group(g, columns=fallback,
+                                    use_threads=False)
             for name in fallback:
                 arr = tbl.column(name).combine_chunks()
                 cols[name] = _arrow_to_column(by_name[name], arr)
         return cols
+
+    def _fallback_reader(self, dict_cols: tuple):
+        """Memoized arrow reader for fallback reads; dict_cols surface
+        as DictionaryArrays (empty tuple -> the plain shared reader)."""
+        if not dict_cols:
+            return self._pf
+        with self._cache_lock:
+            pf = self._fb_readers.get(dict_cols)
+        if pf is None:
+            pf = parquet_file_cached(self._path,
+                                     read_dictionary=dict_cols)
+            with self._cache_lock:
+                pf = self._fb_readers.setdefault(dict_cols, pf)
+        return pf
+
+
+def _remap_codes(canon: DictPool, data: np.ndarray,
+                 offsets: np.ndarray,
+                 n_pool: int) -> Optional[np.ndarray]:
+    """Remap table from THIS page's codes onto the canonical pool's,
+    or None when the page carries a value outside the canonical pool
+    (a genuinely new dictionary — the caller re-interns instead).
+
+    The canonical pool's bytes→code index memoizes on the pool; the
+    null SENTINEL slot is excluded from it so a real empty-bytes value
+    can never alias onto the sentinel (the mask plane empties the
+    sentinel's hex slot — aliasing would silently unmask '' rows).
+    The returned table has n_pool+1 entries: the page's own sentinel
+    (code n_pool) maps to the canonical sentinel."""
+    if canon.null_code is None:
+        return None
+    if n_pool == 0:
+        return np.array([canon.null_code], dtype=np.int32)
+    from transferia_tpu.columnar.batch import _gather_varwidth
+    from transferia_tpu.ops.rowhash import pool_accumulators
+
+    memo = canon.memo_get(("remap_keys",))
+    if memo is None:
+        a1, a2 = pool_accumulators(canon)
+        ckeys = (a1.astype(np.uint64) << np.uint64(32)) \
+            | a2.astype(np.uint64)
+        # poison the sentinel's key: a real empty-bytes value must
+        # never alias onto the null sentinel (the mask plane empties
+        # the sentinel's hex slot — aliasing would silently unmask ''
+        # rows); the exact verification below backstops any residual
+        # collision with the poison value
+        ckeys = ckeys.copy()
+        ckeys[canon.null_code] = np.uint64(0xFFFFFFFFFFFFFFFF)
+        sorter = np.argsort(ckeys, kind="stable")
+        memo = (ckeys[sorter], sorter)
+        canon.memo_set(("remap_keys",), memo)
+    sorted_keys, sorter = memo
+    pool_bytes = int(offsets[n_pool])
+    page_pool = DictPool(data[:pool_bytes],
+                         np.ascontiguousarray(offsets[:n_pool + 1],
+                                              dtype=np.int32))
+    p1, p2 = pool_accumulators(page_pool)
+    pkeys = (p1.astype(np.uint64) << np.uint64(32)) \
+        | p2.astype(np.uint64)
+    pos = np.searchsorted(sorted_keys, pkeys)
+    cand = sorter[np.minimum(pos, canon.n_values - 1)]
+    # the keys are 64-bit content hashes — verify the implied mapping
+    # byte-EXACTLY (one native gather + two memcmps); any miss (value
+    # outside the pool, or a hash collision) rejects the remap and the
+    # caller re-interns, so a wrong code can never reach a consumer
+    g_data, g_off = _gather_varwidth(
+        canon.values_data,
+        np.ascontiguousarray(canon.values_offsets, dtype=np.int32),
+        cand.astype(np.int64))
+    if not (np.array_equal(g_off, offsets[:n_pool + 1])
+            and np.array_equal(g_data, data[:pool_bytes])):
+        return None
+    return np.append(cand.astype(np.int32),
+                     np.int32(canon.null_code))
+
+
+def dict_encoded_columns(meta, names) -> tuple:
+    """The subset of `names` whose chunks carry a dictionary encoding
+    (RLE/PLAIN_DICTIONARY) in EVERY row group — the columns worth
+    reading with `read_dictionary`.  The all-groups quantifier matters:
+    `read_dictionary` applies file-wide, and a writer whose dictionary
+    page overflowed partway (dictionary_pagesize_limit) leaves later
+    row groups PLAIN — forcing dictionary reads there would make arrow
+    BUILD a dictionary for a high-cardinality column, a pure loss."""
+    if meta.num_row_groups == 0:
+        return ()
+    rg0 = meta.row_group(0)
+    by_name = {}
+    for i in range(meta.num_columns):
+        by_name[rg0.column(i).path_in_schema] = i
+    out = []
+    for name in names:
+        idx = by_name.get(name)
+        if idx is None:
+            continue
+        ok = True
+        for g in range(meta.num_row_groups):
+            encs = meta.row_group(g).column(idx).encodings
+            if "RLE_DICTIONARY" not in encs \
+                    and "PLAIN_DICTIONARY" not in encs:
+                ok = False
+                break
+        if ok:
+            out.append(name)
+    return tuple(sorted(out))
 
 
 def slice_columns(cols: dict[str, Column], lo: int,

@@ -105,30 +105,43 @@ def _utf8_column(name: str, values: np.ndarray) -> Column:
     return Column(name, CanonicalType.UTF8, out, offsets)
 
 
-# per-(preset, column) shared DictPools for dict_encode batches: every
-# batch of a load references ONE pool object, so downstream memos
-# (hexed HMAC pool, rowhash accumulators) amortize across the whole
-# transfer exactly as parquet row-group dictionaries do
+# per-(preset, column) stable DictPools for dict_encode batches: pool
+# BYTES build fresh per batch (exactly like a parquet file's
+# per-row-group dict pages) and converge on one object through the
+# content-interning layer (columnar/batch.intern_pool) — every batch of
+# a load references ONE pool, so downstream memos (hexed HMAC pool,
+# rowhash accumulators, device digest matrices) amortize across the
+# whole transfer AND the cross-row-group sharing machinery is exercised
+# by the in-repo source, not only by real parquet files.
+
+
+# fallback identity cache for TRANSFERIA_TPU_POOL_SHARING=0: the kill
+# switch must restore the pre-sharing behavior (one stable pool per
+# (preset, column) per process), not regress to a fresh pool per batch
 _DICT_POOLS: dict = {}
 _DICT_POOL_LOCK = threading.Lock()
 
 
 def _shared_pool(key: str, values: list[str]):
     from transferia_tpu.columnar.batch import (
-        DictPool,
         _offsets_from_lengths,
+        intern_pool,
+        pool_sharing_enabled,
     )
 
+    if not pool_sharing_enabled():
+        with _DICT_POOL_LOCK:
+            pool = _DICT_POOLS.get(key)
+        if pool is not None:
+            return pool
+    bufs = [v.encode() for v in values]
+    data = np.frombuffer(b"".join(bufs), dtype=np.uint8).copy()
+    # one extra empty-bytes sentinel entry for null rows (none in the
+    # sample presets, but the pool contract carries it)
+    off = _offsets_from_lengths([len(b) for b in bufs] + [0])
+    pool = intern_pool(("sample", key), data, off, null_code=len(bufs))
     with _DICT_POOL_LOCK:
-        pool = _DICT_POOLS.get(key)
-        if pool is None:
-            bufs = [v.encode() for v in values]
-            data = np.frombuffer(b"".join(bufs), dtype=np.uint8).copy()
-            # one extra empty-bytes sentinel entry for null rows (none
-            # in the sample presets, but the pool contract carries it)
-            off = _offsets_from_lengths([len(b) for b in bufs] + [0])
-            pool = DictPool(data, off, null_code=len(bufs))
-            _DICT_POOLS[key] = pool
+        pool = _DICT_POOLS.setdefault(key, pool)
     return pool
 
 

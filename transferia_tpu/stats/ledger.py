@@ -70,6 +70,10 @@ FIELDS = (
     # publish decisions, fenced (stale-epoch) attempts, and rows the
     # staging dedup window dropped before publish
     "commits", "commit_fences", "dedup_rows_dropped",
+    # pool-once encoded Arrow wire (interchange/convert
+    # EncodedWireState): dict pool bytes shipped (once per stream) vs
+    # codes-only batch bytes
+    "pool_bytes_shipped", "codes_bytes_shipped",
 )
 
 _INT_FIELDS = frozenset(f for f in FIELDS if not f.endswith("_seconds"))

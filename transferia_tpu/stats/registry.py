@@ -205,6 +205,15 @@ class DeviceStats(_Bundle):
         self.dict_pool_hits = self.m.counter("dict_pool_device_hits")
         self.dict_pool_uploads = self.m.counter(
             "dict_pool_device_uploads")
+        # pool interning + decode-buffer economics (columnar/batch
+        # intern_pool, parquet_native._finish_bytearray): content-hit
+        # pool reuse across row groups/parts, and bytes a kept pool
+        # view pins vs bytes copied out to free the decode buffer
+        self.dict_pool_share_hits = self.m.counter("dict_pool_share_hits")
+        self.dict_pool_pinned_bytes = self.m.counter(
+            "dict_pool_pinned_bytes")
+        self.dict_pool_copied_bytes = self.m.counter(
+            "dict_pool_copied_bytes")
         # dict-native reduction plane (ops/rowhash.py, mask fast paths):
         # columns that crossed a stage still code-encoded vs columns a
         # consumer flattened — nonzero flat materializations on a
@@ -231,6 +240,18 @@ class InterchangeStats(_Bundle):
         self.copied_buffers = self.m.counter("interchange_copied_buffers")
         self.flight_streams = self.m.counter("interchange_flight_streams")
         self.shm_segments = self.m.counter("interchange_shm_segments")
+        # pool-once encoded wire (interchange/convert.EncodedWireState):
+        # the ratio gauge is the wire's honesty metric — flat-equivalent
+        # bytes over (pool-once + codes) bytes actually framed; ~1.0 on
+        # a dict-heavy stream means pools re-ship or columns cross flat
+        self.pools_shipped = self.m.counter("interchange_pools_shipped")
+        self.pool_bytes_shipped = self.m.counter(
+            "interchange_pool_bytes_shipped")
+        self.codes_bytes_shipped = self.m.counter(
+            "interchange_codes_bytes_shipped")
+        self.flat_equiv_bytes = self.m.counter(
+            "interchange_flat_equiv_bytes")
+        self.encoded_wire_ratio = self.m.gauge("encoded_wire_ratio")
 
 
 class ChaosStats(_Bundle):

@@ -647,6 +647,14 @@ class DeviceTelemetry:
             self.h2d_raw_equiv_bytes = 0
             self.dict_pool_hits = 0
             self.dict_pool_uploads = 0
+            # pool interning (columnar/batch.intern_pool): producers
+            # re-creating identical pool bytes converged on one object
+            self.dict_pool_share_hits = 0
+            # decode-buffer pinning decisions (parquet_native
+            # _finish_bytearray): bytes a kept pool VIEW pins beyond the
+            # pool itself vs bytes copied out to release the buffer
+            self.dict_pool_pinned_bytes = 0
+            self.dict_pool_copied_bytes = 0
             # dict-native pipeline honesty pair: columns handled in
             # their code+pool encoding end-to-end vs columns some
             # consumer flattened (Column._materialize) — a dict-heavy
@@ -704,6 +712,18 @@ class DeviceTelemetry:
         with self._lock:
             self.dict_pool_uploads += 1
 
+    def record_pool_share_hit(self) -> None:
+        """A re-created pool matched an interned one by content."""
+        with self._lock:
+            self.dict_pool_share_hits += 1
+
+    def record_pool_buffer(self, pinned: int = 0, copied: int = 0) -> None:
+        """One decode-buffer retention decision: `pinned` extra bytes a
+        kept view keeps alive, or `copied` pool bytes memcpy'd out."""
+        with self._lock:
+            self.dict_pool_pinned_bytes += int(pinned)
+            self.dict_pool_copied_bytes += int(copied)
+
     def record_dict_preserved(self, n: int = 1) -> None:
         """A dict column crossed a pipeline stage still code-encoded."""
         with self._lock:
@@ -744,6 +764,9 @@ class DeviceTelemetry:
                 "dispatch_compression_ratio": round(ratio, 2),
                 "dict_pool_hits": self.dict_pool_hits,
                 "dict_pool_uploads": self.dict_pool_uploads,
+                "dict_pool_share_hits": self.dict_pool_share_hits,
+                "dict_pool_pinned_bytes": self.dict_pool_pinned_bytes,
+                "dict_pool_copied_bytes": self.dict_pool_copied_bytes,
                 "lazy_dict_preserved": self.lazy_dict_preserved,
                 "dict_flat_materializations":
                     self.dict_flat_materializations,
@@ -777,6 +800,9 @@ class DeviceTelemetry:
                 "h2d_raw_equiv_bytes": self.h2d_raw_equiv_bytes,
                 "dict_pool_hits": self.dict_pool_hits,
                 "dict_pool_uploads": self.dict_pool_uploads,
+                "dict_pool_share_hits": self.dict_pool_share_hits,
+                "dict_pool_pinned_bytes": self.dict_pool_pinned_bytes,
+                "dict_pool_copied_bytes": self.dict_pool_copied_bytes,
                 "lazy_dict_preserved": self.lazy_dict_preserved,
                 "dict_flat_materializations":
                     self.dict_flat_materializations,
@@ -795,6 +821,9 @@ class DeviceTelemetry:
                 ("h2d_raw_equiv_bytes", ds.h2d_raw_equiv_bytes),
                 ("dict_pool_hits", ds.dict_pool_hits),
                 ("dict_pool_uploads", ds.dict_pool_uploads),
+                ("dict_pool_share_hits", ds.dict_pool_share_hits),
+                ("dict_pool_pinned_bytes", ds.dict_pool_pinned_bytes),
+                ("dict_pool_copied_bytes", ds.dict_pool_copied_bytes),
                 ("lazy_dict_preserved", ds.lazy_dict_preserved),
                 ("dict_flat_materializations",
                  ds.dict_flat_materializations),

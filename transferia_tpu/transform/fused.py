@@ -180,10 +180,11 @@ class DeviceFusedStep(Transformer):
         from transferia_tpu.ops.dispatch import encoding_enabled
 
         enc = encoding_enabled()
-        # the pool route only exists on the single-device program: a
-        # batch large enough to take the mesh program flattens dict
-        # columns onto the raw block wire, and the estimate must charge
-        # that, not the single-device short-cut
+        # the dict route differs per program: the single-device pool
+        # route ships NOTHING per row (codes rebind on host); the mesh
+        # dict route ships the int32 codes sharded (4 B/row) plus per-
+        # row digest words back, with the pool digest matrix amortized
+        # by its memo exactly like the hexed pool
         mesh_route = (self.sharded_program is not None
                       and n_rows >= self._sharded_min_rows)
         h2d = 0.0
@@ -192,9 +193,27 @@ class DeviceFusedStep(Transformer):
             col = None
             if batch is not None and name in batch.columns:
                 col = batch.column(name)
-            if (enc and not mesh_route and col is not None
-                    and col.is_lazy_dict):
+            if enc and col is not None and col.is_lazy_dict:
                 pool = col.dict_enc.pool
+                if mesh_route:
+                    if pool.n_values > 2 * max(n_rows, 1) and \
+                            pool.memo_get(("hmac_digest_rows",
+                                           bytes(key))) is None:
+                        # economics-rejected on the mesh: flat wire
+                        h2d += 128.0 * n_rows
+                        d2h += 32.0 * n_rows
+                        continue
+                    if pool.memo_get(("hmac_digest_rows",
+                                      bytes(key))) is None:
+                        h2d += 128.0 * pool.n_values  # one pool upload
+                        d2h += 32.0 * pool.n_values
+                    # the memo amortizes the pool HASH, not the wire:
+                    # the host digest matrix re-ships with every launch
+                    # (it rides the jit args), so charge it per batch
+                    h2d += 32.0 * pool.n_values  # replicated digests
+                    h2d += 4.0 * n_rows   # sharded codes
+                    d2h += 32.0 * n_rows  # gathered digest words back
+                    continue
                 if pool.memo_get(("hmac_hex", bytes(key))) is not None:
                     continue  # hexed pool already resident: free
                 if pool.n_values <= 2 * max(n_rows, 1):
@@ -332,14 +351,17 @@ class DeviceFusedStep(Transformer):
             program = self.sharded_program
         # device-resident dict masking: a DictEnc column's pool hashes
         # ON DEVICE once per (pool, key) and the batch's row bytes never
-        # cross the link — the codes rebind to the hexed pool on the
-        # host.  (The mesh program shards per-row digests across chips,
-        # so the pool route only applies to the single-device program.)
+        # cross the link — on the single-device program the codes rebind
+        # to the hexed pool on the host; on the MESH program the codes
+        # shard over the row axis and each device gathers per-row digest
+        # words from the replicated pool digest matrix (fusedmesh
+        # DictMaskInput) — either way the flat bytes never ship.
         dict_cols: dict[str, Column] = {}
         mask_inputs = []
         flat_entries = []
         flat_states = []
         use_pool_route = encoding_enabled() and program is self.program
+        use_mesh_dict = encoding_enabled() and program is not self.program
         for (name, key), states in zip(self.mask_entries,
                                        self.program._states):
             col = batch.column(name)
@@ -365,6 +387,20 @@ class DeviceFusedStep(Transformer):
 
                 dict_cols[name] = mask_dict_column(bytes(key), col)
                 continue
+            if use_mesh_dict and col.is_lazy_dict:
+                from transferia_tpu.parallel.fusedmesh import (
+                    dict_mask_input,
+                )
+
+                dmi = dict_mask_input(bytes(key), col)
+                if dmi is not None:
+                    # stays in the program (digests byte-identical to
+                    # the flat route; hex output consumed identically)
+                    mask_inputs.append(dmi)
+                    flat_entries.append(name)
+                    continue
+                # economics-rejected pool: the flat block wire, as the
+                # mesh always shipped before the dict route existed
             mask_inputs.append((col.data, col.offsets))
             flat_entries.append(name)
             flat_states.append(states)
