@@ -1278,6 +1278,14 @@ TOLERANCE_OVERRIDES = {
     "mysql2kafka_debezium_rows_per_sec": 0.4,
     "pg2ch_snapshot_rows_per_sec": 0.4,
     "fleet_transfers_per_sec": 0.4,
+    # merged-histogram dispatch tails (fleet/bench.py via stats/hdr.py):
+    # scheduling-bound on the 1-core bench boxes, and the p999 of a
+    # ~100-sample window is a single observation — wide bands on
+    # purpose; the histogram's merge==concat exactness is pinned by
+    # unit tests, not by run-to-run latency stability
+    "fleet_dispatch_p50_ms": 0.6,
+    "fleet_dispatch_p99_ms": 0.8,
+    "fleet_dispatch_p999_ms": 1.0,
     # loopback-gRPC round trips on the 1-core bench boxes are
     # scheduling-bound; the wire-bytes ratio is the stable signal and
     # gates through wire_bytes-derived fields, not rows/s
@@ -1842,6 +1850,11 @@ def main() -> int:
         for line in _fmt_fleet(report).splitlines():
             print(f"# {line}", file=sys.stderr)
         _METRICS_EMITTED.append(report)
+        # the merged-histogram dispatch tail rides the --against gate
+        # as its own metric lines (latency direction: *_ms suffix)
+        for q in ("p50", "p99", "p999"):
+            _emit({"metric": f"fleet_dispatch_{q}_ms", "unit": "ms",
+                   "value": report[f"dispatch_hdr_{q}_ms"]})
         print(json.dumps(report))
         return gated(0 if report["ok"] else 1)
 

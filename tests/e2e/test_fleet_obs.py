@@ -1,0 +1,233 @@
+"""Fleet observability e2e: REAL worker processes exporting durable
+obs segments through a filestore coordinator (stats/fleetobs.py).
+
+Two proofs:
+
+1. **Single merged timeline** — one transfer's ticket is admitted by
+   the scheduler (this test process, tracing on), run partway by
+   worker A, drained via SIGTERM at a part boundary, and finished by
+   worker B.  The trace context stamped into the ticket payload at
+   admission (fleet/distributed.py TICKET_TRACE_KEY) is adopted by
+   BOTH claimers, so the merged Perfetto export contains spans from
+   all THREE processes linked under ONE trace id, and the merged
+   fleet ledger passes the cross-process conservation check.
+
+2. **SIGKILL survival** — a worker is kill -9'd mid-transfer; its
+   heartbeat-cadence exports survive it (at most one export interval
+   lost), the survivor reclaims and finishes, and the merge still
+   renders with conservation intact over the surviving segments.
+"""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from transferia_tpu.abstract.ticket import FleetTicket
+from transferia_tpu.coordinator import FileStoreCoordinator
+from transferia_tpu.stats import fleetobs, trace
+
+pytestmark = pytest.mark.slow
+
+# sized so the SIGTERM-drain handoff window is SECONDS wide: after
+# the first part commits, ~31 parts (each a real fused-pipeline run)
+# remain — worker A cannot finish them between the poll observing the
+# first completion and the signal landing
+ROWS = 32768
+PARTS = 32
+
+
+def _payload(i, rows=ROWS):
+    return {
+        "kind": "sample_snapshot", "rows": rows, "shard_parts": PARTS,
+        "batch_rows": max(64, rows // (PARTS * 2)),
+        "sink_id": f"e2e-obs-{i}", "operation_id": f"op-e2e-obs-{i}",
+        "transformation": {"transformers": [
+            {"mask_field": {"columns": ["device_id"], "salt": "obs"}},
+        ]},
+    }
+
+
+def _spawn_worker(root, index, lease_seconds=None):
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env["TRANSFERIA_TPU_TRACE"] = "1"
+    env["TRANSFERIA_TPU_OBS_INTERVAL"] = "0.2"
+    if lease_seconds is not None:
+        env["TRANSFERIA_TPU_LEASE_SECONDS"] = str(lease_seconds)
+    return subprocess.Popen(
+        [sys.executable, "-m", "transferia_tpu.cli.main",
+         "--log-level", "warning",
+         "--coordinator", "filestore", "--coordinator-dir", root,
+         "worker", "--queue", "fleet",
+         "--worker-index", str(index),
+         "--heartbeat", "0.3", "--idle-exit", "5"],
+        env=env, cwd=os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))))
+
+
+def _wait(predicate, deadline_s, what, poll=0.2):
+    deadline = time.monotonic() + deadline_s
+    while time.monotonic() < deadline:
+        if predicate():
+            return
+        time.sleep(poll)
+    pytest.fail(f"timed out waiting for {what}")
+
+
+def _terminate_all(procs):
+    for p in procs:
+        if p.poll() is None:
+            p.terminate()
+    for p in procs:
+        try:
+            p.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            p.kill()
+
+
+def _trace_id_pids(segments):
+    """trace_id -> set of pids whose segments carry spans of it."""
+    out = {}
+    for seg in segments:
+        for rec in seg.get("spans", []):
+            tid = rec[8]
+            if tid:
+                out.setdefault(tid, set()).add(seg["pid"])
+    return out
+
+
+def test_one_transfer_three_processes_single_timeline(tmp_path):
+    root = str(tmp_path / "cp")
+    cp = FileStoreCoordinator(root=root)
+    from transferia_tpu.fleet.distributed import DistributedFleetScheduler
+    from transferia_tpu.stats.registry import Metrics
+
+    trace.enable(True)
+    procs = []
+    try:
+        trace.reset()
+        sched = DistributedFleetScheduler(cp, queue="fleet",
+                                          metrics=Metrics(),
+                                          name="e2e-obs-sched")
+        assert sched.submit(FleetTicket(
+            ticket_id="tk-obs", transfer_id="e2e-obs-0",
+            payload=_payload(0))) == "admitted"
+        # the admission stamped its trace onto the wire
+        stored = cp.list_tickets("fleet")[0]
+        assert stored.payload.get("__trace")
+
+        # worker A runs part of the transfer, then drains on SIGTERM
+        # at a part boundary; worker B resumes from committed parts
+        wa = _spawn_worker(root, 1)
+        procs.append(wa)
+        _wait(lambda: any(p.completed for p in
+                          cp.operation_parts("op-e2e-obs-0")),
+              180, "worker A to commit a part", poll=0.05)
+        wa.send_signal(signal.SIGTERM)
+        wa.wait(timeout=120)
+        assert wa.returncode == 0
+        # the drain landed mid-transfer: the ticket went back to the
+        # queue with work left (the whole point of the handoff)
+        assert cp.list_tickets("fleet")[0].state == "queued", \
+            "worker A finished before the drain could land — " \
+            "transfer sizing regression"
+        wb = _spawn_worker(root, 2)
+        procs.append(wb)
+        _wait(lambda: all(t.state == "done"
+                          for t in cp.list_tickets("fleet")),
+              240, "worker B to finish the drained transfer")
+
+        # the scheduler process exports its own segment (admission
+        # spans) — three processes now share the obs scope
+        fleetobs.exporter_for(
+            cp, worker=f"sched.{os.getpid()}").export("final")
+    finally:
+        trace.enable(False)
+        _terminate_all(procs)
+
+    segments = cp.list_obs_segments(fleetobs.default_scope())
+    pids = {seg["pid"] for seg in segments}
+    assert len(pids) == 3, f"expected 3 processes, got {pids}"
+
+    # ONE trace id spans all three processes: the admission span
+    # (scheduler), worker A's partial run, worker B's resume
+    spanning = {tid: ps for tid, ps in
+                _trace_id_pids(segments).items() if len(ps) == 3}
+    assert spanning, "no trace id linked spans from all 3 processes"
+
+    # the merged Perfetto doc renders them as three pid lanes with
+    # cross-process flow links
+    doc = fleetobs.export_fleet_chrome_trace(segments,
+                                             transfer_id="e2e-obs-0")
+    ev_pids = {e["pid"] for e in doc["traceEvents"]
+               if e.get("ph") == "X"}
+    assert len(ev_pids) == 3
+    assert any(e.get("cat") == "flow" for e in doc["traceEvents"])
+
+    # cross-process conservation: merged ledger totals == Σ
+    # per-process totals, and the fleet saw every row
+    view = fleetobs.merge_segments(segments)
+    assert view["conservation"]["ok"], view["conservation"]
+    assert view["totals"]["rows_in"] >= ROWS
+    merged_rows = sum(
+        vals["rows_in"]
+        for vals in view["conservation"]["per_process_totals"].values())
+    assert merged_rows == view["totals"]["rows_in"]
+    # the transfer's merged row names both workers
+    row = view["transfers"].get("e2e-obs-0")
+    assert row is not None and len(row["workers"]) >= 2, row
+
+
+def test_sigkill_loses_at_most_one_export_interval(tmp_path):
+    root = str(tmp_path / "cp")
+    cp = FileStoreCoordinator(root=root, lease_seconds=2.0)
+    cp.enqueue_ticket("fleet", FleetTicket(
+        ticket_id="tk-kill", transfer_id="e2e-obs-kill",
+        payload=_payload("kill", rows=4096)))
+
+    wa = _spawn_worker(root, 1, lease_seconds=2.0)
+    wb = _spawn_worker(root, 2, lease_seconds=2.0)
+    procs = [wa, wb]
+    try:
+        def claimed_by():
+            ts = cp.list_tickets("fleet")
+            return ts[0].claimed_by if ts and ts[0].state == "claimed" \
+                else ""
+
+        _wait(claimed_by, 180, "a worker to claim the ticket")
+        victim = wa if claimed_by() == "w1" else wb
+        victim_pid = victim.pid
+
+        def victim_exported():
+            return any(seg["pid"] == victim_pid for seg in
+                       cp.list_obs_segments(fleetobs.default_scope()))
+
+        _wait(victim_exported, 120,
+              "the claiming worker's first obs export")
+        victim.kill()                       # SIGKILL: no flush, no drain
+        victim.wait(timeout=30)
+
+        _wait(lambda: all(t.state == "done"
+                          for t in cp.list_tickets("fleet")),
+              300, "the survivor to reclaim and finish")
+    finally:
+        _terminate_all(procs)
+
+    segments = cp.list_obs_segments(fleetobs.default_scope())
+    # the SIGKILLed worker's last heartbeat-cadence export survived it
+    assert any(seg["pid"] == victim_pid for seg in segments), \
+        "victim's exported observability vanished with the process"
+    # and the merge over the surviving segments still passes
+    # conservation — the torn tail is at most one export interval
+    view = fleetobs.merge_segments(segments)
+    assert view["conservation"]["ok"], view["conservation"]
+    assert view["totals"]["rows_in"] > 0
+    assert any(key.endswith(f":{victim_pid}") for key in
+               view["conservation"]["per_process_totals"])
+    doc = fleetobs.export_fleet_chrome_trace(segments)
+    assert json.dumps(doc)                  # serializable end-to-end

@@ -435,6 +435,19 @@ class AuditingCoordinator(Coordinator):
     def operation_parts(self, operation_id):
         return self.inner.operation_parts(operation_id)
 
+    def supports_obs_segments(self):
+        return self.inner.supports_obs_segments()
+
+    def put_obs_segment(self, scope, segment):
+        return self.inner.put_obs_segment(scope, segment)
+
+    def list_obs_segments(self, scope):
+        return self.inner.list_obs_segments(scope)
+
+    def gc_obs_segments(self, scope, retention_seconds=None):
+        return self.inner.gc_obs_segments(
+            scope, retention_seconds=retention_seconds)
+
     def operation_health(self, operation_id, worker_index, payload=None):
         return self.inner.operation_health(operation_id, worker_index,
                                            payload)

@@ -1197,6 +1197,11 @@ def fleet_distributed_schedule(trial: int, seed: int) -> str:
         clauses.append(
             f"fleet.complete=after:{rng.randrange(0, 2)},times:1,"
             f"raise:ChaosInjectedError")
+    if rng.random() < 0.5:
+        # observability exports are best-effort: transient export
+        # faults must never fail the part/ticket they rode on, and the
+        # post-trial merge must still pass on the surviving segments
+        clauses.append("obs.export=prob:0.3,raise:ChaosInjectedError")
     return ";".join(clauses)
 
 
@@ -1340,6 +1345,41 @@ def _fleet_dist_scenario(trial: int, seed: int, rows: int, spec: str,
                     f"accepted"))
         fires = failpoints.fire_counts()
         log = failpoints.fire_log()
+
+    # fleet observability survives the worker kill: segments exported
+    # through the coordinator (heartbeat cadence + ticket boundaries +
+    # the survivor's final flush) outlive the victim process, and the
+    # merged pane must render with cross-process conservation intact
+    # even when some exports were chaos-faulted away
+    from transferia_tpu.stats import fleetobs
+
+    obs_segments = cp.list_obs_segments(fleetobs.default_scope())
+    if not obs_segments:
+        violations.append(Violation(
+            "fleet-observability",
+            "no obs segments survived the trial (export plane dark)"))
+    else:
+        obs_view = fleetobs.merge_segments(obs_segments)
+        if not obs_view["conservation"]["ok"]:
+            violations.append(Violation(
+                "fleet-observability",
+                f"merged obs conservation drifted: "
+                f"{obs_view['conservation']['drift']}"))
+        if "obs.export" not in spec and \
+                not any(label.startswith("fleet.w2.")
+                        for label in obs_view["workers"]):
+            # only asserted on schedules that don't fault the export
+            # plane itself: with obs.export armed, a seed could fault
+            # every one of the survivor's exports legitimately
+            violations.append(Violation(
+                "fleet-observability",
+                "survivor worker exported no obs segment (final "
+                "flush on drain missing)"))
+        if obs_view["totals"].get("rows_in", 0) <= 0:
+            violations.append(Violation(
+                "fleet-observability",
+                "merged fleet ledger shows zero rows for a trial "
+                "that delivered data"))
 
     tickets = cp.list_tickets(queue)
     by_id = {t.ticket_id: t for t in tickets}

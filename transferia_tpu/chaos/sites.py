@@ -217,6 +217,19 @@ SITES: dict[str, tuple[str, str]] = {
         "failures must be absorbed by the ticket lease TTL; with "
         "raise:WorkerKilledError the heartbeat dies and the worker's "
         "claimed ticket is reclaimed by a survivor after expiry"),
+    "obs.export": (
+        "stats/fleetobs.py",
+        "observability-segment export failing (coordinator "
+        "unreachable at heartbeat cadence) — export is best-effort: a "
+        "failed export must never fail the part/ticket it rode on, "
+        "and at most one export interval of observability is lost "
+        "(the next beat re-sends the window under the same seq)"),
+    "obs.merge": (
+        "stats/fleetobs.py",
+        "a torn/truncated obs segment hitting the reader's merge "
+        "(writer SIGKILLed mid-put) — the merge must skip and count "
+        "the corrupt segment and still render the pane from the "
+        "survivors"),
     "client.s3.request": (
         "coordinator/s3client.py",
         "S3 wire request failing (timeout, 5xx, connection reset)"),

@@ -152,6 +152,14 @@ def build_parser() -> argparse.ArgumentParser:
                           "(snapshot transfers run to completion)")
     trc.add_argument("--rows", type=int, default=50_000,
                      help="demo source rows (only without --transfer)")
+    trc.add_argument("--fleet", default="", metavar="TRANSFER_ID",
+                     help="fleet mode: instead of running anything, "
+                          "merge the durable obs segments from the "
+                          "coordinator (stats/fleetobs.py) into ONE "
+                          "Perfetto timeline for this transfer — spans "
+                          "from every worker process that touched it, "
+                          "linked under the propagated trace ids "
+                          "('all' = every trace in the scope)")
     cha = sub.add_parser(
         "chaos",
         help="seeded fault-injection trials over the built-in sample "
@@ -281,8 +289,19 @@ def build_parser() -> argparse.ArgumentParser:
     top.add_argument("--limit", type=int, default=20,
                      help="transfer rows per frame")
     top.add_argument("--json", action="store_true", dest="as_json",
-                     help="print one raw /debug/ledger snapshot and "
-                          "exit")
+                     help="print one raw snapshot (ledger, or the "
+                          "merged fleet view under --fleet) and exit")
+    top.add_argument("--once", action="store_true",
+                     help="render one formatted frame and exit "
+                          "(scripting / CI smokes)")
+    top.add_argument("--fleet", action="store_true",
+                     help="cluster pane: merge the durable obs "
+                          "segments of every worker process from the "
+                          "coordinator (global --coordinator* flags) "
+                          "instead of polling one worker's health "
+                          "port — fleet ledger, per-worker liveness "
+                          "ages, merged latency histograms, "
+                          "cross-process conservation")
     return p
 
 
@@ -291,6 +310,17 @@ def _setup(args) -> None:
         level=getattr(logging, args.log_level.upper()),
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
     )
+    import os as _os
+
+    if _os.environ.get("TRANSFERIA_TPU_TRACE", "") not in (
+            "", "0", "false", "no"):
+        # headless span capture: worker processes in a fleet can't be
+        # handed a --trace flag per run, but their obs segments export
+        # span deltas (stats/fleetobs.py) — this env knob turns the
+        # ring on so `trtpu trace --fleet` has cross-process spans
+        from transferia_tpu.stats import trace as _trace
+
+        _trace.enable(True)
     # secret redaction + value truncation on every handler
     # (internal/logger/sanitizer_encoder.go + json_truncator.go parity)
     from transferia_tpu.utils.logsanitize import install as _install_san
@@ -366,6 +396,25 @@ def _start_health_server(port: int) -> int:
                         f"{len(data):X}\r\n".encode() + data + b"\r\n")
                 self.wfile.write(b"0\r\n\r\n")
                 return
+            elif self.path.startswith("/debug/fleet/obs"):
+                # fleet-wide observability pane: obs segments from N
+                # worker processes merged through the registered
+                # coordinator (stats/fleetobs.py) — cluster ledger,
+                # per-worker liveness, merged latency histograms, and
+                # the cross-process conservation check
+                from transferia_tpu.stats import fleetobs
+
+                view = fleetobs.debug_fleet_obs()
+                if view is None:
+                    body = json.dumps({
+                        "error": "no obs runtime registered (run under "
+                                 "`trtpu worker` with an obs-capable "
+                                 "coordinator)"}).encode()
+                    status = 503
+                else:
+                    body = fleetobs.dumps_view(view).encode()
+                    status = 200
+                ctype = "application/json"
             elif self.path.startswith("/debug/ledger"):
                 # per-transfer/per-tenant resource attribution + the
                 # conservation reconciliation (stats/ledger.py); the
@@ -733,6 +782,8 @@ def cmd_trace(args) -> int:
     from transferia_tpu.stats.ledger import LEDGER
     from transferia_tpu.stats.registry import Metrics
 
+    if args.fleet:
+        return cmd_trace_fleet(args)
     if args.transfer:
         transfer = _load_transfer(args)
     else:
@@ -773,6 +824,46 @@ def cmd_trace(args) -> int:
         print(trace.format_summary(wall))
         print("device telemetry: "
               + json.dumps(trace.TELEMETRY.snapshot()))
+    return 0
+
+
+def cmd_trace_fleet(args) -> int:
+    """`trtpu trace --fleet <transfer>`: stitch the durable obs
+    segments of every process that touched the transfer into ONE
+    Perfetto timeline (stats/fleetobs.py) — each worker process is a
+    pid lane, cross-process parent links render as flow arrows."""
+    from transferia_tpu.stats import fleetobs
+
+    cp = _coordinator(args)
+    if not cp.supports_obs_segments():
+        print("coordinator has no obs-segment support; nothing to "
+              "merge", file=sys.stderr)
+        return 2
+    scope = fleetobs.default_scope()
+    segments = cp.list_obs_segments(scope)
+    if not segments:
+        print(f"no obs segments under scope {scope!r} — are workers "
+              f"running with observability export on?", file=sys.stderr)
+        return 2
+    transfer_filter = "" if args.fleet == "all" else args.fleet
+    doc = fleetobs.export_fleet_chrome_trace(
+        segments, transfer_id=transfer_filter)
+    with open(args.out, "w") as fh:
+        json.dump(doc, fh)
+    other = doc["otherData"]
+    view = fleetobs.merge_segments(segments)
+    cons = view["conservation"]
+    print(f"fleet trace: {len(doc['traceEvents'])} events from "
+          f"{other['processes']} process(es) "
+          f"({other['segments']} segments, "
+          f"{other['corrupt_segments']} torn) -> {args.out} "
+          f"(open in https://ui.perfetto.dev)")
+    print(f"fleet conservation: "
+          f"{'OK' if cons['ok'] else 'DRIFT ' + json.dumps(cons['drift'])}")
+    if transfer_filter and other["processes"] == 0:
+        print(f"no spans matched transfer {transfer_filter!r} "
+              f"(check the id, or pass 'all')", file=sys.stderr)
+        return 1
     return 0
 
 
@@ -918,6 +1009,13 @@ def cmd_worker(args) -> int:
         heartbeat_interval=args.heartbeat,
         idle_exit_seconds=args.idle_exit,
         max_tickets=args.max_tickets)
+    if cp.supports_obs_segments():
+        # give this process's health port the fleet panes
+        # (/debug/fleet/obs merged view, /debug/fleet worker liveness)
+        from transferia_tpu.stats import fleetobs
+
+        fleetobs.register_runtime(cp,
+                                  health_scope=f"fleet:{args.queue}")
     stop = threading.Event()
 
     def handle_sig(signum, frame):
@@ -937,14 +1035,19 @@ def cmd_worker(args) -> int:
 
 
 def cmd_top(args) -> int:
-    """Live resource console over GET /debug/ledger (stats/ledger.py
-    format_top): one frame per --interval, ANSI clear between frames on
-    a tty, plain appended frames when piped."""
+    """Live resource console: per-process over GET /debug/ledger
+    (stats/ledger.py format_top), or — with --fleet — the merged
+    cluster pane from the coordinator's durable obs segments
+    (stats/fleetobs.py).  One frame per --interval, ANSI clear between
+    frames on a tty; --once renders a single frame (CI smokes),
+    --json dumps one raw snapshot."""
     import time as _time
     import urllib.request
 
     from transferia_tpu.stats.ledger import format_top
 
+    if args.fleet:
+        return cmd_top_fleet(args)
     url = args.url.rstrip("/") + "/debug/ledger"
     frames = 0
     try:
@@ -971,7 +1074,46 @@ def cmd_top(args) -> int:
                 print("\x1b[2J\x1b[H", end="")
             print(format_top(snap, limit=args.limit), flush=True)
             frames += 1
-            if args.frames and frames >= args.frames:
+            if args.once or (args.frames and frames >= args.frames):
+                return 0
+            _time.sleep(max(0.2, args.interval))
+    except KeyboardInterrupt:
+        return 0
+
+
+def cmd_top_fleet(args) -> int:
+    """`trtpu top --fleet`: the cluster pane.  Reads every worker
+    process's durable obs segments through the coordinator (global
+    --coordinator* flags), merges them (latest cumulative state per
+    process, summed across processes), and renders the fleet ledger
+    with per-worker liveness ages and merged latency tails."""
+    import time as _time
+
+    from transferia_tpu.stats import fleetobs
+
+    cp = _coordinator(args)
+    if not cp.supports_obs_segments():
+        print("trtpu top --fleet: coordinator has no obs-segment "
+              "support", file=sys.stderr)
+        return 2
+    frames = 0
+    try:
+        while True:
+            try:
+                view = fleetobs.read_view(cp)
+            except Exception as e:
+                print(f"trtpu top --fleet: segment read failed: {e}",
+                      file=sys.stderr)
+                return 2
+            if args.as_json:
+                print(fleetobs.dumps_view(view))
+                return 0
+            if frames and sys.stdout.isatty():
+                print("\x1b[2J\x1b[H", end="")
+            print(fleetobs.format_fleet_top(view, limit=args.limit),
+                  flush=True)
+            frames += 1
+            if args.once or (args.frames and frames >= args.frames):
                 return 0
             _time.sleep(max(0.2, args.interval))
     except KeyboardInterrupt:

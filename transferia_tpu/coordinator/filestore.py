@@ -56,6 +56,7 @@ class FileStoreCoordinator(Coordinator):
         os.makedirs(os.path.join(root, "operations"), exist_ok=True)
         os.makedirs(os.path.join(root, "health"), exist_ok=True)
         os.makedirs(os.path.join(root, "fleet"), exist_ok=True)
+        os.makedirs(os.path.join(root, "obs"), exist_ok=True)
 
     # -- file helpers -------------------------------------------------------
     def _tdir(self, transfer_id: str) -> str:
@@ -426,6 +427,104 @@ class FileStoreCoordinator(Coordinator):
             if pruned:
                 doc["tickets"] = keep
                 self._write_json(p, doc)
+        return pruned
+
+    # -- durable observability segments --------------------------------------
+    # One file per segment (`obs/<scope>/<worker>-<seq>.json`): the put
+    # is an atomic tmp+rename (under the flock for write-write
+    # convention with the other doc stores), so a reader can never see
+    # a torn file from a healthy writer — torn segments come from
+    # crashed writers and the merge plane tolerates them.
+
+    def _obs_dir(self, scope: str) -> str:
+        import urllib.parse as _up
+
+        d = os.path.join(self.root, "obs", _up.quote(scope, safe=""))
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    @staticmethod
+    def _obs_name(worker: str, seq: int) -> str:
+        import urllib.parse as _up
+
+        return f"{_up.quote(worker, safe='')}-{seq:08d}.json"
+
+    def put_obs_segment(self, scope: str, segment: dict) -> None:
+        d = self._obs_dir(scope)
+        worker = str(segment.get("worker", ""))
+        seq = int(segment.get("seq", 0))
+        p = os.path.join(d, self._obs_name(worker, seq))
+        # no flock: _write_json is an atomic tmp+rename and each
+        # (worker, seq) has exactly one writer — a lock FILE here
+        # would leak one `.lock` per export forever (seq is always
+        # fresh), growing the obs dir O(history)
+        self._write_json(p, segment)
+
+    def _obs_files(self, scope: str) -> list[str]:
+        d = self._obs_dir(scope)
+        return sorted(
+            os.path.join(d, name) for name in os.listdir(d)
+            if name.endswith(".json"))
+
+    def list_obs_segments(self, scope: str) -> list[dict]:
+        out = []
+        for p in self._obs_files(scope):
+            seg = self._read_json(p, None)
+            if isinstance(seg, dict):
+                out.append(seg)
+            # torn/unparseable files are skipped: the merge renders
+            # from the survivors (a crashed writer's last segment)
+        return out
+
+    def gc_obs_segments(self, scope: str,
+                        retention_seconds: Optional[float] = None
+                        ) -> int:
+        from transferia_tpu.coordinator.interface import (
+            obs_retention_seconds,
+            obs_segments_per_worker,
+        )
+
+        retention = obs_retention_seconds() \
+            if retention_seconds is None else retention_seconds
+        bound = obs_segments_per_worker()
+        now = time.time()
+        per_worker: dict[str, list[str]] = {}
+        pruned = 0
+        for p in self._obs_files(scope):
+            name = os.path.basename(p)
+            worker = name[:-5].rsplit("-", 1)[0]
+            seg = self._read_json(p, None)
+            ts = seg.get("ts") if isinstance(seg, dict) else None
+            if not isinstance(ts, (int, float)):
+                try:  # torn segment: fall back to the file clock
+                    ts = os.path.getmtime(p)
+                except OSError:
+                    continue
+            if now - ts > retention:
+                try:
+                    os.remove(p)
+                    pruned += 1
+                except OSError:
+                    pass
+                continue
+            per_worker.setdefault(worker, []).append(p)
+        for paths in per_worker.values():
+            for p in sorted(paths)[:-bound]:
+                try:
+                    os.remove(p)
+                    pruned += 1
+                except OSError:
+                    pass
+        # hygiene: crashed writers (or older code) may leave stray
+        # tmp/lock files next to the segments — they are never listed,
+        # so only GC can reclaim them
+        d = self._obs_dir(scope)
+        for name in os.listdir(d):
+            if name.endswith(".lock") or ".tmp." in name:
+                try:
+                    os.remove(os.path.join(d, name))
+                except OSError:
+                    pass
         return pruned
 
     def _write_health(self, path: str, worker_index: int,

@@ -45,6 +45,27 @@ def ticket_retention_seconds(environ=os.environ) -> float:
                      DEFAULT_TICKET_RETENTION)
 
 
+# Observability segments (stats/fleetobs.py) follow the ticket
+# retention conventions: age-based pruning plus a hard per-worker
+# segment bound, so a long-lived fleet's obs store stays O(workers),
+# never O(history).  An hour of segments at heartbeat cadence is the
+# post-mortem window; the panes only need the LATEST cumulative segment
+# per process plus the recent span deltas.
+DEFAULT_OBS_RETENTION = 3_600.0
+ENV_OBS_RETENTION = "TRANSFERIA_TPU_OBS_RETENTION"
+DEFAULT_OBS_SEGMENTS_PER_WORKER = 8
+ENV_OBS_SEGMENTS_PER_WORKER = "TRANSFERIA_TPU_OBS_SEGMENTS_PER_WORKER"
+
+
+def obs_retention_seconds(environ=os.environ) -> float:
+    return env_float(environ, ENV_OBS_RETENTION, DEFAULT_OBS_RETENTION)
+
+
+def obs_segments_per_worker(environ=os.environ) -> int:
+    return max(1, int(env_float(environ, ENV_OBS_SEGMENTS_PER_WORKER,
+                                DEFAULT_OBS_SEGMENTS_PER_WORKER)))
+
+
 def deadline_expired(expires_at: float,
                      now: Optional[float] = None) -> bool:
     """The single lease-expiry rule (0 = no lease, never expires).
@@ -315,6 +336,45 @@ class Coordinator(abc.ABC):
         staying O(active).  Queued/claimed tickets are never touched;
         the decision logs (AuditingCoordinator) are unaffected.
         Returns tickets pruned."""
+        return 0
+
+    # -- durable observability segments (stats/fleetobs.py) ------------------
+    #
+    # Each worker process periodically serializes a bounded delta of
+    # its trace ring, its cumulative resource ledger, and its metrics
+    # counters into a SEGMENT written through the coordinator, so a
+    # SIGKILLed worker's last-exported observability survives the
+    # process.  Segments are plain JSON dicts keyed by (worker, seq):
+    # re-putting the same (worker, seq) REPLACES (idempotent export
+    # retry).  Readers merge them (fleetobs.merge_segments) tolerant of
+    # torn/truncated payloads.  Backends without support keep the
+    # defaults — export silently disables (a missing obs plane must
+    # never fail the data plane).
+
+    def supports_obs_segments(self) -> bool:
+        return type(self).put_obs_segment is not \
+            Coordinator.put_obs_segment
+
+    def put_obs_segment(self, scope: str, segment: dict) -> None:
+        """Durably store one segment under `scope` (an obs domain, by
+        default one per fleet — stats/fleetobs.py DEFAULT_SCOPE).  The
+        segment dict must carry `worker` (str) and `seq` (int); same
+        (worker, seq) replaces."""
+        raise NotImplementedError
+
+    def list_obs_segments(self, scope: str) -> list[dict]:
+        """Every readable segment in the scope, (worker, seq)-ordered.
+        Unparseable/torn stored segments are SKIPPED, not raised — the
+        pane renders from the survivors."""
+        return []
+
+    def gc_obs_segments(self, scope: str,
+                        retention_seconds: Optional[float] = None
+                        ) -> int:
+        """Retention GC: prune segments older than `retention_seconds`
+        (default TRANSFERIA_TPU_OBS_RETENTION) and trim each worker to
+        its newest TRANSFERIA_TPU_OBS_SEGMENTS_PER_WORKER segments.
+        Returns segments pruned."""
         return 0
 
     # -- worker health (operation.go:30-36, replication.go:72-74) -----------

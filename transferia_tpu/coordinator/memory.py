@@ -92,6 +92,11 @@ class MemoryCoordinator(Coordinator):
         self._health_lock = threading.Lock()
         self.health_reports: deque = deque(maxlen=HEALTH_HISTORY_LIMIT)
         self._health_latest: dict[tuple[str, int], dict] = {}
+        # observability segments: scope -> {(worker, seq): segment};
+        # bounded at put time (per-worker trim) so a forgotten GC can't
+        # grow an in-process coordinator without limit
+        self._obs_lock = threading.Lock()
+        self._obs: dict[str, dict[tuple[str, int], dict]] = {}
 
     def _op(self, operation_id: str) -> _OpState:
         """Get-or-create the operation's state slot (the only place
@@ -417,6 +422,58 @@ class MemoryCoordinator(Coordinator):
                     if not ticket_expired(d, retention, now)]
             pruned = len(q.tickets) - len(keep)
             q.tickets = keep
+        return pruned
+
+    # -- durable observability segments --------------------------------------
+    def put_obs_segment(self, scope: str, segment: dict) -> None:
+        import json as _json
+
+        from transferia_tpu.coordinator.interface import (
+            obs_segments_per_worker,
+        )
+
+        # json round trip: deep-copies (the exporter keeps mutating its
+        # buffers) AND validates serializability — a segment that can't
+        # cross the filestore/s3 backends must fail HERE too, not only
+        # in multi-process deployments
+        seg = _json.loads(_json.dumps(segment))
+        worker = str(seg.get("worker", ""))
+        seq = int(seg.get("seq", 0))
+        bound = obs_segments_per_worker()
+        with self._obs_lock:
+            store = self._obs.setdefault(scope, {})
+            store[(worker, seq)] = seg
+            mine = sorted(k for k in store if k[0] == worker)
+            for key in mine[:-bound]:
+                del store[key]
+
+    def list_obs_segments(self, scope: str) -> list[dict]:
+        import json as _json
+
+        with self._obs_lock:
+            store = self._obs.get(scope, {})
+            items = [store[k] for k in sorted(store)]
+        return [_json.loads(_json.dumps(s)) for s in items]
+
+    def gc_obs_segments(self, scope: str,
+                        retention_seconds: Optional[float] = None
+                        ) -> int:
+        from transferia_tpu.coordinator.interface import (
+            obs_retention_seconds,
+        )
+
+        retention = obs_retention_seconds() \
+            if retention_seconds is None else retention_seconds
+        now = time.time()
+        pruned = 0
+        with self._obs_lock:
+            store = self._obs.get(scope, {})
+            for key in list(store):
+                ts = store[key].get("ts", 0.0)
+                if isinstance(ts, (int, float)) \
+                        and now - ts > retention:
+                    del store[key]
+                    pruned += 1
         return pruned
 
     def operation_health(self, operation_id: str, worker_index: int,

@@ -726,6 +726,81 @@ class S3Coordinator(Coordinator):
             pruned += 1
         return pruned
 
+    # -- durable observability segments --------------------------------------
+    # Per-segment objects (`<prefix>obs/<scope>/<worker>-<seq>.json`):
+    # the (worker, seq) key is unique per export, so the put needs no
+    # conditional write — a RE-put of the same seq (export retry after
+    # a lost response) replaces its own object, which is the idempotent
+    # contract.  Torn bodies (a writer that died mid-PUT never makes
+    # the object visible on real S3; fakes/filesystems may) are skipped
+    # at read time.
+
+    def _obs_prefix(self, scope: str) -> str:
+        import urllib.parse as _up
+
+        return self._key("obs", _up.quote(scope, safe=""), "")
+
+    def _obs_key(self, scope: str, worker: str, seq: int) -> str:
+        import urllib.parse as _up
+
+        return self._obs_prefix(scope) + \
+            f"{_up.quote(worker, safe='')}-{seq:08d}.json"
+
+    def put_obs_segment(self, scope: str, segment: dict) -> None:
+        worker = str(segment.get("worker", ""))
+        seq = int(segment.get("seq", 0))
+        self._put_json(self._obs_key(scope, worker, seq), segment)
+
+    def list_obs_segments(self, scope: str) -> list[dict]:
+        out = []
+        for obj in self.client.list(self._obs_prefix(scope)):
+            d, _ = self._get_json(obj.key, None)
+            if isinstance(d, dict):
+                out.append(d)
+        return out
+
+    def gc_obs_segments(self, scope: str,
+                        retention_seconds: Optional[float] = None
+                        ) -> int:
+        from transferia_tpu.coordinator.interface import (
+            obs_retention_seconds,
+            obs_segments_per_worker,
+        )
+
+        retention = obs_retention_seconds() \
+            if retention_seconds is None else retention_seconds
+        bound = obs_segments_per_worker()
+        now = time.time()
+        pruned = 0
+        per_worker: dict[str, list[str]] = {}
+        for obj in self.client.list(self._obs_prefix(scope)):
+            base = obj.key.rsplit("/", 1)[-1]
+            if not base.endswith(".json"):
+                continue
+            worker = base[:-5].rsplit("-", 1)[0]
+            d, _ = self._get_json(obj.key, None)
+            ts = d.get("ts") if isinstance(d, dict) else None
+            if not isinstance(ts, (int, float)):
+                # torn/unparsable body (crashed writer on a fake or
+                # filesystem backend — real S3 never surfaces partial
+                # PUTs): it will never parse, the merge only ever
+                # skips it, and its writer is gone, so no per-worker
+                # trim can reach it — delete instead of re-GETting it
+                # on every pass forever
+                self.client.delete(obj.key)
+                pruned += 1
+                continue
+            if now - ts > retention:
+                self.client.delete(obj.key)
+                pruned += 1
+                continue
+            per_worker.setdefault(worker, []).append(obj.key)
+        for keys in per_worker.values():
+            for key in sorted(keys)[:-bound]:
+                self.client.delete(key)
+                pruned += 1
+        return pruned
+
     # -- health -------------------------------------------------------------
     def operation_health(self, operation_id: str, worker_index: int,
                          payload: Optional[dict] = None) -> None:

@@ -85,12 +85,21 @@ def _commit_rollup() -> dict:
 
 def debug_snapshot() -> dict:
     """The `/debug/fleet` payload: every live scheduler's and
-    autoscaler's snapshot, plus the commit-ledger rollup."""
+    autoscaler's snapshot, the commit-ledger rollup, and — when an obs
+    runtime is registered (`trtpu worker`, tests) — per-worker
+    heartbeat liveness ages from `get_operation_health`, so an
+    operator sees a stale worker long before its lease expires."""
+    from transferia_tpu.stats import fleetobs
+
     with _registry_lock:
         scheds = list(_SCHEDULERS)
         scalers = list(_AUTOSCALERS)
-    return {
+    out = {
         "schedulers": [s.snapshot() for s in scheds],
         "autoscalers": [a.snapshot() for a in scalers],
         "commits": _commit_rollup(),
     }
+    liveness = fleetobs.worker_liveness()
+    if liveness is not None:
+        out["workers"] = liveness
+    return out

@@ -33,6 +33,7 @@ from transferia_tpu.fleet.scheduler import (
     percentile,
 )
 from transferia_tpu.models import Transfer, TransferType
+from transferia_tpu.stats import hdr
 from transferia_tpu.stats.registry import Metrics
 
 logger = logging.getLogger(__name__)
@@ -163,6 +164,10 @@ def run_fleet_bench(transfers: int = 120, workers: int = 8,
         if decision != "admitted":
             logger.error("fleet bench: %s not admitted: %s",
                          tid, decision)
+    # baseline for the mergeable dispatch-latency histogram
+    # (stats/hdr.py): the registry is process-global, so the bench
+    # carves its own window out of it with a bucket-wise diff
+    h0 = hdr.STAGES.get("fleet_dispatch")
     t0 = time.perf_counter()
     sched.start()
     try:
@@ -170,6 +175,8 @@ def run_fleet_bench(transfers: int = 120, workers: int = 8,
         wall = time.perf_counter() - t0
     finally:
         sched.shutdown()
+    hwin = hdr.STAGES.get("fleet_dispatch").diff(h0)
+    hdr_summary = hwin.summary()
 
     # -- delivery audit ------------------------------------------------------
     lost: list[str] = []
@@ -210,6 +217,14 @@ def run_fleet_bench(transfers: int = 120, workers: int = 8,
         "jain_fairness": round(fairness, 4),
         "dispatch_p50_ms": round(percentile(lats_ms, 0.50), 3),
         "dispatch_p99_ms": round(percentile(lats_ms, 0.99), 3),
+        # the mergeable-histogram view of the same tail (stats/hdr.py
+        # — what the fleet obs segments export and the panes merge):
+        # p999 exists only here, scalar percentiles stop at p99
+        "dispatch_hdr_p50_ms": hdr_summary["p50_ms"],
+        "dispatch_hdr_p99_ms": hdr_summary["p99_ms"],
+        "dispatch_hdr_p999_ms": hdr_summary["p999_ms"],
+        "dispatch_hdr_count": hdr_summary["count"],
+        "dispatch_hdr_max_trace": hdr_summary["max_trace"],
         "pick_p50_us": round(percentile(picks_us, 0.50), 1),
         "pick_p99_us": round(percentile(picks_us, 0.99), 1),
         "desired_workers_final": sched.desired_workers(),
@@ -229,6 +244,11 @@ def format_report(report: dict) -> str:
         f"  dispatch latency p50={report['dispatch_p50_ms']}ms "
         f"p99={report['dispatch_p99_ms']}ms  (pick overhead "
         f"p50={report['pick_p50_us']}us p99={report['pick_p99_us']}us)",
+        f"  dispatch hdr (mergeable): "
+        f"p50={report['dispatch_hdr_p50_ms']}ms "
+        f"p99={report['dispatch_hdr_p99_ms']}ms "
+        f"p999={report['dispatch_hdr_p999_ms']}ms "
+        f"n={report['dispatch_hdr_count']}",
         f"  jain fairness (contention window, skew 10:1): "
         f"{report['jain_fairness']}",
         f"  completed={report['completed']} failed={report['failed']} "

@@ -55,6 +55,14 @@ logger = logging.getLogger(__name__)
 
 DEFAULT_QUEUE = "fleet"
 
+# Payload key carrying the admitting scheduler's span context across
+# the process boundary (trace.wire_format form).  Payloads are opaque
+# JSON the runner registry resolves; the dunder prefix keeps it out of
+# any runner's parameter namespace.  FleetWorker._run_ticket adopts it,
+# so a ticket's admission (scheduler process) and run (worker process)
+# land on ONE trace in the merged fleet timeline (stats/fleetobs.py).
+TICKET_TRACE_KEY = "__trace"
+
 
 def charged_cost(ticket: FleetTicket) -> int:
     """Deficit units one ticket charges: cost x QoS factor — identical
@@ -218,6 +226,12 @@ class DistributedFleetScheduler:
                 if adm_sp:
                     adm_sp.add(decision="shed-tenant-quota")
                 return "shed-tenant-quota"
+            # stamp the admission trace onto the wire BEFORE the
+            # enqueue: whichever worker process claims the ticket
+            # adopts this context and joins the trace
+            wire = trace.wire_format(trace.current_context())
+            if wire and TICKET_TRACE_KEY not in ticket.payload:
+                ticket.payload[TICKET_TRACE_KEY] = wire
             failpoint("fleet.enqueue")
             stored = self.cp.enqueue_ticket(self.queue, ticket)
             with self._lock:
@@ -337,6 +351,17 @@ class DistributedFleetScheduler:
                 self.stats.gc_pruned.inc(pruned)
                 logger.info("ticket GC pruned %d terminal ticket(s) "
                             "from %r", pruned, self.queue)
+            # observability-segment retention rides the same cadence
+            # (stats/fleetobs.py): the SCHEDULER prunes fleet-wide so
+            # a crashed worker's final segments still age out even
+            # though no exporter of that process will ever GC again
+            try:
+                from transferia_tpu.stats import fleetobs
+
+                self.cp.gc_obs_segments(fleetobs.default_scope())
+            except Exception as e:  # advisory, like all obs work
+                logger.debug("obs segment GC failed (retrying next "
+                             "cycle): %s", e)
 
     def counts(self, tickets: Optional[list] = None) -> dict[str, int]:
         out = {"queued": 0, "claimed": 0, "done": 0, "failed": 0}

@@ -60,7 +60,7 @@ from typing import Any, Callable, Optional
 from transferia_tpu.abstract.errors import is_worker_kill
 from transferia_tpu.chaos.failpoints import failpoint
 from transferia_tpu.fleet.backpressure import BackpressureController
-from transferia_tpu.stats import trace
+from transferia_tpu.stats import hdr, trace
 from transferia_tpu.stats.ledger import LEDGER
 from transferia_tpu.stats.registry import FleetStats, Metrics
 
@@ -426,6 +426,13 @@ class FleetScheduler:
         lat = ticket.dispatch_latency
         self.dispatch_latencies.append(lat)
         self.stats.dispatch_time.observe(lat)
+        # mergeable log-bucket histogram (stats/hdr.py): the fleet obs
+        # segments export this, so N processes' dispatch tails merge
+        # into one exact p50/p99/p999; the exemplar on the max bucket
+        # is the worst dispatch's trace id
+        hdr.observe("fleet_dispatch", lat,
+                    trace_id=ticket.trace_ctx.trace_id
+                    if ticket.trace_ctx else 0)
         # the queue wait becomes a real span on the ticket trace,
         # recorded retroactively now that it ended (admission →
         # dispatch decision, regardless of which lane thread picked it)

@@ -46,8 +46,12 @@ from transferia_tpu.abstract.errors import (
 from transferia_tpu.abstract.ticket import FleetTicket, ticket_claimable
 from transferia_tpu.chaos.failpoints import failpoint
 from transferia_tpu.coordinator.interface import Coordinator
-from transferia_tpu.fleet.distributed import DEFAULT_QUEUE, WdrrPicker
-from transferia_tpu.stats import trace
+from transferia_tpu.fleet.distributed import (
+    DEFAULT_QUEUE,
+    TICKET_TRACE_KEY,
+    WdrrPicker,
+)
+from transferia_tpu.stats import fleetobs, trace
 from transferia_tpu.stats.ledger import LEDGER
 from transferia_tpu.stats.registry import DistributedFleetStats, Metrics
 
@@ -197,6 +201,14 @@ class FleetWorker:
         self.tickets_run = 0
         # replay surface: (ticket_id, claim_epoch, stolen_from)
         self.claim_log: list[tuple] = []
+        # fleet observability export stream (stats/fleetobs.py): this
+        # worker AND every SnapshotLoader it runs (via the ambient
+        # exporter around _run_ticket) share one (worker, seq) stream
+        import os as _os
+
+        self._obs = fleetobs.exporter_for(
+            coordinator,
+            worker=f"fleet.{self.worker_id}.{_os.getpid()}")
 
     # -- drain / liveness ----------------------------------------------------
     def request_drain(self) -> None:
@@ -274,6 +286,9 @@ class FleetWorker:
                         "ticket": held.ticket_id if held else "",
                         "tickets_run": self.tickets_run,
                     })
+                # observability export at heartbeat cadence: a kill -9
+                # between beats loses at most one export interval
+                self._obs.export("periodic")
             except Exception as e:
                 if is_worker_kill(e):
                     logger.error(
@@ -317,6 +332,16 @@ class FleetWorker:
                     excluded.add(cand.ticket_id)  # lost the race
                     continue
                 self.picker.charge(won)
+                if won.attempts == 1 and won.enqueued_at:
+                    # distributed dispatch latency (enqueue → first
+                    # claim, wall clock — the only shared axis across
+                    # processes) into the mergeable histogram the obs
+                    # segments export; re-claims after crash/preempt
+                    # are recovery, not dispatch, and are excluded
+                    from transferia_tpu.stats import hdr
+
+                    hdr.observe("fleet_dispatch",
+                                max(0.0, time.time() - won.enqueued_at))
                 self.stats.claimed.inc()
                 if won.stolen_from:
                     self.stats.steals.inc()
@@ -397,13 +422,23 @@ class FleetWorker:
             # the part queue
             resume=ticket.attempts > 1 or ticket.preemptions > 0,
             worker_id=self.worker_id, queue=self.queue)
+        # cross-process causal link: the admitting scheduler stamped
+        # its span context into the payload (fleet/distributed.py
+        # TRACE_KEY) — adopting it parents this worker's run span onto
+        # the SAME trace, so the merged fleet timeline shows admission
+        # and run as one causally-linked story even across processes
+        wctx = trace.parse_wire(
+            ticket.payload.get(TICKET_TRACE_KEY, ""))
         sp = trace.span("fleet_ticket_run", ticket_id=ticket.ticket_id,
                         tenant=ticket.tenant, qos=ticket.qos,
                         worker=self.worker_id, epoch=ticket.claim_epoch,
-                        attempt=ticket.attempts, resume=ctx.resume)
-        with sp, LEDGER.context(
+                        attempt=ticket.attempts, resume=ctx.resume,
+                        transfer_id=ticket.transfer_id
+                        or ticket.ticket_id)
+        with trace.adopted(wctx), sp, LEDGER.context(
                 transfer_id=ticket.transfer_id or ticket.ticket_id,
-                tenant=ticket.tenant):
+                tenant=ticket.tenant), \
+                fleetobs.ambient_exporter(self._obs):
             runner(ticket, ctx)
 
     def run(self, stop: Optional[threading.Event] = None) -> None:
@@ -481,11 +516,20 @@ class FleetWorker:
                     with self._lock:
                         self._current = None
                         self._revoked = False
+                    # ticket boundary export: the finished (or failed/
+                    # yielded) ticket's spend is durable before the
+                    # next claim
+                    self._obs.export("ticket")
             # graceful drain: nothing claimed at this point (the yield
             # path released before we got here)
         finally:
             hb_stop.set()
             hb.join(timeout=5.0)
+            if not self._dead:
+                # SIGTERM-drain / idle-exit flush; a KILLED worker
+                # deliberately does NOT flush — that is the crash whose
+                # last heartbeat-cadence export the plane survives on
+                self._obs.export("final")
             self.stats.worker_exits.inc()
 
 

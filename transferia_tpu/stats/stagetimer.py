@@ -11,6 +11,12 @@ bool check).
 Totals are summed across threads, so with N part-upload threads a stage
 total can exceed wall time; the point is the *ratio* between stages and
 the overlap factor (sum(stages)/wall).
+
+Per-stage latency DISTRIBUTIONS live in stats/hdr.py: every enabled
+stage() / add() also lands in the process-global mergeable log-bucket
+histograms (STAGES), which is what the fleet observability plane
+exports — the scalar totals here stay the cheap single-process
+breakdown, the histograms are the cross-process p50/p99/p999 source.
 """
 
 from __future__ import annotations
@@ -18,6 +24,8 @@ from __future__ import annotations
 import threading
 import time
 from contextlib import contextmanager
+
+from transferia_tpu.stats import hdr
 
 _enabled = False
 _lock = threading.Lock()
@@ -68,6 +76,7 @@ def stage(name: str):
             _counts[name] = _counts.get(name, 0) + 1
             if name in _sample_stages:
                 _samples.setdefault(name, []).append(dt)
+        hdr.observe(name, dt)
 
 
 def add(name: str, seconds: float) -> None:
@@ -78,6 +87,7 @@ def add(name: str, seconds: float) -> None:
         _counts[name] = _counts.get(name, 0) + 1
         if name in _sample_stages:
             _samples.setdefault(name, []).append(seconds)
+    hdr.observe(name, seconds)
 
 
 def snapshot() -> dict[str, dict]:

@@ -70,6 +70,11 @@ _epoch = 0.0
 _lock = threading.Lock()
 _ring: deque = deque(maxlen=DEFAULT_CAPACITY)
 _tls = threading.local()
+# lifetime count of ring appends (NOT ring length: the deque evicts).
+# The obs-segment exporter (stats/fleetobs.py) uses this as its delta
+# mark — "how many new records since my last export" — without the
+# record tuples themselves needing sequence fields.
+_recorded = 0
 
 
 class SpanContext(NamedTuple):
@@ -82,10 +87,17 @@ class SpanContext(NamedTuple):
     span_id: int
 
 
-# span/trace ids are process-unique counters offset by the pid so ids
-# minted on both ends of an in-host wire (Flight loopback, shm handoff
-# between forked workers) never collide in one merged timeline
-_ids = itertools.count(((os.getpid() & 0xFFFF) << 32) + 1)
+# span/trace ids are process-unique counters salted by (host, pid) so
+# ids minted on both ends of an in-host wire (Flight loopback, shm
+# handoff between forked workers) — or by two pid-1 containers on
+# DIFFERENT hosts feeding one merged fleet timeline
+# (stats/fleetobs.py) — never collide in one merged view
+import socket as _socket
+import zlib as _zlib
+
+_ids = itertools.count(
+    ((_zlib.crc32(_socket.gethostname().encode()) & 0xFFFF) << 48)
+    + ((os.getpid() & 0xFFFF) << 32) + 1)
 _ctx: "contextvars.ContextVar[Optional[SpanContext]]" = \
     contextvars.ContextVar("trtpu_trace_ctx", default=None)
 
@@ -170,7 +182,9 @@ class Span:
         if depth:
             stack[-1]._child += dur
         t = threading.current_thread()
+        global _recorded
         with _lock:
+            _recorded += 1
             _ring.append((
                 self.name, t.ident, t.name,
                 self._t0 - _epoch, dur, max(0.0, dur - self._child),
@@ -227,7 +241,9 @@ def instant(name: str, ctx: Optional[SpanContext] = None,
     trace_id = at.trace_id if at else 0
     parent_id = at.span_id if at else 0
     t = threading.current_thread()
+    global _recorded
     with _lock:
+        _recorded += 1
         _ring.append((name, t.ident, t.name,
                       time.perf_counter() - _epoch, 0.0, 0.0, -1,
                       args or None, trace_id, 0, parent_id))
@@ -247,7 +263,9 @@ def complete(name: str, t0: float, dur: float,
     trace_id = at.trace_id if at else span_id
     parent_id = at.span_id if at else 0
     t = threading.current_thread()
+    global _recorded
     with _lock:
+        _recorded += 1
         _ring.append((name, t.ident, t.name, t0 - _epoch, dur,
                       dur, 0, args or None, trace_id, span_id,
                       parent_id))
@@ -326,6 +344,34 @@ def spans() -> list[tuple]:
     instants (span_id 0, parent_id = the span they fired on)."""
     with _lock:
         return list(_ring)
+
+
+def record_count() -> int:
+    """Lifetime number of records appended (monotonic; survives ring
+    eviction).  `spans()[-(record_count() - mark):]` is the exporter's
+    bounded delta since `mark` — records evicted past the ring capacity
+    are simply lost, which the obs plane reports as `spans_dropped`."""
+    with _lock:
+        return _recorded
+
+
+def spans_with_count() -> tuple[int, list]:
+    """(record_count, ring snapshot) under ONE lock hold — the obs
+    exporter's delta window.  Reading the two separately would let
+    concurrent appends displace the oldest records of the intended
+    window out of the tail slice, silently losing them while
+    `spans_dropped` stays 0."""
+    with _lock:
+        return _recorded, list(_ring)
+
+
+def epoch_unix() -> float:
+    """Wall-clock time of this process's capture epoch (the zero point
+    of every recorded t0).  Cross-process merge (stats/fleetobs.py)
+    aligns N processes' timelines by shifting each one's spans by its
+    exported epoch — perf_counter zeros are process-arbitrary, wall
+    clocks are the only shared axis."""
+    return time.time() - (time.perf_counter() - _epoch)
 
 
 # -- export -----------------------------------------------------------------

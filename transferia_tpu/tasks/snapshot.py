@@ -59,7 +59,7 @@ from transferia_tpu.coordinator.interface import (
     lease_expired,
 )
 from transferia_tpu.factories import make_async_sink, new_storage
-from transferia_tpu.stats import trace
+from transferia_tpu.stats import fleetobs, trace
 from transferia_tpu.stats.ledger import LEDGER
 from transferia_tpu.stats.registry import (
     CommitStats,
@@ -166,6 +166,13 @@ class SnapshotLoader:
         # tables whose scan predicate has been computed (set-once; reads
         # and adds race benignly — worst case one repeat computation)
         self._pushdown_done: set = set()
+        # fleet observability export stream (stats/fleetobs.py): under
+        # a fleet worker this joins the worker's ambient stream; a bare
+        # sharded loader exports under its own worker label.  Disabled
+        # (no-op) on coordinators without obs-segment support.
+        self._obs = fleetobs.exporter_for(
+            coordinator, worker=f"snap.w{self.worker_index}."
+                                f"{os.getpid()}")
 
     # -- entry points ---------------------------------------------------------
     def upload_tables(self, tables: Optional[list[TableDescription]] = None
@@ -190,6 +197,9 @@ class SnapshotLoader:
                     self._secondary_flow(storage)
         finally:
             storage.close()
+            # final observability flush: whatever this operation spent
+            # survives the process even if it exits right after
+            self._obs.export("final")
 
     def filtered_table_list(self, storage: Storage
                             ) -> list[TableDescription]:
@@ -671,6 +681,9 @@ class SnapshotLoader:
                     }
                 self.cp.operation_health(self.operation_id,
                                          self.worker_index, payload)
+                # observability export at heartbeat cadence: a SIGKILL
+                # between beats loses at most one export interval
+                self._obs.export("periodic")
             except WorkerKilledError:
                 logger.error(
                     "worker %d heartbeat killed: lease renewals stop, "
@@ -832,7 +845,14 @@ class SnapshotLoader:
                                 part: OperationTablePart,
                                 schemas: dict) -> None:
         def attempt():
+            # always-on per-part latency distribution (stats/hdr.py):
+            # the mergeable histogram the fleet obs segments export —
+            # per-part granularity, so the cost is one bucket add
+            from transferia_tpu.stats import hdr
+
+            t0 = time.perf_counter()
             self._upload_part(storage, part, schemas)
+            hdr.observe("part_upload", time.perf_counter() - t0)
 
         # abstract/errors.is_retriable: fatal AND programming/schema
         # errors anywhere in the cause chain fail the part immediately
@@ -1093,5 +1113,8 @@ class SnapshotLoader:
         # track the same cadence
         trace.TELEMETRY.fold_into(self.metrics)
         LEDGER.fold_into(self.metrics)
+        # part completion is an export trigger (coalesced inside the
+        # exporter): the committed part's spend is durable immediately
+        self._obs.export("part")
         logger.info("part %s done: %d rows, %d bytes",
                     part.key(), rows_done, read_bytes)
