@@ -1286,6 +1286,12 @@ TOLERANCE_OVERRIDES = {
     "fleet_dispatch_p50_ms": 0.6,
     "fleet_dispatch_p99_ms": 0.8,
     "fleet_dispatch_p999_ms": 1.0,
+    # end-to-end freshness p99 (SLO plane): wall-clock from the sample
+    # source's event-time stamp to sink publish — dominated by queue
+    # wait on the 1-core boxes, so it swings with scheduling like the
+    # dispatch tails above; the SLO verdict math is pinned by
+    # tests/unit/test_slo.py, not by run-to-run latency stability
+    "replication_lag_p99_ms": 0.8,
     # loopback-gRPC round trips on the 1-core bench boxes are
     # scheduling-bound; the wire-bytes ratio is the stable signal and
     # gates through wire_bytes-derived fields, not rows/s
@@ -1855,6 +1861,11 @@ def main() -> int:
         for q in ("p50", "p99", "p999"):
             _emit({"metric": f"fleet_dispatch_{q}_ms", "unit": "ms",
                    "value": report[f"dispatch_hdr_{q}_ms"]})
+        # end-to-end freshness tail (SLO plane): event-time → publish
+        # lag over the run window, latency direction like any *_ms
+        if report.get("replication_lag_count"):
+            _emit({"metric": "replication_lag_p99_ms", "unit": "ms",
+                   "value": report["replication_lag_p99_ms"]})
         print(json.dumps(report))
         return gated(0 if report["ok"] else 1)
 

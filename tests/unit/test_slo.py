@@ -1,0 +1,429 @@
+"""SLO plane (stats/slo.py + stats/watermark.py + stats/critpath.py):
+burn-rate window math against hand-computed budgets, watermark
+monotonicity across restart/resume and merge orders, `~overflow`
+cardinality bounding, critical-path attribution on a synthetic
+multi-process trace with flow links, and evaluation determinism
+across segment orders."""
+
+import json
+import random
+
+import numpy as np
+import pytest
+
+from transferia_tpu.abstract.schema import TableID
+from transferia_tpu.fleet.backpressure import BackpressureController
+from transferia_tpu.providers.sample import make_batch
+from transferia_tpu.stats import critpath, hdr, slo, watermark
+from transferia_tpu.stats.hdr import LogHistogram
+from transferia_tpu.stats.ledger import FIELDS
+
+
+def _hist(good: int = 0, bad: int = 0,
+          good_s: float = 0.1, bad_s: float = 50.0) -> dict:
+    h = LogHistogram()
+    for _ in range(good):
+        h.observe(good_s)
+    for _ in range(bad):
+        h.observe(bad_s)
+    return h.to_json()
+
+
+def _seg(pid: int, seq: int, ts: float, hists=None, totals=None,
+         watermarks=None, spans=None, host: str = "h1",
+         epoch_unix: float = 0.0) -> dict:
+    full_totals = dict.fromkeys(FIELDS, 0)
+    full_totals.update(totals or {})
+    return {
+        "v": 1, "worker": f"w{pid}", "pid": pid, "host": host,
+        "seq": seq, "ts": ts, "kind": "periodic",
+        "epoch_unix": epoch_unix,
+        "spans": spans or [],
+        "ledger": {"totals": full_totals, "transfers": {},
+                   "tenants": {}, "conservation_ok": True},
+        "telemetry": {},
+        "hists": hists or {},
+        "watermarks": watermarks or {},
+    }
+
+
+EPOCH = 100_000.0
+
+
+class TestBurnRate:
+    def test_hand_computed_latency_burn(self):
+        """Cumulative stream: baseline 100 good, end adds 50 good +
+        50 bad.  Window delta = 50/50 → bad fraction 0.5; target 0.99
+        → budget 0.01 → burn 50 on both windows → burning."""
+        base = _hist(good=100)
+        end = _hist(good=150, bad=50)
+        segs = [
+            _seg(1, 1, EPOCH - 4000, hists={watermark.STAGE_LAG: base}),
+            _seg(1, 2, EPOCH, hists={watermark.STAGE_LAG: end}),
+        ]
+        obj = (slo.SloObjective("lag", stage=watermark.STAGE_LAG,
+                                threshold_ms=5000.0, target=0.99),)
+        view = slo.evaluate(segs, objectives=obj)
+        v = view["objectives"]["lag"]
+        # ts-4000 is older than both window cutoffs → baseline for both
+        assert v["burn_fast"] == pytest.approx(50.0)
+        assert v["burn_slow"] == pytest.approx(50.0)
+        assert v["events_fast"] == 100
+        assert v["burning"] and not view["ok"]
+        assert view["burning"] == ["lag"]
+
+    def test_fast_burn_alone_does_not_page(self):
+        """A fresh blip burns the 5m window but not the 1h one: the
+        multi-window AND keeps it from paging."""
+        base_old = _hist(good=10_000)          # slow-window baseline
+        base_fast = _hist(good=19_900)         # fast-window baseline
+        end = _hist(good=19_950, bad=50)       # blip in the last 5m
+        segs = [
+            _seg(1, 1, EPOCH - 4000,
+                 hists={watermark.STAGE_LAG: base_old}),
+            _seg(1, 2, EPOCH - 400,
+                 hists={watermark.STAGE_LAG: base_fast}),
+            _seg(1, 3, EPOCH, hists={watermark.STAGE_LAG: end}),
+        ]
+        obj = (slo.SloObjective("lag", stage=watermark.STAGE_LAG,
+                                threshold_ms=5000.0, target=0.99),)
+        v = slo.evaluate(segs, objectives=obj)["objectives"]["lag"]
+        # fast window: 50 good + 50 bad → burn 50
+        assert v["burn_fast"] == pytest.approx(50.0)
+        # slow window: 9950 good + 50 bad → bad 0.005 → burn 0.5
+        assert v["burn_slow"] == pytest.approx(0.5)
+        assert not v["burning"]
+
+    def test_hand_computed_availability_burn(self):
+        """commits/commit_fences deltas: 50 commits + 50 fences in the
+        window → bad 0.5 vs target 0.999 → burn 500."""
+        segs = [
+            _seg(1, 1, EPOCH - 4000,
+                 totals={"commits": 100, "commit_fences": 0}),
+            _seg(1, 2, EPOCH,
+                 totals={"commits": 150, "commit_fences": 50}),
+        ]
+        obj = (slo.SloObjective("avail", kind="availability",
+                                target=0.999),)
+        v = slo.evaluate(segs, objectives=obj)["objectives"]["avail"]
+        assert v["burn_fast"] == pytest.approx(500.0)
+        assert v["events_fast"] == 100
+        assert v["burning"]
+
+    def test_empty_window_is_not_a_breach(self):
+        segs = [_seg(1, 1, EPOCH)]
+        view = slo.evaluate(segs)
+        assert view["ok"]
+        assert all(not v["burning"]
+                   for v in view["objectives"].values())
+
+    def test_no_baseline_means_whole_history(self):
+        """A young process (no segment older than the window) judges
+        its entire cumulative history — honest, not vacuous."""
+        segs = [_seg(1, 1, EPOCH,
+                     hists={watermark.STAGE_LAG: _hist(bad=10)})]
+        obj = (slo.SloObjective("lag", stage=watermark.STAGE_LAG,
+                                threshold_ms=5000.0, target=0.99),)
+        v = slo.evaluate(segs, objectives=obj)["objectives"]["lag"]
+        assert v["burning"]
+        assert v["events_fast"] == 10
+
+    def test_determinism_across_segment_orders_and_processes(self):
+        """PURITY: any process, any segment order, same verdicts."""
+        rng = random.Random(7)
+        segs = [
+            _seg(1, 1, EPOCH - 4000,
+                 hists={watermark.STAGE_LAG: _hist(good=100)},
+                 totals={"commits": 10}),
+            _seg(1, 2, EPOCH,
+                 hists={watermark.STAGE_LAG: _hist(good=150, bad=50)},
+                 totals={"commits": 20, "commit_fences": 1}),
+            _seg(2, 1, EPOCH - 1000,
+                 hists={watermark.STAGE_LAG: _hist(good=30)},
+                 watermarks={"t1": {"a": {"event_ns": 5, "lsn": 1,
+                                          "publish_unix": 9.0,
+                                          "origin": "event"}}}),
+            _seg(2, 2, EPOCH - 10,
+                 hists={watermark.STAGE_LAG: _hist(good=60, bad=3)},
+                 watermarks={"t1": {"a": {"event_ns": 9, "lsn": 2,
+                                          "publish_unix": 19.0,
+                                          "origin": "event"}}}),
+        ]
+        want = json.dumps(slo.evaluate(segs), sort_keys=True,
+                          default=str)
+        for _ in range(6):
+            rng.shuffle(segs)
+            got = json.dumps(slo.evaluate(segs), sort_keys=True,
+                             default=str)
+            assert got == want
+
+    def test_spec_env_overrides_and_junk_falls_back(self):
+        env = {slo.ENV_SPEC: json.dumps([
+            {"name": "custom", "kind": "latency", "stage": "s",
+             "threshold_ms": 100, "target": 0.5, "tenant": "t"}])}
+        objs = slo.objectives_from_env(env)
+        assert len(objs) == 1 and objs[0].name == "custom"
+        assert objs[0].tenant == "t"
+        junk = slo.objectives_from_env({slo.ENV_SPEC: "not json"})
+        assert {o.name for o in junk} == \
+            {o.name for o in slo.DEFAULT_OBJECTIVES}
+
+    def test_fraction_at_most(self):
+        h = LogHistogram()
+        assert h.fraction_at_most(1.0) == 1.0       # empty = no bad
+        for _ in range(3):
+            h.observe(0.1)
+        h.observe(100.0)
+        assert h.fraction_at_most(5.0) == pytest.approx(0.75)
+        assert h.fraction_at_most(1000.0) == 1.0
+
+
+class TestWatermarks:
+    def _map(self, **kw):
+        return watermark.WatermarkMap(**kw)
+
+    def test_advance_is_monotone(self):
+        m = self._map()
+        assert m.advance("t1", "a", event_ns=100, lsn=5)
+        assert not m.advance("t1", "a", event_ns=50, lsn=3)
+        snap = m.snapshot()
+        assert snap["t1"]["a"]["event_ns"] == 100
+        assert snap["t1"]["a"]["lsn"] == 5
+        assert m.regressions_skipped == 1
+
+    def test_restart_resume_merge_never_regresses(self):
+        """A restarted process re-publishing an older watermark can
+        never regress the merged view (max-merge)."""
+        before = self._map()
+        before.advance("t1", "a", event_ns=100, lsn=9)
+        exported = before.snapshot()
+        resumed = self._map()                  # fresh process
+        resumed.advance("t1", "a", event_ns=80, lsn=7)
+        merged = watermark.merge_maps([exported, resumed.snapshot()])
+        assert merged["t1"]["a"]["event_ns"] == 100
+        assert merged["t1"]["a"]["lsn"] == 9
+        # merge is commutative + idempotent
+        flipped = watermark.merge_maps(
+            [resumed.snapshot(), exported, exported])
+        assert flipped == merged
+
+    def test_merge_tolerates_junk(self):
+        merged = watermark.merge_maps([
+            None, "junk", {"t1": "junk"},
+            {"t1": {"a": {"event_ns": "x"}}},
+            {"t1": {"a": {"event_ns": 4, "lsn": 0,
+                          "publish_unix": 1.0, "origin": "event"}}},
+        ])
+        assert merged["t1"]["a"]["event_ns"] == 4
+
+    def test_overflow_eviction_bounds_cardinality(self):
+        m = self._map(max_tables=3)
+        for i in range(10):
+            m.advance("t1", f"table{i}", event_ns=i + 1)
+        tables = m.snapshot()["t1"]
+        assert len(tables) <= 3
+        assert watermark.OVERFLOW in tables
+        # the fold preserves the max of what it evicted
+        assert tables[watermark.OVERFLOW]["event_ns"] >= 1
+        assert m.folded_entries > 0
+
+    def test_observe_publish_records_lag(self):
+        hdr.STAGES.reset()
+        m = self._map()
+        batch = make_batch("iot", TableID("s", "e"), 0, 16, 7)
+        now_ns = 1_000_000_000_000_000_000
+        batch.commit_times = np.full(16, now_ns - 2_000_000_000,
+                                     dtype=np.int64)
+        lag = m.observe_publish("t1", batch, now_ns=now_ns)
+        assert lag == pytest.approx(2.0)
+        snap = m.snapshot()["t1"]["s.e"]
+        assert snap["event_ns"] == now_ns - 2_000_000_000
+        assert snap["origin"] == "event"
+        h = hdr.STAGES.get(watermark.STAGE_LAG)
+        assert h.count == 1
+        hdr.STAGES.reset()
+
+    def test_observe_publish_without_event_time(self):
+        """No carrier and no poll watermark: liveness only, no
+        fabricated lag."""
+        hdr.STAGES.reset()
+        m = self._map()
+        batch = make_batch("iot", TableID("s", "e"), 0, 8, 7)
+        assert batch.commit_times is None
+        assert m.observe_publish("t1", batch) is None
+        snap = m.snapshot()["t1"]["s.e"]
+        assert snap["event_ns"] == 0 and snap["origin"] == "publish"
+        assert snap["publish_unix"] > 0
+        assert hdr.STAGES.get(watermark.STAGE_LAG).count == 0
+
+    def test_poll_watermark_stands_in(self):
+        m = self._map()
+        m.advance("t1", f"{watermark.POLL_PREFIX}topic:0",
+                  event_ns=5_000, origin="poll")
+        batch = make_batch("iot", TableID("s", "e"), 0, 8, 7)
+        lag = m.observe_publish("t1", batch, now_ns=15_000)
+        assert lag == pytest.approx(10_000 / 1e9)
+        assert m.snapshot()["t1"]["s.e"]["origin"] == "poll"
+
+    def test_summarize_floor_is_oldest_table(self):
+        merged = watermark.merge_maps([{
+            "t1": {
+                "a": {"event_ns": int(50e9), "lsn": 0,
+                      "publish_unix": 60.0, "origin": "event"},
+                "b": {"event_ns": int(90e9), "lsn": 0,
+                      "publish_unix": 95.0, "origin": "event"},
+                f"{watermark.POLL_PREFIX}x:0": {
+                    "event_ns": int(99e9), "lsn": 0,
+                    "publish_unix": 99.0, "origin": "poll"},
+            }}])
+        s = watermark.summarize(merged, now=100.0)["t1"]
+        assert s["tables"] == 2                 # poll keys excluded
+        assert s["watermark_unix"] == 50.0      # slowest table rules
+        assert s["lag_ms"] == pytest.approx(50_000.0)
+
+
+def _span(name, t0, dur, trace_id, span_id, parent_id, tid=1,
+          args=None):
+    return [name, tid, "T", t0, dur, dur, 0, args, trace_id, span_id,
+            parent_id]
+
+
+class TestCriticalPath:
+    def test_multi_process_flow_links(self):
+        """part(0..10) on proc A with decode(0..3) and dispatch(3..7);
+        a wire hop (5..7) recorded by proc B parents into the dispatch
+        span via the flow link.  Every second lands in a stage."""
+        args = {"transfer_id": "tx"}
+        seg_a = _seg(1, 1, 100.0, epoch_unix=1000.0, spans=[
+            _span("part", 0.0, 10.0, 9, 1, 0, args=args),
+            _span("source_decode", 0.0, 3.0, 9, 2, 1),
+            _span("device_dispatch", 3.0, 4.0, 9, 3, 1),
+        ])
+        # proc B's capture epoch is 2s later; its local t0 3.0 lands at
+        # wall 5.0 on the shared axis
+        seg_b = _seg(2, 1, 100.0, host="h2", epoch_unix=1002.0, spans=[
+            _span("flight_do_put", 3.0, 2.0, 9, 4, 3),
+        ])
+        records = critpath.records_from_segments([seg_a, seg_b])
+        assert len(records) == 4
+        report = critpath.explain(records, transfer_id="tx")
+        assert report["processes"] == 2
+        assert report["wall_s"] == pytest.approx(10.0)
+        assert report["attributed_pct"] == pytest.approx(100.0)
+        st = report["stages"]
+        assert st["decode"]["seconds"] == pytest.approx(3.0)
+        assert st["device dispatch"]["seconds"] == pytest.approx(2.0)
+        assert st["wire"]["seconds"] == pytest.approx(2.0)
+        # part's own tail (7..10) is orchestration
+        assert st["orchestration"]["seconds"] == pytest.approx(3.0)
+        assert len(report["levers"]) == 3
+        assert report["parts"][0]["wall_s"] == pytest.approx(10.0)
+
+    def test_transfer_filter_and_fallback(self):
+        other = _seg(1, 1, 100.0, epoch_unix=0.0, spans=[
+            _span("part", 0.0, 4.0, 5, 10, 0,
+                  args={"transfer_id": "other"}),
+            _span("sink", 0.0, 4.0, 5, 11, 10),
+        ])
+        records = critpath.records_from_segments([other])
+        hit = critpath.explain(records, transfer_id="other")
+        assert hit["stages"]["publish"]["seconds"] == pytest.approx(4.0)
+        # unknown id falls back to all records (demo single-transfer)
+        miss = critpath.explain(records, transfer_id="nope")
+        assert miss["spans"] == hit["spans"]
+
+    def test_dedup_across_overlapping_windows(self):
+        spans = [_span("part", 0.0, 2.0, 1, 1, 0)]
+        seg1 = _seg(1, 1, 100.0, epoch_unix=0.0, spans=spans)
+        seg2 = _seg(1, 2, 101.0, epoch_unix=0.0, spans=spans)
+        assert len(critpath.records_from_segments([seg1, seg2])) == 1
+
+    def test_cycle_guard(self):
+        records = critpath.records_from_segments([
+            _seg(1, 1, 100.0, epoch_unix=0.0, spans=[
+                _span("part", 0.0, 4.0, 1, 1, 2),
+                _span("sink", 1.0, 2.0, 1, 2, 1),
+            ])])
+        report = critpath.explain(records)   # must terminate
+        assert report["wall_s"] == pytest.approx(4.0)
+
+    def test_stage_map_covers_known_spans(self):
+        assert critpath.stage_of("source_decode") == "decode"
+        assert critpath.stage_of("flight_do_get") == "wire"
+        assert critpath.stage_of("pg_publish_txn") == "publish"
+        assert critpath.stage_of("coord_commit_part") == "commit"
+        assert critpath.stage_of("never_heard_of_it") == "orchestration"
+
+
+class TestAlertHook:
+    class _Sched:
+        def __init__(self):
+            self.weights = {"interactive": 1.0}
+
+        def tenant_weight(self, name):
+            return self.weights.get(name, 1.0)
+
+        def set_tenant_weight(self, name, weight):
+            prior = self.weights.get(name, 1.0)
+            self.weights[name] = weight
+            return prior
+
+    def _burning_view(self, tenant=""):
+        return {"objectives": {"lag": {
+            "burning": True, "burn_fast": 5.0,
+            "objective": {"tenant": tenant}}}}
+
+    def test_latch_and_clear_external_backpressure(self):
+        bp = BackpressureController(probe=lambda name: 0.0)
+        hook = slo.SloAlertHook(backpressure=bp)
+        actions = hook.apply(self._burning_view())
+        assert actions["latched"] == ["slo:lag"]
+        assert bp.overloaded()
+        assert "external:slo:lag" in bp.latched_signals()
+        assert bp.snapshot()["external:slo:lag"]["latched"]
+        actions = hook.apply({"objectives": {}})
+        assert actions["cleared"] == ["slo:lag"]
+        assert not bp.overloaded()
+
+    def test_tenant_weight_escalation_and_restore(self):
+        sched = self._Sched()
+        hook = slo.SloAlertHook(scheduler=sched, escalate_factor=2.0)
+        hook.apply(self._burning_view(tenant="interactive"))
+        assert sched.weights["interactive"] == pytest.approx(2.0)
+        # idempotent while still burning: no stacking
+        hook.apply(self._burning_view(tenant="interactive"))
+        assert sched.weights["interactive"] == pytest.approx(2.0)
+        hook.apply({"objectives": {}})
+        assert sched.weights["interactive"] == pytest.approx(1.0)
+
+    def test_scheduler_live_retune(self):
+        from transferia_tpu.fleet.scheduler import FleetScheduler
+
+        sched = FleetScheduler(workers=1)
+        assert sched.tenant_weight("t") == pytest.approx(1.0)
+        prior = sched.set_tenant_weight("t", 3.0)
+        assert prior == pytest.approx(1.0)
+        assert sched.tenant_weight("t") == pytest.approx(3.0)
+
+
+class TestLocalEvaluation:
+    def test_local_segments_shape_and_evaluate(self):
+        segs = slo.local_segments()
+        assert len(segs) == 1
+        view = slo.evaluate(segs)
+        assert "objectives" in view and "watermarks" in view
+
+    def test_fold_verdicts_gauges(self):
+        from transferia_tpu.stats.registry import Metrics
+
+        m = Metrics()
+        view = {"objectives": {"a": {"burn_fast": 2.5,
+                                     "burn_slow": 0.5,
+                                     "burning": False}},
+                "burning": [],
+                "watermarks": {"t1": {"lag_ms": 123.0}}}
+        slo.fold_verdicts(m, view)
+        assert m.value("slo_objectives") == 1
+        assert m.value("slo_worst_burn_fast") == pytest.approx(2.5)
+        assert m.value("slo_worst_replication_lag_ms") == \
+            pytest.approx(123.0)

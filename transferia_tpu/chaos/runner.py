@@ -1380,6 +1380,46 @@ def _fleet_dist_scenario(trial: int, seed: int, rows: int, spec: str,
                 "fleet-observability",
                 "merged fleet ledger shows zero rows for a trial "
                 "that delivered data"))
+        # watermark monotonicity across the kill: per (process,
+        # transfer, table) the published event watermark must be
+        # non-decreasing in segment order, and the merged (max-merge)
+        # view must dominate every individual segment — a SIGKILLed
+        # worker's lost final segment may lose PROGRESS but can never
+        # REGRESS what was already published
+        from transferia_tpu.stats import watermark as wmks
+
+        per_proc: dict = {}
+        for seg in sorted(
+                (s for s in obs_segments if isinstance(s, dict)),
+                key=lambda s: (str(s.get("host", "")),
+                               int(s.get("pid", 0) or 0),
+                               int(s.get("seq", 0) or 0))):
+            proc = (str(seg.get("host", "")),
+                    int(seg.get("pid", 0) or 0))
+            cur = wmks.merge_maps([seg.get("watermarks")])
+            prev = per_proc.get(proc, {})
+            for tid, tables in prev.items():
+                for table, entry in tables.items():
+                    now_e = cur.get(tid, {}).get(table)
+                    if now_e is not None and \
+                            now_e["event_ns"] < entry["event_ns"]:
+                        violations.append(Violation(
+                            "watermark-monotonicity",
+                            f"{proc} regressed watermark "
+                            f"{tid}/{table}: {entry['event_ns']} -> "
+                            f"{now_e['event_ns']}"))
+            per_proc[proc] = wmks.merge_maps([prev, cur])
+        merged_wm = obs_view.get("watermarks", {})
+        for proc_map in per_proc.values():
+            for tid, tables in proc_map.items():
+                for table, entry in tables.items():
+                    got = merged_wm.get(tid, {}).get(table)
+                    if got is None or \
+                            got["event_ns"] < entry["event_ns"]:
+                        violations.append(Violation(
+                            "watermark-monotonicity",
+                            f"merged view regressed watermark "
+                            f"{tid}/{table} below a segment's value"))
 
     tickets = cp.list_tickets(queue)
     by_id = {t.ticket_id: t for t in tickets}

@@ -230,6 +230,19 @@ SITES: dict[str, tuple[str, str]] = {
         "(writer SIGKILLed mid-put) — the merge must skip and count "
         "the corrupt segment and still render the pane from the "
         "survivors"),
+    "watermark.advance": (
+        "stats/watermark.py",
+        "freshness-watermark advance failing (bookkeeping fault) — "
+        "absorbed and counted: a watermark fault must never fail the "
+        "batch it rode on, and the per-(transfer, table) watermark "
+        "stays monotone (the fleet_distributed chaos mode asserts a "
+        "worker kill never regresses a published watermark)"),
+    "slo.evaluate": (
+        "stats/slo.py",
+        "SLO burn-rate evaluation failing mid-verdict — the evaluator "
+        "must surface an error payload to the caller (`/debug/slo` "
+        "reports it, `trtpu slo` exits 2), never a half-computed "
+        "verdict that could latch or clear the QoS plane wrongly"),
     "client.s3.request": (
         "coordinator/s3client.py",
         "S3 wire request failing (timeout, 5xx, connection reset)"),

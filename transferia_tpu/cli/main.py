@@ -302,6 +302,48 @@ def build_parser() -> argparse.ArgumentParser:
                           "port — fleet ledger, per-worker liveness "
                           "ages, merged latency histograms, "
                           "cross-process conservation")
+    sl = sub.add_parser(
+        "slo",
+        help="SLO verdicts: burn-rate objectives (fast 5m / slow 1h "
+             "windows) over the merged obs-segment stream plus the "
+             "per-transfer freshness watermarks (stats/slo.py); "
+             "default polls GET /debug/slo on a worker's health "
+             "port, --fleet evaluates the coordinator's segments "
+             "directly, --demo runs a sample→memory transfer and "
+             "judges it")
+    sl.add_argument("--url", default="http://127.0.0.1:8080",
+                    help="health server base URL of the worker")
+    sl.add_argument("--fleet", action="store_true",
+                    help="evaluate the durable obs segments from the "
+                         "coordinator (global --coordinator* flags) "
+                         "instead of polling a worker health port — "
+                         "any process computes identical verdicts "
+                         "from the same segments")
+    sl.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable verdicts")
+    sl.add_argument("--demo", action="store_true",
+                    help="self-contained smoke: run the sample→stdout "
+                         "demo transfer locally, then evaluate this "
+                         "process's own state")
+    sl.add_argument("--rows", type=int, default=50_000,
+                    help="demo rows")
+    ex = sub.add_parser(
+        "explain",
+        help="critical-path attribution: walk the causal trace "
+             "(parent/child spans + cross-process flow links) and "
+             "attribute end-to-end wall time to pipeline stages "
+             "(decode, device dispatch, queue wait, wire, publish) "
+             "with a top-3-levers summary (stats/critpath.py); "
+             "`explain demo` runs the sample→stdout demo transfer "
+             "with tracing and explains it, `explain <transfer-id>` "
+             "merges the fleet obs segments for that transfer")
+    ex.add_argument("target",
+                    help="'demo' or a transfer id to explain from the "
+                         "coordinator's obs segments")
+    ex.add_argument("--rows", type=int, default=50_000,
+                    help="demo rows")
+    ex.add_argument("--json", action="store_true", dest="as_json",
+                    help="machine-readable report")
     return p
 
 
@@ -415,6 +457,17 @@ def _start_health_server(port: int) -> int:
                     body = fleetobs.dumps_view(view).encode()
                     status = 200
                 ctype = "application/json"
+            elif self.path.startswith("/debug/slo"):
+                # burn-rate verdicts + freshness watermarks: fleet-wide
+                # through the registered obs runtime when there is one,
+                # this process's own state otherwise (stats/slo.py —
+                # pure over the segments, so every process agrees)
+                from transferia_tpu.stats import slo
+
+                body = json.dumps(slo.debug_slo(),
+                                  default=str).encode()
+                ctype = "application/json"
+                status = 200
             elif self.path.startswith("/debug/ledger"):
                 # per-transfer/per-tenant resource attribution + the
                 # conservation reconciliation (stats/ledger.py); the
@@ -556,6 +609,10 @@ def main(argv=None) -> int:
         return cmd_worker(args)
     if args.command == "top":
         return cmd_top(args)
+    if args.command == "slo":
+        return cmd_slo(args)
+    if args.command == "explain":
+        return cmd_explain(args)
 
     transfer = _load_transfer(args)
     cp = _coordinator(args)
@@ -1067,6 +1124,17 @@ def cmd_top(args) -> int:
                       f"/debug/ledger snapshot (wrong service?)",
                       file=sys.stderr)
                 return 2
+            # lag/SLO columns ride the same poll, best-effort: an old
+            # worker without /debug/slo still renders a plain frame
+            slo_url = args.url.rstrip("/") + "/debug/slo"
+            try:
+                with urllib.request.urlopen(slo_url, timeout=10) as r:
+                    verdicts = json.loads(r.read())
+                if isinstance(verdicts, dict) and \
+                        "objectives" in verdicts:
+                    snap["slo"] = verdicts
+            except (OSError, ValueError):
+                pass
             if args.as_json:
                 print(json.dumps(snap, indent=1))
                 return 0
@@ -1118,6 +1186,114 @@ def cmd_top_fleet(args) -> int:
             _time.sleep(max(0.2, args.interval))
     except KeyboardInterrupt:
         return 0
+
+
+def _run_demo_snapshot(rows: int) -> None:
+    """One traced sample→stdout snapshot in THIS process (the `trtpu
+    slo --demo` / `trtpu explain demo` substrate)."""
+    from transferia_tpu.coordinator import MemoryCoordinator
+    from transferia_tpu.stats import trace
+    from transferia_tpu.stats.registry import Metrics
+    from transferia_tpu.tasks import SnapshotLoader
+
+    trace.reset()
+    trace.enable(True)
+    try:
+        SnapshotLoader(_demo_trace_transfer(rows), MemoryCoordinator(),
+                       metrics=Metrics()).upload_tables()
+    finally:
+        trace.enable(False)
+
+
+def cmd_slo(args) -> int:
+    """`trtpu slo`: burn-rate verdicts + freshness watermarks.  URL
+    mode polls GET /debug/slo with the `trtpu top` error contract
+    (non-JSON / wrong-shape bodies exit 2); --fleet evaluates the
+    coordinator's obs segments directly; --demo runs the sample
+    snapshot locally first so the verdicts have data to judge."""
+    import urllib.request
+
+    from transferia_tpu.stats import slo
+
+    if args.demo:
+        _run_demo_snapshot(args.rows)
+        view = slo.evaluate(slo.local_segments())
+        view["scope"] = "demo"
+    elif args.fleet:
+        from transferia_tpu.stats import fleetobs
+
+        cp = _coordinator(args)
+        if not cp.supports_obs_segments():
+            print("trtpu slo --fleet: coordinator has no obs-segment "
+                  "support", file=sys.stderr)
+            return 2
+        scope = fleetobs.default_scope()
+        segments = cp.list_obs_segments(scope)
+        if not segments:
+            print(f"trtpu slo --fleet: no obs segments under scope "
+                  f"{scope!r}", file=sys.stderr)
+            return 2
+        view = slo.evaluate(segments)
+        view["scope"] = scope
+    else:
+        url = args.url.rstrip("/") + "/debug/slo"
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                view = json.loads(resp.read())
+        except (OSError, ValueError) as e:
+            print(f"trtpu slo: {url}: {e}", file=sys.stderr)
+            return 2
+        if not isinstance(view, dict) or "objectives" not in view:
+            # valid JSON but not an SLO payload (wrong service, or the
+            # evaluator surfaced an error dict): exit 2, like top
+            detail = view.get("error") if isinstance(view, dict) \
+                else "response is not a /debug/slo payload"
+            print(f"trtpu slo: {url}: {detail}", file=sys.stderr)
+            return 2
+    if args.as_json:
+        print(json.dumps(view, indent=1, default=str))
+    else:
+        print(slo.format_verdicts(view))
+    return 0 if view.get("ok") else 1
+
+
+def cmd_explain(args) -> int:
+    """`trtpu explain`: critical-path attribution.  `demo` runs the
+    traced sample snapshot in-process and explains its own spans; a
+    transfer id merges the coordinator's obs segments (multi-worker
+    critical path via cross-process flow links)."""
+    from transferia_tpu.stats import critpath
+
+    if args.target == "demo":
+        _run_demo_snapshot(args.rows)
+        records = critpath.records_from_local()
+        report = critpath.explain(records, transfer_id="trace-demo")
+    else:
+        from transferia_tpu.stats import fleetobs
+
+        cp = _coordinator(args)
+        if not cp.supports_obs_segments():
+            print("trtpu explain: coordinator has no obs-segment "
+                  "support", file=sys.stderr)
+            return 2
+        scope = fleetobs.default_scope()
+        segments = cp.list_obs_segments(scope)
+        if not segments:
+            print(f"trtpu explain: no obs segments under scope "
+                  f"{scope!r} — are workers running with observability "
+                  f"export on?", file=sys.stderr)
+            return 2
+        records = critpath.records_from_segments(segments)
+        report = critpath.explain(records, transfer_id=args.target)
+    if not report.get("spans"):
+        print("trtpu explain: no spans found (tracing off, or the "
+              "transfer id matched nothing)", file=sys.stderr)
+        return 2
+    if args.as_json:
+        print(json.dumps(report, indent=1, default=str))
+    else:
+        print(critpath.format_report(report))
+    return 0
 
 
 def cmd_validate(args) -> int:

@@ -103,6 +103,9 @@ class BackpressureController:
         # at the exact moment this controller reads them, not stale
         # from the last dispatch
         self._tick_listeners: list[Callable[[], None]] = []
+        # external latches (the SLO alert hook): named pressure sources
+        # outside the gauge table, held until explicitly cleared
+        self._external: dict[str, str] = {}
 
     def add_tick_listener(self, cb: Callable[[], None]) -> None:
         with self._lock:
@@ -158,12 +161,27 @@ class BackpressureController:
                     st.latched = False
                     st.transitions += 1
                 hot = hot or st.latched
-            return hot
+            return hot or bool(self._external)
+
+    def latch_external(self, name: str, reason: str = "") -> None:
+        """Latch a named external pressure source (an SLO objective
+        burning budget sheds new admissions until it recovers)."""
+        with self._lock:
+            if name not in self._external:
+                logger.info("backpressure: external latch %s (%s)",
+                            name, reason or "-")
+            self._external[name] = reason
+
+    def clear_external(self, name: str) -> None:
+        with self._lock:
+            if self._external.pop(name, None) is not None:
+                logger.info("backpressure: external latch %s cleared",
+                            name)
 
     def snapshot(self) -> dict:
         """Per-signal values/latch states for /debug/fleet."""
         with self._lock:
-            return {
+            out = {
                 st.spec.name: {
                     "value": st.value,
                     "latched": st.latched,
@@ -174,7 +192,15 @@ class BackpressureController:
                 }
                 for st in self._states
             }
+            for name, reason in sorted(self._external.items()):
+                out[f"external:{name}"] = {
+                    "value": 1.0, "latched": True, "high": 1.0,
+                    "low": 0.0, "inverted": False, "transitions": 0,
+                    "reason": reason,
+                }
+            return out
 
     def latched_signals(self) -> list[str]:
         with self._lock:
-            return [st.spec.name for st in self._states if st.latched]
+            return [st.spec.name for st in self._states if st.latched] \
+                + [f"external:{n}" for n in sorted(self._external)]

@@ -33,7 +33,7 @@ from transferia_tpu.fleet.scheduler import (
     percentile,
 )
 from transferia_tpu.models import Transfer, TransferType
-from transferia_tpu.stats import hdr
+from transferia_tpu.stats import hdr, watermark
 from transferia_tpu.stats.registry import Metrics
 
 logger = logging.getLogger(__name__)
@@ -168,6 +168,7 @@ def run_fleet_bench(transfers: int = 120, workers: int = 8,
     # (stats/hdr.py): the registry is process-global, so the bench
     # carves its own window out of it with a bucket-wise diff
     h0 = hdr.STAGES.get("fleet_dispatch")
+    l0 = hdr.STAGES.get(watermark.STAGE_LAG)
     t0 = time.perf_counter()
     sched.start()
     try:
@@ -177,6 +178,7 @@ def run_fleet_bench(transfers: int = 120, workers: int = 8,
         sched.shutdown()
     hwin = hdr.STAGES.get("fleet_dispatch").diff(h0)
     hdr_summary = hwin.summary()
+    lag_summary = hdr.STAGES.get(watermark.STAGE_LAG).diff(l0).summary()
 
     # -- delivery audit ------------------------------------------------------
     lost: list[str] = []
@@ -225,6 +227,11 @@ def run_fleet_bench(transfers: int = 120, workers: int = 8,
         "dispatch_hdr_p999_ms": hdr_summary["p999_ms"],
         "dispatch_hdr_count": hdr_summary["count"],
         "dispatch_hdr_max_trace": hdr_summary["max_trace"],
+        # end-to-end freshness tail over the same run window: sample
+        # batches carry event time, the sink-side Statistician feeds
+        # publish lag into the mergeable replication_lag histogram
+        "replication_lag_p99_ms": lag_summary["p99_ms"],
+        "replication_lag_count": lag_summary["count"],
         "pick_p50_us": round(percentile(picks_us, 0.50), 1),
         "pick_p99_us": round(percentile(picks_us, 0.99), 1),
         "desired_workers_final": sched.desired_workers(),
@@ -249,6 +256,9 @@ def format_report(report: dict) -> str:
         f"p99={report['dispatch_hdr_p99_ms']}ms "
         f"p999={report['dispatch_hdr_p999_ms']}ms "
         f"n={report['dispatch_hdr_count']}",
+        f"  replication lag (mergeable): "
+        f"p99={report['replication_lag_p99_ms']}ms "
+        f"n={report['replication_lag_count']}",
         f"  jain fairness (contention window, skew 10:1): "
         f"{report['jain_fairness']}",
         f"  completed={report['completed']} failed={report['failed']} "

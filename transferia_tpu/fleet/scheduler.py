@@ -368,6 +368,31 @@ class FleetScheduler:
                 name, float(self._tenant_weights.get(name, 1.0)))
         return tn
 
+    def tenant_weight(self, name: str) -> float:
+        """Current WDRR weight for a tenant (configured default when it
+        has not queued work yet)."""
+        with self._lock:
+            tn = self._tenants.get(name)
+            if tn is not None:
+                return tn.weight
+            return float(self._tenant_weights.get(name, 1.0))
+
+    def set_tenant_weight(self, name: str, weight: float) -> float:
+        """Retune a tenant's WDRR weight live (the SLO alert hook's
+        escalation lever: a tenant burning latency budget gets a larger
+        deficit refill, so its queue drains faster).  Returns the prior
+        weight so the caller can restore it on recovery."""
+        weight = max(0.01, float(weight))
+        with self._lock:
+            tn = self._tenants.get(name)
+            prior = tn.weight if tn is not None \
+                else float(self._tenant_weights.get(name, 1.0))
+            self._tenant_weights[name] = weight
+            if tn is not None:
+                tn.weight = weight
+            self._cond.notify_all()
+        return prior
+
     # -- dispatch (DRR) ------------------------------------------------------
     def _pick_locked(self) -> Optional[FleetTransfer]:
         """One deficit-round-robin decision.  Caller holds the lock."""

@@ -29,6 +29,7 @@ from transferia_tpu.middlewares.helpers import (
 )
 from transferia_tpu.stats import trace
 from transferia_tpu.stats.ledger import LEDGER
+from transferia_tpu.stats.watermark import WATERMARKS
 from transferia_tpu.stats.registry import SinkerStats
 from transferia_tpu.utils.backoff import retry_with_backoff
 
@@ -56,9 +57,14 @@ class _Wrap(Sinker):
 class Statistician(_Wrap):
     """Counts pushed rows/bytes per table (middlewares/statistician.go)."""
 
-    def __init__(self, inner: Sinker, stats: SinkerStats):
+    def __init__(self, inner: Sinker, stats: SinkerStats,
+                 transfer_id: str = ""):
         super().__init__(inner)
         self.stats = stats
+        # explicit identity (not a contextvar): pushes arrive on
+        # parsequeue/asynchronizer threads that never saw the
+        # submitting thread's context
+        self.transfer_id = transfer_id
 
     @staticmethod
     def _prefix(batch: Batch, k: int) -> Batch:
@@ -104,6 +110,10 @@ class Statistician(_Wrap):
             for it in batch:
                 if it.is_row_event():
                     self.stats.record_table(str(it.table_id), 1)
+        if self.transfer_id and n_rows:
+            # freshness: the batch has durably reached the sink — this
+            # is the publish-watermark advance + end-to-end lag sample
+            WATERMARKS.observe_publish(self.transfer_id, batch)
 
 
 class Filter(_Wrap):

@@ -89,7 +89,8 @@ class EventHubProvider(Provider):
                                    self.coordinator)
         return QueueSource(client, self.transfer.src.parser_config(),
                            parallelism=self.transfer.src.parallelism,
-                           metrics=self.metrics)
+                           metrics=self.metrics,
+                           transfer_id=self.transfer.id)
 
     def test(self) -> TestResult:
         result = TestResult(ok=True)

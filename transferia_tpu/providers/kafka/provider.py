@@ -396,7 +396,8 @@ class KafkaProvider(Provider):
                                        self.coordinator)
             return QueueSource(client, p.parser,
                                parallelism=p.parallelism,
-                               metrics=self.metrics)
+                               metrics=self.metrics,
+                               transfer_id=self.transfer.id)
         return None
 
     def sinker(self):

@@ -361,7 +361,8 @@ class KinesisProvider(Provider):
                                          self.coordinator)
             return QueueSource(client, p.parser,
                                parallelism=p.parallelism,
-                               metrics=self.metrics)
+                               metrics=self.metrics,
+                               transfer_id=self.transfer.id)
         return None
 
     def test(self) -> TestResult:

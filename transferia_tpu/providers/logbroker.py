@@ -167,7 +167,8 @@ class LogbrokerProvider(Provider):
                                    self.coordinator)
         return QueueSource(client, p.parser_config(),
                            parallelism=p.parallelism,
-                           metrics=self.metrics)
+                           metrics=self.metrics,
+                           transfer_id=self.transfer.id)
 
     def sinker(self):
         if not isinstance(self.transfer.dst, LogbrokerTargetParams):

@@ -308,7 +308,8 @@ class MQProvider(Provider):
         p = self.transfer.src
         client = _MQClient(p)
         return QueueSource(client, p.parser, parallelism=p.parallelism,
-                           metrics=self.metrics)
+                           metrics=self.metrics,
+                           transfer_id=self.transfer.id)
 
     def sinker(self):
         return MQSinker(self.transfer.dst)

@@ -21,6 +21,7 @@ from transferia_tpu.parsequeue import ParseQueue
 from transferia_tpu.parsers import Message, Parser, make_parser
 from transferia_tpu.stats import trace
 from transferia_tpu.stats.registry import Metrics, SourceStats
+from transferia_tpu.stats.watermark import POLL_PREFIX, WATERMARKS
 
 logger = logging.getLogger(__name__)
 
@@ -79,7 +80,7 @@ class QueueSource(Source):
 
     def __init__(self, client, parser_config, parallelism: int = 4,
                  metrics: Optional[Metrics] = None,
-                 stop_poll: float = 0.2):
+                 stop_poll: float = 0.2, transfer_id: str = ""):
         self.client = client
         self.parser: Parser = make_parser(parser_config) \
             if parser_config else make_parser({"blank": {}})
@@ -88,6 +89,7 @@ class QueueSource(Source):
         self.sequencer = Sequencer()
         self._stop = threading.Event()
         self.stop_poll = stop_poll
+        self.transfer_id = transfer_id
 
     def run(self, sink: AsyncSink) -> None:
         def parse(fb: FetchedBatch):
@@ -131,6 +133,17 @@ class QueueSource(Source):
                     self.sequencer.start_processing(
                         fb.topic, fb.partition, fb.offsets()
                     )
+                    if self.transfer_id:
+                        # poll watermark: the newest broker write time
+                        # seen for this partition — the stand-in event
+                        # time for batches whose parser drops it
+                        wm = max((m.write_time_ns for m in fb.messages),
+                                 default=0)
+                        if wm:
+                            WATERMARKS.advance(
+                                self.transfer_id,
+                                f"{POLL_PREFIX}{fb.topic}:{fb.partition}",
+                                event_ns=wm, origin="poll")
                     pq.add(fb)
             pq.wait()
             if pq.failure is not None:

@@ -289,6 +289,12 @@ class SampleStorage(Storage, ShardingStorage):
                 batch = make_batch(self.params.preset, table.id, start, n,
                                    self.params.seed,
                                    dict_encode=self.params.dict_encode)
+            # synthetic data's event time IS its generation instant —
+            # stamped on the read path (not in make_batch, whose output
+            # is compared byte-identically by tests) so the freshness
+            # plane measures real generate→publish lag on demo runs
+            batch.commit_times = np.full(n, time.time_ns(),
+                                         dtype=np.int64)
             pusher(batch)
 
 
@@ -311,6 +317,8 @@ class SampleReplicationSource(Source):
                                self.params.seed)
             lsn += 1
             batch.lsns = np.full(bs, lsn, dtype=np.int64)
+            batch.commit_times = np.full(bs, time.time_ns(),
+                                         dtype=np.int64)
             futures.append(sink.async_push(batch))
             if len(futures) > 16:
                 futures.pop(0).result()

@@ -94,7 +94,8 @@ class YDSProvider(Provider):
                                  self.coordinator)
         return QueueSource(client, p.parser_config(),
                            parallelism=p.parallelism,
-                           metrics=self.metrics)
+                           metrics=self.metrics,
+                           transfer_id=self.transfer.id)
 
     def test(self) -> TestResult:
         result = TestResult(ok=True)

@@ -121,7 +121,7 @@ class PartitionedWorker:
                 client, self.transfer.src.parser_config(),
                 parallelism=max(
                     1, self.transfer.src.parallelism // len(partitions)),
-                metrics=self.metrics)
+                metrics=self.metrics, transfer_id=self.transfer.id)
             sink = make_async_sink(self.transfer, self.metrics,
                                    snapshot_stage=False)
             with self._plock:
@@ -275,6 +275,11 @@ def _stop_on_event(stop_event: threading.Event, worker: LocalWorker) -> None:
 def _heartbeat_loop(stop_event: threading.Event, cp: Coordinator,
                     transfer_id: str,
                     metrics: Optional[Metrics] = None) -> None:
+    from transferia_tpu.stats import fleetobs
+
+    exporter = None
+    if getattr(cp, "supports_obs_segments", lambda: False)():
+        exporter = fleetobs.exporter_for(cp, f"repl-{transfer_id}")
     while not stop_event.wait(HEARTBEAT_SECONDS):
         cp.transfer_health(transfer_id, healthy=True)
         if metrics is not None:
@@ -284,3 +289,13 @@ def _heartbeat_loop(stop_event: threading.Event, cp: Coordinator,
             # folds on the same heartbeat
             trace.TELEMETRY.fold_into(metrics)
             LEDGER.fold_into(metrics)
+            if exporter is not None:
+                # obs segments (spans, hists, watermarks) ride the same
+                # beat: a long replication's freshness is visible
+                # fleet-wide, and SLO burn rates get their window edges
+                exporter.export("periodic")
+                from transferia_tpu.stats import slo
+
+                slo.fold_verdicts(metrics, slo.debug_slo())
+    if exporter is not None:
+        exporter.export("final")

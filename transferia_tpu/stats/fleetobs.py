@@ -64,7 +64,7 @@ from typing import Optional
 from transferia_tpu.abstract.errors import is_worker_kill
 from transferia_tpu.chaos.failpoints import failpoint
 from transferia_tpu.coordinator.interface import env_float
-from transferia_tpu.stats import hdr, trace
+from transferia_tpu.stats import hdr, trace, watermark
 # _INT_FIELDS is the ledger's own exact-vs-rounded field split — the
 # merge's conservation check must agree with it, so share the set
 from transferia_tpu.stats.ledger import FIELDS, LEDGER, _INT_FIELDS
@@ -180,6 +180,7 @@ class ObsExporter:
             },
             "telemetry": trace.TELEMETRY.snapshot(),
             "hists": hdr.STAGES.snapshot(),
+            "watermarks": watermark.WATERMARKS.snapshot(),
         }
         return seg, total
 
@@ -471,6 +472,11 @@ def merge_segments(raw_segments: list,
 
         hists = hdr.merge_stage_maps(
             [seg.get("hists", {}) for seg in by_pid.values()])
+        # watermarks merge over ALL segments, not latest-per-pid:
+        # max-merge is idempotent, and a SIGKILLed worker's lost final
+        # segment must not regress what its earlier segments published
+        merged_wm = watermark.merge_maps(
+            [seg.get("watermarks") for seg in segments])
         span_count = sum(len(seg.get("spans", [])) for seg in segments)
         return {
             "segments": len(segments),
@@ -484,6 +490,8 @@ def merge_segments(raw_segments: list,
             "telemetry": telemetry,
             "hists": {name: h.summary()
                       for name, h in sorted(hists.items())},
+            "watermarks": merged_wm,
+            "freshness": watermark.summarize(merged_wm, now=now),
             "conservation": conservation,
         }
 
@@ -633,7 +641,7 @@ _FLEET_TOP_COLS = (
     ("transfer", 22), ("tenant", 10), ("wrk", 4), ("rows_in", 9),
     ("rows_out", 9), ("mb_in", 8), ("mb_out", 8), ("h2d_mb", 8),
     ("launch", 7), ("retry", 6), ("steal", 6), ("fires", 6),
-    ("commit", 7), ("fence", 6),
+    ("commit", 7), ("fence", 6), ("lag_ms", 8),
 )
 
 
@@ -667,12 +675,20 @@ def format_fleet_top(view: dict, limit: int = 20) -> str:
             f"{name}[p50={h.get('p50_ms', 0)} p99={h.get('p99_ms', 0)} "
             f"p999={h.get('p999_ms', 0)}ms n={h.get('count', 0)}]"
             for name, h in ranked))
+    lag = hists.get(watermark.STAGE_LAG) if hists else None
+    if lag and lag.get("count"):
+        lines.append(
+            f"replication lag: p50={lag.get('p50_ms', 0)} "
+            f"p99={lag.get('p99_ms', 0)} p999={lag.get('p999_ms', 0)}ms "
+            f"n={lag.get('count', 0)}")
     lines.append(" ".join(f"{name:>{w}}"
                           for name, w in _FLEET_TOP_COLS))
     rows = sorted(view.get("transfers", {}).items(),
                   key=lambda kv: -(kv[1].get("bytes_out", 0)
                                    + kv[1].get("bytes_in", 0)))
+    fresh = view.get("freshness", {})
     for tid, v in rows[:limit]:
+        lag_ms = fresh.get(tid, {}).get("lag_ms")
         cells = (tid[:22], str(v.get("tenant", "-"))[:10],
                  len(v.get("workers", [])), v.get("rows_in", 0),
                  v.get("rows_out", 0),
@@ -681,7 +697,8 @@ def format_fleet_top(view: dict, limit: int = 20) -> str:
                  f"{v.get('h2d_bytes', 0) / 1e6:.1f}",
                  v.get("launches", 0), v.get("retries", 0),
                  v.get("lease_steals", 0), v.get("chaos_fires", 0),
-                 v.get("commits", 0), v.get("commit_fences", 0))
+                 v.get("commits", 0), v.get("commit_fences", 0),
+                 "-" if lag_ms is None else f"{lag_ms:.0f}")
         lines.append(" ".join(
             f"{c:>{w}}" for c, (_n, w) in zip(cells, _FLEET_TOP_COLS)))
     if len(rows) > limit:

@@ -124,6 +124,19 @@ class LogHistogram:
                 return bucket_mid(idx)
         return self.max_value
 
+    def fraction_at_most(self, value: float) -> float:
+        """Fraction of observations <= value (the SLO good-event
+        ratio).  1.0 for an empty histogram — no observations means no
+        bad events, not a breach.  The bucket containing `value` counts
+        as good, so the answer inherits the ~3% bucket granularity —
+        plenty for burn-rate work, and exactly reproducible from any
+        merge order."""
+        if self.count <= 0:
+            return 1.0
+        limit = bucket_index(value)
+        good = sum(n for idx, n in self.counts.items() if idx <= limit)
+        return min(1.0, good / self.count)
+
     def diff(self, baseline: "LogHistogram") -> "LogHistogram":
         """Self minus a prior snapshot of the SAME histogram (bucket-
         wise, clamped at 0) — how a bench carves its own window out of

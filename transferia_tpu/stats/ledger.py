@@ -439,6 +439,16 @@ def format_top(snapshot: dict, limit: int = 20) -> str:
                 tenants.items(),
                 key=lambda kv: -kv[1]["bytes_out"])[:8])
         lines.append(f"tenants: {roll}")
+    slo_view = snapshot.get("slo")
+    if isinstance(slo_view, dict) and slo_view.get("objectives"):
+        burning = slo_view.get("burning", []) or []
+        lags = [row.get("lag_ms") for row in
+                (slo_view.get("watermarks", {}) or {}).values()
+                if isinstance(row.get("lag_ms"), (int, float))]
+        lines.append(
+            f"slo: {'BURNING ' + ','.join(burning) if burning else 'OK'}"
+            f" ({len(slo_view['objectives'])} objectives)"
+            + (f"  max lag {max(lags):.0f}ms" if lags else ""))
     header = " ".join(f"{name:>{w}}" for name, w in _TOP_COLS)
     lines.append(header)
     rows = sorted(snapshot.get("transfers", {}).items(),

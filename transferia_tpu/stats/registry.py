@@ -142,6 +142,20 @@ class SinkerStats(_Bundle):
         self.table_rows[table] = self.table_rows.get(table, 0) + rows
 
 
+class SloStats(_Bundle):
+    """SLO plane gauges (stats/slo.py fold_verdicts): the scrapeable
+    shape of the latest burn-rate evaluation."""
+
+    def __init__(self, metrics: Optional[Metrics] = None):
+        super().__init__(metrics)
+        self.objectives = self.m.gauge("slo_objectives")
+        self.burning = self.m.gauge("slo_burning")
+        self.worst_burn_fast = self.m.gauge("slo_worst_burn_fast")
+        self.worst_burn_slow = self.m.gauge("slo_worst_burn_slow")
+        self.worst_lag_ms = self.m.gauge("slo_worst_replication_lag_ms")
+        self.evaluations = self.m.counter("slo_evaluations")
+
+
 class BuffererStats(_Bundle):
     """middleware bufferer flush metrics."""
 
