@@ -27,10 +27,12 @@ from typing import Optional
 
 import numpy as np
 
-ROWS = int(os.environ.get("BENCH_ROWS", 2_000_000))
-WIDE_ROWS = int(os.environ.get("BENCH_WIDE_ROWS", 10_000_000))
-BATCH_ROWS = int(os.environ.get("BENCH_BATCH_ROWS", 131_072))
-DATA_DIR = os.environ.get("BENCH_DIR", "/tmp/trtpu_bench")
+from transferia_tpu.runtime import knobs
+
+ROWS = knobs.env_int("BENCH_ROWS", 2_000_000)
+WIDE_ROWS = knobs.env_int("BENCH_WIDE_ROWS", 10_000_000)
+BATCH_ROWS = knobs.env_int("BENCH_BATCH_ROWS", 131_072)
+DATA_DIR = knobs.env_str("BENCH_DIR", "/tmp/trtpu_bench")
 PARQUET = os.path.join(DATA_DIR, f"hits_{ROWS}.parquet")
 WIDE_PARQUET = os.path.join(DATA_DIR, f"hits_wide_{WIDE_ROWS}.parquet")
 
@@ -44,8 +46,9 @@ def _auto_process_count() -> int:
     spent 345% of wall in 4 time-sliced decode threads.  Use the real
     affinity count, capped at the reference's ProcessCount default of 4
     (pkg/abstract/runtime.go:105-107)."""
-    if os.environ.get("BENCH_PROCESS_COUNT"):
-        return int(os.environ["BENCH_PROCESS_COUNT"])
+    pinned = knobs.env_int("BENCH_PROCESS_COUNT", 0)
+    if pinned:
+        return pinned
     return max(1, min(4, int(_effective_cpus())))
 
 
@@ -376,7 +379,7 @@ def _device_available(timeout_s: float | None = None) -> bool:
     import tempfile
 
     if timeout_s is None:
-        timeout_s = float(os.environ.get("BENCH_PROBE_TIMEOUT", 330))
+        timeout_s = knobs.env_float("BENCH_PROBE_TIMEOUT", 330.0)
     trace_path = os.path.join(tempfile.gettempdir(),
                               "trtpu_bench_probe_trace.log")
     try:
@@ -1235,7 +1238,7 @@ def _trace_out_path() -> str:
     (stats/trace.py) and writes a Perfetto-loadable trace.json next to
     the usual stderr diagnostics — every benchmark run can then ship a
     timeline artifact alongside its numbers."""
-    out = os.environ.get("BENCH_TRACE", "")
+    out = knobs.env_str("BENCH_TRACE", "")
     for a in sys.argv[1:]:
         if a == "--trace":
             out = out or os.path.join(DATA_DIR, "bench_trace.json")
@@ -1482,8 +1485,8 @@ def measure_dispatch() -> dict:
         set_placement,
     )
 
-    rows = int(os.environ.get("BENCH_DISPATCH_ROWS", 131_072))
-    n_batches = max(1, int(os.environ.get("BENCH_DISPATCH_BATCHES", 4)))
+    rows = knobs.env_int("BENCH_DISPATCH_ROWS", 131_072)
+    n_batches = max(1, knobs.env_int("BENCH_DISPATCH_BATCHES", 4))
     uniques = 4096
     tid = TableID("bench", "dispatch")
     schema = new_table_schema([("URL", "utf8"), ("RegionID", "int32")])
@@ -1582,9 +1585,8 @@ def measure_checksum_dict() -> dict:
     from transferia_tpu.ops.rowhash import TableFingerprinter
     from transferia_tpu.stats.trace import TELEMETRY
 
-    rows = int(os.environ.get("BENCH_CHECKSUM_DICT_ROWS", 262_144))
-    n_batches = max(1, int(os.environ.get("BENCH_CHECKSUM_DICT_BATCHES",
-                                          8)))
+    rows = knobs.env_int("BENCH_CHECKSUM_DICT_ROWS", 262_144)
+    n_batches = max(1, knobs.env_int("BENCH_CHECKSUM_DICT_BATCHES", 8))
     uniques = 4096
     tid = TableID("bench", "checksum_dict")
     # the ClickBench `hits` character: one wide id plus several
@@ -1689,9 +1691,8 @@ def measure_encoded_wire() -> dict:
     )
     from transferia_tpu.interchange.telemetry import TELEMETRY as ITEL
 
-    rows = int(os.environ.get("BENCH_ENCODED_WIRE_ROWS", 65_536))
-    n_batches = max(1, int(os.environ.get("BENCH_ENCODED_WIRE_BATCHES",
-                                          4)))
+    rows = knobs.env_int("BENCH_ENCODED_WIRE_ROWS", 65_536)
+    n_batches = max(1, knobs.env_int("BENCH_ENCODED_WIRE_BATCHES", 4))
     uniques = 4096
     tid = TableID("bench", "encoded_wire")
     schema = new_table_schema([("URL", "utf8"), ("RegionID", "int32")])
@@ -1778,7 +1779,7 @@ def measure_interchange() -> dict:
     is the IPC-or-shm path beating the pivot baseline by >= 2x."""
     from transferia_tpu.interchange.bench import run_interchange_bench
 
-    rows = int(os.environ.get("BENCH_INTERCHANGE_ROWS", 500_000))
+    rows = knobs.env_int("BENCH_INTERCHANGE_ROWS", 500_000)
     return run_interchange_bench(rows=rows, batch_rows=65_536)
 
 
@@ -1793,9 +1794,9 @@ def measure_fleet() -> dict:
     from transferia_tpu.fleet.bench import run_fleet_bench
 
     return run_fleet_bench(
-        transfers=int(os.environ.get("BENCH_FLEET_TRANSFERS", 120)),
-        workers=int(os.environ.get("BENCH_FLEET_WORKERS", 8)),
-        rows=int(os.environ.get("BENCH_FLEET_ROWS", 256)),
+        transfers=knobs.env_int("BENCH_FLEET_TRANSFERS", 120),
+        workers=knobs.env_int("BENCH_FLEET_WORKERS", 8),
+        rows=knobs.env_int("BENCH_FLEET_ROWS", 256),
     )
 
 
@@ -2109,7 +2110,7 @@ def main() -> int:
     except Exception as e:
         print(f"# fingerprint bench failed: {type(e).__name__}: {e}",
               file=sys.stderr)
-    if os.environ.get("BENCH_SKIP_CHECKSUM_DICT") != "1":
+    if knobs.env_str("BENCH_SKIP_CHECKSUM_DICT", "") != "1":
         try:
             cdict = measure_checksum_dict()
             if fallback:
@@ -2118,21 +2119,21 @@ def main() -> int:
         except Exception as e:
             print(f"# checksum-dict bench failed: {type(e).__name__}: "
                   f"{e}", file=sys.stderr)
-    if os.environ.get("BENCH_SKIP_INTERCHANGE") != "1":
+    if knobs.env_str("BENCH_SKIP_INTERCHANGE", "") != "1":
         try:
             ichg = measure_interchange()
             _emit(ichg)
         except Exception as e:
             print(f"# interchange bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
-    if os.environ.get("BENCH_SKIP_ENCODED_WIRE") != "1":
+    if knobs.env_str("BENCH_SKIP_ENCODED_WIRE", "") != "1":
         try:
             ew = measure_encoded_wire()
             _emit(ew)
         except Exception as e:
             print(f"# encoded-wire bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
-    if os.environ.get("BENCH_SKIP_DISPATCH") != "1":
+    if knobs.env_str("BENCH_SKIP_DISPATCH", "") != "1":
         try:
             disp = measure_dispatch()
             if fallback:
@@ -2143,7 +2144,7 @@ def main() -> int:
                   file=sys.stderr)
     # remaining BASELINE configs (each prints one tail line; failures
     # never mask the headline, which already printed)
-    if os.environ.get("BENCH_SKIP_KAFKA2CH") != "1":
+    if knobs.env_str("BENCH_SKIP_KAFKA2CH", "") != "1":
         try:
             k2ch = measure_kafka2ch()
             if fallback:
@@ -2152,7 +2153,7 @@ def main() -> int:
         except Exception as e:
             print(f"# kafka2ch bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr)
-    if os.environ.get("BENCH_SKIP_CONFIGS") != "1":
+    if knobs.env_str("BENCH_SKIP_CONFIGS", "") != "1":
         for name, fn in (("pg2ch", measure_pg2ch),
                          ("mysql2kafka", measure_mysql2kafka),
                          ("kafka_sr64", measure_kafka_sr2ch)):

@@ -21,9 +21,12 @@ from transferia_tpu.analysis.engine import (
 from transferia_tpu.analysis.rules import (
     DevicePurityRule,
     ExceptionHygieneRule,
+    KnobRegistryRule,
     LockDisciplineRule,
+    LockOrderRule,
     RegistryContractRule,
     ResourceSafetyRule,
+    ThreadLifecycleRule,
 )
 
 
@@ -759,3 +762,463 @@ class TestWholeTree:
         from transferia_tpu.analysis.cli import main
 
         assert main(["--strict"]) == 0
+
+
+# -- LCK002 whole-program lock order ------------------------------------------
+
+def project_findings(rule, sources):
+    """Run a ProjectRule over in-memory sources keyed by relpath."""
+    files = {}
+    for path, src in sources.items():
+        src = textwrap.dedent(src)
+        files[path] = (ast.parse(src), src.splitlines())
+    return rule.check_project(".", files)
+
+
+LCK2_ABBA = """
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self._x = threading.Lock()
+            self._y = threading.Lock()
+
+        def fwd(self):
+            with self._x:
+                with self._y:
+                    pass
+
+        def rev(self):
+            with self._y:
+                with self._x:
+                    pass
+"""
+
+LCK2_INTERPROC = """
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self._x = threading.Lock()
+            self._y = threading.Lock()
+
+        def fwd(self):
+            with self._x:
+                with self._y:
+                    pass
+
+        def rev(self):
+            with self._y:
+                self.helper()
+
+        def helper(self):
+            with self._x:
+                pass
+"""
+
+LCK2_CLEAN = """
+    import threading
+
+    class Pair:
+        def __init__(self):
+            self._x = threading.Lock()
+            self._y = threading.Lock()
+
+        def one(self):
+            with self._x:
+                with self._y:
+                    pass
+
+        def two(self):
+            with self._x:
+                with self._y:
+                    pass
+"""
+
+LCK2_COND_ALIAS = """
+    import threading
+
+    class Gate:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._cond = threading.Condition(self._lock)
+
+        def f(self):
+            with self._cond:
+                with self._lock:
+                    pass
+
+        def g(self):
+            with self._lock:
+                with self._cond:
+                    pass
+"""
+
+LCK2_NAMED = """
+    from transferia_tpu.runtime import lockwatch
+
+    class A:
+        def __init__(self):
+            self._a = lockwatch.named_lock("svc.alpha")
+            self._b = lockwatch.named_lock("svc.beta")
+
+        def fwd(self):
+            with self._a:
+                with self._b:
+                    pass
+
+    class B:
+        def __init__(self):
+            self._p = lockwatch.named_lock("svc.beta")
+            self._q = lockwatch.named_lock("svc.alpha")
+
+        def rev(self):
+            with self._p:
+                with self._q:
+                    pass
+"""
+
+
+class TestLockOrder:
+    def test_direct_abba_cycle(self):
+        found = project_findings(LockOrderRule(),
+                                 {"transferia_tpu/pair.py": LCK2_ABBA})
+        assert len(found) == 1
+        f = found[0]
+        assert f.rule == "LCK002" and f.severity == "error"
+        assert "potential deadlock" in f.message
+        assert "Pair._x" in f.message and "Pair._y" in f.message
+        # one witness chain per direction, each file:line -> file:line
+        assert f.message.count("before") == 2
+        assert f.message.count("pair.py:") >= 4
+        assert " -> " in f.message
+
+    def test_interprocedural_cycle_through_call_chain(self):
+        found = project_findings(
+            LockOrderRule(), {"transferia_tpu/pair.py": LCK2_INTERPROC})
+        assert len(found) == 1
+        # the y-before-x witness threads rev() -> helper(): the chain
+        # carries the call site, so it is at least three steps long
+        assert found[0].message.count("pair.py:") >= 5
+
+    def test_consistent_order_is_clean(self):
+        assert project_findings(
+            LockOrderRule(), {"transferia_tpu/pair.py": LCK2_CLEAN}) == []
+
+    def test_condition_aliases_to_wrapped_lock(self):
+        # Condition(self._lock) IS self._lock for ordering purposes:
+        # opposite cond/lock nesting must not report a false cycle
+        assert project_findings(
+            LockOrderRule(),
+            {"transferia_tpu/gate.py": LCK2_COND_ALIAS}) == []
+
+    def test_named_locks_unify_identity_across_classes(self):
+        found = project_findings(
+            LockOrderRule(), {"transferia_tpu/svc.py": LCK2_NAMED})
+        assert len(found) == 1
+        assert "svc.alpha" in found[0].message
+        assert "svc.beta" in found[0].message
+
+    def test_suppressed(self, tmp_path):
+        pkg = tmp_path / "transferia_tpu"
+        pkg.mkdir()
+        body = textwrap.dedent(LCK2_ABBA)
+        (pkg / "pair.py").write_text(body)
+        result = run_rules(["transferia_tpu"], [LockOrderRule()],
+                           root=str(tmp_path))
+        assert len(result.findings) == 1
+        (pkg / "pair.py").write_text(
+            "# trtpu: ignore-file[LCK002]\n" + body)
+        result = run_rules(["transferia_tpu"], [LockOrderRule()],
+                           root=str(tmp_path))
+        assert result.findings == []
+
+    def test_real_tree_lock_graph_is_acyclic(self):
+        result = run_rules(["transferia_tpu"], [LockOrderRule()],
+                           root=_repo_root())
+        assert result.findings == [], \
+            [f.format() for f in result.findings]
+
+    def test_real_coordinator_locks_resolved(self):
+        """The index must SEE the production locks — an acyclic result
+        is only meaningful if resolution worked."""
+        import os
+
+        from transferia_tpu.analysis import callgraph
+        from transferia_tpu.analysis.engine import iter_python_files
+
+        root = _repo_root()
+        files = {}
+        for rel in iter_python_files(["transferia_tpu"], root):
+            with open(os.path.join(root, rel), encoding="utf-8") as fh:
+                src = fh.read()
+            try:
+                files[rel] = (ast.parse(src), src.splitlines())
+            except SyntaxError:
+                continue
+        ix = callgraph.build_index(files)
+        assert "coordinator.op" in ix.locks
+        assert "fleet.scheduler" in ix.locks
+        assert ix.locks["coordinator.op"].kind == "rlock"
+        # acquired-while-holding nesting exists and stays acyclic: the
+        # coordinator releases its map locks before taking op locks
+        assert len(ix.edges) > 0
+        assert callgraph.find_cycles(ix) == []
+
+
+# -- THD001 thread lifecycle ---------------------------------------------------
+
+THD_BAD = """
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    def leak_thread():
+        t = threading.Thread(target=print)
+        t.start()
+
+    def leak_inline():
+        threading.Thread(target=print).start()
+
+    def leak_pool():
+        ex = ThreadPoolExecutor(max_workers=2)
+        ex.submit(print)
+
+    def leak_timer():
+        t = threading.Timer(5.0, print)
+        t.start()
+"""
+
+THD_SUPPRESSED = """
+    import threading
+
+    def monitor():
+        t = threading.Thread(target=print)  # trtpu: ignore[THD001]
+        t.start()
+"""
+
+THD_CLEAN = """
+    import threading
+    from concurrent.futures import ThreadPoolExecutor
+
+    def joins():
+        t = threading.Thread(target=print)
+        t.start()
+        t.join()
+
+    def daemonized():
+        t = threading.Thread(target=print, daemon=True)
+        t.start()
+
+    def daemon_attr():
+        t = threading.Thread(target=print)
+        t.daemon = True
+        t.start()
+
+    def pool_ctx():
+        with ThreadPoolExecutor(max_workers=2) as ex:
+            ex.submit(print)
+
+    def pool_shutdown():
+        ex = ThreadPoolExecutor(max_workers=2)
+        try:
+            ex.submit(print)
+        finally:
+            ex.shutdown()
+
+    def timer_cancelled():
+        t = threading.Timer(5.0, print)
+        t.start()
+        t.cancel()
+
+    def comprehension_join():
+        ts = [threading.Thread(target=print) for _ in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+"""
+
+THD_CLASS_CLEAN = """
+    import threading
+
+    class Pump:
+        def start(self):
+            self._t = threading.Thread(target=self._run)
+            self._t.start()
+
+        def stop(self):
+            self._t.join()
+
+        def _run(self):
+            pass
+"""
+
+THD_CLASS_LEAK = """
+    import threading
+
+    class Leaky:
+        def start(self):
+            self._t = threading.Thread(target=print)
+            self._t.start()
+"""
+
+THD_CROSS_FUNCTION = """
+    import threading
+
+    def bad():
+        t = threading.Thread(target=print)
+        t.start()
+
+    def unrelated():
+        t = threading.Thread(target=print)
+        t.start()
+        t.join()
+"""
+
+
+class TestThreadLifecycle:
+    def test_true_positives(self):
+        found = check_src(ThreadLifecycleRule(), THD_BAD)
+        assert len(found) == 4
+        msgs = " ".join(f.message for f in found)
+        assert "no visible lifecycle" in msgs
+        assert "never bound" in msgs                 # inline .start()
+        assert "neither a context manager" in msgs   # executor
+        assert all(f.rule == "THD001" and f.severity == "error"
+                   for f in found)
+
+    def test_suppressed(self):
+        assert check_src(ThreadLifecycleRule(), THD_SUPPRESSED) == []
+
+    def test_clean_lifecycles(self):
+        assert check_src(ThreadLifecycleRule(), THD_CLEAN) == []
+
+    def test_class_attr_join_in_other_method_is_clean(self):
+        assert check_src(ThreadLifecycleRule(), THD_CLASS_CLEAN) == []
+
+    def test_class_attr_leak_flagged(self):
+        found = check_src(ThreadLifecycleRule(), THD_CLASS_LEAK)
+        assert len(found) == 1
+        assert "'_t'" in found[0].message
+
+    def test_join_in_unrelated_function_does_not_credit(self):
+        # ownership is per-scope: a join of a same-named local in a
+        # DIFFERENT function must not absolve the leak
+        found = check_src(ThreadLifecycleRule(), THD_CROSS_FUNCTION)
+        assert len(found) == 1
+        assert found[0].line == 5
+
+    def test_real_tree_holds_contract(self):
+        result = run_rules(["transferia_tpu"], [ThreadLifecycleRule()],
+                           root=_repo_root())
+        assert result.findings == [], \
+            [f.format() for f in result.findings]
+
+
+# -- KNB001 env-knob drift -------------------------------------------------------
+
+class TestKnobRegistry:
+    def _run(self, tmp_path, files, readme=""):
+        (tmp_path / "README.md").write_text(readme)
+        for rel, src in files.items():
+            p = tmp_path / rel
+            p.parent.mkdir(parents=True, exist_ok=True)
+            p.write_text(textwrap.dedent(src))
+        return run_rules(["transferia_tpu"], [KnobRegistryRule()],
+                         root=str(tmp_path)).findings
+
+    def test_direct_environ_read_flagged(self, tmp_path):
+        found = self._run(tmp_path, {"transferia_tpu/a.py": """
+            import os
+            v = os.environ.get("TRANSFERIA_TPU_FOO", "1")
+        """}, readme="| `TRANSFERIA_TPU_FOO` | 1 | a knob |\n")
+        assert len(found) == 1
+        assert "read directly" in found[0].message
+        assert "runtime.knobs" in found[0].message
+
+    def test_getenv_and_subscript_read_flagged(self, tmp_path):
+        found = self._run(tmp_path, {"transferia_tpu/a.py": """
+            import os
+            v = os.getenv("TRANSFERIA_TPU_FOO")
+            w = os.environ["TRANSFERIA_TPU_FOO"]
+        """}, readme="TRANSFERIA_TPU_FOO\n")
+        assert len(found) == 2
+
+    def test_environ_write_is_not_a_read(self, tmp_path):
+        found = self._run(tmp_path, {"transferia_tpu/a.py": """
+            import os
+            os.environ["TRANSFERIA_TPU_SET"] = "1"
+            del os.environ["TRANSFERIA_TPU_SET"]
+        """})
+        assert found == []
+
+    def test_registry_helper_documented_is_clean(self, tmp_path):
+        found = self._run(tmp_path, {"transferia_tpu/a.py": """
+            from transferia_tpu.runtime import knobs
+            v = knobs.env_int("TRANSFERIA_TPU_ROWS", 4)
+        """}, readme="| `TRANSFERIA_TPU_ROWS` | 4 | rows |\n")
+        assert found == []
+
+    def test_undocumented_knob_flagged_once(self, tmp_path):
+        found = self._run(tmp_path, {"transferia_tpu/a.py": """
+            from transferia_tpu.runtime import knobs
+            v = knobs.env_int("TRANSFERIA_TPU_HIDDEN", 4)
+            w = knobs.env_float("TRANSFERIA_TPU_HIDDEN", 4.0)
+        """})
+        assert len(found) == 1
+        assert "not documented" in found[0].message
+        assert "TRANSFERIA_TPU_HIDDEN" in found[0].message
+
+    def test_dead_doc_row_flagged(self, tmp_path):
+        found = self._run(tmp_path, {"transferia_tpu/a.py": """
+            from transferia_tpu.runtime import knobs
+            v = knobs.env_int("TRANSFERIA_TPU_LIVE", 4)
+        """}, readme="| `TRANSFERIA_TPU_LIVE` | 4 | live |\n"
+                     "| `TRANSFERIA_TPU_GONE` | 0 | removed |\n")
+        assert len(found) == 1
+        f = found[0]
+        assert f.path == "README.md" and f.line == 2
+        assert "dead doc row" in f.message
+
+    def test_env_constant_indirection_resolves(self, tmp_path):
+        found = self._run(tmp_path, {"transferia_tpu/a.py": """
+            from transferia_tpu.runtime import knobs
+            ENV_ROWS = "TRANSFERIA_TPU_ROWS2"
+            v = knobs.env_int(ENV_ROWS, 4)
+        """})
+        assert len(found) == 1
+        assert "TRANSFERIA_TPU_ROWS2" in found[0].message
+
+    def test_environ_first_shim_slot_resolves(self, tmp_path):
+        # coordinator.interface-style shim: env_float(environ, key, d)
+        found = self._run(tmp_path, {"transferia_tpu/a.py": """
+            def env_float(environ, key, default):
+                return float(environ.get(key, default))
+
+            def read(environ):
+                return env_float(environ, "TRANSFERIA_TPU_SHIM", 1.0)
+        """}, readme="TRANSFERIA_TPU_SHIM\n")
+        assert found == []
+
+    def test_knobs_module_itself_exempt(self, tmp_path):
+        found = self._run(tmp_path, {
+            "transferia_tpu/runtime/knobs.py": """
+                import os
+                def env_raw(name, default=None):
+                    return os.environ.get(name, default)
+                v = os.environ.get("TRANSFERIA_TPU_BASE", "1")
+            """}, readme="TRANSFERIA_TPU_BASE\n")
+        assert found == []
+
+    def test_suppressed(self, tmp_path):
+        found = self._run(tmp_path, {"transferia_tpu/a.py": """
+            import os
+            v = os.environ.get("TRANSFERIA_TPU_FOO")  # trtpu: ignore[KNB001]
+        """}, readme="TRANSFERIA_TPU_FOO\n")
+        assert found == []
+
+    def test_real_tree_holds_contract(self):
+        result = run_rules(["transferia_tpu"], [KnobRegistryRule()],
+                           root=_repo_root())
+        assert result.findings == [], \
+            [f.format() for f in result.findings]

@@ -408,6 +408,14 @@ class TestLeaderLease:
         b = LeaderLease(cp, queue="q", replica_id="rb")
         assert a.ensure() and not b.ensure()
         time.sleep(0.3)                  # leader dies silently
+        # the lease TTL is stamped at CLAIM time (abstract/ticket.py
+        # claim_in_place), so raising it here hardens only rb's
+        # upcoming steal: ra's already-expired claim stays stealable,
+        # while rb's stolen claim can no longer expire mid-assert on a
+        # slow backend roundtrip (the s3 fake's CAS walk made the
+        # 0.15 s tenure flaky — a lockwatch-armed run showed zero lock
+        # inversions, pure timing)
+        cp.lease_seconds = 30.0
         assert b.ensure()                # standby steals the claim
         # the old leader's renew is (ticket, epoch)-fenced: it observes
         # the loss and demotes instead of resurrecting its claim

@@ -1,0 +1,327 @@
+"""Tests for the runtime lock-order sentinel (runtime/lockwatch.py).
+
+Hand-built two-thread schedules prove inversion detection; the rest
+pins the observed-order DAG learning, reentrant-lock handling, the
+long-hold threshold, blocking-call detection under a lock, the
+Condition protocol over watched locks, and fold idempotence into a
+Metrics registry.
+"""
+
+import threading
+import time
+
+import pytest
+
+from transferia_tpu.runtime import lockwatch
+
+
+@pytest.fixture
+def watch():
+    """A fresh armed watch per test; always disarmed afterwards (the
+    arm patches time.sleep process-wide)."""
+    lockwatch.disarm()
+    w = lockwatch.arm()
+    yield w
+    lockwatch.disarm()
+
+
+# -- arming & lock construction ----------------------------------------------
+
+class TestArming:
+    def test_disarmed_named_lock_is_plain_primitive(self, monkeypatch):
+        monkeypatch.delenv(lockwatch.ENV_LOCKWATCH, raising=False)
+        lockwatch.disarm()
+        lk = lockwatch.named_lock("t.plain")
+        assert not isinstance(lk, lockwatch.WatchedLock)
+        rl = lockwatch.named_lock("t.plain_r", kind="rlock")
+        assert not isinstance(rl, lockwatch.WatchedLock)
+        with lk:
+            pass  # still a working lock
+
+    def test_env_knob_arms_on_first_lock(self, monkeypatch):
+        lockwatch.disarm()
+        monkeypatch.setenv(lockwatch.ENV_LOCKWATCH, "1")
+        try:
+            lk = lockwatch.named_lock("t.env_armed")
+            assert isinstance(lk, lockwatch.WatchedLock)
+            assert lockwatch.is_armed()
+        finally:
+            lockwatch.disarm()
+
+    def test_armed_lock_falls_back_to_delegation_after_disarm(self,
+                                                              watch):
+        lk = lockwatch.named_lock("t.fallback")
+        lockwatch.disarm()
+        with lk:  # no watch: plain delegation, no counters
+            pass
+        assert watch.counters()["acquisitions"] == 0
+
+    def test_disarm_restores_time_sleep(self):
+        lockwatch.disarm()
+        orig = time.sleep
+        lockwatch.arm()
+        assert time.sleep is not orig
+        lockwatch.disarm()
+        assert time.sleep is orig
+
+
+# -- inversion detection -------------------------------------------------------
+
+class TestInversionDetection:
+    def test_single_thread_abba_inversion(self, watch):
+        a = lockwatch.named_lock("t.a")
+        b = lockwatch.named_lock("t.b")
+        with a:
+            with b:  # learns a -> b
+                pass
+        with b:
+            with a:  # reverse: inversion
+                pass
+        assert watch.counters()["inversions"] == 1
+        (inv,) = watch.inversions()
+        assert inv["locks"] == ["t.a", "t.b"]
+        assert inv["first"]["order"] == ["t.a", "t.b"]
+        assert inv["second"]["order"] == ["t.b", "t.a"]
+        for side in ("first", "second"):
+            assert ":" in inv[side]["held_site"]
+            assert ":" in inv[side]["acquire_site"]
+        assert inv["stack"]  # full stack captured on the finding
+
+    def test_two_thread_schedule_inversion(self, watch):
+        a = lockwatch.named_lock("t2.a")
+        b = lockwatch.named_lock("t2.b")
+
+        def fwd():
+            with a:
+                with b:
+                    pass
+
+        def rev():
+            with b:
+                with a:
+                    pass
+
+        t1 = threading.Thread(target=fwd, daemon=True)
+        t1.start()
+        t1.join()
+        t2 = threading.Thread(target=rev, daemon=True)
+        t2.start()
+        t2.join()
+        assert watch.counters()["inversions"] == 1
+        (inv,) = watch.inversions()
+        assert inv["locks"] == ["t2.a", "t2.b"]
+
+    def test_inversion_deduplicated_per_pair(self, watch):
+        a = lockwatch.named_lock("t3.a")
+        b = lockwatch.named_lock("t3.b")
+        with a:
+            with b:
+                pass
+        for _ in range(3):
+            with b:
+                with a:
+                    pass
+        # the pair is reported once: after the first inversion both
+        # directions are in the DAG and the finding key dedups the pair
+        assert watch.counters()["inversions"] == 1
+        assert len(watch.inversions()) == 1
+
+    def test_consistent_order_never_inverts(self, watch):
+        a = lockwatch.named_lock("t4.a")
+        b = lockwatch.named_lock("t4.b")
+        for _ in range(10):
+            with a:
+                with b:
+                    pass
+        assert watch.counters()["inversions"] == 0
+        assert watch.edge_count() == 1
+
+
+# -- DAG learning --------------------------------------------------------------
+
+class TestDagLearning:
+    def test_edges_accumulate_per_held_pair(self, watch):
+        a = lockwatch.named_lock("d.a")
+        b = lockwatch.named_lock("d.b")
+        c = lockwatch.named_lock("d.c")
+        with a:
+            with b:
+                with c:  # edges: a->b, a->c, b->c
+                    pass
+        assert watch.edge_count() == 3
+        snap = watch.snapshot()
+        assert snap["order_edges"] == 3
+
+    def test_reentrant_rlock_reacquire_records_no_edge(self, watch):
+        r = lockwatch.named_lock("d.r", kind="rlock")
+        with r:
+            with r:  # reentrant: no self-edge, one acquisition
+                pass
+        assert watch.edge_count() == 0
+        assert watch.counters()["acquisitions"] == 1
+        assert watch.counters()["inversions"] == 0
+
+    def test_held_stack_empties_after_release(self, watch):
+        a = lockwatch.named_lock("d.h")
+        with a:
+            assert watch.held_names() == ["d.h"]
+        assert watch.held_names() == []
+
+
+# -- long holds & blocking calls -----------------------------------------------
+
+class TestHoldAndBlocking:
+    def test_long_hold_flagged_at_release(self):
+        lockwatch.disarm()
+        watch = lockwatch.arm(hold_ms=1.0)
+        try:
+            a = lockwatch.named_lock("h.slow")
+            with a:
+                time.sleep(0.02)
+            assert watch.counters()["long_holds"] == 1
+            (f,) = watch.findings("long_hold")
+            assert f["lock"] == "h.slow"
+            assert f["held_ms"] > f["threshold_ms"] == 1.0
+        finally:
+            lockwatch.disarm()
+
+    def test_fast_hold_not_flagged(self, watch):
+        a = lockwatch.named_lock("h.fast")
+        with a:
+            pass
+        assert watch.counters()["long_holds"] == 0
+
+    def test_sleep_under_lock_is_blocking_finding(self, watch):
+        a = lockwatch.named_lock("h.blk")
+        with a:
+            time.sleep(0)  # patched while armed
+        assert watch.counters()["blocking_in_lock"] == 1
+        (f,) = watch.findings("blocking_in_lock")
+        assert f["call"] == "time.sleep"
+        assert f["lock"] == "h.blk"
+        assert f["locks_held"] == ["h.blk"]
+
+    def test_sleep_outside_lock_is_fine(self, watch):
+        time.sleep(0)
+        assert watch.counters()["blocking_in_lock"] == 0
+
+    def test_explicit_note_blocking_hook(self, watch):
+        a = lockwatch.named_lock("h.hook")
+        with a:
+            lockwatch.note_blocking("socket.recv")
+        (f,) = watch.findings("blocking_in_lock")
+        assert f["call"] == "socket.recv"
+
+
+# -- Condition protocol ---------------------------------------------------------
+
+class TestConditionOverWatchedLock:
+    def test_wait_notify_keeps_held_stack_consistent(self, watch):
+        lk = lockwatch.named_lock("c.lock")
+        cond = threading.Condition(lk)
+        ready = threading.Event()
+        state = {"woke": False}
+
+        def waiter():
+            with cond:
+                ready.set()
+                cond.wait(timeout=5.0)
+                # wait() reacquired: the held stack must agree
+                state["held_in_wait"] = list(watch.held_names())
+                state["woke"] = True
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        assert ready.wait(5.0)
+        with cond:
+            cond.notify()
+        t.join(5.0)
+        assert state["woke"]
+        assert state["held_in_wait"] == ["c.lock"]
+        assert watch.held_names() == []  # main thread released
+        assert watch.counters()["inversions"] == 0
+
+    def test_wait_releases_for_other_thread_acquire_order(self, watch):
+        # the classic sentinel trap: cond.wait() must POP the held
+        # stack, else the notifier's acquire looks like an inversion
+        lk = lockwatch.named_lock("c2.lock")
+        other = lockwatch.named_lock("c2.other")
+        cond = threading.Condition(lk)
+        ready = threading.Event()
+
+        def waiter():
+            with cond:
+                ready.set()
+                cond.wait(timeout=5.0)
+
+        t = threading.Thread(target=waiter, daemon=True)
+        t.start()
+        assert ready.wait(5.0)
+        with other:
+            with cond:
+                cond.notify()
+        t.join(5.0)
+        assert watch.counters()["inversions"] == 0
+
+
+# -- fold & snapshot -------------------------------------------------------------
+
+class TestFoldAndSnapshot:
+    def test_fold_into_metrics_publishes_deltas_once(self, watch):
+        from transferia_tpu.stats.registry import Metrics
+
+        a = lockwatch.named_lock("f.a")
+        b = lockwatch.named_lock("f.b")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        metrics = Metrics()
+        d1 = watch.fold_into(metrics)
+        assert d1["acquisitions"] == 4
+        assert d1["inversions"] == 1
+        d2 = watch.fold_into(metrics)  # idempotent: nothing new
+        assert all(v == 0 for v in d2.values())
+        assert metrics.value("lockwatch_acquisitions") == 4
+        assert metrics.value("lockwatch_inversions") == 1
+        with a:
+            pass
+        d3 = watch.fold_into(metrics)
+        assert d3["acquisitions"] == 1
+        assert metrics.value("lockwatch_acquisitions") == 5
+
+    def test_module_fold_noop_when_disarmed(self):
+        from transferia_tpu.stats.registry import Metrics
+
+        lockwatch.disarm()
+        assert lockwatch.fold_into(Metrics()) == {}
+
+    def test_snapshot_shape_for_obs_segments(self, watch):
+        a = lockwatch.named_lock("s.a")
+        b = lockwatch.named_lock("s.b")
+        with a:
+            with b:
+                pass
+        with b:
+            with a:
+                pass
+        snap = watch.snapshot()
+        assert set(snap) == {"counters", "order_edges", "findings"}
+        assert snap["counters"]["inversions"] == 1
+        (f,) = snap["findings"]
+        # stacks are stripped from segment payloads (size-bounded wire)
+        assert f["stack"] is None
+        assert f["kind"] == "lock_order_inversion"
+
+    def test_finding_cap_bounds_memory(self):
+        lockwatch.disarm()
+        watch = lockwatch.arm(hold_ms=-1.0)  # every release "long"
+        try:
+            for i in range(lockwatch.MAX_FINDINGS + 50):
+                with lockwatch.named_lock(f"cap.{i}"):
+                    pass
+            assert len(watch.findings()) <= lockwatch.MAX_FINDINGS
+        finally:
+            lockwatch.disarm()

@@ -10,21 +10,29 @@ from transferia_tpu.analysis.rules.exception_hygiene import (
 from transferia_tpu.analysis.rules.failpoint_contract import (
     FailpointContractRule,
 )
+from transferia_tpu.analysis.rules.knob_registry import KnobRegistryRule
 from transferia_tpu.analysis.rules.lock_discipline import LockDisciplineRule
+from transferia_tpu.analysis.rules.lock_order import LockOrderRule
 from transferia_tpu.analysis.rules.registry_contract import (
     RegistryContractRule,
 )
 from transferia_tpu.analysis.rules.resource_safety import ResourceSafetyRule
+from transferia_tpu.analysis.rules.thread_lifecycle import (
+    ThreadLifecycleRule,
+)
 from transferia_tpu.analysis.rules.trace_contract import TraceContractRule
 
 ALL_RULE_CLASSES: tuple[type, ...] = (
     DevicePurityRule,
     LockDisciplineRule,
+    LockOrderRule,
+    ThreadLifecycleRule,
     ExceptionHygieneRule,
     ResourceSafetyRule,
     RegistryContractRule,
     FailpointContractRule,
     TraceContractRule,
+    KnobRegistryRule,
 )
 
 
@@ -36,7 +44,10 @@ __all__ = [
     "ALL_RULE_CLASSES",
     "default_rules",
     "DevicePurityRule",
+    "KnobRegistryRule",
     "LockDisciplineRule",
+    "LockOrderRule",
+    "ThreadLifecycleRule",
     "ExceptionHygieneRule",
     "FailpointContractRule",
     "ResourceSafetyRule",

@@ -20,7 +20,7 @@ from typing import Optional, Sequence
 
 from transferia_tpu.analysis.engine import Finding, Rule
 
-_LOCK_CTORS = {"Lock", "RLock", "Condition"}
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "named_lock"}
 _INIT_METHODS = {"__init__", "__new__", "__del__", "__init_subclass__"}
 _BLOCKING_SIMPLE = {"time.sleep", "socket.create_connection",
                     "urllib.request.urlopen", "recv_exact"}

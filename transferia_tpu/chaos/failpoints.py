@@ -62,6 +62,7 @@ from transferia_tpu.abstract.errors import (
 )
 from transferia_tpu.chaos.sites import site_names
 
+from transferia_tpu.runtime import knobs
 ENV_SPEC = "TRANSFERIA_TPU_FAILPOINTS"
 ENV_SEED = "TRANSFERIA_TPU_FAILPOINTS_SEED"
 
@@ -272,10 +273,10 @@ def active(spec: str, seed: int = 0):
 
 def activate_from_env(environ=os.environ) -> bool:
     """Arm from TRANSFERIA_TPU_FAILPOINTS; returns True when armed."""
-    spec = environ.get(ENV_SPEC, "")
+    spec = knobs.env_str(ENV_SPEC, "", environ=environ)
     if not spec:
         return False
-    configure(spec, int(environ.get(ENV_SEED, "0") or "0"))
+    configure(spec, knobs.env_int(ENV_SEED, 0, environ=environ))
     return True
 
 

@@ -1519,6 +1519,67 @@ def run_fleet_distributed_trial(trial: int, seed: int, rows: int,
                         first["logs"]["admission"]])
 
 
+# -- lock_order mode ----------------------------------------------------------
+#
+# The fleet_distributed gauntlet re-run with the runtime lock-order
+# sentinel armed (runtime/lockwatch.py): every named production lock
+# created during the scenario — scheduler, backpressure latch,
+# coordinator maps and per-op locks, obs exporter, ledger — becomes a
+# watched lock recording per-thread acquisition order.  The acceptance
+# bar is ZERO lock-order inversions per seed on top of the mode's own
+# exactly-once + byte-identical-replay audits.  Long holds and
+# blocking-calls-under-a-lock are timing-dependent under CI load, so
+# they are logged and folded into metrics but do not fail the trial.
+
+
+def run_lock_order_trial(trial: int, seed: int, rows: int,
+                         reference: DeliveryReference,
+                         spec: Optional[str] = None,
+                         metrics=None) -> TrialResult:
+    from transferia_tpu.runtime import lockwatch
+
+    already_armed = lockwatch.active()
+    watch = lockwatch.arm()
+    try:
+        result = run_fleet_distributed_trial(trial, seed, rows,
+                                             reference, spec=spec)
+    finally:
+        if already_armed is None:
+            lockwatch.disarm()
+    result.mode = "lock_order"
+    counters = watch.counters()
+    for inv in watch.inversions():
+        first, second = inv["first"], inv["second"]
+        result.verdict.violations.append(Violation(
+            "lock-order", (
+                f"inversion between {inv['locks'][0]} and "
+                f"{inv['locks'][1]} on thread {inv['thread']}: "
+                f"order {' -> '.join(first['order'])} established at "
+                f"{first['held_site']} -> {first['acquire_site']}, "
+                f"reversed {' -> '.join(second['order'])} at "
+                f"{second['held_site']} -> {second['acquire_site']}")))
+    if result.verdict.violations:
+        result.verdict.passed = False
+    for f in watch.findings("long_hold"):
+        logger.info("chaos lock_order trial %d: long hold on %s "
+                    "(%.1f ms > %.1f ms) acquired at %s", trial,
+                    f["lock"], f["held_ms"], f["threshold_ms"],
+                    f["acquire_site"])
+    for f in watch.findings("blocking_in_lock"):
+        logger.info("chaos lock_order trial %d: blocking call %s under "
+                    "%s at %s", trial, f["call"], f["lock"],
+                    f["call_site"])
+    logger.info(
+        "chaos lock_order trial %d: %d acquisitions over %d order "
+        "edges, %d inversion(s), %d long hold(s), %d blocking call(s) "
+        "under a lock", trial, counters["acquisitions"],
+        watch.edge_count(), counters["inversions"],
+        counters["long_holds"], counters["blocking_in_lock"])
+    if metrics is not None:
+        watch.fold_into(metrics)
+    return result
+
+
 # -- replication mode --------------------------------------------------------
 
 _REPL_PARSER = {"json": {
@@ -1686,8 +1747,8 @@ def run_trials(trials: int = 5, seed: int = 7, mode: str = "both",
         modes = ("snapshot", "replication")
     elif mode == "all":
         modes = ("snapshot", "replication", "worker_crash",
-                 "scheduler_kill", "fleet_distributed", "arrow_ipc",
-                 "exactly_once")
+                 "scheduler_kill", "fleet_distributed", "lock_order",
+                 "arrow_ipc", "exactly_once")
     else:
         modes = (mode,)
     if "arrow_ipc" in modes:
@@ -1727,6 +1788,14 @@ def run_trials(trials: int = 5, seed: int = 7, mode: str = "both",
                                                 spec=spec)
                 report.results.append(r)
                 logger.info("chaos fleet_distributed trial %d: %s", t,
+                            r.verdict.summary().splitlines()[0])
+        if "lock_order" in modes:
+            ref = _snapshot_reference(min(rows, FLEET_DIST_ROWS))
+            for t in range(trials):
+                r = run_lock_order_trial(t, seed, rows, ref, spec=spec,
+                                         metrics=metrics)
+                report.results.append(r)
+                logger.info("chaos lock_order trial %d: %s", t,
                             r.verdict.summary().splitlines()[0])
         if "exactly_once" in modes:
             from transferia_tpu.chaos import wire_backends

@@ -174,8 +174,8 @@ def build_parser() -> argparse.ArgumentParser:
     cha.add_argument("--mode", default="both",
                      choices=["snapshot", "replication", "worker_crash",
                               "scheduler_kill", "fleet_distributed",
-                              "arrow_ipc", "exactly_once", "both",
-                              "all"],
+                              "lock_order", "arrow_ipc", "exactly_once",
+                              "both", "all"],
                      help="worker_crash kills a sharded worker mid-part "
                           "and audits lease reclamation + epoch "
                           "fencing; scheduler_kill kills a fleet "
@@ -188,7 +188,12 @@ def build_parser() -> argparse.ArgumentParser:
                           "resume-from-committed-parts, exactly-once "
                           "delivery, and byte-identical replay of the "
                           "admission/claim/preempt logs across two "
-                          "runs of one seed); arrow_ipc audits the "
+                          "runs of one seed); lock_order re-runs the "
+                          "fleet_distributed gauntlet with the "
+                          "runtime lock-order sentinel armed "
+                          "(runtime/lockwatch.py) and additionally "
+                          "requires ZERO lock-order inversions per "
+                          "seed; arrow_ipc audits the "
                           "zero-copy interchange wire (arrow_ipc "
                           "source → memory); exactly_once audits the "
                           "staged two-phase commit (zero duplicate/"
@@ -196,8 +201,8 @@ def build_parser() -> argparse.ArgumentParser:
                           "kills and zombie replay, per capable sink "
                           "backend); both = snapshot+replication; all "
                           "adds worker_crash + scheduler_kill + "
-                          "fleet_distributed + arrow_ipc + "
-                          "exactly_once")
+                          "fleet_distributed + lock_order + arrow_ipc "
+                          "+ exactly_once")
     cha.add_argument("--rows", type=int, default=0,
                      help="snapshot source rows (default 4096)")
     cha.add_argument("--messages", type=int, default=0,
@@ -352,9 +357,9 @@ def _setup(args) -> None:
         level=getattr(logging, args.log_level.upper()),
         format="%(asctime)s %(levelname).1s %(name)s: %(message)s",
     )
-    import os as _os
+    from transferia_tpu.runtime import knobs
 
-    if _os.environ.get("TRANSFERIA_TPU_TRACE", "") not in (
+    if knobs.env_str("TRANSFERIA_TPU_TRACE", "") not in (
             "", "0", "false", "no"):
         # headless span capture: worker processes in a fleet can't be
         # handed a --trace flag per run, but their obs segments export

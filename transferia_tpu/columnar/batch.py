@@ -32,6 +32,7 @@ from transferia_tpu.abstract.schema import (
     TableID,
     TableSchema,
 )
+from transferia_tpu.runtime import lockwatch
 
 _BUCKETS = (256, 1024, 4096, 16384, 65536, 262144, 1048576)
 _INT32_MAX = 2**31 - 1
@@ -209,7 +210,7 @@ class DictPool:
 # concurrent part threads.
 _POOL_CACHE: dict = {}
 _POOL_CACHE_MAX = 64
-_POOL_CACHE_LOCK = threading.Lock()
+_POOL_CACHE_LOCK = lockwatch.named_lock("pool.intern")
 
 # Content-interned pools (intern_pool): every producer that re-creates a
 # value pool with identical bytes — the native parquet reader decoding
@@ -224,9 +225,9 @@ _INTERN_CACHE_MAX = 128
 def pool_sharing_enabled() -> bool:
     """TRANSFERIA_TPU_POOL_SHARING=0 disables content interning (each
     producer keeps private pools — the pre-sharing wire)."""
-    import os
+    from transferia_tpu.runtime import knobs
 
-    return os.environ.get("TRANSFERIA_TPU_POOL_SHARING", "1") != "0"
+    return knobs.env_str("TRANSFERIA_TPU_POOL_SHARING", "1") != "0"
 
 
 def _pool_digest(values_data: np.ndarray, values_offsets: np.ndarray,

@@ -11,6 +11,7 @@ from typing import Any, Optional
 
 from transferia_tpu.abstract.table import OperationTablePart
 from transferia_tpu.abstract.ticket import FleetTicket
+from transferia_tpu.runtime import knobs
 
 # Part-claim lease TTL (seconds).  A claim is a lease: the holding worker
 # renews it from its heartbeat thread (SnapshotLoader), and an expired
@@ -22,12 +23,10 @@ ENV_LEASE_SECONDS = "TRANSFERIA_TPU_LEASE_SECONDS"
 
 
 def env_float(environ, key: str, default: float) -> float:
-    """Float env knob with garbage falling back to the default (shared
-    by the lease TTL here and the SnapshotTuning knobs)."""
-    try:
-        return float(environ.get(key, default))
-    except (TypeError, ValueError):
-        return default
+    """Float env knob with garbage falling back to the default (compat
+    shim kept for the lease TTL and SnapshotTuning call sites; the
+    registry itself lives in runtime/knobs.py)."""
+    return knobs.env_float(key, default, environ=environ)
 
 
 def default_lease_seconds(environ=os.environ) -> float:

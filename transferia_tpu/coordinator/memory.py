@@ -38,6 +38,7 @@ from transferia_tpu.coordinator.interface import (
     default_lease_seconds,
     lease_expired,
 )
+from transferia_tpu.runtime import lockwatch
 from transferia_tpu.stats import trace
 
 # bounded health history: long operations heartbeat for hours — keep the
@@ -54,7 +55,7 @@ class _OpState:
     __slots__ = ("lock", "parts", "state")
 
     def __init__(self):
-        self.lock = threading.RLock()
+        self.lock = lockwatch.named_lock("coordinator.op", kind="rlock")
         self.parts: list[OperationTablePart] = []
         self.state: dict[str, Any] = {}
 
@@ -67,7 +68,8 @@ class _QueueState:
     __slots__ = ("lock", "tickets", "next_seq")
 
     def __init__(self):
-        self.lock = threading.RLock()
+        self.lock = lockwatch.named_lock("coordinator.queue",
+                                         kind="rlock")
         self.tickets: list[dict] = []
         self.next_seq = 0
 
@@ -75,27 +77,30 @@ class _QueueState:
 class MemoryCoordinator(Coordinator):
     def __init__(self, lease_seconds: Optional[float] = None):
         # transfer-scoped maps (status / state KV / messages)
-        self._lock = threading.RLock()
+        self._lock = lockwatch.named_lock("coordinator.transfers",
+                                          kind="rlock")
         self._status: dict[str, TransferStatus] = {}
         self._state: dict[str, dict[str, Any]] = {}
         self._messages: dict[str, list[tuple[str, str]]] = {}
         # operation-scoped state: per-operation locks
-        self._ops_lock = threading.Lock()
+        self._ops_lock = lockwatch.named_lock("coordinator.ops_map")
         self._ops: dict[str, _OpState] = {}
         # fleet admission queues: per-queue locks, same pattern
-        self._queues_lock = threading.Lock()
+        self._queues_lock = lockwatch.named_lock(
+            "coordinator.queues_map")
         self._queues: dict[str, _QueueState] = {}
         self.lease_seconds = (default_lease_seconds()
                               if lease_seconds is None else lease_seconds)
         # rolling window of (scope, worker, payload) tuples; latest
         # report per (scope, worker) kept separately for readers
-        self._health_lock = threading.Lock()
+        self._health_lock = lockwatch.named_lock(
+            "coordinator.health")
         self.health_reports: deque = deque(maxlen=HEALTH_HISTORY_LIMIT)
         self._health_latest: dict[tuple[str, int], dict] = {}
         # observability segments: scope -> {(worker, seq): segment};
         # bounded at put time (per-worker trim) so a forgotten GC can't
         # grow an in-process coordinator without limit
-        self._obs_lock = threading.Lock()
+        self._obs_lock = lockwatch.named_lock("coordinator.obs")
         self._obs: dict[str, dict[tuple[str, int], dict]] = {}
 
     def _op(self, operation_id: str) -> _OpState:

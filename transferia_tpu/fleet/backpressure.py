@@ -26,6 +26,7 @@ import threading
 from dataclasses import dataclass, field
 from typing import Callable, Optional
 
+from transferia_tpu.runtime import lockwatch
 from transferia_tpu.stats.registry import Metrics
 
 logger = logging.getLogger(__name__)
@@ -95,7 +96,7 @@ class BackpressureController:
                  probe: Optional[Callable[[str], float]] = None):
         self.metrics = metrics or Metrics()
         self._probe = probe
-        self._lock = threading.Lock()
+        self._lock = lockwatch.named_lock("fleet.backpressure")
         self._states = [SignalState(s) for s in signals]
         # tick listeners: called (outside the signal lock) on every
         # overloaded() evaluation — the scheduler hangs its gauge

@@ -60,6 +60,7 @@ from typing import Any, Callable, Optional
 from transferia_tpu.abstract.errors import is_worker_kill
 from transferia_tpu.chaos.failpoints import failpoint
 from transferia_tpu.fleet.backpressure import BackpressureController
+from transferia_tpu.runtime import lockwatch
 from transferia_tpu.stats import hdr, trace
 from transferia_tpu.stats.ledger import LEDGER
 from transferia_tpu.stats.registry import FleetStats, Metrics
@@ -229,7 +230,7 @@ class FleetScheduler:
         self._n_workers = workers
         self._lanes_per_worker = max_inflight_per_worker
         self._tenant_weights = dict(tenant_weights or {})
-        self._lock = threading.Lock()
+        self._lock = lockwatch.named_lock("fleet.scheduler")
         self._cond = threading.Condition(self._lock)
         self._tenants: dict[str, _Tenant] = {}
         self._active: deque[str] = deque()   # tenants with queued work

@@ -34,7 +34,6 @@ Every buffer adoption is tallied in `telemetry.TELEMETRY`
 from __future__ import annotations
 
 import json
-import os
 from typing import Any, Optional
 
 import numpy as np
@@ -53,6 +52,7 @@ from transferia_tpu.columnar.batch import (
 )
 from transferia_tpu.interchange._pyarrow import pyarrow
 from transferia_tpu.interchange.telemetry import TELEMETRY
+from transferia_tpu.runtime import knobs
 
 SCHEMA_KEY = b"trtpu:schema"
 TABLE_KEY = b"trtpu:table"
@@ -74,7 +74,7 @@ def encoded_wire_enabled() -> bool:
     record batches."""
     global _encoded_wire_cached
     if _encoded_wire_cached is None:
-        _encoded_wire_cached = os.environ.get(
+        _encoded_wire_cached = knobs.env_str(
             "TRANSFERIA_TPU_ENCODED_FLIGHT", "1") != "0"
     return _encoded_wire_cached
 

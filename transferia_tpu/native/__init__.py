@@ -11,9 +11,12 @@ from __future__ import annotations
 import ctypes
 import logging
 import os
+
 import pathlib
 import threading
 from typing import Optional
+
+from transferia_tpu.runtime import knobs
 
 logger = logging.getLogger(__name__)
 
@@ -214,7 +217,7 @@ def lib() -> Optional[ctypes.CDLL]:
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if os.environ.get("TRANSFERIA_TPU_NO_NATIVE") == "1":
+        if knobs.env_str("TRANSFERIA_TPU_NO_NATIVE", "") == "1":
             return None
         if not build():  # no-op when the .so is newer than the source
             return None

@@ -44,6 +44,7 @@ from typing import Optional
 
 import numpy as np
 
+from transferia_tpu.runtime import knobs
 from transferia_tpu.stats import trace
 from transferia_tpu.stats.trace import TELEMETRY
 
@@ -73,11 +74,8 @@ def for_frame() -> int:
     compiled decode."""
     global _for_frame_cached
     if _for_frame_cached is None:
-        env = os.environ.get("TRANSFERIA_TPU_FOR_FRAME")
-        try:
-            _for_frame_cached = max(0, int(env)) if env else 256
-        except ValueError:
-            _for_frame_cached = 256
+        _for_frame_cached = max(
+            0, knobs.env_int("TRANSFERIA_TPU_FOR_FRAME", 256))
     return _for_frame_cached
 
 
@@ -91,7 +89,7 @@ def dispatch_encoding() -> str:
     """auto (encode whenever it shrinks, default) | raw."""
     global _mode_cached
     if _mode_cached is None:
-        mode = os.environ.get(
+        mode = knobs.env_str(
             "TRANSFERIA_TPU_DISPATCH_ENCODING", "auto").lower()
         _mode_cached = mode if mode in ("auto", "raw") else "auto"
     return _mode_cached

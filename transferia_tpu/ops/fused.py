@@ -31,6 +31,7 @@ import jax.numpy as jnp
 
 from transferia_tpu.columnar.batch import bucket_rows
 from transferia_tpu.ops.decode import pack_mask_words
+from transferia_tpu.runtime import knobs
 from transferia_tpu.ops.dispatch import (
     decode_pred_device,
     encode_pred_column,
@@ -62,9 +63,7 @@ def _chunk_rows() -> int:
     """
     global _chunk_rows_cached
     if _chunk_rows_cached is None:
-        import os
-
-        env = os.environ.get("TRANSFERIA_TPU_CHUNK_ROWS")
+        env = knobs.env_raw("TRANSFERIA_TPU_CHUNK_ROWS")
         if env is not None:
             _chunk_rows_cached = max(0, int(env))
         else:
@@ -93,15 +92,7 @@ def _dispatch_depth() -> int:
     """Launches kept in flight by the pipelined path (H2D of chunk g+1
     staged while chunk g computes and g-1 drains).
     TRANSFERIA_TPU_DISPATCH_DEPTH overrides; floor 1."""
-    import os
-
-    env = os.environ.get("TRANSFERIA_TPU_DISPATCH_DEPTH")
-    if env is not None:
-        try:
-            return max(1, int(env))
-        except ValueError:
-            pass
-    return 2
+    return max(1, knobs.env_int("TRANSFERIA_TPU_DISPATCH_DEPTH", 2))
 
 
 def _pallas_pack_enabled() -> bool:
@@ -113,9 +104,7 @@ def _pallas_pack_enabled() -> bool:
     PCIe-attached devices, but costs an extra launch — through a
     high-latency tunnel the host C++ pack + padded H2D wins.
     """
-    import os
-
-    if os.environ.get("TRANSFERIA_TPU_PALLAS_PACK") != "1":
+    if knobs.env_str("TRANSFERIA_TPU_PALLAS_PACK", "") != "1":
         return False
     try:
         import jax

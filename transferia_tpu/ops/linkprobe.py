@@ -24,13 +24,14 @@ returned without measuring.
 from __future__ import annotations
 
 import logging
-import os
 import threading
 import time
 from dataclasses import dataclass
 from typing import Optional
 
 import numpy as np
+
+from transferia_tpu.runtime import knobs
 
 
 @dataclass(frozen=True)
@@ -68,7 +69,7 @@ _PROBE_BYTES = 4 << 20
 
 
 def _parse_env(backend: str) -> Optional[LinkProfile]:
-    env = os.environ.get("TRANSFERIA_TPU_LINK")
+    env = knobs.env_raw("TRANSFERIA_TPU_LINK")
     if not env:
         return None
     try:
@@ -131,13 +132,9 @@ _degraded_reads = 0
 
 
 def _reprobe_every() -> int:
-    env = os.environ.get("TRANSFERIA_TPU_LINK_REPROBE")
-    if env is not None:
-        try:
-            return max(0, int(env))  # 0 disables re-probing
-        except ValueError:
-            pass
-    return _REPROBE_DEFAULT
+    # 0 disables re-probing
+    return max(0, knobs.env_int("TRANSFERIA_TPU_LINK_REPROBE",
+                                _REPROBE_DEFAULT))
 
 
 def probe_link(force: bool = False) -> LinkProfile:

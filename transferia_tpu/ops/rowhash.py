@@ -423,11 +423,10 @@ def _device_keys_requested(environ=None) -> bool:
     batches already ride the device — fused chains, device
     fingerprinting — so codes/blocks are hot and the link cost is
     amortized by the reduction plane)."""
-    import os as _os
+    from transferia_tpu.runtime import knobs
 
-    env = _os.environ if environ is None else environ
-    return str(env.get("TRANSFERIA_TPU_DEDUP_KEYS", "")).lower() == \
-        "device"
+    return knobs.env_str("TRANSFERIA_TPU_DEDUP_KEYS", "",
+                         environ=environ).lower() == "device"
 
 
 def _batch_device_resident(batch: ColumnBatch) -> bool:

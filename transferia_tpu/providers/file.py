@@ -35,9 +35,11 @@ from transferia_tpu.abstract.interfaces import (
 )
 from transferia_tpu.abstract.kinds import Kind
 from transferia_tpu.abstract.schema import TableID, TableSchema
+
 from transferia_tpu.abstract.table import TableDescription
 from transferia_tpu.columnar.batch import ColumnBatch, arrow_to_table_schema
 from transferia_tpu.models.endpoint import EndpointParams, register_endpoint
+from transferia_tpu.runtime import knobs
 from transferia_tpu.providers.registry import Provider, register_provider
 
 
@@ -112,7 +114,7 @@ class FileStorage(Storage, ShardingStorage, ScanPredicateStorage):
 
     # -- decode-pipeline knob resolution ------------------------------------
     def _decode_threads(self) -> int:
-        env = os.environ.get("TRANSFERIA_TPU_DECODE_THREADS")
+        env = knobs.env_raw("TRANSFERIA_TPU_DECODE_THREADS")
         k = int(env) if env else self.params.decode_threads
         if k <= 0:
             # auto: each upload worker already runs a consumer thread
@@ -125,7 +127,7 @@ class FileStorage(Storage, ShardingStorage, ScanPredicateStorage):
         return max(1, min(8, k))
 
     def _readahead_groups(self) -> int:
-        env = os.environ.get("TRANSFERIA_TPU_READAHEAD_GROUPS")
+        env = knobs.env_raw("TRANSFERIA_TPU_READAHEAD_GROUPS")
         n = int(env) if env else self.params.readahead_groups
         if n < 0:  # auto: overlap decode unless there's a single core
             from transferia_tpu.runtime.limits import effective_cpus

@@ -34,6 +34,7 @@ import numpy as np
 
 from transferia_tpu.abstract.schema import CanonicalType, TableSchema
 from transferia_tpu.columnar.batch import Column, DictEnc, DictPool
+from transferia_tpu.runtime import knobs
 
 logger = logging.getLogger(__name__)
 
@@ -235,7 +236,7 @@ class NativeParquetReader:
              ) -> Optional["NativeParquetReader"]:
         from transferia_tpu.native import lib as native_lib
 
-        if os.environ.get("TRANSFERIA_TPU_NATIVE_PARQUET", "1") == "0":
+        if knobs.env_str("TRANSFERIA_TPU_NATIVE_PARQUET", "1") == "0":
             return None
         cdll = native_lib()
         if cdll is None or not hasattr(cdll, "pq_decode_rowgroup"):

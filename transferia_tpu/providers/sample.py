@@ -31,6 +31,7 @@ from transferia_tpu.abstract.schema import TableID, TableSchema, new_table_schem
 from transferia_tpu.abstract.table import TableDescription
 from transferia_tpu.columnar.batch import Column, ColumnBatch
 from transferia_tpu.models.endpoint import EndpointParams, register_endpoint
+from transferia_tpu.runtime import lockwatch
 from transferia_tpu.providers.registry import Provider, register_provider
 from transferia_tpu.typesystem.rules import register_source_rules
 from transferia_tpu.abstract.schema import CanonicalType
@@ -119,7 +120,7 @@ def _utf8_column(name: str, values: np.ndarray) -> Column:
 # switch must restore the pre-sharing behavior (one stable pool per
 # (preset, column) per process), not regress to a fresh pool per batch
 _DICT_POOLS: dict = {}
-_DICT_POOL_LOCK = threading.Lock()
+_DICT_POOL_LOCK = lockwatch.named_lock("pool.sample_dict")
 
 
 def _shared_pool(key: str, values: list[str]):

@@ -1,5 +1,18 @@
-"""Execution runtimes (reference: pkg/runtime/local/)."""
+"""Execution runtimes (reference: pkg/runtime/local/).
 
-from transferia_tpu.runtime.local import LocalWorker, run_replication
+`local` pulls the whole factory chain (sources, sinks, coordinator), so
+it is imported lazily: leaf modules in this package (`knobs`,
+`lockwatch`) must stay importable from anywhere in the tree without
+dragging the heavy graph in — they are imported by the very modules
+`local` depends on.
+"""
 
 __all__ = ["LocalWorker", "run_replication"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        from transferia_tpu.runtime import local
+
+        return getattr(local, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,118 @@
+"""Central registry for environment knobs (`TRANSFERIA_TPU_*`, `BENCH_*`).
+
+Every env-tunable in the tree reads through one of the helpers here so
+that (a) the full knob surface is enumerable at runtime
+(`registered_knobs()`), and (b) the KNB001 static rule can cross-check
+code against the README knob table: a knob read anywhere else is
+"undocumented plumbing", a README row naming a knob nobody reads is a
+dead doc row.
+
+Helpers read the environment at *call* time (not import time) so tests
+can monkeypatch `os.environ`; each also takes an explicit ``environ``
+mapping for call sites that already thread one through (coordinator
+lease tunables, snapshot tuning).
+
+This module is deliberately a leaf: stdlib imports only.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from dataclasses import dataclass
+from typing import Mapping, Optional
+
+__all__ = [
+    "Knob",
+    "env_bool",
+    "env_float",
+    "env_int",
+    "env_raw",
+    "env_str",
+    "registered_knobs",
+]
+
+
+@dataclass(frozen=True)
+class Knob:
+    """One registered env knob: name, value kind, and its default."""
+
+    name: str
+    kind: str            # str | raw | int | float | bool
+    default: object
+
+
+_REGISTRY: dict[str, Knob] = {}
+_REG_LOCK = threading.Lock()
+
+# strings that read as False for env_bool; anything else non-empty is
+# True (matches the tree's dominant `!= "0"` / kill-switch idiom)
+_FALSY = frozenset({"0", "false", "no", "off"})
+
+
+def _register(name: str, kind: str, default: object) -> None:
+    with _REG_LOCK:
+        if name not in _REGISTRY:
+            _REGISTRY[name] = Knob(name, kind, default)
+
+
+def registered_knobs() -> dict[str, Knob]:
+    """Snapshot of every knob read so far in this process."""
+    with _REG_LOCK:
+        return dict(_REGISTRY)
+
+
+def _lookup(name: str, environ: Optional[Mapping[str, str]]):
+    env = os.environ if environ is None else environ
+    return env.get(name)
+
+
+def env_raw(name: str,
+            environ: Optional[Mapping[str, str]] = None) -> Optional[str]:
+    """The raw value, or None when unset — for knobs whose *presence*
+    is the signal (auto-vs-pinned tri-states like CHUNK_ROWS/LINK)."""
+    _register(name, "raw", None)
+    return _lookup(name, environ)
+
+
+def env_str(name: str, default: str = "",
+            environ: Optional[Mapping[str, str]] = None) -> str:
+    _register(name, "str", default)
+    v = _lookup(name, environ)
+    return default if v is None else v
+
+
+def env_int(name: str, default: int,
+            environ: Optional[Mapping[str, str]] = None) -> int:
+    _register(name, "int", default)
+    v = _lookup(name, environ)
+    if v is None or not str(v).strip():
+        return default
+    try:
+        return int(str(v).strip())
+    except ValueError:
+        return default
+
+
+def env_float(name: str, default: float,
+              environ: Optional[Mapping[str, str]] = None) -> float:
+    _register(name, "float", default)
+    v = _lookup(name, environ)
+    if v is None or not str(v).strip():
+        return default
+    try:
+        return float(str(v).strip())
+    except ValueError:
+        return default
+
+
+def env_bool(name: str, default: bool,
+             environ: Optional[Mapping[str, str]] = None) -> bool:
+    """Kill-switch semantics: "0"/"false"/"no"/"off" (any case) are
+    False, any other non-empty string is True, unset/empty keeps the
+    default."""
+    _register(name, "bool", default)
+    v = _lookup(name, environ)
+    if v is None or not str(v).strip():
+        return default
+    return str(v).strip().lower() not in _FALSY

@@ -63,7 +63,7 @@ from typing import Optional
 
 from transferia_tpu.abstract.errors import is_worker_kill
 from transferia_tpu.chaos.failpoints import failpoint
-from transferia_tpu.coordinator.interface import env_float
+from transferia_tpu.runtime import knobs, lockwatch
 from transferia_tpu.stats import hdr, trace, watermark
 # _INT_FIELDS is the ledger's own exact-vs-rounded field split — the
 # merge's conservation check must agree with it, so share the set
@@ -84,15 +84,12 @@ SEGMENT_VERSION = 1
 
 
 def default_scope(environ=os.environ) -> str:
-    return environ.get(ENV_SCOPE, "") or DEFAULT_SCOPE
+    return knobs.env_str(ENV_SCOPE, "", environ=environ) or DEFAULT_SCOPE
 
 
 def export_enabled(environ=os.environ) -> bool:
-    return environ.get(ENV_EXPORT, "1") not in ("0", "false", "no")
-
-
-def _env_num(name: str, default: float) -> float:
-    return env_float(os.environ, name, default)
+    return knobs.env_str(ENV_EXPORT, "1",
+                         environ=environ) not in ("0", "false", "no")
 
 
 # -- exporter -----------------------------------------------------------------
@@ -124,7 +121,7 @@ class ObsExporter:
         self.enabled = export_enabled() and \
             bool(getattr(coordinator, "supports_obs_segments",
                          lambda: False)())
-        self._lock = threading.Lock()
+        self._lock = lockwatch.named_lock("obs.exporter")
         self._seq = 0
         self._span_mark = 0
         self._last_attempt = 0.0
@@ -138,7 +135,8 @@ class ObsExporter:
     def _build(self, kind: str, seq: int) -> tuple[dict, int]:
         """Assemble one segment (caller holds the lock).  Returns the
         segment and the new span mark to commit on a successful put."""
-        max_spans = int(_env_num(ENV_MAX_SPANS, DEFAULT_MAX_SPANS))
+        max_spans = int(knobs.env_float(ENV_MAX_SPANS,
+                                        DEFAULT_MAX_SPANS))
         # one lock hold for (count, ring): reading them separately
         # would let concurrent appends displace the oldest records of
         # this window out of the tail slice uncounted
@@ -182,6 +180,12 @@ class ObsExporter:
             "hists": hdr.STAGES.snapshot(),
             "watermarks": watermark.WATERMARKS.snapshot(),
         }
+        watch = lockwatch.active()
+        if watch is not None:
+            # cumulative per process, merged latest-per-pid like the
+            # ledger: the fleet pane's "zero inversions" assertion must
+            # survive worker restarts and coalesced exports
+            seg["lockwatch"] = watch.snapshot()
         return seg, total
 
     def export(self, kind: str = "periodic") -> bool:
@@ -202,7 +206,8 @@ class ObsExporter:
         try:
             now = time.monotonic()
             if not final and now - self._last_attempt < \
-                    _env_num(ENV_MIN_INTERVAL, DEFAULT_MIN_INTERVAL):
+                    knobs.env_float(ENV_MIN_INTERVAL,
+                                    DEFAULT_MIN_INTERVAL):
                 return False
             self._last_attempt = now
             seq = self._seq + 1
@@ -398,6 +403,8 @@ def merge_segments(raw_segments: list,
         transfers: dict[str, dict] = {}
         tenants: dict[str, dict] = {}
         telemetry: dict = {}
+        lockwatch_counters: dict = {}
+        lockwatch_findings: list = []
         worker_conservation_ok = True
         for proc, seg in by_pid.items():
             led = seg.get("ledger", {})
@@ -437,6 +444,17 @@ def merge_segments(raw_segments: list,
                 for name, v in tel.items():
                     if isinstance(v, (int, float)):
                         telemetry[name] = telemetry.get(name, 0) + v
+            lw = seg.get("lockwatch", {})
+            if isinstance(lw, dict):
+                for name, v in (lw.get("counters") or {}).items():
+                    if isinstance(v, (int, float)):
+                        lockwatch_counters[name] = \
+                            lockwatch_counters.get(name, 0) + v
+                for f in (lw.get("findings") or [])[:8]:
+                    if isinstance(f, dict) and \
+                            len(lockwatch_findings) < 64:
+                        lockwatch_findings.append(
+                            dict(f, worker=str(seg.get("worker", ""))))
 
         # cross-process conservation: the per-transfer aggregation and
         # the per-process totals are INDEPENDENT sums of the same
@@ -493,6 +511,8 @@ def merge_segments(raw_segments: list,
             "watermarks": merged_wm,
             "freshness": watermark.summarize(merged_wm, now=now),
             "conservation": conservation,
+            "lockwatch": {"counters": lockwatch_counters,
+                          "findings": lockwatch_findings},
         }
 
 

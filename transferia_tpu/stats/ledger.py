@@ -47,6 +47,8 @@ import time
 import weakref
 from typing import NamedTuple, Optional
 
+from transferia_tpu.runtime import knobs, lockwatch
+
 UNATTRIBUTED = "-"
 
 
@@ -119,15 +121,15 @@ class ResourceLedger:
 
     def __init__(self, max_entries: Optional[int] = None):
         if max_entries is None:
-            max_entries = int(os.environ.get(
-                "TRANSFERIA_TPU_LEDGER_ENTRIES", "4096") or "4096")
+            max_entries = knobs.env_int(
+                "TRANSFERIA_TPU_LEDGER_ENTRIES", 4096)
         self.max_entries = max(8, max_entries)
-        self._lock = threading.Lock()
+        self._lock = lockwatch.named_lock("ledger.records")
         # serializes fold_into: concurrent folds into one target would
         # both read the same baseline and double-publish the delta
         # (DeviceTelemetry.fold_into holds its lock for the same
         # reason).  Separate from _lock so folds never stall record_*.
-        self._fold_lock = threading.Lock()
+        self._fold_lock = lockwatch.named_lock("ledger.fold")
         self._entries: dict[LedgerKey, _Entry] = {}
         # insertion order for evictions; a dict for O(1) removal
         self._order: dict[LedgerKey, None] = {}

@@ -235,6 +235,16 @@ class DeviceStats(_Bundle):
         self.lazy_dict_preserved = self.m.counter("lazy_dict_preserved")
         self.dict_flat_materializations = self.m.counter(
             "dict_flat_materializations")
+        # concurrency sentinel (runtime/lockwatch.py fold_into): lock
+        # acquisitions observed under the armed watch, plus the three
+        # finding classes — any nonzero inversion count is a potential
+        # deadlock witnessed at runtime
+        self.lockwatch_acquisitions = self.m.counter(
+            "lockwatch_acquisitions")
+        self.lockwatch_inversions = self.m.counter("lockwatch_inversions")
+        self.lockwatch_long_holds = self.m.counter("lockwatch_long_holds")
+        self.lockwatch_blocking_in_lock = self.m.counter(
+            "lockwatch_blocking_in_lock")
 
 
 class InterchangeStats(_Bundle):

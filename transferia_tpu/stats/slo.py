@@ -63,7 +63,7 @@ from dataclasses import dataclass
 from typing import Optional
 
 from transferia_tpu.chaos.failpoints import failpoint
-from transferia_tpu.coordinator.interface import env_float
+from transferia_tpu.runtime import knobs
 from transferia_tpu.stats import hdr, trace, watermark
 
 FAST_WINDOW_SECONDS = 300.0     # 5m: catches a fresh regression
@@ -99,11 +99,13 @@ def default_objectives(environ=os.environ) -> tuple:
     return (
         SloObjective(
             "replication_lag_p99", stage=watermark.STAGE_LAG,
-            threshold_ms=env_float(environ, ENV_LAG_MS, 5000.0),
+            threshold_ms=knobs.env_float(ENV_LAG_MS, 5000.0,
+                                          environ=environ),
             target=0.99),
         SloObjective(
             "part_upload_p99", stage="part_upload",
-            threshold_ms=env_float(environ, ENV_UPLOAD_MS, 30_000.0),
+            threshold_ms=knobs.env_float(ENV_UPLOAD_MS, 30_000.0,
+                                          environ=environ),
             target=0.99),
         SloObjective(
             "part_commit_availability", kind="availability",
@@ -118,7 +120,7 @@ def objectives_from_env(environ=os.environ) -> tuple:
     """The active objective set: ``TRANSFERIA_TPU_SLO_SPEC`` (JSON list
     of objective dicts) replaces the defaults wholesale; a torn spec
     falls back to the defaults rather than silently disabling SLOs."""
-    raw = environ.get(ENV_SPEC, "")
+    raw = knobs.env_str(ENV_SPEC, "", environ=environ)
     if not raw:
         return default_objectives(environ)
     try:

@@ -50,6 +50,7 @@ from typing import Optional
 
 from transferia_tpu.abstract.errors import is_worker_kill
 from transferia_tpu.chaos.failpoints import failpoint
+from transferia_tpu.runtime import knobs
 from transferia_tpu.stats import hdr, trace
 
 OVERFLOW = "~overflow"
@@ -63,11 +64,8 @@ _ENTRY_FIELDS = ("event_ns", "lsn", "publish_unix")
 
 
 def _max_tables(environ=os.environ) -> int:
-    try:
-        return max(2, int(environ.get(ENV_MAX_TABLES,
-                                      DEFAULT_MAX_TABLES)))
-    except (TypeError, ValueError):
-        return DEFAULT_MAX_TABLES
+    return max(2, knobs.env_int(ENV_MAX_TABLES, DEFAULT_MAX_TABLES,
+                                environ=environ))
 
 
 def batch_event_ns(batch) -> int:

@@ -53,11 +53,13 @@ from transferia_tpu.abstract.interfaces import (
 from transferia_tpu.abstract.schema import TableID
 from transferia_tpu.abstract.table import OperationTablePart, TableDescription
 from transferia_tpu.chaos.failpoints import failpoint
+
 from transferia_tpu.coordinator.interface import (
     Coordinator,
     env_float,
     lease_expired,
 )
+from transferia_tpu.runtime import knobs
 from transferia_tpu.factories import make_async_sink, new_storage
 from transferia_tpu.stats import fleetobs, trace
 from transferia_tpu.stats.ledger import LEDGER
@@ -84,7 +86,8 @@ ENV_STAGED_COMMIT = "TRANSFERIA_TPU_STAGED_COMMIT"
 
 
 def staged_commits_enabled(environ=os.environ) -> bool:
-    return str(environ.get(ENV_STAGED_COMMIT, "auto")).lower() not in (
+    return knobs.env_str(ENV_STAGED_COMMIT, "auto",
+                         environ=environ).lower() not in (
         "off", "0", "false", "no")
 
 
@@ -109,15 +112,17 @@ class SnapshotTuning:
 
     @classmethod
     def from_env(cls, environ=os.environ) -> "SnapshotTuning":
-        pfx = "TRANSFERIA_TPU_SNAPSHOT_"
         return cls(
             secondary_bootstrap_timeout=env_float(
-                environ, pfx + "BOOTSTRAP_TIMEOUT", 600.0),
-            wait_poll=env_float(environ, pfx + "WAIT_POLL", 0.5),
+                environ, "TRANSFERIA_TPU_SNAPSHOT_BOOTSTRAP_TIMEOUT",
+                600.0),
+            wait_poll=env_float(
+                environ, "TRANSFERIA_TPU_SNAPSHOT_WAIT_POLL", 0.5),
             wait_timeout=env_float(
-                environ, pfx + "WAIT_TIMEOUT", 24 * 3600.0),
+                environ, "TRANSFERIA_TPU_SNAPSHOT_WAIT_TIMEOUT",
+                24 * 3600.0),
             stall_timeout=env_float(
-                environ, pfx + "STALL_TIMEOUT", 600.0),
+                environ, "TRANSFERIA_TPU_SNAPSHOT_STALL_TIMEOUT", 600.0),
             heartbeat_interval=env_float(
                 environ, "TRANSFERIA_TPU_HEARTBEAT_INTERVAL", 5.0),
         )

@@ -33,6 +33,7 @@ from transferia_tpu.abstract.schema import (
     TableID,
     TableSchema,
 )
+from transferia_tpu.runtime import knobs
 from transferia_tpu.predicate.ast import TrueNode
 from transferia_tpu.columnar.batch import Column, ColumnBatch
 from transferia_tpu.transform.base import TransformResult, Transformer
@@ -47,7 +48,7 @@ _enabled: Optional[bool] = None
 def device_fusion_enabled() -> bool:
     global _enabled
     if _enabled is None:
-        if os.environ.get("TRANSFERIA_TPU_DEVICE", "").lower() in (
+        if knobs.env_str("TRANSFERIA_TPU_DEVICE", "").lower() in (
                 "0", "off", "false"):
             _enabled = False
         else:
@@ -81,7 +82,8 @@ def placement_mode() -> str:
     """
     global _placement
     if _placement is None:
-        mode = os.environ.get("TRANSFERIA_TPU_PLACEMENT", "auto").lower()
+        mode = knobs.env_str("TRANSFERIA_TPU_PLACEMENT",
+                             "auto").lower()
         _placement = mode if mode in ("auto", "device", "host") else "auto"
     return _placement
 
