@@ -1299,6 +1299,14 @@ TOLERANCE_OVERRIDES = {
     # scheduling-bound; the wire-bytes ratio is the stable signal and
     # gates through wire_bytes-derived fields, not rows/s
     "encoded_wire_rows_per_sec": 0.5,
+    # multi-stream lane: a loopback put+get per curve point, so the
+    # same scheduling noise as encoded_wire applies; the 4-vs-1 ratio
+    # divides two such numbers and on the 1-core bench boxes carries
+    # NO parallelism signal at all (substream threads timeshare one
+    # core) — the lane's contracts gate in-run (pool-once, encoded
+    # shrink) and in tests, not through this ratio
+    "interchange_multistream_rows_per_sec": 0.5,
+    "interchange_stream4_speedup": 1.0,
 }
 
 
@@ -1783,6 +1791,24 @@ def measure_interchange() -> dict:
     return run_interchange_bench(rows=rows, batch_rows=65_536)
 
 
+def _emit_multistream(report: dict) -> None:
+    """The multi-stream lane's own gate lines out of an interchange
+    report: rows/s at 4 substreams on the dict-heavy shape, and the
+    4-vs-1 scaling ratio.  Both carry TOLERANCE_OVERRIDES bands — on a
+    1-core bench box the ratio is pure scheduling noise (substream
+    threads timeshare the core), so the band is wide on purpose."""
+    curve = report.get("stream_curve") or {}
+    four = curve.get("4") or {}
+    if four.get("rows_per_sec"):
+        _emit({"metric": "interchange_multistream_rows_per_sec",
+               "unit": "rows/sec", "value": four["rows_per_sec"],
+               "wire_mb": four.get("wire_mb"),
+               "encoded_wire_ratio": four.get("encoded_wire_ratio")})
+    if report.get("stream4_speedup"):
+        _emit({"metric": "interchange_stream4_speedup", "unit": "x",
+               "value": report["stream4_speedup"]})
+
+
 def measure_fleet() -> dict:
     """`--fleet`: the fleet control plane's scheduler bench — 100+
     concurrent sample→memory transfers through admission control +
@@ -1878,6 +1904,7 @@ def main() -> int:
         for line in format_report(report).splitlines():
             print(f"# {line}", file=sys.stderr)
         _METRICS_EMITTED.append(report)
+        _emit_multistream(report)
         print(json.dumps(report))
         return gated()
 
@@ -2123,6 +2150,7 @@ def main() -> int:
         try:
             ichg = measure_interchange()
             _emit(ichg)
+            _emit_multistream(ichg)
         except Exception as e:
             print(f"# interchange bench failed: {type(e).__name__}: {e}",
                   file=sys.stderr)

@@ -1,0 +1,351 @@
+"""Multi-stream transport lane tests (interchange/flight.py substreams,
+interchange/regions.py, interchange/streams.py).
+
+Covers: deterministic in-order reassembly of striped parts at every
+substream count (round-robin indexes, not thread arrival order), the
+all-or-nothing put contract under a mid-substream failpoint (an
+incomplete token must never become visible), the region buffer pool's
+refcount/seal ownership discipline (including shm regions whose
+readers outlive the writer's close), and the stream-count model's
+pinned-vs-auto decisions under TRANSFERIA_TPU_STREAM_LINK.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+from transferia_tpu.abstract.schema import TableID
+from transferia_tpu.columnar.batch import ColumnBatch
+from transferia_tpu.interchange.telemetry import TELEMETRY
+
+requires_pyarrow = pytest.mark.requires_pyarrow
+
+TID = TableID("sample", "events")
+
+
+def _batches(n_batches: int, rows: int = 400, dict_encode: bool = False):
+    from transferia_tpu.providers.sample import make_batch
+
+    return [make_batch("iot", TID, i * rows, rows, 7,
+                       dict_encode=dict_encode)
+            for i in range(n_batches)]
+
+
+# -- substream reassembly ----------------------------------------------------
+
+@requires_pyarrow
+class TestSubstreamReassembly:
+    def test_every_stream_count_reassembles_in_order(self):
+        pytest.importorskip("pyarrow.flight")
+        from transferia_tpu.interchange.flight import (
+            FlightShardClient,
+            ShardFlightServer,
+        )
+
+        batches = _batches(7)
+        want = ColumnBatch.concat(batches).to_pydict()
+        with ShardFlightServer() as srv, \
+                FlightShardClient(srv.location, allow_shm=False) as cli:
+            for n in (1, 2, 3, 4, 7, 8):
+                cli.put_part("p", batches, streams=n)
+                got = cli.get_part("p")
+                assert sum(g.n_rows for g in got) == 7 * 400
+                # order is the ROUND-ROBIN reassembly index, so the
+                # concatenation is byte-identical to the input no
+                # matter how the substream threads interleaved
+                assert ColumnBatch.concat(got).to_pydict() == want
+                cli.drop("p")
+
+    def test_reassembly_is_stable_across_repeats(self):
+        """Thread arrival order varies run to run; the reassembled
+        part must not."""
+        pytest.importorskip("pyarrow.flight")
+        from transferia_tpu.interchange.flight import (
+            FlightShardClient,
+            ShardFlightServer,
+        )
+
+        batches = _batches(6, rows=200, dict_encode=True)
+        want = ColumnBatch.concat(batches).to_pydict()
+        with ShardFlightServer() as srv, \
+                FlightShardClient(srv.location, allow_shm=False) as cli:
+            for _ in range(5):
+                cli.put_part("p", batches, streams=3)
+                got = cli.get_part("p")
+                assert ColumnBatch.concat(got).to_pydict() == want
+                cli.drop("p")
+
+    def test_dict_pool_ships_once_per_part(self):
+        pytest.importorskip("pyarrow.flight")
+        from transferia_tpu.interchange.flight import (
+            FlightShardClient,
+            ShardFlightServer,
+        )
+
+        batches = _batches(8, rows=256, dict_encode=True)
+        with ShardFlightServer() as srv, \
+                FlightShardClient(srv.location, allow_shm=False) as cli:
+            TELEMETRY.reset()
+            cli.put_part("p", batches, streams=1)
+            cli.drop("p")
+            one = TELEMETRY.snapshot()
+            TELEMETRY.reset()
+            cli.put_part("p", batches, streams=4)
+            got = cli.get_part("p")
+            four = TELEMETRY.snapshot()
+            cli.drop("p")
+            # striping must not multiply pool ships: substreams >= 1 go
+            # codes-only and rebind to substream 0's dictionaries
+            assert four["pools_shipped"] == one["pools_shipped"] > 0
+            assert four["substreams_out"] == 4
+            assert four["substreams_in"] == 4
+            assert ColumnBatch.concat(got).to_pydict() == \
+                ColumnBatch.concat(batches).to_pydict()
+
+    def test_single_batch_part_degrades_to_one_stream(self):
+        pytest.importorskip("pyarrow.flight")
+        from transferia_tpu.interchange.flight import (
+            FlightShardClient,
+            ShardFlightServer,
+        )
+
+        b = _batches(1)[0]
+        with ShardFlightServer() as srv, \
+                FlightShardClient(srv.location, allow_shm=False) as cli:
+            cli.put_part("p", [b], streams=8)  # clamps to len(batches)
+            got = cli.get_part("p")
+            assert ColumnBatch.concat(got).to_pydict() == b.to_pydict()
+
+
+# -- all-or-nothing put ------------------------------------------------------
+
+@requires_pyarrow
+class TestSubstreamFailure:
+    def test_mid_substream_fault_kills_whole_put(self):
+        pytest.importorskip("pyarrow.flight")
+        from transferia_tpu.chaos import failpoints
+        from transferia_tpu.interchange.flight import (
+            FlightShardClient,
+            ShardFlightServer,
+        )
+
+        batches = _batches(6)
+        with ShardFlightServer() as srv, \
+                FlightShardClient(srv.location, allow_shm=False) as cli:
+            with failpoints.active(
+                    "flight.substream=after:1,times:1,"
+                    "raise:ConnectionError", seed=3):
+                with pytest.raises(Exception):
+                    cli.put_part("p", batches, streams=3)
+            # nothing promoted, nothing staged visible: the surviving
+            # substreams' stripes must not exist under any read path
+            assert cli.keys() == []
+            meta = cli._part_meta("p")
+            assert not meta or not meta.get("substreams")
+            # the retry (fresh token) lands cleanly over the debris
+            cli.put_part("p", batches, streams=3)
+            got = cli.get_part("p")
+            assert ColumnBatch.concat(got).to_pydict() == \
+                ColumnBatch.concat(batches).to_pydict()
+
+    def test_stale_epoch_fences_multistream_put(self):
+        pytest.importorskip("pyarrow.flight")
+        from transferia_tpu.abstract.errors import StaleEpochPublishError
+        from transferia_tpu.interchange.flight import (
+            FlightShardClient,
+            ShardFlightServer,
+        )
+
+        batches = _batches(4)
+        with ShardFlightServer() as srv, \
+                FlightShardClient(srv.location, allow_shm=False) as cli:
+            cli.put_part("p", batches, epoch=5, streams=2)
+            with pytest.raises(StaleEpochPublishError):
+                cli.put_part("p", batches[:2], epoch=3, streams=2)
+            got = cli.get_part("p")  # the epoch-5 part survived intact
+            assert sum(g.n_rows for g in got) == 4 * 400
+
+
+# -- region buffer pool ------------------------------------------------------
+
+@requires_pyarrow
+class TestRegionLifecycle:
+    def test_seal_once_and_write_fence(self):
+        from transferia_tpu.interchange.regions import Region, RegionError
+
+        r = Region(64)
+        r.writer_buffer()  # writable pre-seal
+        r.seal()
+        with pytest.raises(RegionError):
+            r.writer_buffer()
+        with pytest.raises(RegionError):
+            r.seal()
+        r.close()
+        assert r.disposed
+
+    def test_view_requires_seal_and_retain_guards_dispose(self):
+        from transferia_tpu.interchange.regions import Region, RegionError
+
+        r = Region(32)
+        with pytest.raises(RegionError):
+            r.view()
+        r.seal()
+        reader = r.retain()
+        assert r.refcount == 2
+        r.close()  # writer gone; reader still pins the memory
+        assert not r.disposed
+        v = reader.view(0, 8)
+        assert len(v) == 8
+        reader.release()
+        assert r.disposed
+        with pytest.raises(RegionError):
+            r.retain()
+        with pytest.raises(RegionError):
+            r.release()
+
+    def test_pinned_vs_copied_accounting(self):
+        from transferia_tpu.interchange.regions import Region
+
+        TELEMETRY.reset()
+        r = Region(100)
+        r.seal()
+        r.view(0, 60)
+        r.read_copy(0, 10)
+        snap = TELEMETRY.snapshot()
+        assert snap["regions_sealed"] == 1
+        assert snap["region_pinned_bytes"] == 60
+        assert snap["region_copied_bytes"] == 10
+        r.close()
+
+    def test_shm_region_reader_outlives_writer_close(self):
+        from transferia_tpu.interchange.convert import batch_to_arrow
+        from transferia_tpu.interchange.regions import frame_batches
+
+        pa = pytest.importorskip("pyarrow")
+        rbs = [batch_to_arrow(b) for b in _batches(2, rows=100)]
+        region = frame_batches(rbs, kind="shm", unlink_on_dispose=True)
+        reader = region.retain()
+        region.close()  # writer's reference drops; mapping survives
+        assert not region.disposed
+        with pa.ipc.open_stream(reader.view()) as rd:
+            back = rd.read_all()
+        assert back.num_rows == 200
+        del back, rd
+        reader.release()
+        assert region.disposed
+
+    def test_failed_seal_disposes(self):
+        from transferia_tpu.chaos import failpoints
+        from transferia_tpu.interchange.regions import Region
+
+        with failpoints.active("region.seal=times:1,raise:OSError",
+                               seed=1):
+            r = Region(16)
+            with pytest.raises(OSError):
+                r.seal()
+            assert r.disposed  # never leaks a writable buffer
+
+
+# -- stream-count model ------------------------------------------------------
+
+class TestStreamModel:
+    def setup_method(self):
+        from transferia_tpu.interchange import streams
+
+        streams.reset_stream_cache()
+
+    teardown_method = setup_method
+
+    def test_env_pin_wins(self, monkeypatch):
+        from transferia_tpu.interchange import streams
+
+        monkeypatch.setenv("TRANSFERIA_TPU_FLIGHT_STREAMS", "4")
+        assert streams.auto_substreams(100 << 20, 16) == 4
+        assert streams.auto_substreams(100 << 20, 3) == 3  # batch clamp
+        monkeypatch.setenv("TRANSFERIA_TPU_FLIGHT_STREAMS", "99")
+        assert streams.auto_substreams(100 << 20, 99) == \
+            streams.MAX_STREAMS
+
+    def test_small_parts_never_stripe(self, monkeypatch):
+        from transferia_tpu.interchange import streams
+
+        monkeypatch.delenv("TRANSFERIA_TPU_FLIGHT_STREAMS",
+                           raising=False)
+        monkeypatch.setenv("TRANSFERIA_TPU_STREAM_LINK", "1,100,400")
+        assert streams.auto_substreams(100 << 10, 16) == 1
+        assert streams.auto_substreams(100 << 20, 1) == 1
+
+    def test_pinned_link_prices_the_curve(self, monkeypatch):
+        from transferia_tpu.interchange import streams
+
+        monkeypatch.delenv("TRANSFERIA_TPU_FLIGHT_STREAMS",
+                           raising=False)
+        # 1ms setup, 100 MB/s per stream, 400 MB/s aggregate: a big
+        # part wants the link ceiling (4 streams), never more
+        monkeypatch.setenv("TRANSFERIA_TPU_STREAM_LINK", "1,100,400")
+        streams.reset_stream_cache()
+        prof = streams.probe_stream_link()
+        assert not prof.measured and not prof.degraded
+        assert streams.auto_substreams(256 << 20, 64) == 4
+        # a link with no headroom over one stream: striping is pure
+        # overhead, the model stays at 1
+        monkeypatch.setenv("TRANSFERIA_TPU_STREAM_LINK", "1,100,100")
+        streams.reset_stream_cache()
+        assert streams.auto_substreams(256 << 20, 64) == 1
+
+    def test_modeled_seconds_monotone_in_bytes(self, monkeypatch):
+        from transferia_tpu.interchange import streams
+
+        monkeypatch.setenv("TRANSFERIA_TPU_STREAM_LINK", "1,100,400")
+        streams.reset_stream_cache()
+        p = streams.probe_stream_link()
+        assert streams.modeled_seconds(2, 200 << 20, p) > \
+            streams.modeled_seconds(2, 100 << 20, p)
+
+    def test_degraded_profile_reprobes_after_window(self, monkeypatch):
+        from transferia_tpu.interchange import streams
+
+        monkeypatch.delenv("TRANSFERIA_TPU_STREAM_LINK", raising=False)
+        monkeypatch.setenv("TRANSFERIA_TPU_STREAM_REPROBE", "3")
+        streams.reset_stream_cache()
+        # wedge the probe once: the fallback profile must self-heal
+        real = streams._measure
+        monkeypatch.setattr(streams, "_measure",
+                            lambda: (_ for _ in ()).throw(OSError()))
+        assert streams.probe_stream_link().degraded
+        monkeypatch.setattr(streams, "_measure", real)
+        for _ in range(3):
+            prof = streams.probe_stream_link()
+        assert not prof.degraded and prof.measured
+
+
+# -- auto selection end to end -----------------------------------------------
+
+@requires_pyarrow
+def test_put_part_autos_streams_from_pinned_link(monkeypatch):
+    """With the link pinned wide and a multi-megabyte part, put_part's
+    auto path stripes; the telemetry shows the substream count it
+    chose."""
+    pytest.importorskip("pyarrow.flight")
+    from transferia_tpu.interchange import streams
+    from transferia_tpu.interchange.flight import (
+        FlightShardClient,
+        ShardFlightServer,
+    )
+
+    monkeypatch.delenv("TRANSFERIA_TPU_FLIGHT_STREAMS", raising=False)
+    monkeypatch.setenv("TRANSFERIA_TPU_STREAM_LINK", "1,50,200")
+    streams.reset_stream_cache()
+    try:
+        batches = _batches(8, rows=40_000)  # ~10+ MB: model stripes
+        with ShardFlightServer() as srv, \
+                FlightShardClient(srv.location, allow_shm=False) as cli:
+            TELEMETRY.reset()
+            cli.put_part("p", batches)
+            snap = TELEMETRY.snapshot()
+            assert snap["substreams_out"] > 1
+            got = cli.get_part("p")
+            assert sum(g.n_rows for g in got) == 8 * 40_000
+    finally:
+        streams.reset_stream_cache()

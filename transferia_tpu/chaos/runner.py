@@ -72,6 +72,8 @@ REPLICATION_SITES = (
 )
 ARROW_IPC_SITES = (
     "interchange.ipc.read",
+    "flight.substream",
+    "region.seal",
     "transform.chain",
     "device.dispatch",
     "sink.push",
@@ -480,6 +482,76 @@ def _arrow_ipc_reference(dataset_dir: str) -> DeliveryReference:
     return ref
 
 
+def _exercise_wire_sites(rows: int = 1024) -> Optional[str]:
+    """Drive the multi-stream Flight lane and a region-backed shm
+    segment under the armed schedule, so the `flight.substream` and
+    `region.seal` sites sit on a real path: an injected substream fault
+    must fail the WHOLE part put (no partial visibility) with a retry
+    replacing wholesale, and a failed seal must retire the segment name
+    with nothing handed out.  Returns a violation message or None."""
+    from transferia_tpu.abstract.schema import TableID
+    from transferia_tpu.interchange import shm as shm_mod
+    from transferia_tpu.interchange.flight import (
+        FlightShardClient,
+        ShardFlightServer,
+    )
+    from transferia_tpu.providers.sample import make_batch
+
+    tid = TableID("sample", "events")
+    bs = max(64, rows // 8)
+    batches = [make_batch("iot", tid, start, min(bs, rows - start), 7)
+               for start in range(0, rows, bs)]
+    expect = sum(b.n_rows for b in batches)
+    key = "sample.events/wire"
+    srv = ShardFlightServer(enable_shm=False)
+    try:
+        with FlightShardClient(srv.location, allow_shm=False) as cli:
+            for _ in range(MAX_SNAPSHOT_RUNS):
+                try:
+                    cli.put_part(key, batches, streams=4)
+                    break
+                except Exception:
+                    # at-least-once contract: a mid-substream fault
+                    # must leave NOTHING visible before the retry
+                    if cli.keys():
+                        return ("flight.substream fault left a "
+                                "partially visible part")
+            else:
+                return (f"multi-stream put never completed in "
+                        f"{MAX_SNAPSHOT_RUNS} attempts")
+            got = cli.get_part(key)
+            n = sum(b.n_rows for b in got)
+            if n != expect:
+                return f"multi-stream reassembly rows {n} != {expect}"
+    finally:
+        srv.close()
+    # three segments per trial so the low-traffic `region.seal` site
+    # sees enough hits for any after:0..2 gate to land
+    for _ in range(3):
+        handle = None
+        for _ in range(MAX_SNAPSHOT_RUNS):
+            try:
+                handle = shm_mod.write_segment(batches[:2])
+                break
+            except Exception:  # trtpu: ignore[EXC001] — armed chaos faults are the point
+                # a failed fill/seal retires the name; the retry gets
+                # a fresh segment
+                continue
+        if handle is None:
+            return (f"region-backed shm segment never sealed in "
+                    f"{MAX_SNAPSHOT_RUNS} attempts")
+        att = shm_mod.attach(handle)
+        try:
+            n = sum(b.n_rows for b in att.batches())
+            want = sum(b.n_rows for b in batches[:2])
+            if n != want:
+                return f"shm segment rows {n} != {want}"
+        finally:
+            att.close()
+            shm_mod.unlink_segment(handle)
+    return None
+
+
 def run_arrow_ipc_trial(trial: int, seed: int, dataset_dir: str,
                         reference: DeliveryReference,
                         spec: Optional[str] = None,
@@ -509,6 +581,7 @@ def run_arrow_ipc_trial(trial: int, seed: int, dataset_dir: str,
                 restarts += 1
                 logger.info("chaos arrow_ipc run %d failed (%s); "
                             "re-activating", attempt + 1, e)
+        wire_violation = _exercise_wire_sites()
         fires = failpoints.fire_counts()
         log = failpoints.fire_log()
     seconds = time.monotonic() - t0
@@ -522,6 +595,9 @@ def run_arrow_ipc_trial(trial: int, seed: int, dataset_dir: str,
             "run-completed",
             f"arrow_ipc snapshot never completed in {MAX_SNAPSHOT_RUNS} "
             f"runs: {run_error}"))
+    if wire_violation is not None:
+        verdict.passed = False
+        verdict.violations.append(Violation("wire-leg", wire_violation))
     store.clear()
     return TrialResult(mode="arrow_ipc", trial=trial, seed=seed,
                        spec=spec, verdict=verdict, fire_counts=fires,

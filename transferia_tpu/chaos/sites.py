@@ -165,6 +165,18 @@ SITES: dict[str, tuple[str, str]] = {
         "interchange/shm.py",
         "shared-memory segment attach failing (segment reaped, name "
         "raced) — the client must fall back to the Flight wire path"),
+    "flight.substream": (
+        "interchange/flight.py",
+        "one substream of a multi-stream part put dying mid-stripe "
+        "(gRPC stream reset) — the WHOLE part put must fail with "
+        "nothing promoted server-side (no partial visibility), and "
+        "the retried put must replace wholesale"),
+    "region.seal": (
+        "interchange/regions.py",
+        "region seal failing after scatter/gather writes landed "
+        "(mmap fault, shm truncation) — the region must dispose "
+        "cleanly, never hand out views of an unsealed buffer, and "
+        "the caller's put/segment write must fail whole"),
     "fleet.admit": (
         "fleet/scheduler.py",
         "fleet admission RPC failing before the transfer is enqueued "

@@ -237,6 +237,10 @@ def build_parser() -> argparse.ArgumentParser:
     fli.add_argument("--rows", type=int, default=200_000,
                      help="bench: rows moved per path")
     fli.add_argument("--batch-rows", type=int, default=16_384)
+    fli.add_argument("--streams", default="1,2,4,8",
+                     help="bench: comma-separated substream counts for "
+                          "the multi-stream scaling curve over the "
+                          "dict-heavy shape (default 1,2,4,8)")
     fli.add_argument("--json", action="store_true", dest="as_json",
                      help="bench: machine-readable report")
     flt = sub.add_parser(
@@ -983,9 +987,11 @@ def cmd_flight(args) -> int:
             run_interchange_bench,
         )
 
+        counts = tuple(int(t) for t in args.streams.split(",") if t)
         report = run_interchange_bench(
             rows=args.rows, batch_rows=args.batch_rows,
-            flight_uri=args.uri or None)
+            flight_uri=args.uri or None,
+            stream_counts=counts or (1, 2, 4, 8))
         if args.as_json:
             print(json.dumps(report, indent=1))
         else:

@@ -301,6 +301,66 @@ def reset_intern_cache() -> None:
         _INTERN_CACHE.clear()
 
 
+def remap_codes_onto(canon: "DictPool", data: np.ndarray,
+                     offsets: np.ndarray,
+                     n_pool: int) -> Optional[np.ndarray]:
+    """Remap table from a candidate pool's codes onto the canonical
+    pool's, or None when the candidate carries a value outside the
+    canonical pool (a genuinely new dictionary — the caller re-interns
+    instead).  Shared by every order-insensitive pool-sharing consumer
+    (parquet dict pages across row groups, arrow dictionaries across
+    IPC/Flight streams) so the verification discipline can't fork.
+
+    The canonical pool's bytes→code index memoizes on the pool; the
+    null SENTINEL slot is excluded from it so a real empty-bytes value
+    can never alias onto the sentinel (the mask plane empties the
+    sentinel's hex slot — aliasing would silently unmask '' rows).
+    The returned table has n_pool+1 entries: the candidate's own
+    sentinel (code n_pool) maps to the canonical sentinel."""
+    if canon.null_code is None:
+        return None
+    if n_pool == 0:
+        return np.array([canon.null_code], dtype=np.int32)
+    from transferia_tpu.ops.rowhash import pool_accumulators
+
+    memo = canon.memo_get(("remap_keys",))
+    if memo is None:
+        a1, a2 = pool_accumulators(canon)
+        ckeys = (a1.astype(np.uint64) << np.uint64(32)) \
+            | a2.astype(np.uint64)
+        # poison the sentinel's key: a real empty-bytes value must
+        # never alias onto the null sentinel; the exact verification
+        # below backstops any residual collision with the poison value
+        ckeys = ckeys.copy()
+        ckeys[canon.null_code] = np.uint64(0xFFFFFFFFFFFFFFFF)
+        sorter = np.argsort(ckeys, kind="stable")
+        memo = (ckeys[sorter], sorter)
+        canon.memo_set(("remap_keys",), memo)
+    sorted_keys, sorter = memo
+    pool_bytes = int(offsets[n_pool])
+    cand_pool = DictPool(data[:pool_bytes],
+                         np.ascontiguousarray(offsets[:n_pool + 1],
+                                              dtype=np.int32))
+    p1, p2 = pool_accumulators(cand_pool)
+    pkeys = (p1.astype(np.uint64) << np.uint64(32)) \
+        | p2.astype(np.uint64)
+    pos = np.searchsorted(sorted_keys, pkeys)
+    cand = sorter[np.minimum(pos, canon.n_values - 1)]
+    # the keys are 64-bit content hashes — verify the implied mapping
+    # byte-EXACTLY (one native gather + two memcmps); any miss (value
+    # outside the pool, or a hash collision) rejects the remap and the
+    # caller re-interns, so a wrong code can never reach a consumer
+    g_data, g_off = _gather_varwidth(
+        canon.values_data,
+        np.ascontiguousarray(canon.values_offsets, dtype=np.int32),
+        cand.astype(np.int64))
+    if not (np.array_equal(g_off, offsets[:n_pool + 1])
+            and np.array_equal(g_data, data[:pool_bytes])):
+        return None
+    return np.append(cand.astype(np.int32),
+                     np.int32(canon.null_code))
+
+
 class DictEnc:
     """Dictionary encoding of a variable-width column (ClickHouse
     LowCardinality / Arrow DictionaryArray analogue).
@@ -1018,7 +1078,9 @@ def _adopt_string_buffers(arr) -> tuple[np.ndarray, np.ndarray]:
     return np.ascontiguousarray(data), np.ascontiguousarray(off)
 
 
-def _adopt_dict_pool(pool_arr, vt, pt, pa) -> DictPool:
+def _adopt_dict_pool(pool_arr, vt, pt, pa,
+                     scope: Optional[tuple] = None,
+                     ) -> tuple[DictPool, Optional[np.ndarray]]:
     """Adopt an arrow dictionary as a shared DictPool.
 
     Keyed by buffer identity: all batch slices of one row group reference
@@ -1026,6 +1088,13 @@ def _adopt_dict_pool(pool_arr, vt, pt, pa) -> DictPool:
     set of memos (the HMAC mask hashes a shared pool exactly once).  The
     cache entry pins the arrow array, keeping the address a valid key.
     An empty-bytes sentinel entry is appended for null rows (null_code).
+
+    When `scope` is given and pool sharing is on, a pool that carries the
+    same VALUE SET as the scope's canonical pool in a different order is
+    not re-interned: `remap_codes_onto` prices the permutation and the
+    caller rewrites codes through the returned table — order-insensitive
+    convergence, mirroring `_adopt_dict_page` on the parquet path.
+    Returns (pool, remap) where remap is None when codes pass through.
     """
     # key on the ORIGINAL array's buffers: casting large_string allocates
     # fresh buffers each call, which would make the key never repeat
@@ -1039,32 +1108,48 @@ def _adopt_dict_pool(pool_arr, vt, pt, pa) -> DictPool:
     with _POOL_CACHE_LOCK:
         hit = _POOL_CACHE.get(key)
         if hit is not None:
-            return hit[0]
+            return hit[0], hit[2]
     if pt.is_large_string(vt) or pt.is_large_binary(vt):
         pool_arr = pool_arr.cast(
             pa.string() if pt.is_large_string(vt) else pa.binary())
     pool_data, pool_off = _adopt_string_buffers(pool_arr)
     # append the null sentinel (empty bytes) at index n_values
     pool_off = np.append(pool_off, pool_off[-1]).astype(np.int32)
-    # content interning: arrow dictionaries re-read per row group carry
-    # identical bytes in fresh buffers — converge them on one DictPool
-    # so memos amortize across row groups exactly as on the native
-    # path.  The INTERNED pool owns copied buffers (finalize): a pool
-    # view into an IPC message / shm segment would otherwise pin the
-    # whole mapping for the cache entry's lifetime
-    dpool = intern_pool(
-        None, pool_data, pool_off, null_code=len(pool_arr),
-        finalize=lambda d, o: (np.ascontiguousarray(d).copy(),
-                               np.ascontiguousarray(o).copy()))
+    dpool, remap = None, None
+    if scope is not None and pool_sharing_enabled():
+        canon = intern_peek(scope)
+        if canon is not None:
+            remap = remap_codes_onto(canon, pool_data, pool_off,
+                                     len(pool_arr))
+            if remap is not None:
+                from transferia_tpu.stats.trace import TELEMETRY
+
+                TELEMETRY.record_pool_share_hit()
+                if np.array_equal(remap,
+                                  np.arange(len(pool_arr) + 1,
+                                            dtype=np.int32)):
+                    remap = None  # same order: codes pass through
+                dpool = canon
+    if dpool is None:
+        # content interning: arrow dictionaries re-read per row group
+        # carry identical bytes in fresh buffers — converge them on one
+        # DictPool so memos amortize across row groups exactly as on the
+        # native path.  The INTERNED pool owns copied buffers (finalize):
+        # a pool view into an IPC message / shm segment would otherwise
+        # pin the whole mapping for the cache entry's lifetime
+        dpool = intern_pool(
+            scope, pool_data, pool_off, null_code=len(pool_arr),
+            finalize=lambda d, o: (np.ascontiguousarray(d).copy(),
+                                   np.ascontiguousarray(o).copy()))
     with _POOL_CACHE_LOCK:
         hit = _POOL_CACHE.get(key)
         if hit is not None:
-            return hit[0]
+            return hit[0], hit[2]
         while len(_POOL_CACHE) >= _POOL_CACHE_MAX:
             _POOL_CACHE.pop(next(iter(_POOL_CACHE)), None)
         # pin the ORIGINAL array: its buffer addresses are the key
-        _POOL_CACHE[key] = (dpool, orig)
-    return dpool
+        _POOL_CACHE[key] = (dpool, orig, remap)
+    return dpool, remap
 
 
 def _arrow_to_column(cs: ColSchema, arr) -> Column:
@@ -1082,11 +1167,18 @@ def _arrow_to_column(cs: ColSchema, arr) -> Column:
                 or pt.is_binary(vt) or pt.is_large_binary(vt)):
             pool_arr = arr.dictionary
             if pool_arr.null_count == 0:
-                dpool = _adopt_dict_pool(pool_arr, vt, pt, pa)
+                dpool, remap = _adopt_dict_pool(
+                    pool_arr, vt, pt, pa,
+                    scope=("arrow", cs.name, str(vt)))
                 idx = arr.indices
                 if idx.null_count:
                     idx = idx.fill_null(0)
                 codes = np.asarray(idx.cast(pa.int32()))
+                if remap is not None:
+                    # permuted pool adopted onto the canonical one: the
+                    # codes change basis (remap has n_pool+1 slots; real
+                    # codes stay < n_pool, slot n_pool is the sentinel)
+                    codes = remap[codes]
                 if validity is not None:
                     # canonical null representation is empty bytes (matches
                     # the flat path): null rows point at the pool's empty

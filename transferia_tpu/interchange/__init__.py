@@ -10,12 +10,18 @@ formats can map onto it without per-row work:
 - `ipc.py`       Arrow IPC stream framing over files and inherited fds —
                  the `arrow_ipc` provider (providers/arrow_ipc.py) makes
                  the format a first-class transfer endpoint;
-- `flight.py`    Arrow Flight shard transport (DoGet/DoPut, one stream
-                 per `OperationTablePart`) — wire-speed worker→worker
+- `flight.py`    Arrow Flight shard transport (DoGet/DoPut, N concurrent
+                 epoch-fenced substreams per `OperationTablePart` with
+                 deterministic reassembly) — wire-speed worker→worker
                  handoff instead of re-decoding parquet per worker;
 - `shm.py`       same-host shared-memory handoff (IPC-framed segments in
                  `multiprocessing.shared_memory`), selected automatically
-                 by the Flight client when both peers are co-located.
+                 by the Flight client when both peers are co-located;
+- `regions.py`   refcounted seal-once region buffer pool under the shm
+                 leg — one producer→region copy, reader views pin the
+                 mapping past the writer's close;
+- `streams.py`   stream-count model (substreams vs link bandwidth, env
+                 pin + degraded reprobe, linkprobe conventions).
 
 Grounding: "Benchmarking Apache Arrow Flight" and "Zerrow: True
 Zero-Copy Arrow Pipelines" (PAPERS.md).  Buffer-ownership rules live in
@@ -29,3 +35,6 @@ pyarrow-backed code path is actually exercised (`_pyarrow.py`).
 from transferia_tpu.interchange.telemetry import TELEMETRY
 
 __all__ = ["TELEMETRY"]
+
+# regions/streams/flight import lazily where used: they pull pyarrow-
+# backed paths and must stay importable on arrow-less installs.

@@ -28,11 +28,13 @@ from transferia_tpu.columnar.batch import ColumnBatch
 from transferia_tpu.interchange.telemetry import TELEMETRY
 
 
-def _mk_batches(rows: int, batch_rows: int, preset: str):
+def _mk_batches(rows: int, batch_rows: int, preset: str,
+                dict_encode: bool = False):
     from transferia_tpu.providers.sample import make_batch
 
     tid = TableID("bench", "interchange")
-    return [make_batch(preset, tid, start, min(batch_rows, rows - start), 7)
+    return [make_batch(preset, tid, start, min(batch_rows, rows - start), 7,
+                       dict_encode=dict_encode)
             for start in range(0, rows, batch_rows)]
 
 
@@ -45,8 +47,19 @@ def _time(fn) -> float:
 def run_interchange_bench(rows: int = 200_000, batch_rows: int = 16_384,
                           preset: str = "iot",
                           with_flight: bool = True,
-                          flight_uri: Optional[str] = None) -> dict:
-    """Run all paths over identical batches; returns the report dict."""
+                          flight_uri: Optional[str] = None,
+                          stream_counts: tuple = (1, 2, 4, 8)) -> dict:
+    """Run all paths over identical batches; returns the report dict.
+
+    With Flight enabled the bench also drives the multi-stream lane
+    over the DICT-HEAVY shape (`stream_curve`): the same part put/got
+    at each substream count in `stream_counts`, reporting rows/s and
+    bytes-on-wire per point (the frontier), and ASSERTING in-run that
+    each put ships every pool exactly once (pool-once per part, not
+    per substream) and that the encoded wire genuinely shrinks
+    (`encoded_wire_ratio` > 1).  The shm path runs through the region
+    buffer pool; `region_copied_bytes` staying 0 is the zero-
+    intermediate-copy proof of that path."""
     from transferia_tpu.interchange import ipc, shm
     from transferia_tpu.interchange.convert import arrow_to_batch
 
@@ -77,7 +90,8 @@ def run_interchange_bench(rows: int = 200_000, batch_rows: int = 16_384,
 
     ipc_s = _time(ipc_path)
 
-    # shared-memory segment handoff
+    # shared-memory segment handoff (decode → region → map, no
+    # intermediate copy: region_copied_bytes must stay 0)
     def shm_path():
         h = shm.write_segment(batches)
         att = shm.attach(h)
@@ -86,8 +100,15 @@ def run_interchange_bench(rows: int = 200_000, batch_rows: int = 16_384,
         shm.unlink_segment(h)
 
     shm_s = _time(shm_path)
+    region_snap = TELEMETRY.snapshot()
+    if region_snap["region_copied_bytes"]:
+        raise AssertionError(
+            "region path copied "
+            f"{region_snap['region_copied_bytes']} bytes — the "
+            "decode→region→socket path must be zero-copy")
 
     flight_s = None
+    stream_curve: dict[str, dict] = {}
     if with_flight:
         from transferia_tpu.interchange.flight import (
             FlightShardClient,
@@ -107,11 +128,17 @@ def run_interchange_bench(rows: int = 200_000, batch_rows: int = 16_384,
 
                 flight_s = _time(flight_path)
                 cli.drop("bench.interchange/0")
+                # snapshot the single-shape counters BEFORE the curve:
+                # each curve point resets telemetry to isolate its own
+                # pool-once / wire-bytes accounting
+                snap = TELEMETRY.snapshot()
+                stream_curve = _stream_curve(
+                    cli, rows, batch_rows, preset, stream_counts)
         finally:
             if server is not None:
                 server.close()
-
-    snap = TELEMETRY.snapshot()
+    if not with_flight:
+        snap = TELEMETRY.snapshot()
     zc_total = snap["zero_copy_buffers"] + snap["copied_buffers"]
 
     def path_stats(seconds: Optional[float]):
@@ -138,12 +165,79 @@ def run_interchange_bench(rows: int = 200_000, batch_rows: int = 16_384,
         "copied_buffers": snap["copied_buffers"],
         "zero_copy_ratio": round(
             snap["zero_copy_buffers"] / zc_total, 4) if zc_total else 0.0,
+        "regions_sealed": snap["regions_sealed"],
+        "region_pinned_bytes": snap["region_pinned_bytes"],
+        "region_copied_bytes": snap["region_copied_bytes"],
     }
+    if stream_curve:
+        report["stream_curve"] = stream_curve
+        base = stream_curve.get("1", {}).get("rows_per_sec")
+        four = stream_curve.get("4", {}).get("rows_per_sec")
+        if base and four:
+            report["stream4_speedup"] = round(four / base, 2)
     best = max(s["rows_per_sec"] for k, s in report["paths"].items()
                if s is not None and k != "pivot")
     report["value"] = best
     report["unit"] = "rows/sec"
     return report
+
+
+def _stream_curve(cli, rows: int, batch_rows: int, preset: str,
+                  stream_counts) -> dict[str, dict]:
+    """The multi-stream scaling curve over the DICT-HEAVY shape: one
+    part put+got per substream count, each point reporting rows/s and
+    the bytes the wire actually carried (the bytes-on-wire vs rows/s
+    frontier).  Asserts the pool-once-per-part and encoded-wire-shrink
+    contracts IN-RUN — a silently flat or pool-re-shipping wire would
+    otherwise still produce a plausible-looking curve."""
+    dict_batches = _mk_batches(rows, batch_rows, preset, dict_encode=True)
+    n_rows = sum(b.n_rows for b in dict_batches)
+    key = "bench.interchange/streams"
+    # warmup put/get: pool interning, arrow wrapping memos, and the
+    # stream-link probe all pay once — they must not be billed to the
+    # first curve point (it would fake the scaling ratio)
+    cli.put_part(key, dict_batches, streams=1)
+    for _ in cli.get_part(key):
+        pass
+    cli.drop(key)
+    curve: dict[str, dict] = {}
+    pools_per_put: Optional[int] = None
+    for n in stream_counts:
+        n = max(1, min(int(n), len(dict_batches)))
+        if str(n) in curve:
+            continue
+        TELEMETRY.reset()
+
+        def one_put(n=n):
+            cli.put_part(key, dict_batches, streams=n)
+            for _ in cli.get_part(key):
+                pass
+
+        secs = _time(one_put)
+        cli.drop(key)
+        s = TELEMETRY.snapshot()
+        shipped = s["pool_bytes_shipped"] + s["codes_bytes_shipped"]
+        if pools_per_put is None:
+            pools_per_put = s["pools_shipped"]
+        # pool-once per PART: striping must not multiply pool ships
+        if s["pools_shipped"] != pools_per_put:
+            raise AssertionError(
+                f"{n}-substream put shipped {s['pools_shipped']} pools "
+                f"(expected {pools_per_put}) — pool-once-per-part "
+                "contract broken")
+        if shipped and s["flat_equiv_bytes"] <= shipped:
+            raise AssertionError(
+                "encoded wire did not shrink the dict-heavy shape "
+                f"({s['flat_equiv_bytes']} flat vs {shipped} shipped)")
+        curve[str(n)] = {
+            "rows_per_sec": round(n_rows / secs),
+            "wire_mb": round(s["bytes_out"] / 1e6, 2),
+            "pools_shipped": s["pools_shipped"],
+            "encoded_wire_ratio": round(
+                s["flat_equiv_bytes"] / shipped, 2) if shipped else 0.0,
+            "substreams": s["substreams_out"],
+        }
+    return curve
 
 
 def format_report(report: dict) -> str:
@@ -160,4 +254,17 @@ def format_report(report: dict) -> str:
     lines.append(
         f"  zero-copy buffers: {report['zero_copy_buffers']} "
         f"({report['zero_copy_ratio']:.0%} of adoptions)")
+    if report.get("regions_sealed"):
+        lines.append(
+            f"  regions: {report['regions_sealed']} sealed, "
+            f"{report['region_copied_bytes']} bytes copied")
+    for n, pt in (report.get("stream_curve") or {}).items():
+        lines.append(
+            f"  flight x{n}: {pt['rows_per_sec']:>12,} rows/s  "
+            f"{pt['wire_mb']:>8.2f} MB wire  "
+            f"pools={pt['pools_shipped']}  "
+            f"ratio={pt['encoded_wire_ratio']:.1f}x")
+    if "stream4_speedup" in report:
+        lines.append(
+            f"  4-substream speedup vs 1: {report['stream4_speedup']}x")
     return "\n".join(lines)

@@ -57,6 +57,17 @@ from transferia_tpu.runtime import knobs
 SCHEMA_KEY = b"trtpu:schema"
 TABLE_KEY = b"trtpu:table"
 PART_KEY = b"trtpu:part_id"
+# field-level markers of the encoded wire:
+# - FOR_KEY marks a binary column carrying a frame-of-reference payload
+#   (value = the canonical type name the decode reconstructs);
+# - DICTREF_KEY marks an int32 codes-only column whose dictionary ships
+#   on substream 0 of the same part (value = the pool's arrow type) —
+#   `rebind_dict_columns` reattaches it before adoption.
+FOR_KEY = b"trtpu:forenc"
+DICTREF_KEY = b"trtpu:dictref"
+_FOR_MAGIC = 0x464F5231  # "FOR1" LE
+_FOR_HEADER_WORDS = 7    # magic, n_rows, bit_width, frame, n_mins,
+#                          n_words, n_validity_bytes
 _SIDECAR_KINDS = "__trtpu_kinds"
 _SIDECAR_LSNS = "__trtpu_lsns"
 _SIDECAR_COMMIT = "__trtpu_commit_times"
@@ -64,6 +75,26 @@ _SIDECARS = (_SIDECAR_KINDS, _SIDECAR_LSNS, _SIDECAR_COMMIT)
 
 
 _encoded_wire_cached: Optional[bool] = None
+_for_wire_cached: Optional[bool] = None
+
+
+def for_wire_enabled() -> bool:
+    """TRANSFERIA_TPU_FOR_WIRE=0 forces int columns RAW on the Arrow
+    wire; default on — list-framed streams (Flight parts, shm segments,
+    IPC files) FOR-encode clustered integer columns with sidecar frame
+    mins when every batch of the column passes the `ops/dispatch`
+    `_for_plan` guard chain (byte-identical round trip)."""
+    global _for_wire_cached
+    if _for_wire_cached is None:
+        _for_wire_cached = knobs.env_str(
+            "TRANSFERIA_TPU_FOR_WIRE", "1") != "0"
+    return _for_wire_cached
+
+
+def set_for_wire(on: Optional[bool]) -> None:
+    """Force the FOR wire on/off (None = re-read the env)."""
+    global _for_wire_cached
+    _for_wire_cached = on
 
 
 def encoded_wire_enabled() -> bool:
@@ -130,6 +161,13 @@ class EncodedWireState:
         self._new_pools += new_pools
         return new_pools
 
+    def account_payload(self, shipped_bytes: int, flat_bytes: int) -> None:
+        """Stage a non-dict encoded column's wire bytes (FOR frames):
+        the packed payload counts like codes, the raw dtype bytes like
+        flat — same pending/commit discipline as `account()`."""
+        self._codes_b += int(shipped_bytes)
+        self._flat_b += int(flat_bytes)
+
     def commit(self) -> None:
         """Publish the staged tallies (the stream's bytes landed)."""
         from transferia_tpu.stats.ledger import LEDGER
@@ -144,6 +182,157 @@ class EncodedWireState:
                    codes_bytes_shipped=self._codes_b)
         self._pool_b = self._codes_b = self._flat_b = 0
         self._new_pools = 0
+
+
+def plan_for_wire(batches, wire: Optional[EncodedWireState] = None
+                  ) -> dict[str, list]:
+    """Decide which integer columns of a batch LIST cross as FOR frames.
+
+    An Arrow stream's schema is fixed at open, so a column either
+    FOR-encodes in EVERY batch of the stream or crosses raw — the plan
+    runs `ops/dispatch._for_plan` (the exact device guard chain:
+    frame-divisible row count, int32-exact values, genuine shrink) over
+    all batches up front and keeps only all-or-nothing winners.
+    Returns {column name: [per-batch (mins, rel, bw, frame)]} with the
+    remainders still UNPACKED — the expensive bit-pack happens in
+    `_for_array` at conversion time, which a multi-stream put runs on
+    its substream threads (packing here would serialize it on the
+    spawning thread).  Pass each batch's entry to
+    `batch_to_arrow(for_enc=...)`.  With `wire`, stages payload-vs-flat
+    bytes into the stream's EncodedWireState."""
+    if not batches or not for_wire_enabled():
+        return {}
+    from transferia_tpu.ops.dispatch import _for_plan
+
+    out: dict[str, list] = {}
+    for cs in batches[0].schema:
+        if cs.data_type.is_variable_width \
+                or np.dtype(cs.data_type.np_dtype).kind not in "iu":
+            continue
+        encs, shipped, flat = [], 0, 0
+        for b in batches:
+            c = b.columns.get(cs.name)
+            if c is None or c.is_lazy_dict:
+                encs = []
+                break
+            plan = _for_plan(c.data.reshape(1, -1)) \
+                if c.data.ndim == 1 else None
+            if plan is None:
+                encs = []
+                break
+            mins, rel, bw, frame = plan
+            encs.append((mins[0], rel[0], bw, frame))
+            flat += int(c.data.nbytes)
+            # packed size without packing: bw bits per value, byte-
+            # rounded then padded to whole uint32 words (pack_bits_host)
+            words_nb = -4 * (-((c.n_rows * bw + 7) // 8) // 4)
+            shipped += _FOR_HEADER_WORDS * 4 + mins[0].nbytes + words_nb
+            if c.validity is not None:
+                shipped += (c.n_rows + 7) // 8
+        if encs:
+            out[cs.name] = encs
+            if wire is not None:
+                wire.account_payload(shipped, flat)
+    return out
+
+
+def _for_array(pa, c: Column, enc) -> Any:
+    """One FOR-encoded column → a binary Arrow array whose ROW 0 holds
+    the whole payload (header + frame mins + packed remainders + packed
+    validity) and rows 1..n-1 are empty — a RecordBatch column must be
+    n_rows long, and this shape keeps the payload in-band in the data
+    buffer where per-batch variance is allowed (schema/field metadata
+    ship once per stream and must stay constant)."""
+    from transferia_tpu.ops.dispatch import pack_bits_host
+
+    mins, rel, bw, frame = enc
+    words = pack_bits_host(rel, bw)
+    n = c.n_rows
+    vbytes = (np.packbits(c.validity, bitorder="little").tobytes()
+              if c.validity is not None else b"")
+    header = np.array([_FOR_MAGIC, n, bw, frame, len(mins), len(words),
+                       len(vbytes)], dtype=np.uint32)
+    payload = header.tobytes() + mins.tobytes() + words.tobytes() + vbytes
+    offsets = np.zeros(n + 1, dtype=np.int32)
+    offsets[1:] = len(payload)
+    TELEMETRY.add(copied_buffers=1)  # the pack is a materialization
+    return pa.Array.from_buffers(
+        pa.binary(), n,
+        [None, pa.py_buffer(offsets), pa.py_buffer(payload)])
+
+
+def _decode_for_column(cs, arr) -> Column:
+    """Inverse of `_for_array`: unpack the row-0 payload back into the
+    canonical integer column, byte-identical (values and validity)."""
+    bufs = arr.buffers()
+    off = np.frombuffer(bufs[1], dtype=np.int32,
+                        count=len(arr) + 1 + arr.offset)[arr.offset:]
+    payload = np.frombuffer(bufs[2], dtype=np.uint8)[off[0]:off[1]]
+    payload = np.ascontiguousarray(payload)
+    hdr = np.frombuffer(payload, dtype=np.uint32,
+                        count=_FOR_HEADER_WORDS)
+    magic, n, bw, frame, n_mins, n_words, n_vbytes = (int(x) for x in hdr)
+    if magic != _FOR_MAGIC:
+        raise ValueError(f"FOR wire column {cs.name!r}: bad magic "
+                         f"{magic:#x}")
+    pos = _FOR_HEADER_WORDS * 4
+    mins = np.frombuffer(payload, dtype=np.int32, count=n_mins,
+                         offset=pos)
+    pos += 4 * n_mins
+    words = np.frombuffer(payload, dtype=np.uint32, count=n_words,
+                          offset=pos)
+    pos += 4 * n_words
+    bits = np.unpackbits(words.view(np.uint8), bitorder="little")
+    weights = (np.uint64(1) << np.arange(bw, dtype=np.uint64))
+    rel = (bits[:n * bw].reshape(n, bw).astype(np.uint64) * weights) \
+        .sum(axis=1).astype(np.int64)
+    values = np.repeat(mins.astype(np.int64), frame)[:n] + rel
+    data = values.astype(cs.data_type.np_dtype)
+    validity = None
+    if n_vbytes:
+        vb = np.frombuffer(payload, dtype=np.uint8, count=n_vbytes,
+                           offset=pos)
+        validity = np.unpackbits(vb, bitorder="little")[:n] \
+            .astype(np.bool_)
+    TELEMETRY.add(copied_buffers=1)  # the unpack materializes
+    return Column(cs.name, cs.data_type, data, None, validity)
+
+
+def dict_columns_of(rb) -> dict:
+    """{column name: dictionary array} for each DictionaryArray column
+    of a RecordBatch — the pools substream 0 carries for the part."""
+    pa = pyarrow("Arrow dictionary extraction")
+    out = {}
+    for i, field in enumerate(rb.schema):
+        if pa.types.is_dictionary(field.type):
+            out[field.name] = rb.column(i).dictionary
+    return out
+
+
+def rebind_dict_columns(rb, dictionaries: dict):
+    """Codes-only batch (DICTREF-marked int32 columns) + the pools from
+    substream 0 → a batch whose dict columns are DictionaryArrays again
+    (a zero-copy rebind: the codes and pool buffers are reused as-is).
+    Batches without DICTREF markers pass through untouched."""
+    pa = pyarrow("Arrow dictionary rebind")
+    arrays, fields, changed = [], [], False
+    for i, field in enumerate(rb.schema):
+        fmd = field.metadata or {}
+        pool = dictionaries.get(field.name)
+        if DICTREF_KEY in fmd and pool is not None:
+            arr = pa.DictionaryArray.from_arrays(rb.column(i), pool)
+            fields.append(pa.field(
+                field.name, pa.dictionary(pa.int32(), pool.type),
+                nullable=field.nullable))
+            arrays.append(arr)
+            changed = True
+        else:
+            arrays.append(rb.column(i))
+            fields.append(field)
+    if not changed:
+        return rb
+    return pa.RecordBatch.from_arrays(
+        arrays, schema=pa.schema(fields, metadata=rb.schema.metadata))
 
 
 def _validity_buffer(pa, validity: Optional[np.ndarray]):
@@ -214,14 +403,40 @@ def _column_to_arrow(pa, c: Column, pa_type) -> tuple[Any, Any]:
     return arr, pa_type
 
 
-def batch_to_arrow(batch: ColumnBatch):
+def batch_to_arrow(batch: ColumnBatch,
+                   for_enc: Optional[dict] = None,
+                   strip_pools: Optional[set] = None):
     """ColumnBatch → pyarrow.RecordBatch, wrapping the existing numpy
-    buffers (no per-row path, no memcpy for fixed-width columns)."""
+    buffers (no per-row path, no memcpy for fixed-width columns).
+
+    `for_enc` ({name: (mins, words, bw, frame)} from `plan_for_wire`)
+    ships those integer columns as FOR frames.  `strip_pools` (column
+    names) ships those dict columns CODES-ONLY with a DICTREF marker —
+    the multi-stream put uses it on substreams ≥ 1 so the pool crosses
+    once per PART (on substream 0), not once per substream."""
     pa = pyarrow("ColumnBatch→Arrow conversion")
     arrays, fields = [], []
     for cs in batch.schema:
         c = batch.columns.get(cs.name)
         if c is None:
+            continue
+        if for_enc and cs.name in for_enc:
+            arrays.append(_for_array(pa, c, for_enc[cs.name]))
+            fields.append(pa.field(
+                cs.name, pa.binary(), nullable=not cs.required,
+                metadata={FOR_KEY: cs.data_type.name.encode()}))
+            continue
+        if (strip_pools and cs.name in strip_pools and c.is_lazy_dict
+                and encoded_wire_enabled()):
+            enc = c.dict_enc
+            idx = pa.Array.from_buffers(
+                pa.int32(), c.n_rows,
+                [_validity_buffer(pa, c.validity), _wrap(pa, enc.indices)])
+            arrays.append(idx)
+            fields.append(pa.field(
+                cs.name, pa.int32(), nullable=not cs.required,
+                metadata={DICTREF_KEY:
+                          str(_ARROW_TYPES[cs.data_type]).encode()}))
             continue
         arr, ftype = _column_to_arrow(pa, c, _ARROW_TYPES[cs.data_type])
         arrays.append(arr)
@@ -325,6 +540,10 @@ def arrow_to_batch(rb, table_id: Optional[TableID] = None,
             continue
         arr = rb.column(idx)
         t = arr.type
+        fmd = rb.schema.field(idx).metadata or {}
+        if FOR_KEY in fmd:
+            cols[cs.name] = _decode_for_column(cs, arr)
+            continue
         validity = np.asarray(arr.is_valid()) if arr.null_count else None
         if pa.types.is_dictionary(t):
             # shared-pool adoption (zero-copy, pool memoized) lives in

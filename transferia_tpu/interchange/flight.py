@@ -1,4 +1,4 @@
-"""Arrow Flight shard transport: DoGet/DoPut, one stream per part.
+"""Arrow Flight shard transport: DoGet/DoPut, striped streams per part.
 
 `ShardFlightServer` is the worker→worker handoff point for sharded
 snapshots: a producer (e.g. the decode plane) `put_part()`s each
@@ -7,6 +7,21 @@ them at wire speed instead of re-decoding parquet per worker.  Parts
 are keyed by `OperationTablePart.key()`-style strings (the provider
 layer uses `<namespace>.<table>/<part_index>`); a re-put of a key
 REPLACES the stored stream (retried uploads must not append duplicates).
+
+Multi-stream lane: one gRPC stream's framing loop is serialization
+bound, so `put_part`/`get_part` stripe a part's batches over N
+concurrent substreams when `interchange/streams.py` prices it
+profitable (`TRANSFERIA_TPU_FLIGHT_STREAMS` pins N; 0/unset autos from
+part bytes and the probed link).  Substream i of a put carries
+descriptor path `[key, epoch|-, "sub:i:n:token"]`; the server STAGES
+stripes under (key, token) and promotes the part atomically only when
+all n arrived — an incomplete put is never visible, a retry's fresh
+token drops stale stripes, and the epoch fence applies at promote
+exactly like a single-stream put.  Reassembly is deterministic
+round-robin (global batch j = stripe j%n position j//n).  Dict pools
+ship once per PART, not per substream: substreams ≥ 1 carry codes-only
+columns (`convert.DICTREF_KEY`) rebound to substream 0's dictionaries
+at promote/reassembly.
 
 Co-located fast path: with `enable_shm=True` the server seals each part
 into a shared-memory segment (interchange/shm.py) on first local
@@ -34,12 +49,23 @@ from transferia_tpu.columnar.batch import ColumnBatch
 from transferia_tpu.interchange import shm as shm_mod
 from transferia_tpu.interchange._pyarrow import flight as _flight
 from transferia_tpu.interchange._pyarrow import pyarrow
-from transferia_tpu.interchange.convert import arrow_to_batch, batch_to_arrow
+from transferia_tpu.interchange.convert import (
+    arrow_to_batch,
+    batch_to_arrow,
+    dict_columns_of,
+    rebind_dict_columns,
+)
 from transferia_tpu.interchange.telemetry import TELEMETRY
 
 ACTION_SHM_LOCATE = "shm_locate"
 ACTION_DROP = "drop"
 ACTION_KEYS = "keys"
+ACTION_PART_META = "part_meta"
+
+# substream DoGet tickets are `<part key>\x1f<stream idx>` — the unit
+# separator cannot appear in `<namespace>.<table>/<part>` keys, so the
+# sub-ticket namespace can never collide with a real part key
+SUB_SEP = "\x1f"
 
 # substring marker a stale-epoch put rejection carries across the gRPC
 # error (clients map it back to abstract.errors.StaleEpochPublishError)
@@ -113,6 +139,12 @@ class ShardFlightServer:
         self._lock = threading.Lock()
         # key -> (schema, [RecordBatch], rows)
         self._parts: dict[str, tuple] = {}
+        # multi-stream staging: (key, token) -> {stream idx: entry};
+        # promoted parts keep their raw stripes in _subparts (served to
+        # substream DoGets) and their stripe count in _submeta
+        self._staged: dict[tuple, dict[int, tuple]] = {}
+        self._subparts: dict[str, tuple] = {}
+        self._submeta: dict[str, tuple] = {}
         self._segments: dict[str, shm_mod.ShmHandle] = {}
         # staged-commit publish fence: key -> last accepted publish
         # epoch (puts that carry an epoch in the descriptor are fenced;
@@ -162,11 +194,17 @@ class ShardFlightServer:
                 epoch = int(descriptor.path[1].decode())
             except (ValueError, UnicodeDecodeError):
                 epoch = None
+        sub = None
+        if len(descriptor.path) > 2:
+            sub = _parse_sub(descriptor.path[2].decode())
         # adopt the CLIENT's span context (rode in as gRPC metadata):
         # the server-side span parents to the caller's flight_put span,
         # so Perfetto draws one flow arrow across the wire
         with trace.adopted(ctx):
-            self._do_put_adopted(key, reader, trace, epoch)
+            if sub is not None:
+                self._do_put_substream(key, reader, trace, epoch, *sub)
+            else:
+                self._do_put_adopted(key, reader, trace, epoch)
 
     def _do_put_adopted(self, key, reader, trace, epoch=None) -> None:
         failpoint("interchange.flight.do_put")
@@ -189,6 +227,7 @@ class ShardFlightServer:
                             f"epoch {epoch} <= published epoch {prev}")
                     self._part_epochs[key] = epoch
                 self._parts[key] = (reader.schema, rbs, rows)
+                self._drop_sub_locked(key)
                 stale = self._segments.pop(key, None)
             if stale is not None:
                 shm_mod.unlink_segment(stale)  # re-put replaces, never appends
@@ -197,13 +236,89 @@ class ShardFlightServer:
         if sp:
             sp.add(rows=rows, bytes=nbytes)
 
+    def _do_put_substream(self, key, reader, trace, epoch,
+                          idx: int, n: int, token: str) -> None:
+        """One stripe of a multi-stream part put: STAGE it, and promote
+        the part atomically when the last stripe of the token lands.
+        Incomplete staging is never visible to any read path."""
+        failpoint("flight.substream")
+        sp = trace.span("flight_do_put_sub", part=key, sub=idx)
+        with sp:
+            rbs, rows, nbytes = [], 0, 0
+            for chunk in reader:
+                rbs.append(chunk.data)
+                rows += chunk.data.num_rows
+                nbytes += chunk.data.nbytes
+            stale = None
+            with self._lock:
+                # early fence: a stale-epoch stripe fails its client
+                # thread (and with it the whole client-side put) before
+                # anything could promote
+                if epoch is not None:
+                    prev = self._part_epochs.get(key)
+                    if prev is not None and epoch < prev:
+                        raise self._fl.FlightServerError(
+                            f"{STALE_EPOCH_MARKER}: put of {key!r} at "
+                            f"epoch {epoch} <= published epoch {prev}")
+                # a NEW token supersedes older incomplete staging of the
+                # key: the retried put replaces wholesale, stale stripes
+                # must never mix into it
+                for k in [k for k in self._staged
+                          if k[0] == key and k[1] != token]:
+                    del self._staged[k]
+                stripes = self._staged.setdefault((key, token), {})
+                stripes[idx] = (reader.schema, rbs, rows)
+                if len(stripes) == n:
+                    stale = self._promote_locked(key, token, n, epoch)
+            if stale is not None:
+                shm_mod.unlink_segment(stale)
+            TELEMETRY.add(flight_streams=1, batches_in=len(rbs),
+                          bytes_in=nbytes)
+        if sp:
+            sp.add(rows=rows, bytes=nbytes)
+
+    def _promote_locked(self, key: str, token: str, n: int,
+                        epoch: Optional[int]):
+        """All n stripes landed: assemble the part (deterministic
+        round-robin, codes-only batches rebound to stripe 0's
+        dictionaries so the pool crosses once per part) and make it
+        visible in ONE step.  Returns the stale shm segment to unlink
+        outside the lock.  Caller holds self._lock."""
+        stripes = self._staged.pop((key, token))
+        if epoch is not None:
+            self._part_epochs[key] = epoch
+        per = [stripes[i] for i in range(n)]
+        dicts = dict_columns_of(per[0][1][0]) if per[0][1] else {}
+        total = sum(len(p[1]) for p in per)
+        rbs, rows = [], 0
+        for j in range(total):
+            rb = per[j % n][1][j // n]
+            if j % n and dicts:
+                rb = rebind_dict_columns(rb, dicts)
+            rbs.append(rb)
+            rows += rb.num_rows
+        self._drop_sub_locked(key)
+        self._parts[key] = (rbs[0].schema, rbs, rows)
+        for i in range(n):
+            self._subparts[f"{key}{SUB_SEP}{i}"] = per[i]
+        self._submeta[key] = (n, token)
+        return self._segments.pop(key, None)
+
+    def _drop_sub_locked(self, key: str) -> None:
+        """Forget a part's substream view (replace-wholesale: any fresh
+        put supersedes the old stripes).  Caller holds self._lock."""
+        meta = self._submeta.pop(key, None)
+        if meta:
+            for i in range(meta[0]):
+                self._subparts.pop(f"{key}{SUB_SEP}{i}", None)
+
     def _do_get(self, ticket, ctx=None):
         from transferia_tpu.stats import trace
 
         key = ticket.ticket.decode()
         failpoint("interchange.flight.do_get")
         with self._lock:
-            entry = self._parts.get(key)
+            entry = self._subparts.get(key) or self._parts.get(key)
         if entry is None:
             raise KeyError(f"flight: unknown part {key!r}")
         schema, rbs, rows = entry
@@ -243,9 +358,20 @@ class ShardFlightServer:
                 body = json.dumps(sorted(self._parts)).encode()
             return [self._fl.Result(self._pa.py_buffer(body))]
         key = action.body.to_pybytes().decode()
+        if t == ACTION_PART_META:
+            with self._lock:
+                if key not in self._parts:
+                    raise KeyError(f"flight: unknown part {key!r}")
+                meta = self._submeta.get(key)
+            body = json.dumps(
+                {"substreams": meta[0] if meta else 0}).encode()
+            return [self._fl.Result(self._pa.py_buffer(body))]
         if t == ACTION_DROP:
             with self._lock:
                 self._parts.pop(key, None)
+                self._drop_sub_locked(key)
+                for k in [k for k in self._staged if k[0] == key]:
+                    del self._staged[k]
                 seg = self._segments.pop(key, None)
             if seg is not None:
                 shm_mod.unlink_segment(seg)
@@ -299,6 +425,7 @@ class ShardFlightServer:
                     raise StaleEpochPublishError(key, epoch, prev)
                 self._part_epochs[key] = epoch
             self._parts[key] = (rbs[0].schema, rbs, rows)
+            self._drop_sub_locked(key)
             stale = self._segments.pop(key, None)
         if stale is not None:
             shm_mod.unlink_segment(stale)
@@ -311,6 +438,9 @@ class ShardFlightServer:
             segments = list(self._segments.values())
             self._segments.clear()
             self._parts.clear()
+            self._staged.clear()
+            self._subparts.clear()
+            self._submeta.clear()
         for seg in segments:
             shm_mod.unlink_segment(seg)
 
@@ -346,6 +476,68 @@ def is_local_uri(uri: str) -> bool:
     return host in _LOCAL_HOSTS or host == socket.gethostname()
 
 
+def _parse_sub(s: str) -> Optional[tuple[int, int, str]]:
+    """`sub:<i>:<n>:<token>` descriptor element → (i, n, token)."""
+    if not s.startswith("sub:"):
+        return None
+    try:
+        _tag, i, n, token = s.split(":", 3)
+        i, n = int(i), int(n)
+    except ValueError:
+        return None
+    if not (0 <= i < n and token):
+        return None
+    return i, n, token
+
+
+def _approx_part_bytes(batches) -> int:
+    """Wire-bytes estimate of a part (input to the stream-count model):
+    codes + each distinct pool once for dict columns, data + offsets
+    otherwise — the same shape the encoded wire actually ships."""
+    seen: set[int] = set()
+    total = 0
+    for b in batches:
+        for c in b.columns.values():
+            if c.is_lazy_dict:
+                enc = c.dict_enc
+                total += int(enc.indices.nbytes)
+                if id(enc.pool) not in seen:
+                    seen.add(id(enc.pool))
+                    total += int(enc.pool.nbytes())
+            else:
+                total += int(c.data.nbytes)
+                if c.offsets is not None:
+                    total += int(c.offsets.nbytes)
+    return total
+
+
+def _strippable_pools(batches) -> set[str]:
+    """Dict columns whose pool is ONE object across every batch of the
+    part: substreams ≥ 1 may ship them codes-only because substream 0's
+    single dictionary rebind covers all of them.  A column whose pool
+    varies per batch keeps full DictionaryArrays on every substream."""
+    from transferia_tpu.interchange.convert import encoded_wire_enabled
+
+    if not batches or not encoded_wire_enabled():
+        return set()
+    if any(not isinstance(b, ColumnBatch) for b in batches):
+        # pre-converted RecordBatches carry their own dictionaries;
+        # nothing to strip without the ColumnBatch pool identity
+        return set()
+    out: set[str] = set()
+    for cs in batches[0].schema:
+        pool_ids = set()
+        for b in batches:
+            c = b.columns.get(cs.name)
+            if c is None or not c.is_lazy_dict:
+                pool_ids.clear()
+                break
+            pool_ids.add(id(c.dict_enc.pool))
+        if len(pool_ids) == 1:
+            out.add(cs.name)
+    return out
+
+
 class FlightShardClient:
     """Client side of the shard handoff.
 
@@ -361,6 +553,7 @@ class FlightShardClient:
         self._client = fl.connect(uri)
         self.allow_shm = is_local_uri(uri) if allow_shm is None \
             else allow_shm
+        self._allow_meta = True  # latches False on UNIMPLEMENTED
         self._attachments: list = []  # pin mapped segments we handed out
 
     def begin_put(self, key: str, schema, epoch: Optional[int] = None):
@@ -386,39 +579,163 @@ class FlightShardClient:
             writer, _ = self._client.do_put(descriptor, schema)
         return writer
 
-    def put_part(self, key: str, batches: Iterable[ColumnBatch]) -> int:
-        from transferia_tpu.interchange.convert import EncodedWireState
+    def put_part(self, key: str, batches: Iterable[ColumnBatch],
+                 epoch: Optional[int] = None,
+                 streams: Optional[int] = None) -> int:
+        """Publish one part's batches (a re-put replaces wholesale).
+
+        `epoch` engages the server's staged-commit fence (stale epochs
+        surface as StaleEpochPublishError).  `streams` pins the
+        substream count; None lets TRANSFERIA_TPU_FLIGHT_STREAMS / the
+        stream-count model decide.  Multi-stream puts stripe batches
+        round-robin over concurrent DoPuts; any substream failure fails
+        the WHOLE put with nothing visible server-side."""
+        from transferia_tpu.interchange import streams as streams_mod
+        from transferia_tpu.interchange.convert import (
+            EncodedWireState,
+            plan_for_wire,
+        )
         from transferia_tpu.stats import trace
 
+        batches = list(batches)
+        if not batches:
+            return 0
+        all_cb = not any(isinstance(b, self._pa.RecordBatch)
+                         for b in batches)
+        # pool-once accounting rides the PART: the first batch
+        # referencing a pool ships it (an Arrow dictionary batch on
+        # substream 0), later batches are codes-only — and the ship
+        # point is chaos-injectable (a put must fail WHOLE, so a
+        # consumer never holds codes without their pool).  Tallies
+        # publish only after the part lands (wire.commit) so a failed
+        # put never counts bytes that never crossed.
         wire = EncodedWireState()
-        rbs = []
+        new_pools = 0
+        for b in batches:
+            if not isinstance(b, self._pa.RecordBatch):
+                new_pools += wire.account(b)
+        if new_pools:
+            failpoint("flight.pool_ship")
+            trace.instant("flight_pool_ship", part=key,
+                          pools=new_pools)
+        for_encs = plan_for_wire(batches, wire) if all_cb else {}
+        if streams is not None:
+            n = max(1, min(int(streams), streams_mod.MAX_STREAMS,
+                           len(batches)))
+        elif all_cb:
+            n = streams_mod.auto_substreams(
+                _approx_part_bytes(batches), len(batches))
+        else:
+            n = 1
+        if n <= 1:
+            return self._put_single(key, batches, wire, for_encs,
+                                    epoch, trace)
+        return self._put_multi(key, batches, wire, for_encs, epoch, n,
+                               trace)
+
+    def _put_single(self, key, batches, wire, for_encs, epoch,
+                    trace) -> int:
+        rbs, ci = [], 0
         for b in batches:
             if isinstance(b, self._pa.RecordBatch):
                 rbs.append(b)
                 continue
-            # pool-once accounting rides the stream: the first batch
-            # referencing a pool ships it (an Arrow dictionary batch),
-            # later batches are codes-only — and the ship point is
-            # chaos-injectable (a put must fail WHOLE, so a consumer
-            # never holds codes without their pool).  Tallies publish
-            # only after the stream lands (wire.commit) so a failed
-            # put never counts bytes that never crossed.
-            if wire.account(b):
-                failpoint("flight.pool_ship")
-            rbs.append(batch_to_arrow(b))
-        if not rbs:
-            return 0
+            fe = {nm: encs[ci] for nm, encs in for_encs.items()}
+            rbs.append(batch_to_arrow(b, for_enc=fe or None))
+            ci += 1
         rows = 0
         sp = trace.span("flight_put", part=key)
         with sp:
-            with self.begin_put(key, rbs[0].schema) as writer:
-                for rb in rbs:
-                    writer.write_batch(rb)
-                    rows += rb.num_rows
+            try:
+                with self.begin_put(key, rbs[0].schema,
+                                    epoch=epoch) as writer:
+                    for rb in rbs:
+                        writer.write_batch(rb)
+                        rows += rb.num_rows
+            except Exception as e:
+                if epoch is not None:
+                    raise_if_stale_epoch(e, key, epoch)
+                raise
             wire.commit()
             if sp:
                 sp.add(rows=rows,
                        bytes=sum(rb.nbytes for rb in rbs))
+        return rows
+
+    def _put_multi(self, key, batches, wire, for_encs, epoch, n,
+                   trace) -> int:
+        import uuid
+
+        strippable = _strippable_pools(batches)
+        token = uuid.uuid4().hex[:16]
+        # stripes carry the UNCONVERTED batches: each substream thread
+        # serializes its own stripe (batch_to_arrow is the conversion
+        # cost of the put — keeping it on the spawning thread would
+        # serialize exactly the work the striping exists to overlap).
+        # Substream 0 wraps the pools once; the pool wrap memoizes on
+        # the shared DictPool, so no cross-thread duplication.
+        stripes: list[list] = [[] for _ in range(n)]
+        for j, b in enumerate(batches):
+            fe = {nm: encs[j] for nm, encs in for_encs.items()}
+            stripes[j % n].append((b, fe))
+        rows = sum(b.num_rows if isinstance(b, self._pa.RecordBatch)
+                   else b.n_rows for b in batches)
+        errors: list = [None] * n
+        nbytes: list = [0] * n
+
+        def run(i: int) -> None:
+            writer = None
+            try:
+                desc = self._fl.FlightDescriptor.for_path(
+                    key, "-" if epoch is None else str(epoch),
+                    f"sub:{i}:{n}:{token}")
+                options = _trace_call_options(self._fl)
+                for b, fe in stripes[i]:
+                    if isinstance(b, self._pa.RecordBatch):
+                        rb = b
+                    else:
+                        rb = batch_to_arrow(
+                            b, for_enc=fe or None,
+                            strip_pools=strippable if i else None)
+                    if writer is None:
+                        if options is not None:
+                            writer, _ = self._client.do_put(
+                                desc, rb.schema, options=options)
+                        else:
+                            writer, _ = self._client.do_put(
+                                desc, rb.schema)
+                    writer.write_batch(rb)
+                    nbytes[i] += rb.nbytes
+                if writer is not None:
+                    writer.close()  # surfaces the server-side verdict
+                    writer = None
+            except BaseException as e:
+                errors[i] = e
+                if writer is not None:
+                    try:
+                        writer.close()
+                    except Exception:  # trtpu: ignore[EXC001] — best-effort close; errors[i] already carries the fault
+                        pass
+
+        sp = trace.span("flight_put", part=key, substreams=n)
+        with sp:
+            threads = [threading.Thread(target=run, args=(i,),
+                                        daemon=True) for i in range(n)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            err = next((e for e in errors if e is not None), None)
+            if err is not None:
+                # the server never promoted (an incomplete token stages
+                # invisibly and the retry's fresh token drops it)
+                if epoch is not None:
+                    raise_if_stale_epoch(err, key, epoch)
+                raise err
+            wire.commit()
+            TELEMETRY.add(substreams_out=n)
+            if sp:
+                sp.add(rows=rows, substreams=n, bytes=sum(nbytes))
         return rows
 
     def get_part(self, key: str) -> list[ColumnBatch]:
@@ -432,6 +749,14 @@ class FlightShardClient:
                     if sp:
                         sp.add(transport="shm")
                     return batches
+            meta = self._part_meta(key)
+            n = int(meta.get("substreams", 0)) if meta else 0
+            if n > 1:
+                out = self._get_multi(key, n)
+                if sp:
+                    sp.add(transport="grpc", substreams=n,
+                           batches=len(out))
+                return out
             options = _trace_call_options(self._fl)
             ticket = self._fl.Ticket(key.encode())
             reader = (self._client.do_get(ticket, options=options)
@@ -443,6 +768,77 @@ class FlightShardClient:
             if sp:
                 sp.add(transport="grpc", batches=len(out))
             return out
+
+    def _part_meta(self, key: str) -> Optional[dict]:
+        """The server's substream layout for a part (None on servers
+        without the action or when the part is unknown — the caller
+        falls back to the single-stream DoGet either way)."""
+        if not self._allow_meta:
+            return None
+        try:
+            results = list(self._client.do_action(
+                (ACTION_PART_META, key.encode())))
+            return json.loads(results[0].body.to_pybytes())
+        except Exception as e:
+            if isinstance(e, getattr(self._fl,
+                                     "FlightUnimplementedError", ())):
+                self._allow_meta = False  # pre-substream server
+            return None
+
+    def _get_multi(self, key: str, n: int) -> list[ColumnBatch]:
+        """n concurrent DoGets over the part's raw stripes, reassembled
+        round-robin; codes-only batches rebind to substream 0's
+        dictionaries (the one pool ship of the part) before adoption.
+
+        Adoption (arrow_to_batch) runs INSIDE each reader thread — the
+        decode cost of the get is exactly what the striping exists to
+        overlap.  Substreams ≥ 1 block on an event until substream 0's
+        first batch lands (it carries the part's only pool ship), then
+        rebind and adopt as their own chunks stream in."""
+        results: list = [None] * n
+        errors: list = [None] * n
+        dicts: dict = {}
+        dicts_ready = threading.Event()
+
+        def run(i: int) -> None:
+            try:
+                options = _trace_call_options(self._fl)
+                ticket = self._fl.Ticket(
+                    f"{key}{SUB_SEP}{i}".encode())
+                reader = (self._client.do_get(ticket, options=options)
+                          if options is not None
+                          else self._client.do_get(ticket))
+                out: list = []
+                for chunk in reader:
+                    rb = chunk.data
+                    if i == 0 and not out:
+                        dicts.update(dict_columns_of(rb))
+                        dicts_ready.set()
+                    if i:
+                        dicts_ready.wait()
+                        if dicts:
+                            rb = rebind_dict_columns(rb, dicts)
+                    out.append(arrow_to_batch(rb))
+                results[i] = out
+            except BaseException as e:
+                errors[i] = e
+            finally:
+                if i == 0:
+                    dicts_ready.set()  # empty/failed stripe 0 must
+                    #                    never strand the waiters
+
+        threads = [threading.Thread(target=run, args=(i,), daemon=True)
+                   for i in range(n)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        err = next((e for e in errors if e is not None), None)
+        if err is not None:
+            raise err
+        TELEMETRY.add(substreams_in=n)
+        total = sum(len(r) for r in results)
+        return [results[j % n][j // n] for j in range(total)]
 
     def _try_shm(self, key: str) -> Optional[list[ColumnBatch]]:
         try:

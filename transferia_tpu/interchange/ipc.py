@@ -83,14 +83,52 @@ class StreamWriter:
 
 
 def write_stream(loc: str, batches) -> int:
-    """Write batches (one table) to a location; returns rows written."""
-    w = StreamWriter(open_location(loc, "wb"))
+    """Write batches (one table) to a location; returns rows written.
+
+    A materialized batch LIST gets the FOR wire: integer columns that
+    pass the `convert.plan_for_wire` all-batches guard cross as packed
+    frame-of-reference payloads (an IPC stream's schema is fixed at
+    open, so the plan needs the whole list — the incremental
+    `StreamWriter` keeps plain ints)."""
+    if not isinstance(batches, (list, tuple)):
+        w = StreamWriter(open_location(loc, "wb"))
+        try:
+            for b in batches:
+                w.write(b)
+        finally:
+            w.close()
+        return w.rows_written
+    from transferia_tpu.interchange.convert import (
+        EncodedWireState,
+        plan_for_wire,
+    )
+
+    pa = pyarrow("Arrow IPC stream writing")
+    cbs = [b for b in batches if not isinstance(b, pa.RecordBatch)]
+    wire = EncodedWireState()  # pool-once per stream
+    for b in cbs:
+        wire.account(b)
+    for_encs = plan_for_wire(cbs, wire) \
+        if cbs and len(cbs) == len(batches) else {}
+    rows, writer = 0, None
+    fobj = open_location(loc, "wb")
     try:
-        for b in batches:
-            w.write(b)
+        for ci, b in enumerate(batches):
+            if isinstance(b, pa.RecordBatch):
+                rb = b
+            else:
+                fe = {nm: encs[ci] for nm, encs in for_encs.items()}
+                rb = batch_to_arrow(b, for_enc=fe or None)
+            if writer is None:
+                writer = pa.ipc.new_stream(fobj, rb.schema)
+            writer.write_batch(rb)
+            rows += rb.num_rows
+        if writer is not None:
+            writer.close()
+        wire.commit()  # tallies publish only for landed bytes
     finally:
-        w.close()
-    return w.rows_written
+        fobj.close()
+    return rows
 
 
 def read_schema(fobj: IO[bytes]):

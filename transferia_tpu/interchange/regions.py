@@ -1,0 +1,239 @@
+"""Region buffer pool: refcounted seal-once buffers for the wire.
+
+The multi-stream transport lane frames encoded pages straight from the
+decode plane into the transport buffer and hands consumers VIEWS of
+that buffer — decode-memmap → IPC frame → socket with zero
+intermediate copies.  The ownership discipline is Zerrow-style
+(PAPERS.md): a `Region` is allocated writable, filled by exactly one
+writer (scatter/gather of the pool-once Arrow frames), SEALED once,
+and thereafter immutable and many-reader; refcounts — not Python GC —
+decide when the backing memory dies, so a reader holding a view can
+outlive the writer's `close()` (shm regions defer their unmap exactly
+like `shm.ShmAttachment`).
+
+Rules (ARCHITECTURE.md "Multi-stream transport"):
+
+- one writer, pre-seal only: `writer_buffer()` raises once sealed;
+- `seal()` exactly once (chaos: the `region.seal` failpoint) — a
+  region that fails to seal disposes instead of leaking a writable
+  buffer to a reader;
+- readers call `retain()` before adopting a `view()` and `release()`
+  when the adopted batches die; release-to-zero disposes the backing
+  memory (heap) or unmaps it (shm), deferring while numpy/pyarrow
+  exports still pin the mapping;
+- accounting is folded into `InterchangeStats`: `regions_sealed`,
+  and the pinned-vs-copied byte split (`region_pinned_bytes` vs
+  `region_copied_bytes`) — a region path claiming zero-copy must show
+  zero `region_copied_bytes`.
+"""
+
+from __future__ import annotations
+
+from multiprocessing import shared_memory
+from typing import Optional
+
+from transferia_tpu.chaos.failpoints import failpoint
+from transferia_tpu.interchange._pyarrow import pyarrow
+from transferia_tpu.interchange.telemetry import TELEMETRY
+from transferia_tpu.runtime import lockwatch
+from transferia_tpu.stats import trace
+
+REGION_PREFIX = "trtpu-region-"
+
+
+class RegionError(RuntimeError):
+    """Ownership-discipline violation (write after seal, view before
+    seal, release past zero) — always a caller bug, never absorbed."""
+
+
+class Region:
+    """One refcounted seal-once buffer (heap bytearray or shm segment).
+
+    The allocator holds the initial reference; `close()` drops it.
+    Every reader that adopts a view takes its own reference."""
+
+    def __init__(self, size: int, kind: str = "heap",
+                 unlink_on_dispose: bool = False):
+        if kind not in ("heap", "shm"):
+            raise ValueError(f"region kind {kind!r}: heap|shm")
+        self.size = int(size)
+        self.kind = kind
+        self.sealed = False
+        self.name: Optional[str] = None
+        self._rc = 1
+        self._disposed = False
+        self._unlink = unlink_on_dispose
+        self._lock = lockwatch.named_lock("region.rc")
+        self._seg = None
+        if kind == "shm":
+            self._seg = shared_memory.SharedMemory(create=True,
+                                                   size=max(1, self.size))
+            self.name = self._seg.name
+            self._mem = self._seg.buf
+        else:
+            self._mem = memoryview(bytearray(max(1, self.size)))
+        pa = pyarrow("the region buffer pool")
+        # one pa.py_buffer for the region's lifetime: every view slices
+        # it, so numpy `.base` chains of adopted batches root HERE and
+        # the export count tells dispose when readers are truly gone
+        self._buf = pa.py_buffer(self._mem)
+
+    # -- writer side ---------------------------------------------------------
+    def writer_buffer(self):
+        """The writable pyarrow buffer (pre-seal only): the target of
+        the one permitted copy (producer → region), via
+        `pa.FixedSizeBufferWriter` scatter/gather framing."""
+        with self._lock:
+            if self.sealed:
+                raise RegionError("region is sealed: write refused")
+            if self._disposed:
+                raise RegionError("region is disposed")
+        return self._buf
+
+    def seal(self) -> None:
+        """Freeze the region (exactly once).  A seal failure disposes
+        the region — an unsealed buffer must never reach a reader."""
+        with self._lock:
+            if self.sealed:
+                raise RegionError("region already sealed")
+            if self._disposed:
+                raise RegionError("region is disposed")
+        try:
+            failpoint("region.seal")
+        except BaseException:
+            self.close()
+            raise
+        with self._lock:
+            self.sealed = True
+        trace.instant("region_seal", kind=self.kind, bytes=self.size)
+        TELEMETRY.add(regions_sealed=1)
+
+    # -- reader side ---------------------------------------------------------
+    def retain(self) -> "Region":
+        with self._lock:
+            if self._disposed:
+                raise RegionError("region is disposed: retain refused")
+            self._rc += 1
+        return self
+
+    def release(self) -> None:
+        with self._lock:
+            if self._rc <= 0:
+                raise RegionError("region released past zero")
+            self._rc -= 1
+            dead = self._rc == 0 and not self._disposed
+            if dead:
+                self._disposed = True
+        if dead:
+            self._dispose()
+
+    def close(self) -> None:
+        """Drop the allocator's reference (idempotent)."""
+        with self._lock:
+            if self._disposed or self._rc <= 0:
+                return
+        self.release()
+
+    @property
+    def refcount(self) -> int:
+        with self._lock:
+            return self._rc
+
+    @property
+    def disposed(self) -> bool:
+        with self._lock:
+            return self._disposed
+
+    def view(self, offset: int = 0, length: Optional[int] = None):
+        """A zero-copy pa.Buffer slice of the sealed region (reader
+        must hold a reference via `retain()` for the view's lifetime).
+        Tallied as pinned bytes — the region path's zero-copy proof."""
+        with self._lock:
+            if not self.sealed:
+                raise RegionError("region not sealed: view refused")
+            if self._disposed:
+                raise RegionError("region is disposed")
+        length = self.size - offset if length is None else length
+        TELEMETRY.add(region_pinned_bytes=length)
+        return self._buf[offset:offset + length]
+
+    def read_copy(self, offset: int = 0, length: Optional[int] = None
+                  ) -> bytes:
+        """Materialize a slice (the copying escape hatch, tallied so a
+        'zero-copy' path that quietly materializes shows up)."""
+        with self._lock:
+            if not self.sealed:
+                raise RegionError("region not sealed: read refused")
+        length = self.size - offset if length is None else length
+        TELEMETRY.add(region_copied_bytes=length)
+        return bytes(self._mem[offset:offset + length])
+
+    # -- disposal ------------------------------------------------------------
+    def _dispose(self) -> None:
+        from transferia_tpu.interchange import shm as shm_mod
+
+        self._buf = None
+        mem, self._mem = self._mem, None
+        seg, self._seg = self._seg, None
+        if self.kind == "heap":
+            return  # dropping the refs frees the bytearray
+        # shm: our memoryview of seg.buf must go before close(); numpy
+        # views adopted by still-live batches keep the pa.Buffer (and
+        # through it the mapping) alive — defer the unmap until they
+        # die, exactly like a closed ShmAttachment
+        del mem
+        if seg is not None:
+            shm_mod._close_or_defer(seg)
+            if self._unlink:
+                try:
+                    shared_memory.SharedMemory(name=seg.name)
+                except FileNotFoundError:
+                    pass
+                else:
+                    seg.unlink()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def frame_batches(rbs, kind: str = "heap",
+                  unlink_on_dispose: bool = False) -> Region:
+    """Serialize Arrow RecordBatches into a sealed region as ONE IPC
+    stream: a counting pass sizes the region exactly, then the stream
+    writes straight into the mapped memory (the single producer→region
+    copy of the handoff) and the region seals.  Consumers open
+    `pa.ipc.open_stream` over `region.view()` and adopt batches whose
+    buffers view the region in place."""
+    pa = pyarrow("the region buffer pool")
+    if not rbs:
+        raise ValueError("regions.frame_batches: no batches")
+    mock = pa.MockOutputStream()
+    with pa.ipc.new_stream(mock, rbs[0].schema) as w:
+        for rb in rbs:
+            w.write_batch(rb)
+    region = Region(mock.size(), kind=kind,
+                    unlink_on_dispose=unlink_on_dispose)
+    try:
+        sink = pa.FixedSizeBufferWriter(region.writer_buffer())
+        with pa.ipc.new_stream(sink, rbs[0].schema) as w:
+            for rb in rbs:
+                w.write_batch(rb)
+        sink.close()
+        region.seal()
+    except BaseException:
+        if not region.disposed:
+            self_close(region)
+        raise
+    return region
+
+
+def self_close(region: Region) -> None:
+    """Best-effort close that never masks the propagating error."""
+    try:
+        region.close()
+    except Exception:  # trtpu: ignore[EXC001] — best-effort cleanup on an already-propagating error
+        pass

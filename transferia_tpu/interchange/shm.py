@@ -78,19 +78,33 @@ def write_segment(batches: Iterable[ColumnBatch]) -> ShmHandle:
 
     Sizes the segment exactly with a counting pass over the already-
     wrapped Arrow batches (MockOutputStream measures framing without
-    writing), then streams into the mapped memory — the single copy of
-    the handoff."""
-    from transferia_tpu.interchange.convert import EncodedWireState
+    writing), then streams into a shm-backed `regions.Region` — the
+    single producer→region copy of the handoff, sealed before the
+    handle is handed out.  The segment NAME outlives the writer's
+    mapping (readers attach by name; retirement stays `unlink_segment`)
+    — the region only owns the writer-side mapping lifetime."""
+    from transferia_tpu.interchange import regions as regions_mod
+    from transferia_tpu.interchange.convert import (
+        EncodedWireState,
+        plan_for_wire,
+    )
 
     pa = pyarrow("the shared-memory handoff")
+    batches = list(batches)
     wire = EncodedWireState()  # pool-once per segment (one IPC stream)
-    rbs = []
+    cbs = [b for b in batches if not isinstance(b, pa.RecordBatch)]
+    for b in cbs:
+        wire.account(b)
+    for_encs = plan_for_wire(cbs, wire) \
+        if cbs and len(cbs) == len(batches) else {}
+    rbs, ci = [], 0
     for b in batches:
         if isinstance(b, pa.RecordBatch):
             rbs.append(b)
-        else:
-            wire.account(b)
-            rbs.append(batch_to_arrow(b))
+            continue
+        fe = {nm: encs[ci] for nm, encs in for_encs.items()}
+        rbs.append(batch_to_arrow(b, for_enc=fe or None))
+        ci += 1
     if not rbs:
         raise ValueError("shm.write_segment: no batches")
     rbs = _stamp_trace(rbs)
@@ -99,24 +113,30 @@ def write_segment(batches: Iterable[ColumnBatch]) -> ShmHandle:
         for rb in rbs:
             w.write_batch(rb)
     size = mock.size()
-    seg = shared_memory.SharedMemory(create=True, size=size)
+    region = regions_mod.Region(size, kind="shm")
     try:
-        _fill_segment(pa, seg, rbs)
+        _fill_region(pa, region, rbs)
+        region.seal()
         wire.commit()  # pool-once tallies publish once the seal lands
         TELEMETRY.add(shm_segments=1, bytes_out=size)
-        handle = ShmHandle(name=seg.name, size=size)
+        handle = ShmHandle(name=region.name, size=size)
     except BaseException:
-        seg.close()
-        seg.unlink()
+        # a failed fill/seal retires the segment NAME too — nothing was
+        # handed out, so nobody can be attached
+        name = region.name
+        regions_mod.self_close(region)
+        if name:
+            unlink_segment(ShmHandle(name=name, size=size))
         raise
-    seg.close()  # the name stays valid until unlink()
+    region.close()
     return handle
 
 
-def _fill_segment(pa, seg, rbs) -> None:
-    """Stream into the mapping in its own scope: the pa.Buffer's export
-    on `seg.buf` must release before the caller's `seg.close()`."""
-    sink = pa.FixedSizeBufferWriter(pa.py_buffer(seg.buf))
+def _fill_region(pa, region, rbs) -> None:
+    """Stream into the region in its own scope: the writer's export on
+    the region buffer must release before the caller's region.close()
+    can unmap promptly (a lingering export just defers the unmap)."""
+    sink = pa.FixedSizeBufferWriter(region.writer_buffer())
     with pa.ipc.new_stream(sink, rbs[0].schema) as w:
         for rb in rbs:
             w.write_batch(rb)

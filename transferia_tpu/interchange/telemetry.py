@@ -29,6 +29,18 @@ _FIELDS = (
     "pool_bytes_shipped",
     "codes_bytes_shipped",
     "flat_equiv_bytes",
+    # multi-stream transport lane (flight.py substreams): concurrent
+    # DoPut/DoGet substreams opened per part, beyond the part stream
+    # itself — flight_streams counts wire streams, these count the
+    # parallelism the striping added on top
+    "substreams_out",
+    "substreams_in",
+    # region buffer pool (regions.py): sealed regions, and the
+    # pinned-vs-copied byte split of what reached a region — the
+    # zero-intermediate-copy honesty counters of the region path
+    "regions_sealed",
+    "region_pinned_bytes",
+    "region_copied_bytes",
 )
 
 

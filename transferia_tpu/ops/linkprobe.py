@@ -19,6 +19,13 @@ ops/fused.py's chunk sizing.  TRANSFERIA_TPU_LINK="rtt_ms,h2d_mbs,d2h_mbs"
 overrides the measurement (tests pin placement decisions with it); on the
 CPU backend the "link" is in-process and a constant ideal profile is
 returned without measuring.
+
+`interchange/streams.py` prices the worker↔worker WIRE the same way
+this module prices the host↔device link: one probe per process, an env
+pin (`TRANSFERIA_TPU_STREAM_LINK`), and the identical degraded-profile
+re-probe contract (`TRANSFERIA_TPU_STREAM_REPROBE`, mirroring
+`TRANSFERIA_TPU_LINK_REPROBE` below) — keep the two contracts in sync
+when either changes.
 """
 
 from __future__ import annotations

@@ -208,8 +208,6 @@ class FlightSinker(Sinker, StagedSinker):
         self._stage = PartStage(key, epoch, hold=True)
 
     def publish_part(self, key: str, epoch: int) -> int:
-        from transferia_tpu.interchange.convert import batch_to_arrow
-        from transferia_tpu.interchange.flight import raise_if_stale_epoch
         from transferia_tpu.providers.staging import (
             part_slug,
             publish_guard,
@@ -217,9 +215,13 @@ class FlightSinker(Sinker, StagedSinker):
 
         if self._stage is None:
             raise RuntimeError(f"flight sink: no open stage for {key!r}")
-        # group the staged blocks per table: one epoch-fenced DoPut
-        # stream per `<ns>.<table>/<part>` wire key, replacing whatever
-        # an earlier publish of this part streamed
+        # group the staged blocks per table: one epoch-fenced put per
+        # `<ns>.<table>/<part>` wire key, replacing whatever an earlier
+        # publish of this part streamed.  put_part owns the rest of the
+        # wire contract: pool-once accounting, FOR planning, the
+        # stream-count model's substream choice, all-or-nothing
+        # multi-stream failure, and stale-epoch mapping — a non-stale
+        # wire failure propagates so the part republishes idempotently.
         by_table: dict[TableID, list] = {}
         for batch in self._stage.batches:
             for b in self._blocks(batch):
@@ -227,26 +229,9 @@ class FlightSinker(Sinker, StagedSinker):
         rows = 0
         with publish_guard(key, epoch):
             for tid, blocks in by_table.items():
-                from transferia_tpu.interchange.convert import (
-                    EncodedWireState,
-                )
-
-                wire = EncodedWireState()  # pool-once per publish stream
                 wire_key = part_key(tid, f"part-{part_slug(key)}")
-                rbs = []
-                for b in blocks:
-                    wire.account(b)
-                    rbs.append(batch_to_arrow(b))
-                try:
-                    writer = self._client.begin_put(
-                        wire_key, rbs[0].schema, epoch=epoch)
-                    with writer:
-                        for rb in rbs:
-                            writer.write_batch(rb)
-                            rows += rb.num_rows
-                    wire.commit()  # only landed streams count
-                except Exception as e:
-                    raise_if_stale_epoch(e, wire_key, epoch)
+                rows += self._client.put_part(wire_key, blocks,
+                                              epoch=epoch)
         self.last_dedup_dropped = self._stage.dedup_dropped
         self._stage = None
         return rows

@@ -647,64 +647,10 @@ class NativeParquetReader:
         return pf
 
 
-def _remap_codes(canon: DictPool, data: np.ndarray,
-                 offsets: np.ndarray,
-                 n_pool: int) -> Optional[np.ndarray]:
-    """Remap table from THIS page's codes onto the canonical pool's,
-    or None when the page carries a value outside the canonical pool
-    (a genuinely new dictionary — the caller re-interns instead).
-
-    The canonical pool's bytes→code index memoizes on the pool; the
-    null SENTINEL slot is excluded from it so a real empty-bytes value
-    can never alias onto the sentinel (the mask plane empties the
-    sentinel's hex slot — aliasing would silently unmask '' rows).
-    The returned table has n_pool+1 entries: the page's own sentinel
-    (code n_pool) maps to the canonical sentinel."""
-    if canon.null_code is None:
-        return None
-    if n_pool == 0:
-        return np.array([canon.null_code], dtype=np.int32)
-    from transferia_tpu.columnar.batch import _gather_varwidth
-    from transferia_tpu.ops.rowhash import pool_accumulators
-
-    memo = canon.memo_get(("remap_keys",))
-    if memo is None:
-        a1, a2 = pool_accumulators(canon)
-        ckeys = (a1.astype(np.uint64) << np.uint64(32)) \
-            | a2.astype(np.uint64)
-        # poison the sentinel's key: a real empty-bytes value must
-        # never alias onto the null sentinel (the mask plane empties
-        # the sentinel's hex slot — aliasing would silently unmask ''
-        # rows); the exact verification below backstops any residual
-        # collision with the poison value
-        ckeys = ckeys.copy()
-        ckeys[canon.null_code] = np.uint64(0xFFFFFFFFFFFFFFFF)
-        sorter = np.argsort(ckeys, kind="stable")
-        memo = (ckeys[sorter], sorter)
-        canon.memo_set(("remap_keys",), memo)
-    sorted_keys, sorter = memo
-    pool_bytes = int(offsets[n_pool])
-    page_pool = DictPool(data[:pool_bytes],
-                         np.ascontiguousarray(offsets[:n_pool + 1],
-                                              dtype=np.int32))
-    p1, p2 = pool_accumulators(page_pool)
-    pkeys = (p1.astype(np.uint64) << np.uint64(32)) \
-        | p2.astype(np.uint64)
-    pos = np.searchsorted(sorted_keys, pkeys)
-    cand = sorter[np.minimum(pos, canon.n_values - 1)]
-    # the keys are 64-bit content hashes — verify the implied mapping
-    # byte-EXACTLY (one native gather + two memcmps); any miss (value
-    # outside the pool, or a hash collision) rejects the remap and the
-    # caller re-interns, so a wrong code can never reach a consumer
-    g_data, g_off = _gather_varwidth(
-        canon.values_data,
-        np.ascontiguousarray(canon.values_offsets, dtype=np.int32),
-        cand.astype(np.int64))
-    if not (np.array_equal(g_off, offsets[:n_pool + 1])
-            and np.array_equal(g_data, data[:pool_bytes])):
-        return None
-    return np.append(cand.astype(np.int32),
-                     np.int32(canon.null_code))
+# order-insensitive code remap onto a canonical pool: the guard-chain
+# and byte-exact verification live with the intern machinery in
+# columnar/batch.py (shared with the arrow dictionary adoption path)
+from transferia_tpu.columnar.batch import remap_codes_onto as _remap_codes
 
 
 def dict_encoded_columns(meta, names) -> tuple:

@@ -276,6 +276,18 @@ class InterchangeStats(_Bundle):
         self.flat_equiv_bytes = self.m.counter(
             "interchange_flat_equiv_bytes")
         self.encoded_wire_ratio = self.m.gauge("encoded_wire_ratio")
+        # multi-stream transport lane (interchange/flight.py): DoPut /
+        # DoGet substreams striped per part on top of the part stream
+        self.substreams_out = self.m.counter("interchange_substreams_out")
+        self.substreams_in = self.m.counter("interchange_substreams_in")
+        # region buffer pool (interchange/regions.py): sealed regions
+        # and the pinned-vs-copied byte split — zero region_copied_bytes
+        # on the region path is the zero-intermediate-copy proof
+        self.regions_sealed = self.m.counter("interchange_regions_sealed")
+        self.region_pinned_bytes = self.m.counter(
+            "interchange_region_pinned_bytes")
+        self.region_copied_bytes = self.m.counter(
+            "interchange_region_copied_bytes")
 
 
 class ChaosStats(_Bundle):

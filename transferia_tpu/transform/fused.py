@@ -397,14 +397,36 @@ class DeviceFusedStep(Transformer):
                 dmi = dict_mask_input(bytes(key), col)
                 if dmi is not None:
                     # stays in the program (digests byte-identical to
-                    # the flat route; hex output consumed identically)
+                    # the flat route), but the OUTPUT keeps the
+                    # encoding: the digest-rows memo dict_mask_input
+                    # just warmed makes the hexed pool a conversion,
+                    # not a re-hash, and the codes rebind to it —
+                    # mesh outputs stay dict-encoded end to end
+                    # instead of rematerializing rows*64 hex bytes on
+                    # the host.  (The input must stay in mask_inputs:
+                    # the sharded program zips its key states with
+                    # inputs positionally.)
                     mask_inputs.append(dmi)
-                    flat_entries.append(name)
+                    from transferia_tpu.ops.dispatch import (
+                        device_hmac_dict_pool,
+                    )
+
+                    hexed = device_hmac_dict_pool(bytes(key),
+                                                  col.dict_enc.pool,
+                                                  col.n_rows)
+                    if hexed is not None:
+                        from transferia_tpu.transform.plugins.mask \
+                            import dict_hex_column
+
+                        dict_cols[name] = dict_hex_column(col, hexed)
+                        flat_entries.append((name, True))
+                    else:
+                        flat_entries.append((name, False))
                     continue
                 # economics-rejected pool: the flat block wire, as the
                 # mesh always shipped before the dict route existed
             mask_inputs.append((col.data, col.offsets))
-            flat_entries.append(name)
+            flat_entries.append((name, False))
             flat_states.append(states)
         pred_inputs = {}
         for name in self.pred_cols:
@@ -426,7 +448,9 @@ class DeviceFusedStep(Transformer):
 
         with stagetimer.stage("host_post"), trace.span("host_post"):
             cols = dict(batch.columns)
-            for name, hx in zip(flat_entries, hexes):
+            for (name, preserved), hx in zip(flat_entries, hexes):
+                if preserved:
+                    continue  # dict_cols carries the rebound column
                 validity = batch.column(name).validity
                 data, offsets = hex_to_varwidth(hx, validity)
                 cols[name] = Column(name, CanonicalType.UTF8, data,
