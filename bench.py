@@ -1307,6 +1307,14 @@ TOLERANCE_OVERRIDES = {
     # shrink) and in tests, not through this ratio
     "interchange_multistream_rows_per_sec": 0.5,
     "interchange_stream4_speedup": 1.0,
+    # staging-store reads are lexsort-bound and swing with the 1-core
+    # boxes' scheduling; the cutover seal is a sub-ms in-memory
+    # decision where a single preemption doubles the mean — the
+    # correctness half (compaction equivalence, no-flatten pin) gates
+    # through the run's own `ok`, not through these bands
+    "mvcc_merge_layered_rows_per_sec": 0.4,
+    "mvcc_merge_compacted_rows_per_sec": 0.4,
+    "mvcc_cutover_ms": 0.8,
 }
 
 
@@ -1826,6 +1834,20 @@ def measure_fleet() -> dict:
     )
 
 
+def measure_mvcc() -> dict:
+    """`--mvcc`: the MVCC staging store's two read shapes — layered
+    merge-on-read vs the compacted base — plus the cutover seal
+    latency floor (mvcc/bench.py).  The run self-checks compaction
+    row-equivalence and the zero-flat-materializations pin; both fold
+    into `ok`."""
+    from transferia_tpu.mvcc.bench import run_mvcc_bench
+
+    return run_mvcc_bench(
+        rows=knobs.env_int("BENCH_MVCC_ROWS", 200_000),
+        layers=knobs.env_int("BENCH_MVCC_LAYERS", 12),
+    )
+
+
 def main() -> int:
     from transferia_tpu.stats import stagetimer
 
@@ -1893,6 +1915,24 @@ def main() -> int:
         if report.get("replication_lag_count"):
             _emit({"metric": "replication_lag_p99_ms", "unit": "ms",
                    "value": report["replication_lag_p99_ms"]})
+        print(json.dumps(report))
+        return gated(0 if report["ok"] else 1)
+
+    if "--mvcc" in sys.argv[1:]:
+        # standalone stage: layered vs compacted staging-store reads +
+        # cutover seal latency (one JSON line); the run self-checks
+        # compaction equivalence and the no-flatten pin
+        from transferia_tpu.mvcc.bench import format_report as _fmt_mvcc
+
+        report = measure_mvcc()
+        for line in _fmt_mvcc(report).splitlines():
+            print(f"# {line}", file=sys.stderr)
+        _METRICS_EMITTED.append(report)
+        _emit({"metric": "mvcc_merge_compacted_rows_per_sec",
+               "unit": "rows/sec",
+               "value": report["compacted_rows_per_sec"]})
+        _emit({"metric": "mvcc_cutover_ms", "unit": "ms",
+               "value": report["cutover_ms"]})
         print(json.dumps(report))
         return gated(0 if report["ok"] else 1)
 

@@ -1,0 +1,140 @@
+"""MVCC control-doc conformance: memory + filestore + s3 (+ the LWW
+degrade) must implement byte-identical admission/cutover/prune
+semantics (abstract/mvccfence.py) around their own atomicity
+primitive — including the zombie-snapshot-worker-publishes-after-
+cutover fence."""
+
+import pytest
+
+from transferia_tpu.abstract import mvccfence
+from transferia_tpu.coordinator import (
+    FileStoreCoordinator,
+    MemoryCoordinator,
+    S3Coordinator,
+)
+
+
+@pytest.fixture(params=["memory", "filestore", "s3", "s3-lww"])
+def cp(request, tmp_path):
+    if request.param == "memory":
+        yield MemoryCoordinator()
+        return
+    if request.param == "filestore":
+        yield FileStoreCoordinator(root=str(tmp_path / "cp"))
+        return
+    from tests.recipes.fake_s3 import FakeS3
+
+    fake = FakeS3(
+        conditional_writes=(request.param == "s3"), page_size=3,
+    ).start()
+    try:
+        yield S3Coordinator(
+            bucket="cp-bucket", endpoint=fake.endpoint,
+            access_key="test-ak", secret_key="test-sk",
+        )
+    finally:
+        fake.stop()
+
+
+def layer(worker="w0", seq=0, lsn_min=100, lsn_max=110, rows=8,
+          table="s.t", content_key="abc"):
+    return {"worker": worker, "seq": seq, "table": table,
+            "lsn_min": lsn_min, "lsn_max": lsn_max, "rows": rows,
+            "content_key": content_key}
+
+
+SCOPE = "mvcc/t1"
+
+
+class TestMvccConformance:
+    def test_supports(self, cp):
+        assert cp.supports_mvcc()
+
+    def test_empty_state(self, cp):
+        st = cp.mvcc_state(SCOPE)
+        assert st["layers"] == []
+        assert st["cutover"] is None
+        assert st["watermark"] == -1
+
+    def test_admit_and_state(self, cp):
+        d = cp.mvcc_admit_layer(SCOPE, layer(seq=0))
+        assert d["status"] == mvccfence.ADMITTED
+        d = cp.mvcc_admit_layer(SCOPE, layer(seq=1, lsn_min=111,
+                                             lsn_max=120))
+        assert d["status"] == mvccfence.ADMITTED and d["layers"] == 2
+        st = cp.mvcc_state(SCOPE)
+        assert [(x["worker"], x["seq"]) for x in st["layers"]] == \
+            [("w0", 0), ("w0", 1)]
+        assert st["watermark"] == 120
+
+    def test_admit_replace_is_idempotent_and_keeps_order(self, cp):
+        cp.mvcc_admit_layer(SCOPE, layer(seq=0))
+        cp.mvcc_admit_layer(SCOPE, layer(seq=1, lsn_max=120))
+        # lost ack: the worker re-sends the FIRST admission with a
+        # corrected content key — replaced in the same slot
+        d = cp.mvcc_admit_layer(SCOPE, layer(seq=0, content_key="xyz"))
+        assert d["status"] == mvccfence.REPLACED
+        st = cp.mvcc_state(SCOPE)
+        assert [(x["seq"], x["content_key"]) for x in st["layers"]] == \
+            [(0, "xyz"), (1, "abc")]
+
+    def test_cutover_first_wins_then_idempotent(self, cp):
+        cp.mvcc_admit_layer(SCOPE, layer(seq=0, lsn_max=115))
+        d = cp.mvcc_cutover(SCOPE, 115, 2)
+        assert d == {"granted": True, "first": True, "watermark": 115,
+                     "epoch": 2}
+        # identical retry (activation crashed after the seal): granted
+        d = cp.mvcc_cutover(SCOPE, 115, 2)
+        assert d["granted"] and not d["first"]
+        # a DIFFERENT decision is fenced and handed the sealed values
+        d = cp.mvcc_cutover(SCOPE, 999, 3)
+        assert not d["granted"]
+        assert (d["watermark"], d["epoch"]) == (115, 2)
+
+    def test_zombie_snapshot_worker_publishes_after_cutover(self, cp):
+        """The acceptance scenario: a worker that went quiet before the
+        cutover wakes up and publishes its delta layer afterwards.  A
+        NEW (worker, seq) is fenced — its rows were not part of the
+        sealed decision; a re-put of an ADMITTED key is an idempotent
+        ack (its rows were)."""
+        cp.mvcc_admit_layer(SCOPE, layer(worker="w0", seq=0))
+        cp.mvcc_cutover(SCOPE, 110, 2)
+        z = cp.mvcc_admit_layer(SCOPE, layer(worker="w-zombie", seq=0,
+                                             lsn_min=200, lsn_max=210))
+        assert z["status"] == mvccfence.FENCED
+        assert z["cutover"]["watermark"] == 110
+        dup = cp.mvcc_admit_layer(SCOPE, layer(worker="w0", seq=0))
+        assert dup["status"] == mvccfence.DUPLICATE
+        # the fenced layer never entered the doc
+        st = cp.mvcc_state(SCOPE)
+        assert len(st["layers"]) == 1
+        assert st["watermark"] == 110
+
+    def test_prune_is_idempotent(self, cp):
+        cp.mvcc_admit_layer(SCOPE, layer(seq=0))
+        cp.mvcc_admit_layer(SCOPE, layer(seq=1))
+        cp.mvcc_admit_layer(SCOPE, layer(seq=2))
+        assert cp.mvcc_prune_layers(SCOPE, [("w0", 0), ("w0", 1)]) == 2
+        # compaction rerun after a crash re-prunes the same keys
+        assert cp.mvcc_prune_layers(SCOPE, [("w0", 0), ("w0", 1)]) == 0
+        st = cp.mvcc_state(SCOPE)
+        assert [x["seq"] for x in st["layers"]] == [2]
+        # unknown scope prunes nothing
+        assert cp.mvcc_prune_layers("mvcc/other", [("w0", 0)]) == 0
+
+    def test_scopes_are_isolated(self, cp):
+        cp.mvcc_admit_layer("mvcc/a", layer(seq=0))
+        cp.mvcc_cutover("mvcc/a", 110, 2)
+        st = cp.mvcc_state("mvcc/b")
+        assert st["layers"] == [] and st["cutover"] is None
+        d = cp.mvcc_admit_layer("mvcc/b", layer(seq=0))
+        assert d["status"] == mvccfence.ADMITTED
+
+    def test_decision_is_the_one_that_landed(self, cp):
+        """The returned decision reflects the doc AFTER this call's
+        merge landed — admitting twice reports replace the second
+        time on every backend (no lost-update on the decision)."""
+        a = cp.mvcc_admit_layer(SCOPE, layer(seq=5))
+        b = cp.mvcc_admit_layer(SCOPE, layer(seq=5))
+        assert a["status"] == mvccfence.ADMITTED
+        assert b["status"] == mvccfence.REPLACED

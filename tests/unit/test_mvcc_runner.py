@@ -1,0 +1,256 @@
+"""SNAPSHOT_AND_INCREMENT activation through the MVCC store: the
+slot-before-snapshot ordering regression, fenced part landings, the
+resume watermark handoff, the dict-heavy end-to-end no-flatten pin,
+and a chaos-mode smoke trial."""
+
+import numpy as np
+import pytest
+
+from transferia_tpu.abstract import TableID
+from transferia_tpu.abstract.kinds import KIND_CODES, Kind
+from transferia_tpu.abstract.schema import new_table_schema
+from transferia_tpu.abstract.table import OperationTablePart
+from transferia_tpu.columnar.batch import ColumnBatch
+from transferia_tpu.coordinator import MemoryCoordinator
+from transferia_tpu.models import Transfer, TransferType
+from transferia_tpu.mvcc import MvccStore
+from transferia_tpu.mvcc.runner import (
+    STATE_EPOCH,
+    STATE_WATERMARK,
+    activate_snapshot_and_increment,
+    land_snapshot_part,
+    resume_state,
+    store_scope,
+)
+from transferia_tpu.providers.memory import MemoryTargetParams, get_store
+from transferia_tpu.providers.sample import SampleSourceParams
+from transferia_tpu.stats.trace import TELEMETRY
+from transferia_tpu.tasks import activate_delivery
+
+U = KIND_CODES[Kind.UPDATE]
+
+
+def make_transfer(tid, rows=64, **src_kw):
+    return Transfer(
+        id=tid,
+        type=TransferType.SNAPSHOT_AND_INCREMENT,
+        src=SampleSourceParams(preset="users", table="users", rows=rows,
+                               batch_rows=32, **src_kw),
+        dst=MemoryTargetParams(sink_id=f"mvccrun_{tid}"),
+    )
+
+
+def delta_batch(schema, tid, ids, lsns):
+    """An UPDATE layer over sample `users` rows (PK user_id)."""
+    cols = {}
+    for cs in schema:
+        if cs.name == "user_id":
+            cols[cs.name] = list(ids)
+        elif cs.data_type.value in ("int8", "int16", "int32", "int64",
+                                    "uint8", "uint16", "uint32",
+                                    "uint64"):
+            cols[cs.name] = [0] * len(ids)
+        elif cs.data_type.value == "double":
+            cols[cs.name] = [0.0] * len(ids)
+        else:
+            cols[cs.name] = ["patched"] * len(ids)
+    return ColumnBatch.from_pydict(
+        tid, schema, cols,
+        kinds=np.full(len(ids), U, dtype=np.int8),
+        lsns=np.asarray(lsns, dtype=np.int64))
+
+
+class TestActivateDelivery:
+    def test_sai_e2e_and_resume_state(self):
+        t = make_transfer("sai1", rows=64)
+        store = get_store("mvccrun_sai1")
+        store.clear()
+        cp = MemoryCoordinator()
+        assert resume_state(cp, t.id) is None
+        activate_delivery(t, cp)
+        assert cp.get_status(t.id).value == "activated"
+        assert store.row_count(TableID("sample", "users")) == 64
+        # no deltas arrived during the snapshot: the sealed watermark
+        # is the empty high-watermark, epoch 1
+        assert resume_state(cp, t.id) == {"watermark": -1, "epoch": 1}
+
+    def test_dict_heavy_sai_pins_zero_flat_materializations(self):
+        """The acceptance pin: a dict-encoded S&I activation crosses
+        snapshot → store → merge → publish with ZERO dict flat
+        materializations."""
+        t = make_transfer("sai_dict", rows=256, dict_encode=True)
+        store = get_store("mvccrun_sai_dict")
+        store.clear()
+        TELEMETRY.reset()
+        activate_delivery(t, MemoryCoordinator())
+        snap = TELEMETRY.snapshot()
+        assert snap["dict_flat_materializations"] == 0, snap
+        assert snap["lazy_dict_preserved"] > 0
+        assert store.row_count(TableID("sample", "users")) == 256
+
+    def test_slot_created_before_snapshot(self, monkeypatch):
+        """Regression: the replication slot must exist BEFORE the first
+        snapshot row is read — created after, changes committed during
+        the snapshot fall into a silently-lost window."""
+        import transferia_tpu.mvcc.runner as runner_mod
+        from transferia_tpu.tasks import activate as activate_mod
+
+        events = []
+        t = make_transfer("sai_slot", rows=32)
+        get_store("mvccrun_sai_slot").clear()
+        real_get = activate_mod.get_provider
+
+        class SlotProvider:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+            def supports_activate(self):
+                return True
+
+            def activate(self, callbacks):
+                events.append("slot")
+
+        def fake_get(provider_id, transfer, metrics):
+            p = real_get(provider_id, transfer, metrics)
+            if provider_id == transfer.src_provider():
+                return SlotProvider(p)
+            return p
+
+        real_sai = runner_mod.activate_snapshot_and_increment
+
+        def recording_sai(*a, **kw):
+            events.append("snapshot")
+            return real_sai(*a, **kw)
+
+        monkeypatch.setattr(activate_mod, "get_provider", fake_get)
+        monkeypatch.setattr(runner_mod,
+                            "activate_snapshot_and_increment",
+                            recording_sai)
+        activate_delivery(t, MemoryCoordinator())
+        assert events == ["slot", "snapshot"]
+
+
+class TestRunnerPieces:
+    def test_deltas_hook_feeds_the_cutover(self):
+        t = make_transfer("sai_delta", rows=64)
+        store = get_store("mvccrun_sai_delta")
+        store.clear()
+        cp = MemoryCoordinator()
+
+        def deltas(st: MvccStore):
+            tbl = st.tables()[0]
+            bv = st._bases[tbl]["part-0"]
+            b0 = bv.batches[0]
+            st.append_delta(tbl, "w0", 0, [delta_batch(
+                b0.schema, b0.table_id, [0, 1], [100, 101])])
+
+        st = activate_snapshot_and_increment(t, cp, deltas=deltas)
+        assert st.sealed() == (101, 1)
+        assert resume_state(cp, t.id) == {"watermark": 101, "epoch": 1}
+        # the published image carries the patched rows exactly once
+        assert store.row_count(TableID("sample", "users")) == 64
+
+    def test_idempotent_activation_adopts_sealed_decision(self):
+        t = make_transfer("sai_retry", rows=32)
+        get_store("mvccrun_sai_retry").clear()
+        cp = MemoryCoordinator()
+        st1 = activate_snapshot_and_increment(t, cp, epoch=1)
+        assert st1.sealed() == (-1, 1)
+        # the retry (fresh store, same scope) asks for a different
+        # epoch; the coordinator hands back the sealed decision
+        st2 = activate_snapshot_and_increment(t, cp, epoch=2)
+        assert st2.sealed() == (-1, 1)
+        assert resume_state(cp, t.id) == {"watermark": -1, "epoch": 1}
+
+    def test_land_snapshot_part_fenced_by_commit_grant(self):
+        schema = new_table_schema([("id", "int64", True),
+                                   ("val", "utf8")])
+        tid = TableID("s", "t")
+        b = ColumnBatch.from_pydict(tid, schema,
+                                    {"id": [1], "val": ["a"]})
+        part = OperationTablePart(operation_id="op-x", table_id=tid,
+                                  part_index=0, assignment_epoch=3)
+
+        class DenyingCoordinator:
+            def commit_part(self, operation_id, p):
+                return False
+
+        class GrantingCoordinator:
+            def commit_part(self, operation_id, p):
+                return True
+
+        st = MvccStore("mvcc/land")
+        assert not land_snapshot_part(st, DenyingCoordinator(), "op-x",
+                                      part, [b])
+        assert st.read_at(str(tid)) == []
+        assert land_snapshot_part(st, GrantingCoordinator(), "op-x",
+                                  part, [b])
+        assert sum(x.n_rows for x in st.read_at(str(tid))) == 1
+        # unsupported backends (commit_part → None) land unfenced
+        st2 = MvccStore("mvcc/land2")
+        assert land_snapshot_part(st2, None, "op-x", part, [b])
+
+    def test_store_scope_shape(self):
+        assert store_scope("t-1") == "mvcc/t-1"
+        assert STATE_WATERMARK != STATE_EPOCH
+
+
+class TestCompactionTickets:
+    def _layered_store(self, scope):
+        schema = new_table_schema([("id", "int64", True),
+                                   ("val", "utf8")])
+        tid = TableID("s", "t")
+        st = MvccStore(scope, MemoryCoordinator())
+        st.put_base(str(tid), "p0", 1, [ColumnBatch.from_pydict(
+            tid, schema, {"id": [1, 2], "val": ["a", "b"]})])
+        for seq in range(4):
+            st.append_delta(str(tid), "w0", seq, [
+                ColumnBatch.from_pydict(
+                    tid, schema, {"id": [2], "val": [f"v{seq}"]},
+                    kinds=np.asarray([U], dtype=np.int8),
+                    lsns=np.asarray([100 + seq], dtype=np.int64))])
+        return st, str(tid)
+
+    def test_scavenger_ticket_through_worker_runner(self):
+        from transferia_tpu.fleet.worker import RUNNERS
+        from transferia_tpu.mvcc import register_store, unregister_store
+        from transferia_tpu.mvcc.compact import enqueue_compaction
+
+        scope = "mvcc/ticket-test"
+        st, table = self._layered_store(scope)
+        cp = st.cp
+        ticket = enqueue_compaction(cp, "fleet", st, table)
+        assert ticket is not None
+        assert ticket.qos == "scavenger"
+        # deterministic id: re-noticing the opportunity dedups
+        again = enqueue_compaction(cp, "fleet", st, table)
+        assert again.ticket_id == ticket.ticket_id
+        register_store(st)
+        try:
+            RUNNERS["mvcc_compact"](ticket, None)
+        finally:
+            unregister_store(scope)
+        assert st.layer_count(table) == 0
+        assert cp.mvcc_state(scope)["layers"] == []
+
+    def test_unresolved_scope_releases_the_ticket(self):
+        from transferia_tpu.fleet.worker import RUNNERS
+        from transferia_tpu.mvcc.compact import compaction_ticket
+
+        t = compaction_ticket("mvcc/nowhere", "s.t", 100)
+        with pytest.raises(RuntimeError, match="no MVCC store"):
+            RUNNERS["mvcc_compact"](t, None)
+
+
+class TestChaosSmoke:
+    def test_one_seeded_trial(self):
+        from transferia_tpu.chaos.runner import run_trials
+
+        report = run_trials(trials=1, seed=11,
+                            mode="snapshot_and_increment")
+        assert report.passed, report.to_dict()
+        fired = report.sites_fired()
+        assert any(site.startswith("mvcc.") for site in fired)

@@ -1,0 +1,357 @@
+"""MVCC staging store: merge-on-read semantics (latest-wins over
+``(pk, lsn, layer, source, position)``), point-in-time reads around
+the cutover, compaction byte-equivalence, and the no-flatten
+discipline — dict columns cross the store still code-encoded and
+merged integer columns stay FOR-encodable."""
+
+import numpy as np
+import pytest
+
+from transferia_tpu.abstract.kinds import KIND_CODES, Kind
+from transferia_tpu.abstract.schema import (
+    CanonicalType,
+    ColSchema,
+    TableID,
+    TableSchema,
+    new_table_schema,
+)
+from transferia_tpu.columnar.batch import (
+    Column,
+    ColumnBatch,
+    DictEnc,
+    DictPool,
+    _offsets_from_lengths,
+)
+from transferia_tpu.coordinator import MemoryCoordinator
+from transferia_tpu.mvcc import MvccStore, OversizeLayerError
+from transferia_tpu.mvcc.compact import compact_table, should_compact
+from transferia_tpu.mvcc.store import (
+    DEFAULT_COMPACT_MIN_LAYERS,
+    ENV_COMPACT_MIN_LAYERS,
+    ENV_MAX_LAYER_ROWS,
+    compact_min_layers,
+    content_key,
+    max_layer_rows,
+    pk_column_names,
+)
+from transferia_tpu.providers.staging import StaleEpochPublishError
+from transferia_tpu.stats.trace import TELEMETRY
+
+I, U, D = (KIND_CODES[Kind.INSERT], KIND_CODES[Kind.UPDATE],
+           KIND_CODES[Kind.DELETE])
+
+TID = TableID("s", "t")
+SCHEMA = new_table_schema([("id", "int64", True), ("val", "utf8")])
+TABLE = str(TID)
+
+
+def batch(ids, vals, kinds=None, lsns=None):
+    kw = {}
+    if kinds is not None:
+        kw["kinds"] = np.asarray(kinds, dtype=np.int8)
+    if lsns is not None:
+        kw["lsns"] = np.asarray(lsns, dtype=np.int64)
+    return ColumnBatch.from_pydict(
+        TID, SCHEMA, {"id": list(ids), "val": list(vals)}, **kw)
+
+
+def rows_of(batches):
+    """Merged output → {id: val} (asserting each id appears once)."""
+    out = {}
+    for b in batches:
+        d = b.to_pydict()
+        for i, v in zip(d["id"], d["val"]):
+            assert i not in out, f"duplicate id {i} across sources"
+            out[i] = v
+    return out
+
+
+def store(**kw):
+    st = MvccStore("mvcc/test", **kw)
+    st.put_base(TABLE, "p0", 1, [batch([1, 2, 3], ["a", "b", "c"])])
+    return st
+
+
+class TestMergeOnRead:
+    def test_base_only(self):
+        st = store()
+        assert rows_of(st.read_at(TABLE)) == {1: "a", 2: "b", 3: "c"}
+
+    def test_insert_update_delete_kinds(self):
+        st = store()
+        st.append_delta(TABLE, "w0", 0, [batch(
+            [4, 2, 3], ["d", "B", "c"], kinds=[I, U, D],
+            lsns=[100, 101, 102])])
+        assert rows_of(st.read_at(TABLE)) == {1: "a", 2: "B", 4: "d"}
+
+    def test_later_layer_beats_earlier(self):
+        st = store()
+        st.append_delta(TABLE, "w0", 0,
+                        [batch([2], ["x"], kinds=[U], lsns=[100])])
+        st.append_delta(TABLE, "w1", 0,
+                        [batch([2], ["y"], kinds=[U], lsns=[105])])
+        assert rows_of(st.read_at(TABLE))[2] == "y"
+
+    def test_out_of_order_lsns_within_a_layer(self):
+        """A layer's rows need not arrive LSN-sorted: the per-row lsn
+        decides the winner, not the position in the layer."""
+        st = store()
+        st.append_delta(TABLE, "w0", 0, [batch(
+            [2, 2, 2], ["late", "early", "mid"], kinds=[U, U, U],
+            lsns=[107, 103, 105])])
+        assert rows_of(st.read_at(TABLE))[2] == "late"
+        # point-in-time slices by lsn, not position
+        assert rows_of(st.read_at(TABLE, watermark=105))[2] == "mid"
+        assert rows_of(st.read_at(TABLE, watermark=103))[2] == "early"
+
+    def test_same_lsn_position_breaks_tie(self):
+        st = store()
+        st.append_delta(TABLE, "w0", 0, [batch(
+            [2, 2], ["first", "second"], kinds=[U, U],
+            lsns=[100, 100])])
+        assert rows_of(st.read_at(TABLE))[2] == "second"
+
+    def test_delete_then_reinsert(self):
+        st = store()
+        st.append_delta(TABLE, "w0", 0, [batch(
+            [1, 1], ["", "A2"], kinds=[D, I], lsns=[100, 110])])
+        assert rows_of(st.read_at(TABLE))[1] == "A2"
+        # at the watermark between the two, the row is gone
+        assert 1 not in rows_of(st.read_at(TABLE, watermark=105))
+
+    def test_multi_part_base(self):
+        st = MvccStore("mvcc/test")
+        st.put_base(TABLE, "p0", 1, [batch([1], ["a"])])
+        st.put_base(TABLE, "p1", 1, [batch([2], ["b"])])
+        st.append_delta(TABLE, "w0", 0,
+                        [batch([2], ["B"], kinds=[U], lsns=[100])])
+        assert rows_of(st.read_at(TABLE)) == {1: "a", 2: "B"}
+
+    def test_unknown_table_reads_empty(self):
+        assert store().read_at("s.other") == []
+
+
+class TestPointInTimeAroundCutover:
+    def test_pre_mid_post(self):
+        st = store()
+        st.append_delta(TABLE, "w0", 0,
+                        [batch([2], ["B1"], kinds=[U], lsns=[100])])
+        st.append_delta(TABLE, "w0", 1,
+                        [batch([2], ["B2"], kinds=[U], lsns=[200])])
+        # pre-cutover: explicit watermarks slice history
+        assert rows_of(st.read_at(TABLE, watermark=50))[2] == "b"
+        assert rows_of(st.read_at(TABLE, watermark=150))[2] == "B1"
+        # mid: the default read pre-cutover is the local high-watermark
+        assert rows_of(st.read_at(TABLE))[2] == "B2"
+        d = st.cutover(epoch=2)
+        assert d["granted"] and d["watermark"] == 200
+        # post-cutover: the default read is pinned AT the sealed
+        # watermark, and a zombie append cannot move it
+        z = st.append_delta(TABLE, "w9", 0,
+                            [batch([2], ["Z"], kinds=[U], lsns=[300])])
+        assert z["status"] == "fenced"
+        assert rows_of(st.read_at(TABLE))[2] == "B2"
+
+    def test_cutover_against_coordinator(self):
+        cp = MemoryCoordinator()
+        st = MvccStore("mvcc/cp", coordinator=cp)
+        st.put_base(TABLE, "p0", 1, [batch([1], ["a"])])
+        st.append_delta(TABLE, "w0", 0,
+                        [batch([1], ["A"], kinds=[U], lsns=[100])])
+        assert st.cutover(epoch=2)["granted"]
+        # a second store over the same scope sees the sealed decision
+        st2 = MvccStore("mvcc/cp", coordinator=cp)
+        assert st2.sealed() == (100, 2)
+        assert st2.cutover(epoch=3)["granted"] is False
+
+    def test_idempotent_append_retry_replaces(self):
+        st = store()
+        b = [batch([2], ["B"], kinds=[U], lsns=[100])]
+        assert st.append_delta(TABLE, "w0", 0, b)["status"] == "admitted"
+        assert st.append_delta(TABLE, "w0", 0, b)["status"] == "replaced"
+        assert st.layer_count(TABLE) == 1
+        assert rows_of(st.read_at(TABLE))[2] == "B"
+
+    def test_zombie_base_re_put_is_fenced(self):
+        st = MvccStore("mvcc/test")
+        st.put_base(TABLE, "p0", 2, [batch([1], ["a"])])
+        with pytest.raises(StaleEpochPublishError):
+            st.put_base(TABLE, "p0", 1, [batch([1], ["old"])])
+        # idempotent same-epoch re-put replaces wholesale
+        st.put_base(TABLE, "p0", 2, [batch([1], ["a2"])])
+        assert rows_of(st.read_at(TABLE)) == {1: "a2"}
+
+
+class TestCompaction:
+    def _layered(self):
+        st = store()
+        st.append_delta(TABLE, "w0", 0, [batch(
+            [4, 2], ["d", "B"], kinds=[I, U], lsns=[100, 101])])
+        st.append_delta(TABLE, "w0", 1,
+                        [batch([3], [""], kinds=[D], lsns=[110])])
+        st.append_delta(TABLE, "w1", 0,
+                        [batch([5], ["e"], kinds=[I], lsns=[120])])
+        return st
+
+    def test_byte_equivalence(self):
+        st = self._layered()
+        before = rows_of(st.read_at(TABLE))
+        res = compact_table(st, TABLE)
+        assert res["rows"] == len(before)
+        assert len(res["folded"]) == 3
+        assert st.layer_count(TABLE) == 0
+        assert rows_of(st.read_at(TABLE)) == before
+
+    def test_partial_fold_keeps_tail_layers(self):
+        st = self._layered()
+        at_110 = rows_of(st.read_at(TABLE, watermark=110))
+        res = compact_table(st, TABLE, watermark=110)
+        # the lsn=120 layer's tail is above the fold point: kept
+        assert res["folded"] == [("w0", 0), ("w0", 1)]
+        assert st.layer_count(TABLE) == 1
+        assert rows_of(st.read_at(TABLE, watermark=110)) == at_110
+        assert rows_of(st.read_at(TABLE))[5] == "e"
+
+    def test_compaction_prunes_coordinator_doc(self):
+        cp = MemoryCoordinator()
+        st = MvccStore("mvcc/cpx", coordinator=cp)
+        st.put_base(TABLE, "p0", 1, [batch([1], ["a"])])
+        st.append_delta(TABLE, "w0", 0,
+                        [batch([1], ["A"], kinds=[U], lsns=[100])])
+        compact_table(st, TABLE)
+        assert cp.mvcc_state("mvcc/cpx")["layers"] == []
+
+    def test_rerun_after_crash_is_idempotent(self):
+        st = self._layered()
+        want = rows_of(st.read_at(TABLE))
+        compact_table(st, TABLE)
+        # kill -9 between install and prune → the ticket reruns whole
+        compact_table(st, TABLE)
+        assert rows_of(st.read_at(TABLE)) == want
+
+    def test_should_compact_threshold(self):
+        st = self._layered()
+        env = {ENV_COMPACT_MIN_LAYERS: "3"}
+        assert should_compact(st, TABLE, environ=env)
+        assert not should_compact(st, TABLE,
+                                  environ={ENV_COMPACT_MIN_LAYERS: "4"})
+
+
+class TestEncodingsSurviveTheMerge:
+    def _dict_store(self, n=512):
+        """Dict-heavy table: `seg` is a shared-pool code column on both
+        the base and the delta layer."""
+        vals = [b"alpha", b"beta", b"gamma"]
+        pool = DictPool(
+            np.frombuffer(b"".join(vals), dtype=np.uint8).copy(),
+            _offsets_from_lengths([len(v) for v in vals]))
+        schema = TableSchema((
+            ColSchema("id", CanonicalType.INT64, primary_key=True),
+            ColSchema("seg", CanonicalType.UTF8)))
+
+        def mk(ids, codes, **kw):
+            return ColumnBatch(TID, schema, {
+                "id": Column("id", CanonicalType.INT64,
+                             np.asarray(ids, dtype=np.int64)),
+                "seg": Column("seg", CanonicalType.UTF8,
+                              dict_enc=DictEnc(
+                                  np.asarray(codes, dtype=np.int32),
+                                  pool=pool)),
+            }, **kw)
+
+        st = MvccStore("mvcc/dict")
+        ids = np.arange(n)
+        st.put_base(TABLE, "p0", 1, [mk(ids, ids % 3)])
+        upd = np.arange(0, n, 7)
+        st.append_delta(TABLE, "w0", 0, [mk(
+            upd, (upd + 1) % 3,
+            kinds=np.full(len(upd), U, dtype=np.int8),
+            lsns=np.arange(100, 100 + len(upd), dtype=np.int64))])
+        return st, n
+
+    def test_dict_columns_stay_encoded(self):
+        st, n = self._dict_store()
+        TELEMETRY.reset()
+        merged = st.read_at(TABLE)
+        assert sum(b.n_rows for b in merged) == n
+        assert all(b.column("seg").is_lazy_dict for b in merged)
+        snap = TELEMETRY.snapshot()
+        assert snap["dict_flat_materializations"] == 0, snap
+
+    def test_compaction_keeps_dict_encoding(self):
+        st, n = self._dict_store()
+        TELEMETRY.reset()
+        compact_table(st, TABLE)
+        merged = st.read_at(TABLE)
+        assert all(b.column("seg").is_lazy_dict for b in merged)
+        assert TELEMETRY.snapshot()["dict_flat_materializations"] == 0
+
+    def test_merged_int_columns_stay_for_encodable(self):
+        """The merge's take() must hand back clustered int64 frames the
+        wire planner can still FOR-encode — not widened/objectified
+        copies."""
+        from transferia_tpu.ops.dispatch import encode_for
+
+        st, n = self._dict_store()
+        merged = st.read_at(TABLE)
+        big = max(merged, key=lambda b: b.n_rows)
+        ids = big.column("id").data
+        assert ids.dtype == np.int64
+        # the wire pads row buckets to frame multiples; hand the
+        # planner one full frame of the merged output
+        assert encode_for(ids[:256]) is not None
+
+
+class TestLimitsAndKeys:
+    def test_oversize_layer_rejected(self, monkeypatch):
+        monkeypatch.setenv(ENV_MAX_LAYER_ROWS, "4")
+        st = store()
+        with pytest.raises(OversizeLayerError):
+            st.append_delta(TABLE, "w0", 0, [batch(
+                range(5), ["x"] * 5, kinds=[I] * 5,
+                lsns=range(100, 105))])
+        # nothing was admitted
+        assert st.layer_count(TABLE) == 0
+
+    def test_knob_accessors(self):
+        assert compact_min_layers(environ={}) == \
+            DEFAULT_COMPACT_MIN_LAYERS
+        assert compact_min_layers(
+            environ={ENV_COMPACT_MIN_LAYERS: "9"}) == 9
+        # floor of 1: a zero knob cannot disable folding entirely
+        assert compact_min_layers(
+            environ={ENV_COMPACT_MIN_LAYERS: "0"}) == 1
+        assert max_layer_rows(environ={ENV_MAX_LAYER_ROWS: "64"}) == 64
+
+    def test_content_key_is_order_independent(self):
+        a = batch([1, 2], ["a", "b"], kinds=[I, I], lsns=[100, 101])
+        b = batch([2, 1], ["b", "a"], kinds=[I, I], lsns=[101, 100])
+        assert content_key([a]) == content_key([b])
+        c = batch([3], ["c"], kinds=[I], lsns=[102])
+        assert content_key([a]) != content_key([a, c])
+
+    def test_keyless_table_falls_back_to_whole_row(self):
+        schema = TableSchema((ColSchema("x", CanonicalType.INT64),
+                              ColSchema("y", CanonicalType.UTF8)))
+        assert pk_column_names(schema) == ["x", "y"]
+        tid = TableID("s", "nokey")
+        st = MvccStore("mvcc/nokey")
+        st.put_base(str(tid), "p0", 1, [ColumnBatch.from_pydict(
+            tid, schema, {"x": [1, 1], "y": ["a", "b"]})])
+        # whole-row identity: identical rows collapse, distinct stay
+        st.append_delta(str(tid), "w0", 0, [ColumnBatch.from_pydict(
+            tid, schema, {"x": [1], "y": ["a"]},
+            kinds=np.asarray([I], dtype=np.int8),
+            lsns=np.asarray([100], dtype=np.int64))])
+        merged = st.read_at(str(tid))
+        assert sum(b.n_rows for b in merged) == 2
+
+    def test_watermark_and_stats(self):
+        st = store()
+        assert st.watermark() == -1
+        st.append_delta(TABLE, "w0", 0,
+                        [batch([2], ["B"], kinds=[U], lsns=[100])])
+        assert st.watermark() == 100
+        assert st.tables() == [TABLE]
+        assert st.stats.m.value("mvcc_base_versions") == 1
+        assert st.stats.m.value("mvcc_delta_layers") == 1

@@ -1,0 +1,141 @@
+"""Shared MVCC staging-store fence semantics (dict form).
+
+The MVCC staging store (transferia_tpu/mvcc/) keeps its columnar layer
+DATA in process memory; what must survive crashes and arbitrate races
+is the CONTROL state — which delta layers were admitted and whether the
+snapshot→replication cutover has been sealed.  That state is one JSON
+document per scope stored through the coordinator, exactly like fleet
+tickets and obs segments, so all three backends (memory dict / flock'd
+file / S3 conditional writes) implement byte-identical semantics around
+their own atomicity primitive.
+
+Document shape::
+
+    {"layers": [ {worker, seq, table, lsn_min, lsn_max, rows,
+                  content_key, admitted_at}, ... ],      # admission order
+     "cutover": null | {"watermark": W, "epoch": E, "sealed_at": ts}}
+
+Rules (mirroring abstract/ticket.py's in-place helpers):
+
+* Layer admission is idempotent under the obs-segment ``(worker, seq)``
+  replace convention: re-admitting the same key REPLACES the stored
+  metadata in place (same admission position — merge order is stable
+  across a worker's retry of a faulted admission RPC).
+* The cutover is a single first-wins fence: the first seal wins
+  atomically; an identical retry (same watermark AND epoch) is granted
+  idempotently; anything else is fenced and handed the sealed decision.
+* After the seal, NEW layer admissions are fenced — a zombie snapshot
+  worker that wakes up and publishes after the cutover cannot slip rows
+  into a decision that already happened.  Re-admitting an
+  already-admitted key stays an idempotent ack (the data it refers to
+  was part of the decision).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Optional
+
+# admission statuses (mvcc_admit_layer result["status"])
+ADMITTED = "admitted"      # new (worker, seq) appended pre-cutover
+REPLACED = "replaced"      # same (worker, seq) re-put pre-cutover
+DUPLICATE = "duplicate"    # same (worker, seq) re-put post-cutover: ack,
+#                            no mutation — the layer was in the decision
+FENCED = "fenced"          # new (worker, seq) post-cutover: rejected
+
+
+def new_mvcc_doc() -> dict:
+    return {"layers": [], "cutover": None}
+
+
+def layer_key(layer: dict) -> tuple[str, int]:
+    """Identity of a delta layer: the obs-segment (worker, seq) pair."""
+    return (str(layer.get("worker", "")), int(layer.get("seq", -1)))
+
+
+def normalize_layer(layer: dict,
+                    now: Optional[float] = None) -> dict:
+    """JSON-plain metadata record for one admitted layer.  Only control
+    fields cross the coordinator — columnar data stays in process."""
+    return {
+        "worker": str(layer.get("worker", "")),
+        "seq": int(layer.get("seq", -1)),
+        "table": str(layer.get("table", "")),
+        "lsn_min": int(layer.get("lsn_min", 0)),
+        "lsn_max": int(layer.get("lsn_max", 0)),
+        "rows": int(layer.get("rows", 0)),
+        "content_key": str(layer.get("content_key", "")),
+        "admitted_at": (time.time() if now is None else now),
+    }
+
+
+def admit_layer_in_place(doc: dict, layer: dict,
+                         now: Optional[float] = None) -> dict:
+    """Mutate the scope doc with one layer admission; returns the
+    decision dict the backends hand back verbatim."""
+    key = layer_key(layer)
+    layers = doc.setdefault("layers", [])
+    idx = next((i for i, d in enumerate(layers)
+                if layer_key(d) == key), None)
+    sealed = doc.get("cutover")
+    if sealed is not None:
+        if idx is not None:
+            return {"status": DUPLICATE, "cutover": dict(sealed)}
+        return {"status": FENCED, "cutover": dict(sealed)}
+    rec = normalize_layer(layer, now)
+    if idx is not None:
+        layers[idx] = rec
+        return {"status": REPLACED, "layers": len(layers)}
+    layers.append(rec)
+    return {"status": ADMITTED, "layers": len(layers)}
+
+
+def cutover_in_place(doc: dict, watermark: int, epoch: int,
+                     now: Optional[float] = None) -> dict:
+    """Seal (or re-acknowledge, or fence) the cutover decision."""
+    sealed = doc.get("cutover")
+    if sealed is None:
+        doc["cutover"] = {"watermark": int(watermark),
+                          "epoch": int(epoch),
+                          "sealed_at": (time.time() if now is None
+                                        else now)}
+        return {"granted": True, "first": True,
+                "watermark": int(watermark), "epoch": int(epoch)}
+    same = (int(sealed.get("watermark", -1)) == int(watermark)
+            and int(sealed.get("epoch", -1)) == int(epoch))
+    return {"granted": same, "first": False,
+            "watermark": int(sealed.get("watermark", -1)),
+            "epoch": int(sealed.get("epoch", -1))}
+
+
+def prune_layers_in_place(doc: dict, keys: list) -> int:
+    """Drop layer records by (worker, seq) key — compaction folded them
+    into a new base version.  Idempotent: missing keys prune nothing."""
+    want = {(str(k[0]), int(k[1])) for k in keys}
+    layers = doc.setdefault("layers", [])
+    kept = [d for d in layers if layer_key(d) not in want]
+    pruned = len(layers) - len(kept)
+    doc["layers"] = kept
+    return pruned
+
+
+def doc_watermark(doc: dict) -> int:
+    """Delta LSN high-watermark over every admitted layer (-1 = none).
+    The cutover driver seals THIS value: the highest LSN any admitted
+    layer carries is exactly where the replication lane must resume."""
+    layers = doc.get("layers") or []
+    if not layers:
+        return -1
+    return max(int(d.get("lsn_max", 0)) for d in layers)
+
+
+def state_view(doc: Optional[dict]) -> dict:
+    """Read-only JSON-plain snapshot of a scope doc (missing = empty)."""
+    if not doc:
+        doc = new_mvcc_doc()
+    return {
+        "layers": [dict(d) for d in (doc.get("layers") or [])],
+        "cutover": (dict(doc["cutover"])
+                    if doc.get("cutover") else None),
+        "watermark": doc_watermark(doc),
+    }

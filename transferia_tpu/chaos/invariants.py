@@ -310,6 +310,12 @@ class AuditingCoordinator(Coordinator):
         self.enqueue_log: list[tuple] = []
         self.ticket_claim_log: list[tuple] = []
         self.ticket_revoke_log: list[tuple] = []
+        # MVCC staging decisions — the replay surfaces of
+        # snapshot_and_increment trials: layer admissions (worker, seq,
+        # status) in decision order and the sealed cutovers (watermark,
+        # epoch, granted, first)
+        self.mvcc_admit_log: list[tuple] = []
+        self.mvcc_cutover_log: list[tuple] = []
 
     # -- watched methods ----------------------------------------------------
     def create_operation_parts(self, operation_id, parts):
@@ -386,6 +392,35 @@ class AuditingCoordinator(Coordinator):
     def gc_tickets(self, queue, retention_seconds=None):
         return self.inner.gc_tickets(
             queue, retention_seconds=retention_seconds)
+
+    # -- MVCC staging control plane (watched: the replay surfaces) ----------
+    def supports_mvcc(self):
+        return self.inner.supports_mvcc()
+
+    def mvcc_admit_layer(self, scope, layer):
+        res = self.inner.mvcc_admit_layer(scope, layer)
+        with self._lock:
+            self.mvcc_admit_log.append(
+                (str(layer.get("worker", "")),
+                 int(layer.get("seq", -1)),
+                 res.get("status", "")))
+        return res
+
+    def mvcc_cutover(self, scope, watermark, epoch):
+        res = self.inner.mvcc_cutover(scope, watermark, epoch)
+        with self._lock:
+            self.mvcc_cutover_log.append(
+                (int(res.get("watermark", -1)),
+                 int(res.get("epoch", -1)),
+                 bool(res.get("granted")),
+                 bool(res.get("first"))))
+        return res
+
+    def mvcc_state(self, scope):
+        return self.inner.mvcc_state(scope)
+
+    def mvcc_prune_layers(self, scope, keys):
+        return self.inner.mvcc_prune_layers(scope, keys)
 
     def set_transfer_state(self, transfer_id, state):
         self.state_writes += 1

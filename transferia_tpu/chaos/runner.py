@@ -196,6 +196,14 @@ class ChaosReport:
                 line += (f", {kills} worker(s) killed, {steals} "
                          f"ticket(s) reclaimed, {preempts} "
                          f"preemption(s), logs replayed x2")
+            if mode == "snapshot_and_increment":
+                kills = sum(r.kills for r in rs)
+                fenced = sum(r.fence_rejected for r in rs)
+                cutovers = sum(
+                    1 for r in rs for c in r.commit_log if c[2])
+                line += (f", {kills} injected abort(s) retried, "
+                         f"{cutovers} cutover(s) sealed, {fenced} "
+                         f"zombie publish(es) fenced, logs replayed x2")
             if mode == "exactly_once":
                 kills = sum(r.kills for r in rs)
                 steals = sum(len(r.steal_log) for r in rs)
@@ -1809,6 +1817,316 @@ def run_replication_trial(trial: int, seed: int, messages: int,
                        fire_log=log, restarts=restarts, seconds=seconds)
 
 
+# -- snapshot_and_increment mode ---------------------------------------------
+#
+# The MVCC consistent-cutover gauntlet (transferia_tpu/mvcc/): snapshot
+# parts land as base versions while seeded CDC layers stack as deltas,
+# the cutover seals one (watermark, epoch) decision, compaction folds
+# the layers — and seeded aborts fire at every mvcc.* site (a raise at
+# the site IS the kill: the site sits before the state change, so the
+# retrying "next worker attempt" must be idempotent).  The acceptance
+# bar: the final merged read is EXACTLY the fault-free reference (one
+# copy of every surviving row), zombie publishes are fenced at both
+# epochs (snapshot zombie at put_base, delta zombie post-cutover), the
+# compacted read is byte-identical to the layered read, and the fire /
+# admission / cutover logs replay byte-identically across two runs of
+# the same seed.
+
+SAI_SITES = ("mvcc.append", "mvcc.cutover", "mvcc.compact")
+SAI_ROWS = 1024
+SAI_PARTS = 3
+SAI_ATTEMPTS = 10
+
+
+def snapshot_and_increment_schedule(trial: int, seed: int) -> str:
+    rng = random.Random(f"{seed}:snapshot_and_increment:{trial}")
+    clauses = []
+    for site in SAI_SITES:
+        # cutover/compact are hit ~once per run outside their own
+        # retries: only after:0 guarantees a fire.  append sees the
+        # whole layer feed, so it can afford a gate
+        if site == "mvcc.append":
+            after = rng.randrange(0, 4)
+            times = rng.randrange(1, 3)
+        else:
+            after = 0
+            times = 1
+        err = rng.choice(("ConnectionError", "TimeoutError",
+                          "ChaosInjectedError"))
+        clauses.append(f"{site}=after:{after},times:{times},raise:{err}")
+    return ";".join(clauses)
+
+
+def _sai_dataset(seed: int, trial: int, rows: int):
+    """Deterministic dict-heavy base parts + LSN-ordered CDC layers for
+    one (seed, trial): the reference and both faulted runs share it."""
+    import numpy as np
+
+    from transferia_tpu.abstract.kinds import KIND_CODES, Kind
+    from transferia_tpu.abstract.schema import TableID, new_table_schema
+    from transferia_tpu.columnar.batch import ColumnBatch
+
+    rng = random.Random(f"{seed}:snapshot_and_increment:{trial}:data")
+    schema = new_table_schema([("id", "int64", True),
+                               ("segment", "utf8"),
+                               ("amount", "double")])
+    tid = TableID("chaos", "sai_events")
+    per = (rows + SAI_PARTS - 1) // SAI_PARTS
+    parts = []
+    for p in range(SAI_PARTS):
+        lo, hi = p * per, min(rows, (p + 1) * per)
+        ids = list(range(lo, hi))
+        parts.append([ColumnBatch.from_pydict(tid, schema, {
+            "id": ids,
+            "segment": [f"s{i % 6}" for i in ids],  # dict-heavy
+            "amount": [i * 0.5 for i in ids],
+        })])
+    layers = []
+    n_layers = 4 + rng.randrange(0, 3)
+    lsn = 100
+    next_insert = rows
+    for seq in range(n_layers):
+        n_ops = 8 + rng.randrange(0, 8)
+        ids, segs, amts, kinds, lsns = [], [], [], [], []
+        for _ in range(n_ops):
+            roll = rng.random()
+            if roll < 0.5:
+                ids.append(rng.randrange(rows))
+                kinds.append(KIND_CODES[Kind.UPDATE])
+            elif roll < 0.75:
+                ids.append(rng.randrange(rows))
+                kinds.append(KIND_CODES[Kind.DELETE])
+            else:
+                ids.append(next_insert)
+                next_insert += 1
+                kinds.append(KIND_CODES[Kind.INSERT])
+            segs.append(f"s{rng.randrange(6)}")
+            amts.append(round(rng.random() * 100, 3))
+            lsns.append(lsn)
+            lsn += 1
+        # out-of-order WITHIN the layer: the merge resolves by per-row
+        # lsn, not arrival position — shuffle to prove it
+        order = list(range(n_ops))
+        rng.shuffle(order)
+        batch = ColumnBatch.from_pydict(tid, schema, {
+            "id": [ids[i] for i in order],
+            "segment": [segs[i] for i in order],
+            "amount": [amts[i] for i in order],
+        }, kinds=np.array([kinds[i] for i in order], dtype=np.int8),
+            lsns=np.array([lsns[i] for i in order], dtype=np.int64))
+        layers.append(("w0", seq, [batch]))
+    return str(tid), schema, tid, parts, layers
+
+
+def _sai_scenario(trial: int, seed: int, rows: int,
+                  spec: Optional[str], label: str) -> dict:
+    """One full S&I run over the MVCC store.  `spec=None` = the
+    fault-free reference."""
+    from transferia_tpu.abstract.errors import StaleEpochPublishError
+    from transferia_tpu.abstract.kinds import KIND_CODES, Kind
+    from transferia_tpu.columnar.batch import ColumnBatch
+    from transferia_tpu.mvcc.compact import compact_table
+    from transferia_tpu.mvcc.store import MvccStore
+
+    import numpy as np
+
+    table, schema, tid, parts, layers = _sai_dataset(seed, trial, rows)
+    tracker = MonotonicityTracker()
+    cp = AuditingCoordinator(MemoryCoordinator(), tracker)
+    store = MvccStore(f"chaos-sai-{label}", cp)
+    rng = random.Random(f"{seed}:snapshot_and_increment:{trial}:ops")
+    violations: list[Violation] = []
+    kills = 0
+    fence_rejected = 0
+
+    def attempt(op, desc):
+        nonlocal kills
+        for _ in range(SAI_ATTEMPTS):
+            try:
+                return op()
+            except Exception as e:
+                # an injected raise at the site is the kill; the retry
+                # is the next worker attempt and must be idempotent
+                kills += 1
+                logger.debug("chaos sai %s: %s aborted (%s); retrying",
+                             label, desc, e)
+        violations.append(Violation(
+            "run-completed",
+            f"{desc} never succeeded in {SAI_ATTEMPTS} attempts"))
+        return None
+
+    def run():
+        nonlocal fence_rejected
+        # interleave: part, then a delta layer that arrived during it
+        li = 0
+        for pi, batches in enumerate(parts):
+            attempt(lambda b=batches, i=pi: store.put_base(
+                table, f"p{i}", 1, b), f"put_base p{pi}")
+            if rng.random() < 0.3:
+                # lost ack: the worker re-lands the same part at the
+                # same epoch — replace, never duplicate
+                attempt(lambda b=batches, i=pi: store.put_base(
+                    table, f"p{i}", 1, b), f"put_base p{pi} (redo)")
+            if li < len(layers):
+                w, s, lb = layers[li]
+                li += 1
+                d = attempt(lambda: store.append_delta(table, w, s, lb),
+                            f"append ({w},{s})")
+                if d is not None:
+                    tracker.record("mvcc:watermark", store.watermark())
+                if d is not None and rng.random() < 0.3:
+                    # lost ack on the admission RPC: the re-append must
+                    # REPLACE under the (worker, seq) convention
+                    d2 = attempt(
+                        lambda: store.append_delta(table, w, s, lb),
+                        f"append ({w},{s}) (redo)")
+                    if d2 is not None and d2.get("status") != "replaced":
+                        violations.append(Violation(
+                            "idempotent-append",
+                            f"pre-cutover re-append of ({w},{s}) got "
+                            f"{d2.get('status')!r}, want 'replaced'"))
+        # mid-snapshot zombie: a pre-reclaim worker re-publishes part 0
+        # at a STALE epoch after the survivor landed epoch 2
+        attempt(lambda: store.put_base(table, "p0", 2, parts[0]),
+                "put_base p0 (reclaimed)")
+        try:
+            store.put_base(table, "p0", 1, parts[0])
+            violations.append(Violation(
+                "zombie-fenced",
+                "stale-epoch put_base of p0 was NOT fenced"))
+        except StaleEpochPublishError:
+            fence_rejected += 1
+        # remaining deltas land after the snapshot finished
+        while li < len(layers):
+            w, s, lb = layers[li]
+            li += 1
+            if attempt(lambda: store.append_delta(table, w, s, lb),
+                       f"append ({w},{s})") is not None:
+                tracker.record("mvcc:watermark", store.watermark())
+        # the cutover: ONE fenced decision; the retry after an injected
+        # abort must re-seal identically
+        d = attempt(lambda: store.cutover(epoch=2), "cutover")
+        if d is not None and not d.get("granted"):
+            violations.append(Violation(
+                "cutover-granted", f"cutover not granted: {d}"))
+        sealed = store.sealed()
+        if sealed is not None:
+            tracker.record("mvcc:watermark", sealed[0])
+        # post-cutover zombie delta: a NEW layer must be fenced...
+        zb = ColumnBatch.from_pydict(tid, schema, {
+            "id": [10 ** 9], "segment": ["s0"], "amount": [0.0]},
+            kinds=np.array([KIND_CODES[Kind.INSERT]], dtype=np.int8),
+            lsns=np.array([10 ** 6], dtype=np.int64))
+        z = attempt(lambda: store.append_delta(table, "w9", 0, [zb]),
+                    "zombie append")
+        if z is not None:
+            if z.get("status") == "fenced":
+                fence_rejected += 1
+            else:
+                violations.append(Violation(
+                    "zombie-fenced",
+                    f"post-cutover NEW layer got {z.get('status')!r}, "
+                    f"want 'fenced'"))
+        # ...while a re-put of a layer that WAS in the decision is an
+        # idempotent ack
+        w, s, lb = layers[0]
+        dup = attempt(lambda: store.append_delta(table, w, s, lb),
+                      "duplicate append")
+        if dup is not None and dup.get("status") != "duplicate":
+            violations.append(Violation(
+                "idempotent-append",
+                f"post-cutover re-append of ({w},{s}) got "
+                f"{dup.get('status')!r}, want 'duplicate'"))
+        layered = store.read_at(table)
+        # compaction folds the layers; the read must not change
+        attempt(lambda: compact_table(store, table), "compact")
+        compacted = store.read_at(table)
+        if [b.to_pydict() for b in layered] != \
+                [b.to_pydict() for b in compacted]:
+            violations.append(Violation(
+                "compaction-equivalence",
+                "read_at differs between layered and compacted state"))
+        return layered
+
+    if spec:
+        with failpoints.active(spec, seed=seed * 1000 + trial):
+            read = run()
+            fires = failpoints.fire_counts()
+            log = failpoints.fire_log()
+    else:
+        read = run()
+        fires, log = {}, {}
+    return {
+        "read": read, "fires": fires, "fire_log": log,
+        "violations": violations, "kills": kills,
+        "fence_rejected": fence_rejected, "tracker": tracker,
+        "logs": {"admit": list(cp.mvcc_admit_log),
+                 "cutover": list(cp.mvcc_cutover_log)},
+    }
+
+
+def run_snapshot_and_increment_trial(trial: int, seed: int, rows: int,
+                                     spec: Optional[str] = None
+                                     ) -> TrialResult:
+    rows = min(rows, SAI_ROWS)
+    spec = spec if spec is not None else snapshot_and_increment_schedule(
+        trial, seed)
+    t0 = time.monotonic()
+    ref_run = _sai_scenario(trial, seed, rows, None, "ref")
+    violations: list[Violation] = []
+    for v in ref_run["violations"]:
+        violations.append(Violation(
+            v.invariant, f"fault-free reference run: {v.detail}"))
+    reference = DeliveryReference.from_batches(ref_run["read"])
+    # the same seeded scenario runs twice; fire + admission + cutover
+    # logs must replay byte-identically (the per-seed acceptance bar)
+    first = _sai_scenario(trial, seed, rows, spec, "r1")
+    second = _sai_scenario(trial, seed, rows, spec, "r2")
+    seconds = time.monotonic() - t0
+    violations.extend(first["violations"])
+    for v in second["violations"]:
+        violations.append(Violation(
+            v.invariant, f"replay run: {v.detail}"))
+    if first["fire_log"] != second["fire_log"]:
+        violations.append(Violation(
+            "seed-replay",
+            f"fire log diverged between two runs of seed {seed}: "
+            f"{first['fire_log']} vs {second['fire_log']}"))
+    for name in ("admit", "cutover"):
+        if first["logs"][name] != second["logs"][name]:
+            violations.append(Violation(
+                "seed-replay",
+                f"mvcc {name} log diverged between two runs of seed "
+                f"{seed}: {first['logs'][name]} vs "
+                f"{second['logs'][name]}"))
+    # exactly-once: the merged read of BOTH faulted runs must equal the
+    # fault-free reference — retries, lost acks, zombies and the
+    # compaction fold may not duplicate or lose a single row
+    delivered = 0
+    total_dup = 0
+    for label, run in (("", first), ("replay run: ", second)):
+        v = audit_delivery(reference, run["read"], 1, run["tracker"],
+                           exactly_once=True)
+        delivered += v.delivered_rows
+        total_dup += v.duplicate_rows
+        if not v.passed:
+            for viol in v.violations:
+                violations.append(Violation(
+                    viol.invariant, f"{label}{viol.detail}"))
+    verdict = AuditVerdict(passed=not violations, violations=violations,
+                           delivered_rows=delivered,
+                           duplicate_rows=total_dup)
+    return TrialResult(
+        mode="snapshot_and_increment", trial=trial, seed=seed,
+        spec=spec, verdict=verdict, fire_counts=first["fires"],
+        fire_log=first["fire_log"], seconds=seconds,
+        kills=first["kills"] + second["kills"],
+        restarts=first["kills"],
+        fence_rejected=first["fence_rejected"] +
+        second["fence_rejected"],
+        commit_log=first["logs"]["cutover"])
+
+
 # -- entry point -------------------------------------------------------------
 
 def run_trials(trials: int = 5, seed: int = 7, mode: str = "both",
@@ -1824,7 +2142,7 @@ def run_trials(trials: int = 5, seed: int = 7, mode: str = "both",
     elif mode == "all":
         modes = ("snapshot", "replication", "worker_crash",
                  "scheduler_kill", "fleet_distributed", "lock_order",
-                 "arrow_ipc", "exactly_once")
+                 "arrow_ipc", "exactly_once", "snapshot_and_increment")
     else:
         modes = (mode,)
     if "arrow_ipc" in modes:
@@ -1909,6 +2227,13 @@ def run_trials(trials: int = 5, seed: int = 7, mode: str = "both",
                                 r.verdict.summary().splitlines()[0])
             finally:
                 shutil.rmtree(dataset, ignore_errors=True)
+        if "snapshot_and_increment" in modes:
+            for t in range(trials):
+                r = run_snapshot_and_increment_trial(t, seed, rows,
+                                                     spec=spec)
+                report.results.append(r)
+                logger.info("chaos snapshot_and_increment trial %d: %s",
+                            t, r.verdict.summary().splitlines()[0])
         if "replication" in modes:
             ref = _replication_reference(messages)
             for t in range(trials):

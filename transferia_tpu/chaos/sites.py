@@ -255,6 +255,27 @@ SITES: dict[str, tuple[str, str]] = {
         "must surface an error payload to the caller (`/debug/slo` "
         "reports it, `trtpu slo` exits 2), never a half-computed "
         "verdict that could latch or clear the QoS plane wrongly"),
+    "mvcc.append": (
+        "mvcc/store.py",
+        "delta-layer append failing between the coordinator admission "
+        "and the in-process layer install (worker dies mid-append) — "
+        "the retried append re-admits idempotently under the same "
+        "(worker, seq) and the layer lands exactly once in merge "
+        "order; a layer arriving after the cutover seal is fenced"),
+    "mvcc.cutover": (
+        "mvcc/store.py",
+        "the single cutover fence RPC failing at the worst moment "
+        "(coordinator unreachable as the watermark+epoch decision "
+        "seals) — the retry must re-ask and get the idempotent grant "
+        "or the sealed decision; two racing cutovers must agree on "
+        "exactly one (watermark, epoch)"),
+    "mvcc.compact": (
+        "mvcc/compact.py",
+        "compaction ticket dying between materializing the merged "
+        "base version and pruning the folded delta layers (kill -9 "
+        "mid-compaction) — the retried SCAVENGER ticket re-merges "
+        "idempotently: reads stay byte-identical whether the deltas "
+        "were pruned or not"),
     "client.s3.request": (
         "coordinator/s3client.py",
         "S3 wire request failing (timeout, 5xx, connection reset)"),

@@ -175,7 +175,7 @@ def build_parser() -> argparse.ArgumentParser:
                      choices=["snapshot", "replication", "worker_crash",
                               "scheduler_kill", "fleet_distributed",
                               "lock_order", "arrow_ipc", "exactly_once",
-                              "both", "all"],
+                              "snapshot_and_increment", "both", "all"],
                      help="worker_crash kills a sharded worker mid-part "
                           "and audits lease reclamation + epoch "
                           "fencing; scheduler_kill kills a fleet "
@@ -199,10 +199,18 @@ def build_parser() -> argparse.ArgumentParser:
                           "staged two-phase commit (zero duplicate/"
                           "lost rows under torn writes, mid-publish "
                           "kills and zombie replay, per capable sink "
-                          "backend); both = snapshot+replication; all "
-                          "adds worker_crash + scheduler_kill + "
-                          "fleet_distributed + lock_order + arrow_ipc "
-                          "+ exactly_once")
+                          "backend); snapshot_and_increment audits the "
+                          "MVCC consistent cutover (seeded aborts "
+                          "mid-snapshot/mid-delta-append/mid-cutover/"
+                          "mid-compaction, exactly-once merged reads, "
+                          "zombie publishes fenced at both epochs, "
+                          "compaction byte-equivalence, and "
+                          "byte-identical fire/admission/cutover logs "
+                          "across two runs of one seed); both = "
+                          "snapshot+replication; all adds worker_crash "
+                          "+ scheduler_kill + fleet_distributed + "
+                          "lock_order + arrow_ipc + exactly_once + "
+                          "snapshot_and_increment")
     cha.add_argument("--rows", type=int, default=0,
                      help="snapshot source rows (default 4096)")
     cha.add_argument("--messages", type=int, default=0,

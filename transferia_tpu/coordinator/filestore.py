@@ -57,6 +57,7 @@ class FileStoreCoordinator(Coordinator):
         os.makedirs(os.path.join(root, "health"), exist_ok=True)
         os.makedirs(os.path.join(root, "fleet"), exist_ok=True)
         os.makedirs(os.path.join(root, "obs"), exist_ok=True)
+        os.makedirs(os.path.join(root, "mvcc"), exist_ok=True)
 
     # -- file helpers -------------------------------------------------------
     def _tdir(self, transfer_id: str) -> str:
@@ -526,6 +527,59 @@ class FileStoreCoordinator(Coordinator):
                 except OSError:
                     pass
         return pruned
+
+    # -- MVCC staging-store control plane -------------------------------------
+    def _mvcc_path(self, scope: str) -> str:
+        import urllib.parse as _up
+
+        return os.path.join(self.root, "mvcc",
+                            f"{_up.quote(scope, safe='')}.json")
+
+    def _mvcc_doc(self, path: str) -> dict:
+        from transferia_tpu.abstract import mvccfence
+
+        doc = self._read_json(path, {})
+        if not isinstance(doc, dict) or "layers" not in doc:
+            doc = mvccfence.new_mvcc_doc()
+        return doc
+
+    def mvcc_admit_layer(self, scope: str, layer: dict) -> dict:
+        from transferia_tpu.abstract import mvccfence
+
+        p = self._mvcc_path(scope)
+        with self._locked(p):
+            doc = self._mvcc_doc(p)
+            res = mvccfence.admit_layer_in_place(doc, layer)
+            self._write_json(p, doc)
+            return res
+
+    def mvcc_cutover(self, scope: str, watermark: int,
+                     epoch: int) -> dict:
+        from transferia_tpu.abstract import mvccfence
+
+        p = self._mvcc_path(scope)
+        with self._locked(p):
+            doc = self._mvcc_doc(p)
+            res = mvccfence.cutover_in_place(doc, watermark, epoch)
+            self._write_json(p, doc)
+            return res
+
+    def mvcc_state(self, scope: str) -> dict:
+        from transferia_tpu.abstract import mvccfence
+
+        return mvccfence.state_view(
+            self._mvcc_doc(self._mvcc_path(scope)))
+
+    def mvcc_prune_layers(self, scope: str, keys: list) -> int:
+        from transferia_tpu.abstract import mvccfence
+
+        p = self._mvcc_path(scope)
+        with self._locked(p):
+            doc = self._mvcc_doc(p)
+            pruned = mvccfence.prune_layers_in_place(doc, keys)
+            if pruned:
+                self._write_json(p, doc)
+            return pruned
 
     def _write_health(self, path: str, worker_index: int,
                       payload) -> None:

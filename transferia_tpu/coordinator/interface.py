@@ -376,6 +376,55 @@ class Coordinator(abc.ABC):
         Returns segments pruned."""
         return 0
 
+    # -- MVCC staging-store control plane (abstract/mvccfence.py) ------------
+    #
+    # SNAPSHOT_AND_INCREMENT lands snapshot parts as immutable base
+    # versions while CDC deltas accumulate as LSN-ordered layers; the
+    # cutover — delta LSN high-watermark + staged-commit epoch — is ONE
+    # atomic decision recorded here.  Columnar layer data never crosses
+    # the coordinator: each scope stores a small JSON control doc
+    # (admitted layer metadata + the sealed cutover), with the shared
+    # dict-form helpers in abstract/mvccfence.py giving all three
+    # backends byte-identical semantics.  Layer admission is idempotent
+    # under the obs-segment (worker, seq) replace convention and FENCED
+    # once the cutover seals — a zombie snapshot worker publishing after
+    # the decision is rejected, not merged.  Backends without support
+    # keep the defaults (raise); the mvcc store then runs unfenced
+    # in-process (tests only).
+
+    def supports_mvcc(self) -> bool:
+        return type(self).mvcc_admit_layer is not \
+            Coordinator.mvcc_admit_layer
+
+    def mvcc_admit_layer(self, scope: str, layer: dict) -> dict:
+        """Atomically admit one delta-layer metadata record.  Returns
+        the decision dict: {"status": "admitted"|"replaced"|
+        "duplicate"|"fenced", ...} (abstract/mvccfence.py constants).
+        Same (worker, seq) replaces pre-cutover (idempotent retry) and
+        acks as "duplicate" post-cutover; a NEW key post-cutover is
+        "fenced" and must be discarded by the caller."""
+        raise NotImplementedError
+
+    def mvcc_cutover(self, scope: str, watermark: int,
+                     epoch: int) -> dict:
+        """The single fenced cutover decision.  First caller seals
+        (watermark, epoch) atomically; an identical retry is granted
+        idempotently ({"granted": True, "first": False}); any other
+        (watermark, epoch) is fenced and handed the sealed values."""
+        raise NotImplementedError
+
+    def mvcc_state(self, scope: str) -> dict:
+        """Read-only control snapshot: {"layers": [...], "cutover":
+        {...}|None, "watermark": int} (abstract/mvccfence.state_view)."""
+        raise NotImplementedError
+
+    def mvcc_prune_layers(self, scope: str, keys: list) -> int:
+        """Compaction GC: drop layer records by (worker, seq) key after
+        their rows were folded into a new base version.  Idempotent —
+        a compaction ticket retried after kill -9 re-prunes already
+        missing keys for free.  Returns records pruned."""
+        return 0
+
     # -- worker health (operation.go:30-36, replication.go:72-74) -----------
     def operation_health(self, operation_id: str, worker_index: int,
                          payload: Optional[dict] = None) -> None:

@@ -102,6 +102,11 @@ class MemoryCoordinator(Coordinator):
         # grow an in-process coordinator without limit
         self._obs_lock = lockwatch.named_lock("coordinator.obs")
         self._obs: dict[str, dict[tuple[str, int], dict]] = {}
+        # MVCC staging control docs: scope -> doc (abstract/mvccfence.py
+        # shape); columnar layer data never lands here, only the
+        # admission records and the sealed cutover decision
+        self._mvcc_lock = lockwatch.named_lock("coordinator.mvcc")
+        self._mvcc: dict[str, dict] = {}
 
     def _op(self, operation_id: str) -> _OpState:
         """Get-or-create the operation's state slot (the only place
@@ -480,6 +485,44 @@ class MemoryCoordinator(Coordinator):
                     del store[key]
                     pruned += 1
         return pruned
+
+    # -- MVCC staging-store control plane -------------------------------------
+    def mvcc_admit_layer(self, scope: str, layer: dict) -> dict:
+        import json as _json
+
+        from transferia_tpu.abstract import mvccfence
+
+        # json round trip: validates serializability and deep-copies,
+        # exactly like obs segments — callers keep mutating their dicts
+        lay = _json.loads(_json.dumps(layer))
+        with self._mvcc_lock:
+            doc = self._mvcc.setdefault(scope,
+                                        mvccfence.new_mvcc_doc())
+            return mvccfence.admit_layer_in_place(doc, lay)
+
+    def mvcc_cutover(self, scope: str, watermark: int,
+                     epoch: int) -> dict:
+        from transferia_tpu.abstract import mvccfence
+
+        with self._mvcc_lock:
+            doc = self._mvcc.setdefault(scope,
+                                        mvccfence.new_mvcc_doc())
+            return mvccfence.cutover_in_place(doc, watermark, epoch)
+
+    def mvcc_state(self, scope: str) -> dict:
+        from transferia_tpu.abstract import mvccfence
+
+        with self._mvcc_lock:
+            return mvccfence.state_view(self._mvcc.get(scope))
+
+    def mvcc_prune_layers(self, scope: str, keys: list) -> int:
+        from transferia_tpu.abstract import mvccfence
+
+        with self._mvcc_lock:
+            doc = self._mvcc.get(scope)
+            if doc is None:
+                return 0
+            return mvccfence.prune_layers_in_place(doc, keys)
 
     def operation_health(self, operation_id: str, worker_index: int,
                          payload: Optional[dict] = None) -> None:

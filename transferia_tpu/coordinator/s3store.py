@@ -801,6 +801,76 @@ class S3Coordinator(Coordinator):
                 pruned += 1
         return pruned
 
+    # -- MVCC staging-store control plane -------------------------------------
+    # One control doc per scope (`<prefix>mvcc/<scope>.json`), mutated
+    # through the same If-Match CAS loop as every other shared doc: the
+    # abstract/mvccfence helpers run inside the update closure, so the
+    # decision returned is the one that actually LANDED.  Under LWW
+    # degrade the fence weakens to reference semantics exactly like
+    # staged commits — race-sensitive conformance tests skip s3-lww.
+
+    def _mvcc_key(self, scope: str) -> str:
+        import urllib.parse as _up
+
+        return self._key("mvcc", f"{_up.quote(scope, safe='')}.json")
+
+    @staticmethod
+    def _mvcc_doc(cur: dict) -> dict:
+        from transferia_tpu.abstract import mvccfence
+
+        if not isinstance(cur, dict) or "layers" not in cur:
+            return mvccfence.new_mvcc_doc()
+        return cur
+
+    def mvcc_admit_layer(self, scope: str, layer: dict) -> dict:
+        from transferia_tpu.abstract import mvccfence
+
+        res: dict = {}
+
+        def upd(cur: dict) -> dict:
+            nonlocal res
+            doc = self._mvcc_doc(cur)
+            res = mvccfence.admit_layer_in_place(doc, layer)
+            return doc
+
+        self._merge_json(self._mvcc_key(scope), upd)
+        return res
+
+    def mvcc_cutover(self, scope: str, watermark: int,
+                     epoch: int) -> dict:
+        from transferia_tpu.abstract import mvccfence
+
+        res: dict = {}
+
+        def upd(cur: dict) -> dict:
+            nonlocal res
+            doc = self._mvcc_doc(cur)
+            res = mvccfence.cutover_in_place(doc, watermark, epoch)
+            return doc
+
+        self._merge_json(self._mvcc_key(scope), upd)
+        return res
+
+    def mvcc_state(self, scope: str) -> dict:
+        from transferia_tpu.abstract import mvccfence
+
+        cur, _ = self._get_json(self._mvcc_key(scope), {})
+        return mvccfence.state_view(self._mvcc_doc(cur))
+
+    def mvcc_prune_layers(self, scope: str, keys: list) -> int:
+        from transferia_tpu.abstract import mvccfence
+
+        pruned = 0
+
+        def upd(cur: dict) -> dict:
+            nonlocal pruned
+            doc = self._mvcc_doc(cur)
+            pruned = mvccfence.prune_layers_in_place(doc, keys)
+            return doc
+
+        self._merge_json(self._mvcc_key(scope), upd)
+        return pruned
+
     # -- health -------------------------------------------------------------
     def operation_health(self, operation_id: str, worker_index: int,
                          payload: Optional[dict] = None) -> None:

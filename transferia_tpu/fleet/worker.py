@@ -138,9 +138,22 @@ def _run_transfer_yaml(ticket: FleetTicket,
     ).upload_tables()
 
 
+def _run_mvcc_compact(ticket: FleetTicket,
+                      ctx: TicketRunContext) -> None:
+    """Payload: `{"scope", "table", "watermark"}` (mvcc/compact.py).
+    SCAVENGER maintenance over an in-process MVCC staging store — the
+    scope resolves through the process-local registry; a miss raises
+    so the lease hands the ticket to a worker holding the layers."""
+    from transferia_tpu.mvcc.compact import make_compact_runner
+    from transferia_tpu.mvcc.store import resolve_store
+
+    make_compact_runner(resolve_store)(ticket, ctx)
+
+
 RUNNERS: dict[str, Callable[[FleetTicket, TicketRunContext], None]] = {
     "sample_snapshot": _run_sample_snapshot,
     "transfer_yaml": _run_transfer_yaml,
+    "mvcc_compact": _run_mvcc_compact,
 }
 
 

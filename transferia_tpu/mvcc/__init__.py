@@ -1,0 +1,19 @@
+"""MVCC columnar staging store (ROADMAP item 4, "Mainlining Databases").
+
+Snapshot parts land as immutable encoded BASE versions while CDC
+deltas accumulate as LSN-ordered DELTA layers; point-in-time reads
+merge both at a watermark, the snapshot→replication cutover is one
+fenced coordinator decision, and background compaction folds deltas
+into new base versions on SCAVENGER fleet tickets.  See
+ARCHITECTURE.md "MVCC staging store".
+"""
+
+from transferia_tpu.mvcc.store import (  # noqa: F401
+    BaseVersion,
+    DeltaLayer,
+    MvccStore,
+    OversizeLayerError,
+    register_store,
+    resolve_store,
+    unregister_store,
+)

@@ -1,0 +1,188 @@
+"""`bench.py --mvcc`: merge-on-read vs compacted-read throughput and
+cutover decision latency over a dict-heavy staging store.
+
+The lane measures the two read shapes the store serves — the layered
+point-in-time merge (lexsort + per-source take) right after the
+snapshot, and the same read after the SCAVENGER compaction folded the
+layers into one base — plus the cost of the cutover seal itself (one
+coordinator round trip; in the bench that is MemoryCoordinator, so the
+number is the decision-code floor, not a network figure).  The run
+self-checks: the layered and compacted reads must be row-identical and
+the whole pass must finish with ZERO dict flat materializations."""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+from transferia_tpu.abstract.kinds import KIND_CODES, Kind
+from transferia_tpu.abstract.schema import (
+    CanonicalType,
+    ColSchema,
+    TableID,
+    TableSchema,
+)
+from transferia_tpu.columnar.batch import (
+    Column,
+    ColumnBatch,
+    DictEnc,
+    DictPool,
+    _offsets_from_lengths,
+)
+from transferia_tpu.coordinator.memory import MemoryCoordinator
+from transferia_tpu.mvcc.compact import compact_table
+from transferia_tpu.mvcc.store import MvccStore
+from transferia_tpu.stats.trace import TELEMETRY
+
+TID = TableID("bench", "mvcc_events")
+TABLE = str(TID)
+SEGMENTS = [f"segment-{i:02d}".encode() for i in range(24)]
+
+
+def _pool() -> DictPool:
+    data = np.frombuffer(b"".join(SEGMENTS), dtype=np.uint8).copy()
+    return DictPool(data,
+                    _offsets_from_lengths([len(s) for s in SEGMENTS]))
+
+
+def _schema() -> TableSchema:
+    return TableSchema((
+        ColSchema("id", CanonicalType.INT64, primary_key=True),
+        ColSchema("segment", CanonicalType.UTF8),
+        ColSchema("amount", CanonicalType.DOUBLE),
+    ))
+
+
+def _batch(schema, pool, ids: np.ndarray, **kw) -> ColumnBatch:
+    return ColumnBatch(TID, schema, {
+        "id": Column("id", CanonicalType.INT64,
+                     ids.astype(np.int64)),
+        "segment": Column("segment", CanonicalType.UTF8,
+                          dict_enc=DictEnc(
+                              (ids % len(SEGMENTS)).astype(np.int32),
+                              pool=pool)),
+        "amount": Column("amount", CanonicalType.DOUBLE,
+                         (ids * 0.25).astype(np.float64)),
+    }, **kw)
+
+
+def build_store(rows: int, layers: int,
+                batch_rows: int = 65_536) -> MvccStore:
+    """Dict-heavy base (shared pool across every part) + `layers`
+    UPDATE/DELETE delta layers touching ~1/8 of the keyspace each."""
+    schema, pool = _schema(), _pool()
+    st = MvccStore("mvcc/bench")
+    for part, lo in enumerate(range(0, rows, batch_rows)):
+        ids = np.arange(lo, min(lo + batch_rows, rows))
+        st.put_base(TABLE, f"part-{part}", 1,
+                    [_batch(schema, pool, ids)])
+    rng = np.random.default_rng(7)
+    upd = KIND_CODES[Kind.UPDATE]
+    dele = KIND_CODES[Kind.DELETE]
+    lsn = 100
+    per_layer = max(1, rows // (8 * max(1, layers)))
+    for li in range(layers):
+        ids = rng.choice(rows, size=per_layer, replace=False)
+        kinds = np.where(rng.random(per_layer) < 0.1, dele,
+                         upd).astype(np.int8)
+        lsns = np.arange(lsn, lsn + per_layer, dtype=np.int64)
+        lsn += per_layer
+        st.append_delta(TABLE, f"w{li % 4}", li,
+                        [_batch(schema, pool, ids, kinds=kinds,
+                                lsns=lsns)])
+    return st
+
+
+def _timed_reads(st: MvccStore, iters: int) -> tuple[float, int]:
+    rows = 0
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        rows = sum(b.n_rows for b in st.read_at(TABLE))
+    return time.perf_counter() - t0, rows
+
+
+def _rows_view(st: MvccStore) -> dict:
+    out: dict[int, tuple] = {}
+    for b in st.read_at(TABLE):
+        d = b.to_pydict()
+        for i, s, a in zip(d["id"], d["segment"], d["amount"]):
+            out[i] = (s, a)
+    return out
+
+
+def measure_cutover_ms(samples: int = 64) -> float:
+    """Mean seal latency over fresh scopes of one MemoryCoordinator —
+    the decision-code floor for the one-fence cutover."""
+    cp = MemoryCoordinator()
+    schema, pool = _schema(), _pool()
+    total = 0.0
+    for i in range(samples):
+        st = MvccStore(f"mvcc/bench-cut-{i}", cp)
+        ids = np.arange(256)
+        st.put_base(TABLE, "p0", 1, [_batch(schema, pool, ids)])
+        st.append_delta(TABLE, "w0", 0, [_batch(
+            schema, pool, ids[:32],
+            kinds=np.full(32, KIND_CODES[Kind.UPDATE], dtype=np.int8),
+            lsns=np.arange(100, 132, dtype=np.int64))])
+        t0 = time.perf_counter()
+        st.cutover(epoch=2)
+        total += time.perf_counter() - t0
+    return total * 1000.0 / samples
+
+
+def run_mvcc_bench(rows: int = 200_000, layers: int = 12,
+                   iters: int = 3) -> dict:
+    TELEMETRY.reset()
+    t0 = time.perf_counter()
+    st = build_store(rows, layers)
+    build_s = time.perf_counter() - t0
+    layered_view = _rows_view(st)
+    layered_s, visible = _timed_reads(st, iters)
+
+    t0 = time.perf_counter()
+    res = compact_table(st, TABLE)
+    compact_s = time.perf_counter() - t0
+    compacted_s, visible2 = _timed_reads(st, iters)
+    equivalent = (visible == visible2
+                  and _rows_view(st) == layered_view)
+
+    cutover_ms = measure_cutover_ms()
+    flat = TELEMETRY.snapshot()["dict_flat_materializations"]
+    return {
+        "metric": "mvcc_merge_layered_rows_per_sec",
+        "unit": "rows/sec",
+        "value": round(visible * iters / max(layered_s, 1e-9), 1),
+        "ok": bool(equivalent and flat == 0),
+        "rows": rows,
+        "layers": layers,
+        "iters": iters,
+        "visible_rows": visible,
+        "compacted_rows_per_sec": round(
+            visible2 * iters / max(compacted_s, 1e-9), 1),
+        "cutover_ms": round(cutover_ms, 4),
+        "build_seconds": round(build_s, 3),
+        "compact_seconds": round(compact_s, 3),
+        "layers_folded": len(res["folded"]),
+        "compaction_equivalent": equivalent,
+        "dict_flat_materializations": int(flat),
+    }
+
+
+def format_report(report: dict) -> str:
+    lines = [
+        f"mvcc bench: {report['rows']} base rows + "
+        f"{report['layers']} delta layers "
+        f"({report['visible_rows']} visible)",
+        f"  layered merge-on-read: {report['value']} rows/s",
+        f"  compacted read: {report['compacted_rows_per_sec']} rows/s "
+        f"(compaction folded {report['layers_folded']} layers in "
+        f"{report['compact_seconds']}s)",
+        f"  cutover seal: {report['cutover_ms']}ms mean "
+        f"(memory coordinator floor)",
+        f"  flat materializations: "
+        f"{report['dict_flat_materializations']}",
+        "mvcc bench verdict: "
+        + ("PASS" if report["ok"] else "FAIL"),
+    ]
+    return "\n".join(lines)

@@ -1,0 +1,181 @@
+"""SNAPSHOT_AND_INCREMENT orchestration through the MVCC store.
+
+The consistent-cutover flow (ARCHITECTURE.md "MVCC staging store"):
+
+1. The replication slot/changefeed exists FIRST (tasks/activate.py
+   creates it before any snapshot row is read), so every change that
+   lands during the snapshot is captured from the pre-snapshot LSN.
+2. Snapshot parts land as immutable base versions (`put_base`), each
+   landing optionally gated by the PR 11 `commit_part` grant
+   (`land_snapshot_part`) — a zombie snapshot worker is fenced at the
+   coordinator AND at the store's epoch fence.
+3. Replication batches that arrive meanwhile are appended as delta
+   layers (`MvccStore.append_delta`) keyed `(worker, seq)`.
+4. The cutover seals (delta LSN high-watermark, staged-commit epoch)
+   atomically; the merged point-in-time image at that watermark is
+   published to the destination; replication resumes FROM the sealed
+   watermark (`resume_state`) with the sink's dedup window armed — the
+   lsn <= watermark prefix a resuming source replays is dropped by the
+   same `providers/staging.DedupWindow` rule the staged sinks use.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable, Optional
+
+from transferia_tpu.abstract.table import (
+    OperationTablePart,
+    TableDescription,
+)
+from transferia_tpu.columnar.batch import ColumnBatch
+from transferia_tpu.factories import make_sinker, new_storage
+from transferia_tpu.mvcc.store import MvccStore
+from transferia_tpu.stats import trace
+from transferia_tpu.stats.registry import Metrics
+
+logger = logging.getLogger(__name__)
+
+# transfer-state keys (Coordinator.set_transfer_state merges keys, so
+# these coexist with provider checkpoints like pg_wal_lsn)
+STATE_WATERMARK = "mvcc_watermark"
+STATE_EPOCH = "mvcc_epoch"
+
+
+def store_scope(transfer_id: str) -> str:
+    return f"mvcc/{transfer_id}"
+
+
+def land_snapshot_part(store: MvccStore, coordinator,
+                       operation_id: str,
+                       part: OperationTablePart,
+                       batches: list[ColumnBatch]) -> bool:
+    """Fenced landing of one snapshot part: the `commit_part` grant
+    first (False = the part was reclaimed since this worker's claim —
+    discard, another worker owns it now), then `put_base` at the
+    part's assignment epoch.  Returns True when the part landed."""
+    if coordinator is not None:
+        granted = coordinator.commit_part(operation_id, part)
+        if granted is False:
+            logger.warning("mvcc: part %s fenced at commit_part "
+                           "(epoch %d) — discarding", part.key(),
+                           part.assignment_epoch)
+            return False
+    store.put_base(str(part.table_id), f"part-{part.part_index}",
+                   max(1, int(part.assignment_epoch)), batches)
+    return True
+
+
+def snapshot_into_store(transfer, store: MvccStore,
+                        metrics: Optional[Metrics] = None,
+                        tables=None) -> list[str]:
+    """Read the source snapshot into base versions — one part per
+    table description, epoch 1 (single-attempt activation path; the
+    fleet path lands parts via `land_snapshot_part`)."""
+    metrics = metrics or Metrics()
+    storage = new_storage(transfer, metrics)
+    try:
+        if tables is None:
+            tables = [TableDescription(id=tid)
+                      for tid in storage.table_list()]
+        landed = []
+        for i, td in enumerate(tables):
+            batches: list[ColumnBatch] = []
+            storage.load_table(td, batches.append)
+            store.put_base(str(td.id), f"part-{i}", 1, batches)
+            landed.append(str(td.id))
+        return landed
+    finally:
+        storage.close()
+
+
+def publish_merged(store: MvccStore, transfer,
+                   metrics: Optional[Metrics] = None,
+                   watermark: Optional[int] = None) -> int:
+    """Publish the point-in-time merged image of every table to the
+    destination sink.  Staged-commit capable sinks get the fenced
+    begin/publish lifecycle per table (part key `mvcc/<table>`, the
+    sealed epoch); others get direct pushes."""
+    metrics = metrics or Metrics()
+    sealed = store.sealed()
+    epoch = sealed[1] if sealed is not None else 1
+    from transferia_tpu.abstract.commit import find_staged_sink
+
+    sink = make_sinker(transfer, metrics, snapshot_stage=True)
+    staged = find_staged_sink(sink)
+    sp = trace.span("mvcc_publish", tables=len(store.tables()))
+    rows = 0
+    with sp:
+        try:
+            for table in store.tables():
+                merged = store.read_at(table, watermark=watermark)
+                if staged is not None:
+                    key = f"mvcc/{table}"
+                    staged.begin_part(key, epoch)
+                    try:
+                        for b in merged:
+                            sink.push(b)
+                        rows += staged.publish_part(key, epoch)
+                    except BaseException:
+                        staged.abort_part(key)
+                        raise
+                else:
+                    for b in merged:
+                        sink.push(b)
+                        rows += b.n_rows
+        finally:
+            close = getattr(sink, "close", None)
+            if close:
+                close()
+        if sp:
+            sp.add(rows=rows)
+    return rows
+
+
+def resume_state(coordinator, transfer_id: str) -> Optional[dict]:
+    """The sealed cutover decision a resuming replication lane reads:
+    `{"watermark": W, "epoch": E}` or None before a cutover.  The lane
+    starts its source from W and arms the sink dedup window — rows at
+    or below W are the snapshot's, anything the source replays across
+    the boundary is dropped as a torn prefix."""
+    state = coordinator.get_transfer_state(transfer_id)
+    if STATE_WATERMARK not in state:
+        return None
+    return {"watermark": int(state[STATE_WATERMARK]),
+            "epoch": int(state.get(STATE_EPOCH, 1))}
+
+
+def activate_snapshot_and_increment(
+        transfer, coordinator,
+        metrics: Optional[Metrics] = None,
+        tables=None,
+        deltas: Optional[Callable[[MvccStore], None]] = None,
+        store: Optional[MvccStore] = None,
+        epoch: int = 1) -> MvccStore:
+    """The activation-time S&I pipeline over the MVCC store.  `deltas`
+    is the hook where concurrently-arriving replication batches enter
+    (the replication lane calls `store.append_delta` directly; tests
+    and the chaos mode inject through the same hook)."""
+    metrics = metrics or Metrics()
+    st = store or MvccStore(store_scope(transfer.id), coordinator,
+                            metrics)
+    sp = trace.span("mvcc_activate", transfer=transfer.id)
+    with sp:
+        snapshot_into_store(transfer, st, metrics, tables)
+        if deltas is not None:
+            deltas(st)
+        decision = st.cutover(epoch)
+        if not decision.get("granted"):
+            # another activation already sealed — adopt its decision
+            # (idempotent activation retry after a crash)
+            logger.info("mvcc: cutover fenced, adopting sealed "
+                        "(watermark=%s epoch=%s)",
+                        decision.get("watermark"),
+                        decision.get("epoch"))
+        w, e = st.sealed()
+        publish_merged(st, transfer, metrics, watermark=w)
+        coordinator.set_transfer_state(
+            transfer.id, {STATE_WATERMARK: w, STATE_EPOCH: e})
+        if sp:
+            sp.add(watermark=w, epoch=e)
+    return st

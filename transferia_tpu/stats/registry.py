@@ -407,6 +407,33 @@ class DistributedFleetStats(_Bundle):
         self.live_workers = self.m.gauge("fleet_dist_live_workers")
 
 
+class MvccStats(_Bundle):
+    """MVCC staging-store counters (transferia_tpu/mvcc/).  The pair to
+    watch is `layers_fenced` vs `cutovers`: nonzero fences mean zombie
+    snapshot/delta workers published after the cutover sealed and were
+    stopped at the coordinator.  `watermark_lag` is the distance
+    between the newest delta LSN seen and the sealed cutover watermark
+    — a growing lag after cutover means the resumed replication lane
+    is falling behind the source."""
+
+    def __init__(self, metrics: Optional[Metrics] = None):
+        super().__init__(metrics)
+        self.base_versions = self.m.counter("mvcc_base_versions")
+        self.base_rows = self.m.counter("mvcc_base_rows")
+        self.delta_layers = self.m.counter("mvcc_delta_layers")
+        self.delta_rows = self.m.counter("mvcc_delta_rows")
+        self.layers_replaced = self.m.counter("mvcc_layers_replaced")
+        self.layers_fenced = self.m.counter("mvcc_layers_fenced")
+        self.merged_reads = self.m.counter("mvcc_merged_reads")
+        self.merged_rows = self.m.counter("mvcc_merged_rows")
+        self.cutovers = self.m.counter("mvcc_cutovers")
+        self.cutover_fenced = self.m.counter("mvcc_cutover_fenced")
+        self.compactions = self.m.counter("mvcc_compactions")
+        self.compacted_rows = self.m.counter("mvcc_compacted_rows")
+        self.live_layers = self.m.gauge("mvcc_live_layers")
+        self.watermark_lag = self.m.gauge("mvcc_watermark_lag")
+
+
 class TableStats(_Bundle):
     """Per-table progress gauges (pkg/stats/table.go)."""
 

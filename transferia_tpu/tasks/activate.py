@@ -76,7 +76,36 @@ def activate_delivery(transfer, coordinator: Coordinator,
         # undos on callbacks.rollbacks (never registered eagerly here:
         # tearing down a pre-existing slot on a destination-side failure
         # would lose the WAL position of a previous activation).
-        if transfer.type.has_snapshot:
+        if transfer.type == TransferType.SNAPSHOT_AND_INCREMENT:
+            # The replication slot/changefeed must exist BEFORE the
+            # first snapshot row is read: changes committed while the
+            # snapshot runs are only replayable if the slot already
+            # pins the pre-snapshot LSN — created after the snapshot,
+            # the slot starts at a post-snapshot position and the
+            # in-between window is silently lost.  The provider hook
+            # runs slot creation only (no-op callbacks); cleanup and
+            # upload follow explicitly.
+            if src_provider.supports_activate():
+                src_provider.activate(
+                    ActivateCallbacks(lambda _t: None, lambda _t: None,
+                                      rollbacks)
+                )
+            cleanup_cb(tables)
+            if coordinator.supports_mvcc() and \
+                    src_provider.snapshot_provider() is None:
+                # consistent cutover through the MVCC staging store:
+                # snapshot parts land as base versions, deltas captured
+                # during the load stack as layers, and the sealed
+                # watermark is where replication resumes
+                from transferia_tpu.mvcc.runner import (
+                    activate_snapshot_and_increment,
+                )
+
+                activate_snapshot_and_increment(
+                    transfer, coordinator, metrics, tables)
+            else:
+                upload_cb(tables)
+        elif transfer.type.has_snapshot:
             if src_provider.supports_activate():
                 src_provider.activate(
                     ActivateCallbacks(cleanup_cb, upload_cb, rollbacks)
