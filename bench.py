@@ -1315,6 +1315,13 @@ TOLERANCE_OVERRIDES = {
     "mvcc_merge_layered_rows_per_sec": 0.4,
     "mvcc_merge_compacted_rows_per_sec": 0.4,
     "mvcc_cutover_ms": 0.8,
+    # spill is Arrow-IPC encode + a heap-blob put, rebuild replays the
+    # whole manifest through decode + re-land — both wall-clock
+    # numbers swing with the 1-core boxes' scheduling; the durability
+    # contracts (byte-identical rebuild, no-flatten round trip) gate
+    # through the run's own `ok` and the spill conformance tests
+    "mvcc_spill_mbs": 0.5,
+    "mvcc_rebuild_ms": 0.8,
 }
 
 
@@ -1933,6 +1940,10 @@ def main() -> int:
                "value": report["compacted_rows_per_sec"]})
         _emit({"metric": "mvcc_cutover_ms", "unit": "ms",
                "value": report["cutover_ms"]})
+        _emit({"metric": "mvcc_spill_mbs", "unit": "MB/s",
+               "value": report["spill_mbs"]})
+        _emit({"metric": "mvcc_rebuild_ms", "unit": "ms",
+               "value": report["rebuild_ms"]})
         print(json.dumps(report))
         return gated(0 if report["ok"] else 1)
 

@@ -82,7 +82,7 @@ class TestMvccConformance:
         cp.mvcc_admit_layer(SCOPE, layer(seq=0, lsn_max=115))
         d = cp.mvcc_cutover(SCOPE, 115, 2)
         assert d == {"granted": True, "first": True, "watermark": 115,
-                     "epoch": 2}
+                     "epoch": 2, "offsets": {}}
         # identical retry (activation crashed after the seal): granted
         d = cp.mvcc_cutover(SCOPE, 115, 2)
         assert d["granted"] and not d["first"]

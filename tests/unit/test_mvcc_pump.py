@@ -1,0 +1,339 @@
+"""MVCC live replication pump: CDC drains into the store while the
+snapshot loads — flush-group offset placement (offsets ride ONLY the
+last layer of a flush), manifest-driven resume (seek past admitted
+offsets), crash/rebuild with zero loss and zero duplicates in the
+merged image, the zombie-pump fence, the sealed-offset commit fence,
+and the deprecation path for the PR 19 `deltas` callback."""
+
+import json
+
+import numpy as np
+import pytest
+
+from transferia_tpu.abstract.kinds import KIND_CODES, Kind
+from transferia_tpu.abstract.schema import TableID, new_table_schema
+from transferia_tpu.columnar.batch import ColumnBatch
+from transferia_tpu.coordinator import MemoryCoordinator
+from transferia_tpu.models import Transfer, TransferType
+from transferia_tpu.mvcc import MvccStore
+from transferia_tpu.mvcc.pump import (
+    MvccPump,
+    partition_key,
+    split_partition_key,
+)
+from transferia_tpu.mvcc.runner import (
+    activate_snapshot_and_increment,
+    resume_state,
+    store_scope,
+)
+from transferia_tpu.mvcc.spill import rebuild_store
+from transferia_tpu.mvcc.store import register_store, unregister_store
+from transferia_tpu.parsers.base import Message, ParseResult
+from transferia_tpu.providers.memory import MemoryTargetParams, get_store
+from transferia_tpu.providers.mq import (
+    _BROKERS,
+    MQSourceParams,
+    _MQClient,
+    get_broker,
+)
+from transferia_tpu.providers.sample import SampleSourceParams
+
+I, U = KIND_CODES[Kind.INSERT], KIND_CODES[Kind.UPDATE]
+
+PARSER = {"json": {
+    "schema": [
+        {"name": "id", "type": "int64", "key": True},
+        {"name": "payload", "type": "utf8"},
+        {"name": "amount", "type": "double"},
+    ],
+    "table": "pump_events",
+    "namespace": "mqtest",
+    "add_system_cols": False,
+}}
+TID = TableID("mqtest", "pump_events")
+TABLE = str(TID)
+TOPIC = "events"
+
+
+def feed_messages(n=40):
+    """Insert ids 0..n/2-1, then update every one of them — the final
+    image is exactly the second half, latest-wins by PK."""
+    half = n // 2
+    out = []
+    for i in range(half):
+        out.append({"id": i, "payload": f"v0-{i}", "amount": float(i)})
+    for i in range(half):
+        out.append({"id": i, "payload": f"v1-{i}",
+                    "amount": float(i) + 0.5})
+    return out
+
+
+def make_feed(name, msgs, n_partitions=2):
+    _BROKERS.pop(name, None)
+    broker = get_broker(name, n_partitions=n_partitions)
+    for i, m in enumerate(msgs):
+        broker.produce(TOPIC, str(m["id"]).encode(),
+                       json.dumps(m).encode(),
+                       partition=i % n_partitions)
+    params = MQSourceParams(broker_id=name, topic=TOPIC,
+                            parser=PARSER, n_partitions=n_partitions)
+    return broker, params
+
+
+def new_pump(store, params, **kw):
+    kw.setdefault("layer_rows", 10)
+    return MvccPump(store, _MQClient(params),
+                    parser_config=PARSER, **kw)
+
+
+def drain(pump, max_messages=8):
+    while pump.step(max_messages=max_messages):
+        pass
+    pump.flush()
+
+
+def merged_rows(store):
+    """Merged image -> {id: payload}, asserting each id appears once
+    (the zero-duplicate pin)."""
+    out = {}
+    for b in store.read_at(TABLE):
+        d = b.to_pydict()
+        for i, p in zip(d["id"], d["payload"]):
+            assert i not in out, f"duplicate id {i} in merged image"
+            out[i] = p
+    return out
+
+
+def expected_rows(msgs):
+    return {m["id"]: m["payload"] for m in msgs}
+
+
+class TestPumpDrive:
+    def test_partition_key_roundtrip(self):
+        assert partition_key("a:b", 3) == "a:b:3"
+        assert split_partition_key("a:b:3") == ("a:b", 3)
+
+    def test_drain_builds_layers_and_offsets(self):
+        msgs = feed_messages(40)
+        broker, params = make_feed("mq-pump-drain", msgs)
+        cp = MemoryCoordinator()
+        st = MvccStore("mvcc/pump-drain", cp)
+        pump = new_pump(st, params)
+        drain(pump)
+        assert merged_rows(st) == expected_rows(msgs)
+        # pump-local LSNs are dense over the feed
+        assert st.watermark() == len(msgs) - 1
+        # covered offsets = the broker's high offset per partition
+        assert pump.offsets() == {f"{TOPIC}:0": 19, f"{TOPIC}:1": 19}
+        layers = st.control_state()["layers"]
+        assert [d["worker"] for d in layers] == ["pump"] * len(layers)
+        assert [d["seq"] for d in layers] == list(range(len(layers)))
+        # nothing committed to the source before a sealed cutover
+        assert broker.committed_offset("transfer", TOPIC, 0) == -1
+
+    def test_flush_offsets_ride_only_the_last_layer(self):
+        """A flush sealing several tables' layers must put the covered
+        offsets on the LAST one only: die between them and the resume
+        point has not advanced past rows that never sealed."""
+        schema = new_table_schema([("id", "int64", True)])
+        t_a, t_b = TableID("s", "aa"), TableID("s", "bb")
+
+        class TwoTableParser:
+            def do_batch(self, messages):
+                n = len(messages)
+                kw = {"kinds": np.full(n, I, dtype=np.int8)}
+                return ParseResult(batches=[
+                    ColumnBatch.from_pydict(
+                        t_a, schema, {"id": list(range(n))}, **kw),
+                    ColumnBatch.from_pydict(
+                        t_b, schema, {"id": list(range(n))}, **kw),
+                ])
+
+        class OneShotClient:
+            def __init__(self):
+                self.fed = False
+
+            def fetch(self, max_messages=1024):
+                if self.fed:
+                    return []
+                self.fed = True
+                from transferia_tpu.providers.queue_common import (
+                    FetchedBatch,
+                )
+
+                return [FetchedBatch(TOPIC, 0, [
+                    Message(value=b"x", topic=TOPIC, offset=o)
+                    for o in range(3)])]
+
+            def commit(self, topic, partition, offset):
+                pass
+
+        cp = MemoryCoordinator()
+        st = MvccStore("mvcc/pump-flushgroup", cp)
+        pump = MvccPump(st, OneShotClient(), parser=TwoTableParser(),
+                        layer_rows=1)
+        pump.step()
+        pump.flush()
+        layers = st.control_state()["layers"]
+        assert [d["table"] for d in layers] == [str(t_a), str(t_b)]
+        assert not layers[0].get("offsets")
+        assert layers[1].get("offsets") == {f"{TOPIC}:0": 2}
+
+    def test_resume_seeks_past_admitted_offsets(self):
+        msgs = feed_messages(40)
+        broker, params = make_feed("mq-pump-resume", msgs)
+        cp = MemoryCoordinator()
+        st = MvccStore("mvcc/pump-resume", cp)
+        pump1 = new_pump(st, params, layer_rows=6)
+        pump1.step(max_messages=8)
+        pump1.step(max_messages=8)
+        pump1.flush()
+        covered = pump1.offsets()
+        assert covered
+        seqs_before = [d["seq"] for d in st.control_state()["layers"]]
+        # a fresh incarnation arms its cursor from the manifest, not
+        # from the group's committed offsets (still -1)
+        pump2 = new_pump(st, params, layer_rows=6)
+        for key, off in covered.items():
+            topic, part = split_partition_key(key)
+            assert pump2.client.positions[part] == off + 1
+        drain(pump2)
+        assert merged_rows(st) == expected_rows(msgs)
+        seqs = [d["seq"] for d in st.control_state()["layers"]]
+        assert len(set(seqs)) == len(seqs)
+        assert min(s for s in seqs if s not in seqs_before) == \
+            max(seqs_before) + 1
+
+    def test_crash_rebuild_resume_zero_loss_zero_dup(self):
+        """Kill the worker mid-feed: the survivor rebuilds the scope
+        from the spill manifest and a fresh pump re-reads only what no
+        admitted layer covers — the merged image is complete with every
+        id exactly once."""
+        msgs = feed_messages(40)
+        broker, params = make_feed("mq-pump-crash", msgs)
+        cp = MemoryCoordinator()
+        scope = "mvcc/pump-crash"
+        unregister_store(scope)
+        st = register_store(MvccStore(scope, cp))
+        pump1 = new_pump(st, params, layer_rows=6)
+        pump1.step(max_messages=10)
+        pump1.flush()
+        # SIGKILL: in-process columnar state is gone
+        unregister_store(scope)
+        st2 = rebuild_store(scope, cp)
+        assert st2 is not None
+        pump2 = new_pump(st2, params, layer_rows=6)
+        drain(pump2)
+        d = st2.cutover(2, offsets=pump2.offsets())
+        assert d["granted"]
+        assert merged_rows(st2) == expected_rows(msgs)
+
+    def test_zombie_pump_fenced_after_cutover(self):
+        msgs = feed_messages(20)
+        broker, params = make_feed("mq-pump-zombie", msgs)
+        cp = MemoryCoordinator()
+        st = MvccStore("mvcc/pump-zombie", cp)
+        pump = new_pump(st, params)
+        drain(pump)
+        assert st.cutover(2, offsets=pump.offsets())["granted"]
+        doc_layers = len(st.control_state()["layers"])
+        broker.produce(TOPIC, b"99", json.dumps(
+            {"id": 99, "payload": "late", "amount": 9.9}).encode(),
+            partition=0)
+        pump.step()
+        pump.flush()
+        assert pump.fenced
+        assert pump.step() == 0  # a fenced pump stops consuming
+        assert len(st.control_state()["layers"]) == doc_layers
+        assert 99 not in merged_rows(st)
+
+
+class TestOffsetFence:
+    def test_commit_requires_a_sealed_cutover(self):
+        msgs = feed_messages(20)
+        broker, params = make_feed("mq-pump-fence1", msgs)
+        st = MvccStore("mvcc/pump-fence1", MemoryCoordinator())
+        pump = new_pump(st, params)
+        drain(pump)
+        with pytest.raises(RuntimeError, match="no sealed cutover"):
+            pump.commit_sealed_offsets()
+        assert broker.committed_offset("transfer", TOPIC, 0) == -1
+
+    def test_only_sealed_offsets_reach_the_source(self):
+        msgs = feed_messages(20)
+        broker, params = make_feed("mq-pump-fence2", msgs)
+        st = MvccStore("mvcc/pump-fence2", MemoryCoordinator())
+        pump = new_pump(st, params)
+        drain(pump)
+        sealed_offs = pump.offsets()
+        assert st.cutover(2, offsets=sealed_offs)["granted"]
+        # rows arriving after the seal never move the commit point:
+        # the fenced append leaves the sealed doc untouched
+        broker.produce(TOPIC, b"77", json.dumps(
+            {"id": 77, "payload": "late", "amount": 7.7}).encode(),
+            partition=0)
+        pump.step()
+        pump.flush()
+        committed = pump.commit_sealed_offsets()
+        assert committed == sealed_offs == st.sealed_offsets()
+        for key, off in sealed_offs.items():
+            topic, part = split_partition_key(key)
+            assert broker.committed_offset("transfer", topic,
+                                           part) == off
+        # idempotent retry (the mvcc.offset_commit kill replays it)
+        assert pump.commit_sealed_offsets() == sealed_offs
+
+
+def make_transfer(tid, rows=64):
+    return Transfer(
+        id=tid,
+        type=TransferType.SNAPSHOT_AND_INCREMENT,
+        src=SampleSourceParams(preset="users", table="users",
+                               rows=rows, batch_rows=32),
+        dst=MemoryTargetParams(sink_id=f"mvccpump_{tid}"),
+    )
+
+
+class TestActivationIntegration:
+    def test_deltas_callback_is_deprecated_but_works(self):
+        t = make_transfer("pdep1")
+        get_store("mvccpump_pdep1").clear()
+        cp = MemoryCoordinator()
+        seen = []
+        with pytest.warns(DeprecationWarning, match="pump"):
+            activate_snapshot_and_increment(
+                t, cp, deltas=lambda st: seen.append(st))
+        assert len(seen) == 1
+        assert resume_state(cp, t.id) == {"watermark": -1, "epoch": 1}
+
+    def test_from_transfer_returns_none_for_non_queue_source(self):
+        t = make_transfer("pnq1")
+        st = MvccStore(store_scope(t.id), MemoryCoordinator())
+        assert MvccPump.from_transfer(t, st) is None
+
+    def test_activation_with_live_pump_seals_and_commits(self):
+        """End to end: snapshot + concurrent pump -> cutover seals the
+        covered offsets -> only then do they commit to the broker ->
+        resume_state exposes them for the replication lane."""
+        msgs = feed_messages(40)
+        broker, params = make_feed("mq-pump-act", msgs)
+        t = make_transfer("pact1")
+        get_store("mvccpump_pact1").clear()
+        cp = MemoryCoordinator()
+        st = MvccStore(store_scope(t.id), cp)
+        pump = new_pump(st, params, layer_rows=8)
+        out = activate_snapshot_and_increment(t, cp, store=st,
+                                              pump=pump)
+        assert out is st
+        assert set(st.tables()) == {TABLE, "sample.users"}
+        rs = resume_state(cp, t.id)
+        assert rs["epoch"] == 1
+        assert rs["offsets"] == {f"{TOPIC}:0": 19, f"{TOPIC}:1": 19}
+        assert rs["watermark"] == len(msgs) - 1
+        for part in (0, 1):
+            assert broker.committed_offset("transfer", TOPIC,
+                                           part) == 19
+        # both tables published through the staged sink
+        sink = get_store("mvccpump_pact1")
+        assert sink.row_count(TID) == len(expected_rows(msgs))
+        assert sink.row_count(TableID("sample", "users")) == 64

@@ -406,21 +406,39 @@ class AuditingCoordinator(Coordinator):
                  res.get("status", "")))
         return res
 
-    def mvcc_cutover(self, scope, watermark, epoch):
-        res = self.inner.mvcc_cutover(scope, watermark, epoch)
+    def mvcc_cutover(self, scope, watermark, epoch, offsets=None):
+        res = self.inner.mvcc_cutover(scope, watermark, epoch,
+                                      offsets=offsets)
         with self._lock:
             self.mvcc_cutover_log.append(
                 (int(res.get("watermark", -1)),
                  int(res.get("epoch", -1)),
                  bool(res.get("granted")),
-                 bool(res.get("first"))))
+                 bool(res.get("first")),
+                 tuple(sorted(
+                     (res.get("offsets") or {}).items()))))
         return res
+
+    def mvcc_record_base(self, scope, base):
+        return self.inner.mvcc_record_base(scope, base)
 
     def mvcc_state(self, scope):
         return self.inner.mvcc_state(scope)
 
     def mvcc_prune_layers(self, scope, keys):
         return self.inner.mvcc_prune_layers(scope, keys)
+
+    def supports_mvcc_blobs(self):
+        return self.inner.supports_mvcc_blobs()
+
+    def put_mvcc_blob(self, scope, name, data):
+        return self.inner.put_mvcc_blob(scope, name, data)
+
+    def get_mvcc_blob(self, scope, locator):
+        return self.inner.get_mvcc_blob(scope, locator)
+
+    def delete_mvcc_blobs(self, scope, locators):
+        return self.inner.delete_mvcc_blobs(scope, locators)
 
     def set_transfer_state(self, transfer_id, state):
         self.state_writes += 1

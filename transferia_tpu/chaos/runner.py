@@ -1819,20 +1819,32 @@ def run_replication_trial(trial: int, seed: int, messages: int,
 
 # -- snapshot_and_increment mode ---------------------------------------------
 #
-# The MVCC consistent-cutover gauntlet (transferia_tpu/mvcc/): snapshot
-# parts land as base versions while seeded CDC layers stack as deltas,
-# the cutover seals one (watermark, epoch) decision, compaction folds
-# the layers — and seeded aborts fire at every mvcc.* site (a raise at
-# the site IS the kill: the site sits before the state change, so the
-# retrying "next worker attempt" must be idempotent).  The acceptance
-# bar: the final merged read is EXACTLY the fault-free reference (one
-# copy of every surviving row), zombie publishes are fenced at both
-# epochs (snapshot zombie at put_base, delta zombie post-cutover), the
-# compacted read is byte-identical to the layered read, and the fire /
-# admission / cutover logs replay byte-identically across two runs of
-# the same seed.
+# The MVCC consistent-cutover gauntlet (transferia_tpu/mvcc/), two
+# seeded scenarios per trial:
+#
+# * LAYERED — snapshot parts land as base versions while seeded CDC
+#   layers stack as deltas, the cutover seals one (watermark, epoch)
+#   decision, compaction folds the layers.
+# * PUMP — the crash-survivable path: a LIVE MvccPump fetches a seeded
+#   broker feed into delta layers while the base part lands; every
+#   injected raise is a worker SIGKILL, and the survivor REBUILDS the
+#   scope from the spill manifest (mvcc/spill.py) and resumes the pump
+#   from the admitted-layer offsets; the cutover seals the source
+#   offsets inside the fence and only the sealed values commit back.
+#
+# Seeded aborts fire at every mvcc.* site plus replication.pump (a
+# raise at the site IS the kill: each site sits before its state
+# change, so the retrying "next worker attempt" must be idempotent).
+# The acceptance bar: the final merged read is EXACTLY the fault-free
+# reference (zero lost, zero duplicate rows), zombie publishes are
+# fenced at both epochs AND at the pump, a fresh-store rebuild reads
+# byte-identically, the compacted read equals the layered read, and
+# the fire / admission / cutover logs replay byte-identically across
+# two runs of the same seed.
 
-SAI_SITES = ("mvcc.append", "mvcc.cutover", "mvcc.compact")
+SAI_SITES = ("mvcc.append", "mvcc.cutover", "mvcc.compact",
+             "mvcc.spill", "mvcc.rebuild", "replication.pump",
+             "mvcc.offset_commit")
 SAI_ROWS = 1024
 SAI_PARTS = 3
 SAI_ATTEMPTS = 10
@@ -1842,10 +1854,11 @@ def snapshot_and_increment_schedule(trial: int, seed: int) -> str:
     rng = random.Random(f"{seed}:snapshot_and_increment:{trial}")
     clauses = []
     for site in SAI_SITES:
-        # cutover/compact are hit ~once per run outside their own
-        # retries: only after:0 guarantees a fire.  append sees the
-        # whole layer feed, so it can afford a gate
-        if site == "mvcc.append":
+        # cutover/compact/rebuild/offset_commit are hit ~once per run
+        # outside their own retries: only after:0 guarantees a fire.
+        # append/spill/pump see the whole feed, so they can afford a
+        # gate
+        if site in ("mvcc.append", "mvcc.spill", "replication.pump"):
             after = rng.randrange(0, 4)
             times = rng.randrange(1, 3)
         else:
@@ -2065,6 +2078,221 @@ def _sai_scenario(trial: int, seed: int, rows: int,
     }
 
 
+_SAI_PUMP_PARSER = {"json": {
+    "schema": [
+        {"name": "id", "type": "int64", "key": True},
+        {"name": "payload", "type": "utf8"},
+        {"name": "amount", "type": "double"},
+    ],
+    "table": "sai_pump_events",
+    "namespace": "chaos",
+    "add_system_cols": False,
+}}
+SAI_PUMP_MESSAGES = 160
+SAI_PUMP_BASE = 64
+
+
+def _sai_pump_dataset(seed: int, trial: int) -> list:
+    """Deterministic broker feed for one (seed, trial): half the
+    messages update base ids, half insert new ones — all three runs
+    (reference, trial, replay) see identical bytes."""
+    rng = random.Random(f"{seed}:sai-pump:{trial}:data")
+    msgs = []
+    next_insert = SAI_PUMP_BASE
+    for _ in range(SAI_PUMP_MESSAGES):
+        if rng.random() < 0.5:
+            rid = rng.randrange(SAI_PUMP_BASE)
+        else:
+            rid = next_insert
+            next_insert += 1
+        msgs.append({"id": rid, "payload": f"p{rng.randrange(12)}",
+                     "amount": round(rng.random() * 50, 3)})
+    return msgs
+
+
+def _sai_pump_scenario(trial: int, seed: int, spec: Optional[str],
+                       label: str) -> dict:
+    """Crash-survivable S&I through the LIVE replication pump.
+
+    A base part lands (spilling through the coordinator blob store)
+    while MvccPump incarnations fetch the seeded broker feed into
+    delta layers.  Every injected raise is a worker SIGKILL: the
+    survivor drops the dead incarnation's store wholesale, REBUILDS
+    the scope from the spill manifest, and resumes a fresh pump from
+    the admitted-layer offsets — re-fetching ONLY what no admitted
+    layer covers.  The cutover seals the pump's covered offsets inside
+    the fence decision, only the SEALED offsets commit back to the
+    broker (retried through the mvcc.offset_commit kill), a
+    fresh-store rebuild must read byte-identically, and a zombie pump
+    incarnation that wakes after the seal must fence itself.
+    `spec=None` = the fault-free reference."""
+    import json as _json
+
+    from transferia_tpu.abstract.schema import TableID, new_table_schema
+    from transferia_tpu.columnar.batch import ColumnBatch
+    from transferia_tpu.mvcc.pump import MvccPump
+    from transferia_tpu.mvcc.spill import rebuild_store
+    from transferia_tpu.mvcc.store import MvccStore, unregister_store
+    from transferia_tpu.providers.mq import (
+        _BROKERS,
+        MQSourceParams,
+        _MQClient,
+        get_broker,
+    )
+
+    msgs = _sai_pump_dataset(seed, trial)
+    broker_id = f"chaos-sai-pump-{seed}-{trial}-{label}"
+    _BROKERS.pop(broker_id, None)  # re-runs in one process start clean
+    broker = get_broker(broker_id, n_partitions=2)
+    for i, m in enumerate(msgs):
+        broker.produce("sai-topic", str(m["id"]).encode(),
+                       _json.dumps(m).encode(), partition=i % 2)
+    params = MQSourceParams(broker_id=broker_id, topic="sai-topic",
+                            parser=_SAI_PUMP_PARSER, n_partitions=2)
+    scope = f"chaos-sai-pump-{label}"
+    unregister_store(scope)
+    tracker = MonotonicityTracker()
+    cp = AuditingCoordinator(MemoryCoordinator(), tracker)
+    schema = new_table_schema([("id", "int64", True),
+                               ("payload", "utf8"),
+                               ("amount", "double")])
+    tid = TableID("chaos", "sai_pump_events")
+    table = str(tid)
+    violations: list[Violation] = []
+    kills = 0
+    fence_rejected = 0
+    store = MvccStore(scope, cp)
+
+    def attempt(op, desc):
+        nonlocal kills
+        for _ in range(SAI_ATTEMPTS):
+            try:
+                return op()
+            except Exception as e:
+                kills += 1
+                logger.debug("chaos sai-pump %s: %s aborted (%s); "
+                             "retrying", label, desc, e)
+        violations.append(Violation(
+            "run-completed",
+            f"{desc} never succeeded in {SAI_ATTEMPTS} attempts"))
+        return None
+
+    def survivor_store():
+        """A killed worker's replacement: fresh process, nothing but
+        the manifest + blobs (the rebuild itself can be killed)."""
+        unregister_store(scope)
+        st = attempt(lambda: rebuild_store(scope, cp),
+                     "survivor rebuild")
+        return st if st is not None else MvccStore(scope, cp)
+
+    def run():
+        nonlocal store, fence_rejected, kills
+        ids = list(range(SAI_PUMP_BASE))
+        base = ColumnBatch.from_pydict(tid, schema, {
+            "id": ids,
+            "payload": [f"p{i % 12}" for i in ids],
+            "amount": [i * 0.25 for i in ids],
+        })
+        attempt(lambda: store.put_base(table, "p0", 1, [base]),
+                "put_base p0")
+        # pump incarnations: each injected raise kills the worker; the
+        # next incarnation rebuilds the store and resumes from the
+        # offsets the admitted layers cover
+        pump = None
+        for _ in range(SAI_ATTEMPTS):
+            try:
+                pump = MvccPump(store, _MQClient(params),
+                                parser_config=_SAI_PUMP_PARSER,
+                                worker="pump", layer_rows=24)
+                while pump.step(max_messages=16):
+                    pass
+                pump.flush()
+                break
+            except Exception as e:
+                kills += 1
+                logger.debug("chaos sai-pump %s: pump incarnation "
+                             "killed (%s); resuming", label, e)
+                store = survivor_store()
+        else:
+            violations.append(Violation(
+                "run-completed",
+                f"pump never drained in {SAI_ATTEMPTS} incarnations"))
+            return []
+        # the cutover seals watermark+epoch+source offsets atomically
+        d = attempt(lambda: store.cutover(epoch=2,
+                                          offsets=pump.offsets()),
+                    "cutover")
+        if d is not None and not d.get("granted"):
+            violations.append(Violation(
+                "cutover-granted", f"cutover not granted: {d}"))
+        sealed = store.sealed()
+        if sealed is not None:
+            tracker.record("mvcc:watermark", sealed[0])
+        # the fenced offset commit: only the SEALED values ever reach
+        # the broker, retried through the mvcc.offset_commit kill
+        committed = attempt(lambda: pump.commit_sealed_offsets(),
+                            "offset commit")
+        sealed_offs = store.sealed_offsets() or {}
+        if committed is not None:
+            group_offs = {
+                f"{t}:{p}": o
+                for (g, t, p), o in broker.committed.items()
+                if g == params.group}
+            if group_offs != sealed_offs:
+                violations.append(Violation(
+                    "offset-fence",
+                    f"broker committed {group_offs}, cutover sealed "
+                    f"{sealed_offs}"))
+        # zombie pump: two post-seal messages arrive; a dead-but-alive
+        # incarnation that pumps them must fence itself, not deliver
+        doc_layers = len(cp.mvcc_state(scope)["layers"])
+        for j, m in enumerate(_sai_pump_dataset(seed, trial + 7)[:2]):
+            broker.produce("sai-topic", str(m["id"]).encode(),
+                           _json.dumps(m).encode(), partition=j % 2)
+        try:
+            pump.step(max_messages=16)
+            pump.flush()
+        except Exception:
+            kills += 1  # an injected kill beat the fence to it
+        if pump.fenced:
+            fence_rejected += 1
+        if len(cp.mvcc_state(scope)["layers"]) != doc_layers:
+            violations.append(Violation(
+                "zombie-fenced",
+                "post-seal pump append landed unfenced layers"))
+        before = store.read_at(table)
+        # the restart-rebuild bar: a FRESH store built from nothing
+        # but the manifest + blobs must read byte-identically
+        unregister_store(scope)
+        rebuilt = survivor_store()
+        after = rebuilt.read_at(table)
+        if [b.to_pydict() for b in before] != \
+                [b.to_pydict() for b in after]:
+            violations.append(Violation(
+                "rebuild-identical",
+                "read_at differs between the pre-crash store and the "
+                "manifest rebuild"))
+        unregister_store(scope)
+        return after
+
+    if spec:
+        with failpoints.active(spec, seed=seed * 1000 + trial):
+            read = run()
+            fires = failpoints.fire_counts()
+            log = failpoints.fire_log()
+    else:
+        read = run()
+        fires, log = {}, {}
+    _BROKERS.pop(broker_id, None)
+    return {
+        "read": read, "fires": fires, "fire_log": log,
+        "violations": violations, "kills": kills,
+        "fence_rejected": fence_rejected, "tracker": tracker,
+        "logs": {"admit": list(cp.mvcc_admit_log),
+                 "cutover": list(cp.mvcc_cutover_log)},
+    }
+
+
 def run_snapshot_and_increment_trial(trial: int, seed: int, rows: int,
                                      spec: Optional[str] = None
                                      ) -> TrialResult:
@@ -2072,59 +2300,83 @@ def run_snapshot_and_increment_trial(trial: int, seed: int, rows: int,
     spec = spec if spec is not None else snapshot_and_increment_schedule(
         trial, seed)
     t0 = time.monotonic()
-    ref_run = _sai_scenario(trial, seed, rows, None, "ref")
     violations: list[Violation] = []
-    for v in ref_run["violations"]:
-        violations.append(Violation(
-            v.invariant, f"fault-free reference run: {v.detail}"))
-    reference = DeliveryReference.from_batches(ref_run["read"])
-    # the same seeded scenario runs twice; fire + admission + cutover
-    # logs must replay byte-identically (the per-seed acceptance bar)
-    first = _sai_scenario(trial, seed, rows, spec, "r1")
-    second = _sai_scenario(trial, seed, rows, spec, "r2")
-    seconds = time.monotonic() - t0
-    violations.extend(first["violations"])
-    for v in second["violations"]:
-        violations.append(Violation(
-            v.invariant, f"replay run: {v.detail}"))
-    if first["fire_log"] != second["fire_log"]:
-        violations.append(Violation(
-            "seed-replay",
-            f"fire log diverged between two runs of seed {seed}: "
-            f"{first['fire_log']} vs {second['fire_log']}"))
-    for name in ("admit", "cutover"):
-        if first["logs"][name] != second["logs"][name]:
-            violations.append(Violation(
-                "seed-replay",
-                f"mvcc {name} log diverged between two runs of seed "
-                f"{seed}: {first['logs'][name]} vs "
-                f"{second['logs'][name]}"))
-    # exactly-once: the merged read of BOTH faulted runs must equal the
-    # fault-free reference — retries, lost acks, zombies and the
-    # compaction fold may not duplicate or lose a single row
     delivered = 0
     total_dup = 0
-    for label, run in (("", first), ("replay run: ", second)):
-        v = audit_delivery(reference, run["read"], 1, run["tracker"],
-                           exactly_once=True)
-        delivered += v.delivered_rows
-        total_dup += v.duplicate_rows
-        if not v.passed:
-            for viol in v.violations:
+    kills = 0
+    restarts = 0
+    fence_rejected = 0
+    fires: dict = {}
+    fire_logs: dict = {}
+    commit_log: list = []
+    # both scenarios run their own reference + two seeded replays; the
+    # fire + admission + cutover logs of r1/r2 must be byte-identical
+    # per seed, and both faulted reads must equal the fault-free one
+    scenarios = (
+        ("layered", lambda sp, lbl: _sai_scenario(
+            trial, seed, rows, sp, lbl)),
+        ("pump", lambda sp, lbl: _sai_pump_scenario(
+            trial, seed, sp, lbl)),
+    )
+    for sname, scenario in scenarios:
+        ref_run = scenario(None, f"{sname}-ref")
+        for v in ref_run["violations"]:
+            violations.append(Violation(
+                v.invariant,
+                f"{sname}: fault-free reference run: {v.detail}"))
+        reference = DeliveryReference.from_batches(ref_run["read"])
+        first = scenario(spec, f"{sname}-r1")
+        second = scenario(spec, f"{sname}-r2")
+        for v in first["violations"]:
+            violations.append(Violation(
+                v.invariant, f"{sname}: {v.detail}"))
+        for v in second["violations"]:
+            violations.append(Violation(
+                v.invariant, f"{sname}: replay run: {v.detail}"))
+        if first["fire_log"] != second["fire_log"]:
+            violations.append(Violation(
+                "seed-replay",
+                f"{sname}: fire log diverged between two runs of "
+                f"seed {seed}: {first['fire_log']} vs "
+                f"{second['fire_log']}"))
+        for name in ("admit", "cutover"):
+            if first["logs"][name] != second["logs"][name]:
                 violations.append(Violation(
-                    viol.invariant, f"{label}{viol.detail}"))
+                    "seed-replay",
+                    f"{sname}: mvcc {name} log diverged between two "
+                    f"runs of seed {seed}: {first['logs'][name]} vs "
+                    f"{second['logs'][name]}"))
+        # exactly-once: retries, lost acks, kills, rebuilds, zombies
+        # and the compaction fold may not duplicate or lose a row
+        for label, run in (("", first), ("replay run: ", second)):
+            v = audit_delivery(reference, run["read"], 1,
+                               run["tracker"], exactly_once=True)
+            delivered += v.delivered_rows
+            total_dup += v.duplicate_rows
+            if not v.passed:
+                for viol in v.violations:
+                    violations.append(Violation(
+                        viol.invariant, f"{sname}: {label}{viol.detail}"))
+        for site, n in first["fires"].items():
+            fires[site] = fires.get(site, 0) + n
+        fire_logs.update({f"{sname}:{k}": v
+                          for k, v in first["fire_log"].items()})
+        kills += first["kills"] + second["kills"]
+        restarts += first["kills"]
+        fence_rejected += first["fence_rejected"] + \
+            second["fence_rejected"]
+        commit_log.extend(first["logs"]["cutover"])
+    seconds = time.monotonic() - t0
     verdict = AuditVerdict(passed=not violations, violations=violations,
                            delivered_rows=delivered,
                            duplicate_rows=total_dup)
     return TrialResult(
         mode="snapshot_and_increment", trial=trial, seed=seed,
-        spec=spec, verdict=verdict, fire_counts=first["fires"],
-        fire_log=first["fire_log"], seconds=seconds,
-        kills=first["kills"] + second["kills"],
-        restarts=first["kills"],
-        fence_rejected=first["fence_rejected"] +
-        second["fence_rejected"],
-        commit_log=first["logs"]["cutover"])
+        spec=spec, verdict=verdict, fire_counts=fires,
+        fire_log=fire_logs, seconds=seconds,
+        kills=kills, restarts=restarts,
+        fence_rejected=fence_rejected,
+        commit_log=commit_log)
 
 
 # -- entry point -------------------------------------------------------------

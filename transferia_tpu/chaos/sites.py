@@ -276,6 +276,27 @@ SITES: dict[str, tuple[str, str]] = {
         "mid-compaction) — the retried SCAVENGER ticket re-merges "
         "idempotently: reads stay byte-identical whether the deltas "
         "were pruned or not"),
+    "mvcc.spill": (
+        "mvcc/spill.py",
+        "layer/base spill dying between the landing's local encode "
+        "and the coordinator blob put (worker SIGKILL mid-spill) — "
+        "the landing must fail WHOLE (no manifest record naming a "
+        "missing blob) and the idempotent retry redoes both halves "
+        "under the same deterministic blob name"),
+    "mvcc.rebuild": (
+        "mvcc/spill.py",
+        "a restarted worker dying at the start of a manifest rebuild "
+        "(second kill during recovery) — the retried rebuild must "
+        "reconstruct the scope byte-identically from the doc + blobs, "
+        "layers in admission order, dict pools re-adopted"),
+    "mvcc.offset_commit": (
+        "mvcc/pump.py",
+        "the fenced source-offset commit dying between the cutover "
+        "seal and the client commit (pump killed at the worst moment) "
+        "— the sealed offsets are already in the decision, so the "
+        "retried commit re-reads and re-commits them idempotently; "
+        "a pump that lost the race commits the SEALED values, never "
+        "its local view"),
     "client.s3.request": (
         "coordinator/s3client.py",
         "S3 wire request failing (timeout, 5xx, connection reset)"),

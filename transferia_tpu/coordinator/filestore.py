@@ -554,14 +554,26 @@ class FileStoreCoordinator(Coordinator):
             return res
 
     def mvcc_cutover(self, scope: str, watermark: int,
-                     epoch: int) -> dict:
+                     epoch: int, offsets=None) -> dict:
         from transferia_tpu.abstract import mvccfence
 
         p = self._mvcc_path(scope)
         with self._locked(p):
             doc = self._mvcc_doc(p)
-            res = mvccfence.cutover_in_place(doc, watermark, epoch)
+            res = mvccfence.cutover_in_place(doc, watermark, epoch,
+                                             offsets=offsets)
             self._write_json(p, doc)
+            return res
+
+    def mvcc_record_base(self, scope: str, base: dict) -> dict:
+        from transferia_tpu.abstract import mvccfence
+
+        p = self._mvcc_path(scope)
+        with self._locked(p):
+            doc = self._mvcc_doc(p)
+            res = mvccfence.record_base_in_place(doc, base)
+            if res.get("status") != mvccfence.FENCED:
+                self._write_json(p, doc)
             return res
 
     def mvcc_state(self, scope: str) -> dict:
@@ -580,6 +592,50 @@ class FileStoreCoordinator(Coordinator):
             if pruned:
                 self._write_json(p, doc)
             return pruned
+
+    # -- MVCC spill blobs ----------------------------------------------------
+    # One file per blob under mvcc/blobs/<scope>/; the atomic
+    # tmp+rename publish (same as _write_json) makes a retried spill
+    # an idempotent replace and a SIGKILL mid-put invisible.  Each
+    # (scope, name) has exactly one writer — the worker holding the
+    # layer — so no flock is needed (the obs-segment rule).
+    def _mvcc_blob_path(self, scope: str, name: str) -> str:
+        import urllib.parse as _up
+
+        d = os.path.join(self.root, "mvcc", "blobs",
+                         _up.quote(scope, safe=""))
+        os.makedirs(d, exist_ok=True)
+        return os.path.join(d, f"{_up.quote(name, safe='')}.bin")
+
+    def put_mvcc_blob(self, scope: str, name: str,
+                      data: bytes) -> str:
+        p = self._mvcc_blob_path(scope, name)
+        tmp = f"{p}.tmp.{os.getpid()}"
+        with open(tmp, "wb") as fh:
+            fh.write(data)
+        os.replace(tmp, p)
+        return f"file://{p}"
+
+    def get_mvcc_blob(self, scope: str, locator: str):
+        if not locator.startswith("file://"):
+            return None
+        try:
+            with open(locator[len("file://"):], "rb") as fh:
+                return fh.read()
+        except FileNotFoundError:
+            return None
+
+    def delete_mvcc_blobs(self, scope: str, locators: list) -> int:
+        deleted = 0
+        for loc in locators:
+            if not str(loc).startswith("file://"):
+                continue
+            try:
+                os.remove(str(loc)[len("file://"):])
+                deleted += 1
+            except FileNotFoundError:
+                pass
+        return deleted
 
     def _write_health(self, path: str, worker_index: int,
                       payload) -> None:

@@ -406,16 +406,31 @@ class Coordinator(abc.ABC):
         raise NotImplementedError
 
     def mvcc_cutover(self, scope: str, watermark: int,
-                     epoch: int) -> dict:
+                     epoch: int,
+                     offsets: Optional[dict] = None) -> dict:
         """The single fenced cutover decision.  First caller seals
-        (watermark, epoch) atomically; an identical retry is granted
-        idempotently ({"granted": True, "first": False}); any other
-        (watermark, epoch) is fenced and handed the sealed values."""
+        (watermark, epoch) atomically — together with `offsets`, the
+        per-source-partition replication offsets the admitted layers
+        covered, so the source-offset commit is INSIDE the fence; an
+        identical retry is granted idempotently ({"granted": True,
+        "first": False}); any other (watermark, epoch) is fenced and
+        handed the sealed values.  Every response carries the SEALED
+        offsets — a zombie pump adopts them instead of its own view."""
+        raise NotImplementedError
+
+    def mvcc_record_base(self, scope: str, base: dict) -> dict:
+        """Record one spilled base version in the scope's manifest
+        (abstract/mvccfence.record_base_in_place): {"table", "part",
+        "epoch", "rows", "content_key", "locator"}.  Same (table,
+        part) at an equal/newer epoch replaces (idempotent part
+        retry); an OLDER epoch is a zombie and returns status
+        "fenced" — the caller must discard its landing."""
         raise NotImplementedError
 
     def mvcc_state(self, scope: str) -> dict:
-        """Read-only control snapshot: {"layers": [...], "cutover":
-        {...}|None, "watermark": int} (abstract/mvccfence.state_view)."""
+        """Read-only control snapshot: {"layers": [...], "bases":
+        {...}, "cutover": {...}|None, "watermark": int}
+        (abstract/mvccfence.state_view)."""
         raise NotImplementedError
 
     def mvcc_prune_layers(self, scope: str, keys: list) -> int:
@@ -423,6 +438,41 @@ class Coordinator(abc.ABC):
         their rows were folded into a new base version.  Idempotent —
         a compaction ticket retried after kill -9 re-prunes already
         missing keys for free.  Returns records pruned."""
+        return 0
+
+    # -- MVCC layer blobs (mvcc/spill.py) ------------------------------------
+    #
+    # Encoded base versions and delta layers spill as opaque Arrow-IPC
+    # byte blobs to coordinator-addressable storage — the memory
+    # backend keeps heap bytes, filestore writes files under its mvcc/
+    # dir, s3 puts objects — so a restarted worker (or ANY fleet
+    # worker picking up an mvcc_compact ticket) rebuilds a scope
+    # byte-identically from the control doc's manifest.  `put` returns
+    # an opaque LOCATOR the same backend's `get` resolves; deterministic
+    # (scope, name) addressing makes a retried put an idempotent
+    # replace.  Backends without support keep the defaults — the store
+    # then runs in-process-only, exactly the pre-spill behavior.
+
+    def supports_mvcc_blobs(self) -> bool:
+        return type(self).put_mvcc_blob is not \
+            Coordinator.put_mvcc_blob
+
+    def put_mvcc_blob(self, scope: str, name: str,
+                      data: bytes) -> str:
+        """Durably store one blob under (scope, name); returns the
+        locator to record in the manifest.  Re-putting the same
+        (scope, name) REPLACES (idempotent spill retry)."""
+        raise NotImplementedError
+
+    def get_mvcc_blob(self, scope: str,
+                      locator: str) -> Optional[bytes]:
+        """Fetch a spilled blob by its manifest locator (None when the
+        blob is gone — e.g. already GC'd after compaction)."""
+        return None
+
+    def delete_mvcc_blobs(self, scope: str, locators: list) -> int:
+        """Blob GC after compaction folded the layers they carried.
+        Idempotent; returns blobs actually deleted."""
         return 0
 
     # -- worker health (operation.go:30-36, replication.go:72-74) -----------

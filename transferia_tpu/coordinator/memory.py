@@ -107,6 +107,11 @@ class MemoryCoordinator(Coordinator):
         # admission records and the sealed cutover decision
         self._mvcc_lock = lockwatch.named_lock("coordinator.mvcc")
         self._mvcc: dict[str, dict] = {}
+        # MVCC spill blobs: the memory backend "spills" to heap bytes
+        # keyed by locator — same addressability contract as the
+        # filestore/s3 backends, process-lifetime durability (what an
+        # in-process coordinator can offer)
+        self._mvcc_blobs: dict[str, dict[str, bytes]] = {}
 
     def _op(self, operation_id: str) -> _OpState:
         """Get-or-create the operation's state slot (the only place
@@ -501,13 +506,25 @@ class MemoryCoordinator(Coordinator):
             return mvccfence.admit_layer_in_place(doc, lay)
 
     def mvcc_cutover(self, scope: str, watermark: int,
-                     epoch: int) -> dict:
+                     epoch: int, offsets=None) -> dict:
         from transferia_tpu.abstract import mvccfence
 
         with self._mvcc_lock:
             doc = self._mvcc.setdefault(scope,
                                         mvccfence.new_mvcc_doc())
-            return mvccfence.cutover_in_place(doc, watermark, epoch)
+            return mvccfence.cutover_in_place(doc, watermark, epoch,
+                                              offsets=offsets)
+
+    def mvcc_record_base(self, scope: str, base: dict) -> dict:
+        import json as _json
+
+        from transferia_tpu.abstract import mvccfence
+
+        rec = _json.loads(_json.dumps(base))
+        with self._mvcc_lock:
+            doc = self._mvcc.setdefault(scope,
+                                        mvccfence.new_mvcc_doc())
+            return mvccfence.record_base_in_place(doc, rec)
 
     def mvcc_state(self, scope: str) -> dict:
         from transferia_tpu.abstract import mvccfence
@@ -523,6 +540,28 @@ class MemoryCoordinator(Coordinator):
             if doc is None:
                 return 0
             return mvccfence.prune_layers_in_place(doc, keys)
+
+    # -- MVCC spill blobs ----------------------------------------------------
+    def put_mvcc_blob(self, scope: str, name: str,
+                      data: bytes) -> str:
+        locator = f"heap://{scope}/{name}"
+        with self._mvcc_lock:
+            self._mvcc_blobs.setdefault(scope, {})[locator] = \
+                bytes(data)
+        return locator
+
+    def get_mvcc_blob(self, scope: str, locator: str):
+        with self._mvcc_lock:
+            return self._mvcc_blobs.get(scope, {}).get(locator)
+
+    def delete_mvcc_blobs(self, scope: str, locators: list) -> int:
+        deleted = 0
+        with self._mvcc_lock:
+            blobs = self._mvcc_blobs.get(scope, {})
+            for loc in locators:
+                if blobs.pop(loc, None) is not None:
+                    deleted += 1
+        return deleted
 
     def operation_health(self, operation_id: str, worker_index: int,
                          payload: Optional[dict] = None) -> None:

@@ -837,7 +837,7 @@ class S3Coordinator(Coordinator):
         return res
 
     def mvcc_cutover(self, scope: str, watermark: int,
-                     epoch: int) -> dict:
+                     epoch: int, offsets=None) -> dict:
         from transferia_tpu.abstract import mvccfence
 
         res: dict = {}
@@ -845,7 +845,22 @@ class S3Coordinator(Coordinator):
         def upd(cur: dict) -> dict:
             nonlocal res
             doc = self._mvcc_doc(cur)
-            res = mvccfence.cutover_in_place(doc, watermark, epoch)
+            res = mvccfence.cutover_in_place(doc, watermark, epoch,
+                                             offsets=offsets)
+            return doc
+
+        self._merge_json(self._mvcc_key(scope), upd)
+        return res
+
+    def mvcc_record_base(self, scope: str, base: dict) -> dict:
+        from transferia_tpu.abstract import mvccfence
+
+        res: dict = {}
+
+        def upd(cur: dict) -> dict:
+            nonlocal res
+            doc = self._mvcc_doc(cur)
+            res = mvccfence.record_base_in_place(doc, base)
             return doc
 
         self._merge_json(self._mvcc_key(scope), upd)
@@ -870,6 +885,39 @@ class S3Coordinator(Coordinator):
 
         self._merge_json(self._mvcc_key(scope), upd)
         return pruned
+
+    # -- MVCC spill blobs ----------------------------------------------------
+    # Plain objects under <prefix>mvccblob/<scope>/<name> — no CAS:
+    # each (scope, name) has exactly one writer and a retried put is
+    # a byte-identical replace (S3 PUT is atomic per object).
+    def _mvcc_blob_key(self, scope: str, name: str) -> str:
+        import urllib.parse as _up
+
+        return self._key("mvccblob", _up.quote(scope, safe=""),
+                         _up.quote(name, safe=""))
+
+    def put_mvcc_blob(self, scope: str, name: str,
+                      data: bytes) -> str:
+        key = self._mvcc_blob_key(scope, name)
+        self.client.put(key, bytes(data))
+        return f"s3://{key}"
+
+    def get_mvcc_blob(self, scope: str, locator: str):
+        if not locator.startswith("s3://"):
+            return None
+        got = self.client.get(locator[len("s3://"):])
+        return got[0] if got is not None else None
+
+    def delete_mvcc_blobs(self, scope: str, locators: list) -> int:
+        deleted = 0
+        for loc in locators:
+            if not str(loc).startswith("s3://"):
+                continue
+            key = str(loc)[len("s3://"):]
+            if self.client.get(key) is not None:
+                self.client.delete(key)
+                deleted += 1
+        return deleted
 
     # -- health -------------------------------------------------------------
     def operation_health(self, operation_id: str, worker_index: int,

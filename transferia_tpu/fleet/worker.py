@@ -141,9 +141,12 @@ def _run_transfer_yaml(ticket: FleetTicket,
 def _run_mvcc_compact(ticket: FleetTicket,
                       ctx: TicketRunContext) -> None:
     """Payload: `{"scope", "table", "watermark"}` (mvcc/compact.py).
-    SCAVENGER maintenance over an in-process MVCC staging store — the
-    scope resolves through the process-local registry; a miss raises
-    so the lease hands the ticket to a worker holding the layers."""
+    SCAVENGER maintenance over an MVCC staging store — the scope
+    resolves through the process-local registry, and a miss REBUILDS
+    it from the spill manifest through this worker's coordinator
+    (mvcc/spill.py): any fleet worker can run the ticket.  Only when
+    nothing was ever spilled does the miss raise, so the lease hands
+    the ticket to the worker holding the layers."""
     from transferia_tpu.mvcc.compact import make_compact_runner
     from transferia_tpu.mvcc.store import resolve_store
 

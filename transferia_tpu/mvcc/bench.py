@@ -1,14 +1,18 @@
-"""`bench.py --mvcc`: merge-on-read vs compacted-read throughput and
-cutover decision latency over a dict-heavy staging store.
+"""`bench.py --mvcc`: merge-on-read vs compacted-read throughput,
+cutover decision latency, and the durable-spill round trip over a
+dict-heavy staging store.
 
 The lane measures the two read shapes the store serves — the layered
 point-in-time merge (lexsort + per-source take) right after the
 snapshot, and the same read after the SCAVENGER compaction folded the
 layers into one base — plus the cost of the cutover seal itself (one
 coordinator round trip; in the bench that is MemoryCoordinator, so the
-number is the decision-code floor, not a network figure).  The run
-self-checks: the layered and compacted reads must be row-identical and
-the whole pass must finish with ZERO dict flat materializations."""
+number is the decision-code floor, not a network figure), the spill
+encode+put throughput (`mvcc_spill_mbs`), and the full restart rebuild
+from the manifest (`mvcc_rebuild_ms` — the crash-recovery window a
+survivor pays before it can serve reads).  The run self-checks: the
+layered, rebuilt, and compacted reads must be row-identical and the
+whole pass must finish with ZERO dict flat materializations."""
 
 from __future__ import annotations
 
@@ -32,7 +36,8 @@ from transferia_tpu.columnar.batch import (
 )
 from transferia_tpu.coordinator.memory import MemoryCoordinator
 from transferia_tpu.mvcc.compact import compact_table
-from transferia_tpu.mvcc.store import MvccStore
+from transferia_tpu.mvcc.spill import rebuild_store
+from transferia_tpu.mvcc.store import MvccStore, unregister_store
 from transferia_tpu.stats.trace import TELEMETRY
 
 TID = TableID("bench", "mvcc_events")
@@ -68,11 +73,15 @@ def _batch(schema, pool, ids: np.ndarray, **kw) -> ColumnBatch:
 
 
 def build_store(rows: int, layers: int,
-                batch_rows: int = 65_536) -> MvccStore:
+                batch_rows: int = 65_536,
+                coordinator=None,
+                scope: str = "mvcc/bench") -> MvccStore:
     """Dict-heavy base (shared pool across every part) + `layers`
-    UPDATE/DELETE delta layers touching ~1/8 of the keyspace each."""
+    UPDATE/DELETE delta layers touching ~1/8 of the keyspace each.
+    With a coordinator, every landing also spills through the blob
+    store (the durable path the rebuild measurement replays)."""
     schema, pool = _schema(), _pool()
-    st = MvccStore("mvcc/bench")
+    st = MvccStore(scope, coordinator)
     for part, lo in enumerate(range(0, rows, batch_rows)):
         ids = np.arange(lo, min(lo + batch_rows, rows))
         st.put_base(TABLE, f"part-{part}", 1,
@@ -131,14 +140,50 @@ def measure_cutover_ms(samples: int = 64) -> float:
     return total * 1000.0 / samples
 
 
+def measure_spill_mbs(st: MvccStore, coordinator) -> tuple[float, int]:
+    """Pure spill throughput: encode + put every resident base part
+    and delta layer to a throwaway scope.  The manifest bookkeeping is
+    not in the loop — this is the byte-moving half every landing pays
+    with spill on."""
+    from transferia_tpu.mvcc.spill import encode_batches
+
+    batch_sets = [bv.batches
+                  for parts in st._bases.values()
+                  for bv in parts.values()]
+    batch_sets += [la.batches for la in st._layers.values()]
+    nbytes = 0
+    t0 = time.perf_counter()
+    for i, bs in enumerate(batch_sets):
+        data = encode_batches(bs)
+        coordinator.put_mvcc_blob("mvcc/bench-spillrate",
+                                  f"blob-{i}", data)
+        nbytes += len(data)
+    dt = time.perf_counter() - t0
+    return nbytes / max(dt, 1e-9) / 1e6, nbytes
+
+
 def run_mvcc_bench(rows: int = 200_000, layers: int = 12,
                    iters: int = 3) -> dict:
     TELEMETRY.reset()
+    cp = MemoryCoordinator()
+    scope = "mvcc/bench"
+    unregister_store(scope)
     t0 = time.perf_counter()
-    st = build_store(rows, layers)
+    st = build_store(rows, layers, coordinator=cp, scope=scope)
     build_s = time.perf_counter() - t0
     layered_view = _rows_view(st)
     layered_s, visible = _timed_reads(st, iters)
+
+    spill_mbs, spill_bytes = measure_spill_mbs(st, cp)
+
+    # the restart: drop the in-process store wholesale and rebuild the
+    # worst-case manifest (every layer still unfolded) from blobs —
+    # the window a survivor pays before it can serve reads
+    unregister_store(scope)
+    t0 = time.perf_counter()
+    st = rebuild_store(scope, cp)
+    rebuild_s = time.perf_counter() - t0
+    rebuild_equivalent = _rows_view(st) == layered_view
 
     t0 = time.perf_counter()
     res = compact_table(st, TABLE)
@@ -149,11 +194,12 @@ def run_mvcc_bench(rows: int = 200_000, layers: int = 12,
 
     cutover_ms = measure_cutover_ms()
     flat = TELEMETRY.snapshot()["dict_flat_materializations"]
+    unregister_store(scope)
     return {
         "metric": "mvcc_merge_layered_rows_per_sec",
         "unit": "rows/sec",
         "value": round(visible * iters / max(layered_s, 1e-9), 1),
-        "ok": bool(equivalent and flat == 0),
+        "ok": bool(equivalent and rebuild_equivalent and flat == 0),
         "rows": rows,
         "layers": layers,
         "iters": iters,
@@ -161,6 +207,10 @@ def run_mvcc_bench(rows: int = 200_000, layers: int = 12,
         "compacted_rows_per_sec": round(
             visible2 * iters / max(compacted_s, 1e-9), 1),
         "cutover_ms": round(cutover_ms, 4),
+        "spill_mbs": round(spill_mbs, 1),
+        "spill_bytes": int(spill_bytes),
+        "rebuild_ms": round(rebuild_s * 1000.0, 2),
+        "rebuild_equivalent": rebuild_equivalent,
         "build_seconds": round(build_s, 3),
         "compact_seconds": round(compact_s, 3),
         "layers_folded": len(res["folded"]),
@@ -180,6 +230,10 @@ def format_report(report: dict) -> str:
         f"{report['compact_seconds']}s)",
         f"  cutover seal: {report['cutover_ms']}ms mean "
         f"(memory coordinator floor)",
+        f"  spill: {report['spill_mbs']} MB/s encode+put "
+        f"({report['spill_bytes']} bytes)",
+        f"  restart rebuild: {report['rebuild_ms']}ms "
+        f"(equivalent: {report['rebuild_equivalent']})",
         f"  flat materializations: "
         f"{report['dict_flat_materializations']}",
         "mvcc bench verdict: "

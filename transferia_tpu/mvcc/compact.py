@@ -53,11 +53,29 @@ def compact_table(store: MvccStore, table: str,
         watermark = sealed[0] if sealed is not None else store.watermark()
     sp = trace.span("mvcc_compact", table=table, watermark=watermark)
     with sp:
+        # folded layers' blob locators, captured BEFORE the prune
+        # drops their manifest records (the only place they're named)
+        locators = {}
+        if store.cp is not None:
+            locators = {
+                (str(d.get("worker", "")), int(d.get("seq", -1))):
+                    str(d["locator"])
+                for d in (store.control_state().get("layers") or [])
+                if d.get("locator")}
         merged = store.read_at(table, watermark=int(watermark))
         folded = store.install_compacted(table, int(watermark), merged)
         pruned = 0
         if store.cp is not None and folded:
             pruned = store.cp.mvcc_prune_layers(store.scope, folded)
+            # the fold is durable inside the compacted base's blob —
+            # GC the folded layers' now-unreferenced blobs (best-effort:
+            # a crash here leaves orphans no manifest record names)
+            gone = [locators[k] for k in folded if k in locators]
+            if gone:
+                try:
+                    store.cp.delete_mvcc_blobs(store.scope, gone)
+                except Exception:  # trtpu: ignore[EXC001] — GC is best-effort; orphan blobs are harmless, the fold already landed
+                    pass
         rows = sum(b.n_rows for b in merged)
         if sp:
             sp.add(rows=rows, folded=len(folded), pruned=pruned)
@@ -97,12 +115,25 @@ def make_compact_runner(
         resolve_store: Callable[[str], Optional[MvccStore]]):
     """Build the `RUNNERS[PAYLOAD_KIND]` entry for fleet workers.
     Columnar layer data lives in process, so the worker supplies
-    `resolve_store(scope)` — a missing store (worker restarted, layers
-    not rebuilt yet) releases the ticket by raising; the lease hands
-    it to a worker that holds the scope."""
+    `resolve_store(scope)` for the registry hit; a miss with the
+    ticket context's coordinator in hand REBUILDS the scope from its
+    spill manifest (mvcc/spill.py) — ANY fleet worker can run the
+    ticket, not just the one that landed the layers.  A miss with no
+    coordinator (or nothing ever spilled) releases the ticket by
+    raising; the lease hands it on."""
     def _run(ticket: FleetTicket, ctx) -> None:
         p = ticket.payload
         store = resolve_store(p["scope"])
+        if store is None:
+            cp = getattr(ctx, "coordinator", None)
+            if cp is not None:
+                from transferia_tpu.mvcc.store import (
+                    resolve_store as registry_resolve,
+                )
+
+                store = registry_resolve(
+                    p["scope"], coordinator=cp,
+                    metrics=getattr(ctx, "metrics", None))
         if store is None:
             raise RuntimeError(
                 f"ticket {ticket.ticket_id}: no MVCC store for scope "

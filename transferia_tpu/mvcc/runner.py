@@ -22,6 +22,7 @@ The consistent-cutover flow (ARCHITECTURE.md "MVCC staging store"):
 from __future__ import annotations
 
 import logging
+import warnings
 from typing import Callable, Optional
 
 from transferia_tpu.abstract.table import (
@@ -40,6 +41,7 @@ logger = logging.getLogger(__name__)
 # these coexist with provider checkpoints like pg_wal_lsn)
 STATE_WATERMARK = "mvcc_watermark"
 STATE_EPOCH = "mvcc_epoch"
+STATE_OFFSETS = "mvcc_offsets"
 
 
 def store_scope(transfer_id: str) -> str:
@@ -141,8 +143,14 @@ def resume_state(coordinator, transfer_id: str) -> Optional[dict]:
     state = coordinator.get_transfer_state(transfer_id)
     if STATE_WATERMARK not in state:
         return None
-    return {"watermark": int(state[STATE_WATERMARK]),
-            "epoch": int(state.get(STATE_EPOCH, 1))}
+    out = {"watermark": int(state[STATE_WATERMARK]),
+           "epoch": int(state.get(STATE_EPOCH, 1))}
+    offsets = state.get(STATE_OFFSETS)
+    if offsets:
+        # the source offsets sealed inside the cutover fence — present
+        # only when a pump fed the activation (queue-shaped sources)
+        out["offsets"] = {str(k): int(v) for k, v in offsets.items()}
+    return out
 
 
 def activate_snapshot_and_increment(
@@ -151,20 +159,53 @@ def activate_snapshot_and_increment(
         tables=None,
         deltas: Optional[Callable[[MvccStore], None]] = None,
         store: Optional[MvccStore] = None,
-        epoch: int = 1) -> MvccStore:
-    """The activation-time S&I pipeline over the MVCC store.  `deltas`
-    is the hook where concurrently-arriving replication batches enter
-    (the replication lane calls `store.append_delta` directly; tests
-    and the chaos mode inject through the same hook)."""
+        epoch: int = 1,
+        pump=None) -> MvccStore:
+    """The activation-time S&I pipeline over the MVCC store.
+
+    `pump` is the PRODUCTION entry for concurrently-arriving
+    replication: an `mvcc.pump.MvccPump` (or `pump=True` to build one
+    from the transfer's source via `MvccPump.from_transfer`) runs
+    alongside the snapshot read, appending LSN-ordered delta layers;
+    the cutover then seals the pump's covered source offsets inside
+    the same fence decision as the watermark/epoch, and ONLY the
+    sealed offsets commit back to the source
+    (`pump.commit_sealed_offsets`).
+
+    `deltas` — a callable handed the store — is the DEPRECATED
+    predecessor of the pump (kept for tests and simple injection); it
+    runs after the snapshot, before the cutover.
+    """
     metrics = metrics or Metrics()
     st = store or MvccStore(store_scope(transfer.id), coordinator,
                             metrics)
+    if deltas is not None:
+        warnings.warn(
+            "activate_snapshot_and_increment(deltas=...) is "
+            "deprecated; pass an MvccPump via pump= (or pump=True) — "
+            "the live replication pump with fenced offset commit",
+            DeprecationWarning, stacklevel=2)
+    if pump is True:
+        from transferia_tpu.mvcc.pump import MvccPump
+
+        pump = MvccPump.from_transfer(transfer, st, metrics)
     sp = trace.span("mvcc_activate", transfer=transfer.id)
     with sp:
-        snapshot_into_store(transfer, st, metrics, tables)
-        if deltas is not None:
-            deltas(st)
-        decision = st.cutover(epoch)
+        if pump is not None:
+            pump.start()
+        try:
+            snapshot_into_store(transfer, st, metrics, tables)
+            if deltas is not None:
+                deltas(st)
+            offsets = None
+            if pump is not None:
+                pump.drain()
+                offsets = pump.offsets()
+            decision = st.cutover(epoch, offsets=offsets)
+        except BaseException:
+            if pump is not None:
+                pump.stop()
+            raise
         if not decision.get("granted"):
             # another activation already sealed — adopt its decision
             # (idempotent activation retry after a crash)
@@ -173,9 +214,16 @@ def activate_snapshot_and_increment(
                         decision.get("watermark"),
                         decision.get("epoch"))
         w, e = st.sealed()
+        if pump is not None:
+            # the offset fence: the source learns its offsets ONLY
+            # from the sealed decision, never from a pump's local view
+            pump.commit_sealed_offsets()
         publish_merged(st, transfer, metrics, watermark=w)
-        coordinator.set_transfer_state(
-            transfer.id, {STATE_WATERMARK: w, STATE_EPOCH: e})
+        state = {STATE_WATERMARK: w, STATE_EPOCH: e}
+        sealed_offs = st.sealed_offsets()
+        if sealed_offs:
+            state[STATE_OFFSETS] = sealed_offs
+        coordinator.set_transfer_state(transfer.id, state)
         if sp:
             sp.add(watermark=w, epoch=e)
     return st

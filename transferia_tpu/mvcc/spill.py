@@ -1,0 +1,225 @@
+"""Durable layer spill: encoded MVCC layers survive the process.
+
+Base versions and delta layers keep their columnar data in process for
+the hot merge path, but a worker SIGKILL mid-activation must not lose
+the scope — so every landed layer also SPILLS through the PR 18
+region/arrow-IPC machinery (Zerrow-style: the batches serialize ONCE
+into sealed heap regions as length-prefixed Arrow IPC stream segments
+— dict pools, FOR-able ints and the CDC kind/lsn sidecars ride the
+same wire the Flight/shm legs use) and the bytes land in
+coordinator-addressable
+blob storage (`Coordinator.put_mvcc_blob`: heap bytes on the memory
+backend, files under the filestore root, s3 objects).  The control doc
+(abstract/mvccfence.py) is the MANIFEST: each admitted layer record
+carries the blob locator, so
+
+* a restarted worker rebuilds the whole scope byte-identically from
+  nothing but the doc + blobs (`rebuild_store`), with
+  `dict_flat_materializations == 0` surviving the round trip, and
+* `mvcc_compact` SCAVENGER tickets run on ANY fleet worker — a scope
+  miss in the process-local registry rebuilds instead of raising.
+
+Spill failures FAIL the landing (put_base/append_delta) before the
+manifest records anything, so the idempotent retry redoes both; a
+blob put that landed without its manifest record is an orphan a later
+retry overwrites by deterministic (scope, name) addressing.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import struct
+from typing import Optional
+from urllib.parse import quote
+
+from transferia_tpu.chaos.failpoints import failpoint
+from transferia_tpu.interchange._pyarrow import have_pyarrow
+from transferia_tpu.runtime import knobs
+from transferia_tpu.stats import trace
+
+# kill switch: spill on by default wherever the coordinator offers
+# blob storage and pyarrow is importable; off = PR 19's in-process-only
+# behavior (a worker restart loses the scope)
+ENV_SPILL = "TRANSFERIA_TPU_MVCC_SPILL"
+# rebuild-time content_key verification of every decoded layer against
+# its manifest record (cheap rowhash pass; disable only for benches)
+ENV_SPILL_VERIFY = "TRANSFERIA_TPU_MVCC_SPILL_VERIFY"
+
+
+def spill_enabled(environ=os.environ) -> bool:
+    return knobs.env_bool(ENV_SPILL, True, environ=environ)
+
+
+def spill_verify(environ=os.environ) -> bool:
+    return knobs.env_bool(ENV_SPILL_VERIFY, True, environ=environ)
+
+
+class SpillError(RuntimeError):
+    """A spilled blob is missing or fails content verification — the
+    manifest and blob storage disagree (lost write, torn GC)."""
+
+
+def base_blob_name(table: str, part: str, epoch: int) -> str:
+    """Deterministic blob address for a base version: a part retry at
+    the same epoch re-puts the same name (idempotent replace)."""
+    return (f"base-{quote(table, safe='')}-{quote(part, safe='')}"
+            f"-e{int(epoch)}")
+
+
+def layer_blob_name(worker: str, seq: int) -> str:
+    return f"layer-{quote(worker, safe='')}-{int(seq)}"
+
+
+def _encode_segment(rbs) -> bytes:
+    """One run of schema-identical RecordBatches -> one sealed heap
+    region holding one Arrow IPC stream (the single producer→durable
+    copy of the spill, tallied as `region_copied_bytes`)."""
+    from transferia_tpu.interchange.regions import frame_batches
+
+    region = frame_batches(rbs, kind="heap")
+    try:
+        return region.read_copy()
+    finally:
+        region.close()
+
+
+def encode_batches(batches) -> bytes:
+    """Serialize batches as length-prefixed Arrow IPC stream SEGMENTS
+    through sealed heap regions.  One IPC stream needs one schema, but
+    a spilled landing may mix shapes — a compacted base merges CDC
+    batches (kind/lsn sidecar columns) with snapshot batches (none),
+    and per-source batches carry distinct dict-pool refs — so
+    consecutive schema-identical batches group into one stream and
+    each schema break starts a new `>Q`-length-prefixed segment.
+    Empty layers encode as b"" (streams need a schema batch)."""
+    from transferia_tpu.interchange.convert import batch_to_arrow
+
+    rbs = [batch_to_arrow(b) for b in batches if b.n_rows > 0]
+    if not rbs:
+        return b""
+    segments: list[bytes] = []
+    run = [rbs[0]]
+    for rb in rbs[1:]:
+        if rb.schema.equals(run[-1].schema, check_metadata=True):
+            run.append(rb)
+        else:
+            segments.append(_encode_segment(run))
+            run = [rb]
+    segments.append(_encode_segment(run))
+    return b"".join(struct.pack(">Q", len(s)) + s
+                    for s in segments)
+
+
+def decode_batches(data: bytes, table_id=None, schema=None) -> list:
+    """Adopt a spilled stream back into ColumnBatches — byte-identical
+    to the producer's, dict pools shared-adopted, kind/lsn sidecars
+    restored (interchange/convert.arrow_to_batch)."""
+    from transferia_tpu.interchange.ipc import iter_stream
+
+    if not data:
+        return []
+    out: list = []
+    mv = memoryview(data)
+    pos = 0
+    while pos < len(mv):
+        (n,) = struct.unpack_from(">Q", mv, pos)
+        pos += 8
+        seg = bytes(mv[pos:pos + n])
+        pos += n
+        out.extend(iter_stream(io.BytesIO(seg), table_id=table_id,
+                               schema=schema))
+    return out
+
+
+def spill_blob(coordinator, scope: str, name: str,
+               batches) -> tuple[str, int]:
+    """Encode and put one blob; returns (locator, bytes).  The
+    `mvcc.spill` failpoint sits BEFORE the put — an injected kill here
+    is a worker dying with the layer un-spilled, and the retried
+    landing must redo both halves."""
+    failpoint("mvcc.spill")
+    sp = trace.span("mvcc_spill", scope=scope, blob=name)
+    with sp:
+        data = encode_batches(batches)
+        locator = coordinator.put_mvcc_blob(scope, name, data)
+        if sp:
+            sp.add(bytes=len(data))
+        return locator, len(data)
+
+
+def _fetch(coordinator, scope: str, rec: dict, kind: str) -> bytes:
+    locator = rec.get("locator") or ""
+    data = coordinator.get_mvcc_blob(scope, locator) \
+        if locator else None
+    if data is None:
+        raise SpillError(
+            f"mvcc rebuild {scope}: {kind} blob {locator!r} is gone "
+            f"(manifest record {rec.get('content_key', '')!r})")
+    return data
+
+
+def rebuild_store(scope: str, coordinator, metrics=None,
+                  environ=os.environ):
+    """Rebuild a scope from its manifest + blobs on a fresh store.
+
+    Bases re-land part by part at their recorded epochs and layers
+    re-install in ADMISSION ORDER with their original (worker, seq)
+    and LSN bounds — merge order is exactly the pre-crash store's, so
+    `read_at` is byte-identical.  Layers are installed WITHOUT
+    re-admission (the doc already holds their records; re-admitting
+    would fence post-cutover).  Returns the registered store, or None
+    when the scope has no manifest (nothing was ever spilled).
+    """
+    from transferia_tpu.mvcc.store import (
+        MvccStore,
+        content_key,
+        register_store,
+    )
+
+    if coordinator is None or not coordinator.supports_mvcc() \
+            or not coordinator.supports_mvcc_blobs() \
+            or not have_pyarrow():
+        return None
+    state = coordinator.mvcc_state(scope)
+    bases = state.get("bases") or {}
+    layers = [rec for rec in (state.get("layers") or [])
+              if rec.get("locator")]
+    if not bases and not layers:
+        return None
+    failpoint("mvcc.rebuild")
+    sp = trace.span("mvcc_rebuild", scope=scope, bases=len(bases),
+                    layers=len(layers))
+    verify = spill_verify(environ)
+    with sp:
+        st = MvccStore(scope, coordinator, metrics)
+        rows = 0
+        for key in sorted(bases):
+            rec = bases[key]
+            batches = decode_batches(_fetch(coordinator, scope, rec,
+                                            "base"))
+            if verify and str(rec.get("content_key", "")) != \
+                    content_key(batches):
+                raise SpillError(
+                    f"mvcc rebuild {scope}: base {key} decoded to a "
+                    f"different content key than its manifest record")
+            st.put_base(str(rec["table"]), str(rec["part"]),
+                        int(rec.get("epoch", 1)), batches,
+                        locator=str(rec.get("locator", "")))
+            rows += sum(b.n_rows for b in batches)
+        for rec in layers:
+            batches = decode_batches(_fetch(coordinator, scope, rec,
+                                            "layer"))
+            if verify and str(rec.get("content_key", "")) != \
+                    content_key(batches):
+                raise SpillError(
+                    f"mvcc rebuild {scope}: layer "
+                    f"({rec.get('worker')}, {rec.get('seq')}) decoded "
+                    f"to a different content key than its record")
+            st.adopt_layer(rec, batches)
+            rows += sum(b.n_rows for b in batches)
+        st.stats.rebuilds.inc()
+        st.stats.rebuilt_layers.inc(len(layers))
+        if sp:
+            sp.add(rows=rows)
+        return register_store(st)

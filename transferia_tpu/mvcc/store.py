@@ -79,9 +79,11 @@ class OversizeLayerError(ValueError):
 
 # Process-local scope -> store registry: columnar layer data lives in
 # process, so a fleet worker picking up an `mvcc_compact` ticket
-# resolves the scope here (fleet/worker.py RUNNERS).  A miss means this
-# worker never built the scope's layers — the runner raises and the
-# ticket's lease hands it to a worker that holds them.
+# resolves the scope here (fleet/worker.py RUNNERS).  A registry miss
+# with a coordinator in hand REBUILDS the scope from its spill
+# manifest (mvcc/spill.py) — any fleet worker can run the ticket; a
+# miss without one means this worker never built the scope's layers —
+# the runner raises and the ticket's lease hands it on.
 _STORES: dict[str, "MvccStore"] = {}
 _STORES_LOCK = threading.Lock()
 
@@ -93,9 +95,15 @@ def register_store(store: "MvccStore") -> "MvccStore":
     return store
 
 
-def resolve_store(scope: str) -> Optional["MvccStore"]:
+def resolve_store(scope: str, coordinator=None,
+                  metrics=None) -> Optional["MvccStore"]:
     with _STORES_LOCK:
-        return _STORES.get(scope)
+        st = _STORES.get(scope)
+    if st is not None or coordinator is None:
+        return st
+    from transferia_tpu.mvcc.spill import rebuild_store
+
+    return rebuild_store(scope, coordinator, metrics)
 
 
 def unregister_store(scope: str) -> None:
@@ -153,7 +161,10 @@ class BaseVersion:
 
 @dataclass
 class DeltaLayer:
-    """One admitted replication layer (LSN-ordered rows with kinds)."""
+    """One admitted replication layer (LSN-ordered rows with kinds).
+    `locator` names the spilled blob (mvcc/spill.py) and `offsets`
+    the per-source-partition high offsets the rows covered — both ride
+    the admission record into the control-doc manifest."""
 
     table: str
     worker: str
@@ -162,6 +173,8 @@ class DeltaLayer:
     lsn_min: int = 0
     lsn_max: int = 0
     content_key: str = ""
+    locator: str = ""
+    offsets: dict = field(default_factory=dict)
 
     @property
     def rows(self) -> int:
@@ -169,10 +182,15 @@ class DeltaLayer:
 
     def meta(self) -> dict:
         """The JSON-plain admission record (abstract/mvccfence.py)."""
-        return {"worker": self.worker, "seq": self.seq,
-                "table": self.table, "lsn_min": self.lsn_min,
-                "lsn_max": self.lsn_max, "rows": self.rows,
-                "content_key": self.content_key}
+        m = {"worker": self.worker, "seq": self.seq,
+             "table": self.table, "lsn_min": self.lsn_min,
+             "lsn_max": self.lsn_max, "rows": self.rows,
+             "content_key": self.content_key}
+        if self.locator:
+            m["locator"] = self.locator
+        if self.offsets:
+            m["offsets"] = dict(self.offsets)
+        return m
 
 
 class MvccStore:
@@ -201,19 +219,58 @@ class MvccStore:
         self._doc = mvccfence.new_mvcc_doc()
         self._sealed: Optional[tuple[int, int]] = None
 
+    def spilling(self, environ=os.environ) -> bool:
+        """Whether landings spill through mvcc/spill.py: a blob-capable
+        coordinator, pyarrow importable, and the kill switch on."""
+        from transferia_tpu.interchange._pyarrow import have_pyarrow
+        from transferia_tpu.mvcc.spill import spill_enabled
+
+        return (self.cp is not None
+                and self.cp.supports_mvcc_blobs()
+                and have_pyarrow() and spill_enabled(environ))
+
     # -- base versions ------------------------------------------------------
     def put_base(self, table: str, part: str, epoch: int,
-                 batches: list[ColumnBatch]) -> BaseVersion:
+                 batches: list[ColumnBatch],
+                 locator: Optional[str] = None) -> BaseVersion:
         """Land one snapshot part as an immutable base layer.  The
         per-(table, part) epoch fence rejects zombie re-puts from
         before a reclaim; an equal/newer epoch REPLACES (idempotent
-        part retry — the part republishes wholesale)."""
+        part retry — the part republishes wholesale).  With spill on,
+        the encoded part also lands as a coordinator blob + manifest
+        record BEFORE the in-process install, so a worker death right
+        after this call can already rebuild it; a stale-epoch record
+        is fenced at the coordinator too (cross-process zombie).
+        `locator` marks an already-spilled landing (rebuild path) —
+        the manifest record exists, don't re-spill."""
         sp = trace.span("mvcc_put_base", table=table, part=part,
                         epoch=epoch)
         with sp:
             self._fence.check_and_advance(f"{table}/{part}", epoch)
             bv = BaseVersion(table=table, part=part, epoch=epoch,
                              batches=list(batches))
+            if locator is None and self.spilling():
+                from transferia_tpu.mvcc import spill as spill_mod
+
+                loc, nbytes = spill_mod.spill_blob(
+                    self.cp, self.scope,
+                    spill_mod.base_blob_name(table, part, epoch),
+                    bv.batches)
+                res = self.cp.mvcc_record_base(self.scope, {
+                    "table": table, "part": part, "epoch": epoch,
+                    "rows": bv.rows,
+                    "content_key": content_key(bv.batches),
+                    "locator": loc})
+                if res.get("status") == mvccfence.FENCED:
+                    from transferia_tpu.abstract.errors import (
+                        StaleEpochPublishError,
+                    )
+
+                    raise StaleEpochPublishError(
+                        f"{table}/{part}", epoch,
+                        int(res.get("epoch", 0)))
+                self.stats.spill_blobs.inc()
+                self.stats.spill_bytes.inc(nbytes)
             with self._lock:
                 self._bases.setdefault(table, {})[part] = bv
             self.stats.base_versions.inc()
@@ -224,17 +281,35 @@ class MvccStore:
 
     # -- delta layers -------------------------------------------------------
     def append_delta(self, table: str, worker: str, seq: int,
-                     batches: list[ColumnBatch]) -> dict:
+                     batches: list[ColumnBatch],
+                     offsets: Optional[dict] = None) -> dict:
         """Append one LSN-ordered delta layer.  Returns the admission
         decision dict; status "fenced" means the cutover already
         sealed and the layer was DISCARDED (zombie publish) — callers
         must not treat the rows as delivered.  Re-appending the same
-        (worker, seq) replaces (idempotent retry)."""
+        (worker, seq) replaces (idempotent retry).  `offsets` is the
+        replication pump's per-source-partition high offsets for the
+        rows — stored on the admission record so a resuming pump and
+        the cutover's fenced offset commit can both read them.  With
+        spill on, the encoded layer lands as a blob BEFORE admission —
+        the manifest never names a missing blob."""
         failpoint("mvcc.append")
         sp = trace.span("mvcc_append", table=table, worker=worker,
                         seq=seq)
         with sp:
             layer = self._build_layer(table, worker, seq, batches)
+            if offsets:
+                layer.offsets = {str(k): int(v)
+                                 for k, v in offsets.items()}
+            if self.spilling():
+                from transferia_tpu.mvcc import spill as spill_mod
+
+                layer.locator, nbytes = spill_mod.spill_blob(
+                    self.cp, self.scope,
+                    spill_mod.layer_blob_name(worker, seq),
+                    layer.batches)
+                self.stats.spill_blobs.inc()
+                self.stats.spill_bytes.inc(nbytes)
             if self.cp is not None:
                 decision = self.cp.mvcc_admit_layer(self.scope,
                                                     layer.meta())
@@ -245,6 +320,16 @@ class MvccStore:
             status = decision.get("status")
             if status == mvccfence.FENCED:
                 self.stats.layers_fenced.inc()
+                if layer.locator:
+                    # zombie publish: the blob never made the
+                    # manifest — GC the orphan (best-effort; an
+                    # unreachable coordinator leaves a dangling blob
+                    # no manifest record ever names)
+                    try:
+                        self.cp.delete_mvcc_blobs(self.scope,
+                                                  [layer.locator])
+                    except Exception:  # trtpu: ignore[EXC001] — best-effort GC; the dangling blob is unnamed by any record
+                        pass
                 if sp:
                     sp.add(status=status)
                 return decision
@@ -289,6 +374,34 @@ class MvccStore:
             lsn_min=lsn_lo or 0, lsn_max=lsn_hi or 0,
             content_key=content_key(batches))
 
+    def adopt_layer(self, rec: dict,
+                    batches: list[ColumnBatch]) -> DeltaLayer:
+        """Install one already-admitted layer from its manifest record
+        WITHOUT re-admission (the rebuild path, mvcc/spill.py): the
+        control doc already holds the record — re-admitting would
+        fence post-cutover — so the decoded batches just take their
+        original place in admission order."""
+        layer = DeltaLayer(
+            table=str(rec.get("table", "")),
+            worker=str(rec.get("worker", "")),
+            seq=int(rec.get("seq", -1)),
+            batches=list(batches),
+            lsn_min=int(rec.get("lsn_min", 0)),
+            lsn_max=int(rec.get("lsn_max", 0)),
+            content_key=str(rec.get("content_key", "")),
+            locator=str(rec.get("locator", "")),
+            offsets={str(k): int(v)
+                     for k, v in (rec.get("offsets") or {}).items()})
+        key = (layer.worker, layer.seq)
+        with self._lock:
+            if key not in self._layers:
+                self._order.append(key)
+            self._layers[key] = layer
+            self.stats.live_layers.set(len(self._layers))
+        self.stats.delta_layers.inc()
+        self.stats.delta_rows.inc(layer.rows)
+        return layer
+
     # -- control views ------------------------------------------------------
     def tables(self) -> list[str]:
         with self._lock:
@@ -312,36 +425,69 @@ class MvccStore:
                 return -1
             return max(la.lsn_max for la in self._layers.values())
 
+    def control_state(self) -> dict:
+        """JSON-plain view of the scope's control doc — coordinator
+        doc when fenced, the local doc otherwise (same shape either
+        way; abstract/mvccfence.state_view)."""
+        return (self.cp.mvcc_state(self.scope) if self.cp is not None
+                else mvccfence.state_view(self._doc))
+
     def sealed(self) -> Optional[tuple[int, int]]:
         """(watermark, epoch) of the sealed cutover, None before it."""
         if self._sealed is not None:
             return self._sealed
-        state = (self.cp.mvcc_state(self.scope) if self.cp is not None
-                 else mvccfence.state_view(self._doc))
+        state = self.control_state()
         cut = state.get("cutover")
         if cut:
             self._sealed = (int(cut["watermark"]), int(cut["epoch"]))
         return self._sealed
 
+    def local_offsets(self) -> dict:
+        """Per-source-partition high offsets over the layers THIS
+        store holds — max-merged, the value the cutover seals."""
+        out: dict[str, int] = {}
+        with self._lock:
+            for la in self._layers.values():
+                for part, off in la.offsets.items():
+                    cur = out.get(part)
+                    if cur is None or int(off) > cur:
+                        out[part] = int(off)
+        return out
+
+    def sealed_offsets(self) -> Optional[dict]:
+        """The source offsets sealed inside the cutover decision, None
+        before a seal.  These — never a pump's local view — are what
+        commits to the replication source."""
+        cut = self.control_state().get("cutover")
+        if not cut:
+            return None
+        return {str(k): int(v)
+                for k, v in (cut.get("offsets") or {}).items()}
+
     # -- cutover ------------------------------------------------------------
     def cutover(self, epoch: int,
-                watermark: Optional[int] = None) -> dict:
+                watermark: Optional[int] = None,
+                offsets: Optional[dict] = None) -> dict:
         """Seal the snapshot→replication handoff: the delta LSN
-        high-watermark and the staged-commit epoch become one atomic
-        coordinator decision.  Idempotent retry of the same decision
-        is granted; a different (watermark, epoch) after the seal is
-        fenced and receives the sealed values — the caller must adopt
-        them (exactly one cutover ever wins)."""
+        high-watermark, the staged-commit epoch AND the replication
+        source offsets become one atomic coordinator decision.
+        Idempotent retry of the same decision is granted; a different
+        (watermark, epoch) after the seal is fenced and receives the
+        sealed values — the caller must adopt them (exactly one
+        cutover ever wins, and the source offset commits inside it:
+        a zombie pump can neither double-deliver nor skip a window)."""
         failpoint("mvcc.cutover")
         sp = trace.span("mvcc_cutover", scope=self.scope, epoch=epoch)
         with sp:
             w = self.watermark() if watermark is None else int(watermark)
+            offs = self.local_offsets() if offsets is None else offsets
             if self.cp is not None:
-                decision = self.cp.mvcc_cutover(self.scope, w, epoch)
+                decision = self.cp.mvcc_cutover(self.scope, w, epoch,
+                                                offsets=offs)
             else:
                 with self._lock:
-                    decision = mvccfence.cutover_in_place(self._doc, w,
-                                                          epoch)
+                    decision = mvccfence.cutover_in_place(
+                        self._doc, w, epoch, offsets=offs)
             if decision.get("granted"):
                 self._sealed = (int(decision["watermark"]),
                                 int(decision["epoch"]))
@@ -464,6 +610,44 @@ class MvccStore:
             self._order = [k for k in self._order
                            if k in self._layers]
             self.stats.live_layers.set(len(self._layers))
+        # the compacted base spills like any landing, but EXCLUSIVE:
+        # its manifest record evicts the table's pre-compaction part
+        # records (their rows — minus folded deletes — are inside the
+        # fold; re-landing them on rebuild would resurrect rows), so a
+        # rebuild reads ONE base blob instead of bases + folded layers
+        self.spill_base(bv, exclusive=True)
         self.stats.compactions.inc()
         self.stats.compacted_rows.inc(sum(b.n_rows for b in merged))
         return folded
+
+    def spill_base(self, bv: BaseVersion,
+                   exclusive: bool = False) -> Optional[str]:
+        """Spill one installed base version to the coordinator blob +
+        manifest record (no-op when spill is off).  Equal/newer epochs
+        replace; the caller owns fence handling for older ones.
+        `exclusive` marks a compacted base: its record evicts the
+        table's other part records and their blobs are GC'd
+        (best-effort — an orphan blob no record names is harmless)."""
+        if not self.spilling():
+            return None
+        from transferia_tpu.mvcc import spill as spill_mod
+
+        loc, nbytes = spill_mod.spill_blob(
+            self.cp, self.scope,
+            spill_mod.base_blob_name(bv.table, bv.part, bv.epoch),
+            bv.batches)
+        rec = {"table": bv.table, "part": bv.part, "epoch": bv.epoch,
+               "rows": bv.rows, "content_key": content_key(bv.batches),
+               "locator": loc}
+        if exclusive:
+            rec["exclusive"] = True
+        res = self.cp.mvcc_record_base(self.scope, rec)
+        evicted = [x for x in (res.get("evicted") or []) if x != loc]
+        if evicted:
+            try:
+                self.cp.delete_mvcc_blobs(self.scope, evicted)
+            except Exception:  # trtpu: ignore[EXC001] — eviction GC is best-effort; orphan blobs are harmless
+                pass
+        self.stats.spill_blobs.inc()
+        self.stats.spill_bytes.inc(nbytes)
+        return loc

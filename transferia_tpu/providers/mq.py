@@ -201,6 +201,14 @@ class _MQClient:
     def commit(self, topic: str, partition: int, offset: int) -> None:
         self.broker.commit(self.group, topic, partition, offset)
 
+    def seek(self, topic: str, partition: int, offset: int) -> None:
+        """Reposition the fetch cursor (kafka consumer seek): the MVCC
+        pump resumes partitions from the offsets its admitted layers
+        already cover, not from the group's committed offset — those
+        only commit inside the cutover fence (mvcc/pump.py)."""
+        if topic == self.topic and partition in self.positions:
+            self.positions[partition] = int(offset)
+
     def close(self) -> None:
         pass
 

@@ -69,6 +69,33 @@ class FetchedBatch:
         return [m.offset for m in self.messages]
 
 
+def pump_checkpoint(fb: FetchedBatch,
+                    stats: Optional[SourceStats] = None,
+                    transfer_id: str = "") -> None:
+    """Per-fetched-batch pump bookkeeping, shared by every replication
+    pump over a fetch/commit client (the QueueSource below and the
+    MVCC activation pump, mvcc/pump.py): the `replication.pump`
+    failpoint — a kill between fetch and enqueue, which the resuming
+    pump must absorb by restarting from its last committed/admitted
+    offset — plus the trace instant and source counters."""
+    failpoint("replication.pump")
+    trace.instant("replication_pump", topic=fb.topic,
+                  partition=fb.partition,
+                  messages=len(fb.messages))
+    if stats is not None:
+        stats.changeitems.inc(len(fb.messages))
+        stats.read_bytes.inc(sum(len(m.value) for m in fb.messages))
+    if transfer_id:
+        # poll watermark: the newest broker write time seen for this
+        # partition — the stand-in event time for batches whose
+        # parser drops it
+        wm = max((m.write_time_ns for m in fb.messages), default=0)
+        if wm:
+            WATERMARKS.advance(
+                transfer_id, f"{POLL_PREFIX}{fb.topic}:{fb.partition}",
+                event_ns=wm, origin="poll")
+
+
 class QueueSource(Source):
     """Generic replication source over a fetch/commit client.
 
@@ -122,28 +149,10 @@ class QueueSource(Source):
                     self._stop.wait(self.stop_poll)
                     continue
                 for fb in fetched:
-                    failpoint("replication.pump")
-                    trace.instant("replication_pump", topic=fb.topic,
-                                  partition=fb.partition,
-                                  messages=len(fb.messages))
-                    self.stats.changeitems.inc(len(fb.messages))
-                    self.stats.read_bytes.inc(
-                        sum(len(m.value) for m in fb.messages)
-                    )
+                    pump_checkpoint(fb, self.stats, self.transfer_id)
                     self.sequencer.start_processing(
                         fb.topic, fb.partition, fb.offsets()
                     )
-                    if self.transfer_id:
-                        # poll watermark: the newest broker write time
-                        # seen for this partition — the stand-in event
-                        # time for batches whose parser drops it
-                        wm = max((m.write_time_ns for m in fb.messages),
-                                 default=0)
-                        if wm:
-                            WATERMARKS.advance(
-                                self.transfer_id,
-                                f"{POLL_PREFIX}{fb.topic}:{fb.partition}",
-                                event_ns=wm, origin="poll")
                     pq.add(fb)
             pq.wait()
             if pq.failure is not None:

@@ -430,6 +430,13 @@ class MvccStats(_Bundle):
         self.cutover_fenced = self.m.counter("mvcc_cutover_fenced")
         self.compactions = self.m.counter("mvcc_compactions")
         self.compacted_rows = self.m.counter("mvcc_compacted_rows")
+        self.spill_blobs = self.m.counter("mvcc_spill_blobs")
+        self.spill_bytes = self.m.counter("mvcc_spill_bytes")
+        self.rebuilds = self.m.counter("mvcc_rebuilds")
+        self.rebuilt_layers = self.m.counter("mvcc_rebuilt_layers")
+        self.pump_rows = self.m.counter("mvcc_pump_rows")
+        self.pump_layers = self.m.counter("mvcc_pump_layers")
+        self.offset_commits = self.m.counter("mvcc_offset_commits")
         self.live_layers = self.m.gauge("mvcc_live_layers")
         self.watermark_lag = self.m.gauge("mvcc_watermark_lag")
 
